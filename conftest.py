@@ -1,0 +1,23 @@
+"""Root conftest: force a virtual 8-device CPU platform for all tests.
+
+Real-TPU execution happens only in bench.py / __graft_entry__.entry(); tests exercise the
+multi-device sharding paths on the host (xla_force_host_platform_device_count), per the
+driver contract.
+
+The image's sitecustomize imports jax and registers the tunneled TPU backend before
+pytest starts, so plain env-var setdefaults are too late — we must update jax.config
+directly (safe as long as no backend has been initialized yet, which conftest import
+time guarantees).
+"""
+
+import os
+
+_platform = os.environ.get("SURGE_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)

@@ -1,0 +1,41 @@
+"""surge_tpu — a TPU-native CQRS / event-sourcing framework.
+
+A ground-up re-design of the capabilities of UltimateSoftware/surge (Scala/Akka/Kafka)
+for TPU hardware and the JAX/XLA compilation model:
+
+- Typed command engines with single-writer aggregates (asyncio tasks replace Akka actors).
+- Transactional event+state publishing to a replicated log (in-memory / file-backed log
+  transports with Kafka-compatible semantics: idempotent producers, epochs/fencing,
+  read-committed isolation).
+- KTable-style materialized state store with watermark bookkeeping.
+- The north-star workload: massively parallel aggregate-state replay — the per-aggregate
+  ``handle_event`` fold lifted into a batched ``jax.lax.scan`` over event tensors,
+  ``vmap``-ed across aggregates and sharded over a ``jax.sharding.Mesh``
+  (``replay_backend = "tpu"``).
+- Health supervision, metrics, W3C trace propagation, and a gRPC-shaped multilanguage
+  bridge, mirroring the reference's component inventory (see SURVEY.md §2).
+
+Reference parity pointers cite the Scala sources as ``file:line`` in docstrings.
+"""
+
+__version__ = "0.1.0"
+
+from surge_tpu.config import Config, default_config
+from surge_tpu.serialization import (
+    SerializedMessage,
+    SerializedAggregate,
+    AggregateReadFormatting,
+    AggregateWriteFormatting,
+    EventWriteFormatting,
+)
+
+__all__ = [
+    "Config",
+    "default_config",
+    "SerializedMessage",
+    "SerializedAggregate",
+    "AggregateReadFormatting",
+    "AggregateWriteFormatting",
+    "EventWriteFormatting",
+    "__version__",
+]

@@ -1,0 +1,47 @@
+"""Event/state schema registry and event→tensor codec.
+
+The reference's ``modules/serialization`` defines only a byte-level contract; replay there
+is a Kafka Streams RocksDB restore (SURVEY.md §3.3). The TPU build replaces bulk restore
+with a batched ``lax.scan`` fold over *tensor-encoded* events, so serialization gains a
+second, tensor-level contract:
+
+- :mod:`surge_tpu.codec.schema` — declarative schemas for event/state dataclasses
+  (numeric fields only on the tensor path; dictionary-encode strings via :class:`Vocab`).
+- :mod:`surge_tpu.codec.tensor` — struct-of-arrays encoding of ragged per-aggregate event
+  logs into dense ``[B, T]`` columns + mask + type ids (tagged unions for heterogeneous
+  event types), and the inverse for golden-value round-trip tests.
+"""
+
+from surge_tpu.codec.schema import (
+    FieldSpec,
+    EventSchema,
+    StateSchema,
+    SchemaRegistry,
+    Vocab,
+    event_fields_from_dataclass,
+)
+from surge_tpu.codec.tensor import (
+    PAD_TYPE_ID,
+    EncodedEvents,
+    encode_events,
+    decode_events,
+    encode_states,
+    decode_states,
+    bucket_lengths,
+)
+
+__all__ = [
+    "FieldSpec",
+    "EventSchema",
+    "StateSchema",
+    "SchemaRegistry",
+    "Vocab",
+    "event_fields_from_dataclass",
+    "PAD_TYPE_ID",
+    "EncodedEvents",
+    "encode_events",
+    "decode_events",
+    "encode_states",
+    "decode_states",
+    "bucket_lengths",
+]

@@ -1,0 +1,144 @@
+"""Struct-of-arrays encoding of ragged per-aggregate event logs.
+
+Layout (``EncodedEvents``), chosen for the TPU scan (SURVEY.md §7 "Event→tensor codec"):
+
+- ``type_ids``: int32 ``[B, T]`` — tagged-union discriminant; ``PAD_TYPE_ID`` (-1) marks
+  padding past each aggregate's log length.
+- ``cols``: dict of ``[B, T]`` arrays, one per union column (see
+  ``SchemaRegistry.union_columns``). Fields an event type lacks are zero-filled.
+- ``lengths``: int32 ``[B]`` — true log lengths (mask = position < length).
+
+B is the aggregate batch dimension (vmap/shard axis), T the time dimension (lax.scan
+axis). Encoding is pure NumPy on the host; the replay engine moves arrays to device and
+transposes to time-major itself.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from surge_tpu.codec.schema import SchemaRegistry, StateSchema
+
+PAD_TYPE_ID = -1
+
+
+@dataclass
+class EncodedEvents:
+    type_ids: np.ndarray  # [B, T] int32
+    cols: dict[str, np.ndarray]  # each [B, T]
+    lengths: np.ndarray  # [B] int32
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.type_ids.shape[0])
+
+    @property
+    def max_len(self) -> int:
+        return int(self.type_ids.shape[1])
+
+    def mask(self) -> np.ndarray:
+        """bool [B, T]: True where a real event exists."""
+        return self.type_ids != PAD_TYPE_ID
+
+    def nbytes(self) -> int:
+        return self.type_ids.nbytes + self.lengths.nbytes + sum(c.nbytes for c in self.cols.values())
+
+
+def encode_events(registry: SchemaRegistry, event_logs: Sequence[Sequence[Any]],
+                  pad_to: int | None = None) -> EncodedEvents:
+    """Encode ragged per-aggregate event lists into a dense tagged-union batch."""
+    b = len(event_logs)
+    lengths = np.asarray([len(log) for log in event_logs], dtype=np.int32)
+    t = int(pad_to) if pad_to is not None else int(lengths.max(initial=0))
+    if lengths.size and lengths.max(initial=0) > t:
+        raise ValueError(f"pad_to={t} < longest log {int(lengths.max())}")
+
+    type_ids = np.full((b, t), PAD_TYPE_ID, dtype=np.int32)
+    union = registry.union_columns()
+    cols = {f.name: np.zeros((b, t), dtype=f.dtype) for f in union}
+
+    for i, log in enumerate(event_logs):
+        for j, event in enumerate(log):
+            schema = registry.schema_for(event)
+            type_ids[i, j] = schema.type_id
+            for f in schema.fields:
+                cols[f.name][i, j] = schema.getter(event, f.name)
+    return EncodedEvents(type_ids=type_ids, cols=cols, lengths=lengths)
+
+
+def decode_events(registry: SchemaRegistry, enc: EncodedEvents) -> list[list[Any]]:
+    """Inverse of :func:`encode_events` — for golden round-trip tests."""
+    out: list[list[Any]] = []
+    for i in range(enc.batch_size):
+        log: list[Any] = []
+        for j in range(int(enc.lengths[i])):
+            tid = int(enc.type_ids[i, j])
+            schema = registry.schema_for_id(tid)
+            kwargs = {}
+            for f in schema.fields:
+                v = enc.cols[f.name][i, j]
+                if f.dtype.kind == "b":
+                    kwargs[f.name] = bool(v)
+                elif f.dtype.kind in "iu":
+                    kwargs[f.name] = int(v)
+                else:
+                    kwargs[f.name] = float(v)
+            log.append(_construct(schema.cls, kwargs))
+        out.append(log)
+    return out
+
+
+_EXCLUDED_DEFAULTS = {str: "", int: 0, float: 0.0, bool: False}
+
+
+def _construct(cls: type, kwargs: dict[str, Any]) -> Any:
+    """Build a dataclass instance, filling fields excluded from the tensor schema
+    (e.g. aggregate-id strings) with neutral defaults."""
+    import dataclasses
+
+    for f in dataclasses.fields(cls):
+        if f.name in kwargs:
+            continue
+        if f.default is not dataclasses.MISSING or f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+            continue
+        ann = f.type if isinstance(f.type, type) else {"str": str, "int": int,
+                                                       "float": float, "bool": bool}.get(str(f.type))
+        kwargs[f.name] = _EXCLUDED_DEFAULTS.get(ann, None)
+    return cls(**kwargs)
+
+
+def encode_states(schema: StateSchema, states: Sequence[Any]) -> dict[str, np.ndarray]:
+    """Batch scalar states into the dict-of-arrays carry pytree ``{name: [B]}``."""
+    out: dict[str, np.ndarray] = {}
+    for f in schema.fields:
+        out[f.name] = np.asarray([getattr(s, f.name) for s in states], dtype=f.dtype)
+    return out
+
+
+def decode_states(schema: StateSchema, tree: Mapping[str, np.ndarray]) -> list[Any]:
+    """Inverse of :func:`encode_states`."""
+    arrays = {f.name: np.asarray(tree[f.name]) for f in schema.fields}
+    b = len(next(iter(arrays.values()))) if arrays else 0
+    return [schema.from_record({n: a[i] for n, a in arrays.items()}) for i in range(b)]
+
+
+def bucket_lengths(lengths: Sequence[int], buckets: Sequence[int]) -> dict[int, list[int]]:
+    """Group aggregate indices into padded-length buckets (ragged batching).
+
+    Returns {bucket_cap: [indices]} where each log fits its bucket. Logs longer than the
+    largest bucket go into a final bucket rounded up to the next multiple of it.
+    """
+    if not buckets:
+        raise ValueError("need at least one bucket size")
+    caps = sorted(buckets)
+    groups: dict[int, list[int]] = {}
+    for idx, ln in enumerate(lengths):
+        cap = next((c for c in caps if ln <= c), None)
+        if cap is None:
+            biggest = caps[-1]
+            cap = ((ln + biggest - 1) // biggest) * biggest
+        groups.setdefault(cap, []).append(idx)
+    return groups

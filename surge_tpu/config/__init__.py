@@ -1,0 +1,190 @@
+"""Config system — typed accessors over layered key/value config with env overrides.
+
+Equivalent of the reference's Typesafe-Config (HOCON) ``reference.conf`` stack
+(modules/common/src/main/resources/reference.conf, modules/command-engine/core/src/main/
+resources/reference.conf) including the env-var-override-on-every-key pattern and the
+typed accessor objects (surge/internal/config/{TimeoutConfig,RetryConfig,BackoffConfig}.scala).
+
+Keys are dotted strings (``surge.producer.flush-interval-ms``). Resolution order:
+explicit overrides > environment (``SURGE_PRODUCER_FLUSH_INTERVAL_MS``) > defaults.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+
+def _env_key(key: str) -> str:
+    return key.upper().replace(".", "_").replace("-", "_")
+
+
+#: Defaults mirroring the reference's reference.conf files (values in ms unless noted).
+#: Citations: command-engine/core reference.conf:20-30 (flush interval, txn timeout,
+#: ktable lag check), common reference.conf:15-21 (streams commit interval), :133-142
+#: (aggregate init retries), :155-165 (ask timeout / passivation), :198-199 (restore
+#: max poll records), :228-260 (health windows).
+DEFAULTS: dict[str, Any] = {
+    # --- log / producer (reference: surge.kafka.publisher.*) ---
+    "surge.producer.flush-interval-ms": 50,
+    "surge.producer.batch-size": 16384,
+    "surge.producer.linger-ms": 5,
+    "surge.producer.transaction-timeout-ms": 60_000,
+    "surge.producer.slow-transaction-warning-ms": 1_000,
+    "surge.producer.ktable-check-interval-ms": 500,
+    "surge.producer.enable-transactions": True,
+    # --- state store / ktable (reference: surge.kafka-streams.*) ---
+    "surge.state-store.commit-interval-ms": 3_000,
+    "surge.state-store.standby-replicas": 0,
+    "surge.state-store.restore-max-poll-records": 500,
+    "surge.state-store.wipe-state-on-start": False,
+    "surge.state-store.backend": "memory",  # memory | native | rocks-like file store
+    # --- aggregate actor (reference: surge.state-store-actor.*) ---
+    "surge.aggregate.ask-timeout-ms": 30_000,
+    "surge.aggregate.idle-passivation-ms": 30_000,
+    "surge.aggregate.init-retry-interval-ms": 500,
+    "surge.aggregate.init-fetch-retry-ms": 2_000,
+    "surge.aggregate.init-max-attempts": 10,
+    "surge.aggregate.publish-max-retries": 3,
+    "surge.aggregate.publish-timeout-ms": 30_000,
+    "surge.aggregate.passivation-buffer-limit": 1000,
+    # --- serialization (core reference.conf:73-76) ---
+    "surge.serialization.thread-pool-size": 32,
+    # --- replay engine (new: the TPU north star; BASELINE.json replayBackend=tpu) ---
+    "surge.replay.backend": "tpu",  # tpu | cpu (scalar fold)
+    "surge.replay.batch-size": 8192,  # aggregates per device step
+    "surge.replay.time-chunk": 512,  # events scanned per lax.scan segment
+    "surge.replay.length-buckets": "64,256,1024,4096",
+    "surge.replay.mesh-axes": "data",
+    "surge.replay.donate-carry": True,
+    # --- health (common reference.conf:228-260) ---
+    "surge.health.window-frequency-ms": 10_000,
+    "surge.health.window-advance-ms": 10_000,
+    "surge.health.window-buffer-size": 10,
+    "surge.health.signal-buffer-size": 25,
+    "surge.health.supervisor-restart-max": 3,
+    # --- feature flags (core reference.conf:64-71) ---
+    "surge.feature-flags.experimental.enable-mesh-sharding": False,
+    "surge.feature-flags.experimental.disable-single-record-transactions": False,
+    # --- engine ---
+    "surge.engine.num-partitions": 8,
+    "surge.engine.dr-standby-enabled": False,
+}
+
+
+@dataclass
+class Config:
+    """Layered config: overrides > env > DEFAULTS."""
+
+    overrides: dict[str, Any] = field(default_factory=dict)
+    defaults: Mapping[str, Any] = field(default_factory=lambda: DEFAULTS)
+
+    def get(self, key: str, fallback: Any = None) -> Any:
+        if key in self.overrides:
+            return self.overrides[key]
+        env = os.environ.get(_env_key(key))
+        if env is not None:
+            return _coerce(env, self.defaults.get(key, fallback))
+        if key in self.defaults:
+            return self.defaults[key]
+        return fallback
+
+    def get_int(self, key: str, fallback: int = 0) -> int:
+        return int(self.get(key, fallback))
+
+    def get_float(self, key: str, fallback: float = 0.0) -> float:
+        return float(self.get(key, fallback))
+
+    def get_bool(self, key: str, fallback: bool = False) -> bool:
+        v = self.get(key, fallback)
+        if isinstance(v, str):
+            return v.strip().lower() in ("1", "true", "yes", "on")
+        return bool(v)
+
+    def get_str(self, key: str, fallback: str = "") -> str:
+        return str(self.get(key, fallback))
+
+    def get_int_list(self, key: str, fallback: str = "") -> list[int]:
+        raw = self.get_str(key, fallback)
+        return [int(p) for p in raw.split(",") if p.strip()]
+
+    def get_seconds(self, key: str, fallback_ms: int = 0) -> float:
+        """Millisecond config value as seconds (asyncio sleeps take seconds)."""
+        return self.get_int(key, fallback_ms) / 1000.0
+
+    def with_overrides(self, **kv: Any) -> "Config":
+        merged = dict(self.overrides)
+        merged.update({k.replace("_", "-") if False else k: v for k, v in kv.items()})
+        return Config(overrides=merged, defaults=self.defaults)
+
+
+def _coerce(env_value: str, exemplar: Any) -> Any:
+    """Coerce an env-var string to the type of the default it overrides."""
+    if isinstance(exemplar, bool):
+        return env_value.strip().lower() in ("1", "true", "yes", "on")
+    if isinstance(exemplar, int):
+        try:
+            return int(env_value)
+        except ValueError:
+            return env_value
+    if isinstance(exemplar, float):
+        try:
+            return float(env_value)
+        except ValueError:
+            return env_value
+    return env_value
+
+
+_DEFAULT = Config()
+
+
+def default_config() -> Config:
+    return _DEFAULT
+
+
+# --- Typed accessor bundles (surge/internal/config/*.scala equivalents) ---
+
+
+@dataclass(frozen=True)
+class TimeoutConfig:
+    """surge/internal/config/TimeoutConfig.scala equivalent."""
+
+    ask_timeout_s: float
+    publish_timeout_s: float
+
+    @staticmethod
+    def from_config(cfg: Config) -> "TimeoutConfig":
+        return TimeoutConfig(
+            ask_timeout_s=cfg.get_seconds("surge.aggregate.ask-timeout-ms"),
+            publish_timeout_s=cfg.get_seconds("surge.aggregate.publish-timeout-ms"),
+        )
+
+
+@dataclass(frozen=True)
+class RetryConfig:
+    """surge/internal/config/RetryConfig.scala equivalent."""
+
+    init_retry_interval_s: float
+    init_fetch_retry_s: float
+    init_max_attempts: int
+    publish_max_retries: int
+
+    @staticmethod
+    def from_config(cfg: Config) -> "RetryConfig":
+        return RetryConfig(
+            init_retry_interval_s=cfg.get_seconds("surge.aggregate.init-retry-interval-ms"),
+            init_fetch_retry_s=cfg.get_seconds("surge.aggregate.init-fetch-retry-ms"),
+            init_max_attempts=cfg.get_int("surge.aggregate.init-max-attempts"),
+            publish_max_retries=cfg.get_int("surge.aggregate.publish-max-retries"),
+        )
+
+
+@dataclass(frozen=True)
+class BackoffConfig:
+    """surge/internal/config/BackoffConfig.scala equivalent (BackoffSupervisor knobs)."""
+
+    min_backoff_s: float = 0.1
+    max_backoff_s: float = 10.0
+    random_factor: float = 0.2
+    max_retries: int = 3

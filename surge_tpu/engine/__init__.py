@@ -1,0 +1,29 @@
+"""Command engine core — the L3/L4 equivalent of the reference's command-engine modules.
+
+- :mod:`surge_tpu.engine.model` — user-facing processing-model API
+  (scaladsl/command/CommandModels.scala:12-74 equivalents) plus the TPU replay spec.
+- :mod:`surge_tpu.engine.entity` — per-aggregate single-writer entity
+  (internal/persistence/PersistentActor.scala).
+- :mod:`surge_tpu.engine.publisher` — transactional partition publisher FSM
+  (internal/kafka/KafkaProducerActorImpl.scala).
+- :mod:`surge_tpu.engine.pipeline` — engine lifecycle wiring
+  (internal/domain/SurgeMessagePipeline.scala).
+"""
+
+from surge_tpu.engine.model import (
+    AggregateCommandModel,
+    AsyncAggregateCommandModel,
+    AggregateEventModel,
+    RejectedCommand,
+    ReplayHandlers,
+    ReplaySpec,
+)
+
+__all__ = [
+    "AggregateCommandModel",
+    "AsyncAggregateCommandModel",
+    "AggregateEventModel",
+    "RejectedCommand",
+    "ReplayHandlers",
+    "ReplaySpec",
+]

@@ -1,0 +1,15 @@
+"""Conformance fixture model families, mirroring the reference's test/doc aggregates:
+
+- :mod:`surge_tpu.models.counter` — the Counter bounded context
+  (command-engine/core/src/test/scala/surge/core/TestBoundedContext.scala:17-82),
+  including the poison commands/events its tests rely on.
+- :mod:`surge_tpu.models.bank_account` — the BankAccount docs sample
+  (surge-docs/src/test/scala/docs/command/BankAccountCommandModel.scala:53-88).
+- :mod:`surge_tpu.models.shopping_cart` — variable-length-log aggregate for
+  ragged/segmented replay (BASELINE.json config "ShoppingCart aggregate").
+
+Each family ships the scalar model (engine steady state) AND the JAX ReplaySpec
+(TPU batched replay) over the same event schema — golden tests assert the two folds agree.
+"""
+
+from surge_tpu.models import counter, bank_account, shopping_cart  # noqa: F401

@@ -1,0 +1,217 @@
+"""BankAccount docs-sample parity fixture — BankAccountCommandModel.scala:53-88.
+
+Semantics preserved exactly:
+- CreateAccount on an existing account emits no events (idempotent create).
+- Credit/Debit on a missing account reject (AccountDoesNotExistException analog).
+- Debit with insufficient funds rejects (InsufficientFundsException analog).
+- BankAccountCreated replaces the state; BankAccountUpdated sets the balance only when
+  the account exists (``aggregate.map(_.copy(...))``).
+
+On the tensor path, strings (owner, security code) are dictionary-encoded via Vocab and
+the "exists" optionality becomes an explicit ``created`` flag column. Balances are float32
+on the tensor path (see tests for the exactness/tolerance discipline).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from surge_tpu.codec.schema import SchemaRegistry, Vocab
+from surge_tpu.engine.model import RejectedCommand, ReplayHandlers, ReplaySpec
+from surge_tpu.serialization import JsonEventFormatting, JsonFormatting
+
+
+@dataclass(frozen=True)
+class BankAccount:
+    account_number: str
+    account_owner: str
+    security_code: str
+    balance: float
+
+
+@dataclass(frozen=True)
+class CreateAccount:
+    account_number: str
+    account_owner: str
+    security_code: str
+    initial_balance: float
+
+
+@dataclass(frozen=True)
+class CreditAccount:
+    account_number: str
+    amount: float
+
+
+@dataclass(frozen=True)
+class DebitAccount:
+    account_number: str
+    amount: float
+
+
+@dataclass(frozen=True)
+class BankAccountCreated:
+    account_number: str
+    account_owner: str
+    security_code: str
+    balance: float
+
+
+@dataclass(frozen=True)
+class BankAccountUpdated:
+    account_number: str
+    new_balance: float
+
+
+class AccountDoesNotExist(RejectedCommand):
+    pass
+
+
+class InsufficientFunds(RejectedCommand):
+    pass
+
+
+class BankAccountModel:
+    """Scalar model — processCommand/handleEvent parity with BankAccountCommandModel.scala:54-88."""
+
+    def initial_state(self, aggregate_id: str) -> Optional[BankAccount]:
+        return None
+
+    def process_command(self, state: Optional[BankAccount], command) -> Sequence[object]:
+        if isinstance(command, CreateAccount):
+            if state is not None:
+                return []
+            return [BankAccountCreated(command.account_number, command.account_owner,
+                                       command.security_code, command.initial_balance)]
+        if isinstance(command, CreditAccount):
+            if state is None:
+                raise AccountDoesNotExist(command.account_number)
+            return [BankAccountUpdated(state.account_number, state.balance + command.amount)]
+        if isinstance(command, DebitAccount):
+            if state is None:
+                raise AccountDoesNotExist(command.account_number)
+            if state.balance < command.amount:
+                raise InsufficientFunds(state.account_number)
+            return [BankAccountUpdated(state.account_number, state.balance - command.amount)]
+        raise RejectedCommand(f"unknown command {command!r}")
+
+    def handle_event(self, state: Optional[BankAccount], event) -> Optional[BankAccount]:
+        if isinstance(event, BankAccountCreated):
+            return BankAccount(event.account_number, event.account_owner,
+                               event.security_code, event.balance)
+        if isinstance(event, BankAccountUpdated):
+            if state is None:
+                return None
+            return BankAccount(state.account_number, state.account_owner,
+                               state.security_code, event.new_balance)
+        return state
+
+    def replay_spec(self) -> ReplaySpec:
+        return make_replay_spec()
+
+
+# --- tensor path -----------------------------------------------------------------------
+
+CREATED, UPDATED = 0, 1
+
+
+@dataclass(frozen=True)
+class EncodedAccountState:
+    """Tensor-side state record (the scalar BankAccount with strings vocab-encoded)."""
+
+    created: bool
+    owner_code: int
+    security_code_code: int
+    balance: float
+
+
+@dataclass(frozen=True)
+class EncodedCreated:
+    owner_code: int
+    security_code_code: int
+    balance: float
+
+
+@dataclass(frozen=True)
+class EncodedUpdated:
+    new_balance: float
+
+
+def make_registry() -> SchemaRegistry:
+    reg = SchemaRegistry()
+    reg.register_event(EncodedCreated, type_id=CREATED)
+    reg.register_event(EncodedUpdated, type_id=UPDATED)
+    reg.register_state(EncodedAccountState)
+    return reg
+
+
+def encode_event(vocab: Vocab, event):
+    """Host-side vocab encoding of the domain events into their tensor forms."""
+    if isinstance(event, BankAccountCreated):
+        return EncodedCreated(owner_code=vocab.encode(event.account_owner),
+                              security_code_code=vocab.encode(event.security_code),
+                              balance=np.float32(event.balance))
+    if isinstance(event, BankAccountUpdated):
+        return EncodedUpdated(new_balance=np.float32(event.new_balance))
+    raise TypeError(f"not a bank account event: {event!r}")
+
+
+def decode_state(vocab: Vocab, account_number: str, rec: EncodedAccountState) -> Optional[BankAccount]:
+    if not rec.created:
+        return None
+    return BankAccount(account_number=account_number,
+                       account_owner=vocab.decode(rec.owner_code),
+                       security_code=vocab.decode(rec.security_code_code),
+                       balance=float(rec.balance))
+
+
+def make_replay_spec() -> ReplaySpec:
+    import jax.numpy as jnp
+
+    def created(s, f):
+        return {"created": jnp.asarray(True),
+                "owner_code": f["owner_code"],
+                "security_code_code": f["security_code_code"],
+                "balance": f["balance"]}
+
+    def updated(s, f):
+        # aggregate.map(_.copy(balance = newBalance)): no-op when account absent
+        return {"created": s["created"],
+                "owner_code": s["owner_code"],
+                "security_code_code": s["security_code_code"],
+                "balance": jnp.where(s["created"], f["new_balance"], s["balance"])}
+
+    return ReplaySpec(
+        registry=make_registry(),
+        handlers=ReplayHandlers({CREATED: created, UPDATED: updated}),
+        init_record={"created": False, "owner_code": 0, "security_code_code": 0, "balance": 0.0},
+    )
+
+
+# --- byte formats ---
+
+_EVENTS = {c.__name__: c for c in (BankAccountCreated, BankAccountUpdated)}
+
+
+def state_formatting() -> JsonFormatting:
+    return JsonFormatting(
+        to_dict=lambda s: {"account_number": s.account_number, "account_owner": s.account_owner,
+                           "security_code": s.security_code, "balance": s.balance},
+        from_dict=lambda d: BankAccount(**d))
+
+
+def event_formatting() -> JsonEventFormatting:
+    def to_dict(e):
+        d = {k: getattr(e, k) for k in e.__dataclass_fields__}
+        d["_type"] = type(e).__name__
+        return d
+
+    def from_dict(d):
+        d = dict(d)
+        return _EVENTS[d.pop("_type")](**d)
+
+    return JsonEventFormatting(to_dict=to_dict, from_dict=from_dict,
+                               key_of=lambda e: e.account_number)

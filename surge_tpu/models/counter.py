@@ -1,0 +1,205 @@
+"""Counter bounded context — parity fixture for TestBoundedContext.scala:17-175.
+
+State(aggregate_id, count, version); Increment/Decrement/DoNothing commands; poison
+commands (FailCommandProcessing, CreateExceptionThrowingEvent, CreateUnserializableEvent)
+used by engine failure-path tests, exactly as the reference's specs use them
+(TestBoundedContext.scala:39-43).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from surge_tpu.codec.schema import SchemaRegistry
+from surge_tpu.engine.model import RejectedCommand, ReplayHandlers, ReplaySpec
+from surge_tpu.serialization import JsonEventFormatting, JsonFormatting
+
+
+# --- domain types (TestBoundedContext.scala:18-66) ---
+
+
+@dataclass(frozen=True)
+class State:
+    aggregate_id: str
+    count: int
+    version: int
+
+
+@dataclass(frozen=True)
+class Increment:
+    aggregate_id: str
+
+
+@dataclass(frozen=True)
+class Decrement:
+    aggregate_id: str
+
+
+@dataclass(frozen=True)
+class DoNothing:
+    aggregate_id: str
+
+
+@dataclass(frozen=True)
+class CreateNoOpEvent:
+    aggregate_id: str
+
+
+@dataclass(frozen=True)
+class FailCommandProcessing:
+    aggregate_id: str
+    error_msg: str
+
+
+@dataclass(frozen=True)
+class CreateExceptionThrowingEvent:
+    aggregate_id: str
+    error_msg: str
+
+
+@dataclass(frozen=True)
+class CreateUnserializableEvent:
+    aggregate_id: str
+    error_msg: str
+
+
+@dataclass(frozen=True)
+class CountIncremented:
+    aggregate_id: str
+    increment_by: int
+    sequence_number: int
+
+
+@dataclass(frozen=True)
+class CountDecremented:
+    aggregate_id: str
+    decrement_by: int
+    sequence_number: int
+
+
+@dataclass(frozen=True)
+class NoOpEvent:
+    aggregate_id: str
+    sequence_number: int
+
+
+class ExceptionThrowingError(RuntimeError):
+    """Raised when an ExceptionThrowingEvent is folded (fault-injection fixture)."""
+
+
+@dataclass(frozen=True)
+class ExceptionThrowingEvent:
+    aggregate_id: str
+    sequence_number: int
+    error_msg: str
+
+
+@dataclass(frozen=True)
+class UnserializableEvent:
+    aggregate_id: str
+    sequence_number: int
+    error_msg: str
+
+
+# --- scalar model (TestBoundedContext BusinessLogicTrait handleEvent/processCommand) ---
+
+
+class CounterModel:
+    def initial_state(self, aggregate_id: str) -> Optional[State]:
+        return None
+
+    def process_command(self, state: Optional[State], command) -> Sequence[object]:
+        agg_id = command.aggregate_id
+        seq = (state.version if state else 0) + 1
+        if isinstance(command, Increment):
+            return [CountIncremented(agg_id, 1, seq)]
+        if isinstance(command, Decrement):
+            return [CountDecremented(agg_id, 1, seq)]
+        if isinstance(command, DoNothing):
+            return []
+        if isinstance(command, CreateNoOpEvent):
+            return [NoOpEvent(agg_id, seq)]
+        if isinstance(command, FailCommandProcessing):
+            raise RejectedCommand(command.error_msg)
+        if isinstance(command, CreateExceptionThrowingEvent):
+            return [ExceptionThrowingEvent(agg_id, seq, command.error_msg)]
+        if isinstance(command, CreateUnserializableEvent):
+            return [UnserializableEvent(agg_id, seq, command.error_msg)]
+        raise RejectedCommand(f"unknown command {command!r}")
+
+    def handle_event(self, state: Optional[State], event) -> Optional[State]:
+        current = state if state is not None else State(event.aggregate_id, 0, 0)
+        if isinstance(event, CountIncremented):
+            return State(current.aggregate_id, current.count + event.increment_by, event.sequence_number)
+        if isinstance(event, CountDecremented):
+            return State(current.aggregate_id, current.count - event.decrement_by, event.sequence_number)
+        if isinstance(event, NoOpEvent):
+            return current
+        if isinstance(event, UnserializableEvent):
+            return State(current.aggregate_id, current.count, event.sequence_number)
+        if isinstance(event, ExceptionThrowingEvent):
+            raise ExceptionThrowingError(event.error_msg)
+        return current
+
+    # -- TPU replay contract --------------------------------------------------------
+    def replay_spec(self) -> ReplaySpec:
+        return make_replay_spec()
+
+
+# --- tensor schemas + JAX fold ---
+
+INCREMENTED, DECREMENTED, NOOP = 0, 1, 2
+
+
+def make_registry() -> SchemaRegistry:
+    reg = SchemaRegistry()
+    reg.register_event(CountIncremented, type_id=INCREMENTED, exclude=("aggregate_id",))
+    reg.register_event(CountDecremented, type_id=DECREMENTED, exclude=("aggregate_id",))
+    reg.register_event(NoOpEvent, type_id=NOOP, exclude=("aggregate_id",))
+    reg.register_state(State, exclude=("aggregate_id",))
+    return reg
+
+
+def make_replay_spec() -> ReplaySpec:
+    def incremented(s, f):
+        return {"count": s["count"] + f["increment_by"], "version": f["sequence_number"]}
+
+    def decremented(s, f):
+        return {"count": s["count"] - f["decrement_by"], "version": f["sequence_number"]}
+
+    return ReplaySpec(
+        registry=make_registry(),
+        handlers=ReplayHandlers({INCREMENTED: incremented, DECREMENTED: decremented}),
+        init_record={"count": 0, "version": 0},
+    )
+
+
+# --- byte formats (play-json Format equivalents, TestBoundedContext.scala:84-110) ---
+
+_EVENT_TYPES = {c.__name__: c for c in (CountIncremented, CountDecremented, NoOpEvent,
+                                        ExceptionThrowingEvent, UnserializableEvent)}
+
+
+def _event_to_dict(e) -> dict:
+    d = dict(e.__dict__) if not hasattr(e, "__dataclass_fields__") else {
+        k: getattr(e, k) for k in e.__dataclass_fields__}
+    d["_type"] = type(e).__name__
+    return d
+
+
+def _event_from_dict(d: dict):
+    d = dict(d)
+    cls = _EVENT_TYPES[d.pop("_type")]
+    return cls(**d)
+
+
+def state_formatting() -> JsonFormatting:
+    return JsonFormatting(
+        to_dict=lambda s: {"aggregate_id": s.aggregate_id, "count": s.count, "version": s.version},
+        from_dict=lambda d: State(**d))
+
+
+def event_formatting() -> JsonEventFormatting:
+    return JsonEventFormatting(to_dict=_event_to_dict, from_dict=_event_from_dict,
+                               key_of=lambda e: e.aggregate_id)

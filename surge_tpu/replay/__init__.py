@@ -1,0 +1,23 @@
+"""TPU replay engine — batched aggregate-state reconstruction (the north star).
+
+The reference rebuilds materialized state by a Kafka Streams restore: a scalar
+per-aggregate ``handleEvent`` fold while scanning the log (SURVEY.md §3.3). Here that
+fold is lifted onto the TPU:
+
+- per-event-type JAX handlers → one step function via ``lax.switch`` (tagged union),
+- ``jax.vmap`` across the aggregate batch dimension B,
+- ``jax.lax.scan`` across the time dimension T (time-major event columns),
+- padding masked by ``type_id == PAD_TYPE_ID`` (state carried through unchanged),
+- carry donation + time-chunked streaming so a log bigger than HBM folds in segments,
+- optional ``jax.sharding.Mesh`` data-parallel sharding of B (embarrassingly parallel;
+  XLA inserts no collectives on the hot path).
+"""
+
+from surge_tpu.replay.engine import (
+    ReplayEngine,
+    ReplayResult,
+    make_step_fn,
+    make_batch_fold,
+)
+
+__all__ = ["ReplayEngine", "ReplayResult", "make_step_fn", "make_batch_fold"]

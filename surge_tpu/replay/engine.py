@@ -1,0 +1,264 @@
+"""Core batched fold: vmap(switch-step) scanned over time-major event columns."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from surge_tpu.codec.tensor import PAD_TYPE_ID, EncodedEvents, bucket_lengths, encode_states
+from surge_tpu.config import Config, default_config
+from surge_tpu.engine.model import ReplaySpec, StateTree
+
+
+def make_step_fn(spec: ReplaySpec) -> Callable[[StateTree, Mapping[str, Any]], StateTree]:
+    """One-event step for a single aggregate: dispatch on type_id, mask padding.
+
+    The returned function is scalar over the batch dim (engine vmaps it). Padding
+    (``type_id == PAD_TYPE_ID``) must leave state untouched — scans run to the padded
+    length for every lane.
+    """
+    num_types = spec.registry.num_event_types
+    handlers = spec.handlers.ordered(num_types)
+    state_fields = spec.registry.state.field_names
+
+    def normalize(new: StateTree, old: StateTree) -> StateTree:
+        # handlers may return partial dicts; missing columns carry through, and dtypes
+        # are pinned to the schema so the scan carry shape is stable
+        out = {}
+        for name in state_fields:
+            v = new.get(name, old[name])
+            out[name] = jnp.asarray(v, dtype=old[name].dtype)
+        return out
+
+    def step(state: StateTree, event: Mapping[str, Any]) -> StateTree:
+        tid = event["type_id"]
+        branch = jnp.clip(tid, 0, num_types - 1)
+        fields = {k: v for k, v in event.items() if k != "type_id"}
+        wrapped = [
+            (lambda h: lambda s: normalize(h(s, fields), s))(h) for h in handlers
+        ]
+        new_state = jax.lax.switch(branch, wrapped, state)
+        is_real = tid != PAD_TYPE_ID
+        return {k: jnp.where(is_real, new_state[k], state[k]) for k in state}
+
+    return step
+
+
+def make_batch_fold(spec: ReplaySpec, *, unroll: int = 1):
+    """Batched fold: ``(carry {name:[B]}, events {col:[T,B]}) -> carry``.
+
+    The per-aggregate fold of CommandModels.scala:20-21 / PersistentActor's applyEvents,
+    vectorized: ``lax.scan`` over T of ``vmap``-over-B of the switch step. jit-compiled by
+    the caller (ReplayEngine) with carry donation.
+    """
+    step = make_step_fn(spec)
+    vstep = jax.vmap(step, in_axes=(0, 0))
+
+    def fold(carry: StateTree, events: Mapping[str, jnp.ndarray]) -> StateTree:
+        def scan_body(c, ev_t):
+            return vstep(c, ev_t), None
+
+        out, _ = jax.lax.scan(scan_body, carry, events, unroll=unroll)
+        return out
+
+    return fold
+
+
+@dataclass
+class ReplayResult:
+    """Folded states + accounting for throughput metrics."""
+
+    states: dict[str, np.ndarray]  # {col: [B]} in the original aggregate order
+    num_aggregates: int
+    num_events: int
+    padded_events: int  # B*T actually scanned (padding overhead indicator)
+
+
+class ReplayEngine:
+    """Drives batched replay for one model family.
+
+    Equivalent role: the bulk-restore path of AggregateStateStoreKafkaStreams
+    (common/.../kafka/streams/AggregateStateStoreKafkaStreams.scala:53-178) with
+    ``replayBackend = tpu`` (BASELINE.json). Consumes ``EncodedEvents`` batches (from
+    surge_tpu.codec) and produces state columns; the KTable-equivalent store ingests the
+    writeback.
+
+    Parameters
+    ----------
+    spec: the model's ReplaySpec.
+    config: batch size / time chunk / bucket knobs (``surge.replay.*``).
+    mesh: optional ``jax.sharding.Mesh``; batch dim B is sharded over ``mesh_axis``.
+    """
+
+    def __init__(self, spec: ReplaySpec, config: Config | None = None,
+                 mesh: Optional[jax.sharding.Mesh] = None, mesh_axis: str = "data",
+                 unroll: int = 1) -> None:
+        self.spec = spec
+        self.config = config or default_config()
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        self.time_chunk = self.config.get_int("surge.replay.time-chunk")
+        self.batch_size = self.config.get_int("surge.replay.batch-size")
+        self.buckets = self.config.get_int_list("surge.replay.length-buckets", "64,256,1024,4096")
+
+        fold = make_batch_fold(spec, unroll=unroll)
+        if mesh is not None:
+            pspec = jax.sharding.PartitionSpec(mesh_axis)
+            sharding = jax.sharding.NamedSharding(mesh, pspec)
+            carry_sh = jax.tree_util.tree_map(lambda _: sharding, self._carry_struct())
+            self._fold = jax.jit(fold, donate_argnums=(0,),
+                                 in_shardings=(carry_sh, None), out_shardings=carry_sh)
+            self._sharding = sharding
+        else:
+            self._fold = jax.jit(fold, donate_argnums=(0,))
+            self._sharding = None
+
+    # -- helpers ------------------------------------------------------------------------
+
+    def _carry_struct(self) -> StateTree:
+        return {f.name: None for f in self.spec.registry.state.fields}
+
+    def _lane_multiple(self) -> int:
+        """Pad B to a multiple of device count (for even mesh sharding) × 8."""
+        n = 1 if self.mesh is None else int(np.prod(self.mesh.devices.shape))
+        return max(8 * n, n)
+
+    def init_carry(self, batch: int) -> StateTree:
+        init = self.spec.init_state_tree()
+        carry = {k: jnp.broadcast_to(jnp.asarray(v), (batch,)) for k, v in init.items()}
+        if self._sharding is not None:
+            carry = jax.device_put(carry, self._sharding)
+        return {k: jnp.asarray(v) for k, v in carry.items()}
+
+    def carry_from_states(self, states: Sequence[Any]) -> StateTree:
+        """Resume from snapshots (checkpointed carry, SURVEY.md §5.4 TPU mapping)."""
+        tree = encode_states(self.spec.registry.state, states)
+        return {k: jnp.asarray(v) for k, v in tree.items()}
+
+    # -- core entry points --------------------------------------------------------------
+
+    def replay_encoded(self, enc: EncodedEvents,
+                       init_carry: StateTree | None = None) -> ReplayResult:
+        """Fold one encoded batch. Time axis is chunked to ``time_chunk`` so arbitrarily
+        long (padded) logs stream through a fixed-size compiled program."""
+        b, t = enc.batch_size, enc.max_len
+        pad_b = -b % self._lane_multiple()
+        bp = b + pad_b
+
+        type_ids = np.full((bp, t), PAD_TYPE_ID, dtype=np.int32)
+        type_ids[:b] = enc.type_ids
+        cols = {}
+        for name, col in enc.cols.items():
+            buf = np.zeros((bp, t), dtype=col.dtype)
+            buf[:b] = col
+            cols[name] = buf
+
+        carry = init_carry if init_carry is not None else self.init_carry(bp)
+        if init_carry is not None and next(iter(carry.values())).shape[0] != bp:
+            carry = {k: jnp.concatenate(
+                [jnp.asarray(v), jnp.zeros((bp - v.shape[0],), dtype=v.dtype)])
+                for k, v in carry.items()}
+        if self._sharding is not None:
+            carry = jax.device_put(carry, self._sharding)
+
+        chunk = self.time_chunk if self.time_chunk > 0 else t
+        for start in range(0, t, max(chunk, 1)):
+            stop = min(start + chunk, t)
+            width = stop - start
+            # keep the compiled program count low: pad the tail chunk to full width
+            ev = {"type_id": _time_major(type_ids, start, stop, chunk, PAD_TYPE_ID)}
+            for name, col in cols.items():
+                ev[name] = _time_major(col, start, stop, chunk, 0)
+            if self._sharding is not None:
+                col_sh = jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec(None, self.mesh_axis))
+                ev = {k: jax.device_put(v, col_sh) for k, v in ev.items()}
+            carry = self._fold(carry, ev)
+            del width
+
+        states = {k: np.asarray(v)[:b] for k, v in carry.items()}
+        return ReplayResult(states=states, num_aggregates=b,
+                            num_events=int(enc.lengths.sum()), padded_events=bp * t)
+
+    def replay_ragged(self, registry_enc_logs: Sequence[Sequence[Any]],
+                      encode=None) -> ReplayResult:
+        """Length-bucketed replay of ragged logs (SURVEY.md §5.7).
+
+        Groups aggregates by log length into padded buckets, folds each bucket, and
+        scatters results back into original order.
+        """
+        from surge_tpu.codec.tensor import encode_events
+
+        logs = registry_enc_logs
+        lengths = [len(l) for l in logs]
+        groups = bucket_lengths(lengths, self.buckets)
+        state_fields = self.spec.registry.state.fields
+        out = {f.name: np.zeros((len(logs),), dtype=f.dtype) for f in state_fields}
+        total_events = 0
+        padded = 0
+        for cap in sorted(groups):
+            idxs = groups[cap]
+            sub = [logs[i] for i in idxs]
+            enc = encode_events(self.spec.registry, sub, pad_to=cap)
+            res = self.replay_encoded(enc)
+            for name in out:
+                out[name][idxs] = res.states[name]
+            total_events += res.num_events
+            padded += res.padded_events
+        return ReplayResult(states=out, num_aggregates=len(logs),
+                            num_events=total_events, padded_events=padded)
+
+    def replay_stream(self, chunks, batch: int) -> ReplayResult:
+        """Fold a stream of EncodedEvents chunks (same B, consecutive time windows),
+        carrying state across chunks — the 100M-event-log path where the whole encoded
+        log never exists in HBM at once."""
+        carry = None
+        total_events = 0
+        padded = 0
+        bp = None
+        for enc in chunks:
+            if carry is None:
+                b = enc.batch_size
+                pad_b = -b % self._lane_multiple()
+                bp = b + pad_b
+                carry = self.init_carry(bp)
+            res_carry = self._fold_chunk(carry, enc, bp)
+            carry = res_carry
+            total_events += int(enc.lengths.sum())
+            padded += bp * enc.max_len
+        if carry is None:
+            raise ValueError("empty chunk stream")
+        states = {k: np.asarray(v)[:batch] for k, v in carry.items()}
+        return ReplayResult(states=states, num_aggregates=batch,
+                            num_events=total_events, padded_events=padded)
+
+    def _fold_chunk(self, carry: StateTree, enc: EncodedEvents, bp: int) -> StateTree:
+        b, t = enc.batch_size, enc.max_len
+        type_ids = np.full((bp, t), PAD_TYPE_ID, dtype=np.int32)
+        type_ids[:b] = enc.type_ids
+        ev = {"type_id": np.ascontiguousarray(type_ids.T)}
+        for name, col in enc.cols.items():
+            buf = np.zeros((bp, t), dtype=col.dtype)
+            buf[:b] = col
+            ev[name] = np.ascontiguousarray(buf.T)
+        if self._sharding is not None:
+            col_sh = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec(None, self.mesh_axis))
+            ev = {k: jax.device_put(v, col_sh) for k, v in ev.items()}
+        return self._fold(carry, ev)
+
+
+def _time_major(col: np.ndarray, start: int, stop: int, chunk: int, pad_value) -> np.ndarray:
+    """Slice [B, start:stop], pad to ``chunk`` wide, return time-major [chunk, B]."""
+    piece = col[:, start:stop]
+    width = stop - start
+    if chunk and width < chunk:
+        pad = np.full((col.shape[0], chunk - width), pad_value, dtype=col.dtype)
+        piece = np.concatenate([piece, pad], axis=1)
+    return np.ascontiguousarray(piece.T)

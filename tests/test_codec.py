@@ -1,0 +1,87 @@
+"""Codec golden tests: scalar↔tensor round trips (SURVEY.md §7 step 1)."""
+
+import numpy as np
+import pytest
+
+from surge_tpu.codec import (
+    SchemaRegistry,
+    Vocab,
+    bucket_lengths,
+    decode_events,
+    decode_states,
+    encode_events,
+    encode_states,
+    PAD_TYPE_ID,
+)
+from surge_tpu.models import counter, shopping_cart
+
+
+def test_counter_event_round_trip():
+    reg = counter.make_registry()
+    logs = [
+        [counter.CountIncremented("a", 1, 1), counter.CountDecremented("a", 2, 2)],
+        [counter.NoOpEvent("b", 1)],
+        [],
+    ]
+    # aggregate_id is excluded from the tensor path — it round-trips as the batch key
+    enc = encode_events(reg, logs)
+    assert enc.type_ids.shape == (3, 2)
+    assert enc.lengths.tolist() == [2, 1, 0]
+    assert enc.type_ids[2, 0] == PAD_TYPE_ID
+    dec = decode_events(reg, enc)
+    assert dec[0] == [counter.CountIncremented("", 1, 1), counter.CountDecremented("", 2, 2)]
+    assert dec[1] == [counter.NoOpEvent("", 1)]
+    assert dec[2] == []
+
+
+def test_union_columns_promote_and_zero_fill():
+    reg = shopping_cart.make_registry()
+    union = {f.name: f.dtype for f in reg.union_columns()}
+    assert set(union) == {"item_code", "quantity", "unit_price_cents", "sequence_number"}
+    logs = [[shopping_cart.CheckedOut("c", 1)]]
+    enc = encode_events(reg, logs)
+    # CheckedOut carries no item fields: zero-filled
+    assert enc.cols["item_code"][0, 0] == 0
+    assert enc.cols["sequence_number"][0, 0] == 1
+
+
+def test_state_round_trip():
+    reg = counter.make_registry()
+    states = [counter.State("x", 5, 3), counter.State("y", -2, 9)]
+    tree = encode_states(reg.state, states)
+    assert tree["count"].dtype == np.int32
+    back = decode_states(reg.state, tree)
+    # aggregate_id excluded → compare tensor fields
+    assert [(s.count, s.version) for s in back] == [(5, 3), (-2, 9)]
+
+
+def test_pad_to_shorter_than_longest_raises():
+    reg = counter.make_registry()
+    logs = [[counter.NoOpEvent("a", i) for i in range(5)]]
+    with pytest.raises(ValueError):
+        encode_events(reg, logs, pad_to=3)
+
+
+def test_bucket_lengths():
+    groups = bucket_lengths([3, 70, 0, 64, 5000], [64, 256, 1024, 4096])
+    assert groups[64] == [0, 2, 3]
+    assert groups[256] == [1]
+    # over the largest bucket → rounded up to multiple of largest
+    assert groups[8192] == [4]
+
+
+def test_vocab():
+    v = Vocab()
+    a, b = v.encode("alice"), v.encode("bob")
+    assert v.encode("alice") == a
+    assert v.decode(a) == "alice" and v.decode(b) == "bob"
+    assert v.decode(0) == ""
+
+
+def test_duplicate_registration_rejected():
+    reg = SchemaRegistry()
+    reg.register_event(counter.NoOpEvent, type_id=0, exclude=("aggregate_id",))
+    with pytest.raises(ValueError):
+        reg.register_event(counter.NoOpEvent, type_id=1, exclude=("aggregate_id",))
+    with pytest.raises(ValueError):
+        reg.register_event(counter.CountIncremented, type_id=0, exclude=("aggregate_id",))
