@@ -22,8 +22,11 @@ from surge_tpu.codec.schema import (
 )
 from surge_tpu.codec.tensor import (
     PAD_TYPE_ID,
+    ColumnarEvents,
     EncodedEvents,
+    columnar_to_batch,
     encode_events,
+    encode_events_columnar,
     decode_events,
     encode_states,
     decode_states,
@@ -38,7 +41,10 @@ __all__ = [
     "Vocab",
     "event_fields_from_dataclass",
     "PAD_TYPE_ID",
+    "ColumnarEvents",
     "EncodedEvents",
+    "columnar_to_batch",
+    "encode_events_columnar",
     "encode_events",
     "decode_events",
     "encode_states",

@@ -158,9 +158,12 @@ class SchemaRegistry:
         return self._state
 
     def schema_for(self, event: Any) -> EventSchema:
-        s = self._by_cls.get(type(event))
+        return self.schema_for_cls(type(event))
+
+    def schema_for_cls(self, cls: type) -> EventSchema:
+        s = self._by_cls.get(cls)
         if s is None:
-            raise KeyError(f"unregistered event type {type(event).__name__}")
+            raise KeyError(f"unregistered event type {cls.__name__}")
         return s
 
     def schema_for_id(self, type_id: int) -> EventSchema:

@@ -47,26 +47,119 @@ class EncodedEvents:
         return self.type_ids.nbytes + self.lengths.nbytes + sum(c.nbytes for c in self.cols.values())
 
 
+@dataclass
+class ColumnarEvents:
+    """Flat struct-of-arrays event log: N events across B aggregates, time-ordered
+    within each aggregate. This is the *storage* layout (log segments are columnar so
+    bulk replay never touches Python objects — SURVEY.md §7 hard-part "host-side
+    encode"); :func:`columnar_to_batch` scatters it into the padded ``[B, T]`` batch
+    with pure vectorized NumPy.
+
+    - ``agg_idx``: int32 ``[N]`` — which aggregate (dense 0..B-1) each event belongs to.
+    - ``type_ids``: int32 ``[N]``.
+    - ``cols``: dict of ``[N]`` arrays (union columns; zero where a type lacks a field).
+    """
+
+    num_aggregates: int
+    agg_idx: np.ndarray
+    type_ids: np.ndarray
+    cols: dict[str, np.ndarray]
+
+    @property
+    def num_events(self) -> int:
+        return int(self.type_ids.shape[0])
+
+    def nbytes(self) -> int:
+        return (self.agg_idx.nbytes + self.type_ids.nbytes
+                + sum(c.nbytes for c in self.cols.values()))
+
+    def sorted_by_aggregate(self) -> "ColumnarEvents":
+        """Events grouped by aggregate (stable: per-aggregate time order preserved),
+        which makes :meth:`slice_aggregates` a contiguous O(1)-index slice."""
+        if self.agg_idx.size and np.all(np.diff(self.agg_idx) >= 0):
+            return self
+        order = np.argsort(self.agg_idx, kind="stable")
+        return ColumnarEvents(
+            num_aggregates=self.num_aggregates, agg_idx=self.agg_idx[order],
+            type_ids=self.type_ids[order],
+            cols={k: v[order] for k, v in self.cols.items()})
+
+    def slice_aggregates(self, start: int, stop: int) -> "ColumnarEvents":
+        """Sub-log for aggregates [start, stop). Requires aggregate-sorted order
+        (see :meth:`sorted_by_aggregate`); re-indexes agg_idx to 0..(stop-start)."""
+        lo, hi = np.searchsorted(self.agg_idx, (start, stop))
+        return ColumnarEvents(
+            num_aggregates=stop - start,
+            agg_idx=self.agg_idx[lo:hi] - np.int32(start),
+            type_ids=self.type_ids[lo:hi],
+            cols={k: v[lo:hi] for k, v in self.cols.items()})
+
+
+def columnar_to_batch(colev: ColumnarEvents, pad_to: int | None = None) -> EncodedEvents:
+    """Scatter a flat columnar log into the padded ``[B, T]`` batch. Fully vectorized
+    (one stable argsort + one fancy-index scatter per column); no per-event Python."""
+    b = colev.num_aggregates
+    n = colev.num_events
+    lengths = np.bincount(colev.agg_idx, minlength=b).astype(np.int32)
+    t = int(pad_to) if pad_to is not None else int(lengths.max(initial=0))
+    if lengths.size and int(lengths.max(initial=0)) > t:
+        raise ValueError(f"pad_to={t} < longest log {int(lengths.max())}")
+
+    # stable sort groups events by aggregate while preserving per-aggregate time order
+    order = np.argsort(colev.agg_idx, kind="stable")
+    sorted_agg = colev.agg_idx[order]
+    starts = np.zeros(b + 1, dtype=np.int64)
+    np.cumsum(lengths, out=starts[1:])
+    slot = np.arange(n, dtype=np.int64) - starts[sorted_agg]
+
+    type_ids = np.full((b, t), PAD_TYPE_ID, dtype=np.int32)
+    type_ids[sorted_agg, slot] = colev.type_ids[order]
+    cols = {}
+    for name, col in colev.cols.items():
+        buf = np.zeros((b, t), dtype=col.dtype)
+        buf[sorted_agg, slot] = col[order]
+        cols[name] = buf
+    return EncodedEvents(type_ids=type_ids, cols=cols, lengths=lengths)
+
+
+def encode_events_columnar(registry: SchemaRegistry,
+                           event_logs: Sequence[Sequence[Any]]) -> ColumnarEvents:
+    """Flatten object logs into the columnar layout. Groups the per-event Python work
+    by event type so each field extracts in one comprehension per (type, field) rather
+    than a nested per-event/per-field loop."""
+    union = registry.union_columns()
+    flat: list[Any] = []
+    agg_idx_parts: list[np.ndarray] = []
+    for i, log in enumerate(event_logs):
+        flat.extend(log)
+        agg_idx_parts.append(np.full(len(log), i, dtype=np.int32))
+    n = len(flat)
+    agg_idx = (np.concatenate(agg_idx_parts) if agg_idx_parts
+               else np.zeros(0, dtype=np.int32))
+
+    type_ids = np.empty(n, dtype=np.int32)
+    by_type: dict[type, list[int]] = {}
+    for k, ev in enumerate(flat):
+        by_type.setdefault(type(ev), []).append(k)
+    cols = {f.name: np.zeros(n, dtype=f.dtype) for f in union}
+    for cls, idxs in by_type.items():
+        schema = registry.schema_for_cls(cls)
+        ii = np.asarray(idxs, dtype=np.int64)
+        type_ids[ii] = schema.type_id
+        getter = schema.getter
+        for f in schema.fields:
+            name = f.name
+            cols[name][ii] = [getter(flat[k], name) for k in idxs]
+    return ColumnarEvents(num_aggregates=len(event_logs), agg_idx=agg_idx,
+                          type_ids=type_ids, cols=cols)
+
+
 def encode_events(registry: SchemaRegistry, event_logs: Sequence[Sequence[Any]],
                   pad_to: int | None = None) -> EncodedEvents:
     """Encode ragged per-aggregate event lists into a dense tagged-union batch."""
-    b = len(event_logs)
-    lengths = np.asarray([len(log) for log in event_logs], dtype=np.int32)
-    t = int(pad_to) if pad_to is not None else int(lengths.max(initial=0))
-    if lengths.size and lengths.max(initial=0) > t:
-        raise ValueError(f"pad_to={t} < longest log {int(lengths.max())}")
-
-    type_ids = np.full((b, t), PAD_TYPE_ID, dtype=np.int32)
-    union = registry.union_columns()
-    cols = {f.name: np.zeros((b, t), dtype=f.dtype) for f in union}
-
-    for i, log in enumerate(event_logs):
-        for j, event in enumerate(log):
-            schema = registry.schema_for(event)
-            type_ids[i, j] = schema.type_id
-            for f in schema.fields:
-                cols[f.name][i, j] = schema.getter(event, f.name)
-    return EncodedEvents(type_ids=type_ids, cols=cols, lengths=lengths)
+    colev = encode_events_columnar(registry, event_logs)
+    enc = columnar_to_batch(colev, pad_to=pad_to)
+    return enc
 
 
 def decode_events(registry: SchemaRegistry, enc: EncodedEvents) -> list[list[Any]]:

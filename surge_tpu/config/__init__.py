@@ -113,9 +113,15 @@ class Config:
         """Millisecond config value as seconds (asyncio sleeps take seconds)."""
         return self.get_int(key, fallback_ms) / 1000.0
 
-    def with_overrides(self, **kv: Any) -> "Config":
+    def with_overrides(self, overrides: Mapping[str, Any] | None = None, **kv: Any) -> "Config":
+        """Layer overrides on top. Dotted keys go in ``overrides``; keyword args use
+        underscore form (``surge_replay_time_chunk``) and are canonicalized against the
+        known default keys (so they actually match what ``get`` reads)."""
         merged = dict(self.overrides)
-        merged.update({k.replace("_", "-") if False else k: v for k, v in kv.items()})
+        merged.update(overrides or {})
+        canonical = {_env_key(k): k for k in self.defaults}
+        for k, v in kv.items():
+            merged[canonical.get(_env_key(k), k)] = v
         return Config(overrides=merged, defaults=self.defaults)
 
 

@@ -2,12 +2,6 @@
 
 - :mod:`surge_tpu.engine.model` — user-facing processing-model API
   (scaladsl/command/CommandModels.scala:12-74 equivalents) plus the TPU replay spec.
-- :mod:`surge_tpu.engine.entity` — per-aggregate single-writer entity
-  (internal/persistence/PersistentActor.scala).
-- :mod:`surge_tpu.engine.publisher` — transactional partition publisher FSM
-  (internal/kafka/KafkaProducerActorImpl.scala).
-- :mod:`surge_tpu.engine.pipeline` — engine lifecycle wiring
-  (internal/domain/SurgeMessagePipeline.scala).
 """
 
 from surge_tpu.engine.model import (

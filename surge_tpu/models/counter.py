@@ -149,14 +149,20 @@ class CounterModel:
 
 # --- tensor schemas + JAX fold ---
 
-INCREMENTED, DECREMENTED, NOOP = 0, 1, 2
+INCREMENTED, DECREMENTED, NOOP, UNSERIALIZABLE = 0, 1, 2, 3
 
 
 def make_registry() -> SchemaRegistry:
+    """Tensor-path event subset: every event the reference fold handles without
+    raising (TestBoundedContext.scala handleEvent). ExceptionThrowingEvent is
+    deliberately unregistered — its fold semantics are "throw", which the batched
+    path surfaces as an encode-time KeyError instead."""
     reg = SchemaRegistry()
     reg.register_event(CountIncremented, type_id=INCREMENTED, exclude=("aggregate_id",))
     reg.register_event(CountDecremented, type_id=DECREMENTED, exclude=("aggregate_id",))
     reg.register_event(NoOpEvent, type_id=NOOP, exclude=("aggregate_id",))
+    reg.register_event(UnserializableEvent, type_id=UNSERIALIZABLE,
+                       exclude=("aggregate_id", "error_msg"))
     reg.register_state(State, exclude=("aggregate_id",))
     return reg
 
@@ -168,9 +174,14 @@ def make_replay_spec() -> ReplaySpec:
     def decremented(s, f):
         return {"count": s["count"] - f["decrement_by"], "version": f["sequence_number"]}
 
+    def unserializable(s, f):
+        # reference: version bumps to sequenceNumber, count unchanged
+        return {"version": f["sequence_number"]}
+
     return ReplaySpec(
         registry=make_registry(),
-        handlers=ReplayHandlers({INCREMENTED: incremented, DECREMENTED: decremented}),
+        handlers=ReplayHandlers({INCREMENTED: incremented, DECREMENTED: decremented,
+                                 UNSERIALIZABLE: unserializable}),
         init_record={"count": 0, "version": 0},
     )
 

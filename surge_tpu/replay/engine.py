@@ -1,17 +1,33 @@
-"""Core batched fold: vmap(switch-step) scanned over time-major event columns."""
+"""Core batched fold: vmap(switch-step) scanned over time-major event columns.
+
+Scale discipline (SURVEY.md §7 hard-part 2, BASELINE.md 1M-aggregate/100M-event target):
+
+- **B-chunking**: ``surge.replay.batch-size`` bounds the aggregates resident on device at
+  once; larger batches stream through in fixed-size chunks so HBM usage is constant and
+  one compiled program serves every chunk.
+- **T-chunking**: ``surge.replay.time-chunk`` bounds the scanned window; tail windows are
+  padded to full width (padding is masked inside the step), again pinning compiled shapes.
+- **Donation safety**: caller-visible carries are always copied into fresh padded host
+  buffers before entering the donated jit, so external arrays are never consumed.
+"""
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
-from functools import partial
-from typing import Any, Callable, Mapping, Optional, Sequence
+from typing import Any, Callable, Iterable, Mapping, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from surge_tpu.codec.tensor import PAD_TYPE_ID, EncodedEvents, bucket_lengths, encode_states
+from surge_tpu.codec.tensor import (
+    PAD_TYPE_ID,
+    ColumnarEvents,
+    EncodedEvents,
+    bucket_lengths,
+    columnar_to_batch,
+    encode_states,
+)
 from surge_tpu.config import Config, default_config
 from surge_tpu.engine.model import ReplaySpec, StateTree
 
@@ -19,9 +35,9 @@ from surge_tpu.engine.model import ReplaySpec, StateTree
 def make_step_fn(spec: ReplaySpec) -> Callable[[StateTree, Mapping[str, Any]], StateTree]:
     """One-event step for a single aggregate: dispatch on type_id, mask padding.
 
-    The returned function is scalar over the batch dim (engine vmaps it). Padding
-    (``type_id == PAD_TYPE_ID``) must leave state untouched — scans run to the padded
-    length for every lane.
+    The returned function is scalar over the batch dim (engine vmaps it). Any type_id
+    outside ``[0, num_types)`` — padding (-1) or corrupt positive ids — carries state
+    through unchanged rather than dispatching to an arbitrary handler.
     """
     num_types = spec.registry.num_event_types
     handlers = spec.handlers.ordered(num_types)
@@ -44,7 +60,7 @@ def make_step_fn(spec: ReplaySpec) -> Callable[[StateTree, Mapping[str, Any]], S
             (lambda h: lambda s: normalize(h(s, fields), s))(h) for h in handlers
         ]
         new_state = jax.lax.switch(branch, wrapped, state)
-        is_real = tid != PAD_TYPE_ID
+        is_real = (tid >= 0) & (tid < num_types)
         return {k: jnp.where(is_real, new_state[k], state[k]) for k in state}
 
     return step
@@ -85,9 +101,9 @@ class ReplayEngine:
 
     Equivalent role: the bulk-restore path of AggregateStateStoreKafkaStreams
     (common/.../kafka/streams/AggregateStateStoreKafkaStreams.scala:53-178) with
-    ``replayBackend = tpu`` (BASELINE.json). Consumes ``EncodedEvents`` batches (from
-    surge_tpu.codec) and produces state columns; the KTable-equivalent store ingests the
-    writeback.
+    ``replayBackend = tpu`` (BASELINE.json). Consumes ``EncodedEvents`` /
+    ``ColumnarEvents`` batches (from surge_tpu.codec) and produces state columns; the
+    KTable-equivalent store ingests the writeback.
 
     Parameters
     ----------
@@ -104,7 +120,9 @@ class ReplayEngine:
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.time_chunk = self.config.get_int("surge.replay.time-chunk")
-        self.batch_size = self.config.get_int("surge.replay.batch-size")
+        lane = self._lane_multiple()
+        self.batch_size = _round_up(
+            max(self.config.get_int("surge.replay.batch-size"), lane), lane)
         self.buckets = self.config.get_int_list("surge.replay.length-buckets", "64,256,1024,4096")
 
         fold = make_batch_fold(spec, unroll=unroll)
@@ -112,12 +130,16 @@ class ReplayEngine:
             pspec = jax.sharding.PartitionSpec(mesh_axis)
             sharding = jax.sharding.NamedSharding(mesh, pspec)
             carry_sh = jax.tree_util.tree_map(lambda _: sharding, self._carry_struct())
+            ev_sharding = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(None, mesh_axis))
             self._fold = jax.jit(fold, donate_argnums=(0,),
                                  in_shardings=(carry_sh, None), out_shardings=carry_sh)
             self._sharding = sharding
+            self._ev_sharding = ev_sharding
         else:
             self._fold = jax.jit(fold, donate_argnums=(0,))
             self._sharding = None
+            self._ev_sharding = None
 
     # -- helpers ------------------------------------------------------------------------
 
@@ -129,73 +151,139 @@ class ReplayEngine:
         n = 1 if self.mesh is None else int(np.prod(self.mesh.devices.shape))
         return max(8 * n, n)
 
-    def init_carry(self, batch: int) -> StateTree:
-        init = self.spec.init_state_tree()
-        carry = {k: jnp.broadcast_to(jnp.asarray(v), (batch,)) for k, v in init.items()}
-        if self._sharding is not None:
-            carry = jax.device_put(carry, self._sharding)
-        return {k: jnp.asarray(v) for k, v in carry.items()}
+    def num_compiles(self) -> int:
+        """Compiled-program count for the fold (compile-stability instrumentation).
+        Returns -1 if the JAX internal it relies on is unavailable."""
+        try:
+            return int(self._fold._cache_size())
+        except AttributeError:
+            return -1
 
-    def carry_from_states(self, states: Sequence[Any]) -> StateTree:
+    def init_carry_np(self, batch: int) -> dict[str, np.ndarray]:
+        """Host-side initial carry columns ``{name: [batch]}``."""
+        init = self.spec.init_state_tree()
+        return {k: np.broadcast_to(np.asarray(v), (batch,)).copy()
+                for k, v in init.items()}
+
+    def init_carry(self, batch: int) -> StateTree:
+        carry = self.init_carry_np(batch)
+        return self._device_carry(carry)
+
+    def _device_carry(self, carry: Mapping[str, np.ndarray]) -> StateTree:
+        if self._sharding is not None:
+            return {k: jax.device_put(np.asarray(v), self._sharding)
+                    for k, v in carry.items()}
+        return {k: jnp.asarray(np.asarray(v)) for k, v in carry.items()}
+
+    def carry_from_states(self, states: Sequence[Any]) -> dict[str, np.ndarray]:
         """Resume from snapshots (checkpointed carry, SURVEY.md §5.4 TPU mapping)."""
-        tree = encode_states(self.spec.registry.state, states)
-        return {k: jnp.asarray(v) for k, v in tree.items()}
+        return encode_states(self.spec.registry.state, states)
+
+    def _carry_slice(self, init_carry: Mapping[str, Any] | None,
+                     start: int, stop: int, bp: int) -> StateTree:
+        """Fresh padded device carry for aggregates [start:stop), donation-safe:
+        external arrays are copied to host buffers first, never handed to the jit."""
+        if init_carry is None:
+            return self._device_carry(self.init_carry_np(bp))
+        defaults = self.init_carry_np(bp)
+        out = {}
+        for k, full in init_carry.items():
+            piece = np.asarray(full)[start:stop]
+            buf = defaults[k]
+            buf[: stop - start] = piece
+            out[k] = buf
+        return self._device_carry(out)
+
+    def _device_events(self, ev: Mapping[str, np.ndarray]) -> Mapping[str, Any]:
+        if self._ev_sharding is not None:
+            return {k: jax.device_put(v, self._ev_sharding) for k, v in ev.items()}
+        return ev
 
     # -- core entry points --------------------------------------------------------------
 
     def replay_encoded(self, enc: EncodedEvents,
-                       init_carry: StateTree | None = None) -> ReplayResult:
-        """Fold one encoded batch. Time axis is chunked to ``time_chunk`` so arbitrarily
-        long (padded) logs stream through a fixed-size compiled program."""
+                       init_carry: Mapping[str, Any] | None = None) -> ReplayResult:
+        """Fold one encoded batch. The aggregate axis is chunked to
+        ``surge.replay.batch-size`` and the time axis to ``surge.replay.time-chunk`` so
+        arbitrarily large batches and arbitrarily long (padded) logs stream through a
+        fixed-size compiled program with bounded HBM."""
         b, t = enc.batch_size, enc.max_len
-        pad_b = -b % self._lane_multiple()
-        bp = b + pad_b
+        bs = min(self.batch_size, _round_up(max(b, 1), self._lane_multiple()))
+        state_fields = self.spec.registry.state.fields
+        out = {f.name: np.zeros((b,), dtype=f.dtype) for f in state_fields}
+        padded = 0
 
-        type_ids = np.full((bp, t), PAD_TYPE_ID, dtype=np.int32)
-        type_ids[:b] = enc.type_ids
-        cols = {}
-        for name, col in enc.cols.items():
-            buf = np.zeros((bp, t), dtype=col.dtype)
-            buf[:b] = col
-            cols[name] = buf
+        for start in range(0, max(b, 1), bs):
+            stop = min(start + bs, b)
+            if stop <= start:
+                break
+            carry = self._carry_slice(init_carry, start, stop, bs)
+            carry = self._fold_window(
+                carry, enc.type_ids[start:stop], {k: v[start:stop] for k, v in enc.cols.items()}, bs)
+            for name in out:
+                out[name][start:stop] = np.asarray(carry[name])[: stop - start]
+            padded += bs * _round_up(t, self.time_chunk if self.time_chunk > 0 else max(t, 1))
 
-        carry = init_carry if init_carry is not None else self.init_carry(bp)
-        if init_carry is not None and next(iter(carry.values())).shape[0] != bp:
-            carry = {k: jnp.concatenate(
-                [jnp.asarray(v), jnp.zeros((bp - v.shape[0],), dtype=v.dtype)])
-                for k, v in carry.items()}
-        if self._sharding is not None:
-            carry = jax.device_put(carry, self._sharding)
+        return ReplayResult(states=out, num_aggregates=b,
+                            num_events=int(enc.lengths.sum()), padded_events=padded)
 
-        chunk = self.time_chunk if self.time_chunk > 0 else t
-        for start in range(0, t, max(chunk, 1)):
-            stop = min(start + chunk, t)
-            width = stop - start
-            # keep the compiled program count low: pad the tail chunk to full width
-            ev = {"type_id": _time_major(type_ids, start, stop, chunk, PAD_TYPE_ID)}
+    def replay_columnar(self, colev: ColumnarEvents,
+                        init_carry: Mapping[str, Any] | None = None) -> ReplayResult:
+        """Fold a flat columnar log (the log-segment storage layout) directly.
+
+        Densifies per B-chunk, never the whole batch: each chunk pads only to its own
+        max log length, so host memory stays bounded by ``batch-size × local max T``
+        even when one aggregate's log dwarfs the rest."""
+        b = colev.num_aggregates
+        bs = min(self.batch_size, _round_up(max(b, 1), self._lane_multiple()))
+        sorted_ev = colev.sorted_by_aggregate()
+        state_fields = self.spec.registry.state.fields
+        out = {f.name: np.zeros((b,), dtype=f.dtype) for f in state_fields}
+        padded = 0
+        total_events = 0
+        for start in range(0, max(b, 1), bs):
+            stop = min(start + bs, b)
+            if stop <= start:
+                break
+            enc = columnar_to_batch(sorted_ev.slice_aggregates(start, stop))
+            carry = self._carry_slice(init_carry, start, stop, bs)
+            carry = self._fold_window(carry, enc.type_ids, enc.cols, bs)
+            for name in out:
+                out[name][start:stop] = np.asarray(carry[name])[: stop - start]
+            t = enc.max_len
+            padded += bs * _round_up(t, self.time_chunk if self.time_chunk > 0 else max(t, 1))
+            total_events += int(enc.lengths.sum())
+        return ReplayResult(states=out, num_aggregates=b,
+                            num_events=total_events, padded_events=padded)
+
+    def _fold_window(self, carry: StateTree, type_ids: np.ndarray,
+                     cols: Mapping[str, np.ndarray], bs: int) -> StateTree:
+        """Fold one [b?, T] window (b? ≤ bs) through T-chunked fixed-width programs."""
+        b, t = type_ids.shape
+        chunk = self.time_chunk if self.time_chunk > 0 else max(t, 1)
+        for s in range(0, max(t, 1), chunk):
+            e = min(s + chunk, t)
+            if e <= s:
+                break
+            ev = {"type_id": _time_major_padded(type_ids, s, e, chunk, bs, PAD_TYPE_ID)}
             for name, col in cols.items():
-                ev[name] = _time_major(col, start, stop, chunk, 0)
-            if self._sharding is not None:
-                col_sh = jax.sharding.NamedSharding(
-                    self.mesh, jax.sharding.PartitionSpec(None, self.mesh_axis))
-                ev = {k: jax.device_put(v, col_sh) for k, v in ev.items()}
-            carry = self._fold(carry, ev)
-            del width
+                ev[name] = _time_major_padded(col, s, e, chunk, bs, 0)
+            carry = self._fold(carry, self._device_events(ev))
+        return carry
 
-        states = {k: np.asarray(v)[:b] for k, v in carry.items()}
-        return ReplayResult(states=states, num_aggregates=b,
-                            num_events=int(enc.lengths.sum()), padded_events=bp * t)
-
-    def replay_ragged(self, registry_enc_logs: Sequence[Sequence[Any]],
-                      encode=None) -> ReplayResult:
+    def replay_ragged(self, logs: Sequence[Sequence[Any]],
+                      encode: Callable[[Any], Any] | None = None) -> ReplayResult:
         """Length-bucketed replay of ragged logs (SURVEY.md §5.7).
 
         Groups aggregates by log length into padded buckets, folds each bucket, and
-        scatters results back into original order.
+        scatters results back into original order. ``encode`` (if given) maps each raw
+        event to its tensor-schema form first — e.g. bank_account's host-side Vocab
+        dictionary encoding.
         """
         from surge_tpu.codec.tensor import encode_events
 
-        logs = registry_enc_logs
+        if encode is not None:
+            logs = [[encode(e) for e in log] for log in logs]
         lengths = [len(l) for l in logs]
         groups = bucket_lengths(lengths, self.buckets)
         state_fields = self.spec.registry.state.fields
@@ -214,51 +302,52 @@ class ReplayEngine:
         return ReplayResult(states=out, num_aggregates=len(logs),
                             num_events=total_events, padded_events=padded)
 
-    def replay_stream(self, chunks, batch: int) -> ReplayResult:
+    def replay_stream(self, chunks: Iterable[EncodedEvents], batch: int,
+                      init_carry: Mapping[str, Any] | None = None) -> ReplayResult:
         """Fold a stream of EncodedEvents chunks (same B, consecutive time windows),
         carrying state across chunks — the 100M-event-log path where the whole encoded
-        log never exists in HBM at once."""
-        carry = None
+        log never exists in HBM at once. Every window is padded to ``time-chunk`` width
+        so one compiled program serves the entire stream."""
+        bs = min(self.batch_size, _round_up(max(batch, 1), self._lane_multiple()))
+        n_bchunks = max((batch + bs - 1) // bs, 1)
+        carries: list[StateTree | None] = [None] * n_bchunks
         total_events = 0
         padded = 0
-        bp = None
         for enc in chunks:
-            if carry is None:
-                b = enc.batch_size
-                pad_b = -b % self._lane_multiple()
-                bp = b + pad_b
-                carry = self.init_carry(bp)
-            res_carry = self._fold_chunk(carry, enc, bp)
-            carry = res_carry
+            if enc.batch_size != batch:
+                raise ValueError(f"stream chunk batch {enc.batch_size} != {batch}")
+            t = enc.max_len
+            for ci in range(n_bchunks):
+                start, stop = ci * bs, min((ci + 1) * bs, batch)
+                if carries[ci] is None:
+                    carries[ci] = self._carry_slice(init_carry, start, stop, bs)
+                carries[ci] = self._fold_window(
+                    carries[ci], enc.type_ids[start:stop],
+                    {k: v[start:stop] for k, v in enc.cols.items()}, bs)
             total_events += int(enc.lengths.sum())
-            padded += bp * enc.max_len
-        if carry is None:
+            padded += n_bchunks * bs * _round_up(t, self.time_chunk or max(t, 1))
+        if carries[0] is None:
             raise ValueError("empty chunk stream")
-        states = {k: np.asarray(v)[:batch] for k, v in carry.items()}
-        return ReplayResult(states=states, num_aggregates=batch,
+        state_fields = self.spec.registry.state.fields
+        out = {f.name: np.zeros((batch,), dtype=f.dtype) for f in state_fields}
+        for ci in range(n_bchunks):
+            start, stop = ci * bs, min((ci + 1) * bs, batch)
+            for name in out:
+                out[name][start:stop] = np.asarray(carries[ci][name])[: stop - start]
+        return ReplayResult(states=out, num_aggregates=batch,
                             num_events=total_events, padded_events=padded)
 
-    def _fold_chunk(self, carry: StateTree, enc: EncodedEvents, bp: int) -> StateTree:
-        b, t = enc.batch_size, enc.max_len
-        type_ids = np.full((bp, t), PAD_TYPE_ID, dtype=np.int32)
-        type_ids[:b] = enc.type_ids
-        ev = {"type_id": np.ascontiguousarray(type_ids.T)}
-        for name, col in enc.cols.items():
-            buf = np.zeros((bp, t), dtype=col.dtype)
-            buf[:b] = col
-            ev[name] = np.ascontiguousarray(buf.T)
-        if self._sharding is not None:
-            col_sh = jax.sharding.NamedSharding(
-                self.mesh, jax.sharding.PartitionSpec(None, self.mesh_axis))
-            ev = {k: jax.device_put(v, col_sh) for k, v in ev.items()}
-        return self._fold(carry, ev)
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m if m > 0 else n
 
 
-def _time_major(col: np.ndarray, start: int, stop: int, chunk: int, pad_value) -> np.ndarray:
-    """Slice [B, start:stop], pad to ``chunk`` wide, return time-major [chunk, B]."""
-    piece = col[:, start:stop]
+def _time_major_padded(col: np.ndarray, start: int, stop: int, chunk: int,
+                       bs: int, pad_value) -> np.ndarray:
+    """Slice [b, start:stop], pad time to ``chunk`` and batch to ``bs``, return
+    time-major [chunk, bs]. Always allocates a fresh buffer (donation-safe)."""
+    b = col.shape[0]
     width = stop - start
-    if chunk and width < chunk:
-        pad = np.full((col.shape[0], chunk - width), pad_value, dtype=col.dtype)
-        piece = np.concatenate([piece, pad], axis=1)
-    return np.ascontiguousarray(piece.T)
+    out = np.full((chunk, bs), pad_value, dtype=col.dtype)
+    out[:width, :b] = col[:, start:stop].T
+    return out
