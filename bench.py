@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""North-star benchmark: cold replay of a ragged event log (BASELINE.md targets).
+
+Builds a 1M-aggregate / 100M-event counter corpus columnar-side (no Python event
+objects), measures the scalar CPU fold baseline on a stratified sample (the reference's
+Kafka Streams restore is exactly this per-aggregate scalar fold, SURVEY.md §3.3), then
+runs the batched TPU replay over the full corpus and verifies every folded state against
+the closed-form expected result.
+
+Prints ONE JSON line to stdout:
+    {"metric": "cold_replay_events_per_sec", "value": N, "unit": "events/s",
+     "vs_baseline": <speedup over the scalar CPU fold>}
+
+Env knobs: SURGE_BENCH_AGGREGATES (1_000_000), SURGE_BENCH_EVENTS (100_000_000),
+SURGE_BENCH_CPU_SAMPLE (200_000 events), SURGE_BENCH_TIME_CHUNK, SURGE_BENCH_BATCH.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    num_aggregates = int(os.environ.get("SURGE_BENCH_AGGREGATES", 1_000_000))
+    num_events = int(os.environ.get("SURGE_BENCH_EVENTS", 100_000_000))
+    cpu_sample_events = int(os.environ.get("SURGE_BENCH_CPU_SAMPLE", 200_000))
+    time_chunk = int(os.environ.get("SURGE_BENCH_TIME_CHUNK", 128))
+    batch_size = int(os.environ.get("SURGE_BENCH_BATCH", 8192))
+
+    import jax
+
+    from surge_tpu.config import default_config
+    from surge_tpu.engine.model import fold_events
+    from surge_tpu.models.counter import CounterModel, make_replay_spec
+    from surge_tpu.replay.corpus import decode_sample, sample_indices, synth_counter_corpus
+    from surge_tpu.replay.engine import ReplayEngine
+
+    platform = jax.devices()[0].platform
+    log(f"platform={platform} devices={jax.devices()}")
+
+    t0 = time.perf_counter()
+    corpus = synth_counter_corpus(num_aggregates, num_events, seed=42,
+                                  sort_by_length=True)
+    log(f"corpus: {corpus.num_aggregates} aggregates, {corpus.num_events} events, "
+        f"{corpus.events.nbytes() / 1e9:.2f} GB columnar "
+        f"({time.perf_counter() - t0:.1f}s)")
+
+    # -- scalar CPU fold baseline (the reference restore path) ------------------------
+    idx = sample_indices(corpus, cpu_sample_events)
+    logs = decode_sample(corpus, idx)
+    n_sample = sum(len(l) for l in logs)
+    model = CounterModel()
+    t0 = time.perf_counter()
+    folded = [fold_events(model, None, events) for events in logs]
+    cpu_s = time.perf_counter() - t0
+    cpu_eps = n_sample / cpu_s
+    # golden cross-check: the scalar fold must agree with the closed-form expectation
+    for j, state in zip(idx, folded):
+        expect_c, expect_v = int(corpus.expected_count[j]), int(corpus.expected_version[j])
+        got_c = state.count if state is not None else 0
+        got_v = state.version if state is not None else 0
+        if got_c != expect_c or got_v != expect_v:
+            raise AssertionError(
+                f"scalar fold mismatch at aggregate {j}: "
+                f"({got_c},{got_v}) != ({expect_c},{expect_v})")
+    log(f"cpu baseline: {n_sample} events over {len(logs)} aggregates in {cpu_s:.2f}s "
+        f"-> {cpu_eps:,.0f} events/s (verified)")
+
+    # -- batched TPU replay ------------------------------------------------------------
+    cfg = default_config().with_overrides({
+        "surge.replay.batch-size": batch_size,
+        "surge.replay.time-chunk": time_chunk,
+    })
+    engine = ReplayEngine(make_replay_spec(), config=cfg)
+
+    # warm up the one compiled program (shapes are fixed [time_chunk, batch_size])
+    warm = synth_counter_corpus(min(batch_size, num_aggregates),
+                                min(batch_size * 4, num_events), seed=1)
+    engine.replay_columnar(warm.events)
+    log(f"warmup done, compiled programs: {engine.num_compiles()}")
+
+    t0 = time.perf_counter()
+    result = engine.replay_columnar(corpus.events)
+    replay_s = time.perf_counter() - t0
+    eps = corpus.num_events / replay_s
+    aps = corpus.num_aggregates / replay_s
+
+    if not np.array_equal(result.states["count"], corpus.expected_count):
+        raise AssertionError("replay count mismatch vs closed-form fold")
+    if not np.array_equal(result.states["version"], corpus.expected_version):
+        raise AssertionError("replay version mismatch vs closed-form fold")
+    if result.num_events != corpus.num_events:
+        raise AssertionError("replay event accounting mismatch")
+
+    speedup = eps / cpu_eps
+    pad_ratio = result.padded_events / max(corpus.num_events, 1)
+    log(f"replay: {corpus.num_events:,} events / {corpus.num_aggregates:,} aggregates "
+        f"in {replay_s:.2f}s -> {eps:,.0f} events/s, {aps:,.0f} aggregates/s "
+        f"(pad ratio {pad_ratio:.2f}, compiles {engine.num_compiles()}, verified)")
+    log(f"speedup vs scalar CPU fold: {speedup:.1f}x (target >=50x)")
+
+    print(json.dumps({
+        "metric": "cold_replay_events_per_sec",
+        "value": round(eps),
+        "unit": "events/s",
+        "vs_baseline": round(speedup, 2),
+        "aggregates_per_sec": round(aps),
+        "cpu_baseline_events_per_sec": round(cpu_eps),
+        "num_events": corpus.num_events,
+        "num_aggregates": corpus.num_aggregates,
+        "pad_ratio": round(pad_ratio, 3),
+        "platform": platform,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -105,19 +105,28 @@ def columnar_to_batch(colev: ColumnarEvents, pad_to: int | None = None) -> Encod
     if lengths.size and int(lengths.max(initial=0)) > t:
         raise ValueError(f"pad_to={t} < longest log {int(lengths.max())}")
 
-    # stable sort groups events by aggregate while preserving per-aggregate time order
-    order = np.argsort(colev.agg_idx, kind="stable")
-    sorted_agg = colev.agg_idx[order]
+    # stable sort groups events by aggregate while preserving per-aggregate time order;
+    # skipped entirely when the log is already aggregate-sorted (the hot path:
+    # replay_columnar slices a sorted_by_aggregate() log)
+    already_sorted = n == 0 or bool(np.all(np.diff(colev.agg_idx) >= 0))
+    if already_sorted:
+        sorted_agg = colev.agg_idx
+        src_tids, src_cols = colev.type_ids, colev.cols
+    else:
+        order = np.argsort(colev.agg_idx, kind="stable")
+        sorted_agg = colev.agg_idx[order]
+        src_tids = colev.type_ids[order]
+        src_cols = {k: v[order] for k, v in colev.cols.items()}
     starts = np.zeros(b + 1, dtype=np.int64)
     np.cumsum(lengths, out=starts[1:])
     slot = np.arange(n, dtype=np.int64) - starts[sorted_agg]
 
     type_ids = np.full((b, t), PAD_TYPE_ID, dtype=np.int32)
-    type_ids[sorted_agg, slot] = colev.type_ids[order]
+    type_ids[sorted_agg, slot] = src_tids
     cols = {}
-    for name, col in colev.cols.items():
+    for name, col in src_cols.items():
         buf = np.zeros((b, t), dtype=col.dtype)
-        buf[sorted_agg, slot] = col[order]
+        buf[sorted_agg, slot] = col
         cols[name] = buf
     return EncodedEvents(type_ids=type_ids, cols=cols, lengths=lengths)
 

@@ -1,0 +1,149 @@
+"""Synthetic replay corpora, generated columnar (no per-event Python objects).
+
+The benchmark workload from BASELINE.md — 1M aggregates / 100M events of cold replay —
+can't be generated as Python object lists (that alone would dominate wall-clock on one
+core). This module builds :class:`~surge_tpu.codec.tensor.ColumnarEvents` directly with
+vectorized NumPy, along with a closed-form expected final state (per-aggregate bincount
+sums) so the full corpus can be *verified* without ever folding it scalar-side.
+
+The scalar CPU fold baseline (what the reference does during a Kafka Streams restore,
+SURVEY.md §3.3) is measured on a decoded sample and extrapolated.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from surge_tpu.codec.tensor import ColumnarEvents
+from surge_tpu.models import counter
+
+
+@dataclass
+class CounterCorpus:
+    """A ragged counter-event corpus plus its closed-form expected fold result."""
+
+    events: ColumnarEvents  # aggregate-sorted (time order within aggregate)
+    lengths: np.ndarray  # [B] int64 events per aggregate
+    expected_count: np.ndarray  # [B] int64: sum(inc) - sum(dec) per aggregate
+    expected_version: np.ndarray  # [B] int32: last non-noop sequence number
+
+    @property
+    def num_aggregates(self) -> int:
+        return int(self.lengths.shape[0])
+
+    @property
+    def num_events(self) -> int:
+        return int(self.events.num_events)
+
+
+def ragged_lengths(num_aggregates: int, num_events: int, rng: np.random.Generator,
+                   spread: float = 0.6) -> np.ndarray:
+    """Ragged per-aggregate log lengths summing exactly to ``num_events``.
+
+    Lognormal-shaped (most aggregates short, a long tail), mirroring real event-sourced
+    populations; ``spread`` is the lognormal sigma.
+    """
+    if num_aggregates <= 0:
+        return np.zeros(0, dtype=np.int64)
+    w = rng.lognormal(mean=0.0, sigma=spread, size=num_aggregates)
+    lengths = np.floor(w * (num_events / w.sum())).astype(np.int64)
+    # distribute the rounding remainder one event at a time over the first aggregates
+    deficit = num_events - int(lengths.sum())
+    if deficit > 0:
+        lengths[:deficit] += 1
+    return lengths
+
+
+def synth_counter_corpus(num_aggregates: int, num_events: int, seed: int = 0,
+                         spread: float = 0.6,
+                         sort_by_length: bool = False) -> CounterCorpus:
+    """Counter-model corpus: Increment/Decrement/NoOp/Unserializable events.
+
+    Event mix: 45% inc (by 1..3), 35% dec (by 1..2), 15% noop, 5% unserializable —
+    exercising all four tensor-path event types of the TestBoundedContext parity fixture
+    (reference TestBoundedContext.scala:17-82). ``sort_by_length`` orders aggregates by
+    log length (what the replay engine's bucketing does anyway) so fixed-size B-chunks
+    have homogeneous T and minimal padding.
+    """
+    rng = np.random.default_rng(seed)
+    lengths = ragged_lengths(num_aggregates, num_events, rng, spread)
+    if sort_by_length:
+        order = np.argsort(lengths, kind="stable")
+        lengths = lengths[order]
+    n = int(lengths.sum())
+
+    starts = np.zeros(num_aggregates + 1, dtype=np.int64)
+    np.cumsum(lengths, out=starts[1:])
+    agg_idx = np.repeat(np.arange(num_aggregates, dtype=np.int32), lengths)
+    # within-aggregate ordinal, 1-based — the model stamps sequence_number = version+1
+    # on each event, which for a pure event log is exactly the event's ordinal
+    seq = (np.arange(n, dtype=np.int64) - starts[agg_idx] + 1).astype(np.int32)
+
+    type_ids = rng.choice(
+        np.array([counter.INCREMENTED, counter.DECREMENTED, counter.NOOP,
+                  counter.UNSERIALIZABLE], dtype=np.int32),
+        size=n, p=[0.45, 0.35, 0.15, 0.05]).astype(np.int32)
+    inc = np.where(type_ids == counter.INCREMENTED,
+                   rng.integers(1, 4, size=n, dtype=np.int32), 0).astype(np.int32)
+    dec = np.where(type_ids == counter.DECREMENTED,
+                   rng.integers(1, 3, size=n, dtype=np.int32), 0).astype(np.int32)
+
+    events = ColumnarEvents(
+        num_aggregates=num_aggregates, agg_idx=agg_idx, type_ids=type_ids,
+        cols={"increment_by": inc, "decrement_by": dec, "sequence_number": seq})
+
+    expected_count = (
+        np.bincount(agg_idx, weights=inc, minlength=num_aggregates)
+        - np.bincount(agg_idx, weights=dec, minlength=num_aggregates)).astype(np.int64)
+    # version = sequence number of the last event whose handler writes version
+    # (inc/dec/unserializable); NoOp carries version through (counter.py handlers)
+    writes_version = type_ids != counter.NOOP
+    seq_masked = np.where(writes_version, seq, 0)
+    # segment max over non-empty segments only: reduceat over the non-empty starts
+    # reduces each exactly over its own events (empty segments in between have zero
+    # width), and stays in-bounds without clamping
+    expected_version = np.zeros(num_aggregates, dtype=np.int32)
+    nonempty = lengths > 0
+    if n and nonempty.any():
+        idx = starts[:-1][nonempty]
+        expected_version[nonempty] = np.maximum.reduceat(seq_masked, idx).astype(np.int32)
+
+    return CounterCorpus(events=events, lengths=lengths,
+                         expected_count=expected_count,
+                         expected_version=expected_version)
+
+
+def decode_sample(corpus: CounterCorpus, indices) -> list[list]:
+    """Materialize the logs at ``indices`` as Python event objects — input for the
+    scalar CPU fold baseline (generously excludes deserialization cost)."""
+    ev = corpus.events
+    starts = np.zeros(corpus.num_aggregates + 1, dtype=np.int64)
+    np.cumsum(corpus.lengths, out=starts[1:])
+    ctors = {
+        counter.INCREMENTED: lambda a, i, d, s: counter.CountIncremented(a, int(i), int(s)),
+        counter.DECREMENTED: lambda a, i, d, s: counter.CountDecremented(a, int(d), int(s)),
+        counter.NOOP: lambda a, i, d, s: counter.NoOpEvent(a, int(s)),
+        counter.UNSERIALIZABLE: lambda a, i, d, s: counter.UnserializableEvent(a, int(s), ""),
+    }
+    inc, dec, seq = (ev.cols["increment_by"], ev.cols["decrement_by"],
+                     ev.cols["sequence_number"])
+    logs = []
+    for b in indices:
+        lo, hi = int(starts[b]), int(starts[b + 1])
+        agg = f"agg-{b}"
+        logs.append([ctors[int(ev.type_ids[k])](agg, inc[k], dec[k], seq[k])
+                     for k in range(lo, hi)])
+    return logs
+
+
+def sample_indices(corpus: CounterCorpus, target_events: int) -> np.ndarray:
+    """Stratified aggregate sample (every k-th, so length-sorted corpora stay
+    representative) totaling roughly ``target_events`` events."""
+    b = corpus.num_aggregates
+    total = corpus.num_events
+    if total <= target_events:
+        return np.arange(b)
+    k = max(int(np.ceil(total / target_events)), 1)
+    return np.arange(0, b, k)
