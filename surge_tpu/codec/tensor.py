@@ -106,17 +106,10 @@ def columnar_to_batch(colev: ColumnarEvents, pad_to: int | None = None) -> Encod
         raise ValueError(f"pad_to={t} < longest log {int(lengths.max())}")
 
     # stable sort groups events by aggregate while preserving per-aggregate time order;
-    # skipped entirely when the log is already aggregate-sorted (the hot path:
-    # replay_columnar slices a sorted_by_aggregate() log)
-    already_sorted = n == 0 or bool(np.all(np.diff(colev.agg_idx) >= 0))
-    if already_sorted:
-        sorted_agg = colev.agg_idx
-        src_tids, src_cols = colev.type_ids, colev.cols
-    else:
-        order = np.argsort(colev.agg_idx, kind="stable")
-        sorted_agg = colev.agg_idx[order]
-        src_tids = colev.type_ids[order]
-        src_cols = {k: v[order] for k, v in colev.cols.items()}
+    # sorted_by_aggregate is a no-op on the hot path (replay_columnar slices an
+    # already-sorted log)
+    srt = colev.sorted_by_aggregate()
+    sorted_agg, src_tids, src_cols = srt.agg_idx, srt.type_ids, srt.cols
     starts = np.zeros(b + 1, dtype=np.int64)
     np.cumsum(lengths, out=starts[1:])
     slot = np.arange(n, dtype=np.int64) - starts[sorted_agg]

@@ -1,0 +1,111 @@
+"""Shared runtime primitives: lifecycle contract, ring buffer, task supervision.
+
+Equivalents of the reference's ``surge.core.Controllable``/``Ack``
+(modules/common/src/main/scala/surge/core/Controllable.scala:7-34), ``CircularBuffer``
+(surge/internal/utils/CircularBuffer.scala), and the Akka actor-lifecycle plumbing
+(``ActorLifecycleManagerActor``) — re-expressed for asyncio tasks instead of actors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable, Coroutine, Generic, List, Optional, TypeVar
+
+logger = logging.getLogger("surge_tpu")
+
+T = TypeVar("T")
+
+
+class Ack:
+    """Positive acknowledgement of a lifecycle op (surge.core.Ack)."""
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return "Ack()"
+
+
+class Controllable:
+    """Lifecycle contract: start/stop/restart/shutdown (Controllable.scala:7-34).
+
+    Components (store indexer, publishers, router, pipeline) subclass this; the health
+    supervisor restarts registered Controllables when fatal signal patterns match
+    (HealthSupervisorActor.scala:63-111 analog).
+    """
+
+    async def start(self) -> Ack:
+        raise NotImplementedError
+
+    async def stop(self) -> Ack:
+        raise NotImplementedError
+
+    async def restart(self) -> Ack:
+        await self.stop()
+        return await self.start()
+
+    async def shutdown(self) -> Ack:
+        """Terminal stop (no restart expected)."""
+        return await self.stop()
+
+
+class CircularBuffer(Generic[T]):
+    """Fixed-capacity ring (CircularBuffer.scala analog; health bus keeps the last N
+    signals in one of these — HealthSignalBus.scala:177)."""
+
+    def __init__(self, capacity: int) -> None:
+        self._capacity = max(int(capacity), 1)
+        self._items: List[T] = []
+        self._next = 0
+
+    def push(self, item: T) -> None:
+        if len(self._items) < self._capacity:
+            self._items.append(item)
+        else:
+            self._items[self._next] = item
+        self._next = (self._next + 1) % self._capacity
+
+    def to_list(self) -> List[T]:
+        """Oldest→newest."""
+        if len(self._items) < self._capacity:
+            return list(self._items)
+        return self._items[self._next:] + self._items[: self._next]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class BackgroundTask:
+    """A supervised asyncio loop task with clean cancel-on-stop semantics."""
+
+    def __init__(self, coro_factory: Callable[[], Coroutine[Any, Any, None]],
+                 name: str) -> None:
+        self._factory = coro_factory
+        self._name = name
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._factory())
+            self._task.set_name(self._name)
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001 — stop is best-effort
+                pass
+
+    @property
+    def running(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+
+def resolve_future(fut: "asyncio.Future[T]", value: T) -> None:
+    if not fut.done():
+        fut.set_result(value)
+
+
+def fail_future(fut: asyncio.Future, exc: BaseException) -> None:
+    if not fut.done():
+        fut.set_exception(exc)

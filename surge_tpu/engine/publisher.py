@@ -1,0 +1,275 @@
+"""Per-partition transactional publisher — the exactly-once write path.
+
+Re-derivation of the protocol of the reference's ``KafkaProducerActorImpl``
+(modules/command-engine/core/src/main/scala/surge/internal/kafka/
+KafkaProducerActorImpl.scala:182-528) as an asyncio FSM:
+
+- ``uninitialized`` → ``initializing``: open the transactional producer (fencing any
+  zombie holding the same ``{prefix}-{state_topic}-{partition}`` id,
+  KafkaProducerActorImpl.scala:124), commit a flush record to establish the epoch
+  (:321-340), then
+- ``waiting_for_ktable``: hold publishes until the state store has indexed everything
+  already on the state topic (lag == 0, :341-376) so ``is_aggregate_state_current``
+  answers are sound from the first command, then
+- ``processing``: batch all pending publishes on a flush tick into ONE transaction
+  spanning events + state topics (:397-453); on commit, acknowledge every batched
+  publisher and track the published aggregates as **in-flight by state-topic offset**
+  until the store's indexed watermark passes them (:580-699) — the gap that
+  ``is_aggregate_state_current`` (:530-540) reports.
+- Fencing (``ProducerFencedError``) fails the open batch, then either re-initializes
+  (still partition owner: new epoch re-fences the impostor) or shuts down (ownership
+  lost) — :502-528.
+- Duplicate publish suppression by request id with a TTL (the ``PublishTracker``
+  analog, :580-608) so an entity retrying a publish whose commit actually landed does
+  not double-write.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol, Sequence
+
+from surge_tpu.common import BackgroundTask, fail_future, logger, resolve_future
+from surge_tpu.config import Config, default_config
+from surge_tpu.log.transport import LogRecord, ProducerFencedError
+
+
+class PublishFailedError(Exception):
+    """The batch containing this publish could not be committed."""
+
+
+class PublisherNotReadyError(Exception):
+    """Publish attempted before initialization finished or after shutdown."""
+
+
+class StoreProgress(Protocol):
+    """The state store's indexing progress, as seen by the publisher (the KTable
+    consumer-lag query, KafkaProducerActorImpl.scala:701-708)."""
+
+    def indexed_watermark(self, topic: str, partition: int) -> int:
+        """Offsets ``< watermark`` have been indexed into the materialized store."""
+
+
+@dataclass
+class _Pending:
+    request_id: str
+    aggregate_id: str
+    records: List[LogRecord]
+    future: "asyncio.Future[None]"
+
+
+@dataclass
+class PublisherStats:
+    """Counters for tests/metrics (flush loop visibility)."""
+
+    flushes: int = 0
+    records_published: int = 0
+    batches_failed: int = 0
+    fences: int = 0
+    reinitializations: int = 0
+    dedup_hits: int = 0
+    in_flight: int = 0
+
+
+class PartitionPublisher:
+    """Single-writer publisher for one (state-topic) partition."""
+
+    def __init__(self, log, state_topic: str, events_topic: Optional[str],
+                 partition: int, progress: StoreProgress,
+                 config: Config | None = None, transactional_id_prefix: str = "surge",
+                 still_owner: Callable[[], bool] = lambda: True,
+                 on_signal: Callable[[str, str], None] | None = None) -> None:
+        self.log = log
+        self.state_topic = state_topic
+        self.events_topic = events_topic
+        self.partition = partition
+        self.progress = progress
+        self.config = config or default_config()
+        self.transactional_id = f"{transactional_id_prefix}-{state_topic}-{partition}"
+        self.still_owner = still_owner
+        self.on_signal = on_signal or (lambda name, level: None)
+
+        self.state = "uninitialized"
+        self.stats = PublisherStats()
+        self._producer = None
+        self._pending: List[_Pending] = []
+        self._in_flight: Dict[str, int] = {}  # aggregate_id -> max state offset published
+        self._completed: Dict[str, float] = {}  # request_id -> completion time
+        self._watermark = 0
+        self._ready = asyncio.Event()
+        self._flush_interval = self.config.get_seconds("surge.producer.flush-interval-ms", 50)
+        self._check_interval = self.config.get_seconds("surge.producer.ktable-check-interval-ms", 500)
+        self._slow_txn_s = self.config.get_seconds("surge.producer.slow-transaction-warning-ms", 1000)
+        self._dedup_ttl_s = 60.0
+        self._single_record_opt_in = self.config.get_bool(
+            "surge.feature-flags.experimental.disable-single-record-transactions")
+        self._flush_task = BackgroundTask(self._flush_loop, f"publisher-flush-{partition}")
+        self._progress_task = BackgroundTask(self._progress_loop, f"publisher-progress-{partition}")
+
+    # -- lifecycle ----------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self.state = "initializing"
+        await self._initialize()
+        self._flush_task.start()
+        self._progress_task.start()
+
+    async def stop(self) -> None:
+        self.state = "stopped"
+        self._ready.clear()
+        await self._flush_task.stop()
+        await self._progress_task.stop()
+        for p in self._pending:
+            fail_future(p.future, PublisherNotReadyError("publisher stopped"))
+        self._pending.clear()
+
+    async def _initialize(self) -> None:
+        """Open producer (fences zombies), commit the flush record, gate on store lag."""
+        self._producer = self.log.transactional_producer(self.transactional_id)
+        self._producer.begin()
+        self._producer.send(LogRecord(topic=self.state_topic, key=None, value=b"",
+                                      partition=self.partition,
+                                      headers={"surge-flush": "1"}))
+        self._producer.commit()
+        self.state = "waiting_for_ktable"
+        while True:
+            end = self.log.end_offset(self.state_topic, self.partition)
+            self._watermark = self.progress.indexed_watermark(self.state_topic, self.partition)
+            if self._watermark >= end:
+                break
+            await asyncio.sleep(self._check_interval)
+        self.state = "processing"
+        self._ready.set()
+
+    async def wait_ready(self, timeout: float = 30.0) -> None:
+        await asyncio.wait_for(self._ready.wait(), timeout)
+
+    # -- publish path -------------------------------------------------------------------
+
+    async def publish(self, aggregate_id: str, records: Sequence[LogRecord],
+                      request_id: str) -> None:
+        """Queue records for the next flush transaction; resolves at commit.
+
+        Raises :class:`PublishFailedError` if the batch fails — callers (the aggregate
+        entity's persistence ladder, KTablePersistenceSupport.scala:71-156) retry with
+        the SAME ``request_id`` so a commit that actually landed is not repeated.
+        """
+        if self.state not in ("processing", "waiting_for_ktable", "initializing"):
+            raise PublisherNotReadyError(f"publisher state={self.state}")
+        if request_id in self._completed:
+            self.stats.dedup_hits += 1
+            return
+        fut: "asyncio.Future[None]" = asyncio.get_running_loop().create_future()
+        self._pending.append(_Pending(request_id, aggregate_id,
+                                      list(records), fut))
+        await fut
+
+    def is_aggregate_state_current(self, aggregate_id: str) -> bool:
+        """True iff nothing published for this aggregate is still ahead of the store's
+        indexed watermark and nothing is pending (KafkaProducerActorImpl.scala:530-540)."""
+        if any(p.aggregate_id == aggregate_id for p in self._pending):
+            return False
+        off = self._in_flight.get(aggregate_id)
+        if off is None:
+            return True
+        return off < self._watermark
+
+    # -- internal loops -----------------------------------------------------------------
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._flush_interval)
+            if self._pending and self.state == "processing":
+                batch, self._pending = self._pending, []
+                await self._publish_batch(batch)
+            self._purge_dedup()
+
+    async def _progress_loop(self) -> None:
+        while True:
+            self._refresh_watermark()
+            await asyncio.sleep(self._check_interval)
+
+    def _refresh_watermark(self) -> None:
+        self._watermark = self.progress.indexed_watermark(self.state_topic, self.partition)
+        for agg_id in [a for a, off in self._in_flight.items() if off < self._watermark]:
+            del self._in_flight[agg_id]
+        self.stats.in_flight = len(self._in_flight)
+
+    async def flush_now(self) -> None:
+        """Immediate flush (test/shutdown hook; production path is the timed tick)."""
+        if self._pending and self.state == "processing":
+            batch, self._pending = self._pending, []
+            await self._publish_batch(batch)
+
+    async def _publish_batch(self, batch: List[_Pending]) -> None:
+        records = [r for p in batch for r in p.records]
+        t0 = time.perf_counter()
+        try:
+            if self._single_record_opt_in and len(records) == 1:
+                committed = [self._producer.send_immediate(records[0])]
+            else:
+                self._producer.begin()
+                for r in records:
+                    self._producer.send(r)
+                committed = list(self._producer.commit())
+        except ProducerFencedError:
+            self.stats.fences += 1
+            self.on_signal("surge.producer.fenced", "error")
+            for p in batch:
+                fail_future(p.future, PublishFailedError(
+                    f"publisher for partition {self.partition} was fenced"))
+            await self._handle_fenced()
+            return
+        except Exception as exc:  # noqa: BLE001 — transport failure fails the batch
+            self.stats.batches_failed += 1
+            try:
+                if getattr(self._producer, "in_transaction", False):
+                    self._producer.abort()
+            except Exception:  # noqa: BLE001
+                self.on_signal("surge.producer.abort-failed", "error")
+            for p in batch:
+                fail_future(p.future, PublishFailedError(str(exc)))
+            return
+
+        elapsed = time.perf_counter() - t0
+        if elapsed > self._slow_txn_s:
+            logger.warning("slow publish transaction: %.3fs on %s[%d]",
+                           elapsed, self.state_topic, self.partition)
+        # in-flight tracking: the max state-topic offset per aggregate in this commit
+        by_index = iter(committed)
+        now = time.time()
+        for p in batch:
+            max_state_off = None
+            for _ in p.records:
+                rec = next(by_index)
+                if rec.topic == self.state_topic:
+                    max_state_off = rec.offset if max_state_off is None else max(max_state_off, rec.offset)
+            if max_state_off is not None:
+                self._in_flight[p.aggregate_id] = max_state_off
+            self._completed[p.request_id] = now
+            resolve_future(p.future, None)
+        self.stats.flushes += 1
+        self.stats.records_published += len(records)
+        self.stats.in_flight = len(self._in_flight)
+
+    async def _handle_fenced(self) -> None:
+        """Fenced: re-init if we still own the partition, else shut down
+        (KafkaProducerActorImpl.scala:502-528)."""
+        self.state = "fenced"
+        self._ready.clear()
+        if self.still_owner():
+            self.stats.reinitializations += 1
+            self.on_signal("surge.producer.reinitializing", "warning")
+            await self._initialize()
+        else:
+            self.on_signal("surge.producer.shutdown-not-owner", "warning")
+            await self.stop()
+
+    def _purge_dedup(self) -> None:
+        if not self._completed:
+            return
+        cutoff = time.time() - self._dedup_ttl_s
+        for rid in [r for r, t in self._completed.items() if t < cutoff]:
+            del self._completed[rid]

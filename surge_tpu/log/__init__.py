@@ -1,0 +1,30 @@
+"""Log transport layer — the durability/replication substrate seam.
+
+Equivalent of the reference's Kafka client layer (modules/common/src/main/scala/surge/
+kafka/KafkaProducer.scala:18-265, KafkaConsumer.scala:17-132, KafkaAdminClient.scala) and
+the broker semantics the engine relies on: transactional atomic multi-topic appends,
+producer-epoch zombie fencing, read_committed isolation, compacted state topics, and
+consumer-lag queries. Every engine test in the reference runs against this seam
+(SURVEY.md §4); :class:`InMemoryLog` is the EmbeddedKafka analog and the default
+transport for single-process engines.
+"""
+
+from surge_tpu.log.transport import (
+    LogRecord,
+    LogTransport,
+    ProducerFencedError,
+    TopicSpec,
+    TransactionalProducer,
+    TransactionStateError,
+)
+from surge_tpu.log.memory import InMemoryLog
+
+__all__ = [
+    "InMemoryLog",
+    "LogRecord",
+    "LogTransport",
+    "ProducerFencedError",
+    "TopicSpec",
+    "TransactionalProducer",
+    "TransactionStateError",
+]

@@ -1,0 +1,197 @@
+"""Deterministic in-process log with full transactional semantics.
+
+The EmbeddedKafka analog (SURVEY.md §4 test strategy): every broker behavior the engine
+depends on — atomic multi-topic commits, epoch fencing, read_committed isolation,
+compaction views, offset queries — reproduced in-process so engine/publisher/store tests
+are hermetic and fast. Also the default transport for single-process engines.
+
+Offsets are assigned at commit time under one lock, so a transaction's records across
+topics become visible atomically and read_committed == read_uncommitted at all times
+(open transactions buffer producer-side). This is a simplification of Kafka's
+LSO/control-record machinery that preserves the observable contract the engine uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from surge_tpu.log.transport import (
+    LogRecord,
+    ProducerFencedError,
+    TopicSpec,
+    TransactionStateError,
+)
+
+
+class InMemoryLog:
+    """In-process :class:`surge_tpu.log.transport.LogTransport` implementation."""
+
+    def __init__(self, auto_create_partitions: int = 1) -> None:
+        self._topics: Dict[str, TopicSpec] = {}
+        self._partitions: Dict[Tuple[str, int], List[LogRecord]] = {}
+        self._epochs: Dict[str, int] = {}
+        self._lock = threading.RLock()
+        self._auto_create_partitions = auto_create_partitions
+        # async wakeups for consumers; created lazily per (topic, partition)
+        self._append_events: Dict[Tuple[str, int], asyncio.Event] = {}
+
+    # -- topics -------------------------------------------------------------------------
+
+    def create_topic(self, spec: TopicSpec) -> None:
+        with self._lock:
+            if spec.name in self._topics:
+                return
+            self._topics[spec.name] = spec
+            for p in range(spec.partitions):
+                self._partitions[(spec.name, p)] = []
+
+    def topic(self, name: str) -> TopicSpec:
+        with self._lock:
+            if name not in self._topics:
+                self.create_topic(TopicSpec(name, self._auto_create_partitions))
+            return self._topics[name]
+
+    def num_partitions(self, name: str) -> int:
+        return self.topic(name).partitions
+
+    # -- producers ----------------------------------------------------------------------
+
+    def transactional_producer(self, transactional_id: str) -> "InMemoryTxnProducer":
+        with self._lock:
+            epoch = self._epochs.get(transactional_id, 0) + 1
+            self._epochs[transactional_id] = epoch
+            return InMemoryTxnProducer(self, transactional_id, epoch)
+
+    def _check_epoch(self, transactional_id: str, epoch: int) -> None:
+        with self._lock:
+            if self._epochs.get(transactional_id) != epoch:
+                raise ProducerFencedError(
+                    f"producer {transactional_id!r} epoch {epoch} fenced by "
+                    f"epoch {self._epochs.get(transactional_id)}")
+
+    def _append(self, records: Sequence[LogRecord]) -> List[LogRecord]:
+        """Atomically append records (possibly spanning topics/partitions)."""
+        out: List[LogRecord] = []
+        now = time.time()
+        with self._lock:
+            touched = set()
+            for r in records:
+                self.topic(r.topic)  # auto-create
+                part = self._partitions.get((r.topic, r.partition))
+                if part is None:
+                    raise KeyError(f"{r.topic}[{r.partition}] does not exist")
+                assigned = LogRecord(
+                    topic=r.topic, key=r.key, value=r.value, partition=r.partition,
+                    headers=dict(r.headers), offset=len(part), timestamp=now)
+                part.append(assigned)
+                out.append(assigned)
+                touched.add((r.topic, r.partition))
+        for tp in touched:
+            ev = self._append_events.get(tp)
+            if ev is not None:
+                ev.set()
+        return out
+
+    # -- reads --------------------------------------------------------------------------
+
+    def read(self, topic: str, partition: int, from_offset: int = 0,
+             max_records: Optional[int] = None,
+             isolation: str = "read_committed") -> Sequence[LogRecord]:
+        del isolation  # open transactions are producer-side buffers; log is all-stable
+        with self._lock:
+            part = self._partitions.get((topic, partition), [])
+            end = len(part) if max_records is None else min(len(part), from_offset + max_records)
+            return list(part[from_offset:end])
+
+    def end_offset(self, topic: str, partition: int,
+                   isolation: str = "read_committed") -> int:
+        del isolation
+        with self._lock:
+            self.topic(topic)
+            return len(self._partitions[(topic, partition)])
+
+    def latest_by_key(self, topic: str, partition: int,
+                      isolation: str = "read_committed") -> Mapping[str, LogRecord]:
+        with self._lock:
+            out: Dict[str, LogRecord] = {}
+            for r in self._partitions.get((topic, partition), []):
+                if r.key is None:
+                    continue
+                if r.value is None:
+                    out.pop(r.key, None)  # tombstone
+                else:
+                    out[r.key] = r
+            return out
+
+    async def wait_for_append(self, topic: str, partition: int,
+                              after_offset: int) -> None:
+        tp = (topic, partition)
+        while self.end_offset(topic, partition) <= after_offset:
+            ev = self._append_events.get(tp)
+            if ev is None or ev.is_set():
+                ev = asyncio.Event()
+                self._append_events[tp] = ev
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                pass  # re-check end_offset (guards against lost wakeups across loops)
+
+
+class InMemoryTxnProducer:
+    """Transactional producer handle; one per transactional id, epoch-fenced."""
+
+    def __init__(self, log: InMemoryLog, transactional_id: str, epoch: int) -> None:
+        self._log = log
+        self.transactional_id = transactional_id
+        self.epoch = epoch
+        self._buffer: Optional[List[LogRecord]] = None
+
+    @property
+    def fenced(self) -> bool:
+        try:
+            self._log._check_epoch(self.transactional_id, self.epoch)
+            return False
+        except ProducerFencedError:
+            return True
+
+    @property
+    def in_transaction(self) -> bool:
+        return self._buffer is not None
+
+    def begin(self) -> None:
+        self._log._check_epoch(self.transactional_id, self.epoch)
+        if self._buffer is not None:
+            raise TransactionStateError("transaction already open")
+        self._buffer = []
+
+    def send(self, record: LogRecord) -> None:
+        self._log._check_epoch(self.transactional_id, self.epoch)
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        self._buffer.append(record)
+
+    def commit(self) -> Sequence[LogRecord]:
+        # fencing is re-checked inside the atomic append's lock window
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        with self._log._lock:
+            self._log._check_epoch(self.transactional_id, self.epoch)
+            records = self._buffer
+            self._buffer = None
+            return self._log._append(records)
+
+    def abort(self) -> None:
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        self._buffer = None
+
+    def send_immediate(self, record: LogRecord) -> LogRecord:
+        with self._log._lock:
+            self._log._check_epoch(self.transactional_id, self.epoch)
+            if self._buffer is not None:
+                raise TransactionStateError(
+                    "send_immediate inside an open transaction")
+            return self._log._append([record])[0]

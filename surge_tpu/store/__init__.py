@@ -1,0 +1,25 @@
+"""Materialized aggregate-state store — the KTable equivalent.
+
+Reference: the embedded Kafka Streams KTable over the compacted state topic
+(modules/common/src/main/scala/surge/kafka/streams/AggregateStateStoreKafkaStreams.scala:53-178,
+SurgeStateStoreConsumer.scala:57-76 — "the entire KTable is just a compacted-topic →
+key-value-store index"). Here the index is an explicit asyncio consumer task
+(:class:`StateStoreIndexer`) over a pluggable :class:`KeyValueStore`, with
+``(partition, offset)`` watermarks answering the publisher's lag queries, plus a **bulk
+restore** path that rebuilds the whole store by folding the events topic through the TPU
+replay engine (``surge.replay.backend=tpu``) or the scalar fold (``cpu``) — the
+north-star workload (SURVEY.md §3.3, BASELINE.md).
+"""
+
+from surge_tpu.store.kv import InMemoryKeyValueStore, KeyValueStore
+from surge_tpu.store.indexer import StateStoreIndexer
+from surge_tpu.store.restore import RestoreResult, restore_from_events, restore_from_state_topic
+
+__all__ = [
+    "InMemoryKeyValueStore",
+    "KeyValueStore",
+    "StateStoreIndexer",
+    "RestoreResult",
+    "restore_from_events",
+    "restore_from_state_topic",
+]

@@ -1,0 +1,147 @@
+"""State-topic indexer task: compacted topic → KV store, with watermarks.
+
+The asyncio re-expression of the embedded Kafka Streams KTable job
+(KafkaStreamManagerActor.scala:20-190 + SurgeStateStoreConsumer.scala:57-76): consume the
+state topic read_committed, upsert the latest snapshot per aggregate id into the KV
+store, and expose
+
+- ``get_aggregate_bytes(id)`` — the aggregate cold-start read path
+  (AggregateStateStoreKafkaStreams.scala:126-140),
+- ``indexed_watermark(topic, partition)`` — the lag signal the publisher's
+  ``is_aggregate_state_current`` gating consumes (KafkaProducerActorImpl.scala:701-708),
+- ``wipe-state-on-start`` (common reference.conf:8-12) and bulk-restore priming
+  (watermark fast-forward after a TPU rebuild).
+
+On-change listeners fire on every RUNNING transition / assignment change — the
+``KafkaStreamsUpdatePartitionsOnStateChangeListener`` analog that keeps the partition
+tracker current (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, List, Optional, Sequence
+
+from surge_tpu.common import Ack, BackgroundTask, Controllable, logger
+from surge_tpu.config import Config, default_config
+from surge_tpu.log.transport import LogRecord
+from surge_tpu.store.kv import KeyValueStore, create_store
+
+
+class StateStoreIndexer(Controllable):
+    """Materializes one state topic's assigned partitions into a KV store."""
+
+    def __init__(self, log, state_topic: str,
+                 partitions: Optional[Sequence[int]] = None,
+                 store: Optional[KeyValueStore] = None,
+                 config: Config | None = None,
+                 on_signal: Callable[[str, str], None] | None = None) -> None:
+        self.log = log
+        self.state_topic = state_topic
+        self.config = config or default_config()
+        self.store = store if store is not None else create_store(
+            self.config.get_str("surge.state-store.backend", "memory"))
+        self.partitions: List[int] = list(
+            partitions if partitions is not None else range(log.num_partitions(state_topic)))
+        self.on_signal = on_signal or (lambda name, level: None)
+        self._watermarks: Dict[int, int] = {p: 0 for p in self.partitions}
+        self._max_poll = self.config.get_int("surge.state-store.restore-max-poll-records", 500)
+        self._poll_timeout = max(
+            self.config.get_seconds("surge.state-store.commit-interval-ms", 3000), 0.001)
+        self._tasks: List[BackgroundTask] = []
+        self._running = False
+        self._state_listeners: List[Callable[[str], None]] = []
+
+    # -- lifecycle (Controllable) -------------------------------------------------------
+
+    async def start(self) -> Ack:
+        if self.config.get_bool("surge.state-store.wipe-state-on-start"):
+            logger.info("wipe-state-on-start: clearing %s store", self.state_topic)
+            self.store.clear()
+            self._watermarks = {p: 0 for p in self.partitions}
+        self._tasks = [
+            BackgroundTask(self._make_partition_loop(p), f"indexer-{self.state_topic}-{p}")
+            for p in self.partitions
+        ]
+        for t in self._tasks:
+            t.start()
+        self._running = True
+        self._notify_state("running")
+        return Ack()
+
+    async def stop(self) -> Ack:
+        self._running = False
+        for t in self._tasks:
+            await t.stop()
+        self._tasks = []
+        self._notify_state("stopped")
+        return Ack()
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    def register_state_listener(self, fn: Callable[[str], None]) -> None:
+        """Listener(state) on running/stopped transitions (partition-tracker feed)."""
+        self._state_listeners.append(fn)
+
+    def _notify_state(self, state: str) -> None:
+        for fn in self._state_listeners:
+            try:
+                fn(state)
+            except Exception:  # noqa: BLE001 — listener bugs must not kill the indexer
+                logger.exception("state listener failed")
+
+    # -- read path ----------------------------------------------------------------------
+
+    def get_aggregate_bytes(self, aggregate_id: str) -> Optional[bytes]:
+        return self.store.get(aggregate_id)
+
+    def indexed_watermark(self, topic: str, partition: int) -> int:
+        if topic != self.state_topic:
+            return 0
+        return self._watermarks.get(partition, 0)
+
+    def total_lag(self) -> int:
+        """Sum over assigned partitions of (end offset − indexed watermark)."""
+        return sum(max(self.log.end_offset(self.state_topic, p) - self._watermarks[p], 0)
+                   for p in self.partitions)
+
+    # -- restore priming ----------------------------------------------------------------
+
+    def prime(self, watermarks: Dict[int, int]) -> None:
+        """Fast-forward watermarks after a bulk restore filled the store out-of-band
+        (the TPU replay writeback path, surge_tpu.store.restore)."""
+        for p, off in watermarks.items():
+            if p in self._watermarks:
+                self._watermarks[p] = max(self._watermarks[p], off)
+
+    # -- indexing loop ------------------------------------------------------------------
+
+    def _make_partition_loop(self, partition: int):
+        async def loop() -> None:
+            while True:
+                offset = self._watermarks[partition]
+                records = self.log.read(self.state_topic, partition, offset,
+                                        max_records=self._max_poll)
+                if records:
+                    self._apply(records)
+                    self._watermarks[partition] = records[-1].offset + 1
+                    continue
+                try:
+                    await asyncio.wait_for(
+                        self.log.wait_for_append(self.state_topic, partition, offset),
+                        timeout=self._poll_timeout)
+                except asyncio.TimeoutError:
+                    pass
+
+        return loop
+
+    def _apply(self, records: Sequence[LogRecord]) -> None:
+        for r in records:
+            if r.key is None:
+                continue  # flush/control record (publisher init sentinel)
+            if r.value is None:
+                self.store.delete(r.key)
+            else:
+                self.store.put(r.key, r.value)

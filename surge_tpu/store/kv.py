@@ -1,0 +1,73 @@
+"""Pluggable key-value store backing the materialized state.
+
+The plugin seam mirrors ``SurgeKafkaStreamsPersistencePlugin`` (modules/common/src/main/
+scala/surge/kafka/streams/SurgeKafkaStreamsPersistencePlugin.scala:12-51 — RocksDB by
+default, loadable by name from ``surge.kafka-streams.state-store-plugin``). Backends here:
+``memory`` (dict), and ``native`` (the C++ mmap store in ``csrc/``, loaded via ctypes)
+selected by ``surge.state-store.backend``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Protocol, Tuple
+
+
+class KeyValueStore(Protocol):
+    """Byte-oriented KV contract (ReadOnlyKeyValueStore + write side)."""
+
+    def get(self, key: str) -> Optional[bytes]: ...
+
+    def put(self, key: str, value: bytes) -> None: ...
+
+    def delete(self, key: str) -> None: ...
+
+    def all_items(self) -> Iterator[Tuple[str, bytes]]: ...
+
+    def range_items(self, start: str, stop: str) -> Iterator[Tuple[str, bytes]]:
+        """Keys in ``[start, stop]`` (inclusive, like ReadOnlyKeyValueStore.range)."""
+
+    def approximate_num_entries(self) -> int: ...
+
+    def clear(self) -> None: ...
+
+
+class InMemoryKeyValueStore:
+    """Dict-backed store (the in-memory persistence plugin analog)."""
+
+    def __init__(self) -> None:
+        self._data: Dict[str, bytes] = {}
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self._data.get(key)
+
+    def put(self, key: str, value: bytes) -> None:
+        self._data[key] = value
+
+    def delete(self, key: str) -> None:
+        self._data.pop(key, None)
+
+    def all_items(self) -> Iterator[Tuple[str, bytes]]:
+        return iter(sorted(self._data.items()))
+
+    def range_items(self, start: str, stop: str) -> Iterator[Tuple[str, bytes]]:
+        return iter((k, v) for k, v in sorted(self._data.items()) if start <= k <= stop)
+
+    def approximate_num_entries(self) -> int:
+        return len(self._data)
+
+    def clear(self) -> None:
+        self._data.clear()
+
+
+def create_store(backend: str) -> KeyValueStore:
+    """Backend selection by config name (plugin-loader analog,
+    SurgeKafkaStreamsPersistencePluginLoader.load:30-51)."""
+    if backend == "memory":
+        return InMemoryKeyValueStore()
+    if backend == "native":
+        from surge_tpu.store.native import NativeKeyValueStore, native_available
+
+        if native_available():
+            return NativeKeyValueStore()
+        return InMemoryKeyValueStore()
+    raise ValueError(f"unknown state-store backend {backend!r}")

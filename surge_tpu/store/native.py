@@ -1,0 +1,120 @@
+"""ctypes loader for the C++ state store (csrc/). Falls back cleanly when unbuilt.
+
+The native backend replaces the reference's RocksDB JNI dependency
+(SurgeKafkaStreamsPersistencePlugin.scala:17-22, CustomRocksDBConfigSetter.scala) with a
+first-party C++ hash-indexed KV store. ``create_store("native")`` uses it when the
+shared library has been built (``csrc/build.sh``) and silently degrades to the
+in-memory store otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Iterator, Optional, Tuple
+
+_LIB_PATHS = [
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+                 "csrc", "build", "libsurge_store.so"),
+    os.path.join(os.path.dirname(__file__), "libsurge_store.so"),
+]
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    for path in _LIB_PATHS:
+        if os.path.exists(path):
+            lib = ctypes.CDLL(path)
+            lib.surge_store_new.restype = ctypes.c_void_p
+            lib.surge_store_free.argtypes = [ctypes.c_void_p]
+            lib.surge_store_put.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t]
+            lib.surge_store_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_size_t)]
+            lib.surge_store_get.restype = ctypes.POINTER(ctypes.c_char)
+            lib.surge_store_delete.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+            lib.surge_store_size.argtypes = [ctypes.c_void_p]
+            lib.surge_store_size.restype = ctypes.c_size_t
+            lib.surge_store_clear.argtypes = [ctypes.c_void_p]
+            lib.surge_store_iter_new.argtypes = [ctypes.c_void_p]
+            lib.surge_store_iter_new.restype = ctypes.c_void_p
+            lib.surge_store_iter_next.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+                ctypes.POINTER(ctypes.c_size_t)]
+            lib.surge_store_iter_next.restype = ctypes.c_int
+            lib.surge_store_iter_free.argtypes = [ctypes.c_void_p]
+            _lib = lib
+            return _lib
+    return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeKeyValueStore:
+    """KV store backed by the C++ open-addressing hash store (csrc/store.cc)."""
+
+    def __init__(self) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native store library not built (run csrc/build.sh)")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.surge_store_new())
+
+    def __del__(self) -> None:  # pragma: no cover
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None) is not None:
+            self._lib.surge_store_free(h)
+
+    def put(self, key: str, value: bytes) -> None:
+        k = key.encode()
+        self._lib.surge_store_put(self._h, k, len(k), value, len(value))
+
+    def get(self, key: str) -> Optional[bytes]:
+        k = key.encode()
+        n = ctypes.c_size_t(0)
+        p = self._lib.surge_store_get(self._h, k, len(k), ctypes.byref(n))
+        if not p:
+            return None
+        return ctypes.string_at(p, n.value)
+
+    def delete(self, key: str) -> None:
+        k = key.encode()
+        self._lib.surge_store_delete(self._h, k, len(k))
+
+    def approximate_num_entries(self) -> int:
+        return int(self._lib.surge_store_size(self._h))
+
+    def clear(self) -> None:
+        self._lib.surge_store_clear(self._h)
+
+    def all_items(self) -> Iterator[Tuple[str, bytes]]:
+        items = []
+        it = ctypes.c_void_p(self._lib.surge_store_iter_new(self._h))
+        try:
+            kp = ctypes.POINTER(ctypes.c_char)()
+            vp = ctypes.POINTER(ctypes.c_char)()
+            kn = ctypes.c_size_t(0)
+            vn = ctypes.c_size_t(0)
+            while self._lib.surge_store_iter_next(
+                    it, ctypes.byref(kp), ctypes.byref(kn),
+                    ctypes.byref(vp), ctypes.byref(vn)):
+                items.append((ctypes.string_at(kp, kn.value).decode(),
+                              ctypes.string_at(vp, vn.value)))
+        finally:
+            self._lib.surge_store_iter_free(it)
+        return iter(sorted(items))
+
+    def range_items(self, start: str, stop: str) -> Iterator[Tuple[str, bytes]]:
+        return iter((k, v) for k, v in self.all_items() if start <= k <= stop)

@@ -1,0 +1,125 @@
+"""Bulk store restore — the cold-start rebuild path (north-star workload).
+
+Two sources, selected by the engine on cold start:
+
+- :func:`restore_from_state_topic` — scan the compacted state topic's latest snapshot
+  per aggregate into the store. This is the reference's only restore path (Kafka Streams
+  changelog restore, SURVEY.md §3.3 "bulk replay is Kafka Streams restore").
+- :func:`restore_from_events` — rebuild every aggregate's state by folding the events
+  topic. **New capability**: routed through the batched TPU replay engine when
+  ``surge.replay.backend = tpu`` (ReplayEngine: vmap×scan over event tensors) or the
+  scalar fold when ``cpu`` — both must produce byte-identical stores (golden-tested).
+
+Both return ``(partition → next offset)`` watermarks so the indexer can be primed and
+resume tail-indexing exactly where the restore left off (the checkpoint/resume contract,
+SURVEY.md §5.4 TPU mapping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from surge_tpu.config import Config, default_config
+from surge_tpu.engine.model import ReplaySpec, fold_events
+from surge_tpu.store.kv import KeyValueStore
+
+
+@dataclass
+class RestoreResult:
+    num_aggregates: int
+    num_events: int
+    watermarks: Dict[int, int]  # partition -> next offset (on the scanned topic)
+    backend: str
+
+
+def restore_from_state_topic(log, state_topic: str, store: KeyValueStore,
+                             partitions: Optional[Sequence[int]] = None) -> RestoreResult:
+    """Latest-snapshot-per-key scan of the compacted state topic into the store."""
+    parts = list(partitions if partitions is not None
+                 else range(log.num_partitions(state_topic)))
+    n = 0
+    watermarks: Dict[int, int] = {}
+    for p in parts:
+        for key, rec in log.latest_by_key(state_topic, p).items():
+            store.put(key, rec.value)
+            n += 1
+        watermarks[p] = log.end_offset(state_topic, p)
+    return RestoreResult(num_aggregates=n, num_events=n, watermarks=watermarks,
+                         backend="state-topic")
+
+
+def restore_from_events(
+        log, events_topic: str, store: KeyValueStore, *,
+        deserialize_event: Callable[[bytes], Any],
+        serialize_state: Callable[[str, Any], bytes],
+        model=None, replay_spec: Optional[ReplaySpec] = None,
+        encode_event: Callable[[Any], Any] | None = None,
+        decode_state: Callable[[str, Any], Any] | None = None,
+        config: Config | None = None, mesh=None,
+        partitions: Optional[Sequence[int]] = None) -> RestoreResult:
+    """Fold the whole events topic into per-aggregate states and write them back.
+
+    Backend comes from ``surge.replay.backend``: ``tpu`` batches the fold through
+    :class:`surge_tpu.replay.ReplayEngine` (requires ``replay_spec``; ``encode_event``
+    maps raw events into tensor-schema form, e.g. Vocab dictionary encoding, and
+    ``decode_state`` post-processes each decoded state given its aggregate id);
+    ``cpu`` runs the scalar per-aggregate fold (requires ``model``).
+    """
+    cfg = config or default_config()
+    backend = cfg.get_str("surge.replay.backend", "tpu")
+    parts = list(partitions if partitions is not None
+                 else range(log.num_partitions(events_topic)))
+
+    # group events by aggregate id, preserving per-partition offset order (the log's
+    # per-aggregate order guarantee: one partition per aggregate)
+    logs: Dict[str, list] = {}
+    num_events = 0
+    watermarks: Dict[int, int] = {}
+    for p in parts:
+        for rec in log.read(events_topic, p):
+            if rec.key is None or rec.value is None:
+                continue
+            logs.setdefault(rec.key, []).append(deserialize_event(rec.value))
+            num_events += 1
+        watermarks[p] = log.end_offset(events_topic, p)
+
+    agg_ids = list(logs)
+    if backend == "cpu":
+        if model is None:
+            raise ValueError("cpu replay backend requires `model`")
+        states = [fold_events(model, model.initial_state(a) if hasattr(model, "initial_state") else None,
+                              logs[a]) for a in agg_ids]
+    elif backend == "tpu":
+        if replay_spec is None:
+            raise ValueError("tpu replay backend requires `replay_spec`")
+        from surge_tpu.codec.tensor import decode_states
+        from surge_tpu.replay.engine import ReplayEngine
+
+        engine = ReplayEngine(replay_spec, config=cfg, mesh=mesh)
+        result = engine.replay_ragged([logs[a] for a in agg_ids], encode=encode_event)
+        states = decode_states(replay_spec.registry.state, result.states)
+    else:
+        raise ValueError(f"unknown replay backend {backend!r}")
+
+    for agg_id, state in zip(agg_ids, states):
+        if state is None:
+            continue
+        state = _with_aggregate_id(state, agg_id)
+        if decode_state is not None:
+            state = decode_state(agg_id, state)
+        store.put(agg_id, serialize_state(agg_id, state))
+    return RestoreResult(num_aggregates=len(agg_ids), num_events=num_events,
+                         watermarks=watermarks, backend=backend)
+
+
+def _with_aggregate_id(state: Any, aggregate_id: str) -> Any:
+    """Re-attach the aggregate id to states reconstructed from tensor columns (string
+    fields are excluded from the tensor schema, surge_tpu.codec.schema)."""
+    if dataclasses.is_dataclass(state) and any(
+            f.name == "aggregate_id" for f in dataclasses.fields(state)):
+        current = getattr(state, "aggregate_id", None)
+        if not current:
+            return dataclasses.replace(state, aggregate_id=aggregate_id)
+    return state

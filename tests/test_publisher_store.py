@@ -1,0 +1,297 @@
+"""Publisher FSM + state-store indexer + bulk restore.
+
+The KafkaProducerActorImplSpec / AggregateStateStoreKafkaStreamsSpec analogs
+(SURVEY.md §4): init gating on store lag, one-transaction flush batching,
+in-flight tracking behind is_aggregate_state_current, zombie fencing with
+restart-or-shutdown, request dedup, and the cpu-vs-tpu byte-identical cold rebuild."""
+
+import asyncio
+
+import pytest
+
+from surge_tpu.config import default_config
+from surge_tpu.engine.publisher import (
+    PartitionPublisher,
+    PublishFailedError,
+)
+from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
+from surge_tpu.models import counter
+from surge_tpu.store import (
+    InMemoryKeyValueStore,
+    StateStoreIndexer,
+    restore_from_events,
+    restore_from_state_topic,
+)
+
+CFG = default_config().with_overrides({
+    "surge.producer.flush-interval-ms": 5,
+    "surge.producer.ktable-check-interval-ms": 5,
+    "surge.state-store.commit-interval-ms": 20,
+})
+
+
+def make_log():
+    log = InMemoryLog()
+    log.create_topic(TopicSpec("events", 1))
+    log.create_topic(TopicSpec("state", 1, compacted=True))
+    return log
+
+
+def state_rec(agg, value):
+    return LogRecord(topic="state", key=agg, value=value, partition=0)
+
+
+def event_rec(agg, value):
+    return LogRecord(topic="events", key=agg, value=value, partition=0)
+
+
+async def start_stack(log, **pub_kwargs):
+    indexer = StateStoreIndexer(log, "state", config=CFG)
+    await indexer.start()
+    pub = PartitionPublisher(log, "state", "events", 0, indexer, config=CFG, **pub_kwargs)
+    await pub.start()
+    await pub.wait_ready(5.0)
+    return indexer, pub
+
+
+def test_init_commits_flush_record_and_waits_for_lag_zero():
+    async def scenario():
+        log = make_log()
+        # pre-existing state records the indexer must chew through before ready
+        seed = log.transactional_producer("seed")
+        seed.begin()
+        for i in range(20):
+            seed.send(state_rec(f"a{i}", b"s"))
+        seed.commit()
+
+        indexer = StateStoreIndexer(log, "state", config=CFG)
+        pub = PartitionPublisher(log, "state", "events", 0, indexer, config=CFG)
+        start = asyncio.ensure_future(pub.start())
+        await asyncio.sleep(0.05)
+        assert pub.state == "waiting_for_ktable"  # indexer not running yet
+        await indexer.start()
+        await start
+        await pub.wait_ready(5.0)
+        assert pub.state == "processing"
+        # flush record landed on the state topic but is ignored by the store
+        assert log.end_offset("state", 0) == 21
+        assert indexer.store.approximate_num_entries() == 20
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_flush_batches_multiple_publishes_into_one_transaction():
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log)
+        base_state = log.end_offset("state", 0)
+
+        await asyncio.gather(
+            pub.publish("a", [event_rec("a", b"e1"), state_rec("a", b"sa")], "r1"),
+            pub.publish("b", [event_rec("b", b"e2"), state_rec("b", b"sb")], "r2"),
+        )
+        assert pub.stats.flushes == 1  # both rode one transaction
+        assert pub.stats.records_published == 4
+        assert [r.value for r in log.read("events", 0)] == [b"e1", b"e2"]
+        assert log.end_offset("state", 0) == base_state + 2
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_is_aggregate_state_current_tracks_indexing_gap():
+    async def scenario():
+        log = make_log()
+        indexer = StateStoreIndexer(log, "state", config=CFG)
+        await indexer.start()
+        pub = PartitionPublisher(log, "state", "events", 0, indexer, config=CFG)
+        await pub.start()
+        await pub.wait_ready(5.0)
+        await indexer.stop()  # freeze indexing to observe the in-flight window
+
+        await pub.publish("agg", [state_rec("agg", b"s1")], "r1")
+        pub._refresh_watermark()
+        assert not pub.is_aggregate_state_current("agg")  # published, not yet indexed
+        assert pub.is_aggregate_state_current("other")
+
+        await indexer.start()
+        await asyncio.sleep(0.05)
+        pub._refresh_watermark()
+        assert pub.is_aggregate_state_current("agg")
+        assert indexer.get_aggregate_bytes("agg") == b"s1"
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_zombie_fenced_batch_fails_and_shuts_down_when_not_owner():
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log, still_owner=lambda: False)
+        events_before = log.end_offset("events", 0)
+
+        # an impostor takes over the transactional id (new process owns the partition)
+        log.transactional_producer(pub.transactional_id)
+        with pytest.raises(PublishFailedError):
+            await pub.publish("a", [event_rec("a", b"zombie")], "r1")
+        assert pub.stats.fences == 1
+        assert pub.state == "stopped"  # not owner -> shutdown
+        assert log.end_offset("events", 0) == events_before  # nothing half-written
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_fenced_but_still_owner_reinitializes_and_serves_again():
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log, still_owner=lambda: True)
+
+        log.transactional_producer(pub.transactional_id)  # fence it once
+        with pytest.raises(PublishFailedError):
+            await pub.publish("a", [event_rec("a", b"lost")], "r1")
+        await pub.wait_ready(5.0)  # re-initialized with a fresh epoch
+        assert pub.stats.reinitializations == 1
+        assert pub.state == "processing"
+
+        await pub.publish("a", [event_rec("a", b"retry")], "r1-retry")
+        assert [r.value for r in log.read("events", 0)] == [b"retry"]
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_request_id_dedup_suppresses_double_write():
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log)
+        await pub.publish("a", [event_rec("a", b"e1")], "req-1")
+        await pub.publish("a", [event_rec("a", b"e1")], "req-1")  # retry after success
+        assert pub.stats.dedup_hits == 1
+        assert [r.value for r in log.read("events", 0)] == [b"e1"]
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_indexer_tombstones_and_wipe_on_start():
+    async def scenario():
+        log = make_log()
+        p = log.transactional_producer("seed")
+        p.begin()
+        p.send(state_rec("a", b"s1"))
+        p.send(state_rec("b", b"s2"))
+        p.send(state_rec("a", None))  # tombstone deletes a
+        p.commit()
+
+        indexer = StateStoreIndexer(log, "state", config=CFG)
+        await indexer.start()
+        await asyncio.sleep(0.05)
+        assert indexer.get_aggregate_bytes("a") is None
+        assert indexer.get_aggregate_bytes("b") == b"s2"
+        assert indexer.indexed_watermark("state", 0) == 3
+        assert indexer.total_lag() == 0
+        await indexer.stop()
+
+        wipe_cfg = CFG.with_overrides({"surge.state-store.wipe-state-on-start": True})
+        indexer2 = StateStoreIndexer(log, "state", store=indexer.store, config=wipe_cfg)
+        await indexer2.start()  # wipe clears, then re-indexes from offset 0
+        await asyncio.sleep(0.05)
+        assert indexer2.get_aggregate_bytes("b") == b"s2"
+        await indexer2.stop()
+
+    asyncio.run(scenario())
+
+
+# -- bulk restore -----------------------------------------------------------------------
+
+
+def _seed_counter_events(log, num_aggregates=40):
+    """Write counter event histories to the events topic via the real model+formats."""
+    model = counter.CounterModel()
+    fmt = counter.event_formatting()
+    p = log.transactional_producer("seed")
+    expected = {}
+    for i in range(num_aggregates):
+        agg = f"agg{i:03d}"
+        state = None
+        cmds = ([counter.Increment(agg)] * (i % 7 + 1)
+                + [counter.Decrement(agg)] * (i % 3)
+                + [counter.CreateNoOpEvent(agg)] * (i % 2))
+        p.begin()
+        for cmd in cmds:
+            events = model.process_command(state, cmd)
+            for ev in events:
+                msg = fmt.write_event(ev)
+                p.send(LogRecord(topic="events", key=msg.key, value=msg.value, partition=0))
+                state = model.handle_event(state, ev)
+        p.commit()
+        expected[agg] = state
+    return expected
+
+
+def test_restore_from_events_cpu_and_tpu_byte_identical():
+    log = make_log()
+    expected = _seed_counter_events(log)
+    model = counter.CounterModel()
+    evt_fmt = counter.event_formatting()
+    state_fmt = counter.state_formatting()
+
+    def deserialize_event(data: bytes):
+        from surge_tpu.serialization import SerializedMessage
+
+        return evt_fmt.read_event(SerializedMessage(key="", value=data))
+
+    def serialize_state(agg_id: str, state) -> bytes:
+        return state_fmt.write_state(state).value
+
+    kwargs = dict(deserialize_event=deserialize_event, serialize_state=serialize_state,
+                  model=model, replay_spec=counter.make_replay_spec())
+    cpu_store, tpu_store = InMemoryKeyValueStore(), InMemoryKeyValueStore()
+    r_cpu = restore_from_events(
+        log, "events", cpu_store,
+        config=default_config().with_overrides({"surge.replay.backend": "cpu"}), **kwargs)
+    r_tpu = restore_from_events(
+        log, "events", tpu_store,
+        config=default_config().with_overrides({"surge.replay.backend": "tpu",
+                                                "surge.replay.batch-size": 16,
+                                                "surge.replay.time-chunk": 8}), **kwargs)
+
+    assert r_cpu.backend == "cpu" and r_tpu.backend == "tpu"
+    assert r_cpu.num_aggregates == r_tpu.num_aggregates == len(expected)
+    assert list(cpu_store.all_items()) == list(tpu_store.all_items())  # byte-identical
+    # and both match the live fold the seeding ran
+    for agg, state in expected.items():
+        assert cpu_store.get(agg) == state_fmt.write_state(state).value
+    assert r_cpu.watermarks == r_tpu.watermarks == {0: log.end_offset("events", 0)}
+
+
+def test_restore_from_state_topic_latest_snapshot_wins():
+    log = make_log()
+    p = log.transactional_producer("seed")
+    p.begin()
+    p.send(state_rec("a", b"old"))
+    p.send(state_rec("a", b"new"))
+    p.send(state_rec("b", b"bv"))
+    p.commit()
+    store = InMemoryKeyValueStore()
+    res = restore_from_state_topic(log, "state", store)
+    assert store.get("a") == b"new" and store.get("b") == b"bv"
+    assert res.watermarks == {0: 3}
+
+    # priming an indexer with restore watermarks means it does not re-apply history
+    async def scenario():
+        indexer = StateStoreIndexer(log, "state", store=store, config=CFG)
+        indexer.prime(res.watermarks)
+        await indexer.start()
+        await asyncio.sleep(0.02)
+        assert indexer.indexed_watermark("state", 0) == 3
+        await indexer.stop()
+
+    asyncio.run(scenario())
