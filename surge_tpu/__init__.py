@@ -18,9 +18,18 @@ for TPU hardware and the JAX/XLA compilation model:
 Reference parity pointers cite the Scala sources as ``file:line`` in docstrings.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from surge_tpu.config import Config, default_config
+from surge_tpu.dsl import (
+    CommandFailure,
+    CommandRejected,
+    CommandSuccess,
+    SurgeCommandBusinessLogic,
+    SurgeEngine,
+    SurgeEngineBuilder,
+    create_engine,
+)
 from surge_tpu.serialization import (
     SerializedMessage,
     SerializedAggregate,
@@ -30,7 +39,14 @@ from surge_tpu.serialization import (
 )
 
 __all__ = [
+    "CommandFailure",
+    "CommandRejected",
+    "CommandSuccess",
     "Config",
+    "SurgeCommandBusinessLogic",
+    "SurgeEngine",
+    "SurgeEngineBuilder",
+    "create_engine",
     "default_config",
     "SerializedMessage",
     "SerializedAggregate",

@@ -91,6 +91,8 @@ class BackgroundTask:
         task, self._task = self._task, None
         if task is not None and not task.done():
             task.cancel()
+            if task is asyncio.current_task():
+                return  # self-stop: the cancellation lands at our next await point
             try:
                 await task
             except (asyncio.CancelledError, Exception):  # noqa: BLE001 — stop is best-effort

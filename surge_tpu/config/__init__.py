@@ -53,6 +53,7 @@ DEFAULTS: dict[str, Any] = {
     "surge.serialization.thread-pool-size": 32,
     # --- replay engine (new: the TPU north star; BASELINE.json replayBackend=tpu) ---
     "surge.replay.backend": "tpu",  # tpu | cpu (scalar fold)
+    "surge.replay.restore-on-start": False,  # engine cold start folds the events topic
     "surge.replay.batch-size": 8192,  # aggregates per device step
     "surge.replay.time-chunk": 512,  # events scanned per lax.scan segment
     "surge.replay.length-buckets": "64,256,1024,4096",

@@ -1,0 +1,83 @@
+"""User-facing engine factories — the scaladsl/javadsl surface.
+
+Mirrors ``SurgeCommand`` (modules/command-engine/scaladsl/src/main/scala/surge/scaladsl/
+command/SurgeCommand.scala:24-70): ``create_engine(business_logic)`` builds a fully wired
+:class:`~surge_tpu.engine.pipeline.SurgeEngine`; and ``SurgeEngineBuilder`` mirrors the
+javadsl's ``SurgeCommandBuilder.withBusinessLogic(...).build()``
+(javadsl/command/SurgeCommandBuilder.scala:9-22) for callers preferring fluent wiring.
+
+The result ADTs (:class:`CommandSuccess` / :class:`CommandRejected` /
+:class:`CommandFailure`) are re-exported here — scaladsl/common/AggregateRefResult.scala:5-11.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from surge_tpu.config import Config
+from surge_tpu.engine.business_logic import SurgeCommandBusinessLogic
+from surge_tpu.engine.entity import CommandFailure, CommandRejected, CommandSuccess
+from surge_tpu.engine.partition import HostPort, PartitionTracker
+from surge_tpu.engine.pipeline import EngineNotRunningError, EngineStatus, SurgeEngine
+
+__all__ = [
+    "CommandFailure",
+    "CommandRejected",
+    "CommandSuccess",
+    "EngineNotRunningError",
+    "EngineStatus",
+    "SurgeCommandBusinessLogic",
+    "SurgeEngine",
+    "SurgeEngineBuilder",
+    "create_engine",
+]
+
+
+def create_engine(business_logic: SurgeCommandBusinessLogic, *, log=None,
+                  config: Optional[Config] = None,
+                  local_host: Optional[HostPort] = None,
+                  tracker: Optional[PartitionTracker] = None,
+                  remote_deliver=None, mesh=None) -> SurgeEngine:
+    """Build (not start) an engine — ``SurgeCommand(businessLogic)`` equivalent.
+
+    Single-node by default (in-memory log, self-assigned partitions); pass a shared
+    ``tracker``/``remote_deliver`` for multi-node routing (SURVEY.md §2.10)."""
+    return SurgeEngine(business_logic, log=log, config=config, local_host=local_host,
+                       tracker=tracker, remote_deliver=remote_deliver, mesh=mesh)
+
+
+class SurgeEngineBuilder:
+    """Fluent builder (javadsl SurgeCommandBuilder analog)."""
+
+    def __init__(self) -> None:
+        self._logic: Optional[SurgeCommandBusinessLogic] = None
+        self._kwargs: dict[str, Any] = {}
+
+    def with_business_logic(self, logic: SurgeCommandBusinessLogic) -> "SurgeEngineBuilder":
+        self._logic = logic
+        return self
+
+    def with_log(self, log) -> "SurgeEngineBuilder":
+        self._kwargs["log"] = log
+        return self
+
+    def with_config(self, config: Config) -> "SurgeEngineBuilder":
+        self._kwargs["config"] = config
+        return self
+
+    def with_local_host(self, host: HostPort) -> "SurgeEngineBuilder":
+        self._kwargs["local_host"] = host
+        return self
+
+    def with_tracker(self, tracker: PartitionTracker) -> "SurgeEngineBuilder":
+        self._kwargs["tracker"] = tracker
+        return self
+
+    def with_mesh(self, mesh) -> "SurgeEngineBuilder":
+        self._kwargs["mesh"] = mesh
+        return self
+
+    def build(self) -> SurgeEngine:
+        if self._logic is None:
+            raise ValueError("business logic is required (with_business_logic)")
+        return create_engine(self._logic, **self._kwargs)

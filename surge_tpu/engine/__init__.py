@@ -1,7 +1,18 @@
 """Command engine core — the L3/L4 equivalent of the reference's command-engine modules.
 
-- :mod:`surge_tpu.engine.model` — user-facing processing-model API
-  (scaladsl/command/CommandModels.scala:12-74 equivalents) plus the TPU replay spec.
+- :mod:`surge_tpu.engine.model` — processing-model API + TPU replay spec
+  (scaladsl/command/CommandModels.scala:12-74).
+- :mod:`surge_tpu.engine.business_logic` — the user bundle + serialization executor
+  (SurgeCommandBusinessLogicTrait, internal/SurgeModel.scala).
+- :mod:`surge_tpu.engine.entity` — per-aggregate single-writer FSM
+  (internal/persistence/PersistentActor.scala).
+- :mod:`surge_tpu.engine.publisher` — per-partition exactly-once publisher
+  (internal/kafka/KafkaProducerActorImpl.scala).
+- :mod:`surge_tpu.engine.shard` / :mod:`surge_tpu.engine.router` /
+  :mod:`surge_tpu.engine.partition` — entity parents, partition routing, assignments
+  (Shard.scala, KafkaPartitionShardRouterActor.scala, PartitionAssignments.scala).
+- :mod:`surge_tpu.engine.ref` — AggregateRef client surface.
+- :mod:`surge_tpu.engine.pipeline` — the wired engine (SurgeMessagePipeline.scala).
 """
 
 from surge_tpu.engine.model import (
@@ -12,12 +23,55 @@ from surge_tpu.engine.model import (
     ReplayHandlers,
     ReplaySpec,
 )
+from surge_tpu.engine.business_logic import SurgeCommandBusinessLogic, SurgeModel
+from surge_tpu.engine.entity import (
+    AggregateEntity,
+    ApplyEvents,
+    CommandFailure,
+    CommandRejected,
+    CommandSuccess,
+    Envelope,
+    GetState,
+    ProcessMessage,
+)
+from surge_tpu.engine.partition import (
+    HostPort,
+    PartitionAssignments,
+    PartitionTracker,
+    partition_for_key,
+)
+from surge_tpu.engine.pipeline import EngineNotRunningError, EngineStatus, SurgeEngine
+from surge_tpu.engine.publisher import PartitionPublisher
+from surge_tpu.engine.ref import AggregateRef
+from surge_tpu.engine.router import SurgePartitionRouter
+from surge_tpu.engine.shard import Shard
 
 __all__ = [
     "AggregateCommandModel",
-    "AsyncAggregateCommandModel",
+    "AggregateEntity",
     "AggregateEventModel",
+    "AggregateRef",
+    "ApplyEvents",
+    "AsyncAggregateCommandModel",
+    "CommandFailure",
+    "CommandRejected",
+    "CommandSuccess",
+    "EngineNotRunningError",
+    "EngineStatus",
+    "Envelope",
+    "GetState",
+    "HostPort",
+    "PartitionAssignments",
+    "PartitionPublisher",
+    "PartitionTracker",
+    "ProcessMessage",
     "RejectedCommand",
     "ReplayHandlers",
     "ReplaySpec",
+    "Shard",
+    "SurgeCommandBusinessLogic",
+    "SurgeEngine",
+    "SurgeModel",
+    "SurgePartitionRouter",
+    "partition_for_key",
 ]

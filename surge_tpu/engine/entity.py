@@ -1,0 +1,286 @@
+"""Per-aggregate single-writer entity — the PersistentActor equivalent.
+
+Re-derivation of the reference FSM (modules/command-engine/core/src/main/scala/surge/
+internal/persistence/PersistentActor.scala:100-365) as one asyncio task per live
+aggregate id, with the mailbox doubling as the stash:
+
+- ``initializing``: the KTable init protocol (KTableInitializationSupport.scala:20-82) —
+  poll ``is_aggregate_state_current`` on the partition publisher with bounded retries,
+  then fetch + deserialize the snapshot from the state store; messages arriving
+  meanwhile simply wait in the mailbox (the uninitialized-stash of
+  PersistentActor.scala:174-195).
+- ``free_to_process``: pop one envelope at a time (single-writer guarantee); commands run
+  the user model, fold events, serialize off-thread, and
+- ``persisting``: publish events + state through the partition publisher with the
+  bounded retry ladder of KTablePersistenceSupport.scala:71-156 — same request id on
+  every attempt (publisher dedup makes retries idempotent), timeout per attempt, and a
+  **crash** after max retries (the parent recreates the entity, which re-reads state
+  from the store — PersistentActor.onPersistenceFailure:357-364).
+- idle passivation after ``surge.aggregate.idle-passivation-ms`` (:287-296), negotiated
+  with the parent shard so late messages are buffered, never lost.
+
+Error surface mirrors ACKSuccess/ACKError/ACKRejection (:33-64): domain rejections
+(``RejectedCommand``) → :class:`CommandRejected`; model/fold/serialization exceptions →
+:class:`CommandFailure` with the entity staying alive; persistence exhaustion →
+:class:`CommandFailure` AND entity crash.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence
+
+from surge_tpu.common import fail_future, logger, resolve_future
+from surge_tpu.config import Config, RetryConfig, TimeoutConfig, default_config
+from surge_tpu.engine.business_logic import SurgeModel
+from surge_tpu.engine.model import RejectedCommand
+from surge_tpu.engine.publisher import PartitionPublisher
+
+
+# -- message + result ADTs (PersistentActor.scala:33-64, AggregateRefResult.scala:5-11) --
+
+
+@dataclass
+class ProcessMessage:
+    command: Any
+
+
+@dataclass
+class GetState:
+    pass
+
+
+@dataclass
+class ApplyEvents:
+    events: Sequence[Any]
+
+
+@dataclass
+class Envelope:
+    message: Any
+    reply: "asyncio.Future[Any]"
+    headers: dict = field(default_factory=dict)  # trace context rides here
+
+
+@dataclass
+class CommandSuccess:
+    state: Any  # the post-command aggregate state (None = deleted/empty)
+
+
+@dataclass
+class CommandRejected:
+    reason: Exception
+
+
+@dataclass
+class CommandFailure:
+    error: Exception
+
+
+class EntityCrashed(Exception):
+    """The entity died mid-processing (persistence exhaustion or init failure)."""
+
+
+class AggregateEntity:
+    """One live aggregate: mailbox task + FSM state."""
+
+    def __init__(self, aggregate_id: str, surge_model: SurgeModel,
+                 publisher: PartitionPublisher,
+                 fetch_state: Callable[[str], Optional[bytes]],
+                 partition: int = 0, config: Config | None = None,
+                 on_passivate: Callable[[str], None] | None = None,
+                 on_stopped: Callable[[str, List[Envelope], bool], None] | None = None) -> None:
+        self.aggregate_id = aggregate_id
+        self.surge_model = surge_model
+        self.model = surge_model.logic.model
+        self.publisher = publisher
+        self.fetch_state = fetch_state
+        self.partition = partition
+        self.config = config or default_config()
+        self.on_passivate = on_passivate or (lambda agg_id: None)
+        self.on_stopped = on_stopped or (lambda agg_id, leftovers, crashed: None)
+        self.retry = RetryConfig.from_config(self.config)
+        self.timeouts = TimeoutConfig.from_config(self.config)
+        self._idle_s = self.config.get_seconds("surge.aggregate.idle-passivation-ms", 30_000)
+        self.state_name = "created"
+        self.state: Any = None
+        self._mailbox: "asyncio.Queue[Envelope]" = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+
+    # -- public surface -----------------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._run())
+        self._task.set_name(f"entity-{self.aggregate_id}")
+
+    def deliver(self, env: Envelope) -> None:
+        if self.state_name == "stopped":
+            raise EntityCrashed(f"entity {self.aggregate_id} is stopped")
+        self._mailbox.put_nowait(env)
+
+    async def stop(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self.state_name = "stopped"
+
+    # -- FSM ---------------------------------------------------------------------------
+
+    async def _run(self) -> None:
+        crashed = False
+        try:
+            await self._initialize()
+            self.state_name = "free_to_process"
+            while True:
+                try:
+                    env = await asyncio.wait_for(self._mailbox.get(), timeout=self._idle_s)
+                except asyncio.TimeoutError:
+                    self.on_passivate(self.aggregate_id)  # parent starts buffering now
+                    break
+                try:
+                    await self._handle(env)
+                except _PersistenceExhausted:
+                    crashed = True
+                    break
+            # drain any envelopes that arrived before passivation/crash was signalled
+            while not self._mailbox.empty() and not crashed:
+                try:
+                    await self._handle(self._mailbox.get_nowait())
+                except _PersistenceExhausted:
+                    crashed = True
+        except _InitFailed:
+            crashed = True
+        except asyncio.CancelledError:
+            # externally stopped (shard/engine shutdown): fail queued callers promptly
+            # rather than letting their asks ride out the full timeout
+            self.state_name = "stopped"
+            while not self._mailbox.empty():
+                env = self._mailbox.get_nowait()
+                fail_future(env.reply, EntityCrashed(
+                    f"entity {self.aggregate_id} stopped"))
+            raise
+        finally:
+            if self.state_name != "stopped":
+                self.state_name = "stopped"
+                leftovers = []
+                while not self._mailbox.empty():
+                    leftovers.append(self._mailbox.get_nowait())
+                self.on_stopped(self.aggregate_id, leftovers, crashed)
+
+    async def _initialize(self) -> None:
+        """KTable init protocol: gate on publish lag, then fetch + deserialize."""
+        self.state_name = "initializing"
+        for attempt in range(self.retry.init_max_attempts):
+            if not self.publisher.is_aggregate_state_current(self.aggregate_id):
+                await asyncio.sleep(self.retry.init_retry_interval_s)
+                continue
+            try:
+                data = self.fetch_state(self.aggregate_id)
+                self.state = (self.surge_model.deserialize_state(data)
+                              if data is not None else self._initial_state())
+                return
+            except Exception:  # noqa: BLE001 — fetch/deserialize failure retries
+                logger.exception("state fetch failed for %s (attempt %d)",
+                                 self.aggregate_id, attempt + 1)
+                await asyncio.sleep(self.retry.init_fetch_retry_s)
+        logger.error("init exhausted for aggregate %s", self.aggregate_id)
+        raise _InitFailed()
+
+    def _initial_state(self) -> Any:
+        fn = getattr(self.model, "initial_state", None)
+        return fn(self.aggregate_id) if fn is not None else None
+
+    async def _handle(self, env: Envelope) -> None:
+        msg = env.message
+        if isinstance(msg, GetState):
+            resolve_future(env.reply, self.state)
+            return
+        if isinstance(msg, ProcessMessage):
+            await self._process_command(env, msg.command)
+            return
+        if isinstance(msg, ApplyEvents):
+            await self._apply_events(env, msg.events)
+            return
+        fail_future(env.reply, TypeError(f"unknown message {type(msg).__name__}"))
+
+    async def _process_command(self, env: Envelope, command: Any) -> None:
+        # 1. user command handler (may reject)
+        try:
+            events = list(self.model.process_command(self.state, command))
+        except RejectedCommand as rej:
+            resolve_future(env.reply, CommandRejected(rej))
+            return
+        except Exception as exc:  # noqa: BLE001 — user code failure → error ACK
+            resolve_future(env.reply, CommandFailure(exc))
+            return
+        # 2. fold + persist + reply
+        await self._fold_and_persist(env, events, reply_state=True)
+
+    async def _apply_events(self, env: Envelope, events: Sequence[Any]) -> None:
+        """applyEvents path (PersistentActor.doApplyEvent:245-264): fold externally
+        produced events, publish the state snapshot only."""
+        await self._fold_and_persist(env, list(events), reply_state=True,
+                                     state_only=True)
+
+    async def _fold_and_persist(self, env: Envelope, events: List[Any],
+                                reply_state: bool, state_only: bool = False) -> None:
+        old_state = self.state
+        try:
+            new_state = old_state
+            for ev in events:
+                new_state = self.model.handle_event(new_state, ev)
+        except Exception as exc:  # noqa: BLE001 — fold failure → error ACK, no persist
+            resolve_future(env.reply, CommandFailure(exc))
+            return
+
+        if not events and not state_only:
+            # no-op command: nothing to persist (reference skips publish when there are
+            # no events and state is unchanged)
+            resolve_future(env.reply, CommandSuccess(new_state))
+            return
+
+        self.state_name = "persisting"
+        try:
+            try:
+                records = await self.surge_model.serialize_outputs(
+                    self.aggregate_id, self.partition, new_state,
+                    [] if state_only else events)
+            except Exception as exc:  # noqa: BLE001 — serialization failure → error ACK
+                resolve_future(env.reply, CommandFailure(exc))
+                return
+
+            request_id = uuid.uuid4().hex
+            last_error: Optional[Exception] = None
+            for _ in range(self.retry.publish_max_retries + 1):
+                try:
+                    await asyncio.wait_for(
+                        self.publisher.publish(self.aggregate_id, records, request_id),
+                        timeout=self.timeouts.publish_timeout_s)
+                    self.state = new_state
+                    resolve_future(env.reply, CommandSuccess(new_state))
+                    return
+                except asyncio.TimeoutError as exc:
+                    last_error = exc
+                except Exception as exc:  # noqa: BLE001 — publish failure → retry
+                    last_error = exc
+            # retries exhausted: error the sender, then crash so the next message gets
+            # a fresh entity re-initialized from the store
+            resolve_future(env.reply, CommandFailure(
+                last_error or RuntimeError("publish failed")))
+            raise _PersistenceExhausted()
+        finally:
+            if self.state_name == "persisting":
+                self.state_name = "free_to_process"
+
+
+class _InitFailed(Exception):
+    pass
+
+
+class _PersistenceExhausted(Exception):
+    pass

@@ -1,0 +1,201 @@
+"""SurgeEngine — the wired engine object (SurgeMessagePipeline equivalent).
+
+Reference: modules/command-engine/core/src/main/scala/surge/internal/domain/
+SurgeMessagePipeline.scala:33-240 — constructs and owns the partition tracker, the
+state-store indexer (KTable), the per-partition regions (publisher + shard), and the
+router; implements ``Controllable`` start/stop/restart with an engine-status atomic
+(SurgeEngineStatus.scala) and exposes ``aggregate_for`` (scaladsl/command/
+SurgeCommand.scala:24-70).
+
+Startup order follows :3.1's call stack: state-store indexer first, then router; in
+single-node mode (no external control plane) the engine self-assigns every partition,
+the PartitionTracker broadcast creates all local regions, and each region's publisher
+runs its init-transactions + lag-gate protocol before serving. The optional
+events-topic bulk restore (``surge.replay.restore-on-start``) runs the TPU replay
+engine BEFORE indexing starts and fast-forwards the store watermarks — the
+``replayBackend = tpu`` north star wired into the engine's cold start."""
+
+from __future__ import annotations
+
+import asyncio
+from enum import Enum
+from typing import Callable, Dict, List, Optional
+
+from surge_tpu.common import Ack, Controllable, logger
+from surge_tpu.config import Config, default_config
+from surge_tpu.engine.business_logic import SurgeCommandBusinessLogic, SurgeModel
+from surge_tpu.engine.entity import AggregateEntity, Envelope
+from surge_tpu.engine.partition import HostPort, PartitionTracker
+from surge_tpu.engine.publisher import PartitionPublisher
+from surge_tpu.engine.ref import AggregateRef
+from surge_tpu.engine.router import SurgePartitionRouter
+from surge_tpu.engine.shard import Shard
+from surge_tpu.log import InMemoryLog, TopicSpec
+from surge_tpu.store import StateStoreIndexer, restore_from_events
+
+
+class EngineStatus(Enum):
+    """SurgeEngineStatus.scala equivalents."""
+
+    STOPPED = "stopped"
+    STARTING = "starting"
+    RUNNING = "running"
+    STOPPING = "stopping"
+    FAILED = "failed"
+
+
+class _Region:
+    """One partition's publisher + shard (PersistentActorRegion.scala:26-116)."""
+
+    def __init__(self, partition: int, publisher: PartitionPublisher, shard: Shard) -> None:
+        self.partition = partition
+        self.publisher = publisher
+        self.shard = shard
+        self._publisher_start = asyncio.ensure_future(publisher.start())
+        self._publisher_start.add_done_callback(self._on_publisher_started)
+
+    def _on_publisher_started(self, task: asyncio.Task) -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            logger.error("publisher init failed for partition %d: %r",
+                         self.partition, exc)
+
+    def deliver(self, aggregate_id: str, env: Envelope) -> None:
+        self.shard.deliver(aggregate_id, env)
+
+    async def stop(self) -> None:
+        await self.shard.stop()
+        if not self._publisher_start.done():
+            self._publisher_start.cancel()
+        await self.publisher.stop()
+
+
+class SurgeEngine(Controllable):
+    """A running engine for one aggregate family."""
+
+    def __init__(self, logic: SurgeCommandBusinessLogic, log=None,
+                 config: Config | None = None,
+                 local_host: HostPort | None = None,
+                 tracker: PartitionTracker | None = None,
+                 remote_deliver=None, mesh=None) -> None:
+        self.logic = logic
+        self.config = config or default_config()
+        self.log = log if log is not None else InMemoryLog()
+        self.local_host = local_host or HostPort("localhost", 0)
+        self.mesh = mesh
+        self.status = EngineStatus.STOPPED
+        self.num_partitions = self.config.get_int("surge.engine.num-partitions", 8)
+        self._external_tracker = tracker is not None
+        self.tracker = tracker or PartitionTracker()
+
+        self.log.create_topic(TopicSpec(logic.state_topic, self.num_partitions, compacted=True))
+        if logic.events_topic:
+            self.log.create_topic(TopicSpec(logic.events_topic, self.num_partitions))
+        self.surge_model = SurgeModel(logic, self.config)
+        self.indexer = StateStoreIndexer(self.log, logic.state_topic, config=self.config)
+        self.router = SurgePartitionRouter(
+            num_partitions=self.num_partitions, tracker=self.tracker,
+            local_host=self.local_host, region_creator=self._create_region,
+            remote_deliver=remote_deliver,
+            dr_standby=self.config.get_bool("surge.engine.dr-standby-enabled"))
+        self._rebalance_listeners: List[Callable] = []
+
+    # -- lifecycle (SurgeMessagePipeline.scala:185-240) ----------------------------------
+
+    async def start(self) -> Ack:
+        self.status = EngineStatus.STARTING
+        try:
+            if self.config.get_bool("surge.replay.restore-on-start"):
+                await self.rebuild_from_events()
+            await self.indexer.start()
+            await self.router.start()
+            if not self._external_tracker and not self.tracker.assignments.assignments:
+                # single-node mode: self-assign every partition (no external control
+                # plane; multi-node engines share an externally-updated tracker)
+                self.tracker.update({self.local_host: list(range(self.num_partitions))})
+            self.status = EngineStatus.RUNNING
+            return Ack()
+        except Exception:
+            self.status = EngineStatus.FAILED
+            raise
+
+    async def stop(self) -> Ack:
+        self.status = EngineStatus.STOPPING
+        await self.router.stop()  # stops regions (shards + publishers)
+        await self.indexer.stop()
+        self.surge_model.close()
+        self.status = EngineStatus.STOPPED
+        return Ack()
+
+    async def shutdown(self) -> Ack:
+        return await self.stop()
+
+    # -- client surface ------------------------------------------------------------------
+
+    def aggregate_for(self, aggregate_id: str) -> AggregateRef:
+        """scaladsl SurgeCommand.aggregateFor (SurgeCommand.scala:52-54)."""
+        return AggregateRef(aggregate_id, self._deliver_checked, self.config)
+
+    def _deliver_checked(self, aggregate_id: str, env: Envelope) -> None:
+        if self.status != EngineStatus.RUNNING:
+            raise EngineNotRunningError(
+                f"engine status is {self.status.value} (SurgeEngineNotRunningException)")
+        self.router.deliver(aggregate_id, env)
+
+    def register_rebalance_listener(self, listener: Callable) -> None:
+        """listener(assignments, changes) on every tracker update
+        (registerRebalanceListener, SurgeMessagePipeline.scala:93-95)."""
+        self.tracker.register(listener)
+
+    # -- regions -------------------------------------------------------------------------
+
+    def _create_region(self, partition: int) -> _Region:
+        publisher = PartitionPublisher(
+            self.log, self.logic.state_topic, self.logic.events_topic or None,
+            partition, self.indexer, config=self.config,
+            transactional_id_prefix=self.logic.transactional_id_prefix,
+            still_owner=lambda p=partition: (
+                self.tracker.assignments.partition_to_host().get(p) == self.local_host))
+        shard = Shard(
+            f"{self.logic.aggregate_name}-{partition}",
+            lambda aggregate_id, on_passivate, on_stopped: AggregateEntity(
+                aggregate_id, self.surge_model, publisher,
+                fetch_state=self.indexer.get_aggregate_bytes, partition=partition,
+                config=self.config, on_passivate=on_passivate, on_stopped=on_stopped),
+            buffer_limit=self.config.get_int("surge.aggregate.passivation-buffer-limit", 1000))
+        return _Region(partition, publisher, shard)
+
+    # -- TPU bulk restore ---------------------------------------------------------------
+
+    async def rebuild_from_events(self):
+        """Rebuild the materialized store by folding the events topic through the
+        configured replay backend (tpu: batched ReplayEngine; cpu: scalar fold), then
+        fast-forward the indexer watermarks past the snapshots the events already
+        cover. Disaster-recovery / cold-cache warmup path (BASELINE.md north star)."""
+        if not self.logic.events_topic:
+            raise ValueError("rebuild_from_events requires an events topic")
+        evt_fmt = self.logic.event_format
+        state_fmt = self.logic.state_format
+        from surge_tpu.serialization import SerializedMessage
+
+        spec = self.logic.replay_spec()
+        result = await asyncio.get_running_loop().run_in_executor(None, lambda: restore_from_events(
+            self.log, self.logic.events_topic, self.indexer.store,
+            deserialize_event=lambda b: evt_fmt.read_event(SerializedMessage(key="", value=b)),
+            serialize_state=lambda agg_id, st: state_fmt.write_state(st).value,
+            model=self.logic.model, replay_spec=spec,
+            encode_event=getattr(self.logic, "encode_event", None),
+            decode_state=getattr(self.logic, "decode_state", None),
+            config=self.config, mesh=self.mesh))
+        # snapshots already on the state topic are superseded by the replayed states
+        self.indexer.prime({p: self.log.end_offset(self.logic.state_topic, p)
+                            for p in range(self.num_partitions)})
+        logger.info("rebuild_from_events: %d aggregates from %d events via %s",
+                    result.num_aggregates, result.num_events, result.backend)
+        return result
+
+
+class EngineNotRunningError(Exception):
+    """SurgeEngineNotRunningException analog (scaladsl/common)."""

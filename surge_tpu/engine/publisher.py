@@ -112,7 +112,17 @@ class PartitionPublisher:
 
     async def start(self) -> None:
         self.state = "initializing"
-        await self._initialize()
+        try:
+            await self._initialize()
+        except Exception as exc:
+            # surface init failure to queued publishers instead of letting them ride
+            # the timeout ladder with no root cause
+            self.state = "failed"
+            self.on_signal("surge.producer.init-failed", "error")
+            for p in self._pending:
+                fail_future(p.future, PublisherNotReadyError(f"init failed: {exc}"))
+            self._pending.clear()
+            raise
         self._flush_task.start()
         self._progress_task.start()
 
@@ -162,9 +172,19 @@ class PartitionPublisher:
             self.stats.dedup_hits += 1
             return
         fut: "asyncio.Future[None]" = asyncio.get_running_loop().create_future()
-        self._pending.append(_Pending(request_id, aggregate_id,
-                                      list(records), fut))
-        await fut
+        pending = _Pending(request_id, aggregate_id, list(records), fut)
+        self._pending.append(pending)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # caller timed out: withdraw the queued write so a same-request_id retry
+            # does not double-queue it. If the flush already drained it, the commit may
+            # still land — then the retry is absorbed by the _completed dedup.
+            try:
+                self._pending.remove(pending)
+            except ValueError:
+                pass
+            raise
 
     def is_aggregate_state_current(self, aggregate_id: str) -> bool:
         """True iff nothing published for this aggregate is still ahead of the store's
@@ -265,7 +285,10 @@ class PartitionPublisher:
             await self._initialize()
         else:
             self.on_signal("surge.producer.shutdown-not-owner", "warning")
-            await self.stop()
+            # runs inside the flush loop: mark stopped now, cancel the loops from a
+            # separate task (a task cannot await its own cancellation)
+            self.state = "stopped"
+            asyncio.ensure_future(self.stop())
 
     def _purge_dedup(self) -> None:
         if not self._completed:

@@ -1,0 +1,72 @@
+"""AggregateRef — the client proxy for one aggregate id.
+
+Reference: internal/persistence/AggregateRefTrait.scala:31-102 + the scaladsl surface
+(scaladsl/command/AggregateRef.scala:15-60): ``send_command`` / ``get_state`` /
+``apply_events`` as ask-style calls with timeout mapping into the result ADTs
+(CommandSuccess / CommandRejected / CommandFailure)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Optional, Sequence
+
+from surge_tpu.config import Config, TimeoutConfig, default_config
+from surge_tpu.engine.entity import (
+    ApplyEvents,
+    CommandFailure,
+    CommandRejected,
+    CommandSuccess,
+    Envelope,
+    GetState,
+    ProcessMessage,
+)
+
+# deliver(aggregate_id, envelope) — a Shard, or the partition router in front of many
+DeliverFn = Callable[[str, Envelope], None]
+
+
+class AggregateRef:
+    """Typed handle on one aggregate (AggregateRefTrait.scala:31-102)."""
+
+    def __init__(self, aggregate_id: str, deliver: DeliverFn,
+                 config: Config | None = None,
+                 headers_factory: Callable[[], dict] | None = None) -> None:
+        self.aggregate_id = aggregate_id
+        self._deliver = deliver
+        self._timeouts = TimeoutConfig.from_config(config or default_config())
+        self._headers_factory = headers_factory or dict
+
+    async def _ask(self, message: Any) -> Any:
+        fut: "asyncio.Future[Any]" = asyncio.get_running_loop().create_future()
+        env = Envelope(message=message, reply=fut, headers=self._headers_factory())
+        try:
+            self._deliver(self.aggregate_id, env)
+        except Exception as exc:  # noqa: BLE001 — routing failures surface as failures
+            return CommandFailure(exc)
+        try:
+            return await asyncio.wait_for(fut, timeout=self._timeouts.ask_timeout_s)
+        except asyncio.TimeoutError as exc:
+            return CommandFailure(exc)
+
+    async def send_command(self, command: Any):
+        """→ CommandSuccess(new_state) | CommandRejected(reason) | CommandFailure(err)
+        (AggregateRefTrait.sendCommand:76-93)."""
+        result = await self._ask(ProcessMessage(command))
+        if isinstance(result, (CommandSuccess, CommandRejected, CommandFailure)):
+            return result
+        return CommandFailure(TypeError(f"unexpected reply {result!r}"))
+
+    async def get_state(self) -> Optional[Any]:
+        """Current state, or None (queryState:62-64). Raises on ask failure."""
+        result = await self._ask(GetState())
+        if isinstance(result, CommandFailure):
+            raise result.error
+        return result
+
+    async def apply_events(self, events: Sequence[Any]):
+        """Fold externally-produced events; → CommandSuccess | CommandFailure
+        (applyEvents:95-101)."""
+        result = await self._ask(ApplyEvents(list(events)))
+        if isinstance(result, (CommandSuccess, CommandFailure)):
+            return result
+        return CommandFailure(TypeError(f"unexpected reply {result!r}"))

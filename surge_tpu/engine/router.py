@@ -1,0 +1,153 @@
+"""Partition router: aggregate id → partition → local shard or remote node.
+
+Reference: KafkaPartitionShardRouterActor (modules/common/src/main/scala/surge/kafka/
+KafkaPartitionShardRouterActor.scala:25-372) — routes by the producer's partitioner
+(deliverMessage:205-222), follows :class:`PartitionTracker` updates (rebalance region
+lifecycle, updatePartitionAssignments:114-142), creates local regions on demand
+(newActorRegionForPartition:248-283), and supports DR-standby (defer region creation
+until first delivery, :174-185,309-316). Remote partitions forward through a pluggable
+``remote_deliver`` (the Akka-remoting ActorSelection analog — the control-plane
+transport supplies it; SURVEY.md §5.8)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from surge_tpu.common import Ack, Controllable, fail_future, logger
+from surge_tpu.engine.entity import Envelope
+from surge_tpu.engine.partition import (
+    AssignmentChanges,
+    HostPort,
+    PartitionAssignments,
+    PartitionTracker,
+    partition_by_up_to_colon,
+    partition_for_key,
+)
+
+# region_creator(partition) -> a Shard-like object (deliver(agg_id, env) + async stop())
+RegionCreator = Callable[[int], object]
+# remote_deliver(host, partition, aggregate_id, envelope) — cross-node forwarding
+RemoteDeliver = Callable[[HostPort, int, str, Envelope], None]
+
+
+class NoRouteError(Exception):
+    """No assignment known for the key's partition and no buffering headroom."""
+
+
+class SurgePartitionRouter(Controllable):
+    """Routes envelopes for one aggregate family across partitions/hosts."""
+
+    def __init__(self, num_partitions: int, tracker: PartitionTracker,
+                 local_host: HostPort, region_creator: RegionCreator,
+                 partition_by: Callable[[str], str] = partition_by_up_to_colon,
+                 remote_deliver: Optional[RemoteDeliver] = None,
+                 dr_standby: bool = False, pending_limit: int = 1000) -> None:
+        self.num_partitions = num_partitions
+        self.tracker = tracker
+        self.local_host = local_host
+        self.region_creator = region_creator
+        self.partition_by = partition_by
+        self.remote_deliver = remote_deliver
+        self.dr_standby = dr_standby
+        self.pending_limit = pending_limit
+        self._regions: Dict[int, object] = {}
+        self._pending: Dict[int, List[Tuple[str, Envelope]]] = {}
+        self._started = False
+
+    # -- lifecycle ----------------------------------------------------------------------
+
+    async def start(self) -> Ack:
+        self._started = True
+        self.tracker.register(self._on_assignments)
+        return Ack()
+
+    async def stop(self) -> Ack:
+        self._started = False
+        self.tracker.unregister(self._on_assignments)
+        for region in list(self._regions.values()):
+            await region.stop()
+        self._regions.clear()
+        for buf in self._pending.values():
+            for _, env in buf:
+                fail_future(env.reply, NoRouteError("router stopped"))
+        self._pending.clear()
+        return Ack()
+
+    # -- routing ------------------------------------------------------------------------
+
+    def partition_for(self, aggregate_id: str) -> int:
+        return partition_for_key(self.partition_by(aggregate_id), self.num_partitions)
+
+    def deliver(self, aggregate_id: str, env: Envelope) -> None:
+        """deliverMessage:205-222 — resolve owner, local-or-remote dispatch."""
+        partition = self.partition_for(aggregate_id)
+        owner = self.tracker.assignments.partition_to_host().get(partition)
+        if owner is None:
+            buf = self._pending.setdefault(partition, [])
+            if len(buf) >= self.pending_limit:
+                fail_future(env.reply, NoRouteError(
+                    f"no assignment for partition {partition} and buffer full"))
+                return
+            buf.append((aggregate_id, env))
+            return
+        self._dispatch(owner, partition, aggregate_id, env)
+
+    def _dispatch(self, owner: HostPort, partition: int, aggregate_id: str,
+                  env: Envelope) -> None:
+        if owner == self.local_host:
+            region = self._regions.get(partition)
+            if region is None:
+                # DR-standby defers creation to first message (:174-185); normal mode
+                # lazily materializes too if an assignment listener raced a delivery
+                region = self._create_region(partition)
+            region.deliver(aggregate_id, env)
+        elif self.remote_deliver is not None:
+            self.remote_deliver(owner, partition, aggregate_id, env)
+        else:
+            fail_future(env.reply, NoRouteError(
+                f"partition {partition} owned by {owner} and no remote transport"))
+
+    def _create_region(self, partition: int):
+        region = self.region_creator(partition)
+        self._regions[partition] = region
+        return region
+
+    @property
+    def local_partitions(self) -> List[int]:
+        return sorted(self._regions)
+
+    # -- rebalance ----------------------------------------------------------------------
+
+    def _on_assignments(self, assignments: PartitionAssignments,
+                        changes: AssignmentChanges) -> None:
+        import asyncio
+
+        if not self._started:
+            return
+        # stop revoked local regions (PoisonPill analog, :298-307)
+        for p in changes.revoked.get(self.local_host, []):
+            region = self._regions.pop(p, None)
+            if region is not None:
+                logger.info("router: stopping revoked region %d", p)
+                asyncio.ensure_future(region.stop())
+        # eagerly create added local regions unless DR-standby (:144-156)
+        if not self.dr_standby:
+            for p in changes.added.get(self.local_host, []):
+                if p not in self._regions:
+                    self._create_region(p)
+        # drain buffered deliveries now that owners are known
+        owner_of = assignments.partition_to_host()
+        for p in [p for p in self._pending if p in owner_of]:
+            for aggregate_id, env in self._pending.pop(p):
+                self._dispatch(owner_of[p], p, aggregate_id, env)
+
+    # -- health -------------------------------------------------------------------------
+
+    def health(self) -> dict:
+        """Router health snapshot (getHealthCheck:353-366 analog)."""
+        return {
+            "name": "router",
+            "status": "up" if self._started else "down",
+            "local_partitions": self.local_partitions,
+            "pending": {p: len(b) for p, b in self._pending.items()},
+        }

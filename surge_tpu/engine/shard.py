@@ -1,0 +1,91 @@
+"""Entity parent for one partition — the Shard equivalent.
+
+Reference: modules/common/src/main/scala/surge/internal/akka/cluster/Shard.scala:34-200 —
+creates a child entity per aggregate id on demand (getOrCreateEntity:101-113), buffers
+messages (bounded) while a child passivates (receivePassivate:165-180, buffer:115-123),
+and restarts a child that stopped with messages waiting (entityTerminated:134-147).
+Crashed children are recreated on the next delivery with their unprocessed mail
+redelivered — the fresh entity re-initializes from the state store.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from surge_tpu.common import fail_future, logger
+from surge_tpu.engine.entity import AggregateEntity, Envelope
+
+# factory(aggregate_id, on_passivate, on_stopped) -> started-or-startable entity
+EntityFactory = Callable[..., AggregateEntity]
+
+
+class BufferFullError(Exception):
+    """Passivation buffer overflow (Shard.scala:115-123 drops with a warning)."""
+
+
+class Shard:
+    """Owns the live entities of one partition."""
+
+    def __init__(self, name: str, entity_factory: EntityFactory,
+                 buffer_limit: int = 1000) -> None:
+        self.name = name
+        self.entity_factory = entity_factory
+        self.buffer_limit = buffer_limit
+        self._entities: Dict[str, AggregateEntity] = {}
+        self._passivating: Dict[str, List[Envelope]] = {}
+
+    # -- delivery -----------------------------------------------------------------------
+
+    def deliver(self, aggregate_id: str, env: Envelope) -> None:
+        if aggregate_id in self._passivating:
+            buf = self._passivating[aggregate_id]
+            if len(buf) >= self.buffer_limit:
+                fail_future(env.reply, BufferFullError(
+                    f"{self.name}: passivation buffer full for {aggregate_id}"))
+                return
+            buf.append(env)
+            return
+        self._get_or_create(aggregate_id).deliver(env)
+
+    def _get_or_create(self, aggregate_id: str) -> AggregateEntity:
+        entity = self._entities.get(aggregate_id)
+        if entity is None or entity.state_name == "stopped":
+            entity = self.entity_factory(
+                aggregate_id, on_passivate=self._on_passivate,
+                on_stopped=self._on_stopped)
+            self._entities[aggregate_id] = entity
+            entity.start()
+        return entity
+
+    @property
+    def num_live_entities(self) -> int:
+        return len(self._entities)
+
+    def live_entity(self, aggregate_id: str) -> AggregateEntity | None:
+        return self._entities.get(aggregate_id)
+
+    # -- passivation protocol (entity callbacks, same event loop) ------------------------
+
+    def _on_passivate(self, aggregate_id: str) -> None:
+        self._passivating.setdefault(aggregate_id, [])
+
+    def _on_stopped(self, aggregate_id: str, leftovers: List[Envelope],
+                    crashed: bool) -> None:
+        self._entities.pop(aggregate_id, None)
+        pending = self._passivating.pop(aggregate_id, []) + list(leftovers)
+        if crashed:
+            logger.warning("%s: entity %s crashed; %d message(s) to redeliver",
+                           self.name, aggregate_id, len(pending))
+        for env in pending:  # restart-on-buffered (Shard.scala:134-147)
+            self.deliver(aggregate_id, env)
+
+    # -- lifecycle ----------------------------------------------------------------------
+
+    async def stop(self) -> None:
+        for entity in list(self._entities.values()):
+            await entity.stop()
+        self._entities.clear()
+        for buf in self._passivating.values():
+            for env in buf:
+                fail_future(env.reply, RuntimeError(f"shard {self.name} stopped"))
+        self._passivating.clear()

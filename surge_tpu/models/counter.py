@@ -193,6 +193,11 @@ _EVENT_TYPES = {c.__name__: c for c in (CountIncremented, CountDecremented, NoOp
 
 
 def _event_to_dict(e) -> dict:
+    if isinstance(e, UnserializableEvent):
+        # parity: the reference's play-json format for this event throws — that is the
+        # point of the CreateUnserializableEvent poison command (TestBoundedContext
+        # serialization-failure path). The tensor path still folds it.
+        raise ValueError(f"deliberately unserializable event: {e.error_msg}")
     d = dict(e.__dict__) if not hasattr(e, "__dataclass_fields__") else {
         k: getattr(e, k) for k in e.__dataclass_fields__}
     d["_type"] = type(e).__name__

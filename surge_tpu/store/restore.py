@@ -107,7 +107,9 @@ def restore_from_events(
         if state is None:
             continue
         state = _with_aggregate_id(state, agg_id)
-        if decode_state is not None:
+        if decode_state is not None and backend == "tpu":
+            # decode_state maps tensor-schema records back to domain states (e.g.
+            # Vocab-decoded strings); cpu-path states are already domain objects
             state = decode_state(agg_id, state)
         store.put(agg_id, serialize_state(agg_id, state))
     return RestoreResult(num_aggregates=len(agg_ids), num_events=num_events,

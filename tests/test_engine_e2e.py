@@ -1,0 +1,153 @@
+"""End-to-end engine: create_engine → start → send_command/get_state → stop.
+
+The SurgeMessagePipelineSpec / docs BankAccountCommandEngineSpec analog (SURVEY.md §4):
+full wiring (tracker → router → regions → publisher → indexer) over the in-memory log,
+multi-partition routing, engine restart resuming state from the log, and the TPU
+events-topic rebuild wired into engine cold start."""
+
+import asyncio
+
+import pytest
+
+from surge_tpu import (
+    CommandRejected,
+    CommandSuccess,
+    SurgeCommandBusinessLogic,
+    SurgeEngineBuilder,
+    create_engine,
+    default_config,
+)
+from surge_tpu.engine.pipeline import EngineNotRunningError, EngineStatus
+from surge_tpu.log import InMemoryLog
+from surge_tpu.models import counter
+
+CFG = default_config().with_overrides({
+    "surge.producer.flush-interval-ms": 5,
+    "surge.producer.ktable-check-interval-ms": 5,
+    "surge.state-store.commit-interval-ms": 20,
+    "surge.aggregate.init-retry-interval-ms": 5,
+    "surge.engine.num-partitions": 4,
+    "surge.replay.batch-size": 16,
+    "surge.replay.time-chunk": 8,
+})
+
+
+def make_logic():
+    return SurgeCommandBusinessLogic(
+        aggregate_name="counter", model=counter.CounterModel(),
+        state_format=counter.state_formatting(),
+        event_format=counter.event_formatting())
+
+
+def test_engine_lifecycle_and_commands_across_partitions():
+    async def scenario():
+        engine = create_engine(make_logic(), config=CFG)
+        assert engine.status == EngineStatus.STOPPED
+        await engine.start()
+        assert engine.status == EngineStatus.RUNNING
+
+        # aggregates spread over partitions; all must route correctly
+        agg_ids = [f"agg{i}" for i in range(12)]
+        partitions = {engine.router.partition_for(a) for a in agg_ids}
+        assert len(partitions) > 1
+        for agg in agg_ids:
+            r = await engine.aggregate_for(agg).send_command(counter.Increment(agg))
+            assert isinstance(r, CommandSuccess), r
+        r = await engine.aggregate_for("agg0").send_command(counter.Increment("agg0"))
+        assert r.state.count == 2
+
+        rej = await engine.aggregate_for("agg1").send_command(
+            counter.FailCommandProcessing("agg1", "no"))
+        assert isinstance(rej, CommandRejected)
+
+        await engine.stop()
+        assert engine.status == EngineStatus.STOPPED
+        with pytest.raises(EngineNotRunningError):
+            engine._deliver_checked("agg0", None)
+
+    asyncio.run(scenario())
+
+
+def test_engine_restart_resumes_from_log():
+    async def scenario():
+        log = InMemoryLog()
+        engine = create_engine(make_logic(), log=log, config=CFG)
+        await engine.start()
+        for _ in range(3):
+            r = await engine.aggregate_for("agg7").send_command(counter.Increment("agg7"))
+        assert r.state.count == 3
+        await engine.stop()
+
+        # a brand-new engine over the same log: state survives (the log IS the store)
+        engine2 = create_engine(make_logic(), log=log, config=CFG)
+        await engine2.start()
+        state = None
+        for _ in range(100):
+            r = await engine2.aggregate_for("agg7").send_command(counter.Increment("agg7"))
+            if isinstance(r, CommandSuccess):
+                state = r.state
+                break
+            await asyncio.sleep(0.02)
+        assert state is not None and state.count == 4 and state.version == 4
+        await engine2.stop()
+
+    asyncio.run(scenario())
+
+
+def test_builder_surface():
+    async def scenario():
+        engine = (SurgeEngineBuilder()
+                  .with_business_logic(make_logic())
+                  .with_config(CFG)
+                  .with_log(InMemoryLog())
+                  .build())
+        await engine.start()
+        r = await engine.aggregate_for("a").send_command(counter.Increment("a"))
+        assert isinstance(r, CommandSuccess)
+        await engine.stop()
+
+    asyncio.run(scenario())
+
+    with pytest.raises(ValueError):
+        SurgeEngineBuilder().build()
+
+
+def test_rebuild_from_events_on_cold_start():
+    async def scenario():
+        log = InMemoryLog()
+        engine = create_engine(make_logic(), log=log, config=CFG)
+        await engine.start()
+        for i in range(10):
+            agg = f"agg{i}"
+            for _ in range(i % 4 + 1):
+                await engine.aggregate_for(agg).send_command(counter.Increment(agg))
+        await engine.stop()
+
+        # cold start with restore-on-start: store is rebuilt by folding the events
+        # topic through the TPU replay backend before serving
+        cfg = CFG.with_overrides({"surge.replay.restore-on-start": True,
+                                  "surge.replay.backend": "tpu"})
+        engine2 = create_engine(make_logic(), log=log, config=cfg)
+        await engine2.start()
+        # the store already holds every aggregate before any command arrives
+        assert engine2.indexer.store.approximate_num_entries() == 10
+        state = engine2.logic.state_format.read_state(
+            engine2.indexer.get_aggregate_bytes("agg3"))
+        assert state.count == 4  # 3 % 4 + 1
+        r = await engine2.aggregate_for("agg3").send_command(counter.Increment("agg3"))
+        assert isinstance(r, CommandSuccess) and r.state.count == 5
+        await engine2.stop()
+
+    asyncio.run(scenario())
+
+
+def test_rebalance_listener_sees_assignments():
+    async def scenario():
+        seen = []
+        engine = create_engine(make_logic(), config=CFG)
+        engine.register_rebalance_listener(lambda a, c: seen.append(dict(a.assignments)))
+        await engine.start()
+        assert seen and list(seen[-1].values())[0] == [0, 1, 2, 3]
+        await engine.stop()
+
+    asyncio.run(scenario())

@@ -1,0 +1,274 @@
+"""Aggregate entity FSM + shard + AggregateRef — the PersistentActorSpec analog.
+
+Drives a real entity against the real publisher/store stack (no mocks below the model),
+covering the reference spec's hardest paths (PersistentActorSpec, SURVEY.md §4):
+happy-path command fold+persist+reply, rejections, command/fold/serialization failures,
+publish retry-then-crash with recreate-from-store, init gating, passivation buffering."""
+
+import asyncio
+
+import pytest
+
+from surge_tpu.config import default_config
+from surge_tpu.engine.business_logic import SurgeCommandBusinessLogic, SurgeModel
+from surge_tpu.engine.entity import (
+    AggregateEntity,
+    CommandFailure,
+    CommandRejected,
+    CommandSuccess,
+)
+from surge_tpu.engine.publisher import PartitionPublisher, PublishFailedError
+from surge_tpu.engine.ref import AggregateRef
+from surge_tpu.engine.shard import Shard
+from surge_tpu.log import InMemoryLog, TopicSpec
+from surge_tpu.models import counter
+from surge_tpu.store import StateStoreIndexer
+
+CFG = default_config().with_overrides({
+    "surge.producer.flush-interval-ms": 5,
+    "surge.producer.ktable-check-interval-ms": 5,
+    "surge.state-store.commit-interval-ms": 20,
+    "surge.aggregate.init-retry-interval-ms": 5,
+    "surge.aggregate.init-fetch-retry-ms": 5,
+    "surge.aggregate.publish-timeout-ms": 2_000,
+    "surge.aggregate.ask-timeout-ms": 2_000,
+    "surge.serialization.thread-pool-size": 2,
+})
+
+
+def make_logic():
+    return SurgeCommandBusinessLogic(
+        aggregate_name="counter", model=counter.CounterModel(),
+        state_format=counter.state_formatting(),
+        event_format=counter.event_formatting(),
+        state_topic="state", events_topic="events")
+
+
+class Stack:
+    """log + indexer + publisher + shard wired like the pipeline will wire them."""
+
+    def __init__(self, config=CFG, publisher_cls=PartitionPublisher):
+        self.config = config
+        self.log = InMemoryLog()
+        self.log.create_topic(TopicSpec("events", 1))
+        self.log.create_topic(TopicSpec("state", 1, compacted=True))
+        self.logic = make_logic()
+        self.surge_model = SurgeModel(self.logic, config)
+        self.indexer = StateStoreIndexer(self.log, "state", config=config)
+        self.publisher = publisher_cls(self.log, "state", "events", 0, self.indexer,
+                                       config=config)
+        self.shard = Shard("p0", self._entity_factory)
+
+    def _entity_factory(self, aggregate_id, on_passivate, on_stopped):
+        return AggregateEntity(
+            aggregate_id, self.surge_model, self.publisher,
+            fetch_state=self.indexer.get_aggregate_bytes, partition=0,
+            config=self.config, on_passivate=on_passivate, on_stopped=on_stopped)
+
+    async def start(self):
+        await self.indexer.start()
+        await self.publisher.start()
+        await self.publisher.wait_ready(5.0)
+        return self
+
+    async def stop(self):
+        await self.shard.stop()
+        await self.publisher.stop()
+        await self.indexer.stop()
+        self.surge_model.close()
+
+    def ref(self, aggregate_id) -> AggregateRef:
+        return AggregateRef(aggregate_id, self.shard.deliver, self.config)
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_send_command_fold_persist_reply():
+    async def scenario():
+        s = await Stack().start()
+        ref = s.ref("agg1")
+        r1 = await ref.send_command(counter.Increment("agg1"))
+        assert isinstance(r1, CommandSuccess)
+        assert r1.state.count == 1 and r1.state.version == 1
+        r2 = await ref.send_command(counter.Increment("agg1"))
+        r3 = await ref.send_command(counter.Decrement("agg1"))
+        assert r3.state.count == 1 and r3.state.version == 3
+        assert await ref.get_state() == r3.state
+
+        # events topic carries the three events; state topic the three snapshots
+        events = [r for r in s.log.read("events", 0)]
+        assert len(events) == 3
+        assert s.log.latest_by_key("state", 0)["agg1"].value == \
+            counter.state_formatting().write_state(r3.state).value
+        await s.stop()
+
+    run(scenario())
+
+
+def test_rejection_leaves_state_unchanged():
+    async def scenario():
+        s = await Stack().start()
+        ref = s.ref("agg1")
+        await ref.send_command(counter.Increment("agg1"))
+        r = await ref.send_command(counter.FailCommandProcessing("agg1", "nope"))
+        assert isinstance(r, CommandRejected)
+        assert str(r.reason) == "nope"
+        assert (await ref.get_state()).count == 1  # unchanged, entity alive
+        await s.stop()
+
+    run(scenario())
+
+
+def test_fold_exception_errors_but_entity_survives():
+    async def scenario():
+        s = await Stack().start()
+        ref = s.ref("agg1")
+        await ref.send_command(counter.Increment("agg1"))
+        r = await ref.send_command(counter.CreateExceptionThrowingEvent("agg1", "boom"))
+        assert isinstance(r, CommandFailure)
+        assert isinstance(r.error, counter.ExceptionThrowingError)
+        events_before = s.log.end_offset("events", 0)
+        rr = await ref.send_command(counter.Increment("agg1"))  # still serving
+        assert isinstance(rr, CommandSuccess) and rr.state.count == 2
+        assert s.log.end_offset("events", 0) == events_before + 1
+        await s.stop()
+
+    run(scenario())
+
+
+def test_serialization_failure_publishes_nothing():
+    async def scenario():
+        s = await Stack().start()
+        ref = s.ref("agg1")
+        await ref.send_command(counter.Increment("agg1"))
+        ev_before = s.log.end_offset("events", 0)
+        st_before = s.log.end_offset("state", 0)
+        r = await ref.send_command(counter.CreateUnserializableEvent("agg1", "bad"))
+        assert isinstance(r, CommandFailure)
+        assert "unserializable" in str(r.error)
+        assert s.log.end_offset("events", 0) == ev_before
+        assert s.log.end_offset("state", 0) == st_before
+        # in-memory state must NOT have advanced past what was persisted
+        assert (await ref.get_state()).version == 1
+        await s.stop()
+
+    run(scenario())
+
+
+def test_entity_initializes_from_store_snapshot():
+    async def scenario():
+        s = await Stack().start()
+        ref = s.ref("agg9")
+        r = await ref.send_command(counter.Increment("agg9"))
+        # wait until the snapshot is both indexed and no longer in flight
+        for _ in range(200):
+            s.publisher._refresh_watermark()
+            if s.publisher.is_aggregate_state_current("agg9"):
+                break
+            await asyncio.sleep(0.01)
+        entity = s.shard.live_entity("agg9")
+        await entity.stop()  # simulate passivation/eviction
+
+        r2 = await s.ref("agg9").send_command(counter.Increment("agg9"))
+        assert isinstance(r2, CommandSuccess)
+        assert r2.state.count == 2 and r2.state.version == 2  # resumed from snapshot
+        await s.stop()
+
+    run(scenario())
+
+
+def test_publish_retry_exhaustion_crashes_then_recreates():
+    class AlwaysFailingPublisher(PartitionPublisher):
+        async def publish(self, aggregate_id, records, request_id):
+            raise PublishFailedError("injected transport failure")
+
+    async def scenario():
+        cfg = CFG.with_overrides({"surge.aggregate.publish-max-retries": 1})
+        s = Stack(config=cfg, publisher_cls=AlwaysFailingPublisher)
+        await s.indexer.start()
+        await s.publisher.start()
+        await s.publisher.wait_ready(5.0)
+        ref = s.ref("agg1")
+        r = await ref.send_command(counter.Increment("agg1"))
+        assert isinstance(r, CommandFailure)
+        await asyncio.sleep(0.01)
+        dead = s.shard.live_entity("agg1")
+        assert dead is None or dead.state_name == "stopped"  # crashed
+
+        # heal the transport: next command gets a fresh entity that works
+        s.publisher.__class__ = PartitionPublisher
+        r2 = await ref.send_command(counter.Increment("agg1"))
+        assert isinstance(r2, CommandSuccess) and r2.state.count == 1
+        await s.stop()
+
+    run(scenario())
+
+
+def test_idle_passivation_and_buffered_redelivery():
+    async def scenario():
+        cfg = CFG.with_overrides({"surge.aggregate.idle-passivation-ms": 30})
+        s = await Stack(config=cfg).start()
+        ref = s.ref("agg1")
+        await ref.send_command(counter.Increment("agg1"))
+        assert s.shard.num_live_entities == 1
+        # wait for idle passivation + snapshot indexing
+        for _ in range(300):
+            if s.shard.num_live_entities == 0 and \
+                    s.publisher.is_aggregate_state_current("agg1"):
+                break
+            s.publisher._refresh_watermark()
+            await asyncio.sleep(0.01)
+        assert s.shard.num_live_entities == 0
+
+        r = await ref.send_command(counter.Increment("agg1"))  # revives from store
+        assert isinstance(r, CommandSuccess) and r.state.count == 2
+        await s.stop()
+
+    run(scenario())
+
+
+def test_passivation_window_buffering():
+    async def scenario():
+        s = await Stack().start()
+        ref = s.ref("agg1")
+        await ref.send_command(counter.Increment("agg1"))
+        # simulate the passivation window: parent marked, entity not yet stopped
+        s.shard._on_passivate("agg1")
+        ask = asyncio.ensure_future(ref.send_command(counter.Increment("agg1")))
+        await asyncio.sleep(0.02)
+        assert not ask.done()  # buffered, not delivered
+        entity = s.shard.live_entity("agg1")
+        await entity.stop()
+        s.shard._on_stopped("agg1", [], False)  # triggers redelivery to fresh entity
+        r = await ask
+        assert isinstance(r, CommandSuccess) and r.state.count == 2
+        await s.stop()
+
+    run(scenario())
+
+
+def test_apply_events_publishes_state_only():
+    async def scenario():
+        s = await Stack().start()
+        ref = s.ref("agg1")
+        ev_before = s.log.end_offset("events", 0)
+        r = await ref.apply_events([counter.CountIncremented("agg1", 5, 1)])
+        assert isinstance(r, CommandSuccess) and r.state.count == 5
+        assert s.log.end_offset("events", 0) == ev_before  # no events published
+        assert s.log.latest_by_key("state", 0)["agg1"].value is not None
+        await s.stop()
+
+    run(scenario())
+
+
+def test_ask_timeout_maps_to_command_failure():
+    async def scenario():
+        cfg = CFG.with_overrides({"surge.aggregate.ask-timeout-ms": 50})
+        dropped = AggregateRef("agg1", deliver=lambda agg_id, env: None, config=cfg)
+        r = await dropped.send_command(counter.Increment("agg1"))
+        assert isinstance(r, CommandFailure)
+        assert isinstance(r.error, asyncio.TimeoutError)
+
+    run(scenario())

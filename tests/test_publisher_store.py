@@ -295,3 +295,69 @@ def test_restore_from_state_topic_latest_snapshot_wins():
         await indexer.stop()
 
     asyncio.run(scenario())
+
+
+def test_restore_from_events_bank_account_vocab_paths_identical():
+    """cpu (domain fold) vs tpu (vocab-encoded tensor fold + decode_state) must agree."""
+    from surge_tpu.models import bank_account as ba
+    from surge_tpu.serialization import SerializedMessage
+
+    log = make_log()
+    model = ba.BankAccountModel()
+    evt_fmt = ba.event_formatting()
+    state_fmt = ba.state_formatting()
+    p = log.transactional_producer("seed")
+    for i in range(17):
+        acct = f"acct{i:02d}"
+        state = None
+        cmds = [ba.CreateAccount(acct, f"o{i}", "pw", 100.0)]
+        cmds += [ba.CreditAccount(acct, 0.25 * (j + 1)) for j in range(i % 4)]
+        p.begin()
+        for cmd in cmds:
+            for ev in model.process_command(state, cmd):
+                m = evt_fmt.write_event(ev)
+                p.send(LogRecord(topic="events", key=m.key, value=m.value, partition=0))
+                state = model.handle_event(state, ev)
+        p.commit()
+
+    vocab = ba.Vocab()
+    kwargs = dict(
+        deserialize_event=lambda b: evt_fmt.read_event(SerializedMessage(key="", value=b)),
+        serialize_state=lambda a, st: state_fmt.write_state(st).value,
+        model=model, replay_spec=ba.make_replay_spec(),
+        encode_event=lambda e: ba.encode_event(vocab, e),
+        decode_state=lambda a, rec: ba.decode_state(vocab, a, rec))
+    s_cpu, s_tpu = InMemoryKeyValueStore(), InMemoryKeyValueStore()
+    restore_from_events(log, "events", s_cpu,
+                        config=default_config().with_overrides({"surge.replay.backend": "cpu"}),
+                        **kwargs)
+    restore_from_events(log, "events", s_tpu,
+                        config=default_config().with_overrides({"surge.replay.backend": "tpu",
+                                                                "surge.replay.batch-size": 8,
+                                                                "surge.replay.time-chunk": 4}),
+                        **kwargs)
+    assert list(s_cpu.all_items()) == list(s_tpu.all_items())
+    assert s_cpu.approximate_num_entries() == 17
+
+
+def test_cancelled_publish_withdrawn_no_double_commit():
+    """A publish whose caller times out must be withdrawn from the pending batch so the
+    same-request_id retry does not commit the records twice (review r2 finding)."""
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log)
+        task = asyncio.ensure_future(
+            pub.publish("a", [event_rec("a", b"e1")], "req-1"))
+        await asyncio.sleep(0)  # queued, not yet flushed
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        await pub.publish("a", [event_rec("a", b"e1")], "req-1")  # the retry
+        await asyncio.sleep(0.05)
+        assert [r.value for r in log.read("events", 0)] == [b"e1"]  # exactly once
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())

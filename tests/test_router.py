@@ -1,0 +1,206 @@
+"""Partitioner / assignments / tracker / router — KafkaPartitionShardRouterActorSpec
+analog with probe-backed regions (SURVEY.md §4 pattern 3)."""
+
+import asyncio
+
+import pytest
+
+from surge_tpu.engine.entity import Envelope
+from surge_tpu.engine.partition import (
+    HostPort,
+    PartitionAssignments,
+    PartitionTracker,
+    murmur3_string_hash,
+    partition_by_up_to_colon,
+    partition_for_key,
+)
+from surge_tpu.engine.router import NoRouteError, SurgePartitionRouter
+
+ME = HostPort("local", 1)
+OTHER = HostPort("remote", 2)
+
+
+# -- partitioner ------------------------------------------------------------------------
+
+
+def test_murmur3_deterministic_and_signed32():
+    vals = {murmur3_string_hash(s) for s in ("", "a", "ab", "agg:1", "x" * 31)}
+    assert len(vals) == 5  # distinct
+    for s in ("", "a", "ab", "agg:1", "x" * 31):
+        h = murmur3_string_hash(s)
+        assert h == murmur3_string_hash(s)
+        assert -(2 ** 31) <= h < 2 ** 31
+
+
+def test_partition_for_key_range_and_coverage():
+    n = 8
+    hits = {partition_for_key(f"agg{i}", n) for i in range(500)}
+    assert hits == set(range(n))  # all partitions reachable
+    for i in range(100):
+        assert 0 <= partition_for_key(f"k{i}", 3) < 3
+    with pytest.raises(ValueError):
+        partition_for_key("x", 0)
+
+
+def test_partition_by_up_to_colon():
+    assert partition_by_up_to_colon("tenant:uuid-123") == "tenant"
+    assert partition_by_up_to_colon("plain") == "plain"
+    # co-location: same prefix -> same partition
+    assert partition_for_key(partition_by_up_to_colon("t1:a"), 8) == \
+        partition_for_key(partition_by_up_to_colon("t1:b"), 8)
+
+
+# -- assignments + tracker --------------------------------------------------------------
+
+
+def test_assignment_diff_revoked_and_added():
+    pa = PartitionAssignments({ME: [0, 1, 2], OTHER: [3]})
+    new, changes = pa.update({ME: [0, 2], OTHER: [3, 1]})
+    assert changes.revoked[ME] == [1]
+    assert changes.added[OTHER] == [1]
+    assert new.partition_to_host()[1] == OTHER
+
+
+def test_tracker_broadcast_and_replay_on_register():
+    tracker = PartitionTracker()
+    tracker.update({ME: [0]})
+    seen = []
+    tracker.register(lambda a, c: seen.append((dict(a.assignments), c)))
+    assert seen and seen[0][0] == {ME: [0]}  # replayed current state
+    tracker.update({ME: [0, 1]})
+    assert seen[-1][1].added[ME] == [1]
+
+
+# -- router -----------------------------------------------------------------------------
+
+
+class ProbeRegion:
+    """Probe-forwarding region substitute (ProbeInterceptorRegionCreator analog)."""
+
+    def __init__(self, partition):
+        self.partition = partition
+        self.delivered = []
+        self.stopped = False
+
+    def deliver(self, aggregate_id, env):
+        self.delivered.append((aggregate_id, env))
+        if not env.reply.done():
+            env.reply.set_result(f"region-{self.partition}")
+
+    async def stop(self):
+        self.stopped = True
+
+
+def make_router(tracker, regions, remote=None, **kw):
+    def creator(p):
+        regions[p] = ProbeRegion(p)
+        return regions[p]
+
+    return SurgePartitionRouter(num_partitions=4, tracker=tracker, local_host=ME,
+                                region_creator=creator, remote_deliver=remote, **kw)
+
+
+def env():
+    return Envelope(message="m", reply=asyncio.get_event_loop().create_future())
+
+
+def test_local_delivery_routes_to_owned_partition_region():
+    async def scenario():
+        tracker = PartitionTracker()
+        regions = {}
+        router = make_router(tracker, regions)
+        await router.start()
+        tracker.update({ME: [0, 1, 2, 3]})
+        assert router.local_partitions == [0, 1, 2, 3]
+
+        agg = "agg42"
+        e = env()
+        router.deliver(agg, e)
+        p = router.partition_for(agg)
+        assert regions[p].delivered[0][0] == agg
+        assert await e.reply == f"region-{p}"
+        await router.stop()
+
+    asyncio.run(scenario())
+
+
+def test_remote_partition_forwards_through_remote_deliver():
+    async def scenario():
+        tracker = PartitionTracker()
+        forwarded = []
+        router = make_router(tracker, {}, remote=lambda hp, p, a, e: forwarded.append((hp, p, a)))
+        await router.start()
+        tracker.update({OTHER: [0, 1, 2, 3]})
+        router.deliver("agg1", env())
+        assert forwarded and forwarded[0][0] == OTHER
+        assert forwarded[0][1] == router.partition_for("agg1")
+        await router.stop()
+
+    asyncio.run(scenario())
+
+
+def test_no_remote_transport_fails_the_ask():
+    async def scenario():
+        tracker = PartitionTracker()
+        router = make_router(tracker, {})
+        await router.start()
+        tracker.update({OTHER: [0, 1, 2, 3]})
+        e = env()
+        router.deliver("agg1", e)
+        with pytest.raises(NoRouteError):
+            await e.reply
+        await router.stop()
+
+    asyncio.run(scenario())
+
+
+def test_deliveries_buffer_until_assignments_arrive():
+    async def scenario():
+        tracker = PartitionTracker()
+        regions = {}
+        router = make_router(tracker, regions)
+        await router.start()
+        e1, e2 = env(), env()
+        router.deliver("agg1", e1)
+        router.deliver("agg2", e2)
+        assert not regions  # nothing known yet -> buffered
+        tracker.update({ME: [0, 1, 2, 3]})
+        assert await e1.reply and await e2.reply  # drained on assignment
+        await router.stop()
+
+    asyncio.run(scenario())
+
+
+def test_rebalance_stops_revoked_regions():
+    async def scenario():
+        tracker = PartitionTracker()
+        regions = {}
+        router = make_router(tracker, regions)
+        await router.start()
+        tracker.update({ME: [0, 1, 2, 3]})
+        created = dict(regions)
+        tracker.update({ME: [0], OTHER: [1, 2, 3]})
+        await asyncio.sleep(0)  # let the stop tasks run
+        assert router.local_partitions == [0]
+        assert created[1].stopped and created[2].stopped and created[3].stopped
+        assert not created[0].stopped
+        await router.stop()
+
+    asyncio.run(scenario())
+
+
+def test_dr_standby_defers_region_creation_until_first_message():
+    async def scenario():
+        tracker = PartitionTracker()
+        regions = {}
+        router = make_router(tracker, regions, dr_standby=True)
+        await router.start()
+        tracker.update({ME: [0, 1, 2, 3]})
+        assert regions == {}  # standby: no eager regions
+        e = env()
+        router.deliver("agg1", e)
+        assert len(regions) == 1  # created on first traffic
+        assert await e.reply
+        await router.stop()
+
+    asyncio.run(scenario())
