@@ -37,13 +37,14 @@ def create_engine(business_logic: SurgeCommandBusinessLogic, *, log=None,
                   config: Optional[Config] = None,
                   local_host: Optional[HostPort] = None,
                   tracker: Optional[PartitionTracker] = None,
-                  remote_deliver=None, mesh=None) -> SurgeEngine:
+                  remote_deliver=None, mesh=None, tracer=None) -> SurgeEngine:
     """Build (not start) an engine — ``SurgeCommand(businessLogic)`` equivalent.
 
     Single-node by default (in-memory log, self-assigned partitions); pass a shared
     ``tracker``/``remote_deliver`` for multi-node routing (SURVEY.md §2.10)."""
     return SurgeEngine(business_logic, log=log, config=config, local_host=local_host,
-                       tracker=tracker, remote_deliver=remote_deliver, mesh=mesh)
+                       tracker=tracker, remote_deliver=remote_deliver, mesh=mesh,
+                       tracer=tracer)
 
 
 class SurgeEngineBuilder:
@@ -71,6 +72,10 @@ class SurgeEngineBuilder:
 
     def with_tracker(self, tracker: PartitionTracker) -> "SurgeEngineBuilder":
         self._kwargs["tracker"] = tracker
+        return self
+
+    def with_tracer(self, tracer) -> "SurgeEngineBuilder":
+        self._kwargs["tracer"] = tracer
         return self
 
     def with_mesh(self, mesh) -> "SurgeEngineBuilder":

@@ -37,6 +37,17 @@ from surge_tpu.config import Config, RetryConfig, TimeoutConfig, default_config
 from surge_tpu.engine.business_logic import SurgeModel
 from surge_tpu.engine.model import RejectedCommand
 from surge_tpu.engine.publisher import PartitionPublisher
+from surge_tpu.metrics import EngineMetrics, engine_metrics
+
+# fallback quiver for entities constructed outside a pipeline (tests, tools)
+_DEFAULT_METRICS: EngineMetrics | None = None
+
+
+def _default_metrics() -> EngineMetrics:
+    global _DEFAULT_METRICS
+    if _DEFAULT_METRICS is None:
+        _DEFAULT_METRICS = engine_metrics()
+    return _DEFAULT_METRICS
 
 
 # -- message + result ADTs (PersistentActor.scala:33-64, AggregateRefResult.scala:5-11) --
@@ -91,7 +102,8 @@ class AggregateEntity:
                  fetch_state: Callable[[str], Optional[bytes]],
                  partition: int = 0, config: Config | None = None,
                  on_passivate: Callable[[str], None] | None = None,
-                 on_stopped: Callable[[str, List[Envelope], bool], None] | None = None) -> None:
+                 on_stopped: Callable[[str, List[Envelope], bool], None] | None = None,
+                 metrics: EngineMetrics | None = None, tracer=None) -> None:
         self.aggregate_id = aggregate_id
         self.surge_model = surge_model
         self.model = surge_model.logic.model
@@ -101,6 +113,8 @@ class AggregateEntity:
         self.config = config or default_config()
         self.on_passivate = on_passivate or (lambda agg_id: None)
         self.on_stopped = on_stopped or (lambda agg_id, leftovers, crashed: None)
+        self.metrics = metrics or _default_metrics()
+        self.tracer = tracer
         self.retry = RetryConfig.from_config(self.config)
         self.timeouts = TimeoutConfig.from_config(self.config)
         self._idle_s = self.config.get_seconds("surge.aggregate.idle-passivation-ms", 30_000)
@@ -180,9 +194,11 @@ class AggregateEntity:
                 await asyncio.sleep(self.retry.init_retry_interval_s)
                 continue
             try:
-                data = self.fetch_state(self.aggregate_id)
-                self.state = (self.surge_model.deserialize_state(data)
-                              if data is not None else self._initial_state())
+                with self.metrics.state_fetch_timer.time():
+                    data = self.fetch_state(self.aggregate_id)
+                with self.metrics.deserialization_timer.time():
+                    self.state = (self.surge_model.deserialize_state(data)
+                                  if data is not None else self._initial_state())
                 return
             except Exception:  # noqa: BLE001 — fetch/deserialize failure retries
                 logger.exception("state fetch failed for %s (attempt %d)",
@@ -196,6 +212,29 @@ class AggregateEntity:
         return fn(self.aggregate_id) if fn is not None else None
 
     async def _handle(self, env: Envelope) -> None:
+        # receive span, child of the ask span via traceparent headers
+        # (ActorWithTracing's around-receive + PersistentActor.scala:166-168)
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start_span(
+                f"entity.{type(env.message).__name__}", headers=env.headers)
+            span.set_attribute("aggregate_id", self.aggregate_id)
+            span.set_attribute("partition", self.partition)
+        try:
+            await self._handle_inner(env)
+            if span is not None and env.reply.done() and not env.reply.cancelled():
+                result = env.reply.exception() or env.reply.result()
+                if isinstance(result, (CommandFailure, BaseException)):
+                    span.status = "error"
+        except BaseException as exc:
+            if span is not None:
+                span.record_exception(exc)
+            raise
+        finally:
+            if span is not None:
+                span.finish()
+
+    async def _handle_inner(self, env: Envelope) -> None:
         msg = env.message
         if isinstance(msg, GetState):
             resolve_future(env.reply, self.state)
@@ -210,12 +249,16 @@ class AggregateEntity:
 
     async def _process_command(self, env: Envelope, command: Any) -> None:
         # 1. user command handler (may reject)
+        self.metrics.command_rate.record()
         try:
-            events = list(self.model.process_command(self.state, command))
+            with self.metrics.command_handling_timer.time():
+                events = list(self.model.process_command(self.state, command))
         except RejectedCommand as rej:
+            self.metrics.rejection_rate.record()
             resolve_future(env.reply, CommandRejected(rej))
             return
         except Exception as exc:  # noqa: BLE001 — user code failure → error ACK
+            self.metrics.error_rate.record()
             resolve_future(env.reply, CommandFailure(exc))
             return
         # 2. fold + persist + reply
@@ -231,10 +274,12 @@ class AggregateEntity:
                                 reply_state: bool, state_only: bool = False) -> None:
         old_state = self.state
         try:
-            new_state = old_state
-            for ev in events:
-                new_state = self.model.handle_event(new_state, ev)
+            with self.metrics.event_handling_timer.time():
+                new_state = old_state
+                for ev in events:
+                    new_state = self.model.handle_event(new_state, ev)
         except Exception as exc:  # noqa: BLE001 — fold failure → error ACK, no persist
+            self.metrics.error_rate.record()
             resolve_future(env.reply, CommandFailure(exc))
             return
 
@@ -247,10 +292,12 @@ class AggregateEntity:
         self.state_name = "persisting"
         try:
             try:
-                records = await self.surge_model.serialize_outputs(
-                    self.aggregate_id, self.partition, new_state,
-                    [] if state_only else events)
+                with self.metrics.serialization_timer.time():
+                    records = await self.surge_model.serialize_outputs(
+                        self.aggregate_id, self.partition, new_state,
+                        [] if state_only else events)
             except Exception as exc:  # noqa: BLE001 — serialization failure → error ACK
+                self.metrics.error_rate.record()
                 resolve_future(env.reply, CommandFailure(exc))
                 return
 
@@ -258,9 +305,10 @@ class AggregateEntity:
             last_error: Optional[Exception] = None
             for _ in range(self.retry.publish_max_retries + 1):
                 try:
-                    await asyncio.wait_for(
-                        self.publisher.publish(self.aggregate_id, records, request_id),
-                        timeout=self.timeouts.publish_timeout_s)
+                    with self.metrics.publish_timer.time():
+                        await asyncio.wait_for(
+                            self.publisher.publish(self.aggregate_id, records, request_id),
+                            timeout=self.timeouts.publish_timeout_s)
                     self.state = new_state
                     resolve_future(env.reply, CommandSuccess(new_state))
                     return

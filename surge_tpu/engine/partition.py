@@ -18,7 +18,7 @@ Reference semantics preserved exactly:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Mapping, Tuple
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
 
 from surge_tpu.common import logger
 
@@ -46,7 +46,10 @@ def murmur3_string_hash(s: str) -> int:
     """Scala MurmurHash3.stringHash: mixes UTF-16 code units two at a time. Returns a
     signed 32-bit int (negative values possible, as on the JVM)."""
     h = _STRING_SEED
-    units = [ord(c) for c in s]  # BMP assumption matches JVM char semantics for ids
+    # UTF-16 code units (JVM chars): astral code points become surrogate pairs, so
+    # length and pair-mixing match the JVM exactly
+    data = s.encode("utf-16-be")
+    units = [(data[i] << 8) | data[i + 1] for i in range(0, len(data), 2)]
     i = 0
     n = len(units)
     while i + 1 < n:
@@ -110,9 +113,14 @@ class PartitionAssignments:
     """Current cluster assignment map + diffing update (PartitionAssignments.scala:50-63)."""
 
     assignments: Assignments = field(default_factory=dict)
+    _p2h: Optional[Dict[int, HostPort]] = field(default=None, repr=False, compare=False)
 
     def partition_to_host(self) -> Dict[int, HostPort]:
-        return {p: hp for hp, parts in self.assignments.items() for p in parts}
+        # cached: instances are replaced wholesale by update(), and this sits on the
+        # per-message routing hot path
+        if self._p2h is None:
+            self._p2h = {p: hp for hp, parts in self.assignments.items() for p in parts}
+        return self._p2h
 
     def update(self, new: Assignments) -> Tuple["PartitionAssignments", AssignmentChanges]:
         changes = AssignmentChanges(revoked=_missing(self.assignments, new),

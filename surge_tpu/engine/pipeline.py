@@ -30,7 +30,9 @@ from surge_tpu.engine.publisher import PartitionPublisher
 from surge_tpu.engine.ref import AggregateRef
 from surge_tpu.engine.router import SurgePartitionRouter
 from surge_tpu.engine.shard import Shard
+from surge_tpu.health import HealthCheck, HealthSignalBus, HealthSupervisor, RegexMatcher
 from surge_tpu.log import InMemoryLog, TopicSpec
+from surge_tpu.metrics import Metrics, engine_metrics
 from surge_tpu.store import StateStoreIndexer, restore_from_events
 
 
@@ -79,7 +81,7 @@ class SurgeEngine(Controllable):
                  config: Config | None = None,
                  local_host: HostPort | None = None,
                  tracker: PartitionTracker | None = None,
-                 remote_deliver=None, mesh=None) -> None:
+                 remote_deliver=None, mesh=None, tracer=None) -> None:
         self.logic = logic
         self.config = config or default_config()
         self.log = log if log is not None else InMemoryLog()
@@ -93,8 +95,18 @@ class SurgeEngine(Controllable):
         self.log.create_topic(TopicSpec(logic.state_topic, self.num_partitions, compacted=True))
         if logic.events_topic:
             self.log.create_topic(TopicSpec(logic.events_topic, self.num_partitions))
+        # observability plane: metrics registry + health signal bus + supervisor
+        # (SurgeMessagePipeline wires the SlidingHealthSignalStreamProvider + Metrics
+        # the same way, SurgeMessagePipeline.scala:56-87)
+        self.metrics_registry = Metrics()
+        self.metrics = engine_metrics(self.metrics_registry)
+        self.tracer = tracer  # None = tracing disabled (zero per-message overhead)
+        self.health_bus = HealthSignalBus(
+            self.config.get_int("surge.health.signal-buffer-size", 25))
+        self.health_supervisor = HealthSupervisor(self.health_bus, self.config)
         self.surge_model = SurgeModel(logic, self.config)
-        self.indexer = StateStoreIndexer(self.log, logic.state_topic, config=self.config)
+        self.indexer = StateStoreIndexer(self.log, logic.state_topic, config=self.config,
+                                         on_signal=self.health_bus.signal_fn("state-store"))
         self.router = SurgePartitionRouter(
             num_partitions=self.num_partitions, tracker=self.tracker,
             local_host=self.local_host, region_creator=self._create_region,
@@ -109,6 +121,12 @@ class SurgeEngine(Controllable):
         try:
             if self.config.get_bool("surge.replay.restore-on-start"):
                 await self.rebuild_from_events()
+            # restart the state store on fatal signals (the restartSignalPatterns of
+            # AggregateStateStoreKafkaStreams.scala:74-76)
+            self.health_supervisor.register(
+                "state-store", self.indexer,
+                restart_patterns=[RegexMatcher(r"state-store.*fatal")])
+            self.health_supervisor.start()
             await self.indexer.start()
             await self.router.start()
             if not self._external_tracker and not self.tracker.assignments.assignments:
@@ -123,6 +141,7 @@ class SurgeEngine(Controllable):
 
     async def stop(self) -> Ack:
         self.status = EngineStatus.STOPPING
+        self.health_supervisor.stop()
         await self.router.stop()  # stops regions (shards + publishers)
         await self.indexer.stop()
         self.surge_model.close()
@@ -136,7 +155,8 @@ class SurgeEngine(Controllable):
 
     def aggregate_for(self, aggregate_id: str) -> AggregateRef:
         """scaladsl SurgeCommand.aggregateFor (SurgeCommand.scala:52-54)."""
-        return AggregateRef(aggregate_id, self._deliver_checked, self.config)
+        return AggregateRef(aggregate_id, self._deliver_checked, self.config,
+                            tracer=self.tracer)
 
     def _deliver_checked(self, aggregate_id: str, env: Envelope) -> None:
         if self.status != EngineStatus.RUNNING:
@@ -157,15 +177,47 @@ class SurgeEngine(Controllable):
             partition, self.indexer, config=self.config,
             transactional_id_prefix=self.logic.transactional_id_prefix,
             still_owner=lambda p=partition: (
-                self.tracker.assignments.partition_to_host().get(p) == self.local_host))
+                self.tracker.assignments.partition_to_host().get(p) == self.local_host),
+            on_signal=self.health_bus.signal_fn(f"publisher-{partition}"),
+            metrics=self.metrics)
         shard = Shard(
             f"{self.logic.aggregate_name}-{partition}",
             lambda aggregate_id, on_passivate, on_stopped: AggregateEntity(
                 aggregate_id, self.surge_model, publisher,
                 fetch_state=self.indexer.get_aggregate_bytes, partition=partition,
-                config=self.config, on_passivate=on_passivate, on_stopped=on_stopped),
+                config=self.config, on_passivate=on_passivate, on_stopped=on_stopped,
+                metrics=self.metrics, tracer=self.tracer),
             buffer_limit=self.config.get_int("surge.aggregate.passivation-buffer-limit", 1000))
         return _Region(partition, publisher, shard)
+
+    # -- health -------------------------------------------------------------------------
+
+    def health_check(self) -> HealthCheck:
+        """Engine → router → regions ask-chain (SurgeHealthCheck analog,
+        KafkaPartitionShardRouterActor.getHealthCheck:353-366). Also refreshes the
+        live-entity gauge."""
+        regions = []
+        live = 0
+        for p, region in self.router.regions():
+            live += region.shard.num_live_entities
+            pub_ok = region.publisher.state == "processing"
+            regions.append(HealthCheck(
+                name=f"region-{p}",
+                status="up" if pub_ok else "degraded",
+                components=[HealthCheck(name=f"publisher-{p}",
+                                        status="up" if pub_ok else "down")]))
+        self.metrics.live_entities.record(live)
+        router_h = self.router.health()
+        return HealthCheck(
+            name=self.logic.aggregate_name,
+            status="up" if self.status == EngineStatus.RUNNING else "down",
+            components=[
+                HealthCheck(name="router",
+                            status="up" if router_h["status"] == "up" else "down",
+                            components=regions),
+                HealthCheck(name="state-store",
+                            status="up" if self.indexer.running else "down"),
+            ])
 
     # -- TPU bulk restore ---------------------------------------------------------------
 
@@ -189,7 +241,15 @@ class SurgeEngine(Controllable):
             encode_event=getattr(self.logic, "encode_event", None),
             decode_state=getattr(self.logic, "decode_state", None),
             config=self.config, mesh=self.mesh))
-        # snapshots already on the state topic are superseded by the replayed states
+        # overlay snapshots for aggregates the events topic does not cover (state-only
+        # publishes, e.g. apply_events) — for event-sourced aggregates the replayed
+        # state and the latest snapshot are identical because events+state commit
+        # atomically, so the replayed value stands
+        store = self.indexer.store
+        for p in range(self.num_partitions):
+            for key, rec in self.log.latest_by_key(self.logic.state_topic, p).items():
+                if store.get(key) is None:
+                    store.put(key, rec.value)
         self.indexer.prime({p: self.log.end_offset(self.logic.state_topic, p)
                             for p in range(self.num_partitions)})
         logger.info("rebuild_from_events: %d aggregates from %d events via %s",

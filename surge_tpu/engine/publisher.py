@@ -80,7 +80,8 @@ class PartitionPublisher:
                  partition: int, progress: StoreProgress,
                  config: Config | None = None, transactional_id_prefix: str = "surge",
                  still_owner: Callable[[], bool] = lambda: True,
-                 on_signal: Callable[[str, str], None] | None = None) -> None:
+                 on_signal: Callable[[str, str], None] | None = None,
+                 metrics=None) -> None:
         self.log = log
         self.state_topic = state_topic
         self.events_topic = events_topic
@@ -93,10 +94,14 @@ class PartitionPublisher:
 
         self.state = "uninitialized"
         self.stats = PublisherStats()
+        self.metrics = metrics  # EngineMetrics quiver (optional)
         self._producer = None
         self._pending: List[_Pending] = []
         self._in_flight: Dict[str, int] = {}  # aggregate_id -> max state offset published
         self._completed: Dict[str, float] = {}  # request_id -> completion time
+        # request_id -> outcome future of the batch currently committing it; retries of
+        # an in-flight request join the commit instead of re-queueing (exactly-once)
+        self._committing: Dict[str, "asyncio.Future[Optional[Exception]]"] = {}
         self._watermark = 0
         self._ready = asyncio.Event()
         self._flush_interval = self.config.get_seconds("surge.producer.flush-interval-ms", 50)
@@ -171,6 +176,15 @@ class PartitionPublisher:
         if request_id in self._completed:
             self.stats.dedup_hits += 1
             return
+        committing = self._committing.get(request_id)
+        if committing is not None:
+            # this request's batch is mid-commit (the caller timed out and retried
+            # while the transaction was in flight): join the outcome, never re-queue
+            self.stats.dedup_hits += 1
+            outcome = await asyncio.shield(committing)
+            if outcome is not None:
+                raise PublishFailedError(str(outcome))
+            return
         fut: "asyncio.Future[None]" = asyncio.get_running_loop().create_future()
         pending = _Pending(request_id, aggregate_id, list(records), fut)
         self._pending.append(pending)
@@ -225,6 +239,21 @@ class PartitionPublisher:
 
     async def _publish_batch(self, batch: List[_Pending]) -> None:
         records = [r for p in batch for r in p.records]
+        outcome: "asyncio.Future[Optional[Exception]]" = \
+            asyncio.get_running_loop().create_future()
+        for p in batch:
+            self._committing[p.request_id] = outcome
+        try:
+            await self._publish_batch_inner(batch, records, outcome)
+        finally:
+            if not outcome.done():
+                outcome.set_result(RuntimeError("publish batch aborted"))
+            for p in batch:
+                self._committing.pop(p.request_id, None)
+
+    async def _publish_batch_inner(self, batch: List[_Pending],
+                                   records: List[LogRecord],
+                                   outcome: "asyncio.Future[Optional[Exception]]") -> None:
         t0 = time.perf_counter()
         try:
             if self._single_record_opt_in and len(records) == 1:
@@ -234,9 +263,12 @@ class PartitionPublisher:
                 for r in records:
                     self._producer.send(r)
                 committed = list(self._producer.commit())
-        except ProducerFencedError:
+        except ProducerFencedError as exc:
             self.stats.fences += 1
+            if self.metrics is not None:
+                self.metrics.fence_counter.record()
             self.on_signal("surge.producer.fenced", "error")
+            outcome.set_result(exc)
             for p in batch:
                 fail_future(p.future, PublishFailedError(
                     f"publisher for partition {self.partition} was fenced"))
@@ -244,16 +276,21 @@ class PartitionPublisher:
             return
         except Exception as exc:  # noqa: BLE001 — transport failure fails the batch
             self.stats.batches_failed += 1
+            if self.metrics is not None:
+                self.metrics.publish_failure_counter.record()
             try:
                 if getattr(self._producer, "in_transaction", False):
                     self._producer.abort()
             except Exception:  # noqa: BLE001
                 self.on_signal("surge.producer.abort-failed", "error")
+            outcome.set_result(exc)
             for p in batch:
                 fail_future(p.future, PublishFailedError(str(exc)))
             return
 
         elapsed = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.flush_timer.record_ms(elapsed * 1000.0)
         if elapsed > self._slow_txn_s:
             logger.warning("slow publish transaction: %.3fs on %s[%d]",
                            elapsed, self.state_topic, self.partition)
@@ -270,6 +307,7 @@ class PartitionPublisher:
                 self._in_flight[p.aggregate_id] = max_state_off
             self._completed[p.request_id] = now
             resolve_future(p.future, None)
+        outcome.set_result(None)
         self.stats.flushes += 1
         self.stats.records_published += len(records)
         self.stats.in_flight = len(self._in_flight)

@@ -30,23 +30,42 @@ class AggregateRef:
 
     def __init__(self, aggregate_id: str, deliver: DeliverFn,
                  config: Config | None = None,
-                 headers_factory: Callable[[], dict] | None = None) -> None:
+                 headers_factory: Callable[[], dict] | None = None,
+                 tracer=None) -> None:
         self.aggregate_id = aggregate_id
         self._deliver = deliver
         self._timeouts = TimeoutConfig.from_config(config or default_config())
         self._headers_factory = headers_factory or dict
+        self._tracer = tracer
 
     async def _ask(self, message: Any) -> Any:
         fut: "asyncio.Future[Any]" = asyncio.get_running_loop().create_future()
-        env = Envelope(message=message, reply=fut, headers=self._headers_factory())
+        headers = self._headers_factory()
+        span = None
+        if self._tracer is not None:
+            # span at the ask boundary, trace context rides the envelope headers
+            # (AggregateRefTrait.scala:77-79 + TracedMessage)
+            from surge_tpu.tracing import inject_context
+
+            span = self._tracer.start_span(
+                f"aggregate-ref.{type(message).__name__}", headers=headers)
+            span.set_attribute("aggregate_id", self.aggregate_id)
+            headers = inject_context(span.context, headers)
+        env = Envelope(message=message, reply=fut, headers=headers)
         try:
             self._deliver(self.aggregate_id, env)
-        except Exception as exc:  # noqa: BLE001 — routing failures surface as failures
-            return CommandFailure(exc)
-        try:
             return await asyncio.wait_for(fut, timeout=self._timeouts.ask_timeout_s)
         except asyncio.TimeoutError as exc:
+            if span is not None:
+                span.record_exception(exc)
             return CommandFailure(exc)
+        except Exception as exc:  # noqa: BLE001 — routing failures surface as failures
+            if span is not None:
+                span.record_exception(exc)
+            return CommandFailure(exc)
+        finally:
+            if span is not None:
+                span.finish()
 
     async def send_command(self, command: Any):
         """→ CommandSuccess(new_state) | CommandRejected(reason) | CommandFailure(err)

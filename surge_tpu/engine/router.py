@@ -116,6 +116,11 @@ class SurgePartitionRouter(Controllable):
     def local_partitions(self) -> List[int]:
         return sorted(self._regions)
 
+    def regions(self):
+        """Public (partition, region) accessor in partition order: lets health/metrics
+        compose without reaching into router internals."""
+        return sorted(self._regions.items())
+
     # -- rebalance ----------------------------------------------------------------------
 
     def _on_assignments(self, assignments: PartitionAssignments,

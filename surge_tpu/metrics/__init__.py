@@ -1,0 +1,236 @@
+"""Metrics registry — sensors fanning values into statistics providers.
+
+Equivalent of modules/metrics/src/main/scala/surge/metrics/Metrics.scala:126-228 +
+Sensor.scala:9-39: a named-sensor registry where each sensor updates one or more
+:mod:`~surge_tpu.metrics.statistics` providers, with recording levels
+(``surge.metrics.recording-level``: Info < Debug < Trace, MetricsConfig), the
+high-level instrument types (counter / gauge / timer / rate), snapshot export
+(``get_metrics`` / ``metric_descriptions`` / ``as_html`` — Metrics.scala:220-281), and
+the ~20 predeclared engine metrics (Metrics.scala:20-115) via :func:`engine_metrics`.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Dict, List, Optional
+
+from surge_tpu.metrics.statistics import (
+    Count,
+    ExponentialWeightedMovingAverage,
+    Max,
+    MetricValueProvider,
+    Min,
+    MostRecentValue,
+    RateHistogram,
+    TimeBucketHistogram,
+)
+
+__all__ = [
+    "MetricInfo",
+    "Metrics",
+    "RecordingLevel",
+    "Sensor",
+    "Timer",
+    "engine_metrics",
+]
+
+
+class RecordingLevel(IntEnum):
+    """Metrics.scala RecordingLevel: a sensor records iff its level <= configured."""
+
+    INFO = 0
+    DEBUG = 1
+    TRACE = 2
+
+
+@dataclass(frozen=True)
+class MetricInfo:
+    name: str
+    description: str = ""
+    tags: tuple = ()
+
+
+@dataclass
+class _Registered:
+    info: MetricInfo
+    provider: MetricValueProvider
+
+
+class Sensor:
+    """One named recording point fanning into N providers (Sensor.scala:9-39)."""
+
+    def __init__(self, name: str, level: RecordingLevel, enabled: bool) -> None:
+        self.name = name
+        self.level = level
+        self.enabled = enabled
+        self._providers: List[MetricValueProvider] = []
+
+    def add_metric(self, info: MetricInfo, provider: MetricValueProvider,
+                   registry: "Metrics") -> None:
+        self._providers.append(provider)
+        registry._register(info, provider)
+
+    def record(self, value: float = 1.0, timestamp: Optional[float] = None) -> None:
+        if not self.enabled:
+            return
+        ts = timestamp if timestamp is not None else time.time()
+        for p in self._providers:
+            p.update(value, ts)
+
+
+class Timer:
+    """EWMA + min/max/p99 over millisecond durations (the reference timer shape)."""
+
+    def __init__(self, sensor: Sensor) -> None:
+        self._sensor = sensor
+
+    def record_ms(self, ms: float) -> None:
+        self._sensor.record(ms)
+
+    @contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record_ms((time.perf_counter() - t0) * 1000.0)
+
+    async def time_async(self, awaitable):
+        t0 = time.perf_counter()
+        try:
+            return await awaitable
+        finally:
+            self.record_ms((time.perf_counter() - t0) * 1000.0)
+
+
+class Metrics:
+    """The registry (Metrics.scala:126-228)."""
+
+    def __init__(self, recording_level: RecordingLevel = RecordingLevel.INFO) -> None:
+        self.recording_level = recording_level
+        self._sensors: Dict[str, Sensor] = {}
+        self._metrics: Dict[str, _Registered] = {}
+
+    # -- core ---------------------------------------------------------------------------
+
+    def sensor(self, name: str, level: RecordingLevel = RecordingLevel.INFO) -> Sensor:
+        if name not in self._sensors:
+            self._sensors[name] = Sensor(name, level,
+                                         enabled=level <= self.recording_level)
+        return self._sensors[name]
+
+    def _register(self, info: MetricInfo, provider: MetricValueProvider) -> None:
+        self._metrics[info.name] = _Registered(info, provider)
+
+    # -- instruments --------------------------------------------------------------------
+
+    def counter(self, info: MetricInfo, level: RecordingLevel = RecordingLevel.INFO) -> Sensor:
+        s = self.sensor(info.name, level)
+        if info.name not in self._metrics:
+            s.add_metric(info, Count(), self)
+        return s
+
+    def gauge(self, info: MetricInfo, level: RecordingLevel = RecordingLevel.INFO) -> Sensor:
+        s = self.sensor(info.name, level)
+        if info.name not in self._metrics:
+            s.add_metric(info, MostRecentValue(), self)
+        return s
+
+    def timer(self, info: MetricInfo, level: RecordingLevel = RecordingLevel.INFO) -> Timer:
+        s = self.sensor(info.name, level)
+        if info.name not in self._metrics:
+            s.add_metric(info, ExponentialWeightedMovingAverage(), self)
+            s.add_metric(MetricInfo(f"{info.name}.min", f"min of {info.name}"), Min(), self)
+            s.add_metric(MetricInfo(f"{info.name}.max", f"max of {info.name}"), Max(), self)
+            s.add_metric(MetricInfo(f"{info.name}.p99", f"p99 of {info.name}"),
+                         TimeBucketHistogram(), self)
+        return Timer(s)
+
+    def rate(self, info: MetricInfo, level: RecordingLevel = RecordingLevel.INFO) -> Sensor:
+        """1/5/15-minute event rates (Metrics.scala rate registration)."""
+        s = self.sensor(info.name, level)
+        if f"{info.name}.one-minute-rate" not in self._metrics:
+            for label, secs in (("one-minute-rate", 60.0), ("five-minute-rate", 300.0),
+                                ("fifteen-minute-rate", 900.0)):
+                s.add_metric(MetricInfo(f"{info.name}.{label}", info.description),
+                             RateHistogram(secs), self)
+        return s
+
+    # -- export (Metrics.scala:220-281) --------------------------------------------------
+
+    def get_metrics(self) -> Dict[str, float]:
+        return {name: r.provider.get_value() for name, r in sorted(self._metrics.items())}
+
+    def metric_descriptions(self) -> Dict[str, str]:
+        return {name: r.info.description for name, r in sorted(self._metrics.items())}
+
+    def as_html(self) -> str:
+        rows = "".join(
+            f"<tr><td>{name}</td><td>{value:.4g}</td></tr>"
+            for name, value in self.get_metrics().items())
+        return f"<table><tr><th>metric</th><th>value</th></tr>{rows}</table>"
+
+
+# -- predeclared engine metrics (Metrics.scala:20-115 + PersistentActor MetricsQuiver) --
+
+
+@dataclass
+class EngineMetrics:
+    """The standard engine instrument set, created once per engine."""
+
+    registry: Metrics
+    state_fetch_timer: Timer = field(init=False)
+    command_handling_timer: Timer = field(init=False)
+    event_handling_timer: Timer = field(init=False)
+    serialization_timer: Timer = field(init=False)
+    deserialization_timer: Timer = field(init=False)
+    publish_timer: Timer = field(init=False)
+    flush_timer: Timer = field(init=False)
+    replay_timer: Timer = field(init=False)
+    command_rate: Sensor = field(init=False)
+    rejection_rate: Sensor = field(init=False)
+    error_rate: Sensor = field(init=False)
+    publish_failure_counter: Sensor = field(init=False)
+    fence_counter: Sensor = field(init=False)
+    replay_events_per_sec: Sensor = field(init=False)
+    live_entities: Sensor = field(init=False)
+
+    def __post_init__(self) -> None:
+        m, MI = self.registry, MetricInfo
+        self.state_fetch_timer = m.timer(MI(
+            "surge.aggregate.state-fetch-timer", "ms to fetch state from the store"))
+        self.command_handling_timer = m.timer(MI(
+            "surge.aggregate.command-handling-timer", "ms in process_command"))
+        self.event_handling_timer = m.timer(MI(
+            "surge.aggregate.event-handling-timer", "ms folding events"))
+        self.serialization_timer = m.timer(MI(
+            "surge.aggregate.state-serialization-timer", "ms serializing outputs"))
+        self.deserialization_timer = m.timer(MI(
+            "surge.aggregate.state-deserialization-timer", "ms deserializing snapshots"))
+        self.publish_timer = m.timer(MI(
+            "surge.aggregate.event-publish-timer", "ms from publish to commit ack"))
+        self.flush_timer = m.timer(MI(
+            "surge.producer.flush-timer", "ms per flush transaction"))
+        self.replay_timer = m.timer(MI(
+            "surge.replay.batch-timer", "ms per TPU replay fold"))
+        self.command_rate = m.rate(MI(
+            "surge.engine.command-rate", "commands processed"))
+        self.rejection_rate = m.rate(MI(
+            "surge.engine.rejection-rate", "commands rejected"))
+        self.error_rate = m.rate(MI(
+            "surge.engine.error-rate", "command failures"))
+        self.publish_failure_counter = m.counter(MI(
+            "surge.producer.publish-failures", "failed publish batches"))
+        self.fence_counter = m.counter(MI(
+            "surge.producer.fences", "producer fencing events"))
+        self.replay_events_per_sec = m.gauge(MI(
+            "surge.replay.events-per-sec", "latest replay throughput"))
+        self.live_entities = m.gauge(MI(
+            "surge.engine.live-entities", "currently resident aggregate entities"))
+
+
+def engine_metrics(registry: Optional[Metrics] = None) -> EngineMetrics:
+    return EngineMetrics(registry if registry is not None else Metrics())

@@ -1,0 +1,140 @@
+"""Metric value providers — the statistics behind every sensor.
+
+Equivalents of modules/metrics/src/main/scala/surge/metrics/statistics/*: Count, Min,
+Max, MostRecentValue, ExponentialWeightedMovingAverage (timers use EWMA(0.95),
+Metrics.scala:134-172), RateHistogram over 1/5/15-minute windows, and a fixed-bucket
+time histogram. Providers are updated by :class:`~surge_tpu.metrics.Sensor` and read by
+the registry snapshot."""
+
+from __future__ import annotations
+
+import bisect
+import time
+from collections import deque
+from typing import Deque, List, Protocol, Sequence, Tuple
+
+
+class MetricValueProvider(Protocol):
+    def update(self, value: float, timestamp: float) -> None: ...
+
+    def get_value(self) -> float: ...
+
+
+class Count:
+    """Running total of recorded values (statistics/Count.scala)."""
+
+    def __init__(self) -> None:
+        self._total = 0.0
+
+    def update(self, value: float, timestamp: float) -> None:
+        self._total += value
+
+    def get_value(self) -> float:
+        return self._total
+
+
+class MostRecentValue:
+    def __init__(self) -> None:
+        self._value = 0.0
+
+    def update(self, value: float, timestamp: float) -> None:
+        self._value = value
+
+    def get_value(self) -> float:
+        return self._value
+
+
+class Min:
+    def __init__(self) -> None:
+        self._value: float | None = None
+
+    def update(self, value: float, timestamp: float) -> None:
+        self._value = value if self._value is None else min(self._value, value)
+
+    def get_value(self) -> float:
+        return 0.0 if self._value is None else self._value
+
+
+class Max:
+    def __init__(self) -> None:
+        self._value: float | None = None
+
+    def update(self, value: float, timestamp: float) -> None:
+        self._value = value if self._value is None else max(self._value, value)
+
+    def get_value(self) -> float:
+        return 0.0 if self._value is None else self._value
+
+
+class ExponentialWeightedMovingAverage:
+    """EWMA with the reference's timer smoothing (alpha weight on history, 0.95
+    default — Metrics.scala:141-147)."""
+
+    def __init__(self, alpha: float = 0.95) -> None:
+        self.alpha = alpha
+        self._value = 0.0
+        self._initialized = False
+
+    def update(self, value: float, timestamp: float) -> None:
+        if not self._initialized:
+            self._value = value
+            self._initialized = True
+        else:
+            self._value = self.alpha * self._value + (1.0 - self.alpha) * value
+
+    def get_value(self) -> float:
+        return self._value
+
+
+class RateHistogram:
+    """Events/second over a sliding window (statistics/RateHistogram.scala; the
+    registry exposes 1/5/15-minute variants)."""
+
+    def __init__(self, window_s: float) -> None:
+        self.window_s = window_s
+        self._events: Deque[Tuple[float, float]] = deque()  # (timestamp, weight)
+        self._sum = 0.0
+
+    def update(self, value: float, timestamp: float) -> None:
+        self._events.append((timestamp, value))
+        self._sum += value
+        self._evict(timestamp)
+
+    def _evict(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._events and self._events[0][0] < cutoff:
+            _, w = self._events.popleft()
+            self._sum -= w
+
+    def get_value(self) -> float:
+        self._evict(time.time())
+        return self._sum / self.window_s
+
+
+class TimeBucketHistogram:
+    """Counts of recorded durations falling into fixed latency buckets
+    (statistics/TimeBucketHistogram.scala analog). ``get_value`` reports the p-th
+    percentile estimate (upper bucket bound)."""
+
+    def __init__(self, buckets_ms: Sequence[float] = (1, 5, 10, 25, 50, 100, 250, 500,
+                                                      1000, 2500, 5000, 10000),
+                 percentile: float = 0.99) -> None:
+        self.bounds: List[float] = sorted(buckets_ms)
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)
+        self.percentile = percentile
+        self._total = 0
+
+    def update(self, value: float, timestamp: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self._total += 1
+
+    def get_value(self) -> float:
+        if self._total == 0:
+            return 0.0
+        target = self.percentile * self._total
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self.bounds[i] if i < len(self.bounds) else float("inf")
+        return self.bounds[-1]

@@ -1,0 +1,165 @@
+"""Tracing: OTel-shaped spans + W3C trace-context propagation across async hops.
+
+Equivalents of the reference tracing stack (SURVEY.md §5.1): spans wrap every message
+hop (``ActorWithTracing`` wraps receive; spans created at the AggregateRef ask boundary
+AggregateRefTrait.scala:77-79, in the router/shard KafkaPartitionShardRouterActor.scala:216,
+and in the aggregate actor PersistentActor.scala:166-168); ``TracedMessage`` carries W3C
+``traceparent`` headers across hops (internal/tracing/TracedMessage.scala:10-26);
+inject/extract mirrors ``TracePropagation.asHeaders``/``childFrom``
+(TracePropagation.scala:13-61 — W3CTraceContextPropagator format:
+``00-{trace_id:32x}-{span_id:16x}-{flags:02x}``).
+
+No OpenTelemetry SDK dependency: :class:`Tracer` is the pluggable surface (users supply
+an exporter; the reference's noop-by-default ``openTelemetry`` override,
+SurgeGenericBusinessLogicTrait.scala:33), with :class:`InMemoryTracer` for tests and
+:class:`NoopTracer` as the default.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Mapping, Optional
+
+__all__ = [
+    "InMemoryTracer",
+    "NoopTracer",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "extract_context",
+    "inject_context",
+]
+
+_TRACEPARENT = "traceparent"
+_RE_TRACEPARENT = re.compile(
+    r"^(?P<version>[0-9a-f]{2})-(?P<trace>[0-9a-f]{32})-(?P<span>[0-9a-f]{16})-(?P<flags>[0-9a-f]{2})$")
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    trace_id: str  # 32 hex chars
+    span_id: str  # 16 hex chars
+    sampled: bool = True
+
+
+def _new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def _new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def inject_context(ctx: SpanContext, headers: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """TracePropagation.asHeaders: W3C traceparent into a header map."""
+    out = dict(headers or {})
+    out[_TRACEPARENT] = f"00-{ctx.trace_id}-{ctx.span_id}-{'01' if ctx.sampled else '00'}"
+    return out
+
+
+def extract_context(headers: Mapping[str, str]) -> Optional[SpanContext]:
+    """TracePropagation.childFrom: parse traceparent; None if absent/malformed."""
+    raw = headers.get(_TRACEPARENT, "")
+    m = _RE_TRACEPARENT.match(raw)
+    if not m:
+        return None
+    return SpanContext(trace_id=m.group("trace"), span_id=m.group("span"),
+                       sampled=m.group("flags") == "01")
+
+
+@dataclass
+class Span:
+    """One operation's span. ``finish`` hands it to the tracer's exporter."""
+
+    name: str
+    context: SpanContext
+    parent_id: Optional[str] = None
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+    attributes: Dict[str, object] = field(default_factory=dict)
+    events: List[tuple] = field(default_factory=list)
+    status: str = "ok"  # "ok" | "error"
+    _tracer: Optional["Tracer"] = field(default=None, repr=False)
+
+    def set_attribute(self, key: str, value: object) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def add_event(self, name: str, attributes: Optional[dict] = None) -> "Span":
+        """TracingHelper's log op."""
+        self.events.append((time.time(), name, attributes or {}))
+        return self
+
+    def record_exception(self, exc: BaseException) -> "Span":
+        """TracingHelper's error op."""
+        self.status = "error"
+        self.add_event("exception", {"type": type(exc).__name__, "message": str(exc)})
+        return self
+
+    def finish(self) -> None:
+        if self.end_time is None:
+            self.end_time = time.time()
+            if self._tracer is not None:
+                self._tracer._on_finished(self)
+
+    @property
+    def duration_ms(self) -> float:
+        return ((self.end_time or time.time()) - self.start_time) * 1000.0
+
+    # context-manager sugar
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.record_exception(exc)
+        self.finish()
+
+
+class Tracer:
+    """Span factory with an exporter hook."""
+
+    def __init__(self, service: str = "surge",
+                 exporter: Optional[Callable[[Span], None]] = None) -> None:
+        self.service = service
+        self._exporter = exporter
+
+    def start_span(self, name: str,
+                   parent: Optional[SpanContext | Span] = None,
+                   headers: Optional[Mapping[str, str]] = None) -> Span:
+        """Child of ``parent`` (or of the context in ``headers``), else a new root."""
+        parent_ctx = parent.context if isinstance(parent, Span) else parent
+        if parent_ctx is None and headers is not None:
+            parent_ctx = extract_context(headers)
+        if parent_ctx is not None:
+            ctx = SpanContext(trace_id=parent_ctx.trace_id, span_id=_new_span_id(),
+                              sampled=parent_ctx.sampled)
+            return Span(name=name, context=ctx, parent_id=parent_ctx.span_id,
+                        _tracer=self)
+        ctx = SpanContext(trace_id=_new_trace_id(), span_id=_new_span_id())
+        return Span(name=name, context=ctx, _tracer=self)
+
+    def _on_finished(self, span: Span) -> None:
+        if self._exporter is not None:
+            self._exporter(span)
+
+
+class NoopTracer(Tracer):
+    """Default: spans are created but never exported (noop OpenTelemetry default)."""
+
+    def __init__(self) -> None:
+        super().__init__(exporter=None)
+
+
+class InMemoryTracer(Tracer):
+    """Collects finished spans for assertions (test exporter)."""
+
+    def __init__(self, service: str = "surge") -> None:
+        self.finished: List[Span] = []
+        super().__init__(service=service, exporter=self.finished.append)
+
+    def spans_named(self, name: str) -> List[Span]:
+        return [s for s in self.finished if s.name == name]

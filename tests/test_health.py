@@ -1,0 +1,166 @@
+"""Health bus, matchers, windows, supervisor — and the pipeline restart-on-signal test
+(the SurgeMessagePipelineSpec:150-253 analog: inject a fatal signal, observe the
+registered component restart through its Controllable)."""
+
+import asyncio
+import time
+
+from surge_tpu.common import Ack, Controllable
+from surge_tpu.config import default_config
+from surge_tpu.health import (
+    HealthSignal,
+    HealthSignalBus,
+    HealthSupervisor,
+    NameEqualsMatcher,
+    RegexMatcher,
+    RepeatingSignalMatcher,
+    SlidingSignalWindow,
+)
+
+
+def test_bus_ring_buffer_and_subscribers():
+    bus = HealthSignalBus(buffer_size=3)
+    seen = []
+    bus.subscribe(seen.append)
+    for i in range(5):
+        bus.emit(f"s{i}", "warning", source="t")
+    assert [s.name for s in bus.recent()] == ["s2", "s3", "s4"]  # bounded
+    assert len(seen) == 5
+    fn = bus.signal_fn("component")
+    fn("component.err", "error")
+    assert seen[-1].name == "component.err" and seen[-1].source == "component"
+
+
+def test_matchers():
+    w = SlidingSignalWindow(10.0)
+    sig = HealthSignal("kafka.fatal.error", "error")
+    assert NameEqualsMatcher("kafka.fatal.error").matches(sig, w)
+    assert not NameEqualsMatcher("other").matches(sig, w)
+    assert RegexMatcher(r"fatal").matches(sig, w)
+    assert not RegexMatcher(r"^other").matches(sig, w)
+
+
+def test_repeating_matcher_requires_window_count():
+    w = SlidingSignalWindow(10.0)
+    m = RepeatingSignalMatcher(3, NameEqualsMatcher("x"))
+    for i in range(3):
+        sig = HealthSignal("x")
+        w.add(sig)
+        matched = m.matches(sig, w)
+    assert matched  # third occurrence within the window fires
+    # old signals expire out of the window
+    w2 = SlidingSignalWindow(0.001)
+    w2.add(HealthSignal("x", timestamp=time.time() - 1))
+    sig = HealthSignal("x")
+    w2.add(sig)
+    assert not m.matches(sig, w2)
+
+
+def test_window_slider_threshold():
+    w = SlidingSignalWindow(1000.0, advance_threshold=2)
+    for i in range(5):
+        w.add(HealthSignal(f"s{i}"))
+    assert len(w) == 2  # buffer advance on threshold
+
+
+class Restartable(Controllable):
+    def __init__(self):
+        self.starts = 0
+        self.stops = 0
+        self.shutdowns = 0
+
+    async def start(self) -> Ack:
+        self.starts += 1
+        return Ack()
+
+    async def stop(self) -> Ack:
+        self.stops += 1
+        return Ack()
+
+    async def shutdown(self) -> Ack:
+        self.shutdowns += 1
+        return Ack()
+
+
+def test_supervisor_restarts_on_pattern_then_escalates():
+    async def scenario():
+        bus = HealthSignalBus()
+        sup = HealthSupervisor(bus, default_config().with_overrides(
+            {"surge.health.supervisor-restart-max": 2}))
+        comp = Restartable()
+        sup.register("comp", comp, restart_patterns=[RegexMatcher("fatal")])
+        sup.start()
+
+        bus.emit("kafka.fatal.error", "error")
+        await asyncio.sleep(0.01)
+        assert comp.starts == 1 and comp.stops == 1  # restarted via Controllable
+        assert any(s.name == "health.component-restarted" for s in bus.recent())
+
+        bus.emit("kafka.fatal.error", "error")
+        await asyncio.sleep(0.01)
+        assert comp.starts == 2
+
+        # budget exhausted -> escalate to shutdown
+        bus.emit("kafka.fatal.error", "error")
+        await asyncio.sleep(0.01)
+        assert comp.starts == 2 and comp.shutdowns == 1
+        sup.stop()
+
+    asyncio.run(scenario())
+
+
+def test_supervisor_shutdown_pattern():
+    async def scenario():
+        bus = HealthSignalBus()
+        sup = HealthSupervisor(bus)
+        comp = Restartable()
+        sup.register("comp", comp, restart_patterns=[],
+                     shutdown_patterns=[NameEqualsMatcher("die")])
+        sup.start()
+        bus.emit("die", "error")
+        await asyncio.sleep(0.01)
+        assert comp.shutdowns == 1 and comp.starts == 0
+        sup.stop()
+
+    asyncio.run(scenario())
+
+
+def test_pipeline_restarts_state_store_on_fatal_signal():
+    """Engine-level: a fatal state-store signal triggers a supervised restart and the
+    engine keeps serving commands afterwards."""
+    from surge_tpu import SurgeCommandBusinessLogic, CommandSuccess, create_engine, default_config
+    from surge_tpu.models import counter
+
+    cfg = default_config().with_overrides({
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 20,
+        "surge.engine.num-partitions": 2,
+    })
+
+    async def scenario():
+        engine = create_engine(SurgeCommandBusinessLogic(
+            aggregate_name="counter", model=counter.CounterModel(),
+            state_format=counter.state_formatting(),
+            event_format=counter.event_formatting()), config=cfg)
+        await engine.start()
+        r = await engine.aggregate_for("a").send_command(counter.Increment("a"))
+        assert isinstance(r, CommandSuccess)
+
+        engine.health_bus.emit("state-store.fatal.error", "error", source="test")
+        await asyncio.sleep(0.05)
+        assert any(s.name == "health.component-restarted" and s.source == "state-store"
+                   for s in engine.health_bus.recent())
+        assert engine.indexer.running  # restarted, not dead
+        assert engine.health_check().is_healthy()
+
+        r = await engine.aggregate_for("b").send_command(counter.Increment("b"))
+        assert isinstance(r, CommandSuccess)
+
+        # metrics were recorded along the command path
+        snap = engine.metrics_registry.get_metrics()
+        assert snap["surge.engine.command-rate.one-minute-rate"] > 0
+        assert snap["surge.aggregate.event-publish-timer"] > 0
+        await engine.stop()
+
+    asyncio.run(scenario())

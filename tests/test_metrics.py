@@ -1,0 +1,102 @@
+"""Metrics registry + statistics providers (metrics/statistics *Spec analogs)."""
+
+import time
+
+from surge_tpu.metrics import MetricInfo, Metrics, RecordingLevel, engine_metrics
+from surge_tpu.metrics.statistics import (
+    Count,
+    ExponentialWeightedMovingAverage,
+    Max,
+    Min,
+    MostRecentValue,
+    RateHistogram,
+    TimeBucketHistogram,
+)
+
+
+def test_basic_providers():
+    now = time.time()
+    c, mr, mn, mx = Count(), MostRecentValue(), Min(), Max()
+    for v in (5.0, 1.0, 3.0):
+        for p in (c, mr, mn, mx):
+            p.update(v, now)
+    assert c.get_value() == 9.0
+    assert mr.get_value() == 3.0
+    assert mn.get_value() == 1.0
+    assert mx.get_value() == 5.0
+    assert Min().get_value() == 0.0  # empty
+
+
+def test_ewma_smoothing():
+    e = ExponentialWeightedMovingAverage(alpha=0.5)
+    e.update(100.0, 0)
+    assert e.get_value() == 100.0  # first value initializes
+    e.update(0.0, 0)
+    assert e.get_value() == 50.0
+    e.update(0.0, 0)
+    assert e.get_value() == 25.0
+
+
+def test_rate_histogram_window_eviction():
+    r = RateHistogram(window_s=60.0)
+    now = time.time()
+    for i in range(120):
+        r.update(1.0, now - 90 + i)  # half the marks are older than the window
+    assert abs(r.get_value() - 60 / 60.0) < 0.2
+
+
+def test_time_bucket_histogram_percentile():
+    h = TimeBucketHistogram(buckets_ms=(10, 100, 1000), percentile=0.99)
+    assert h.get_value() == 0.0
+    for _ in range(99):
+        h.update(5.0, 0)
+    h.update(500.0, 0)
+    assert h.get_value() == 10  # 99% of samples sit in the 10ms bucket
+    for _ in range(10):
+        h.update(500.0, 0)  # fatten the tail past 1%
+    assert h.get_value() == 1000  # p99 now lands in the 1000ms bucket bound
+
+
+def test_registry_instruments_and_export():
+    m = Metrics()
+    m.counter(MetricInfo("c", "a counter")).record(2)
+    m.counter(MetricInfo("c")).record(3)
+    m.gauge(MetricInfo("g")).record(7)
+    t = m.timer(MetricInfo("t"))
+    t.record_ms(10.0)
+    with t.time():
+        pass
+    m.rate(MetricInfo("r")).record()
+
+    snap = m.get_metrics()
+    assert snap["c"] == 5.0
+    assert snap["g"] == 7.0
+    assert snap["t.max"] >= snap["t.min"] >= 0.0
+    assert snap["r.one-minute-rate"] > 0
+    assert m.metric_descriptions()["c"] == "a counter"
+    assert "<table>" in m.as_html() and "<td>c</td>" in m.as_html()
+
+
+def test_recording_level_filters():
+    m = Metrics(recording_level=RecordingLevel.INFO)
+    debug = m.counter(MetricInfo("d"), level=RecordingLevel.DEBUG)
+    debug.record(5)
+    assert m.get_metrics()["d"] == 0.0  # DEBUG sensor disabled at INFO level
+
+    m2 = Metrics(recording_level=RecordingLevel.TRACE)
+    m2.counter(MetricInfo("d"), level=RecordingLevel.DEBUG).record(5)
+    assert m2.get_metrics()["d"] == 5.0
+
+
+def test_engine_metrics_quiver_names():
+    em = engine_metrics()
+    snap = em.registry.get_metrics()
+    for name in ("surge.aggregate.state-fetch-timer",
+                 "surge.aggregate.command-handling-timer",
+                 "surge.aggregate.event-publish-timer",
+                 "surge.producer.flush-timer",
+                 "surge.replay.batch-timer",
+                 "surge.engine.command-rate.one-minute-rate",
+                 "surge.producer.fences",
+                 "surge.engine.live-entities"):
+        assert name in snap, name

@@ -361,3 +361,36 @@ def test_cancelled_publish_withdrawn_no_double_commit():
         await indexer.stop()
 
     asyncio.run(scenario())
+
+
+def test_retry_joins_in_flight_commit_instead_of_requeueing():
+    """A retry arriving while its batch is mid-commit must join the commit outcome,
+    not enqueue a second copy (review r2: double-commit via slow transaction)."""
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log)
+        outcome = asyncio.get_running_loop().create_future()
+        pub._committing["req-1"] = outcome  # simulate: batch with req-1 committing now
+
+        join = asyncio.ensure_future(
+            pub.publish("a", [event_rec("a", b"dup")], "req-1"))
+        await asyncio.sleep(0.02)
+        assert not join.done()          # waiting on the in-flight commit
+        assert pub._pending == []       # nothing re-queued
+        outcome.set_result(None)        # the original commit lands
+        await join                      # retry resolves successfully
+        assert log.end_offset("events", 0) == 0  # and wrote nothing new
+
+        # failure outcome propagates to the joiner as PublishFailedError
+        outcome2 = asyncio.get_running_loop().create_future()
+        pub._committing["req-2"] = outcome2
+        join2 = asyncio.ensure_future(
+            pub.publish("b", [event_rec("b", b"x")], "req-2"))
+        await asyncio.sleep(0)
+        outcome2.set_result(RuntimeError("commit failed"))
+        with pytest.raises(PublishFailedError):
+            await join2
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())

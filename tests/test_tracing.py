@@ -1,0 +1,100 @@
+"""Tracing: span lifecycle, W3C propagation, and end-to-end trace continuity through
+ref → entity (TracePropagationSpec / ActorWithTracing analogs)."""
+
+import asyncio
+
+from surge_tpu.tracing import (
+    InMemoryTracer,
+    NoopTracer,
+    SpanContext,
+    extract_context,
+    inject_context,
+)
+
+
+def test_inject_extract_roundtrip():
+    ctx = SpanContext(trace_id="a" * 32, span_id="b" * 16)
+    headers = inject_context(ctx, {"other": "x"})
+    assert headers["other"] == "x"
+    assert headers["traceparent"] == f"00-{'a'*32}-{'b'*16}-01"
+    back = extract_context(headers)
+    assert back == ctx
+
+
+def test_extract_rejects_malformed():
+    assert extract_context({}) is None
+    assert extract_context({"traceparent": "junk"}) is None
+    assert extract_context({"traceparent": "00-short-id-01"}) is None
+
+
+def test_child_span_inherits_trace():
+    tracer = InMemoryTracer()
+    root = tracer.start_span("root")
+    headers = inject_context(root.context)
+    child = tracer.start_span("child", headers=headers)
+    assert child.context.trace_id == root.context.trace_id
+    assert child.parent_id == root.context.span_id
+    assert child.context.span_id != root.context.span_id
+    child.finish()
+    root.finish()
+    assert [s.name for s in tracer.finished] == ["child", "root"]
+
+
+def test_span_events_errors_and_context_manager():
+    tracer = InMemoryTracer()
+    with tracer.start_span("op") as span:
+        span.set_attribute("k", 1)
+        span.add_event("checkpoint")
+    assert tracer.finished[0].attributes["k"] == 1
+    assert tracer.finished[0].status == "ok"
+
+    try:
+        with tracer.start_span("bad"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    bad = tracer.spans_named("bad")[0]
+    assert bad.status == "error"
+    assert bad.events[0][1] == "exception"
+
+
+def test_noop_tracer_collects_nothing():
+    t = NoopTracer()
+    with t.start_span("x"):
+        pass  # no exporter, no error
+
+
+def test_engine_trace_continuity_ref_to_entity():
+    """The ask span and the entity receive span share one trace id."""
+    from surge_tpu import SurgeCommandBusinessLogic, CommandSuccess, create_engine, default_config
+    from surge_tpu.models import counter
+
+    cfg = default_config().with_overrides({
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.engine.num-partitions": 2,
+    })
+    tracer = InMemoryTracer()
+
+    async def scenario():
+        engine = create_engine(SurgeCommandBusinessLogic(
+            aggregate_name="counter", model=counter.CounterModel(),
+            state_format=counter.state_formatting(),
+            event_format=counter.event_formatting()), config=cfg, tracer=tracer)
+        await engine.start()
+        r = await engine.aggregate_for("agg1").send_command(counter.Increment("agg1"))
+        assert isinstance(r, CommandSuccess)
+        rej = await engine.aggregate_for("agg1").send_command(
+            counter.FailCommandProcessing("agg1", "no"))
+        await engine.stop()
+
+    asyncio.run(scenario())
+
+    asks = tracer.spans_named("aggregate-ref.ProcessMessage")
+    receives = tracer.spans_named("entity.ProcessMessage")
+    assert len(asks) == 2 and len(receives) == 2
+    # continuity: entity span is a child in the same trace
+    assert receives[0].context.trace_id == asks[0].context.trace_id
+    assert receives[0].parent_id == asks[0].context.span_id
+    assert receives[0].attributes["aggregate_id"] == "agg1"
+    assert receives[0].status == "ok"
