@@ -1,0 +1,15 @@
+#!/bin/sh
+# Build the native libraries into csrc/build/ (picked up by surge_tpu.store.native and
+# surge_tpu.log.segment via ctypes). Requires only g++; no external dependencies.
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+# Link to a temp name then atomically rename, so a process that has the current .so
+# dlopen'd never sees a truncated file.
+g++ -O2 -std=c++17 -shared -fPIC -Wall -o build/.libsurge_store.so.tmp store.cc
+mv build/.libsurge_store.so.tmp build/libsurge_store.so
+if [ -f segment.cc ]; then
+  g++ -O2 -std=c++17 -shared -fPIC -Wall -o build/.libsurge_segment.so.tmp segment.cc
+  mv build/.libsurge_segment.so.tmp build/libsurge_segment.so
+fi
+echo "built: $(ls build)"

@@ -1,0 +1,209 @@
+// Open-addressing hash KV store — the native state-store backend.
+//
+// Replaces the reference's RocksDB JNI dependency (SurgeKafkaStreamsPersistencePlugin
+// .scala:17-22) for the materialized-state read path: the engine's steady-state access
+// pattern is point get/put by aggregate id (KafkaStreamManagerActor.scala:89-91), which
+// an in-process open-addressing table serves with no JNI/FFI marshalling beyond ctypes.
+//
+// Layout: one flat slot array (linear probing, power-of-two capacity, tombstones),
+// keys+values owned by the slots as length-prefixed byte strings. Load factor <= 0.7;
+// tombstone compaction happens on grow. Not thread-safe by design: the engine drives
+// each store from a single asyncio loop (single-writer, like the Kafka Streams task
+// thread owning a RocksDB shard).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::string key;
+  std::string value;
+  uint64_t hash = 0;
+  enum State : uint8_t { kEmpty = 0, kUsed = 1, kTombstone = 2 } state = kEmpty;
+};
+
+uint64_t fnv1a(const char* data, size_t n) {
+  uint64_t h = 1469598103934665603ull;
+  for (size_t i = 0; i < n; ++i) {
+    h ^= static_cast<unsigned char>(data[i]);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+class Store {
+ public:
+  Store() : slots_(kInitialCapacity) {}
+
+  void Put(const char* key, size_t klen, const char* val, size_t vlen) {
+    MaybeGrow();
+    const uint64_t h = fnv1a(key, klen);
+    Slot* slot = FindForInsert(key, klen, h);
+    if (slot->state != Slot::kUsed) {
+      if (slot->state == Slot::kTombstone) --tombstones_;
+      slot->key.assign(key, klen);
+      slot->hash = h;
+      slot->state = Slot::kUsed;
+      ++size_;
+    }
+    slot->value.assign(val, vlen);
+  }
+
+  const std::string* Get(const char* key, size_t klen) const {
+    const Slot* slot = Find(key, klen);
+    return slot ? &slot->value : nullptr;
+  }
+
+  void Delete(const char* key, size_t klen) {
+    Slot* slot = const_cast<Slot*>(Find(key, klen));
+    if (slot == nullptr) return;
+    slot->key.clear();
+    slot->value.clear();
+    slot->state = Slot::kTombstone;
+    --size_;
+    ++tombstones_;
+  }
+
+  size_t Size() const { return size_; }
+
+  void Clear() {
+    slots_.assign(kInitialCapacity, Slot());
+    size_ = 0;
+    tombstones_ = 0;
+  }
+
+  const std::vector<Slot>& slots() const { return slots_; }
+
+ private:
+  static constexpr size_t kInitialCapacity = 1024;  // power of two
+
+  const Slot* Find(const char* key, size_t klen) const {
+    const uint64_t h = fnv1a(key, klen);
+    const size_t mask = slots_.size() - 1;
+    for (size_t i = h & mask, probes = 0; probes < slots_.size();
+         i = (i + 1) & mask, ++probes) {
+      const Slot& s = slots_[i];
+      if (s.state == Slot::kEmpty) return nullptr;
+      if (s.state == Slot::kUsed && s.hash == h && s.key.size() == klen &&
+          std::memcmp(s.key.data(), key, klen) == 0) {
+        return &s;
+      }
+    }
+    return nullptr;
+  }
+
+  Slot* FindForInsert(const char* key, size_t klen, uint64_t h) {
+    const size_t mask = slots_.size() - 1;
+    Slot* first_tombstone = nullptr;
+    for (size_t i = h & mask;; i = (i + 1) & mask) {
+      Slot& s = slots_[i];
+      if (s.state == Slot::kEmpty) return first_tombstone ? first_tombstone : &s;
+      if (s.state == Slot::kTombstone) {
+        if (first_tombstone == nullptr) first_tombstone = &s;
+      } else if (s.hash == h && s.key.size() == klen &&
+                 std::memcmp(s.key.data(), key, klen) == 0) {
+        return &s;
+      }
+    }
+  }
+
+  void MaybeGrow() {
+    if ((size_ + tombstones_ + 1) * 10 < slots_.size() * 7) return;
+    // Tombstone-dominated tables rehash in place; capacity doubles only when the
+    // live load is genuinely high, so churn on a bounded working set stays bounded.
+    const size_t new_cap =
+        (size_ * 10 >= slots_.size() * 4) ? slots_.size() * 2 : slots_.size();
+    std::vector<Slot> old;
+    old.swap(slots_);
+    slots_.assign(new_cap, Slot());
+    size_ = 0;
+    tombstones_ = 0;
+    for (Slot& s : old) {
+      if (s.state == Slot::kUsed) {
+        MoveIn(std::move(s));
+      }
+    }
+  }
+
+  void MoveIn(Slot&& s) {
+    const size_t mask = slots_.size() - 1;
+    for (size_t i = s.hash & mask;; i = (i + 1) & mask) {
+      if (slots_[i].state != Slot::kUsed) {
+        slots_[i] = std::move(s);
+        ++size_;
+        return;
+      }
+    }
+  }
+
+  std::vector<Slot> slots_;
+  size_t size_ = 0;
+  size_t tombstones_ = 0;
+};
+
+struct Iter {
+  const Store* store;
+  size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* surge_store_new() { return new Store(); }
+
+void surge_store_free(void* h) { delete static_cast<Store*>(h); }
+
+void surge_store_put(void* h, const char* key, size_t klen, const char* val,
+                     size_t vlen) {
+  static_cast<Store*>(h)->Put(key, klen, val, vlen);
+}
+
+// Returned pointer is valid until the next mutating call (the Python side copies
+// immediately via ctypes.string_at).
+const char* surge_store_get(void* h, const char* key, size_t klen, size_t* out_len) {
+  const std::string* v = static_cast<Store*>(h)->Get(key, klen);
+  if (v == nullptr) {
+    *out_len = 0;
+    return nullptr;
+  }
+  *out_len = v->size();
+  return v->data();
+}
+
+void surge_store_delete(void* h, const char* key, size_t klen) {
+  static_cast<Store*>(h)->Delete(key, klen);
+}
+
+size_t surge_store_size(void* h) { return static_cast<Store*>(h)->Size(); }
+
+void surge_store_clear(void* h) { static_cast<Store*>(h)->Clear(); }
+
+void* surge_store_iter_new(void* h) {
+  return new Iter{static_cast<Store*>(h), 0};
+}
+
+int surge_store_iter_next(void* it_h, const char** key, size_t* klen,
+                          const char** val, size_t* vlen) {
+  Iter* it = static_cast<Iter*>(it_h);
+  const auto& slots = it->store->slots();
+  while (it->pos < slots.size()) {
+    const Slot& s = slots[it->pos++];
+    if (s.state == Slot::kUsed) {
+      *key = s.key.data();
+      *klen = s.key.size();
+      *val = s.value.data();
+      *vlen = s.value.size();
+      return 1;
+    }
+  }
+  return 0;
+}
+
+void surge_store_iter_free(void* it_h) { delete static_cast<Iter*>(it_h); }
+
+}  // extern "C"
