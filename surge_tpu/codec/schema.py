@@ -27,26 +27,45 @@ _DTYPE_FOR_ANNOTATION = {
 
 @dataclass(frozen=True)
 class FieldSpec:
-    """A single numeric field of an event/state schema."""
+    """A single numeric field of an event/state schema.
+
+    ``bits`` (optional) declares the field's wire width for the bit-packed transfer
+    format (surge_tpu.codec.wire): an unsigned value in ``[0, 2**bits)``. Fields
+    without ``bits`` ride the wire as full-width side columns. Only unsigned integer
+    ranges can be packed; host→device transfer is the replay bottleneck
+    (SURVEY.md §7 hard-part 2), so narrow event payloads should declare it.
+    """
 
     name: str
     dtype: np.dtype
+    bits: int | None = None
 
     def __post_init__(self) -> None:
         object.__setattr__(self, "dtype", np.dtype(self.dtype))
+        if self.bits is not None:
+            if self.dtype.kind not in "iub":
+                raise TypeError(f"field {self.name}: bits= requires an integer/bool "
+                                f"dtype, got {self.dtype}")
+            if not 0 < self.bits <= 30:
+                raise ValueError(f"field {self.name}: bits must be in [1, 30]")
 
 
 def event_fields_from_dataclass(cls: type, overrides: Mapping[str, Any] | None = None,
-                                exclude: Iterable[str] = ()) -> tuple[FieldSpec, ...]:
-    """Derive FieldSpecs from a dataclass's annotations (int→i32, float→f32, bool→bool)."""
+                                exclude: Iterable[str] = (),
+                                bits: Mapping[str, int] | None = None) -> tuple[FieldSpec, ...]:
+    """Derive FieldSpecs from a dataclass's annotations (int→i32, float→f32, bool→bool).
+
+    ``bits`` maps field names to wire bit widths (see :class:`FieldSpec`)."""
     overrides = dict(overrides or {})
+    bits = dict(bits or {})
     excluded = set(exclude)
     specs = []
     for f in dataclasses.fields(cls):
         if f.name in excluded:
             continue
         if f.name in overrides:
-            specs.append(FieldSpec(f.name, np.dtype(overrides[f.name])))
+            specs.append(FieldSpec(f.name, np.dtype(overrides[f.name]),
+                                   bits=bits.get(f.name)))
             continue
         dt = _DTYPE_FOR_ANNOTATION.get(f.type if isinstance(f.type, type) else None)
         if dt is None:
@@ -57,7 +76,7 @@ def event_fields_from_dataclass(cls: type, overrides: Mapping[str, Any] | None =
             raise TypeError(
                 f"{cls.__name__}.{f.name}: unsupported tensor field type {f.type!r}; "
                 f"exclude it or dictionary-encode it (Vocab) first")
-        specs.append(FieldSpec(f.name, dt))
+        specs.append(FieldSpec(f.name, dt, bits=bits.get(f.name)))
     return tuple(specs)
 
 
@@ -129,14 +148,15 @@ class SchemaRegistry:
     def register_event(self, cls: type, *, type_id: int | None = None,
                        fields: Sequence[FieldSpec] | None = None,
                        overrides: Mapping[str, Any] | None = None,
-                       exclude: Iterable[str] = ()) -> EventSchema:
+                       exclude: Iterable[str] = (),
+                       bits: Mapping[str, int] | None = None) -> EventSchema:
         if cls in self._by_cls:
             raise ValueError(f"event type {cls.__name__} already registered")
         tid = type_id if type_id is not None else len(self._by_id)
         if tid in self._by_id:
             raise ValueError(f"type_id {tid} already taken by {self._by_id[tid].cls.__name__}")
         fs = tuple(fields) if fields is not None else event_fields_from_dataclass(
-            cls, overrides=overrides, exclude=exclude)
+            cls, overrides=overrides, exclude=exclude, bits=bits)
         schema = EventSchema(cls=cls, type_id=tid, fields=fs)
         self._by_cls[cls] = schema
         self._by_id[tid] = schema
@@ -178,15 +198,26 @@ class SchemaRegistry:
         return (max(self._by_id) + 1) if self._by_id else 0
 
     def union_columns(self) -> tuple[FieldSpec, ...]:
-        """The union layout: one column per distinct field name, dtype-promoted."""
+        """The union layout: one column per distinct field name, dtype-promoted.
+
+        ``bits`` merges to the max declared width, but only when *every* event type
+        carrying the field declares one — a single undeclared use forces the column
+        to full width (packing a value that overflows its bits would corrupt
+        neighbours)."""
         merged: dict[str, np.dtype] = {}
+        merged_bits: dict[str, int | None] = {}
         for schema in self.event_schemas:
             for f in schema.fields:
                 if f.name in merged:
                     merged[f.name] = np.promote_types(merged[f.name], f.dtype)
+                    old = merged_bits[f.name]
+                    merged_bits[f.name] = (max(old, f.bits)
+                                           if (old is not None and f.bits is not None)
+                                           else None)
                 else:
                     merged[f.name] = f.dtype
-        return tuple(FieldSpec(n, merged[n]) for n in sorted(merged))
+                    merged_bits[f.name] = f.bits
+        return tuple(FieldSpec(n, merged[n], bits=merged_bits[n]) for n in sorted(merged))
 
 
 class Vocab:

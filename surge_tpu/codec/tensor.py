@@ -30,6 +30,10 @@ class EncodedEvents:
     type_ids: np.ndarray  # [B, T] int32
     cols: dict[str, np.ndarray]  # each [B, T]
     lengths: np.ndarray  # [B] int32
+    # union columns the producer declares derivable on device instead of stored/
+    # transferred ({name: surge_tpu.codec.wire.DERIVE_*}); e.g. positional sequence
+    # numbers ({"sequence_number": "ordinal"})
+    derived_cols: dict[str, str] = field(default_factory=dict)
 
     @property
     def batch_size(self) -> int:
@@ -64,6 +68,8 @@ class ColumnarEvents:
     agg_idx: np.ndarray
     type_ids: np.ndarray
     cols: dict[str, np.ndarray]
+    # columns the device derives instead of reading (see EncodedEvents.derived_cols)
+    derived_cols: dict[str, str] = field(default_factory=dict)
 
     @property
     def num_events(self) -> int:
@@ -82,7 +88,8 @@ class ColumnarEvents:
         return ColumnarEvents(
             num_aggregates=self.num_aggregates, agg_idx=self.agg_idx[order],
             type_ids=self.type_ids[order],
-            cols={k: v[order] for k, v in self.cols.items()})
+            cols={k: v[order] for k, v in self.cols.items()},
+            derived_cols=dict(self.derived_cols))
 
     def slice_aggregates(self, start: int, stop: int) -> "ColumnarEvents":
         """Sub-log for aggregates [start, stop). Requires aggregate-sorted order
@@ -92,7 +99,8 @@ class ColumnarEvents:
             num_aggregates=stop - start,
             agg_idx=self.agg_idx[lo:hi] - np.int32(start),
             type_ids=self.type_ids[lo:hi],
-            cols={k: v[lo:hi] for k, v in self.cols.items()})
+            cols={k: v[lo:hi] for k, v in self.cols.items()},
+            derived_cols=dict(self.derived_cols))
 
 
 def columnar_to_batch(colev: ColumnarEvents, pad_to: int | None = None) -> EncodedEvents:
@@ -121,7 +129,8 @@ def columnar_to_batch(colev: ColumnarEvents, pad_to: int | None = None) -> Encod
         buf = np.zeros((b, t), dtype=col.dtype)
         buf[sorted_agg, slot] = col
         cols[name] = buf
-    return EncodedEvents(type_ids=type_ids, cols=cols, lengths=lengths)
+    return EncodedEvents(type_ids=type_ids, cols=cols, lengths=lengths,
+                         derived_cols=dict(colev.derived_cols))
 
 
 def encode_events_columnar(registry: SchemaRegistry,

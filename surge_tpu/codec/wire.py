@@ -1,0 +1,166 @@
+"""Bit-packed host→device wire format for event windows.
+
+Host→device transfer is the replay engine's bottleneck (SURVEY.md §7 hard-part 2: a
+100M-event log at 4 int32 columns is 1.6 GB on the wire; the fold itself is a few int
+ops per event). This module shrinks the wire to the information actually present:
+
+- The **type discriminant** and every union column with a declared ``FieldSpec.bits``
+  width are packed into one little-endian word of ``ceil(total_bits/8)`` bytes per
+  event (``packed``: uint8 ``[T, B, nbytes]``). The Counter fixture's events — type
+  (3 bits incl. padding sentinel) + increment_by (4) + decrement_by (4) — fit in
+  **two bytes per event**, 8× less wire than the naive int32 columns.
+- Columns without ``bits`` ride as full-width **side** arrays ``[T, B]`` (floats,
+  wide ints).
+- **Derived columns** never cross the wire at all: a data producer that knows a column
+  is positional (``derived_cols={"sequence_number": "ordinal"}`` on
+  ``ColumnarEvents``/``EncodedEvents``) lets the device recompute it as
+  ``base + time_index + 1``. Event-sourced sequence numbers are ordinal by
+  construction in the steady-state log (seq == offset within the aggregate's
+  stream), so bulk replay of framework-written logs always qualifies; object-encoded
+  test logs keep the explicit column.
+
+Packing is pure vectorized NumPy; unpacking is jitted JAX that the fold program fuses
+with the scan, so decode costs no extra HBM round trip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+import numpy as np
+
+from surge_tpu.codec.schema import FieldSpec, SchemaRegistry
+
+#: derivation kinds a producer may declare for a column
+DERIVE_ORDINAL = "ordinal"
+
+_MAX_PACKED_BITS = 32  # one uint32 word per event; wider layouts spill to side columns
+
+
+@dataclass(frozen=True)
+class _PackedField:
+    name: str
+    dtype: np.dtype
+    bits: int
+    shift: int
+
+    @property
+    def mask(self) -> int:
+        return (1 << self.bits) - 1
+
+
+class WireFormat:
+    """Pack/unpack schedule for one (registry, derived-columns) pair."""
+
+    def __init__(self, registry: SchemaRegistry,
+                 derived: Mapping[str, str] | None = None) -> None:
+        self.registry = registry
+        self.derived = dict(derived or {})
+        for name, kind in self.derived.items():
+            if kind != DERIVE_ORDINAL:
+                raise ValueError(f"unknown derivation {kind!r} for column {name!r}")
+
+        num_types = registry.num_event_types
+        self.num_types = num_types
+        self.type_bits = max(int(num_types).bit_length(), 1)  # +1 value: pad sentinel
+        self.pad_code = num_types
+
+        shift = self.type_bits
+        packed: list[_PackedField] = []
+        side: list[FieldSpec] = []
+        self.derived_fields: list[FieldSpec] = []
+        for f in registry.union_columns():
+            if f.name in self.derived:
+                self.derived_fields.append(f)
+            elif f.bits is not None and shift + f.bits <= _MAX_PACKED_BITS:
+                packed.append(_PackedField(f.name, f.dtype, f.bits, shift))
+                shift += f.bits
+            else:
+                side.append(f)
+        self.packed_fields = tuple(packed)
+        self.side_fields = tuple(side)
+        self.total_bits = shift
+        self.nbytes = (shift + 7) // 8
+        # the byte pattern a padding slot must decode to: pad_code in the type bits,
+        # zeros elsewhere
+        self.pad_bytes = tuple((self.pad_code >> (8 * k)) & 0xFF
+                               for k in range(self.nbytes))
+
+    def wire_bytes_per_event(self) -> int:
+        """Transfer cost per event slot (packed word + side columns)."""
+        return self.nbytes + sum(f.dtype.itemsize for f in self.side_fields)
+
+    # -- host side ----------------------------------------------------------------------
+
+    def pack_window(self, type_ids: np.ndarray, cols: Mapping[str, np.ndarray],
+                    start: int, stop: int, chunk: int, bs: int
+                    ) -> tuple[np.ndarray, dict[str, np.ndarray]]:
+        """Pack the time window ``[:, start:stop)`` of a batch-major ``[b, T]`` layout
+        into time-major device-ready buffers padded to ``[chunk, bs]``.
+
+        Returns ``(packed uint8 [chunk, bs, nbytes], side {name: [chunk, bs]})``.
+        Fresh buffers every call (donation-safe). Padding slots decode to the pad
+        sentinel. Raises if a packed field's value overflows its declared bits.
+        """
+        b = type_ids.shape[0]
+        width = stop - start
+        tid = type_ids[:, start:stop]
+        # out-of-range ids — padding (-1) or corrupt positive values — pack as the pad
+        # sentinel so they carry state through (the same contract make_step_fn keeps
+        # for the unpacked path); a corrupt id must never spill into field bits
+        word = np.where((tid < 0) | (tid >= self.num_types),
+                        self.pad_code, tid).astype(np.uint32)
+        for pf in self.packed_fields:
+            col = cols[pf.name][:, start:stop]
+            if col.size and ((col < 0).any()
+                             or (col.astype(np.int64) >> pf.bits).any()):
+                raise ValueError(
+                    f"column {pf.name!r} overflows its declared {pf.bits}-bit wire "
+                    f"width (max value {int(col.max())}, min {int(col.min())})")
+            word |= col.astype(np.uint32) << np.uint32(pf.shift)
+
+        packed = np.empty((chunk, bs, self.nbytes), dtype=np.uint8)
+        for k in range(self.nbytes):
+            packed[..., k] = self.pad_bytes[k]
+            packed[:width, :b, k] = ((word >> np.uint32(8 * k)) & np.uint32(0xFF)).T
+
+        side: dict[str, np.ndarray] = {}
+        for f in self.side_fields:
+            buf = np.zeros((chunk, bs), dtype=f.dtype)
+            buf[:width, :b] = cols[f.name][:, start:stop].T
+            side[f.name] = buf
+        return packed, side
+
+    # -- device side ----------------------------------------------------------------------
+
+    def decode(self, packed: Any, side: Mapping[str, Any], ord_base: Any
+               ) -> dict[str, Any]:
+        """JAX-traceable unpack: ``[chunk, B, nbytes]`` uint8 (+side columns, +ordinal
+        base ``[B]``) → the events dict the fold scan consumes, with ``type_id`` as
+        int32 (padding → -1) and each field at its schema dtype.
+
+        ``ord_base[b] + t + 1`` is the derived ordinal of the event at time row ``t``
+        (0 for fresh replays; the already-folded event count when resuming).
+        """
+        import jax.numpy as jnp
+
+        chunk = packed.shape[0]
+        word = packed[..., 0].astype(jnp.uint32)
+        for k in range(1, self.nbytes):
+            word = word | (packed[..., k].astype(jnp.uint32) << np.uint32(8 * k))
+
+        tid = (word & np.uint32((1 << self.type_bits) - 1)).astype(jnp.int32)
+        events: dict[str, Any] = {
+            "type_id": jnp.where(tid >= self.num_types, jnp.int32(-1), tid)}
+        for pf in self.packed_fields:
+            raw = (word >> np.uint32(pf.shift)) & np.uint32(pf.mask)
+            events[pf.name] = raw.astype(pf.dtype)
+        for f in self.side_fields:
+            events[f.name] = side[f.name]
+        if self.derived_fields:
+            t_idx = jnp.arange(chunk, dtype=jnp.int32)[:, None]
+            for f in self.derived_fields:
+                ordinal = ord_base[None, :].astype(jnp.int32) + t_idx + 1
+                events[f.name] = ordinal.astype(f.dtype)
+        return events

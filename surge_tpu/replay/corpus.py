@@ -77,8 +77,9 @@ def synth_counter_corpus(num_aggregates: int, num_events: int, seed: int = 0,
     starts = np.zeros(num_aggregates + 1, dtype=np.int64)
     np.cumsum(lengths, out=starts[1:])
     agg_idx = np.repeat(np.arange(num_aggregates, dtype=np.int32), lengths)
-    # within-aggregate ordinal, 1-based — the model stamps sequence_number = version+1
-    # on each event, which for a pure event log is exactly the event's ordinal
+    # within-aggregate ordinal, 1-based — this corpus stamps sequence_number as the
+    # event's position in its aggregate's log, so the column is declared
+    # device-derivable ("ordinal") and never stored or transferred (codec/wire.py)
     seq = (np.arange(n, dtype=np.int64) - starts[agg_idx] + 1).astype(np.int32)
 
     type_ids = rng.choice(
@@ -92,7 +93,8 @@ def synth_counter_corpus(num_aggregates: int, num_events: int, seed: int = 0,
 
     events = ColumnarEvents(
         num_aggregates=num_aggregates, agg_idx=agg_idx, type_ids=type_ids,
-        cols={"increment_by": inc, "decrement_by": dec, "sequence_number": seq})
+        cols={"increment_by": inc, "decrement_by": dec},
+        derived_cols={"sequence_number": "ordinal"})
 
     expected_count = (
         np.bincount(agg_idx, weights=inc, minlength=num_aggregates)
@@ -127,13 +129,13 @@ def decode_sample(corpus: CounterCorpus, indices) -> list[list]:
         counter.NOOP: lambda a, i, d, s: counter.NoOpEvent(a, int(s)),
         counter.UNSERIALIZABLE: lambda a, i, d, s: counter.UnserializableEvent(a, int(s), ""),
     }
-    inc, dec, seq = (ev.cols["increment_by"], ev.cols["decrement_by"],
-                     ev.cols["sequence_number"])
+    inc, dec = ev.cols["increment_by"], ev.cols["decrement_by"]
     logs = []
     for b in indices:
         lo, hi = int(starts[b]), int(starts[b + 1])
         agg = f"agg-{b}"
-        logs.append([ctors[int(ev.type_ids[k])](agg, inc[k], dec[k], seq[k])
+        # sequence_number is a derived ordinal column: position within the log + 1
+        logs.append([ctors[int(ev.type_ids[k])](agg, inc[k], dec[k], k - lo + 1)
                      for k in range(lo, hi)])
     return logs
 

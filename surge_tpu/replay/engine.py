@@ -21,13 +21,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from surge_tpu.codec.tensor import (
-    PAD_TYPE_ID,
     ColumnarEvents,
     EncodedEvents,
     bucket_lengths,
     columnar_to_batch,
     encode_states,
 )
+from surge_tpu.codec.wire import WireFormat
 from surge_tpu.config import Config, default_config
 from surge_tpu.engine.model import ReplaySpec, StateTree
 
@@ -125,21 +125,50 @@ class ReplayEngine:
             max(self.config.get_int("surge.replay.batch-size"), lane), lane)
         self.buckets = self.config.get_int_list("surge.replay.length-buckets", "64,256,1024,4096")
 
-        fold = make_batch_fold(spec, unroll=unroll)
+        self._unroll = unroll
+        # one (wire, jitted fold) per derived-column declaration the inputs carry —
+        # in practice at most two: framework logs (ordinal seq) and object-test logs
+        self._wire_folds: dict[frozenset, tuple[WireFormat, Any]] = {}
         if mesh is not None:
             pspec = jax.sharding.PartitionSpec(mesh_axis)
-            sharding = jax.sharding.NamedSharding(mesh, pspec)
-            carry_sh = jax.tree_util.tree_map(lambda _: sharding, self._carry_struct())
-            ev_sharding = jax.sharding.NamedSharding(
+            self._sharding = jax.sharding.NamedSharding(mesh, pspec)
+            self._packed_sharding = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(None, mesh_axis, None))
+            self._ev_sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(None, mesh_axis))
-            self._fold = jax.jit(fold, donate_argnums=(0,),
-                                 in_shardings=(carry_sh, None), out_shardings=carry_sh)
-            self._sharding = sharding
-            self._ev_sharding = ev_sharding
         else:
-            self._fold = jax.jit(fold, donate_argnums=(0,))
             self._sharding = None
+            self._packed_sharding = None
             self._ev_sharding = None
+
+    def _wire_fold(self, derived_cols: Mapping[str, str]) -> tuple[WireFormat, Any]:
+        """The (WireFormat, jitted fold) pair for one derived-column declaration.
+
+        The fold consumes wire-packed windows directly — decode happens inside the
+        jit so XLA fuses unpacking into the scan and only wire bytes cross the link:
+        ``fold(carry {name:[B]}, packed u8 [T,B,nbytes], side {name:[T,B]},
+        ord_base i32 [B]) -> carry``.
+        """
+        key = frozenset(dict(derived_cols).items())
+        hit = self._wire_folds.get(key)
+        if hit is not None:
+            return hit
+        wire = WireFormat(self.spec.registry, derived_cols)
+        batch_fold = make_batch_fold(self.spec, unroll=self._unroll)
+
+        def fold(carry: StateTree, packed, side, ord_base) -> StateTree:
+            return batch_fold(carry, wire.decode(packed, side, ord_base))
+
+        if self.mesh is not None:
+            carry_sh = jax.tree_util.tree_map(lambda _: self._sharding,
+                                              self._carry_struct())
+            jitted = jax.jit(fold, donate_argnums=(0,),
+                             in_shardings=(carry_sh, None, None, None),
+                             out_shardings=carry_sh)
+        else:
+            jitted = jax.jit(fold, donate_argnums=(0,))
+        self._wire_folds[key] = (wire, jitted)
+        return wire, jitted
 
     # -- helpers ------------------------------------------------------------------------
 
@@ -152,12 +181,15 @@ class ReplayEngine:
         return max(8 * n, n)
 
     def num_compiles(self) -> int:
-        """Compiled-program count for the fold (compile-stability instrumentation).
-        Returns -1 if the JAX internal it relies on is unavailable."""
-        try:
-            return int(self._fold._cache_size())
-        except AttributeError:
-            return -1
+        """Compiled-program count across fold variants (compile-stability
+        instrumentation). Returns -1 if the JAX internal it relies on is unavailable."""
+        total = 0
+        for _, jitted in self._wire_folds.values():
+            try:
+                total += int(jitted._cache_size())
+            except AttributeError:
+                return -1
+        return total
 
     def init_carry_np(self, batch: int) -> dict[str, np.ndarray]:
         """Host-side initial carry columns ``{name: [batch]}``."""
@@ -194,19 +226,27 @@ class ReplayEngine:
             out[k] = buf
         return self._device_carry(out)
 
-    def _device_events(self, ev: Mapping[str, np.ndarray]) -> Mapping[str, Any]:
+    def _device_window(self, packed: np.ndarray, side: Mapping[str, np.ndarray],
+                       ord_base: np.ndarray):
         if self._ev_sharding is not None:
-            return {k: jax.device_put(v, self._ev_sharding) for k, v in ev.items()}
-        return ev
+            return (jax.device_put(packed, self._packed_sharding),
+                    {k: jax.device_put(v, self._ev_sharding) for k, v in side.items()},
+                    jax.device_put(ord_base, self._sharding))
+        return packed, side, ord_base
 
     # -- core entry points --------------------------------------------------------------
 
     def replay_encoded(self, enc: EncodedEvents,
-                       init_carry: Mapping[str, Any] | None = None) -> ReplayResult:
+                       init_carry: Mapping[str, Any] | None = None,
+                       ordinal_base: np.ndarray | None = None) -> ReplayResult:
         """Fold one encoded batch. The aggregate axis is chunked to
         ``surge.replay.batch-size`` and the time axis to ``surge.replay.time-chunk`` so
         arbitrarily large batches and arbitrarily long (padded) logs stream through a
-        fixed-size compiled program with bounded HBM."""
+        fixed-size compiled program with bounded HBM.
+
+        When resuming (``init_carry`` from a snapshot) and the batch declares derived
+        ordinal columns, ``ordinal_base`` must carry each aggregate's already-folded
+        event count ``[B]`` so the derived ordinals continue rather than restart."""
         b, t = enc.batch_size, enc.max_len
         bs = min(self.batch_size, _round_up(max(b, 1), self._lane_multiple()))
         state_fields = self.spec.registry.state.fields
@@ -219,7 +259,10 @@ class ReplayEngine:
                 break
             carry = self._carry_slice(init_carry, start, stop, bs)
             carry = self._fold_window(
-                carry, enc.type_ids[start:stop], {k: v[start:stop] for k, v in enc.cols.items()}, bs)
+                carry, enc.type_ids[start:stop],
+                {k: v[start:stop] for k, v in enc.cols.items()}, bs,
+                derived_cols=enc.derived_cols,
+                ordinal_base=None if ordinal_base is None else ordinal_base[start:stop])
             for name in out:
                 out[name][start:stop] = np.asarray(carry[name])[: stop - start]
             padded += bs * _round_up(t, self.time_chunk if self.time_chunk > 0 else max(t, 1))
@@ -228,7 +271,8 @@ class ReplayEngine:
                             num_events=int(enc.lengths.sum()), padded_events=padded)
 
     def replay_columnar(self, colev: ColumnarEvents,
-                        init_carry: Mapping[str, Any] | None = None) -> ReplayResult:
+                        init_carry: Mapping[str, Any] | None = None,
+                        ordinal_base: np.ndarray | None = None) -> ReplayResult:
         """Fold a flat columnar log (the log-segment storage layout) directly.
 
         Densifies per B-chunk, never the whole batch: each chunk pads only to its own
@@ -247,7 +291,10 @@ class ReplayEngine:
                 break
             enc = columnar_to_batch(sorted_ev.slice_aggregates(start, stop))
             carry = self._carry_slice(init_carry, start, stop, bs)
-            carry = self._fold_window(carry, enc.type_ids, enc.cols, bs)
+            carry = self._fold_window(carry, enc.type_ids, enc.cols, bs,
+                                      derived_cols=enc.derived_cols,
+                                      ordinal_base=None if ordinal_base is None
+                                      else ordinal_base[start:stop])
             for name in out:
                 out[name][start:stop] = np.asarray(carry[name])[: stop - start]
             t = enc.max_len
@@ -257,18 +304,30 @@ class ReplayEngine:
                             num_events=total_events, padded_events=padded)
 
     def _fold_window(self, carry: StateTree, type_ids: np.ndarray,
-                     cols: Mapping[str, np.ndarray], bs: int) -> StateTree:
-        """Fold one [b?, T] window (b? ≤ bs) through T-chunked fixed-width programs."""
+                     cols: Mapping[str, np.ndarray], bs: int,
+                     derived_cols: Mapping[str, str] | None = None,
+                     t_base: int = 0,
+                     ordinal_base: np.ndarray | None = None) -> StateTree:
+        """Fold one [b?, T] window (b? ≤ bs) through T-chunked fixed-width programs.
+
+        Each chunk is wire-packed on the host (uint8 word + side columns) and decoded
+        inside the fold jit. The ordinal base of device-derived positional columns is
+        ``ordinal_base[b] + t_base + s``: per-aggregate already-folded event counts
+        (resume) plus the window's global time offset (replay_stream's cumulative
+        width of prior chunks)."""
+        wire, fold = self._wire_fold(derived_cols or {})
         b, t = type_ids.shape
         chunk = self.time_chunk if self.time_chunk > 0 else max(t, 1)
+        base = np.zeros((bs,), dtype=np.int32)
+        if ordinal_base is not None:
+            base[:b] = np.asarray(ordinal_base, dtype=np.int32)[:b]
         for s in range(0, max(t, 1), chunk):
             e = min(s + chunk, t)
             if e <= s:
                 break
-            ev = {"type_id": _time_major_padded(type_ids, s, e, chunk, bs, PAD_TYPE_ID)}
-            for name, col in cols.items():
-                ev[name] = _time_major_padded(col, s, e, chunk, bs, 0)
-            carry = self._fold(carry, self._device_events(ev))
+            packed, side = wire.pack_window(type_ids, cols, s, e, chunk, bs)
+            ord_base = base + np.int32(t_base + s)
+            carry = fold(carry, *self._device_window(packed, side, ord_base))
         return carry
 
     def replay_ragged(self, logs: Sequence[Sequence[Any]],
@@ -303,7 +362,8 @@ class ReplayEngine:
                             num_events=total_events, padded_events=padded)
 
     def replay_stream(self, chunks: Iterable[EncodedEvents], batch: int,
-                      init_carry: Mapping[str, Any] | None = None) -> ReplayResult:
+                      init_carry: Mapping[str, Any] | None = None,
+                      ordinal_base: np.ndarray | None = None) -> ReplayResult:
         """Fold a stream of EncodedEvents chunks (same B, consecutive time windows),
         carrying state across chunks — the 100M-event-log path where the whole encoded
         log never exists in HBM at once. Every window is padded to ``time-chunk`` width
@@ -313,6 +373,7 @@ class ReplayEngine:
         carries: list[StateTree | None] = [None] * n_bchunks
         total_events = 0
         padded = 0
+        t_cursor = 0  # global time offset of the current chunk (ordinal base)
         for enc in chunks:
             if enc.batch_size != batch:
                 raise ValueError(f"stream chunk batch {enc.batch_size} != {batch}")
@@ -323,8 +384,12 @@ class ReplayEngine:
                     carries[ci] = self._carry_slice(init_carry, start, stop, bs)
                 carries[ci] = self._fold_window(
                     carries[ci], enc.type_ids[start:stop],
-                    {k: v[start:stop] for k, v in enc.cols.items()}, bs)
+                    {k: v[start:stop] for k, v in enc.cols.items()}, bs,
+                    derived_cols=enc.derived_cols, t_base=t_cursor,
+                    ordinal_base=None if ordinal_base is None
+                    else ordinal_base[start:stop])
             total_events += int(enc.lengths.sum())
+            t_cursor += t
             padded += n_bchunks * bs * _round_up(t, self.time_chunk or max(t, 1))
         if carries[0] is None:
             raise ValueError("empty chunk stream")
@@ -340,14 +405,3 @@ class ReplayEngine:
 
 def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m if m > 0 else n
-
-
-def _time_major_padded(col: np.ndarray, start: int, stop: int, chunk: int,
-                       bs: int, pad_value) -> np.ndarray:
-    """Slice [b, start:stop], pad time to ``chunk`` and batch to ``bs``, return
-    time-major [chunk, bs]. Always allocates a fresh buffer (donation-safe)."""
-    b = col.shape[0]
-    width = stop - start
-    out = np.full((chunk, bs), pad_value, dtype=col.dtype)
-    out[:width, :b] = col[:, start:stop].T
-    return out

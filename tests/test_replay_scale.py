@@ -174,3 +174,41 @@ def test_columnar_chunked_skewed_lengths():
     np.testing.assert_array_equal(res.states["count"], expected)
     # the 500-long log only inflates its own chunk: padding ≤ chunk0(512*8) + others(32*8 each)
     assert res.padded_events <= 8 * 512 + (b // 8 - 1) * 8 * 32 + 8 * 32
+
+
+def test_resume_with_derived_ordinals_continues_sequence():
+    """Checkpoint-resume over a derived-ordinal corpus: the second half's derived
+    sequence numbers must continue from each aggregate's already-folded count
+    (ordinal_base), not restart at 1 (which would corrupt version)."""
+    import numpy as np
+
+    from surge_tpu.models.counter import make_replay_spec
+    from surge_tpu.replay.corpus import synth_counter_corpus
+    from surge_tpu.replay.engine import ReplayEngine
+
+    corpus = synth_counter_corpus(64, 4000, seed=11)
+    ev = corpus.events  # aggregate-sorted flat columnar stream
+    engine = ReplayEngine(make_replay_spec())
+
+    # split each aggregate's log in half at the event level
+    starts = np.zeros(corpus.num_aggregates + 1, dtype=np.int64)
+    np.cumsum(corpus.lengths, out=starts[1:])
+    first_len = corpus.lengths // 2
+    keep_first = np.zeros(corpus.num_events, dtype=bool)
+    for b in range(corpus.num_aggregates):
+        keep_first[starts[b]: starts[b] + first_len[b]] = True
+
+    from surge_tpu.codec.tensor import ColumnarEvents
+
+    def subset(mask):
+        return ColumnarEvents(
+            num_aggregates=corpus.num_aggregates, agg_idx=ev.agg_idx[mask],
+            type_ids=ev.type_ids[mask],
+            cols={k: v[mask] for k, v in ev.cols.items()},
+            derived_cols=dict(ev.derived_cols))
+
+    r1 = engine.replay_columnar(subset(keep_first))
+    r2 = engine.replay_columnar(subset(~keep_first), init_carry=r1.states,
+                                ordinal_base=first_len.astype(np.int32))
+    assert np.array_equal(r2.states["count"], corpus.expected_count)
+    assert np.array_equal(r2.states["version"], corpus.expected_version)

@@ -1,0 +1,142 @@
+"""Wire format: bit-packed windows round-trip exactly, overflow is rejected,
+derived ordinal columns materialize on device, padding decodes to the sentinel.
+
+The wire layer is what makes the 100M-event replay transfer-feasible
+(SURVEY.md §7 hard-part 2); these tests pin its pack/decode contract independently of
+the replay engine goldens.
+"""
+
+import numpy as np
+import pytest
+
+from surge_tpu.codec.schema import FieldSpec, SchemaRegistry
+from surge_tpu.codec.wire import WireFormat
+from surge_tpu.models.counter import make_registry
+
+
+def _tiny_registry(bits_a=5, bits_b=None):
+    from dataclasses import make_dataclass
+
+    EvA = make_dataclass("EvA", [("a", int)])
+    EvB = make_dataclass("EvB", [("b", float)])
+    reg = SchemaRegistry()
+    reg.register_event(EvA, fields=[FieldSpec("a", np.int32, bits=bits_a)])
+    reg.register_event(EvB, fields=[FieldSpec("b", np.float32, bits=bits_b)])
+    St = make_dataclass("St", [("a", int)])
+    reg.register_state(St, fields=[FieldSpec("a", np.int32)])
+    return reg
+
+
+def test_counter_wire_is_two_bytes():
+    wire = WireFormat(make_registry(), {"sequence_number": "ordinal"})
+    assert wire.nbytes == 2  # 3 type bits + 4 + 4 = 11 bits
+    assert wire.wire_bytes_per_event() == 2
+    assert [f.name for f in wire.derived_fields] == ["sequence_number"]
+    # without the derivation declaration, sequence_number rides full-width
+    wire2 = WireFormat(make_registry())
+    assert wire2.wire_bytes_per_event() == 2 + 4
+
+
+def test_pack_decode_round_trip():
+    wire = WireFormat(make_registry(), {"sequence_number": "ordinal"})
+    rng = np.random.default_rng(0)
+    b, t = 5, 9
+    type_ids = rng.integers(0, 4, size=(b, t)).astype(np.int32)
+    type_ids[0, 4:] = -1  # padding tail
+    cols = {
+        "increment_by": rng.integers(0, 16, size=(b, t)).astype(np.int32),
+        "decrement_by": rng.integers(0, 16, size=(b, t)).astype(np.int32),
+    }
+    packed, side = wire.pack_window(type_ids, cols, 0, t, chunk=16, bs=8)
+    assert packed.shape == (16, 8, 2) and packed.dtype == np.uint8
+    assert side == {}
+
+    ev = wire.decode(packed, side, np.zeros(8, np.int32))
+    got_tid = np.asarray(ev["type_id"])
+    # real region round-trips; padding (both the tail and the pad rows/cols) is -1
+    assert np.array_equal(got_tid[:t, :b].T, type_ids)
+    assert (got_tid[t:, :] == -1).all() and (got_tid[:, b:] == -1).all()
+    assert np.array_equal(np.asarray(ev["increment_by"])[:t, :b].T, cols["increment_by"])
+    assert np.array_equal(np.asarray(ev["decrement_by"])[:t, :b].T, cols["decrement_by"])
+    # derived ordinal: base 0 → row index + 1, at the field's dtype
+    seq = np.asarray(ev["sequence_number"])
+    assert seq.dtype == np.int32
+    assert np.array_equal(seq[:, 0], np.arange(1, 17, dtype=np.int32))
+
+
+def test_time_window_slice_and_ordinal_base():
+    wire = WireFormat(make_registry(), {"sequence_number": "ordinal"})
+    b, t = 3, 20
+    type_ids = np.zeros((b, t), dtype=np.int32)
+    cols = {"increment_by": np.ones((b, t), np.int32),
+            "decrement_by": np.zeros((b, t), np.int32)}
+    packed, side = wire.pack_window(type_ids, cols, 8, 16, chunk=8, bs=8)
+    ev = wire.decode(packed, side, np.full(8, 8, np.int32))
+    seq = np.asarray(ev["sequence_number"])
+    # events at global positions 8..15 → ordinals 9..16
+    assert np.array_equal(seq[:, 0], np.arange(9, 17, dtype=np.int32))
+
+
+def test_overflow_raises():
+    wire = WireFormat(make_registry(), {"sequence_number": "ordinal"})
+    type_ids = np.zeros((1, 1), dtype=np.int32)
+    cols = {"increment_by": np.array([[16]], np.int32),  # 2**4 — one past the width
+            "decrement_by": np.zeros((1, 1), np.int32)}
+    with pytest.raises(ValueError, match="increment_by.*4-bit"):
+        wire.pack_window(type_ids, cols, 0, 1, chunk=1, bs=1)
+    cols = {"increment_by": np.array([[-1]], np.int32),  # negatives cannot pack
+            "decrement_by": np.zeros((1, 1), np.int32)}
+    with pytest.raises(ValueError, match="increment_by"):
+        wire.pack_window(type_ids, cols, 0, 1, chunk=1, bs=1)
+
+
+def test_undeclared_bits_ride_side_columns():
+    reg = _tiny_registry(bits_a=5, bits_b=None)
+    wire = WireFormat(reg)
+    assert [pf.name for pf in wire.packed_fields] == ["a"]
+    assert [f.name for f in wire.side_fields] == ["b"]
+    type_ids = np.array([[0, 1]], dtype=np.int32)
+    cols = {"a": np.array([[17, 0]], np.int32),
+            "b": np.array([[0.0, 2.5]], np.float32)}
+    packed, side = wire.pack_window(type_ids, cols, 0, 2, chunk=2, bs=1)
+    ev = wire.decode(packed, side, np.zeros(1, np.int32))
+    assert np.asarray(ev["a"])[0, 0] == 17
+    assert np.asarray(ev["b"])[1, 0] == np.float32(2.5)
+    assert np.asarray(ev["b"]).dtype == np.float32
+
+
+def test_unknown_derivation_rejected():
+    with pytest.raises(ValueError, match="unknown derivation"):
+        WireFormat(make_registry(), {"sequence_number": "fibonacci"})
+
+
+def test_corrupt_type_codes_decode_as_padding():
+    """Codes above num_types (possible with a corrupt word) must mask to -1, not
+    dispatch to an arbitrary handler (same contract as make_step_fn's clip guard)."""
+    wire = WireFormat(make_registry(), {"sequence_number": "ordinal"})
+    packed = np.full((1, 1, wire.nbytes), 0xFF, dtype=np.uint8)  # type bits = 7 > 4
+    ev = wire.decode(packed, {}, np.zeros(1, np.int32))
+    assert int(np.asarray(ev["type_id"])[0, 0]) == -1
+
+
+def test_overflow_detects_uint32_wrap():
+    """Values that are multiples of 2**32 must raise, not silently wrap to 0."""
+    wire = WireFormat(make_registry(), {"sequence_number": "ordinal"})
+    type_ids = np.zeros((1, 1), dtype=np.int32)
+    cols = {"increment_by": np.array([[2**32]], np.int64),
+            "decrement_by": np.zeros((1, 1), np.int32)}
+    with pytest.raises(ValueError, match="increment_by"):
+        wire.pack_window(type_ids, cols, 0, 1, chunk=1, bs=1)
+
+
+def test_corrupt_positive_type_id_packs_as_padding():
+    """A positive out-of-range type_id must not spill into field bits: tid=8 with
+    3 type bits would otherwise decode as (type 0, increment_by 1)."""
+    wire = WireFormat(make_registry(), {"sequence_number": "ordinal"})
+    type_ids = np.array([[8]], dtype=np.int32)
+    cols = {"increment_by": np.zeros((1, 1), np.int32),
+            "decrement_by": np.zeros((1, 1), np.int32)}
+    packed, side = wire.pack_window(type_ids, cols, 0, 1, chunk=1, bs=1)
+    ev = wire.decode(packed, side, np.zeros(1, np.int32))
+    assert int(np.asarray(ev["type_id"])[0, 0]) == -1
+    assert int(np.asarray(ev["increment_by"])[0, 0]) == 0
