@@ -1,0 +1,171 @@
+// Block codec for log segment files: LZ-style compression + CRC32.
+//
+// Fills the role of the reference's native Kafka compression codecs (lz4/zstd JNI,
+// producer default compression-type=lz4 — SURVEY.md §2.9 item 2): log blocks are
+// compressed in C++ on the append path and decompressed on the read path, via ctypes
+// from surge_tpu/log/segment.py.
+//
+// Format ("SLZ1", not LZ4-compatible): a sequence of ops. Each op starts with a token
+// byte: high nibble = literal length, low nibble = match length - kMinMatch. Length
+// nibbles of 15 extend with 255-run bytes (like LZ4's varint scheme). Literals follow
+// the token; a match follows as a 2-byte little-endian back-offset (1..65535) into the
+// already-produced output. A final op may have match length nibble 0 meaning
+// "literals only, end of stream". Matching uses a 4-byte-hash greedy parser.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kMinMatch = 4;
+constexpr int kHashBits = 15;
+constexpr int kMaxOffset = 65535;
+
+inline uint32_t Hash4(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return (v * 2654435761u) >> (32 - kHashBits);
+}
+
+inline void PutLength(uint8_t*& op, size_t len) {
+  while (len >= 255) {
+    *op++ = 255;
+    len -= 255;
+  }
+  *op++ = static_cast<uint8_t>(len);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Worst-case output size for n input bytes (all literals + token overhead).
+size_t surge_lz_bound(size_t n) { return n + n / 255 + 16; }
+
+// Returns compressed size, or 0 if dst_cap is too small (caller should then store
+// the block uncompressed).
+size_t surge_lz_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t dst_cap) {
+  if (dst_cap < surge_lz_bound(n)) return 0;
+  if (n == 0) {
+    dst[0] = 0;
+    return 1;
+  }
+  static thread_local uint32_t table[1u << kHashBits];
+  std::memset(table, 0, sizeof(table));
+
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + n;
+  const uint8_t* const mflimit = (n >= 12) ? iend - 11 : src;  // last safe match start
+  const uint8_t* anchor = src;
+  uint8_t* op = dst;
+
+  while (ip < mflimit) {
+    // find a match via the 4-byte hash table
+    uint32_t h = Hash4(ip);
+    const uint8_t* ref = src + table[h];
+    table[h] = static_cast<uint32_t>(ip - src);
+    if (ref >= ip || ip - ref > kMaxOffset || ref < src ||
+        std::memcmp(ref, ip, kMinMatch) != 0) {
+      ++ip;
+      continue;
+    }
+    // extend the match forward
+    const uint8_t* mp = ref + kMinMatch;
+    const uint8_t* p = ip + kMinMatch;
+    while (p < iend && *p == *mp) ++p, ++mp;
+    size_t match_len = p - ip;
+    size_t lit_len = ip - anchor;
+
+    // op layout (must mirror the decoder): token, literal-length extension,
+    // literals, match-length extension, offset. The match nibble is stored +1 so
+    // 0 can mean "end of stream".
+    size_t ml_code = match_len - kMinMatch;
+    size_t ml_nibble = (ml_code < 14) ? ml_code + 1 : 15;
+    *op++ = static_cast<uint8_t>(((lit_len < 15 ? lit_len : 15) << 4) | ml_nibble);
+    if (lit_len >= 15) PutLength(op, lit_len - 15);
+    std::memcpy(op, anchor, lit_len);
+    op += lit_len;
+    if (ml_nibble == 15) PutLength(op, ml_code - 14);
+    uint16_t off = static_cast<uint16_t>(ip - ref);
+    *op++ = static_cast<uint8_t>(off & 0xFF);
+    *op++ = static_cast<uint8_t>(off >> 8);
+
+    ip += match_len;
+    anchor = ip;
+    if (ip < mflimit) table[Hash4(ip - 2)] = static_cast<uint32_t>(ip - 2 - src);
+  }
+
+  // trailing literals, match nibble 0 = end
+  size_t lit_len = iend - anchor;
+  uint8_t token = static_cast<uint8_t>((lit_len < 15 ? lit_len : 15) << 4);
+  *op++ = token;
+  if (lit_len >= 15) PutLength(op, lit_len - 15);
+  std::memcpy(op, anchor, lit_len);
+  op += lit_len;
+  return static_cast<size_t>(op - dst);
+}
+
+// Returns decompressed size, or 0 on malformed/overflowing input.
+size_t surge_lz_decompress(const uint8_t* src, size_t n, uint8_t* dst,
+                           size_t dst_cap) {
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + n;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + dst_cap;
+
+  while (ip < iend) {
+    uint8_t token = *ip++;
+    size_t lit_len = token >> 4;
+    size_t ml_nibble = token & 0x0F;
+    if (lit_len == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return 0;
+        b = *ip++;
+        lit_len += b;
+      } while (b == 255);
+    }
+    if (ip + lit_len > iend || op + lit_len > oend) return 0;
+    std::memcpy(op, ip, lit_len);
+    ip += lit_len;
+    op += lit_len;
+    if (ml_nibble == 0) break;  // end of stream
+    size_t ml_code = ml_nibble - 1;
+    if (ml_nibble == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return 0;
+        b = *ip++;
+        ml_code += b;
+      } while (b == 255);
+    }
+    size_t match_len = ml_code + kMinMatch;
+    if (ip + 2 > iend) return 0;
+    size_t off = ip[0] | (static_cast<size_t>(ip[1]) << 8);
+    ip += 2;
+    if (off == 0 || static_cast<size_t>(op - dst) < off) return 0;
+    if (op + match_len > oend) return 0;
+    const uint8_t* ref = op - off;
+    for (size_t i = 0; i < match_len; ++i) op[i] = ref[i];  // overlapping copy
+    op += match_len;
+  }
+  return static_cast<size_t>(op - dst);
+}
+
+uint32_t surge_crc32(const uint8_t* src, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) crc = table[(crc ^ src[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+}  // extern "C"

@@ -113,8 +113,9 @@ class WireFormat:
                         self.pad_code, tid).astype(np.uint32)
         for pf in self.packed_fields:
             col = cols[pf.name][:, start:stop]
-            if col.size and ((col < 0).any()
-                             or (col.astype(np.int64) >> pf.bits).any()):
+            # dtype-preserving range check (no int64 temporary on the hot path);
+            # catches negatives and any value past the width, incl. 2**32 multiples
+            if col.size and ((col < 0) | (col > pf.mask)).any():
                 raise ValueError(
                     f"column {pf.name!r} overflows its declared {pf.bits}-bit wire "
                     f"width (max value {int(col.max())}, min {int(col.min())})")

@@ -18,8 +18,10 @@ from surge_tpu.log.transport import (
     TransactionStateError,
 )
 from surge_tpu.log.memory import InMemoryLog
+from surge_tpu.log.file import FileLog
 
 __all__ = [
+    "FileLog",
     "InMemoryLog",
     "LogRecord",
     "LogTransport",

@@ -1,0 +1,297 @@
+"""Durable file-backed log transport: segment files + commit journal.
+
+The single-node durability substrate standing in for the reference's Kafka broker
+(SURVEY.md §2.9 item 3): same observable contract as :class:`InMemoryLog` — atomic
+multi-topic transactions, epoch fencing, read_committed views — plus crash recovery.
+
+Layout under the root directory::
+
+    topics.json           topic specs (rewritten + fsynced on create)
+    epochs.json           producer epochs (rewritten + fsynced on producer open)
+    commits.log           the COMMIT JOURNAL: one JSON line per transaction listing
+                          [topic, partition, base_offset, count, seg_end_pos] per
+                          touched partition, fsynced after the data blocks
+    data/{topic}-{p}.seg  one segment file per topic-partition: a sequence of
+                          compressed blocks (surge_tpu.log.segment), one per
+                          transaction per partition
+
+**Crash atomicity.** A transaction is durable iff its journal line is. Data blocks are
+written and fsynced *before* the journal line, so on recovery every journaled block is
+present; segment bytes beyond the last journaled end position (a torn write from a
+crashed commit) are truncated away. This mirrors the role Kafka's transaction markers
+play for read_committed consumers (SurgeStateStoreConsumer.scala:38) with a
+single-node journal instead of a two-phase broker protocol.
+
+Producers reuse :class:`InMemoryTxnProducer` — the transactional/fencing protocol is
+identical; only ``_append`` differs (journaled disk commit vs list append).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from surge_tpu.log import segment as seg
+from surge_tpu.log.memory import InMemoryTxnProducer, LogBase
+from surge_tpu.log.transport import LogRecord, TopicSpec
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably record directory entries (new/renamed files) — without this a crash
+    can lose a whole file whose contents were fsynced."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover — platform without directory fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class _Partition:
+    """In-memory index of one partition's segment file."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.blocks: List[Tuple[int, int, int]] = []  # (base_offset, file_pos, count)
+        self.end_offset = 0
+        self.end_pos = 0  # durable end of the segment file
+        self.file = None  # append handle, opened lazily
+        self._cache: Tuple[int, List[LogRecord]] | None = None  # (file_pos, records)
+
+
+class FileLog(LogBase):
+    """Durable :class:`surge_tpu.log.transport.LogTransport` implementation.
+
+    ``fsync`` policy: ``"commit"`` (default — fsync data + journal + directory
+    entries on every commit; crash-durable) or ``"none"`` (OS buffering only; fast,
+    for tests/benches).
+    """
+
+    def __init__(self, root: str, fsync: str = "commit",
+                 auto_create_partitions: int = 1) -> None:
+        self.root = root
+        self._fsync = fsync == "commit"
+        self._auto_create_partitions = auto_create_partitions
+        self._lock = threading.RLock()
+        self._topics: Dict[str, TopicSpec] = {}
+        self._epochs: Dict[str, int] = {}
+        self._parts: Dict[Tuple[str, int], _Partition] = {}
+        self._append_events: Dict[Tuple[str, int], asyncio.Event] = {}
+        os.makedirs(os.path.join(root, "data"), exist_ok=True)
+        self._journal_path = os.path.join(root, "commits.log")
+        self._recover()
+        self._journal = open(self._journal_path, "ab")
+
+    # -- recovery -------------------------------------------------------------------------
+
+    def _recover(self) -> None:
+        topics_path = os.path.join(self.root, "topics.json")
+        if os.path.exists(topics_path):
+            with open(topics_path) as f:
+                for name, meta in json.load(f).items():
+                    self._topics[name] = TopicSpec(name, meta["partitions"],
+                                                   meta["compacted"])
+                    for p in range(meta["partitions"]):
+                        self._parts[(name, p)] = _Partition(self._seg_path(name, p))
+        epochs_path = os.path.join(self.root, "epochs.json")
+        if os.path.exists(epochs_path):
+            with open(epochs_path) as f:
+                self._epochs = {k: int(v) for k, v in json.load(f).items()}
+
+        # journal scan: the durable frontier of every partition. A torn tail line
+        # (crash mid-journal-write) is truncated away so the reopened append handle
+        # never concatenates the next entry onto garbage.
+        durable: Dict[Tuple[str, int], Tuple[int, int]] = {}  # -> (end_offset, end_pos)
+        if os.path.exists(self._journal_path):
+            good_end = 0
+            with open(self._journal_path, "rb") as f:
+                for line in f:
+                    try:
+                        entry = json.loads(line)
+                    except ValueError:
+                        break  # torn tail
+                    if not line.endswith(b"\n"):
+                        break  # complete JSON but no newline: still a torn write
+                    good_end += len(line)
+                    for topic, p, base, count, end_pos in entry["parts"]:
+                        durable[(topic, p)] = (base + count, end_pos)
+            if os.path.getsize(self._journal_path) > good_end:
+                with open(self._journal_path, "r+b") as f:
+                    f.truncate(good_end)
+        # truncate torn data tails; rebuild block indexes up to the durable frontier
+        for key, part in self._parts.items():
+            end_offset, end_pos = durable.get(key, (0, 0))
+            part.end_offset, part.end_pos = end_offset, end_pos
+            if not os.path.exists(part.path):
+                continue
+            if os.path.getsize(part.path) > end_pos:
+                with open(part.path, "r+b") as f:
+                    f.truncate(end_pos)
+            with open(part.path, "rb") as f:
+                data = f.read(end_pos)
+            pos = 0
+            while pos < len(data):
+                codec, base, count, unlen, plen, crc, start = seg.read_block_header(
+                    data, pos)
+                part.blocks.append((base, pos, count))
+                pos = start + plen
+
+    def _seg_path(self, topic: str, partition: int) -> str:
+        return os.path.join(self.root, "data", f"{topic}-{partition}.seg")
+
+    def _persist_json(self, name: str, obj) -> None:
+        path = os.path.join(self.root, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(self.root)
+
+    # -- topics ---------------------------------------------------------------------------
+
+    def create_topic(self, spec: TopicSpec) -> None:
+        with self._lock:
+            if spec.name in self._topics:
+                return
+            self._topics[spec.name] = spec
+            for p in range(spec.partitions):
+                self._parts.setdefault((spec.name, p),
+                                       _Partition(self._seg_path(spec.name, p)))
+            self._persist_json("topics.json", {
+                t.name: {"partitions": t.partitions, "compacted": t.compacted}
+                for t in self._topics.values()})
+
+    # -- producers (protocol shared with the in-memory log) -------------------------------
+
+    def transactional_producer(self, transactional_id: str) -> InMemoryTxnProducer:
+        with self._lock:
+            epoch = self._next_epoch(transactional_id)
+            self._persist_json("epochs.json", self._epochs)
+            return InMemoryTxnProducer(self, transactional_id, epoch)
+
+    def _append(self, records: Sequence[LogRecord]) -> List[LogRecord]:
+        """One transaction: per-partition blocks + one journal line. Atomic under
+        the commit journal (see module docstring)."""
+        if not records:
+            return []
+        out: List[LogRecord] = []
+        now = time.time()
+        with self._lock:
+            grouped: Dict[Tuple[str, int], List[LogRecord]] = {}
+            for r in records:
+                self.topic(r.topic)
+                key = (r.topic, r.partition)
+                if key not in self._parts:
+                    raise KeyError(f"{r.topic}[{r.partition}] does not exist")
+                assigned = LogRecord(
+                    topic=r.topic, key=r.key, value=r.value, partition=r.partition,
+                    headers=dict(r.headers),
+                    offset=self._parts[key].end_offset + len(grouped.get(key, [])),
+                    timestamp=now)
+                grouped.setdefault(key, []).append(assigned)
+                out.append(assigned)
+
+            entry_parts = []
+            # (partition, base_offset, old_pos, new_pos, count)
+            staged: List[Tuple[_Partition, int, int, int, int]] = []
+            try:
+                for (topic, p), recs in grouped.items():
+                    part = self._parts[(topic, p)]
+                    base = part.end_offset
+                    block = seg.encode_block(recs, base)
+                    if part.file is None:
+                        existed = os.path.exists(part.path)
+                        part.file = open(part.path, "ab")
+                        if self._fsync and not existed:
+                            _fsync_dir(os.path.dirname(part.path))
+                    part.file.write(block)
+                    part.file.flush()
+                    if self._fsync:
+                        os.fsync(part.file.fileno())
+                    new_pos = part.end_pos + len(block)
+                    entry_parts.append([topic, p, base, len(recs), new_pos])
+                    staged.append((part, base, part.end_pos, new_pos, len(recs)))
+
+                # the commit point: journal line durable => transaction durable
+                self._journal.write((json.dumps({"parts": entry_parts}) + "\n").encode())
+                self._journal.flush()
+                if self._fsync:
+                    os.fsync(self._journal.fileno())
+            except BaseException:
+                # physical rollback: a failed commit must leave no orphan block below
+                # a later transaction's journaled frontier (recovery would resurrect
+                # it as committed data with overlapping offsets)
+                for part, _base, old_pos, _new_pos, _count in staged:
+                    if part.file is not None:
+                        part.file.truncate(old_pos)
+                        part.file.seek(0, os.SEEK_END)
+                raise
+
+            touched = set(grouped)
+            for part, base, old_pos, new_pos, count in staged:
+                part.blocks.append((base, old_pos, count))
+                part.end_pos = new_pos
+                part.end_offset = base + count
+        self._notify_append(touched)
+        return out
+
+    # -- reads ----------------------------------------------------------------------------
+
+    def _decode_block_at(self, part: _Partition, topic: str, p: int,
+                         file_pos: int) -> List[LogRecord]:
+        if part._cache is not None and part._cache[0] == file_pos:
+            return part._cache[1]
+        with open(part.path, "rb") as f:
+            f.seek(file_pos)
+            header = f.read(seg.HEADER_SIZE)
+            plen = seg.header_payload_len(header)
+            data = header + f.read(plen)
+        recs, _ = seg.decode_block(data, 0, topic, p)
+        part._cache = (file_pos, recs)
+        return recs
+
+    def read(self, topic: str, partition: int, from_offset: int = 0,
+             max_records: Optional[int] = None,
+             isolation: str = "read_committed") -> Sequence[LogRecord]:
+        del isolation  # only journaled (committed) blocks are ever indexed
+        with self._lock:
+            part = self._parts.get((topic, partition))
+            if part is None:  # parity with InMemoryLog: reads never create topics
+                return []
+            blocks = list(part.blocks)
+        out: List[LogRecord] = []
+        limit = max_records if max_records is not None else None
+        for base, pos, count in blocks:
+            if base + count <= from_offset:
+                continue
+            recs = self._decode_block_at(part, topic, partition, pos)
+            for r in recs:
+                if r.offset < from_offset:
+                    continue
+                out.append(r)
+                if limit is not None and len(out) >= limit:
+                    return out
+        return out
+
+    def end_offset(self, topic: str, partition: int,
+                   isolation: str = "read_committed") -> int:
+        del isolation
+        with self._lock:
+            self.topic(topic)
+            return self._parts[(topic, partition)].end_offset
+
+    def close(self) -> None:
+        with self._lock:
+            self._journal.close()
+            for part in self._parts.values():
+                if part.file is not None:
+                    part.file.close()
+                    part.file = None

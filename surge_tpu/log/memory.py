@@ -26,7 +26,72 @@ from surge_tpu.log.transport import (
 )
 
 
-class InMemoryLog:
+class LogBase:
+    """Transport-independent log behavior shared by the in-memory and file backends:
+    topic auto-creation, epoch bookkeeping/fencing checks, compaction views built on
+    ``read``, and the consumer wakeup primitive. Subclasses provide storage
+    (``create_topic``/``read``/``end_offset``/``_append``) and populate ``_topics``,
+    ``_epochs``, ``_lock``, ``_append_events``."""
+
+    _topics: Dict[str, TopicSpec]
+    _epochs: Dict[str, int]
+    _auto_create_partitions: int
+
+    def topic(self, name: str) -> TopicSpec:
+        with self._lock:
+            if name not in self._topics:
+                self.create_topic(TopicSpec(name, self._auto_create_partitions))
+            return self._topics[name]
+
+    def num_partitions(self, name: str) -> int:
+        return self.topic(name).partitions
+
+    def _next_epoch(self, transactional_id: str) -> int:
+        with self._lock:
+            epoch = self._epochs.get(transactional_id, 0) + 1
+            self._epochs[transactional_id] = epoch
+            return epoch
+
+    def _check_epoch(self, transactional_id: str, epoch: int) -> None:
+        with self._lock:
+            if self._epochs.get(transactional_id) != epoch:
+                raise ProducerFencedError(
+                    f"producer {transactional_id!r} epoch {epoch} fenced by "
+                    f"epoch {self._epochs.get(transactional_id)}")
+
+    def latest_by_key(self, topic: str, partition: int,
+                      isolation: str = "read_committed") -> Mapping[str, LogRecord]:
+        out: Dict[str, LogRecord] = {}
+        for r in self.read(topic, partition, isolation=isolation):
+            if r.key is None:
+                continue
+            if r.value is None:
+                out.pop(r.key, None)  # tombstone
+            else:
+                out[r.key] = r
+        return out
+
+    def _notify_append(self, touched) -> None:
+        for tp in touched:
+            ev = self._append_events.get(tp)
+            if ev is not None:
+                ev.set()
+
+    async def wait_for_append(self, topic: str, partition: int,
+                              after_offset: int) -> None:
+        tp = (topic, partition)
+        while self.end_offset(topic, partition) <= after_offset:
+            ev = self._append_events.get(tp)
+            if ev is None or ev.is_set():
+                ev = asyncio.Event()
+                self._append_events[tp] = ev
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                pass  # re-check end_offset (guards against lost wakeups across loops)
+
+
+class InMemoryLog(LogBase):
     """In-process :class:`surge_tpu.log.transport.LogTransport` implementation."""
 
     def __init__(self, auto_create_partitions: int = 1) -> None:
@@ -48,29 +113,11 @@ class InMemoryLog:
             for p in range(spec.partitions):
                 self._partitions[(spec.name, p)] = []
 
-    def topic(self, name: str) -> TopicSpec:
-        with self._lock:
-            if name not in self._topics:
-                self.create_topic(TopicSpec(name, self._auto_create_partitions))
-            return self._topics[name]
-
-    def num_partitions(self, name: str) -> int:
-        return self.topic(name).partitions
-
     # -- producers ----------------------------------------------------------------------
 
     def transactional_producer(self, transactional_id: str) -> "InMemoryTxnProducer":
-        with self._lock:
-            epoch = self._epochs.get(transactional_id, 0) + 1
-            self._epochs[transactional_id] = epoch
-            return InMemoryTxnProducer(self, transactional_id, epoch)
-
-    def _check_epoch(self, transactional_id: str, epoch: int) -> None:
-        with self._lock:
-            if self._epochs.get(transactional_id) != epoch:
-                raise ProducerFencedError(
-                    f"producer {transactional_id!r} epoch {epoch} fenced by "
-                    f"epoch {self._epochs.get(transactional_id)}")
+        return InMemoryTxnProducer(self, transactional_id,
+                                   self._next_epoch(transactional_id))
 
     def _append(self, records: Sequence[LogRecord]) -> List[LogRecord]:
         """Atomically append records (possibly spanning topics/partitions)."""
@@ -89,10 +136,7 @@ class InMemoryLog:
                 part.append(assigned)
                 out.append(assigned)
                 touched.add((r.topic, r.partition))
-        for tp in touched:
-            ev = self._append_events.get(tp)
-            if ev is not None:
-                ev.set()
+        self._notify_append(touched)
         return out
 
     # -- reads --------------------------------------------------------------------------
@@ -112,33 +156,6 @@ class InMemoryLog:
         with self._lock:
             self.topic(topic)
             return len(self._partitions[(topic, partition)])
-
-    def latest_by_key(self, topic: str, partition: int,
-                      isolation: str = "read_committed") -> Mapping[str, LogRecord]:
-        with self._lock:
-            out: Dict[str, LogRecord] = {}
-            for r in self._partitions.get((topic, partition), []):
-                if r.key is None:
-                    continue
-                if r.value is None:
-                    out.pop(r.key, None)  # tombstone
-                else:
-                    out[r.key] = r
-            return out
-
-    async def wait_for_append(self, topic: str, partition: int,
-                              after_offset: int) -> None:
-        tp = (topic, partition)
-        while self.end_offset(topic, partition) <= after_offset:
-            ev = self._append_events.get(tp)
-            if ev is None or ev.is_set():
-                ev = asyncio.Event()
-                self._append_events[tp] = ev
-            try:
-                await asyncio.wait_for(ev.wait(), timeout=0.5)
-            except asyncio.TimeoutError:
-                pass  # re-check end_offset (guards against lost wakeups across loops)
-
 
 class InMemoryTxnProducer:
     """Transactional producer handle; one per transactional id, epoch-fenced."""
