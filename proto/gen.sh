@@ -1,0 +1,8 @@
+#!/bin/sh
+# Regenerate the Python protobuf bindings (message classes only; the gRPC service
+# glue is hand-written in surge_tpu/multilanguage/service.py because grpcio-tools
+# is not in the image).
+set -e
+cd "$(dirname "$0")/.."
+protoc -I proto --python_out=surge_tpu/multilanguage proto/multilanguage.proto
+echo "generated: surge_tpu/multilanguage/multilanguage_pb2.py"

@@ -28,6 +28,7 @@ Error surface mirrors ACKSuccess/ACKError/ACKRejection (:33-64): domain rejectio
 from __future__ import annotations
 
 import asyncio
+import inspect
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
@@ -248,11 +249,17 @@ class AggregateEntity:
         fail_future(env.reply, TypeError(f"unknown message {type(msg).__name__}"))
 
     async def _process_command(self, env: Envelope, command: Any) -> None:
-        # 1. user command handler (may reject)
+        # 1. user command handler (may reject). Async models (the reference's
+        # AsyncAggregateCommandModel — e.g. the multilanguage bridge's gRPC
+        # round-trip to the business app) return awaitables; the single-writer
+        # guarantee holds because this entity task awaits inline.
         self.metrics.command_rate.record()
         try:
             with self.metrics.command_handling_timer.time():
-                events = list(self.model.process_command(self.state, command))
+                result = self.model.process_command(self.state, command)
+                if inspect.isawaitable(result):
+                    result = await result
+                events = list(result)
         except RejectedCommand as rej:
             self.metrics.rejection_rate.record()
             resolve_future(env.reply, CommandRejected(rej))
@@ -275,9 +282,16 @@ class AggregateEntity:
         old_state = self.state
         try:
             with self.metrics.event_handling_timer.time():
-                new_state = old_state
-                for ev in events:
-                    new_state = self.model.handle_event(new_state, ev)
+                batch_fold = getattr(self.model, "handle_events", None)
+                if batch_fold is not None:
+                    # async/batch fold (AsyncAggregateCommandModel.handleEvents)
+                    new_state = batch_fold(old_state, events)
+                    if inspect.isawaitable(new_state):
+                        new_state = await new_state
+                else:
+                    new_state = old_state
+                    for ev in events:
+                        new_state = self.model.handle_event(new_state, ev)
         except Exception as exc:  # noqa: BLE001 — fold failure → error ACK, no persist
             self.metrics.error_rate.record()
             resolve_future(env.reply, CommandFailure(exc))

@@ -78,10 +78,25 @@ class AggregateEventModel(Protocol[S, E]):
 
 
 def fold_events(model: AggregateCommandModel, state: Optional[S], events: Sequence[E]) -> Optional[S]:
-    """The scalar fold (reference: events.foldLeft at CommandModels.scala:20-21)."""
-    for ev in events:
-        state = model.handle_event(state, ev)
-    return state
+    """The scalar fold (reference: events.foldLeft at CommandModels.scala:20-21).
+
+    Prefers per-event ``handle_event``; falls back to a synchronous batch
+    ``handle_events``. Async-only models (e.g. the multilanguage gRPC model) cannot
+    fold offline — bulk restore must go through a scalar-capable model."""
+    import inspect
+
+    handle_event = getattr(model, "handle_event", None)
+    if handle_event is not None:
+        for ev in events:
+            state = handle_event(state, ev)
+        return state
+    batch = getattr(model, "handle_events", None)
+    if batch is not None and not inspect.iscoroutinefunction(batch):
+        return batch(state, list(events))
+    raise TypeError(
+        f"{type(model).__name__} has no synchronous fold (handle_event or "
+        f"non-async handle_events) — offline replay/restore is unavailable for "
+        f"async-only models")
 
 
 # --------------------------------------------------------------------------------------

@@ -1,0 +1,36 @@
+"""Multilanguage gRPC bridge — polyglot business apps over a sidecar engine.
+
+Capability parity with the reference's multilanguage modules (SURVEY.md §2.11):
+the protocol IDL lives in ``proto/multilanguage.proto`` (regenerate bindings with
+``proto/gen.sh``); :mod:`gateway` is the engine side (gateway service + the generic
+gRPC-backed processing model); :mod:`sdk` is the app side (CQRSModel + SerDeser +
+BusinessLogicServer + SurgeClient).
+"""
+
+from surge_tpu.multilanguage.gateway import (
+    BytesCommand,
+    BytesEvent,
+    GrpcBusinessModel,
+    MultilanguageGatewayServer,
+    generic_business_logic,
+)
+from surge_tpu.multilanguage.sdk import (
+    BusinessLogicServer,
+    CommandRejectedByApp,
+    CQRSModel,
+    SerDeser,
+    SurgeClient,
+)
+
+__all__ = [
+    "BusinessLogicServer",
+    "BytesCommand",
+    "BytesEvent",
+    "CQRSModel",
+    "CommandRejectedByApp",
+    "GrpcBusinessModel",
+    "MultilanguageGatewayServer",
+    "SerDeser",
+    "SurgeClient",
+    "generic_business_logic",
+]

@@ -1,0 +1,198 @@
+"""The multilanguage gateway: a Surge engine whose business logic lives in another
+process, reached over gRPC.
+
+Reference roles reproduced (SURVEY.md §2.11):
+
+- :class:`GrpcBusinessModel` — ``GenericAsyncAggregateCommandModel``
+  (modules/multilanguage/.../GenericAsyncAggregateCommandModel.scala:14-104): the
+  engine-side processing model whose ``process_command``/``handle_events`` are gRPC
+  calls to the business app's ``BusinessLogic`` service, timed with the
+  ``SURGE_GRPC_*``-equivalent metrics.
+- byte-payload formats — ``GenericSurgeCommandBusinessLogic`` (protobuf-bytes
+  read/write formatting, GenericSurgeCommandBusinessLogic.scala:14-43): the state
+  topic stores the app's opaque payload verbatim.
+- :class:`MultilanguageGatewayServer` — ``MultilanguageGatewayServer`` +
+  ``MultilanguageGatewayServiceImpl`` (MultilanguageGatewayServiceImpl.scala:29-82):
+  hosts ``MultilanguageGateway`` (ForwardCommand → ``aggregate_for(id).send_command``,
+  GetState → ``.get_state``, HealthCheck → the engine health tree).
+
+State on the wire is ``AggregateState(exists=False)`` for "no aggregate"; inside the
+engine, state is ``None`` or raw payload bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import grpc
+
+from surge_tpu.engine.business_logic import SurgeCommandBusinessLogic
+from surge_tpu.engine.entity import CommandRejected, CommandSuccess
+from surge_tpu.engine.model import RejectedCommand
+from surge_tpu.metrics import MetricInfo, Metrics
+from surge_tpu.multilanguage import multilanguage_pb2 as pb
+from surge_tpu.multilanguage.service import (
+    BUSINESS_METHODS,
+    BUSINESS_SERVICE,
+    GATEWAY_METHODS,
+    GATEWAY_SERVICE,
+    generic_handler,
+    unary_callables,
+)
+from surge_tpu.serialization import SerializedAggregate, SerializedMessage
+
+
+@dataclass(frozen=True)
+class BytesCommand:
+    """An opaque app command routed through the engine."""
+
+    aggregate_id: str
+    payload: bytes
+
+
+@dataclass(frozen=True)
+class BytesEvent:
+    """An opaque app event (the envelope keeps the aggregate id for the events
+    topic key and the HandleEvents callback)."""
+
+    aggregate_id: str
+    payload: bytes
+
+
+class GrpcBusinessModel:
+    """Async processing model delegating to the app's BusinessLogic service.
+
+    State is ``Optional[bytes]`` (the app's serialized state), events are raw
+    payload bytes — the engine never interprets them.
+    """
+
+    def __init__(self, channel: grpc.aio.Channel,
+                 metrics: Optional[Metrics] = None) -> None:
+        self._calls = unary_callables(channel, BUSINESS_SERVICE, BUSINESS_METHODS)
+        m = metrics or Metrics()
+        # the SURGE_GRPC_* call timers of GenericAsyncAggregateCommandModel.scala:24-38
+        self._process_timer = m.timer(MetricInfo(
+            "surge.grpc.process-command-timer",
+            "Round-trip latency of BusinessLogic.ProcessCommand"))
+        self._handle_timer = m.timer(MetricInfo(
+            "surge.grpc.handle-events-timer",
+            "Round-trip latency of BusinessLogic.HandleEvents"))
+
+    def initial_state(self, aggregate_id: str) -> Optional[bytes]:
+        return None
+
+    @staticmethod
+    def _wire_state(aggregate_id: str, state: Optional[bytes]) -> pb.AggregateState:
+        return pb.AggregateState(aggregate_id=aggregate_id, payload=state or b"",
+                                 exists=state is not None)
+
+    async def process_command(self, state: Optional[bytes],
+                              command: BytesCommand) -> Sequence[BytesEvent]:
+        req = pb.ProcessCommandRequest(
+            state=self._wire_state(command.aggregate_id, state),
+            command=pb.DomainCommand(aggregate_id=command.aggregate_id,
+                                     payload=command.payload))
+        with self._process_timer.time():
+            reply = await self._calls["ProcessCommand"](req)
+        if not reply.success:
+            raise RejectedCommand(reply.rejection or "rejected by business app")
+        return [BytesEvent(e.aggregate_id or command.aggregate_id, e.payload)
+                for e in reply.events]
+
+    async def handle_events(self, state: Optional[bytes],
+                            events: Sequence[BytesEvent]) -> Optional[bytes]:
+        if not events:
+            return state
+        agg_id = events[0].aggregate_id
+        req = pb.HandleEventsRequest(
+            state=self._wire_state(agg_id, state),
+            events=[pb.DomainEvent(aggregate_id=e.aggregate_id, payload=e.payload)
+                    for e in events])
+        with self._handle_timer.time():
+            reply = await self._calls["HandleEvents"](req)
+        return reply.state.payload if reply.state.exists else None
+
+
+class _PassthroughStateFormat:
+    """State bytes on the topic == the app's payload (protobuf-bytes formatting).
+
+    ``None`` state writes a tombstone (``value=None`` deletes the key from the
+    compacted topic), so an app state that legitimately serializes to zero bytes —
+    any all-default proto message — round-trips as ``exists=True, payload=b""``
+    instead of collapsing to "does not exist"."""
+
+    def write_state(self, state: Optional[bytes]) -> SerializedAggregate:
+        return SerializedAggregate(value=state)
+
+    def read_state(self, data: bytes) -> Optional[bytes]:
+        return bytes(data)
+
+
+class _PassthroughEventFormat:
+    def write_event(self, event: BytesEvent) -> SerializedMessage:
+        return SerializedMessage(key=event.aggregate_id, value=event.payload)
+
+    def read_event(self, msg: SerializedMessage) -> BytesEvent:
+        return BytesEvent(msg.key, msg.value)
+
+
+def generic_business_logic(aggregate_name: str, channel: grpc.aio.Channel,
+                           metrics: Optional[Metrics] = None
+                           ) -> SurgeCommandBusinessLogic:
+    """The GenericSurgeCommandBusinessLogic analog: byte payloads end to end."""
+    return SurgeCommandBusinessLogic(
+        aggregate_name=aggregate_name,
+        model=GrpcBusinessModel(channel, metrics),
+        state_format=_PassthroughStateFormat(),
+        event_format=_PassthroughEventFormat())
+
+
+class MultilanguageGatewayServer:
+    """gRPC server exposing an engine to polyglot apps (SidecarMain analog)."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.engine = engine
+        self._host = host
+        self._port = port
+        self._server: Optional[grpc.aio.Server] = None
+        self.bound_port: Optional[int] = None
+
+    # -- service implementation ----------------------------------------------------------
+
+    async def ForwardCommand(self, request: pb.ForwardCommandRequest,
+                             context) -> pb.ForwardCommandReply:
+        cmd = request.command
+        result = await self.engine.aggregate_for(cmd.aggregate_id).send_command(
+            BytesCommand(cmd.aggregate_id, cmd.payload))
+        if isinstance(result, CommandSuccess):
+            return pb.ForwardCommandReply(
+                success=True,
+                state=GrpcBusinessModel._wire_state(cmd.aggregate_id, result.state))
+        if isinstance(result, CommandRejected):
+            return pb.ForwardCommandReply(success=False, rejection=str(result.reason))
+        await context.abort(grpc.StatusCode.INTERNAL, str(result.error))
+
+    async def GetState(self, request: pb.GetStateRequest, context) -> pb.GetStateReply:
+        state = await self.engine.aggregate_for(request.aggregate_id).get_state()
+        return pb.GetStateReply(
+            state=GrpcBusinessModel._wire_state(request.aggregate_id, state))
+
+    async def HealthCheck(self, request: pb.HealthRequest, context) -> pb.HealthReply:
+        health = self.engine.health_check()
+        return pb.HealthReply(status="up" if health.is_healthy() else "down")
+
+    # -- lifecycle -----------------------------------------------------------------------
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (generic_handler(GATEWAY_SERVICE, GATEWAY_METHODS, self),))
+        self.bound_port = self._server.add_insecure_port(f"{self._host}:{self._port}")
+        await self._server.start()
+        return self.bound_port
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None

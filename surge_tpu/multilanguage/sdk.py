@@ -1,0 +1,154 @@
+"""App-side SDK: write pure CQRS handlers in any process, serve them to the engine.
+
+The Python counterpart of the reference's language SDKs
+(multilanguage-scala-sdk/.../ScalaSurge.scala:16-77 — ``CQRSModel`` of two pure
+functions + ``SerDeser`` + a server binding the BusinessLogicService; the C# SDK has
+the same shape, SurgeEngine.cs:12-80):
+
+- :class:`CQRSModel` — ``process_command(state, command) -> [events]`` (raise
+  :class:`CommandRejectedByApp` to reject) and ``handle_events(state, events) -> state``
+  over the app's own domain objects.
+- :class:`SerDeser` — app-object ⇄ bytes codecs for state/command/event.
+- :class:`BusinessLogicServer` — hosts the ``BusinessLogic`` gRPC service over the
+  model (the engine's :class:`~surge_tpu.multilanguage.gateway.GrpcBusinessModel`
+  calls it).
+- :class:`SurgeClient` — the app's typed handle on the gateway
+  (forward_command/get_state/health over ``MultilanguageGateway``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import grpc
+
+from surge_tpu.multilanguage import multilanguage_pb2 as pb
+from surge_tpu.multilanguage.service import (
+    BUSINESS_METHODS,
+    BUSINESS_SERVICE,
+    GATEWAY_METHODS,
+    GATEWAY_SERVICE,
+    generic_handler,
+    unary_callables,
+)
+
+
+class CommandRejectedByApp(Exception):
+    """Raised by app command handlers to reject a command (maps to a rejection
+    reply, not an error)."""
+
+
+@dataclass
+class CQRSModel:
+    """Two pure functions over app domain objects (scala-sdk Model.scala analog)."""
+
+    process_command: Callable[[Optional[Any], Any], Sequence[Any]]
+    handle_events: Callable[[Optional[Any], Sequence[Any]], Optional[Any]]
+
+
+@dataclass
+class SerDeser:
+    """App-object ⇄ bytes codecs (scala-sdk SerDeser analog)."""
+
+    serialize_state: Callable[[Any], bytes]
+    deserialize_state: Callable[[bytes], Any]
+    serialize_event: Callable[[Any], bytes]
+    deserialize_event: Callable[[bytes], Any]
+    serialize_command: Callable[[Any], bytes]
+    deserialize_command: Callable[[bytes], Any]
+
+
+class BusinessLogicServer:
+    """Hosts the app's CQRSModel as the BusinessLogic gRPC service."""
+
+    def __init__(self, model: CQRSModel, serdes: SerDeser,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.model = model
+        self.serdes = serdes
+        self._host = host
+        self._port = port
+        self._server: Optional[grpc.aio.Server] = None
+        self.bound_port: Optional[int] = None
+
+    def _state_in(self, wire: pb.AggregateState) -> Optional[Any]:
+        return self.serdes.deserialize_state(wire.payload) if wire.exists else None
+
+    def _state_out(self, aggregate_id: str, state: Optional[Any]) -> pb.AggregateState:
+        if state is None:
+            return pb.AggregateState(aggregate_id=aggregate_id, exists=False)
+        return pb.AggregateState(aggregate_id=aggregate_id,
+                                 payload=self.serdes.serialize_state(state),
+                                 exists=True)
+
+    # -- service implementation ----------------------------------------------------------
+
+    async def ProcessCommand(self, request: pb.ProcessCommandRequest,
+                             context) -> pb.ProcessCommandReply:
+        state = self._state_in(request.state)
+        command = self.serdes.deserialize_command(request.command.payload)
+        try:
+            events = self.model.process_command(state, command)
+        except CommandRejectedByApp as rej:
+            return pb.ProcessCommandReply(success=False, rejection=str(rej))
+        agg = request.command.aggregate_id
+        return pb.ProcessCommandReply(success=True, events=[
+            pb.DomainEvent(aggregate_id=agg,
+                           payload=self.serdes.serialize_event(e))
+            for e in events])
+
+    async def HandleEvents(self, request: pb.HandleEventsRequest,
+                           context) -> pb.HandleEventsReply:
+        state = self._state_in(request.state)
+        events = [self.serdes.deserialize_event(e.payload) for e in request.events]
+        new_state = self.model.handle_events(state, events)
+        return pb.HandleEventsReply(
+            state=self._state_out(request.state.aggregate_id, new_state))
+
+    async def HealthCheck(self, request: pb.HealthRequest, context) -> pb.HealthReply:
+        return pb.HealthReply(status="up")
+
+    # -- lifecycle -----------------------------------------------------------------------
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (generic_handler(BUSINESS_SERVICE, BUSINESS_METHODS, self),))
+        self.bound_port = self._server.add_insecure_port(f"{self._host}:{self._port}")
+        await self._server.start()
+        return self.bound_port
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None
+
+
+class SurgeClient:
+    """Typed app handle on the gateway (ScalaSurgeServer's client analog)."""
+
+    def __init__(self, channel: grpc.aio.Channel, serdes: SerDeser) -> None:
+        self._calls = unary_callables(channel, GATEWAY_SERVICE, GATEWAY_METHODS)
+        self.serdes = serdes
+
+    async def forward_command(self, aggregate_id: str, command: Any
+                              ) -> Tuple[bool, Optional[Any], str]:
+        """Returns (success, state, rejection_reason)."""
+        reply = await self._calls["ForwardCommand"](pb.ForwardCommandRequest(
+            command=pb.DomainCommand(
+                aggregate_id=aggregate_id,
+                payload=self.serdes.serialize_command(command))))
+        if not reply.success:
+            return False, None, reply.rejection
+        state = (self.serdes.deserialize_state(reply.state.payload)
+                 if reply.state.exists else None)
+        return True, state, ""
+
+    async def get_state(self, aggregate_id: str) -> Optional[Any]:
+        reply = await self._calls["GetState"](
+            pb.GetStateRequest(aggregate_id=aggregate_id))
+        return (self.serdes.deserialize_state(reply.state.payload)
+                if reply.state.exists else None)
+
+    async def health(self) -> str:
+        return (await self._calls["HealthCheck"](pb.HealthRequest())).status

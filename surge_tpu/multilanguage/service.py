@@ -1,0 +1,57 @@
+"""Hand-written gRPC service glue (grpcio-tools is not available to codegen stubs).
+
+Method tables for the two services in proto/multilanguage.proto; servers register
+them via :func:`generic_handler`, clients build typed callables via
+:func:`unary_callables`. Equivalent surface to the generated ``*_pb2_grpc`` modules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping
+
+import grpc
+
+from surge_tpu.multilanguage import multilanguage_pb2 as pb
+
+_PACKAGE = "surge_tpu.multilanguage"
+
+GATEWAY_SERVICE = f"{_PACKAGE}.MultilanguageGateway"
+BUSINESS_SERVICE = f"{_PACKAGE}.BusinessLogic"
+
+#: method name -> (request message class, reply message class)
+GATEWAY_METHODS: Dict[str, tuple] = {
+    "ForwardCommand": (pb.ForwardCommandRequest, pb.ForwardCommandReply),
+    "GetState": (pb.GetStateRequest, pb.GetStateReply),
+    "HealthCheck": (pb.HealthRequest, pb.HealthReply),
+}
+
+BUSINESS_METHODS: Dict[str, tuple] = {
+    "ProcessCommand": (pb.ProcessCommandRequest, pb.ProcessCommandReply),
+    "HandleEvents": (pb.HandleEventsRequest, pb.HandleEventsReply),
+    "HealthCheck": (pb.HealthRequest, pb.HealthReply),
+}
+
+
+def generic_handler(service_name: str, methods: Mapping[str, tuple],
+                    implementation: Any) -> grpc.GenericRpcHandler:
+    """Build a server handler mapping each method to ``implementation.<Method>``
+    (an async callable ``(request, context) -> reply``)."""
+    rpc_handlers = {}
+    for name, (req_cls, reply_cls) in methods.items():
+        fn = getattr(implementation, name)
+        rpc_handlers[name] = grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=req_cls.FromString,
+            response_serializer=reply_cls.SerializeToString)
+    return grpc.method_handlers_generic_handler(service_name, rpc_handlers)
+
+
+def unary_callables(channel: grpc.aio.Channel, service_name: str,
+                    methods: Mapping[str, tuple]) -> Dict[str, Callable]:
+    """Typed client callables ``{method: async fn(request) -> reply}``."""
+    out = {}
+    for name, (req_cls, reply_cls) in methods.items():
+        out[name] = channel.unary_unary(
+            f"/{service_name}/{name}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=reply_cls.FromString)
+    return out

@@ -1,0 +1,236 @@
+"""Multilanguage bridge: full polyglot loop over real gRPC sockets.
+
+The MultilanguageGatewayServiceImplSpec analog (SURVEY.md §4.5): a "business app"
+(pure CQRSModel + JSON SerDeser, scala-sdk-sample Main.scala analog) serves the
+BusinessLogic service; the engine runs the generic byte-payload model whose
+process_command/handle_events are gRPC calls to it; the app drives commands through
+the gateway service and reads state back. Everything over loopback sockets — two
+real processes' worth of protocol on one loop.
+"""
+
+import asyncio
+import json
+
+import grpc
+import pytest
+
+from surge_tpu import default_config
+from surge_tpu.dsl import create_engine
+from surge_tpu.multilanguage import (
+    BusinessLogicServer,
+    CommandRejectedByApp,
+    CQRSModel,
+    MultilanguageGatewayServer,
+    SerDeser,
+    SurgeClient,
+    generic_business_logic,
+)
+
+CFG = default_config().with_overrides({
+    "surge.producer.flush-interval-ms": 5,
+    "surge.producer.ktable-check-interval-ms": 5,
+    "surge.state-store.commit-interval-ms": 20,
+    "surge.aggregate.init-retry-interval-ms": 5,
+    "surge.engine.num-partitions": 2,
+})
+
+
+# --- the "polyglot" app: a bank account in plain dicts + JSON --------------------------
+
+
+def process_command(state, command):
+    kind = command["kind"]
+    if kind == "create":
+        if state is not None:
+            return []
+        return [{"kind": "created", "owner": command["owner"],
+                 "balance": command["balance"]}]
+    if state is None:
+        raise CommandRejectedByApp("account does not exist")
+    if kind == "credit":
+        return [{"kind": "updated", "balance": state["balance"] + command["amount"]}]
+    if kind == "debit":
+        if state["balance"] < command["amount"]:
+            raise CommandRejectedByApp("insufficient funds")
+        return [{"kind": "updated", "balance": state["balance"] - command["amount"]}]
+    raise CommandRejectedByApp(f"unknown command {kind}")
+
+
+def handle_events(state, events):
+    for e in events:
+        if e["kind"] == "created":
+            state = {"owner": e["owner"], "balance": e["balance"]}
+        elif e["kind"] == "updated" and state is not None:
+            state = {**state, "balance": e["balance"]}
+    return state
+
+
+def json_serdes() -> SerDeser:
+    enc = lambda o: json.dumps(o, sort_keys=True).encode()
+    dec = lambda b: json.loads(b)
+    return SerDeser(enc, dec, enc, dec, enc, dec)
+
+
+def test_full_polyglot_loop():
+    async def scenario():
+        serdes = json_serdes()
+        app_server = BusinessLogicServer(
+            CQRSModel(process_command, handle_events), serdes)
+        app_port = await app_server.start()
+
+        business_channel = grpc.aio.insecure_channel(f"127.0.0.1:{app_port}")
+        engine = create_engine(
+            generic_business_logic("bank", business_channel), config=CFG)
+        await engine.start()
+        gateway = MultilanguageGatewayServer(engine)
+        gw_port = await gateway.start()
+
+        gw_channel = grpc.aio.insecure_channel(f"127.0.0.1:{gw_port}")
+        client = SurgeClient(gw_channel, serdes)
+
+        # create + credit + debit through the full loop
+        ok, state, _ = await client.forward_command(
+            "acct-1", {"kind": "create", "owner": "pat", "balance": 100})
+        assert ok and state == {"owner": "pat", "balance": 100}
+        ok, state, _ = await client.forward_command(
+            "acct-1", {"kind": "credit", "amount": 50})
+        assert ok and state["balance"] == 150
+        ok, state, reason = await client.forward_command(
+            "acct-1", {"kind": "debit", "amount": 1000})
+        assert not ok and "insufficient" in reason
+
+        # rejection for a missing aggregate
+        ok, _, reason = await client.forward_command(
+            "acct-404", {"kind": "credit", "amount": 1})
+        assert not ok and "does not exist" in reason
+
+        # read path + health
+        state = await client.get_state("acct-1")
+        assert state == {"owner": "pat", "balance": 150}
+        assert await client.get_state("acct-404") is None
+        assert await client.health() == "up"
+
+        # the engine really persisted opaque payloads: events topic holds the app's
+        # JSON, state topic the serialized state — all uninterpreted by the engine
+        evs = []
+        for p in range(2):
+            evs += [json.loads(r.value) for r in engine.log.read("bank-events", p)]
+        assert {e["kind"] for e in evs} == {"created", "updated"}
+
+        await gateway.stop()
+        await engine.stop()
+        await app_server.stop()
+        await business_channel.close()
+        await gw_channel.close()
+
+    asyncio.run(scenario())
+
+
+def test_engine_restart_refolds_through_app(tmp_path):
+    """Cold restart: the engine re-reads opaque state bytes it cannot interpret and
+    the app keeps working — proving state ownership stays app-side."""
+    async def scenario():
+        from surge_tpu.log import InMemoryLog
+
+        serdes = json_serdes()
+        app_server = BusinessLogicServer(
+            CQRSModel(process_command, handle_events), serdes)
+        app_port = await app_server.start()
+        ch = grpc.aio.insecure_channel(f"127.0.0.1:{app_port}")
+        log = InMemoryLog()
+
+        engine = create_engine(generic_business_logic("bank", ch), log=log, config=CFG)
+        await engine.start()
+        gw = MultilanguageGatewayServer(engine)
+        port = await gw.start()
+        client = SurgeClient(grpc.aio.insecure_channel(f"127.0.0.1:{port}"), serdes)
+        await client.forward_command("a", {"kind": "create", "owner": "x", "balance": 7})
+        await gw.stop()
+        await engine.stop()
+
+        engine2 = create_engine(generic_business_logic("bank", ch), log=log, config=CFG)
+        await engine2.start()
+        gw2 = MultilanguageGatewayServer(engine2)
+        port2 = await gw2.start()
+        client2 = SurgeClient(grpc.aio.insecure_channel(f"127.0.0.1:{port2}"), serdes)
+        ok, state, _ = await client2.forward_command("a", {"kind": "credit", "amount": 3})
+        assert ok and state == {"owner": "x", "balance": 10}
+        await gw2.stop()
+        await engine2.stop()
+        await app_server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_empty_bytes_state_round_trips_as_existing():
+    """Regression: an app state serializing to ZERO bytes (any all-default proto
+    message) must survive restart as exists=True — not collapse to 'no aggregate'.
+    None state instead writes a tombstone."""
+    async def scenario():
+        from surge_tpu.log import InMemoryLog
+
+        # state is a plain counter int; 0 serializes to b"" on purpose
+        def ser_state(n):
+            return b"" if n == 0 else str(n).encode()
+
+        def deser_state(b):
+            return 0 if b == b"" else int(b)
+
+        enc = lambda o: json.dumps(o).encode()
+        dec = lambda b: json.loads(b)
+        serdes = SerDeser(ser_state, deser_state, enc, dec, enc, dec)
+
+        def pc(state, command):
+            if command["kind"] == "init":
+                if state is not None:
+                    raise CommandRejectedByApp("already exists")
+                return [{"kind": "set", "value": 0}]
+            return [{"kind": "set", "value": command["value"]}]
+
+        def he(state, events):
+            for e in events:
+                state = e["value"]
+            return state
+
+        app = BusinessLogicServer(CQRSModel(pc, he), serdes)
+        port = await app.start()
+        ch = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        log = InMemoryLog()
+
+        engine = create_engine(generic_business_logic("ctr", ch), log=log, config=CFG)
+        await engine.start()
+        gw = MultilanguageGatewayServer(engine)
+        gwp = await gw.start()
+        client = SurgeClient(grpc.aio.insecure_channel(f"127.0.0.1:{gwp}"), serdes)
+        ok, state, _ = await client.forward_command("c1", {"kind": "init"})
+        assert ok and state == 0
+        await gw.stop(); await engine.stop()
+
+        # restart: the zero-byte state must still exist (init is rejected)
+        engine2 = create_engine(generic_business_logic("ctr", ch), log=log, config=CFG)
+        await engine2.start()
+        gw2 = MultilanguageGatewayServer(engine2)
+        gwp2 = await gw2.start()
+        client2 = SurgeClient(grpc.aio.insecure_channel(f"127.0.0.1:{gwp2}"), serdes)
+        ok, _, reason = await client2.forward_command("c1", {"kind": "init"})
+        assert not ok and "already exists" in reason
+        state = await client2.get_state("c1")
+        assert state == 0
+        await gw2.stop(); await engine2.stop(); await app.stop()
+
+    asyncio.run(scenario())
+
+
+def test_async_only_model_cannot_bulk_restore():
+    """fold_events must fail with a clear error for async-only models instead of
+    an AttributeError deep in the restore path."""
+    from surge_tpu.engine.model import fold_events
+    from surge_tpu.multilanguage.gateway import GrpcBusinessModel
+
+    class _FakeChannel:
+        def unary_unary(self, *a, **kw):
+            return lambda req: None
+
+    model = GrpcBusinessModel(_FakeChannel())
+    with pytest.raises(TypeError, match="async-only"):
+        fold_events(model, None, [b"x"])
