@@ -7,8 +7,8 @@ ops per event). This module shrinks the wire to the information actually present
 - The **type discriminant** and every union column with a declared ``FieldSpec.bits``
   width are packed into one little-endian word of ``ceil(total_bits/8)`` bytes per
   event (``packed``: uint8 ``[T, B, nbytes]``). The Counter fixture's events — type
-  (3 bits incl. padding sentinel) + increment_by (4) + decrement_by (4) — fit in
-  **two bytes per event**, 8× less wire than the naive int32 columns.
+  (3 bits incl. padding sentinel) + increment_by (2) + decrement_by (2) — fit in
+  **one byte per event**, 16× less wire than the naive int32 columns.
 - Columns without ``bits`` ride as full-width **side** arrays ``[T, B]`` (floats,
   wide ints).
 - **Derived columns** never cross the wire at all: a data producer that knows a column

@@ -65,8 +65,16 @@ DEFAULTS: dict[str, Any] = {
     "surge.health.window-buffer-size": 10,
     "surge.health.signal-buffer-size": 25,
     "surge.health.supervisor-restart-max": 3,
+    # --- event-loop starvation prober (execution-context-prober analog) ---
+    "surge.event-loop-prober.enabled": True,
+    "surge.event-loop-prober.interval-ms": 1_000,
+    "surge.event-loop-prober.threshold-ms": 200,
+    "surge.event-loop-prober.late-probes": 3,
     # --- feature flags (core reference.conf:64-71) ---
     "surge.feature-flags.experimental.enable-mesh-sharding": False,
+    # alternative clustering backend (external shard allocation; the
+    # enable-akka-cluster analog, core reference.conf:64-66)
+    "surge.feature-flags.experimental.enable-cluster-sharding": False,
     "surge.feature-flags.experimental.disable-single-record-transactions": False,
     # --- engine ---
     "surge.engine.num-partitions": 8,

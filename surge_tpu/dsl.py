@@ -37,14 +37,19 @@ def create_engine(business_logic: SurgeCommandBusinessLogic, *, log=None,
                   config: Optional[Config] = None,
                   local_host: Optional[HostPort] = None,
                   tracker: Optional[PartitionTracker] = None,
-                  remote_deliver=None, mesh=None, tracer=None) -> SurgeEngine:
+                  remote_deliver=None, mesh=None, tracer=None,
+                  membership=None, shard_allocation=None) -> SurgeEngine:
     """Build (not start) an engine — ``SurgeCommand(businessLogic)`` equivalent.
 
     Single-node by default (in-memory log, self-assigned partitions); pass a shared
-    ``tracker``/``remote_deliver`` for multi-node routing (SURVEY.md §2.10)."""
+    ``tracker``/``remote_deliver`` for multi-node routing (SURVEY.md §2.10).
+    With ``surge.feature-flags.experimental.enable-cluster-sharding`` the engine uses
+    the external-shard-allocation backend; share ``membership``/``shard_allocation``
+    across the cluster's engines (surge_tpu.engine.cluster)."""
     return SurgeEngine(business_logic, log=log, config=config, local_host=local_host,
                        tracker=tracker, remote_deliver=remote_deliver, mesh=mesh,
-                       tracer=tracer)
+                       tracer=tracer, membership=membership,
+                       shard_allocation=shard_allocation)
 
 
 class SurgeEngineBuilder:
@@ -80,6 +85,16 @@ class SurgeEngineBuilder:
 
     def with_mesh(self, mesh) -> "SurgeEngineBuilder":
         self._kwargs["mesh"] = mesh
+        return self
+
+    def with_membership(self, membership) -> "SurgeEngineBuilder":
+        """Shared ClusterMembership for the cluster-sharding backend."""
+        self._kwargs["membership"] = membership
+        return self
+
+    def with_shard_allocation(self, allocation) -> "SurgeEngineBuilder":
+        """Shared ExternalShardAllocation for the cluster-sharding backend."""
+        self._kwargs["shard_allocation"] = allocation
         return self
 
     def build(self) -> SurgeEngine:

@@ -1,0 +1,233 @@
+"""Alternative clustering backend: explicit membership + external shard allocation.
+
+The reference ships two interchangeable routing backends selected by
+``surge.feature-flags.experimental.enable-akka-cluster``
+(core reference.conf:64-66, SurgePartitionRouterImpl.scala:34-161): the default
+partition-sharding router, and Akka Cluster Sharding with an
+``ExternalShardAllocationStrategy`` where shard id == partition number and a
+rebalance listener drives allocations (KafkaClusterShardingRebalanceListener
+.scala:17-183: join seeds with lowest-address bootstrap, update shard→member
+allocations, start/stop per-partition regions).
+
+TPU-native re-derivation (no Akka): plain registries on the event loop —
+
+- :class:`ClusterMembership` — the member set; the lowest address is the leader
+  (the "lowest-address node bootstraps the cluster" rule, :144-159).
+- :class:`ExternalShardAllocation` — the explicit shard→member table + listeners
+  (ExternalShardAllocationStrategy.updateShardLocations, :163-177).
+- :class:`ClusterShardingRouter` — same delivery surface as
+  :class:`~surge_tpu.engine.router.SurgePartitionRouter`, but ownership comes from
+  the allocation table, and a partition-tracker listener (the rebalance listener
+  role) lets THE LEADER translate partition assignments into allocations for the
+  whole cluster (:83-116).
+
+Engines select the backend with
+``surge.feature-flags.experimental.enable-cluster-sharding``; multi-node setups
+share one membership + allocation + tracker across engines (in one process for
+tests, over the control plane in production).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Mapping, Optional
+
+from surge_tpu.common import Ack, logger
+from surge_tpu.engine.partition import (
+    AssignmentChanges,
+    HostPort,
+    PartitionAssignments,
+    PartitionTracker,
+    partition_by_up_to_colon,
+)
+from surge_tpu.engine.router import RegionCreator, RemoteDeliver, RouterBase
+
+
+class ClusterMembership:
+    """Cluster member registry. Leader = lowest (host, port) — deterministic without
+    coordination, mirroring the reference's lowest-address bootstrap rule."""
+
+    def __init__(self) -> None:
+        self._members: List[HostPort] = []
+        self._listeners: List[Callable[[List[HostPort]], None]] = []
+
+    @property
+    def members(self) -> List[HostPort]:
+        return list(self._members)
+
+    @property
+    def leader(self) -> Optional[HostPort]:
+        return min(self._members) if self._members else None
+
+    def join(self, member: HostPort) -> None:
+        if member not in self._members:
+            self._members.append(member)
+            self._members.sort()
+            self._broadcast()
+
+    def leave(self, member: HostPort) -> None:
+        if member in self._members:
+            self._members.remove(member)
+            self._broadcast()
+
+    def subscribe(self, fn: Callable[[List[HostPort]], None]) -> None:
+        self._listeners.append(fn)
+
+    def unsubscribe(self, fn: Callable[[List[HostPort]], None]) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def _broadcast(self) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(self.members)
+            except Exception:  # noqa: BLE001
+                logger.exception("membership listener failed")
+
+
+class ExternalShardAllocation:
+    """Explicit shard(=partition)→member table with change listeners."""
+
+    def __init__(self) -> None:
+        self._locations: Dict[int, HostPort] = {}
+        self._listeners: List[Callable[[Mapping[int, HostPort]], None]] = []
+
+    @property
+    def locations(self) -> Dict[int, HostPort]:
+        return dict(self._locations)
+
+    def location_of(self, shard: int) -> Optional[HostPort]:
+        return self._locations.get(shard)
+
+    def update_shard_locations(self, mapping: Mapping[int, HostPort]) -> None:
+        """updateShardLocations: merge the new shard→member entries and notify."""
+        changed = {s: m for s, m in mapping.items()
+                   if self._locations.get(s) != m}
+        if not changed:
+            return
+        self._locations.update(changed)
+        self._broadcast()
+
+    def deallocate_member(self, member: HostPort) -> None:
+        """Drop every shard allocated to ``member`` (it left the cluster); deliveries
+        for those shards buffer until the leader re-allocates them."""
+        dropped = [s for s, m in self._locations.items() if m == member]
+        if not dropped:
+            return
+        for s in dropped:
+            del self._locations[s]
+        self._broadcast()
+
+    def subscribe(self, fn: Callable[[Mapping[int, HostPort]], None]) -> None:
+        self._listeners.append(fn)
+
+    def unsubscribe(self, fn: Callable[[Mapping[int, HostPort]], None]) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def _broadcast(self) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(dict(self._locations))
+            except Exception:  # noqa: BLE001
+                logger.exception("shard allocation listener failed")
+
+
+class ClusterShardingRouter(RouterBase):
+    """Shard-allocation-driven router; delivery surface identical to
+    :class:`SurgePartitionRouter` (both extend ``RouterBase``) so the engine can
+    swap backends by flag. Shard id == partition number
+    (KafkaShardingClassicMessageExtractor)."""
+
+    health_name = "cluster-router"
+
+    def __init__(self, num_partitions: int, tracker: PartitionTracker,
+                 local_host: HostPort, region_creator: RegionCreator,
+                 membership: Optional[ClusterMembership] = None,
+                 allocation: Optional[ExternalShardAllocation] = None,
+                 partition_by: Callable[[str], str] = partition_by_up_to_colon,
+                 remote_deliver: Optional[RemoteDeliver] = None,
+                 pending_limit: int = 1000) -> None:
+        super().__init__(num_partitions, local_host, region_creator,
+                         partition_by=partition_by, remote_deliver=remote_deliver,
+                         pending_limit=pending_limit)
+        self.tracker = tracker
+        self.membership = membership if membership is not None else ClusterMembership()
+        self.allocation = (allocation if allocation is not None
+                           else ExternalShardAllocation())
+
+    def owner_of(self, partition: int) -> Optional[HostPort]:
+        return self.allocation.location_of(partition)
+
+    # -- lifecycle ----------------------------------------------------------------------
+
+    async def start(self) -> Ack:
+        self._started = True
+        self.allocation.subscribe(self._on_allocations)
+        self.membership.subscribe(self._on_membership)
+        self.tracker.register(self._on_assignments)
+        self.membership.join(self.local_host)  # join seeds (:144-159)
+        return Ack()
+
+    async def stop(self) -> Ack:
+        self._started = False
+        self.tracker.unregister(self._on_assignments)
+        self.membership.leave(self.local_host)
+        self.membership.unsubscribe(self._on_membership)
+        self.allocation.unsubscribe(self._on_allocations)
+        await self._shutdown_regions()
+        return Ack()
+
+    # -- rebalance listener (KafkaClusterShardingRebalanceListener) ----------------------
+
+    def _on_assignments(self, assignments: PartitionAssignments,
+                        changes: AssignmentChanges) -> None:
+        """Translate partition assignments into shard allocations — leader only
+        (:163-177); every node then reacts to the allocation change."""
+        if not self._started:
+            return
+        if self.membership.leader != self.local_host:
+            return
+        self.allocation.update_shard_locations(
+            {p: hp for hp, parts in assignments.assignments.items() for p in parts})
+
+    def _on_membership(self, members) -> None:
+        """Departed members must not keep owning shards: the leader drops their
+        allocations and re-derives placements from the current tracker assignments
+        (deliveries for still-unowned shards buffer meanwhile)."""
+        if not self._started or self.membership.leader != self.local_host:
+            return
+        member_set = set(members)
+        for gone in {m for m in self.allocation.locations.values()
+                     if m not in member_set}:
+            self.allocation.deallocate_member(gone)
+        live = {p: hp
+                for hp, parts in self.tracker.assignments.assignments.items()
+                for p in parts if hp in member_set}
+        if live:
+            self.allocation.update_shard_locations(live)
+
+    def _on_allocations(self, locations: Mapping[int, HostPort]) -> None:
+        if not self._started:
+            return
+        # stop regions for shards allocated away (:83-116 producer stop)
+        for shard in [s for s in list(self._regions)
+                      if locations.get(s) != self.local_host]:
+            self._stop_region(shard, "re-allocated")
+        # start regions for newly local shards; drain buffered deliveries
+        for shard, owner in locations.items():
+            if owner == self.local_host and shard not in self._regions:
+                self._create_region(shard)
+        self._drain_pending()
+
+    # -- health -------------------------------------------------------------------------
+
+    def health(self) -> dict:
+        out = super().health()
+        out["members"] = [str(m) for m in self.membership.members]
+        out["leader"] = (str(self.membership.leader)
+                         if self.membership.leader else None)
+        return out

@@ -1,0 +1,113 @@
+"""Event-only engine DSL — the SurgeEvent surface.
+
+Reference: the event-engine side of the scaladsl (scaladsl/event/SurgeEvent.scala:19-59,
+AggregateEventModel.scala:10-38, SurgeEventServiceModel.scala:15-46): models implement
+only the event fold (``handle_event`` / async batch ``handle_events``); there is no
+command side — the engine publishes state snapshots only (no events topic), and the
+client surface is ``apply_events`` + ``get_state`` (``sendCommand`` does not exist; the
+core model's ``handle`` throws in the reference, AggregateEventModel.scala:24).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from surge_tpu.config import Config
+from surge_tpu.engine.business_logic import SurgeCommandBusinessLogic
+from surge_tpu.engine.pipeline import SurgeEngine
+
+
+class _EventOnlyModel:
+    """Adapts an event model (handle_event / handle_events, optional initial_state)
+    to the engine's processing-model port, with the command side closed off."""
+
+    def __init__(self, event_model: Any) -> None:
+        self._inner = event_model
+        handle_event = getattr(event_model, "handle_event", None)
+        if handle_event is not None:
+            self.handle_event = handle_event
+        batch = getattr(event_model, "handle_events", None)
+        if batch is not None:
+            self.handle_events = batch
+        if handle_event is None and batch is None:
+            raise TypeError(
+                f"{type(event_model).__name__} must define handle_event or "
+                f"handle_events")
+        replay = getattr(event_model, "replay_spec", None)
+        if replay is not None:
+            self.replay_spec = replay
+
+    def initial_state(self, aggregate_id: str) -> Any:
+        fn = getattr(self._inner, "initial_state", None)
+        return fn(aggregate_id) if fn is not None else None
+
+    def process_command(self, state: Any, command: Any):
+        raise TypeError(
+            "event engines do not process commands — use apply_events "
+            "(AggregateEventModel.scala:24 throws the same way)")
+
+
+def event_business_logic(aggregate_name: str, event_model: Any, state_format: Any,
+                         **kwargs) -> SurgeCommandBusinessLogic:
+    """SurgeEventServiceModel analog: state topic only, no events topic."""
+    return SurgeCommandBusinessLogic(
+        aggregate_name=aggregate_name, model=_EventOnlyModel(event_model),
+        state_format=state_format, event_format=_NoEventFormat(),
+        publish_state_only=True, **kwargs)
+
+
+class _NoEventFormat:
+    """Event engines never serialize events (publish_state_only short-circuits the
+    events-topic path); reaching this is a wiring bug."""
+
+    def write_event(self, event: Any):
+        raise TypeError("event engines do not publish events")
+
+    def read_event(self, msg: Any):
+        raise TypeError("event engines do not read events")
+
+
+class EventAggregateRef:
+    """The event-engine client handle: apply_events + get_state only
+    (scaladsl/event — no sendCommand exists on this surface)."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+        self.aggregate_id = inner.aggregate_id
+
+    async def apply_events(self, events):
+        return await self._inner.apply_events(events)
+
+    async def get_state(self) -> Optional[Any]:
+        return await self._inner.get_state()
+
+
+class SurgeEventEngine:
+    """Thin wrapper giving the event-engine client surface over a SurgeEngine."""
+
+    def __init__(self, engine: SurgeEngine) -> None:
+        self.engine = engine
+
+    def aggregate_for(self, aggregate_id: str) -> EventAggregateRef:
+        return EventAggregateRef(self.engine.aggregate_for(aggregate_id))
+
+    async def start(self):
+        return await self.engine.start()
+
+    async def stop(self):
+        return await self.engine.stop()
+
+    def health_check(self):
+        return self.engine.health_check()
+
+    @property
+    def status(self):
+        return self.engine.status
+
+
+def create_event_engine(aggregate_name: str, event_model: Any, state_format: Any,
+                        *, log=None, config: Optional[Config] = None,
+                        **engine_kwargs) -> SurgeEventEngine:
+    """``SurgeEvent(businessLogic)`` equivalent (scaladsl/event/SurgeEvent.scala:19-59)."""
+    logic = event_business_logic(aggregate_name, event_model, state_format)
+    return SurgeEventEngine(SurgeEngine(logic, log=log, config=config, **engine_kwargs))

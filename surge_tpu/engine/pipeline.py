@@ -81,7 +81,8 @@ class SurgeEngine(Controllable):
                  config: Config | None = None,
                  local_host: HostPort | None = None,
                  tracker: PartitionTracker | None = None,
-                 remote_deliver=None, mesh=None, tracer=None) -> None:
+                 remote_deliver=None, mesh=None, tracer=None,
+                 membership=None, shard_allocation=None) -> None:
         self.logic = logic
         self.config = config or default_config()
         self.log = log if log is not None else InMemoryLog()
@@ -104,14 +105,30 @@ class SurgeEngine(Controllable):
         self.health_bus = HealthSignalBus(
             self.config.get_int("surge.health.signal-buffer-size", 25))
         self.health_supervisor = HealthSupervisor(self.health_bus, self.config)
+        from surge_tpu.health.prober import EventLoopProber
+
+        self.loop_prober = (EventLoopProber(
+            self.config, on_signal=self.health_bus.signal_fn("event-loop"))
+            if self.config.get_bool("surge.event-loop-prober.enabled") else None)
         self.surge_model = SurgeModel(logic, self.config)
         self.indexer = StateStoreIndexer(self.log, logic.state_topic, config=self.config,
                                          on_signal=self.health_bus.signal_fn("state-store"))
-        self.router = SurgePartitionRouter(
-            num_partitions=self.num_partitions, tracker=self.tracker,
-            local_host=self.local_host, region_creator=self._create_region,
-            remote_deliver=remote_deliver,
-            dr_standby=self.config.get_bool("surge.engine.dr-standby-enabled"))
+        # routing backend selection by feature flag (SurgePartitionRouterImpl.scala:
+        # 34-161 picks between the partition router and cluster sharding the same way)
+        if self.config.get_bool("surge.feature-flags.experimental.enable-cluster-sharding"):
+            from surge_tpu.engine.cluster import ClusterShardingRouter
+
+            self.router = ClusterShardingRouter(
+                num_partitions=self.num_partitions, tracker=self.tracker,
+                local_host=self.local_host, region_creator=self._create_region,
+                membership=membership, allocation=shard_allocation,
+                remote_deliver=remote_deliver)
+        else:
+            self.router = SurgePartitionRouter(
+                num_partitions=self.num_partitions, tracker=self.tracker,
+                local_host=self.local_host, region_creator=self._create_region,
+                remote_deliver=remote_deliver,
+                dr_standby=self.config.get_bool("surge.engine.dr-standby-enabled"))
         self._rebalance_listeners: List[Callable] = []
 
     # -- lifecycle (SurgeMessagePipeline.scala:185-240) ----------------------------------
@@ -127,6 +144,8 @@ class SurgeEngine(Controllable):
                 "state-store", self.indexer,
                 restart_patterns=[RegexMatcher(r"state-store.*fatal")])
             self.health_supervisor.start()
+            if self.loop_prober is not None:
+                self.loop_prober.start()
             await self.indexer.start()
             await self.router.start()
             if not self._external_tracker and not self.tracker.assignments.assignments:
@@ -137,11 +156,18 @@ class SurgeEngine(Controllable):
             return Ack()
         except Exception:
             self.status = EngineStatus.FAILED
+            # unwind partially-started observability tasks: a failed engine must not
+            # leave the prober ticking or the supervisor subscribed forever
+            self.health_supervisor.stop()
+            if self.loop_prober is not None:
+                await self.loop_prober.stop()
             raise
 
     async def stop(self) -> Ack:
         self.status = EngineStatus.STOPPING
         self.health_supervisor.stop()
+        if self.loop_prober is not None:
+            await self.loop_prober.stop()
         await self.router.stop()  # stops regions (shards + publishers)
         await self.indexer.stop()
         self.surge_model.close()

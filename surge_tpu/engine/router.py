@@ -34,44 +34,33 @@ class NoRouteError(Exception):
     """No assignment known for the key's partition and no buffering headroom."""
 
 
-class SurgePartitionRouter(Controllable):
-    """Routes envelopes for one aggregate family across partitions/hosts."""
+class RouterBase(Controllable):
+    """Shared routing machinery: key→partition hashing, pending-buffering while the
+    owner is unknown, local-vs-remote dispatch, lazy region creation, and the
+    health/regions accessors. Backends differ only in how a partition's owner is
+    resolved (``owner_of``) and what drives rebalances."""
 
-    def __init__(self, num_partitions: int, tracker: PartitionTracker,
-                 local_host: HostPort, region_creator: RegionCreator,
+    health_name = "router"
+
+    def __init__(self, num_partitions: int, local_host: HostPort,
+                 region_creator: RegionCreator,
                  partition_by: Callable[[str], str] = partition_by_up_to_colon,
                  remote_deliver: Optional[RemoteDeliver] = None,
-                 dr_standby: bool = False, pending_limit: int = 1000) -> None:
+                 pending_limit: int = 1000) -> None:
         self.num_partitions = num_partitions
-        self.tracker = tracker
         self.local_host = local_host
         self.region_creator = region_creator
         self.partition_by = partition_by
         self.remote_deliver = remote_deliver
-        self.dr_standby = dr_standby
         self.pending_limit = pending_limit
         self._regions: Dict[int, object] = {}
         self._pending: Dict[int, List[Tuple[str, Envelope]]] = {}
         self._started = False
 
-    # -- lifecycle ----------------------------------------------------------------------
+    # -- backend hook -------------------------------------------------------------------
 
-    async def start(self) -> Ack:
-        self._started = True
-        self.tracker.register(self._on_assignments)
-        return Ack()
-
-    async def stop(self) -> Ack:
-        self._started = False
-        self.tracker.unregister(self._on_assignments)
-        for region in list(self._regions.values()):
-            await region.stop()
-        self._regions.clear()
-        for buf in self._pending.values():
-            for _, env in buf:
-                fail_future(env.reply, NoRouteError("router stopped"))
-        self._pending.clear()
-        return Ack()
+    def owner_of(self, partition: int) -> Optional[HostPort]:
+        raise NotImplementedError
 
     # -- routing ------------------------------------------------------------------------
 
@@ -81,12 +70,12 @@ class SurgePartitionRouter(Controllable):
     def deliver(self, aggregate_id: str, env: Envelope) -> None:
         """deliverMessage:205-222 — resolve owner, local-or-remote dispatch."""
         partition = self.partition_for(aggregate_id)
-        owner = self.tracker.assignments.partition_to_host().get(partition)
+        owner = self.owner_of(partition)
         if owner is None:
             buf = self._pending.setdefault(partition, [])
             if len(buf) >= self.pending_limit:
                 fail_future(env.reply, NoRouteError(
-                    f"no assignment for partition {partition} and buffer full"))
+                    f"no owner for partition {partition} and buffer full"))
                 return
             buf.append((aggregate_id, env))
             return
@@ -112,6 +101,32 @@ class SurgePartitionRouter(Controllable):
         self._regions[partition] = region
         return region
 
+    def _stop_region(self, partition: int, why: str) -> None:
+        import asyncio
+
+        region = self._regions.pop(partition, None)
+        if region is not None:
+            logger.info("%s: stopping %s region %d", self.health_name, why, partition)
+            asyncio.ensure_future(region.stop())
+
+    def _drain_pending(self) -> None:
+        """Dispatch buffered deliveries whose owner is now known."""
+        for p in list(self._pending):
+            owner = self.owner_of(p)
+            if owner is None:
+                continue
+            for aggregate_id, env in self._pending.pop(p):
+                self._dispatch(owner, p, aggregate_id, env)
+
+    async def _shutdown_regions(self) -> None:
+        for region in list(self._regions.values()):
+            await region.stop()
+        self._regions.clear()
+        for buf in self._pending.values():
+            for _, env in buf:
+                fail_future(env.reply, NoRouteError(f"{self.health_name} stopped"))
+        self._pending.clear()
+
     @property
     def local_partitions(self) -> List[int]:
         return sorted(self._regions)
@@ -121,38 +136,59 @@ class SurgePartitionRouter(Controllable):
         compose without reaching into router internals."""
         return sorted(self._regions.items())
 
+    def health(self) -> dict:
+        """Router health snapshot (getHealthCheck:353-366 analog)."""
+        return {
+            "name": self.health_name,
+            "status": "up" if self._started else "down",
+            "local_partitions": self.local_partitions,
+            "pending": {p: len(b) for p, b in self._pending.items()},
+        }
+
+
+class SurgePartitionRouter(RouterBase):
+    """Default backend: partition owners come straight from the tracker's consumer
+    assignments."""
+
+    def __init__(self, num_partitions: int, tracker: PartitionTracker,
+                 local_host: HostPort, region_creator: RegionCreator,
+                 partition_by: Callable[[str], str] = partition_by_up_to_colon,
+                 remote_deliver: Optional[RemoteDeliver] = None,
+                 dr_standby: bool = False, pending_limit: int = 1000) -> None:
+        super().__init__(num_partitions, local_host, region_creator,
+                         partition_by=partition_by, remote_deliver=remote_deliver,
+                         pending_limit=pending_limit)
+        self.tracker = tracker
+        self.dr_standby = dr_standby
+
+    def owner_of(self, partition: int) -> Optional[HostPort]:
+        return self.tracker.assignments.partition_to_host().get(partition)
+
+    # -- lifecycle ----------------------------------------------------------------------
+
+    async def start(self) -> Ack:
+        self._started = True
+        self.tracker.register(self._on_assignments)
+        return Ack()
+
+    async def stop(self) -> Ack:
+        self._started = False
+        self.tracker.unregister(self._on_assignments)
+        await self._shutdown_regions()
+        return Ack()
+
     # -- rebalance ----------------------------------------------------------------------
 
     def _on_assignments(self, assignments: PartitionAssignments,
                         changes: AssignmentChanges) -> None:
-        import asyncio
-
         if not self._started:
             return
         # stop revoked local regions (PoisonPill analog, :298-307)
         for p in changes.revoked.get(self.local_host, []):
-            region = self._regions.pop(p, None)
-            if region is not None:
-                logger.info("router: stopping revoked region %d", p)
-                asyncio.ensure_future(region.stop())
+            self._stop_region(p, "revoked")
         # eagerly create added local regions unless DR-standby (:144-156)
         if not self.dr_standby:
             for p in changes.added.get(self.local_host, []):
                 if p not in self._regions:
                     self._create_region(p)
-        # drain buffered deliveries now that owners are known
-        owner_of = assignments.partition_to_host()
-        for p in [p for p in self._pending if p in owner_of]:
-            for aggregate_id, env in self._pending.pop(p):
-                self._dispatch(owner_of[p], p, aggregate_id, env)
-
-    # -- health -------------------------------------------------------------------------
-
-    def health(self) -> dict:
-        """Router health snapshot (getHealthCheck:353-366 analog)."""
-        return {
-            "name": "router",
-            "status": "up" if self._started else "down",
-            "local_partitions": self.local_partitions,
-            "pending": {p: len(b) for p, b in self._pending.items()},
-        }
+        self._drain_pending()

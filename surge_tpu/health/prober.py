@@ -1,0 +1,74 @@
+"""Event-loop starvation prober.
+
+The asyncio re-derivation of the reference's thread-starvation detector
+(``ExecutionContextProber`` — internal/utils/ExecutionContextProber.scala:17-172,
+config ``surge.execution-context-prober.*`` in common reference.conf:291-302): the
+reference schedules no-op probes on a target ExecutionContext and warns when they
+don't run within a timeout. Here the hazard is blocking the single event loop (long
+synchronous serialization, accidental sync IO, an unyielding fold), so the probe is a
+timestamped ``sleep(interval)`` whose *lateness* measures how long the loop was
+unavailable; sustained lateness past the threshold emits a health signal and a log
+warning with the same "possible starvation" message intent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Optional
+
+from surge_tpu.common import logger
+from surge_tpu.config import Config, default_config
+
+
+class EventLoopProber:
+    """Measures event-loop responsiveness; signals on sustained starvation."""
+
+    def __init__(self, config: Config | None = None,
+                 on_signal: Optional[Callable[[str, str], None]] = None) -> None:
+        cfg = config or default_config()
+        self.interval_s = cfg.get_seconds("surge.event-loop-prober.interval-ms", 1000)
+        self.threshold_s = cfg.get_seconds("surge.event-loop-prober.threshold-ms", 200)
+        # consecutive late probes before signalling (the reference probes in rounds
+        # of numProbes before deciding)
+        self.late_probes = cfg.get_int("surge.event-loop-prober.late-probes", 3)
+        self._on_signal = on_signal or (lambda name, level: None)
+        self._task: Optional[asyncio.Task] = None
+        self._late_streak = 0
+        self.max_delay_s = 0.0
+        self.last_delay_s = 0.0
+        self.starvation_events = 0
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+            self._task.set_name("surge-event-loop-prober")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            t0 = time.perf_counter()
+            await asyncio.sleep(self.interval_s)
+            delay = (time.perf_counter() - t0) - self.interval_s
+            self.last_delay_s = delay
+            self.max_delay_s = max(self.max_delay_s, delay)
+            if delay > self.threshold_s:
+                self._late_streak += 1
+                if self._late_streak >= self.late_probes:
+                    self.starvation_events += 1
+                    self._late_streak = 0
+                    logger.warning(
+                        "possible event-loop starvation: probe %.0fms late "
+                        "(threshold %.0fms) %d times in a row",
+                        delay * 1e3, self.threshold_s * 1e3, self.late_probes)
+                    self._on_signal("event-loop.starvation", "warning")
+            else:
+                self._late_streak = 0

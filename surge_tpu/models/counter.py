@@ -158,14 +158,14 @@ def make_registry() -> SchemaRegistry:
     deliberately unregistered — its fold semantics are "throw", which the batched
     path surfaces as an encode-time KeyError instead."""
     reg = SchemaRegistry()
-    # narrow wire widths: increment/decrement deltas are small unsigned ints (the
-    # reference commands always emit 1, TestBoundedContext.scala:27-31) — with the
-    # 3-bit type discriminant the whole event packs into TWO wire bytes when
-    # sequence_number is producer-derived (codec/wire.py)
+    # narrow wire widths: increment/decrement deltas are 0..3 (the reference
+    # commands always emit 1, TestBoundedContext.scala:27-31) — with the 3-bit type
+    # discriminant the whole event packs into ONE wire byte when sequence_number is
+    # producer-derived (codec/wire.py)
     reg.register_event(CountIncremented, type_id=INCREMENTED, exclude=("aggregate_id",),
-                       bits={"increment_by": 4})
+                       bits={"increment_by": 2})
     reg.register_event(CountDecremented, type_id=DECREMENTED, exclude=("aggregate_id",),
-                       bits={"decrement_by": 4})
+                       bits={"decrement_by": 2})
     reg.register_event(NoOpEvent, type_id=NOOP, exclude=("aggregate_id",))
     reg.register_event(UnserializableEvent, type_id=UNSERIALIZABLE,
                        exclude=("aggregate_id", "error_msg"))

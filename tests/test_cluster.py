@@ -1,0 +1,225 @@
+"""Alternative clustering backend: membership, external shard allocation, and the
+cluster-sharding router — the enable-akka-cluster feature-flag path
+(SurgePartitionRouterImpl.scala:85-121, KafkaClusterShardingRebalanceListener
+.scala:17-183) re-derived without Akka.
+
+Multi-node behavior runs as two engines on one loop sharing membership +
+allocation + tracker + log — the multi-jvm spec analog (SURVEY.md §4.6)."""
+
+import asyncio
+
+import pytest
+
+from surge_tpu import SurgeCommandBusinessLogic, create_engine, default_config
+from surge_tpu.engine.cluster import (
+    ClusterMembership,
+    ClusterShardingRouter,
+    ExternalShardAllocation,
+)
+from surge_tpu.engine.entity import Envelope
+from surge_tpu.engine.partition import HostPort, PartitionTracker
+from surge_tpu.log import InMemoryLog
+from surge_tpu.models import counter
+
+A = HostPort("node-a", 1)
+B = HostPort("node-b", 2)
+
+CLUSTER_CFG = default_config().with_overrides({
+    "surge.producer.flush-interval-ms": 5,
+    "surge.producer.ktable-check-interval-ms": 5,
+    "surge.state-store.commit-interval-ms": 20,
+    "surge.aggregate.init-retry-interval-ms": 5,
+    "surge.engine.num-partitions": 4,
+    "surge.feature-flags.experimental.enable-cluster-sharding": True,
+})
+
+
+def make_logic():
+    return SurgeCommandBusinessLogic(
+        aggregate_name="counter", model=counter.CounterModel(),
+        state_format=counter.state_formatting(),
+        event_format=counter.event_formatting())
+
+
+# -- registries -------------------------------------------------------------------------
+
+
+def test_membership_leader_is_lowest_address():
+    m = ClusterMembership()
+    assert m.leader is None
+    m.join(B)
+    assert m.leader == B
+    m.join(A)
+    assert m.leader == A  # lowest address bootstraps/leads
+    m.join(A)  # idempotent
+    assert m.members == [A, B]
+    m.leave(A)
+    assert m.leader == B
+
+
+def test_shard_allocation_notifies_only_on_change():
+    alloc = ExternalShardAllocation()
+    seen = []
+    alloc.subscribe(lambda locs: seen.append(dict(locs)))
+    alloc.update_shard_locations({0: A, 1: B})
+    alloc.update_shard_locations({0: A, 1: B})  # no change → no broadcast
+    alloc.update_shard_locations({1: A})
+    assert len(seen) == 2
+    assert alloc.location_of(1) == A
+    assert alloc.locations == {0: A, 1: A}
+
+
+# -- router unit (probe regions) --------------------------------------------------------
+
+
+class ProbeRegion:
+    def __init__(self, partition):
+        self.partition = partition
+        self.delivered = []
+        self.stopped = False
+
+    def deliver(self, aggregate_id, env):
+        self.delivered.append((aggregate_id, env))
+        env.reply.set_result(f"probe-{self.partition}")
+
+    async def stop(self):
+        self.stopped = True
+
+
+def test_router_buffers_until_allocated_and_moves_shards():
+    async def scenario():
+        tracker = PartitionTracker()
+        membership = ClusterMembership()
+        alloc = ExternalShardAllocation()
+        regions = {}
+
+        def creator(p):
+            regions[p] = ProbeRegion(p)
+            return regions[p]
+
+        router = ClusterShardingRouter(
+            num_partitions=4, tracker=tracker, local_host=A,
+            region_creator=creator, membership=membership, allocation=alloc)
+        await router.start()
+        assert membership.members == [A]
+
+        # unallocated shard: delivery buffers
+        env = Envelope(message="m", reply=asyncio.get_running_loop().create_future())
+        router.deliver("agg", env)
+        assert not env.reply.done()
+
+        # the leader (A) translates tracker assignments into allocations
+        shard = router.partition_for("agg")
+        tracker.update({A: list(range(4))})
+        assert alloc.locations == {p: A for p in range(4)}
+        assert await env.reply == f"probe-{shard}"
+
+        # re-allocating the shard away stops the local region
+        alloc.update_shard_locations({shard: B})
+        await asyncio.sleep(0)
+        assert regions[shard].stopped
+
+        # deliveries to a remote shard without a transport fail fast
+        env2 = Envelope(message="m", reply=asyncio.get_running_loop().create_future())
+        router.deliver("agg", env2)
+        with pytest.raises(Exception, match="no remote transport"):
+            await env2.reply
+        await router.stop()
+
+    asyncio.run(scenario())
+
+
+def test_non_leader_does_not_allocate():
+    async def scenario():
+        tracker = PartitionTracker()
+        membership = ClusterMembership()
+        membership.join(A)  # A exists and is the leader…
+        alloc = ExternalShardAllocation()
+        router_b = ClusterShardingRouter(
+            num_partitions=4, tracker=tracker, local_host=B,
+            region_creator=ProbeRegion, membership=membership, allocation=alloc)
+        await router_b.start()  # …so B must not write allocations
+        tracker.update({B: [0, 1, 2, 3]})
+        assert alloc.locations == {}
+        await router_b.stop()
+
+    asyncio.run(scenario())
+
+
+# -- two-engine cluster end-to-end ------------------------------------------------------
+
+
+def test_two_node_cluster_routes_and_rebalances():
+    async def scenario():
+        log = InMemoryLog()
+        tracker = PartitionTracker()
+        membership = ClusterMembership()
+        alloc = ExternalShardAllocation()
+        engines = {}
+
+        def remote_deliver(owner, partition, aggregate_id, env):
+            engines[owner].router.deliver(aggregate_id, env)
+
+        for host in (A, B):
+            engines[host] = create_engine(
+                make_logic(), log=log, config=CLUSTER_CFG, local_host=host,
+                tracker=tracker, membership=membership, shard_allocation=alloc,
+                remote_deliver=remote_deliver)
+        await engines[A].start()
+        await engines[B].start()
+        tracker.update({A: [0, 1], B: [2, 3]})
+
+        # drive 40 aggregates from node A; ids hash across all four shards, so some
+        # forward to B over remote_deliver
+        for i in range(40):
+            r = await engines[A].aggregate_for(f"agg-{i}").send_command(
+                counter.Increment(f"agg-{i}"))
+            assert r.state.count == 1, (i, r)
+        local_a = set(engines[A].router.local_partitions)
+        local_b = set(engines[B].router.local_partitions)
+        assert local_a <= {0, 1} and local_b <= {2, 3} and local_a and local_b
+
+        # rebalance: all shards to B; A's regions stop, traffic still lands
+        tracker.update({B: [0, 1, 2, 3]})
+        await asyncio.sleep(0.02)
+        assert engines[A].router.local_partitions == []
+        r = await engines[A].aggregate_for("agg-7").send_command(
+            counter.Increment("agg-7"))
+        assert r.state.count == 2
+
+        await engines[A].stop()
+        await engines[B].stop()
+
+    asyncio.run(scenario())
+
+
+def test_member_departure_reallocates_shards():
+    """Regression: when a member leaves, its shard allocations must not keep
+    routing to the dead node — the leader drops them and re-derives placements
+    from the live assignments."""
+    async def scenario():
+        tracker = PartitionTracker()
+        membership = ClusterMembership()
+        alloc = ExternalShardAllocation()
+
+        routers = {}
+        for host in (A, B):
+            routers[host] = ClusterShardingRouter(
+                num_partitions=4, tracker=tracker, local_host=host,
+                region_creator=ProbeRegion, membership=membership, allocation=alloc)
+            await routers[host].start()
+        tracker.update({A: [0, 1], B: [2, 3]})
+        assert alloc.locations == {0: A, 1: A, 2: B, 3: B}
+
+        # B departs; the leader must drop B's shards and reassign what the tracker
+        # still maps to live members
+        tracker.update({A: [0, 1, 2, 3]})  # control plane reassigned first
+        await routers[B].stop()
+        assert all(owner == A for owner in alloc.locations.values())
+        assert set(alloc.locations) == {0, 1, 2, 3}
+        await routers[A].stop()
+
+        # symmetric: leader departure leaves the survivor as leader who can allocate
+        assert membership.members == []
+
+    asyncio.run(scenario())

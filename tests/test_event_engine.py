@@ -1,0 +1,91 @@
+"""Event-only engine: apply_events/get_state surface, no command side, state-only
+publishing (scaladsl/event parity — SurgeEvent.scala:19-59, AggregateEventModel
+.scala:10-38)."""
+
+import asyncio
+
+import pytest
+
+from surge_tpu import default_config
+from surge_tpu.engine.entity import CommandFailure, CommandSuccess
+from surge_tpu.engine.event_dsl import create_event_engine
+from surge_tpu.log import InMemoryLog
+from surge_tpu.models import counter
+
+CFG = default_config().with_overrides({
+    "surge.producer.flush-interval-ms": 5,
+    "surge.producer.ktable-check-interval-ms": 5,
+    "surge.state-store.commit-interval-ms": 20,
+    "surge.aggregate.init-retry-interval-ms": 5,
+    "surge.engine.num-partitions": 2,
+})
+
+
+class CounterEventModel:
+    """Event-side-only model: just the fold (AggregateEventModel analog)."""
+
+    def initial_state(self, aggregate_id):
+        return None
+
+    def handle_event(self, state, event):
+        return counter.CounterModel().handle_event(state, event)
+
+
+def test_apply_events_and_get_state():
+    async def scenario():
+        log = InMemoryLog()
+        engine = create_event_engine(
+            "counter-events", CounterEventModel(), counter.state_formatting(),
+            log=log, config=CFG)
+        await engine.start()
+        ref = engine.aggregate_for("agg-1")
+        r = await ref.apply_events([
+            counter.CountIncremented("agg-1", 2, 1),
+            counter.CountIncremented("agg-1", 3, 2),
+        ])
+        assert isinstance(r, CommandSuccess) and r.state.count == 5
+        st = await ref.get_state()
+        assert st.count == 5 and st.version == 2
+        # the surface has no send_command at all
+        assert not hasattr(ref, "send_command")
+
+        # state-only publishing: a state topic exists, no events topic was created
+        assert log.end_offset("counter-events-state",
+                              engine.engine.router.partition_for("agg-1")) >= 1
+        assert "counter-events-events" not in log._topics
+        await engine.stop()
+
+        # restart resumes the snapshot from the compacted state topic
+        engine2 = create_event_engine(
+            "counter-events", CounterEventModel(), counter.state_formatting(),
+            log=log, config=CFG)
+        await engine2.start()
+        st = await engine2.aggregate_for("agg-1").get_state()
+        assert st.count == 5
+        await engine2.stop()
+
+    asyncio.run(scenario())
+
+
+def test_event_model_requires_a_fold():
+    class NoFold:
+        pass
+
+    with pytest.raises(TypeError, match="handle_event"):
+        create_event_engine("x", NoFold(), counter.state_formatting())
+
+
+def test_commands_are_rejected_at_the_model():
+    async def scenario():
+        engine = create_event_engine(
+            "counter-events", CounterEventModel(), counter.state_formatting(),
+            config=CFG)
+        await engine.start()
+        # the inner engine surface still exists, but the model's command side throws
+        r = await engine.engine.aggregate_for("agg-9").send_command(
+            counter.Increment("agg-9"))
+        assert isinstance(r, CommandFailure)
+        assert "do not process commands" in str(r.error)
+        await engine.stop()
+
+    asyncio.run(scenario())

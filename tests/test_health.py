@@ -164,3 +164,36 @@ def test_pipeline_restarts_state_store_on_fatal_signal():
         await engine.stop()
 
     asyncio.run(scenario())
+
+
+def test_event_loop_prober_detects_starvation():
+    """ExecutionContextProber analog (SURVEY.md §5.2): blocking the loop makes
+    probes late; sustained lateness emits a health signal."""
+    import asyncio
+    import time
+
+    from surge_tpu.config import default_config
+    from surge_tpu.health import HealthSignalBus
+    from surge_tpu.health.prober import EventLoopProber
+
+    async def scenario():
+        bus = HealthSignalBus()
+        cfg = default_config().with_overrides({
+            "surge.event-loop-prober.interval-ms": 10,
+            "surge.event-loop-prober.threshold-ms": 20,
+            "surge.event-loop-prober.late-probes": 2,
+        })
+        prober = EventLoopProber(cfg, on_signal=bus.signal_fn("event-loop"))
+        prober.start()
+        # block the loop synchronously (the starvation hazard); a loaded CI host can
+        # also be "naturally" late, so the test only asserts the positive direction
+        for _ in range(8):
+            time.sleep(0.04)  # deliberate sync block
+            await asyncio.sleep(0)  # minimal yield: every probe fires late
+        await asyncio.sleep(0.05)
+        await prober.stop()
+        assert prober.starvation_events >= 1
+        assert any(s.name == "event-loop.starvation" for s in bus.recent())
+        assert prober.max_delay_s > 0.02
+
+    asyncio.run(scenario())

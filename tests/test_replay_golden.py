@@ -33,9 +33,9 @@ def random_counter_logs(n, max_len, seed=0):
             seq += 1
             kind = rng.randrange(3)
             if kind == 0:
-                log.append(counter.CountIncremented(str(i), rng.randrange(1, 5), seq))
+                log.append(counter.CountIncremented(str(i), rng.randrange(1, 4), seq))
             elif kind == 1:
-                log.append(counter.CountDecremented(str(i), rng.randrange(1, 5), seq))
+                log.append(counter.CountDecremented(str(i), rng.randrange(1, 4), seq))
             else:
                 log.append(counter.NoOpEvent(str(i), seq))
         logs.append(log)

@@ -36,8 +36,8 @@ def test_columnar_scatter_pure_numpy_path():
     b, n = 50, 4000
     agg_idx = np.sort(rng.integers(0, b, size=n).astype(np.int32))
     type_ids = rng.integers(0, 2, size=n).astype(np.int32)  # inc / dec
-    inc = np.where(type_ids == 0, rng.integers(1, 5, size=n), 0).astype(np.int32)
-    dec = np.where(type_ids == 1, rng.integers(1, 5, size=n), 0).astype(np.int32)
+    inc = np.where(type_ids == 0, rng.integers(1, 4, size=n), 0).astype(np.int32)
+    dec = np.where(type_ids == 1, rng.integers(1, 4, size=n), 0).astype(np.int32)
     seq = np.ones(n, dtype=np.int32)
     colev = ColumnarEvents(num_aggregates=b, agg_idx=agg_idx, type_ids=type_ids,
                            cols={"increment_by": inc, "decrement_by": dec,

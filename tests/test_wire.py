@@ -27,14 +27,14 @@ def _tiny_registry(bits_a=5, bits_b=None):
     return reg
 
 
-def test_counter_wire_is_two_bytes():
+def test_counter_wire_is_one_byte():
     wire = WireFormat(make_registry(), {"sequence_number": "ordinal"})
-    assert wire.nbytes == 2  # 3 type bits + 4 + 4 = 11 bits
-    assert wire.wire_bytes_per_event() == 2
+    assert wire.nbytes == 1  # 3 type bits + 2 + 2 = 7 bits
+    assert wire.wire_bytes_per_event() == 1
     assert [f.name for f in wire.derived_fields] == ["sequence_number"]
     # without the derivation declaration, sequence_number rides full-width
     wire2 = WireFormat(make_registry())
-    assert wire2.wire_bytes_per_event() == 2 + 4
+    assert wire2.wire_bytes_per_event() == 1 + 4
 
 
 def test_pack_decode_round_trip():
@@ -44,11 +44,11 @@ def test_pack_decode_round_trip():
     type_ids = rng.integers(0, 4, size=(b, t)).astype(np.int32)
     type_ids[0, 4:] = -1  # padding tail
     cols = {
-        "increment_by": rng.integers(0, 16, size=(b, t)).astype(np.int32),
-        "decrement_by": rng.integers(0, 16, size=(b, t)).astype(np.int32),
+        "increment_by": rng.integers(0, 4, size=(b, t)).astype(np.int32),
+        "decrement_by": rng.integers(0, 4, size=(b, t)).astype(np.int32),
     }
     packed, side = wire.pack_window(type_ids, cols, 0, t, chunk=16, bs=8)
-    assert packed.shape == (16, 8, 2) and packed.dtype == np.uint8
+    assert packed.shape == (16, 8, 1) and packed.dtype == np.uint8
     assert side == {}
 
     ev = wire.decode(packed, side, np.zeros(8, np.int32))
@@ -80,9 +80,9 @@ def test_time_window_slice_and_ordinal_base():
 def test_overflow_raises():
     wire = WireFormat(make_registry(), {"sequence_number": "ordinal"})
     type_ids = np.zeros((1, 1), dtype=np.int32)
-    cols = {"increment_by": np.array([[16]], np.int32),  # 2**4 — one past the width
+    cols = {"increment_by": np.array([[4]], np.int32),  # 2**2 — one past the width
             "decrement_by": np.zeros((1, 1), np.int32)}
-    with pytest.raises(ValueError, match="increment_by.*4-bit"):
+    with pytest.raises(ValueError, match="increment_by.*2-bit"):
         wire.pack_window(type_ids, cols, 0, 1, chunk=1, bs=1)
     cols = {"increment_by": np.array([[-1]], np.int32),  # negatives cannot pack
             "decrement_by": np.zeros((1, 1), np.int32)}
@@ -135,7 +135,7 @@ def test_corrupt_positive_type_id_packs_as_padding():
     wire = WireFormat(make_registry(), {"sequence_number": "ordinal"})
     type_ids = np.array([[8]], dtype=np.int32)
     cols = {"increment_by": np.zeros((1, 1), np.int32),
-            "decrement_by": np.zeros((1, 1), np.int32)}
+            "decrement_by": np.zeros((1, 1), np.int32)}  # tid 8 & 7 == 0 if spilled
     packed, side = wire.pack_window(type_ids, cols, 0, 1, chunk=1, bs=1)
     ev = wire.decode(packed, side, np.zeros(1, np.int32))
     assert int(np.asarray(ev["type_id"])[0, 0]) == -1
