@@ -5,4 +5,5 @@
 set -e
 cd "$(dirname "$0")/.."
 protoc -I proto --python_out=surge_tpu/multilanguage proto/multilanguage.proto
-echo "generated: surge_tpu/multilanguage/multilanguage_pb2.py"
+protoc -I proto --python_out=surge_tpu/remote proto/node_transport.proto
+echo "generated: surge_tpu/multilanguage/multilanguage_pb2.py surge_tpu/remote/node_transport_pb2.py"

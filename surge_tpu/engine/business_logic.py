@@ -29,6 +29,11 @@ class SurgeCommandBusinessLogic:
     model: Any  # AggregateCommandModel (sync) — process_command / handle_event
     state_format: Any  # AggregateRead+WriteFormatting
     event_format: Any  # EventRead+WriteFormatting
+    # command ⇄ bytes codec; only required for cross-node delivery over the gRPC
+    # node transport (the reference serializes envelopes with Jackson-CBOR for
+    # akka-remoting the same way — optional because single-node engines never
+    # serialize commands)
+    command_format: Any = None
     state_topic: str = ""
     events_topic: str = ""
     publish_state_only: bool = False  # event-engine mode (no events topic)

@@ -101,6 +101,16 @@ class RouterBase(Controllable):
         self._regions[partition] = region
         return region
 
+    def deliver_local(self, partition: int, aggregate_id: str, env: Envelope) -> None:
+        """Deliver into this node's region for ``partition`` WITHOUT re-resolving
+        ownership. The node-transport server uses this for envelopes another node
+        already addressed here — re-routing them through ``deliver`` could ping-pong
+        unboundedly while two nodes' trackers disagree mid-rebalance."""
+        region = self._regions.get(partition)
+        if region is None:
+            region = self._create_region(partition)
+        region.deliver(aggregate_id, env)
+
     def _stop_region(self, partition: int, why: str) -> None:
         import asyncio
 

@@ -13,7 +13,8 @@ from typing import Optional, Sequence
 
 from surge_tpu.codec.schema import SchemaRegistry
 from surge_tpu.engine.model import RejectedCommand, ReplayHandlers, ReplaySpec
-from surge_tpu.serialization import JsonEventFormatting, JsonFormatting
+from surge_tpu.serialization import (JsonCommandFormatting, JsonEventFormatting,
+                                     JsonFormatting)
 
 
 # --- domain types (TestBoundedContext.scala:18-66) ---
@@ -204,16 +205,35 @@ def _event_to_dict(e) -> dict:
         # point of the CreateUnserializableEvent poison command (TestBoundedContext
         # serialization-failure path). The tensor path still folds it.
         raise ValueError(f"deliberately unserializable event: {e.error_msg}")
-    d = dict(e.__dict__) if not hasattr(e, "__dataclass_fields__") else {
-        k: getattr(e, k) for k in e.__dataclass_fields__}
-    d["_type"] = type(e).__name__
-    return d
+    return _to_tagged_dict(e)
 
 
 def _event_from_dict(d: dict):
+    return _from_tagged_dict(_EVENT_TYPES, d)
+
+
+_COMMAND_TYPES = {c.__name__: c for c in (Increment, Decrement, DoNothing,
+                                          CreateNoOpEvent, FailCommandProcessing,
+                                          CreateExceptionThrowingEvent,
+                                          CreateUnserializableEvent)}
+
+
+def _to_tagged_dict(obj) -> dict:
+    d = {k: getattr(obj, k) for k in obj.__dataclass_fields__}
+    d["_type"] = type(obj).__name__
+    return d
+
+
+def _from_tagged_dict(type_map: dict, d: dict):
     d = dict(d)
-    cls = _EVENT_TYPES[d.pop("_type")]
-    return cls(**d)
+    return type_map[d.pop("_type")](**d)
+
+
+def command_formatting() -> JsonCommandFormatting:
+    """Command codec for cross-node delivery (remote transport tests)."""
+    return JsonCommandFormatting(
+        to_dict=_to_tagged_dict,
+        from_dict=lambda d: _from_tagged_dict(_COMMAND_TYPES, d))
 
 
 def state_formatting() -> JsonFormatting:

@@ -1,0 +1,15 @@
+"""Inter-node remote delivery — the distributed control-plane transport.
+
+The reference forwards envelopes between nodes over Akka remoting (Artery TCP
+``ActorSelection`` built from HostPort, KafkaPartitionShardRouterActor.scala:265-271,
+serialized with Jackson-CBOR). The TPU-native build replaces that with gRPC over
+DCN (SURVEY.md §5.8): each engine node runs a :class:`NodeTransportServer`; routers
+forward to remote owners through a :class:`GrpcRemoteDeliver` whose channels are
+keyed by HostPort. Payloads cross in the app's own formats (``command_format`` /
+``event_format`` / ``state_format`` from the business logic), and trace context
+rides the request headers like TracedMessage carries W3C headers.
+"""
+
+from surge_tpu.remote.transport import GrpcRemoteDeliver, NodeTransportServer
+
+__all__ = ["GrpcRemoteDeliver", "NodeTransportServer"]

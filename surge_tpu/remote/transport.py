@@ -1,0 +1,205 @@
+"""gRPC node transport: server (deliver into the local engine) + client (RemoteDeliver).
+
+See package docstring. Service glue is hand-written like the multilanguage bridge
+(grpcio-tools absent); the generated message classes live in ``node_transport_pb2``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+import grpc
+
+from surge_tpu.common import fail_future, logger, resolve_future
+from surge_tpu.engine.entity import (
+    ApplyEvents,
+    CommandFailure,
+    CommandRejected,
+    CommandSuccess,
+    Envelope,
+    GetState,
+    ProcessMessage,
+)
+from surge_tpu.engine.model import RejectedCommand
+from surge_tpu.engine.partition import HostPort
+from surge_tpu.multilanguage.service import generic_handler
+from surge_tpu.remote import node_transport_pb2 as pb
+from surge_tpu.serialization import SerializedMessage
+
+SERVICE = "surge_tpu.node.NodeTransport"
+METHODS = {"Deliver": (pb.DeliverRequest, pb.DeliverReply)}
+
+
+class NodeTransportServer:
+    """Receives forwarded envelopes and delivers them into the local engine's router
+    (the remote PartitionRegion role)."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.engine = engine
+        self._host = host
+        self._port = port
+        self._server: Optional[grpc.aio.Server] = None
+        self.bound_port: Optional[int] = None
+
+    async def Deliver(self, request: pb.DeliverRequest, context) -> pb.DeliverReply:
+        logic = self.engine.logic
+        kind = request.WhichOneof("kind")
+        if kind == "command":
+            if logic.command_format is None:
+                return pb.DeliverReply(outcome="failure",
+                                       error="node has no command_format configured")
+            message = ProcessMessage(
+                logic.command_format.read_command(request.command))
+        elif kind == "get_state":
+            message = GetState()
+        elif kind == "apply_events":
+            message = ApplyEvents([
+                logic.event_format.read_event(
+                    SerializedMessage(key=request.aggregate_id, value=e))
+                for e in request.apply_events.events])
+        else:
+            return pb.DeliverReply(outcome="failure", error=f"unknown kind {kind!r}")
+
+        fut: "asyncio.Future" = asyncio.get_running_loop().create_future()
+        env = Envelope(message=message, reply=fut, headers=dict(request.headers))
+        try:
+            # the sender already resolved ownership to this node: deliver into the
+            # addressed partition's local region (no re-route — see deliver_local)
+            self.engine.router.deliver_local(request.partition, request.aggregate_id,
+                                             env)
+            result = await fut
+        except Exception as exc:  # noqa: BLE001 — routing errors surface as failure
+            return pb.DeliverReply(outcome="failure", error=repr(exc))
+
+        if isinstance(message, GetState):
+            if result is None:
+                return pb.DeliverReply(outcome="no_state")
+            return pb.DeliverReply(
+                outcome="state", state=logic.state_format.write_state(result).value)
+        if isinstance(result, CommandSuccess):
+            written = logic.state_format.write_state(result.state).value
+            return pb.DeliverReply(outcome="success", state=written or b"")
+        if isinstance(result, CommandRejected):
+            return pb.DeliverReply(outcome="rejected", error=str(result.reason))
+        if isinstance(result, CommandFailure):
+            return pb.DeliverReply(outcome="failure", error=repr(result.error))
+        return pb.DeliverReply(outcome="failure", error=f"unexpected reply {result!r}")
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (generic_handler(SERVICE, METHODS, self),))
+        self.bound_port = self._server.add_insecure_port(f"{self._host}:{self._port}")
+        await self._server.start()
+        return self.bound_port
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None
+
+
+class GrpcRemoteDeliver:
+    """The router's ``remote_deliver`` hook over gRPC: resolves the owner's channel
+    from an address book and forwards the envelope, mapping the reply back onto the
+    caller's future (ask semantics preserved across the wire)."""
+
+    def __init__(self, logic, addresses: Dict[HostPort, str] | None = None,
+                 timeout_s: float = 30.0) -> None:
+        self.logic = logic
+        # HostPort -> "host:port" gRPC target; defaults to the HostPort itself
+        self.addresses = dict(addresses or {})
+        self.timeout_s = timeout_s
+        self._channels: Dict[HostPort, grpc.aio.Channel] = {}
+        self._calls: Dict[HostPort, object] = {}
+        # strong refs: the loop only weakly references tasks, and a GC'd forward
+        # task would leave the caller's reply future silently unresolved
+        self._inflight: set = set()
+
+    def set_address(self, node: HostPort, target: str) -> None:
+        """(Re)point a node at a gRPC target; drops any cached channel so a node
+        restarting on a new port takes effect immediately."""
+        if self.addresses.get(node) == target:
+            return
+        self.addresses[node] = target
+        self._calls.pop(node, None)
+        channel = self._channels.pop(node, None)
+        if channel is not None:
+            task = asyncio.ensure_future(channel.close())
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+
+    def _call_for(self, node: HostPort):
+        call = self._calls.get(node)
+        if call is None:
+            target = self.addresses.get(node, f"{node.host}:{node.port}")
+            channel = grpc.aio.insecure_channel(target)
+            self._channels[node] = channel
+            call = channel.unary_unary(
+                f"/{SERVICE}/Deliver",
+                request_serializer=pb.DeliverRequest.SerializeToString,
+                response_deserializer=pb.DeliverReply.FromString)
+            self._calls[node] = call
+        return call
+
+    def __call__(self, owner: HostPort, partition: int, aggregate_id: str,
+                 env: Envelope) -> None:
+        try:
+            request = self._encode(partition, aggregate_id, env)
+        except Exception as exc:  # noqa: BLE001 — unserializable command etc.
+            fail_future(env.reply, exc)
+            return
+        task = asyncio.ensure_future(self._forward(owner, request, env))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    def _encode(self, partition: int, aggregate_id: str,
+                env: Envelope) -> pb.DeliverRequest:
+        request = pb.DeliverRequest(aggregate_id=aggregate_id, partition=partition,
+                                    headers=dict(env.headers))
+        msg = env.message
+        if isinstance(msg, ProcessMessage):
+            if self.logic.command_format is None:
+                raise TypeError(
+                    "cross-node send_command requires business logic with a "
+                    "command_format")
+            request.command = self.logic.command_format.write_command(msg.command)
+        elif isinstance(msg, GetState):
+            request.get_state = True
+        elif isinstance(msg, ApplyEvents):
+            request.apply_events.events.extend(
+                self.logic.event_format.write_event(e).value for e in msg.events)
+        else:
+            raise TypeError(f"unroutable message {type(msg).__name__}")
+        return request
+
+    async def _forward(self, owner: HostPort, request: pb.DeliverRequest,
+                       env: Envelope) -> None:
+        try:
+            reply: pb.DeliverReply = await self._call_for(owner)(
+                request, timeout=self.timeout_s)
+        except Exception as exc:  # noqa: BLE001 — connectivity errors
+            logger.warning("remote deliver to %s failed: %r", owner, exc)
+            fail_future(env.reply, exc)
+            return
+        outcome = reply.outcome
+        if outcome == "no_state":
+            resolve_future(env.reply, None)
+        elif outcome == "state":
+            resolve_future(env.reply, self.logic.state_format.read_state(reply.state))
+        elif outcome == "success":
+            state = (self.logic.state_format.read_state(reply.state)
+                     if reply.state else None)
+            resolve_future(env.reply, CommandSuccess(state))
+        elif outcome == "rejected":
+            resolve_future(env.reply, CommandRejected(RejectedCommand(reply.error)))
+        else:
+            resolve_future(env.reply, CommandFailure(
+                RuntimeError(f"remote failure: {reply.error}")))
+
+    async def close(self) -> None:
+        for channel in self._channels.values():
+            await channel.close()
+        self._channels.clear()
+        self._calls.clear()

@@ -93,6 +93,21 @@ class JsonFormatting(Generic[State]):
 
 
 @dataclass
+class JsonCommandFormatting:
+    """Command ⇄ bytes codec for cross-node delivery (the Jackson-CBOR envelope
+    serialization role of the reference's remoting, core reference.conf:1-11)."""
+
+    to_dict: Callable[[Any], dict]
+    from_dict: Callable[[dict], Any]
+
+    def write_command(self, command: Any) -> bytes:
+        return json.dumps(self.to_dict(command)).encode()
+
+    def read_command(self, data: bytes) -> Any:
+        return self.from_dict(json.loads(data.decode()))
+
+
+@dataclass
 class JsonEventFormatting(Generic[Event]):
     """Event JSON formatter; key is the aggregate id extracted by ``key_of``."""
 
@@ -115,5 +130,6 @@ __all__ = [
     "EventWriteFormatting",
     "EventReadFormatting",
     "JsonFormatting",
+    "JsonCommandFormatting",
     "JsonEventFormatting",
 ]

@@ -1,0 +1,184 @@
+"""gRPC node transport: two engine nodes forwarding envelopes over real sockets.
+
+The multi-jvm routing spec analog (SurgePartitionRouterImplMultiJvmSpec, SURVEY.md
+§4.6), with gRPC-over-loopback replacing Akka remoting: ask semantics (success /
+rejection / failure / state) must survive the wire in the app's own formats."""
+
+import asyncio
+
+import pytest
+
+from surge_tpu import SurgeCommandBusinessLogic, create_engine, default_config
+from surge_tpu.engine.entity import CommandFailure, CommandRejected, CommandSuccess
+from surge_tpu.engine.partition import HostPort, PartitionTracker
+from surge_tpu.log import InMemoryLog
+from surge_tpu.models import counter
+from surge_tpu.remote import GrpcRemoteDeliver, NodeTransportServer
+
+A = HostPort("node-a", 1)
+B = HostPort("node-b", 2)
+
+CFG = default_config().with_overrides({
+    "surge.producer.flush-interval-ms": 5,
+    "surge.producer.ktable-check-interval-ms": 5,
+    "surge.state-store.commit-interval-ms": 20,
+    "surge.aggregate.init-retry-interval-ms": 5,
+    "surge.engine.num-partitions": 4,
+})
+
+
+def make_logic(with_commands=True):
+    return SurgeCommandBusinessLogic(
+        aggregate_name="counter", model=counter.CounterModel(),
+        state_format=counter.state_formatting(),
+        event_format=counter.event_formatting(),
+        command_format=counter.command_formatting() if with_commands else None)
+
+
+async def _two_nodes(with_commands=True):
+    log = InMemoryLog()
+    tracker = PartitionTracker()
+    engines, servers, delivers = {}, {}, {}
+    for host in (A, B):
+        deliver = GrpcRemoteDeliver(make_logic(with_commands))
+        delivers[host] = deliver
+        engines[host] = create_engine(make_logic(with_commands), log=log, config=CFG,
+                                      local_host=host, tracker=tracker,
+                                      remote_deliver=deliver)
+    for host in (A, B):
+        await engines[host].start()
+        servers[host] = NodeTransportServer(engines[host])
+        port = await servers[host].start()
+        for d in delivers.values():
+            d.set_address(host, f"127.0.0.1:{port}")
+    tracker.update({A: [0, 1], B: [2, 3]})
+    return log, tracker, engines, servers, delivers
+
+
+async def _teardown(engines, servers, delivers):
+    for host in (A, B):
+        await servers[host].stop()
+        await engines[host].stop()
+        await delivers[host].close()
+
+
+def test_cross_node_commands_and_reads():
+    async def scenario():
+        log, tracker, engines, servers, delivers = await _two_nodes()
+        # drive everything from node A; ids on partitions 2..3 cross the wire to B
+        remote_hit = 0
+        for i in range(30):
+            agg = f"agg-{i}"
+            r = await engines[A].aggregate_for(agg).send_command(counter.Increment(agg))
+            assert isinstance(r, CommandSuccess) and r.state.count == 1, (i, r)
+            if engines[A].router.partition_for(agg) in (2, 3):
+                remote_hit += 1
+        assert remote_hit > 0  # some aggregates really crossed nodes
+
+        # cross-node get_state + apply_events
+        remote_agg = next(f"agg-{i}" for i in range(30)
+                          if engines[A].router.partition_for(f"agg-{i}") in (2, 3))
+        st = await engines[A].aggregate_for(remote_agg).get_state()
+        assert st is not None and st.count == 1
+        r = await engines[A].aggregate_for(remote_agg).apply_events(
+            [counter.CountIncremented(remote_agg, 4, st.version + 1)])
+        assert isinstance(r, CommandSuccess) and r.state.count == 5
+
+        # cross-node rejection round-trips as CommandRejected
+        r = await engines[A].aggregate_for(remote_agg).send_command(
+            counter.FailCommandProcessing(remote_agg, "nope"))
+        assert isinstance(r, CommandRejected) and "nope" in str(r.reason)
+
+        # state for a never-touched remote aggregate is None across the wire
+        empty = next(f"fresh-{i}" for i in range(50)
+                     if engines[A].router.partition_for(f"fresh-{i}") in (2, 3))
+        assert await engines[A].aggregate_for(empty).get_state() is None
+
+        await _teardown(engines, servers, delivers)
+
+    asyncio.run(scenario())
+
+
+def test_missing_command_format_fails_fast():
+    async def scenario():
+        log, tracker, engines, servers, delivers = await _two_nodes(with_commands=False)
+        remote_agg = next(f"agg-{i}" for i in range(50)
+                          if engines[A].router.partition_for(f"agg-{i}") in (2, 3))
+        r = await engines[A].aggregate_for(remote_agg).send_command(
+            counter.Increment(remote_agg))
+        assert isinstance(r, CommandFailure)
+        assert "command_format" in str(r.error)
+        await _teardown(engines, servers, delivers)
+
+    asyncio.run(scenario())
+
+
+def test_unreachable_node_surfaces_failure():
+    async def scenario():
+        log = InMemoryLog()
+        tracker = PartitionTracker()
+        deliver = GrpcRemoteDeliver(make_logic())
+        deliver.set_address(B, "127.0.0.1:1")  # nothing listens there
+        engine = create_engine(make_logic(), log=log, config=CFG, local_host=A,
+                               tracker=tracker, remote_deliver=deliver)
+        await engine.start()
+        tracker.update({A: [0, 1], B: [2, 3]})
+        remote_agg = next(f"agg-{i}" for i in range(50)
+                          if engine.router.partition_for(f"agg-{i}") in (2, 3))
+        r = await engine.aggregate_for(remote_agg).send_command(
+            counter.Increment(remote_agg))
+        assert isinstance(r, CommandFailure)
+        await engine.stop()
+        await deliver.close()
+
+    asyncio.run(scenario())
+
+
+def test_readdressing_a_restarted_node_takes_effect():
+    """Regression: set_address must drop the cached channel so a node that came
+    back on a new port is reachable immediately."""
+    async def scenario():
+        log, tracker, engines, servers, delivers = await _two_nodes()
+        remote_agg = next(f"agg-{i}" for i in range(50)
+                          if engines[A].router.partition_for(f"agg-{i}") in (2, 3))
+        r = await engines[A].aggregate_for(remote_agg).send_command(
+            counter.Increment(remote_agg))
+        assert isinstance(r, CommandSuccess)
+
+        # B's server restarts on a different port
+        await servers[B].stop()
+        servers[B] = NodeTransportServer(engines[B])
+        new_port = await servers[B].start()
+        delivers[A].set_address(B, f"127.0.0.1:{new_port}")
+        await asyncio.sleep(0)  # let the old channel's close task run
+
+        r = await engines[A].aggregate_for(remote_agg).send_command(
+            counter.Increment(remote_agg))
+        assert isinstance(r, CommandSuccess) and r.state.count == 2
+        await _teardown(engines, servers, delivers)
+
+    asyncio.run(scenario())
+
+
+def test_server_delivers_to_addressed_partition_without_rerouting():
+    """Regression: a forwarded envelope must land in the addressed partition's
+    local region even if the receiving node's tracker disagrees (diverged trackers
+    mid-rebalance must not ping-pong envelopes between nodes)."""
+    async def scenario():
+        log, tracker, engines, servers, delivers = await _two_nodes()
+        remote_agg = next(f"agg-{i}" for i in range(50)
+                          if engines[A].router.partition_for(f"agg-{i}") in (2, 3))
+        p = engines[A].router.partition_for(remote_agg)
+        # B's view diverges: it now believes A owns everything
+        engines[B].tracker = tracker  # shared; simulate divergence via direct call
+        # deliver through B's transport server directly with the addressed partition
+        from surge_tpu.remote.transport import pb
+
+        req = pb.DeliverRequest(aggregate_id=remote_agg, partition=p)
+        req.command = counter.command_formatting().write_command(
+            counter.Increment(remote_agg))
+        reply = await servers[B].Deliver(req, None)
+        assert reply.outcome == "success"
+        await _teardown(engines, servers, delivers)
+
+    asyncio.run(scenario())
