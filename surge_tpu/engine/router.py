@@ -84,12 +84,7 @@ class RouterBase(Controllable):
     def _dispatch(self, owner: HostPort, partition: int, aggregate_id: str,
                   env: Envelope) -> None:
         if owner == self.local_host:
-            region = self._regions.get(partition)
-            if region is None:
-                # DR-standby defers creation to first message (:174-185); normal mode
-                # lazily materializes too if an assignment listener raced a delivery
-                region = self._create_region(partition)
-            region.deliver(aggregate_id, env)
+            self.deliver_local(partition, aggregate_id, env)
         elif self.remote_deliver is not None:
             self.remote_deliver(owner, partition, aggregate_id, env)
         else:
@@ -103,9 +98,12 @@ class RouterBase(Controllable):
 
     def deliver_local(self, partition: int, aggregate_id: str, env: Envelope) -> None:
         """Deliver into this node's region for ``partition`` WITHOUT re-resolving
-        ownership. The node-transport server uses this for envelopes another node
-        already addressed here — re-routing them through ``deliver`` could ping-pong
-        unboundedly while two nodes' trackers disagree mid-rebalance."""
+        ownership. ``_dispatch`` uses this for locally-owned partitions; the
+        node-transport server uses it for envelopes another node already addressed
+        here — re-routing those through ``deliver`` could ping-pong unboundedly
+        while two nodes' trackers disagree mid-rebalance. Regions materialize
+        lazily (DR-standby defers creation to first message, :174-185; normal mode
+        lazily materializes too if an assignment listener raced a delivery)."""
         region = self._regions.get(partition)
         if region is None:
             region = self._create_region(partition)

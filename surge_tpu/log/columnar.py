@@ -1,0 +1,237 @@
+"""Columnar event-log segments: the bulk-replay storage format.
+
+SURVEY.md §7 hard-part 3: folding a 100M-event topic cannot afford per-event Python
+objects — the reference's restore path (Kafka Streams changelog scan) streams record
+batches; the TPU-native equivalent streams **struct-of-arrays chunks** straight into
+:meth:`surge_tpu.replay.ReplayEngine.replay_columnar`. This module is the durable
+form of :class:`~surge_tpu.codec.tensor.ColumnarEvents`:
+
+- A **segment file** holds a header (schema: columns, dtypes, derived-column
+  declarations) and a sequence of chunks. Each chunk covers a disjoint, contiguous
+  range of aggregates (aggregate-sorted), so chunks replay independently and their
+  state columns concatenate.
+- Column bytes are SLZ-compressed per column (csrc/segment.cc) when the native codec
+  is built — event streams compress well (narrow dtypes, repeated patterns).
+- ``build_segment_from_topic`` is the offline conversion job: read an events topic
+  through the app's event format once, encode columnar, write the segment. Replays
+  after that never touch Python objects again (the role of Kafka's compacted-restore
+  optimization, performed once instead of per cold start).
+
+Layout (little-endian):
+    magic "SCOL" | u32 header_len | header JSON |
+    per chunk: u32 marker 0x43484B31 ("CHK1") | u32 meta_len | meta JSON |
+               column payloads in meta order (each raw or SLZ per meta)
+Header JSON: {"columns": {name: dtype_str}, "derived": {...}, "type_dtype": str}
+Chunk meta JSON: {"num_aggregates": n, "num_events": m,
+                  "cols": [[name, codec, stored_len, raw_len], ...]}  — includes the
+implicit "agg_idx" and "type_ids" columns.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Iterator, Optional
+
+import numpy as np
+
+from surge_tpu.codec.tensor import ColumnarEvents
+from surge_tpu.log import segment as seg
+
+MAGIC = b"SCOL"
+CHUNK_MARKER = 0x43484B31
+
+
+def _encode_array(arr: np.ndarray):
+    raw = np.ascontiguousarray(arr).tobytes()
+    compressed = seg.slz_compress(raw)
+    if compressed is not None:
+        return seg.CODEC_SLZ, compressed, len(raw)
+    return seg.CODEC_RAW, raw, len(raw)
+
+
+def _decode_array(data: bytes, codec: int, raw_len: int, dtype: np.dtype) -> np.ndarray:
+    if codec == seg.CODEC_SLZ:
+        data = seg.slz_decompress(data, raw_len)
+    return np.frombuffer(data, dtype=dtype)
+
+
+class ColumnarSegmentWriter:
+    """Appends aggregate-range chunks of a model family's event log."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._file = None
+        self._header_written = False
+        self._schema: Optional[dict] = None
+        self._total_aggregates = 0
+        self._total_events = 0
+
+    def append(self, colev: ColumnarEvents) -> None:
+        """Append one chunk. Every chunk must share the first chunk's column schema;
+        each holds its own disjoint aggregate range (ids are chunk-local 0..n)."""
+        colev = colev.sorted_by_aggregate()
+        schema = {
+            "columns": {name: str(col.dtype) for name, col in sorted(colev.cols.items())},
+            "derived": dict(colev.derived_cols),
+            "type_dtype": str(colev.type_ids.dtype),
+            "agg_dtype": str(colev.agg_idx.dtype),
+        }
+        if self._file is None:
+            self._file = open(self.path, "wb")
+            header = json.dumps(schema).encode()
+            self._file.write(MAGIC + struct.pack("<I", len(header)) + header)
+            self._schema = schema
+        elif schema != self._schema:
+            raise ValueError("chunk schema differs from the segment's header schema")
+
+        cols_meta = []
+        payloads = []
+        for name, arr in [("agg_idx", colev.agg_idx), ("type_ids", colev.type_ids)] + \
+                sorted(colev.cols.items()):
+            codec, stored, raw_len = _encode_array(arr)
+            cols_meta.append([name, codec, len(stored), raw_len])
+            payloads.append(stored)
+        meta = json.dumps({
+            "num_aggregates": colev.num_aggregates,
+            "num_events": colev.num_events,
+            "cols": cols_meta,
+        }).encode()
+        self._file.write(struct.pack("<II", CHUNK_MARKER, len(meta)) + meta)
+        for p in payloads:
+            self._file.write(p)
+        self._total_aggregates += colev.num_aggregates
+        self._total_events += colev.num_events
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "ColumnarSegmentWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_segment(path: str) -> Iterator[ColumnarEvents]:
+    """Stream the segment's chunks back as ColumnarEvents (zero-copy frombuffer
+    views over the decompressed column bytes)."""
+    with open(path, "rb") as f:
+        head = f.read(8)
+        if head[:4] != MAGIC:
+            raise ValueError(f"{path}: not a columnar segment")
+        (hlen,) = struct.unpack("<I", head[4:8])
+        header = json.loads(f.read(hlen))
+        col_dtypes = {name: np.dtype(dt) for name, dt in header["columns"].items()}
+        type_dtype = np.dtype(header["type_dtype"])
+        agg_dtype = np.dtype(header["agg_dtype"])
+        derived = dict(header.get("derived", {}))
+
+        while True:
+            prefix = f.read(8)
+            if not prefix:
+                return
+            marker, mlen = struct.unpack("<II", prefix)
+            if marker != CHUNK_MARKER:
+                raise ValueError(f"{path}: bad chunk marker {marker:#x}")
+            meta = json.loads(f.read(mlen))
+            arrays = {}
+            for name, codec, stored_len, raw_len in meta["cols"]:
+                dtype = (agg_dtype if name == "agg_idx"
+                         else type_dtype if name == "type_ids"
+                         else col_dtypes[name])
+                arrays[name] = _decode_array(f.read(stored_len), codec, raw_len, dtype)
+            yield ColumnarEvents(
+                num_aggregates=meta["num_aggregates"],
+                agg_idx=arrays.pop("agg_idx"),
+                type_ids=arrays.pop("type_ids"),
+                cols=arrays,
+                derived_cols=dict(derived))
+
+
+def segment_info(path: str) -> dict:
+    """Totals + schema without decompressing column payloads."""
+    total_aggregates = total_events = num_chunks = 0
+    with open(path, "rb") as f:
+        head = f.read(8)
+        if head[:4] != MAGIC:
+            raise ValueError(f"{path}: not a columnar segment")
+        (hlen,) = struct.unpack("<I", head[4:8])
+        header = json.loads(f.read(hlen))
+        while True:
+            prefix = f.read(8)
+            if not prefix:
+                break
+            marker, mlen = struct.unpack("<II", prefix)
+            meta = json.loads(f.read(mlen))
+            f.seek(sum(c[2] for c in meta["cols"]), 1)
+            total_aggregates += meta["num_aggregates"]
+            total_events += meta["num_events"]
+            num_chunks += 1
+    return {"schema": header, "num_aggregates": total_aggregates,
+            "num_events": total_events, "num_chunks": num_chunks}
+
+
+def _drop_derived(colev: ColumnarEvents, derived_cols: dict) -> None:
+    """Remove columns the device will re-derive — after VERIFYING the data really
+    matches the derivation (an ordinal declaration over a column whose values are
+    not positional would silently corrupt the replay)."""
+    n = colev.num_events
+    if n:
+        starts = np.zeros(colev.num_aggregates + 1, dtype=np.int64)
+        np.cumsum(np.bincount(colev.agg_idx, minlength=colev.num_aggregates),
+                  out=starts[1:])
+        ordinal = np.arange(n, dtype=np.int64) - starts[colev.agg_idx] + 1
+    for name, kind in derived_cols.items():
+        col = colev.cols.get(name)
+        if col is not None:
+            if kind == "ordinal" and n and not np.array_equal(
+                    col.astype(np.int64), ordinal):
+                raise ValueError(
+                    f"column {name!r} declared derived as ordinal but its values "
+                    f"are not positional — refusing to drop it")
+            del colev.cols[name]
+        colev.derived_cols[name] = kind
+
+
+def build_segment_from_topic(log, topic: str, registry, deserialize_event,
+                             path: str, partitions=None,
+                             encode_event=None,
+                             derived_cols: Optional[dict] = None,
+                             chunk_aggregates: int = 65536) -> dict:
+    """Offline conversion job: events topic → columnar segment.
+
+    Reads every partition's records once, groups events per aggregate (key),
+    encodes them columnar via the registry, and writes aggregate-range chunks.
+    ``encode_event`` maps raw events to tensor-schema form first (e.g. vocab
+    dictionary encoding). Returns ``segment_info(path)``.
+    """
+    from surge_tpu.codec.tensor import encode_events_columnar
+    from surge_tpu.serialization import SerializedMessage
+
+    if partitions is None:
+        partitions = range(log.num_partitions(topic))
+    logs: dict[str, list] = {}
+    for p in partitions:
+        for r in log.read(topic, p):
+            if r.key is None or r.value is None:
+                continue
+            ev = deserialize_event(SerializedMessage(key=r.key, value=r.value))
+            if encode_event is not None:
+                ev = encode_event(ev)
+            logs.setdefault(r.key, []).append(ev)
+
+    ordered = sorted(logs)
+    with ColumnarSegmentWriter(path) as writer:
+        for start in range(0, max(len(ordered), 1), chunk_aggregates):
+            chunk_ids = ordered[start: start + chunk_aggregates]
+            if not chunk_ids:
+                break
+            colev = encode_events_columnar(registry, [logs[a] for a in chunk_ids])
+            if derived_cols:
+                _drop_derived(colev, derived_cols)
+            writer.append(colev)
+    return {"aggregate_order": ordered, **segment_info(path)}

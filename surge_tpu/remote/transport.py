@@ -116,6 +116,10 @@ class GrpcRemoteDeliver:
         # strong refs: the loop only weakly references tasks, and a GC'd forward
         # task would leave the caller's reply future silently unresolved
         self._inflight: set = set()
+        # per-aggregate forward chains: concurrent unary RPCs would otherwise race
+        # and reorder same-aggregate envelopes, breaking the per-aggregate FIFO
+        # guarantee local delivery (and the remoting channel it replaces) provides
+        self._chains: Dict[tuple, asyncio.Task] = {}
 
     def set_address(self, node: HostPort, target: str) -> None:
         """(Re)point a node at a gRPC target; drops any cached channel so a node
@@ -133,13 +137,12 @@ class GrpcRemoteDeliver:
     def _call_for(self, node: HostPort):
         call = self._calls.get(node)
         if call is None:
+            from surge_tpu.multilanguage.service import unary_callables
+
             target = self.addresses.get(node, f"{node.host}:{node.port}")
             channel = grpc.aio.insecure_channel(target)
             self._channels[node] = channel
-            call = channel.unary_unary(
-                f"/{SERVICE}/Deliver",
-                request_serializer=pb.DeliverRequest.SerializeToString,
-                response_deserializer=pb.DeliverReply.FromString)
+            call = unary_callables(channel, SERVICE, METHODS)["Deliver"]
             self._calls[node] = call
         return call
 
@@ -150,9 +153,24 @@ class GrpcRemoteDeliver:
         except Exception as exc:  # noqa: BLE001 — unserializable command etc.
             fail_future(env.reply, exc)
             return
-        task = asyncio.ensure_future(self._forward(owner, request, env))
+        # chain after the aggregate's previous in-flight forward (FIFO per aggregate)
+        key = (owner, aggregate_id)
+        prev = self._chains.get(key)
+        task = asyncio.ensure_future(self._forward_after(prev, owner, request, env))
+        self._chains[key] = task
+        task.add_done_callback(lambda t, k=key: self._chain_done(k, t))
         self._inflight.add(task)
         task.add_done_callback(self._inflight.discard)
+
+    def _chain_done(self, key: tuple, task: asyncio.Task) -> None:
+        if self._chains.get(key) is task:
+            del self._chains[key]
+
+    async def _forward_after(self, prev: Optional[asyncio.Task], owner: HostPort,
+                             request: pb.DeliverRequest, env: Envelope) -> None:
+        if prev is not None:
+            await asyncio.wait({prev})  # _forward never raises; outcome irrelevant
+        await self._forward(owner, request, env)
 
     def _encode(self, partition: int, aggregate_id: str,
                 env: Envelope) -> pb.DeliverRequest:

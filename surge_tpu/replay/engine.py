@@ -361,6 +361,30 @@ class ReplayEngine:
         return ReplayResult(states=out, num_aggregates=len(logs),
                             num_events=total_events, padded_events=padded)
 
+    def replay_columnar_chunks(self, chunks: Iterable[ColumnarEvents]) -> ReplayResult:
+        """Fold a stream of aggregate-range chunks (each covering a DISJOINT set of
+        aggregates — the columnar segment layout, surge_tpu.log.columnar): chunks
+        replay independently and their state columns concatenate in order. The
+        whole-log array never materializes in host memory at once."""
+        state_fields = self.spec.registry.state.fields
+        parts: dict[str, list[np.ndarray]] = {f.name: [] for f in state_fields}
+        total_aggregates = total_events = padded = 0
+        for colev in chunks:
+            res = self.replay_columnar(colev)
+            for name in parts:
+                parts[name].append(res.states[name])
+            total_aggregates += res.num_aggregates
+            total_events += res.num_events
+            padded += res.padded_events
+        if total_aggregates == 0:
+            return ReplayResult(states={f.name: np.zeros((0,), dtype=f.dtype)
+                                        for f in state_fields},
+                                num_aggregates=0, num_events=0, padded_events=0)
+        return ReplayResult(
+            states={name: np.concatenate(arrs) for name, arrs in parts.items()},
+            num_aggregates=total_aggregates, num_events=total_events,
+            padded_events=padded)
+
     def replay_stream(self, chunks: Iterable[EncodedEvents], batch: int,
                       init_carry: Mapping[str, Any] | None = None,
                       ordinal_base: np.ndarray | None = None) -> ReplayResult:

@@ -162,23 +162,49 @@ def test_readdressing_a_restarted_node_takes_effect():
 
 def test_server_delivers_to_addressed_partition_without_rerouting():
     """Regression: a forwarded envelope must land in the addressed partition's
-    local region even if the receiving node's tracker disagrees (diverged trackers
-    mid-rebalance must not ping-pong envelopes between nodes)."""
+    local region even when the receiving node's OWN tracker claims another node
+    owns it (diverged trackers mid-rebalance must not ping-pong envelopes)."""
+    async def scenario():
+        from surge_tpu.remote.transport import pb
+
+        log = InMemoryLog()
+        # B has its own tracker whose view says A owns EVERY partition — so a
+        # regressed server (router.deliver) would forward back toward A
+        tracker_b = PartitionTracker()
+        engine_b = create_engine(make_logic(), log=log, config=CFG, local_host=B,
+                                 tracker=tracker_b,
+                                 remote_deliver=lambda *a: (_ for _ in ()).throw(
+                                     AssertionError("envelope bounced back off-node")))
+        await engine_b.start()
+        tracker_b.update({A: [0, 1, 2, 3]})
+        server_b = NodeTransportServer(engine_b)
+        await server_b.start()
+
+        req = pb.DeliverRequest(aggregate_id="agg-x", partition=2)
+        req.command = counter.command_formatting().write_command(
+            counter.Increment("agg-x"))
+        reply = await server_b.Deliver(req, None)
+        assert reply.outcome == "success", reply
+        await server_b.stop()
+        await engine_b.stop()
+
+    asyncio.run(scenario())
+
+
+def test_same_aggregate_forwards_preserve_fifo_order():
+    """Regression: two un-awaited sends to one remote aggregate must arrive in
+    send order (per-aggregate FIFO across the wire, like local mailbox delivery)."""
     async def scenario():
         log, tracker, engines, servers, delivers = await _two_nodes()
         remote_agg = next(f"agg-{i}" for i in range(50)
                           if engines[A].router.partition_for(f"agg-{i}") in (2, 3))
-        p = engines[A].router.partition_for(remote_agg)
-        # B's view diverges: it now believes A owns everything
-        engines[B].tracker = tracker  # shared; simulate divergence via direct call
-        # deliver through B's transport server directly with the addressed partition
-        from surge_tpu.remote.transport import pb
-
-        req = pb.DeliverRequest(aggregate_id=remote_agg, partition=p)
-        req.command = counter.command_formatting().write_command(
-            counter.Increment(remote_agg))
-        reply = await servers[B].Deliver(req, None)
-        assert reply.outcome == "success"
+        ref = engines[A].aggregate_for(remote_agg)
+        # fire many sends concurrently; sequence numbers must come back monotonically
+        tasks = [asyncio.ensure_future(ref.send_command(counter.Increment(remote_agg)))
+                 for _ in range(10)]
+        results = await asyncio.gather(*tasks)
+        counts = [r.state.count for r in results]
+        assert counts == list(range(1, 11)), counts
         await _teardown(engines, servers, delivers)
 
     asyncio.run(scenario())
