@@ -30,6 +30,8 @@ from surge_tpu.dsl import (
     SurgeEngineBuilder,
     create_engine,
 )
+from surge_tpu.engine.event_dsl import SurgeEventEngine, create_event_engine
+from surge_tpu.log import FileLog, InMemoryLog
 from surge_tpu.serialization import (
     SerializedMessage,
     SerializedAggregate,
@@ -43,10 +45,14 @@ __all__ = [
     "CommandRejected",
     "CommandSuccess",
     "Config",
+    "FileLog",
+    "InMemoryLog",
     "SurgeCommandBusinessLogic",
     "SurgeEngine",
     "SurgeEngineBuilder",
+    "SurgeEventEngine",
     "create_engine",
+    "create_event_engine",
     "default_config",
     "SerializedMessage",
     "SerializedAggregate",

@@ -1,0 +1,12 @@
+"""Admin service — operator introspection and control for a running engine.
+
+The JMX-suite analog (reference: surge/health/jmx SurgeHealthActor:20-132, MBean
+exposing the health registry plus restart/stop controls, behind
+``supervisor-actor.jmx-enabled``): a small gRPC service per engine process serving
+the health-check tree, the metrics registry export, the supervised-component list,
+and restart/stop controls routed through each component's ``Controllable``.
+"""
+
+from surge_tpu.admin.server import AdminClient, AdminServer
+
+__all__ = ["AdminClient", "AdminServer"]

@@ -1,0 +1,109 @@
+"""Admin gRPC server/client over the hand-written service glue (see package doc)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict
+from typing import Optional
+
+import grpc
+
+from surge_tpu.admin import admin_pb2 as pb
+from surge_tpu.multilanguage.service import generic_handler, unary_callables
+
+SERVICE = "surge_tpu.admin.SurgeAdmin"
+METHODS = {
+    "GetHealth": (pb.Empty, pb.HealthTreeReply),
+    "GetMetrics": (pb.Empty, pb.MetricsReply),
+    "ListComponents": (pb.Empty, pb.RegistrationsReply),
+    "RestartComponent": (pb.ComponentRequest, pb.ComponentReply),
+    "StopEngine": (pb.Empty, pb.ComponentReply),
+}
+
+
+class AdminServer:
+    """Serves introspection + control for one engine."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.engine = engine
+        self._host = host
+        self._port = port
+        self._server: Optional[grpc.aio.Server] = None
+        self.bound_port: Optional[int] = None
+
+    # -- service implementation ----------------------------------------------------------
+
+    async def GetHealth(self, request, context) -> pb.HealthTreeReply:
+        tree = self.engine.health_check()
+        return pb.HealthTreeReply(tree_json=json.dumps(asdict(tree)).encode())
+
+    async def GetMetrics(self, request, context) -> pb.MetricsReply:
+        reg = self.engine.metrics_registry
+        return pb.MetricsReply(metrics_json=json.dumps({
+            "values": reg.get_metrics(),
+            "descriptions": reg.metric_descriptions(),
+        }).encode())
+
+    async def ListComponents(self, request, context) -> pb.RegistrationsReply:
+        return pb.RegistrationsReply(
+            names=self.engine.health_supervisor.registered())
+
+    async def RestartComponent(self, request, context) -> pb.ComponentReply:
+        """Drive the component's restart through the supervisor (the MBean restart
+        op) — same budget and signal emission as a pattern-matched restart."""
+        try:
+            await self.engine.health_supervisor.restart_component(request.name)
+            return pb.ComponentReply(ok=True, detail="restarted")
+        except KeyError:
+            return pb.ComponentReply(
+                ok=False, detail=f"unknown component {request.name!r}")
+        except Exception as exc:  # noqa: BLE001 — operator gets the failure back
+            return pb.ComponentReply(ok=False, detail=repr(exc))
+
+    async def StopEngine(self, request, context) -> pb.ComponentReply:
+        try:
+            await self.engine.stop()
+            return pb.ComponentReply(ok=True, detail="stopped")
+        except Exception as exc:  # noqa: BLE001
+            return pb.ComponentReply(ok=False, detail=repr(exc))
+
+    # -- lifecycle -----------------------------------------------------------------------
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (generic_handler(SERVICE, METHODS, self),))
+        self.bound_port = self._server.add_insecure_port(f"{self._host}:{self._port}")
+        await self._server.start()
+        return self.bound_port
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None
+
+
+class AdminClient:
+    """Typed operator client."""
+
+    def __init__(self, channel: grpc.aio.Channel) -> None:
+        self._calls = unary_callables(channel, SERVICE, METHODS)
+
+    async def health(self) -> dict:
+        reply = await self._calls["GetHealth"](pb.Empty())
+        return json.loads(reply.tree_json)
+
+    async def metrics(self) -> dict:
+        reply = await self._calls["GetMetrics"](pb.Empty())
+        return json.loads(reply.metrics_json)
+
+    async def components(self) -> list:
+        return list((await self._calls["ListComponents"](pb.Empty())).names)
+
+    async def restart_component(self, name: str) -> tuple[bool, str]:
+        r = await self._calls["RestartComponent"](pb.ComponentRequest(name=name))
+        return r.ok, r.detail
+
+    async def stop_engine(self) -> tuple[bool, str]:
+        r = await self._calls["StopEngine"](pb.Empty())
+        return r.ok, r.detail

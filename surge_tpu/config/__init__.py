@@ -28,15 +28,11 @@ def _env_key(key: str) -> str:
 DEFAULTS: dict[str, Any] = {
     # --- log / producer (reference: surge.kafka.publisher.*) ---
     "surge.producer.flush-interval-ms": 50,
-    "surge.producer.batch-size": 16384,
-    "surge.producer.linger-ms": 5,
-    "surge.producer.transaction-timeout-ms": 60_000,
     "surge.producer.slow-transaction-warning-ms": 1_000,
     "surge.producer.ktable-check-interval-ms": 500,
     "surge.producer.enable-transactions": True,
     # --- state store / ktable (reference: surge.kafka-streams.*) ---
     "surge.state-store.commit-interval-ms": 3_000,
-    "surge.state-store.standby-replicas": 0,
     "surge.state-store.restore-max-poll-records": 500,
     "surge.state-store.wipe-state-on-start": False,
     "surge.state-store.backend": "memory",  # memory | native | rocks-like file store
@@ -61,7 +57,6 @@ DEFAULTS: dict[str, Any] = {
     "surge.replay.donate-carry": True,
     # --- health (common reference.conf:228-260) ---
     "surge.health.window-frequency-ms": 10_000,
-    "surge.health.window-advance-ms": 10_000,
     "surge.health.window-buffer-size": 10,
     "surge.health.signal-buffer-size": 25,
     "surge.health.supervisor-restart-max": 3,

@@ -247,6 +247,27 @@ class SurgeEngine(Controllable):
 
     # -- TPU bulk restore ---------------------------------------------------------------
 
+    def _resolve_mesh(self):
+        """The replay mesh: an explicit ``mesh=`` wins; otherwise the
+        enable-mesh-sharding feature flag builds a 1-D ``data`` mesh over every
+        visible device (entity-parallel replay across the chip/pod, SURVEY.md
+        §2.10)."""
+        if self.mesh is not None:
+            return self.mesh
+        if not self.config.get_bool(
+                "surge.feature-flags.experimental.enable-mesh-sharding"):
+            return None
+        import jax
+        import numpy as _np
+
+        devices = jax.devices()
+        if len(devices) < 2:
+            return None  # a 1-device mesh adds sharding overhead for nothing
+        axis = (self.config.get_str("surge.replay.mesh-axes", "data")
+                .split(",")[0].strip() or "data")  # must match ReplayEngine's axis
+        self.mesh = jax.sharding.Mesh(_np.asarray(devices), (axis,))
+        return self.mesh
+
     async def rebuild_from_events(self):
         """Rebuild the materialized store by folding the events topic through the
         configured replay backend (tpu: batched ReplayEngine; cpu: scalar fold), then
@@ -259,6 +280,7 @@ class SurgeEngine(Controllable):
         from surge_tpu.serialization import SerializedMessage
 
         spec = self.logic.replay_spec()
+        mesh = self._resolve_mesh()
         result = await asyncio.get_running_loop().run_in_executor(None, lambda: restore_from_events(
             self.log, self.logic.events_topic, self.indexer.store,
             deserialize_event=lambda b: evt_fmt.read_event(SerializedMessage(key="", value=b)),
@@ -266,7 +288,7 @@ class SurgeEngine(Controllable):
             model=self.logic.model, replay_spec=spec,
             encode_event=getattr(self.logic, "encode_event", None),
             decode_state=getattr(self.logic, "decode_state", None),
-            config=self.config, mesh=self.mesh))
+            config=self.config, mesh=mesh))
         # overlay snapshots for aggregates the events topic does not cover (state-only
         # publishes, e.g. apply_events) — for event-sourced aggregates the replayed
         # state and the latest snapshot are identical because events+state commit

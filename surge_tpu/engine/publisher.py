@@ -110,6 +110,14 @@ class PartitionPublisher:
         self._dedup_ttl_s = 60.0
         self._single_record_opt_in = self.config.get_bool(
             "surge.feature-flags.experimental.disable-single-record-transactions")
+        # surge.producer.enable-transactions=false: append every record individually
+        # (no atomicity across events+state; still epoch-fenced) — the reference's
+        # non-transactional producer mode for throughput-over-consistency setups
+        self._transactions_enabled = self.config.get_bool(
+            "surge.producer.enable-transactions", True)
+        # non-transactional mode: request_id -> records already appended (resume
+        # point for retries of a partially-failed batch)
+        self._partial_progress: Dict[str, int] = {}
         self._flush_task = BackgroundTask(self._flush_loop, f"publisher-flush-{partition}")
         self._progress_task = BackgroundTask(self._progress_loop, f"publisher-progress-{partition}")
 
@@ -256,7 +264,18 @@ class PartitionPublisher:
                                    outcome: "asyncio.Future[Optional[Exception]]") -> None:
         t0 = time.perf_counter()
         try:
-            if self._single_record_opt_in and len(records) == 1:
+            if not self._transactions_enabled:
+                # per-record appends: a mid-batch failure must not re-append the
+                # prefix on the entity's same-request_id retry, so progress is
+                # tracked per request and retries resume where they stopped
+                committed = []
+                for p in batch:
+                    start = self._partial_progress.get(p.request_id, 0)
+                    for i in range(start, len(p.records)):
+                        committed.append(self._producer.send_immediate(p.records[i]))
+                        self._partial_progress[p.request_id] = i + 1
+                    self._partial_progress.pop(p.request_id, None)
+            elif self._single_record_opt_in and len(records) == 1:
                 committed = [self._producer.send_immediate(records[0])]
             else:
                 self._producer.begin()

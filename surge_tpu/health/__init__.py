@@ -206,6 +206,14 @@ class HealthSupervisor:
     def registered(self) -> List[str]:
         return sorted(self._registrations)
 
+    async def restart_component(self, name: str) -> None:
+        """Operator-initiated restart of a registered component (the JMX MBean
+        restart op): same budget/signal path a matched pattern takes.
+        Raises KeyError for unknown names."""
+        reg = self._registrations[name]
+        await self._restart(reg, HealthSignal(name="admin.restart-requested",
+                                              level="trace", source=name))
+
     def _on_signal(self, signal: HealthSignal) -> None:
         for reg in self._registrations.values():
             reg.window.add(signal)

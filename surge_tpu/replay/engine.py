@@ -113,12 +113,17 @@ class ReplayEngine:
     """
 
     def __init__(self, spec: ReplaySpec, config: Config | None = None,
-                 mesh: Optional[jax.sharding.Mesh] = None, mesh_axis: str = "data",
-                 unroll: int = 1) -> None:
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 mesh_axis: Optional[str] = None, unroll: int = 1) -> None:
         self.spec = spec
         self.config = config or default_config()
         self.mesh = mesh
+        # batch-axis name: explicit arg > surge.replay.mesh-axes (first entry)
+        if mesh_axis is None:
+            mesh_axis = (self.config.get_str("surge.replay.mesh-axes", "data")
+                         .split(",")[0].strip() or "data")
         self.mesh_axis = mesh_axis
+        self.donate_carry = self.config.get_bool("surge.replay.donate-carry", True)
         self.time_chunk = self.config.get_int("surge.replay.time-chunk")
         lane = self._lane_multiple()
         self.batch_size = _round_up(
@@ -159,14 +164,15 @@ class ReplayEngine:
         def fold(carry: StateTree, packed, side, ord_base) -> StateTree:
             return batch_fold(carry, wire.decode(packed, side, ord_base))
 
+        donate = (0,) if self.donate_carry else ()
         if self.mesh is not None:
             carry_sh = jax.tree_util.tree_map(lambda _: self._sharding,
                                               self._carry_struct())
-            jitted = jax.jit(fold, donate_argnums=(0,),
+            jitted = jax.jit(fold, donate_argnums=donate,
                              in_shardings=(carry_sh, None, None, None),
                              out_shardings=carry_sh)
         else:
-            jitted = jax.jit(fold, donate_argnums=(0,))
+            jitted = jax.jit(fold, donate_argnums=donate)
         self._wire_folds[key] = (wire, jitted)
         return wire, jitted
 

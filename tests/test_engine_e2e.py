@@ -151,3 +151,60 @@ def test_rebalance_listener_sees_assignments():
         await engine.stop()
 
     asyncio.run(scenario())
+
+
+def test_mesh_sharding_flag_builds_replay_mesh():
+    """The enable-mesh-sharding flag must have a real consumer: without an explicit
+    mesh, engine replay builds a 1-D data mesh over all visible devices (8 on the
+    test CPU backend) and the rebuild still matches."""
+    async def scenario():
+        import jax
+
+        log = InMemoryLog()
+        engine = create_engine(make_logic(), log=log, config=CFG)
+        await engine.start()
+        for i in range(10):
+            await engine.aggregate_for(f"m-{i}").send_command(counter.Increment(f"m-{i}"))
+        await engine.stop()
+
+        cfg = CFG.with_overrides({
+            "surge.feature-flags.experimental.enable-mesh-sharding": True,
+            "surge.replay.batch-size": 16,
+        })
+        engine2 = create_engine(make_logic(), log=log, config=cfg)
+        await engine2.start()
+        res = await engine2.rebuild_from_events()
+        assert res.num_aggregates == 10
+        assert engine2.mesh is not None
+        assert engine2.mesh.devices.size == len(jax.devices())
+        st = await engine2.aggregate_for("m-3").get_state()
+        assert st.count == 1
+        await engine2.stop()
+
+    asyncio.run(scenario())
+
+
+def test_mesh_axis_name_config_is_consistent():
+    """Regression: surge.replay.mesh-axes must name the axis in BOTH the engine's
+    auto-built mesh and the ReplayEngine shardings."""
+    async def scenario():
+        log = InMemoryLog()
+        engine = create_engine(make_logic(), log=log, config=CFG)
+        await engine.start()
+        for i in range(6):
+            await engine.aggregate_for(f"x-{i}").send_command(counter.Increment(f"x-{i}"))
+        await engine.stop()
+
+        cfg = CFG.with_overrides({
+            "surge.feature-flags.experimental.enable-mesh-sharding": True,
+            "surge.replay.mesh-axes": "batch",
+            "surge.replay.batch-size": 16,
+        })
+        engine2 = create_engine(make_logic(), log=log, config=cfg)
+        await engine2.start()
+        res = await engine2.rebuild_from_events()
+        assert res.num_aggregates == 6
+        assert engine2.mesh.axis_names == ("batch",)
+        await engine2.stop()
+
+    asyncio.run(scenario())

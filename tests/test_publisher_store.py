@@ -394,3 +394,41 @@ def test_retry_joins_in_flight_commit_instead_of_requeueing():
         await indexer.stop()
 
     asyncio.run(scenario())
+
+
+def test_non_transactional_publisher_mode():
+    """surge.producer.enable-transactions=false: every record appends individually
+    (no atomicity) but fencing and read-your-writes gating still hold."""
+    import asyncio
+
+    from surge_tpu import SurgeCommandBusinessLogic, create_engine, default_config
+    from surge_tpu.models import counter
+
+    cfg = default_config().with_overrides({
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 10,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.engine.num-partitions": 1,
+        "surge.producer.enable-transactions": False,
+    })
+
+    async def scenario():
+        engine = create_engine(
+            SurgeCommandBusinessLogic(
+                aggregate_name="counter", model=counter.CounterModel(),
+                state_format=counter.state_formatting(),
+                event_format=counter.event_formatting()),
+            config=cfg)
+        await engine.start()
+        for i in range(5):
+            r = await engine.aggregate_for("nt-1").send_command(
+                counter.Increment("nt-1"))
+        assert r.state.count == 5
+        st = await engine.aggregate_for("nt-1").get_state()
+        assert st.count == 5
+        # events + state really landed on the log
+        assert engine.log.end_offset("counter-events", 0) == 5
+        await engine.stop()
+
+    asyncio.run(scenario())
