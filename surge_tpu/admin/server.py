@@ -70,10 +70,14 @@ class AdminServer:
     # -- lifecycle -----------------------------------------------------------------------
 
     async def start(self) -> int:
+        from surge_tpu.remote.security import add_secure_port
+
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers(
             (generic_handler(SERVICE, METHODS, self),))
-        self.bound_port = self._server.add_insecure_port(f"{self._host}:{self._port}")
+        self.bound_port = add_secure_port(
+            self._server, f"{self._host}:{self._port}",
+            getattr(self.engine, "config", None))
         await self._server.start()
         return self.bound_port
 

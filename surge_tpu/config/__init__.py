@@ -71,6 +71,12 @@ DEFAULTS: dict[str, Any] = {
     # enable-akka-cluster analog, core reference.conf:64-66)
     "surge.feature-flags.experimental.enable-cluster-sharding": False,
     "surge.feature-flags.experimental.disable-single-record-transactions": False,
+    # --- gRPC transport security (KafkaSecurityConfiguration analog) ---
+    "surge.grpc.tls.enabled": False,
+    "surge.grpc.tls.cert-file": "",
+    "surge.grpc.tls.key-file": "",
+    "surge.grpc.tls.root-ca-file": "",
+    "surge.grpc.tls.require-client-auth": False,
     # --- engine ---
     "surge.engine.num-partitions": 8,
     "surge.engine.dr-standby-enabled": False,

@@ -1,0 +1,76 @@
+"""Transport security for the gRPC surfaces — the KafkaSecurityConfiguration analog.
+
+The reference secures its data plane with Kafka SASL/SSL properties derived from
+config (modules/common/.../KafkaSecurityConfiguration.scala); surge_tpu's inter-node
+and gateway planes are gRPC, so the equivalent is TLS (optionally mutual) driven by
+the same layered config:
+
+    surge.grpc.tls.enabled        (false)  — plaintext by default
+    surge.grpc.tls.cert-file               — this process's certificate chain (PEM)
+    surge.grpc.tls.key-file                — this process's private key (PEM)
+    surge.grpc.tls.root-ca-file            — CA bundle used to verify peers
+    surge.grpc.tls.require-client-auth (false) — servers demand client certs (mTLS)
+
+``add_secure_port`` / ``secure_channel`` are used by every server/client in
+surge_tpu.remote, surge_tpu.multilanguage, and surge_tpu.admin; with TLS disabled
+they fall back to the insecure variants, so single-process and test setups need no
+certificates.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import grpc
+
+from surge_tpu.config import Config, default_config
+
+
+def tls_enabled(config: Optional[Config]) -> bool:
+    cfg = config or default_config()
+    return cfg.get_bool("surge.grpc.tls.enabled", False)
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def server_credentials(config: Config) -> grpc.ServerCredentials:
+    cert = config.get_str("surge.grpc.tls.cert-file")
+    key = config.get_str("surge.grpc.tls.key-file")
+    if not cert or not key:
+        raise ValueError(
+            "surge.grpc.tls.enabled requires surge.grpc.tls.cert-file and "
+            "surge.grpc.tls.key-file")
+    root = config.get_str("surge.grpc.tls.root-ca-file")
+    require_client = config.get_bool("surge.grpc.tls.require-client-auth", False)
+    return grpc.ssl_server_credentials(
+        [(_read(key), _read(cert))],
+        root_certificates=_read(root) if root else None,
+        require_client_auth=require_client)
+
+
+def channel_credentials(config: Config) -> grpc.ChannelCredentials:
+    root = config.get_str("surge.grpc.tls.root-ca-file")
+    cert = config.get_str("surge.grpc.tls.cert-file")
+    key = config.get_str("surge.grpc.tls.key-file")
+    return grpc.ssl_channel_credentials(
+        root_certificates=_read(root) if root else None,
+        private_key=_read(key) if key else None,
+        certificate_chain=_read(cert) if cert else None)
+
+
+def add_secure_port(server: grpc.aio.Server, address: str,
+                    config: Optional[Config]) -> int:
+    """Bind ``address`` with TLS when enabled, plaintext otherwise."""
+    if tls_enabled(config):
+        return server.add_secure_port(address, server_credentials(config))
+    return server.add_insecure_port(address)
+
+
+def secure_channel(target: str, config: Optional[Config]) -> grpc.aio.Channel:
+    """Open a channel with TLS when enabled, plaintext otherwise."""
+    if tls_enabled(config):
+        return grpc.aio.secure_channel(target, channel_credentials(config))
+    return grpc.aio.insecure_channel(target)

@@ -41,6 +41,7 @@ class NodeTransportServer:
         self._port = port
         self._server: Optional[grpc.aio.Server] = None
         self.bound_port: Optional[int] = None
+        self._config = getattr(engine, "config", None)
 
     async def Deliver(self, request: pb.DeliverRequest, context) -> pb.DeliverReply:
         logic = self.engine.logic
@@ -87,10 +88,13 @@ class NodeTransportServer:
         return pb.DeliverReply(outcome="failure", error=f"unexpected reply {result!r}")
 
     async def start(self) -> int:
+        from surge_tpu.remote.security import add_secure_port
+
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers(
             (generic_handler(SERVICE, METHODS, self),))
-        self.bound_port = self._server.add_insecure_port(f"{self._host}:{self._port}")
+        self.bound_port = add_secure_port(
+            self._server, f"{self._host}:{self._port}", self._config)
         await self._server.start()
         return self.bound_port
 
@@ -106,8 +110,9 @@ class GrpcRemoteDeliver:
     caller's future (ask semantics preserved across the wire)."""
 
     def __init__(self, logic, addresses: Dict[HostPort, str] | None = None,
-                 timeout_s: float = 30.0) -> None:
+                 timeout_s: float = 30.0, config=None) -> None:
         self.logic = logic
+        self.config = config  # TLS when surge.grpc.tls.enabled (remote/security.py)
         # HostPort -> "host:port" gRPC target; defaults to the HostPort itself
         self.addresses = dict(addresses or {})
         self.timeout_s = timeout_s
@@ -138,9 +143,10 @@ class GrpcRemoteDeliver:
         call = self._calls.get(node)
         if call is None:
             from surge_tpu.multilanguage.service import unary_callables
+            from surge_tpu.remote.security import secure_channel
 
             target = self.addresses.get(node, f"{node.host}:{node.port}")
-            channel = grpc.aio.insecure_channel(target)
+            channel = secure_channel(target, self.config)
             self._channels[node] = channel
             call = unary_callables(channel, SERVICE, METHODS)["Deliver"]
             self._calls[node] = call

@@ -1,0 +1,106 @@
+"""gRPC transport security (the KafkaSecurityConfiguration analog): node transport
+over real TLS with a self-signed CA, plus plaintext fallback when disabled."""
+
+import asyncio
+import subprocess
+
+import pytest
+
+from surge_tpu import SurgeCommandBusinessLogic, create_engine, default_config
+from surge_tpu.engine.entity import CommandSuccess
+from surge_tpu.engine.partition import HostPort, PartitionTracker
+from surge_tpu.log import InMemoryLog
+from surge_tpu.models import counter
+from surge_tpu.remote import GrpcRemoteDeliver, NodeTransportServer
+
+A = HostPort("node-a", 1)
+B = HostPort("node-b", 2)
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """Self-signed CA + a localhost server certificate."""
+    d = tmp_path_factory.mktemp("certs")
+    ca_key, ca_crt = str(d / "ca.key"), str(d / "ca.crt")
+    srv_key, srv_csr, srv_crt = str(d / "s.key"), str(d / "s.csr"), str(d / "s.crt")
+    ext = str(d / "ext.cnf")
+    run = lambda *args: subprocess.run(args, check=True, capture_output=True)
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes", "-keyout", ca_key,
+        "-out", ca_crt, "-days", "1", "-subj", "/CN=surge-test-ca")
+    run("openssl", "req", "-newkey", "rsa:2048", "-nodes", "-keyout", srv_key,
+        "-out", srv_csr, "-subj", "/CN=localhost")
+    with open(ext, "w") as f:
+        f.write("subjectAltName=DNS:localhost,IP:127.0.0.1\n")
+    run("openssl", "x509", "-req", "-in", srv_csr, "-CA", ca_crt, "-CAkey", ca_key,
+        "-CAcreateserial", "-out", srv_crt, "-days", "1", "-extfile", ext)
+    return {"ca": ca_crt, "cert": srv_crt, "key": srv_key}
+
+
+def make_logic():
+    return SurgeCommandBusinessLogic(
+        aggregate_name="counter", model=counter.CounterModel(),
+        state_format=counter.state_formatting(),
+        event_format=counter.event_formatting(),
+        command_format=counter.command_formatting())
+
+
+def test_node_transport_over_tls(certs):
+    async def scenario():
+        tls_cfg = default_config().with_overrides({
+            "surge.producer.flush-interval-ms": 5,
+            "surge.producer.ktable-check-interval-ms": 5,
+            "surge.state-store.commit-interval-ms": 20,
+            "surge.aggregate.init-retry-interval-ms": 5,
+            "surge.engine.num-partitions": 4,
+            "surge.grpc.tls.enabled": True,
+            "surge.grpc.tls.cert-file": certs["cert"],
+            "surge.grpc.tls.key-file": certs["key"],
+            "surge.grpc.tls.root-ca-file": certs["ca"],
+        })
+        log, tracker = InMemoryLog(), PartitionTracker()
+        engines, servers, delivers = {}, {}, {}
+        for host in (A, B):
+            deliver = GrpcRemoteDeliver(make_logic(), config=tls_cfg)
+            delivers[host] = deliver
+            engines[host] = create_engine(make_logic(), log=log, config=tls_cfg,
+                                          local_host=host, tracker=tracker,
+                                          remote_deliver=deliver)
+        for host in (A, B):
+            await engines[host].start()
+            servers[host] = NodeTransportServer(engines[host], host="localhost")
+            port = await servers[host].start()
+            for d in delivers.values():
+                d.set_address(host, f"localhost:{port}")
+        tracker.update({A: [0, 1], B: [2, 3]})
+
+        crossed = 0
+        for i in range(20):
+            agg = f"agg-{i}"
+            r = await engines[A].aggregate_for(agg).send_command(
+                counter.Increment(agg))
+            assert isinstance(r, CommandSuccess) and r.state.count == 1, (i, r)
+            if engines[A].router.partition_for(agg) in (2, 3):
+                crossed += 1
+        assert crossed > 0  # commands really crossed the encrypted link
+
+        for host in (A, B):
+            await servers[host].stop()
+            await engines[host].stop()
+            await delivers[host].close()
+
+    asyncio.run(scenario())
+
+
+def test_tls_requires_cert_and_key():
+    from surge_tpu.remote.security import server_credentials
+
+    cfg = default_config().with_overrides({"surge.grpc.tls.enabled": True})
+    with pytest.raises(ValueError, match="cert-file"):
+        server_credentials(cfg)
+
+
+def test_plaintext_default_unchanged():
+    from surge_tpu.remote.security import tls_enabled
+
+    assert not tls_enabled(default_config())
+    assert not tls_enabled(None)
