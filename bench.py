@@ -29,6 +29,46 @@ def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def acquire_backend():
+    """Bounded-retry backend bring-up with CPU fallback (VERDICT r2 weak #1).
+
+    The tunneled TPU backend can be transiently UNAVAILABLE; one hiccup must not cost
+    the round's only data point. Retry acquisition (jax re-attempts init while no
+    backend exists), then fall back to the host CPU platform so the bench still emits
+    a real measured number with the platform honestly reported.
+    """
+    attempts = int(os.environ.get("SURGE_BENCH_BACKEND_ATTEMPTS", 5))
+    backoff_s = float(os.environ.get("SURGE_BENCH_BACKEND_BACKOFF_S", 60))
+
+    import jax
+
+    from jax.extend.backend import clear_backends
+
+    last_err = None
+    for attempt in range(1, attempts + 1):
+        try:
+            devices = jax.devices()
+            log(f"backend up on attempt {attempt}: {devices}")
+            return jax, devices
+        except Exception as err:
+            last_err = err
+            log(f"backend attempt {attempt}/{attempts} failed: {err}")
+            if attempt < attempts:
+                # a failed bring-up can leave partially-initialized backends cached
+                # (e.g. cpu registered before the tpu factory raised) — clear so the
+                # next attempt genuinely re-initializes the target platform
+                clear_backends()
+                time.sleep(backoff_s)
+
+    log(f"giving up on the default platform, falling back to cpu: {last_err}")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.pop("AXON_POOL_IPS", None)
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()  # raises only if even the host CPU platform is broken
+    return jax, devices
+
+
 def main() -> None:
     num_aggregates = int(os.environ.get("SURGE_BENCH_AGGREGATES", 1_000_000))
     num_events = int(os.environ.get("SURGE_BENCH_EVENTS", 100_000_000))
@@ -36,7 +76,7 @@ def main() -> None:
     time_chunk = int(os.environ.get("SURGE_BENCH_TIME_CHUNK", 128))
     batch_size = int(os.environ.get("SURGE_BENCH_BATCH", 8192))
 
-    import jax
+    jax, devices = acquire_backend()
 
     from surge_tpu.config import default_config
     from surge_tpu.engine.model import fold_events
@@ -44,8 +84,8 @@ def main() -> None:
     from surge_tpu.replay.corpus import decode_sample, sample_indices, synth_counter_corpus
     from surge_tpu.replay.engine import ReplayEngine
 
-    platform = jax.devices()[0].platform
-    log(f"platform={platform} devices={jax.devices()}")
+    platform = devices[0].platform
+    log(f"platform={platform} devices={devices}")
 
     t0 = time.perf_counter()
     corpus = synth_counter_corpus(num_aggregates, num_events, seed=42,
@@ -123,4 +163,17 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as err:  # terminal failure must still emit one JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "cold_replay_events_per_sec",
+            "value": 0,
+            "unit": "events/s",
+            "vs_baseline": 0,
+            "error": f"{type(err).__name__}: {err}",
+        }), flush=True)
+        sys.exit(1)
