@@ -31,6 +31,8 @@ DEFAULTS: dict[str, Any] = {
     "surge.producer.slow-transaction-warning-ms": 1_000,
     "surge.producer.ktable-check-interval-ms": 500,
     "surge.producer.enable-transactions": True,
+    # publish dedup window (the PublishTracker 60s TTL, KafkaProducerActorImpl.scala:580-608)
+    "surge.producer.publish-dedup-ttl-ms": 60_000,
     # --- state store / ktable (reference: surge.kafka-streams.*) ---
     "surge.state-store.commit-interval-ms": 3_000,
     "surge.state-store.restore-max-poll-records": 500,

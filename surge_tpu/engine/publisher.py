@@ -107,7 +107,8 @@ class PartitionPublisher:
         self._flush_interval = self.config.get_seconds("surge.producer.flush-interval-ms", 50)
         self._check_interval = self.config.get_seconds("surge.producer.ktable-check-interval-ms", 500)
         self._slow_txn_s = self.config.get_seconds("surge.producer.slow-transaction-warning-ms", 1000)
-        self._dedup_ttl_s = 60.0
+        self._dedup_ttl_s = self.config.get_seconds(
+            "surge.producer.publish-dedup-ttl-ms", 60_000)
         self._single_record_opt_in = self.config.get_bool(
             "surge.feature-flags.experimental.disable-single-record-transactions")
         # surge.producer.enable-transactions=false: append every record individually
@@ -115,9 +116,14 @@ class PartitionPublisher:
         # non-transactional producer mode for throughput-over-consistency setups
         self._transactions_enabled = self.config.get_bool(
             "surge.producer.enable-transactions", True)
-        # non-transactional mode: request_id -> records already appended (resume
-        # point for retries of a partially-failed batch)
-        self._partial_progress: Dict[str, int] = {}
+        # non-transactional mode: request_id -> LogRecords already appended (with
+        # offsets). A mid-batch failure keeps every affected request's appended
+        # records here so a same-request_id retry resumes after them AND can still
+        # contribute the full record list to the success bookkeeping — without this,
+        # retries would either re-append (duplicating events on the log) or hand the
+        # offset-alignment loop a short `committed` list.
+        self._partial_records: Dict[str, List[LogRecord]] = {}
+        self._partial_touched: Dict[str, float] = {}  # request_id -> last retry time
         self._flush_task = BackgroundTask(self._flush_loop, f"publisher-flush-{partition}")
         self._progress_task = BackgroundTask(self._progress_loop, f"publisher-progress-{partition}")
 
@@ -265,16 +271,22 @@ class PartitionPublisher:
         t0 = time.perf_counter()
         try:
             if not self._transactions_enabled:
-                # per-record appends: a mid-batch failure must not re-append the
-                # prefix on the entity's same-request_id retry, so progress is
-                # tracked per request and retries resume where they stopped
+                # per-record appends: a mid-batch failure must not re-append any
+                # already-written record on the entity's same-request_id retry, so
+                # the appended records themselves are kept per request and retries
+                # resume after them (contributing the full list to `committed` so
+                # the offset-alignment loop below stays 1:1 with p.records)
                 committed = []
                 for p in batch:
-                    start = self._partial_progress.get(p.request_id, 0)
-                    for i in range(start, len(p.records)):
-                        committed.append(self._producer.send_immediate(p.records[i]))
-                        self._partial_progress[p.request_id] = i + 1
-                    self._partial_progress.pop(p.request_id, None)
+                    done = self._partial_records.setdefault(p.request_id, [])
+                    self._partial_touched[p.request_id] = time.time()
+                    for i in range(len(done), len(p.records)):
+                        done.append(self._producer.send_immediate(p.records[i]))
+                    committed.extend(done)
+                # every append landed: the batch is durable, drop the resume state
+                for p in batch:
+                    self._partial_records.pop(p.request_id, None)
+                    self._partial_touched.pop(p.request_id, None)
             elif self._single_record_opt_in and len(records) == 1:
                 committed = [self._producer.send_immediate(records[0])]
             else:
@@ -348,8 +360,11 @@ class PartitionPublisher:
             asyncio.ensure_future(self.stop())
 
     def _purge_dedup(self) -> None:
-        if not self._completed:
-            return
         cutoff = time.time() - self._dedup_ttl_s
         for rid in [r for r, t in self._completed.items() if t < cutoff]:
             del self._completed[rid]
+        # partial-resume state whose entity never retried again (crashed out of its
+        # retry ladder) ages out on the same TTL
+        for rid in [r for r, t in self._partial_touched.items() if t < cutoff]:
+            self._partial_touched.pop(rid, None)
+            self._partial_records.pop(rid, None)

@@ -79,8 +79,11 @@ class NodeTransportServer:
             return pb.DeliverReply(
                 outcome="state", state=logic.state_format.write_state(result).value)
         if isinstance(result, CommandSuccess):
+            if result.state is None:
+                return pb.DeliverReply(outcome="success", has_state=False)
             written = logic.state_format.write_state(result.state).value
-            return pb.DeliverReply(outcome="success", state=written or b"")
+            return pb.DeliverReply(outcome="success", state=written or b"",
+                                   has_state=True)
         if isinstance(result, CommandRejected):
             return pb.DeliverReply(outcome="rejected", error=str(result.reason))
         if isinstance(result, CommandFailure):
@@ -192,6 +195,9 @@ class GrpcRemoteDeliver:
         elif isinstance(msg, GetState):
             request.get_state = True
         elif isinstance(msg, ApplyEvents):
+            # SetInParent selects the oneof even for zero events, so an empty
+            # ApplyEvents crosses the wire as the no-op it is locally
+            request.apply_events.SetInParent()
             request.apply_events.events.extend(
                 self.logic.event_format.write_event(e).value for e in msg.events)
         else:
@@ -213,8 +219,10 @@ class GrpcRemoteDeliver:
         elif outcome == "state":
             resolve_future(env.reply, self.logic.state_format.read_state(reply.state))
         elif outcome == "success":
-            state = (self.logic.state_format.read_state(reply.state)
-                     if reply.state else None)
+            # has_state is the discriminator; non-empty state without it keeps
+            # compatibility with servers predating the field
+            exists = reply.has_state or bool(reply.state)
+            state = self.logic.state_format.read_state(reply.state) if exists else None
             resolve_future(env.reply, CommandSuccess(state))
         elif outcome == "rejected":
             resolve_future(env.reply, CommandRejected(RejectedCommand(reply.error)))

@@ -297,6 +297,15 @@ def test_failed_journal_write_rolls_back_data_blocks(root):
         def flush(self):
             pass
 
+        def tell(self):
+            return real_journal.tell()
+
+        def truncate(self, n):
+            return real_journal.truncate(n)
+
+        def seek(self, *a):
+            return real_journal.seek(*a)
+
         def fileno(self):
             return real_journal.fileno()
 
@@ -315,5 +324,210 @@ def test_failed_journal_write_rolls_back_data_blocks(root):
     log.close()
 
     log2 = _fresh(root)
+    assert [r.value for r in log2.read("t", 0)] == [b"A", b"C"]
+    log2.close()
+
+
+def test_failed_partition_write_rolls_back_own_torn_bytes(root):
+    """Regression (r2 advisor): when a partition's OWN write/flush raises mid-commit,
+    its torn bytes must be truncated too — not just the partitions already staged —
+    or later commits append after garbage and corrupt the partition until restart."""
+    log = _fresh(root)
+    log.create_topic(TopicSpec("t", 2))
+    p = log.transactional_producer("tx")
+    p.begin()
+    p.send(LogRecord(topic="t", key="a", value=b"A0", partition=0))
+    p.send(LogRecord(topic="t", key="a", value=b"A1", partition=1))
+    p.commit()
+
+    class Boom(RuntimeError):
+        pass
+
+    part1 = log._parts[("t", 1)]
+    real_file = part1.file
+
+    class TornWriteFile:
+        """Writes land (torn bytes on disk) but flush explodes once."""
+
+        def __init__(self):
+            self.armed = True
+
+        def write(self, data):
+            return real_file.write(data)
+
+        def flush(self):
+            if self.armed:
+                self.armed = False
+                real_file.flush()  # make sure the torn bytes really hit the file
+                raise Boom()
+            return real_file.flush()
+
+        def truncate(self, n):
+            return real_file.truncate(n)
+
+        def seek(self, *a):
+            return real_file.seek(*a)
+
+        def fileno(self):
+            return real_file.fileno()
+
+        def close(self):
+            return real_file.close()
+
+    part1.file = TornWriteFile()
+    p.begin()
+    p.send(LogRecord(topic="t", key="b", value=b"B0", partition=0))
+    p.send(LogRecord(topic="t", key="b", value=b"LOST", partition=1))
+    with pytest.raises(Boom):
+        p.commit()
+    part1.file = real_file
+
+    # same-process follow-up commit must land cleanly on both partitions
+    p.begin()
+    p.send(LogRecord(topic="t", key="c", value=b"C0", partition=0))
+    p.send(LogRecord(topic="t", key="c", value=b"C1", partition=1))
+    p.commit()
+    assert [r.value for r in log.read("t", 0)] == [b"A0", b"C0"]
+    assert [r.value for r in log.read("t", 1)] == [b"A1", b"C1"]
+    log.close()
+
+    log2 = _fresh(root)  # and survive recovery
+    assert [r.value for r in log2.read("t", 0)] == [b"A0", b"C0"]
+    assert [r.value for r in log2.read("t", 1)] == [b"A1", b"C1"]
+    log2.close()
+
+
+def test_fsync_none_journal_ahead_of_data_clamps_to_intact_prefix(root):
+    """Regression (r2 advisor): with fsync='none' a crash can persist the journal
+    line but lose data-file bytes; the reopened log must clamp to the last intact
+    block instead of raising BlockCorruptError from the constructor."""
+    log = _fresh(root)
+    log.create_topic(TopicSpec("t", 1))
+    p = log.transactional_producer("tx")
+    p.begin(); p.send(LogRecord(topic="t", key="a", value=b"A")); p.commit()
+    first_end_pos = log._parts[("t", 0)].end_pos
+    p.begin(); p.send(LogRecord(topic="t", key="b", value=b"B")); p.commit()
+    log.close()
+
+    # crash simulation: journal retained both lines, data lost the second block's tail
+    seg_path = log._parts[("t", 0)].path
+    import os as _os
+    with open(seg_path, "r+b") as f:
+        f.truncate(first_end_pos + 7)  # mid-header of block 2
+
+    log2 = _fresh(root)  # must open, clamped to block 1
+    assert [r.value for r in log2.read("t", 0)] == [b"A"]
+    assert log2.end_offset("t", 0) == 1
+    p2 = log2.transactional_producer("tx")
+    p2.begin(); p2.send(LogRecord(topic="t", key="c", value=b"C")); p2.commit()
+    assert [r.value for r in log2.read("t", 0)] == [b"A", b"C"]
+    log2.close()
+
+    log3 = _fresh(root)  # the clamped frontier + new commit survive another restart
+    assert [r.value for r in log3.read("t", 0)] == [b"A", b"C"]
+    assert log3.end_offset("t", 0) == 2
+    log3.close()
+
+
+def test_fsync_none_whole_data_file_lost_clamps_to_empty(root):
+    """Extreme fsync='none' crash: the data file never reached disk at all."""
+    log = _fresh(root)
+    log.create_topic(TopicSpec("t", 1))
+    p = log.transactional_producer("tx")
+    p.begin(); p.send(LogRecord(topic="t", key="a", value=b"GONE")); p.commit()
+    seg_path = log._parts[("t", 0)].path
+    log.close()
+    import os as _os
+    _os.remove(seg_path)
+
+    log2 = _fresh(root)
+    assert log2.read("t", 0) == []
+    assert log2.end_offset("t", 0) == 0
+    p2 = log2.transactional_producer("tx")
+    p2.begin(); p2.send(LogRecord(topic="t", key="b", value=b"B")); p2.commit()
+    log2.close()
+    log3 = _fresh(root)
+    assert [r.value for r in log3.read("t", 0)] == [b"B"]
+    log3.close()
+
+
+def test_partial_journal_line_is_rolled_back(root):
+    """A journal flush that fails after a partial OS write must not leave a torn
+    half-line poisoning the journal — later committed transactions would be
+    discarded by recovery's torn-tail scan."""
+    log = _fresh(root)
+    log.create_topic(TopicSpec("t", 1))
+    p = log.transactional_producer("tx")
+    p.begin(); p.send(LogRecord(topic="t", key="a", value=b"A")); p.commit()
+
+    class Boom(RuntimeError):
+        pass
+
+    real_journal = log._journal
+
+    class HalfWriteJournal:
+        """Half the line reaches the file, then flush explodes."""
+
+        def write(self, data):
+            real_journal.write(data[: len(data) // 2])
+
+        def flush(self):
+            real_journal.flush()
+            raise Boom()
+
+        def tell(self):
+            return real_journal.tell()
+
+        def truncate(self, n):
+            return real_journal.truncate(n)
+
+        def seek(self, *a):
+            return real_journal.seek(*a)
+
+        def fileno(self):
+            return real_journal.fileno()
+
+        def close(self):
+            return real_journal.close()
+
+    log._journal = HalfWriteJournal()
+    p.begin(); p.send(LogRecord(topic="t", key="b", value=b"LOST"))
+    with pytest.raises(Boom):
+        p.commit()
+    log._journal = real_journal
+
+    # an acknowledged commit AFTER the failed one must survive restart
+    p.begin(); p.send(LogRecord(topic="t", key="c", value=b"C")); p.commit()
+    log.close()
+    log2 = _fresh(root)
+    assert [r.value for r in log2.read("t", 0)] == [b"A", b"C"]
+    log2.close()
+
+
+def test_garbled_payload_with_intact_header_clamps_at_open(root):
+    """fsync='none' writeback can persist a block header but garble its payload;
+    recovery must CRC-check and clamp rather than index a block whose first read
+    would crash the indexer."""
+    from surge_tpu.log import segment as seg
+
+    log = _fresh(root)
+    log.create_topic(TopicSpec("t", 1))
+    p = log.transactional_producer("tx")
+    p.begin(); p.send(LogRecord(topic="t", key="a", value=b"A")); p.commit()
+    first_end = log._parts[("t", 0)].end_pos
+    p.begin(); p.send(LogRecord(topic="t", key="b", value=b"B" * 64)); p.commit()
+    seg_path = log._parts[("t", 0)].path
+    log.close()
+
+    # garble block 2's payload, leaving its header intact
+    with open(seg_path, "r+b") as f:
+        f.seek(first_end + seg.HEADER_SIZE)
+        f.write(b"\x00" * 8)
+
+    log2 = _fresh(root)
+    assert [r.value for r in log2.read("t", 0)] == [b"A"]
+    assert log2.end_offset("t", 0) == 1
+    p2 = log2.transactional_producer("tx")
+    p2.begin(); p2.send(LogRecord(topic="t", key="c", value=b"C")); p2.commit()
     assert [r.value for r in log2.read("t", 0)] == [b"A", b"C"]
     log2.close()

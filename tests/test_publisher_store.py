@@ -432,3 +432,78 @@ def test_non_transactional_publisher_mode():
         await engine.stop()
 
     asyncio.run(scenario())
+
+
+def test_non_transactional_mid_batch_failure_resumes_exactly_once():
+    """Regression (r2 advisor): a mid-batch failure in non-transactional mode must
+    not re-append already-written records on the same-request_id retry, and the
+    retry's success bookkeeping must stay offset-aligned with every request."""
+    cfg = CFG.with_overrides({"surge.producer.enable-transactions": False})
+
+    async def scenario():
+        log = make_log()
+        indexer = StateStoreIndexer(log, "state", config=cfg)
+        await indexer.start()
+        pub = PartitionPublisher(log, "state", "events", 0, indexer, config=cfg)
+        await pub.start()
+        await pub.wait_ready(5.0)
+
+        class Boom(RuntimeError):
+            pass
+
+        real_send = pub._producer.send_immediate
+        calls = {"n": 0}
+
+        def flaky_send(record):
+            calls["n"] += 1
+            if calls["n"] == 4:  # r1 fully appended, r2 half appended, r3 untouched
+                raise Boom()
+            return real_send(record)
+
+        pub._producer.send_immediate = flaky_send
+        t1 = asyncio.ensure_future(
+            pub.publish("a", [event_rec("a", b"e-a"), state_rec("a", b"s-a")], "r1"))
+        t2 = asyncio.ensure_future(
+            pub.publish("b", [event_rec("b", b"e-b"), state_rec("b", b"s-b")], "r2"))
+        t3 = asyncio.ensure_future(
+            pub.publish("c", [event_rec("c", b"e-c"), state_rec("c", b"s-c")], "r3"))
+        await asyncio.sleep(0)
+        await pub.flush_now()
+        for t in (t1, t2, t3):
+            with pytest.raises(PublishFailedError):
+                await t
+        pub._producer.send_immediate = real_send
+
+        # entity retry ladder: same request ids, same records
+        r1 = asyncio.ensure_future(
+            pub.publish("a", [event_rec("a", b"e-a"), state_rec("a", b"s-a")], "r1"))
+        r2 = asyncio.ensure_future(
+            pub.publish("b", [event_rec("b", b"e-b"), state_rec("b", b"s-b")], "r2"))
+        r3 = asyncio.ensure_future(
+            pub.publish("c", [event_rec("c", b"e-c"), state_rec("c", b"s-c")], "r3"))
+        await asyncio.sleep(0)
+        await pub.flush_now()
+        await asyncio.gather(r1, r2, r3)
+
+        # exactly-once on the log: no duplicated events despite the retry
+        assert [r.value for r in log.read("events", 0)] == [b"e-a", b"e-b", b"e-c"]
+        state_values = [r.value for r in log.read("state", 0) if r.value != b""]
+        assert state_values == [b"s-a", b"s-b", b"s-c"]
+        assert not pub._partial_records  # resume state fully drained
+
+        # offset alignment: every aggregate's in-flight offset is its real state
+        # offset, and the watermark clears them once indexed
+        for agg in ("a", "b", "c"):
+            off = pub._in_flight.get(agg)
+            assert off is not None
+            rec = next(r for r in log.read("state", 0) if r.key == agg)
+            assert off == rec.offset
+        await asyncio.sleep(0.1)  # let the indexer catch up
+        pub._refresh_watermark()
+        for agg in ("a", "b", "c"):
+            assert pub.is_aggregate_state_current(agg), agg
+
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())

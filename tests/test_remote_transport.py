@@ -208,3 +208,75 @@ def test_same_aggregate_forwards_preserve_fifo_order():
         await _teardown(engines, servers, delivers)
 
     asyncio.run(scenario())
+
+
+def test_empty_apply_events_crosses_wire_as_noop():
+    """Regression (r2 advisor): ApplyEvents([]) must still select the protobuf
+    oneof — an empty list previously left WhichOneof None and the server failed
+    a call that is a successful no-op locally."""
+    async def scenario():
+        log, tracker, engines, servers, delivers = await _two_nodes()
+        remote_agg = next(f"agg-{i}" for i in range(50)
+                          if engines[A].router.partition_for(f"agg-{i}") in (2, 3))
+        ref = engines[A].aggregate_for(remote_agg)
+        await ref.send_command(counter.Increment(remote_agg))
+        r = await ref.apply_events([])
+        assert isinstance(r, CommandSuccess), r
+        assert r.state is not None and r.state.count == 1
+        await _teardown(engines, servers, delivers)
+
+    asyncio.run(scenario())
+
+
+def test_zero_byte_state_success_keeps_existence_across_wire():
+    """Regression (r2 advisor): a CommandSuccess whose serialized state is
+    legitimately zero bytes (passthrough formats) must not collapse to
+    CommandSuccess(None) on the client — existence now travels as has_state."""
+    from surge_tpu.engine.entity import Envelope
+    from surge_tpu.remote.transport import pb
+
+    class EmptyBytesStateFormat:
+        def write_state(self, state):
+            from surge_tpu.serialization import SerializedAggregate
+            return SerializedAggregate(value=state)  # b"" stays b""
+
+        def read_state(self, value):
+            return value
+
+    class StubLogic:
+        state_format = EmptyBytesStateFormat()
+        command_format = counter.command_formatting()
+        event_format = counter.event_formatting()
+
+    class StubRouter:
+        def deliver_local(self, partition, aggregate_id, env: Envelope):
+            env.reply.set_result(CommandSuccess(b""))  # exists, zero bytes
+
+    class StubEngine:
+        logic = StubLogic()
+        router = StubRouter()
+        config = None
+
+    async def scenario():
+        server = NodeTransportServer(StubEngine())
+        req = pb.DeliverRequest(aggregate_id="z", partition=0)
+        req.command = counter.command_formatting().write_command(
+            counter.Increment("z"))
+        reply = await server.Deliver(req, None)
+        assert reply.outcome == "success"
+        assert reply.has_state  # the discriminator, not byte length
+        assert reply.state == b""
+        # client mapping: has_state=True with empty bytes -> state exists
+        deliver = GrpcRemoteDeliver(StubLogic())
+        fut = asyncio.get_running_loop().create_future()
+
+        async def fake_call(request, timeout=None):
+            return reply
+
+        deliver._calls[A] = fake_call
+        await deliver._forward(A, req, Envelope(message=None, reply=fut))
+        result = await fut
+        assert isinstance(result, CommandSuccess)
+        assert result.state == b""  # NOT None
+
+    asyncio.run(scenario())
