@@ -70,6 +70,9 @@ class ColumnarEvents:
     cols: dict[str, np.ndarray]
     # columns the device derives instead of reading (see EncodedEvents.derived_cols)
     derived_cols: dict[str, str] = field(default_factory=dict)
+    # optional aggregate-id strings, indexed by aggregate index 0..B-1 — carried by
+    # segment chunks so bulk replay can write folded states back to the keyed store
+    aggregate_ids: list[str] | None = None
 
     @property
     def num_events(self) -> int:
@@ -89,7 +92,8 @@ class ColumnarEvents:
             num_aggregates=self.num_aggregates, agg_idx=self.agg_idx[order],
             type_ids=self.type_ids[order],
             cols={k: v[order] for k, v in self.cols.items()},
-            derived_cols=dict(self.derived_cols))
+            derived_cols=dict(self.derived_cols),
+            aggregate_ids=self.aggregate_ids)
 
     def slice_aggregates(self, start: int, stop: int) -> "ColumnarEvents":
         """Sub-log for aggregates [start, stop). Requires aggregate-sorted order
@@ -100,7 +104,9 @@ class ColumnarEvents:
             agg_idx=self.agg_idx[lo:hi] - np.int32(start),
             type_ids=self.type_ids[lo:hi],
             cols={k: v[lo:hi] for k, v in self.cols.items()},
-            derived_cols=dict(self.derived_cols))
+            derived_cols=dict(self.derived_cols),
+            aggregate_ids=(None if self.aggregate_ids is None
+                           else self.aggregate_ids[start:stop]))
 
 
 def columnar_to_batch(colev: ColumnarEvents, pad_to: int | None = None) -> EncodedEvents:

@@ -57,6 +57,10 @@ DEFAULTS: dict[str, Any] = {
     "surge.replay.length-buckets": "64,256,1024,4096",
     "surge.replay.mesh-axes": "data",
     "surge.replay.donate-carry": True,
+    # columnar-segment cold start: when set, rebuild_from_events streams this
+    # segment (building it once from the topics if absent) instead of folding
+    # per-event Python objects
+    "surge.replay.segment-path": "",
     # --- health (common reference.conf:228-260) ---
     "surge.health.window-frequency-ms": 10_000,
     "surge.health.window-buffer-size": 10,

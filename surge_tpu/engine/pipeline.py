@@ -270,9 +270,19 @@ class SurgeEngine(Controllable):
 
     async def rebuild_from_events(self):
         """Rebuild the materialized store by folding the events topic through the
-        configured replay backend (tpu: batched ReplayEngine; cpu: scalar fold), then
-        fast-forward the indexer watermarks past the snapshots the events already
-        cover. Disaster-recovery / cold-cache warmup path (BASELINE.md north star)."""
+        configured replay backend, then bring the indexer current.
+
+        Two paths:
+        - ``surge.replay.segment-path`` set → **columnar segment restore** (the
+          100M-event-scale path): build the segment once if absent (events topic →
+          struct-of-arrays chunks + state-only snapshot carry), then stream it
+          through the batched ReplayEngine with no per-event Python objects, and
+          prime the indexer at the segment's build-time state watermarks so
+          tail-indexing covers everything since (events+state commit atomically, so
+          every post-build change has a post-watermark snapshot).
+        - otherwise → the object-based fold (small-topic fallback) + a full
+          state-topic snapshot overlay.
+        """
         if not self.logic.events_topic:
             raise ValueError("rebuild_from_events requires an events topic")
         evt_fmt = self.logic.event_format
@@ -281,6 +291,19 @@ class SurgeEngine(Controllable):
 
         spec = self.logic.replay_spec()
         mesh = self._resolve_mesh()
+
+        segment_path = self.config.get_str("surge.replay.segment-path", "")
+        if segment_path:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._rebuild_from_segment(segment_path, spec, mesh))
+            if result.watermarks:  # snapshot-carrying segment: no state-topic scan
+                self.indexer.prime(result.watermarks)
+            else:  # segment built without a state topic: overlay + prime at now
+                self._overlay_snapshots_and_prime()
+            logger.info("rebuild_from_events: %d aggregates from %d events via %s",
+                        result.num_aggregates, result.num_events, result.backend)
+            return result
+
         result = await asyncio.get_running_loop().run_in_executor(None, lambda: restore_from_events(
             self.log, self.logic.events_topic, self.indexer.store,
             deserialize_event=lambda b: evt_fmt.read_event(SerializedMessage(key="", value=b)),
@@ -289,20 +312,53 @@ class SurgeEngine(Controllable):
             encode_event=getattr(self.logic, "encode_event", None),
             decode_state=getattr(self.logic, "decode_state", None),
             config=self.config, mesh=mesh))
-        # overlay snapshots for aggregates the events topic does not cover (state-only
-        # publishes, e.g. apply_events) — for event-sourced aggregates the replayed
-        # state and the latest snapshot are identical because events+state commit
-        # atomically, so the replayed value stands
-        store = self.indexer.store
-        for p in range(self.num_partitions):
-            for key, rec in self.log.latest_by_key(self.logic.state_topic, p).items():
-                if store.get(key) is None:
-                    store.put(key, rec.value)
-        self.indexer.prime({p: self.log.end_offset(self.logic.state_topic, p)
-                            for p in range(self.num_partitions)})
+        self._overlay_snapshots_and_prime()
         logger.info("rebuild_from_events: %d aggregates from %d events via %s",
                     result.num_aggregates, result.num_events, result.backend)
         return result
+
+    def _overlay_snapshots_and_prime(self) -> None:
+        """Overlay the state topic's latest snapshot per key and prime the indexer
+        at the current end offsets. Latest-wins unconditionally: events+state commit
+        atomically, so a snapshot is always ≥ any state replayed from events it
+        covers — this both fills in state-only aggregates (apply_events) and
+        corrects states replayed from a stale externally-built segment."""
+        store = self.indexer.store
+        for p in range(self.num_partitions):
+            for key, rec in self.log.latest_by_key(self.logic.state_topic, p).items():
+                if rec.value is None:  # tombstone, same as the indexer's tail path
+                    store.delete(key)
+                else:
+                    store.put(key, rec.value)
+        self.indexer.prime({p: self.log.end_offset(self.logic.state_topic, p)
+                            for p in range(self.num_partitions)})
+
+    def _rebuild_from_segment(self, segment_path: str, spec, mesh):
+        """Blocking half of the segment rebuild (runs in the executor): build the
+        segment if absent, then stream-restore the store from it."""
+        import os
+
+        from surge_tpu.log.columnar import build_segment_from_topic
+        from surge_tpu.store.restore import restore_from_segment
+
+        evt_fmt = self.logic.event_format
+        state_fmt = self.logic.state_format
+        if not os.path.exists(segment_path):
+            # build to a temp path and rename: a crash mid-build must not leave a
+            # partial file that later cold starts would silently restore from
+            tmp_path = segment_path + ".building"
+            build_segment_from_topic(
+                self.log, self.logic.events_topic, spec.registry,
+                evt_fmt.read_event, tmp_path,
+                encode_event=getattr(self.logic, "encode_event", None),
+                derived_cols=getattr(self.logic, "derived_cols", None),
+                state_topic=self.logic.state_topic)
+            os.replace(tmp_path, segment_path)
+        return restore_from_segment(
+            segment_path, self.indexer.store, replay_spec=spec,
+            serialize_state=lambda agg_id, st: state_fmt.write_state(st).value,
+            decode_state=getattr(self.logic, "decode_state", None),
+            config=self.config, mesh=mesh)
 
 
 class EngineNotRunningError(Exception):

@@ -19,11 +19,20 @@ form of :class:`~surge_tpu.codec.tensor.ColumnarEvents`:
 
 Layout (little-endian):
     magic "SCOL" | u32 header_len | header JSON |
-    per chunk: u32 marker 0x43484B31 ("CHK1") | u32 meta_len | meta JSON |
-               column payloads in meta order (each raw or SLZ per meta)
-Header JSON: {"columns": {name: dtype_str}, "derived": {...}, "type_dtype": str}
+    per section: u32 marker | u32 meta_len | meta JSON | payloads
+    - chunk section (marker "CHK1"): column payloads in meta order (raw or SLZ per
+      meta); meta may also carry an "ids" payload (newline-joined aggregate-id
+      strings) so replay can write folded states back to the keyed store
+    - snapshot section (marker "SNP1"): one uvarint-framed key/value blob holding
+      the latest state snapshots of aggregates ABSENT from the events topic
+      (state-only publishes) — the checkpoint-carry that lets a segment restore
+      skip the post-replay state-topic scan entirely
+Header JSON: {"columns": {name: dtype_str}, "derived": {...}, "type_dtype": str,
+              "extra": {...}} — "extra" carries build-time metadata such as the
+source topic watermarks (see build_segment_from_topic).
 Chunk meta JSON: {"num_aggregates": n, "num_events": m,
-                  "cols": [[name, codec, stored_len, raw_len], ...]}  — includes the
+                  "cols": [[name, codec, stored_len, raw_len], ...],
+                  "ids": [codec, stored_len, raw_len] | absent}  — cols includes the
 implicit "agg_idx" and "type_ids" columns.
 """
 
@@ -40,6 +49,7 @@ from surge_tpu.log import segment as seg
 
 MAGIC = b"SCOL"
 CHUNK_MARKER = 0x43484B31
+SNAPSHOT_MARKER = 0x534E5031  # "SNP1"
 
 
 def _encode_array(arr: np.ndarray):
@@ -59,29 +69,35 @@ def _decode_array(data: bytes, codec: int, raw_len: int, dtype: np.dtype) -> np.
 class ColumnarSegmentWriter:
     """Appends aggregate-range chunks of a model family's event log."""
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, extra_header: Optional[dict] = None) -> None:
         self.path = path
         self._file = None
         self._header_written = False
         self._schema: Optional[dict] = None
+        self._extra = dict(extra_header or {})
         self._total_aggregates = 0
         self._total_events = 0
 
+    def _write_header(self, schema: dict) -> None:
+        self._file = open(self.path, "wb")
+        header = json.dumps(schema).encode()
+        self._file.write(MAGIC + struct.pack("<I", len(header)) + header)
+        self._schema = schema
+
     def append(self, colev: ColumnarEvents) -> None:
         """Append one chunk. Every chunk must share the first chunk's column schema;
-        each holds its own disjoint aggregate range (ids are chunk-local 0..n)."""
+        each holds its own disjoint aggregate range (ids are chunk-local 0..n).
+        ``colev.aggregate_ids`` (if set) is persisted alongside the columns."""
         colev = colev.sorted_by_aggregate()
         schema = {
             "columns": {name: str(col.dtype) for name, col in sorted(colev.cols.items())},
             "derived": dict(colev.derived_cols),
             "type_dtype": str(colev.type_ids.dtype),
             "agg_dtype": str(colev.agg_idx.dtype),
+            "extra": self._extra,
         }
         if self._file is None:
-            self._file = open(self.path, "wb")
-            header = json.dumps(schema).encode()
-            self._file.write(MAGIC + struct.pack("<I", len(header)) + header)
-            self._schema = schema
+            self._write_header(schema)
         elif schema != self._schema:
             raise ValueError("chunk schema differs from the segment's header schema")
 
@@ -92,16 +108,58 @@ class ColumnarSegmentWriter:
             codec, stored, raw_len = _encode_array(arr)
             cols_meta.append([name, codec, len(stored), raw_len])
             payloads.append(stored)
-        meta = json.dumps({
+        meta_obj = {
             "num_aggregates": colev.num_aggregates,
             "num_events": colev.num_events,
             "cols": cols_meta,
-        }).encode()
+        }
+        if colev.aggregate_ids is not None:
+            if len(colev.aggregate_ids) != colev.num_aggregates:
+                raise ValueError("aggregate_ids length != num_aggregates")
+            if any("\n" in i or not i for i in colev.aggregate_ids):
+                raise ValueError("aggregate ids must be non-empty and newline-free "
+                                 "(newline is the id separator)")
+            raw = "\n".join(colev.aggregate_ids).encode()
+            compressed = seg.slz_compress(raw)
+            if compressed is not None:
+                meta_obj["ids"] = [seg.CODEC_SLZ, len(compressed), len(raw)]
+                payloads.append(compressed)
+            else:
+                meta_obj["ids"] = [seg.CODEC_RAW, len(raw), len(raw)]
+                payloads.append(raw)
+        meta = json.dumps(meta_obj).encode()
         self._file.write(struct.pack("<II", CHUNK_MARKER, len(meta)) + meta)
         for p in payloads:
             self._file.write(p)
         self._total_aggregates += colev.num_aggregates
         self._total_events += colev.num_events
+
+    def append_snapshots(self, items) -> None:
+        """Write a snapshot section: latest serialized states of aggregates the
+        events topic does not cover (state-only publishes). ``items`` is an
+        iterable of ``(key: str, value: bytes)``."""
+        if self._file is None:
+            raise ValueError("append at least one chunk before snapshots")
+        blob = bytearray()
+        count = 0
+        for key, value in items:
+            kb = key.encode()
+            seg._put_uvarint(blob, len(kb))
+            blob += kb
+            seg._put_uvarint(blob, len(value))
+            blob += value
+            count += 1
+        raw = bytes(blob)
+        compressed = seg.slz_compress(raw)
+        if compressed is not None:
+            meta_obj = {"count": count, "blob": [seg.CODEC_SLZ, len(compressed), len(raw)]}
+            payload = compressed
+        else:
+            meta_obj = {"count": count, "blob": [seg.CODEC_RAW, len(raw), len(raw)]}
+            payload = raw
+        meta = json.dumps(meta_obj).encode()
+        self._file.write(struct.pack("<II", SNAPSHOT_MARKER, len(meta)) + meta)
+        self._file.write(payload)
 
     def close(self) -> None:
         if self._file is not None:
@@ -135,26 +193,41 @@ def read_segment(path: str) -> Iterator[ColumnarEvents]:
             if not prefix:
                 return
             marker, mlen = struct.unpack("<II", prefix)
-            if marker != CHUNK_MARKER:
-                raise ValueError(f"{path}: bad chunk marker {marker:#x}")
+            if marker not in (CHUNK_MARKER, SNAPSHOT_MARKER):
+                raise ValueError(f"{path}: bad section marker {marker:#x}")
             meta = json.loads(f.read(mlen))
+            if marker == SNAPSHOT_MARKER:  # not a chunk; read via read_segment_snapshots
+                f.seek(meta["blob"][1], 1)
+                continue
             arrays = {}
             for name, codec, stored_len, raw_len in meta["cols"]:
                 dtype = (agg_dtype if name == "agg_idx"
                          else type_dtype if name == "type_ids"
                          else col_dtypes[name])
                 arrays[name] = _decode_array(f.read(stored_len), codec, raw_len, dtype)
+            ids = None
+            if "ids" in meta:
+                codec, stored_len, raw_len = meta["ids"]
+                raw = f.read(stored_len)
+                if codec == seg.CODEC_SLZ:
+                    raw = seg.slz_decompress(raw, raw_len)
+                ids = raw.decode().split("\n") if raw else []
+                if len(ids) != meta["num_aggregates"]:
+                    raise ValueError(
+                        f"{path}: id count {len(ids)} != aggregates "
+                        f"{meta['num_aggregates']} — corrupt chunk")
             yield ColumnarEvents(
                 num_aggregates=meta["num_aggregates"],
                 agg_idx=arrays.pop("agg_idx"),
                 type_ids=arrays.pop("type_ids"),
                 cols=arrays,
-                derived_cols=dict(derived))
+                derived_cols=dict(derived),
+                aggregate_ids=ids)
 
 
 def segment_info(path: str) -> dict:
     """Totals + schema without decompressing column payloads."""
-    total_aggregates = total_events = num_chunks = 0
+    total_aggregates = total_events = num_chunks = num_snapshots = 0
     with open(path, "rb") as f:
         head = f.read(8)
         if head[:4] != MAGIC:
@@ -166,13 +239,60 @@ def segment_info(path: str) -> dict:
             if not prefix:
                 break
             marker, mlen = struct.unpack("<II", prefix)
+            if marker not in (CHUNK_MARKER, SNAPSHOT_MARKER):
+                raise ValueError(f"{path}: bad section marker {marker:#x}")
             meta = json.loads(f.read(mlen))
-            f.seek(sum(c[2] for c in meta["cols"]), 1)
+            if marker == SNAPSHOT_MARKER:
+                f.seek(meta["blob"][1], 1)
+                num_snapshots += meta["count"]
+                continue
+            skip = sum(c[2] for c in meta["cols"])
+            if "ids" in meta:
+                skip += meta["ids"][1]
+            f.seek(skip, 1)
             total_aggregates += meta["num_aggregates"]
             total_events += meta["num_events"]
             num_chunks += 1
     return {"schema": header, "num_aggregates": total_aggregates,
-            "num_events": total_events, "num_chunks": num_chunks}
+            "num_events": total_events, "num_chunks": num_chunks,
+            "num_snapshots": num_snapshots}
+
+
+def read_segment_snapshots(path: str) -> Iterator[tuple]:
+    """Stream the snapshot sections' ``(key, value)`` pairs (state-only aggregates)."""
+    with open(path, "rb") as f:
+        head = f.read(8)
+        if head[:4] != MAGIC:
+            raise ValueError(f"{path}: not a columnar segment")
+        (hlen,) = struct.unpack("<I", head[4:8])
+        f.seek(hlen, 1)
+        while True:
+            prefix = f.read(8)
+            if not prefix:
+                return
+            marker, mlen = struct.unpack("<II", prefix)
+            if marker not in (CHUNK_MARKER, SNAPSHOT_MARKER):
+                raise ValueError(f"{path}: bad section marker {marker:#x}")
+            meta = json.loads(f.read(mlen))
+            if marker != SNAPSHOT_MARKER:
+                skip = sum(c[2] for c in meta["cols"])
+                if "ids" in meta:
+                    skip += meta["ids"][1]
+                f.seek(skip, 1)
+                continue
+            codec, stored_len, raw_len = meta["blob"]
+            raw = f.read(stored_len)
+            if codec == seg.CODEC_SLZ:
+                raw = seg.slz_decompress(raw, raw_len)
+            pos = 0
+            for _ in range(meta["count"]):
+                klen, pos = seg._get_uvarint(raw, pos)
+                key = raw[pos: pos + klen].decode()
+                pos += klen
+                vlen, pos = seg._get_uvarint(raw, pos)
+                value = raw[pos: pos + vlen]
+                pos += vlen
+                yield key, value
 
 
 def _drop_derived(colev: ColumnarEvents, derived_cols: dict) -> None:
@@ -201,20 +321,31 @@ def build_segment_from_topic(log, topic: str, registry, deserialize_event,
                              path: str, partitions=None,
                              encode_event=None,
                              derived_cols: Optional[dict] = None,
-                             chunk_aggregates: int = 65536) -> dict:
+                             chunk_aggregates: int = 65536,
+                             state_topic: Optional[str] = None) -> dict:
     """Offline conversion job: events topic → columnar segment.
 
     Reads every partition's records once, groups events per aggregate (key),
-    encodes them columnar via the registry, and writes aggregate-range chunks.
-    ``encode_event`` maps raw events to tensor-schema form first (e.g. vocab
-    dictionary encoding). Returns ``segment_info(path)``.
+    encodes them columnar via the registry, and writes aggregate-range chunks
+    with their aggregate ids. ``encode_event`` maps raw events to tensor-schema
+    form first (e.g. vocab dictionary encoding). Returns ``segment_info(path)``.
+
+    The header's ``extra`` records the source watermarks at build time so a
+    restore can prime the indexer exactly where the segment's coverage ends.
+    When ``state_topic`` is given, the latest snapshots of aggregates ABSENT
+    from the events topic (state-only publishes) are carried in a snapshot
+    section, making the segment a complete cold-start image — the restore needs
+    no state-topic scan (the Kafka Streams restore equivalent,
+    AggregateStateStoreKafkaStreams.scala:53-178, performed once at build).
     """
     from surge_tpu.codec.tensor import encode_events_columnar
     from surge_tpu.serialization import SerializedMessage
 
     if partitions is None:
         partitions = range(log.num_partitions(topic))
+    partitions = list(partitions)
     logs: dict[str, list] = {}
+    watermarks: dict[str, int] = {}
     for p in partitions:
         for r in log.read(topic, p):
             if r.key is None or r.value is None:
@@ -223,15 +354,31 @@ def build_segment_from_topic(log, topic: str, registry, deserialize_event,
             if encode_event is not None:
                 ev = encode_event(ev)
             logs.setdefault(r.key, []).append(ev)
+        watermarks[str(p)] = log.end_offset(topic, p)
+
+    extra: dict = {"topic": topic, "watermarks": watermarks}
+    snapshots: list[tuple] = []
+    if state_topic is not None:
+        state_watermarks: dict[str, int] = {}
+        for p in range(log.num_partitions(state_topic)):
+            for key, rec in log.latest_by_key(state_topic, p).items():
+                if key not in logs and rec.value:
+                    snapshots.append((key, rec.value))
+            state_watermarks[str(p)] = log.end_offset(state_topic, p)
+        extra["state_topic"] = state_topic
+        extra["state_watermarks"] = state_watermarks
 
     ordered = sorted(logs)
-    with ColumnarSegmentWriter(path) as writer:
+    with ColumnarSegmentWriter(path, extra_header=extra) as writer:
         for start in range(0, max(len(ordered), 1), chunk_aggregates):
             chunk_ids = ordered[start: start + chunk_aggregates]
-            if not chunk_ids:
-                break
             colev = encode_events_columnar(registry, [logs[a] for a in chunk_ids])
             if derived_cols:
                 _drop_derived(colev, derived_cols)
+            colev.aggregate_ids = list(chunk_ids)
             writer.append(colev)
+            if not chunk_ids:
+                break
+        if snapshots:
+            writer.append_snapshots(snapshots)
     return {"aggregate_order": ordered, **segment_info(path)}

@@ -94,6 +94,9 @@ class ReplayResult:
     num_aggregates: int
     num_events: int
     padded_events: int  # B*T actually scanned (padding overhead indicator)
+    # aggregate-id strings aligned with the state columns, when the inputs carried
+    # them (segment chunks) — lets callers write states back to the keyed store
+    aggregate_ids: Optional[list] = None
 
 
 class ReplayEngine:
@@ -375,6 +378,8 @@ class ReplayEngine:
         state_fields = self.spec.registry.state.fields
         parts: dict[str, list[np.ndarray]] = {f.name: [] for f in state_fields}
         total_aggregates = total_events = padded = 0
+        ids: list = []
+        saw_ids = True
         for colev in chunks:
             res = self.replay_columnar(colev)
             for name in parts:
@@ -382,14 +387,19 @@ class ReplayEngine:
             total_aggregates += res.num_aggregates
             total_events += res.num_events
             padded += res.padded_events
+            if colev.aggregate_ids is None:
+                saw_ids = False
+            elif saw_ids:
+                ids.extend(colev.aggregate_ids)
         if total_aggregates == 0:
             return ReplayResult(states={f.name: np.zeros((0,), dtype=f.dtype)
                                         for f in state_fields},
-                                num_aggregates=0, num_events=0, padded_events=0)
+                                num_aggregates=0, num_events=0, padded_events=0,
+                                aggregate_ids=[] if saw_ids else None)
         return ReplayResult(
             states={name: np.concatenate(arrs) for name, arrs in parts.items()},
             num_aggregates=total_aggregates, num_events=total_events,
-            padded_events=padded)
+            padded_events=padded, aggregate_ids=ids if saw_ids else None)
 
     def replay_stream(self, chunks: Iterable[EncodedEvents], batch: int,
                       init_carry: Mapping[str, Any] | None = None,

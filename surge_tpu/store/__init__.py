@@ -13,7 +13,12 @@ north-star workload (SURVEY.md §3.3, BASELINE.md).
 
 from surge_tpu.store.kv import InMemoryKeyValueStore, KeyValueStore
 from surge_tpu.store.indexer import StateStoreIndexer
-from surge_tpu.store.restore import RestoreResult, restore_from_events, restore_from_state_topic
+from surge_tpu.store.restore import (
+    RestoreResult,
+    restore_from_events,
+    restore_from_segment,
+    restore_from_state_topic,
+)
 
 __all__ = [
     "InMemoryKeyValueStore",
@@ -21,5 +26,6 @@ __all__ = [
     "StateStoreIndexer",
     "RestoreResult",
     "restore_from_events",
+    "restore_from_segment",
     "restore_from_state_topic",
 ]

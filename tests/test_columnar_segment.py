@@ -140,3 +140,65 @@ def test_build_segment_refuses_false_ordinal_claim(tmp_path):
         build_segment_from_topic(
             log, "ev", counter.make_registry(), fmt.read_event,
             str(tmp_path / "x.scol"), derived_cols={"sequence_number": "ordinal"})
+
+
+def test_segment_carries_ids_snapshots_and_watermarks(tmp_path):
+    """Chunks persist aggregate ids, the snapshot section carries state-only
+    aggregates, and the header records build-time watermarks — together a complete
+    cold-start image (restore_from_segment consumes all three)."""
+    from surge_tpu.log.columnar import read_segment_snapshots, segment_info
+    from surge_tpu.store import InMemoryKeyValueStore, restore_from_segment
+
+    log = InMemoryLog()
+    log.create_topic(TopicSpec("counter-events", 2))
+    log.create_topic(TopicSpec("counter-state", 2, compacted=True))
+    fmt = counter.event_formatting()
+    prod = log.transactional_producer("seed")
+    expected = {}
+    from surge_tpu.engine.model import fold_events
+    model = counter.CounterModel()
+    for i in range(10):
+        agg = f"agg-{i}"
+        events = [counter.CountIncremented(agg, 2, k + 1) for k in range(i + 1)]
+        expected[agg] = fold_events(model, None, events)
+        prod.begin()
+        for e in events:
+            prod.send(LogRecord(topic="counter-events", key=agg,
+                                value=fmt.write_event(e).value, partition=i % 2))
+        prod.commit()
+    # a state-only snapshot (no events for this key)
+    prod.begin()
+    prod.send(LogRecord(topic="counter-state", key="lonely", value=b"SNAP",
+                        partition=0))
+    prod.commit()
+
+    path = str(tmp_path / "full.scol")
+    info = build_segment_from_topic(
+        log, "counter-events", counter.make_registry(), fmt.read_event, path,
+        derived_cols={"sequence_number": "ordinal"}, chunk_aggregates=4,
+        state_topic="counter-state")
+    assert info["num_snapshots"] == 1
+    extra = info["schema"]["extra"]
+    assert extra["watermarks"] == {str(p): log.end_offset("counter-events", p)
+                                   for p in range(2)}
+    assert extra["state_watermarks"] == {str(p): log.end_offset("counter-state", p)
+                                         for p in range(2)}
+
+    chunks = list(read_segment(path))
+    assert all(c.aggregate_ids is not None for c in chunks)
+    assert [i for c in chunks for i in c.aggregate_ids] == sorted(expected)
+    assert list(read_segment_snapshots(path)) == [("lonely", b"SNAP")]
+
+    # restore writes every folded state + snapshot into the store
+    store = InMemoryKeyValueStore()
+    sfmt = counter.state_formatting()
+    res = restore_from_segment(
+        path, store, replay_spec=counter.make_replay_spec(),
+        serialize_state=lambda a, s: sfmt.write_state(s).value)
+    assert res.backend == "segment"
+    assert res.num_aggregates == 11
+    assert res.watermarks == {p: log.end_offset("counter-state", p) for p in range(2)}
+    assert store.get("lonely") == b"SNAP"
+    for agg, st in expected.items():
+        got = sfmt.read_state(store.get(agg))
+        assert (got.count, got.version) == (st.count, st.version), agg

@@ -208,3 +208,65 @@ def test_mesh_axis_name_config_is_consistent():
         await engine2.stop()
 
     asyncio.run(scenario())
+
+
+def test_rebuild_from_segment_cold_start(tmp_path):
+    """VERDICT r2 #3: the columnar segment path is wired into the engine's rebuild.
+    A cold engine with surge.replay.segment-path builds the segment once (events +
+    state-only snapshot carry), streams it through the batched replay, and ends up
+    byte-identical to the object-based scalar rebuild — including an aggregate that
+    only ever saw apply_events (state-only publish) and post-build deltas picked up
+    by indexer tailing from the segment's build watermarks."""
+    async def scenario():
+        log = InMemoryLog()
+        engine = create_engine(make_logic(), log=log, config=CFG)
+        await engine.start()
+        for i in range(12):
+            agg = f"agg{i}"
+            for _ in range(i % 4 + 1):
+                await engine.aggregate_for(agg).send_command(counter.Increment(agg))
+        # a state-only aggregate: apply_events publishes a snapshot but no events
+        r = await engine.aggregate_for("state-only").apply_events(
+            [counter.CountIncremented("state-only", 7, 1)])
+        assert isinstance(r, CommandSuccess) and r.state.count == 7
+        await engine.stop()
+
+        seg_path = str(tmp_path / "counter.scol")
+        seg_cfg = CFG.with_overrides({"surge.replay.segment-path": seg_path,
+                                      "surge.replay.restore-on-start": True})
+        engine2 = create_engine(make_logic(), log=log, config=seg_cfg)
+        await engine2.start()
+        import os
+        assert os.path.exists(seg_path)  # built on first rebuild
+        assert engine2.indexer.store.approximate_num_entries() == 13
+        segment_bytes = {f"agg{i}": engine2.indexer.get_aggregate_bytes(f"agg{i}")
+                         for i in range(12)}
+        # the state-only aggregate came from the snapshot section
+        st = engine2.logic.state_format.read_state(
+            engine2.indexer.get_aggregate_bytes("state-only"))
+        assert st.count == 7
+        # post-build delta: a new command after the segment exists (stale for the
+        # NEXT cold start)
+        r = await engine2.aggregate_for("agg0").send_command(counter.Increment("agg0"))
+        assert isinstance(r, CommandSuccess), r
+        expected = r.state.count
+        await engine2.stop()
+
+        # byte-identical to the object-based scalar rebuild (engines run
+        # sequentially — concurrent ones would fence each other's publishers)
+        ref = create_engine(make_logic(), log=log,
+                            config=CFG.with_overrides({"surge.replay.backend": "cpu"}))
+        await ref.start()
+        await ref.rebuild_from_events()
+        for i in range(1, 12):  # agg0 has the post-segment delta; compare the rest
+            agg = f"agg{i}"
+            assert segment_bytes[agg] == ref.indexer.get_aggregate_bytes(agg), agg
+        await ref.stop()
+
+        engine3 = create_engine(make_logic(), log=log, config=seg_cfg)
+        await engine3.start()  # stale segment; delta rides the indexer tail
+        st = await engine3.aggregate_for("agg0").get_state()
+        assert st.count == expected
+        await engine3.stop()
+
+    asyncio.run(scenario())
