@@ -1,18 +1,25 @@
 #!/usr/bin/env python
 """North-star benchmark: cold replay of a ragged event log (BASELINE.md targets).
 
-Builds a 1M-aggregate / 100M-event counter corpus columnar-side (no Python event
-objects), measures the scalar CPU fold baseline on a stratified sample (the reference's
-Kafka Streams restore is exactly this per-aggregate scalar fold, SURVEY.md §3.3), then
-runs the batched TPU replay over the full corpus and verifies every folded state against
-the closed-form expected result.
+Phase 1 (replay): builds a 1M-aggregate / 100M-event counter corpus columnar-side (no
+Python event objects), measures the scalar CPU fold baseline on a stratified sample
+(the reference's Kafka Streams restore is exactly this per-aggregate scalar fold,
+SURVEY.md §3.3), then runs the batched TPU replay over the full corpus and verifies
+every folded state against the closed-form expected result.
+
+Phase 2 (steady state): p50/p99 send_command latency and commands/sec through the full
+engine (router → entity → transactional publisher with the reference's 50 ms flush
+tick → durable FileLog with fsync-on-commit) — the second BASELINE.md target; the
+reference's envelope is flush-interval + txn commit.
 
 Prints ONE JSON line to stdout:
     {"metric": "cold_replay_events_per_sec", "value": N, "unit": "events/s",
-     "vs_baseline": <speedup over the scalar CPU fold>}
+     "vs_baseline": <speedup over the scalar CPU fold>,
+     "command_p50_ms": ..., "command_p99_ms": ..., "commands_per_sec": ...}
 
 Env knobs: SURGE_BENCH_AGGREGATES (1_000_000), SURGE_BENCH_EVENTS (100_000_000),
-SURGE_BENCH_CPU_SAMPLE (200_000 events), SURGE_BENCH_TIME_CHUNK, SURGE_BENCH_BATCH.
+SURGE_BENCH_CPU_SAMPLE (200_000 events), SURGE_BENCH_TIME_CHUNK, SURGE_BENCH_BATCH,
+SURGE_BENCH_LATENCY_SECONDS (5; 0 skips phase 2), SURGE_BENCH_LATENCY_WORKERS (64).
 """
 
 from __future__ import annotations
@@ -67,6 +74,81 @@ def acquire_backend():
     jax.config.update("jax_platforms", "cpu")
     devices = jax.devices()  # raises only if even the host CPU platform is broken
     return jax, devices
+
+
+def steady_state_latency(seconds: float) -> dict:
+    """Phase 2: the full command path on one node, reference-default envelope.
+
+    Concurrent per-aggregate workers issue sequential Increment commands through
+    ``aggregate_for().send_command`` against a FileLog (fsync on commit) with the
+    50 ms flush tick, so each command's latency = handling + wait-for-tick + one
+    durable transaction commit — directly comparable to the reference's
+    flush-interval + Kafka txn commit envelope (core reference.conf:20-21).
+    """
+    import asyncio
+    import shutil
+    import tempfile
+
+    from surge_tpu import (
+        CommandSuccess,
+        SurgeCommandBusinessLogic,
+        create_engine,
+        default_config,
+    )
+    from surge_tpu.log.file import FileLog
+    from surge_tpu.models import counter
+
+    workers = int(os.environ.get("SURGE_BENCH_LATENCY_WORKERS", 64))
+    flush_ms = default_config().get_int("surge.producer.flush-interval-ms")
+    root = tempfile.mkdtemp(prefix="surge-bench-latency-")
+
+    async def scenario() -> dict:
+        log = FileLog(os.path.join(root, "log"))
+        engine = create_engine(
+            SurgeCommandBusinessLogic(
+                aggregate_name="counter", model=counter.CounterModel(),
+                state_format=counter.state_formatting(),
+                event_format=counter.event_formatting()),
+            log=log, config=default_config())
+        await engine.start()
+
+        latencies: list = []
+
+        async def worker(i: int, stop_at: float) -> None:
+            agg = f"bench-{i}"
+            ref = engine.aggregate_for(agg)
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                r = await ref.send_command(counter.Increment(agg))
+                if not isinstance(r, CommandSuccess):
+                    raise RuntimeError(f"command failed: {r}")
+                latencies.append(time.perf_counter() - t0)
+
+        # warmup (entity init + first flushes), then the measured window
+        await asyncio.gather(*(worker(i, time.perf_counter() + 1.0)
+                               for i in range(workers)))
+        latencies.clear()
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker(i, t0 + seconds) for i in range(workers)))
+        elapsed = time.perf_counter() - t0
+        await engine.stop()
+        log.close()
+
+        lat_ms = sorted(1000.0 * x for x in latencies)
+        n = len(lat_ms)
+        return {
+            "command_p50_ms": round(lat_ms[n // 2], 2),
+            "command_p99_ms": round(lat_ms[min(n - 1, (99 * n) // 100)], 2),
+            "commands_per_sec": round(n / elapsed),
+            "latency_commands": n,
+            "latency_workers": workers,
+            "flush_interval_ms": flush_ms,
+        }
+
+    try:
+        return asyncio.run(scenario())
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def main() -> None:
@@ -148,7 +230,7 @@ def main() -> None:
         f"(pad ratio {pad_ratio:.2f}, compiles {engine.num_compiles()}, verified)")
     log(f"speedup vs scalar CPU fold: {speedup:.1f}x (target >=50x)")
 
-    print(json.dumps({
+    payload = {
         "metric": "cold_replay_events_per_sec",
         "value": round(eps),
         "unit": "events/s",
@@ -159,7 +241,27 @@ def main() -> None:
         "num_aggregates": corpus.num_aggregates,
         "pad_ratio": round(pad_ratio, 3),
         "platform": platform,
-    }), flush=True)
+    }
+
+    try:
+        latency_seconds = float(os.environ.get("SURGE_BENCH_LATENCY_SECONDS", 5))
+    except ValueError:
+        latency_seconds = 0.0
+        payload["latency_error"] = "unparseable SURGE_BENCH_LATENCY_SECONDS"
+    if latency_seconds > 0:
+        try:
+            stats = steady_state_latency(latency_seconds)
+            log(f"steady state: p50 {stats['command_p50_ms']}ms, "
+                f"p99 {stats['command_p99_ms']}ms, "
+                f"{stats['commands_per_sec']} commands/s "
+                f"({stats['latency_workers']} workers, "
+                f"{stats['flush_interval_ms']}ms flush, fsync commit)")
+            payload.update(stats)
+        except Exception as exc:  # noqa: BLE001 — phase 2 must not void phase 1
+            log(f"steady-state latency phase failed: {exc!r}")
+            payload["latency_error"] = f"{type(exc).__name__}: {exc}"
+
+    print(json.dumps(payload), flush=True)
 
 
 if __name__ == "__main__":
