@@ -7,4 +7,6 @@ cd "$(dirname "$0")/.."
 protoc -I proto --python_out=surge_tpu/multilanguage proto/multilanguage.proto
 protoc -I proto --python_out=surge_tpu/remote proto/node_transport.proto
 protoc -I proto --python_out=surge_tpu/admin proto/admin.proto
-echo "generated: surge_tpu/multilanguage/multilanguage_pb2.py surge_tpu/remote/node_transport_pb2.py surge_tpu/admin/admin_pb2.py"
+protoc -I proto --python_out=surge_tpu/log proto/log_service.proto
+protoc -I proto --python_out=surge_tpu/remote proto/control_plane.proto
+echo "generated: surge_tpu/multilanguage/multilanguage_pb2.py surge_tpu/remote/node_transport_pb2.py surge_tpu/admin/admin_pb2.py surge_tpu/log/log_service_pb2.py surge_tpu/remote/control_plane_pb2.py"

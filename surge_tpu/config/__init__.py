@@ -77,6 +77,9 @@ DEFAULTS: dict[str, Any] = {
     # enable-akka-cluster analog, core reference.conf:64-66)
     "surge.feature-flags.experimental.enable-cluster-sharding": False,
     "surge.feature-flags.experimental.disable-single-record-transactions": False,
+    # --- control plane (cross-process membership/assignment service) ---
+    "surge.control-plane.ping-interval-ms": 500,
+    "surge.control-plane.member-timeout-ms": 3_000,
     # --- gRPC transport security (KafkaSecurityConfiguration analog) ---
     "surge.grpc.tls.enabled": False,
     "surge.grpc.tls.cert-file": "",

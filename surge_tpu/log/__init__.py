@@ -20,8 +20,22 @@ from surge_tpu.log.transport import (
 from surge_tpu.log.memory import InMemoryLog
 from surge_tpu.log.file import FileLog
 
+
+def __getattr__(name):
+    # grpc-backed broker pieces load lazily so `import surge_tpu` does not make
+    # grpc a hard dependency of replay-only / FileLog-only consumers
+    if name == "GrpcLogTransport":
+        from surge_tpu.log.client import GrpcLogTransport
+        return GrpcLogTransport
+    if name == "LogServer":
+        from surge_tpu.log.server import LogServer
+        return LogServer
+    raise AttributeError(name)
+
 __all__ = [
     "FileLog",
+    "GrpcLogTransport",
+    "LogServer",
     "InMemoryLog",
     "LogRecord",
     "LogTransport",

@@ -1,0 +1,197 @@
+"""GrpcLogTransport — the LogTransport protocol over a remote LogServer.
+
+The KafkaProducer/KafkaConsumer-wrapper role (KafkaProducer.scala:18-265,
+KafkaConsumer.scala:17-132): thin, promise-free blocking calls against a remote
+broker, with transactions buffered locally and shipped atomically at commit, and
+fencing surfaced as :class:`ProducerFencedError`. Calls use a synchronous gRPC
+channel — they block the calling thread for one loopback/network round trip, which
+is the same envelope the reference's producer calls have against a broker.
+
+``wait_for_append`` long-polls the server from an executor thread so the event loop
+stays free (the dedicated poll-thread pattern of KafkaConsumerTrait).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import grpc
+
+from surge_tpu.log import log_service_pb2 as pb
+from surge_tpu.log.server import METHODS, SERVICE, msg_to_record, record_to_msg
+from surge_tpu.log.transport import (
+    LogRecord,
+    ProducerFencedError,
+    TopicSpec,
+    TransactionStateError,
+)
+
+
+def _raise_for(reply: pb.TxnReply) -> None:
+    if reply.ok:
+        return
+    if reply.error_kind == "fenced":
+        raise ProducerFencedError(reply.error)
+    if reply.error_kind == "state":
+        raise TransactionStateError(reply.error)
+    raise RuntimeError(f"log server error: {reply.error}")
+
+
+class GrpcTxnProducer:
+    """Client half of a server-side transactional producer (one token)."""
+
+    def __init__(self, transport: "GrpcLogTransport", token: int) -> None:
+        self._transport = transport
+        self._token = token
+        self._buffer: Optional[List[LogRecord]] = None
+        self._fenced = False
+
+    @property
+    def fenced(self) -> bool:
+        return self._fenced
+
+    @property
+    def in_transaction(self) -> bool:
+        return self._buffer is not None
+
+    def begin(self) -> None:
+        if self._buffer is not None:
+            raise TransactionStateError("transaction already open")
+        self._buffer = []
+
+    def send(self, record: LogRecord) -> None:
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        self._buffer.append(record)
+
+    def commit(self) -> Sequence[LogRecord]:
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        records, self._buffer = self._buffer, None
+        reply = self._transport._transact(self._token, "commit", records)
+        self._check_fence(reply)
+        _raise_for(reply)
+        return [msg_to_record(m) for m in reply.records]
+
+    def abort(self) -> None:
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        self._buffer = None  # records never left this process
+
+    def send_immediate(self, record: LogRecord) -> LogRecord:
+        reply = self._transport._transact(self._token, "send_immediate", [record])
+        self._check_fence(reply)
+        _raise_for(reply)
+        return msg_to_record(reply.records[0])
+
+    def _check_fence(self, reply: pb.TxnReply) -> None:
+        if not reply.ok and reply.error_kind == "fenced":
+            self._fenced = True
+
+
+class GrpcLogTransport:
+    """:class:`surge_tpu.log.transport.LogTransport` against a remote LogServer."""
+
+    def __init__(self, target: str, config=None,
+                 auto_create_partitions: int = 1) -> None:
+        from surge_tpu.remote.security import secure_sync_channel
+
+        self.target = target
+        self._channel = secure_sync_channel(target, config)
+        self._calls: Dict[str, object] = {}
+        for name, (req_cls, reply_cls) in METHODS.items():
+            self._calls[name] = self._channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=reply_cls.FromString)
+        self._auto_create_partitions = auto_create_partitions
+        self._topics: Dict[str, TopicSpec] = {}  # local spec cache
+        self._lock = threading.Lock()
+
+    # -- topics ---------------------------------------------------------------------------
+
+    def create_topic(self, spec: TopicSpec) -> None:
+        self._calls["CreateTopic"](pb.CreateTopicRequest(spec=pb.TopicSpecMsg(
+            name=spec.name, partitions=spec.partitions, compacted=spec.compacted)))
+        with self._lock:
+            self._topics[spec.name] = spec
+
+    def topic(self, name: str) -> TopicSpec:
+        with self._lock:
+            hit = self._topics.get(name)
+        if hit is not None:
+            return hit
+        reply = self._calls["GetTopic"](pb.TopicRequest(name=name))
+        if not reply.found:
+            # parity with InMemoryLog: unknown topics auto-create
+            spec = TopicSpec(name, self._auto_create_partitions)
+            self.create_topic(spec)
+            return spec
+        spec = TopicSpec(reply.spec.name, reply.spec.partitions, reply.spec.compacted)
+        with self._lock:
+            self._topics[name] = spec
+        return spec
+
+    def num_partitions(self, name: str) -> int:
+        return self.topic(name).partitions
+
+    # -- producers ------------------------------------------------------------------------
+
+    def transactional_producer(self, transactional_id: str) -> GrpcTxnProducer:
+        reply = self._calls["OpenProducer"](
+            pb.OpenProducerRequest(transactional_id=transactional_id))
+        return GrpcTxnProducer(self, reply.producer_token)
+
+    def _transact(self, token: int, op: str,
+                  records: Sequence[LogRecord]) -> pb.TxnReply:
+        return self._calls["Transact"](pb.TxnRequest(
+            producer_token=token, op=op,
+            records=[record_to_msg(r) for r in records]))
+
+    # -- reads ----------------------------------------------------------------------------
+
+    def read(self, topic: str, partition: int, from_offset: int = 0,
+             max_records: Optional[int] = None,
+             isolation: str = "read_committed") -> Sequence[LogRecord]:
+        del isolation  # the server's log already serves committed records only
+        req = pb.ReadRequest(topic=topic, partition=partition,
+                             from_offset=from_offset)
+        if max_records is not None:
+            req.has_max = True
+            req.max_records = max_records
+        reply = self._calls["Read"](req)
+        return [msg_to_record(m) for m in reply.records]
+
+    def end_offset(self, topic: str, partition: int,
+                   isolation: str = "read_committed") -> int:
+        del isolation
+        self.topic(topic)  # auto-create parity
+        return self._calls["EndOffset"](
+            pb.OffsetRequest(topic=topic, partition=partition)).end_offset
+
+    def latest_by_key(self, topic: str, partition: int,
+                      isolation: str = "read_committed") -> Mapping[str, LogRecord]:
+        reply = self._calls["LatestByKey"](
+            pb.OffsetRequest(topic=topic, partition=partition))
+        return {m.key: msg_to_record(m) for m in reply.records}
+
+    async def wait_for_append(self, topic: str, partition: int,
+                              after_offset: int) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            reply = await loop.run_in_executor(None, lambda: self._calls[
+                "WaitForAppend"](pb.WaitRequest(
+                    topic=topic, partition=partition, after_offset=after_offset,
+                    timeout_s=0.5)))
+            if reply.appended:
+                return
+            if loop.time() - t0 < 0.1:
+                # the broker's long-poll slots were contended and it answered
+                # immediately — pace the retry so this doesn't become a hot loop
+                await asyncio.sleep(0.1)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,235 @@
+"""Networked log broker: any LogTransport served over gRPC.
+
+The shared durability substrate between engine processes — the role the external
+Kafka broker plays for the reference (SURVEY.md §2.9 item 3; KafkaProducer.scala /
+KafkaConsumer.scala are thin wrappers over a remote broker exactly like
+:class:`surge_tpu.log.client.GrpcLogTransport` is over this server). Wraps any
+in-process :class:`~surge_tpu.log.transport.LogTransport` — :class:`FileLog` for a
+durable single-node broker, :class:`InMemoryLog` for tests (the EmbeddedKafka
+analog, SURVEY.md §4.5).
+
+Runs on the **synchronous** gRPC server (thread pool): the broker's inner logs are
+already thread-safe, handlers never touch an event loop, and one process can host
+the broker alongside grpc.aio clients/servers without the multi-loop hazards of
+grpc.aio-on-a-thread.
+
+Semantics preserved across the wire:
+
+- **Atomic transactions**: the client buffers ``send()`` locally and ships the whole
+  transaction in one ``Transact(op="commit")`` request; the server appends it through
+  the wrapped log's transactional producer, so multi-topic atomicity and
+  read_committed visibility are the inner log's.
+- **Producer-epoch fencing**: ``OpenProducer`` opens a server-side producer, fencing
+  any earlier holder of the transactional id (including one opened by another
+  process); a fenced producer's operations return ``error_kind="fenced"`` which the
+  client re-raises as :class:`ProducerFencedError`.
+- **Consumer wakeups**: ``WaitForAppend`` long-polls ``end_offset`` with a bounded
+  timeout (the client loops, so arbitrarily long waits stay cheap per request).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from concurrent import futures
+from typing import Dict, Optional
+
+import grpc
+
+from surge_tpu.common import logger
+from surge_tpu.log import log_service_pb2 as pb
+from surge_tpu.log.transport import (
+    LogRecord,
+    ProducerFencedError,
+    TopicSpec,
+    TransactionStateError,
+)
+
+SERVICE = "surge_tpu.log.LogService"
+METHODS = {
+    "CreateTopic": (pb.CreateTopicRequest, pb.TopicReply),
+    "GetTopic": (pb.TopicRequest, pb.TopicReply),
+    "OpenProducer": (pb.OpenProducerRequest, pb.OpenProducerReply),
+    "Transact": (pb.TxnRequest, pb.TxnReply),
+    "Read": (pb.ReadRequest, pb.ReadReply),
+    "EndOffset": (pb.OffsetRequest, pb.OffsetReply),
+    "LatestByKey": (pb.OffsetRequest, pb.LatestByKeyReply),
+    "WaitForAppend": (pb.WaitRequest, pb.WaitReply),
+}
+
+
+def record_to_msg(r: LogRecord) -> pb.RecordMsg:
+    msg = pb.RecordMsg(topic=r.topic, partition=r.partition,
+                       offset=r.offset, timestamp=r.timestamp)
+    if r.key is not None:
+        msg.has_key = True
+        msg.key = r.key
+    if r.value is not None:
+        msg.has_value = True
+        msg.value = r.value
+    for k, v in r.headers.items():
+        msg.headers[k] = v
+    return msg
+
+
+def msg_to_record(m: pb.RecordMsg) -> LogRecord:
+    return LogRecord(topic=m.topic, key=m.key if m.has_key else None,
+                     value=m.value if m.has_value else None,
+                     partition=m.partition, headers=dict(m.headers),
+                     offset=m.offset, timestamp=m.timestamp)
+
+
+class LogServer:
+    """gRPC facade over an in-process log. One instance per broker process."""
+
+    def __init__(self, log, host: str = "127.0.0.1", port: int = 0,
+                 config=None, max_workers: int = 32) -> None:
+        self.log = log
+        self._host = host
+        self._port = port
+        self._config = config
+        self._max_workers = max_workers
+        self._server: Optional[grpc.Server] = None
+        self.bound_port: Optional[int] = None
+        self._producers: Dict[int, tuple] = {}  # token -> (txn_id, producer)
+        self._fenced_tokens: "OrderedDict[int, None]" = OrderedDict()
+        self._next_token = 1
+        self._token_lock = threading.Lock()
+        # long-poll waiters may not occupy more than half the handler pool, or
+        # many tailing indexers would starve the Transact/Read command path
+        self._wait_slots = threading.BoundedSemaphore(max(max_workers // 2, 1))
+
+    # -- handlers (sync; called on the server thread pool) --------------------------------
+
+    def CreateTopic(self, request: pb.CreateTopicRequest, context) -> pb.TopicReply:
+        spec = TopicSpec(request.spec.name, request.spec.partitions or 1,
+                         request.spec.compacted)
+        self.log.create_topic(spec)
+        return pb.TopicReply(found=True, spec=request.spec)
+
+    def GetTopic(self, request: pb.TopicRequest, context) -> pb.TopicReply:
+        try:
+            spec = self.log.topic(request.name)
+        except KeyError:
+            return pb.TopicReply(found=False)
+        return pb.TopicReply(found=True, spec=pb.TopicSpecMsg(
+            name=spec.name, partitions=spec.partitions, compacted=spec.compacted))
+
+    def OpenProducer(self, request: pb.OpenProducerRequest,
+                     context) -> pb.OpenProducerReply:
+        producer = self.log.transactional_producer(request.transactional_id)
+        with self._token_lock:
+            # prune tokens this open just fenced (the inner log fenced their
+            # producers); remember them so a zombie client still gets the
+            # protocol-correct "fenced" answer rather than "unknown token"
+            for stale in [t for t, (tid, _) in self._producers.items()
+                          if tid == request.transactional_id]:
+                del self._producers[stale]
+                self._fenced_tokens[stale] = None
+            while len(self._fenced_tokens) > 1024:
+                self._fenced_tokens.popitem(last=False)
+            token = self._next_token
+            self._next_token += 1
+            self._producers[token] = (request.transactional_id, producer)
+        return pb.OpenProducerReply(producer_token=token)
+
+    def Transact(self, request: pb.TxnRequest, context) -> pb.TxnReply:
+        entry = self._producers.get(request.producer_token)
+        if entry is None:
+            if request.producer_token in self._fenced_tokens:
+                return pb.TxnReply(ok=False, error="producer fenced",
+                                   error_kind="fenced")
+            return pb.TxnReply(ok=False, error="unknown producer token",
+                               error_kind="state")
+        _, producer = entry
+        records = [msg_to_record(m) for m in request.records]
+        try:
+            if request.op == "commit":
+                producer.begin()
+                for r in records:
+                    producer.send(r)
+                committed = producer.commit()
+            elif request.op == "abort":
+                # transactions buffer client-side; nothing server-side to discard
+                committed = []
+            elif request.op == "send_immediate":
+                committed = [producer.send_immediate(r) for r in records]
+            else:
+                return pb.TxnReply(ok=False, error=f"unknown op {request.op!r}",
+                                   error_kind="state")
+        except ProducerFencedError as exc:
+            return pb.TxnReply(ok=False, error=str(exc), error_kind="fenced")
+        except TransactionStateError as exc:
+            return pb.TxnReply(ok=False, error=str(exc), error_kind="state")
+        except Exception as exc:  # noqa: BLE001 — surface inner-log failures
+            logger.exception("log server transact failed")
+            return pb.TxnReply(ok=False, error=repr(exc), error_kind="other")
+        return pb.TxnReply(ok=True, records=[record_to_msg(r) for r in committed])
+
+    def Read(self, request: pb.ReadRequest, context) -> pb.ReadReply:
+        max_records = request.max_records if request.has_max else None
+        recs = self.log.read(request.topic, request.partition,
+                             from_offset=request.from_offset,
+                             max_records=max_records)
+        return pb.ReadReply(records=[record_to_msg(r) for r in recs])
+
+    def EndOffset(self, request: pb.OffsetRequest, context) -> pb.OffsetReply:
+        return pb.OffsetReply(
+            end_offset=self.log.end_offset(request.topic, request.partition))
+
+    def LatestByKey(self, request: pb.OffsetRequest,
+                    context) -> pb.LatestByKeyReply:
+        latest = self.log.latest_by_key(request.topic, request.partition)
+        return pb.LatestByKeyReply(records=[record_to_msg(r)
+                                            for r in latest.values()])
+
+    def WaitForAppend(self, request: pb.WaitRequest, context) -> pb.WaitReply:
+        def check() -> bool:
+            return (self.log.end_offset(request.topic, request.partition)
+                    > request.after_offset)
+
+        if not self._wait_slots.acquire(blocking=False):
+            # pool contended: answer immediately (the client paces its retry)
+            return pb.WaitReply(appended=check())
+        try:
+            deadline = time.monotonic() + max(request.timeout_s, 0.01)
+            while time.monotonic() < deadline:
+                if check():
+                    return pb.WaitReply(appended=True)
+                time.sleep(0.02)
+            return pb.WaitReply(appended=False)
+        finally:
+            self._wait_slots.release()
+
+    # -- lifecycle ------------------------------------------------------------------------
+
+    def start(self) -> int:
+        from surge_tpu.remote.security import server_credentials, tls_enabled
+
+        rpc = {}
+        for name, (req_cls, reply_cls) in METHODS.items():
+            rpc[name] = grpc.unary_unary_rpc_method_handler(
+                getattr(self, name), request_deserializer=req_cls.FromString,
+                response_serializer=reply_cls.SerializeToString)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self._max_workers))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, rpc),))
+        address = f"{self._host}:{self._port}"
+        if tls_enabled(self._config):
+            self.bound_port = self._server.add_secure_port(
+                address, server_credentials(self._config))
+        else:
+            self.bound_port = self._server.add_insecure_port(address)
+        self._server.start()
+        return self.bound_port
+
+    def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+
+    # aliases kept for symmetry with the asyncio-hosted servers
+    serve_background = start
+    shutdown_background = stop

@@ -10,6 +10,15 @@ keyed by HostPort. Payloads cross in the app's own formats (``command_format`` /
 rides the request headers like TracedMessage carries W3C headers.
 """
 
+from surge_tpu.remote.control_plane import (
+    ControlPlaneClient,
+    ControlPlaneServer,
+)
 from surge_tpu.remote.transport import GrpcRemoteDeliver, NodeTransportServer
 
-__all__ = ["GrpcRemoteDeliver", "NodeTransportServer"]
+__all__ = [
+    "ControlPlaneClient",
+    "ControlPlaneServer",
+    "GrpcRemoteDeliver",
+    "NodeTransportServer",
+]

@@ -1,0 +1,519 @@
+"""Cross-process control plane: membership, assignments, allocations, one epoch.
+
+The reference distributes this metadata through the Kafka consumer-group protocol
+(rebalances produce assignments) and Akka remoting (the assignment registry actor
+broadcasts them — KafkaConsumerStateTrackingActor.scala:39-118; the cluster-sharding
+listener pushes external shard allocations — KafkaClusterShardingRebalanceListener
+.scala:144-181). Here a small gRPC service is the single authority:
+
+- **ControlPlaneServer** owns the member set (heartbeat-expired), the partition
+  assignments (auto-balanced across live members on every membership change — the
+  consumer-group-rebalance role), the shard-allocation table, and a monotonically
+  increasing **epoch** stamped on every state broadcast.
+- **ControlPlaneClient** joins, watches the server-streamed state, and applies each
+  epoch-ordered update into *remote mirror* objects — drop-in subclasses of
+  :class:`PartitionTracker` / :class:`ClusterMembership` /
+  :class:`ExternalShardAllocation` whose mutators forward to the server instead of
+  mutating locally. Engines and routers are wired to the mirrors unchanged.
+- **Dual-leader closure**: ``UpdateShardLocations`` is compare-and-set on the epoch
+  AND verified against the server's own leader view, so two nodes that transiently
+  both believe they are the lowest-address leader cannot both win — the stale one
+  gets a Conflict and reconverges from the next watch update.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Dict, List, Mapping, Optional
+
+import grpc
+
+from surge_tpu.common import logger
+from surge_tpu.config import Config, default_config
+from surge_tpu.engine.cluster import ClusterMembership, ExternalShardAllocation
+from surge_tpu.engine.partition import (
+    AssignmentChanges,
+    Assignments,
+    HostPort,
+    PartitionTracker,
+)
+from surge_tpu.remote import control_plane_pb2 as pb
+
+SERVICE = "surge_tpu.control.ControlPlane"
+UNARY_METHODS = {
+    "Join": (pb.JoinRequest, pb.ClusterState),
+    "Leave": (pb.MemberRequest, pb.ControlAck),
+    "Ping": (pb.MemberRequest, pb.ControlAck),
+    "UpdateAssignments": (pb.UpdateAssignmentsRequest, pb.ControlAck),
+    "UpdateShardLocations": (pb.AllocateRequest, pb.ControlAck),
+}
+
+
+def _hp(member: pb.Member) -> HostPort:
+    return HostPort(member.host, member.port)
+
+
+def _hp_str(s: str) -> HostPort:
+    host, _, port = s.rpartition(":")
+    return HostPort(host, int(port))
+
+
+class ControlPlaneServer:
+    """The epoch authority. One per cluster (like the reference's broker/seed role)."""
+
+    def __init__(self, num_partitions: int, host: str = "127.0.0.1", port: int = 0,
+                 auto_balance: bool = True,
+                 member_timeout_s: Optional[float] = None,
+                 config: Config | None = None) -> None:
+        self.num_partitions = num_partitions
+        self.auto_balance = auto_balance
+        cfg = config or default_config()
+        self.member_timeout_s = (
+            member_timeout_s if member_timeout_s is not None
+            else cfg.get_seconds("surge.control-plane.member-timeout-ms", 3_000))
+        self._host = host
+        self._port = port
+        self._config = config
+        self.epoch = 0
+        self._members: Dict[HostPort, dict] = {}  # -> {last_ping, transport_target}
+        self._assignments: Dict[HostPort, List[int]] = {}
+        self._locations: Dict[int, HostPort] = {}
+        self._watchers: List[asyncio.Queue] = []
+        self._server: Optional[grpc.aio.Server] = None
+        self.bound_port: Optional[int] = None
+        self._expiry_task: Optional[asyncio.Task] = None
+        self._thread = None
+        self._thread_loop = None
+
+    # -- state ----------------------------------------------------------------------------
+
+    def _state_msg(self) -> pb.ClusterState:
+        state = pb.ClusterState(epoch=self.epoch)
+        for m in sorted(self._members):
+            state.members.append(pb.Member(
+                host=m.host, port=m.port,
+                transport_target=self._members[m]["transport_target"]))
+        for m, parts in self._assignments.items():
+            state.assignments[str(m)].partitions.extend(sorted(parts))
+        for p, m in self._locations.items():
+            state.shard_locations[p] = str(m)
+        return state
+
+    def _leader(self) -> Optional[HostPort]:
+        return min(self._members) if self._members else None
+
+    def _bump_and_broadcast(self) -> None:
+        self.epoch += 1
+        msg = self._state_msg()
+        for q in list(self._watchers):
+            q.put_nowait(msg)
+
+    def _rebalance(self) -> None:
+        """Round-robin the partition range across live members (the consumer-group
+        rebalance role). Deterministic: members sorted, partitions in order."""
+        members = sorted(self._members)
+        if not members:
+            self._assignments = {}
+            return
+        new: Dict[HostPort, List[int]] = {m: [] for m in members}
+        for p in range(self.num_partitions):
+            new[members[p % len(members)]].append(p)
+        self._assignments = new
+
+    def _remove_member(self, member: HostPort) -> bool:
+        if member not in self._members:
+            return False
+        del self._members[member]
+        self._assignments.pop(member, None)
+        # a departed member must not keep owning shards; the leader (or the next
+        # assignment application) re-allocates the now-unowned partitions
+        self._locations = {p: m for p, m in self._locations.items() if m != member}
+        if self.auto_balance:
+            self._rebalance()
+        return True
+
+    # -- handlers -------------------------------------------------------------------------
+
+    async def Join(self, request: pb.JoinRequest, context) -> pb.ClusterState:
+        member = _hp(request.member)
+        self._members[member] = {
+            "last_ping": time.monotonic(),
+            "transport_target": request.member.transport_target,
+        }
+        if self.auto_balance:
+            self._rebalance()
+        self._bump_and_broadcast()
+        return self._state_msg()
+
+    async def Leave(self, request: pb.MemberRequest, context) -> pb.ControlAck:
+        if self._remove_member(_hp(request.member)):
+            self._bump_and_broadcast()
+        return pb.ControlAck(ok=True, epoch=self.epoch)
+
+    async def Ping(self, request: pb.MemberRequest, context) -> pb.ControlAck:
+        info = self._members.get(_hp(request.member))
+        if info is None:  # expired or never joined: tell the node to re-join
+            return pb.ControlAck(ok=False, error="unknown member", epoch=self.epoch)
+        info["last_ping"] = time.monotonic()
+        return pb.ControlAck(ok=True, epoch=self.epoch)
+
+    async def UpdateAssignments(self, request: pb.UpdateAssignmentsRequest,
+                                context) -> pb.ControlAck:
+        self._assignments = {
+            _hp_str(host): list(pl.partitions)
+            for host, pl in request.assignments.items()}
+        self._bump_and_broadcast()
+        return pb.ControlAck(ok=True, epoch=self.epoch)
+
+    async def UpdateShardLocations(self, request: pb.AllocateRequest,
+                                   context) -> pb.ControlAck:
+        sender = _hp(request.member)
+        leader = self._leader()
+        if sender != leader:
+            return pb.ControlAck(
+                ok=False, epoch=self.epoch,
+                error=f"not leader (leader is {leader})")
+        if request.observed_epoch != self.epoch:
+            return pb.ControlAck(
+                ok=False, epoch=self.epoch,
+                error=f"stale epoch {request.observed_epoch} != {self.epoch}")
+        changed = False
+        for p, target in request.locations.items():
+            owner = _hp_str(target)
+            if self._locations.get(p) != owner:
+                self._locations[p] = owner
+                changed = True
+        if changed:
+            self._bump_and_broadcast()
+        return pb.ControlAck(ok=True, epoch=self.epoch)
+
+    async def Watch(self, request: pb.WatchRequest, context):
+        queue: asyncio.Queue = asyncio.Queue()
+        self._watchers.append(queue)
+        try:
+            if self.epoch > request.from_epoch:
+                yield self._state_msg()
+            while True:
+                yield await queue.get()
+        finally:
+            self._watchers.remove(queue)
+
+    # -- expiry ---------------------------------------------------------------------------
+
+    async def _expiry_loop(self) -> None:
+        interval = max(self.member_timeout_s / 3.0, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            cutoff = time.monotonic() - self.member_timeout_s
+            expired = [m for m, info in self._members.items()
+                       if info["last_ping"] < cutoff]
+            changed = False
+            for m in expired:
+                logger.warning("control plane: member %s heartbeat-expired", m)
+                changed |= self._remove_member(m)
+            if changed:
+                self._bump_and_broadcast()
+
+    # -- lifecycle ------------------------------------------------------------------------
+
+    def _handler(self) -> grpc.GenericRpcHandler:
+        rpc = {}
+        for name, (req_cls, reply_cls) in UNARY_METHODS.items():
+            rpc[name] = grpc.unary_unary_rpc_method_handler(
+                getattr(self, name), request_deserializer=req_cls.FromString,
+                response_serializer=reply_cls.SerializeToString)
+        rpc["Watch"] = grpc.unary_stream_rpc_method_handler(
+            self.Watch, request_deserializer=pb.WatchRequest.FromString,
+            response_serializer=pb.ClusterState.SerializeToString)
+        return grpc.method_handlers_generic_handler(SERVICE, rpc)
+
+    async def start(self) -> int:
+        from surge_tpu.remote.security import add_secure_port
+
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((self._handler(),))
+        self.bound_port = add_secure_port(
+            self._server, f"{self._host}:{self._port}", self._config)
+        await self._server.start()
+        self._expiry_task = asyncio.ensure_future(self._expiry_loop())
+        return self.bound_port
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._expiry_task is not None:
+            self._expiry_task.cancel()
+            self._expiry_task = None
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None
+
+    def serve_background(self) -> int:
+        """Dedicated thread + loop (standalone seed process or sync tests)."""
+        import threading
+
+        ready = threading.Event()
+        port_box = {}
+
+        def run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._thread_loop = loop
+            port_box["port"] = loop.run_until_complete(self.start())
+            ready.set()
+            loop.run_forever()
+            loop.run_until_complete(self.stop())
+            loop.close()
+
+        self._thread = threading.Thread(target=run, name="surge-control-plane",
+                                        daemon=True)
+        self._thread.start()
+        ready.wait(10.0)
+        return port_box["port"]
+
+    def shutdown_background(self) -> None:
+        if self._thread_loop is not None:
+            self._thread_loop.call_soon_threadsafe(self._thread_loop.stop)
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+
+# -- client-side remote mirrors ----------------------------------------------------------
+
+
+class RemotePartitionTracker(PartitionTracker):
+    """Tracker mirror: ``update`` forwards to the control plane; local state (and
+    listener broadcasts) change only when the watch stream applies a new epoch."""
+
+    def __init__(self, client: "ControlPlaneClient") -> None:
+        super().__init__()
+        self._client = client
+
+    def update(self, new: Assignments) -> AssignmentChanges:
+        self._client.push_assignments(new)
+        return AssignmentChanges(revoked={}, added={})
+
+    def _apply(self, new: Assignments) -> None:
+        if new != self.assignments.assignments:
+            super().update(new)
+
+
+class RemoteClusterMembership(ClusterMembership):
+    """Membership mirror: join/leave forward to the control plane."""
+
+    def __init__(self, client: "ControlPlaneClient") -> None:
+        super().__init__()
+        self._client = client
+
+    def join(self, member: HostPort) -> None:
+        self._client.request_join()
+
+    def leave(self, member: HostPort) -> None:
+        self._client.request_leave()
+
+    def _apply(self, members: List[HostPort]) -> None:
+        if sorted(members) != self._members:
+            self._members = sorted(members)
+            self._broadcast()
+
+
+class RemoteExternalShardAllocation(ExternalShardAllocation):
+    """Allocation mirror: updates are epoch-CAS'd through the control plane."""
+
+    def __init__(self, client: "ControlPlaneClient") -> None:
+        super().__init__()
+        self._client = client
+
+    def update_shard_locations(self, mapping: Mapping[int, HostPort]) -> None:
+        self._client.push_allocations(mapping)
+
+    def deallocate_member(self, member: HostPort) -> None:
+        pass  # the server prunes a departed member's allocations itself
+
+    def _apply(self, locations: Dict[int, HostPort]) -> None:
+        if locations != self._locations:
+            self._locations = dict(locations)
+            self._broadcast()
+
+
+class ControlPlaneClient:
+    """One node's connection to the control plane.
+
+    Owns the remote mirrors (``tracker``/``membership``/``allocation``) that the
+    engine and router are constructed with, a watch task applying epoch-ordered
+    state, and a heartbeat task. ``on_peers`` fires with ``{HostPort: target}`` on
+    every membership application so the caller can (re)point its
+    :class:`GrpcRemoteDeliver` address book.
+    """
+
+    def __init__(self, target: str, local: HostPort, transport_target: str = "",
+                 config: Config | None = None,
+                 on_peers: Callable[[Dict[HostPort, str]], None] | None = None,
+                 ping_interval_s: float | None = None) -> None:
+        self.target = target
+        self.local = local
+        self.transport_target = transport_target
+        self.config = config or default_config()
+        self.on_peers = on_peers
+        self.applied_epoch = 0
+        self.tracker = RemotePartitionTracker(self)
+        self.membership = RemoteClusterMembership(self)
+        self.allocation = RemoteExternalShardAllocation(self)
+        self._ping_interval_s = (
+            ping_interval_s if ping_interval_s is not None
+            else self.config.get_seconds("surge.control-plane.ping-interval-ms", 500))
+        self._channel: Optional[grpc.aio.Channel] = None
+        self._calls: Dict[str, object] = {}
+        self._watch_call = None
+        self._tasks: List[asyncio.Task] = []
+        self._inflight: set = set()
+
+    def _member_msg(self) -> pb.Member:
+        return pb.Member(host=self.local.host, port=self.local.port,
+                         transport_target=self.transport_target)
+
+    async def start(self) -> None:
+        from surge_tpu.remote.security import secure_channel
+
+        self._channel = secure_channel(self.target, self.config)
+        for name, (req_cls, reply_cls) in UNARY_METHODS.items():
+            self._calls[name] = self._channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=reply_cls.FromString)
+        self._watch_call = self._channel.unary_stream(
+            f"/{SERVICE}/Watch",
+            request_serializer=pb.WatchRequest.SerializeToString,
+            response_deserializer=pb.ClusterState.FromString)
+        state = await self._calls["Join"](pb.JoinRequest(member=self._member_msg()))
+        self._apply_state(state, force=True)
+        self._tasks = [asyncio.ensure_future(self._watch_loop()),
+                       asyncio.ensure_future(self._ping_loop())]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._tasks = []
+        if self._calls:
+            try:
+                await self._calls["Leave"](
+                    pb.MemberRequest(member=self._member_msg()), timeout=2.0)
+            except Exception:  # noqa: BLE001 — seed may already be gone
+                pass
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+
+    # -- state application ----------------------------------------------------------------
+
+    def _apply_state(self, state: pb.ClusterState, force: bool = False) -> None:
+        """Apply an epoch-ordered update. ``force`` accepts a LOWER epoch — used for
+        Join responses, where a lower epoch means the seed restarted with fresh
+        state (its epochs restarted too); without force the mirrors would discard
+        every post-restart update until the new epoch caught up."""
+        if state.epoch <= self.applied_epoch and not force:
+            return
+        self.applied_epoch = state.epoch
+        members = [_hp(m) for m in state.members]
+        targets = {_hp(m): (m.transport_target or str(_hp(m)))
+                   for m in state.members}
+        if self.on_peers is not None:
+            try:
+                self.on_peers(targets)
+            except Exception:  # noqa: BLE001
+                logger.exception("on_peers callback failed")
+        # order matters: peers/members first so leader checks and remote routing
+        # see the new topology before assignment/allocation listeners fire
+        self.membership._apply(members)
+        self.tracker._apply({
+            _hp_str(host): list(pl.partitions)
+            for host, pl in state.assignments.items()})
+        self.allocation._apply({
+            p: _hp_str(t) for p, t in state.shard_locations.items()})
+
+    async def _watch_loop(self) -> None:
+        while True:
+            try:
+                stream = self._watch_call(pb.WatchRequest(
+                    member=self._member_msg(), from_epoch=self.applied_epoch))
+                async for state in stream:
+                    self._apply_state(state)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — reconnect after seed restart
+                logger.warning("control-plane watch dropped (%r); retrying", exc)
+                await asyncio.sleep(0.5)
+
+    async def _ping_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._ping_interval_s)
+            try:
+                ack = await self._calls["Ping"](
+                    pb.MemberRequest(member=self._member_msg()), timeout=2.0)
+                if not ack.ok:  # expired server-side (or seed restarted): re-join
+                    state = await self._calls["Join"](
+                        pb.JoinRequest(member=self._member_msg()))
+                    self._apply_state(state, force=True)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("control-plane ping failed: %r", exc)
+
+    # -- mutator forwarding (fire-and-forget; convergence via the watch stream) -----------
+
+    def _spawn(self, coro, what: str = "control-plane rpc") -> None:
+        async def guarded() -> None:
+            # transient seed unavailability must not silently drop a mutation —
+            # retry a few times; a still-failing update is logged loudly and
+            # recovered by the next epoch-driven listener re-fire
+            for attempt in range(3):
+                try:
+                    await coro()
+                    return
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:  # noqa: BLE001
+                    logger.warning("%s failed (attempt %d/3): %r",
+                                   what, attempt + 1, exc)
+                    await asyncio.sleep(0.5)
+            logger.error("%s dropped after 3 attempts", what)
+
+        task = asyncio.ensure_future(guarded())
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    def push_assignments(self, new: Assignments) -> None:
+        req = pb.UpdateAssignmentsRequest(member=self._member_msg())
+        for hp, parts in new.items():
+            req.assignments[str(hp)].partitions.extend(parts)
+        self._spawn(lambda: self._calls["UpdateAssignments"](req),
+                    "assignment update")
+
+    def push_allocations(self, mapping: Mapping[int, HostPort]) -> None:
+        async def send() -> None:
+            req = pb.AllocateRequest(member=self._member_msg(),
+                                     observed_epoch=self.applied_epoch)
+            for p, hp in mapping.items():
+                req.locations[p] = str(hp)
+            ack = await self._calls["UpdateShardLocations"](req)
+            if not ack.ok:
+                # CAS conflict or leadership change: the newer epoch arrives on the
+                # watch stream and re-triggers the allocation listeners
+                logger.info("allocation update rejected: %s", ack.error)
+
+        self._spawn(send, "allocation update")
+
+    def request_join(self) -> None:
+        if not self._calls:  # pre-start (router.start's membership.join); the
+            return           # client's own start() performs the Join
+        async def join() -> None:
+            state = await self._calls["Join"](pb.JoinRequest(member=self._member_msg()))
+            self._apply_state(state, force=True)
+
+        self._spawn(join, "join")
+
+    def request_leave(self) -> None:
+        if not self._calls:
+            return
+        self._spawn(lambda: self._calls["Leave"](
+            pb.MemberRequest(member=self._member_msg())), "leave")

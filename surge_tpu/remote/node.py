@@ -1,0 +1,74 @@
+"""EngineNode — one process's full node assembly.
+
+Composes what a real deployment runs per process (the reference's "application
+embedding a Surge engine" unit, SurgeMessagePipeline.scala:33-87 + remoting):
+
+- a :class:`GrpcLogTransport` (or any provided log) to the shared log broker,
+- the engine wired to **control-plane mirrors** (tracker/membership/allocation)
+  so partition assignment metadata flows through the ControlPlane service,
+- a :class:`NodeTransportServer` accepting forwarded envelopes, and
+- a :class:`GrpcRemoteDeliver` whose address book tracks the control plane's
+  member list (each member advertises its transport target on Join).
+
+Start order matters and is encapsulated here: the engine starts first (router
+registered on the still-empty mirror tracker), then the transport server binds,
+then the control-plane client joins — the join's state application fans out through
+the mirrors and the router creates/starts exactly the regions this node owns.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from surge_tpu.config import Config, default_config
+from surge_tpu.engine.partition import HostPort
+from surge_tpu.engine.pipeline import SurgeEngine
+from surge_tpu.remote.control_plane import ControlPlaneClient
+from surge_tpu.remote.transport import GrpcRemoteDeliver, NodeTransportServer
+
+
+class EngineNode:
+    """One engine process participating in a cluster."""
+
+    def __init__(self, logic, control_plane_target: str, log,
+                 node_name: str, config: Config | None = None,
+                 advertise_host: str = "127.0.0.1",
+                 cluster_sharding: bool = False) -> None:
+        self.config = config or default_config()
+        if cluster_sharding:
+            self.config = self.config.with_overrides({
+                "surge.feature-flags.experimental.enable-cluster-sharding": True})
+        # logical node identity (stable across transport-port changes); the actual
+        # gRPC target is advertised separately via the control plane
+        self.local = HostPort(node_name, 0)
+        self.client = ControlPlaneClient(control_plane_target, self.local,
+                                         config=self.config,
+                                         on_peers=self._on_peers)
+        self.deliver = GrpcRemoteDeliver(logic, config=self.config)
+        self.engine = SurgeEngine(
+            logic, log=log, config=self.config, local_host=self.local,
+            tracker=self.client.tracker, remote_deliver=self.deliver,
+            membership=self.client.membership,
+            shard_allocation=self.client.allocation)
+        self.server = NodeTransportServer(self.engine)
+        self._advertise_host = advertise_host
+
+    def _on_peers(self, targets) -> None:
+        for member, target in targets.items():
+            if member != self.local and target:
+                self.deliver.set_address(member, target)
+
+    async def start(self) -> None:
+        await self.engine.start()
+        port = await self.server.start()
+        self.client.transport_target = f"{self._advertise_host}:{port}"
+        await self.client.start()
+
+    async def stop(self) -> None:
+        await self.client.stop()  # leave first so peers stop routing to us
+        await self.server.stop()
+        await self.engine.stop()
+        await self.deliver.close()
+
+    def aggregate_for(self, aggregate_id: str):
+        return self.engine.aggregate_for(aggregate_id)

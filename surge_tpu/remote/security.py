@@ -74,3 +74,10 @@ def secure_channel(target: str, config: Optional[Config]) -> grpc.aio.Channel:
     if tls_enabled(config):
         return grpc.aio.secure_channel(target, channel_credentials(config))
     return grpc.aio.insecure_channel(target)
+
+
+def secure_sync_channel(target: str, config: Optional[Config]) -> grpc.Channel:
+    """Synchronous-channel variant of :func:`secure_channel` (blocking clients)."""
+    if tls_enabled(config):
+        return grpc.secure_channel(target, channel_credentials(config))
+    return grpc.insecure_channel(target)

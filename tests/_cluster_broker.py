@@ -1,0 +1,29 @@
+"""Broker process for the multi-process cluster test: shared log + control plane.
+
+Prints one JSON line ``{"log_port": N, "cp_port": M}`` when ready, then serves until
+killed. The log broker is the external-Kafka-broker role; the control plane is the
+consumer-group/seed role (SURVEY.md §2.9 item 3, §2.10 distributed backend).
+"""
+
+import asyncio
+import json
+import sys
+
+sys.path.insert(0, ".")  # repo root
+
+from surge_tpu.log import InMemoryLog, LogServer  # noqa: E402
+from surge_tpu.remote.control_plane import ControlPlaneServer  # noqa: E402
+
+
+async def main() -> None:
+    num_partitions = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    log_server = LogServer(InMemoryLog())
+    log_port = log_server.start()
+    cp = ControlPlaneServer(num_partitions=num_partitions, member_timeout_s=1.5)
+    cp_port = await cp.start()
+    print(json.dumps({"log_port": log_port, "cp_port": cp_port}), flush=True)
+    await asyncio.Event().wait()  # serve until killed
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,97 @@
+"""Worker process for the multi-process cluster test: one EngineNode.
+
+    python tests/_cluster_worker.py <cp_target> <log_target> <my_name> <peer_name> \
+        <result_path>
+
+Round 1 (after both members are visible): increment 12 of MY aggregates — spread
+across every partition, so some route to the peer process over real gRPC — and
+write ``{agg: count}`` to ``<result_path>.r1``.
+
+Round 2 (triggered by the driver creating ``<result_path>.go2``): increment my
+aggregates AND the peer's — run after the peer was SIGKILLed, proving heartbeat
+expiry → rebalance → takeover with state recovered from the shared log — and write
+``<result_path>.r2``.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+from surge_tpu import SurgeCommandBusinessLogic, default_config  # noqa: E402
+from surge_tpu.engine.entity import CommandSuccess  # noqa: E402
+from surge_tpu.log import GrpcLogTransport  # noqa: E402
+from surge_tpu.models import counter  # noqa: E402
+from surge_tpu.remote.node import EngineNode  # noqa: E402
+
+CFG = default_config().with_overrides({
+    "surge.producer.flush-interval-ms": 5,
+    "surge.producer.ktable-check-interval-ms": 5,
+    "surge.state-store.commit-interval-ms": 10,
+    "surge.aggregate.init-retry-interval-ms": 5,
+    "surge.engine.num-partitions": 4,
+    "surge.control-plane.ping-interval-ms": 200,
+})
+
+
+def aggs_for(name: str) -> list:
+    return [f"{name}-{i}" for i in range(12)]
+
+
+async def send_round(node: EngineNode, aggregates: list) -> dict:
+    out = {}
+    for agg in aggregates:
+        last_err = None
+        for _ in range(10):  # rebalance handoffs can fail a command transiently
+            r = await node.aggregate_for(agg).send_command(counter.Increment(agg))
+            if isinstance(r, CommandSuccess):
+                out[agg] = r.state.count
+                last_err = None
+                break
+            last_err = r
+            await asyncio.sleep(0.3)
+        if last_err is not None:
+            out[agg] = f"FAILED: {last_err}"
+    return out
+
+
+async def main() -> None:
+    cp_target, log_target, my_name, peer_name, result_path = sys.argv[1:6]
+    node = EngineNode(
+        SurgeCommandBusinessLogic(
+            aggregate_name="counter", model=counter.CounterModel(),
+            state_format=counter.state_formatting(),
+            event_format=counter.event_formatting(),
+            command_format=counter.command_formatting()),
+        cp_target, GrpcLogTransport(log_target), node_name=my_name, config=CFG)
+    await node.start()
+
+    # wait until both members are visible (so partitions are really split)
+    for _ in range(100):
+        if len(node.client.membership.members) >= 2:
+            break
+        await asyncio.sleep(0.1)
+    await asyncio.sleep(0.5)  # let regions settle after the join rebalance
+
+    result = await send_round(node, aggs_for(my_name))
+    with open(result_path + ".r1.tmp", "w") as f:
+        json.dump(result, f)
+    os.replace(result_path + ".r1.tmp", result_path + ".r1")
+
+    # idle until the driver triggers round 2 (after killing the peer)
+    while not os.path.exists(result_path + ".go2"):
+        await asyncio.sleep(0.1)
+    await asyncio.sleep(0.5)  # let expiry + rebalance settle
+
+    result = await send_round(node, aggs_for(my_name) + aggs_for(peer_name))
+    with open(result_path + ".r2.tmp", "w") as f:
+        json.dump(result, f)
+    os.replace(result_path + ".r2.tmp", result_path + ".r2")
+
+    await asyncio.Event().wait()  # stay alive until the driver kills us
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,81 @@
+"""Genuine multi-process cluster: separate OS processes, real gRPC everywhere.
+
+The reference's multi-jvm spec analog (SurgePartitionRouterImplMultiJvmSpec,
+SURVEY.md §4.6) upgraded to real processes: a broker process (shared log + control
+plane), two engine worker processes routing commands both ways over the node
+transport, then SIGKILL of one worker — heartbeat expiry must rebalance its
+partitions to the survivor, which serves the dead worker's aggregates with state
+recovered from the shared log (VERDICT r2 missing #3 done-criterion)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ,
+       "JAX_PLATFORMS": "cpu",
+       "SURGE_TEST_PLATFORM": "cpu"}
+ENV.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def _wait_file(path: str, timeout: float = 60.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {path}")
+
+
+def _spawn(args, **kw):
+    return subprocess.Popen([sys.executable, *args], cwd=REPO, env=ENV, **kw)
+
+
+def test_two_process_cluster_routes_and_survives_kill(tmp_path):
+    procs = []
+    try:
+        broker = _spawn(["tests/_cluster_broker.py", "4"],
+                        stdout=subprocess.PIPE, text=True)
+        procs.append(broker)
+        ports = json.loads(broker.stdout.readline())
+        cp = f"127.0.0.1:{ports['cp_port']}"
+        log = f"127.0.0.1:{ports['log_port']}"
+
+        res_a = str(tmp_path / "a")
+        res_b = str(tmp_path / "b")
+        worker_a = _spawn(["tests/_cluster_worker.py", cp, log, "alpha", "beta", res_a])
+        worker_b = _spawn(["tests/_cluster_worker.py", cp, log, "beta", "alpha", res_b])
+        procs += [worker_a, worker_b]
+
+        # round 1: each worker drove 12 aggregates spread over all partitions —
+        # with two members each owning 2 of 4 partitions, some commands crossed
+        # processes over the node transport in each direction
+        r1_a = _wait_file(res_a + ".r1")
+        r1_b = _wait_file(res_b + ".r1")
+        assert all(c == 1 for c in r1_a.values()), r1_a
+        assert all(c == 1 for c in r1_b.values()), r1_b
+
+        # kill worker B without ceremony: heartbeat expiry must hand its
+        # partitions to A, which then serves BOTH aggregate sets (B's state
+        # recovered from the shared log broker)
+        worker_b.send_signal(signal.SIGKILL)
+        worker_b.wait(10)
+        open(res_a + ".go2", "w").close()
+        r2 = _wait_file(res_a + ".r2", timeout=90.0)
+        for agg in [f"alpha-{i}" for i in range(12)]:
+            assert r2[agg] == 2, (agg, r2[agg])
+        for agg in [f"beta-{i}" for i in range(12)]:
+            assert r2[agg] == 2, (agg, r2[agg])  # 1 from B pre-kill + 1 now
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(5)
+            except Exception:  # noqa: BLE001
+                pass
