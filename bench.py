@@ -46,11 +46,16 @@ def acquire_backend():
     """
     attempts = int(os.environ.get("SURGE_BENCH_BACKEND_ATTEMPTS", 5))
     backoff_s = float(os.environ.get("SURGE_BENCH_BACKEND_BACKOFF_S", 60))
+    # one tunneled bring-up ATTEMPT has been observed to take ~25 minutes before
+    # failing UNAVAILABLE — a wall-clock deadline bounds total acquisition time so
+    # retries cannot eat the whole bench window before the CPU fallback runs
+    deadline_s = float(os.environ.get("SURGE_BENCH_BACKEND_DEADLINE_S", 2400))
 
     import jax
 
     from jax.extend.backend import clear_backends
 
+    t_start = time.monotonic()
     last_err = None
     for attempt in range(1, attempts + 1):
         try:
@@ -59,13 +64,17 @@ def acquire_backend():
             return jax, devices
         except Exception as err:
             last_err = err
-            log(f"backend attempt {attempt}/{attempts} failed: {err}")
-            if attempt < attempts:
+            elapsed = time.monotonic() - t_start
+            log(f"backend attempt {attempt}/{attempts} failed after "
+                f"{elapsed:.0f}s total: {err}")
+            if attempt < attempts and elapsed + backoff_s < deadline_s:
                 # a failed bring-up can leave partially-initialized backends cached
                 # (e.g. cpu registered before the tpu factory raised) — clear so the
                 # next attempt genuinely re-initializes the target platform
                 clear_backends()
                 time.sleep(backoff_s)
+            else:
+                break
 
     log(f"giving up on the default platform, falling back to cpu: {last_err}")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
