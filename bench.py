@@ -1,31 +1,38 @@
 #!/usr/bin/env python
 """North-star benchmark: cold replay of a ragged event log (BASELINE.md targets).
 
-Phase 1 (replay): builds a 1M-aggregate / 100M-event counter corpus columnar-side (no
-Python event objects), measures the scalar CPU fold baseline on a stratified sample
-(the reference's Kafka Streams restore is exactly this per-aggregate scalar fold,
-SURVEY.md §3.3), then runs the batched TPU replay over the full corpus and verifies
-every folded state against the closed-form expected result.
+Structured so the driver's window can NEVER expire with zero data (VERDICT r3 #1):
 
-Phase 2 (steady state): p50/p99 send_command latency and commands/sec through the full
-engine (router → entity → transactional publisher with the reference's 50 ms flush
-tick → durable FileLog with fsync-on-commit) — the second BASELINE.md target; the
-reference's envelope is flush-interval + txn commit.
+1. The parent process forces itself onto the host CPU platform (it never touches the
+   tunneled TPU backend) and builds the 1M-aggregate / 100M-event counter corpus
+   columnar-side, saving it to disk for the replay children.
+2. The scalar CPU fold baseline (the reference's Kafka Streams restore is exactly this
+   per-aggregate scalar fold, SURVEY.md §3.3) and the phase-2 steady-state command
+   latency (p50/p99/commands-per-sec through the full engine with the reference's
+   50 ms flush tick and fsync-on-commit FileLog) are measured first — neither needs
+   any accelerator.
+3. A CPU-JAX replay child measures the batched fold on the host platform and a
+   PROVISIONAL result line is printed immediately (platform honestly "cpu").
+4. ONE patient TPU attempt runs as a child with the original environment. It is never
+   timeout-killed (a killed claimer wedges the axon pool); if it succeeds, the final
+   result line is re-emitted with the TPU numbers. Last line wins for the driver.
 
-Prints ONE JSON line to stdout:
+Prints one JSON line per completed stage to stdout (the last is authoritative):
     {"metric": "cold_replay_events_per_sec", "value": N, "unit": "events/s",
-     "vs_baseline": <speedup over the scalar CPU fold>,
-     "command_p50_ms": ..., "command_p99_ms": ..., "commands_per_sec": ...}
+     "vs_baseline": <speedup over the scalar CPU fold>, "platform": ...,
+     "pad_ratio": ..., "pack_s": ..., "command_p50_ms": ..., ...}
 
 Env knobs: SURGE_BENCH_AGGREGATES (1_000_000), SURGE_BENCH_EVENTS (100_000_000),
 SURGE_BENCH_CPU_SAMPLE (200_000 events), SURGE_BENCH_TIME_CHUNK, SURGE_BENCH_BATCH,
-SURGE_BENCH_LATENCY_SECONDS (5; 0 skips phase 2), SURGE_BENCH_LATENCY_WORKERS (64).
+SURGE_BENCH_LATENCY_SECONDS (5; 0 skips phase 2), SURGE_BENCH_LATENCY_WORKERS (64),
+SURGE_BENCH_SKIP_CPU_REPLAY (0), SURGE_BENCH_TPU (1; 0 skips the TPU attempt).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -36,64 +43,161 @@ def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def acquire_backend():
-    """Bounded-retry backend bring-up with CPU fallback (VERDICT r2 weak #1).
+#: the last payload printed to stdout — the terminal failure handler re-emits this
+#: (with the error attached) so a late crash can never clobber a measured result
+#: with a value-0 line under the driver's last-line-wins parse
+_last_printed: dict | None = None
 
-    The tunneled TPU backend can be transiently UNAVAILABLE; one hiccup must not cost
-    the round's only data point. Retry acquisition (jax re-attempts init while no
-    backend exists), then fall back to the host CPU platform so the bench still emits
-    a real measured number with the platform honestly reported.
-    """
-    attempts = int(os.environ.get("SURGE_BENCH_BACKEND_ATTEMPTS", 5))
-    backoff_s = float(os.environ.get("SURGE_BENCH_BACKEND_BACKOFF_S", 60))
-    # one tunneled bring-up ATTEMPT has been observed to take ~25 minutes before
-    # failing UNAVAILABLE — a wall-clock deadline bounds total acquisition time so
-    # retries cannot eat the whole bench window before the CPU fallback runs
-    deadline_s = float(os.environ.get("SURGE_BENCH_BACKEND_DEADLINE_S", 2400))
 
+def emit(payload: dict) -> None:
+    global _last_printed
+    _last_printed = dict(payload)
+    print(json.dumps(payload), flush=True)
+
+
+def _cpu_env(env: dict) -> dict:
+    """A copy of ``env`` pinned to the host CPU platform. Unsetting
+    PALLAS_AXON_POOL_IPS is required — it is what makes sitecustomize register the
+    tunneled backend; JAX_PLATFORMS alone does not prevent the claim."""
+    out = dict(env)
+    out.pop("PALLAS_AXON_POOL_IPS", None)
+    out.pop("AXON_POOL_IPS", None)
+    out["JAX_PLATFORMS"] = "cpu"
+    return out
+
+
+# --------------------------------------------------------------------------------------
+# corpus on disk (parent writes once; replay children mmap)
+# --------------------------------------------------------------------------------------
+
+_CORPUS_FILES = ("agg_idx", "type_ids", "increment_by", "decrement_by",
+                 "lengths", "expected_count", "expected_version")
+
+
+def save_corpus(corpus, root: str) -> None:
+    ev = corpus.events
+    arrays = {
+        "agg_idx": ev.agg_idx, "type_ids": ev.type_ids,
+        "increment_by": ev.cols["increment_by"],
+        "decrement_by": ev.cols["decrement_by"],
+        "lengths": corpus.lengths, "expected_count": corpus.expected_count,
+        "expected_version": corpus.expected_version,
+    }
+    for name in _CORPUS_FILES:
+        np.save(os.path.join(root, f"{name}.npy"), arrays[name])
+
+
+def load_corpus(root: str):
+    from surge_tpu.codec.tensor import ColumnarEvents
+    from surge_tpu.replay.corpus import CounterCorpus
+
+    a = {name: np.load(os.path.join(root, f"{name}.npy"), mmap_mode="r")
+         for name in _CORPUS_FILES}
+    events = ColumnarEvents(
+        num_aggregates=int(a["lengths"].shape[0]), agg_idx=a["agg_idx"],
+        type_ids=a["type_ids"],
+        cols={"increment_by": a["increment_by"], "decrement_by": a["decrement_by"]},
+        derived_cols={"sequence_number": "ordinal"})
+    return CounterCorpus(events=events, lengths=a["lengths"],
+                         expected_count=a["expected_count"],
+                         expected_version=a["expected_version"])
+
+
+# --------------------------------------------------------------------------------------
+# replay child: one backend, one measured replay, one JSON line on stdout
+# --------------------------------------------------------------------------------------
+
+def replay_child(corpus_dir: str) -> None:
     import jax
 
-    from jax.extend.backend import clear_backends
+    devices = jax.devices()  # ONE attempt; parent decides platform via env
+    platform = devices[0].platform
+    log(f"child backend up: platform={platform} devices={devices}")
 
-    t_start = time.monotonic()
-    last_err = None
-    for attempt in range(1, attempts + 1):
-        try:
-            devices = jax.devices()
-            log(f"backend up on attempt {attempt}: {devices}")
-            return jax, devices
-        except Exception as err:
-            last_err = err
-            elapsed = time.monotonic() - t_start
-            log(f"backend attempt {attempt}/{attempts} failed after "
-                f"{elapsed:.0f}s total: {err}")
-            if attempt < attempts and elapsed + backoff_s < deadline_s:
-                # a failed bring-up can leave partially-initialized backends cached
-                # (e.g. cpu registered before the tpu factory raised) — clear so the
-                # next attempt genuinely re-initializes the target platform
-                clear_backends()
-                time.sleep(backoff_s)
-            else:
-                break
+    from surge_tpu.config import default_config
+    from surge_tpu.models.counter import make_replay_spec
+    from surge_tpu.replay.corpus import synth_counter_corpus
+    from surge_tpu.replay.engine import ReplayEngine
 
-    log(f"giving up on the default platform, falling back to cpu: {last_err}")
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-    os.environ.pop("AXON_POOL_IPS", None)
-    clear_backends()
-    jax.config.update("jax_platforms", "cpu")
-    devices = jax.devices()  # raises only if even the host CPU platform is broken
-    return jax, devices
+    time_chunk = int(os.environ.get("SURGE_BENCH_TIME_CHUNK", 128))
+    batch_size = int(os.environ.get("SURGE_BENCH_BATCH", 8192))
+    corpus = load_corpus(corpus_dir)
 
+    cfg = default_config().with_overrides({
+        "surge.replay.batch-size": batch_size,
+        "surge.replay.time-chunk": time_chunk,
+    })
+    engine = ReplayEngine(make_replay_spec(), config=cfg)
+
+    # warm up the compiled programs on a small synthetic corpus (fixed shapes)
+    warm = synth_counter_corpus(min(batch_size, corpus.num_aggregates),
+                                min(batch_size * 4, corpus.num_events), seed=1)
+    engine.replay_columnar(warm.events)
+    engine.stats.update(pack_s=0.0, h2d_s=0.0, windows=0)
+    log(f"child warmup done, compiled programs: {engine.num_compiles()}")
+
+    t0 = time.perf_counter()
+    result = engine.replay_columnar(corpus.events)
+    replay_s = time.perf_counter() - t0
+
+    if not np.array_equal(result.states["count"], corpus.expected_count):
+        raise AssertionError("replay count mismatch vs closed-form fold")
+    if not np.array_equal(result.states["version"], corpus.expected_version):
+        raise AssertionError("replay version mismatch vs closed-form fold")
+    if result.num_events != corpus.num_events:
+        raise AssertionError("replay event accounting mismatch")
+
+    eps = corpus.num_events / replay_s
+    payload = {
+        "platform": platform,
+        "events_per_sec": round(eps),
+        "aggregates_per_sec": round(corpus.num_aggregates / replay_s),
+        "replay_s": round(replay_s, 2),
+        "pad_ratio": round(result.padded_events / max(corpus.num_events, 1), 3),
+        "pack_s": round(engine.stats["pack_s"], 2),
+        "h2d_s": round(engine.stats["h2d_s"], 2),
+        "windows": engine.stats["windows"],
+        "compiles": engine.num_compiles(),
+        "num_events": corpus.num_events,
+        "num_aggregates": corpus.num_aggregates,
+    }
+    log(f"child replay: {corpus.num_events:,} events in {replay_s:.2f}s -> "
+        f"{eps:,.0f} events/s (pad {payload['pad_ratio']}, pack {payload['pack_s']}s, "
+        f"{payload['windows']} windows, {payload['compiles']} programs, verified)")
+    print(json.dumps(payload), flush=True)
+
+
+def run_replay_child(env: dict, corpus_dir: str, label: str) -> dict | None:
+    """Run one replay child to completion (NO timeout — a killed claimer wedges the
+    axon pool for hours; the driver owns the overall deadline and the provisional
+    result line is already on stdout before any TPU attempt starts)."""
+    log(f"starting {label} replay child")
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--replay-child", corpus_dir],
+        env=env, stdout=subprocess.PIPE, text=True)
+    elapsed = time.perf_counter() - t0
+    if proc.returncode != 0:
+        log(f"{label} replay child failed rc={proc.returncode} after {elapsed:.0f}s")
+        return None
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    log(f"{label} replay child done in {elapsed:.0f}s: "
+        f"{out['events_per_sec']:,} events/s on {out['platform']}")
+    return out
+
+
+# --------------------------------------------------------------------------------------
+# phase 2: steady-state command latency (no accelerator involved)
+# --------------------------------------------------------------------------------------
 
 def steady_state_latency(seconds: float) -> dict:
-    """Phase 2: the full command path on one node, reference-default envelope.
-
-    Concurrent per-aggregate workers issue sequential Increment commands through
+    """The full command path on one node, reference-default envelope: concurrent
+    per-aggregate workers issue sequential Increment commands through
     ``aggregate_for().send_command`` against a FileLog (fsync on commit) with the
     50 ms flush tick, so each command's latency = handling + wait-for-tick + one
     durable transaction commit — directly comparable to the reference's
-    flush-interval + Kafka txn commit envelope (core reference.conf:20-21).
-    """
+    flush-interval + Kafka txn commit envelope (core reference.conf:20-21)."""
     import asyncio
     import shutil
     import tempfile
@@ -112,13 +216,13 @@ def steady_state_latency(seconds: float) -> dict:
     root = tempfile.mkdtemp(prefix="surge-bench-latency-")
 
     async def scenario() -> dict:
-        log = FileLog(os.path.join(root, "log"))
+        flog = FileLog(os.path.join(root, "log"))
         engine = create_engine(
             SurgeCommandBusinessLogic(
                 aggregate_name="counter", model=counter.CounterModel(),
                 state_format=counter.state_formatting(),
                 event_format=counter.event_formatting()),
-            log=log, config=default_config())
+            log=flog, config=default_config())
         await engine.start()
 
         latencies: list = []
@@ -141,7 +245,7 @@ def steady_state_latency(seconds: float) -> dict:
         await asyncio.gather(*(worker(i, t0 + seconds) for i in range(workers)))
         elapsed = time.perf_counter() - t0
         await engine.stop()
-        log.close()
+        flog.close()
 
         lat_ms = sorted(1000.0 * x for x in latencies)
         n = len(lat_ms)
@@ -160,131 +264,151 @@ def steady_state_latency(seconds: float) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+# --------------------------------------------------------------------------------------
+# parent orchestration
+# --------------------------------------------------------------------------------------
+
+def _merge_replay(payload: dict, child: dict, cpu_eps: float) -> None:
+    payload["value"] = child["events_per_sec"]
+    payload["vs_baseline"] = round(child["events_per_sec"] / cpu_eps, 2) if cpu_eps else 0
+    for k in ("platform", "aggregates_per_sec", "replay_s", "pad_ratio", "pack_s",
+              "h2d_s", "windows", "compiles"):
+        payload[k] = child[k]
+
+
 def main() -> None:
+    orig_env = dict(os.environ)
+    # the parent NEVER initializes the tunneled backend — pin it to the host CPU
+    # before any jax-importing module loads
+    os.environ.update(_cpu_env(orig_env))
+    for k in ("PALLAS_AXON_POOL_IPS", "AXON_POOL_IPS"):
+        os.environ.pop(k, None)
+
     num_aggregates = int(os.environ.get("SURGE_BENCH_AGGREGATES", 1_000_000))
     num_events = int(os.environ.get("SURGE_BENCH_EVENTS", 100_000_000))
     cpu_sample_events = int(os.environ.get("SURGE_BENCH_CPU_SAMPLE", 200_000))
-    time_chunk = int(os.environ.get("SURGE_BENCH_TIME_CHUNK", 128))
-    batch_size = int(os.environ.get("SURGE_BENCH_BATCH", 8192))
 
-    jax, devices = acquire_backend()
+    import shutil
+    import tempfile
 
-    from surge_tpu.config import default_config
     from surge_tpu.engine.model import fold_events
-    from surge_tpu.models.counter import CounterModel, make_replay_spec
+    from surge_tpu.models.counter import CounterModel
     from surge_tpu.replay.corpus import decode_sample, sample_indices, synth_counter_corpus
-    from surge_tpu.replay.engine import ReplayEngine
 
-    platform = devices[0].platform
-    log(f"platform={platform} devices={devices}")
+    payload: dict = {"metric": "cold_replay_events_per_sec", "value": 0,
+                     "unit": "events/s", "vs_baseline": 0}
 
     t0 = time.perf_counter()
     corpus = synth_counter_corpus(num_aggregates, num_events, seed=42,
                                   sort_by_length=True)
+    build_s = time.perf_counter() - t0
     log(f"corpus: {corpus.num_aggregates} aggregates, {corpus.num_events} events, "
-        f"{corpus.events.nbytes() / 1e9:.2f} GB columnar "
-        f"({time.perf_counter() - t0:.1f}s)")
+        f"{corpus.events.nbytes() / 1e9:.2f} GB columnar ({build_s:.1f}s)")
+    payload.update(num_events=corpus.num_events, num_aggregates=corpus.num_aggregates,
+                   corpus_build_s=round(build_s, 1))
 
-    # -- scalar CPU fold baseline (the reference restore path) ------------------------
-    idx = sample_indices(corpus, cpu_sample_events)
-    logs = decode_sample(corpus, idx)
-    n_sample = sum(len(l) for l in logs)
-    model = CounterModel()
-    t0 = time.perf_counter()
-    folded = [fold_events(model, None, events) for events in logs]
-    cpu_s = time.perf_counter() - t0
-    cpu_eps = n_sample / cpu_s
-    # golden cross-check: the scalar fold must agree with the closed-form expectation
-    for j, state in zip(idx, folded):
-        expect_c, expect_v = int(corpus.expected_count[j]), int(corpus.expected_version[j])
-        got_c = state.count if state is not None else 0
-        got_v = state.version if state is not None else 0
-        if got_c != expect_c or got_v != expect_v:
-            raise AssertionError(
-                f"scalar fold mismatch at aggregate {j}: "
-                f"({got_c},{got_v}) != ({expect_c},{expect_v})")
-    log(f"cpu baseline: {n_sample} events over {len(logs)} aggregates in {cpu_s:.2f}s "
-        f"-> {cpu_eps:,.0f} events/s (verified)")
-
-    # -- batched TPU replay ------------------------------------------------------------
-    cfg = default_config().with_overrides({
-        "surge.replay.batch-size": batch_size,
-        "surge.replay.time-chunk": time_chunk,
-    })
-    engine = ReplayEngine(make_replay_spec(), config=cfg)
-
-    # warm up the one compiled program (shapes are fixed [time_chunk, batch_size])
-    warm = synth_counter_corpus(min(batch_size, num_aggregates),
-                                min(batch_size * 4, num_events), seed=1)
-    engine.replay_columnar(warm.events)
-    log(f"warmup done, compiled programs: {engine.num_compiles()}")
-
-    t0 = time.perf_counter()
-    result = engine.replay_columnar(corpus.events)
-    replay_s = time.perf_counter() - t0
-    eps = corpus.num_events / replay_s
-    aps = corpus.num_aggregates / replay_s
-
-    if not np.array_equal(result.states["count"], corpus.expected_count):
-        raise AssertionError("replay count mismatch vs closed-form fold")
-    if not np.array_equal(result.states["version"], corpus.expected_version):
-        raise AssertionError("replay version mismatch vs closed-form fold")
-    if result.num_events != corpus.num_events:
-        raise AssertionError("replay event accounting mismatch")
-
-    speedup = eps / cpu_eps
-    pad_ratio = result.padded_events / max(corpus.num_events, 1)
-    log(f"replay: {corpus.num_events:,} events / {corpus.num_aggregates:,} aggregates "
-        f"in {replay_s:.2f}s -> {eps:,.0f} events/s, {aps:,.0f} aggregates/s "
-        f"(pad ratio {pad_ratio:.2f}, compiles {engine.num_compiles()}, verified)")
-    log(f"speedup vs scalar CPU fold: {speedup:.1f}x (target >=50x)")
-
-    payload = {
-        "metric": "cold_replay_events_per_sec",
-        "value": round(eps),
-        "unit": "events/s",
-        "vs_baseline": round(speedup, 2),
-        "aggregates_per_sec": round(aps),
-        "cpu_baseline_events_per_sec": round(cpu_eps),
-        "num_events": corpus.num_events,
-        "num_aggregates": corpus.num_aggregates,
-        "pad_ratio": round(pad_ratio, 3),
-        "platform": platform,
-    }
-
+    corpus_dir = tempfile.mkdtemp(prefix="surge-bench-corpus-")
     try:
-        latency_seconds = float(os.environ.get("SURGE_BENCH_LATENCY_SECONDS", 5))
-    except ValueError:
-        latency_seconds = 0.0
-        payload["latency_error"] = "unparseable SURGE_BENCH_LATENCY_SECONDS"
-    if latency_seconds > 0:
-        try:
-            stats = steady_state_latency(latency_seconds)
-            log(f"steady state: p50 {stats['command_p50_ms']}ms, "
-                f"p99 {stats['command_p99_ms']}ms, "
-                f"{stats['commands_per_sec']} commands/s "
-                f"({stats['latency_workers']} workers, "
-                f"{stats['flush_interval_ms']}ms flush, fsync commit)")
-            payload.update(stats)
-        except Exception as exc:  # noqa: BLE001 — phase 2 must not void phase 1
-            log(f"steady-state latency phase failed: {exc!r}")
-            payload["latency_error"] = f"{type(exc).__name__}: {exc}"
+        t0 = time.perf_counter()
+        save_corpus(corpus, corpus_dir)
+        log(f"corpus saved to {corpus_dir} ({time.perf_counter() - t0:.1f}s)")
 
-    print(json.dumps(payload), flush=True)
+        # -- scalar CPU fold baseline (the reference restore path) --------------------
+        idx = sample_indices(corpus, cpu_sample_events)
+        logs = decode_sample(corpus, idx)
+        n_sample = sum(len(l) for l in logs)
+        model = CounterModel()
+        t0 = time.perf_counter()
+        folded = [fold_events(model, None, events) for events in logs]
+        cpu_s = time.perf_counter() - t0
+        cpu_eps = n_sample / cpu_s
+        # golden cross-check: scalar fold must agree with the closed-form expectation
+        for j, state in zip(idx, folded):
+            expect = (int(corpus.expected_count[j]), int(corpus.expected_version[j]))
+            got = (state.count, state.version) if state is not None else (0, 0)
+            if got != expect:
+                raise AssertionError(
+                    f"scalar fold mismatch at aggregate {j}: {got} != {expect}")
+        log(f"cpu baseline: {n_sample} events over {len(logs)} aggregates in "
+            f"{cpu_s:.2f}s -> {cpu_eps:,.0f} events/s (verified)")
+        payload["cpu_baseline_events_per_sec"] = round(cpu_eps)
+
+        # -- phase 2: steady-state latency (no accelerator) ---------------------------
+        try:
+            latency_seconds = float(os.environ.get("SURGE_BENCH_LATENCY_SECONDS", 5))
+        except ValueError:
+            latency_seconds = 0.0
+            payload["latency_error"] = "unparseable SURGE_BENCH_LATENCY_SECONDS"
+        if latency_seconds > 0:
+            try:
+                stats = steady_state_latency(latency_seconds)
+                log(f"steady state: p50 {stats['command_p50_ms']}ms, "
+                    f"p99 {stats['command_p99_ms']}ms, "
+                    f"{stats['commands_per_sec']} commands/s")
+                payload.update(stats)
+            except Exception as exc:  # noqa: BLE001 — phase 2 must not void phase 1
+                log(f"steady-state latency phase failed: {exc!r}")
+                payload["latency_error"] = f"{type(exc).__name__}: {exc}"
+
+        # the corpus lives on disk now; free the ~1.6 GB in-memory copy (and the
+        # decoded sample) before replay children map the same data
+        del corpus, logs, folded
+
+        # -- CPU-JAX batched replay (provisional headline) ----------------------------
+        if os.environ.get("SURGE_BENCH_SKIP_CPU_REPLAY", "0") != "1":
+            cpu_child = run_replay_child(_cpu_env(orig_env), corpus_dir, "cpu")
+            if cpu_child is not None:
+                _merge_replay(payload, cpu_child, cpu_eps)
+                payload["cpu_jax_events_per_sec"] = cpu_child["events_per_sec"]
+            else:
+                payload["cpu_replay_error"] = "cpu replay child failed (see stderr)"
+        # PROVISIONAL line: from here on the round has a real measured number no
+        # matter what the TPU attempt does (last line wins for the driver)
+        emit(payload)
+
+        # -- ONE patient TPU attempt (never killed) -----------------------------------
+        tpu_possible = (orig_env.get("PALLAS_AXON_POOL_IPS")
+                        or orig_env.get("AXON_POOL_IPS")
+                        or orig_env.get("JAX_PLATFORMS", "") not in ("", "cpu"))
+        if os.environ.get("SURGE_BENCH_TPU", "1") == "1" and tpu_possible:
+            tpu_child = run_replay_child(dict(orig_env), corpus_dir, "tpu")
+            if tpu_child is not None and tpu_child["platform"] != "cpu":
+                _merge_replay(payload, tpu_child, cpu_eps)
+                log(f"speedup vs scalar CPU fold: {payload['vs_baseline']}x "
+                    f"(target >=50x)")
+                emit(payload)
+            elif tpu_child is not None:
+                log("tpu child came up on cpu; keeping provisional result")
+            else:
+                payload["tpu_error"] = "tpu replay child failed (see stderr)"
+                emit(payload)
+        elif not tpu_possible:
+            log("no accelerator platform configured in the environment; done")
+    finally:
+        shutil.rmtree(corpus_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--replay-child":
+        try:
+            replay_child(sys.argv[2])
+        except BaseException:
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            sys.exit(1)
+        sys.exit(0)
     try:
         main()
     except BaseException as err:  # terminal failure must still emit one JSON line
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        print(json.dumps({
-            "metric": "cold_replay_events_per_sec",
-            "value": 0,
-            "unit": "events/s",
-            "vs_baseline": 0,
-            "error": f"{type(err).__name__}: {err}",
-        }), flush=True)
+        # never clobber an already-measured result with a value-0 line: re-emit the
+        # last printed payload with the error attached (last line wins)
+        final = dict(_last_printed) if _last_printed else {
+            "metric": "cold_replay_events_per_sec", "value": 0,
+            "unit": "events/s", "vs_baseline": 0}
+        final["error"] = f"{type(err).__name__}: {err}"
+        print(json.dumps(final), flush=True)
         sys.exit(1)
