@@ -296,7 +296,15 @@ class SurgeEngine(Controllable):
         if segment_path:
             result = await asyncio.get_running_loop().run_in_executor(
                 None, lambda: self._rebuild_from_segment(segment_path, spec, mesh))
-            if result.watermarks:  # snapshot-carrying segment: no state-topic scan
+            if result.watermarks:  # snapshot-carrying segment: no full state scan
+                # Segment states are BUILD-time states. Wherever the indexer has
+                # already advanced past the build watermark (warm rebuild, or the
+                # tail loop ran concurrently with the restore), those snapshots
+                # will never be re-read after prime()'s max() — re-apply exactly
+                # that window so the restore cannot revert the store to stale
+                # values (advisor r3 finding #2). Cold starts have watermark 0
+                # everywhere and skip this entirely.
+                self._replay_state_window(result.watermarks)
                 self.indexer.prime(result.watermarks)
             else:  # segment built without a state topic: overlay + prime at now
                 self._overlay_snapshots_and_prime()
@@ -316,6 +324,25 @@ class SurgeEngine(Controllable):
         logger.info("rebuild_from_events: %d aggregates from %d events via %s",
                     result.num_aggregates, result.num_events, result.backend)
         return result
+
+    def _replay_state_window(self, build_watermarks: Dict[int, int]) -> None:
+        """Re-apply state-topic records in [build watermark, current indexer
+        watermark) per partition — the window a segment restore just clobbered and
+        the tail loop will not revisit. Latest-wins with tombstone deletes, same
+        as the indexer's own apply path."""
+        store = self.indexer.store
+        for p in range(self.num_partitions):
+            start = build_watermarks.get(p, 0)
+            current = self.indexer.indexed_watermark(self.logic.state_topic, p)
+            if current <= start:
+                continue
+            for r in self.log.read(self.logic.state_topic, p, start):
+                if r.offset >= current or r.key is None:
+                    continue
+                if r.value is None:
+                    store.delete(r.key)
+                else:
+                    store.put(r.key, r.value)
 
     def _overlay_snapshots_and_prime(self) -> None:
         """Overlay the state topic's latest snapshot per key and prime the indexer

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from typing import Dict, List, Mapping, Optional, Sequence
 
 import grpc
@@ -40,16 +41,32 @@ def _raise_for(reply: pb.TxnReply) -> None:
 
 
 class GrpcTxnProducer:
-    """Client half of a server-side transactional producer (one token)."""
+    """Client half of a server-side transactional producer (one token).
+
+    Commits are idempotent over the wire: every commit/send_immediate carries a
+    per-token sequence number, and a lost reply is retried with the SAME number —
+    the server answers a replayed sequence from its cached reply instead of
+    appending the transaction twice (the Kafka idempotent-producer role,
+    KafkaProducerActorImpl.scala:161-165 `enable.idempotence`).
+    """
 
     def __init__(self, transport: "GrpcLogTransport", token: int) -> None:
         self._transport = transport
         self._token = token
         self._buffer: Optional[List[LogRecord]] = None
         self._fenced = False
+        self._next_seq = 1
 
     @property
     def fenced(self) -> bool:
+        """Whether this producer has observed itself fenced.
+
+        Lazy, unlike InMemoryTxnProducer: it flips only after an operation
+        fails with ``error_kind="fenced"`` — a proactive poll can read a stale
+        False until the next wire operation. The publisher FSM only consults it
+        after a failed publish, where the two contracts agree; callers needing
+        a fresh answer should attempt an operation rather than poll this.
+        """
         return self._fenced
 
     @property
@@ -70,9 +87,11 @@ class GrpcTxnProducer:
         if self._buffer is None:
             raise TransactionStateError("no open transaction")
         records, self._buffer = self._buffer, None
-        reply = self._transport._transact(self._token, "commit", records)
+        reply = self._transport._transact(self._token, "commit", records,
+                                          seq=self._next_seq)
         self._check_fence(reply)
         _raise_for(reply)
+        self._next_seq += 1
         return [msg_to_record(m) for m in reply.records]
 
     def abort(self) -> None:
@@ -81,9 +100,11 @@ class GrpcTxnProducer:
         self._buffer = None  # records never left this process
 
     def send_immediate(self, record: LogRecord) -> LogRecord:
-        reply = self._transport._transact(self._token, "send_immediate", [record])
+        reply = self._transport._transact(self._token, "send_immediate",
+                                          [record], seq=self._next_seq)
         self._check_fence(reply)
         _raise_for(reply)
+        self._next_seq += 1
         return msg_to_record(reply.records[0])
 
     def _check_fence(self, reply: pb.TxnReply) -> None:
@@ -144,11 +165,28 @@ class GrpcLogTransport:
             pb.OpenProducerRequest(transactional_id=transactional_id))
         return GrpcTxnProducer(self, reply.producer_token)
 
-    def _transact(self, token: int, op: str,
-                  records: Sequence[LogRecord]) -> pb.TxnReply:
-        return self._calls["Transact"](pb.TxnRequest(
-            producer_token=token, op=op,
-            records=[record_to_msg(r) for r in records]))
+    def _transact(self, token: int, op: str, records: Sequence[LogRecord],
+                  seq: int = 0, attempts: int = 4) -> pb.TxnReply:
+        request = pb.TxnRequest(
+            producer_token=token, op=op, txn_seq=seq,
+            records=[record_to_msg(r) for r in records])
+        backoff = 0.05
+        for attempt in range(attempts):
+            try:
+                return self._calls["Transact"](request)
+            except grpc.RpcError as exc:
+                # Reply loss / transient broker unavailability: retry the SAME
+                # txn_seq so a commit the server did apply is answered from its
+                # dedup cache, not appended again. Anything non-transient (or
+                # seq-less ops, which we cannot safely replay) propagates.
+                code = exc.code() if hasattr(exc, "code") else None
+                transient = code in (grpc.StatusCode.UNAVAILABLE,
+                                     grpc.StatusCode.DEADLINE_EXCEEDED)
+                if not seq or not transient or attempt == attempts - 1:
+                    raise
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.4)
+        raise RuntimeError("unreachable")
 
     # -- reads ----------------------------------------------------------------------------
 

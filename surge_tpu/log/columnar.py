@@ -338,23 +338,41 @@ def build_segment_from_topic(log, topic: str, registry, deserialize_event,
     no state-topic scan (the Kafka Streams restore equivalent,
     AggregateStateStoreKafkaStreams.scala:53-178, performed once at build).
     """
+    import os
+    import shutil
+    import tempfile
+
     from surge_tpu.codec.tensor import encode_events_columnar
     from surge_tpu.serialization import SerializedMessage
 
     if partitions is None:
         partitions = range(log.num_partitions(topic))
     partitions = list(partitions)
-    logs: dict[str, list] = {}
+
+    def scan(p: int):
+        """Page through one partition so a 100M-event topic never materializes
+        as one Python list (restore-consumer-max-poll-records role,
+        common reference.conf:198-199)."""
+        offset = 0
+        while True:
+            batch = log.read(topic, p, from_offset=offset, max_records=10_000)
+            if not batch:
+                return
+            for r in batch:
+                if r.key is not None and r.value is not None:
+                    yield r
+            offset = batch[-1].offset + 1
+
+    # Pass 1: key census only — O(num_aggregates) memory, no event objects.
+    keys: set[str] = set()
     watermarks: dict[str, int] = {}
     for p in partitions:
-        for r in log.read(topic, p):
-            if r.key is None or r.value is None:
-                continue
-            ev = deserialize_event(SerializedMessage(key=r.key, value=r.value))
-            if encode_event is not None:
-                ev = encode_event(ev)
-            logs.setdefault(r.key, []).append(ev)
+        for r in scan(p):
+            keys.add(r.key)
         watermarks[str(p)] = log.end_offset(topic, p)
+    ordered = sorted(keys)
+    chunk_of = {k: i // chunk_aggregates for i, k in enumerate(ordered)}
+    num_chunks = (len(ordered) + chunk_aggregates - 1) // chunk_aggregates
 
     extra: dict = {"topic": topic, "watermarks": watermarks}
     snapshots: list[tuple] = []
@@ -362,23 +380,66 @@ def build_segment_from_topic(log, topic: str, registry, deserialize_event,
         state_watermarks: dict[str, int] = {}
         for p in range(log.num_partitions(state_topic)):
             for key, rec in log.latest_by_key(state_topic, p).items():
-                if key not in logs and rec.value:
+                if key not in keys and rec.value:
                     snapshots.append((key, rec.value))
             state_watermarks[str(p)] = log.end_offset(state_topic, p)
         extra["state_topic"] = state_topic
         extra["state_watermarks"] = state_watermarks
 
-    ordered = sorted(logs)
-    with ColumnarSegmentWriter(path, extra_header=extra) as writer:
-        for start in range(0, max(len(ordered), 1), chunk_aggregates):
-            chunk_ids = ordered[start: start + chunk_aggregates]
-            colev = encode_events_columnar(registry, [logs[a] for a in chunk_ids])
-            if derived_cols:
-                _drop_derived(colev, derived_cols)
-            colev.aggregate_ids = list(chunk_ids)
-            writer.append(colev)
-            if not chunk_ids:
-                break
-        if snapshots:
-            writer.append_snapshots(snapshots)
+    # Pass 2: spill each record's raw bytes into its chunk-range file, then
+    # encode one chunk at a time — peak footprint is ONE chunk's events plus the
+    # key census, not the whole corpus (advisor r3 finding #4). Per-key event
+    # order is preserved: a key lives in one partition and each partition is
+    # scanned in offset order.
+    spill_dir = tempfile.mkdtemp(prefix=".scol-build-",
+                                 dir=os.path.dirname(path) or ".")
+    try:
+        spills = [open(os.path.join(spill_dir, f"c{i}"), "wb", buffering=1 << 20)
+                  for i in range(num_chunks)]
+        try:
+            for p in partitions:
+                for r in scan(p):
+                    kb = r.key.encode()
+                    frame = bytearray()
+                    seg._put_uvarint(frame, len(kb))
+                    frame += kb
+                    seg._put_uvarint(frame, len(r.value))
+                    frame += r.value
+                    spills[chunk_of[r.key]].write(frame)
+        finally:
+            for f in spills:
+                f.close()
+
+        def chunk_events(i: int, chunk_ids: list) -> list:
+            with open(os.path.join(spill_dir, f"c{i}"), "rb") as f:
+                data = f.read()
+            by_key: dict[str, list] = {k: [] for k in chunk_ids}
+            pos = 0
+            while pos < len(data):
+                klen, pos = seg._get_uvarint(data, pos)
+                key = data[pos: pos + klen].decode()
+                pos += klen
+                vlen, pos = seg._get_uvarint(data, pos)
+                ev = deserialize_event(SerializedMessage(
+                    key=key, value=data[pos: pos + vlen]))
+                pos += vlen
+                if encode_event is not None:
+                    ev = encode_event(ev)
+                by_key[key].append(ev)
+            return [by_key[a] for a in chunk_ids]
+
+        with ColumnarSegmentWriter(path, extra_header=extra) as writer:
+            for i in range(max(num_chunks, 1)):
+                chunk_ids = ordered[i * chunk_aggregates:
+                                    (i + 1) * chunk_aggregates]
+                colev = encode_events_columnar(
+                    registry, chunk_events(i, chunk_ids) if chunk_ids else [])
+                if derived_cols:
+                    _drop_derived(colev, derived_cols)
+                colev.aggregate_ids = list(chunk_ids)
+                writer.append(colev)
+            if snapshots:
+                writer.append_snapshots(snapshots)
+    finally:
+        shutil.rmtree(spill_dir, ignore_errors=True)
     return {"aggregate_order": ordered, **segment_info(path)}

@@ -46,6 +46,24 @@ from surge_tpu.log.transport import (
     TransactionStateError,
 )
 
+class _ProducerState:
+    """Server-side producer handle plus the idempotency dedup cache.
+
+    One commit/send_immediate is in flight per producer at a time (the publisher
+    is the partition's single writer), so caching only the most recent
+    (seq, reply) per token is enough to answer any replay the client can send.
+    """
+
+    __slots__ = ("txn_id", "producer", "last_seq", "last_reply", "lock")
+
+    def __init__(self, txn_id: str, producer) -> None:
+        self.txn_id = txn_id
+        self.producer = producer
+        self.last_seq = 0
+        self.last_reply: Optional[pb.TxnReply] = None
+        self.lock = threading.Lock()
+
+
 SERVICE = "surge_tpu.log.LogService"
 METHODS = {
     "CreateTopic": (pb.CreateTopicRequest, pb.TopicReply),
@@ -92,7 +110,7 @@ class LogServer:
         self._max_workers = max_workers
         self._server: Optional[grpc.Server] = None
         self.bound_port: Optional[int] = None
-        self._producers: Dict[int, tuple] = {}  # token -> (txn_id, producer)
+        self._producers: Dict[int, "_ProducerState"] = {}  # by token
         self._fenced_tokens: "OrderedDict[int, None]" = OrderedDict()
         self._next_token = 1
         self._token_lock = threading.Lock()
@@ -123,49 +141,69 @@ class LogServer:
             # prune tokens this open just fenced (the inner log fenced their
             # producers); remember them so a zombie client still gets the
             # protocol-correct "fenced" answer rather than "unknown token"
-            for stale in [t for t, (tid, _) in self._producers.items()
-                          if tid == request.transactional_id]:
+            for stale in [t for t, st in self._producers.items()
+                          if st.txn_id == request.transactional_id]:
                 del self._producers[stale]
                 self._fenced_tokens[stale] = None
             while len(self._fenced_tokens) > 1024:
                 self._fenced_tokens.popitem(last=False)
             token = self._next_token
             self._next_token += 1
-            self._producers[token] = (request.transactional_id, producer)
+            self._producers[token] = _ProducerState(
+                request.transactional_id, producer)
         return pb.OpenProducerReply(producer_token=token)
 
     def Transact(self, request: pb.TxnRequest, context) -> pb.TxnReply:
-        entry = self._producers.get(request.producer_token)
-        if entry is None:
+        state = self._producers.get(request.producer_token)
+        if state is None:
             if request.producer_token in self._fenced_tokens:
                 return pb.TxnReply(ok=False, error="producer fenced",
                                    error_kind="fenced")
             return pb.TxnReply(ok=False, error="unknown producer token",
                                error_kind="state")
-        _, producer = entry
         records = [msg_to_record(m) for m in request.records]
-        try:
-            if request.op == "commit":
-                producer.begin()
-                for r in records:
-                    producer.send(r)
-                committed = producer.commit()
-            elif request.op == "abort":
-                # transactions buffer client-side; nothing server-side to discard
-                committed = []
-            elif request.op == "send_immediate":
-                committed = [producer.send_immediate(r) for r in records]
-            else:
-                return pb.TxnReply(ok=False, error=f"unknown op {request.op!r}",
-                                   error_kind="state")
-        except ProducerFencedError as exc:
-            return pb.TxnReply(ok=False, error=str(exc), error_kind="fenced")
-        except TransactionStateError as exc:
-            return pb.TxnReply(ok=False, error=str(exc), error_kind="state")
-        except Exception as exc:  # noqa: BLE001 — surface inner-log failures
-            logger.exception("log server transact failed")
-            return pb.TxnReply(ok=False, error=repr(exc), error_kind="other")
-        return pb.TxnReply(ok=True, records=[record_to_msg(r) for r in committed])
+        with state.lock:
+            # idempotency window (txn_seq > 0): a replayed seq means the client
+            # lost our reply and retried — answer from cache, never append twice
+            if request.txn_seq:
+                if request.txn_seq == state.last_seq:
+                    if state.last_reply is not None:
+                        return state.last_reply
+                    return pb.TxnReply(ok=False, error="duplicate txn_seq with "
+                                       "no cached reply", error_kind="state")
+                if request.txn_seq < state.last_seq:
+                    return pb.TxnReply(
+                        ok=False, error_kind="state",
+                        error=f"stale txn_seq {request.txn_seq} "
+                              f"(last {state.last_seq})")
+            try:
+                if request.op == "commit":
+                    state.producer.begin()
+                    for r in records:
+                        state.producer.send(r)
+                    committed = state.producer.commit()
+                elif request.op == "abort":
+                    # transactions buffer client-side; nothing to discard here
+                    committed = []
+                elif request.op == "send_immediate":
+                    committed = [state.producer.send_immediate(r)
+                                 for r in records]
+                else:
+                    return pb.TxnReply(ok=False, error_kind="state",
+                                       error=f"unknown op {request.op!r}")
+            except ProducerFencedError as exc:
+                return pb.TxnReply(ok=False, error=str(exc), error_kind="fenced")
+            except TransactionStateError as exc:
+                return pb.TxnReply(ok=False, error=str(exc), error_kind="state")
+            except Exception as exc:  # noqa: BLE001 — surface inner-log failures
+                logger.exception("log server transact failed")
+                return pb.TxnReply(ok=False, error=repr(exc), error_kind="other")
+            reply = pb.TxnReply(ok=True,
+                                records=[record_to_msg(r) for r in committed])
+            if request.txn_seq:
+                state.last_seq = request.txn_seq
+                state.last_reply = reply
+            return reply
 
     def Read(self, request: pb.ReadRequest, context) -> pb.ReadReply:
         max_records = request.max_records if request.has_max else None

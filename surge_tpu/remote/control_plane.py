@@ -160,6 +160,18 @@ class ControlPlaneServer:
 
     async def UpdateAssignments(self, request: pb.UpdateAssignmentsRequest,
                                 context) -> pb.ControlAck:
+        # Same closure as UpdateShardLocations (advisor r3 #3): when the server
+        # auto-balances, IT owns assignments — a member wholesale-overwriting them
+        # (e.g. from a stale membership view) would reinstate dead members'
+        # partitions until the next rebalance. Manual mode stays writable but is
+        # epoch-CAS'd so a stale writer loses and reconverges from the watch.
+        if self.auto_balance:
+            return pb.ControlAck(ok=False, epoch=self.epoch,
+                                 error="assignments are auto-balanced")
+        if request.observed_epoch != self.epoch:
+            return pb.ControlAck(
+                ok=False, epoch=self.epoch,
+                error=f"stale epoch {request.observed_epoch} != {self.epoch}")
         self._assignments = {
             _hp_str(host): list(pl.partitions)
             for host, pl in request.assignments.items()}
@@ -483,11 +495,18 @@ class ControlPlaneClient:
         task.add_done_callback(self._inflight.discard)
 
     def push_assignments(self, new: Assignments) -> None:
-        req = pb.UpdateAssignmentsRequest(member=self._member_msg())
-        for hp, parts in new.items():
-            req.assignments[str(hp)].partitions.extend(parts)
-        self._spawn(lambda: self._calls["UpdateAssignments"](req),
-                    "assignment update")
+        async def send() -> None:
+            req = pb.UpdateAssignmentsRequest(member=self._member_msg(),
+                                              observed_epoch=self.applied_epoch)
+            for hp, parts in new.items():
+                req.assignments[str(hp)].partitions.extend(parts)
+            ack = await self._calls["UpdateAssignments"](req)
+            if not ack.ok:
+                # auto-balanced server or CAS conflict: the authoritative state
+                # arrives on the watch stream
+                logger.info("assignment update rejected: %s", ack.error)
+
+        self._spawn(send, "assignment update")
 
     def push_allocations(self, mapping: Mapping[int, HostPort]) -> None:
         async def send() -> None:

@@ -270,3 +270,46 @@ def test_rebuild_from_segment_cold_start(tmp_path):
         await engine3.stop()
 
     asyncio.run(scenario())
+
+
+def test_warm_rebuild_from_stale_segment_does_not_regress_store(tmp_path):
+    """Advisor r3 #2: a WARM rebuild through the segment path (indexer watermark
+    already past the segment's build watermark) must not revert aggregates to
+    their build-time states — the post-build state window is re-applied before
+    priming."""
+    async def scenario():
+        log = InMemoryLog()
+        engine = create_engine(make_logic(), log=log, config=CFG)
+        await engine.start()
+        for _ in range(3):
+            await engine.aggregate_for("warm").send_command(counter.Increment("warm"))
+        await engine.stop()
+
+        seg_path = str(tmp_path / "counter.scol")
+        seg_cfg = CFG.with_overrides({"surge.replay.segment-path": seg_path,
+                                      "surge.replay.restore-on-start": True})
+        # cold start builds the segment at watermark "count=3"
+        engine2 = create_engine(make_logic(), log=log, config=seg_cfg)
+        await engine2.start()
+        # post-build traffic: the live indexer advances past the build watermark
+        for _ in range(2):
+            r = await engine2.aggregate_for("warm").send_command(
+                counter.Increment("warm"))
+        assert r.state.count == 5
+        # wait until the tail indexer has actually indexed the new snapshot
+        for _ in range(200):
+            if engine2.indexer.total_lag() == 0:
+                break
+            await asyncio.sleep(0.01)
+        # WARM rebuild from the now-stale segment (explicit call on the running
+        # engine): without the state-window replay the store reverts to count=3
+        # and the tail loop never re-reads the already-indexed snapshot
+        await engine2.rebuild_from_events()
+        st = engine2.logic.state_format.read_state(
+            engine2.indexer.get_aggregate_bytes("warm"))
+        assert st.count == 5
+        st = await engine2.aggregate_for("warm").get_state()
+        assert st.count == 5
+        await engine2.stop()
+
+    asyncio.run(scenario())

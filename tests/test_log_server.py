@@ -104,6 +104,33 @@ def test_tombstone_and_headers_round_trip(broker):
     assert "gone" not in log.latest_by_key("state", 0)
 
 
+def test_commit_replay_is_idempotent(broker):
+    """Reply loss: retrying a commit with the same txn_seq must not append twice
+    — the server answers the replayed seq from its dedup cache (ADVICE r3 #1)."""
+    log = broker()
+    log.create_topic(TopicSpec("events", 1))
+    p = log.transactional_producer("txn-0")
+    p.begin()
+    p.send(rec("events", "a", b"e1"))
+    p.send(rec("events", "a", b"e2"))
+    first = p.commit()
+    # simulate the lost-reply retry: same token, same seq, same records
+    replay = log._transact(p._token, "commit",
+                           [rec("events", "a", b"e1"), rec("events", "a", b"e2")],
+                           seq=1)
+    assert replay.ok
+    assert [m.offset for m in replay.records] == [r.offset for r in first]
+    assert [r.value for r in log.read("events", 0)] == [b"e1", b"e2"]  # no dupes
+    unseq = log._transact(p._token, "commit", [rec("events", "a", b"e3")], seq=0)
+    assert unseq.ok  # seq=0 opts out of dedup (appends normally)
+    p.begin(); p.send(rec("events", "a", b"e4"))
+    assert p.commit()[0].offset == 3  # producer's own seq advanced to 2
+    # now seq=1 is older than last_seq=2: rejected, nothing appended
+    older = log._transact(p._token, "commit", [rec("events", "a", b"e5")], seq=1)
+    assert not older.ok and older.error_kind == "state"
+    assert log.end_offset("events", 0) == 4
+
+
 def test_wait_for_append_wakes_on_commit(broker):
     log = broker()
     log.create_topic(TopicSpec("events", 1))
