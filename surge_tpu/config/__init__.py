@@ -54,6 +54,12 @@ DEFAULTS: dict[str, Any] = {
     "surge.replay.restore-on-start": False,  # engine cold start folds the events topic
     "surge.replay.batch-size": 8192,  # aggregates per device step
     "surge.replay.time-chunk": 512,  # events scanned per lax.scan segment
+    # tail windows shrink through a power-of-two ladder down to this width instead
+    # of padding to a full time-chunk (pad_ratio lever; 0/neg disables the ladder)
+    "surge.replay.min-time-window": 8,
+    # order aggregates by log length before B-chunking so each chunk's local max
+    # length ≈ its members' lengths (columnar replay pad_ratio lever)
+    "surge.replay.sort-by-length": True,
     "surge.replay.length-buckets": "64,256,1024,4096",
     "surge.replay.mesh-axes": "data",
     "surge.replay.donate-carry": True,
