@@ -129,9 +129,13 @@ def replay_child(corpus_dir: str) -> None:
     })
     engine = ReplayEngine(make_replay_spec(), config=cfg)
 
-    # warm up the compiled programs on a small synthetic corpus (fixed shapes)
-    warm = synth_counter_corpus(min(batch_size, corpus.num_aggregates),
-                                min(batch_size * 4, corpus.num_events), seed=1)
+    # warm up EVERY compiled program the measured run can dispatch: one aggregate
+    # of length 2*time_chunk-1 bit-decomposes into the full chunk plus every
+    # tail-ladder width down to min-time-window, so no XLA compilation lands
+    # inside the timed window regardless of the corpus's length distribution
+    warm_lengths = np.ones(engine.batch_size, dtype=np.int64)
+    warm_lengths[-1] = 2 * max(engine.time_chunk, engine.min_time_window, 1) - 1
+    warm = synth_counter_corpus(0, 0, seed=1, lengths=warm_lengths)
     engine.replay_columnar(warm.events)
     engine.stats.update(pack_s=0.0, h2d_s=0.0, windows=0)
     log(f"child warmup done, compiled programs: {engine.num_compiles()}")

@@ -130,6 +130,7 @@ class SurgeEngine(Controllable):
                 remote_deliver=remote_deliver,
                 dr_standby=self.config.get_bool("surge.engine.dr-standby-enabled"))
         self._rebalance_listeners: List[Callable] = []
+        self._indexer_listener: Optional[Callable] = None
 
     # -- lifecycle (SurgeMessagePipeline.scala:185-240) ----------------------------------
 
@@ -146,6 +147,16 @@ class SurgeEngine(Controllable):
             self.health_supervisor.start()
             if self.loop_prober is not None:
                 self.loop_prober.start()
+            # the indexer materializes only the partitions this node serves and
+            # follows rebalances (Kafka Streams restores per assigned partition,
+            # SURVEY.md §3.3; task migration §3.5); the listener is kept so
+            # stop() can unregister it from a shared long-lived tracker
+            self.indexer.set_partitions(self._indexer_partitions())
+            if self._indexer_listener is None:
+                self._indexer_listener = (
+                    lambda _asg, _ch: self.indexer.set_partitions(
+                        self._indexer_partitions()))
+                self.tracker.register(self._indexer_listener, replay_current=False)
             await self.indexer.start()
             await self.router.start()
             if not self._external_tracker and not self.tracker.assignments.assignments:
@@ -158,6 +169,9 @@ class SurgeEngine(Controllable):
             self.status = EngineStatus.FAILED
             # unwind partially-started observability tasks: a failed engine must not
             # leave the prober ticking or the supervisor subscribed forever
+            if self._indexer_listener is not None:
+                self.tracker.unregister(self._indexer_listener)
+                self._indexer_listener = None
             self.health_supervisor.stop()
             if self.loop_prober is not None:
                 await self.loop_prober.stop()
@@ -165,6 +179,9 @@ class SurgeEngine(Controllable):
 
     async def stop(self) -> Ack:
         self.status = EngineStatus.STOPPING
+        if self._indexer_listener is not None:
+            self.tracker.unregister(self._indexer_listener)
+            self._indexer_listener = None
         self.health_supervisor.stop()
         if self.loop_prober is not None:
             await self.loop_prober.stop()
@@ -198,6 +215,11 @@ class SurgeEngine(Controllable):
     # -- regions -------------------------------------------------------------------------
 
     def _create_region(self, partition: int) -> _Region:
+        if partition not in self.indexer.partitions:
+            # a region implies serving this partition: its publisher's lag gate
+            # needs the indexer tailing it even if the tracker view disagrees
+            self.indexer.set_partitions(
+                sorted(set(self.indexer.partitions) | {partition}))
         publisher = PartitionPublisher(
             self.log, self.logic.state_topic, self.logic.events_topic or None,
             partition, self.indexer, config=self.config,
@@ -245,6 +267,26 @@ class SurgeEngine(Controllable):
                             status="up" if self.indexer.running else "down"),
             ])
 
+    def owned_partitions(self) -> List[int]:
+        """The partitions this node owns per the tracker — or ALL partitions when
+        no assignments exist yet (single-node cold start self-assigns everything;
+        a multi-node engine's external tracker is populated by the control plane
+        before start)."""
+        mapping = self.tracker.assignments.partition_to_host()
+        if not mapping:
+            return list(range(self.num_partitions))
+        return sorted(p for p, h in mapping.items() if h == self.local_host)
+
+    def _indexer_partitions(self) -> List[int]:
+        """Partitions the state-store indexer must tail: owned ones plus any with
+        a live local region (a direct node-transport delivery can create a region
+        the tracker view disclaims mid-rebalance — its publisher lag gate still
+        needs the watermark to advance). A region partition revoked later keeps
+        tailing until the next assignment update; harmless, just idle reads."""
+        parts = set(self.owned_partitions())
+        parts.update(p for p, _ in self.router.regions())
+        return sorted(parts)
+
     # -- TPU bulk restore ---------------------------------------------------------------
 
     def _resolve_mesh(self):
@@ -291,12 +333,20 @@ class SurgeEngine(Controllable):
 
         spec = self.logic.replay_spec()
         mesh = self._resolve_mesh()
+        # restore ONLY this node's partitions (the reference restores per assigned
+        # task, SURVEY.md §3.3): a multi-node cold start does 1/N of the work and
+        # never writes other nodes' aggregates into the local store
+        owned = self.owned_partitions()
 
         segment_path = self.config.get_str("surge.replay.segment-path", "")
         if segment_path:
             result = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: self._rebuild_from_segment(segment_path, spec, mesh))
+                None, lambda: self._rebuild_from_segment(
+                    segment_path, spec, mesh, owned))
             if result.watermarks:  # snapshot-carrying segment: no full state scan
+                # already scoped to `owned`: restore_from_segment filters its
+                # returned watermarks by the partitions it was given
+                watermarks = result.watermarks
                 # Segment states are BUILD-time states. Wherever the indexer has
                 # already advanced past the build watermark (warm rebuild, or the
                 # tail loop ran concurrently with the restore), those snapshots
@@ -304,10 +354,10 @@ class SurgeEngine(Controllable):
                 # that window so the restore cannot revert the store to stale
                 # values (advisor r3 finding #2). Cold starts have watermark 0
                 # everywhere and skip this entirely.
-                self._replay_state_window(result.watermarks)
-                self.indexer.prime(result.watermarks)
+                self._replay_state_window(watermarks)
+                self.indexer.prime(watermarks)
             else:  # segment built without a state topic: overlay + prime at now
-                self._overlay_snapshots_and_prime()
+                self._overlay_snapshots_and_prime(owned)
             logger.info("rebuild_from_events: %d aggregates from %d events via %s",
                         result.num_aggregates, result.num_events, result.backend)
             return result
@@ -319,8 +369,8 @@ class SurgeEngine(Controllable):
             model=self.logic.model, replay_spec=spec,
             encode_event=getattr(self.logic, "encode_event", None),
             decode_state=getattr(self.logic, "decode_state", None),
-            config=self.config, mesh=mesh))
-        self._overlay_snapshots_and_prime()
+            config=self.config, mesh=mesh, partitions=owned))
+        self._overlay_snapshots_and_prime(owned)
         logger.info("rebuild_from_events: %d aggregates from %d events via %s",
                     result.num_aggregates, result.num_events, result.backend)
         return result
@@ -331,7 +381,7 @@ class SurgeEngine(Controllable):
         the tail loop will not revisit. Latest-wins with tombstone deletes, same
         as the indexer's own apply path."""
         store = self.indexer.store
-        for p in range(self.num_partitions):
+        for p in build_watermarks:
             start = build_watermarks.get(p, 0)
             current = self.indexer.indexed_watermark(self.logic.state_topic, p)
             if current <= start:
@@ -344,25 +394,30 @@ class SurgeEngine(Controllable):
                 else:
                     store.put(r.key, r.value)
 
-    def _overlay_snapshots_and_prime(self) -> None:
-        """Overlay the state topic's latest snapshot per key and prime the indexer
-        at the current end offsets. Latest-wins unconditionally: events+state commit
-        atomically, so a snapshot is always ≥ any state replayed from events it
-        covers — this both fills in state-only aggregates (apply_events) and
-        corrects states replayed from a stale externally-built segment."""
+    def _overlay_snapshots_and_prime(self, partitions: List[int] | None = None) -> None:
+        """Overlay the state topic's latest snapshot per key (for ``partitions``,
+        default all) and prime the indexer at the current end offsets. Latest-wins
+        unconditionally: events+state commit atomically, so a snapshot is always ≥
+        any state replayed from events it covers — this both fills in state-only
+        aggregates (apply_events) and corrects states replayed from a stale
+        externally-built segment."""
         store = self.indexer.store
-        for p in range(self.num_partitions):
+        parts = list(range(self.num_partitions)) if partitions is None else partitions
+        for p in parts:
             for key, rec in self.log.latest_by_key(self.logic.state_topic, p).items():
                 if rec.value is None:  # tombstone, same as the indexer's tail path
                     store.delete(key)
                 else:
                     store.put(key, rec.value)
         self.indexer.prime({p: self.log.end_offset(self.logic.state_topic, p)
-                            for p in range(self.num_partitions)})
+                            for p in parts})
 
-    def _rebuild_from_segment(self, segment_path: str, spec, mesh):
+    def _rebuild_from_segment(self, segment_path: str, spec, mesh,
+                              owned: List[int] | None = None):
         """Blocking half of the segment rebuild (runs in the executor): build the
-        segment if absent, then stream-restore the store from it."""
+        segment if absent (always covering EVERY partition — it is a shared
+        artifact), then stream-restore only this node's ``owned`` partitions'
+        chunks from it."""
         import os
 
         from surge_tpu.log.columnar import build_segment_from_topic
@@ -385,7 +440,7 @@ class SurgeEngine(Controllable):
             segment_path, self.indexer.store, replay_spec=spec,
             serialize_state=lambda agg_id, st: state_fmt.write_state(st).value,
             decode_state=getattr(self.logic, "decode_state", None),
-            config=self.config, mesh=mesh)
+            config=self.config, mesh=mesh, partitions=owned)
 
 
 class EngineNotRunningError(Exception):

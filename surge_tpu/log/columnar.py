@@ -84,10 +84,13 @@ class ColumnarSegmentWriter:
         self._file.write(MAGIC + struct.pack("<I", len(header)) + header)
         self._schema = schema
 
-    def append(self, colev: ColumnarEvents) -> None:
+    def append(self, colev: ColumnarEvents,
+               partition: Optional[int] = None) -> None:
         """Append one chunk. Every chunk must share the first chunk's column schema;
         each holds its own disjoint aggregate range (ids are chunk-local 0..n).
-        ``colev.aggregate_ids`` (if set) is persisted alongside the columns."""
+        ``colev.aggregate_ids`` (if set) is persisted alongside the columns.
+        ``partition`` records which source partition the chunk's aggregates belong
+        to, enabling partition-scoped restore (SURVEY.md §3.3 per-task restore)."""
         colev = colev.sorted_by_aggregate()
         schema = {
             "columns": {name: str(col.dtype) for name, col in sorted(colev.cols.items())},
@@ -113,6 +116,8 @@ class ColumnarSegmentWriter:
             "num_events": colev.num_events,
             "cols": cols_meta,
         }
+        if partition is not None:
+            meta_obj["partition"] = int(partition)
         if colev.aggregate_ids is not None:
             if len(colev.aggregate_ids) != colev.num_aggregates:
                 raise ValueError("aggregate_ids length != num_aggregates")
@@ -134,10 +139,11 @@ class ColumnarSegmentWriter:
         self._total_aggregates += colev.num_aggregates
         self._total_events += colev.num_events
 
-    def append_snapshots(self, items) -> None:
+    def append_snapshots(self, items, partition: Optional[int] = None) -> None:
         """Write a snapshot section: latest serialized states of aggregates the
         events topic does not cover (state-only publishes). ``items`` is an
-        iterable of ``(key: str, value: bytes)``."""
+        iterable of ``(key: str, value: bytes)``; ``partition`` scopes the section
+        to one source state partition for partition-scoped restore."""
         if self._file is None:
             raise ValueError("append at least one chunk before snapshots")
         blob = bytearray()
@@ -157,6 +163,8 @@ class ColumnarSegmentWriter:
         else:
             meta_obj = {"count": count, "blob": [seg.CODEC_RAW, len(raw), len(raw)]}
             payload = raw
+        if partition is not None:
+            meta_obj["partition"] = int(partition)
         meta = json.dumps(meta_obj).encode()
         self._file.write(struct.pack("<II", SNAPSHOT_MARKER, len(meta)) + meta)
         self._file.write(payload)
@@ -174,9 +182,15 @@ class ColumnarSegmentWriter:
         self.close()
 
 
-def read_segment(path: str) -> Iterator[ColumnarEvents]:
+def read_segment(path: str,
+                 partitions: Optional[set] = None) -> Iterator[ColumnarEvents]:
     """Stream the segment's chunks back as ColumnarEvents (zero-copy frombuffer
-    views over the decompressed column bytes)."""
+    views over the decompressed column bytes). ``partitions`` keeps only chunks
+    whose recorded source partition is in the set — chunks without partition
+    metadata (pre-scoping segments) always pass, and their payloads are seeked
+    past, not decompressed, when filtered out."""
+    if partitions is not None:
+        partitions = {int(p) for p in partitions}
     with open(path, "rb") as f:
         head = f.read(8)
         if head[:4] != MAGIC:
@@ -198,6 +212,13 @@ def read_segment(path: str) -> Iterator[ColumnarEvents]:
             meta = json.loads(f.read(mlen))
             if marker == SNAPSHOT_MARKER:  # not a chunk; read via read_segment_snapshots
                 f.seek(meta["blob"][1], 1)
+                continue
+            if (partitions is not None and "partition" in meta
+                    and meta["partition"] not in partitions):
+                skip = sum(c[2] for c in meta["cols"])
+                if "ids" in meta:
+                    skip += meta["ids"][1]
+                f.seek(skip, 1)
                 continue
             arrays = {}
             for name, codec, stored_len, raw_len in meta["cols"]:
@@ -258,8 +279,13 @@ def segment_info(path: str) -> dict:
             "num_snapshots": num_snapshots}
 
 
-def read_segment_snapshots(path: str) -> Iterator[tuple]:
-    """Stream the snapshot sections' ``(key, value)`` pairs (state-only aggregates)."""
+def read_segment_snapshots(path: str,
+                           partitions: Optional[set] = None) -> Iterator[tuple]:
+    """Stream the snapshot sections' ``(key, value)`` pairs (state-only
+    aggregates). ``partitions`` keeps only sections recorded for those source
+    state partitions (sections without partition metadata always pass)."""
+    if partitions is not None:
+        partitions = {int(p) for p in partitions}
     with open(path, "rb") as f:
         head = f.read(8)
         if head[:4] != MAGIC:
@@ -279,6 +305,10 @@ def read_segment_snapshots(path: str) -> Iterator[tuple]:
                 if "ids" in meta:
                     skip += meta["ids"][1]
                 f.seek(skip, 1)
+                continue
+            if (partitions is not None and "partition" in meta
+                    and meta["partition"] not in partitions):
+                f.seek(meta["blob"][1], 1)
                 continue
             codec, stored_len, raw_len = meta["blob"]
             raw = f.read(stored_len)
@@ -363,25 +393,34 @@ def build_segment_from_topic(log, topic: str, registry, deserialize_event,
                     yield r
             offset = batch[-1].offset + 1
 
-    # Pass 1: key census only — O(num_aggregates) memory, no event objects.
-    keys: set[str] = set()
+    # Pass 1: key census only (key → source partition) — O(num_aggregates)
+    # memory, no event objects.
+    key_partition: dict[str, int] = {}
     watermarks: dict[str, int] = {}
     for p in partitions:
         for r in scan(p):
-            keys.add(r.key)
+            key_partition[r.key] = p
         watermarks[str(p)] = log.end_offset(topic, p)
-    ordered = sorted(keys)
-    chunk_of = {k: i // chunk_aggregates for i, k in enumerate(ordered)}
-    num_chunks = (len(ordered) + chunk_aggregates - 1) // chunk_aggregates
+    # chunks are PER PARTITION (sorted keys within each) so a node can restore
+    # only its assigned partitions' chunks (SURVEY.md §3.3 per-task restore)
+    ordered: list[str] = []
+    chunk_plan: list[tuple[int, list[str]]] = []  # (partition, keys)
+    for p in partitions:
+        keys_p = sorted(k for k, kp in key_partition.items() if kp == p)
+        ordered.extend(keys_p)
+        for start in range(0, len(keys_p), chunk_aggregates):
+            chunk_plan.append((p, keys_p[start: start + chunk_aggregates]))
+    chunk_of = {k: i for i, (_, ks) in enumerate(chunk_plan) for k in ks}
+    num_chunks = len(chunk_plan)
 
     extra: dict = {"topic": topic, "watermarks": watermarks}
-    snapshots: list[tuple] = []
+    snapshots_by_partition: dict[int, list[tuple]] = {}
     if state_topic is not None:
         state_watermarks: dict[str, int] = {}
         for p in range(log.num_partitions(state_topic)):
             for key, rec in log.latest_by_key(state_topic, p).items():
-                if key not in keys and rec.value:
-                    snapshots.append((key, rec.value))
+                if key not in key_partition and rec.value:
+                    snapshots_by_partition.setdefault(p, []).append((key, rec.value))
             state_watermarks[str(p)] = log.end_offset(state_topic, p)
         extra["state_topic"] = state_topic
         extra["state_watermarks"] = state_watermarks
@@ -429,17 +468,20 @@ def build_segment_from_topic(log, topic: str, registry, deserialize_event,
             return [by_key[a] for a in chunk_ids]
 
         with ColumnarSegmentWriter(path, extra_header=extra) as writer:
-            for i in range(max(num_chunks, 1)):
-                chunk_ids = ordered[i * chunk_aggregates:
-                                    (i + 1) * chunk_aggregates]
-                colev = encode_events_columnar(
-                    registry, chunk_events(i, chunk_ids) if chunk_ids else [])
+            if not chunk_plan:  # empty topic: one empty schema-bearing chunk
+                colev = encode_events_columnar(registry, [])
+                if derived_cols:
+                    _drop_derived(colev, derived_cols)
+                colev.aggregate_ids = []
+                writer.append(colev)
+            for i, (p, chunk_ids) in enumerate(chunk_plan):
+                colev = encode_events_columnar(registry, chunk_events(i, chunk_ids))
                 if derived_cols:
                     _drop_derived(colev, derived_cols)
                 colev.aggregate_ids = list(chunk_ids)
-                writer.append(colev)
-            if snapshots:
-                writer.append_snapshots(snapshots)
+                writer.append(colev, partition=p)
+            for p in sorted(snapshots_by_partition):
+                writer.append_snapshots(snapshots_by_partition[p], partition=p)
     finally:
         shutil.rmtree(spill_dir, ignore_errors=True)
     return {"aggregate_order": ordered, **segment_info(path)}

@@ -58,17 +58,23 @@ def ragged_lengths(num_aggregates: int, num_events: int, rng: np.random.Generato
 
 def synth_counter_corpus(num_aggregates: int, num_events: int, seed: int = 0,
                          spread: float = 0.6,
-                         sort_by_length: bool = False) -> CounterCorpus:
+                         sort_by_length: bool = False,
+                         lengths: np.ndarray | None = None) -> CounterCorpus:
     """Counter-model corpus: Increment/Decrement/NoOp/Unserializable events.
 
     Event mix: 45% inc (by 1..3), 35% dec (by 1..2), 15% noop, 5% unserializable —
     exercising all four tensor-path event types of the TestBoundedContext parity fixture
     (reference TestBoundedContext.scala:17-82). ``sort_by_length`` orders aggregates by
     log length (what the replay engine's bucketing does anyway) so fixed-size B-chunks
-    have homogeneous T and minimal padding.
+    have homogeneous T and minimal padding. An explicit ``lengths`` array overrides the
+    lognormal distribution (warm-up corpora that must hit specific window widths).
     """
     rng = np.random.default_rng(seed)
-    lengths = ragged_lengths(num_aggregates, num_events, rng, spread)
+    if lengths is None:
+        lengths = ragged_lengths(num_aggregates, num_events, rng, spread)
+    else:
+        lengths = np.asarray(lengths, dtype=np.int64)
+        num_aggregates = int(lengths.shape[0])
     if sort_by_length:
         order = np.argsort(lengths, kind="stable")
         lengths = lengths[order]

@@ -306,9 +306,9 @@ class ReplayEngine:
         r3 next #2). Output state columns stay in the caller's aggregate order."""
         b = colev.num_aggregates
         bs = min(self.batch_size, _round_up(max(b, 1), self._lane_multiple()))
-        lengths_all = np.bincount(colev.agg_idx, minlength=b).astype(np.int64)
         # ordering only changes chunk composition when there IS more than one chunk
         if self.sort_by_length and b > bs:
+            lengths_all = np.bincount(colev.agg_idx, minlength=b).astype(np.int64)
             perm = np.argsort(lengths_all, kind="stable").astype(np.int32)
             if np.array_equal(perm, np.arange(b, dtype=np.int32)):
                 perm = None  # already length-ordered: skip the O(N) relabel
@@ -362,7 +362,7 @@ class ReplayEngine:
         Every width in the ladder is a distinct compiled program, so the program
         count stays bounded at ``1 + log2(chunk/min)`` per fold variant."""
         if t <= 0:
-            t = 1
+            return []  # nothing to fold: no dispatch (and no all-pad program)
         chunk = self.time_chunk if self.time_chunk > 0 else t
         plan = []
         s = 0

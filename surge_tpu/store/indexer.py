@@ -41,14 +41,18 @@ class StateStoreIndexer(Controllable):
         self.config = config or default_config()
         self.store = store if store is not None else create_store(
             self.config.get_str("surge.state-store.backend", "memory"))
-        self.partitions: List[int] = list(
+        self.partitions: List[int] = sorted(
             partitions if partitions is not None else range(log.num_partitions(state_topic)))
         self.on_signal = on_signal or (lambda name, level: None)
         self._watermarks: Dict[int, int] = {p: 0 for p in self.partitions}
         self._max_poll = self.config.get_int("surge.state-store.restore-max-poll-records", 500)
         self._poll_timeout = max(
             self.config.get_seconds("surge.state-store.commit-interval-ms", 3000), 0.001)
-        self._tasks: List[BackgroundTask] = []
+        self._tasks: Dict[int, BackgroundTask] = {}
+        # partition -> in-flight stop() of its previous loop (set_partitions
+        # revoke); a re-grant chains its new loop behind this so two loops never
+        # tail one partition concurrently
+        self._stopping: Dict[int, asyncio.Task] = {}
         self._running = False
         self._state_listeners: List[Callable[[str], None]] = []
 
@@ -59,11 +63,12 @@ class StateStoreIndexer(Controllable):
             logger.info("wipe-state-on-start: clearing %s store", self.state_topic)
             self.store.clear()
             self._watermarks = {p: 0 for p in self.partitions}
-        self._tasks = [
-            BackgroundTask(self._make_partition_loop(p), f"indexer-{self.state_topic}-{p}")
+        self._tasks = {
+            p: BackgroundTask(self._make_partition_loop(p),
+                              f"indexer-{self.state_topic}-{p}")
             for p in self.partitions
-        ]
-        for t in self._tasks:
+        }
+        for t in self._tasks.values():
             t.start()
         self._running = True
         self._notify_state("running")
@@ -71,11 +76,69 @@ class StateStoreIndexer(Controllable):
 
     async def stop(self) -> Ack:
         self._running = False
-        for t in self._tasks:
+        for t in self._tasks.values():
             await t.stop()
-        self._tasks = []
+        self._tasks = {}
+        # drain in-flight revoke stops so shutdown never orphans a pending task
+        for t in list(self._stopping.values()):
+            try:
+                await t
+            except Exception:  # noqa: BLE001 — stop is best-effort
+                pass
+        self._stopping = {}
         self._notify_state("stopped")
         return Ack()
+
+    def set_partitions(self, partitions: Sequence[int]) -> None:
+        """Retarget which partitions this indexer tails (rebalance: the Kafka
+        Streams task-migration analog, SURVEY.md §3.5). Added partitions start
+        tailing from their last-known watermark (0 if never tailed); removed
+        partitions stop tailing but their already-indexed keys stay in the store
+        — routing ownership means this node is no longer asked for them. A
+        partition re-granted while its old loop is still stopping gets its new
+        loop chained behind the stop, so one partition never has two tailers."""
+        new = sorted(set(partitions))
+        if new == self.partitions:
+            return
+        added = [p for p in new if p not in self._tasks]
+        removed = [p for p in self.partitions if p not in new]
+        self.partitions = new
+        for p in new:
+            self._watermarks.setdefault(p, 0)
+        if not self._running:
+            return
+        for p in removed:
+            task = self._tasks.pop(p, None)
+            if task is not None:
+                stopper = asyncio.ensure_future(task.stop())
+                self._stopping[p] = stopper
+                stopper.add_done_callback(
+                    lambda t, p=p: self._stopping.pop(p, None)
+                    if self._stopping.get(p) is t else None)
+        for p in added:
+            self._start_partition_loop(p)
+
+    def _start_partition_loop(self, p: int) -> None:
+        pending = self._stopping.get(p)
+        if pending is not None and not pending.done():
+            async def chain() -> None:
+                try:
+                    await pending
+                except Exception:  # noqa: BLE001
+                    pass
+                # re-check: assignment may have changed again while waiting
+                if self._running and p in self.partitions and p not in self._tasks:
+                    t = BackgroundTask(self._make_partition_loop(p),
+                                       f"indexer-{self.state_topic}-{p}")
+                    self._tasks[p] = t
+                    t.start()
+
+            asyncio.ensure_future(chain())
+            return
+        t = BackgroundTask(self._make_partition_loop(p),
+                           f"indexer-{self.state_topic}-{p}")
+        self._tasks[p] = t
+        t.start()
 
     @property
     def running(self) -> bool:

@@ -121,7 +121,8 @@ def restore_from_segment(
         replay_spec: ReplaySpec,
         serialize_state: Callable[[str, Any], bytes],
         decode_state: Callable[[str, Any], Any] | None = None,
-        config: Config | None = None, mesh=None) -> RestoreResult:
+        config: Config | None = None, mesh=None,
+        partitions: Optional[Sequence[int]] = None) -> RestoreResult:
     """Rebuild the store from a columnar segment (log/columnar.py) — the scalable
     cold-start path: per-event Python objects never exist; chunks stream through
     :meth:`ReplayEngine.replay_columnar` and only the per-AGGREGATE writeback is
@@ -129,6 +130,10 @@ def restore_from_segment(
     build-time watermarks make it a complete cold-start image, so no state-topic
     scan follows (the restore-throughput knob this replaces: restore consumer
     max.poll.records, common reference.conf:198-199).
+
+    ``partitions`` restores only chunks/snapshot sections recorded for those
+    source partitions (per-assigned-task restore, SURVEY.md §3.3): a multi-node
+    cold start reads 1/N of the segment and never writes unowned aggregates.
     """
     from surge_tpu.codec.tensor import decode_states
     from surge_tpu.log.columnar import (
@@ -142,9 +147,10 @@ def restore_from_segment(
     engine = ReplayEngine(replay_spec, config=cfg, mesh=mesh)
     schema = segment_info(path)["schema"]
     extra = schema.get("extra", {})
+    part_filter = None if partitions is None else {int(p) for p in partitions}
 
     num_aggregates = num_events = 0
-    for chunk in read_segment(path):
+    for chunk in read_segment(path, partitions=part_filter):
         if chunk.aggregate_ids is None:
             raise ValueError(
                 f"{path}: segment chunks carry no aggregate ids; rebuild the "
@@ -161,7 +167,7 @@ def restore_from_segment(
         num_aggregates += res.num_aggregates
         num_events += res.num_events
 
-    for key, value in read_segment_snapshots(path):
+    for key, value in read_segment_snapshots(path, partitions=part_filter):
         store.put(key, value)
         num_aggregates += 1
 
@@ -169,7 +175,8 @@ def restore_from_segment(
     # state watermarks. Empty when the segment was built without a state topic —
     # the caller must then overlay snapshots and prime itself.
     wm_raw = extra.get("state_watermarks") or {}
-    watermarks = {int(p): int(off) for p, off in wm_raw.items()}
+    watermarks = {int(p): int(off) for p, off in wm_raw.items()
+                  if part_filter is None or int(p) in part_filter}
     return RestoreResult(num_aggregates=num_aggregates, num_events=num_events,
                          watermarks=watermarks, backend="segment")
 

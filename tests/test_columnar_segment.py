@@ -186,8 +186,19 @@ def test_segment_carries_ids_snapshots_and_watermarks(tmp_path):
 
     chunks = list(read_segment(path))
     assert all(c.aggregate_ids is not None for c in chunks)
-    assert [i for c in chunks for i in c.aggregate_ids] == sorted(expected)
+    # chunks are per source partition (sorted within each), enabling
+    # partition-scoped restore; the union covers every aggregate exactly once
+    ids = [i for c in chunks for i in c.aggregate_ids]
+    assert sorted(ids) == sorted(expected) and ids == info["aggregate_order"]
+    evens = [f"agg-{i}" for i in range(0, 10, 2)]
+    odds = [f"agg-{i}" for i in range(1, 10, 2)]
+    assert ids == evens + odds  # partition 0 chunks first, then partition 1
+    p0_ids = [i for c in read_segment(path, partitions={0})
+              for i in c.aggregate_ids]
+    assert p0_ids == evens
     assert list(read_segment_snapshots(path)) == [("lonely", b"SNAP")]
+    assert list(read_segment_snapshots(path, partitions={1})) == []
+    assert list(read_segment_snapshots(path, partitions={0})) == [("lonely", b"SNAP")]
 
     # restore writes every folded state + snapshot into the store
     store = InMemoryKeyValueStore()

@@ -272,6 +272,62 @@ def test_rebuild_from_segment_cold_start(tmp_path):
     asyncio.run(scenario())
 
 
+@pytest.mark.parametrize("use_segment", [False, True])
+def test_two_node_cold_restore_is_partition_scoped(tmp_path, use_segment):
+    """VERDICT r3 next #3: a multi-node cold start with restore-on-start must do
+    1/N of the work — each node's store holds ONLY its owned partitions'
+    aggregates, through both the object path and the columnar segment path, and
+    the live indexer tails only owned partitions afterward."""
+    from surge_tpu.engine.partition import HostPort, PartitionTracker
+
+    host_a, host_b = HostPort("node-a", 1), HostPort("node-b", 2)
+
+    async def scenario():
+        log = InMemoryLog()
+        seed = create_engine(make_logic(), log=log, config=CFG)
+        await seed.start()
+        for i in range(24):
+            agg = f"agg{i}"
+            await seed.aggregate_for(agg).send_command(counter.Increment(agg))
+        # a state-only aggregate exercises the snapshot path's scoping too
+        await seed.aggregate_for("state-only").apply_events(
+            [counter.CountIncremented("state-only", 7, 1)])
+        part_of = {f"agg{i}": seed.router.partition_for(f"agg{i}")
+                   for i in range(24)}
+        part_of["state-only"] = seed.router.partition_for("state-only")
+        await seed.stop()
+
+        cfg = CFG.with_overrides({"surge.replay.restore-on-start": True})
+        if use_segment:
+            cfg = cfg.with_overrides(
+                {"surge.replay.segment-path": str(tmp_path / "two.scol")})
+        # external tracker: A owns even partitions, B owns odd
+        n = cfg.get_int("surge.engine.num-partitions")
+        owned = {host_a: [p for p in range(n) if p % 2 == 0],
+                 host_b: [p for p in range(n) if p % 2 == 1]}
+        stores = {}
+        for host in (host_a, host_b):
+            tracker = PartitionTracker()
+            tracker.update(owned)
+            eng = create_engine(make_logic(), log=log, config=cfg,
+                                local_host=host, tracker=tracker)
+            await eng.start()
+            assert sorted(eng.indexer.partitions) == owned[host]
+            stores[host] = {k for k, _ in eng.indexer.store.items()} \
+                if hasattr(eng.indexer.store, "items") else None
+            if stores[host] is None:  # fall back to probing known keys
+                stores[host] = {k for k in part_of
+                                if eng.indexer.get_aggregate_bytes(k) is not None}
+            await eng.stop()
+
+        for host in (host_a, host_b):
+            expect = {k for k, p in part_of.items() if p in owned[host]}
+            got = {k for k in part_of if k in stores[host]}
+            assert got == expect, (host, got ^ expect)
+
+    asyncio.run(scenario())
+
+
 def test_warm_rebuild_from_stale_segment_does_not_regress_store(tmp_path):
     """Advisor r3 #2: a WARM rebuild through the segment path (indexer watermark
     already past the segment's build watermark) must not revert aggregates to
