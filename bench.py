@@ -129,20 +129,27 @@ def replay_child(corpus_dir: str) -> None:
     })
     engine = ReplayEngine(make_replay_spec(), config=cfg)
 
-    # warm up EVERY compiled program the measured run can dispatch: one aggregate
-    # of length 2*time_chunk-1 bit-decomposes into the full chunk plus every
-    # tail-ladder width down to min-time-window, so no XLA compilation lands
-    # inside the timed window regardless of the corpus's length distribution
-    warm_lengths = np.ones(engine.batch_size, dtype=np.int64)
-    warm_lengths[-1] = 2 * max(engine.time_chunk, engine.min_time_window, 1) - 1
+    # warm up EVERY compiled program the measured run can dispatch. Window plans
+    # are per B-chunk (local max length), so use one full-width B-chunk per
+    # program: a chunk whose max length IS a ladder width dispatches exactly
+    # that tail program, plus one chunk of full time-chunk length — no XLA
+    # compilation can land inside the timed window regardless of the corpus's
+    # length distribution
+    widths = engine.ladder_widths() + [max(engine.time_chunk, 1)]
+    warm_lengths = np.repeat(np.asarray(sorted(widths), dtype=np.int64),
+                             engine.batch_size)
     warm = synth_counter_corpus(0, 0, seed=1, lengths=warm_lengths)
     engine.replay_columnar(warm.events)
     engine.stats.update(pack_s=0.0, h2d_s=0.0, windows=0)
-    log(f"child warmup done, compiled programs: {engine.num_compiles()}")
+    warm_compiles = engine.num_compiles()
+    log(f"child warmup done, compiled programs: {warm_compiles}")
 
     t0 = time.perf_counter()
     result = engine.replay_columnar(corpus.events)
     replay_s = time.perf_counter() - t0
+    if engine.num_compiles() != warm_compiles:
+        log(f"WARNING: {engine.num_compiles() - warm_compiles} program(s) "
+            f"compiled INSIDE the timed window (warmup gap)")
 
     if not np.array_equal(result.states["count"], corpus.expected_count):
         raise AssertionError("replay count mismatch vs closed-form fold")

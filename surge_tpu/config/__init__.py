@@ -38,6 +38,11 @@ DEFAULTS: dict[str, Any] = {
     "surge.state-store.restore-max-poll-records": 500,
     "surge.state-store.wipe-state-on-start": False,
     "surge.state-store.backend": "memory",  # memory | native | rocks-like file store
+    # warm standby copies of each partition's materialized state on other nodes
+    # (Kafka Streams num.standby.replicas, common reference.conf:24-25): each
+    # node also tails the partitions it is ring-standby for, so a rebalance
+    # promotion needs no state re-read
+    "surge.state-store.num-standby-replicas": 0,
     # --- aggregate actor (reference: surge.state-store-actor.*) ---
     "surge.aggregate.ask-timeout-ms": 30_000,
     "surge.aggregate.idle-passivation-ms": 30_000,

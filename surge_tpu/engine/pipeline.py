@@ -255,6 +255,10 @@ class SurgeEngine(Controllable):
                 components=[HealthCheck(name=f"publisher-{p}",
                                         status="up" if pub_ok else "down")]))
         self.metrics.live_entities.record(live)
+        # unconditional: a promoted node (standby set now empty) must read 0,
+        # not its last pre-promotion lag
+        self.metrics.standby_lag.record(
+            self.indexer.lag_for(self.standby_partitions()))
         router_h = self.router.health()
         return HealthCheck(
             name=self.logic.aggregate_name,
@@ -278,14 +282,40 @@ class SurgeEngine(Controllable):
         return sorted(p for p, h in mapping.items() if h == self.local_host)
 
     def _indexer_partitions(self) -> List[int]:
-        """Partitions the state-store indexer must tail: owned ones plus any with
-        a live local region (a direct node-transport delivery can create a region
+        """Partitions the state-store indexer must tail: owned ones, any with a
+        live local region (a direct node-transport delivery can create a region
         the tracker view disclaims mid-rebalance — its publisher lag gate still
-        needs the watermark to advance). A region partition revoked later keeps
-        tailing until the next assignment update; harmless, just idle reads."""
+        needs the watermark to advance), plus this node's standby set. A region
+        partition revoked later keeps tailing until the next assignment update;
+        harmless, just idle reads."""
         parts = set(self.owned_partitions())
         parts.update(p for p, _ in self.router.regions())
+        parts.update(self.standby_partitions())
         return sorted(parts)
+
+    def standby_partitions(self) -> List[int]:
+        """Partitions this node keeps a WARM standby copy of (Kafka Streams
+        num.standby.replicas, SurgeStateStoreConsumer.scala:42 + common
+        reference.conf:24-25): for each partition, the N hosts following its
+        owner on the sorted-host ring tail it too, so a rebalance that promotes
+        this node needs no state-topic re-read — the store rows and watermark
+        are already current."""
+        n = self.config.get_int("surge.state-store.num-standby-replicas", 0)
+        if n <= 0:
+            return []
+        hosts = sorted(self.tracker.assignments.assignments)
+        if self.local_host not in hosts or len(hosts) < 2:
+            return []
+        rank = {h: i for i, h in enumerate(hosts)}
+        mine = rank[self.local_host]
+        out = []
+        for p, owner in self.tracker.assignments.partition_to_host().items():
+            if owner == self.local_host:
+                continue
+            gap = (mine - rank[owner]) % len(hosts)
+            if 1 <= gap <= min(n, len(hosts) - 1):
+                out.append(p)
+        return sorted(out)
 
     # -- TPU bulk restore ---------------------------------------------------------------
 
@@ -333,10 +363,11 @@ class SurgeEngine(Controllable):
 
         spec = self.logic.replay_spec()
         mesh = self._resolve_mesh()
-        # restore ONLY this node's partitions (the reference restores per assigned
-        # task, SURVEY.md §3.3): a multi-node cold start does 1/N of the work and
-        # never writes other nodes' aggregates into the local store
-        owned = self.owned_partitions()
+        # restore ONLY the partitions this node serves (the reference restores per
+        # assigned task, SURVEY.md §3.3 — active AND standby tasks): a multi-node
+        # cold start does 1/N (+standbys) of the work and never writes unrelated
+        # nodes' aggregates into the local store
+        owned = sorted(set(self.owned_partitions()) | set(self.standby_partitions()))
 
         segment_path = self.config.get_str("surge.replay.segment-path", "")
         if segment_path:

@@ -230,6 +230,9 @@ class EngineMetrics:
             "surge.replay.events-per-sec", "latest replay throughput"))
         self.live_entities = m.gauge(MI(
             "surge.engine.live-entities", "currently resident aggregate entities"))
+        self.standby_lag = m.gauge(MI(
+            "surge.state-store.standby-lag",
+            "records behind on partitions this node is warm standby for"))
 
 
 def engine_metrics(registry: Optional[Metrics] = None) -> EngineMetrics:

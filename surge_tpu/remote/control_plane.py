@@ -522,6 +522,16 @@ class ControlPlaneClient:
 
         self._spawn(send, "allocation update")
 
+    async def advertise(self, transport_target: str) -> None:
+        """Update this member's advertised transport target by re-joining.
+
+        Lets a node join the control plane (so assignments — and therefore a
+        partition-scoped restore — happen first) and publish its routable
+        address only once its transport server is actually bound."""
+        self.transport_target = transport_target
+        state = await self._calls["Join"](pb.JoinRequest(member=self._member_msg()))
+        self._apply_state(state, force=True)
+
     def request_join(self) -> None:
         if not self._calls:  # pre-start (router.start's membership.join); the
             return           # client's own start() performs the Join

@@ -10,11 +10,14 @@ embedding a Surge engine" unit, SurgeMessagePipeline.scala:33-87 + remoting):
 - a :class:`GrpcRemoteDeliver` whose address book tracks the control plane's
   member list (each member advertises its transport target on Join).
 
-Start order matters and is encapsulated here: the engine starts first (router
-registered on the still-empty mirror tracker), then the transport server binds,
-then the control-plane client joins — the join's state application fans out through
-the mirrors and the router creates/starts exactly the regions this node owns.
-"""
+Start order matters and is encapsulated here: the control-plane client joins
+FIRST (with no transport target yet) so assignments exist before the engine
+starts — the engine's cold restore is then scoped to this node's partitions
+(SURVEY.md §3.3 per-task restore) and the router creates exactly the owned
+regions. Only after the transport server binds does the node advertise its
+routable address; until then peers cannot forward to it, which mirrors the
+reference's rebalance → restore → serve sequence (a joining node's partitions
+are unavailable while its state store rebuilds)."""
 
 from __future__ import annotations
 
@@ -59,10 +62,10 @@ class EngineNode:
                 self.deliver.set_address(member, target)
 
     async def start(self) -> None:
-        await self.engine.start()
+        await self.client.start()  # join: assignments arrive before restore
+        await self.engine.start()  # partition-scoped restore + owned regions
         port = await self.server.start()
-        self.client.transport_target = f"{self._advertise_host}:{port}"
-        await self.client.start()
+        await self.client.advertise(f"{self._advertise_host}:{port}")
 
     async def stop(self) -> None:
         await self.client.stop()  # leave first so peers stop routing to us

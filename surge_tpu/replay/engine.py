@@ -376,16 +376,33 @@ class ReplayEngine:
             # bit-decompose the tail into descending ladder windows so scanned
             # slots ≈ round_up(tail, min) — a single covering window would waste
             # up to 2× on the tail, which dominates when logs are much shorter
-            # than a full time-chunk
-            w = chunk
+            # than a full time-chunk. Widths always come from ladder_widths()
+            # (min × powers of two), never from halving the chunk, so a
+            # non-power-of-two time-chunk cannot produce sub-min or
+            # unpredictable widths.
+            ladder = self.ladder_widths()
+            w = ladder[-1]
             while rem > 0:
-                while w > self.min_time_window and w > rem:
+                while w > ladder[0] and w > rem:
                     w //= 2
                 plan.append((s, w))
                 take = min(w, rem)
                 s += take
                 rem -= take
         return plan
+
+    def ladder_widths(self) -> list[int]:
+        """The tail-window widths _window_plan can dispatch (ascending):
+        ``min-time-window × 2^k``, strictly below the time-chunk (a tail is
+        always < chunk, so a chunk-sized ladder entry could never fire). Every
+        entry is a distinct compiled program; warm-up should cover all of them
+        plus the full chunk (see bench.py)."""
+        min_w = max(self.min_time_window, 1)
+        chunk = self.time_chunk if self.time_chunk > 0 else min_w
+        ladder = [min_w]
+        while ladder[-1] * 2 < chunk:
+            ladder.append(ladder[-1] * 2)
+        return ladder
 
     def _fold_window(self, carry: StateTree, type_ids: np.ndarray,
                      cols: Mapping[str, np.ndarray], bs: int,

@@ -167,8 +167,15 @@ class StateStoreIndexer(Controllable):
 
     def total_lag(self) -> int:
         """Sum over assigned partitions of (end offset − indexed watermark)."""
-        return sum(max(self.log.end_offset(self.state_topic, p) - self._watermarks[p], 0)
-                   for p in self.partitions)
+        return self.lag_for(self.partitions)
+
+    def lag_for(self, partitions: Sequence[int]) -> int:
+        """Sum of (end offset − indexed watermark) over ``partitions`` (the
+        standby-lag gauge input; KafkaProducerActorImpl.scala:701-708 role)."""
+        return sum(
+            max(self.log.end_offset(self.state_topic, p)
+                - self._watermarks.get(p, 0), 0)
+            for p in partitions)
 
     # -- restore priming ----------------------------------------------------------------
 
