@@ -72,6 +72,10 @@ DEFAULTS: dict[str, Any] = {
     # segment (building it once from the topics if absent) instead of folding
     # per-event Python objects
     "surge.replay.segment-path": "",
+    # --- log broker replication (acks=all role, common reference.conf:112-124) ---
+    # how long a commit waits for the follower ack before failing back to the
+    # client (which retries the same txn_seq and re-joins the queued item)
+    "surge.log.replication-ack-timeout-ms": 5_000,
     # --- health (common reference.conf:228-260) ---
     "surge.health.window-frequency-ms": 10_000,
     "surge.health.window-buffer-size": 10,

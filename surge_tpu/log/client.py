@@ -50,12 +50,14 @@ class GrpcTxnProducer:
     KafkaProducerActorImpl.scala:161-165 `enable.idempotence`).
     """
 
-    def __init__(self, transport: "GrpcLogTransport", token: int) -> None:
+    def __init__(self, transport: "GrpcLogTransport", token: int,
+                 generation: int = 0, next_seq: int = 1) -> None:
         self._transport = transport
         self._token = token
+        self._generation = generation  # transport generation at open time
         self._buffer: Optional[List[LogRecord]] = None
         self._fenced = False
-        self._next_seq = 1
+        self._next_seq = next_seq
 
     @property
     def fenced(self) -> bool:
@@ -87,8 +89,13 @@ class GrpcTxnProducer:
         if self._buffer is None:
             raise TransactionStateError("no open transaction")
         records, self._buffer = self._buffer, None
-        reply = self._transport._transact(self._token, "commit", records,
-                                          seq=self._next_seq)
+        try:
+            reply = self._transport._transact(self._token, "commit", records,
+                                              seq=self._next_seq,
+                                              generation=self._generation)
+        except ProducerFencedError:
+            self._fenced = True
+            raise
         self._check_fence(reply)
         _raise_for(reply)
         self._next_seq += 1
@@ -100,8 +107,13 @@ class GrpcTxnProducer:
         self._buffer = None  # records never left this process
 
     def send_immediate(self, record: LogRecord) -> LogRecord:
-        reply = self._transport._transact(self._token, "send_immediate",
-                                          [record], seq=self._next_seq)
+        try:
+            reply = self._transport._transact(self._token, "send_immediate",
+                                              [record], seq=self._next_seq,
+                                              generation=self._generation)
+        except ProducerFencedError:
+            self._fenced = True
+            raise
         self._check_fence(reply)
         _raise_for(reply)
         self._next_seq += 1
@@ -113,28 +125,95 @@ class GrpcTxnProducer:
 
 
 class GrpcLogTransport:
-    """:class:`surge_tpu.log.transport.LogTransport` against a remote LogServer."""
+    """:class:`surge_tpu.log.transport.LogTransport` against a remote LogServer.
 
-    def __init__(self, target: str, config=None,
+    ``target`` may name SEVERAL brokers (comma-separated, or a list): the first
+    is preferred, the rest are failover order (a leader + its ship-on-commit
+    followers, the acks=all role of the reference's replicated Kafka cluster).
+    When the current broker becomes unreachable the transport rolls to the next
+    one; producers opened against the dead broker observe a **generation bump**
+    and surface :class:`ProducerFencedError`, which drives the publisher's
+    existing fenced → re-initialize ladder — it re-opens on the new broker and,
+    thanks to replicated txn-dedup state, resumes its idempotency numbering
+    without duplicating an acked-but-reply-lost commit."""
+
+    def __init__(self, target, config=None,
                  auto_create_partitions: int = 1) -> None:
+        if isinstance(target, str):
+            self.targets = [t.strip() for t in target.split(",") if t.strip()]
+        else:
+            self.targets = list(target)
+        if not self.targets:
+            raise ValueError("need at least one broker target")
+        self.target = self.targets[0]  # current
+        self._config = config
+        from surge_tpu.config import default_config as _dc
+
+        # a commit may legitimately block for the server's replication-ack wait;
+        # the client deadline must sit ABOVE it or slow-but-alive brokers would
+        # be misread as dead
+        self._transact_timeout = max(
+            10.0, 2.0 * (config or _dc()).get_seconds(
+                "surge.log.replication-ack-timeout-ms", 5_000))
+        self._calls: Dict[str, object] = {}
+        self._channel = None
+        self.generation = 0
+        self._auto_create_partitions = auto_create_partitions
+        self._topics: Dict[str, TopicSpec] = {}  # local spec cache
+        self._lock = threading.Lock()
+        self._connect(0)
+
+    def _connect(self, index: int) -> None:
         from surge_tpu.remote.security import secure_sync_channel
 
-        self.target = target
-        self._channel = secure_sync_channel(target, config)
-        self._calls: Dict[str, object] = {}
+        if self._channel is not None:
+            try:
+                self._channel.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self.target = self.targets[index % len(self.targets)]
+        self._channel = secure_sync_channel(self.target, self._config)
         for name, (req_cls, reply_cls) in METHODS.items():
             self._calls[name] = self._channel.unary_unary(
                 f"/{SERVICE}/{name}",
                 request_serializer=req_cls.SerializeToString,
                 response_deserializer=reply_cls.FromString)
-        self._auto_create_partitions = auto_create_partitions
-        self._topics: Dict[str, TopicSpec] = {}  # local spec cache
-        self._lock = threading.Lock()
+
+    def _failover(self, from_generation: int) -> None:
+        with self._lock:
+            if self.generation != from_generation:
+                return  # another caller already rolled
+            self.generation += 1
+            self._connect(self.targets.index(self.target) + 1)
+
+    def _invoke(self, name: str, request, timeout: float = 10.0):
+        """Call with broker failover: UNAVAILABLE rolls to the next target and
+        retries, up to one full cycle through the broker list. DEADLINE retries
+        in place — a slow-but-alive broker must NOT be treated as dead (writing
+        to a follower while its leader still serves would fork the logs)."""
+        last = None
+        for attempt in range(max(len(self.targets), 1) + 1):
+            gen = self.generation
+            try:
+                return self._calls[name](request, timeout=timeout)
+            except grpc.RpcError as exc:
+                code = exc.code() if hasattr(exc, "code") else None
+                if code not in (grpc.StatusCode.UNAVAILABLE,
+                                grpc.StatusCode.DEADLINE_EXCEEDED):
+                    raise
+                last = exc
+                if attempt >= max(len(self.targets), 1):
+                    break
+                if (code == grpc.StatusCode.UNAVAILABLE
+                        and len(self.targets) > 1):
+                    self._failover(gen)
+                time.sleep(0.05)
+        raise last
 
     # -- topics ---------------------------------------------------------------------------
 
     def create_topic(self, spec: TopicSpec) -> None:
-        self._calls["CreateTopic"](pb.CreateTopicRequest(spec=pb.TopicSpecMsg(
+        self._invoke("CreateTopic", pb.CreateTopicRequest(spec=pb.TopicSpecMsg(
             name=spec.name, partitions=spec.partitions, compacted=spec.compacted)))
         with self._lock:
             self._topics[spec.name] = spec
@@ -144,7 +223,7 @@ class GrpcLogTransport:
             hit = self._topics.get(name)
         if hit is not None:
             return hit
-        reply = self._calls["GetTopic"](pb.TopicRequest(name=name))
+        reply = self._invoke("GetTopic", pb.TopicRequest(name=name))
         if not reply.found:
             # parity with InMemoryLog: unknown topics auto-create
             spec = TopicSpec(name, self._auto_create_partitions)
@@ -161,31 +240,65 @@ class GrpcLogTransport:
     # -- producers ------------------------------------------------------------------------
 
     def transactional_producer(self, transactional_id: str) -> GrpcTxnProducer:
-        reply = self._calls["OpenProducer"](
-            pb.OpenProducerRequest(transactional_id=transactional_id))
-        return GrpcTxnProducer(self, reply.producer_token)
+        reply = self._invoke("OpenProducer",
+                             pb.OpenProducerRequest(
+                                 transactional_id=transactional_id))
+        return GrpcTxnProducer(self, reply.producer_token,
+                               generation=self.generation,
+                               next_seq=reply.last_txn_seq + 1)
 
     def _transact(self, token: int, op: str, records: Sequence[LogRecord],
-                  seq: int = 0, attempts: int = 4) -> pb.TxnReply:
+                  seq: int = 0, attempts: int = 4,
+                  generation: Optional[int] = None) -> pb.TxnReply:
         request = pb.TxnRequest(
             producer_token=token, op=op, txn_seq=seq,
             records=[record_to_msg(r) for r in records])
         backoff = 0.05
         for attempt in range(attempts):
+            if generation is not None and generation != self.generation:
+                # the transport failed over to another broker since this
+                # producer was opened: its token is meaningless there. Surface
+                # as fencing — the publisher's fenced → re-initialize ladder
+                # re-opens on the new broker and (replicated dedup) resumes its
+                # idempotency numbering.
+                raise ProducerFencedError(
+                    "broker failover: producer must re-open")
             try:
-                return self._calls["Transact"](request)
+                reply = self._calls["Transact"](request,
+                                                timeout=self._transact_timeout)
             except grpc.RpcError as exc:
                 # Reply loss / transient broker unavailability: retry the SAME
                 # txn_seq so a commit the server did apply is answered from its
                 # dedup cache, not appended again. Anything non-transient (or
                 # seq-less ops, which we cannot safely replay) propagates.
                 code = exc.code() if hasattr(exc, "code") else None
-                transient = code in (grpc.StatusCode.UNAVAILABLE,
-                                     grpc.StatusCode.DEADLINE_EXCEEDED)
-                if not seq or not transient or attempt == attempts - 1:
+                if not seq or code != grpc.StatusCode.UNAVAILABLE \
+                        or attempt == attempts - 1:
+                    if (code == grpc.StatusCode.UNAVAILABLE
+                            and len(self.targets) > 1
+                            and generation is not None):
+                        # current broker is gone: roll the transport so the
+                        # NEXT open lands on a live one, then report fenced
+                        self._failover(generation)
+                        raise ProducerFencedError(
+                            f"broker failover after {exc.code()}")
                     raise
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 0.4)
+                continue
+            if not reply.ok and reply.error_kind == "retriable" and seq:
+                # replication timeout: the commit is applied on the broker but
+                # not yet follower-acked. Retrying the SAME seq re-joins the
+                # queued item server-side. If it never resolves, surface as
+                # fencing — the reinit's OpenProducer numbers PAST the in-limbo
+                # seq, so no different-payload reuse can occur.
+                if attempt == attempts - 1:
+                    raise ProducerFencedError(
+                        f"replication unresolved: {reply.error}")
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.4)
+                continue
+            return reply
         raise RuntimeError("unreachable")
 
     # -- reads ----------------------------------------------------------------------------
@@ -199,20 +312,20 @@ class GrpcLogTransport:
         if max_records is not None:
             req.has_max = True
             req.max_records = max_records
-        reply = self._calls["Read"](req)
+        reply = self._invoke("Read", req)
         return [msg_to_record(m) for m in reply.records]
 
     def end_offset(self, topic: str, partition: int,
                    isolation: str = "read_committed") -> int:
         del isolation
         self.topic(topic)  # auto-create parity
-        return self._calls["EndOffset"](
-            pb.OffsetRequest(topic=topic, partition=partition)).end_offset
+        return self._invoke("EndOffset", pb.OffsetRequest(
+            topic=topic, partition=partition)).end_offset
 
     def latest_by_key(self, topic: str, partition: int,
                       isolation: str = "read_committed") -> Mapping[str, LogRecord]:
-        reply = self._calls["LatestByKey"](
-            pb.OffsetRequest(topic=topic, partition=partition))
+        reply = self._invoke("LatestByKey", pb.OffsetRequest(
+            topic=topic, partition=partition))
         return {m.key: msg_to_record(m) for m in reply.records}
 
     async def wait_for_append(self, topic: str, partition: int,
@@ -220,8 +333,8 @@ class GrpcLogTransport:
         loop = asyncio.get_running_loop()
         while True:
             t0 = loop.time()
-            reply = await loop.run_in_executor(None, lambda: self._calls[
-                "WaitForAppend"](pb.WaitRequest(
+            reply = await loop.run_in_executor(None, lambda: self._invoke(
+                "WaitForAppend", pb.WaitRequest(
                     topic=topic, partition=partition, after_offset=after_offset,
                     timeout_s=0.5)))
             if reply.appended:

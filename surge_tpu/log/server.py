@@ -46,34 +46,58 @@ from surge_tpu.log.transport import (
     TransactionStateError,
 )
 
-class _ProducerState:
-    """Server-side producer handle plus the idempotency dedup cache.
+class _TxnDedup:
+    """Idempotency state for ONE transactional id — shared across producer
+    re-opens (and, via replication, across broker failover): the last committed
+    txn_seq and its reply. One commit is in flight per producer at a time (the
+    publisher is the partition's single writer), so the most recent entry is
+    enough to answer any replay the client can send."""
 
-    One commit/send_immediate is in flight per producer at a time (the publisher
-    is the partition's single writer), so caching only the most recent
-    (seq, reply) per token is enough to answer any replay the client can send.
-    """
+    __slots__ = ("last_seq", "last_reply")
 
-    __slots__ = ("txn_id", "producer", "last_seq", "last_reply", "lock")
-
-    def __init__(self, txn_id: str, producer) -> None:
-        self.txn_id = txn_id
-        self.producer = producer
+    def __init__(self) -> None:
         self.last_seq = 0
         self.last_reply: Optional[pb.TxnReply] = None
+
+
+class _ProducerState:
+    """Server-side producer handle bound to its txn id's dedup state."""
+
+    __slots__ = ("txn_id", "producer", "dedup", "lock")
+
+    def __init__(self, txn_id: str, producer, dedup: _TxnDedup) -> None:
+        self.txn_id = txn_id
+        self.producer = producer
+        self.dedup = dedup
         self.lock = threading.Lock()
+
+
+class _ReplItem:
+    """One ordered replication unit: a committed batch (or bare topic create)."""
+
+    __slots__ = ("specs", "records", "txn_id", "seq", "done", "error")
+
+    def __init__(self, specs, records, txn_id: str = "", seq: int = 0) -> None:
+        self.specs = specs
+        self.records = records
+        self.txn_id = txn_id
+        self.seq = seq
+        self.done = threading.Event()
+        self.error: Optional[str] = None
 
 
 SERVICE = "surge_tpu.log.LogService"
 METHODS = {
     "CreateTopic": (pb.CreateTopicRequest, pb.TopicReply),
     "GetTopic": (pb.TopicRequest, pb.TopicReply),
+    "ListTopics": (pb.ListTopicsRequest, pb.ListTopicsReply),
     "OpenProducer": (pb.OpenProducerRequest, pb.OpenProducerReply),
     "Transact": (pb.TxnRequest, pb.TxnReply),
     "Read": (pb.ReadRequest, pb.ReadReply),
     "EndOffset": (pb.OffsetRequest, pb.OffsetReply),
     "LatestByKey": (pb.OffsetRequest, pb.LatestByKeyReply),
     "WaitForAppend": (pb.WaitRequest, pb.WaitReply),
+    "Replicate": (pb.ReplicateRequest, pb.ReplicateReply),
 }
 
 
@@ -91,6 +115,16 @@ def record_to_msg(r: LogRecord) -> pb.RecordMsg:
     return msg
 
 
+def _same_payload(committed, retried) -> bool:
+    """Whether a retried batch is the same logical payload as the committed one
+    (offsets ignored: the retry's records carry none)."""
+    if len(committed) != len(retried):
+        return False
+    return all(a.topic == b.topic and a.partition == b.partition
+               and a.key == b.key and a.value == b.value
+               for a, b in zip(committed, retried))
+
+
 def msg_to_record(m: pb.RecordMsg) -> LogRecord:
     return LogRecord(topic=m.topic, key=m.key if m.has_key else None,
                      value=m.value if m.has_value else None,
@@ -102,7 +136,8 @@ class LogServer:
     """gRPC facade over an in-process log. One instance per broker process."""
 
     def __init__(self, log, host: str = "127.0.0.1", port: int = 0,
-                 config=None, max_workers: int = 32) -> None:
+                 config=None, max_workers: int = 32,
+                 replicate_to: Optional[list] = None) -> None:
         self.log = log
         self._host = host
         self._port = port
@@ -111,12 +146,29 @@ class LogServer:
         self._server: Optional[grpc.Server] = None
         self.bound_port: Optional[int] = None
         self._producers: Dict[int, "_ProducerState"] = {}  # by token
+        self._txn_dedup: Dict[str, _TxnDedup] = {}  # by transactional id
         self._fenced_tokens: "OrderedDict[int, None]" = OrderedDict()
         self._next_token = 1
         self._token_lock = threading.Lock()
         # long-poll waiters may not occupy more than half the handler pool, or
         # many tailing indexers would starve the Transact/Read command path
         self._wait_slots = threading.BoundedSemaphore(max(max_workers // 2, 1))
+        # -- replication (leader side): one ordered queue per process so the
+        # follower's log is always a gap-free prefix of this one
+        self._repl_targets = list(replicate_to or [])
+        from surge_tpu.config import default_config as _dc
+        cfg = config or _dc()
+        self._repl_ack_timeout_s = cfg.get_seconds(
+            "surge.log.replication-ack-timeout-ms", 5_000)
+        self._repl_queue: "list[_ReplItem]" = []
+        self._repl_cv = threading.Condition()
+        self._repl_pending: Dict[tuple, _ReplItem] = {}  # (txn_id, seq) -> item
+        self._repl_thread: Optional[threading.Thread] = None
+        self._repl_stop = False
+        self._repl_channels: Dict[str, object] = {}
+        # -- replication (follower side): ordered ingest of leader batches
+        self._replica_lock = threading.Lock()
+        self._replica_producer = None
 
     # -- handlers (sync; called on the server thread pool) --------------------------------
 
@@ -124,6 +176,16 @@ class LogServer:
         spec = TopicSpec(request.spec.name, request.spec.partitions or 1,
                          request.spec.compacted)
         self.log.create_topic(spec)
+        if self._repl_targets:
+            # a record-less topic must still exist on the follower with the RIGHT
+            # partition count (auto-create after failover would guess wrong);
+            # best-effort wait — the ordered queue guarantees it lands before
+            # any subsequent batch either way
+            item = _ReplItem([request.spec], [])
+            with self._repl_cv:
+                self._repl_queue.append(item)
+                self._repl_cv.notify()
+            item.done.wait(self._repl_ack_timeout_s)
         return pb.TopicReply(found=True, spec=request.spec)
 
     def GetTopic(self, request: pb.TopicRequest, context) -> pb.TopicReply:
@@ -133,6 +195,23 @@ class LogServer:
             return pb.TopicReply(found=False)
         return pb.TopicReply(found=True, spec=pb.TopicSpecMsg(
             name=spec.name, partitions=spec.partitions, compacted=spec.compacted))
+
+    def _topic_specs(self) -> list:
+        """Snapshot of the inner log's topic specs under its own lock (a live
+        leader may be creating topics concurrently on another pool thread)."""
+        lock = getattr(self.log, "_lock", None)
+        topics = getattr(self.log, "_topics", {})
+        if lock is None:
+            return list(topics.values())
+        with lock:
+            return list(topics.values())
+
+    def ListTopics(self, request: pb.ListTopicsRequest,
+                   context) -> pb.ListTopicsReply:
+        return pb.ListTopicsReply(topics=[
+            pb.TopicSpecMsg(name=s.name, partitions=s.partitions,
+                            compacted=s.compacted)
+            for s in self._topic_specs()])
 
     def OpenProducer(self, request: pb.OpenProducerRequest,
                      context) -> pb.OpenProducerReply:
@@ -149,9 +228,21 @@ class LogServer:
                 self._fenced_tokens.popitem(last=False)
             token = self._next_token
             self._next_token += 1
+            # dedup state outlives the producer: a re-open (same process, or a
+            # failover to this broker carrying replicated dedup) resumes the
+            # idempotency numbering instead of colliding with it
+            dedup = self._txn_dedup.setdefault(request.transactional_id,
+                                               _TxnDedup())
             self._producers[token] = _ProducerState(
-                request.transactional_id, producer)
-        return pb.OpenProducerReply(producer_token=token)
+                request.transactional_id, producer, dedup)
+        # a seq still awaiting replication counts: the new producer must number
+        # PAST it, or its first commit could collide with the in-limbo batch
+        pending_max = max(
+            (s for (tid, s) in list(self._repl_pending)
+             if tid == request.transactional_id), default=0)
+        return pb.OpenProducerReply(
+            producer_token=token,
+            last_txn_seq=max(dedup.last_seq, pending_max))
 
     def Transact(self, request: pb.TxnRequest, context) -> pb.TxnReply:
         state = self._producers.get(request.producer_token)
@@ -163,19 +254,34 @@ class LogServer:
                                error_kind="state")
         records = [msg_to_record(m) for m in request.records]
         with state.lock:
+            dedup = state.dedup
             # idempotency window (txn_seq > 0): a replayed seq means the client
             # lost our reply and retried — answer from cache, never append twice
             if request.txn_seq:
-                if request.txn_seq == state.last_seq:
-                    if state.last_reply is not None:
-                        return state.last_reply
+                if request.txn_seq == dedup.last_seq:
+                    if dedup.last_reply is not None:
+                        return dedup.last_reply
                     return pb.TxnReply(ok=False, error="duplicate txn_seq with "
                                        "no cached reply", error_kind="state")
-                if request.txn_seq < state.last_seq:
+                if request.txn_seq < dedup.last_seq:
                     return pb.TxnReply(
                         ok=False, error_kind="state",
                         error=f"stale txn_seq {request.txn_seq} "
-                              f"(last {state.last_seq})")
+                              f"(last {dedup.last_seq})")
+                # a previous attempt of this seq appended locally but timed out
+                # waiting for replication: re-join that item, never re-append.
+                # The payload must MATCH — the client may only reuse a seq for
+                # the identical batch (a different batch acked from this item's
+                # cache would silently lose its records)
+                pending = self._repl_pending.get((state.txn_id, request.txn_seq))
+                if pending is not None:
+                    if not _same_payload(pending.records, records):
+                        return pb.TxnReply(
+                            ok=False, error_kind="state",
+                            error=f"txn_seq {request.txn_seq} reused with a "
+                                  "different payload while its original batch "
+                                  "awaits replication")
+                    return self._finish_replicated(state, request.txn_seq, pending)
             try:
                 if request.op == "commit":
                     state.producer.begin()
@@ -198,12 +304,225 @@ class LogServer:
             except Exception as exc:  # noqa: BLE001 — surface inner-log failures
                 logger.exception("log server transact failed")
                 return pb.TxnReply(ok=False, error=repr(exc), error_kind="other")
+            if self._repl_targets and committed:
+                item = self._enqueue_replication(committed, state.txn_id,
+                                                 request.txn_seq)
+                return self._finish_replicated(state, request.txn_seq, item)
             reply = pb.TxnReply(ok=True,
                                 records=[record_to_msg(r) for r in committed])
             if request.txn_seq:
-                state.last_seq = request.txn_seq
-                state.last_reply = reply
+                dedup.last_seq = request.txn_seq
+                dedup.last_reply = reply
             return reply
+
+    # -- replication: leader side ---------------------------------------------------------
+
+    def _enqueue_replication(self, committed, txn_id: str, seq: int) -> _ReplItem:
+        specs = []
+        seen = set()
+        for r in committed:
+            if r.topic not in seen:
+                seen.add(r.topic)
+                spec = self.log.topic(r.topic)
+                specs.append(pb.TopicSpecMsg(name=spec.name,
+                                             partitions=spec.partitions,
+                                             compacted=spec.compacted))
+        item = _ReplItem(specs, list(committed), txn_id, seq)
+        with self._repl_cv:
+            self._repl_queue.append(item)
+            if seq:
+                self._repl_pending[(txn_id, seq)] = item
+            self._repl_cv.notify()
+        return item
+
+    def _finish_replicated(self, state: "_ProducerState", seq: int,
+                           item: _ReplItem) -> pb.TxnReply:
+        """Wait for the follower ack; only then return the ok reply (acks=all:
+        an acknowledged commit is always on every follower). Dedup-cache and
+        pending-map maintenance happen in the replication worker, so an item
+        whose client never retries is still cleaned up."""
+        if not item.done.wait(self._repl_ack_timeout_s):
+            return pb.TxnReply(
+                ok=False, error_kind="retriable",
+                error="replication timeout (commit applied locally; retry the "
+                      "same txn_seq to await the follower ack)")
+        if item.error:
+            return pb.TxnReply(ok=False, error_kind="retriable",
+                               error=f"replication failed: {item.error}")
+        return pb.TxnReply(ok=True,
+                           records=[record_to_msg(r) for r in item.records])
+
+    def _replication_loop(self) -> None:
+        """Single worker: drain the queue IN ORDER, retrying each item until it
+        lands on every follower (head-of-line blocking is the point — the
+        follower must stay a prefix of the leader, never a gappy subset)."""
+        backoff = 0.05
+        while True:
+            with self._repl_cv:
+                while not self._repl_queue and not self._repl_stop:
+                    self._repl_cv.wait(0.5)
+                if self._repl_stop:
+                    return
+                item = self._repl_queue[0]
+            err = None
+            for target in self._repl_targets:
+                err = self._ship(target, item)
+                if err is not None:
+                    break
+            if err is None:
+                # finalize BEFORE waking waiters: dedup cache advanced and the
+                # pending entry dropped even if no client ever retries the seq
+                if item.seq:
+                    dedup = self._txn_dedup.setdefault(item.txn_id, _TxnDedup())
+                    if item.seq > dedup.last_seq:
+                        dedup.last_seq = item.seq
+                        dedup.last_reply = pb.TxnReply(
+                            ok=True,
+                            records=[record_to_msg(r) for r in item.records])
+                    self._repl_pending.pop((item.txn_id, item.seq), None)
+                item.error = None
+                item.done.set()
+                with self._repl_cv:
+                    self._repl_queue.pop(0)
+                backoff = 0.05
+            else:
+                item.error = err  # visible to a waiter that times out
+                logger.warning("replication attempt failed: %s", err)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
+
+    def _ship(self, target: str, item: _ReplItem) -> Optional[str]:
+        try:
+            call = self._repl_channels.get(target)
+            if call is None:
+                from surge_tpu.remote.security import secure_sync_channel
+
+                channel = secure_sync_channel(target, self._config)
+                call = channel.unary_unary(
+                    f"/{SERVICE}/Replicate",
+                    request_serializer=pb.ReplicateRequest.SerializeToString,
+                    response_deserializer=pb.ReplicateReply.FromString)
+                self._repl_channels[target] = call
+            reply = call(pb.ReplicateRequest(
+                topics=item.specs,
+                records=[record_to_msg(r) for r in item.records],
+                transactional_id=item.txn_id, txn_seq=item.seq),
+                timeout=self._repl_ack_timeout_s)
+            if not reply.ok:
+                return f"{target}: {reply.error}"
+            return None
+        except Exception as exc:  # noqa: BLE001 — follower down / transport error
+            self._repl_channels.pop(target, None)
+            return f"{target}: {exc!r}"
+
+    # -- replication: follower side -------------------------------------------------------
+
+    def Replicate(self, request: pb.ReplicateRequest, context) -> pb.ReplicateReply:
+        with self._replica_lock:
+            try:
+                known = getattr(self.log, "_topics", {})
+                for spec in request.topics:
+                    # membership check, not .topic(): inner logs auto-create
+                    # unknown topics with 1 partition, which would silently
+                    # mis-partition the replica
+                    if spec.name not in known:
+                        self.log.create_topic(TopicSpec(
+                            spec.name, spec.partitions or 1, spec.compacted))
+                records = [msg_to_record(m) for m in request.records]
+                # idempotent ingest: a re-shipped batch (reply loss, or overlap
+                # with catch_up) skips records this log already holds; a record
+                # AHEAD of our end offset is a gap — out of sync, loud error
+                expected: Dict[tuple, int] = {}
+                to_apply = []
+                for r in records:
+                    tp = (r.topic, r.partition)
+                    if tp not in expected:
+                        expected[tp] = self.log.end_offset(r.topic, r.partition)
+                    if r.offset < expected[tp]:
+                        continue  # already applied
+                    if r.offset > expected[tp]:
+                        return pb.ReplicateReply(
+                            ok=False,
+                            error=f"gap: leader record {r.topic}"
+                                  f"[{r.partition}]@{r.offset} but replica end "
+                                  f"is {expected[tp]} — re-sync via catch_up")
+                    to_apply.append(r)
+                    expected[tp] += 1
+                if to_apply:
+                    if self._replica_producer is None:
+                        self._replica_producer = self.log.transactional_producer(
+                            "__replica__")
+                    self._replica_producer.begin()
+                    for r in to_apply:
+                        self._replica_producer.send(r)
+                    applied = self._replica_producer.commit()
+                    for got, want in zip(applied, to_apply):
+                        if (got.offset != want.offset
+                                or got.partition != want.partition
+                                or got.topic != want.topic):
+                            # out of sync with the leader — loud, unrecoverable
+                            # without a re-sync (catch_up from an empty log)
+                            return pb.ReplicateReply(
+                                ok=False,
+                                error=f"offset mismatch: applied "
+                                      f"{got.topic}[{got.partition}]@{got.offset}"
+                                      f" != leader @{want.offset}")
+                # carry the idempotency dedup so failover retries hit the cache
+                if request.transactional_id and request.txn_seq:
+                    dedup = self._txn_dedup.setdefault(
+                        request.transactional_id, _TxnDedup())
+                    if request.txn_seq > dedup.last_seq:
+                        dedup.last_seq = request.txn_seq
+                        dedup.last_reply = pb.TxnReply(
+                            ok=True, records=list(request.records))
+                return pb.ReplicateReply(ok=True)
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("replica ingest failed")
+                return pb.ReplicateReply(ok=False, error=repr(exc))
+
+    def catch_up(self, leader_target: str) -> int:
+        """Follower bootstrap: copy everything the leader has that this log does
+        not (topics + records per partition, in offset order). Returns the
+        number of records copied. Run BEFORE start() on an empty/behind
+        follower; ship-on-commit keeps it current afterwards."""
+        from surge_tpu.log.client import GrpcLogTransport
+
+        leader = GrpcLogTransport(leader_target, config=self._config)
+        copied = 0
+        try:
+            reply = leader._calls["ListTopics"](pb.ListTopicsRequest())
+            known = getattr(self.log, "_topics", {})
+            for spec_msg in reply.topics:
+                if spec_msg.name not in known:
+                    self.log.create_topic(TopicSpec(
+                        spec_msg.name, spec_msg.partitions or 1,
+                        spec_msg.compacted))
+                for p in range(spec_msg.partitions or 1):
+                    while True:  # page: one unbounded Read would blow the gRPC
+                        start = self.log.end_offset(spec_msg.name, p)
+                        records = leader.read(spec_msg.name, p,
+                                              from_offset=start,
+                                              max_records=1000)
+                        if not records:
+                            break
+                        with self._replica_lock:
+                            if self._replica_producer is None:
+                                self._replica_producer = \
+                                    self.log.transactional_producer("__replica__")
+                            self._replica_producer.begin()
+                            for r in records:
+                                self._replica_producer.send(r)
+                            applied = self._replica_producer.commit()
+                        for got, want in zip(applied, records):
+                            if got.offset != want.offset:
+                                raise RuntimeError(
+                                    f"catch_up offset mismatch on "
+                                    f"{spec_msg.name}[{p}]: {got.offset} != "
+                                    f"{want.offset}")
+                        copied += len(records)
+        finally:
+            leader.close()
+        return copied
 
     def Read(self, request: pb.ReadRequest, context) -> pb.ReadReply:
         max_records = request.max_records if request.has_max else None
@@ -261,9 +580,21 @@ class LogServer:
         else:
             self.bound_port = self._server.add_insecure_port(address)
         self._server.start()
+        if self._repl_targets and self._repl_thread is None:
+            self._repl_stop = False
+            self._repl_thread = threading.Thread(
+                target=self._replication_loop, name="surge-log-replication",
+                daemon=True)
+            self._repl_thread.start()
         return self.bound_port
 
     def stop(self, grace: float = 1.0) -> None:
+        if self._repl_thread is not None:
+            with self._repl_cv:
+                self._repl_stop = True
+                self._repl_cv.notify_all()
+            self._repl_thread.join(grace + 1.0)
+            self._repl_thread = None
         if self._server is not None:
             self._server.stop(grace).wait()
             self._server = None

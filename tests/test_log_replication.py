@@ -1,0 +1,192 @@
+"""Broker replication: ship-on-commit follower + client failover on leader death.
+
+The acks=all role of the reference's replicated Kafka cluster (VERDICT r3 next
+#5; common reference.conf:112-124): a commit is acknowledged only once the
+follower has it, the follower's log is always a gap-free prefix of the leader's,
+and killing the leader mid-traffic loses no committed record — the engine keeps
+serving against the follower, with replicated txn-dedup preventing duplicate
+appends from acked-but-reply-lost commits."""
+
+import asyncio
+
+import pytest
+
+from surge_tpu.log import (
+    GrpcLogTransport,
+    InMemoryLog,
+    LogRecord,
+    LogServer,
+    TopicSpec,
+)
+
+
+@pytest.fixture
+def pair():
+    """A leader LogServer replicating to a follower LogServer."""
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    leader = LogServer(InMemoryLog(), replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    clients = []
+
+    def connect(failover=True) -> GrpcLogTransport:
+        targets = (f"127.0.0.1:{lport},127.0.0.1:{fport}" if failover
+                   else f"127.0.0.1:{lport}")
+        c = GrpcLogTransport(targets)
+        clients.append(c)
+        return c
+
+    yield leader, follower, fport, connect
+    for c in clients:
+        c.close()
+    leader.stop()
+    follower.stop()
+
+
+def rec(topic, key, value, partition=0):
+    return LogRecord(topic=topic, key=key, value=value, partition=partition)
+
+
+def test_commits_ship_to_follower_with_identical_offsets(pair):
+    leader, follower, fport, connect = pair
+    log = connect()
+    log.create_topic(TopicSpec("events", 2))
+    log.create_topic(TopicSpec("state", 2, compacted=True))
+    p = log.transactional_producer("txn-0")
+    p.begin()
+    p.send(rec("events", "a", b"e1"))
+    p.send(rec("events", "a", b"e2", partition=1))
+    p.send(rec("state", "a", b"s1"))
+    out = p.commit()
+    assert [r.offset for r in out] == [0, 0, 0]
+    # read directly from the follower: same records, same offsets, same specs
+    flog = GrpcLogTransport(f"127.0.0.1:{fport}")
+    try:
+        assert flog.topic("events").partitions == 2
+        assert flog.topic("state").compacted
+        assert [r.value for r in flog.read("events", 0)] == [b"e1"]
+        assert [r.value for r in flog.read("events", 1)] == [b"e2"]
+        assert flog.latest_by_key("state", 0)["a"].value == b"s1"
+    finally:
+        flog.close()
+
+
+def test_acked_commits_survive_leader_kill(pair):
+    """Every acknowledged commit must be readable after the leader dies —
+    acks=all means replication happens BEFORE the ack."""
+    leader, follower, fport, connect = pair
+    log = connect()
+    log.create_topic(TopicSpec("events", 1))
+    p = log.transactional_producer("txn-0")
+    acked = []
+    for i in range(20):
+        p.begin()
+        p.send(rec("events", f"k{i}", f"v{i}".encode()))
+        out = p.commit()
+        acked.append((out[0].offset, f"v{i}".encode()))
+    leader.stop(grace=0.1)  # the kill: socket closes, client sees UNAVAILABLE
+    # reads fail over to the follower and see every acked record
+    values = {r.offset: r.value for r in log.read("events", 0)}
+    for off, val in acked:
+        assert values[off] == val
+
+
+def test_producer_fails_over_and_resumes_idempotency_numbering(pair):
+    """After leader death the producer re-opens on the follower (fenced →
+    reopen ladder) and its txn_seq continues from the replicated dedup state,
+    so a retry of the last acked commit cannot append twice."""
+    from surge_tpu.log.transport import ProducerFencedError
+
+    leader, follower, fport, connect = pair
+    log = connect()
+    log.create_topic(TopicSpec("events", 1))
+    p = log.transactional_producer("txn-0")
+    for i in range(3):
+        p.begin()
+        p.send(rec("events", "a", f"v{i}".encode()))
+        p.commit()
+    assert p._next_seq == 4
+    leader.stop(grace=0.1)
+
+    # next commit observes the failover as fencing
+    p.begin()
+    p.send(rec("events", "a", b"v3"))
+    with pytest.raises(ProducerFencedError):
+        p.commit()
+    assert p.fenced
+
+    # re-open (what the publisher's reinit does): numbering resumes at 4
+    p2 = log.transactional_producer("txn-0")
+    assert p2._next_seq == 4
+    # the acked-but-reply-lost case: the LAST commit acked by the dead leader
+    # (seq 3) is retried against the follower — the replicated dedup answers
+    # from cache instead of appending v2 a second time
+    replay = log._transact(p2._token, "commit", [rec("events", "a", b"v2")],
+                           seq=3)
+    assert replay.ok and [m.offset for m in replay.records] == [2]
+    assert log.end_offset("events", 0) == 3  # nothing appended twice
+    p2.begin()
+    p2.send(rec("events", "a", b"v3"))
+    out = p2.commit()
+    assert out[0].offset == 3
+    assert [r.value for r in log.read("events", 0)] == [b"v0", b"v1", b"v2", b"v3"]
+
+
+def test_engine_survives_broker_failover_mid_traffic(pair):
+    """The full engine keeps serving commands across a leader kill: publisher
+    re-initializes on the follower via the fenced ladder, committed state is
+    recovered, and no command's effect is lost or doubled."""
+    from surge_tpu import SurgeCommandBusinessLogic, create_engine, default_config
+    from surge_tpu.engine.entity import CommandSuccess
+    from surge_tpu.models import counter
+
+    leader, follower, fport, connect = pair
+    cfg = default_config().with_overrides({
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 10,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.aggregate.publish-timeout-ms": 4000,
+        "surge.engine.num-partitions": 2,
+    })
+
+    def logic():
+        return SurgeCommandBusinessLogic(
+            aggregate_name="counter", model=counter.CounterModel(),
+            state_format=counter.state_formatting(),
+            event_format=counter.event_formatting())
+
+    async def scenario():
+        log = connect()
+        engine = create_engine(logic(), log=log, config=cfg)
+        await engine.start()
+        for i in range(10):
+            agg = f"agg-{i % 3}"
+            r = await engine.aggregate_for(agg).send_command(counter.Increment(agg))
+            assert isinstance(r, CommandSuccess)
+
+        leader.stop(grace=0.1)  # kill mid-traffic
+
+        async def send_retrying(agg):
+            for _ in range(30):  # publisher reinit window: commands retry
+                r = await engine.aggregate_for(agg).send_command(
+                    counter.Increment(agg))
+                if isinstance(r, CommandSuccess):
+                    return r
+                await asyncio.sleep(0.2)
+            raise AssertionError(f"command never succeeded after failover: {r}")
+
+        r = await send_retrying("agg-0")
+        assert r.state.count == 5  # 4 pre-kill + 1 post-failover: nothing lost
+        r = await send_retrying("agg-1")
+        assert r.state.count == 4
+        await engine.stop()
+
+        # a FRESH engine against only the follower sees all committed state
+        engine2 = create_engine(logic(), log=connect(), config=cfg)
+        await engine2.start()
+        st = await engine2.aggregate_for("agg-0").get_state()
+        assert st.count == 5
+        await engine2.stop()
+
+    asyncio.run(scenario())
