@@ -72,6 +72,9 @@ DEFAULTS: dict[str, Any] = {
     # segment (building it once from the topics if absent) instead of folding
     # per-event Python objects
     "surge.replay.segment-path": "",
+    # append delta chunks/snapshots for post-build offsets on each segment
+    # rebuild, so repeated cold starts never re-crawl the topics
+    "surge.replay.segment-auto-extend": True,
     # --- log broker replication (acks=all role, common reference.conf:112-124) ---
     # how long a commit waits for the follower ack before failing back to the
     # client (which retries the same txn_seq and re-joins the queued item)

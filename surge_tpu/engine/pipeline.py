@@ -467,6 +467,45 @@ class SurgeEngine(Controllable):
                 derived_cols=getattr(self.logic, "derived_cols", None),
                 state_topic=self.logic.state_topic)
             os.replace(tmp_path, segment_path)
+        elif self.config.get_bool("surge.replay.segment-auto-extend", True):
+            # incremental maintenance: append delta chunks/snapshots for offsets
+            # past the segment's watermarks so THIS restore (and the next one)
+            # covers them without a state-topic crawl. Best-effort exclusive
+            # lock — if another engine on a shared path is extending, skip; the
+            # post-restore state window replay covers the delta anyway.
+            from surge_tpu.log.columnar import extend_segment_from_topic
+
+            lock_path = segment_path + ".extending"
+            try:
+                fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                fd = None
+                try:  # a crash mid-extend must not disable extension forever:
+                    # reclaim locks older than 10 minutes (extends are fast —
+                    # they cover only the post-build delta)
+                    import time as _time
+
+                    if _time.time() - os.path.getmtime(lock_path) > 600:
+                        os.unlink(lock_path)
+                        fd = os.open(lock_path,
+                                     os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                        logger.warning("reclaimed stale segment-extend lock %s",
+                                       lock_path)
+                    else:
+                        logger.info("segment extend skipped: %s held by a "
+                                    "concurrent extender", lock_path)
+                except OSError:
+                    fd = None
+            if fd is not None:
+                try:
+                    extend_segment_from_topic(
+                        self.log, self.logic.events_topic, spec.registry,
+                        evt_fmt.read_event, segment_path,
+                        encode_event=getattr(self.logic, "encode_event", None),
+                        state_topic=self.logic.state_topic)
+                finally:
+                    os.close(fd)
+                    os.unlink(lock_path)
         return restore_from_segment(
             segment_path, self.indexer.store, replay_spec=spec,
             serialize_state=lambda agg_id, st: state_fmt.write_state(st).value,

@@ -198,8 +198,11 @@ class GrpcLogTransport:
                 return self._calls[name](request, timeout=timeout)
             except grpc.RpcError as exc:
                 code = exc.code() if hasattr(exc, "code") else None
+                # CANCELLED happens when another thread's failover closed the
+                # shared channel mid-call: retry on the fresh stubs
                 if code not in (grpc.StatusCode.UNAVAILABLE,
-                                grpc.StatusCode.DEADLINE_EXCEEDED):
+                                grpc.StatusCode.DEADLINE_EXCEEDED,
+                                grpc.StatusCode.CANCELLED):
                     raise
                 last = exc
                 if attempt >= max(len(self.targets), 1):
@@ -267,13 +270,17 @@ class GrpcLogTransport:
                 reply = self._calls["Transact"](request,
                                                 timeout=self._transact_timeout)
             except grpc.RpcError as exc:
-                # Reply loss / transient broker unavailability: retry the SAME
-                # txn_seq so a commit the server did apply is answered from its
-                # dedup cache, not appended again. Anything non-transient (or
+                # Reply loss / transient broker trouble: retry the SAME txn_seq
+                # so a commit the server did apply is answered from its dedup
+                # cache, not appended again. DEADLINE and CANCELLED (another
+                # thread's failover closed the channel) retry in place; only
+                # UNAVAILABLE can mean broker death. Anything non-transient (or
                 # seq-less ops, which we cannot safely replay) propagates.
                 code = exc.code() if hasattr(exc, "code") else None
-                if not seq or code != grpc.StatusCode.UNAVAILABLE \
-                        or attempt == attempts - 1:
+                transient = code in (grpc.StatusCode.UNAVAILABLE,
+                                     grpc.StatusCode.DEADLINE_EXCEEDED,
+                                     grpc.StatusCode.CANCELLED)
+                if not seq or not transient or attempt == attempts - 1:
                     if (code == grpc.StatusCode.UNAVAILABLE
                             and len(self.targets) > 1
                             and generation is not None):

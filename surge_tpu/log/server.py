@@ -375,10 +375,12 @@ class LogServer:
                 if item.seq:
                     dedup = self._txn_dedup.setdefault(item.txn_id, _TxnDedup())
                     if item.seq > dedup.last_seq:
-                        dedup.last_seq = item.seq
+                        # reply BEFORE seq: a lock-free reader that observes the
+                        # new last_seq must never see the previous reply
                         dedup.last_reply = pb.TxnReply(
                             ok=True,
                             records=[record_to_msg(r) for r in item.records])
+                        dedup.last_seq = item.seq
                     self._repl_pending.pop((item.txn_id, item.seq), None)
                 item.error = None
                 item.done.set()

@@ -65,7 +65,8 @@ class ControlPlaneServer:
     def __init__(self, num_partitions: int, host: str = "127.0.0.1", port: int = 0,
                  auto_balance: bool = True,
                  member_timeout_s: Optional[float] = None,
-                 config: Config | None = None) -> None:
+                 config: Config | None = None,
+                 persist_path: Optional[str] = None) -> None:
         self.num_partitions = num_partitions
         self.auto_balance = auto_balance
         cfg = config or default_config()
@@ -85,6 +86,86 @@ class ControlPlaneServer:
         self._expiry_task: Optional[asyncio.Task] = None
         self._thread = None
         self._thread_loop = None
+        # durability: every epoch bump snapshots (epoch, members, assignments,
+        # allocations) to disk, and a restarted seed resumes from it — clients
+        # re-joining after the restart see a CONTINUED epoch instead of a reset
+        # one, and no allocation/assignment state is lost with the process
+        # (coordinator durability role, KafkaConsumerStateTrackingActor.scala:
+        # 39-118 backed by the consumer-group store in the reference)
+        self._persist_path = persist_path
+        import threading
+
+        self._save_lock = threading.Lock()
+        self._saved_epoch = -1
+        if persist_path:
+            self._load()
+
+    # -- persistence ----------------------------------------------------------------------
+
+    def _load(self) -> None:
+        import json
+        import os
+
+        if not self._persist_path or not os.path.exists(self._persist_path):
+            return
+        try:
+            with open(self._persist_path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError) as exc:
+            logger.warning("control-plane snapshot %s unreadable (%r); "
+                           "starting fresh", self._persist_path, exc)
+            return
+        self.epoch = int(snap.get("epoch", 0))
+        now = time.monotonic()
+        # restored members get a fresh heartbeat window: they were alive at the
+        # snapshot and their ping loop re-registers within member_timeout anyway
+        self._members = {
+            _hp_str(m["member"]): {"last_ping": now,
+                                   "transport_target": m.get("target", "")}
+            for m in snap.get("members", [])}
+        self._assignments = {
+            _hp_str(host): list(parts)
+            for host, parts in snap.get("assignments", {}).items()}
+        self._locations = {int(p): _hp_str(m)
+                           for p, m in snap.get("locations", {}).items()}
+
+    def _save(self) -> None:
+        """Snapshot state to disk. The dict is built synchronously (cheap); the
+        write+fsync runs in the default executor so membership churn on a slow
+        disk never stalls the event loop past ping timeouts. A version guard
+        keeps out-of-order executor completions from persisting an older epoch
+        over a newer one."""
+        if not self._persist_path:
+            return
+        snap = {
+            "epoch": self.epoch,
+            "members": [{"member": str(m), "target": info["transport_target"]}
+                        for m, info in self._members.items()],
+            "assignments": {str(m): parts
+                            for m, parts in self._assignments.items()},
+            "locations": {str(p): str(m) for p, m in self._locations.items()},
+        }
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._write_snapshot(snap)
+            return
+        loop.run_in_executor(None, self._write_snapshot, snap)
+
+    def _write_snapshot(self, snap: dict) -> None:
+        import json
+        import os
+
+        with self._save_lock:
+            if snap["epoch"] <= self._saved_epoch:
+                return  # a newer snapshot already landed
+            tmp = self._persist_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._persist_path)
+            self._saved_epoch = snap["epoch"]
 
     # -- state ----------------------------------------------------------------------------
 
@@ -105,6 +186,7 @@ class ControlPlaneServer:
 
     def _bump_and_broadcast(self) -> None:
         self.epoch += 1
+        self._save()
         msg = self._state_msg()
         for q in list(self._watchers):
             q.put_nowait(msg)

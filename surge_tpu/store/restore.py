@@ -143,19 +143,47 @@ def restore_from_segment(
     )
     from surge_tpu.replay.engine import ReplayEngine
 
+    import numpy as np
+
     cfg = config or default_config()
     engine = ReplayEngine(replay_spec, config=cfg, mesh=mesh)
-    schema = segment_info(path)["schema"]
+    info = segment_info(path)
+    schema = info["schema"]
     extra = schema.get("extra", {})
     part_filter = None if partitions is None else {int(p) for p in partitions}
 
-    num_aggregates = num_events = 0
+    # Incremental segments append DELTA chunks whose aggregates CONTINUE earlier
+    # chunks' folds: keep each chunk's tensor states + an id index so a later
+    # chunk's init_carry gathers the already-folded state (and new aggregates
+    # start from the model default). Base-only segments (no extends) skip the
+    # retention entirely — the common cold path stays streaming.
+    track = info.get("num_extends", 0) > 0
+    chunk_states: list = []
+    where: Dict[str, tuple] = {}
+    restored: set = set()
+    num_events = 0
     for chunk in read_segment(path, partitions=part_filter):
         if chunk.aggregate_ids is None:
             raise ValueError(
                 f"{path}: segment chunks carry no aggregate ids; rebuild the "
                 "segment with build_segment_from_topic to restore through it")
-        res = engine.replay_columnar(chunk)
+        init = None
+        if track:
+            hits = [(i, a) for i, a in enumerate(chunk.aggregate_ids)
+                    if a in where]
+            if hits:
+                init = engine.init_carry_np(chunk.num_aggregates)
+                for name, col in init.items():
+                    for i, a in hits:
+                        ci, row = where[a]
+                        col[i] = chunk_states[ci][name][row]
+        res = engine.replay_columnar(chunk, init_carry=init)
+        if track:
+            chunk_states.append({k: np.asarray(v)
+                                 for k, v in res.states.items()})
+            ci = len(chunk_states) - 1
+            for i, agg_id in enumerate(chunk.aggregate_ids):
+                where[agg_id] = (ci, i)
         states = decode_states(replay_spec.registry.state, res.states)
         for agg_id, state in zip(chunk.aggregate_ids, states):
             if state is None:
@@ -164,12 +192,14 @@ def restore_from_segment(
             if decode_state is not None:
                 state = decode_state(agg_id, state)
             store.put(agg_id, serialize_state(agg_id, state))
-        num_aggregates += res.num_aggregates
+            restored.add(agg_id)
         num_events += res.num_events
-
+    # snapshot sections apply in file order AFTER chunks: a delta snapshot for
+    # an aggregate supersedes its (older) chunk-folded state, latest-wins
     for key, value in read_segment_snapshots(path, partitions=part_filter):
         store.put(key, value)
-        num_aggregates += 1
+        restored.add(key)
+    num_aggregates = len(restored)
 
     # indexer priming: the segment covers the state topic up to its build-time
     # state watermarks. Empty when the segment was built without a state topic —

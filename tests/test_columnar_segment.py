@@ -68,17 +68,24 @@ def test_segment_compresses_event_columns(tmp_path):
     assert os.path.getsize(path) < raw_bytes / 2  # narrow int columns compress well
 
 
-def test_schema_mismatch_rejected(tmp_path):
+def test_divergent_chunk_schema_round_trips_via_meta_overrides(tmp_path):
+    """A chunk whose schema differs from the header (the delta-chunk case:
+    stored vs derived columns) persists per-chunk overrides and reads back with
+    its own dtypes, not the header's."""
     corpus = synth_counter_corpus(10, 100, seed=1)
-    path = str(tmp_path / "bad.scol")
+    path = str(tmp_path / "mixed.scol")
     w = ColumnarSegmentWriter(path)
     w.append(corpus.events)
     other = ColumnarEvents(num_aggregates=1, agg_idx=np.zeros(1, np.int32),
                            type_ids=np.zeros(1, np.int32),
-                           cols={"weird": np.zeros(1, np.float32)})
-    with pytest.raises(ValueError, match="schema"):
-        w.append(other)
+                           cols={"weird": np.full(1, 2.5, np.float32)})
+    w.append(other)
     w.close()
+    chunks = list(read_segment(path))
+    assert set(chunks[0].cols) == set(corpus.events.cols)
+    assert set(chunks[1].cols) == {"weird"}
+    assert chunks[1].cols["weird"].dtype == np.float32
+    assert float(chunks[1].cols["weird"][0]) == 2.5
 
 
 def test_build_segment_from_topic(tmp_path):
@@ -120,6 +127,106 @@ def test_build_segment_from_topic(tmp_path):
         st = expected[agg]
         assert int(res.states["count"][i]) == st.count, agg
         assert int(res.states["version"][i]) == st.version, agg
+
+
+def test_extend_segment_appends_delta_and_restores_without_rebuild(tmp_path):
+    """VERDICT r3 next #8: after post-build traffic, extend appends delta
+    chunks (schema-overridden: ordinals stored, not derived), state-only delta
+    snapshots, and a watermark override; a restore folds base chunks then
+    CONTINUES each touched aggregate's fold through init_carry — states match
+    the scalar ground truth exactly, and a second extend with no new data is a
+    no-op."""
+    import numpy as np
+
+    from surge_tpu.engine.model import fold_events
+    from surge_tpu.log.columnar import extend_segment_from_topic, segment_info
+    from surge_tpu.store.kv import InMemoryKeyValueStore
+    from surge_tpu.store.restore import restore_from_segment
+
+    log = InMemoryLog()
+    log.create_topic(TopicSpec("counter-events", 2))
+    log.create_topic(TopicSpec("counter-state", 2, compacted=True))
+    model = counter.CounterModel()
+    fmt = counter.event_formatting()
+    sfmt = counter.state_formatting()
+    rng = np.random.default_rng(9)
+    prod = log.transactional_producer("seg")
+    logs: dict = {}
+
+    def send_events(agg, events, partition):
+        prod.begin()
+        for e in events:
+            prod.send(LogRecord(topic="counter-events", key=agg,
+                                value=fmt.write_event(e).value,
+                                partition=partition))
+        st = fold_events(model, None, logs.get(agg, []) + list(events))
+        prod.send(LogRecord(topic="counter-state", key=agg,
+                            value=sfmt.write_state(st).value,
+                            partition=partition))
+        prod.commit()
+        logs.setdefault(agg, []).extend(events)
+
+    # base: 20 aggregates
+    for i in range(20):
+        agg = f"agg-{i}"
+        n = int(rng.integers(1, 9))
+        send_events(agg, [counter.CountIncremented(agg, int(rng.integers(1, 4)),
+                                                   k + 1) for k in range(n)],
+                    i % 2)
+    # a state-only key at build time
+    prod.begin()
+    prod.send(LogRecord(topic="counter-state", key="lonely", value=b"OLD",
+                        partition=0))
+    prod.commit()
+
+    path = str(tmp_path / "inc.scol")
+    build_segment_from_topic(
+        log, "counter-events", counter.make_registry(), fmt.read_event, path,
+        derived_cols={"sequence_number": "ordinal"}, chunk_aggregates=8,
+        state_topic="counter-state")
+    base_chunks = segment_info(path)["num_chunks"]
+
+    # post-build traffic: continuations, brand-new aggregates, a state-only
+    # update, and an update to the snapshot-only key (demoted path)
+    for i in range(0, 20, 3):
+        agg = f"agg-{i}"
+        start = len(logs[agg])
+        send_events(agg, [counter.CountIncremented(agg, 2, start + k + 1)
+                          for k in range(3)], i % 2)
+    for i in range(20, 24):
+        agg = f"agg-{i}"
+        send_events(agg, [counter.CountIncremented(agg, 1, k + 1)
+                          for k in range(2)], i % 2)
+    prod.begin()
+    prod.send(LogRecord(topic="counter-state", key="lonely", value=b"NEW",
+                        partition=0))
+    prod.commit()
+
+    info = extend_segment_from_topic(
+        log, "counter-events", counter.make_registry(), fmt.read_event, path,
+        state_topic="counter-state")
+    assert info["num_chunks"] > base_chunks  # delta chunks landed
+    wm = info["schema"]["extra"]["watermarks"]
+    assert all(int(wm[str(p)]) == log.end_offset("counter-events", p)
+               for p in range(2))
+
+    store = InMemoryKeyValueStore()
+    res = restore_from_segment(
+        path, store, replay_spec=counter.make_replay_spec(),
+        serialize_state=lambda a, s: sfmt.write_state(s).value)
+    for agg, events in logs.items():
+        truth = fold_events(model, None, events)
+        got = sfmt.read_state(store.get(agg))
+        assert (got.count, got.version) == (truth.count, truth.version), agg
+    assert store.get("lonely") == b"NEW"  # demoted snapshot superseded OLD
+    assert res.watermarks == {p: log.end_offset("counter-state", p)
+                              for p in range(2)}
+
+    # nothing new: extend is a no-op (same chunk count, same watermarks)
+    info2 = extend_segment_from_topic(
+        log, "counter-events", counter.make_registry(), fmt.read_event, path,
+        state_topic="counter-state")
+    assert info2["num_chunks"] == info["num_chunks"]
 
 
 def test_build_segment_refuses_false_ordinal_claim(tmp_path):

@@ -122,3 +122,111 @@ def test_client_mirrors_apply_epoch_ordered_state():
             await server.stop()
 
     asyncio.run(scenario())
+
+
+def test_seed_restart_under_traffic_preserves_state_and_routing(tmp_path):
+    """VERDICT r3 next #7: the seed persists (epoch, members, assignments,
+    allocations) to disk; killing and restarting it under command traffic loses
+    no commands — the restarted seed resumes with a CONTINUED epoch and the
+    restored member/assignment state, and a post-restart rebalance (node kill)
+    still converges."""
+    from surge_tpu import SurgeCommandBusinessLogic, default_config
+    from surge_tpu.engine.entity import CommandSuccess
+    from surge_tpu.log import InMemoryLog, LogServer, GrpcLogTransport
+    from surge_tpu.models import counter
+    from surge_tpu.remote.node import EngineNode
+
+    cfg = default_config().with_overrides({
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 10,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.engine.num-partitions": 4,
+        "surge.control-plane.ping-interval-ms": 100,
+        "surge.control-plane.member-timeout-ms": 1_000,
+        "surge.state-store.num-standby-replicas": 1,
+    })
+    persist = str(tmp_path / "seed.json")
+
+    def logic():
+        return SurgeCommandBusinessLogic(
+            aggregate_name="counter", model=counter.CounterModel(),
+            state_format=counter.state_formatting(),
+            event_format=counter.event_formatting(),
+            command_format=counter.command_formatting())
+
+    async def send_retrying(node, agg, deadline_s=20.0):
+        loop = asyncio.get_running_loop()
+        end = loop.time() + deadline_s
+        last = None
+        while loop.time() < end:
+            try:
+                r = await node.aggregate_for(agg).send_command(
+                    counter.Increment(agg))
+            except Exception as exc:  # noqa: BLE001 — routing churn window
+                last = exc
+                await asyncio.sleep(0.2)
+                continue
+            if isinstance(r, CommandSuccess):
+                return r
+            last = r
+            await asyncio.sleep(0.2)
+        raise AssertionError(f"command to {agg} never succeeded: {last}")
+
+    async def scenario():
+        broker = LogServer(InMemoryLog())
+        lport = broker.start()
+        seed = ControlPlaneServer(num_partitions=4, persist_path=persist,
+                                  config=cfg)
+        cport = await seed.start()
+
+        nodes = {}
+        for name in ("alpha", "beta"):
+            nodes[name] = EngineNode(
+                logic(), f"127.0.0.1:{cport}",
+                GrpcLogTransport(f"127.0.0.1:{lport}"), node_name=name,
+                config=cfg)
+            await nodes[name].start()
+        for _ in range(100):
+            if all(len(n.client.membership.members) >= 2
+                   for n in nodes.values()):
+                break
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.3)
+
+        aggs = [f"s{i}" for i in range(8)]
+        for agg in aggs:
+            r = await send_retrying(nodes["alpha"], agg)
+            assert r.state.count == 1
+        epoch_before = seed.epoch
+        assert epoch_before > 0
+
+        # SEED DIES under traffic; routing keeps working off local state
+        await seed.stop(grace=0.2)
+        for agg in aggs:
+            r = await send_retrying(nodes["alpha"], agg)
+            assert r.state.count == 2
+
+        # restart from disk on the same port: epoch continues, members restored
+        seed2 = ControlPlaneServer(num_partitions=4, port=cport,
+                                   persist_path=persist, config=cfg)
+        await seed2.start()
+        assert seed2.epoch >= epoch_before
+        assert len(seed2._members) == 2  # restored, not re-learned
+        await asyncio.sleep(0.5)  # ping loops re-attach
+
+        # post-restart rebalance still converges: kill beta, alpha takes over
+        await nodes["beta"].stop()
+        for _ in range(100):
+            if len(nodes["alpha"].client.membership.members) == 1:
+                break
+            await asyncio.sleep(0.05)
+        for agg in aggs:
+            r = await send_retrying(nodes["alpha"], agg)
+            assert r.state.count == 3, (agg, r.state)
+
+        await nodes["alpha"].stop()
+        await seed2.stop()
+        broker.stop()
+
+    asyncio.run(scenario())

@@ -264,10 +264,17 @@ def test_rebuild_from_segment_cold_start(tmp_path):
         await ref.stop()
 
         engine3 = create_engine(make_logic(), log=log, config=seg_cfg)
-        await engine3.start()  # stale segment; delta rides the indexer tail
+        await engine3.start()  # stale segment: auto-extended with delta chunks
         st = await engine3.aggregate_for("agg0").get_state()
         assert st.count == expected
         await engine3.stop()
+        # the second cold start extended the segment in place: its recorded
+        # watermarks now cover the post-build traffic (VERDICT r3 next #8)
+        from surge_tpu.log.columnar import segment_info
+        wm = segment_info(seg_path)["schema"]["extra"]["watermarks"]
+        n = seg_cfg.get_int("surge.engine.num-partitions")
+        assert {int(p): int(o) for p, o in wm.items()} == {
+            p: log.end_offset("counter-events", p) for p in range(n)}
 
     asyncio.run(scenario())
 
