@@ -21,3 +21,20 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
+
+
+def free_ports(n: int = 1) -> list:
+    """Distinct ephemeral ports: all sockets stay bound until every port is
+    chosen, so two consecutive calls cannot hand back the same port."""
+    import socket
+
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()

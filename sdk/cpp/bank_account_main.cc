@@ -1,0 +1,149 @@
+// BankAccount sample over the surge C++ SDK — the reference's C# sample role
+// (multilanguage-csharp-sdk Sample + SurgeEngine.cs:12-80): the app owns its
+// domain types and serialization (payloads are opaque to the engine), hosts
+// the BusinessLogic callbacks, and drives commands through the gateway.
+//
+//   bank_account <gateway_host> <gateway_port> <business_port> [scenario]
+//
+// Starts the BusinessLogic service on <business_port>, prints
+// "READY <bound_port>" on stdout, and (with "scenario") runs the end-to-end
+// bank-account flow against the gateway, exiting 0 only if every step —
+// including a rejection — behaves exactly as specified.
+
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "surge_sdk.h"
+
+namespace {
+
+// state payload: "owner|balance_cents"; command payloads:
+// "create|owner|cents", "credit|cents", "debit|cents";
+// event payloads: "created|owner|cents", "credited|cents", "debited|cents"
+std::vector<std::string> split(const std::string& s) {
+  std::vector<std::string> out;
+  std::stringstream ss(s);
+  std::string part;
+  while (std::getline(ss, part, '|')) out.push_back(part);
+  return out;
+}
+
+long balance_of(const std::string& state) { return atol(split(state)[1].c_str()); }
+
+std::vector<std::string> process_command(
+    const std::optional<std::string>& state, const std::string& command) {
+  auto parts = split(command);
+  if (parts[0] == "create") {
+    if (state.has_value()) return {};  // idempotent create: no new events
+    return {"created|" + parts[1] + "|" + parts[2]};
+  }
+  if (!state.has_value())
+    throw surge::CommandRejected("account does not exist");
+  if (parts[0] == "credit") return {"credited|" + parts[1]};
+  if (parts[0] == "debit") {
+    long amount = atol(parts[1].c_str());
+    if (amount > balance_of(*state))
+      throw surge::CommandRejected("insufficient funds");
+    return {"debited|" + parts[1]};
+  }
+  throw surge::CommandRejected("unknown command: " + parts[0]);
+}
+
+std::optional<std::string> handle_events(
+    const std::optional<std::string>& state,
+    const std::vector<std::string>& events) {
+  std::optional<std::string> current = state;
+  for (const auto& ev : events) {
+    auto parts = split(ev);
+    if (parts[0] == "created") {
+      current = parts[1] + "|" + parts[2];
+    } else if (current.has_value()) {
+      auto st = split(*current);
+      long bal = atol(st[1].c_str());
+      long amt = atol(parts[1].c_str());
+      bal += parts[0] == "credited" ? amt : -amt;
+      current = st[0] + "|" + std::to_string(bal);
+    }
+  }
+  return current;
+}
+
+int fail(const char* what, const std::string& detail) {
+  fprintf(stderr, "FAIL %s: %s\n", what, detail.c_str());
+  return 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <gateway_host> <gateway_port> <business_port> "
+                    "[scenario]\n", argv[0]);
+    return 2;
+  }
+  surge::CqrsModel model{process_command, handle_events};
+  surge::SurgeEngine engine(model);
+  int bound = engine.start_business_service(atoi(argv[3]));
+  if (bound < 0) return fail("bind", "business service port");
+  printf("READY %d\n", bound);
+  fflush(stdout);
+
+  if (argc < 5 || strcmp(argv[4], "scenario") != 0) {
+    for (;;) pause();  // serve callbacks until killed
+  }
+
+  // the sidecar comes up concurrently (it needs OUR port first): retry the
+  // gateway connection for up to ~15s
+  std::string error;
+  bool connected = false;
+  for (int i = 0; i < 75 && !connected; i++) {
+    connected = engine.connect_gateway(argv[1], atoi(argv[2]), &error);
+    if (!connected) usleep(200 * 1000);
+  }
+  if (!connected) return fail("connect", error);
+
+  // the engine reports "up" only once its regions finish initializing; on a
+  // loaded host that can lag the gateway bind — poll like a real app would
+  std::string health;
+  for (int i = 0; i < 100 && health != "up"; i++) {
+    health = engine.gateway_health(&error);
+    if (health != "up") usleep(200 * 1000);
+  }
+  if (health != "up") return fail("health", "last=" + health + " " + error);
+
+  auto r = engine.forward_command("acct-cpp-1", "create|ada|1000");
+  if (!r.ok || !r.state || balance_of(*r.state) != 1000)
+    return fail("create", r.error + r.rejection);
+
+  r = engine.forward_command("acct-cpp-1", "credit|250");
+  if (!r.ok || balance_of(*r.state) != 1250) return fail("credit", r.error);
+
+  r = engine.forward_command("acct-cpp-1", "debit|1200");
+  if (!r.ok || balance_of(*r.state) != 50) return fail("debit", r.error);
+
+  // over-debit must surface the app's own rejection text through the engine
+  r = engine.forward_command("acct-cpp-1", "debit|100");
+  if (r.ok || r.rejection.find("insufficient funds") == std::string::npos)
+    return fail("rejection", r.error + r.rejection);
+
+  auto [found, state] = engine.get_state("acct-cpp-1", &error);
+  if (!found || balance_of(state) != 50) return fail("get_state", error);
+
+  auto [missing_found, _] = engine.get_state("acct-cpp-nope", &error);
+  if (missing_found) return fail("missing_state", "expected absent");
+
+  // a second account proves per-aggregate isolation
+  r = engine.forward_command("acct-cpp-2", "create|bob|5");
+  if (!r.ok || balance_of(*r.state) != 5) return fail("create2", r.error);
+
+  printf("SCENARIO PASS\n");
+  fflush(stdout);
+  engine.stop();
+  return 0;
+}

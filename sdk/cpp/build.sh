@@ -1,0 +1,12 @@
+#!/bin/sh
+# Build the surge C++ SDK + BankAccount sample against the system libnghttp2
+# and libprotobuf (protoc generates the message classes into build/).
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+protoc -I ../../proto --cpp_out=build ../../proto/multilanguage.proto
+g++ -O2 -std=c++17 -Wall -Ibuild -I. \
+    -o build/bank_account \
+    bank_account_main.cc surge_sdk.cc build/multilanguage.pb.cc \
+    -l:libnghttp2.so.14 -lprotobuf -lpthread
+echo "built: sdk/cpp/build/bank_account"

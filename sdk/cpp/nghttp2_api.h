@@ -1,0 +1,144 @@
+// Hand-written declarations for the subset of the system libnghttp2 ABI the
+// surge C++ SDK uses (the image ships /lib/x86_64-linux-gnu/libnghttp2.so.14,
+// v1.52, without development headers). These mirror the stable public API of
+// nghttp2 — the same role the reference's C# SDK fills with Grpc.Core's
+// native transport (SurgeEngine.cs:12-80): a real HTTP/2 stack under a thin
+// language binding. Signatures are exercised end-to-end against grpc-python
+// by tests/test_cpp_sdk.py, so any ABI drift fails loudly there.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+#include <sys/types.h>
+
+extern "C" {
+
+typedef struct nghttp2_session nghttp2_session;
+typedef struct nghttp2_session_callbacks nghttp2_session_callbacks;
+
+typedef struct {
+  size_t length;
+  int32_t stream_id;
+  uint8_t type;
+  uint8_t flags;
+  uint8_t reserved;
+} nghttp2_frame_hd;
+
+// the real nghttp2_frame is a union of per-type structs; every variant begins
+// with the frame header, which is all the SDK reads
+typedef struct {
+  nghttp2_frame_hd hd;
+} nghttp2_frame;
+
+typedef struct {
+  uint8_t *name;
+  uint8_t *value;
+  size_t namelen;
+  size_t valuelen;
+  uint8_t flags;
+} nghttp2_nv;
+
+typedef union {
+  int fd;
+  void *ptr;
+} nghttp2_data_source;
+
+typedef ssize_t (*nghttp2_data_source_read_callback)(
+    nghttp2_session *session, int32_t stream_id, uint8_t *buf, size_t length,
+    uint32_t *data_flags, nghttp2_data_source *source, void *user_data);
+
+typedef struct {
+  nghttp2_data_source source;
+  nghttp2_data_source_read_callback read_callback;
+} nghttp2_data_provider;
+
+typedef struct {
+  int32_t settings_id;
+  uint32_t value;
+} nghttp2_settings_entry;
+
+// frame types
+enum {
+  NGHTTP2_DATA = 0,
+  NGHTTP2_HEADERS = 1,
+  NGHTTP2_RST_STREAM = 3,
+  NGHTTP2_SETTINGS = 4,
+  NGHTTP2_GOAWAY = 7,
+  NGHTTP2_WINDOW_UPDATE = 8,
+};
+// frame flags
+enum {
+  NGHTTP2_FLAG_NONE = 0,
+  NGHTTP2_FLAG_END_STREAM = 0x01,
+  NGHTTP2_FLAG_END_HEADERS = 0x04,
+};
+// data source flags
+enum {
+  NGHTTP2_DATA_FLAG_NONE = 0,
+  NGHTTP2_DATA_FLAG_EOF = 0x01,
+  NGHTTP2_DATA_FLAG_NO_END_STREAM = 0x02,
+};
+// nv flags
+enum { NGHTTP2_NV_FLAG_NONE = 0 };
+
+typedef ssize_t (*nghttp2_send_callback)(nghttp2_session *session,
+                                         const uint8_t *data, size_t length,
+                                         int flags, void *user_data);
+typedef int (*nghttp2_on_frame_recv_callback)(nghttp2_session *session,
+                                              const nghttp2_frame *frame,
+                                              void *user_data);
+typedef int (*nghttp2_on_data_chunk_recv_callback)(nghttp2_session *session,
+                                                   uint8_t flags,
+                                                   int32_t stream_id,
+                                                   const uint8_t *data,
+                                                   size_t len, void *user_data);
+typedef int (*nghttp2_on_header_callback)(nghttp2_session *session,
+                                          const nghttp2_frame *frame,
+                                          const uint8_t *name, size_t namelen,
+                                          const uint8_t *value, size_t valuelen,
+                                          uint8_t flags, void *user_data);
+typedef int (*nghttp2_on_stream_close_callback)(nghttp2_session *session,
+                                                int32_t stream_id,
+                                                uint32_t error_code,
+                                                void *user_data);
+
+int nghttp2_session_callbacks_new(nghttp2_session_callbacks **callbacks_ptr);
+void nghttp2_session_callbacks_del(nghttp2_session_callbacks *callbacks);
+void nghttp2_session_callbacks_set_send_callback(
+    nghttp2_session_callbacks *cbs, nghttp2_send_callback cb);
+void nghttp2_session_callbacks_set_on_frame_recv_callback(
+    nghttp2_session_callbacks *cbs, nghttp2_on_frame_recv_callback cb);
+void nghttp2_session_callbacks_set_on_data_chunk_recv_callback(
+    nghttp2_session_callbacks *cbs, nghttp2_on_data_chunk_recv_callback cb);
+void nghttp2_session_callbacks_set_on_header_callback(
+    nghttp2_session_callbacks *cbs, nghttp2_on_header_callback cb);
+void nghttp2_session_callbacks_set_on_stream_close_callback(
+    nghttp2_session_callbacks *cbs, nghttp2_on_stream_close_callback cb);
+
+int nghttp2_session_client_new(nghttp2_session **session_ptr,
+                               const nghttp2_session_callbacks *callbacks,
+                               void *user_data);
+int nghttp2_session_server_new(nghttp2_session **session_ptr,
+                               const nghttp2_session_callbacks *callbacks,
+                               void *user_data);
+void nghttp2_session_del(nghttp2_session *session);
+
+int nghttp2_submit_settings(nghttp2_session *session, uint8_t flags,
+                            const nghttp2_settings_entry *iv, size_t niv);
+int32_t nghttp2_submit_request(nghttp2_session *session, const void *pri_spec,
+                               const nghttp2_nv *nva, size_t nvlen,
+                               const nghttp2_data_provider *data_prd,
+                               void *stream_user_data);
+int nghttp2_submit_response(nghttp2_session *session, int32_t stream_id,
+                            const nghttp2_nv *nva, size_t nvlen,
+                            const nghttp2_data_provider *data_prd);
+int nghttp2_submit_trailer(nghttp2_session *session, int32_t stream_id,
+                           const nghttp2_nv *nva, size_t nvlen);
+
+int nghttp2_session_send(nghttp2_session *session);
+ssize_t nghttp2_session_mem_recv(nghttp2_session *session, const uint8_t *in,
+                                 size_t inlen);
+int nghttp2_session_want_read(nghttp2_session *session);
+int nghttp2_session_want_write(nghttp2_session *session);
+
+}  // extern "C"

@@ -1,0 +1,576 @@
+#include "surge_sdk.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+
+#include "multilanguage.pb.h"
+#include "nghttp2_api.h"
+
+namespace surge {
+namespace {
+
+// 5-byte gRPC message framing: 1 byte compressed flag + u32 big-endian length.
+std::string frame_message(const std::string& payload) {
+  std::string out;
+  out.reserve(payload.size() + 5);
+  out.push_back('\0');
+  uint32_t n = static_cast<uint32_t>(payload.size());
+  out.push_back(static_cast<char>((n >> 24) & 0xFF));
+  out.push_back(static_cast<char>((n >> 16) & 0xFF));
+  out.push_back(static_cast<char>((n >> 8) & 0xFF));
+  out.push_back(static_cast<char>(n & 0xFF));
+  out.append(payload);
+  return out;
+}
+
+bool unframe_message(const std::string& data, std::string* payload) {
+  if (data.size() < 5) return false;
+  uint32_t n = (static_cast<uint8_t>(data[1]) << 24) |
+               (static_cast<uint8_t>(data[2]) << 16) |
+               (static_cast<uint8_t>(data[3]) << 8) |
+               static_cast<uint8_t>(data[4]);
+  if (data.size() < 5 + n) return false;
+  payload->assign(data, 5, n);
+  return true;
+}
+
+nghttp2_nv make_nv(const char* name, const std::string& value) {
+  nghttp2_nv nv;
+  nv.name = reinterpret_cast<uint8_t*>(const_cast<char*>(name));
+  nv.namelen = strlen(name);
+  nv.value = reinterpret_cast<uint8_t*>(const_cast<char*>(value.data()));
+  nv.valuelen = value.size();
+  nv.flags = NGHTTP2_NV_FLAG_NONE;
+  return nv;
+}
+
+// Pump the session: flush pending writes, then block (up to timeout) for
+// readable bytes and feed them in. Returns false on EOF/error.
+bool pump(nghttp2_session* session, int fd, int timeout_ms) {
+  while (nghttp2_session_want_write(session)) {
+    if (nghttp2_session_send(session) != 0) return false;
+  }
+  struct pollfd p = {fd, POLLIN, 0};
+  int r = ::poll(&p, 1, timeout_ms);
+  if (r <= 0) return r == 0;  // timeout is not an error; caller loops
+  uint8_t buf[16384];
+  ssize_t n = ::recv(fd, buf, sizeof(buf), 0);
+  if (n <= 0) return false;
+  if (nghttp2_session_mem_recv(session, buf, static_cast<size_t>(n)) < 0)
+    return false;
+  while (nghttp2_session_want_write(session)) {
+    if (nghttp2_session_send(session) != 0) return false;
+  }
+  return true;
+}
+
+struct OutBuffer {
+  std::string data;
+  size_t offset = 0;
+  bool send_trailers = false;  // server responses end with grpc-status trailers
+};
+
+ssize_t out_read_cb(nghttp2_session* session, int32_t stream_id, uint8_t* buf,
+                    size_t length, uint32_t* data_flags,
+                    nghttp2_data_source* source, void*) {
+  OutBuffer* out = static_cast<OutBuffer*>(source->ptr);
+  size_t left = out->data.size() - out->offset;
+  size_t n = left < length ? left : length;
+  memcpy(buf, out->data.data() + out->offset, n);
+  out->offset += n;
+  if (out->offset == out->data.size()) {
+    *data_flags |= NGHTTP2_DATA_FLAG_EOF;
+    if (out->send_trailers) {
+      *data_flags |= NGHTTP2_DATA_FLAG_NO_END_STREAM;
+      static const std::string kZero = "0";
+      nghttp2_nv trailers[] = {make_nv("grpc-status", kZero)};
+      nghttp2_submit_trailer(session, stream_id, trailers, 1);
+    }
+  }
+  return static_cast<ssize_t>(n);
+}
+
+}  // namespace
+
+// ---- client ----------------------------------------------------------------
+
+struct StreamResult {
+  std::string body;
+  bool closed = false;
+  uint32_t error_code = 0;
+  int grpc_status = 0;
+  std::string grpc_message;
+};
+
+struct GrpcConnection::Impl {
+  std::string host;
+  int port;
+  int fd = -1;
+  nghttp2_session* session = nullptr;
+  std::map<int32_t, StreamResult> streams;
+  std::mutex mutex;  // calls are serialized
+
+  static int on_header(nghttp2_session*, const nghttp2_frame* frame,
+                       const uint8_t* name, size_t namelen,
+                       const uint8_t* value, size_t valuelen, uint8_t,
+                       void* user_data) {
+    Impl* self = static_cast<Impl*>(user_data);
+    auto it = self->streams.find(frame->hd.stream_id);
+    if (it == self->streams.end()) return 0;
+    std::string n(reinterpret_cast<const char*>(name), namelen);
+    std::string v(reinterpret_cast<const char*>(value), valuelen);
+    if (n == "grpc-status") it->second.grpc_status = atoi(v.c_str());
+    if (n == "grpc-message") it->second.grpc_message = v;
+    return 0;
+  }
+
+  static int on_data(nghttp2_session*, uint8_t, int32_t stream_id,
+                     const uint8_t* data, size_t len, void* user_data) {
+    Impl* self = static_cast<Impl*>(user_data);
+    auto it = self->streams.find(stream_id);
+    if (it != self->streams.end())
+      it->second.body.append(reinterpret_cast<const char*>(data), len);
+    return 0;
+  }
+
+  static int on_close(nghttp2_session*, int32_t stream_id, uint32_t error_code,
+                      void* user_data) {
+    Impl* self = static_cast<Impl*>(user_data);
+    auto it = self->streams.find(stream_id);
+    if (it != self->streams.end()) {
+      it->second.closed = true;
+      it->second.error_code = error_code;
+    }
+    return 0;
+  }
+};
+
+GrpcConnection::GrpcConnection(std::string host, int port)
+    : impl_(new Impl{std::move(host), port}) {}
+
+GrpcConnection::~GrpcConnection() { close(); }
+
+bool GrpcConnection::connect(std::string* error) {
+  Impl* im = impl_.get();
+  im->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (im->fd < 0) {
+    *error = "socket() failed";
+    return false;
+  }
+  int one = 1;
+  setsockopt(im->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(im->port));
+  inet_pton(AF_INET, im->host.c_str(), &addr.sin_addr);
+  if (::connect(im->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    *error = "connect() to " + im->host + " failed";
+    return false;
+  }
+
+  nghttp2_session_callbacks* cbs = nullptr;
+  nghttp2_session_callbacks_new(&cbs);
+  nghttp2_session_callbacks_set_on_header_callback(cbs, Impl::on_header);
+  nghttp2_session_callbacks_set_on_data_chunk_recv_callback(cbs, Impl::on_data);
+  nghttp2_session_callbacks_set_on_stream_close_callback(cbs, Impl::on_close);
+  nghttp2_session_callbacks_set_send_callback(
+      cbs, [](nghttp2_session*, const uint8_t* data, size_t length, int,
+              void* user_data) -> ssize_t {
+        Impl* self = static_cast<Impl*>(user_data);
+        ssize_t sent = ::send(self->fd, data, length, 0);
+        return sent < 0 ? -902 : sent;
+      });
+  nghttp2_session_client_new(&im->session, cbs, im);
+  nghttp2_session_callbacks_del(cbs);
+  nghttp2_submit_settings(im->session, NGHTTP2_FLAG_NONE, nullptr, 0);
+  if (nghttp2_session_send(im->session) != 0) {
+    *error = "HTTP/2 handshake send failed";
+    return false;
+  }
+  return true;
+}
+
+bool GrpcConnection::call(const std::string& path, const std::string& request,
+                          std::string* response, std::string* error) {
+  Impl* im = impl_.get();
+  std::lock_guard<std::mutex> lock(im->mutex);
+  if (im->session == nullptr) {
+    *error = "not connected";
+    return false;
+  }
+  OutBuffer out;
+  out.data = frame_message(request);
+  nghttp2_data_provider provider;
+  provider.source.ptr = &out;
+  provider.read_callback = out_read_cb;
+  static const std::string kPost = "POST", kScheme = "http",
+                           kContentType = "application/grpc", kTe = "trailers";
+  nghttp2_nv nva[] = {
+      make_nv(":method", kPost),        make_nv(":scheme", kScheme),
+      make_nv(":path", path),           make_nv(":authority", im->host),
+      make_nv("content-type", kContentType), make_nv("te", kTe),
+  };
+  int32_t stream_id = nghttp2_submit_request(im->session, nullptr, nva, 6,
+                                             &provider, nullptr);
+  if (stream_id < 0) {
+    *error = "submit_request failed";
+    return false;
+  }
+  im->streams[stream_id] = StreamResult{};
+  // pump until the stream closes (the sidecar answers unary calls promptly;
+  // 30s total budget mirrors the engine's command timeout)
+  for (int i = 0; i < 300; i++) {
+    StreamResult& st = im->streams[stream_id];
+    if (st.closed) break;
+    if (!pump(im->session, im->fd, 100)) {
+      im->streams.erase(stream_id);
+      *error = "connection lost mid-call";
+      return false;
+    }
+  }
+  StreamResult st = im->streams[stream_id];
+  im->streams.erase(stream_id);
+  if (!st.closed) {
+    *error = "rpc timed out";
+    return false;
+  }
+  if (st.error_code != 0 || st.grpc_status != 0) {
+    *error = "rpc failed: grpc-status=" + std::to_string(st.grpc_status) +
+             (st.grpc_message.empty() ? "" : " (" + st.grpc_message + ")");
+    return false;
+  }
+  if (!unframe_message(st.body, response)) {
+    *error = "malformed grpc response framing";
+    return false;
+  }
+  return true;
+}
+
+void GrpcConnection::close() {
+  Impl* im = impl_.get();
+  if (im->session != nullptr) {
+    nghttp2_session_del(im->session);
+    im->session = nullptr;
+  }
+  if (im->fd >= 0) {
+    ::close(im->fd);
+    im->fd = -1;
+  }
+}
+
+// ---- server ----------------------------------------------------------------
+
+namespace {
+
+struct ServerStream {
+  std::string path;
+  std::string body;
+  OutBuffer out;  // response buffer must outlive the data provider
+};
+
+struct ServerConn {
+  nghttp2_session* session = nullptr;
+  int fd = -1;
+  std::map<int32_t, ServerStream> streams;
+  const std::map<std::string, UnaryHandler>* handlers = nullptr;
+
+  void dispatch(int32_t stream_id) {
+    ServerStream& st = streams[stream_id];
+    static const std::string kStatus200 = "200",
+                             kContentType = "application/grpc";
+    auto it = handlers->find(st.path);
+    if (it == handlers->end()) {
+      static const std::string kUnimplemented = "12";
+      nghttp2_nv nva[] = {make_nv(":status", kStatus200),
+                          make_nv("content-type", kContentType),
+                          make_nv("grpc-status", kUnimplemented)};
+      nghttp2_submit_response(session, stream_id, nva, 3, nullptr);
+      return;
+    }
+    std::string request;
+    std::string reply_bytes;
+    bool handler_ok = true;
+    if (unframe_message(st.body, &request)) {
+      // an app exception must never unwind through the C library frames below
+      // us (std::terminate); surface it as INTERNAL like the Python SDK does
+      try {
+        reply_bytes = it->second(request);
+      } catch (const std::exception& e) {
+        fprintf(stderr, "handler %s threw: %s\n", st.path.c_str(), e.what());
+        handler_ok = false;
+      } catch (...) {
+        fprintf(stderr, "handler %s threw a non-std exception\n",
+                st.path.c_str());
+        handler_ok = false;
+      }
+    }
+    if (!handler_ok) {
+      static const std::string kInternal = "13";
+      nghttp2_nv nva[] = {make_nv(":status", kStatus200),
+                          make_nv("content-type", kContentType),
+                          make_nv("grpc-status", kInternal)};
+      nghttp2_submit_response(session, stream_id, nva, 3, nullptr);
+      return;
+    }
+    st.out.data = frame_message(reply_bytes);
+    st.out.send_trailers = true;
+    nghttp2_data_provider provider;
+    provider.source.ptr = &st.out;
+    provider.read_callback = out_read_cb;
+    nghttp2_nv nva[] = {make_nv(":status", kStatus200),
+                        make_nv("content-type", kContentType)};
+    nghttp2_submit_response(session, stream_id, nva, 2, &provider);
+  }
+
+  static int on_header(nghttp2_session*, const nghttp2_frame* frame,
+                       const uint8_t* name, size_t namelen,
+                       const uint8_t* value, size_t valuelen, uint8_t,
+                       void* user_data) {
+    ServerConn* self = static_cast<ServerConn*>(user_data);
+    std::string n(reinterpret_cast<const char*>(name), namelen);
+    if (n == ":path")
+      self->streams[frame->hd.stream_id].path =
+          std::string(reinterpret_cast<const char*>(value), valuelen);
+    return 0;
+  }
+
+  static int on_data(nghttp2_session*, uint8_t, int32_t stream_id,
+                     const uint8_t* data, size_t len, void* user_data) {
+    ServerConn* self = static_cast<ServerConn*>(user_data);
+    self->streams[stream_id].body.append(reinterpret_cast<const char*>(data),
+                                         len);
+    return 0;
+  }
+
+  static int on_frame_recv(nghttp2_session*, const nghttp2_frame* frame,
+                           void* user_data) {
+    ServerConn* self = static_cast<ServerConn*>(user_data);
+    if ((frame->hd.type == NGHTTP2_DATA || frame->hd.type == NGHTTP2_HEADERS) &&
+        (frame->hd.flags & NGHTTP2_FLAG_END_STREAM) &&
+        self->streams.count(frame->hd.stream_id)) {
+      self->dispatch(frame->hd.stream_id);
+    }
+    return 0;
+  }
+
+  static int on_close(nghttp2_session*, int32_t stream_id, uint32_t,
+                      void* user_data) {
+    static_cast<ServerConn*>(user_data)->streams.erase(stream_id);
+    return 0;
+  }
+};
+
+}  // namespace
+
+GrpcServer::GrpcServer() = default;
+GrpcServer::~GrpcServer() { stop(); }
+
+void GrpcServer::handle(const std::string& path, UnaryHandler handler) {
+  handlers_[path] = std::move(handler);
+}
+
+int GrpcServer::start(int port) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+    return -1;
+  ::listen(listen_fd_, 8);
+  socklen_t len = sizeof(addr);
+  getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  thread_ = std::thread([this] { accept_loop(); });
+  return ntohs(addr.sin_port);
+}
+
+void GrpcServer::accept_loop() {
+  while (!stopping_) {
+    struct pollfd p = {listen_fd_, POLLIN, 0};
+    if (::poll(&p, 1, 200) <= 0) continue;
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) continue;
+    serve_connection(fd);  // the sidecar holds one channel; serve it fully
+    ::close(fd);
+  }
+}
+
+void GrpcServer::serve_connection(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  ServerConn conn;
+  conn.fd = fd;
+  conn.handlers = &handlers_;
+  nghttp2_session_callbacks* cbs = nullptr;
+  nghttp2_session_callbacks_new(&cbs);
+  nghttp2_session_callbacks_set_on_header_callback(cbs, ServerConn::on_header);
+  nghttp2_session_callbacks_set_on_data_chunk_recv_callback(cbs,
+                                                            ServerConn::on_data);
+  nghttp2_session_callbacks_set_on_frame_recv_callback(
+      cbs, ServerConn::on_frame_recv);
+  nghttp2_session_callbacks_set_on_stream_close_callback(cbs,
+                                                         ServerConn::on_close);
+  nghttp2_session_callbacks_set_send_callback(
+      cbs, [](nghttp2_session*, const uint8_t* data, size_t length, int,
+              void* user_data) -> ssize_t {
+        ServerConn* self = static_cast<ServerConn*>(user_data);
+        ssize_t sent = ::send(self->fd, data, length, 0);
+        return sent < 0 ? -902 : sent;
+      });
+  nghttp2_session_server_new(&conn.session, cbs, &conn);
+  nghttp2_session_callbacks_del(cbs);
+  nghttp2_submit_settings(conn.session, NGHTTP2_FLAG_NONE, nullptr, 0);
+
+  while (!stopping_ && (nghttp2_session_want_read(conn.session) ||
+                        nghttp2_session_want_write(conn.session))) {
+    if (!pump(conn.session, fd, 200)) break;
+  }
+  nghttp2_session_del(conn.session);
+}
+
+void GrpcServer::stop() {
+  stopping_ = true;
+  if (thread_.joinable()) thread_.join();
+  if (listen_fd_ >= 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+}
+
+// ---- SDK surface -----------------------------------------------------------
+
+namespace ml = surge_tpu::multilanguage;
+
+static const char kBusinessService[] = "/surge_tpu.multilanguage.BusinessLogic";
+static const char kGatewayService[] =
+    "/surge_tpu.multilanguage.MultilanguageGateway";
+
+SurgeEngine::SurgeEngine(CqrsModel model) : model_(std::move(model)) {}
+SurgeEngine::~SurgeEngine() { stop(); }
+
+int SurgeEngine::start_business_service(int port) {
+  server_.handle(
+      std::string(kBusinessService) + "/ProcessCommand",
+      [this](const std::string& raw) {
+        ml::ProcessCommandRequest req;
+        req.ParseFromString(raw);
+        ml::ProcessCommandReply reply;
+        std::optional<std::string> state;
+        if (req.state().exists()) state = req.state().payload();
+        try {
+          auto events = model_.process_command(state, req.command().payload());
+          reply.set_success(true);
+          for (const auto& ev : events) {
+            ml::DomainEvent* out = reply.add_events();
+            out->set_aggregate_id(req.command().aggregate_id());
+            out->set_payload(ev);
+          }
+        } catch (const CommandRejected& rej) {
+          reply.set_success(false);
+          reply.set_rejection(rej.what());
+        }
+        return reply.SerializeAsString();
+      });
+  server_.handle(
+      std::string(kBusinessService) + "/HandleEvents",
+      [this](const std::string& raw) {
+        ml::HandleEventsRequest req;
+        req.ParseFromString(raw);
+        std::optional<std::string> state;
+        if (req.state().exists()) state = req.state().payload();
+        std::vector<std::string> events;
+        std::string aggregate_id = req.state().aggregate_id();
+        for (const auto& ev : req.events()) {
+          events.push_back(ev.payload());
+          aggregate_id = ev.aggregate_id();
+        }
+        auto new_state = model_.handle_events(state, events);
+        ml::HandleEventsReply reply;
+        reply.mutable_state()->set_aggregate_id(aggregate_id);
+        if (new_state.has_value()) {
+          reply.mutable_state()->set_exists(true);
+          reply.mutable_state()->set_payload(*new_state);
+        } else {
+          reply.mutable_state()->set_exists(false);
+        }
+        return reply.SerializeAsString();
+      });
+  server_.handle(std::string(kBusinessService) + "/HealthCheck",
+                 [](const std::string&) {
+                   ml::HealthReply reply;
+                   reply.set_status("up");
+                   return reply.SerializeAsString();
+                 });
+  return server_.start(port);
+}
+
+bool SurgeEngine::connect_gateway(const std::string& host, int port,
+                                  std::string* error) {
+  gateway_.reset(new GrpcConnection(host, port));
+  return gateway_->connect(error);
+}
+
+ForwardResult SurgeEngine::forward_command(const std::string& aggregate_id,
+                                           const std::string& command_payload) {
+  ForwardResult result;
+  ml::ForwardCommandRequest req;
+  req.mutable_command()->set_aggregate_id(aggregate_id);
+  req.mutable_command()->set_payload(command_payload);
+  std::string raw;
+  if (!gateway_->call(std::string(kGatewayService) + "/ForwardCommand",
+                      req.SerializeAsString(), &raw, &result.error)) {
+    return result;
+  }
+  ml::ForwardCommandReply reply;
+  reply.ParseFromString(raw);
+  if (!reply.success()) {
+    result.rejection = reply.rejection();
+    return result;
+  }
+  result.ok = true;
+  if (reply.state().exists()) result.state = reply.state().payload();
+  return result;
+}
+
+std::pair<bool, std::string> SurgeEngine::get_state(
+    const std::string& aggregate_id, std::string* error) {
+  ml::GetStateRequest req;
+  req.set_aggregate_id(aggregate_id);
+  std::string raw;
+  if (!gateway_->call(std::string(kGatewayService) + "/GetState",
+                      req.SerializeAsString(), &raw, error)) {
+    return {false, ""};
+  }
+  ml::GetStateReply reply;
+  reply.ParseFromString(raw);
+  if (!reply.state().exists()) return {false, ""};
+  return {true, reply.state().payload()};
+}
+
+std::string SurgeEngine::gateway_health(std::string* error) {
+  ml::HealthRequest req;
+  std::string raw;
+  if (!gateway_->call(std::string(kGatewayService) + "/HealthCheck",
+                      req.SerializeAsString(), &raw, error)) {
+    return "";
+  }
+  ml::HealthReply reply;
+  reply.ParseFromString(raw);
+  return reply.status();
+}
+
+void SurgeEngine::stop() {
+  if (gateway_) gateway_->close();
+  server_.stop();
+}
+
+}  // namespace surge

@@ -1,0 +1,122 @@
+// surge C++ SDK — the second-language proof of the multilanguage sidecar
+// protocol (the role of the reference's C# SDK, SurgeEngine.cs:12-80 +
+// CqrsModel.cs): a native app hosts the BusinessLogic service (engine -> app
+// callbacks) and drives the engine through the MultilanguageGateway service
+// (app -> engine), speaking real gRPC over HTTP/2 (system libnghttp2 +
+// libprotobuf) against the Python sidecar — proto/multilanguage.proto is the
+// whole contract, exactly as the reference's proto is for its SDKs.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace surge {
+
+// ---- transport -------------------------------------------------------------
+
+// One blocking gRPC-over-HTTP/2 client connection (unary calls only).
+class GrpcConnection {
+ public:
+  GrpcConnection(std::string host, int port);
+  ~GrpcConnection();
+  GrpcConnection(const GrpcConnection&) = delete;
+  GrpcConnection& operator=(const GrpcConnection&) = delete;
+
+  bool connect(std::string* error);
+  // Unary call: serialized request in, serialized response out. Returns false
+  // on transport/stream failure or non-zero grpc-status.
+  bool call(const std::string& path, const std::string& request,
+            std::string* response, std::string* error);
+  void close();
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+// Minimal gRPC server hosting unary handlers (one connection at a time — the
+// sidecar engine holds exactly one channel to the app).
+using UnaryHandler = std::function<std::string(const std::string& request)>;
+
+class GrpcServer {
+ public:
+  GrpcServer();
+  ~GrpcServer();
+
+  void handle(const std::string& path, UnaryHandler handler);
+  int start(int port);  // returns bound port (port 0 = ephemeral)
+  void stop();
+
+ private:
+  void accept_loop();
+  void serve_connection(int fd);
+
+  std::map<std::string, UnaryHandler> handlers_;
+  int listen_fd_ = -1;
+  std::thread thread_;
+  std::atomic<bool> stopping_{false};
+};
+
+// ---- SDK surface (CQRSModel / SurgeEngine analog) ---------------------------
+
+// Raised by process_command to reject a command (CommandRejectedByApp role).
+struct CommandRejected : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// Two pure functions over app-serialized bytes (the app composes its own
+// domain serde around them, like the reference SDKs' SerDeser).
+struct CqrsModel {
+  // (state or nullopt, command payload) -> event payloads; throw
+  // CommandRejected to reject.
+  std::function<std::vector<std::string>(const std::optional<std::string>&,
+                                         const std::string&)>
+      process_command;
+  // (state or nullopt, event payloads) -> new state (nullopt = delete)
+  std::function<std::optional<std::string>(
+      const std::optional<std::string>&, const std::vector<std::string>&)>
+      handle_events;
+};
+
+struct ForwardResult {
+  bool ok = false;             // transport + command success
+  std::string rejection;       // non-empty when the engine rejected it
+  std::optional<std::string> state;  // post-command state payload
+  std::string error;           // transport-level failure detail
+};
+
+class SurgeEngine {
+ public:
+  explicit SurgeEngine(CqrsModel model);
+  ~SurgeEngine();
+
+  // Host the BusinessLogic service for the sidecar's callbacks.
+  int start_business_service(int port = 0);
+  // Connect to the sidecar's MultilanguageGateway.
+  bool connect_gateway(const std::string& host, int port, std::string* error);
+
+  ForwardResult forward_command(const std::string& aggregate_id,
+                                const std::string& command_payload);
+  // (found, state payload) — found=false means no such aggregate.
+  std::pair<bool, std::string> get_state(const std::string& aggregate_id,
+                                         std::string* error);
+  std::string gateway_health(std::string* error);
+
+  void stop();
+
+ private:
+  CqrsModel model_;
+  GrpcServer server_;
+  std::unique_ptr<GrpcConnection> gateway_;
+};
+
+}  // namespace surge

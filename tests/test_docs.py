@@ -32,24 +32,18 @@ def extract_blocks(name: str) -> list:
     return [b for b in BLOCK_RE.findall(text) if "..." not in b]
 
 
-def _free_port() -> int:
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 @pytest.mark.parametrize("doc", EXECUTABLE_DOCS)
 def test_doc_snippets_execute(doc, tmp_path):
+    from conftest import free_ports
+
     blocks = extract_blocks(doc)
     assert blocks, f"{doc} has no executable python blocks"
     source = "\n".join(blocks)
     source = source.replace("/var/lib/surge", str(tmp_path / "surge"))
     # the docs use fixed narrative ports; isolate concurrent test runs by
-    # substituting free ephemeral ones
-    for narrative_port in ("16000", "17000"):
-        source = source.replace(narrative_port, str(_free_port()))
+    # substituting distinct free ephemeral ones
+    for narrative_port, port in zip(("16000", "17000"), free_ports(2)):
+        source = source.replace(narrative_port, str(port))
     program = ("async def __doc_main__():\n"
                + textwrap.indent(source, "    ")
                + "\n")
