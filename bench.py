@@ -116,7 +116,6 @@ def replay_child(corpus_dir: str) -> None:
 
     from surge_tpu.config import default_config
     from surge_tpu.models.counter import make_replay_spec
-    from surge_tpu.replay.corpus import synth_counter_corpus
     from surge_tpu.replay.engine import ReplayEngine
 
     time_chunk = int(os.environ.get("SURGE_BENCH_TIME_CHUNK", 128))
@@ -129,27 +128,73 @@ def replay_child(corpus_dir: str) -> None:
     })
     engine = ReplayEngine(make_replay_spec(), config=cfg)
 
-    # warm up EVERY compiled program the measured run can dispatch. Window plans
-    # are per B-chunk (local max length), so use one full-width B-chunk per
-    # program: a chunk whose max length IS a ladder width dispatches exactly
-    # that tail program, plus one chunk of full time-chunk length — no XLA
-    # compilation can land inside the timed window regardless of the corpus's
-    # length distribution
-    widths = engine.ladder_widths() + [max(engine.time_chunk, 1)]
-    warm_lengths = np.repeat(np.asarray(sorted(widths), dtype=np.int64),
-                             engine.batch_size)
-    warm = synth_counter_corpus(0, 0, seed=1, lengths=warm_lengths)
-    engine.replay_columnar(warm.events)
+    # The resident path (default) ships the corpus ONCE (1 byte/event, zero
+    # padding on the link) and every fold gathers on-device — the measured
+    # time is the flat pack + upload + all folds. Gather programs depend on
+    # the buffer's static length, so they are warmed on the REAL buffer with
+    # zero-length no-op folds (state untouched) before the timed fold pass.
+    # SURGE_BENCH_RESIDENT=0 falls back to the streaming window path, whose
+    # fixed-shape programs ARE warmable corpus-free: one all-padding
+    # [width, batch] window per ladder width + the full chunk.
+    resident_mode = os.environ.get("SURGE_BENCH_RESIDENT", "1") == "1"
+    bs = engine.batch_size
+    if not resident_mode:
+        union_cols = {f.name: np.zeros((bs, 1), dtype=f.dtype)
+                      for f in make_replay_spec().registry.union_columns()}
+        for width in engine.ladder_widths() + [max(engine.time_chunk, 1)]:
+            carry = engine._carry_slice(None, 0, bs, bs)
+            pad_ids = np.full((bs, width), -1, dtype=np.int32)
+            cols = {name: np.zeros((bs, width), dtype=col.dtype)
+                    for name, col in union_cols.items()
+                    if name not in ("sequence_number",)}
+            engine._fold_window(carry, pad_ids, cols, bs,
+                                derived_cols={"sequence_number": "ordinal"})
     engine.stats.update(pack_s=0.0, h2d_s=0.0, windows=0)
     warm_compiles = engine.num_compiles()
     log(f"child warmup done, compiled programs: {warm_compiles}")
 
-    t0 = time.perf_counter()
-    result = engine.replay_columnar(corpus.events)
-    replay_s = time.perf_counter() - t0
-    if engine.num_compiles() != warm_compiles:
-        log(f"WARNING: {engine.num_compiles() - warm_compiles} program(s) "
-            f"compiled INSIDE the timed window (warmup gap)")
+    extra_timing = {}
+    if resident_mode:
+        t0 = time.perf_counter()
+        resident = engine.prepare_resident(corpus.events)
+        prepare_s = time.perf_counter() - t0
+        gfold = engine._gather_fold(frozenset(resident.derived_key.items()))
+        # warm at the EFFECTIVE dispatch batch (replay_resident rounds small
+        # corpora down), or every timed dispatch would be a cold signature
+        lane = engine._lane_multiple()
+        b = resident.lengths.shape[0]
+        bs_eff = min(engine.batch_size, -(-max(b, 1) // lane) * lane)
+        zeros = np.zeros((bs_eff,), dtype=np.int32)
+        rkey = frozenset(resident.derived_key.items())
+        for width in engine.resident_widths(int(resident.lengths.max(initial=1))):
+            carry = engine._carry_slice(None, 0, bs_eff, bs_eff)
+            carry = gfold(carry, resident.flat_word, resident.flat_side,
+                          zeros, zeros, zeros, np.int32(0), width)
+            # register the warm signature so the post-run delta check is exact
+            engine._signatures.add(("resident", rkey, width, bs_eff))
+        import jax
+
+        jax.block_until_ready(carry)
+        warm_compiles = engine.num_compiles()
+        log(f"resident corpus: {resident.wire_bytes / 1e6:.0f} MB shipped in "
+            f"{resident.upload_s:.1f}s; gather programs warmed")
+        t0 = time.perf_counter()
+        result = engine.replay_resident(resident)
+        fold_s = time.perf_counter() - t0
+        if engine.num_compiles() != warm_compiles:
+            log(f"WARNING: {engine.num_compiles() - warm_compiles} program(s) "
+                f"compiled INSIDE the timed window (warmup gap)")
+        replay_s = prepare_s + fold_s
+        extra_timing = {"upload_s": round(resident.upload_s, 2),
+                        "fold_s": round(fold_s, 2),
+                        "wire_mb": round(resident.wire_bytes / 1e6, 1)}
+    else:
+        t0 = time.perf_counter()
+        result = engine.replay_columnar(corpus.events)
+        replay_s = time.perf_counter() - t0
+        if engine.num_compiles() != warm_compiles:
+            log(f"WARNING: {engine.num_compiles() - warm_compiles} program(s) "
+                f"compiled INSIDE the timed window (warmup gap)")
 
     if not np.array_equal(result.states["count"], corpus.expected_count):
         raise AssertionError("replay count mismatch vs closed-form fold")
@@ -158,10 +203,18 @@ def replay_child(corpus_dir: str) -> None:
     if result.num_events != corpus.num_events:
         raise AssertionError("replay event accounting mismatch")
 
+    # Device-resident fold ceiling: re-fold one full window with inputs pinned
+    # on device — no host link involved — to separate the DESIGN's TPU fold
+    # rate from the tunnel/PCIe transfer bound that governs events_per_sec.
+    device_eps = _device_resident_fold_rate(engine, corpus)
+    log(f"device-resident fold rate: {device_eps:,.0f} event-slots/s "
+        f"(transfer-free)")
+
     eps = corpus.num_events / replay_s
     payload = {
         "platform": platform,
         "events_per_sec": round(eps),
+        "device_fold_events_per_sec": round(device_eps),
         "aggregates_per_sec": round(corpus.num_aggregates / replay_s),
         "replay_s": round(replay_s, 2),
         "pad_ratio": round(result.padded_events / max(corpus.num_events, 1), 3),
@@ -171,11 +224,50 @@ def replay_child(corpus_dir: str) -> None:
         "compiles": engine.num_compiles(),
         "num_events": corpus.num_events,
         "num_aggregates": corpus.num_aggregates,
+        **extra_timing,
     }
     log(f"child replay: {corpus.num_events:,} events in {replay_s:.2f}s -> "
         f"{eps:,.0f} events/s (pad {payload['pad_ratio']}, pack {payload['pack_s']}s, "
         f"{payload['windows']} windows, {payload['compiles']} programs, verified)")
     print(json.dumps(payload), flush=True)
+
+
+def _device_resident_fold_rate(engine, corpus) -> float:
+    """Slots/s of the compiled fold with every input already on device (carry
+    donated and chained): the compute ceiling the replay would reach on a host
+    whose link is not the bottleneck."""
+    import jax
+    import jax.numpy as jnp
+
+    bs = engine.batch_size
+    chunk = max(engine.time_chunk, 1)
+    key, wire, fold = engine._wire_fold({"sequence_number": "ordinal"})
+    ev = corpus.events
+    # one full window of real corpus data (batch-major [b, T] densify)
+    from surge_tpu.codec.tensor import columnar_to_batch
+
+    sub = ev.sorted_by_aggregate().slice_aggregates(0, min(bs, ev.num_aggregates))
+    enc = columnar_to_batch(sub, pad_to=None)
+    t = min(enc.max_len, chunk)
+    packed, side = wire.pack_window(enc.type_ids, enc.cols, 0, t, chunk, bs)
+    packed_dev = jax.device_put(packed)
+    side_dev = {k: jax.device_put(v) for k, v in side.items()}
+    ord_dev = jax.device_put(np.zeros((bs,), dtype=np.int32))
+    carry = engine._carry_slice(None, 0, bs, bs)
+    carry = fold(carry, packed_dev, side_dev, ord_dev)  # warm/compile
+    jax.block_until_ready(carry)
+    # calibrate iterations to a ~2s measurement
+    t0 = time.perf_counter()
+    carry = fold(carry, packed_dev, side_dev, ord_dev)
+    jax.block_until_ready(carry)
+    per_iter = max(time.perf_counter() - t0, 1e-5)
+    iters = max(int(2.0 / per_iter), 3)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry = fold(carry, packed_dev, side_dev, ord_dev)
+    jax.block_until_ready(carry)
+    dt = time.perf_counter() - t0
+    return iters * chunk * bs / dt
 
 
 def run_replay_child(env: dict, corpus_dir: str, label: str) -> dict | None:
@@ -283,8 +375,10 @@ def _merge_replay(payload: dict, child: dict, cpu_eps: float) -> None:
     payload["value"] = child["events_per_sec"]
     payload["vs_baseline"] = round(child["events_per_sec"] / cpu_eps, 2) if cpu_eps else 0
     for k in ("platform", "aggregates_per_sec", "replay_s", "pad_ratio", "pack_s",
-              "h2d_s", "windows", "compiles"):
-        payload[k] = child[k]
+              "h2d_s", "windows", "compiles", "device_fold_events_per_sec",
+              "upload_s", "fold_s", "wire_mb"):
+        if k in child:
+            payload[k] = child[k]
 
 
 def main() -> None:

@@ -8,6 +8,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <cstdio>
 
 #include "multilanguage.pb.h"
@@ -224,9 +225,12 @@ bool GrpcConnection::call(const std::string& path, const std::string& request,
     return false;
   }
   im->streams[stream_id] = StreamResult{};
-  // pump until the stream closes (the sidecar answers unary calls promptly;
-  // 30s total budget mirrors the engine's command timeout)
-  for (int i = 0; i < 300; i++) {
+  // pump until the stream closes, bounded by WALL TIME (30s, mirroring the
+  // engine's command timeout) — an iteration cap would misreport large
+  // responses arriving in many recv chunks as timeouts
+  const auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(30);
+  while (std::chrono::steady_clock::now() < deadline) {
     StreamResult& st = im->streams[stream_id];
     if (st.closed) break;
     if (!pump(im->session, im->fd, 100)) {
@@ -297,7 +301,17 @@ struct ServerConn {
     std::string request;
     std::string reply_bytes;
     bool handler_ok = true;
-    if (unframe_message(st.body, &request)) {
+    if (!unframe_message(st.body, &request)) {
+      // malformed/absent gRPC framing must NOT read as a successful empty
+      // reply — answer INVALID_ARGUMENT so the client sees the error
+      static const std::string kInvalidArgument = "3";
+      nghttp2_nv nva[] = {make_nv(":status", kStatus200),
+                          make_nv("content-type", kContentType),
+                          make_nv("grpc-status", kInvalidArgument)};
+      nghttp2_submit_response(session, stream_id, nva, 3, nullptr);
+      return;
+    }
+    {
       // an app exception must never unwind through the C library frames below
       // us (std::terminate); surface it as INTERNAL like the Python SDK does
       try {

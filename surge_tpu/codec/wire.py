@@ -105,21 +105,9 @@ class WireFormat:
         """
         b = type_ids.shape[0]
         width = stop - start
-        tid = type_ids[:, start:stop]
-        # out-of-range ids — padding (-1) or corrupt positive values — pack as the pad
-        # sentinel so they carry state through (the same contract make_step_fn keeps
-        # for the unpacked path); a corrupt id must never spill into field bits
-        word = np.where((tid < 0) | (tid >= self.num_types),
-                        self.pad_code, tid).astype(np.uint32)
-        for pf in self.packed_fields:
-            col = cols[pf.name][:, start:stop]
-            # dtype-preserving range check (no int64 temporary on the hot path);
-            # catches negatives and any value past the width, incl. 2**32 multiples
-            if col.size and ((col < 0) | (col > pf.mask)).any():
-                raise ValueError(
-                    f"column {pf.name!r} overflows its declared {pf.bits}-bit wire "
-                    f"width (max value {int(col.max())}, min {int(col.min())})")
-            word |= col.astype(np.uint32) << np.uint32(pf.shift)
+        word = self._pack_words(type_ids[:, start:stop],
+                                {pf.name: cols[pf.name][:, start:stop]
+                                 for pf in self.packed_fields})
 
         packed = np.empty((chunk, bs, self.nbytes), dtype=np.uint8)
         for k in range(self.nbytes):
@@ -133,7 +121,76 @@ class WireFormat:
             side[f.name] = buf
         return packed, side
 
+    def _pack_words(self, type_ids: np.ndarray,
+                    cols: Mapping[str, np.ndarray]) -> np.ndarray:
+        """The shared word-build: out-of-range ids — padding (-1) or corrupt
+        positive values — pack as the pad sentinel so they carry state through
+        (the same contract make_step_fn keeps for the unpacked path); a corrupt
+        id must never spill into field bits. Dtype-preserving range checks
+        catch negatives and any value past each declared width."""
+        tid = np.asarray(type_ids)
+        word = np.where((tid < 0) | (tid >= self.num_types),
+                        self.pad_code, tid).astype(np.uint32)
+        for pf in self.packed_fields:
+            col = np.asarray(cols[pf.name])
+            if col.size and ((col < 0) | (col > pf.mask)).any():
+                raise ValueError(
+                    f"column {pf.name!r} overflows its declared {pf.bits}-bit "
+                    f"wire width (max value {int(col.max())}, "
+                    f"min {int(col.min())})")
+            word |= col.astype(np.uint32) << np.uint32(pf.shift)
+        return word
+
+    def pack_flat(self, type_ids: np.ndarray, cols: Mapping[str, np.ndarray]
+                  ) -> tuple[np.ndarray, dict[str, np.ndarray]]:
+        """Pack a FLAT event stream ``[N]`` into ``(packed uint8 [N, nbytes],
+        side {name: [N]})`` — the resident-corpus wire form: exactly
+        ``wire_bytes_per_event()`` per real event, no window padding at all.
+        The device slices per-aggregate slabs from it (see
+        :meth:`decode_words`)."""
+        word = self._pack_words(type_ids,
+                                {pf.name: cols[pf.name]
+                                 for pf in self.packed_fields})
+        n = word.shape[0]
+        packed = np.empty((n, self.nbytes), dtype=np.uint8)
+        for k in range(self.nbytes):
+            packed[:, k] = (word >> np.uint32(8 * k)) & np.uint32(0xFF)
+        side = {f.name: np.ascontiguousarray(cols[f.name], dtype=f.dtype)
+                for f in self.side_fields}
+        return packed, side
+
     # -- device side ----------------------------------------------------------------------
+
+    def expand_flat(self, packed: Any) -> Any:
+        """One-time on-device expansion of the flat packed bytes to a u32 word
+        array ``[N]`` (gather-friendly lanes; HBM-resident, never transferred)."""
+        import jax.numpy as jnp
+
+        word = packed[:, 0].astype(jnp.uint32)
+        for k in range(1, self.nbytes):
+            word = word | (packed[:, k].astype(jnp.uint32) << np.uint32(8 * k))
+        return word
+
+    def decode_words(self, word: Any, side_row: Mapping[str, Any], valid: Any,
+                     ord_base: Any, t: Any) -> dict[str, Any]:
+        """JAX-traceable decode of one scan step's word row ``[B]`` (extracted
+        from a resident flat corpus by contiguous per-lane slabs): slots with
+        ``valid`` false decode to the pad sentinel, and the derived ordinal is
+        ``ord_base[b] + t + 1``."""
+        import jax.numpy as jnp
+
+        tid = (word & np.uint32((1 << self.type_bits) - 1)).astype(jnp.int32)
+        tid = jnp.where(tid >= self.num_types, jnp.int32(-1), tid)
+        events: dict[str, Any] = {
+            "type_id": jnp.where(valid, tid, jnp.int32(-1))}
+        for pf in self.packed_fields:
+            raw = (word >> np.uint32(pf.shift)) & np.uint32(pf.mask)
+            events[pf.name] = raw.astype(pf.dtype)
+        for f in self.side_fields:
+            events[f.name] = side_row[f.name]
+        for f in self.derived_fields:
+            events[f.name] = (ord_base.astype(jnp.int32) + t + 1).astype(f.dtype)
+        return events
 
     def decode(self, packed: Any, side: Mapping[str, Any], ord_base: Any
                ) -> dict[str, Any]:

@@ -62,6 +62,9 @@ DEFAULTS: dict[str, Any] = {
     # tail windows shrink through a power-of-two ladder down to this width instead
     # of padding to a full time-chunk (pad_ratio lever; 0/neg disables the ladder)
     "surge.replay.min-time-window": 8,
+    # resident-corpus replay: HBM budget for one dispatch's [batch, width] slab
+    # (plus its transpose); bounds the scan width of long-log chunks
+    "surge.replay.resident-slab-cap-mb": 512,
     # order aggregates by log length before B-chunking so each chunk's local max
     # length ≈ its members' lengths (columnar replay pad_ratio lever)
     "surge.replay.sort-by-length": True,

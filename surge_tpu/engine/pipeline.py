@@ -53,8 +53,30 @@ class _Region:
         self.partition = partition
         self.publisher = publisher
         self.shard = shard
-        self._publisher_start = asyncio.ensure_future(publisher.start())
+        self._publisher_start = asyncio.ensure_future(self._start_with_retry())
         self._publisher_start.add_done_callback(self._on_publisher_started)
+
+    async def _start_with_retry(self) -> None:
+        """Publisher init with backoff (the BackoffSupervisor role around the
+        reference's producer actor, AggregateStateStoreKafkaStreams.scala:
+        106-118): a transient broker hiccup during open/flush-record must not
+        leave the partition permanently unservable."""
+        backoff = 0.2
+        for attempt in range(5):
+            try:
+                await self.publisher.start()
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — retry transient init failures
+                if attempt == 4:
+                    raise
+                logger.warning(
+                    "publisher init failed for partition %d "
+                    "(attempt %d/5, retrying in %.1fs): %r",
+                    self.partition, attempt + 1, backoff, exc)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
 
     def _on_publisher_started(self, task: asyncio.Task) -> None:
         if task.cancelled():

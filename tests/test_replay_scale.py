@@ -229,6 +229,87 @@ def test_length_sorted_chunking_cuts_padding_and_stays_exact():
     assert ratio_sorted < 2.0
 
 
+def test_resident_corpus_replay_matches_streaming_and_scalar():
+    """Resident-corpus replay (one flat upload + on-device gather densify) must
+    produce byte-identical states to the streaming window path and the scalar
+    fold, in the caller's original aggregate order, while shipping exactly
+    wire_bytes_per_event() per event."""
+    from surge_tpu.replay.corpus import synth_counter_corpus
+
+    corpus = synth_counter_corpus(3000, 120_000, seed=17)  # unsorted order
+    cfg = Config(overrides={"surge.replay.batch-size": 256,
+                            "surge.replay.time-chunk": 32})
+    eng = ReplayEngine(counter.make_replay_spec(), config=cfg)
+    resident = eng.prepare_resident(corpus.events)
+    # 1 byte/event on the link + the fixed slab-guard tail (slice safety)
+    assert resident.wire_bytes == corpus.num_events + eng.resident_cap_width()
+    res = eng.replay_resident(resident)
+    np.testing.assert_array_equal(res.states["count"], corpus.expected_count)
+    np.testing.assert_array_equal(res.states["version"], corpus.expected_version)
+    assert res.num_events == corpus.num_events
+
+    # streaming path agreement (same engine, same config)
+    res2 = eng.replay_columnar(corpus.events)
+    for name in res.states:
+        np.testing.assert_array_equal(res.states[name], res2.states[name])
+
+
+def test_resident_replay_with_side_columns_and_resume():
+    """bank_account has float side columns (they ride the flat side arrays);
+    resume through init_carry/ordinal_base must continue derived ordinals."""
+    from surge_tpu.models import bank_account as ba
+
+    rng = np.random.default_rng(3)
+    reg = ba.make_registry()
+    logs = []
+    for i in range(60):
+        n = int(rng.integers(1, 12))
+        evs = [ba.EncodedCreated(owner_code=i % 5, security_code_code=1,
+                                 balance=np.float32(100.0))]
+        for k in range(n):
+            evs.append(ba.EncodedUpdated(new_balance=np.float32(
+                100.0 + (k + 1) * 0.25)))
+        logs.append(evs)
+    colev = encode_events_columnar(reg, logs)
+    cfg = Config(overrides={"surge.replay.batch-size": 16,
+                            "surge.replay.time-chunk": 8})
+    eng = ReplayEngine(ba.make_replay_spec(), config=cfg)
+    resident = eng.prepare_resident(colev)
+    res = eng.replay_resident(resident)
+    ref = eng.replay_columnar(colev)
+    for name in res.states:
+        np.testing.assert_array_equal(res.states[name], ref.states[name])
+
+    # split replay: fold first half of every log, then resume on the second
+    from surge_tpu.replay.corpus import synth_counter_corpus
+
+    corpus = synth_counter_corpus(64, 4000, seed=11)
+    ev = corpus.events
+    starts = np.zeros(corpus.num_aggregates + 1, dtype=np.int64)
+    np.cumsum(corpus.lengths, out=starts[1:])
+    first_len = corpus.lengths // 2
+    keep = np.zeros(corpus.num_events, dtype=bool)
+    for b in range(corpus.num_aggregates):
+        keep[starts[b]: starts[b] + first_len[b]] = True
+
+    def subset(mask):
+        return ColumnarEvents(
+            num_aggregates=corpus.num_aggregates, agg_idx=ev.agg_idx[mask],
+            type_ids=ev.type_ids[mask],
+            cols={k: v[mask] for k, v in ev.cols.items()},
+            derived_cols=dict(ev.derived_cols))
+
+    ceng = ReplayEngine(counter.make_replay_spec(), config=Config(overrides={
+        "surge.replay.batch-size": 32, "surge.replay.time-chunk": 16}))
+    r1 = ceng.replay_resident(ceng.prepare_resident(subset(keep)))
+    r2 = ceng.replay_resident(
+        ceng.prepare_resident(subset(~keep)),
+        init_carry=r1.states,
+        ordinal_base=first_len.astype(np.int32))
+    np.testing.assert_array_equal(r2.states["count"], corpus.expected_count)
+    np.testing.assert_array_equal(r2.states["version"], corpus.expected_version)
+
+
 def test_resume_with_derived_ordinals_continues_sequence():
     """Checkpoint-resume over a derived-ordinal corpus: the second half's derived
     sequence numbers must continue from each aggregate's already-folded count
