@@ -158,23 +158,8 @@ def replay_child(corpus_dir: str) -> None:
         t0 = time.perf_counter()
         resident = engine.prepare_resident(corpus.events)
         prepare_s = time.perf_counter() - t0
-        gfold = engine._gather_fold(frozenset(resident.derived_key.items()))
-        # warm at the EFFECTIVE dispatch batch (replay_resident rounds small
-        # corpora down), or every timed dispatch would be a cold signature
-        lane = engine._lane_multiple()
-        b = resident.lengths.shape[0]
-        bs_eff = min(engine.batch_size, -(-max(b, 1) // lane) * lane)
-        zeros = np.zeros((bs_eff,), dtype=np.int32)
-        rkey = frozenset(resident.derived_key.items())
-        for width in engine.resident_widths(int(resident.lengths.max(initial=1))):
-            carry = engine._carry_slice(None, 0, bs_eff, bs_eff)
-            carry = gfold(carry, resident.flat_word, resident.flat_side,
-                          zeros, zeros, zeros, np.int32(0), width)
-            # register the warm signature so the post-run delta check is exact
-            engine._signatures.add(("resident", rkey, width, bs_eff))
-        import jax
-
-        jax.block_until_ready(carry)
+        # compile the single tile program against the real buffers (no-op fold)
+        engine.warm_resident(resident)
         warm_compiles = engine.num_compiles()
         log(f"resident corpus: {resident.wire_bytes / 1e6:.0f} MB shipped in "
             f"{resident.upload_s:.1f}s; gather programs warmed")

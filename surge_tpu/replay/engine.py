@@ -92,14 +92,35 @@ class ResidentCorpus:
     """A corpus uploaded once to the device for gather-based replay."""
 
     derived_key: dict
-    flat_word: Any  # u32 [N] on device
+    flat_wire: Any  # packed u8 [N, nbytes] on device (word-expanded per tile)
     flat_side: dict  # {name: [N]} on device
-    starts: np.ndarray  # i32 [B] (length-sorted order)
+    starts: np.ndarray  # i32 [B] (length-sorted order, host copy for planning)
     lengths: np.ndarray  # i32 [B]
     perm: Optional[np.ndarray]  # sorted-rank -> original index (None = identity)
+    starts_dev: Any  # i32 [b_pad] on device
+    lens_dev: Any  # i32 [b_pad] on device
+    b_pad: int  # lane count padded to the dispatch batch
     num_events: int
     wire_bytes: int  # bytes actually shipped to the device
     upload_s: float
+
+
+@dataclass
+class ResidentPlan:
+    """Tile schedule for one resident replay (two lane granularities)."""
+
+    width: int
+    bs_big: int
+    bs_small: int
+    big_i0: np.ndarray  # i32 [k_big]
+    big_tb: np.ndarray  # i32 [k_big]
+    small_i0: np.ndarray  # i32 [k_small]
+    small_tb: np.ndarray  # i32 [k_small]
+
+    @property
+    def padded_slots(self) -> int:
+        return (len(self.big_i0) * self.bs_big
+                + len(self.small_i0) * self.bs_small) * self.width
 
 
 @dataclass
@@ -471,12 +492,18 @@ class ReplayEngine:
         starts/lens (KBs) — the right shape for hosts where the device link,
         not the fold, is the bottleneck (tunneled TPU; and on local hardware it
         turns replay into one streaming upload)."""
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "resident-corpus replay is single-device; use replay_columnar "
+                "for mesh-sharded folds")
         import jax
 
         b = colev.num_aggregates
         lengths = np.bincount(colev.agg_idx, minlength=b).astype(np.int64)
         if self.sort_by_length and b > 1:
-            perm = np.argsort(lengths, kind="stable").astype(np.int32)
+            # DESCENDING by length: the lanes still active after t events form a
+            # prefix, so each tile round dispatches a contiguous lane range
+            perm = np.argsort(-lengths, kind="stable").astype(np.int32)
             if np.array_equal(perm, np.arange(b, dtype=np.int32)):
                 perm = None
             else:
@@ -501,148 +528,288 @@ class ReplayEngine:
         side_flat = {k: np.pad(v, (0, guard)) for k, v in side_flat.items()}
         self.stats["pack_s"] += time.perf_counter() - t0
         t0 = time.perf_counter()
-        flat_word = jax.jit(wire.expand_flat)(jax.device_put(packed))
+        # ship the PACKED bytes; byte→word expansion happens inside the tile
+        # program (no separate expansion compile, 1/4 the HBM and slab traffic)
+        flat_wire = jax.device_put(packed)
         flat_side = {k: jax.device_put(v) for k, v in side_flat.items()}
-        jax.block_until_ready(flat_word)
-        upload_s = time.perf_counter() - t0
-        self.stats["h2d_s"] += upload_s
         starts = np.zeros(b + 1, dtype=np.int64)
         np.cumsum(lengths, out=starts[1:])
+        starts32 = starts[:-1].astype(np.int32)
+        lens32 = lengths.astype(np.int32)
+        bs = min(self.batch_size, _round_up(max(b, 1), self._lane_multiple()))
+        b_pad = _round_up(max(b, 1), bs)
+        starts_p = np.zeros((b_pad,), dtype=np.int32)
+        starts_p[:b] = starts32
+        lens_p = np.zeros((b_pad,), dtype=np.int32)
+        lens_p[:b] = lens32
+        starts_dev = jax.device_put(starts_p)
+        lens_dev = jax.device_put(lens_p)
+        jax.block_until_ready(flat_wire)
+        upload_s = time.perf_counter() - t0
+        self.stats["h2d_s"] += upload_s
         return ResidentCorpus(
-            derived_key=dict(sorted_ev.derived_cols), flat_word=flat_word,
-            flat_side=flat_side, starts=starts[:-1].astype(np.int32),
-            lengths=lengths.astype(np.int32), perm=perm,
+            derived_key=dict(sorted_ev.derived_cols), flat_wire=flat_wire,
+            flat_side=flat_side, starts=starts32,
+            lengths=lens32, perm=perm,
+            starts_dev=starts_dev, lens_dev=lens_dev, b_pad=b_pad,
             num_events=sorted_ev.num_events,
             wire_bytes=packed.nbytes + sum(v.nbytes for v in side_flat.values()),
             upload_s=upload_s)
+
+    def _resident_plan(self, resident: "ResidentCorpus") -> "ResidentPlan":
+        """Host-side tile schedule. Tile k of a granularity folds events
+        ``[t_bases[k], t_bases[k]+width)`` of lanes ``[i0s[k], i0s[k]+bs)``.
+
+        Lanes are length-sorted descending, so the lanes still active in round
+        r form a shrinking prefix. Each round covers it with full-width
+        ``bs_big`` tiles plus narrow ``bs_small`` tiles over the remainder —
+        the narrow granularity caps per-round lane padding at ``bs_small``
+        instead of ``bs_big``. A lane only ever moves big→small as the prefix
+        shrinks, so running ALL big tiles (in round order) before ALL small
+        tiles (in round order) preserves per-lane event order."""
+        b = resident.lengths.shape[0]
+        lane = self._lane_multiple()
+        bs_big = min(self.batch_size, _round_up(max(b, 1), lane))
+        bs_small = min(bs_big, max(lane, bs_big // 8))
+        width = self.resident_tile_width()
+        lens_host = resident.lengths
+        max_len = int(lens_host.max(initial=0)) if b else 0
+        sorted_desc = bool((np.diff(lens_host) <= 0).all()) if b > 1 else True
+        big_i0: list[int] = []
+        big_tb: list[int] = []
+        small_i0: list[int] = []
+        small_tb: list[int] = []
+        if sorted_desc:
+            lens_asc = lens_host[::-1]
+            t_base = 0
+            while t_base < max_len:
+                active = b - int(np.searchsorted(lens_asc, t_base, side="right"))
+                n_big = active // bs_big
+                for k in range(n_big):
+                    big_i0.append(k * bs_big)
+                    big_tb.append(t_base)
+                for i0 in range(n_big * bs_big, active, bs_small):
+                    small_i0.append(i0)
+                    small_tb.append(t_base)
+                t_base += width
+        else:
+            # unsorted corpus: schedule each contiguous lane range only up to
+            # its own local max length (the streaming path's per-chunk bound),
+            # not the global max — lanes stay in one range, so ascending
+            # t_base per range preserves per-lane event order
+            for i0 in range(0, b, bs_big):
+                local_max = int(lens_host[i0: i0 + bs_big].max(initial=0))
+                for t_base in range(0, local_max, width):
+                    big_i0.append(i0)
+                    big_tb.append(t_base)
+        return ResidentPlan(
+            width=width, bs_big=bs_big, bs_small=bs_small,
+            big_i0=np.asarray(big_i0, dtype=np.int32),
+            big_tb=np.asarray(big_tb, dtype=np.int32),
+            small_i0=np.asarray(small_i0, dtype=np.int32),
+            small_tb=np.asarray(small_tb, dtype=np.int32))
+
+    @staticmethod
+    def _plan_cap(k: int) -> int:
+        """Work-list buffer length bucket (next power of two ≥ 64): entries past
+        the traced trip count are never read, so one compiled program serves
+        every plan in the bucket."""
+        cap = 64
+        while cap < k:
+            cap *= 2
+        return cap
 
     def replay_resident(self, resident: "ResidentCorpus",
                         init_carry: Mapping[str, Any] | None = None,
                         ordinal_base: np.ndarray | None = None) -> ReplayResult:
         """Fold a prepared resident corpus. Results are in the ORIGINAL
-        aggregate order of the ColumnarEvents given to :meth:`prepare_resident`."""
+        aggregate order of the ColumnarEvents given to :meth:`prepare_resident`.
+
+        Design (measured on the tunneled v5e): a chained dispatch costs ~0.5 ms
+        but ANY host⇄device traffic — a sync ~75 ms, even a scalar argument a
+        few ms — so the ENTIRE fold pass is ONE dispatch: a ``fori_loop`` over
+        a device-resident work list of (lane-range, time-offset) tiles,
+        mutating a state slab ``{field: [b_pad]}``, with exactly one
+        device→host pull of the folded states at the end."""
         if self.mesh is not None:
             raise NotImplementedError(
                 "resident-corpus replay is single-device; use replay_columnar "
                 "for mesh-sharded folds")
+        import jax
+
         b = resident.lengths.shape[0]
-        bs = min(self.batch_size, _round_up(max(b, 1), self._lane_multiple()))
+        if b == 0:
+            return ReplayResult(states={f.name: np.zeros((0,), dtype=f.dtype)
+                                        for f in self.spec.registry.state.fields},
+                                num_aggregates=0, num_events=0, padded_events=0)
+        plan = self._resident_plan(resident)
+        b_pad = resident.b_pad
         key = frozenset(resident.derived_key.items())
-        fold = self._gather_fold(key)
         state_fields = self.spec.registry.state.fields
-        out = {f.name: np.zeros((b,), dtype=f.dtype) for f in state_fields}
-        padded = 0
-        for start in range(0, max(b, 1), bs):
-            stop = min(start + bs, b)
-            if stop <= start:
-                break
-            idxs = None if resident.perm is None else resident.perm[start:stop]
-            starts_c = np.zeros((bs,), dtype=np.int32)
-            lens_c = np.zeros((bs,), dtype=np.int32)
-            starts_c[: stop - start] = resident.starts[start:stop]
-            lens_c[: stop - start] = resident.lengths[start:stop]
-            carry = self._carry_slice(init_carry, start, stop, bs, idxs=idxs)
-            ob = np.zeros((bs,), dtype=np.int32)
-            if ordinal_base is not None:
-                src = (np.asarray(ordinal_base)[idxs] if idxs is not None
-                       else np.asarray(ordinal_base)[start:stop])
-                ob[: stop - start] = src.astype(np.int32)
-            # ONE dispatch per B-chunk (padding the scan costs compute only —
-            # nothing crosses the link): width is the next power of two ≥ the
-            # chunk's longest log, split into slab-cap-sized dispatches only
-            # when the HBM budget demands it. Programs stay bounded by the
-            # pow2 ladder.
-            t_local = int(lens_c.max(initial=0))
-            cap_w = self.resident_cap_width()
-            t_base = 0
-            while t_base < t_local:
-                rem = t_local - t_base
-                width = max(self.min_time_window, 1)
-                while width < rem and width < cap_w:
-                    width *= 2
-                self.stats["windows"] += 1
-                self._signatures.add(("resident", key, width, bs))
-                carry = fold(carry, resident.flat_word, resident.flat_side,
-                             starts_c, lens_c, ob, np.int32(t_base), width)
-                padded += bs * width
-                t_base += width
-            chunk_states = {name: np.asarray(carry[name])[: stop - start]
-                            for name in out}
-            for name in out:
-                if idxs is None:
-                    out[name][start:stop] = chunk_states[name]
-                else:
-                    out[name][idxs] = chunk_states[name]
+        perm = resident.perm
+
+        ord_p = np.zeros((b_pad,), dtype=np.int32)
+        if ordinal_base is not None:
+            src = np.asarray(ordinal_base)
+            ord_p[:b] = (src[perm] if perm is not None else src).astype(np.int32)
+        slab = self.init_carry_np(b_pad)
+        if init_carry is not None:
+            for k, full in init_carry.items():
+                src = np.asarray(full)
+                slab[k][:b] = src[perm] if perm is not None else src
+        slab = {k: jnp.asarray(v) for k, v in slab.items()}
+        ord_d = jnp.asarray(ord_p)
+
+        # two chained dispatches (big tiles, then small); per-lane order holds
+        # because a lane only ever migrates big→small as the prefix shrinks
+        for bs, i0s, t_bases in ((plan.bs_big, plan.big_i0, plan.big_tb),
+                                 (plan.bs_small, plan.small_i0, plan.small_tb)):
+            k_n = len(i0s)
+            if k_n == 0:
+                continue
+            k_cap = self._plan_cap(k_n)
+            fold = self._resident_program(key, plan.width, bs, k_cap)
+            i0s_p = np.zeros((k_cap,), dtype=np.int32)
+            i0s_p[:k_n] = i0s
+            tb_p = np.zeros((k_cap,), dtype=np.int32)
+            tb_p[:k_n] = t_bases
+            self._signatures.add(("resident", key, plan.width, bs, k_cap))
+            self.stats["windows"] += k_n
+            slab = fold(slab, resident.flat_wire, resident.flat_side,
+                        resident.starts_dev, resident.lens_dev, ord_d,
+                        jnp.asarray(i0s_p), jnp.asarray(tb_p), np.int32(k_n))
+        # the single synchronization of the whole replay
+        out_sorted = {name: np.asarray(slab[name])[:b] for name in
+                      (f.name for f in state_fields)}
+        if perm is None:
+            out = out_sorted
+        else:
+            out = {name: np.empty_like(col) for name, col in out_sorted.items()}
+            for name, col in out_sorted.items():
+                out[name][perm] = col
         return ReplayResult(states=out, num_aggregates=b,
                             num_events=resident.num_events,
-                            padded_events=padded)
+                            padded_events=plan.padded_slots)
 
     def resident_cap_width(self) -> int:
-        """Largest slab scan width the HBM budget allows (pow2 multiple of the
-        min window): one dispatch materializes a [batch, width] u32 slab and
-        its transpose, so width is capped by resident-slab-cap-mb."""
+        """Largest tile width the HBM budget allows (pow2 multiple of the min
+        window): one tile materializes a [batch, width] u32 slab and its
+        transpose, so width is capped by resident-slab-cap-mb."""
         budget = self.config.get_int("surge.replay.resident-slab-cap-mb", 512)
         w = max(self.min_time_window, 1)
         while w * 2 * self.batch_size * 8 <= budget * 1_000_000:
             w *= 2
         return w
 
-    def resident_widths(self, max_len: int) -> list[int]:
-        """Every scan width :meth:`replay_resident` can dispatch for logs up to
-        ``max_len`` (min-time-window × powers of two, capped by the slab
-        budget) — the warm-up set."""
-        cap = self.resident_cap_width()
+    def resident_tile_width(self) -> int:
+        """The fixed tile width of :meth:`replay_resident` tiles: the
+        time-chunk rounded up to a power of two, inside the HBM cap. One width
+        → one compiled program for the whole replay."""
         w = max(self.min_time_window, 1)
-        out = [w]
-        while out[-1] < max_len and out[-1] < cap:
-            out.append(out[-1] * 2)
-        return out
+        target = max(self.time_chunk, 1)
+        cap = self.resident_cap_width()
+        while w < target and w < cap:
+            w *= 2
+        return w
 
-    def _gather_fold(self, key: frozenset):
-        """The jitted resident fold for one derived-column declaration:
-        ``(carry, flat_word [N], side_flat, starts [B], lens [B], ord_base [B],
-        t_base, width·static) -> carry``.
+    def warm_resident(self, resident: "ResidentCorpus") -> None:
+        """Compile every program a :meth:`replay_resident` of this corpus will
+        dispatch, against the real corpus buffers, with zero-trip work lists —
+        so a timed pass runs with zero in-window compiles."""
+        b = resident.lengths.shape[0]
+        if b == 0:
+            return
+        plan = self._resident_plan(resident)
+        key = frozenset(resident.derived_key.items())
+        b_pad = resident.b_pad
+        zeros = jnp.zeros((b_pad,), dtype=jnp.int32)
+        for bs, i0s in ((plan.bs_big, plan.big_i0),
+                        (plan.bs_small, plan.small_i0)):
+            if len(i0s) == 0:
+                continue
+            k_cap = self._plan_cap(len(i0s))
+            fold = self._resident_program(key, plan.width, bs, k_cap)
+            wl = jnp.zeros((k_cap,), dtype=jnp.int32)
+            slab = {k: jnp.asarray(v)
+                    for k, v in self.init_carry_np(b_pad).items()}
+            out = fold(slab, resident.flat_wire, resident.flat_side,
+                       resident.starts_dev, resident.lens_dev, zeros,
+                       wl, wl, np.int32(0))
+            jax.block_until_ready(out)
+            self._signatures.add(("resident", key, plan.width, bs, k_cap))
 
-        Extraction strategy (measured on the tunneled v5e): per-element gathers
-        run ~70M elem/s but per-lane CONTIGUOUS ``dynamic_slice`` slabs run
-        4-5× faster and the dense fold runs at GB/s — so each dispatch slices
-        one ``[B, width]`` slab per lane (events of one aggregate are adjacent
-        in the flat corpus), transposes once to time-major, and scans dense
-        rows. ``width`` is static, so programs stay bounded by the pow2
-        ladder."""
-        hit = self._resident_folds.get(key)
+    def _resident_program(self, key: frozenset, width: int, bs: int,
+                          k_cap: int):
+        """The jitted whole-replay program for one derived-column declaration:
+        ``(state_slab {f: [b_pad]}, flat_wire u8 [N, nbytes], side_flat,
+        starts [b_pad], lens [b_pad], ord_base [b_pad], i0s [k_cap],
+        t_bases [k_cap], k_n) -> state_slab``.
+
+        A ``fori_loop`` over the tile work list; tile k folds events
+        ``[t_bases[k], t_bases[k]+width)`` of lanes ``[i0s[k], i0s[k]+bs)``:
+        per-lane contiguous ``dynamic_slice`` slabs out of the flat packed
+        corpus (events of one aggregate are adjacent), byte→word expansion
+        in-register, one transpose to time-major, a dense scan, and a
+        contiguous write-back into the state slab. The trip count is traced,
+        so one compiled program serves every corpus in the k_cap bucket and
+        the whole replay crosses the host⇄device boundary exactly twice
+        (dispatch in, states out)."""
+        cache_key = (key, width, bs, k_cap)
+        hit = self._resident_folds.get(cache_key)
         if hit is not None:
             return hit
         import jax
 
         wire = WireFormat(self.spec.registry, dict(key))
         batch_step = jax.vmap(make_step_fn(self.spec), in_axes=(0, 0))
+        nbytes = wire.nbytes
 
-        def fold(carry, flat_word, side_flat, starts, lens, ord_base, t_base,
-                 width):
+        def tile(slab_state, flat_wire, side_flat, starts_all, lens_all,
+                 ord_all, i0, t_base):
             import jax.numpy as jnp
 
+            starts = jax.lax.dynamic_slice(starts_all, (i0,), (bs,))
+            lens = jax.lax.dynamic_slice(lens_all, (i0,), (bs,))
+            ord_base = jax.lax.dynamic_slice(ord_all, (i0,), (bs,))
+            carry = {k: jax.lax.dynamic_slice(v, (i0,), (bs,))
+                     for k, v in slab_state.items()}
+
             def slab(arr):
+                # dynamic_slice clamps out-of-range starts (finished/padding
+                # lanes); clamped garbage decodes under a False mask
                 cut = jax.vmap(
                     lambda s0: jax.lax.dynamic_slice(arr, (s0,), (width,)))
-                return cut(starts + t_base).T  # [width, B], rows contiguous
+                return cut(starts + t_base).T  # [width, bs], rows contiguous
 
-            words = slab(flat_word)
+            word = jax.vmap(
+                lambda s0: jax.lax.dynamic_slice(
+                    flat_wire, (s0, 0), (width, nbytes)))(starts + t_base)
+            word = wire.expand_flat(word.reshape(bs * width, nbytes))
+            words = word.reshape(bs, width).T  # [width, bs]
             sides = {name: slab(arr) for name, arr in side_flat.items()}
             ts = jnp.arange(width, dtype=jnp.int32) + t_base
 
             def body(c, xs):
-                word, side_row, t = xs
-                events = wire.decode_words(word, side_row, t < lens, ord_base, t)
+                w_row, side_row, t = xs
+                events = wire.decode_words(w_row, side_row, t < lens, ord_base, t)
                 return batch_step(c, events), None
 
             out, _ = jax.lax.scan(body, carry, (words, sides, ts),
                                   unroll=self._unroll)
-            return out
+            return {k: jax.lax.dynamic_update_slice(slab_state[k], out[k], (i0,))
+                    for k in slab_state}
+
+        def fold(slab_state, flat_wire, side_flat, starts_all, lens_all,
+                 ord_all, i0s, t_bases, k_n):
+            def body(k, st):
+                return tile(st, flat_wire, side_flat, starts_all, lens_all,
+                            ord_all, i0s[k], t_bases[k])
+
+            return jax.lax.fori_loop(0, k_n, body, slab_state)
 
         donate = (0,) if self.donate_carry else ()
-        jitted = jax.jit(fold, donate_argnums=donate, static_argnums=(7,))
-        self._resident_folds[key] = jitted
+        jitted = jax.jit(fold, donate_argnums=donate)
+        self._resident_folds[cache_key] = jitted
         return jitted
 
     def replay_ragged(self, logs: Sequence[Sequence[Any]],

@@ -254,6 +254,39 @@ def test_resident_corpus_replay_matches_streaming_and_scalar():
         np.testing.assert_array_equal(res.states[name], res2.states[name])
 
 
+def test_resident_unsorted_skewed_plan_stays_chunk_local():
+    """With sort-by-length disabled and one lane's log dwarfing the rest, the
+    tile plan must stay bounded by each lane range's LOCAL max (the streaming
+    path's bound), not schedule every range out to the global max."""
+    from surge_tpu.codec.tensor import ColumnarEvents
+    from surge_tpu.replay.corpus import synth_counter_corpus
+
+    corpus = synth_counter_corpus(600, 6_000, seed=3)
+    # graft a long tail onto ONE aggregate: 4000 extra increments on agg 7
+    ev = corpus.events
+    extra = 4000
+    agg_idx = np.concatenate([ev.agg_idx, np.full(extra, 7, dtype=ev.agg_idx.dtype)])
+    type_ids = np.concatenate([ev.type_ids, np.zeros(extra, dtype=ev.type_ids.dtype)])
+    cols = {k: np.concatenate([v, np.ones(extra, dtype=v.dtype) if k == "increment_by"
+                               else np.zeros(extra, dtype=v.dtype)])
+            for k, v in ev.cols.items()}
+    colev = ColumnarEvents(num_aggregates=600, agg_idx=agg_idx, type_ids=type_ids,
+                           cols=cols, derived_cols=dict(ev.derived_cols))
+    cfg = Config(overrides={"surge.replay.batch-size": 128,
+                            "surge.replay.time-chunk": 32,
+                            "surge.replay.sort-by-length": False})
+    eng = ReplayEngine(counter.make_replay_spec(), config=cfg)
+    resident = eng.prepare_resident(colev)
+    plan = eng._resident_plan(resident)
+    # only aggregate 7's range pays for the long log; the others stop at their
+    # local max (~tens of events), so the slot bound is far below b×max_len
+    assert plan.padded_slots < 600 * 4000 // 2
+    res = eng.replay_resident(resident)
+    scalar = eng.replay_columnar(colev)
+    for name in res.states:
+        np.testing.assert_array_equal(res.states[name], scalar.states[name])
+
+
 def test_resident_replay_with_side_columns_and_resume():
     """bank_account has float side columns (they ride the flat side arrays);
     resume through init_carry/ordinal_base must continue derived ordinals."""
