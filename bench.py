@@ -107,6 +107,20 @@ def load_corpus(root: str):
 # replay child: one backend, one measured replay, one JSON line on stdout
 # --------------------------------------------------------------------------------------
 
+def make_engine():
+    """The bench replay engine (shared by parent pack and replay children so
+    the wire form and tile plan agree)."""
+    from surge_tpu.config import default_config
+    from surge_tpu.models.counter import make_replay_spec
+    from surge_tpu.replay.engine import ReplayEngine
+
+    cfg = default_config().with_overrides({
+        "surge.replay.batch-size": int(os.environ.get("SURGE_BENCH_BATCH", 8192)),
+        "surge.replay.time-chunk": int(os.environ.get("SURGE_BENCH_TIME_CHUNK", 128)),
+    })
+    return ReplayEngine(make_replay_spec(), config=cfg)
+
+
 def replay_child(corpus_dir: str) -> None:
     import jax
 
@@ -114,19 +128,10 @@ def replay_child(corpus_dir: str) -> None:
     platform = devices[0].platform
     log(f"child backend up: platform={platform} devices={devices}")
 
-    from surge_tpu.config import default_config
     from surge_tpu.models.counter import make_replay_spec
-    from surge_tpu.replay.engine import ReplayEngine
 
-    time_chunk = int(os.environ.get("SURGE_BENCH_TIME_CHUNK", 128))
-    batch_size = int(os.environ.get("SURGE_BENCH_BATCH", 8192))
     corpus = load_corpus(corpus_dir)
-
-    cfg = default_config().with_overrides({
-        "surge.replay.batch-size": batch_size,
-        "surge.replay.time-chunk": time_chunk,
-    })
-    engine = ReplayEngine(make_replay_spec(), config=cfg)
+    engine = make_engine()
 
     # The resident path (default) ships the corpus ONCE (1 byte/event, zero
     # padding on the link) and every fold gathers on-device — the measured
@@ -155,8 +160,16 @@ def replay_child(corpus_dir: str) -> None:
 
     extra_timing = {}
     if resident_mode:
+        from surge_tpu.replay.engine import ResidentWire
+
+        wire_dir = os.path.join(corpus_dir, "wire")
         t0 = time.perf_counter()
-        resident = engine.prepare_resident(corpus.events)
+        if os.path.isdir(wire_dir):
+            # the parent packed the wire at corpus-build time (the log-segment
+            # build analog): cold replay = mmap + upload + fold
+            resident = engine.upload_resident(ResidentWire.load(wire_dir))
+        else:
+            resident = engine.prepare_resident(corpus.events)
         prepare_s = time.perf_counter() - t0
         # compile the single tile program against the real buffers (no-op fold)
         engine.warm_resident(resident)
@@ -402,6 +415,17 @@ def main() -> None:
         t0 = time.perf_counter()
         save_corpus(corpus, corpus_dir)
         log(f"corpus saved to {corpus_dir} ({time.perf_counter() - t0:.1f}s)")
+
+        # one-time wire pack (the log-segment build analog, SURVEY §5.4): cold
+        # replays mmap this and stream it straight onto the device. Skipped
+        # when the streaming path is benched — no child would read it.
+        if os.environ.get("SURGE_BENCH_RESIDENT", "1") == "1":
+            t0 = time.perf_counter()
+            make_engine().pack_resident(corpus.events).save(
+                os.path.join(corpus_dir, "wire"))
+            wire_pack_s = time.perf_counter() - t0
+            log(f"wire packed+saved ({wire_pack_s:.1f}s, one-time build)")
+            payload["wire_pack_s"] = round(wire_pack_s, 1)
 
         # -- scalar CPU fold baseline (the reference restore path) --------------------
         idx = sample_indices(corpus, cpu_sample_events)

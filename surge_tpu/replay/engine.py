@@ -105,6 +105,66 @@ class ResidentCorpus:
     upload_s: float
 
 
+#: minimum guard rows appended past the wire corpus, so a wire packed under a
+#: small tile-width cap still satisfies engines configured with a larger one
+_WIRE_GUARD_MIN = 8192
+
+
+@dataclass
+class ResidentWire:
+    """The host/disk wire form of a resident corpus (pure numpy, mmap-able).
+
+    Produced by :meth:`ReplayEngine.pack_resident`; consumed by
+    :meth:`ReplayEngine.upload_resident`. Saving this next to the log segment
+    makes the pack a one-time build cost: every later cold start mmaps the
+    wire bytes and streams them straight onto the device."""
+
+    derived_key: dict
+    packed: np.ndarray  # u8 [N+guard, nbytes]
+    side: dict  # {name: np [N+guard]}
+    starts: np.ndarray  # i32 [B] (length-sorted order)
+    lengths: np.ndarray  # i32 [B]
+    perm: Optional[np.ndarray]  # sorted-rank -> original index
+    guard: int
+    num_events: int
+
+    def save(self, root: str) -> None:
+        import json
+        import os
+
+        os.makedirs(root, exist_ok=True)
+        np.save(os.path.join(root, "packed.npy"), self.packed)
+        np.save(os.path.join(root, "starts.npy"), self.starts)
+        np.save(os.path.join(root, "lengths.npy"), self.lengths)
+        if self.perm is not None:
+            np.save(os.path.join(root, "perm.npy"), self.perm)
+        for name, col in self.side.items():
+            np.save(os.path.join(root, f"side_{name}.npy"), col)
+        meta = {"derived_key": self.derived_key, "guard": self.guard,
+                "num_events": self.num_events,
+                "side_names": sorted(self.side),
+                "has_perm": self.perm is not None}
+        with open(os.path.join(root, "wire.json"), "w") as f:
+            json.dump(meta, f)
+
+    @classmethod
+    def load(cls, root: str) -> "ResidentWire":
+        import json
+        import os
+
+        with open(os.path.join(root, "wire.json")) as f:
+            meta = json.load(f)
+        mm = lambda name: np.load(os.path.join(root, name), mmap_mode="r")  # noqa: E731
+        return cls(
+            derived_key=dict(meta["derived_key"]),
+            packed=mm("packed.npy"),
+            side={name: mm(f"side_{name}.npy") for name in meta["side_names"]},
+            starts=np.asarray(mm("starts.npy")),
+            lengths=np.asarray(mm("lengths.npy")),
+            perm=np.asarray(mm("perm.npy")) if meta["has_perm"] else None,
+            guard=int(meta["guard"]), num_events=int(meta["num_events"]))
+
+
 @dataclass
 class ResidentPlan:
     """Tile schedule for one resident replay (two lane granularities)."""
@@ -482,22 +542,12 @@ class ReplayEngine:
 
     # -- resident-corpus path (single upload, on-device densify) ------------------------
 
-    def prepare_resident(self, colev: ColumnarEvents) -> "ResidentCorpus":
-        """Upload the WHOLE corpus once as a flat wire buffer (exactly
-        ``wire_bytes_per_event()`` per event — zero padding crosses the link)
-        and return a handle for :meth:`replay_resident`.
-
-        Every subsequent fold dispatch gathers its window on-device from the
-        resident buffer, so per-window transfer drops to the B-chunk's
-        starts/lens (KBs) — the right shape for hosts where the device link,
-        not the fold, is the bottleneck (tunneled TPU; and on local hardware it
-        turns replay into one streaming upload)."""
-        if self.mesh is not None:
-            raise NotImplementedError(
-                "resident-corpus replay is single-device; use replay_columnar "
-                "for mesh-sharded folds")
-        import jax
-
+    def pack_resident(self, colev: ColumnarEvents) -> "ResidentWire":
+        """Host-side half of :meth:`prepare_resident`: length-sort, flat-pack
+        and guard-pad the corpus into its device wire form. The result is pure
+        numpy and :meth:`ResidentWire.save`-able — a log segment built once can
+        be mmapped and uploaded on every later cold start without re-packing
+        (the pack is one-time work, like the reference's log compaction)."""
         b = colev.num_aggregates
         lengths = np.bincount(colev.agg_idx, minlength=b).astype(np.int64)
         if self.sort_by_length and b > 1:
@@ -517,44 +567,80 @@ class ReplayEngine:
         else:
             perm = None
         sorted_ev = colev.sorted_by_aggregate()
-        key, wire, _ = self._wire_fold(sorted_ev.derived_cols)
+        _, wire, _ = self._wire_fold(sorted_ev.derived_cols)
         t0 = time.perf_counter()
         packed, side_flat = wire.pack_flat(sorted_ev.type_ids, sorted_ev.cols)
         # tail padding so every [start + t_base, width) slab slice stays in
         # bounds without clamping (clamped slices would shift lane data);
         # content is irrelevant — slots past lens decode to the pad sentinel
-        guard = self.resident_cap_width()
+        guard = max(self.resident_tile_width(), _WIRE_GUARD_MIN)
         packed = np.pad(packed, ((0, guard), (0, 0)))
         side_flat = {k: np.pad(v, (0, guard)) for k, v in side_flat.items()}
         self.stats["pack_s"] += time.perf_counter() - t0
-        t0 = time.perf_counter()
-        # ship the PACKED bytes; byte→word expansion happens inside the tile
-        # program (no separate expansion compile, 1/4 the HBM and slab traffic)
-        flat_wire = jax.device_put(packed)
-        flat_side = {k: jax.device_put(v) for k, v in side_flat.items()}
         starts = np.zeros(b + 1, dtype=np.int64)
         np.cumsum(lengths, out=starts[1:])
-        starts32 = starts[:-1].astype(np.int32)
-        lens32 = lengths.astype(np.int32)
+        return ResidentWire(
+            derived_key=dict(sorted_ev.derived_cols), packed=packed,
+            side=side_flat, starts=starts[:-1].astype(np.int32),
+            lengths=lengths.astype(np.int32), perm=perm, guard=guard,
+            num_events=sorted_ev.num_events)
+
+    def upload_resident(self, w: "ResidentWire") -> "ResidentCorpus":
+        """Device-side half of :meth:`prepare_resident`: ship a packed wire
+        corpus (fresh or mmapped from disk) and return the replay handle."""
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "resident-corpus replay is single-device; use replay_columnar "
+                "for mesh-sharded folds")
+        if w.guard < self.resident_tile_width():
+            raise ValueError(
+                f"wire guard {w.guard} is smaller than the engine's tile width "
+                f"{self.resident_tile_width()}; repack or lower "
+                "surge.replay.time-chunk")
+        import jax
+
+        b = w.lengths.shape[0]
+        t0 = time.perf_counter()
+        flat_wire = jax.device_put(np.ascontiguousarray(w.packed))
+        flat_side = {k: jax.device_put(np.ascontiguousarray(v))
+                     for k, v in w.side.items()}
         bs = min(self.batch_size, _round_up(max(b, 1), self._lane_multiple()))
         b_pad = _round_up(max(b, 1), bs)
         starts_p = np.zeros((b_pad,), dtype=np.int32)
-        starts_p[:b] = starts32
+        starts_p[:b] = w.starts
         lens_p = np.zeros((b_pad,), dtype=np.int32)
-        lens_p[:b] = lens32
+        lens_p[:b] = w.lengths
         starts_dev = jax.device_put(starts_p)
         lens_dev = jax.device_put(lens_p)
         jax.block_until_ready(flat_wire)
         upload_s = time.perf_counter() - t0
         self.stats["h2d_s"] += upload_s
         return ResidentCorpus(
-            derived_key=dict(sorted_ev.derived_cols), flat_wire=flat_wire,
-            flat_side=flat_side, starts=starts32,
-            lengths=lens32, perm=perm,
+            derived_key=dict(w.derived_key), flat_wire=flat_wire,
+            flat_side=flat_side, starts=w.starts,
+            lengths=w.lengths, perm=w.perm,
             starts_dev=starts_dev, lens_dev=lens_dev, b_pad=b_pad,
-            num_events=sorted_ev.num_events,
-            wire_bytes=packed.nbytes + sum(v.nbytes for v in side_flat.values()),
+            num_events=w.num_events,
+            wire_bytes=w.packed.nbytes + sum(v.nbytes for v in w.side.values()),
             upload_s=upload_s)
+
+    def prepare_resident(self, colev: ColumnarEvents) -> "ResidentCorpus":
+        """Upload the WHOLE corpus once as a flat wire buffer (exactly
+        ``wire_bytes_per_event()`` per event — zero padding crosses the link)
+        and return a handle for :meth:`replay_resident`.
+
+        Every subsequent fold dispatch gathers its window on-device from the
+        resident buffer, so per-window transfer drops to the B-chunk's
+        starts/lens (KBs) — the right shape for hosts where the device link,
+        not the fold, is the bottleneck (tunneled TPU; and on local hardware it
+        turns replay into one streaming upload). For a corpus replayed more
+        than once, :meth:`pack_resident` + :meth:`ResidentWire.save` persist
+        the pack so later cold starts skip straight to the upload."""
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "resident-corpus replay is single-device; use replay_columnar "
+                "for mesh-sharded folds")
+        return self.upload_resident(self.pack_resident(colev))
 
     def _resident_plan(self, resident: "ResidentCorpus") -> "ResidentPlan":
         """Host-side tile schedule. Tile k of a granularity folds events
@@ -673,7 +759,7 @@ class ReplayEngine:
             i0s_p[:k_n] = i0s
             tb_p = np.zeros((k_cap,), dtype=np.int32)
             tb_p[:k_n] = t_bases
-            self._signatures.add(("resident", key, plan.width, bs, k_cap))
+            self._signatures.add(("resident", key, plan.width, bs, k_cap, b_pad))
             self.stats["windows"] += k_n
             slab = fold(slab, resident.flat_wire, resident.flat_side,
                         resident.starts_dev, resident.lens_dev, ord_d,
@@ -736,7 +822,7 @@ class ReplayEngine:
                        resident.starts_dev, resident.lens_dev, zeros,
                        wl, wl, np.int32(0))
             jax.block_until_ready(out)
-            self._signatures.add(("resident", key, plan.width, bs, k_cap))
+            self._signatures.add(("resident", key, plan.width, bs, k_cap, b_pad))
 
     def _resident_program(self, key: frozenset, width: int, bs: int,
                           k_cap: int):

@@ -241,8 +241,9 @@ def test_resident_corpus_replay_matches_streaming_and_scalar():
                             "surge.replay.time-chunk": 32})
     eng = ReplayEngine(counter.make_replay_spec(), config=cfg)
     resident = eng.prepare_resident(corpus.events)
-    # 1 byte/event on the link + the fixed slab-guard tail (slice safety)
-    assert resident.wire_bytes == corpus.num_events + eng.resident_cap_width()
+    # 1 byte/event on the link + the guard tail (slice safety)
+    guard = max(eng.resident_tile_width(), 8192)
+    assert resident.wire_bytes == corpus.num_events + guard
     res = eng.replay_resident(resident)
     np.testing.assert_array_equal(res.states["count"], corpus.expected_count)
     np.testing.assert_array_equal(res.states["version"], corpus.expected_version)
@@ -252,6 +253,34 @@ def test_resident_corpus_replay_matches_streaming_and_scalar():
     res2 = eng.replay_columnar(corpus.events)
     for name in res.states:
         np.testing.assert_array_equal(res.states[name], res2.states[name])
+
+
+def test_resident_wire_save_load_roundtrip(tmp_path):
+    """pack_resident -> save -> mmap load -> upload must replay identically to
+    the direct prepare_resident path (the cold-start-from-segment flow)."""
+    from surge_tpu.replay.corpus import synth_counter_corpus
+    from surge_tpu.replay.engine import ResidentWire
+
+    corpus = synth_counter_corpus(800, 40_000, seed=9)
+    cfg = Config(overrides={"surge.replay.batch-size": 128,
+                            "surge.replay.time-chunk": 32})
+    eng = ReplayEngine(counter.make_replay_spec(), config=cfg)
+    wire = eng.pack_resident(corpus.events)
+    wire.save(str(tmp_path / "wire"))
+    loaded = ResidentWire.load(str(tmp_path / "wire"))
+    res = eng.replay_resident(eng.upload_resident(loaded))
+    np.testing.assert_array_equal(res.states["count"], corpus.expected_count)
+    np.testing.assert_array_equal(res.states["version"], corpus.expected_version)
+
+    # an engine whose tile width exceeds the packed guard must refuse the wire
+    # (its slab slices could read past the buffer)
+    big = ReplayEngine(counter.make_replay_spec(), config=Config(overrides={
+        "surge.replay.batch-size": 256,
+        "surge.replay.time-chunk": 32768,
+        "surge.replay.resident-slab-cap-mb": 100000}))
+    assert big.resident_tile_width() > loaded.guard
+    with pytest.raises(ValueError):
+        big.upload_resident(loaded)
 
 
 def test_resident_unsorted_skewed_plan_stays_chunk_local():
