@@ -117,6 +117,9 @@ def make_engine():
     cfg = default_config().with_overrides({
         "surge.replay.batch-size": int(os.environ.get("SURGE_BENCH_BATCH", 8192)),
         "surge.replay.time-chunk": int(os.environ.get("SURGE_BENCH_TIME_CHUNK", 128)),
+        # single corpus, explicit warm: exact buffer length, no bucket padding
+        # on the (timed) upload
+        "surge.replay.resident-len-bucket": "exact",
     })
     return ReplayEngine(make_replay_spec(), config=cfg)
 

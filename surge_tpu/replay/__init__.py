@@ -16,8 +16,10 @@ fold is lifted onto the TPU:
 from surge_tpu.replay.engine import (
     ReplayEngine,
     ReplayResult,
+    ResidentWire,
     make_step_fn,
     make_batch_fold,
 )
 
-__all__ = ["ReplayEngine", "ReplayResult", "make_step_fn", "make_batch_fold"]
+__all__ = ["ReplayEngine", "ReplayResult", "ResidentWire", "make_step_fn",
+           "make_batch_fold"]

@@ -110,6 +110,21 @@ class ResidentCorpus:
 _WIRE_GUARD_MIN = 8192
 
 
+def _bucket_rows(arr: np.ndarray, pow2: bool) -> np.ndarray:
+    """Zero-pad the leading axis to the next power of two (min 64Ki rows) so
+    program shapes bucket; identity when bucketing is off or already sized."""
+    if not pow2:
+        return np.ascontiguousarray(arr)
+    n = arr.shape[0]
+    target = 1 << 16
+    while target < n:
+        target <<= 1
+    if target == n:
+        return np.ascontiguousarray(arr)
+    pad = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
 @dataclass
 class ResidentWire:
     """The host/disk wire form of a resident corpus (pure numpy, mmap-able).
@@ -143,7 +158,12 @@ class ResidentWire:
         meta = {"derived_key": self.derived_key, "guard": self.guard,
                 "num_events": self.num_events,
                 "side_names": sorted(self.side),
-                "has_perm": self.perm is not None}
+                "has_perm": self.perm is not None,
+                # layout fingerprint: a consuming engine whose schema evolved
+                # must refuse the wire rather than decode misaligned bytes
+                "nbytes": int(self.packed.shape[1]),
+                "side_dtypes": {k: str(np.dtype(v.dtype))
+                                for k, v in self.side.items()}}
         with open(os.path.join(root, "wire.json"), "w") as f:
             json.dump(meta, f)
 
@@ -567,7 +587,7 @@ class ReplayEngine:
         else:
             perm = None
         sorted_ev = colev.sorted_by_aggregate()
-        _, wire, _ = self._wire_fold(sorted_ev.derived_cols)
+        wire = WireFormat(self.spec.registry, dict(sorted_ev.derived_cols))
         t0 = time.perf_counter()
         packed, side_flat = wire.pack_flat(sorted_ev.type_ids, sorted_ev.cols)
         # tail padding so every [start + t_base, width) slab slice stays in
@@ -587,7 +607,14 @@ class ReplayEngine:
 
     def upload_resident(self, w: "ResidentWire") -> "ResidentCorpus":
         """Device-side half of :meth:`prepare_resident`: ship a packed wire
-        corpus (fresh or mmapped from disk) and return the replay handle."""
+        corpus (fresh or mmapped from disk) and return the replay handle.
+
+        Buffer lengths are bucketed to powers of two by default
+        (``surge.replay.resident-len-bucket = pow2``), so consecutive uploads
+        of different-sized corpora — segment chunks in a restore — reuse one
+        compiled program per bucket instead of recompiling per exact length;
+        ``exact`` skips the padding for single-corpus workloads that warm
+        explicitly (bench)."""
         if self.mesh is not None:
             raise NotImplementedError(
                 "resident-corpus replay is single-device; use replay_columnar "
@@ -597,15 +624,36 @@ class ReplayEngine:
                 f"wire guard {w.guard} is smaller than the engine's tile width "
                 f"{self.resident_tile_width()}; repack or lower "
                 "surge.replay.time-chunk")
+        # layout fingerprint check: never decode a wire packed under a
+        # different schema (misaligned bytes would fold silently-wrong states)
+        wire = WireFormat(self.spec.registry, dict(w.derived_key))
+        if wire.nbytes != w.packed.shape[1]:
+            raise ValueError(
+                f"wire layout mismatch: corpus packed {w.packed.shape[1]} "
+                f"byte(s)/event but the engine's schema packs {wire.nbytes}; "
+                "rebuild the wire with pack_resident")
+        want_sides = {f.name: np.dtype(f.dtype) for f in wire.side_fields}
+        got_sides = {k: np.dtype(v.dtype) for k, v in w.side.items()}
+        if want_sides != got_sides:
+            raise ValueError(
+                f"wire side-column mismatch: corpus has {got_sides}, engine "
+                f"schema expects {want_sides}; rebuild the wire")
         import jax
 
         b = w.lengths.shape[0]
         t0 = time.perf_counter()
-        flat_wire = jax.device_put(np.ascontiguousarray(w.packed))
-        flat_side = {k: jax.device_put(np.ascontiguousarray(v))
+        pow2 = self.config.get_str(
+            "surge.replay.resident-len-bucket", "pow2") == "pow2"
+        flat_wire = jax.device_put(_bucket_rows(w.packed, pow2))
+        flat_side = {k: jax.device_put(_bucket_rows(v, pow2))
                      for k, v in w.side.items()}
         bs = min(self.batch_size, _round_up(max(b, 1), self._lane_multiple()))
         b_pad = _round_up(max(b, 1), bs)
+        if pow2:
+            chunks = 1
+            while chunks * bs < b_pad:
+                chunks *= 2
+            b_pad = chunks * bs
         starts_p = np.zeros((b_pad,), dtype=np.int32)
         starts_p[:b] = w.starts
         lens_p = np.zeros((b_pad,), dtype=np.int32)
@@ -759,7 +807,7 @@ class ReplayEngine:
             i0s_p[:k_n] = i0s
             tb_p = np.zeros((k_cap,), dtype=np.int32)
             tb_p[:k_n] = t_bases
-            self._signatures.add(("resident", key, plan.width, bs, k_cap, b_pad))
+            self._signatures.add(("resident", key, plan.width, bs, k_cap, b_pad, int(resident.flat_wire.shape[0])))
             self.stats["windows"] += k_n
             slab = fold(slab, resident.flat_wire, resident.flat_side,
                         resident.starts_dev, resident.lens_dev, ord_d,
@@ -822,7 +870,7 @@ class ReplayEngine:
                        resident.starts_dev, resident.lens_dev, zeros,
                        wl, wl, np.int32(0))
             jax.block_until_ready(out)
-            self._signatures.add(("resident", key, plan.width, bs, k_cap, b_pad))
+            self._signatures.add(("resident", key, plan.width, bs, k_cap, b_pad, int(resident.flat_wire.shape[0])))
 
     def _resident_program(self, key: frozenset, width: int, bs: int,
                           k_cap: int):

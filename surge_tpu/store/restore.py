@@ -151,6 +151,12 @@ def restore_from_segment(
     schema = info["schema"]
     extra = schema.get("extra", {})
     part_filter = None if partitions is None else {int(p) for p in partitions}
+    # single-device restores fold each chunk through the resident path (one
+    # upload + one program + one sync per chunk) — on a high-latency device
+    # link the streaming path's per-window host round-trips dominate instead;
+    # mesh-sharded restores keep the streaming fold (resident is single-device)
+    use_resident = mesh is None and cfg.get_str(
+        "surge.replay.segment-backend", "resident") == "resident"
 
     # Incremental segments append DELTA chunks whose aggregates CONTINUE earlier
     # chunks' folds: keep each chunk's tensor states + an id index so a later
@@ -177,7 +183,11 @@ def restore_from_segment(
                     for i, a in hits:
                         ci, row = where[a]
                         col[i] = chunk_states[ci][name][row]
-        res = engine.replay_columnar(chunk, init_carry=init)
+        if use_resident:
+            res = engine.replay_resident(engine.prepare_resident(chunk),
+                                         init_carry=init)
+        else:
+            res = engine.replay_columnar(chunk, init_carry=init)
         if track:
             chunk_states.append({k: np.asarray(v)
                                  for k, v in res.states.items()})

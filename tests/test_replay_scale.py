@@ -242,7 +242,8 @@ def test_resident_corpus_replay_matches_streaming_and_scalar():
     eng = ReplayEngine(counter.make_replay_spec(), config=cfg)
     resident = eng.prepare_resident(corpus.events)
     # 1 byte/event on the link + the guard tail (slice safety)
-    guard = max(eng.resident_tile_width(), 8192)
+    from surge_tpu.replay.engine import _WIRE_GUARD_MIN
+    guard = max(eng.resident_tile_width(), _WIRE_GUARD_MIN)
     assert resident.wire_bytes == corpus.num_events + guard
     res = eng.replay_resident(resident)
     np.testing.assert_array_equal(res.states["count"], corpus.expected_count)
@@ -281,6 +282,49 @@ def test_resident_wire_save_load_roundtrip(tmp_path):
     assert big.resident_tile_width() > loaded.guard
     with pytest.raises(ValueError):
         big.upload_resident(loaded)
+
+
+def test_resident_len_bucketing_reuses_programs_across_sizes():
+    """With the default pow2 length bucketing, replaying two different-sized
+    corpora (e.g. consecutive restore chunks) whose buffers land in the same
+    bucket must not add a second compiled-program signature."""
+    from surge_tpu.replay.corpus import synth_counter_corpus
+
+    eng = ReplayEngine(counter.make_replay_spec(), config=Config(overrides={
+        "surge.replay.batch-size": 128, "surge.replay.time-chunk": 32}))
+    c1 = synth_counter_corpus(500, 20_000, seed=1)
+    c2 = synth_counter_corpus(470, 23_000, seed=2)
+    r1 = eng.replay_resident(eng.prepare_resident(c1.events))
+    n_after_first = eng.num_compiles()
+    r2 = eng.replay_resident(eng.prepare_resident(c2.events))
+    assert eng.num_compiles() == n_after_first, "same bucket must reuse programs"
+    np.testing.assert_array_equal(r1.states["count"], c1.expected_count)
+    np.testing.assert_array_equal(r2.states["count"], c2.expected_count)
+
+
+def test_resident_wire_layout_mismatch_refused(tmp_path):
+    """A wire packed under a different schema layout must be refused at upload
+    (silent misaligned decode would fold wrong states)."""
+    import dataclasses
+
+    from surge_tpu.models import bank_account as ba
+    from surge_tpu.replay.corpus import synth_counter_corpus
+    from surge_tpu.replay.engine import ResidentWire
+
+    corpus = synth_counter_corpus(100, 2_000, seed=4)
+    eng = ReplayEngine(counter.make_replay_spec(), config=Config(overrides={
+        "surge.replay.batch-size": 64}))
+    wire = eng.pack_resident(corpus.events)
+    # forge a layout drift: pretend the wire was packed with 2 bytes/event
+    forged = dataclasses.replace(
+        wire, packed=np.repeat(wire.packed, 2, axis=1))
+    with pytest.raises(ValueError, match="layout mismatch"):
+        eng.upload_resident(forged)
+    # and a different model's engine must refuse this wire's side columns
+    beng = ReplayEngine(ba.BankAccountModel().replay_spec(),
+                        config=Config(overrides={"surge.replay.batch-size": 64}))
+    with pytest.raises(ValueError):
+        beng.upload_resident(wire)
 
 
 def test_resident_unsorted_skewed_plan_stays_chunk_local():
