@@ -91,6 +91,25 @@ class WireFormat:
         """Transfer cost per event slot (packed word + side columns)."""
         return self.nbytes + sum(f.dtype.itemsize for f in self.side_fields)
 
+    def layout_fingerprint(self) -> dict:
+        """A JSON-round-trippable description of the exact bit/byte layout.
+
+        Persisted next to packed corpora (ResidentWire meta) so a consuming
+        engine whose schema evolved — field widths, order, type count — is
+        refused instead of decoding misaligned bits into silently-wrong
+        states. Two schemas that pack to the same byte count but different bit
+        positions produce different fingerprints."""
+        return {
+            "num_types": self.num_types,
+            "type_bits": self.type_bits,
+            "nbytes": self.nbytes,
+            "packed": [[pf.name, str(np.dtype(pf.dtype)), pf.bits, pf.shift]
+                       for pf in self.packed_fields],
+            "side": [[f.name, str(np.dtype(f.dtype))]
+                     for f in self.side_fields],
+            "derived": sorted([k, v] for k, v in self.derived.items()),
+        }
+
     # -- host side ----------------------------------------------------------------------
 
     def pack_window(self, type_ids: np.ndarray, cols: Mapping[str, np.ndarray],

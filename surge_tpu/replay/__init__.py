@@ -20,6 +20,7 @@ from surge_tpu.replay.engine import (
     make_step_fn,
     make_batch_fold,
 )
+from surge_tpu.replay.mixed import MixedReplay, combine_replay_specs
 
-__all__ = ["ReplayEngine", "ReplayResult", "ResidentWire", "make_step_fn",
-           "make_batch_fold"]
+__all__ = ["ReplayEngine", "ReplayResult", "ResidentWire", "MixedReplay",
+           "combine_replay_specs", "make_step_fn", "make_batch_fold"]

@@ -142,6 +142,10 @@ class ResidentWire:
     perm: Optional[np.ndarray]  # sorted-rank -> original index
     guard: int
     num_events: int
+    #: WireFormat.layout_fingerprint() of the packing schema; None only for
+    #: wires saved before fingerprints existed (upload falls back to the
+    #: structural byte/side checks)
+    layout: Optional[dict] = None
 
     def save(self, root: str) -> None:
         import json
@@ -163,7 +167,8 @@ class ResidentWire:
                 # must refuse the wire rather than decode misaligned bytes
                 "nbytes": int(self.packed.shape[1]),
                 "side_dtypes": {k: str(np.dtype(v.dtype))
-                                for k, v in self.side.items()}}
+                                for k, v in self.side.items()},
+                "layout": self.layout}
         with open(os.path.join(root, "wire.json"), "w") as f:
             json.dump(meta, f)
 
@@ -182,7 +187,8 @@ class ResidentWire:
             starts=np.asarray(mm("starts.npy")),
             lengths=np.asarray(mm("lengths.npy")),
             perm=np.asarray(mm("perm.npy")) if meta["has_perm"] else None,
-            guard=int(meta["guard"]), num_events=int(meta["num_events"]))
+            guard=int(meta["guard"]), num_events=int(meta["num_events"]),
+            layout=meta.get("layout"))
 
 
 @dataclass
@@ -603,7 +609,8 @@ class ReplayEngine:
             derived_key=dict(sorted_ev.derived_cols), packed=packed,
             side=side_flat, starts=starts[:-1].astype(np.int32),
             lengths=lengths.astype(np.int32), perm=perm, guard=guard,
-            num_events=sorted_ev.num_events)
+            num_events=sorted_ev.num_events,
+            layout=wire.layout_fingerprint())
 
     def upload_resident(self, w: "ResidentWire") -> "ResidentCorpus":
         """Device-side half of :meth:`prepare_resident`: ship a packed wire
@@ -625,9 +632,16 @@ class ReplayEngine:
                 f"{self.resident_tile_width()}; repack or lower "
                 "surge.replay.time-chunk")
         # layout fingerprint check: never decode a wire packed under a
-        # different schema (misaligned bytes would fold silently-wrong states)
+        # different schema (misaligned BITS would fold silently-wrong states —
+        # the fingerprint pins field order, widths, shifts and type count, not
+        # just the total byte width)
         wire = WireFormat(self.spec.registry, dict(w.derived_key))
-        if wire.nbytes != w.packed.shape[1]:
+        if w.layout is not None and w.layout != wire.layout_fingerprint():
+            raise ValueError(
+                f"wire layout mismatch: corpus was packed as {w.layout}, "
+                f"engine schema packs {wire.layout_fingerprint()}; "
+                "rebuild the wire with pack_resident")
+        if wire.nbytes != w.packed.shape[1]:  # also guards corrupted buffers
             raise ValueError(
                 f"wire layout mismatch: corpus packed {w.packed.shape[1]} "
                 f"byte(s)/event but the engine's schema packs {wire.nbytes}; "
@@ -644,9 +658,10 @@ class ReplayEngine:
         t0 = time.perf_counter()
         pow2 = self.config.get_str(
             "surge.replay.resident-len-bucket", "pow2") == "pow2"
-        flat_wire = jax.device_put(_bucket_rows(w.packed, pow2))
-        flat_side = {k: jax.device_put(_bucket_rows(v, pow2))
-                     for k, v in w.side.items()}
+        packed_b = _bucket_rows(w.packed, pow2)
+        side_b = {k: _bucket_rows(v, pow2) for k, v in w.side.items()}
+        flat_wire = jax.device_put(packed_b)
+        flat_side = {k: jax.device_put(v) for k, v in side_b.items()}
         bs = min(self.batch_size, _round_up(max(b, 1), self._lane_multiple()))
         b_pad = _round_up(max(b, 1), bs)
         if pow2:
@@ -669,7 +684,7 @@ class ReplayEngine:
             lengths=w.lengths, perm=w.perm,
             starts_dev=starts_dev, lens_dev=lens_dev, b_pad=b_pad,
             num_events=w.num_events,
-            wire_bytes=w.packed.nbytes + sum(v.nbytes for v in w.side.values()),
+            wire_bytes=packed_b.nbytes + sum(v.nbytes for v in side_b.values()),
             upload_s=upload_s)
 
     def prepare_resident(self, colev: ColumnarEvents) -> "ResidentCorpus":

@@ -238,10 +238,12 @@ def test_resident_corpus_replay_matches_streaming_and_scalar():
 
     corpus = synth_counter_corpus(3000, 120_000, seed=17)  # unsorted order
     cfg = Config(overrides={"surge.replay.batch-size": 256,
-                            "surge.replay.time-chunk": 32})
+                            "surge.replay.time-chunk": 32,
+                            "surge.replay.resident-len-bucket": "exact"})
     eng = ReplayEngine(counter.make_replay_spec(), config=cfg)
     resident = eng.prepare_resident(corpus.events)
-    # 1 byte/event on the link + the guard tail (slice safety)
+    # 1 byte/event on the link + the guard tail (slice safety); exact bucket
+    # policy so the shipped bytes equal the information bytes
     from surge_tpu.replay.engine import _WIRE_GUARD_MIN
     guard = max(eng.resident_tile_width(), _WIRE_GUARD_MIN)
     assert resident.wire_bytes == corpus.num_events + guard
@@ -320,6 +322,13 @@ def test_resident_wire_layout_mismatch_refused(tmp_path):
         wire, packed=np.repeat(wire.packed, 2, axis=1))
     with pytest.raises(ValueError, match="layout mismatch"):
         eng.upload_resident(forged)
+    # same byte count but different BIT layout (field shifts moved) must also
+    # be refused — the fingerprint pins positions, not just widths
+    drifted_layout = dict(wire.layout)
+    drifted_layout["packed"] = [[n, d, b, s + 1]
+                                for n, d, b, s in drifted_layout["packed"]]
+    with pytest.raises(ValueError, match="layout mismatch"):
+        eng.upload_resident(dataclasses.replace(wire, layout=drifted_layout))
     # and a different model's engine must refuse this wire's side columns
     beng = ReplayEngine(ba.BankAccountModel().replay_spec(),
                         config=Config(overrides={"surge.replay.batch-size": 64}))
