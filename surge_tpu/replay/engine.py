@@ -110,18 +110,23 @@ class ResidentCorpus:
 _WIRE_GUARD_MIN = 8192
 
 
+def _bucket_len(n: int) -> int:
+    """Next power of two ≥ n (min 64Ki) — the bucketed buffer length."""
+    target = 1 << 16
+    while target < n:
+        target <<= 1
+    return target
+
+
 def _bucket_rows(arr: np.ndarray, pow2: bool) -> np.ndarray:
     """Zero-pad the leading axis to the next power of two (min 64Ki rows) so
     program shapes bucket; identity when bucketing is off or already sized."""
     if not pow2:
         return np.ascontiguousarray(arr)
-    n = arr.shape[0]
-    target = 1 << 16
-    while target < n:
-        target <<= 1
-    if target == n:
+    target = _bucket_len(arr.shape[0])
+    if target == arr.shape[0]:
         return np.ascontiguousarray(arr)
-    pad = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    pad = [(0, target - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
     return np.pad(arr, pad)
 
 
@@ -686,6 +691,29 @@ class ReplayEngine:
             num_events=w.num_events,
             wire_bytes=packed_b.nbytes + sum(v.nbytes for v in side_b.values()),
             upload_s=upload_s)
+
+    def prepare_resident_sharded(self, source):
+        """Mesh form of :meth:`prepare_resident`: deal the packed corpus's
+        lanes round-robin across the mesh axis and upload each device's shard
+        (surge_tpu.replay.resident_mesh). ``source`` is a ColumnarEvents or an
+        already-packed ResidentWire."""
+        from surge_tpu.replay.resident_mesh import ShardedResident
+
+        wire = (source if isinstance(source, ResidentWire)
+                else self.pack_resident(source))
+        return ShardedResident(self, wire)
+
+    def replay_resident_sharded(self, sharded,
+                                init_carry: Mapping[str, Any] | None = None,
+                                ordinal_base: np.ndarray | None = None
+                                ) -> ReplayResult:
+        """Fold a :meth:`prepare_resident_sharded` corpus across the mesh —
+        the tile-loop design with one shard_map dispatch per granularity and
+        one device→host pull, no collectives (lanes are independent)."""
+        from surge_tpu.replay.resident_mesh import replay_resident_sharded
+
+        return replay_resident_sharded(self, sharded, init_carry=init_carry,
+                                       ordinal_base=ordinal_base)
 
     def prepare_resident(self, colev: ColumnarEvents) -> "ResidentCorpus":
         """Upload the WHOLE corpus once as a flat wire buffer (exactly

@@ -91,10 +91,9 @@ def combine_replay_specs(specs: Mapping[str, ReplaySpec]) -> MixedReplay:
     The combined spec's own ``init_record`` is empty (all-zero lanes): a
     per-model initial state cannot be expressed globally because lanes of
     different models share columns. Models that declare a nonzero
-    ``init_record`` are therefore REFUSED unless the replay will be driven
-    with :meth:`MixedReplay.init_carry` — pass ``allow_nonzero_init=True`` to
-    acknowledge that, and always supply ``init_carry=mixed.init_carry(models)``
-    to the fold."""
+    ``init_record`` are therefore REFUSED here — use
+    :func:`combine_replay_specs_with_init` to acknowledge that, and always
+    supply ``init_carry=mixed.init_carry(models)`` to the fold."""
     return _combine(specs, allow_nonzero_init=False)
 
 
