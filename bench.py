@@ -174,11 +174,17 @@ def replay_child(corpus_dir: str) -> None:
         else:
             resident = engine.prepare_resident(corpus.events)
         prepare_s = time.perf_counter() - t0
-        # compile the single tile program against the real buffers (no-op fold)
+        # compile the single tile program against the real buffers, then run
+        # one full throwaway pass: the first real execution pays a one-time
+        # runtime/autotune cost (~0.7s measured) that is warmup, not replay —
+        # the timed pass still re-uploads its per-replay inputs and re-folds
+        # every event
         engine.warm_resident(resident)
+        engine.replay_resident(resident)
+        engine.stats["windows"] = 0  # count only the timed pass's windows
         warm_compiles = engine.num_compiles()
         log(f"resident corpus: {resident.wire_bytes / 1e6:.0f} MB shipped in "
-            f"{resident.upload_s:.1f}s; gather programs warmed")
+            f"{resident.upload_s:.1f}s; programs warmed + throwaway pass done")
         t0 = time.perf_counter()
         result = engine.replay_resident(resident)
         fold_s = time.perf_counter() - t0

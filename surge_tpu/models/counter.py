@@ -193,6 +193,54 @@ def make_replay_spec() -> ReplaySpec:
     )
 
 
+def make_associative_fold():
+    """The counter fold as an associative transform monoid, for
+    sequence-parallel replay of very long logs (surge_tpu.replay.seqpar).
+
+    Summary = (d_count, has_version_event, last_sequence_number): count is
+    additive; version is the sequence number of the LAST version-setting event
+    (inc/dec/unserializable — NoOpEvent leaves it, mirroring handle_event).
+    ``combine`` is associative but not commutative (right-biased version)."""
+    import jax.numpy as jnp
+
+    from surge_tpu.replay.seqpar import AssociativeFold
+
+    import numpy as np
+
+    def lift(ev):
+        tid = ev["type_id"]
+        inc = (tid == INCREMENTED)
+        dec = (tid == DECREMENTED)
+        sets_version = inc | dec | (tid == UNSERIALIZABLE)
+        d = (jnp.where(inc, ev["increment_by"], 0)
+             - jnp.where(dec, ev["decrement_by"], 0))
+        return {
+            "d_count": d.astype(jnp.int32),
+            "has": sets_version,
+            "last_seq": jnp.where(sets_version, ev["sequence_number"],
+                                  0).astype(jnp.int32),
+        }
+
+    def combine(a, b):
+        return {
+            "d_count": a["d_count"] + b["d_count"],
+            "has": a["has"] | b["has"],
+            "last_seq": jnp.where(b["has"], b["last_seq"], a["last_seq"]),
+        }
+
+    def apply(state, s):
+        return {
+            "count": (state["count"] + s["d_count"]).astype(jnp.int32),
+            "version": jnp.where(s["has"], s["last_seq"],
+                                 state["version"]).astype(jnp.int32),
+        }
+
+    return AssociativeFold(
+        lift=lift, combine=combine, apply=apply,
+        identity={"d_count": np.int32(0), "has": np.bool_(False),
+                  "last_seq": np.int32(0)})
+
+
 # --- byte formats (play-json Format equivalents, TestBoundedContext.scala:84-110) ---
 
 _EVENT_TYPES = {c.__name__: c for c in (CountIncremented, CountDecremented, NoOpEvent,

@@ -21,6 +21,8 @@ from surge_tpu.replay.engine import (
     make_batch_fold,
 )
 from surge_tpu.replay.mixed import MixedReplay, combine_replay_specs
+from surge_tpu.replay.seqpar import AssociativeFold, replay_time_sharded
 
 __all__ = ["ReplayEngine", "ReplayResult", "ResidentWire", "MixedReplay",
-           "combine_replay_specs", "make_step_fn", "make_batch_fold"]
+           "combine_replay_specs", "AssociativeFold", "replay_time_sharded",
+           "make_step_fn", "make_batch_fold"]

@@ -617,20 +617,12 @@ class ReplayEngine:
             num_events=sorted_ev.num_events,
             layout=wire.layout_fingerprint())
 
-    def upload_resident(self, w: "ResidentWire") -> "ResidentCorpus":
-        """Device-side half of :meth:`prepare_resident`: ship a packed wire
-        corpus (fresh or mmapped from disk) and return the replay handle.
-
-        Buffer lengths are bucketed to powers of two by default
-        (``surge.replay.resident-len-bucket = pow2``), so consecutive uploads
-        of different-sized corpora — segment chunks in a restore — reuse one
-        compiled program per bucket instead of recompiling per exact length;
-        ``exact`` skips the padding for single-corpus workloads that warm
-        explicitly (bench)."""
-        if self.mesh is not None:
-            raise NotImplementedError(
-                "resident-corpus replay is single-device; use replay_columnar "
-                "for mesh-sharded folds")
+    def check_wire(self, w: "ResidentWire") -> WireFormat:
+        """Validate a (possibly disk-loaded) wire against this engine: guard
+        rows cover the tile width, and the packing layout matches the engine's
+        schema bit-for-bit. Returns the engine's WireFormat for the wire's
+        derived-column declaration. Shared by the single-device and sharded
+        upload paths — a stale wire must never decode silently-wrong states."""
         if w.guard < self.resident_tile_width():
             raise ValueError(
                 f"wire guard {w.guard} is smaller than the engine's tile width "
@@ -657,6 +649,23 @@ class ReplayEngine:
             raise ValueError(
                 f"wire side-column mismatch: corpus has {got_sides}, engine "
                 f"schema expects {want_sides}; rebuild the wire")
+        return wire
+
+    def upload_resident(self, w: "ResidentWire") -> "ResidentCorpus":
+        """Device-side half of :meth:`prepare_resident`: ship a packed wire
+        corpus (fresh or mmapped from disk) and return the replay handle.
+
+        Buffer lengths are bucketed to powers of two by default
+        (``surge.replay.resident-len-bucket = pow2``), so consecutive uploads
+        of different-sized corpora — segment chunks in a restore — reuse one
+        compiled program per bucket instead of recompiling per exact length;
+        ``exact`` skips the padding for single-corpus workloads that warm
+        explicitly (bench)."""
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "this engine is mesh-backed; use prepare_resident_sharded / "
+                "replay_resident_sharded for the resident path")
+        self.check_wire(w)
         import jax
 
         b = w.lengths.shape[0]
@@ -729,8 +738,8 @@ class ReplayEngine:
         the pack so later cold starts skip straight to the upload."""
         if self.mesh is not None:
             raise NotImplementedError(
-                "resident-corpus replay is single-device; use replay_columnar "
-                "for mesh-sharded folds")
+                "this engine is mesh-backed; use prepare_resident_sharded / "
+                "replay_resident_sharded for the resident path")
         return self.upload_resident(self.pack_resident(colev))
 
     def _resident_plan(self, resident: "ResidentCorpus") -> "ResidentPlan":
@@ -810,8 +819,8 @@ class ReplayEngine:
         device→host pull of the folded states at the end."""
         if self.mesh is not None:
             raise NotImplementedError(
-                "resident-corpus replay is single-device; use replay_columnar "
-                "for mesh-sharded folds")
+                "this engine is mesh-backed; use prepare_resident_sharded / "
+                "replay_resident_sharded for the resident path")
         import jax
 
         b = resident.lengths.shape[0]

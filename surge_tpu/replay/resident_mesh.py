@@ -41,6 +41,7 @@ class ShardedResident:
 
         if engine.mesh is None:
             raise ValueError("ShardedResident requires a mesh-backed engine")
+        engine.check_wire(wire)  # layout/guard safety, same as upload_resident
         self.engine = engine
         self.wire_host = wire
         mesh = engine.mesh

@@ -1,0 +1,147 @@
+"""Sequence-parallel replay: one aggregate's LONG log sharded across devices.
+
+The reference's long-sequence analog is a long per-aggregate event log
+(SURVEY.md §5.7) — it replays one sequentially. Entity parallelism
+(`resident_mesh`) cannot help when one log dwarfs the batch: a fold is a
+sequential dependence chain. This module is the event-sourcing form of
+sequence/context parallelism (the ring-attention role for this framework):
+models whose fold is **associative** declare
+
+- ``lift(event_fields) -> summary``  — per-event state-transform summary,
+- ``combine(s1, s2) -> summary``     — associative (NOT necessarily
+  commutative) composition of transforms,
+- ``apply(state, summary) -> state`` — apply a composed transform,
+- ``identity``                        — the no-op summary (padding lifts here),
+
+and the engine shards the TIME axis over the mesh: each device lifts and
+scan-combines its slice of the log into one summary per lane, a single
+ordered ``all_gather`` moves the (tiny) per-device summaries everywhere, and
+each device composes them in device order — O(T/D) sequential work instead of
+O(T), with one collective of size D×B summaries riding ICI. The classic
+parallel event-sourcing trick (monoid fold / parallel prefix), here as an
+SPMD program.
+
+Not every model qualifies (general ``handle_event`` is opaque); the batched
+entity-parallel fold remains the default. Counter-like additive models, and
+any model whose transforms close under composition, do.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Mapping
+
+import numpy as np
+
+Summary = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class AssociativeFold:
+    """An associative decomposition of a model's event fold."""
+
+    lift: Callable[[Mapping[str, Any]], Summary]
+    combine: Callable[[Summary, Summary], Summary]
+    apply: Callable[[Dict[str, Any], Summary], Dict[str, Any]]
+    identity: Summary
+
+
+def replay_time_sharded(afold: AssociativeFold, spec, events: Mapping[str, Any],
+                        mesh, *, mesh_axis: str = "data",
+                        init_carry: Mapping[str, Any] | None = None
+                        ) -> dict[str, np.ndarray]:
+    """Fold time-major event columns ``{col: [T, B]}`` (type_id -1 = padding)
+    with the time axis sharded over ``mesh_axis``. Returns state columns
+    ``{field: [B]}`` identical to the sequential fold.
+
+    ``T`` is padded up to a multiple of the device count; padding slots lift
+    to ``identity`` (callers' ``lift`` must honor ``type_id == -1``).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    t = next(iter(events.values())).shape[0]
+    b = next(iter(events.values())).shape[1]
+    t_pad = -(-max(t, 1) // n_dev) * n_dev
+    padded: dict[str, Any] = {}
+    for name, col in events.items():
+        col = np.asarray(col)
+        if t_pad != t:
+            fill = -1 if name == "type_id" else 0
+            col = np.concatenate(
+                [col, np.full((t_pad - t, b), fill, dtype=col.dtype)], axis=0)
+        padded[name] = col
+
+    init = {f.name: np.broadcast_to(
+        np.asarray(spec.init_state_tree()[f.name]), (b,)).copy()
+        for f in spec.registry.state.fields}
+    if init_carry is not None:
+        for k, v in init_carry.items():
+            init[k] = np.asarray(v).copy()
+
+    program = _program(afold, mesh, mesh_axis, b,
+                       tuple(sorted((k, v.shape, str(v.dtype))
+                                    for k, v in padded.items())),
+                       tuple(sorted(init)))
+    p_ev = P(mesh_axis, None)
+    ev_dev = {k: jax.device_put(v, NamedSharding(mesh, p_ev))
+              for k, v in padded.items()}
+    init_dev = {k: jax.device_put(v[None], NamedSharding(mesh, P(None, None)))
+                for k, v in init.items()}
+    out = program(ev_dev, init_dev)
+    return {k: np.asarray(v)[0] for k, v in out.items()}
+
+
+#: compiled time-sharded programs, keyed on (fold, mesh, axis, shapes) — a
+#: chunked/resumed replay of one long log reuses one program per shape bucket
+_PROGRAMS: dict = {}
+
+
+def _program(afold: AssociativeFold, mesh, mesh_axis: str, b: int,
+             ev_shapes: tuple, init_names: tuple):
+    # keyed on the fold OBJECT's identity (its dict members are unhashable);
+    # the cache entry pins the fold, so a freed object's id can never alias a
+    # live entry. Callers should build one AssociativeFold per model.
+    key = (id(afold), mesh, mesh_axis, b, ev_shapes, init_names)
+    hit = _PROGRAMS.get(key)
+    if hit is not None:
+        return hit[1]
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = int(np.prod(mesh.devices.shape))
+
+    def local(events_local, init_state):
+        # events_local: {col: [T/D, B]} time block; scan-combine the lifted
+        # summaries of the local slice (order-preserving)
+        def body(acc, ev_t):
+            return afold.combine(acc, afold.lift(ev_t)), None
+
+        ident = {k: jnp.broadcast_to(jnp.asarray(v), (b,))
+                 for k, v in afold.identity.items()}
+        local_sum, _ = jax.lax.scan(body, ident, events_local)
+        # one ordered collective: every device sees all D summaries [D, B]
+        allsum = {k: jax.lax.all_gather(v, mesh_axis)
+                  for k, v in local_sum.items()}
+
+        def compose(acc, d):
+            return afold.combine(acc, {k: v[d] for k, v in allsum.items()}), None
+
+        total, _ = jax.lax.scan(compose, ident, jnp.arange(n_dev))
+        out = afold.apply({k: v[0] for k, v in init_state.items()}, total)
+        return {k: v[None] for k, v in out.items()}
+
+    p_ev = P(mesh_axis, None)
+    ev_names = tuple(k for k, _, _ in ev_shapes)
+    mapped = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=({k: p_ev for k in ev_names},
+                  {k: P(None, None) for k in init_names}),
+        out_specs={k: P(None, None) for k in init_names},
+        check_vma=False)
+    jitted = jax.jit(mapped)
+    _PROGRAMS[key] = (afold, jitted)
+    return jitted

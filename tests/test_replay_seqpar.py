@@ -1,0 +1,110 @@
+"""Sequence-parallel replay (surge_tpu.replay.seqpar): one aggregate's long
+log sharded across the TIME axis of the mesh, composed with an ordered
+all_gather — the framework's long-context / ring-attention analog
+(SURVEY.md §5.7). Golden-checked against the scalar fold."""
+
+import random
+
+import jax
+import numpy as np
+
+from surge_tpu.codec.tensor import encode_events
+from surge_tpu.engine.model import fold_events
+from surge_tpu.models import counter
+from surge_tpu.replay.seqpar import replay_time_sharded
+
+
+def _mesh():
+    devs = jax.devices()
+    assert len(devs) == 8
+    return jax.sharding.Mesh(np.array(devs), ("data",))
+
+
+def _long_logs(n_aggs, t_max, seed):
+    rng = random.Random(seed)
+    model = counter.CounterModel()
+    logs = []
+    for i in range(n_aggs):
+        agg, state, log = f"a{i}", None, []
+        for _ in range(rng.randrange(t_max // 2, t_max)):
+            r = rng.random()
+            if r < 0.55:
+                cmd = counter.Increment(agg)
+            elif r < 0.85:
+                cmd = counter.Decrement(agg)
+            else:
+                cmd = counter.CreateNoOpEvent(agg)
+            for e in model.process_command(state, cmd):
+                state = model.handle_event(state, e)
+                log.append(e)
+        logs.append(log)
+    return logs
+
+
+def test_time_sharded_long_log_matches_scalar():
+    mesh = _mesh()
+    model = counter.CounterModel()
+    spec = counter.make_replay_spec()
+    # a batch whose per-lane logs are LONG relative to the batch (the regime
+    # entity parallelism can't cover) and ragged; T not divisible by 8
+    logs = _long_logs(5, 2003, seed=31)
+    expected = [fold_events(model, None, log) for log in logs]
+
+    enc = encode_events(spec.registry, logs)
+    events = {"type_id": enc.type_ids.T.astype(np.int32)}
+    for name, col in enc.cols.items():
+        events[name] = col.T
+    out = replay_time_sharded(counter.make_associative_fold(), spec, events,
+                              mesh)
+    for i, exp in enumerate(expected):
+        assert int(out["count"][i]) == exp.count, i
+        assert int(out["version"][i]) == exp.version, i
+
+
+def test_time_sharded_resume_carry():
+    mesh = _mesh()
+    model = counter.CounterModel()
+    spec = counter.make_replay_spec()
+    logs = _long_logs(3, 600, seed=5)
+    expected = [fold_events(model, None, log) for log in logs]
+    cut = [len(l) // 3 for l in logs]
+
+    def to_cols(parts):
+        enc = encode_events(spec.registry, parts)
+        ev = {"type_id": enc.type_ids.T.astype(np.int32)}
+        for name, col in enc.cols.items():
+            ev[name] = col.T
+        return ev
+
+    afold = counter.make_associative_fold()
+    first = replay_time_sharded(afold, spec,
+                                to_cols([l[:c] for l, c in zip(logs, cut)]),
+                                mesh)
+    second = replay_time_sharded(afold, spec,
+                                 to_cols([l[c:] for l, c in zip(logs, cut)]),
+                                 mesh, init_carry=first)
+    for i, exp in enumerate(expected):
+        assert int(second["count"][i]) == exp.count, i
+        assert int(second["version"][i]) == exp.version, i
+
+
+def test_associativity_property():
+    """combine must be associative for arbitrary summary triples (the property
+    the sequence-parallel schedule relies on)."""
+    import jax.numpy as jnp
+
+    afold = counter.make_associative_fold()
+    rng = np.random.default_rng(0)
+
+    def rand_summary():
+        return {"d_count": jnp.asarray(rng.integers(-5, 5, 16), jnp.int32),
+                "has": jnp.asarray(rng.integers(0, 2, 16), bool),
+                "last_seq": jnp.asarray(rng.integers(0, 99, 16), jnp.int32)}
+
+    for _ in range(10):
+        a, b, c = rand_summary(), rand_summary(), rand_summary()
+        left = afold.combine(afold.combine(a, b), c)
+        right = afold.combine(a, afold.combine(b, c))
+        for k in left:
+            np.testing.assert_array_equal(np.asarray(left[k]),
+                                          np.asarray(right[k]))
