@@ -193,6 +193,9 @@ def make_replay_spec() -> ReplaySpec:
     )
 
 
+_ASSOCIATIVE_FOLD = None
+
+
 def make_associative_fold():
     """The counter fold as an associative transform monoid, for
     sequence-parallel replay of very long logs (surge_tpu.replay.seqpar).
@@ -200,7 +203,13 @@ def make_associative_fold():
     Summary = (d_count, has_version_event, last_sequence_number): count is
     additive; version is the sequence number of the LAST version-setting event
     (inc/dec/unserializable — NoOpEvent leaves it, mirroring handle_event).
-    ``combine`` is associative but not commutative (right-biased version)."""
+    ``combine`` is associative but not commutative (right-biased version).
+
+    Memoized: seqpar caches compiled programs by fold identity, so repeated
+    calls (e.g. one per restore chunk) must return the same object."""
+    global _ASSOCIATIVE_FOLD
+    if _ASSOCIATIVE_FOLD is not None:
+        return _ASSOCIATIVE_FOLD
     import jax.numpy as jnp
 
     from surge_tpu.replay.seqpar import AssociativeFold
@@ -235,10 +244,11 @@ def make_associative_fold():
                                  state["version"]).astype(jnp.int32),
         }
 
-    return AssociativeFold(
+    _ASSOCIATIVE_FOLD = AssociativeFold(
         lift=lift, combine=combine, apply=apply,
         identity={"d_count": np.int32(0), "has": np.bool_(False),
                   "last_seq": np.int32(0)})
+    return _ASSOCIATIVE_FOLD
 
 
 # --- byte formats (play-json Format equivalents, TestBoundedContext.scala:84-110) ---

@@ -33,12 +33,23 @@ from surge_tpu.config import Config, default_config
 from surge_tpu.engine.model import ReplaySpec, StateTree
 
 
-def make_step_fn(spec: ReplaySpec) -> Callable[[StateTree, Mapping[str, Any]], StateTree]:
+def make_step_fn(spec: ReplaySpec, dispatch: str = "switch"
+                 ) -> Callable[[StateTree, Mapping[str, Any]], StateTree]:
     """One-event step for a single aggregate: dispatch on type_id, mask padding.
 
     The returned function is scalar over the batch dim (engine vmaps it). Any type_id
     outside ``[0, num_types)`` — padding (-1) or corrupt positive ids — carries state
     through unchanged rather than dispatching to an arbitrary handler.
+
+    ``dispatch`` picks the lowering:
+
+    - ``"switch"`` — ``lax.switch`` on the (clipped) type id; under ``vmap``
+      XLA turns this into predicated branches.
+    - ``"select"`` — branchless: EVERY handler runs on every slot and results
+      mask-combine with ``where``. More FLOPs but pure VPU data flow with no
+      per-branch control overhead; event handlers are a few scalar ops each,
+      so on TPU the extra arithmetic is usually cheaper than the branch
+      machinery (``surge.replay.dispatch`` selects it engine-wide).
     """
     num_types = spec.registry.num_event_types
     handlers = spec.handlers.ordered(num_types)
@@ -52,6 +63,21 @@ def make_step_fn(spec: ReplaySpec) -> Callable[[StateTree, Mapping[str, Any]], S
             v = new.get(name, old[name])
             out[name] = jnp.asarray(v, dtype=old[name].dtype)
         return out
+
+    if dispatch == "select":
+        def step(state: StateTree, event: Mapping[str, Any]) -> StateTree:
+            tid = event["type_id"]
+            fields = {k: v for k, v in event.items() if k != "type_id"}
+            out = state
+            for t, h in enumerate(handlers):
+                new = normalize(h(state, fields), state)
+                hit = tid == t
+                out = {k: jnp.where(hit, new[k], out[k]) for k in out}
+            return out
+
+        return step
+    if dispatch != "switch":
+        raise ValueError(f"unknown dispatch {dispatch!r} (switch|select)")
 
     def step(state: StateTree, event: Mapping[str, Any]) -> StateTree:
         tid = event["type_id"]
@@ -67,14 +93,14 @@ def make_step_fn(spec: ReplaySpec) -> Callable[[StateTree, Mapping[str, Any]], S
     return step
 
 
-def make_batch_fold(spec: ReplaySpec, *, unroll: int = 1):
+def make_batch_fold(spec: ReplaySpec, *, unroll: int = 1, dispatch: str = "switch"):
     """Batched fold: ``(carry {name:[B]}, events {col:[T,B]}) -> carry``.
 
     The per-aggregate fold of CommandModels.scala:20-21 / PersistentActor's applyEvents,
     vectorized: ``lax.scan`` over T of ``vmap``-over-B of the switch step. jit-compiled by
     the caller (ReplayEngine) with carry donation.
     """
-    step = make_step_fn(spec)
+    step = make_step_fn(spec, dispatch)
     vstep = jax.vmap(step, in_axes=(0, 0))
 
     def fold(carry: StateTree, events: Mapping[str, jnp.ndarray]) -> StateTree:
@@ -264,6 +290,7 @@ class ReplayEngine:
         self.buckets = self.config.get_int_list("surge.replay.length-buckets", "64,256,1024,4096")
 
         self._unroll = unroll
+        self._dispatch = self.config.get_str("surge.replay.dispatch", "switch")
         # one (wire, jitted fold) per derived-column declaration the inputs carry —
         # in practice at most two: framework logs (ordinal seq) and object-test logs
         self._wire_folds: dict[frozenset, tuple[WireFormat, Any]] = {}
@@ -303,7 +330,8 @@ class ReplayEngine:
         if hit is not None:
             return (key, *hit)
         wire = WireFormat(self.spec.registry, derived_cols)
-        batch_fold = make_batch_fold(self.spec, unroll=self._unroll)
+        batch_fold = make_batch_fold(self.spec, unroll=self._unroll,
+                                     dispatch=self._dispatch)
 
         def fold(carry: StateTree, packed, side, ord_base) -> StateTree:
             return batch_fold(carry, wire.decode(packed, side, ord_base))
@@ -947,7 +975,8 @@ class ReplayEngine:
         import jax
 
         wire = WireFormat(self.spec.registry, dict(key))
-        batch_step = jax.vmap(make_step_fn(self.spec), in_axes=(0, 0))
+        batch_step = jax.vmap(make_step_fn(self.spec, self._dispatch),
+                              in_axes=(0, 0))
         nbytes = wire.nbytes
 
         def tile(slab_state, flat_wire, side_flat, starts_all, lens_all,

@@ -161,7 +161,8 @@ def _sharded_program(engine, key: frozenset, width: int, bs: int, k_cap: int):
     from jax.sharding import PartitionSpec as P
 
     wire = WireFormat(engine.spec.registry, dict(key))
-    batch_step = jax.vmap(make_step_fn(engine.spec), in_axes=(0, 0))
+    batch_step = jax.vmap(make_step_fn(engine.spec, engine._dispatch),
+                          in_axes=(0, 0))
     nbytes = wire.nbytes
     unroll = engine._unroll
 

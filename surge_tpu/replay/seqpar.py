@@ -64,7 +64,13 @@ def replay_time_sharded(afold: AssociativeFold, spec, events: Mapping[str, Any],
     n_dev = int(np.prod(mesh.devices.shape))
     t = next(iter(events.values())).shape[0]
     b = next(iter(events.values())).shape[1]
-    t_pad = -(-max(t, 1) // n_dev) * n_dev
+    # bucket the per-device slice length to a power of two so variable-length
+    # chunks of one long log reuse a program per bucket (padding lifts to the
+    # identity summary, costing only combine steps)
+    t_local = 8
+    while t_local * n_dev < max(t, 1):
+        t_local *= 2
+    t_pad = t_local * n_dev
     padded: dict[str, Any] = {}
     for name, col in events.items():
         col = np.asarray(col)

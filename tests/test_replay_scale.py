@@ -286,6 +286,30 @@ def test_resident_wire_save_load_roundtrip(tmp_path):
         big.upload_resident(loaded)
 
 
+def test_select_dispatch_matches_switch_dispatch():
+    """The branchless select lowering must be state-identical to lax.switch
+    across the resident and streaming paths (it exists purely as a VPU-friendly
+    lowering choice, surge.replay.dispatch)."""
+    from surge_tpu.replay.corpus import synth_counter_corpus
+
+    corpus = synth_counter_corpus(1200, 60_000, seed=23)
+    results = {}
+    for dispatch in ("switch", "select"):
+        eng = ReplayEngine(counter.make_replay_spec(), config=Config(overrides={
+            "surge.replay.batch-size": 256, "surge.replay.time-chunk": 32,
+            "surge.replay.dispatch": dispatch}))
+        r1 = eng.replay_resident(eng.prepare_resident(corpus.events))
+        r2 = eng.replay_columnar(corpus.events)
+        for name in r1.states:
+            np.testing.assert_array_equal(r1.states[name], r2.states[name])
+        results[dispatch] = r1
+    for name in results["switch"].states:
+        np.testing.assert_array_equal(results["switch"].states[name],
+                                      results["select"].states[name])
+    np.testing.assert_array_equal(results["select"].states["count"],
+                                  corpus.expected_count)
+
+
 def test_resident_len_bucketing_reuses_programs_across_sizes():
     """With the default pow2 length bucketing, replaying two different-sized
     corpora (e.g. consecutive restore chunks) whose buffers land in the same
