@@ -291,6 +291,12 @@ class ReplayEngine:
 
         self._unroll = unroll
         self._dispatch = self.config.get_str("surge.replay.dispatch", "switch")
+        self._tile_backend = self.config.get_str("surge.replay.tile-backend",
+                                                 "xla")
+        if self._tile_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown surge.replay.tile-backend "
+                f"{self._tile_backend!r} (xla|pallas)")
         # one (wire, jitted fold) per derived-column declaration the inputs carry —
         # in practice at most two: framework logs (ordinal seq) and object-test logs
         self._wire_folds: dict[frozenset, tuple[WireFormat, Any]] = {}
@@ -978,6 +984,12 @@ class ReplayEngine:
         batch_step = jax.vmap(make_step_fn(self.spec, self._dispatch),
                               in_axes=(0, 0))
         nbytes = wire.nbytes
+        pallas_scan = None
+        if self._tile_backend == "pallas":
+            from surge_tpu.replay.pallas_fold import make_tile_scan
+
+            pallas_scan = make_tile_scan(self.spec, wire, width, bs,
+                                         self._unroll)
 
         def tile(slab_state, flat_wire, side_flat, starts_all, lens_all,
                  ord_all, i0, t_base):
@@ -1002,6 +1014,15 @@ class ReplayEngine:
             word = wire.expand_flat(word.reshape(bs * width, nbytes))
             words = word.reshape(bs, width).T  # [width, bs]
             sides = {name: slab(arr) for name, arr in side_flat.items()}
+
+            if pallas_scan is not None:
+                # the dense scan as a VMEM-resident kernel (relative time)
+                out = pallas_scan(carry, words, sides, lens - t_base,
+                                  ord_base + t_base)
+                return {k: jax.lax.dynamic_update_slice(slab_state[k],
+                                                        out[k], (i0,))
+                        for k in slab_state}
+
             ts = jnp.arange(width, dtype=jnp.int32) + t_base
 
             def body(c, xs):

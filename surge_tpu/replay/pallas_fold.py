@@ -1,0 +1,96 @@
+"""Pallas TPU kernel for the resident tile scan (the hot op).
+
+The XLA lowering of the tile fold — ``lax.scan`` of a vmapped per-event step —
+spends most of its time in per-step loop machinery, not arithmetic: the
+measured fold rate sits far below the VPU's throughput for the few scalar ops
+each event handler performs. This kernel runs the WHOLE tile scan inside one
+``pallas_call``: the `[width, lanes]` word slab streams HBM→VMEM once per lane
+block, the carry lives in registers/VMEM across all ``width`` steps, and the
+per-event dispatch is the branchless select form (compute every handler,
+mask-combine — pure VPU data flow).
+
+Gated by ``surge.replay.tile-backend = pallas`` (default ``xla``); on CPU the
+kernel runs in interpreter mode so tests exercise the exact same program.
+Gather/expand and the tile work-list loop stay in XLA — only the dense scan
+moves into the kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+#: lanes per kernel grid cell (8 sublanes × 128 lanes when viewed 2-D)
+_LANE_BLOCK = 1024
+
+
+def make_tile_scan(spec, wire, width: int, bs: int, unroll: int):
+    """Build ``(carry {f: [bs]}, words u32 [width, bs], sides {name: [width, bs]},
+    lens_rel i32 [bs], ord_rel i32 [bs]) -> carry`` as a pallas_call.
+
+    ``lens_rel`` is each lane's remaining length within this tile
+    (``lens - t_base``); ``ord_rel`` is the lane's ordinal base shifted by the
+    tile offset, so the derived ordinal of local step t is ``ord_rel + t + 1``
+    — identical to the XLA tile's global-t decode."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from surge_tpu.replay.engine import make_step_fn
+
+    # the select (branchless) step, applied to [LB] vectors directly — no vmap:
+    # handlers are scalar jnp expressions that broadcast over the lane vector
+    step = make_step_fn(spec, "select")
+    state_fields = [f.name for f in spec.registry.state.fields]
+    side_names = sorted(f.name for f in wire.side_fields)
+    lb = min(_LANE_BLOCK, bs)
+    while bs % lb != 0:  # largest power-of-two-ish divisor ≤ the lane block
+        lb //= 2
+    assert lb >= 1, bs
+    interpret = jax.default_backend() == "cpu"
+
+    def kernel(*refs):
+        words_ref = refs[0]
+        side_refs = dict(zip(side_names, refs[1: 1 + len(side_names)]))
+        k = 1 + len(side_names)
+        lens_ref, ord_ref = refs[k], refs[k + 1]
+        in_refs = dict(zip(state_fields, refs[k + 2: k + 2 + len(state_fields)]))
+        out_refs = dict(zip(state_fields, refs[k + 2 + len(state_fields):]))
+
+        lens = lens_ref[:]
+        ordr = ord_ref[:]
+        state0 = {name: in_refs[name][:] for name in state_fields}
+
+        def body(t, state):
+            word = words_ref[t, :]
+            side_row = {name: r[t, :] for name, r in side_refs.items()}
+            events = wire.decode_words(word, side_row, t < lens, ordr, t)
+            return step(state, events)
+
+        state = jax.lax.fori_loop(0, width, body, state0, unroll=unroll)
+        for name in state_fields:
+            out_refs[name][:] = state[name]
+
+    grid = (bs // lb,)
+    slab_spec = pl.BlockSpec((width, lb), lambda i: (0, i))
+    vec_spec = pl.BlockSpec((lb,), lambda i: (i,))
+
+    def tile_scan(carry: Mapping[str, Any], words, sides: Mapping[str, Any],
+                  lens_rel, ord_rel):
+        state_dtypes = {f.name: np.dtype(f.dtype)
+                        for f in spec.registry.state.fields}
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[slab_spec] + [slab_spec] * len(side_names)
+                     + [vec_spec, vec_spec] + [vec_spec] * len(state_fields),
+            out_specs=[vec_spec] * len(state_fields),
+            out_shape=[jax.ShapeDtypeStruct((bs,), state_dtypes[n])
+                       for n in state_fields],
+            interpret=interpret,
+        )(words, *(sides[n] for n in side_names), lens_rel, ord_rel,
+          *(carry[n] for n in state_fields))
+        return dict(zip(state_fields, out))
+
+    return tile_scan

@@ -165,6 +165,11 @@ def _sharded_program(engine, key: frozenset, width: int, bs: int, k_cap: int):
                           in_axes=(0, 0))
     nbytes = wire.nbytes
     unroll = engine._unroll
+    pallas_scan = None
+    if engine._tile_backend == "pallas":
+        from surge_tpu.replay.pallas_fold import make_tile_scan
+
+        pallas_scan = make_tile_scan(engine.spec, wire, width, bs, unroll)
 
     def tile(slab_state, flat_wire, side_flat, starts_all, lens_all, ord_all,
              i0, t_base):
@@ -185,6 +190,14 @@ def _sharded_program(engine, key: frozenset, width: int, bs: int, k_cap: int):
         word = wire.expand_flat(word.reshape(bs * width, nbytes))
         words = word.reshape(bs, width).T
         sides = {name: slab(arr) for name, arr in side_flat.items()}
+
+        if pallas_scan is not None:
+            out = pallas_scan(carry, words, sides, lens - t_base,
+                              ord_base + t_base)
+            return {k: jax.lax.dynamic_update_slice(slab_state[k], out[k],
+                                                    (i0,))
+                    for k in slab_state}
+
         ts = jnp.arange(width, dtype=jnp.int32) + t_base
 
         def body(c, xs):
