@@ -136,6 +136,72 @@ class ResidentCorpus:
 _WIRE_GUARD_MIN = 8192
 
 
+def _make_tile(spec: ReplaySpec, wire: WireFormat, width: int, bs: int,
+               unroll: int, dispatch: str, tile_backend: str):
+    """The shared tile body of the resident programs (single-device AND
+    mesh-sharded): ``(state_slab {f: [b_pad]}, flat_wire u8 [N, nbytes],
+    side_flat, starts [b_pad], lens [b_pad], ord_base [b_pad], i0, t_base)
+    -> state_slab``.
+
+    One tile folds events ``[t_base, t_base+width)`` of lanes
+    ``[i0, i0+bs)``: per-lane contiguous ``dynamic_slice`` slabs out of the
+    flat packed corpus (events of one aggregate are adjacent), byte→word
+    expansion in-register, one transpose to time-major, a dense scan (XLA or
+    the Pallas kernel per ``tile_backend``), and a contiguous write-back into
+    the state slab. ``i0``/``t_base`` are traced scalars."""
+    batch_step = jax.vmap(make_step_fn(spec, dispatch), in_axes=(0, 0))
+    nbytes = wire.nbytes
+    pallas_scan = None
+    if tile_backend == "pallas":
+        from surge_tpu.replay.pallas_fold import make_tile_scan
+
+        pallas_scan = make_tile_scan(spec, wire, width, bs, unroll)
+
+    def tile(slab_state, flat_wire, side_flat, starts_all, lens_all,
+             ord_all, i0, t_base):
+        starts = jax.lax.dynamic_slice(starts_all, (i0,), (bs,))
+        lens = jax.lax.dynamic_slice(lens_all, (i0,), (bs,))
+        ord_base = jax.lax.dynamic_slice(ord_all, (i0,), (bs,))
+        carry = {k: jax.lax.dynamic_slice(v, (i0,), (bs,))
+                 for k, v in slab_state.items()}
+
+        def slab(arr):
+            # dynamic_slice clamps out-of-range starts (finished/padding
+            # lanes); clamped garbage decodes under a False mask
+            cut = jax.vmap(
+                lambda s0: jax.lax.dynamic_slice(arr, (s0,), (width,)))
+            return cut(starts + t_base).T  # [width, bs], rows contiguous
+
+        word = jax.vmap(
+            lambda s0: jax.lax.dynamic_slice(
+                flat_wire, (s0, 0), (width, nbytes)))(starts + t_base)
+        word = wire.expand_flat(word.reshape(bs * width, nbytes))
+        words = word.reshape(bs, width).T  # [width, bs]
+        sides = {name: slab(arr) for name, arr in side_flat.items()}
+
+        if pallas_scan is not None:
+            # the dense scan as a VMEM-resident kernel (relative time)
+            out = pallas_scan(carry, words, sides, lens - t_base,
+                              ord_base + t_base)
+            return {k: jax.lax.dynamic_update_slice(slab_state[k],
+                                                    out[k], (i0,))
+                    for k in slab_state}
+
+        ts = jnp.arange(width, dtype=jnp.int32) + t_base
+
+        def body(c, xs):
+            w_row, side_row, t = xs
+            events = wire.decode_words(w_row, side_row, t < lens, ord_base, t)
+            return batch_step(c, events), None
+
+        out, _ = jax.lax.scan(body, carry, (words, sides, ts),
+                              unroll=unroll)
+        return {k: jax.lax.dynamic_update_slice(slab_state[k], out[k], (i0,))
+                for k in slab_state}
+
+    return tile
+
+
 def _bucket_len(n: int) -> int:
     """Next power of two ≥ n (min 64Ki) — the bucketed buffer length."""
     target = 1 << 16
@@ -981,59 +1047,8 @@ class ReplayEngine:
         import jax
 
         wire = WireFormat(self.spec.registry, dict(key))
-        batch_step = jax.vmap(make_step_fn(self.spec, self._dispatch),
-                              in_axes=(0, 0))
-        nbytes = wire.nbytes
-        pallas_scan = None
-        if self._tile_backend == "pallas":
-            from surge_tpu.replay.pallas_fold import make_tile_scan
-
-            pallas_scan = make_tile_scan(self.spec, wire, width, bs,
-                                         self._unroll)
-
-        def tile(slab_state, flat_wire, side_flat, starts_all, lens_all,
-                 ord_all, i0, t_base):
-            import jax.numpy as jnp
-
-            starts = jax.lax.dynamic_slice(starts_all, (i0,), (bs,))
-            lens = jax.lax.dynamic_slice(lens_all, (i0,), (bs,))
-            ord_base = jax.lax.dynamic_slice(ord_all, (i0,), (bs,))
-            carry = {k: jax.lax.dynamic_slice(v, (i0,), (bs,))
-                     for k, v in slab_state.items()}
-
-            def slab(arr):
-                # dynamic_slice clamps out-of-range starts (finished/padding
-                # lanes); clamped garbage decodes under a False mask
-                cut = jax.vmap(
-                    lambda s0: jax.lax.dynamic_slice(arr, (s0,), (width,)))
-                return cut(starts + t_base).T  # [width, bs], rows contiguous
-
-            word = jax.vmap(
-                lambda s0: jax.lax.dynamic_slice(
-                    flat_wire, (s0, 0), (width, nbytes)))(starts + t_base)
-            word = wire.expand_flat(word.reshape(bs * width, nbytes))
-            words = word.reshape(bs, width).T  # [width, bs]
-            sides = {name: slab(arr) for name, arr in side_flat.items()}
-
-            if pallas_scan is not None:
-                # the dense scan as a VMEM-resident kernel (relative time)
-                out = pallas_scan(carry, words, sides, lens - t_base,
-                                  ord_base + t_base)
-                return {k: jax.lax.dynamic_update_slice(slab_state[k],
-                                                        out[k], (i0,))
-                        for k in slab_state}
-
-            ts = jnp.arange(width, dtype=jnp.int32) + t_base
-
-            def body(c, xs):
-                w_row, side_row, t = xs
-                events = wire.decode_words(w_row, side_row, t < lens, ord_base, t)
-                return batch_step(c, events), None
-
-            out, _ = jax.lax.scan(body, carry, (words, sides, ts),
-                                  unroll=self._unroll)
-            return {k: jax.lax.dynamic_update_slice(slab_state[k], out[k], (i0,))
-                    for k in slab_state}
+        tile = _make_tile(self.spec, wire, width, bs, self._unroll,
+                          self._dispatch, self._tile_backend)
 
         def fold(slab_state, flat_wire, side_flat, starts_all, lens_all,
                  ord_all, i0s, t_bases, k_n):

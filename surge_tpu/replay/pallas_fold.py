@@ -48,7 +48,10 @@ def make_tile_scan(spec, wire, width: int, bs: int, unroll: int):
     while bs % lb != 0:  # largest power-of-two-ish divisor ≤ the lane block
         lb //= 2
     assert lb >= 1, bs
-    interpret = jax.default_backend() == "cpu"
+    # the kernel is written for the Mosaic/TPU lowering; every other backend
+    # (cpu tests, gpu hosts) runs it through the interpreter unchanged
+    # ("axon" is the tunneled TPU plugin's platform name)
+    interpret = jax.default_backend() not in ("tpu", "axon")
 
     def kernel(*refs):
         words_ref = refs[0]

@@ -22,8 +22,8 @@ from surge_tpu.replay.engine import (
     ReplayResult,
     ResidentWire,
     _bucket_len,
+    _make_tile,
     _round_up,
-    make_step_fn,
 )
 
 
@@ -161,53 +161,8 @@ def _sharded_program(engine, key: frozenset, width: int, bs: int, k_cap: int):
     from jax.sharding import PartitionSpec as P
 
     wire = WireFormat(engine.spec.registry, dict(key))
-    batch_step = jax.vmap(make_step_fn(engine.spec, engine._dispatch),
-                          in_axes=(0, 0))
-    nbytes = wire.nbytes
-    unroll = engine._unroll
-    pallas_scan = None
-    if engine._tile_backend == "pallas":
-        from surge_tpu.replay.pallas_fold import make_tile_scan
-
-        pallas_scan = make_tile_scan(engine.spec, wire, width, bs, unroll)
-
-    def tile(slab_state, flat_wire, side_flat, starts_all, lens_all, ord_all,
-             i0, t_base):
-        import jax.numpy as jnp
-
-        starts = jax.lax.dynamic_slice(starts_all, (i0,), (bs,))
-        lens = jax.lax.dynamic_slice(lens_all, (i0,), (bs,))
-        ord_base = jax.lax.dynamic_slice(ord_all, (i0,), (bs,))
-        carry = {k: jax.lax.dynamic_slice(v, (i0,), (bs,))
-                 for k, v in slab_state.items()}
-
-        def slab(arr):
-            cut = jax.vmap(lambda s0: jax.lax.dynamic_slice(arr, (s0,), (width,)))
-            return cut(starts + t_base).T
-
-        word = jax.vmap(lambda s0: jax.lax.dynamic_slice(
-            flat_wire, (s0, 0), (width, nbytes)))(starts + t_base)
-        word = wire.expand_flat(word.reshape(bs * width, nbytes))
-        words = word.reshape(bs, width).T
-        sides = {name: slab(arr) for name, arr in side_flat.items()}
-
-        if pallas_scan is not None:
-            out = pallas_scan(carry, words, sides, lens - t_base,
-                              ord_base + t_base)
-            return {k: jax.lax.dynamic_update_slice(slab_state[k], out[k],
-                                                    (i0,))
-                    for k in slab_state}
-
-        ts = jnp.arange(width, dtype=jnp.int32) + t_base
-
-        def body(c, xs):
-            w_row, side_row, t = xs
-            events = wire.decode_words(w_row, side_row, t < lens, ord_base, t)
-            return batch_step(c, events), None
-
-        out, _ = jax.lax.scan(body, carry, (words, sides, ts), unroll=unroll)
-        return {k: jax.lax.dynamic_update_slice(slab_state[k], out[k], (i0,))
-                for k in slab_state}
+    tile = _make_tile(engine.spec, wire, width, bs, engine._unroll,
+                      engine._dispatch, engine._tile_backend)
 
     def local_fold(slab_state, flat_wire, side_flat, starts_all, lens_all,
                    ord_all, i0s, t_bases, k_n):

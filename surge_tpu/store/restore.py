@@ -116,6 +116,66 @@ def restore_from_events(
                          watermarks=watermarks, backend=backend)
 
 
+def _chunk_wire(engine, segment_path: str, chunk):
+    """Per-chunk wire cache beside the segment: ``<segment>.wires/<key>/``.
+
+    The host-side flat pack is the expensive half of a resident replay on a
+    1-core host, and segment chunks are IMMUTABLE once written (extends append
+    new chunks, never rewrite), so the packed wire is cached keyed by the
+    chunk's aggregate-id set — within one segment that set uniquely identifies
+    the chunk. A cached wire whose layout fingerprint no longer matches the
+    engine's schema is repacked (ReplayEngine.check_wire refuses it), so
+    schema evolution invalidates the cache instead of corrupting states.
+    Cold starts after the first mmap straight from disk — the same pack-once
+    contract as ResidentWire in the bench."""
+    import hashlib
+    import os
+    import shutil
+
+    import numpy as np
+
+    from surge_tpu.replay.engine import ResidentWire
+
+    if chunk.aggregate_ids is None:
+        return engine.pack_resident(chunk)
+    # CONTENT-addressed key: delta chunks of an incremental segment can carry
+    # the same aggregate-id set and event count as their base (they continue
+    # the same aggregates), so the key hashes the actual event content too —
+    # immune to chunk ordering and partition filters
+    h = hashlib.sha1()
+    for a in chunk.aggregate_ids:
+        h.update(str(a).encode())
+        h.update(b"\x00")
+    h.update(np.ascontiguousarray(chunk.agg_idx).tobytes())
+    h.update(np.ascontiguousarray(chunk.type_ids).tobytes())
+    for name in sorted(chunk.cols):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(chunk.cols[name]).tobytes())
+    h.update(repr(sorted(chunk.derived_cols.items())).encode())
+    root = os.path.join(f"{segment_path}.wires", h.hexdigest()[:20])
+    if os.path.isdir(root):
+        try:
+            wire = ResidentWire.load(root)
+            engine.check_wire(wire)
+            return wire
+        except Exception:
+            pass  # stale/corrupt cache entry: repack below
+    wire = engine.pack_resident(chunk)
+    try:
+        # atomic publication: a crash or concurrent writer must never leave a
+        # torn entry at the final path (rename is atomic; losing the race to
+        # another writer of the SAME content-keyed entry is harmless)
+        tmp = f"{root}.tmp-{os.getpid()}"
+        wire.save(tmp)
+        try:
+            os.rename(tmp, root)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+    except OSError:
+        pass  # read-only segment dir: cache is an optimization only
+    return wire
+
+
 def restore_from_segment(
         path: str, store: KeyValueStore, *,
         replay_spec: ReplaySpec,
@@ -157,6 +217,7 @@ def restore_from_segment(
     # mesh-sharded restores keep the streaming fold (resident is single-device)
     use_resident = mesh is None and cfg.get_str(
         "surge.replay.segment-backend", "resident") == "resident"
+    wire_cache = cfg.get_bool("surge.replay.segment-wire-cache", True)
 
     # Incremental segments append DELTA chunks whose aggregates CONTINUE earlier
     # chunks' folds: keep each chunk's tensor states + an id index so a later
@@ -184,7 +245,9 @@ def restore_from_segment(
                         ci, row = where[a]
                         col[i] = chunk_states[ci][name][row]
         if use_resident:
-            res = engine.replay_resident(engine.prepare_resident(chunk),
+            wire = (_chunk_wire(engine, path, chunk) if wire_cache
+                    else engine.pack_resident(chunk))
+            res = engine.replay_resident(engine.upload_resident(wire),
                                          init_carry=init)
         else:
             res = engine.replay_columnar(chunk, init_carry=init)

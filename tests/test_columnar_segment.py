@@ -320,3 +320,22 @@ def test_segment_carries_ids_snapshots_and_watermarks(tmp_path):
     for agg, st in expected.items():
         got = sfmt.read_state(store.get(agg))
         assert (got.count, got.version) == (st.count, st.version), agg
+
+    # the first restore left per-chunk wire caches beside the segment; a
+    # second cold start must consume them WITHOUT re-packing
+    import os
+    import unittest.mock as mock
+
+    from surge_tpu.replay.engine import ReplayEngine
+
+    assert os.path.isdir(path + ".wires") and os.listdir(path + ".wires")
+    store2 = InMemoryKeyValueStore()
+    with mock.patch.object(ReplayEngine, "pack_resident",
+                           side_effect=AssertionError("must hit wire cache")):
+        res2 = restore_from_segment(
+            path, store2, replay_spec=counter.make_replay_spec(),
+            serialize_state=lambda a, s: sfmt.write_state(s).value)
+    assert res2.num_aggregates == 11
+    for agg, st in expected.items():
+        got = sfmt.read_state(store2.get(agg))
+        assert (got.count, got.version) == (st.count, st.version), agg
