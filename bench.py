@@ -410,6 +410,25 @@ def main() -> None:
     payload: dict = {"metric": "cold_replay_events_per_sec", "value": 0,
                      "unit": "events/s", "vs_baseline": 0}
 
+    # -- phase 2 first: steady-state latency (no accelerator, no corpus) ----------
+    # running it before the corpus build keeps the multi-GB build/save churn
+    # (page cache pressure, 1-core contention) out of the latency distribution
+    try:
+        latency_seconds = float(os.environ.get("SURGE_BENCH_LATENCY_SECONDS", 5))
+    except ValueError:
+        latency_seconds = 0.0
+        payload["latency_error"] = "unparseable SURGE_BENCH_LATENCY_SECONDS"
+    if latency_seconds > 0:
+        try:
+            stats = steady_state_latency(latency_seconds)
+            log(f"steady state: p50 {stats['command_p50_ms']}ms, "
+                f"p99 {stats['command_p99_ms']}ms, "
+                f"{stats['commands_per_sec']} commands/s")
+            payload.update(stats)
+        except Exception as exc:  # noqa: BLE001 — phase 2 must not void phase 1
+            log(f"steady-state latency phase failed: {exc!r}")
+            payload["latency_error"] = f"{type(exc).__name__}: {exc}"
+
     t0 = time.perf_counter()
     corpus = synth_counter_corpus(num_aggregates, num_events, seed=42,
                                   sort_by_length=True)
@@ -455,23 +474,6 @@ def main() -> None:
         log(f"cpu baseline: {n_sample} events over {len(logs)} aggregates in "
             f"{cpu_s:.2f}s -> {cpu_eps:,.0f} events/s (verified)")
         payload["cpu_baseline_events_per_sec"] = round(cpu_eps)
-
-        # -- phase 2: steady-state latency (no accelerator) ---------------------------
-        try:
-            latency_seconds = float(os.environ.get("SURGE_BENCH_LATENCY_SECONDS", 5))
-        except ValueError:
-            latency_seconds = 0.0
-            payload["latency_error"] = "unparseable SURGE_BENCH_LATENCY_SECONDS"
-        if latency_seconds > 0:
-            try:
-                stats = steady_state_latency(latency_seconds)
-                log(f"steady state: p50 {stats['command_p50_ms']}ms, "
-                    f"p99 {stats['command_p99_ms']}ms, "
-                    f"{stats['commands_per_sec']} commands/s")
-                payload.update(stats)
-            except Exception as exc:  # noqa: BLE001 — phase 2 must not void phase 1
-                log(f"steady-state latency phase failed: {exc!r}")
-                payload["latency_error"] = f"{type(exc).__name__}: {exc}"
 
         # the corpus lives on disk now; free the ~1.6 GB in-memory copy (and the
         # decoded sample) before replay children map the same data

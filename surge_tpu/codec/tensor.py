@@ -73,6 +73,10 @@ class ColumnarEvents:
     # optional aggregate-id strings, indexed by aggregate index 0..B-1 — carried by
     # segment chunks so bulk replay can write folded states back to the keyed store
     aggregate_ids: list[str] | None = None
+    # global chunk ordinal within the source segment file (set by read_segment;
+    # chunks are immutable once written, so this is a stable O(1) identity for
+    # caches keyed per chunk)
+    source_ordinal: int | None = None
 
     @property
     def num_events(self) -> int:

@@ -271,6 +271,7 @@ def read_segment(path: str,
         agg_dtype = np.dtype(header["agg_dtype"])
         derived = dict(header.get("derived", {}))
 
+        ordinal = -1  # global chunk ordinal (counts filtered chunks too)
         while True:
             prefix = f.read(8)
             if len(prefix) < 8:
@@ -288,6 +289,7 @@ def read_segment(path: str,
             if marker == SNAPSHOT_MARKER:  # not a chunk; read via read_segment_snapshots
                 f.seek(meta["blob"][1], 1)
                 continue
+            ordinal += 1
             if (partitions is not None and "partition" in meta
                     and meta["partition"] not in partitions):
                 skip = sum(c[2] for c in meta["cols"])
@@ -326,7 +328,8 @@ def read_segment(path: str,
                 type_ids=arrays.pop("type_ids"),
                 cols=arrays,
                 derived_cols=c_derived,
-                aggregate_ids=ids)
+                aggregate_ids=ids,
+                source_ordinal=ordinal)
 
 
 def segment_info(path: str) -> dict:

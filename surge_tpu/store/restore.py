@@ -129,29 +129,24 @@ def _chunk_wire(engine, segment_path: str, chunk):
     Cold starts after the first mmap straight from disk — the same pack-once
     contract as ResidentWire in the bench."""
     import hashlib
+    import json
     import os
     import shutil
 
-    import numpy as np
-
+    from surge_tpu.codec.wire import WireFormat
     from surge_tpu.replay.engine import ResidentWire
 
-    if chunk.aggregate_ids is None:
-        return engine.pack_resident(chunk)
-    # CONTENT-addressed key: delta chunks of an incremental segment can carry
-    # the same aggregate-id set and event count as their base (they continue
-    # the same aggregates), so the key hashes the actual event content too —
-    # immune to chunk ordering and partition filters
+    if chunk.source_ordinal is None:
+        return engine.pack_resident(chunk)  # not from a segment reader
+    # O(1) key: chunks are immutable once written (extends append, never
+    # rewrite), so the chunk's global ordinal within the segment identifies
+    # its content; the engine's wire-layout fingerprint is part of the key so
+    # schema evolution creates a NEW entry instead of fighting the stale one
+    wire_fmt = WireFormat(engine.spec.registry, dict(chunk.derived_cols))
     h = hashlib.sha1()
-    for a in chunk.aggregate_ids:
-        h.update(str(a).encode())
-        h.update(b"\x00")
-    h.update(np.ascontiguousarray(chunk.agg_idx).tobytes())
-    h.update(np.ascontiguousarray(chunk.type_ids).tobytes())
-    for name in sorted(chunk.cols):
-        h.update(name.encode())
-        h.update(np.ascontiguousarray(chunk.cols[name]).tobytes())
-    h.update(repr(sorted(chunk.derived_cols.items())).encode())
+    h.update(json.dumps(wire_fmt.layout_fingerprint(),
+                        sort_keys=True).encode())
+    h.update(f"|{chunk.source_ordinal}|{chunk.num_events}".encode())
     root = os.path.join(f"{segment_path}.wires", h.hexdigest()[:20])
     if os.path.isdir(root):
         try:
@@ -159,20 +154,18 @@ def _chunk_wire(engine, segment_path: str, chunk):
             engine.check_wire(wire)
             return wire
         except Exception:
-            pass  # stale/corrupt cache entry: repack below
+            pass  # corrupt entry: repack below
     wire = engine.pack_resident(chunk)
+    # atomic publication: a crash or concurrent writer must never leave a
+    # torn entry at the final path (rename is atomic; losing the race to
+    # another writer of the SAME keyed entry is harmless). Any failure —
+    # including ENOSPC mid-save — removes the tmp dir.
+    tmp = f"{root}.tmp-{os.getpid()}"
     try:
-        # atomic publication: a crash or concurrent writer must never leave a
-        # torn entry at the final path (rename is atomic; losing the race to
-        # another writer of the SAME content-keyed entry is harmless)
-        tmp = f"{root}.tmp-{os.getpid()}"
         wire.save(tmp)
-        try:
-            os.rename(tmp, root)
-        except OSError:
-            shutil.rmtree(tmp, ignore_errors=True)
+        os.rename(tmp, root)
     except OSError:
-        pass  # read-only segment dir: cache is an optimization only
+        shutil.rmtree(tmp, ignore_errors=True)
     return wire
 
 
