@@ -30,6 +30,95 @@ def _fresh(root, **kw):
     return FileLog(root, fsync="none", **kw)
 
 
+def test_fuzz_random_crash_points_preserve_committed_frontier(tmp_path):
+    """Randomized crash-recovery fuzz: run a random transactional workload with
+    fsync=commit, snapshot every file's size at each commit (the fsync points),
+    then simulate a crash by truncating data files and the journal to RANDOM
+    lengths at or beyond a random committed frontier k. Reopening must expose
+    exactly the first k transactions' records (read_committed), never a partial
+    transaction, and the log must accept new transactions afterwards."""
+    import shutil
+
+    for seed in range(6):
+        rng = random.Random(100 + seed)
+        root = str(tmp_path / f"fuzz-{seed}")
+        flog = FileLog(root, fsync="commit")
+        flog.create_topic(TopicSpec("ev", 2))
+        flog.create_topic(TopicSpec("st", 1, compacted=True))
+        prod = flog.transactional_producer("fz")
+        committed: list = []  # per txn: list of (topic, partition, value)
+        sizes: list = []  # per txn: {relpath: size}
+
+        def walk_sizes():
+            out = {}
+            for dirpath, _, files in os.walk(root):
+                for fn in files:
+                    p = os.path.join(dirpath, fn)
+                    out[os.path.relpath(p, root)] = os.path.getsize(p)
+            return out
+
+        for t in range(rng.randrange(4, 10)):
+            prod.begin()
+            recs = []
+            for _ in range(rng.randrange(1, 5)):
+                topic = rng.choice(["ev", "ev", "st"])
+                part = rng.randrange(2) if topic == "ev" else 0
+                val = f"txn{t}-{rng.randrange(1000)}".encode()
+                prod.send(LogRecord(topic=topic, key=f"k{rng.randrange(6)}",
+                                    value=val, partition=part))
+                recs.append((topic, part, val))
+            if rng.random() < 0.15:
+                prod.abort()
+            else:
+                prod.commit()
+                committed.append(recs)
+                sizes.append(walk_sizes())
+        flog.close()
+        if not committed:
+            continue
+
+        # crash: keep everything up to commit k, then cut each file somewhere
+        # between its size-at-k and its final size (unsynced tail may be lost
+        # in ANY combination across files)
+        k = rng.randrange(len(committed))
+        crash_root = str(tmp_path / f"fuzz-{seed}-crash")
+        shutil.copytree(root, crash_root)
+        frontier = sizes[k]
+        final = walk_sizes()
+        for rel, size_k in frontier.items():
+            p = os.path.join(crash_root, rel)
+            if not os.path.exists(p):
+                continue
+            hi = final.get(rel, size_k)
+            cut = rng.randrange(size_k, hi + 1) if hi > size_k else size_k
+            with open(p, "r+b") as f:
+                f.truncate(cut)
+
+        relog = FileLog(crash_root, fsync="commit")
+        want: dict = {}
+        for recs in committed[: k + 1]:
+            for topic, part, val in recs:
+                want.setdefault((topic, part), []).append(val)
+        for (topic, part), vals in want.items():
+            got = [r.value for r in relog.read(topic, part)]
+            # committed frontier k must be fully present as a prefix; any
+            # LATER full transactions may also have survived (their fsync
+            # completed) but never a torn partial one
+            assert got[: len(vals)] == vals, (seed, topic, part)
+            extra = got[len(vals):]
+            later = [v for recs in committed[k + 1:] for tp, pp, v in recs
+                     if (tp, pp) == (topic, part)]
+            for v in extra:
+                assert v in later, (seed, topic, part, v)
+        # the reopened log must still accept traffic
+        p2 = relog.transactional_producer("fz2")
+        p2.begin()
+        p2.send(LogRecord(topic="ev", key="post", value=b"alive", partition=0))
+        p2.commit()
+        assert [r.value for r in relog.read("ev", 0)][-1] == b"alive"
+        relog.close()
+
+
 def test_randomized_parity_with_memory_log(root):
     rng = random.Random(3)
     flog, mlog = _fresh(root), InMemoryLog()
