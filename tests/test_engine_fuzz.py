@@ -1,0 +1,141 @@
+"""Randomized fault-injection fuzz of the exactly-once command path.
+
+Concurrent workers drive commands through the FULL engine while the log's
+transaction commits randomly fail BEFORE the append lands (clean abort — the
+entity's retry ladder re-publishes with the same request id).
+
+The transport contract is: ``commit()`` raising means the transaction did NOT
+land. In-process transports satisfy it trivially (commit is atomic); the
+networked broker transport satisfies it by retrying the SAME ``txn_seq``
+against the broker's replicated dedup cache until the outcome is known
+(``log/client.py``; exercised in test_log_server/test_log_replication) — so
+ambiguous "reply lost" commits never reach the publisher as errors.
+
+Invariants checked at the end against the COMMITTED events topic:
+
+1. exactly one event per acknowledged command — no lost acks, no doubled
+   retries (the publisher's request-id dedup + retry-joins-commit machinery);
+2. per-aggregate sequence numbers are exactly 1..n with no gaps or duplicates;
+3. the final queryable state equals the scalar fold of the committed log.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from surge_tpu import (
+    CommandSuccess,
+    SurgeCommandBusinessLogic,
+    create_engine,
+    default_config,
+)
+from surge_tpu.engine.model import fold_events
+from surge_tpu.log import InMemoryLog
+from surge_tpu.log.memory import InMemoryTxnProducer
+from surge_tpu.models import counter
+
+CFG = default_config().with_overrides({
+    "surge.producer.flush-interval-ms": 5,
+    "surge.producer.ktable-check-interval-ms": 5,
+    "surge.state-store.commit-interval-ms": 10,
+    "surge.aggregate.init-retry-interval-ms": 5,
+    "surge.aggregate.publish-retry-max": 10,
+    "surge.engine.num-partitions": 2,
+})
+
+
+class _FlakyProducer:
+    """Delegates to a real producer; commit() randomly aborts-and-raises
+    (the transport-contract-legal failure: raising ⇒ nothing landed)."""
+
+    def __init__(self, inner: InMemoryTxnProducer, rng: random.Random,
+                 p_fail: float) -> None:
+        self._inner = inner
+        self._rng = rng
+        self._p_fail = p_fail
+
+    def commit(self):
+        if self._rng.random() < self._p_fail:
+            self._inner.abort()
+            raise RuntimeError("injected: commit failed (nothing landed)")
+        return self._inner.commit()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _FlakyLog(InMemoryLog):
+    def __init__(self, rng: random.Random, p_fail: float):
+        super().__init__()
+        self._rng = rng
+        self._p_fail = p_fail
+
+    def transactional_producer(self, transactional_id: str):
+        inner = super().transactional_producer(transactional_id)
+        return _FlakyProducer(inner, self._rng, self._p_fail)
+
+
+def _logic():
+    return SurgeCommandBusinessLogic(
+        aggregate_name="counter", model=counter.CounterModel(),
+        state_format=counter.state_formatting(),
+        event_format=counter.event_formatting())
+
+
+@pytest.mark.parametrize("seed", [11, 29, 47])
+def test_fuzz_exactly_once_under_flaky_commits(seed):
+    rng = random.Random(seed)
+
+    async def scenario():
+        log = _FlakyLog(rng, p_fail=0.20)
+        engine = create_engine(_logic(), log=log, config=CFG)
+        await engine.start()
+
+        aggs = [f"agg-{i}" for i in range(8)]
+        acked: dict[str, int] = {a: 0 for a in aggs}
+
+        async def worker(agg: str) -> None:
+            ref = engine.aggregate_for(agg)
+            for _ in range(rng.randrange(6, 14)):
+                cmd = (counter.Increment(agg) if rng.random() < 0.8
+                       else counter.Decrement(agg))
+                r = await ref.send_command(cmd)
+                if isinstance(r, CommandSuccess):
+                    acked[agg] += 1
+                # failures are legal under injection; retries happen inside
+                # the entity — the invariants below are what matter
+
+        await asyncio.gather(*(worker(a) for a in aggs))
+
+        # settle outstanding flushes/indexing, then stop cleanly
+        await asyncio.sleep(0.1)
+        final = {a: await engine.aggregate_for(a).get_state() for a in aggs}
+        await engine.stop()
+        return log, acked, final
+
+    log, acked, final = asyncio.run(scenario())
+
+    fmt = counter.event_formatting()
+    model = counter.CounterModel()
+    per_agg: dict[str, list] = {}
+    for p in range(2):
+        for rec in log.read("counter-events", p):  # read_committed view
+            ev = fmt.read_event(rec)
+            per_agg.setdefault(ev.aggregate_id, []).append(ev)
+
+    for agg in acked:
+        events = per_agg.get(agg, [])
+        seqs = [e.sequence_number for e in events]
+        # invariant 2: a gapless, duplicate-free fold history
+        assert seqs == list(range(1, len(seqs) + 1)), (agg, seqs)
+        # invariant 1: exactly one committed event per acknowledged command
+        assert len(seqs) == acked[agg], (agg, len(seqs), acked[agg])
+        # invariant 3: queryable state equals the scalar fold of the log
+        want = fold_events(model, None, events)
+        got = final[agg]
+        if want is None:
+            assert got is None or got.version == 0, agg
+        else:
+            assert got is not None
+            assert (got.count, got.version) == (want.count, want.version), agg
