@@ -117,11 +117,17 @@ def make_engine():
     cfg = default_config().with_overrides({
         "surge.replay.batch-size": int(os.environ.get("SURGE_BENCH_BATCH", 8192)),
         "surge.replay.time-chunk": int(os.environ.get("SURGE_BENCH_TIME_CHUNK", 128)),
+        "surge.replay.dispatch": os.environ.get("SURGE_BENCH_DISPATCH", "switch"),
+        "surge.replay.tile-backend": os.environ.get("SURGE_BENCH_TILE", "xla"),
+        "surge.replay.upload-chunk-mb": int(
+            os.environ.get("SURGE_BENCH_UPLOAD_CHUNK_MB", 0)),
         # single corpus, explicit warm: exact buffer length, no bucket padding
         # on the (timed) upload
         "surge.replay.resident-len-bucket": "exact",
     })
-    return ReplayEngine(make_replay_spec(), config=cfg)
+    return ReplayEngine(make_replay_spec(),
+                        config=cfg,
+                        unroll=int(os.environ.get("SURGE_BENCH_UNROLL", 1)))
 
 
 def replay_child(corpus_dir: str) -> None:

@@ -40,7 +40,7 @@ CFG = default_config().with_overrides({
     "surge.producer.ktable-check-interval-ms": 5,
     "surge.state-store.commit-interval-ms": 10,
     "surge.aggregate.init-retry-interval-ms": 5,
-    "surge.aggregate.publish-retry-max": 10,
+    "surge.aggregate.publish-max-retries": 10,
     "surge.engine.num-partitions": 2,
 })
 
@@ -86,9 +86,12 @@ def _logic():
 @pytest.mark.parametrize("seed", [11, 29, 47])
 def test_fuzz_exactly_once_under_flaky_commits(seed):
     rng = random.Random(seed)
+    # injection draws interleave with worker draws on wall-clock flush timing;
+    # a SEPARATE stream keeps the workload reproducible per seed
+    inject_rng = random.Random(seed ^ 0x5EED)
 
     async def scenario():
-        log = _FlakyLog(rng, p_fail=0.20)
+        log = _FlakyLog(inject_rng, p_fail=0.20)
         engine = create_engine(_logic(), log=log, config=CFG)
         await engine.start()
 
