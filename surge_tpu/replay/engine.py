@@ -218,6 +218,34 @@ def _chunked_put(arr: np.ndarray, chunk_mb: int):
     return jnp.concatenate(parts, axis=0)
 
 
+def _apply_perm(perm: Optional[np.ndarray],
+                init_carry: Mapping[str, Any] | None,
+                ordinal_base: np.ndarray | None):
+    """Reorder caller inputs (original aggregate order) into the wire's
+    length-sorted lane order."""
+    init_sorted = None
+    if init_carry is not None:
+        init_sorted = {k: (np.asarray(v)[perm] if perm is not None
+                           else np.asarray(v))
+                       for k, v in init_carry.items()}
+    ord_sorted = None
+    if ordinal_base is not None:
+        src = np.asarray(ordinal_base)
+        ord_sorted = src[perm] if perm is not None else src
+    return init_sorted, ord_sorted
+
+
+def _unapply_perm(perm: Optional[np.ndarray],
+                  out_sorted: dict) -> dict:
+    """Scatter sorted-order state columns back to the original order."""
+    if perm is None:
+        return out_sorted
+    out = {name: np.empty_like(col) for name, col in out_sorted.items()}
+    for name, col in out_sorted.items():
+        out[name][perm] = col
+    return out
+
+
 def _bucket_len(n: int) -> int:
     """Next power of two ≥ n (min 64Ki) — the bucketed buffer length."""
     target = 1 << 16
@@ -942,28 +970,40 @@ class ReplayEngine:
             raise NotImplementedError(
                 "this engine is mesh-backed; use prepare_resident_sharded / "
                 "replay_resident_sharded for the resident path")
-        import jax
-
         b = resident.lengths.shape[0]
         if b == 0:
             return ReplayResult(states={f.name: np.zeros((0,), dtype=f.dtype)
                                         for f in self.spec.registry.state.fields},
                                 num_aggregates=0, num_events=0, padded_events=0)
+        perm = resident.perm
+        init_sorted, ord_sorted = _apply_perm(perm, init_carry, ordinal_base)
+        slab, padded = self._dispatch_resident(resident, init_sorted, ord_sorted)
+        # the single synchronization of the whole replay
+        out_sorted = {name: np.asarray(col)[:b] for name, col in slab.items()}
+        return ReplayResult(states=_unapply_perm(perm, out_sorted),
+                            num_aggregates=b,
+                            num_events=resident.num_events,
+                            padded_events=padded)
+
+    def _dispatch_resident(self, resident: "ResidentCorpus",
+                           init_sorted: Mapping[str, np.ndarray] | None,
+                           ord_sorted: np.ndarray | None
+                           ) -> tuple[dict, int]:
+        """Dispatch the whole fold of one resident corpus WITHOUT syncing:
+        returns the (device) state slab and the padded-slot count. ``init``/
+        ``ordinal`` inputs are already in the corpus's sorted lane order."""
+        b = resident.lengths.shape[0]
         plan = self._resident_plan(resident)
         b_pad = resident.b_pad
         key = frozenset(resident.derived_key.items())
-        state_fields = self.spec.registry.state.fields
-        perm = resident.perm
 
         ord_p = np.zeros((b_pad,), dtype=np.int32)
-        if ordinal_base is not None:
-            src = np.asarray(ordinal_base)
-            ord_p[:b] = (src[perm] if perm is not None else src).astype(np.int32)
+        if ord_sorted is not None:
+            ord_p[:b] = np.asarray(ord_sorted).astype(np.int32)
         slab = self.init_carry_np(b_pad)
-        if init_carry is not None:
-            for k, full in init_carry.items():
-                src = np.asarray(full)
-                slab[k][:b] = src[perm] if perm is not None else src
+        if init_sorted is not None:
+            for k, full in init_sorted.items():
+                slab[k][:b] = np.asarray(full)
         slab = {k: jnp.asarray(v) for k, v in slab.items()}
         ord_d = jnp.asarray(ord_p)
 
@@ -980,23 +1020,84 @@ class ReplayEngine:
             i0s_p[:k_n] = i0s
             tb_p = np.zeros((k_cap,), dtype=np.int32)
             tb_p[:k_n] = t_bases
-            self._signatures.add(("resident", key, plan.width, bs, k_cap, b_pad, int(resident.flat_wire.shape[0])))
+            self._signatures.add(("resident", key, plan.width, bs, k_cap,
+                                  b_pad, int(resident.flat_wire.shape[0])))
             self.stats["windows"] += k_n
             slab = fold(slab, resident.flat_wire, resident.flat_side,
                         resident.starts_dev, resident.lens_dev, ord_d,
                         jnp.asarray(i0s_p), jnp.asarray(tb_p), np.int32(k_n))
-        # the single synchronization of the whole replay
-        out_sorted = {name: np.asarray(slab[name])[:b] for name in
-                      (f.name for f in state_fields)}
-        if perm is None:
-            out = out_sorted
-        else:
-            out = {name: np.empty_like(col) for name, col in out_sorted.items()}
-            for name, col in out_sorted.items():
-                out[name][perm] = col
-        return ReplayResult(states=out, num_aggregates=b,
-                            num_events=resident.num_events,
-                            padded_events=plan.padded_slots)
+        return slab, plan.padded_slots
+
+    def replay_resident_streamed(self, w: "ResidentWire", *,
+                                 segments: int | None = None,
+                                 init_carry: Mapping[str, Any] | None = None,
+                                 ordinal_base: np.ndarray | None = None
+                                 ) -> ReplayResult:
+        """Upload AND fold a packed wire in lane segments: segment s's tiles
+        dispatch right after its upload initiates, so on backends that overlap
+        transfers with compute the fold of earlier segments hides later
+        segments' uploads — and on backends that don't, nothing is lost but
+        per-segment overhead. Segments split at event-count boundaries
+        (balanced bytes); lanes stay contiguous, so each piece is a zero-copy
+        slice of the wire. Results are in the original aggregate order.
+
+        ``segments`` defaults to ``surge.replay.upload-stream-segments``
+        (0/1 = plain upload+replay)."""
+        if segments is None:
+            segments = self.config.get_int(
+                "surge.replay.upload-stream-segments", 0)
+        b = w.lengths.shape[0]
+        if segments <= 1 or b == 0:
+            return self.replay_resident(self.upload_resident(w),
+                                        init_carry=init_carry,
+                                        ordinal_base=ordinal_base)
+        self.check_wire(w)
+        perm = w.perm
+        init_sorted, ord_sorted = _apply_perm(perm, init_carry, ordinal_base)
+
+        starts = np.zeros(b + 1, dtype=np.int64)
+        np.cumsum(w.lengths.astype(np.int64), out=starts[1:])
+        total = int(starts[-1])
+        # lane boundaries at ~equal event counts (lanes sorted desc, so early
+        # segments carry the long logs)
+        bounds = [0]
+        for s in range(1, segments):
+            cut = int(np.searchsorted(starts, total * s // segments))
+            bounds.append(min(max(cut, bounds[-1]), b))
+        bounds.append(b)
+
+        state_fields = self.spec.registry.state.fields
+        pieces: list = []
+        padded = 0
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            if hi <= lo:
+                continue
+            base = int(starts[lo])
+            end = int(starts[hi])
+            sub = ResidentWire(
+                derived_key=dict(w.derived_key),
+                packed=w.packed[base: end + w.guard],
+                side={k: v[base: end + w.guard] for k, v in w.side.items()},
+                starts=(w.starts[lo:hi].astype(np.int64) - base).astype(np.int32),
+                lengths=w.lengths[lo:hi], perm=None, guard=w.guard,
+                num_events=end - base, layout=w.layout)
+            piece = self.upload_resident(sub)  # upload initiates...
+            slab, pad = self._dispatch_resident(
+                piece,
+                None if init_sorted is None else
+                {k: v[lo:hi] for k, v in init_sorted.items()},
+                None if ord_sorted is None else ord_sorted[lo:hi])
+            padded += pad
+            pieces.append((lo, hi, slab))  # ...fold dispatched, NOT synced
+        # one sync pass over every piece, then global unsort
+        out_sorted = {f.name: np.empty((b,), dtype=f.dtype)
+                      for f in state_fields}
+        for lo, hi, slab in pieces:
+            for name, col in slab.items():
+                out_sorted[name][lo:hi] = np.asarray(col)[: hi - lo]
+        return ReplayResult(states=_unapply_perm(perm, out_sorted),
+                            num_aggregates=b,
+                            num_events=w.num_events, padded_events=padded)
 
     def resident_cap_width(self) -> int:
         """Largest tile width the HBM budget allows (pow2 multiple of the min
