@@ -21,9 +21,11 @@ from surge_tpu.codec.wire import WireFormat
 from surge_tpu.replay.engine import (
     ReplayResult,
     ResidentWire,
+    _apply_perm,
     _bucket_len,
     _make_tile,
     _round_up,
+    _unapply_perm,
 )
 
 
@@ -226,13 +228,7 @@ def replay_resident_sharded(engine, sharded: ShardedResident,
     init_tree = engine.spec.init_state_tree()
     for name, col in slab.items():
         col[:] = init_tree[name]
-    src_ord = None if ordinal_base is None else np.asarray(ordinal_base)
-    if perm is not None and src_ord is not None:
-        src_ord = src_ord[perm]
-    init_sorted = None
-    if init_carry is not None:
-        init_sorted = {k: (np.asarray(v)[perm] if perm is not None
-                           else np.asarray(v)) for k, v in init_carry.items()}
+    init_sorted, src_ord = _apply_perm(perm, init_carry, ordinal_base)
     for d, lanes in enumerate(sharded.deals):
         if src_ord is not None:
             ord_l[d, : len(lanes)] = src_ord[lanes].astype(np.int32)
@@ -271,12 +267,7 @@ def replay_resident_sharded(engine, sharded: ShardedResident,
     for d, lanes in enumerate(sharded.deals):
         for name in out_sorted:
             out_sorted[name][lanes] = host[name][d, : len(lanes)]
-    if perm is None:
-        out = out_sorted
-    else:
-        out = {name: np.empty_like(col) for name, col in out_sorted.items()}
-        for name, col in out_sorted.items():
-            out[name][perm] = col
-    return ReplayResult(states=out, num_aggregates=b,
+    return ReplayResult(states=_unapply_perm(perm, out_sorted),
+                        num_aggregates=b,
                         num_events=sharded.num_events,
                         padded_events=sharded.padded_slots)
