@@ -183,7 +183,6 @@ def replay_child(corpus_dir: str) -> None:
             engine.stats.update(windows=0, h2d_s=0.0, pack_s=0.0)
             warm_compiles = engine.num_compiles()
             log(f"streamed mode ({stream_segments} segments): warmed")
-            prepare_s = 0.0
             t0 = time.perf_counter()
             result = engine.replay_resident_streamed(wire,
                                                      segments=stream_segments)
@@ -194,8 +193,10 @@ def replay_child(corpus_dir: str) -> None:
             replay_s = fold_s
             extra_timing = {"fold_s": round(fold_s, 2),
                             "stream_segments": stream_segments}
-            resident = None
         else:
+            if stream_segments > 1:
+                log("streamed mode requested but no packed wire dir exists; "
+                    "running the plain resident path")
             t0 = time.perf_counter()
             if os.path.isdir(wire_dir):
                 # the parent packed the wire at corpus-build time (the

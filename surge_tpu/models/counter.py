@@ -8,6 +8,7 @@ used by engine failure-path tests, exactly as the reference's specs use them
 
 from __future__ import annotations
 
+import functools as _functools
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -193,9 +194,7 @@ def make_replay_spec() -> ReplaySpec:
     )
 
 
-_ASSOCIATIVE_FOLD = None
-
-
+@_functools.cache
 def make_associative_fold():
     """The counter fold as an associative transform monoid, for
     sequence-parallel replay of very long logs (surge_tpu.replay.seqpar).
@@ -207,9 +206,6 @@ def make_associative_fold():
 
     Memoized: seqpar caches compiled programs by fold identity, so repeated
     calls (e.g. one per restore chunk) must return the same object."""
-    global _ASSOCIATIVE_FOLD
-    if _ASSOCIATIVE_FOLD is not None:
-        return _ASSOCIATIVE_FOLD
     import jax.numpy as jnp
 
     from surge_tpu.replay.seqpar import AssociativeFold
@@ -244,11 +240,10 @@ def make_associative_fold():
                                  state["version"]).astype(jnp.int32),
         }
 
-    _ASSOCIATIVE_FOLD = AssociativeFold(
+    return AssociativeFold(
         lift=lift, combine=combine, apply=apply,
         identity={"d_count": np.int32(0), "has": np.bool_(False),
                   "last_seq": np.int32(0)})
-    return _ASSOCIATIVE_FOLD
 
 
 # --- byte formats (play-json Format equivalents, TestBoundedContext.scala:84-110) ---

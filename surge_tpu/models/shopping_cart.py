@@ -8,6 +8,7 @@ Prices are integer cents so scalar↔tensor golden comparisons are exact.
 
 from __future__ import annotations
 
+import functools as _functools
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -151,6 +152,58 @@ def make_replay_spec() -> ReplaySpec:
         handlers=ReplayHandlers({ADDED: added, REMOVED: removed, CHECKED_OUT: checked_out}),
         init_record={"item_count": 0, "total_cents": 0, "checked_out": False, "version": 0},
     )
+
+
+@_functools.cache
+def make_associative_fold():
+    """The cart fold as an associative transform monoid for sequence-parallel
+    replay (surge_tpu.replay.seqpar): item/total deltas are additive,
+    checked_out is OR-monotone, version is right-biased on any real event.
+    Memoized, matching the seqpar program cache's identity keying."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from surge_tpu.replay.seqpar import AssociativeFold
+
+    def lift(ev):
+        tid = ev["type_id"]
+        add = tid == ADDED
+        rem = tid == REMOVED
+        real = add | rem | (tid == CHECKED_OUT)
+        signed_qty = (jnp.where(add, ev["quantity"], 0)
+                      - jnp.where(rem, ev["quantity"], 0))
+        return {
+            "d_items": signed_qty.astype(jnp.int32),
+            "d_cents": (signed_qty * ev["unit_price_cents"]).astype(jnp.int32),
+            "checked": tid == CHECKED_OUT,
+            "has": real,
+            "last_seq": jnp.where(real, ev["sequence_number"],
+                                  0).astype(jnp.int32),
+        }
+
+    def combine(a, b):
+        return {
+            "d_items": a["d_items"] + b["d_items"],
+            "d_cents": a["d_cents"] + b["d_cents"],
+            "checked": a["checked"] | b["checked"],
+            "has": a["has"] | b["has"],
+            "last_seq": jnp.where(b["has"], b["last_seq"], a["last_seq"]),
+        }
+
+    def apply(state, s):
+        return {
+            "item_count": (state["item_count"] + s["d_items"]).astype(jnp.int32),
+            "total_cents": (state["total_cents"] + s["d_cents"]).astype(jnp.int32),
+            "checked_out": state["checked_out"] | s["checked"],
+            "version": jnp.where(s["has"], s["last_seq"],
+                                 state["version"]).astype(jnp.int32),
+        }
+
+    return AssociativeFold(
+        lift=lift, combine=combine, apply=apply,
+        identity={"d_items": np.int32(0), "d_cents": np.int32(0),
+                  "checked": np.bool_(False), "has": np.bool_(False),
+                  "last_seq": np.int32(0)})
 
 
 _EVENTS = {c.__name__: c for c in (ItemAdded, ItemRemoved, CheckedOut)}

@@ -88,6 +88,55 @@ def test_time_sharded_resume_carry():
         assert int(second["version"][i]) == exp.version, i
 
 
+def test_time_sharded_shopping_cart_ragged():
+    """The cart family (ragged logs, bool + multi-int state) through the
+    sequence-parallel path vs the scalar fold."""
+    import random as _random
+
+    from surge_tpu.engine.model import RejectedCommand
+    from surge_tpu.models import shopping_cart as sc
+
+    mesh = _mesh()
+    model = sc.CartModel()
+    spec = model.replay_spec()
+    rng = _random.Random(71)
+    logs = []
+    for i in range(6):
+        st, log = None, []
+        for _ in range(900 + 13 * i):
+            if st is not None and st.checked_out:
+                break
+            try:
+                r = rng.random()
+                if r < 0.65:
+                    cmd = sc.AddItem(str(i), rng.randrange(1, 30),
+                                     rng.randrange(1, 4), rng.randrange(100, 900))
+                elif r < 0.999:
+                    cmd = sc.RemoveItem(str(i), rng.randrange(1, 30),
+                                        rng.randrange(1, 3), rng.randrange(100, 900))
+                else:
+                    cmd = sc.Checkout(str(i))
+                events = model.process_command(st, cmd)
+            except RejectedCommand:
+                continue
+            for e in events:
+                st = model.handle_event(st, e)
+                log.append(e)
+        logs.append(log)
+    expected = [fold_events(model, None, log) for log in logs]
+
+    enc = encode_events(spec.registry, logs)
+    events = {"type_id": enc.type_ids.T.astype(np.int32)}
+    for name, col in enc.cols.items():
+        events[name] = col.T
+    out = replay_time_sharded(sc.make_associative_fold(), spec, events, mesh)
+    for i, exp in enumerate(expected):
+        assert int(out["item_count"][i]) == exp.item_count, i
+        assert int(out["total_cents"][i]) == exp.total_cents, i
+        assert bool(out["checked_out"][i]) == exp.checked_out, i
+        assert int(out["version"][i]) == exp.version, i
+
+
 def test_associativity_property():
     """combine must be associative for arbitrary summary triples (the property
     the sequence-parallel schedule relies on)."""
