@@ -14,6 +14,7 @@ on the tensor path (see tests for the exactness/tolerance discipline).
 
 from __future__ import annotations
 
+import functools as _functools
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -189,6 +190,76 @@ def make_replay_spec() -> ReplaySpec:
         handlers=ReplayHandlers({CREATED: created, UPDATED: updated}),
         init_record={"created": False, "owner_code": 0, "security_code_code": 0, "balance": 0.0},
     )
+
+
+@_functools.cache
+def make_associative_fold():
+    """The bank fold as a last-writer-with-reset monoid for sequence-parallel
+    replay (surge_tpu.replay.seqpar): a Created RESETS the account (its values
+    win over everything earlier), an Updated sets the balance only if an
+    account exists at that point, orphan Updateds are no-ops. Summary =
+    (has_create, create vals, last-update-after-last-create); ``combine`` is
+    the standard reset-aware last-writer composition. Memoized for the seqpar
+    program cache's identity keying."""
+    import jax.numpy as jnp
+
+    from surge_tpu.replay.seqpar import AssociativeFold
+
+    def lift(ev):
+        tid = ev["type_id"]
+        cr = tid == CREATED
+        up = tid == UPDATED
+        f32 = jnp.float32
+        return {
+            "hc": cr,
+            "cr_owner": jnp.where(cr, ev["owner_code"], 0).astype(jnp.int32),
+            "cr_sec": jnp.where(cr, ev["security_code_code"],
+                                0).astype(jnp.int32),
+            "cr_bal": jnp.where(cr, ev["balance"], 0.0).astype(f32),
+            "upd_has": up,
+            "upd_bal": jnp.where(up, ev["new_balance"], 0.0).astype(f32),
+        }
+
+    def combine(a, b):
+        # updates after the combined slice's LAST create: b's own if b has a
+        # create (reset) or any update; otherwise a's carry through
+        upd_has = jnp.where(b["hc"], b["upd_has"],
+                            b["upd_has"] | a["upd_has"])
+        upd_bal = jnp.where(b["upd_has"], b["upd_bal"], a["upd_bal"])
+        upd_bal = jnp.where(b["hc"] & ~b["upd_has"],
+                            jnp.float32(0.0), upd_bal)
+        return {
+            "hc": a["hc"] | b["hc"],
+            "cr_owner": jnp.where(b["hc"], b["cr_owner"], a["cr_owner"]),
+            "cr_sec": jnp.where(b["hc"], b["cr_sec"], a["cr_sec"]),
+            "cr_bal": jnp.where(b["hc"], b["cr_bal"], a["cr_bal"]),
+            "upd_has": upd_has,
+            "upd_bal": upd_bal,
+        }
+
+    def apply(state, s):
+        created = state["created"] | s["hc"]
+        # with a create in the slice: its values, overridden by any later
+        # update; without one: updates apply only if the account existed
+        bal_with_create = jnp.where(s["upd_has"], s["upd_bal"], s["cr_bal"])
+        bal_no_create = jnp.where(state["created"] & s["upd_has"],
+                                  s["upd_bal"], state["balance"])
+        return {
+            "created": created,
+            "owner_code": jnp.where(s["hc"], s["cr_owner"],
+                                    state["owner_code"]).astype(jnp.int32),
+            "security_code_code": jnp.where(
+                s["hc"], s["cr_sec"],
+                state["security_code_code"]).astype(jnp.int32),
+            "balance": jnp.where(s["hc"], bal_with_create,
+                                 bal_no_create).astype(jnp.float32),
+        }
+
+    return AssociativeFold(
+        lift=lift, combine=combine, apply=apply,
+        identity={"hc": np.bool_(False), "cr_owner": np.int32(0),
+                  "cr_sec": np.int32(0), "cr_bal": np.float32(0.0),
+                  "upd_has": np.bool_(False), "upd_bal": np.float32(0.0)})
 
 
 # --- byte formats ---
