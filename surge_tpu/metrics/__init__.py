@@ -215,7 +215,9 @@ class EngineMetrics:
         self.flush_timer = m.timer(MI(
             "surge.producer.flush-timer", "ms per flush transaction"))
         self.replay_timer = m.timer(MI(
-            "surge.replay.batch-timer", "ms per TPU replay fold"))
+            "surge.replay.rebuild-timer",
+            "ms per bulk state rebuild (segment build if any + replay fold + "
+            "snapshot overlay + indexer prime)"))
         self.command_rate = m.rate(MI(
             "surge.engine.command-rate", "commands processed"))
         self.rejection_rate = m.rate(MI(
@@ -227,7 +229,9 @@ class EngineMetrics:
         self.fence_counter = m.counter(MI(
             "surge.producer.fences", "producer fencing events"))
         self.replay_events_per_sec = m.gauge(MI(
-            "surge.replay.events-per-sec", "latest replay throughput"))
+            "surge.replay.rebuild-events-per-sec",
+            "events/s of the latest bulk rebuild, end to end (compare "
+            "bench.py's cold_replay_events_per_sec for the fold alone)"))
         self.live_entities = m.gauge(MI(
             "surge.engine.live-entities", "currently resident aggregate entities"))
         self.standby_lag = m.gauge(MI(

@@ -239,6 +239,10 @@ def test_rebuild_from_segment_cold_start(tmp_path):
         import os
         assert os.path.exists(seg_path)  # built on first rebuild
         assert engine2.indexer.store.approximate_num_entries() == 13
+        # the predeclared replay instruments recorded the rebuild (SURVEY §5.5)
+        snap = engine2.metrics_registry.get_metrics()
+        assert snap["surge.replay.rebuild-events-per-sec"] > 0
+        assert snap["surge.replay.rebuild-timer"] > 0
         segment_bytes = {f"agg{i}": engine2.indexer.get_aggregate_bytes(f"agg{i}")
                          for i in range(12)}
         # the state-only aggregate came from the snapshot section

@@ -95,7 +95,7 @@ def test_engine_metrics_quiver_names():
                  "surge.aggregate.command-handling-timer",
                  "surge.aggregate.event-publish-timer",
                  "surge.producer.flush-timer",
-                 "surge.replay.batch-timer",
+                 "surge.replay.rebuild-timer",
                  "surge.engine.command-rate.one-minute-rate",
                  "surge.producer.fences",
                  "surge.engine.live-entities"):
