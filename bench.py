@@ -137,6 +137,21 @@ def replay_child(corpus_dir: str) -> None:
     platform = devices[0].platform
     log(f"child backend up: platform={platform} devices={devices}")
 
+    # On a real accelerator, bank a machine-readable on-chip artifact IMMEDIATELY
+    # (smoke-scale sweep over the prepared knobs -> BENCH_ONCHIP.json, rewritten
+    # after every measurement) before betting the window on the full-scale run;
+    # the sweep's winning knobs then tune this child's headline measurement.
+    if platform != "cpu" and os.environ.get("SURGE_BENCH_ONCHIP", "1") == "1":
+        try:
+            import onchip_sweep
+
+            best = onchip_sweep.run_sweep()
+            for k, v in onchip_sweep.best_to_env(best).items():
+                os.environ.setdefault(k, v)  # explicit user knobs win
+            log(f"on-chip smoke sweep banked (BENCH_ONCHIP.json); best={best}")
+        except Exception as exc:  # noqa: BLE001 — sweep failure must not void the run
+            log(f"on-chip sweep failed (continuing to full scale): {exc!r}")
+
     from surge_tpu.models.counter import make_replay_spec
 
     corpus = load_corpus(corpus_dir)
@@ -263,6 +278,11 @@ def replay_child(corpus_dir: str) -> None:
         "compiles": engine.num_compiles(),
         "num_events": corpus.num_events,
         "num_aggregates": corpus.num_aggregates,
+        "knobs": {"dispatch": engine._dispatch, "unroll": engine._unroll,
+                  "time_chunk": engine.time_chunk, "batch": engine.batch_size,
+                  "tile": engine._tile_backend,
+                  "upload_chunk_mb": engine.config.get_int(
+                      "surge.replay.upload-chunk-mb", 0)},
         **extra_timing,
     }
     log(f"child replay: {corpus.num_events:,} events in {replay_s:.2f}s -> "
@@ -415,7 +435,7 @@ def _merge_replay(payload: dict, child: dict, cpu_eps: float) -> None:
     payload["vs_baseline"] = round(child["events_per_sec"] / cpu_eps, 2) if cpu_eps else 0
     for k in ("platform", "aggregates_per_sec", "replay_s", "pad_ratio", "pack_s",
               "h2d_s", "windows", "compiles", "device_fold_events_per_sec",
-              "upload_s", "fold_s", "wire_mb", "stream_segments"):
+              "upload_s", "fold_s", "wire_mb", "stream_segments", "knobs"):
         if k in child:
             payload[k] = child[k]
 

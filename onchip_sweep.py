@@ -1,0 +1,329 @@
+#!/usr/bin/env python
+"""On-chip knob sweep: convert ANY live tunnel window into a persisted artifact.
+
+Four rounds of benching bet each TPU claim on a full-scale run and produced zero
+machine-readable on-chip numbers (VERDICT r4 "missing" #1).  This module inverts
+that: the moment a backend initializes, it
+
+  1. probes the link (sync latency, H2D bandwidth single vs chunked puts),
+  2. runs a SMOKE-scale resident replay sweep over the prepared knobs
+     (dispatch switch|select, unroll, time-chunk, tile-backend xla|pallas,
+     chunked upload, streamed segments), verifying every config against the
+     closed-form fold,
+  3. rewrites the artifact JSON after EVERY measurement, so a tunnel drop
+     mid-sweep still leaves on-chip evidence,
+  4. optionally re-runs the best configs at full scale (1M/100M).
+
+Called from bench.py's TPU replay child (artifact lands before the full-scale
+attempt) and runnable standalone.  The reference benches its restore/throughput
+on its real broker the same way — measured, not estimated (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+ARTIFACT = os.path.join(REPO, "BENCH_ONCHIP.json")
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class Artifact:
+    """Incrementally-rewritten JSON sidecar; every update is atomic."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.data: dict = {"started_utc": _now(), "done": False}
+
+    def update(self, **kv) -> None:
+        self.data.update(kv)
+        self.data["updated_utc"] = _now()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.data, f, indent=1)
+        os.replace(tmp, self.path)
+
+
+def _probe_link(jax) -> dict:
+    """Sync latency + H2D bandwidth, single put vs 16MB pieces."""
+    import jax.numpy as jnp
+
+    out: dict = {}
+    # sync latency: tiny transfer + block, median of 10
+    x = np.zeros((8,), dtype=np.int32)
+    ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(x))
+        ts.append(time.perf_counter() - t0)
+    out["sync_ms"] = round(1000 * sorted(ts)[len(ts) // 2], 2)
+
+    big = np.random.default_rng(0).integers(0, 255, size=(96 * 1024 * 1024,),
+                                            dtype=np.uint8)
+    t0 = time.perf_counter()
+    d = jax.device_put(big)
+    jax.block_until_ready(d)
+    single = time.perf_counter() - t0
+    out["h2d_single_96mb_mb_s"] = round(big.nbytes / 1e6 / single, 1)
+    del d
+    ch = 16 * 1024 * 1024
+    t0 = time.perf_counter()
+    parts = [jax.device_put(big[i:i + ch]) for i in range(0, big.nbytes, ch)]
+    jax.block_until_ready(parts)
+    chunked = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    whole = jnp.concatenate(parts, axis=0)
+    jax.block_until_ready(whole)
+    out["h2d_chunked_16mb_mb_s"] = round(big.nbytes / 1e6 / chunked, 1)
+    out["h2d_concat_s"] = round(time.perf_counter() - t0, 3)
+    del parts, whole, big
+    return out
+
+
+def _smoke_corpus(cache_dir: str, num_agg: int, num_events: int):
+    """Build-or-load the smoke corpus + packed wire (cached across attempts).
+
+    Crash-safe: the cache is only trusted when its ``complete.json`` marker —
+    written LAST — exists and records the same corpus sizes; anything else
+    (mid-build kill, different parameters) is wiped and rebuilt.  A poisoned
+    cache would otherwise fail every subsequent tunnel attempt, which is the
+    exact outcome this module exists to prevent."""
+    import shutil
+
+    from bench import load_corpus, make_engine, save_corpus
+    from surge_tpu.replay.corpus import synth_counter_corpus
+    from surge_tpu.replay.engine import ResidentWire
+
+    marker = os.path.join(cache_dir, "complete.json")
+    want = {"num_aggregates": num_agg, "num_events": num_events}
+    valid = False
+    if os.path.exists(marker):
+        try:
+            with open(marker) as f:
+                valid = json.load(f) == want
+        except (OSError, ValueError):
+            valid = False
+    if not valid:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        os.makedirs(cache_dir)
+        corpus = synth_counter_corpus(num_agg, num_events, seed=43,
+                                      sort_by_length=True)
+        save_corpus(corpus, cache_dir)
+        make_engine().pack_resident(corpus.events).save(
+            os.path.join(cache_dir, "wire"))
+        tmp = marker + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(want, f)
+        os.replace(tmp, marker)
+    expected = {
+        "count": np.load(os.path.join(cache_dir, "expected_count.npy")),
+        "version": np.load(os.path.join(cache_dir, "expected_version.npy")),
+    }
+    return ResidentWire.load(os.path.join(cache_dir, "wire")), expected
+
+
+def _engine(overrides: dict, unroll: int):
+    from surge_tpu.config import default_config
+    from surge_tpu.models.counter import make_replay_spec
+    from surge_tpu.replay.engine import ReplayEngine
+
+    cfg = default_config().with_overrides({
+        "surge.replay.batch-size": 8192,
+        "surge.replay.time-chunk": 128,
+        "surge.replay.resident-len-bucket": "exact",
+        **overrides,
+    })
+    return ReplayEngine(make_replay_spec(), config=cfg, unroll=unroll)
+
+
+def _run_config(wire, expected, *, dispatch="switch", unroll=1, time_chunk=128,
+                tile="xla", chunk_mb=0, passes=3) -> dict:
+    """Upload + warm + throwaway + timed passes for one knob combination."""
+    cfg = {"dispatch": dispatch, "unroll": unroll, "time_chunk": time_chunk,
+           "tile": tile, "chunk_mb": chunk_mb}
+    try:
+        eng = _engine({
+            "surge.replay.time-chunk": time_chunk,
+            "surge.replay.dispatch": dispatch,
+            "surge.replay.tile-backend": tile,
+            "surge.replay.upload-chunk-mb": chunk_mb,
+        }, unroll)
+        t0 = time.perf_counter()
+        res = eng.upload_resident(wire)
+        upload_s = time.perf_counter() - t0
+        eng.warm_resident(res)
+        t0 = time.perf_counter()
+        r = eng.replay_resident(res)
+        first_s = time.perf_counter() - t0
+        steady = 1e9
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            r = eng.replay_resident(res)
+            steady = min(steady, time.perf_counter() - t0)
+        n = wire.num_events
+        ok = (np.array_equal(r.states["count"], expected["count"])
+              and np.array_equal(r.states["version"], expected["version"]))
+        return {**cfg, "upload_s": round(upload_s, 3),
+                "first_pass_s": round(first_s, 3),
+                "steady_s": round(steady, 4),
+                "events_per_sec": round(n / steady),
+                "pad_ratio": round(r.padded_events / n, 3),
+                "verified": bool(ok)}
+    except Exception as e:  # noqa: BLE001 — a failing config must not kill the sweep
+        return {**cfg, "error": f"{type(e).__name__}: {str(e)[:400]}"}
+
+
+def _run_streamed(wire, expected, segments: int) -> dict:
+    cfg = {"streamed_segments": segments}
+    try:
+        eng = _engine({}, 1)
+        eng.replay_resident_streamed(wire, segments=segments)  # warm/compile
+        t0 = time.perf_counter()
+        r = eng.replay_resident_streamed(wire, segments=segments)
+        dt = time.perf_counter() - t0
+        ok = (np.array_equal(r.states["count"], expected["count"])
+              and np.array_equal(r.states["version"], expected["version"]))
+        return {**cfg, "total_s": round(dt, 3),
+                "events_per_sec_incl_upload": round(wire.num_events / dt),
+                "verified": bool(ok)}
+    except Exception as e:  # noqa: BLE001
+        return {**cfg, "error": f"{type(e).__name__}: {str(e)[:400]}"}
+
+
+SMOKE_CONFIGS = (
+    dict(dispatch="switch", unroll=1),
+    dict(dispatch="select", unroll=1),
+    dict(dispatch="switch", unroll=8),
+    dict(dispatch="select", unroll=8),
+    dict(dispatch="select", unroll=4, time_chunk=256),
+    dict(dispatch="switch", unroll=1, chunk_mb=16),
+    dict(dispatch="select", unroll=1, tile="pallas"),
+    dict(dispatch="select", unroll=4, tile="pallas"),
+)
+
+
+def _device_fold_ceiling(corpus_dir: str) -> float | None:
+    """Transfer-free fold slots/s on this backend (bench helper reused)."""
+    try:
+        from bench import _device_resident_fold_rate, load_corpus, make_engine
+        corpus = load_corpus(corpus_dir)
+        return round(_device_resident_fold_rate(make_engine(), corpus))
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def run_sweep(artifact_path: str = ARTIFACT, *,
+              smoke_aggregates: int = 50_000, smoke_events: int = 5_000_000,
+              smoke_cache: str | None = None,
+              full_corpus_dir: str | None = None) -> dict:
+    """The whole sweep.  Returns the best smoke config's knob dict (for the
+    caller to apply to a subsequent full-scale run via the SURGE_BENCH_* env)."""
+    sys.path.insert(0, REPO)
+    art = Artifact(artifact_path)
+
+    t0 = time.perf_counter()
+    import jax
+
+    devices = jax.devices()  # may hang ~25 min and raise if the pool is down
+    claim_s = time.perf_counter() - t0
+    platform = devices[0].platform
+    art.update(platform=platform, device=str(devices[0]),
+               claim_s=round(claim_s, 1))
+
+    art.update(probe=_probe_link(jax))
+
+    cache = smoke_cache or os.environ.get("SURGE_ONCHIP_CACHE",
+                                          "/tmp/corpus_smoke5m")
+    t0 = time.perf_counter()
+    wire, expected = _smoke_corpus(cache, smoke_aggregates, smoke_events)
+    smoke: dict = {"num_aggregates": smoke_aggregates,
+                   "num_events": smoke_events,
+                   "corpus_s": round(time.perf_counter() - t0, 1),
+                   "configs": []}
+    art.update(smoke=smoke)
+
+    for kw in SMOKE_CONFIGS:
+        row = _run_config(wire, expected, **kw)
+        smoke["configs"].append(row)
+        art.update(smoke=smoke)
+    for segments in (4, 8):
+        row = _run_streamed(wire, expected, segments)
+        smoke["configs"].append(row)
+        art.update(smoke=smoke)
+
+    ok_rows = [c for c in smoke["configs"]
+               if c.get("verified") and "events_per_sec" in c]
+    best = max(ok_rows, key=lambda c: c["events_per_sec"]) if ok_rows else {}
+    smoke["best"] = best
+    smoke["device_fold_slots_per_sec"] = _device_fold_ceiling(cache)
+    art.update(smoke=smoke)
+
+    if full_corpus_dir and os.path.isdir(full_corpus_dir):
+        from bench import make_engine
+        from surge_tpu.replay.engine import ResidentWire
+
+        wire_dir = os.path.join(full_corpus_dir, "wire")
+        if not os.path.isdir(wire_dir):
+            from bench import load_corpus
+            make_engine().pack_resident(
+                load_corpus(full_corpus_dir).events).save(wire_dir)
+        fwire = ResidentWire.load(wire_dir)
+        fexpected = {
+            "count": np.load(os.path.join(full_corpus_dir,
+                                          "expected_count.npy")),
+            "version": np.load(os.path.join(full_corpus_dir,
+                                            "expected_version.npy")),
+        }
+        full: dict = {"num_events": int(fwire.num_events), "configs": []}
+        art.update(full=full)
+        contenders = [dict(dispatch="switch", unroll=1)]
+        if best:
+            contenders.append({k: best[k] for k in
+                               ("dispatch", "unroll", "time_chunk", "tile",
+                                "chunk_mb") if k in best})
+        contenders.append(dict(dispatch="switch", unroll=1, chunk_mb=16))
+        seen: set = set()
+        for kw in contenders:
+            key = tuple(sorted(kw.items()))
+            if key in seen:
+                continue
+            seen.add(key)
+            row = _run_config(fwire, fexpected, passes=2, **kw)
+            full["configs"].append(row)
+            art.update(full=full)
+        for segments in (4, 8):
+            row = _run_streamed(fwire, fexpected, segments)
+            full["configs"].append(row)
+            art.update(full=full)
+        fok = [c for c in full["configs"]
+               if c.get("verified") and "events_per_sec" in c]
+        full["best"] = max(fok, key=lambda c: c["events_per_sec"]) if fok else {}
+        art.update(full=full)
+
+    art.update(done=True)
+    return best
+
+
+def best_to_env(best: dict) -> dict:
+    """Map a sweep row back onto the SURGE_BENCH_* knobs bench.py reads."""
+    if not best:
+        return {}
+    return {"SURGE_BENCH_DISPATCH": str(best.get("dispatch", "switch")),
+            "SURGE_BENCH_UNROLL": str(best.get("unroll", 1)),
+            "SURGE_BENCH_TIME_CHUNK": str(best.get("time_chunk", 128)),
+            "SURGE_BENCH_TILE": str(best.get("tile", "xla")),
+            "SURGE_BENCH_UPLOAD_CHUNK_MB": str(best.get("chunk_mb", 0))}
+
+
+if __name__ == "__main__":
+    full_dir = sys.argv[1] if len(sys.argv) > 1 else None
+    best = run_sweep(full_corpus_dir=full_dir)
+    print(json.dumps({"best": best}), flush=True)
