@@ -78,6 +78,18 @@ DEFAULTS: dict[str, Any] = {
     # append delta chunks/snapshots for post-build offsets on each segment
     # rebuild, so repeated cold starts never re-crawl the topics
     "surge.replay.segment-auto-extend": True,
+    # bounded-memory restore_from_events: topics whose total record count
+    # exceeds this never materialize as one dict of per-event Python objects —
+    # the tpu backend streams through a throwaway columnar segment (spill
+    # files + per-chunk encode), the cpu backend folds in key-hash-range
+    # passes (the restore consumer max.poll.records role, common
+    # reference.conf:198-199). 0 forces the bounded route (cpu passes are
+    # capped at 64, trading per-pass memory, not O(N^2) rescans); negative
+    # disables spilling entirely.
+    "surge.replay.restore-spill-events": 1_000_000,
+    # aggregates per chunk for the throwaway restore segment (peak host
+    # memory of the bounded tpu path = one chunk's decoded events)
+    "surge.replay.restore-chunk-aggregates": 65536,
     # --- log broker replication (acks=all role, common reference.conf:112-124) ---
     # how long a commit waits for the follower ack before failing back to the
     # client (which retries the same txn_seq and re-joins the queued item)

@@ -123,6 +123,18 @@ class ColumnarSegmentWriter:
         self._file.write(struct.pack("<II", WATERMARK_MARKER, len(meta)) + meta)
 
     def _write_header(self, schema: dict) -> None:
+        # Fresh segment at this path: stamp a per-build identity into the
+        # header (restore's sidecar wire cache keys on it — a rebuilt segment
+        # whose chunk happens to share an ordinal+event-count with the old
+        # build must never hit the old build's cached wires, ADVICE r4) and
+        # drop any leftover sidecar cache from a previous build outright.
+        # extend() never lands here, so extends keep the base build's id —
+        # correct, since extends only APPEND chunks at new ordinals.
+        import shutil
+        import uuid
+
+        self._extra.setdefault("build_id", uuid.uuid4().hex)
+        shutil.rmtree(f"{self.path}.wires", ignore_errors=True)
         self._file = open(self.path, "wb")
         header = json.dumps(schema).encode()
         self._file.write(MAGIC + struct.pack("<I", len(header)) + header)
@@ -484,32 +496,33 @@ def build_segment_from_topic(log, topic: str, registry, deserialize_event,
     from surge_tpu.codec.tensor import encode_events_columnar
     from surge_tpu.serialization import SerializedMessage
 
+    from surge_tpu.log.transport import page_keyed_records
+
     if partitions is None:
         partitions = range(log.num_partitions(topic))
     partitions = list(partitions)
 
+    # Watermarks are captured FIRST and every pass is clamped to them: on a
+    # LIVE topic, records committed mid-build would otherwise be seen by the
+    # spill pass but not the key census (KeyError on a brand-new key) or be
+    # folded despite lying past the recorded watermark (double-applied when
+    # the indexer resumes there). Clamping gives the build one consistent
+    # snapshot; later records belong to the tailing indexer / a later extend.
+    wm_int = {p: log.end_offset(topic, p) for p in partitions}
+    watermarks = {str(p): off for p, off in wm_int.items()}
+
     def scan(p: int):
-        """Page through one partition so a 100M-event topic never materializes
-        as one Python list (restore-consumer-max-poll-records role,
-        common reference.conf:198-199)."""
-        offset = 0
-        while True:
-            batch = log.read(topic, p, from_offset=offset, max_records=10_000)
-            if not batch:
-                return
-            for r in batch:
-                if r.key is not None and r.value is not None:
-                    yield r
-            offset = batch[-1].offset + 1
+        """Paged snapshot scan (restore-consumer-max-poll-records role,
+        common reference.conf:198-199) — a 100M-event topic never
+        materializes as one Python list."""
+        return page_keyed_records(log, topic, p, upto=wm_int[p])
 
     # Pass 1: key census only (key → source partition) — O(num_aggregates)
     # memory, no event objects.
     key_partition: dict[str, int] = {}
-    watermarks: dict[str, int] = {}
     for p in partitions:
         for r in scan(p):
             key_partition[r.key] = p
-        watermarks[str(p)] = log.end_offset(topic, p)
     # chunks are PER PARTITION (sorted keys within each) so a node can restore
     # only its assigned partitions' chunks (SURVEY.md §3.3 per-task restore)
     ordered: list[str] = []
@@ -622,30 +635,27 @@ def extend_segment_from_topic(log, topic: str, registry, deserialize_event,
     partitions = sorted(base_wm) if base_wm else list(
         range(log.num_partitions(topic)))
 
-    # collect the delta per partition (small by construction: post-build only)
+    # collect the delta per partition (small by construction: post-build only);
+    # the new watermark is captured BEFORE the scan and clamps it, so a live
+    # producer's mid-extend commits wait for the NEXT extend instead of being
+    # folded past the recorded frontier (same snapshot discipline as the build)
+    from surge_tpu.log.transport import page_keyed_records
+
     delta: dict[int, dict[str, list]] = {}
     new_wm: dict[str, int] = {}
     delta_keys: set[str] = set()
     for p in partitions:
-        start = base_wm.get(p, 0)
+        new_wm[str(p)] = log.end_offset(topic, p)
         per_key: dict[str, list] = {}
-        offset = start
-        while True:
-            batch = log.read(topic, p, from_offset=offset, max_records=10_000)
-            if not batch:
-                break
-            for r in batch:
-                if r.key is None or r.value is None:
-                    continue
-                ev = deserialize_event(SerializedMessage(key=r.key, value=r.value))
-                if encode_event is not None:
-                    ev = encode_event(ev)
-                per_key.setdefault(r.key, []).append(ev)
-                delta_keys.add(r.key)
-            offset = batch[-1].offset + 1
+        for r in page_keyed_records(log, topic, p, start=base_wm.get(p, 0),
+                                    upto=int(new_wm[str(p)])):
+            ev = deserialize_event(SerializedMessage(key=r.key, value=r.value))
+            if encode_event is not None:
+                ev = encode_event(ev)
+            per_key.setdefault(r.key, []).append(ev)
+            delta_keys.add(r.key)
         if per_key:
             delta[p] = per_key
-        new_wm[str(p)] = log.end_offset(topic, p)
 
     state_wm: Optional[dict] = None
     snapshots_by_partition: dict[int, list[tuple]] = {}

@@ -112,3 +112,28 @@ class LogTransport(Protocol):
     async def wait_for_append(self, topic: str, partition: int,
                               after_offset: int) -> None:
         """Resolve once ``end_offset`` exceeds ``after_offset`` (consumer wakeup)."""
+
+
+def page_keyed_records(log, topic: str, partition: int, *,
+                       start: int = 0, upto: Optional[int] = None,
+                       page: int = 10_000):
+    """Offset-paged scan of one partition's keyed records (tombstones and
+    keyless records skipped) — the shared bulk-scan loop of segment builds and
+    bounded restores. ``upto`` clamps the scan to a pre-captured watermark so
+    multi-pass consumers see ONE consistent snapshot of a live topic: records
+    committed after the watermark are left for the tailing indexer instead of
+    being half-seen across passes."""
+    offset = start
+    while True:
+        if upto is not None and offset >= upto:
+            return
+        batch = log.read(topic, partition, from_offset=offset,
+                         max_records=page)
+        if not batch:
+            return
+        for r in batch:
+            if upto is not None and r.offset >= upto:
+                return
+            if r.key is not None and r.value is not None:
+                yield r
+        offset = batch[-1].offset + 1

@@ -906,6 +906,19 @@ class ReplayEngine:
         lane = self._lane_multiple()
         bs_big = min(self.batch_size, _round_up(max(b, 1), lane))
         bs_small = min(bs_big, max(lane, bs_big // 8))
+        # bs_small MUST divide bs_big: the small-tile walk covers
+        # [n_big*bs_big, active) in bs_small steps while the device buffer is
+        # only padded to a bs_big multiple (upload_resident b_pad) — a
+        # non-divisor's last tile would start within bs_small of the buffer
+        # end, dynamic_slice would clamp the lane start, and the tile would
+        # silently RE-APPLY events to lanes the previous tile already folded
+        # (ADVICE r4). Today bs_big is always a multiple of 8*lane so
+        # bs_big//8 divides exactly; this guard keeps the invariant explicit
+        # against future knob/rounding changes.
+        if bs_big % bs_small:
+            bs_small = max(c for c in range(lane, bs_small + 1, lane)
+                           if bs_big % c == 0)  # lane | bs_big, so non-empty
+        assert bs_big % bs_small == 0, (bs_big, bs_small)
         width = self.resident_tile_width()
         lens_host = resident.lengths
         max_len = int(lens_host.max(initial=0)) if b else 0

@@ -339,3 +339,56 @@ def test_segment_carries_ids_snapshots_and_watermarks(tmp_path):
     for agg, st in expected.items():
         got = sfmt.read_state(store2.get(agg))
         assert (got.count, got.version) == (st.count, st.version), agg
+
+
+def test_rebuilt_segment_never_serves_stale_wires(tmp_path):
+    """A segment REBUILT at the same path — same chunk ordinals, same event
+    counts, different content — must restore the NEW states, not the previous
+    build's cached wires (ADVICE r4): every fresh segment stamps a new
+    build_id into its header and creation drops the sidecar cache outright."""
+    import os
+
+    from surge_tpu.engine.model import fold_events
+    from surge_tpu.log.columnar import build_segment_from_topic, segment_info
+    from surge_tpu.store import InMemoryKeyValueStore, restore_from_segment
+
+    model = counter.CounterModel()
+    fmt = counter.event_formatting()
+    sfmt = counter.state_formatting()
+    path = str(tmp_path / "events.scol")
+
+    def build_and_restore(increment_by: int):
+        log = InMemoryLog()
+        log.create_topic(TopicSpec("ev", 1))
+        prod = log.transactional_producer("seed")
+        expected = {}
+        for i in range(6):
+            agg = f"agg-{i}"
+            events = [counter.CountIncremented(agg, increment_by, k + 1)
+                      for k in range(3)]  # SAME count every build
+            expected[agg] = fold_events(model, None, events)
+            prod.begin()
+            for e in events:
+                prod.send(LogRecord(topic="ev", key=agg,
+                                    value=fmt.write_event(e).value))
+            prod.commit()
+        build_segment_from_topic(
+            log, "ev", counter.make_registry(), fmt.read_event, path,
+            derived_cols={"sequence_number": "ordinal"}, chunk_aggregates=6)
+        store = InMemoryKeyValueStore()
+        restore_from_segment(
+            path, store, replay_spec=counter.make_replay_spec(),
+            serialize_state=lambda a, s: sfmt.write_state(s).value)
+        return expected, store
+
+    exp1, store1 = build_and_restore(increment_by=2)
+    build1_id = segment_info(path)["schema"]["extra"]["build_id"]
+    assert os.path.isdir(path + ".wires") and os.listdir(path + ".wires")
+    for agg, st in exp1.items():
+        assert sfmt.read_state(store1.get(agg)).count == st.count
+
+    exp2, store2 = build_and_restore(increment_by=3)  # rebuild, new content
+    assert segment_info(path)["schema"]["extra"]["build_id"] != build1_id
+    for agg, st in exp2.items():
+        got = sfmt.read_state(store2.get(agg))
+        assert (got.count, got.version) == (st.count, st.version), agg

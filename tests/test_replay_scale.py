@@ -258,6 +258,32 @@ def test_resident_corpus_replay_matches_streaming_and_scalar():
         np.testing.assert_array_equal(res.states[name], res2.states[name])
 
 
+def test_resident_plan_small_tile_divides_big():
+    """bs_small must divide bs_big whatever the batch-size knob says: the
+    narrow-tile walk steps in bs_small over a buffer padded only to a bs_big
+    multiple, so a non-divisor's clamped last tile would silently re-apply a
+    round's events to already-covered lanes (ADVICE r4). The awkward
+    batch-sizes here exercise the guard AND the replay must stay exact."""
+    from surge_tpu.replay.corpus import synth_counter_corpus
+
+    corpus = synth_counter_corpus(1500, 60_000, seed=23)
+    for batch in (1007, 72):
+        cfg = Config(overrides={"surge.replay.batch-size": batch,
+                                "surge.replay.time-chunk": 32,
+                                "surge.replay.resident-len-bucket": "exact"})
+        eng = ReplayEngine(counter.make_replay_spec(), config=cfg)
+        resident = eng.prepare_resident(corpus.events)
+        plan = eng._resident_plan(resident)
+        assert plan.bs_big % plan.bs_small == 0, (batch, plan)
+        if plan.small_i0.size:
+            # every narrow tile stays inside the padded lane buffer unclamped
+            assert int(plan.small_i0.max()) + plan.bs_small <= resident.b_pad
+        res = eng.replay_resident(resident)
+        np.testing.assert_array_equal(res.states["count"], corpus.expected_count)
+        np.testing.assert_array_equal(res.states["version"],
+                                      corpus.expected_version)
+
+
 def test_resident_wire_save_load_roundtrip(tmp_path):
     """pack_resident -> save -> mmap load -> upload must replay identically to
     the direct prepare_resident path (the cold-start-from-segment flow)."""
