@@ -199,8 +199,9 @@ def make_associative_fold():
     win over everything earlier), an Updated sets the balance only if an
     account exists at that point, orphan Updateds are no-ops. Summary =
     (has_create, create vals, last-update-after-last-create); ``combine`` is
-    the standard reset-aware last-writer composition. Memoized for the seqpar
-    program cache's identity keying."""
+    the standard reset-aware last-writer composition. Repeated factory calls
+    are structurally equal, sharing seqpar's compiled programs and one-time
+    conformance check."""
     import jax.numpy as jnp
 
     from surge_tpu.replay.seqpar import AssociativeFold

@@ -204,8 +204,9 @@ def make_associative_fold():
     (inc/dec/unserializable — NoOpEvent leaves it, mirroring handle_event).
     ``combine`` is associative but not commutative (right-biased version).
 
-    Memoized: seqpar caches compiled programs by fold identity, so repeated
-    calls (e.g. one per restore chunk) must return the same object."""
+    Repeated factory calls produce structurally-equal folds: seqpar's program
+    cache keys on fold STRUCTURE, so each call shares the compiled programs
+    (and the one-time conformance check) with its predecessors."""
     import jax.numpy as jnp
 
     from surge_tpu.replay.seqpar import AssociativeFold

@@ -159,7 +159,8 @@ def make_associative_fold():
     """The cart fold as an associative transform monoid for sequence-parallel
     replay (surge_tpu.replay.seqpar): item/total deltas are additive,
     checked_out is OR-monotone, version is right-biased on any real event.
-    Memoized, matching the seqpar program cache's identity keying."""
+    Repeated factory calls are structurally equal, sharing seqpar's compiled
+    programs and one-time conformance check."""
     import jax.numpy as jnp
     import numpy as np
 
