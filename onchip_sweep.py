@@ -204,6 +204,12 @@ SMOKE_CONFIGS = (
     dict(dispatch="switch", unroll=8),
     dict(dispatch="select", unroll=8),
     dict(dispatch="select", unroll=4, time_chunk=256),
+    # narrower tiles cut the time-axis tail padding (measured on CPU at 10M:
+    # pad 1.80 -> 1.30 and +11% rate at tc=32); whether the extra tile count
+    # pays for itself against the TPU's per-tile loop cost is exactly what
+    # this sweep decides (VERDICT r4 weak #4)
+    dict(dispatch="switch", unroll=1, time_chunk=64),
+    dict(dispatch="switch", unroll=1, time_chunk=32),
     dict(dispatch="switch", unroll=1, chunk_mb=16),
     dict(dispatch="select", unroll=1, tile="pallas"),
     dict(dispatch="select", unroll=4, tile="pallas"),

@@ -237,6 +237,28 @@ class EngineMetrics:
         self.standby_lag = m.gauge(MI(
             "surge.state-store.standby-lag",
             "records behind on partitions this node is warm standby for"))
+        # Deprecation aliases for the r4 renames (ADVICE r4): dashboards keyed
+        # to the old identifiers — including a timer's .min/.max/.p99
+        # sub-metrics — keep working for a release window; the alias providers
+        # join the same sensor, so every recording lands under both names.
+        # Guarded like every base instrument so re-construction on a shared
+        # registry cannot stack duplicate providers. Remove after the window.
+        old_timer = "surge.replay.batch-timer"
+        if old_timer not in m._metrics:
+            alias = f"DEPRECATED alias of {self.replay_timer._sensor.name}"
+            sensor = self.replay_timer._sensor
+            sensor.add_metric(MI(old_timer, alias),
+                              ExponentialWeightedMovingAverage(), m)
+            sensor.add_metric(MI(f"{old_timer}.min", alias), Min(), m)
+            sensor.add_metric(MI(f"{old_timer}.max", alias), Max(), m)
+            sensor.add_metric(MI(f"{old_timer}.p99", alias),
+                              TimeBucketHistogram(), m)
+        old_gauge = "surge.replay.events-per-sec"
+        if old_gauge not in m._metrics:
+            self.replay_events_per_sec.add_metric(MI(
+                old_gauge,
+                "DEPRECATED alias of surge.replay.rebuild-events-per-sec"),
+                MostRecentValue(), m)
 
 
 def engine_metrics(registry: Optional[Metrics] = None) -> EngineMetrics:
