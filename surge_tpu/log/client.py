@@ -329,6 +329,18 @@ class GrpcLogTransport:
         return self._invoke("EndOffset", pb.OffsetRequest(
             topic=topic, partition=partition)).end_offset
 
+    def replication_status(self) -> dict:
+        """The connected broker's in-sync set (empty targets on a follower /
+        unreplicated broker): {"replicas": {target: in_sync}, "min_insync",
+        "insync_count", "queue_depth"} — the Kafka under-replicated-partitions
+        operator view."""
+        reply = self._invoke("ReplicationStatus",
+                             pb.ReplicationStatusRequest())
+        return {"replicas": {r.target: r.in_sync for r in reply.replicas},
+                "min_insync": reply.min_insync,
+                "insync_count": reply.insync_count,
+                "queue_depth": reply.queue_depth}
+
     def latest_by_key(self, topic: str, partition: int,
                       isolation: str = "read_committed") -> Mapping[str, LogRecord]:
         reply = self._invoke("LatestByKey", pb.OffsetRequest(

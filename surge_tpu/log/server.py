@@ -110,6 +110,8 @@ METHODS = {
     "WaitForAppend": (pb.WaitRequest, pb.WaitReply),
     "Replicate": (pb.ReplicateRequest, pb.ReplicateReply),
     "DedupSnapshot": (pb.DedupSnapshotRequest, pb.DedupSnapshotReply),
+    "ReplicationStatus": (pb.ReplicationStatusRequest,
+                          pb.ReplicationStatusReply),
 }
 
 
@@ -384,9 +386,18 @@ class LogServer:
         return 1 + sum(1 for st in self._repl_target_state.values()
                        if st.in_sync)
 
-    def replication_status(self) -> Dict[str, bool]:
-        """target -> currently in the in-sync set (admin/test visibility)."""
-        return {t: st.in_sync for t, st in self._repl_target_state.items()}
+    def replication_status(self) -> dict:
+        """Operator view of the in-sync set — same shape as
+        ``GrpcLogTransport.replication_status()`` so code parameterized over
+        either works: ``{"replicas": {target: in_sync}, "min_insync",
+        "insync_count", "queue_depth"}``."""
+        with self._repl_cv:
+            depth = len(self._repl_queue)
+        return {"replicas": {t: st.in_sync
+                             for t, st in self._repl_target_state.items()},
+                "min_insync": self._repl_min_insync,
+                "insync_count": self._insync_count(),
+                "queue_depth": depth}
 
     def _replication_loop(self) -> None:
         """Single worker: drain the queue IN ORDER, retrying each item until it
@@ -466,9 +477,12 @@ class LogServer:
                         dedup.last_seq = item.seq
                     self._repl_pending.pop((item.txn_id, item.seq), None)
                 item.error = None
-                item.done.set()
+                # pop BEFORE waking the waiter: a client that gets its commit
+                # reply and immediately asks ReplicationStatus must not see
+                # its own finalized item still counted in queue_depth
                 with self._repl_cv:
                     self._repl_queue.pop(0)
+                item.done.set()
                 backoff = 0.05
             else:
                 item.error = blocking_err  # visible to a waiter that times out
@@ -614,6 +628,18 @@ class LogServer:
             except Exception as exc:  # noqa: BLE001
                 logger.exception("replica ingest failed")
                 return pb.ReplicateReply(ok=False, error=repr(exc))
+
+    def ReplicationStatus(self, request: pb.ReplicationStatusRequest,
+                          context) -> pb.ReplicationStatusReply:
+        """Operator view of the in-sync set (the under-replicated-partitions
+        metric analog): a follower with in_sync=false needs catch_up."""
+        status = self.replication_status()
+        return pb.ReplicationStatusReply(
+            replicas=[pb.ReplicaStatus(target=t, in_sync=s)
+                      for t, s in status["replicas"].items()],
+            min_insync=status["min_insync"],
+            insync_count=status["insync_count"],
+            queue_depth=status["queue_depth"])
 
     def DedupSnapshot(self, request: pb.DedupSnapshotRequest,
                       context) -> pb.DedupSnapshotReply:

@@ -238,7 +238,7 @@ def test_follower_death_degrades_to_min_insync_and_drains():
         p.begin()
         p.send(rec("events", "k", b"v0"))
         p.commit()
-        assert leader.replication_status() == {f"127.0.0.1:{fport}": True}
+        assert leader.replication_status()["replicas"] == {f"127.0.0.1:{fport}": True}
 
         follower.stop(grace=0.1)  # follower dies
         # commits keep the same txn_seq through retriable errors and succeed
@@ -247,7 +247,7 @@ def test_follower_death_degrades_to_min_insync_and_drains():
         out = _commit_retrying(p, rec("events", "k", b"v1"))
         assert out[0].offset == 1
         assert _t.perf_counter() - t0 < 15
-        assert leader.replication_status() == {f"127.0.0.1:{fport}": False}
+        assert leader.replication_status()["replicas"] == {f"127.0.0.1:{fport}": False}
 
         # degraded steady state: commits are instant (no follower wait) and
         # the queue never grows — each item finalizes on dispatch
@@ -288,7 +288,7 @@ def test_follower_rejoins_via_catch_up_mid_traffic():
 
         follower.stop(grace=0.1)
         _commit_retrying(p, rec("events", "kd", b"dead-window"))  # degrade
-        assert leader.replication_status()[f"127.0.0.1:{fport}"] is False
+        assert leader.replication_status()["replicas"][f"127.0.0.1:{fport}"] is False
 
         # replacement broker on the SAME port with an EMPTY log: reachable,
         # but behind — the leader's probes must keep it out of the set
@@ -299,7 +299,7 @@ def test_follower_rejoins_via_catch_up_mid_traffic():
             p.send(rec("events", f"r{i}", f"live{i}".encode()))
             p.commit()
         _t.sleep(1.2)  # beyond the probe interval: reachable != caught up
-        assert leader.replication_status()[f"127.0.0.1:{fport}"] is False
+        assert leader.replication_status()["replicas"][f"127.0.0.1:{fport}"] is False
 
         copied = follower.catch_up(f"127.0.0.1:{lport}")
         assert copied == 7  # 3 + dead-window + 3 committed while out
@@ -311,12 +311,12 @@ def test_follower_rejoins_via_catch_up_mid_traffic():
         # traffic continues; the next probe verifies end offsets and re-joins
         deadline = _t.perf_counter() + 10
         while (_t.perf_counter() < deadline
-               and not leader.replication_status()[f"127.0.0.1:{fport}"]):
+               and not leader.replication_status()["replicas"][f"127.0.0.1:{fport}"]):
             p.begin()
             p.send(rec("events", "probe", b"tick"))
             p.commit()
             _t.sleep(0.2)
-        assert leader.replication_status()[f"127.0.0.1:{fport}"] is True
+        assert leader.replication_status()["replicas"][f"127.0.0.1:{fport}"] is True
 
         # post-rejoin commits are replicated again: kill the leader and read
         # EVERYTHING back from the follower
@@ -356,7 +356,36 @@ def test_min_insync_two_keeps_strict_acks_all():
             p.begin()
             p.send(rec("events", "k", b"v1"))
             p.commit()  # retriable error surfaces: nothing degrades
-        assert leader.replication_status() == {f"127.0.0.1:{fport}": True}
+        assert leader.replication_status()["replicas"] == {f"127.0.0.1:{fport}": True}
+    finally:
+        client.close()
+        leader.stop()
+        follower.stop()
+
+
+def test_replication_status_rpc_exposes_in_sync_set():
+    """Operators read the in-sync set off the broker itself (the Kafka
+    under-replicated-partitions view): healthy -> in_sync, post-degrade ->
+    out, queue drained."""
+    cfg = _degrade_cfg()
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    leader = LogServer(InMemoryLog(), config=cfg,
+                       replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    client = GrpcLogTransport(f"127.0.0.1:{lport}", config=cfg)
+    try:
+        client.create_topic(TopicSpec("events", 1))
+        p = client.transactional_producer("txn-0")
+        p.begin(); p.send(rec("events", "k", b"v")); p.commit()
+        st = client.replication_status()
+        assert st["replicas"] == {f"127.0.0.1:{fport}": True}
+        assert st["insync_count"] == 2 and st["min_insync"] == 1
+        follower.stop(grace=0.1)
+        _commit_retrying(p, rec("events", "k", b"v2"))
+        st = client.replication_status()
+        assert st["replicas"] == {f"127.0.0.1:{fport}": False}
+        assert st["insync_count"] == 1 and st["queue_depth"] == 0
     finally:
         client.close()
         leader.stop()
