@@ -1,0 +1,197 @@
+"""Test support for applications built on surge_tpu.
+
+The reference documents a "mockable engine" pattern for user tests: mock
+``SurgeCommand`` / ``AggregateRef`` so application services can be exercised
+without a broker (surge-docs testing.md + the Java ``TestEngine`` sample,
+surge-docs/src/test/java/javadocs/commandapp/Test.java — SURVEY.md §4 item 8).
+This module is that pattern as a first-class API:
+
+- :class:`StubAggregateRef` — an in-memory AggregateRef double. By default it
+  runs YOUR model's real ``process_command`` / ``handle_event`` against a
+  per-aggregate in-memory state, so service-layer tests exercise real domain
+  logic with zero infrastructure; canned replies and injected failures layer
+  on top for the unhappy paths.
+- :class:`StubEngine` — ``aggregate_for``-compatible factory of those stubs
+  with a shared state map and a command journal for assertions.
+
+For integration-level tests, prefer a REAL engine over ``InMemoryLog`` (the
+EmbeddedKafka equivalent) — see docs/testing.md; these stubs are for the layer
+above, where starting an engine per test is noise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from surge_tpu.engine.entity import (
+    CommandFailure,
+    CommandRejected,
+    CommandSuccess,
+)
+from surge_tpu.engine.model import fold_events
+
+__all__ = ["StubAggregateRef", "StubEngine"]
+
+
+class StubAggregateRef:
+    """In-memory double of :class:`surge_tpu.engine.ref.AggregateRef`.
+
+    With a ``model``, commands run the real domain logic::
+
+        ref = StubAggregateRef("a-1", model=counter.CounterModel())
+        result = await ref.send_command(counter.Increment("a-1"))
+        assert isinstance(result, CommandSuccess) and result.state.count == 1
+
+    Canned behavior for unhappy paths:
+
+    - ``ref.reply_with(result)`` — queue an exact reply for the next
+      ``send_command`` (e.g. ``CommandFailure(TimeoutError())`` to test your
+      service's retry path);
+    - ``ref.fail_with(exc)`` — shorthand for ``reply_with(CommandFailure(exc))``.
+
+    Every command/events batch is recorded on ``.commands`` / ``.applied`` for
+    assertions, mirroring what a TestProbe would capture.
+    """
+
+    def __init__(self, aggregate_id: str, model: Any = None,
+                 state: Any = None,
+                 states: Optional[Dict[str, Any]] = None,
+                 journal: Optional[List[Any]] = None) -> None:
+        self.aggregate_id = aggregate_id
+        self.model = model
+        #: shared map when built via StubEngine; private map otherwise
+        self._states: Dict[str, Any] = states if states is not None else {}
+        #: shared cross-aggregate command journal (StubEngine.commands)
+        self._journal = journal
+        if state is not None:
+            self._states[aggregate_id] = state
+        elif aggregate_id not in self._states and model is not None:
+            init = getattr(model, "initial_state", None)
+            self._states[aggregate_id] = init(aggregate_id) if init else None
+        self.commands: List[Any] = []
+        self.applied: List[Sequence[Any]] = []
+        self._canned: List[Any] = []
+
+    # -- canned behavior ------------------------------------------------------------
+
+    def reply_with(self, result: Any) -> "StubAggregateRef":
+        """Queue an exact reply consumed by the NEXT call on this ref —
+        ``send_command``, ``apply_events``, or ``get_state`` share one queue
+        (a ``CommandFailure`` popped by ``get_state`` raises its error, like
+        the real ref)."""
+        self._canned.append(result)
+        return self
+
+    def fail_with(self, exc: Exception) -> "StubAggregateRef":
+        return self.reply_with(CommandFailure(exc))
+
+    # -- state accessors ------------------------------------------------------------
+
+    @property
+    def state(self) -> Any:
+        return self._states.get(self.aggregate_id)
+
+    @state.setter
+    def state(self, value: Any) -> None:
+        self._states[self.aggregate_id] = value
+
+    # -- AggregateRef surface ---------------------------------------------------------
+
+    async def send_command(self, command: Any):
+        self.commands.append(command)
+        if self._journal is not None:
+            self._journal.append(command)
+        if self._canned:
+            return self._canned.pop(0)
+        if self.model is None:
+            return CommandFailure(RuntimeError(
+                f"StubAggregateRef({self.aggregate_id!r}) has no model and no "
+                "canned reply — pass model= or call reply_with()"))
+        # mirror the REAL entity's semantics exactly (engine/entity.py
+        # _process_command): RejectedCommand -> CommandRejected, any other
+        # user-code exception -> CommandFailure, awaitable results awaited
+        # (async models), and the same fold (incl. batch handle_events).
+        import inspect
+
+        from surge_tpu.engine.model import RejectedCommand
+
+        try:
+            result = self.model.process_command(self.state, command)
+            if inspect.isawaitable(result):
+                result = await result
+            events = list(result)
+        except RejectedCommand as rej:
+            return CommandRejected(rej)
+        except Exception as exc:  # noqa: BLE001 — the failure path under test
+            return CommandFailure(exc)
+        return await self._fold(events)
+
+    async def apply_events(self, events: Sequence[Any]):
+        events = list(events)
+        self.applied.append(events)
+        if self._canned:
+            return self._canned.pop(0)
+        if self.model is None:
+            return CommandFailure(RuntimeError(
+                f"StubAggregateRef({self.aggregate_id!r}) has no model and no "
+                "canned reply — pass model= or call reply_with()"))
+        return await self._fold(events)
+
+    async def _fold(self, events: Sequence[Any]):
+        import inspect
+
+        try:
+            new_state = fold_events(self.model, self.state, events)
+            if inspect.isawaitable(new_state):
+                new_state = await new_state
+        except Exception as exc:  # noqa: BLE001 — the failure path under test
+            return CommandFailure(exc)
+        self.state = new_state
+        return CommandSuccess(new_state)
+
+    async def get_state(self) -> Optional[Any]:
+        if self._canned:
+            result = self._canned.pop(0)
+            if isinstance(result, CommandFailure):
+                raise result.error
+            return result
+        return self.state
+
+
+class StubEngine:
+    """``aggregate_for``-compatible engine double: one shared state map, one
+    :class:`StubAggregateRef` per aggregate id (stable across calls), and a
+    flat command journal across all aggregates for assertions.
+
+    ``seed_state({"a-1": State(...)})`` pre-loads aggregates; ``ref_factory``
+    swaps in a custom stub subclass.
+    """
+
+    def __init__(self, model: Any = None,
+                 ref_factory: Callable[..., StubAggregateRef] | None = None
+                 ) -> None:
+        self.model = model
+        self.states: Dict[str, Any] = {}
+        self.commands: List[Any] = []  # cross-aggregate, in send order
+        self._refs: Dict[str, StubAggregateRef] = {}
+        self._ref_factory = ref_factory or StubAggregateRef
+
+    def seed_state(self, states: Dict[str, Any]) -> "StubEngine":
+        self.states.update(states)
+        return self
+
+    def aggregate_for(self, aggregate_id: str) -> StubAggregateRef:
+        ref = self._refs.get(aggregate_id)
+        if ref is None:
+            ref = self._ref_factory(aggregate_id, model=self.model,
+                                    states=self.states,
+                                    journal=self.commands)
+            self._refs[aggregate_id] = ref
+        return ref
+
+    # the lifecycle surface service code may touch — no-ops on the stub
+    async def start(self) -> None:
+        return None
+
+    async def stop(self) -> None:
+        return None

@@ -23,7 +23,7 @@ BLOCK_RE = re.compile(r"```python\n(.*?)```", re.DOTALL)
 # files whose python blocks are full programs (the rest are prose-only or
 # intentionally fragmentary, filtered by the `...` rule anyway)
 EXECUTABLE_DOCS = ["getting-started.md", "replay.md", "event-engine.md",
-                   "multilanguage.md"]
+                   "multilanguage.md", "testing.md"]
 
 
 def extract_blocks(name: str) -> list:

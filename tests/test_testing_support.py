@@ -1,0 +1,146 @@
+"""surge_tpu.testing — the user-facing engine doubles (SURVEY §4 item 8: the
+reference's documented mockable-engine pattern)."""
+
+import asyncio
+
+import pytest
+
+from surge_tpu.engine.entity import (
+    CommandFailure,
+    CommandRejected,
+    CommandSuccess,
+)
+from surge_tpu.models import counter
+from surge_tpu.testing import StubAggregateRef, StubEngine
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_stub_ref_runs_real_model_logic():
+    ref = StubAggregateRef("a-1", model=counter.CounterModel())
+
+    async def scenario():
+        r1 = await ref.send_command(counter.Increment("a-1"))
+        r2 = await ref.send_command(counter.Decrement("a-1"))
+        return r1, r2
+
+    r1, r2 = run(scenario())
+    assert isinstance(r1, CommandSuccess) and r1.state.count == 1
+    assert isinstance(r2, CommandSuccess) and r2.state.count == 0
+    assert r2.state.version == 2
+    assert [type(c).__name__ for c in ref.commands] == ["Increment", "Decrement"]
+
+
+def test_stub_ref_rejection_surfaces_like_real_engine():
+    ref = StubAggregateRef("a-1", model=counter.CounterModel())
+    r = run(ref.send_command(counter.FailCommandProcessing(
+        "a-1", RuntimeError("nope"))))
+    assert isinstance(r, CommandRejected)
+
+
+def test_canned_replies_and_fail_with():
+    ref = StubAggregateRef("a-1", model=counter.CounterModel())
+    ref.fail_with(TimeoutError("publish timeout"))
+
+    async def scenario():
+        first = await ref.send_command(counter.Increment("a-1"))
+        second = await ref.send_command(counter.Increment("a-1"))
+        return first, second
+
+    first, second = run(scenario())
+    assert isinstance(first, CommandFailure)
+    assert isinstance(first.error, TimeoutError)
+    assert isinstance(second, CommandSuccess)  # canned reply consumed
+    assert second.state.count == 1  # the failed call did not mutate state
+
+
+def test_stub_ref_without_model_demands_canned_reply():
+    ref = StubAggregateRef("a-1")
+    r = run(ref.send_command(counter.Increment("a-1")))
+    assert isinstance(r, CommandFailure)
+    assert "no model" in str(r.error)
+
+
+def test_apply_events_and_get_state():
+    ref = StubAggregateRef("a-1", model=counter.CounterModel())
+
+    async def scenario():
+        r = await ref.apply_events(
+            [counter.CountIncremented("a-1", 3, 1)])
+        st = await ref.get_state()
+        return r, st
+
+    r, st = run(scenario())
+    assert isinstance(r, CommandSuccess) and st.count == 3
+    assert ref.applied and len(ref.applied[0]) == 1
+
+    # canned get_state failure raises, like the real ref
+    ref.reply_with(CommandFailure(ConnectionError("down")))
+    with pytest.raises(ConnectionError):
+        run(ref.get_state())
+
+
+def test_stub_engine_shares_state_and_journals_commands():
+    engine = StubEngine(model=counter.CounterModel())
+    engine.seed_state({"warm": counter.State("warm", count=7, version=3)})
+
+    async def scenario():
+        assert (await engine.aggregate_for("warm").get_state()).count == 7
+        await engine.aggregate_for("a").send_command(counter.Increment("a"))
+        await engine.aggregate_for("b").send_command(counter.Increment("b"))
+        await engine.aggregate_for("a").send_command(counter.Increment("a"))
+        await engine.start()  # lifecycle no-ops exist for service code
+        await engine.stop()
+
+    run(scenario())
+    # the same ref instance is returned per id, state survives across calls
+    assert engine.aggregate_for("a").state.count == 2
+    assert engine.states["b"].count == 1
+    # cross-aggregate journal preserves SEND order
+    assert [(type(c).__name__, c.aggregate_id) for c in engine.commands] == [
+        ("Increment", "a"), ("Increment", "b"), ("Increment", "a")]
+
+
+def test_stub_matches_real_entity_error_semantics():
+    """Parity with engine/entity.py: RejectedCommand -> CommandRejected; any
+    OTHER process_command exception -> CommandFailure (a stub that mapped all
+    exceptions to rejection would green-light the wrong service branch)."""
+
+    class BuggyModel:
+        def initial_state(self, agg_id):
+            return None
+
+        def process_command(self, state, command):
+            raise RuntimeError("infra bug, not a domain rejection")
+
+        def handle_event(self, state, event):
+            return state
+
+    r = run(StubAggregateRef("a", model=BuggyModel()).send_command("cmd"))
+    assert isinstance(r, CommandFailure) and not isinstance(r, CommandRejected)
+
+
+def test_stub_supports_async_models():
+    """Async process_command (the multilanguage-bridge model shape) is awaited
+    inline, like the real single-writer entity."""
+
+    class AsyncCounter:
+        def initial_state(self, agg_id):
+            return 0
+
+        async def process_command(self, state, command):
+            return [command]
+
+        def handle_event(self, state, event):
+            return state + event
+
+    ref = StubAggregateRef("a", model=AsyncCounter())
+
+    async def scenario():
+        await ref.send_command(5)
+        return await ref.send_command(2)
+
+    r = run(scenario())
+    assert isinstance(r, CommandSuccess) and r.state == 7
