@@ -722,16 +722,35 @@ class ReplayEngine:
         and guard-pad the corpus into its device wire form. The result is pure
         numpy and :meth:`ResidentWire.save`-able — a log segment built once can
         be mmapped and uploaded on every later cold start without re-packing
-        (the pack is one-time work, like the reference's log compaction)."""
+        (the pack is one-time work, like the reference's log compaction).
+
+        Fast path: an input whose events are already GROUPED per aggregate
+        (``agg_idx`` non-decreasing — every encode/segment path produces this)
+        is packed in ITS OWN event order and lanes point at their segments by
+        indirection (``starts[k] = start of aggregate perm[k]``). Nothing in
+        the device fold requires lane slabs to be buffer-contiguous — each
+        tile gathers from per-lane bases — so the 100M-event stable sort plus
+        three full-column gathers the old path paid (~17 s of a ~26 s pack at
+        bench scale) disappear; only the O(B) length argsort remains."""
         b = colev.num_aggregates
-        lengths = np.bincount(colev.agg_idx, minlength=b).astype(np.int64)
+        agg = np.asarray(colev.agg_idx)
+        lengths = np.bincount(agg, minlength=b).astype(np.int64)
         if self.sort_by_length and b > 1:
             # DESCENDING by length: the lanes still active after t events form a
             # prefix, so each tile round dispatches a contiguous lane range
             perm = np.argsort(-lengths, kind="stable").astype(np.int32)
             if np.array_equal(perm, np.arange(b, dtype=np.int32)):
                 perm = None
-            else:
+        else:
+            perm = None
+
+        grouped = bool((np.diff(agg) >= 0).all()) if agg.size > 1 else True
+        if grouped:
+            to_pack = colev
+        else:
+            # ungrouped input: materialize the sorted order (rare —
+            # interleaved hand-built columns); lanes end up buffer-contiguous
+            if perm is not None:
                 inv = np.empty_like(perm)
                 inv[perm] = np.arange(b, dtype=np.int32)
                 colev = ColumnarEvents(
@@ -739,12 +758,11 @@ class ReplayEngine:
                     type_ids=colev.type_ids, cols=colev.cols,
                     derived_cols=dict(colev.derived_cols))
                 lengths = lengths[perm]
-        else:
-            perm = None
-        sorted_ev = colev.sorted_by_aggregate()
-        wire = WireFormat(self.spec.registry, dict(sorted_ev.derived_cols))
+            to_pack = colev.sorted_by_aggregate()
+
+        wire = WireFormat(self.spec.registry, dict(to_pack.derived_cols))
         t0 = time.perf_counter()
-        packed, side_flat = wire.pack_flat(sorted_ev.type_ids, sorted_ev.cols)
+        packed, side_flat = wire.pack_flat(to_pack.type_ids, to_pack.cols)
         # tail padding so every [start + t_base, width) slab slice stays in
         # bounds without clamping (clamped slices would shift lane data);
         # content is irrelevant — slots past lens decode to the pad sentinel
@@ -752,13 +770,20 @@ class ReplayEngine:
         packed = np.pad(packed, ((0, guard), (0, 0)))
         side_flat = {k: np.pad(v, (0, guard)) for k, v in side_flat.items()}
         self.stats["pack_s"] += time.perf_counter() - t0
+        # lengths/starts are in the PACKED stream's aggregate-id order; the
+        # grouped path then permutes the lane VIEW only (indirection), the
+        # ungrouped path already permuted the stream itself
         starts = np.zeros(b + 1, dtype=np.int64)
         np.cumsum(lengths, out=starts[1:])
+        starts_lane, lens_lane = starts[:-1], lengths
+        if grouped and perm is not None:
+            starts_lane = starts_lane[perm]
+            lens_lane = lengths[perm]
         return ResidentWire(
-            derived_key=dict(sorted_ev.derived_cols), packed=packed,
-            side=side_flat, starts=starts[:-1].astype(np.int32),
-            lengths=lengths.astype(np.int32), perm=perm, guard=guard,
-            num_events=sorted_ev.num_events,
+            derived_key=dict(to_pack.derived_cols), packed=packed,
+            side=side_flat, starts=starts_lane.astype(np.int32),
+            lengths=lens_lane.astype(np.int32), perm=perm, guard=guard,
+            num_events=to_pack.num_events,
             layout=wire.layout_fingerprint())
 
     def check_wire(self, w: "ResidentWire") -> WireFormat:
@@ -1051,8 +1076,14 @@ class ReplayEngine:
         transfers with compute the fold of earlier segments hides later
         segments' uploads — and on backends that don't, nothing is lost but
         per-segment overhead. Segments split at event-count boundaries
-        (balanced bytes); lanes stay contiguous, so each piece is a zero-copy
-        slice of the wire. Results are in the original aggregate order.
+        (balanced bytes) and each piece is a zero-copy contiguous slice of the
+        buffer: for a contiguous wire the piece's lanes are a lane RANGE; for
+        an indirect wire (the grouped-input fast pack, whose lane slabs tile
+        the buffer in buffer order, not lane order) the piece's lanes are the
+        subset whose slabs fall in the slice, re-sorted desc for the tile
+        plan. A wire whose slabs do not tile its buffer at all (hand-built
+        subset/overlap) falls back to the plain single-upload path. Results
+        are in the original aggregate order either way.
 
         ``segments`` defaults to ``surge.replay.upload-stream-segments``
         (0/1 = plain upload+replay)."""
@@ -1067,47 +1098,95 @@ class ReplayEngine:
         self.check_wire(w)
         perm = w.perm
         init_sorted, ord_sorted = _apply_perm(perm, init_carry, ordinal_base)
+        state_fields = self.spec.registry.state.fields
 
-        starts = np.zeros(b + 1, dtype=np.int64)
-        np.cumsum(w.lengths.astype(np.int64), out=starts[1:])
-        total = int(starts[-1])
-        # lane boundaries at ~equal event counts (lanes sorted desc, so early
-        # segments carry the long logs)
+        starts64 = w.starts.astype(np.int64)
+        lens64 = w.lengths.astype(np.int64)
+        cum = np.zeros(b + 1, dtype=np.int64)
+        np.cumsum(lens64, out=cum[1:])
+        total = int(cum[-1])
+        contiguous = np.array_equal(starts64, cum[:-1])
+        zero_lanes = np.array([], dtype=np.int64)
+        if contiguous:
+            # lanes tile the buffer in lane order: pieces are lane ranges
+            lane_order = None
+            piece_starts = cum
+            n_lanes = b
+        else:
+            # indirect wire (grouped-input fast pack): lane slabs tile the
+            # buffer in BUFFER order, not lane order — walk the NONZERO lanes
+            # by start so each piece is still one zero-copy contiguous slice.
+            # Zero-length lanes occupy no rows (their start is wherever the
+            # next slab begins), so they are excluded from the tiling walk and
+            # tacked onto the first piece, whose plan skips them.
+            nz = np.nonzero(lens64 > 0)[0]
+            zero_lanes = np.nonzero(lens64 == 0)[0]
+            if nz.size == 0:
+                return self.replay_resident(self.upload_resident(w),
+                                            init_carry=init_carry,
+                                            ordinal_base=ordinal_base)
+            lane_order = nz[np.argsort(starts64[nz], kind="stable")]
+            piece_starts = np.zeros(lane_order.size + 1, dtype=np.int64)
+            np.cumsum(lens64[lane_order], out=piece_starts[1:])
+            if not np.array_equal(starts64[lane_order], piece_starts[:-1]):
+                # slabs don't tile the buffer (subset/overlapping wire):
+                # stream piecewise is meaningless — plain path
+                return self.replay_resident(self.upload_resident(w),
+                                            init_carry=init_carry,
+                                            ordinal_base=ordinal_base)
+            n_lanes = lane_order.size
+
+        # piece boundaries at ~equal event counts
         bounds = [0]
         for s in range(1, segments):
-            cut = int(np.searchsorted(starts, total * s // segments))
-            bounds.append(min(max(cut, bounds[-1]), b))
-        bounds.append(b)
+            cut = int(np.searchsorted(piece_starts, total * s // segments))
+            bounds.append(min(max(cut, bounds[-1]), n_lanes))
+        bounds.append(n_lanes)
 
-        state_fields = self.spec.registry.state.fields
         pieces: list = []
         padded = 0
+        first_piece = True
         for lo, hi in zip(bounds[:-1], bounds[1:]):
             if hi <= lo:
                 continue
-            base = int(starts[lo])
-            end = int(starts[hi])
+            base = int(piece_starts[lo])
+            end = int(piece_starts[hi])
+            if lane_order is None:
+                lanes = np.arange(lo, hi)
+                sub_starts = starts64[lo:hi] - base
+                sub_lens = w.lengths[lo:hi]
+            else:
+                lanes = lane_order[lo:hi]
+                if first_piece and zero_lanes.size:
+                    lanes = np.concatenate([lanes, zero_lanes])
+                # piece-local DESC length order so the tile plan keeps its
+                # shrinking-prefix schedule (zero lanes sort last, fold no-op)
+                lanes = lanes[np.argsort(-lens64[lanes], kind="stable")]
+                sub_starts = np.where(lens64[lanes] > 0,
+                                      starts64[lanes] - base, 0)
+                sub_lens = w.lengths[lanes]
+            first_piece = False
             sub = ResidentWire(
                 derived_key=dict(w.derived_key),
                 packed=w.packed[base: end + w.guard],
                 side={k: v[base: end + w.guard] for k, v in w.side.items()},
-                starts=(w.starts[lo:hi].astype(np.int64) - base).astype(np.int32),
-                lengths=w.lengths[lo:hi], perm=None, guard=w.guard,
+                starts=sub_starts.astype(np.int32),
+                lengths=sub_lens, perm=None, guard=w.guard,
                 num_events=end - base, layout=w.layout)
             piece = self.upload_resident(sub)  # upload initiates...
             slab, pad = self._dispatch_resident(
                 piece,
                 None if init_sorted is None else
-                {k: v[lo:hi] for k, v in init_sorted.items()},
-                None if ord_sorted is None else ord_sorted[lo:hi])
+                {k: v[lanes] for k, v in init_sorted.items()},
+                None if ord_sorted is None else ord_sorted[lanes])
             padded += pad
-            pieces.append((lo, hi, slab))  # ...fold dispatched, NOT synced
+            pieces.append((lanes, slab))  # ...fold dispatched, NOT synced
         # one sync pass over every piece, then global unsort
         out_sorted = {f.name: np.empty((b,), dtype=f.dtype)
                       for f in state_fields}
-        for lo, hi, slab in pieces:
+        for lanes, slab in pieces:
             for name, col in slab.items():
-                out_sorted[name][lo:hi] = np.asarray(col)[: hi - lo]
+                out_sorted[name][lanes] = np.asarray(col)[: lanes.shape[0]]
         return ReplayResult(states=_unapply_perm(perm, out_sorted),
                             num_aggregates=b,
                             num_events=w.num_events, padded_events=padded)

@@ -627,3 +627,98 @@ def test_resume_with_derived_ordinals_continues_sequence():
                                 ordinal_base=first_len.astype(np.int32))
     assert np.array_equal(r2.states["count"], corpus.expected_count)
     assert np.array_equal(r2.states["version"], corpus.expected_version)
+
+
+def test_grouped_pack_is_indirect_and_exact_everywhere():
+    """A grouped-input corpus (every encode path produces one) packs WITHOUT
+    the 100M-event sort: the buffer keeps input order and lanes point at
+    their segments by indirection. Every consumer of the wire — plain
+    resident, streamed pieces, save/load round-trip, sharded mesh deal —
+    must agree with the closed form on such a wire."""
+    from surge_tpu.replay.corpus import synth_counter_corpus
+    from surge_tpu.replay.engine import ResidentWire
+
+    corpus = synth_counter_corpus(900, 40_000, seed=77)
+    cfg = Config(overrides={"surge.replay.batch-size": 128,
+                            "surge.replay.time-chunk": 32,
+                            "surge.replay.resident-len-bucket": "exact"})
+    eng = ReplayEngine(counter.make_replay_spec(), config=cfg)
+    wire = eng.pack_resident(corpus.events)
+    # the fast path really triggered: lanes are length-sorted but the buffer
+    # is not lane-ordered
+    assert wire.perm is not None
+    cum = np.zeros(wire.lengths.shape[0], dtype=np.int64)
+    np.cumsum(wire.lengths[:-1].astype(np.int64), out=cum[1:])
+    assert not np.array_equal(wire.starts.astype(np.int64), cum)
+
+    plain = eng.replay_resident(eng.upload_resident(wire))
+    np.testing.assert_array_equal(plain.states["count"], corpus.expected_count)
+    np.testing.assert_array_equal(plain.states["version"],
+                                  corpus.expected_version)
+
+    for segments in (2, 5):
+        st = eng.replay_resident_streamed(wire, segments=segments)
+        for name in plain.states:
+            np.testing.assert_array_equal(st.states[name], plain.states[name],
+                                          err_msg=f"segments={segments}")
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        wire.save(f"{tmp}/w")
+        loaded = ResidentWire.load(f"{tmp}/w")
+        res = eng.replay_resident(eng.upload_resident(loaded))
+        np.testing.assert_array_equal(res.states["count"],
+                                      corpus.expected_count)
+
+    # the sharded mesh deal gathers per-lane slabs straight from the indirect
+    # starts (resident_mesh host-side re-pack)
+    import jax
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("data",))
+    meng = ReplayEngine(counter.make_replay_spec(), config=cfg, mesh=mesh)
+    sharded = meng.prepare_resident_sharded(wire)
+    sres = meng.replay_resident_sharded(sharded)
+    np.testing.assert_array_equal(sres.states["count"], corpus.expected_count)
+    np.testing.assert_array_equal(sres.states["version"],
+                                  corpus.expected_version)
+
+
+def test_streamed_indirect_wire_with_empty_aggregates():
+    """Zero-length lanes occupy no buffer rows: the indirect streamed path
+    must still stream (not silently fall back) and return their init state."""
+    rng = np.random.default_rng(5)
+    b, n = 60, 6000
+    # aggregate 7, 23, 40 have NO events; others grouped ascending
+    live = np.array([a for a in range(b) if a not in (7, 23, 40)])
+    agg_idx = np.sort(rng.choice(live, size=n)).astype(np.int32)
+    type_ids = rng.integers(0, 2, size=n).astype(np.int32)
+    inc = np.where(type_ids == 0, 1, 0).astype(np.int32)
+    dec = np.where(type_ids == 1, 1, 0).astype(np.int32)
+    colev = ColumnarEvents(
+        num_aggregates=b, agg_idx=agg_idx, type_ids=type_ids,
+        cols={"increment_by": inc, "decrement_by": dec},
+        derived_cols={"sequence_number": "ordinal"})
+    eng = ReplayEngine(counter.make_replay_spec(), config=Config(overrides={
+        "surge.replay.batch-size": 16, "surge.replay.time-chunk": 16,
+        "surge.replay.resident-len-bucket": "exact"}))
+    wire = eng.pack_resident(colev)
+    assert int((wire.lengths == 0).sum()) == 3
+    plain = eng.replay_resident(eng.upload_resident(wire))
+    expected = (np.bincount(agg_idx, weights=inc, minlength=b)
+                - np.bincount(agg_idx, weights=dec, minlength=b)).astype(np.int32)
+    np.testing.assert_array_equal(plain.states["count"], expected)
+    import unittest.mock as mock
+
+    for segments in (2, 4):
+        # count piece uploads to prove the path really streamed instead of
+        # silently falling back to one plain upload
+        real_upload = ReplayEngine.upload_resident
+        with mock.patch.object(ReplayEngine, "upload_resident",
+                               autospec=True, side_effect=real_upload) as up:
+            st = eng.replay_resident_streamed(wire, segments=segments)
+        assert up.call_count == segments
+        np.testing.assert_array_equal(st.states["count"], expected,
+                                      err_msg=f"segments={segments}")
+        np.testing.assert_array_equal(st.states["version"],
+                                      plain.states["version"])
