@@ -131,7 +131,8 @@ class WireFormat:
         packed = np.empty((chunk, bs, self.nbytes), dtype=np.uint8)
         for k in range(self.nbytes):
             packed[..., k] = self.pad_bytes[k]
-            packed[:width, :b, k] = ((word >> np.uint32(8 * k)) & np.uint32(0xFF)).T
+            packed[:width, :b, k] = ((word >> np.asarray(8 * k, dtype=word.dtype))
+                                     & np.asarray(0xFF, dtype=word.dtype)).T
 
         side: dict[str, np.ndarray] = {}
         for f in self.side_fields:
@@ -147,9 +148,14 @@ class WireFormat:
         (the same contract make_step_fn keeps for the unpacked path); a corrupt
         id must never spill into field bits. Dtype-preserving range checks
         catch negatives and any value past each declared width."""
+        # narrowest word dtype that holds every packed bit: at bench scale the
+        # build streams N×4-byte intermediates per field, so a 1-byte wire
+        # (counter) building in uint8 moves a quarter of the memory
+        wdtype = (np.uint8 if self.nbytes == 1
+                  else np.uint16 if self.nbytes == 2 else np.uint32)
         tid = np.asarray(type_ids)
         word = np.where((tid < 0) | (tid >= self.num_types),
-                        self.pad_code, tid).astype(np.uint32)
+                        self.pad_code, tid).astype(wdtype)
         for pf in self.packed_fields:
             col = np.asarray(cols[pf.name])
             if col.size and ((col < 0) | (col > pf.mask)).any():
@@ -157,7 +163,8 @@ class WireFormat:
                     f"column {pf.name!r} overflows its declared {pf.bits}-bit "
                     f"wire width (max value {int(col.max())}, "
                     f"min {int(col.min())})")
-            word |= col.astype(np.uint32) << np.uint32(pf.shift)
+            word |= (col.astype(wdtype)
+                     << np.asarray(pf.shift, dtype=wdtype))
         return word
 
     def pack_flat(self, type_ids: np.ndarray, cols: Mapping[str, np.ndarray]
@@ -173,7 +180,8 @@ class WireFormat:
         n = word.shape[0]
         packed = np.empty((n, self.nbytes), dtype=np.uint8)
         for k in range(self.nbytes):
-            packed[:, k] = (word >> np.uint32(8 * k)) & np.uint32(0xFF)
+            packed[:, k] = ((word >> np.asarray(8 * k, dtype=word.dtype))
+                            & np.asarray(0xFF, dtype=word.dtype))
         side = {f.name: np.ascontiguousarray(cols[f.name], dtype=f.dtype)
                 for f in self.side_fields}
         return packed, side
