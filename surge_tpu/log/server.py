@@ -412,83 +412,128 @@ class LogServer:
         item; once it has caught up (operator-run catch_up — a ship stops
         reporting a gap), it re-joins the set. Records finalized while it was
         out are NOT re-queued: catch_up is the re-sync path, exactly like a
-        Kafka replica rejoining the ISR from the log, not the socket."""
+        Kafka replica rejoining the ISR from the log, not the socket.
+
+        The worker itself must be unkillable by a bug: an uncaught exception
+        here would end the thread silently and every later replicated commit
+        would time out retriable forever — so one iteration's failure logs
+        loudly, backs off, and the loop continues. A POISON head item (one
+        that deterministically raises) is failed after a bounded number of
+        strikes instead of livelocking the queue: its waiter gets a retriable
+        error, the queue drains past it, and if the skip leaves the follower
+        gappy the next ship's gap error drives the normal ISR-drop/catch_up
+        path — degraded loudly, never stuck silently."""
         backoff = 0.05
+        poison_item = None
+        strikes = 0
         while True:
-            with self._repl_cv:
-                while not self._repl_queue and not self._repl_stop:
-                    self._repl_cv.wait(0.5)
-                if self._repl_stop:
-                    return
-                item = self._repl_queue[0]
-            now = time.monotonic()
-            blocking_err = None
-            for target in self._repl_targets:
-                st = self._repl_target_state[target]
-                if st.in_sync:
-                    err = self._ship(target, item)
-                    if err is None:
-                        st.failing_since = None
-                        continue
-                    if st.failing_since is None:
-                        st.failing_since = now
-                    insync_after_drop = self._insync_count() - 1
-                    if (now - st.failing_since >= self._repl_isr_timeout_s
-                            and insync_after_drop >= self._repl_min_insync):
-                        st.in_sync = False
-                        st.next_probe = now + 1.0
-                        logger.error(
-                            "follower %s dropped from the in-sync set after "
-                            "%.0fs of failures (%s); commits proceed with "
-                            "%d/%d in-sync replicas — it must catch_up to "
-                            "re-join", target, now - st.failing_since, err,
-                            insync_after_drop, len(self._repl_targets) + 1)
-                    else:
-                        blocking_err = err
-                elif now >= st.next_probe:
-                    # short-timeout probe: verify the follower's log equals the
-                    # leader's end on EVERY partition (a record-less or
-                    # offset-0 ship succeeding proves nothing), then ship the
-                    # head item (idempotent if catch_up already pulled it)
-                    err = self._verify_caught_up(target)
-                    if err is None:
-                        err = self._ship(target, item, timeout=1.0)
-                    if err is None:
-                        st.in_sync = True
-                        st.failing_since = None
-                        logger.warning("follower %s re-joined the in-sync set",
-                                       target)
-                    else:
-                        # fresh clock, not the iteration's `now`: a slow probe
-                        # (blackholed peer) must not be due again immediately,
-                        # or every commit in degraded mode pays it
-                        st.next_probe = time.monotonic() + 1.0
-            if blocking_err is None:
-                # finalize BEFORE waking waiters: dedup cache advanced and the
-                # pending entry dropped even if no client ever retries the seq
-                if item.seq:
-                    dedup = self._txn_dedup.setdefault(item.txn_id, _TxnDedup())
-                    if item.seq > dedup.last_seq:
-                        # reply BEFORE seq: a lock-free reader that observes the
-                        # new last_seq must never see the previous reply
-                        dedup.last_reply = pb.TxnReply(
-                            ok=True,
-                            records=[record_to_msg(r) for r in item.records])
-                        dedup.last_seq = item.seq
-                    self._repl_pending.pop((item.txn_id, item.seq), None)
-                item.error = None
-                # pop BEFORE waking the waiter: a client that gets its commit
-                # reply and immediately asks ReplicationStatus must not see
-                # its own finalized item still counted in queue_depth
+            try:
+                backoff = self._replication_iteration(backoff)
+                poison_item, strikes = None, 0
+            except Exception:  # noqa: BLE001 — the worker must never die
+                logger.exception(
+                    "replication worker iteration failed; continuing")
                 with self._repl_cv:
-                    self._repl_queue.pop(0)
-                item.done.set()
-                backoff = 0.05
-            else:
-                item.error = blocking_err  # visible to a waiter that times out
-                logger.warning("replication attempt failed: %s", blocking_err)
-                time.sleep(backoff)
+                    head = self._repl_queue[0] if self._repl_queue else None
+                if head is not None and head is poison_item:
+                    strikes += 1
+                else:
+                    poison_item, strikes = head, 1
+                if head is not None and strikes >= 20:
+                    logger.error(
+                        "replication head item poisoned (%d consecutive "
+                        "worker exceptions); failing it past the queue — a "
+                        "gappy follower will drop from the in-sync set and "
+                        "needs catch_up", strikes)
+                    with self._repl_cv:
+                        if self._repl_queue and self._repl_queue[0] is head:
+                            self._repl_queue.pop(0)
+                    self._repl_pending.pop((head.txn_id, head.seq), None)
+                    head.error = ("poisoned: repeated replication worker "
+                                  "exceptions (see broker log)")
+                    head.done.set()
+                    poison_item, strikes = None, 0
+                time.sleep(min(backoff, 1.0))
                 backoff = min(backoff * 2, 1.0)
+            if self._repl_stop:
+                return
+
+    def _replication_iteration(self, backoff: float) -> float:
+        """One wait-for-head-item attempt; returns the next backoff (the
+        outer loop repeats and owns the stop check)."""
+        with self._repl_cv:
+            while not self._repl_queue and not self._repl_stop:
+                self._repl_cv.wait(0.5)
+            if self._repl_stop:
+                return backoff
+            item = self._repl_queue[0]
+        now = time.monotonic()
+        blocking_err = None
+        for target in self._repl_targets:
+            st = self._repl_target_state[target]
+            if st.in_sync:
+                err = self._ship(target, item)
+                if err is None:
+                    st.failing_since = None
+                    continue
+                if st.failing_since is None:
+                    st.failing_since = now
+                insync_after_drop = self._insync_count() - 1
+                if (now - st.failing_since >= self._repl_isr_timeout_s
+                        and insync_after_drop >= self._repl_min_insync):
+                    st.in_sync = False
+                    st.next_probe = now + 1.0
+                    logger.error(
+                        "follower %s dropped from the in-sync set after "
+                        "%.0fs of failures (%s); commits proceed with "
+                        "%d/%d in-sync replicas — it must catch_up to "
+                        "re-join", target, now - st.failing_since, err,
+                        insync_after_drop, len(self._repl_targets) + 1)
+                else:
+                    blocking_err = err
+            elif now >= st.next_probe:
+                # short-timeout probe: verify the follower's log equals the
+                # leader's end on EVERY partition (a record-less or
+                # offset-0 ship succeeding proves nothing), then ship the
+                # head item (idempotent if catch_up already pulled it)
+                err = self._verify_caught_up(target)
+                if err is None:
+                    err = self._ship(target, item, timeout=1.0)
+                if err is None:
+                    st.in_sync = True
+                    st.failing_since = None
+                    logger.warning("follower %s re-joined the in-sync set",
+                                   target)
+                else:
+                    # fresh clock, not the iteration's `now`: a slow probe
+                    # (blackholed peer) must not be due again immediately,
+                    # or every commit in degraded mode pays it
+                    st.next_probe = time.monotonic() + 1.0
+        if blocking_err is None:
+            # finalize BEFORE waking waiters: dedup cache advanced and the
+            # pending entry dropped even if no client ever retries the seq
+            if item.seq:
+                dedup = self._txn_dedup.setdefault(item.txn_id, _TxnDedup())
+                if item.seq > dedup.last_seq:
+                    # reply BEFORE seq: a lock-free reader that observes the
+                    # new last_seq must never see the previous reply
+                    dedup.last_reply = pb.TxnReply(
+                        ok=True,
+                        records=[record_to_msg(r) for r in item.records])
+                    dedup.last_seq = item.seq
+                self._repl_pending.pop((item.txn_id, item.seq), None)
+            item.error = None
+            # pop BEFORE waking the waiter: a client that gets its commit
+            # reply and immediately asks ReplicationStatus must not see
+            # its own finalized item still counted in queue_depth
+            with self._repl_cv:
+                self._repl_queue.pop(0)
+            item.done.set()
+            return 0.05
+        item.error = blocking_err  # visible to a waiter that times out
+        logger.warning("replication attempt failed: %s", blocking_err)
+        time.sleep(backoff)
+        return min(backoff * 2, 1.0)
 
     def _verify_caught_up(self, target: str) -> Optional[str]:
         """An out-of-sync follower may only re-join once its log matches the
