@@ -103,6 +103,13 @@ DEFAULTS: dict[str, Any] = {
     # commits until it returns, the pre-r5 behavior).
     "surge.log.replication-min-insync": 1,
     "surge.log.replication-isr-timeout-ms": 10_000,
+    # rejoin under live traffic: an out-of-sync follower lagging by at most
+    # this many records is re-synced BY THE LEADER (missing suffix pushed
+    # through the ordered Replicate stream + dedup table) during its probe —
+    # a one-shot operator catch_up can never converge while commits keep
+    # landing. Beyond the cap (fresh/empty replicas) the follower stays out
+    # until catch_up bulk-copies it. 0 disables auto-resync.
+    "surge.log.replication-auto-resync-max-records": 10_000,
     # --- health (common reference.conf:228-260) ---
     "surge.health.window-frequency-ms": 10_000,
     "surge.health.window-buffer-size": 10,

@@ -110,6 +110,7 @@ METHODS = {
     "WaitForAppend": (pb.WaitRequest, pb.WaitReply),
     "Replicate": (pb.ReplicateRequest, pb.ReplicateReply),
     "DedupSnapshot": (pb.DedupSnapshotRequest, pb.DedupSnapshotReply),
+    "ApplyDedup": (pb.ApplyDedupRequest, pb.ReplicateReply),
     "ReplicationStatus": (pb.ReplicationStatusRequest,
                           pb.ReplicationStatusReply),
 }
@@ -189,9 +190,13 @@ class LogServer:
             "surge.log.replication-min-insync", 1)
         self._repl_isr_timeout_s = cfg.get_seconds(
             "surge.log.replication-isr-timeout-ms", 10_000)
+        self._repl_auto_resync_cap = cfg.get_int(
+            "surge.log.replication-auto-resync-max-records", 10_000)
         self._repl_target_state: Dict[str, _TargetState] = {
             t: _TargetState() for t in self._repl_targets}
-        self._probe_calls: Dict[str, object] = {}  # rejoin-probe stubs by target
+        # rejoin-probe transport: ONE cached channel per target, stubs derived
+        self._probe_channels: Dict[str, object] = {}
+        self._probe_stubs: Dict[tuple, object] = {}
         # -- replication (follower side): ordered ingest of leader batches
         self._replica_lock = threading.Lock()
         self._replica_producer = None
@@ -408,11 +413,12 @@ class LogServer:
         the isr-timeout is dropped from the in-sync set — provided the set
         stays >= min-insync — so the queue drains and commits ack without it
         instead of livelocking retriable forever (VERDICT r4 missing #5). An
-        out-of-sync follower is probed at most once a second with the head
-        item; once it has caught up (operator-run catch_up — a ship stops
-        reporting a gap), it re-joins the set. Records finalized while it was
-        out are NOT re-queued: catch_up is the re-sync path, exactly like a
-        Kafka replica rejoining the ISR from the log, not the socket.
+        out-of-sync follower is probed at most once a second: the leader
+        pushes any small lag itself (auto-resync — records finalized while
+        the follower was out, plus the dedup table; the Kafka replica
+        fetch-loop role) and re-admits the follower once it is a complete
+        prefix net of the queue. Beyond the auto-resync cap the follower
+        stays out until an operator catch_up bulk-copies it.
 
         The worker itself must be unkillable by a bug: an uncaught exception
         here would end the thread silently and every later replicated commit
@@ -492,11 +498,12 @@ class LogServer:
                 else:
                     blocking_err = err
             elif now >= st.next_probe:
-                # short-timeout probe: verify the follower's log equals the
-                # leader's end on EVERY partition (a record-less or
-                # offset-0 ship succeeding proves nothing), then ship the
-                # head item (idempotent if catch_up already pulled it)
-                err = self._verify_caught_up(target)
+                # budgeted probe: push any small lag (auto-resync — a
+                # one-shot catch_up can never converge under live traffic);
+                # returning None proves the follower is a complete prefix net
+                # of the queue, then the head item ships (idempotent if
+                # already delivered)
+                err = self._resync_follower(target)
                 if err is None:
                     err = self._ship(target, item, timeout=1.0)
                 if err is None:
@@ -505,6 +512,11 @@ class LogServer:
                     logger.warning("follower %s re-joined the in-sync set",
                                    target)
                 else:
+                    # operators need the remedy the leader is demanding
+                    # ("run catch_up" / "wipe and catch_up"): log it — the
+                    # probe interval rate-limits this to ~1/s per target
+                    logger.warning("follower %s rejoin probe: %s", target,
+                                   err)
                     # fresh clock, not the iteration's `now`: a slow probe
                     # (blackholed peer) must not be due again immediately,
                     # or every commit in degraded mode pays it
@@ -535,45 +547,161 @@ class LogServer:
         time.sleep(backoff)
         return min(backoff * 2, 1.0)
 
-    def _verify_caught_up(self, target: str) -> Optional[str]:
-        """An out-of-sync follower may only re-join once its log matches the
-        leader's current end offset on EVERY topic-partition — i.e. after a
-        catch_up pulled everything it missed. Probing with the head item alone
-        would false-rejoin on record-less topic creates or a fresh topic's
-        offset-0 batch, and each false rejoin would block commits for another
-        isr-timeout until the gap re-dropped it.
-
-        Records still sitting in the replication queue (the head item
-        included — commits apply locally BEFORE they enqueue) are subtracted
-        from the leader's end: the follower cannot have them yet, and the
-        ordered gap-checked ships deliver them right after the re-join. A
-        commit racing this snapshot just fails the probe; the next one
-        settles."""
-        from surge_tpu.remote.security import secure_sync_channel
-
+    def _queued_counts(self) -> Dict[tuple, int]:
+        """(topic, partition) -> records still in the replication queue (the
+        head item included — commits apply locally BEFORE they enqueue)."""
         with self._repl_cv:
             queued: Dict[tuple, int] = {}
             for it in self._repl_queue:
                 for r in it.records:
                     tp = (r.topic, r.partition)
                     queued[tp] = queued.get(tp, 0) + 1
-        deadline = time.monotonic() + 2.0  # budget: probes run in the worker
-        try:
-            call = self._probe_calls.get(target)
-            if call is None:
+        return queued
+
+    def _probe_stub(self, target: str, method: str, req_cls, reply_cls):
+        stub = self._probe_stubs.get((target, method))
+        if stub is None:
+            channel = self._probe_channels.get(target)
+            if channel is None:
+                from surge_tpu.remote.security import secure_sync_channel
+
                 channel = secure_sync_channel(target, self._config)
-                call = channel.unary_unary(
-                    f"/{SERVICE}/EndOffset",
-                    request_serializer=pb.OffsetRequest.SerializeToString,
-                    response_deserializer=pb.OffsetReply.FromString)
-                self._probe_calls[target] = call
+                self._probe_channels[target] = channel
+            stub = channel.unary_unary(
+                f"/{SERVICE}/{method}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=reply_cls.FromString)
+            self._probe_stubs[(target, method)] = stub
+        return stub
+
+    def _drop_probe_transport(self, target: str) -> None:
+        channel = self._probe_channels.pop(target, None)
+        if channel is not None:
+            try:
+                channel.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+        for key in [k for k in self._probe_stubs if k[0] == target]:
+            self._probe_stubs.pop(key, None)
+
+    def _remote_end_offset(self, target: str, topic: str, p: int) -> int:
+        return self._probe_stub(target, "EndOffset", pb.OffsetRequest,
+                                pb.OffsetReply)(
+            pb.OffsetRequest(topic=topic, partition=p),
+            timeout=1.0).end_offset
+
+    def _resync_follower(self, target: str,
+                         deadline: Optional[float] = None) -> Optional[str]:
+        """Leader-driven re-sync of a SMALL lag (the Kafka replica fetch
+        loop's role): push the follower's missing suffix through the ordered
+        gap-checked Replicate stream, then its dedup table. A one-shot
+        operator catch_up cannot converge while commits keep landing — the
+        pull is always behind by whatever finalized since — so the leader
+        closes the live gap itself. Returning None PROVES the follower is a
+        complete prefix net of the queue (the lag scan saw zero and anything
+        newer sits in the ordered queue behind the probe), so no separate
+        verify pass is needed. Bounded two ways: beyond
+        ``surge.log.replication-auto-resync-max-records`` total lag
+        (fresh/empty replicas) the follower stays out until catch_up
+        bulk-copies it, and the whole probe — lag scan included — runs under
+        one deadline so a slow-but-alive peer with many partitions cannot
+        stall the single replication worker past it (commits are waiting). A
+        follower AHEAD of the leader (diverged) is refused outright."""
+        cap = self._repl_auto_resync_cap
+        if deadline is None:
+            deadline = time.monotonic() + 2.5
+        if cap <= 0:
+            return self._verify_caught_up(target, deadline)
+        try:
+            queued = self._queued_counts()
+            lags: list = []  # (spec, partition, theirs, ours)
+            total = 0
             for spec in self._topic_specs():
                 for p in range(spec.partitions or 1):
                     if time.monotonic() >= deadline:
-                        return f"{target}: probe budget exceeded"
-                    theirs = call(pb.OffsetRequest(topic=spec.name,
-                                                   partition=p),
-                                  timeout=1.0).end_offset
+                        return f"{target}: probe budget exhausted (lag scan)"
+                    theirs = self._remote_end_offset(target, spec.name, p)
+                    raw_end = self.log.end_offset(spec.name, p)
+                    ours = raw_end - queued.get((spec.name, p), 0)
+                    if theirs > raw_end:
+                        # only records the LEADER ITSELF lacks prove
+                        # divergence; a follower holding queued-but-unshipped
+                        # records (catch_up raced the queue) is merely early —
+                        # the queue's gap-checked ships idempotent-skip them
+                        return (f"{target} AHEAD on {spec.name}[{p}] "
+                                f"({theirs} > {raw_end}): diverged — wipe "
+                                "and catch_up")
+                    if theirs < ours:
+                        lags.append((spec, p, theirs, ours))
+                        total += ours - theirs
+            if total > cap:
+                return (f"{target} lags {total} records (> auto-resync cap "
+                        f"{cap}); run catch_up")
+            for spec, p, theirs, ours in lags:
+                while theirs < ours:
+                    if time.monotonic() >= deadline:
+                        return (f"{target}: resync budget exhausted at "
+                                f"{spec.name}[{p}]@{theirs}; continuing "
+                                "next probe")
+                    batch = self.log.read(
+                        spec.name, p, from_offset=theirs,
+                        max_records=min(1000, ours - theirs))[: ours - theirs]
+                    if not batch:
+                        return (f"{target}: leader log read returned nothing "
+                                f"at {spec.name}[{p}]@{theirs}")
+                    spec_msg = pb.TopicSpecMsg(name=spec.name,
+                                               partitions=spec.partitions,
+                                               compacted=spec.compacted)
+                    err = self._ship(target,
+                                     _ReplItem([spec_msg], list(batch)),
+                                     timeout=1.0)
+                    if err is not None:
+                        return err
+                    theirs = batch[-1].offset + 1
+            if total:
+                # dedup table rides along: the pushed records' (txn_id, seq)
+                # advanced on the leader only while the follower was out.
+                # Chunked (a long-lived leader's table can be large — each
+                # entry embeds its cached reply) and budgeted by the probe
+                # deadline rather than a fixed per-call second.
+                snap = self.DedupSnapshot(pb.DedupSnapshotRequest(), None)
+                push = self._probe_stub(target, "ApplyDedup",
+                                        pb.ApplyDedupRequest,
+                                        pb.ReplicateReply)
+                entries = list(snap.entries)
+                for lo in range(0, len(entries), 500):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return (f"{target}: probe budget exhausted "
+                                "(dedup push); continuing next probe")
+                    reply = push(pb.ApplyDedupRequest(
+                        entries=entries[lo: lo + 500]),
+                        timeout=max(left, 0.2))
+                    if not reply.ok:
+                        return f"{target}: dedup push failed: {reply.error}"
+            return None
+        except Exception as exc:  # noqa: BLE001 — still down / transport error
+            self._drop_probe_transport(target)
+            return f"{target}: {exc!r}"
+
+    def _verify_caught_up(self, target: str,
+                          deadline: Optional[float] = None) -> Optional[str]:
+        """Equality check used when auto-resync is DISABLED (cap <= 0): the
+        follower may only re-join once its log matches the leader's current
+        end offset on EVERY topic-partition — records still sitting in the
+        replication queue (the head item included — commits apply locally
+        BEFORE they enqueue) are subtracted, since the follower cannot have
+        them yet and the ordered gap-checked ships deliver them right after
+        the re-join. Deadline-bounded like the resync scan."""
+        if deadline is None:
+            deadline = time.monotonic() + 2.0
+        try:
+            queued = self._queued_counts()
+            for spec in self._topic_specs():
+                for p in range(spec.partitions or 1):
+                    if time.monotonic() >= deadline:
+                        return f"{target}: probe budget exhausted (verify)"
+                    theirs = self._remote_end_offset(target, spec.name, p)
                     ours = (self.log.end_offset(spec.name, p)
                             - queued.get((spec.name, p), 0))
                     if theirs != ours:
@@ -581,7 +709,7 @@ class LogServer:
                                 f"{theirs} != {ours}")
             return None
         except Exception as exc:  # noqa: BLE001 — still down / transport error
-            self._probe_calls.pop(target, None)
+            self._drop_probe_transport(target)
             return f"{target}: {exc!r}"
 
     def _ship(self, target: str, item: _ReplItem,
@@ -697,6 +825,33 @@ class LogServer:
             entries.append(entry)
         return pb.DedupSnapshotReply(entries=entries)
 
+    def _merge_dedup_entries(self, entries) -> None:
+        """Forward-only merge of a peer's (txn_id -> last_seq, reply) table —
+        shared by catch_up's pull and the leader's auto-resync push, which can
+        run CONCURRENTLY (fuzz scenario: operator catch_up racing the probe);
+        the replica lock keeps each (last_seq, last_reply) pair atomic."""
+        with self._replica_lock:
+            self._merge_dedup_entries_locked(entries)
+
+    def _merge_dedup_entries_locked(self, entries) -> None:
+        for entry in entries:
+            dedup = self._txn_dedup.setdefault(entry.transactional_id,
+                                               _TxnDedup())
+            if entry.last_seq > dedup.last_seq:
+                if entry.HasField("last_reply"):
+                    dedup.last_reply = pb.TxnReply()
+                    dedup.last_reply.CopyFrom(entry.last_reply)
+                dedup.last_seq = entry.last_seq
+
+    def ApplyDedup(self, request: pb.ApplyDedupRequest,
+                   context) -> pb.ReplicateReply:
+        try:
+            self._merge_dedup_entries(request.entries)
+            return pb.ReplicateReply(ok=True)
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("dedup apply failed")
+            return pb.ReplicateReply(ok=False, error=repr(exc))
+
     def catch_up(self, leader_target: str) -> int:
         """Follower bootstrap: copy everything the leader has that this log does
         not (topics + records per partition, in offset order) PLUS the leader's
@@ -749,14 +904,7 @@ class LogServer:
             # point is either in the copied records (its seq then also in
             # this snapshot) or will be gap-checked-shipped post-rejoin
             snap = leader._calls["DedupSnapshot"](pb.DedupSnapshotRequest())
-            for entry in snap.entries:
-                dedup = self._txn_dedup.setdefault(entry.transactional_id,
-                                                   _TxnDedup())
-                if entry.last_seq > dedup.last_seq:
-                    if entry.HasField("last_reply"):
-                        dedup.last_reply = pb.TxnReply()
-                        dedup.last_reply.CopyFrom(entry.last_reply)
-                    dedup.last_seq = entry.last_seq
+            self._merge_dedup_entries(snap.entries)
         finally:
             leader.close()
         return copied
@@ -769,6 +917,17 @@ class LogServer:
         return pb.ReadReply(records=[record_to_msg(r) for r in recs])
 
     def EndOffset(self, request: pb.OffsetRequest, context) -> pb.OffsetReply:
+        # NON-mutating membership check, not .topic(): inner logs auto-create
+        # unknown topics with a DEFAULT partition count, so a mere offset
+        # probe of an empty replica (the leader's rejoin lag scan) would pin
+        # the topic at the wrong partitioning and the later resync ship's
+        # create-if-missing would skip it — a silently mis-partitioned
+        # replica. Unknown topic/partition simply holds nothing: offset 0.
+        known = getattr(self.log, "_topics", None)
+        if known is not None:
+            spec = known.get(request.topic)
+            if spec is None or request.partition >= spec.partitions:
+                return pb.OffsetReply(end_offset=0)
         return pb.OffsetReply(
             end_offset=self.log.end_offset(request.topic, request.partition))
 

@@ -272,7 +272,9 @@ def test_follower_rejoins_via_catch_up_mid_traffic():
 
     follower = LogServer(InMemoryLog())
     fport = follower.start()
-    cfg = _degrade_cfg()
+    # auto-resync capped to 2 records: this test exercises the OPERATOR bulk
+    # path — the lag here must exceed the cap so only catch_up can bridge it
+    cfg = _degrade_cfg(**{"surge.log.replication-auto-resync-max-records": 2})
     leader = LogServer(InMemoryLog(), config=cfg,
                        replicate_to=[f"127.0.0.1:{fport}"])
     lport = leader.start()
@@ -438,6 +440,149 @@ def test_replication_worker_survives_internal_bugs():
             follower_vals = [r.value for r in flog.read("events", 0)]
             assert follower_vals == leader_vals
             assert b"v1" in follower_vals
+        finally:
+            flog.close()
+    finally:
+        client.close()
+        leader.stop()
+        follower.stop()
+
+
+def test_isr_fuzz_random_follower_churn_never_loses_acked_records():
+    """Randomized availability fuzz: while a producer commits continuously,
+    the follower is repeatedly killed, replaced empty, caught up, and
+    re-joined. Invariants after every cycle and at the end:
+
+    - every ACKED commit's record is present exactly once on the leader;
+    - after the final catch_up + rejoin, the follower is byte-identical;
+    - the in-sync flag reflects reality (no rejoin while behind).
+    """
+    import random
+    import time as _t
+
+    rng = random.Random(1234)
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    cfg = _degrade_cfg()
+    leader = LogServer(InMemoryLog(), config=cfg,
+                       replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    client = GrpcLogTransport(f"127.0.0.1:{lport}", config=cfg)
+    acked: list = []
+    try:
+        client.create_topic(TopicSpec("events", 2))
+        p = client.transactional_producer("fuzz")
+        seq = 0
+
+        def commit_one():
+            nonlocal seq
+            seq += 1
+            val = f"r{seq}".encode()
+            out = _commit_retrying(p, rec("events", f"k{seq % 7}", val,
+                                          partition=seq % 2))
+            acked.append((out[0].partition, out[0].offset, val))
+
+        for cycle in range(3):
+            for _ in range(rng.randint(2, 5)):
+                commit_one()
+            follower.stop(grace=0.05)  # kill
+            for _ in range(rng.randint(2, 4)):
+                commit_one()  # degrade window: acks go leader-only
+            # replacement broker, empty log, same address
+            follower = LogServer(InMemoryLog(), port=fport)
+            follower.start()
+            _t.sleep(rng.uniform(0.0, 0.3))
+            assert leader.replication_status()["replicas"][
+                f"127.0.0.1:{fport}"] is False  # reachable != caught up
+            follower.catch_up(f"127.0.0.1:{lport}")
+            deadline = _t.perf_counter() + 10
+            while (_t.perf_counter() < deadline
+                   and not leader.replication_status()["replicas"][
+                       f"127.0.0.1:{fport}"]):
+                commit_one()
+                _t.sleep(0.1)
+            assert leader.replication_status()["replicas"][
+                f"127.0.0.1:{fport}"] is True, f"cycle {cycle}"
+
+        # leader holds every acked record exactly once, at its acked offset
+        for part in (0, 1):
+            vals = {r.offset: r.value for r in client.read("events", part)}
+            mine = [(o, v) for (pp, o, v) in acked if pp == part]
+            assert len(mine) == len(vals)
+            for off, val in mine:
+                assert vals[off] == val
+        # the follower is an identical prefix == full copy once drained
+        deadline = _t.perf_counter() + 10
+        while _t.perf_counter() < deadline and leader._repl_queue:
+            _t.sleep(0.05)
+        flog = GrpcLogTransport(f"127.0.0.1:{fport}")
+        try:
+            for part in (0, 1):
+                lv = [(r.offset, r.value) for r in client.read("events", part)]
+                fv = [(r.offset, r.value) for r in flog.read("events", part)]
+                assert fv == lv, f"partition {part}"
+        finally:
+            flog.close()
+    finally:
+        client.close()
+        leader.stop()
+        follower.stop()
+
+
+def test_auto_resync_rejoins_small_lag_without_operator_catch_up():
+    """Within the auto-resync cap the LEADER heals a lagging follower by
+    itself — missing suffix pushed through the ordered Replicate stream plus
+    the dedup table — because a one-shot catch_up can never converge while
+    commits keep landing. No operator action in this test at all."""
+    import time as _t
+
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    cfg = _degrade_cfg()
+    leader = LogServer(InMemoryLog(), config=cfg,
+                       replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    client = GrpcLogTransport(f"127.0.0.1:{lport}", config=cfg)
+    try:
+        # TWO partitions: an offset probe of the empty replacement must not
+        # auto-create the topic single-partitioned (regression: the resync
+        # ship would then skip creation and mis-partition the replica)
+        client.create_topic(TopicSpec("events", 2))
+        p = client.transactional_producer("txn-0")
+        for i in range(4):
+            p.begin()
+            p.send(rec("events", f"k{i}", f"v{i}".encode(), partition=i % 2))
+            p.commit()
+        follower.stop(grace=0.05)
+        _commit_retrying(p, rec("events", "kd", b"degrade"))  # ISR drop
+        # empty replacement; traffic keeps flowing; NO catch_up anywhere
+        follower = LogServer(InMemoryLog(), port=fport)
+        follower.start()
+        deadline = _t.perf_counter() + 10
+        i = 100
+        while (_t.perf_counter() < deadline
+               and not leader.replication_status()["replicas"][
+                   f"127.0.0.1:{fport}"]):
+            p.begin()
+            p.send(rec("events", f"k{i}", f"live{i}".encode(),
+                       partition=i % 2))
+            p.commit()
+            i += 1
+            _t.sleep(0.15)
+        assert leader.replication_status()["replicas"][
+            f"127.0.0.1:{fport}"] is True
+        # dedup rode along: a failover retry of the last seq would dedup here
+        assert (follower._txn_dedup["txn-0"].last_seq
+                == leader._txn_dedup["txn-0"].last_seq > 0)
+        # and the follower is an identical full copy once the queue drains
+        deadline = _t.perf_counter() + 10
+        while _t.perf_counter() < deadline and leader._repl_queue:
+            _t.sleep(0.05)
+        flog = GrpcLogTransport(f"127.0.0.1:{fport}")
+        try:
+            lv = [(r.offset, r.value) for r in client.read("events", 0)]
+            fv = [(r.offset, r.value) for r in flog.read("events", 0)]
+            assert fv == lv and len(fv) >= 6
         finally:
             flog.close()
     finally:
