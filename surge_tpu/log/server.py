@@ -464,21 +464,59 @@ class LogServer:
             if self._repl_stop:
                 return
 
+    def _try_resync_and_ship(self, target: str, item) -> Optional[str]:
+        """Shared probe flow: close any small lag (auto-resync), then PROVE
+        the write path with a ship — the head item when one exists, an empty
+        Replicate otherwise (an idle-pass rejoin on offset equality alone
+        would re-admit a follower whose read path works but whose write path
+        is wedged, and every commit would then pay the isr-timeout before it
+        drops again). Returns None only when both steps succeeded."""
+        err = self._resync_follower(target)
+        if err is None:
+            probe_item = item if item is not None else _ReplItem([], [])
+            err = self._ship(target, probe_item, timeout=1.0)
+        return err
+
     def _replication_iteration(self, backoff: float) -> float:
         """One wait-for-head-item attempt; returns the next backoff (the
-        outer loop repeats and owns the stop check)."""
+        outer loop repeats and owns the stop check).
+
+        The wait also breaks WITHOUT an item when an out-of-sync follower's
+        probe is due: rejoin must not depend on traffic (an idle broker would
+        otherwise never re-admit a healed follower until the next commit) —
+        the Kafka replica fetch loop runs regardless of produce activity."""
         with self._repl_cv:
             while not self._repl_queue and not self._repl_stop:
                 self._repl_cv.wait(0.5)
+                if not self._repl_queue and any(
+                        not st.in_sync
+                        and time.monotonic() >= st.next_probe
+                        for st in self._repl_target_state.values()):
+                    break
             if self._repl_stop:
                 return backoff
-            item = self._repl_queue[0]
+            item = self._repl_queue[0] if self._repl_queue else None
         now = time.monotonic()
         blocking_err = None
         for target in self._repl_targets:
             st = self._repl_target_state[target]
             if st.in_sync:
+                if item is None:
+                    continue  # idle probe pass: nothing to ship
                 err = self._ship(target, item)
+                if err is not None and "gap:" in err and now >= st.next_probe:
+                    # reachable but BEHIND (e.g. restarted empty while the
+                    # min-insync floor forbids dropping it): every ship would
+                    # gap-fail forever and commits would block — resync it in
+                    # place exactly like an out-of-sync probe would, then
+                    # retry the ship (rate-limited by the probe clock)
+                    st.next_probe = time.monotonic() + 1.0
+                    err = self._try_resync_and_ship(target, item)
+                    if err is not None:
+                        logger.warning(
+                            "in-sync follower %s is behind (gap) and resync "
+                            "failed (%s); commits block until it heals or "
+                            "drops", target, err)
                 if err is None:
                     st.failing_since = None
                     continue
@@ -499,13 +537,10 @@ class LogServer:
                     blocking_err = err
             elif now >= st.next_probe:
                 # budgeted probe: push any small lag (auto-resync — a
-                # one-shot catch_up can never converge under live traffic);
-                # returning None proves the follower is a complete prefix net
-                # of the queue, then the head item ships (idempotent if
-                # already delivered)
-                err = self._resync_follower(target)
-                if err is None:
-                    err = self._ship(target, item, timeout=1.0)
+                # one-shot catch_up can never converge under live traffic),
+                # then prove the write path with a ship (head item or an
+                # empty Replicate on the idle pass)
+                err = self._try_resync_and_ship(target, item)
                 if err is None:
                     st.in_sync = True
                     st.failing_since = None
@@ -521,6 +556,8 @@ class LogServer:
                     # (blackholed peer) must not be due again immediately,
                     # or every commit in degraded mode pays it
                     st.next_probe = time.monotonic() + 1.0
+        if item is None:
+            return backoff  # idle probe pass: nothing to finalize
         if blocking_err is None:
             # finalize BEFORE waking waiters: dedup cache advanced and the
             # pending entry dropped even if no client ever retries the seq

@@ -272,9 +272,11 @@ def test_follower_rejoins_via_catch_up_mid_traffic():
 
     follower = LogServer(InMemoryLog())
     fport = follower.start()
-    # auto-resync capped to 2 records: this test exercises the OPERATOR bulk
-    # path — the lag here must exceed the cap so only catch_up can bridge it
-    cfg = _degrade_cfg(**{"surge.log.replication-auto-resync-max-records": 2})
+    # auto-resync capped to 4 records: this test exercises the OPERATOR bulk
+    # path — the outage lag (7+) exceeds the cap so only catch_up can bridge
+    # it, while the live tail that accumulates between catch_up and the next
+    # probe (≤ ~3 ticks at the cadence below) still fits under it
+    cfg = _degrade_cfg(**{"surge.log.replication-auto-resync-max-records": 4})
     leader = LogServer(InMemoryLog(), config=cfg,
                        replicate_to=[f"127.0.0.1:{fport}"])
     lport = leader.start()
@@ -317,7 +319,7 @@ def test_follower_rejoins_via_catch_up_mid_traffic():
             p.begin()
             p.send(rec("events", "probe", b"tick"))
             p.commit()
-            _t.sleep(0.2)
+            _t.sleep(0.3)
         assert leader.replication_status()["replicas"][f"127.0.0.1:{fport}"] is True
 
         # post-rejoin commits are replicated again: kill the leader and read
@@ -589,3 +591,119 @@ def test_auto_resync_rejoins_small_lag_without_operator_catch_up():
         client.close()
         leader.stop()
         follower.stop()
+
+
+def test_idle_broker_rejoins_follower_without_traffic():
+    """Rejoin must not depend on produce activity: after the follower is
+    healed (here: auto-resyncable small lag), an IDLE leader re-admits it
+    from the probe loop alone — the Kafka replica fetch loop runs regardless
+    of traffic."""
+    import time as _t
+
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    cfg = _degrade_cfg()
+    leader = LogServer(InMemoryLog(), config=cfg,
+                       replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    client = GrpcLogTransport(f"127.0.0.1:{lport}", config=cfg)
+    try:
+        client.create_topic(TopicSpec("events", 1))
+        p = client.transactional_producer("txn-0")
+        for i in range(3):
+            p.begin()
+            p.send(rec("events", f"k{i}", f"v{i}".encode()))
+            p.commit()
+        follower.stop(grace=0.05)
+        _commit_retrying(p, rec("events", "kd", b"degrade"))  # ISR drop
+        follower = LogServer(InMemoryLog(), port=fport)
+        follower.start()
+        # NO further commits: the probe loop alone must resync + re-admit
+        deadline = _t.perf_counter() + 10
+        while (_t.perf_counter() < deadline
+               and not leader.replication_status()["replicas"][
+                   f"127.0.0.1:{fport}"]):
+            _t.sleep(0.1)
+        assert leader.replication_status()["replicas"][
+            f"127.0.0.1:{fport}"] is True
+        flog = GrpcLogTransport(f"127.0.0.1:{fport}")
+        try:
+            lv = [(r.offset, r.value) for r in client.read("events", 0)]
+            fv = [(r.offset, r.value) for r in flog.read("events", 0)]
+            assert fv == lv and len(fv) == 4
+        finally:
+            flog.close()
+    finally:
+        client.close()
+        leader.stop()
+        follower.stop()
+
+
+def test_three_replica_min_insync_two_semantics():
+    """RF=3 with min-insync=2 (the classic Kafka posture): one dead follower
+    degrades the set and commits keep flowing with 2/3 replicas acking; both
+    followers dead blocks commits (the floor holds); the healed follower
+    auto-rejoins and the set recovers."""
+    import time as _t
+
+    cfg = _degrade_cfg(**{"surge.log.replication-min-insync": 2})
+    f1 = LogServer(InMemoryLog())
+    f2 = LogServer(InMemoryLog())
+    p1, p2 = f1.start(), f2.start()
+    targets = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    leader = LogServer(InMemoryLog(), config=cfg, replicate_to=targets)
+    lport = leader.start()
+    client = GrpcLogTransport(f"127.0.0.1:{lport}", config=cfg)
+    try:
+        client.create_topic(TopicSpec("events", 1))
+        p = client.transactional_producer("txn-0")
+        p.begin(); p.send(rec("events", "k", b"v0")); p.commit()
+        assert leader.replication_status()["insync_count"] == 3
+
+        f1.stop(grace=0.05)  # one follower dies: 2/3 still >= min-insync
+        out = _commit_retrying(p, rec("events", "k", b"v1"))
+        assert out[0].offset == 1
+        st = leader.replication_status()
+        assert st["insync_count"] == 2
+        assert st["replicas"][targets[0]] is False
+        assert st["replicas"][targets[1]] is True
+        # the surviving follower has every acked record
+        flog = GrpcLogTransport(targets[1])
+        try:
+            assert [r.value for r in flog.read("events", 0)] == [b"v0", b"v1"]
+        finally:
+            flog.close()
+
+        f2.stop(grace=0.05)  # second follower dies: 1/3 < min-insync=2
+        with pytest.raises(Exception):
+            p.begin()
+            p.send(rec("events", "k", b"v2"))
+            p.commit()  # blocks: the floor holds, nothing degrades further
+        assert leader.replication_status()["insync_count"] == 2  # not dropped
+
+        # heal follower 2: an EMPTY replacement that is still IN the set
+        # (the floor forbade dropping it) gap-fails ships until the in-place
+        # resync bridges it; the client's blocked producer observed the
+        # unresolved window as fencing, so it re-opens (the publisher's
+        # reinit ladder) and traffic resumes
+        f2 = LogServer(InMemoryLog(), port=p2)
+        f2.start()
+        p = client.transactional_producer("txn-0")
+        out = _commit_retrying(p, rec("events", "k", b"v3"))
+        assert leader.replication_status()["replicas"][targets[1]] is True
+        # v2 was applied locally before its ack blocked; once healed it
+        # finalized ahead of v3 in queue order
+        vals = [r.value for r in client.read("events", 0)]
+        assert vals[:2] == [b"v0", b"v1"] and vals[-1] == b"v3"
+        assert b"v2" in vals
+        # the healed follower holds the identical log
+        flog2 = GrpcLogTransport(targets[1])
+        try:
+            assert [r.value for r in flog2.read("events", 0)] == vals
+        finally:
+            flog2.close()
+    finally:
+        client.close()
+        leader.stop()
+        f1.stop()
+        f2.stop()
