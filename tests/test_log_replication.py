@@ -484,7 +484,7 @@ def test_isr_fuzz_random_follower_churn_never_loses_acked_records():
                                           partition=seq % 2))
             acked.append((out[0].partition, out[0].offset, val))
 
-        for cycle in range(3):
+        for cycle in range(4):
             for _ in range(rng.randint(2, 5)):
                 commit_one()
             follower.stop(grace=0.05)  # kill
@@ -493,18 +493,20 @@ def test_isr_fuzz_random_follower_churn_never_loses_acked_records():
             # replacement broker, empty log, same address
             follower = LogServer(InMemoryLog(), port=fport)
             follower.start()
-            _t.sleep(rng.uniform(0.0, 0.3))
-            assert leader.replication_status()["replicas"][
-                f"127.0.0.1:{fport}"] is False  # reachable != caught up
-            follower.catch_up(f"127.0.0.1:{lport}")
-            deadline = _t.perf_counter() + 10
+            mode = rng.choice(["catch_up", "auto", "idle"])
+            if mode == "catch_up":
+                follower.catch_up(f"127.0.0.1:{lport}")
+            # "auto": the leader's probe resyncs it under live traffic;
+            # "idle": no catch_up AND no traffic — idle probing alone heals
+            deadline = _t.perf_counter() + 15
             while (_t.perf_counter() < deadline
                    and not leader.replication_status()["replicas"][
                        f"127.0.0.1:{fport}"]):
-                commit_one()
+                if mode != "idle":
+                    commit_one()
                 _t.sleep(0.1)
             assert leader.replication_status()["replicas"][
-                f"127.0.0.1:{fport}"] is True, f"cycle {cycle}"
+                f"127.0.0.1:{fport}"] is True, f"cycle {cycle} ({mode})"
 
         # leader holds every acked record exactly once, at its acked offset
         for part in (0, 1):
