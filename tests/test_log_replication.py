@@ -709,3 +709,80 @@ def test_three_replica_min_insync_two_semantics():
         leader.stop()
         f1.stop()
         f2.stop()
+
+
+def test_engine_unaffected_by_follower_churn():
+    """The full command engine keeps serving at normal latency while the
+    FOLLOWER dies, is replaced empty, and auto-heals — the ISR machinery is
+    invisible to the publisher/entity path, no command effect is lost, and
+    the healed follower ends byte-identical (so a later leader failover
+    would lose nothing)."""
+    from surge_tpu import SurgeCommandBusinessLogic, create_engine, default_config
+    from surge_tpu.engine.entity import CommandSuccess
+    from surge_tpu.models import counter
+
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    bcfg = _degrade_cfg()
+    leader = LogServer(InMemoryLog(), config=bcfg,
+                       replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    ecfg = default_config().with_overrides({
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 10,
+        "surge.engine.num-partitions": 2,
+        "surge.log.replication-ack-timeout-ms": 400,
+    })
+
+    async def scenario():
+        import time as _t
+
+        log = GrpcLogTransport(f"127.0.0.1:{lport}", config=ecfg)
+        engine = create_engine(
+            SurgeCommandBusinessLogic(
+                aggregate_name="counter", model=counter.CounterModel(),
+                state_format=counter.state_formatting(),
+                event_format=counter.event_formatting()),
+            log=log, config=ecfg)
+        await engine.start()
+        counts = {f"agg-{i}": 0 for i in range(4)}
+
+        async def send_ok(agg):
+            for _ in range(50):
+                r = await engine.aggregate_for(agg).send_command(
+                    counter.Increment(agg))
+                if isinstance(r, CommandSuccess):
+                    counts[agg] += 1
+                    return r
+                await asyncio.sleep(0.1)
+            raise AssertionError(f"command stuck for {agg}: {r}")
+
+        nonlocal follower
+        for agg in counts:
+            await send_ok(agg)
+        follower.stop(grace=0.05)  # follower dies mid-traffic
+        for round_ in range(3):
+            for agg in counts:
+                await send_ok(agg)  # degrade window: engine unaffected
+        follower = LogServer(InMemoryLog(), port=fport)
+        follower.start()  # empty replacement auto-heals while traffic flows
+        deadline = _t.perf_counter() + 15
+        while (_t.perf_counter() < deadline
+               and not leader.replication_status()["replicas"][
+                   f"127.0.0.1:{fport}"]):
+            for agg in counts:
+                await send_ok(agg)
+            await asyncio.sleep(0.05)
+        assert leader.replication_status()["replicas"][
+            f"127.0.0.1:{fport}"] is True
+        # every command's effect is present exactly once
+        for agg, n in counts.items():
+            st = await engine.aggregate_for(agg).get_state()
+            assert (st.count, st.version) == (n, n), agg
+        await engine.stop()
+        log.close()
+
+    asyncio.run(scenario())
+    leader.stop()
+    follower.stop()
