@@ -30,7 +30,67 @@ from surge_tpu.engine.entity import (
 )
 from surge_tpu.engine.model import fold_events
 
-__all__ = ["StubAggregateRef", "StubEngine"]
+__all__ = ["StubAggregateRef", "StubEngine", "assert_replay_matches_scalar"]
+
+
+def assert_replay_matches_scalar(model, replay_spec, logs,
+                                 fields: Optional[Sequence[str]] = None,
+                                 encode: Callable[[Any], Any] | None = None,
+                                 config=None) -> None:
+    """The golden-check every new model family should ship (the framework's
+    own test pattern, docs/testing.md §4): batched TPU replay of ``logs``
+    must equal the scalar ``handle_event`` fold, field by field.
+
+    ``encode`` maps raw events into tensor-schema form before replay (the
+    ``replay_ragged`` hook — e.g. bank_account's Vocab dictionary encoding);
+    the scalar fold always runs on the RAW events. ``fields`` selects which
+    state columns to compare; by default every column of the replay spec's
+    state schema whose name is an attribute of the scalar states. An empty
+    log's baseline is the spec's initial record, and float columns compare
+    with a float32-appropriate relative tolerance. Raises ``AssertionError``
+    naming the first diverging (aggregate, field) — or, if nothing at all
+    was comparable (all logs empty with no field overlap), the vacuous run
+    itself."""
+    import math
+
+    import numpy as np
+
+    from surge_tpu.replay import ReplayEngine
+
+    logs = [list(log) for log in logs]
+    truth = [fold_events(model, None, log) for log in logs]
+    res = ReplayEngine(replay_spec, config=config).replay_ragged(
+        logs, encode=encode)
+    init = replay_spec.init_state_tree()
+    if fields is None:
+        fields = [f.name for f in replay_spec.registry.state.fields
+                  if any(hasattr(s, f.name) for s in truth if s is not None)]
+        if not fields:
+            # nothing to compare would pass vacuously: fall back to checking
+            # every schema column against the initial record
+            fields = [f.name for f in replay_spec.registry.state.fields]
+    compared = 0
+    for i, scalar in enumerate(truth):
+        for name in fields:
+            if scalar is not None and not hasattr(scalar, name):
+                continue
+            want = (getattr(scalar, name) if scalar is not None
+                    else np.asarray(init[name]).item())
+            got = np.asarray(res.states[name][i]).item()
+            compared += 1
+            if isinstance(want, bool):
+                got = bool(got)
+            ok = (math.isclose(got, want, rel_tol=1e-5, abs_tol=1e-6)
+                  if isinstance(want, float) else got == want)
+            if not ok:
+                raise AssertionError(
+                    f"replay diverges from the scalar fold at aggregate {i} "
+                    f"field {name!r}: replay={got!r} scalar={want!r}")
+    if not compared:
+        raise AssertionError(
+            "assert_replay_matches_scalar compared nothing (no logs, or no "
+            "state column matches any scalar-state attribute) — pass "
+            "`fields` explicitly")
 
 
 class StubAggregateRef:

@@ -144,3 +144,53 @@ def test_stub_supports_async_models():
 
     r = run(scenario())
     assert isinstance(r, CommandSuccess) and r.state == 7
+
+
+def test_assert_replay_matches_scalar_passes_and_catches_divergence():
+    from surge_tpu.testing import assert_replay_matches_scalar
+
+    model = counter.CounterModel()
+    logs = [[counter.CountIncremented(f"g-{i}", 1, k + 1) for k in range(i + 1)]
+            for i in range(6)]
+    assert_replay_matches_scalar(model, counter.make_replay_spec(), logs)
+
+    # a model whose scalar fold disagrees with the replay spec must be caught
+    class SkewedModel(counter.CounterModel):
+        def handle_event(self, state, event):
+            st = super().handle_event(state, event)
+            if st is not None and st.count >= 3:
+                return type(st)(st.aggregate_id, st.count + 1, st.version)
+            return st
+
+    import pytest
+
+    with pytest.raises(AssertionError, match="diverges"):
+        assert_replay_matches_scalar(SkewedModel(),
+                                     counter.make_replay_spec(), logs)
+
+
+def test_assert_replay_matches_scalar_vocab_models_and_empty_logs():
+    """The packaged golden check covers encode-hook models (bank_account's
+    Vocab) and empty logs (baseline = the spec's initial record, never a
+    vacuous pass)."""
+    from surge_tpu.models import bank_account as ba
+    from surge_tpu.testing import assert_replay_matches_scalar
+
+    vocab = ba.Vocab()
+    model = ba.BankAccountModel()
+    logs = []
+    for i in range(3):
+        st, log = None, []
+        cmds = [ba.CreateAccount(f"b-{i}", f"own-{i}", f"sec-{i}", 100.25),
+                ba.CreditAccount(f"b-{i}", 10.50),
+                ba.DebitAccount(f"b-{i}", 0.25)]
+        for cmd in cmds:
+            for e in model.process_command(st, cmd):
+                st = model.handle_event(st, e)
+                log.append(e)
+        logs.append(log)
+    logs.append([])  # empty log: compared against the initial record
+    assert_replay_matches_scalar(
+        model, ba.make_replay_spec(), logs,
+        fields=["balance"],
+        encode=lambda e: ba.encode_event(vocab, e))
