@@ -124,6 +124,16 @@ class PartitionPublisher:
         # offset-alignment loop a short `committed` list.
         self._partial_records: Dict[str, List[LogRecord]] = {}
         self._partial_touched: Dict[str, float] = {}  # request_id -> last retry time
+        # transactional mode: a commit whose OUTCOME IS UNKNOWN (transport
+        # died, fencing mid-flight) keeps its batch here and retries it
+        # VERBATIM under the same txn_seq — the broker's (now
+        # restart-durable) dedup then answers a commit that actually landed,
+        # instead of a re-batched different payload being appended beside it.
+        # Kafka's producer retries fixed batches for exactly this reason.
+        self._retry_batch: Optional[List[_Pending]] = None
+        self._retry_attempts = 0
+        self._retry_max = self.config.get_int(
+            "surge.producer.publish-retry-max", 8)
         self._flush_task = BackgroundTask(self._flush_loop, f"publisher-flush-{partition}")
         self._progress_task = BackgroundTask(self._progress_loop, f"publisher-progress-{partition}")
 
@@ -153,6 +163,12 @@ class PartitionPublisher:
         for p in self._pending:
             fail_future(p.future, PublisherNotReadyError("publisher stopped"))
         self._pending.clear()
+        if self._retry_batch is not None:
+            for p in self._retry_batch:
+                fail_future(p.future,
+                            PublisherNotReadyError("publisher stopped"))
+            self._retry_batch = None
+            self._retry_attempts = 0
 
     async def _initialize(self) -> None:
         """Open producer (fences zombies), commit the flush record, gate on store lag."""
@@ -161,7 +177,13 @@ class PartitionPublisher:
         self._producer.send(LogRecord(topic=self.state_topic, key=None, value=b"",
                                       partition=self.partition,
                                       headers={"surge-flush": "1"}))
-        self._producer.commit()
+        # unsequenced when the transport supports it: the epoch marker's
+        # duplicates are harmless, and it must not consume the broker's
+        # one-shot reopen-absorption window that a stashed
+        # landed-but-unacked batch needs after a broker restart
+        commit = getattr(self._producer, "commit_unsequenced",
+                         self._producer.commit)
+        commit()
         self.state = "waiting_for_ktable"
         while True:
             end = self.log.end_offset(self.state_topic, self.partition)
@@ -190,6 +212,13 @@ class PartitionPublisher:
         if request_id in self._completed:
             self.stats.dedup_hits += 1
             return
+        if self._retry_batch is not None:
+            for sp in self._retry_batch:
+                if sp.request_id == request_id:
+                    # this request rides the in-limbo batch: join its outcome
+                    self.stats.dedup_hits += 1
+                    await asyncio.shield(sp.future)
+                    return
         committing = self._committing.get(request_id)
         if committing is not None:
             # this request's batch is mid-commit (the caller timed out and retried
@@ -219,6 +248,9 @@ class PartitionPublisher:
         indexed watermark and nothing is pending (KafkaProducerActorImpl.scala:530-540)."""
         if any(p.aggregate_id == aggregate_id for p in self._pending):
             return False
+        if self._retry_batch is not None and any(
+                p.aggregate_id == aggregate_id for p in self._retry_batch):
+            return False  # an in-limbo write is ahead of the store by definition
         off = self._in_flight.get(aggregate_id)
         if off is None:
             return True
@@ -227,16 +259,56 @@ class PartitionPublisher:
     # -- internal loops -----------------------------------------------------------------
 
     async def _flush_loop(self) -> None:
+        # the loop must be unkillable by a bug: _publish_batch fails batches
+        # on expected errors, but an escape here (e.g. from post-commit
+        # bookkeeping) would end the task SILENTLY and every later command on
+        # this partition would time out with no root cause — same hazard
+        # class as the broker's replication worker
         while True:
             await asyncio.sleep(self._flush_interval)
-            if self._pending and self.state == "processing":
-                batch, self._pending = self._pending, []
-                await self._publish_batch(batch)
-            self._purge_dedup()
+            batch: List[_Pending] = []
+            try:
+                if self.state in ("fenced", "waiting_for_ktable"):
+                    # a fencing-triggered re-init that RAISED mid-way (broker
+                    # briefly unreachable — it may already have advanced state
+                    # past "fenced" before the escape) left init incomplete:
+                    # keep retrying on the tick instead of sitting
+                    # dead-but-running forever. _handle_fenced also covers
+                    # the lost-ownership shutdown path.
+                    await self._handle_fenced()
+                if (self._retry_batch is not None
+                        and self.state == "processing"):
+                    # in-limbo batch retries VERBATIM before any new pendings
+                    # commit (same txn_seq -> the broker dedup can answer it)
+                    await self._publish_batch(self._retry_batch)
+                elif self._pending and self.state == "processing":
+                    batch, self._pending = self._pending, []
+                    await self._publish_batch(batch)
+                self._purge_dedup()
+            except Exception as exc:  # noqa: BLE001 — log loudly, keep flushing
+                logger.exception("flush loop iteration failed on %s[%d]; "
+                                 "continuing", self.state_topic, self.partition)
+                # the drained batch's waiters must not hang forever: fail
+                # them so the entity ladder retries with the same request_id.
+                # (If the commit actually landed before the escape, the
+                # broker's txn_seq cache absorbs the replay while the broker
+                # lives; across a broker RESTART that cache is rebuilt from
+                # the __txn_state records it persists with each commit.)
+                for p in batch:
+                    fail_future(p.future, PublishFailedError(
+                        f"flush loop error: {exc}"))
+                try:
+                    self.on_signal("surge.producer.flush-loop-error", "error")
+                except Exception:  # noqa: BLE001 — a raising signal sink must
+                    logger.exception("on_signal failed")  # not kill the loop
 
     async def _progress_loop(self) -> None:
         while True:
-            self._refresh_watermark()
+            try:
+                self._refresh_watermark()
+            except Exception:  # noqa: BLE001 — e.g. transient store lookup
+                logger.exception("watermark refresh failed on %s[%d]; "
+                                 "continuing", self.state_topic, self.partition)
             await asyncio.sleep(self._check_interval)
 
     def _refresh_watermark(self) -> None:
@@ -300,12 +372,18 @@ class PartitionPublisher:
                 self.metrics.fence_counter.record()
             self.on_signal("surge.producer.fenced", "error")
             outcome.set_result(exc)
-            for p in batch:
-                fail_future(p.future, PublishFailedError(
-                    f"publisher for partition {self.partition} was fenced"))
+            if self._transactions_enabled:
+                # outcome unknown (a failover ack may have landed): hold the
+                # batch for a verbatim retry after re-init — the new broker's
+                # replicated/durable dedup absorbs a landed commit
+                self._stash_or_exhaust(batch, exc)
+            else:
+                for p in batch:
+                    fail_future(p.future, PublishFailedError(
+                        f"publisher for partition {self.partition} was fenced"))
             await self._handle_fenced()
             return
-        except Exception as exc:  # noqa: BLE001 — transport failure fails the batch
+        except Exception as exc:  # noqa: BLE001 — transport failure: outcome unknown
             self.stats.batches_failed += 1
             if self.metrics is not None:
                 self.metrics.publish_failure_counter.record()
@@ -315,8 +393,12 @@ class PartitionPublisher:
             except Exception:  # noqa: BLE001
                 self.on_signal("surge.producer.abort-failed", "error")
             outcome.set_result(exc)
-            for p in batch:
-                fail_future(p.future, PublishFailedError(str(exc)))
+            if self._transactions_enabled:
+                self._stash_or_exhaust(batch, exc)
+            else:
+                # non-transactional mode has its own per-record resume state
+                for p in batch:
+                    fail_future(p.future, PublishFailedError(str(exc)))
             return
 
         elapsed = time.perf_counter() - t0
@@ -339,9 +421,42 @@ class PartitionPublisher:
             self._completed[p.request_id] = now
             resolve_future(p.future, None)
         outcome.set_result(None)
+        if batch is self._retry_batch:
+            self._retry_batch = None
+            self._retry_attempts = 0
         self.stats.flushes += 1
         self.stats.records_published += len(records)
         self.stats.in_flight = len(self._in_flight)
+
+    def _stash_or_exhaust(self, batch: List[_Pending], exc: Exception) -> None:
+        """Keep an unknown-outcome batch for verbatim retry, bounded: after
+        publish-retry-max attempts its waiters fail (the entity ladder takes
+        over) and the batch is dropped — a deterministically-failing batch
+        must not block the partition forever."""
+        if self._retry_batch is None:
+            self._retry_batch = batch
+            self._retry_attempts = 1
+        elif batch is not self._retry_batch:
+            # a DIFFERENT batch failed while one is already in limbo (e.g. a
+            # flush_now drain): only one verbatim-retry slot exists — fail the
+            # newcomer's waiters so their entities retry, and leave the
+            # in-limbo batch's accounting untouched
+            for p in batch:
+                fail_future(p.future, PublishFailedError(str(exc)))
+            return
+        else:
+            self._retry_attempts += 1
+        if self._retry_attempts > self._retry_max:
+            logger.error(
+                "publish batch on %s[%d] failed %d verbatim retries (%s); "
+                "failing its waiters", self.state_topic, self.partition,
+                self._retry_attempts, exc)
+            for p in batch:
+                fail_future(p.future, PublishFailedError(str(exc)))
+            self._retry_batch = None
+            self._retry_attempts = 0
+        else:
+            self.on_signal("surge.producer.publish-retry", "warning")
 
     async def _handle_fenced(self) -> None:
         """Fenced: re-init if we still own the partition, else shut down

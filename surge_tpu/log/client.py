@@ -101,6 +101,25 @@ class GrpcTxnProducer:
         self._next_seq += 1
         return [msg_to_record(m) for m in reply.records]
 
+    def commit_unsequenced(self) -> Sequence[LogRecord]:
+        """Commit WITHOUT an idempotency seq (txn_seq=0): for epoch markers
+        like the publisher's init flush record, whose duplicates are harmless
+        and which must not consume the broker's one-shot reopen-absorption
+        window (a landed-but-unacked data batch needs it after a restart)."""
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        records, self._buffer = self._buffer, None
+        try:
+            reply = self._transport._transact(self._token, "commit", records,
+                                              seq=0,
+                                              generation=self._generation)
+        except ProducerFencedError:
+            self._fenced = True
+            raise
+        self._check_fence(reply)
+        _raise_for(reply)
+        return [msg_to_record(m) for m in reply.records]
+
     def abort(self) -> None:
         if self._buffer is None:
             raise TransactionStateError("no open transaction")

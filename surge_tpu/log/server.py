@@ -53,23 +53,30 @@ class _TxnDedup:
     publisher is the partition's single writer), so the most recent entry is
     enough to answer any replay the client can send."""
 
-    __slots__ = ("last_seq", "last_reply")
+    __slots__ = ("last_seq", "last_reply", "locator")
 
     def __init__(self) -> None:
         self.last_seq = 0
         self.last_reply: Optional[pb.TxnReply] = None
+        #: committed-record locations [(topic, partition, offset), ...] for
+        #: last_seq, recovered from __txn_state after a broker restart — the
+        #: lost reply is rebuilt by re-reading the records at these offsets
+        self.locator: Optional[list] = None
 
 
 class _ProducerState:
     """Server-side producer handle bound to its txn id's dedup state."""
 
-    __slots__ = ("txn_id", "producer", "dedup", "lock")
+    __slots__ = ("txn_id", "producer", "dedup", "lock", "fresh")
 
     def __init__(self, txn_id: str, producer, dedup: _TxnDedup) -> None:
         self.txn_id = txn_id
         self.producer = producer
         self.dedup = dedup
         self.lock = threading.Lock()
+        #: True until this producer's first Transact: gates the
+        #: duplicate-absorption of a reopen-retried batch at last_seq+1
+        self.fresh = True
 
 
 class _ReplItem:
@@ -96,6 +103,11 @@ class _TargetState:
         self.failing_since: Optional[float] = None
         self.next_probe = 0.0
 
+
+#: compacted broker-internal topic persisting (txn_id -> last committed seq +
+#: record locations); rebuilt into the dedup table at startup so idempotency
+#: survives a broker restart (the Kafka producer-state-snapshot role)
+TXN_STATE_TOPIC = "__txn_state"
 
 SERVICE = "surge_tpu.log.LogService"
 METHODS = {
@@ -197,6 +209,12 @@ class LogServer:
         # rejoin-probe transport: ONE cached channel per target, stubs derived
         self._probe_channels: Dict[str, object] = {}
         self._probe_stubs: Dict[tuple, object] = {}
+        # durable idempotency: __txn_state writer + recovery of a previous
+        # life's dedup table (in-memory dedup alone reopens the
+        # duplicate-append window on every broker restart)
+        self._txn_state_producer = None
+        self._txn_state_lock = threading.Lock()
+        self._recover_txn_state()
         # -- replication (follower side): ordered ingest of leader batches
         self._replica_lock = threading.Lock()
         self._replica_producer = None
@@ -281,17 +299,42 @@ class LogServer:
             if request.producer_token in self._fenced_tokens:
                 return pb.TxnReply(ok=False, error="producer fenced",
                                    error_kind="fenced")
-            return pb.TxnReply(ok=False, error="unknown producer token",
-                               error_kind="state")
+            # an unknown token is indistinguishable from one lost in a broker
+            # restart (tokens are in-memory); answering "fenced" drives the
+            # client's re-open ladder, which is the correct recovery in both
+            # cases — a "state" error would live-lock a publisher whose broker
+            # bounced (entity retries forever, nothing ever re-opens)
+            return pb.TxnReply(ok=False,
+                               error="unknown producer token "
+                                     "(broker restarted?)",
+                               error_kind="fenced")
         records = [msg_to_record(m) for m in request.records]
         with state.lock:
             dedup = state.dedup
+            fresh = state.fresh
+            if request.txn_seq:
+                # only a SEQ-FUL transact consumes the reopen-freshness: the
+                # publisher's unsequenced epoch flush record must not eat the
+                # one-shot absorption window its stashed batch needs
+                state.fresh = False
             # idempotency window (txn_seq > 0): a replayed seq means the client
-            # lost our reply and retried — answer from cache, never append twice
+            # lost our reply and retried — answer from cache, never append
+            # twice. The cache survives broker restarts via __txn_state (the
+            # reply is rebuilt from the recorded offsets on first replay), and
+            # a replay is only honored for the IDENTICAL payload — answering a
+            # different batch from the cache would silently drop its records.
             if request.txn_seq:
                 if request.txn_seq == dedup.last_seq:
-                    if dedup.last_reply is not None:
-                        return dedup.last_reply
+                    reply = dedup.last_reply or self._rebuild_cached_reply(dedup)
+                    if reply is not None:
+                        cached = [msg_to_record(m) for m in reply.records]
+                        if reply.ok and not _same_payload(cached, records):
+                            return pb.TxnReply(
+                                ok=False, error_kind="state",
+                                error=f"txn_seq {request.txn_seq} reused with "
+                                      "a different payload (its original "
+                                      "batch already committed)")
+                        return reply
                     return pb.TxnReply(ok=False, error="duplicate txn_seq with "
                                        "no cached reply", error_kind="state")
                 if request.txn_seq < dedup.last_seq:
@@ -299,6 +342,25 @@ class LogServer:
                         ok=False, error_kind="state",
                         error=f"stale txn_seq {request.txn_seq} "
                               f"(last {dedup.last_seq})")
+                if (fresh and request.txn_seq == dedup.last_seq + 1
+                        and dedup.last_seq):
+                    # reopen-retry absorption: a publisher whose commit landed
+                    # but whose broker bounced re-opens (numbering resumes at
+                    # last+1) and retries the SAME batch under the new seq.
+                    # Only a producer's FIRST transact can be such a replay —
+                    # later identical consecutive batches are legitimate
+                    # traffic (engine payloads embed monotonic versions, but
+                    # raw clients may repeat bytes).
+                    reply = (dedup.last_reply
+                             or self._rebuild_cached_reply(dedup))
+                    if reply is not None and reply.ok:
+                        cached = [msg_to_record(m) for m in reply.records]
+                        if _same_payload(cached, records):
+                            dedup.last_seq = request.txn_seq
+                            self._persist_txn_state(
+                                state.txn_id, request.txn_seq,
+                                [msg_to_record(m) for m in reply.records])
+                            return reply
                 # a previous attempt of this seq appended locally but timed out
                 # waiting for replication: re-join that item, never re-append.
                 # The payload must MATCH — the client may only reuse a seq for
@@ -344,6 +406,9 @@ class LogServer:
             if request.txn_seq:
                 dedup.last_seq = request.txn_seq
                 dedup.last_reply = reply
+                dedup.locator = None
+                self._persist_txn_state(state.txn_id, request.txn_seq,
+                                        committed)
             return reply
 
     # -- replication: leader side ---------------------------------------------------------
@@ -570,6 +635,9 @@ class LogServer:
                         ok=True,
                         records=[record_to_msg(r) for r in item.records])
                     dedup.last_seq = item.seq
+                    dedup.locator = None
+                    self._persist_txn_state(item.txn_id, item.seq,
+                                            item.records)
                 self._repl_pending.pop((item.txn_id, item.seq), None)
             item.error = None
             # pop BEFORE waking the waiter: a client that gets its commit
@@ -654,6 +722,14 @@ class LogServer:
             lags: list = []  # (spec, partition, theirs, ours)
             total = 0
             for spec in self._topic_specs():
+                if spec.name == TXN_STATE_TOPIC:
+                    # broker-internal dedup annotations are self-maintained on
+                    # EACH side (one record per locally-observed commit), so
+                    # their offsets legitimately differ — comparing or pushing
+                    # them would read as permanent lag or false divergence;
+                    # the dedup content itself travels via ApplyDedup /
+                    # Replicate piggyback / catch_up instead
+                    continue
                 for p in range(spec.partitions or 1):
                     if time.monotonic() >= deadline:
                         return f"{target}: probe budget exhausted (lag scan)"
@@ -735,6 +811,8 @@ class LogServer:
         try:
             queued = self._queued_counts()
             for spec in self._topic_specs():
+                if spec.name == TXN_STATE_TOPIC:
+                    continue  # self-maintained per side; see _resync_follower
                 for p in range(spec.partitions or 1):
                     if time.monotonic() >= deadline:
                         return f"{target}: probe budget exhausted (verify)"
@@ -834,6 +912,10 @@ class LogServer:
                         dedup.last_seq = request.txn_seq
                         dedup.last_reply = pb.TxnReply(
                             ok=True, records=list(request.records))
+                        dedup.locator = None
+                        self._persist_txn_state(
+                            request.transactional_id, request.txn_seq,
+                            [msg_to_record(m) for m in request.records])
                 return pb.ReplicateReply(ok=True)
             except Exception as exc:  # noqa: BLE001
                 logger.exception("replica ingest failed")
@@ -850,6 +932,79 @@ class LogServer:
             min_insync=status["min_insync"],
             insync_count=status["insync_count"],
             queue_depth=status["queue_depth"])
+
+    # -- durable idempotency (__txn_state) ------------------------------------------------
+
+    def _recover_txn_state(self) -> None:
+        """Rebuild the dedup table from the __txn_state records a previous
+        life of this broker persisted with each seq-ful commit: last_seq
+        survives the restart (OpenProducer resumes the client's numbering)
+        and a replayed seq is answered by re-reading the committed records at
+        their recorded offsets instead of appending them a second time."""
+        import json as _json
+
+        known = getattr(self.log, "_topics", {})
+        if TXN_STATE_TOPIC not in known:
+            return
+        recovered = 0
+        for key, rec in self.log.latest_by_key(TXN_STATE_TOPIC, 0).items():
+            try:
+                obj = _json.loads(rec.value)
+                seq = int(obj.get("s", 0))
+            except (ValueError, TypeError):
+                continue
+            dedup = self._txn_dedup.setdefault(key, _TxnDedup())
+            if seq > dedup.last_seq:
+                dedup.last_seq = seq
+                dedup.last_reply = None
+                dedup.locator = [tuple(x) for x in obj.get("r", [])]
+                recovered += 1
+        if recovered:
+            logger.info("recovered %d txn dedup entries from %s",
+                        recovered, TXN_STATE_TOPIC)
+
+    def _persist_txn_state(self, txn_id: str, seq: int, records) -> None:
+        """Durably record (txn_id -> seq, committed-record locations) in the
+        inner log. Best-effort: a failure only re-opens the restart-window
+        duplicate risk, it must never fail the commit it annotates.
+        ``records`` carry their committed offsets (LogRecord or RecordMsg)."""
+        import json as _json
+
+        try:
+            locator = [[r.topic, r.partition, r.offset] for r in records]
+            value = _json.dumps({"s": int(seq), "r": locator}).encode()
+            with self._txn_state_lock:
+                known = getattr(self.log, "_topics", {})
+                if TXN_STATE_TOPIC not in known:
+                    self.log.create_topic(
+                        TopicSpec(TXN_STATE_TOPIC, 1, compacted=True))
+                if self._txn_state_producer is None:
+                    self._txn_state_producer = self.log.transactional_producer(
+                        "__txn_state_writer__")
+                self._txn_state_producer.begin()
+                self._txn_state_producer.send(LogRecord(
+                    topic=TXN_STATE_TOPIC, key=txn_id, value=value,
+                    partition=0))
+                self._txn_state_producer.commit()
+        except Exception:  # noqa: BLE001 — annotation only, never fail commits
+            logger.exception("txn-state persist failed "
+                             "(restart dedup window open)")
+
+    def _rebuild_cached_reply(self, dedup: _TxnDedup) -> Optional[pb.TxnReply]:
+        """Reconstruct a recovered seq's lost reply from its locator by
+        re-reading the committed records where the log holds them."""
+        if dedup.locator is None:
+            return None
+        msgs = []
+        for t, part, off in dedup.locator:
+            recs = self.log.read(str(t), int(part), from_offset=int(off),
+                                 max_records=1)
+            if not recs or recs[0].offset != int(off):
+                return None  # locator points past a truncated/foreign log
+            msgs.append(record_to_msg(recs[0]))
+        reply = pb.TxnReply(ok=True, records=msgs)
+        dedup.last_reply = reply
+        return reply
 
     def DedupSnapshot(self, request: pb.DedupSnapshotRequest,
                       context) -> pb.DedupSnapshotReply:
@@ -879,6 +1034,11 @@ class LogServer:
                     dedup.last_reply = pb.TxnReply()
                     dedup.last_reply.CopyFrom(entry.last_reply)
                 dedup.last_seq = entry.last_seq
+                dedup.locator = None
+                if dedup.last_reply is not None and dedup.last_reply.ok:
+                    self._persist_txn_state(
+                        entry.transactional_id, entry.last_seq,
+                        [msg_to_record(m) for m in dedup.last_reply.records])
 
     def ApplyDedup(self, request: pb.ApplyDedupRequest,
                    context) -> pb.ReplicateReply:

@@ -190,20 +190,42 @@ class StateStoreIndexer(Controllable):
 
     def _make_partition_loop(self, partition: int):
         async def loop() -> None:
+            # a transient transport failure (e.g. the whole broker set briefly
+            # unreachable mid-failover, after the client's own target cycle is
+            # exhausted) must not END this task silently: the partition would
+            # stop indexing forever, the publisher's lag gate would never
+            # advance, and every aggregate on it would stall with no root
+            # cause. Log, signal the health bus, back off — escalating, so a
+            # DETERMINISTIC failure (poison record, store bug) throttles its
+            # own traceback spam and reads differently from transport blips.
+            backoff = 0.25
             while True:
-                offset = self._watermarks[partition]
-                records = self.log.read(self.state_topic, partition, offset,
-                                        max_records=self._max_poll)
-                if records:
-                    self._apply(records)
-                    self._watermarks[partition] = records[-1].offset + 1
-                    continue
                 try:
+                    offset = self._watermarks[partition]
+                    records = self.log.read(self.state_topic, partition,
+                                            offset, max_records=self._max_poll)
+                    if records:
+                        self._apply(records)
+                        self._watermarks[partition] = records[-1].offset + 1
+                        backoff = 0.25  # reset only on a FULL success, so a
+                        continue        # poison _apply still escalates
                     await asyncio.wait_for(
-                        self.log.wait_for_append(self.state_topic, partition, offset),
+                        self.log.wait_for_append(self.state_topic, partition,
+                                                 offset),
                         timeout=self._poll_timeout)
+                    backoff = 0.25
                 except asyncio.TimeoutError:
-                    pass
+                    backoff = 0.25  # an idle wait is healthy too
+                except Exception:  # noqa: BLE001 — keep the tail alive
+                    logger.exception(
+                        "indexer poll failed on %s[%d]; retrying in %.2fs",
+                        self.state_topic, partition, backoff)
+                    try:
+                        self.on_signal("surge.state-store.poll-error", "error")
+                    except Exception:  # noqa: BLE001
+                        logger.exception("on_signal failed")
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 30.0)
 
         return loop
 

@@ -306,7 +306,10 @@ def test_follower_rejoins_via_catch_up_mid_traffic():
         assert leader.replication_status()["replicas"][f"127.0.0.1:{fport}"] is False
 
         copied = follower.catch_up(f"127.0.0.1:{lport}")
-        assert copied == 7  # 3 + dead-window + 3 committed while out
+        # 7 data records (3 + dead-window + 3 committed while out) plus their
+        # __txn_state dedup annotations ride along
+        assert copied == 14
+        assert sum(1 for _ in follower.log.read("events", 0)) == 7
         # catch_up must also carry the txn-dedup table: a failover client
         # retrying an in-flight seq would otherwise re-append records this
         # copy already holds (exactly-once across the outage window)
@@ -786,3 +789,184 @@ def test_engine_unaffected_by_follower_churn():
     asyncio.run(scenario())
     leader.stop()
     follower.stop()
+
+
+def test_engine_recovers_from_single_broker_bounce(tmp_path):
+    """An UNREPLICATED broker that dies and restarts on the same address
+    (FileLog-backed, so the log survives) must not live-lock the engine: the
+    restarted broker answers stale producer tokens as fenced, the publisher's
+    reinit ladder re-opens, and no command effect is lost or doubled."""
+    from surge_tpu import SurgeCommandBusinessLogic, create_engine, default_config
+    from surge_tpu.engine.entity import CommandSuccess
+    from surge_tpu.log.file import FileLog
+    from surge_tpu.models import counter
+
+    broker = LogServer(FileLog(str(tmp_path / "b")))
+    port = broker.start()
+    cfg = default_config().with_overrides({
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 10,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.engine.num-partitions": 2,
+    })
+
+    async def scenario():
+        nonlocal broker
+        log = GrpcLogTransport(f"127.0.0.1:{port}", config=cfg)
+        engine = create_engine(
+            SurgeCommandBusinessLogic(
+                aggregate_name="counter", model=counter.CounterModel(),
+                state_format=counter.state_formatting(),
+                event_format=counter.event_formatting()),
+            log=log, config=cfg)
+        await engine.start()
+        for _ in range(5):
+            r = await engine.aggregate_for("a").send_command(
+                counter.Increment("a"))
+            assert isinstance(r, CommandSuccess)
+
+        broker.stop(grace=0.05)          # total outage...
+        await asyncio.sleep(0.7)         # ...long enough for loops to fail
+        broker = LogServer(FileLog(str(tmp_path / "b")))
+        broker._port = port
+        assert broker.start() == port    # ...and the same address comes back
+
+        ok = None
+        for _ in range(100):
+            r = await engine.aggregate_for("a").send_command(
+                counter.Increment("a"))
+            if isinstance(r, CommandSuccess):
+                ok = r
+                break
+            await asyncio.sleep(0.2)
+        assert ok is not None, "engine never recovered from the bounce"
+        assert (ok.state.count, ok.state.version) == (6, 6), ok.state
+        await engine.stop()
+        log.close()
+
+    asyncio.run(scenario())
+    broker.stop()
+
+
+def test_txn_dedup_survives_broker_restart(tmp_path):
+    """The idempotency window must not die with the broker: __txn_state
+    persists (txn_id -> seq, record locations) with each commit, the restarted
+    broker recovers it, OpenProducer resumes the client's numbering, and a
+    replayed seq is answered with the ORIGINAL reply (rebuilt by re-reading
+    the committed records) — never appended twice. A replayed seq with a
+    DIFFERENT payload is refused loudly."""
+    broker = LogServer(FileLogFactory(tmp_path)())
+    port = broker.start()
+    client = GrpcLogTransport(f"127.0.0.1:{port}")
+    client.create_topic(TopicSpec("events", 1))
+    p = client.transactional_producer("txn-0")
+    p.begin()
+    p.send(rec("events", "k", b"v0"))
+    out = p.commit()  # seq 1
+    assert [r.offset for r in out] == [0]
+    end_before = client.end_offset("events", 0)
+    client.close()
+    broker.stop(grace=0.1)
+
+    broker2 = LogServer(FileLogFactory(tmp_path)())
+    broker2._port = port
+    assert broker2.start() == port
+    client2 = GrpcLogTransport(f"127.0.0.1:{port}")
+    try:
+        p2 = client2.transactional_producer("txn-0")
+        assert p2._next_seq == 2  # numbering recovered across the restart
+        # the acked-but-reply-lost case: replay seq 1 with the SAME payload
+        replay = client2._transact(p2._token, "commit",
+                                   [rec("events", "k", b"v0")], seq=1)
+        assert replay.ok and [m.offset for m in replay.records] == [0]
+        assert client2.end_offset("events", 0) == end_before  # no re-append
+        # and replaying it with a DIFFERENT payload is refused, not absorbed
+        bad = client2._transact(p2._token, "commit",
+                                [rec("events", "k", b"OTHER")], seq=1)
+        assert not bad.ok and bad.error_kind == "state"
+        assert client2.end_offset("events", 0) == end_before
+        # normal traffic resumes at the next seq
+        p2.begin()
+        p2.send(rec("events", "k", b"v1"))
+        out2 = p2.commit()
+        assert out2[0].offset == end_before
+    finally:
+        client2.close()
+        broker2.stop()
+
+
+def FileLogFactory(tmp_path):
+    from surge_tpu.log.file import FileLog
+
+    def make():
+        return FileLog(str(tmp_path / "broker"))
+
+    return make
+
+
+def test_engine_exact_counts_across_repeated_broker_bounces(tmp_path):
+    """The exactly-once ledger under the worst single-broker weather: the
+    FileLog-backed broker bounces repeatedly while commands flow. Every
+    CommandSuccess acked to the caller is counted, and the final aggregate
+    states must equal the acked counts EXACTLY — the durable __txn_state
+    dedup plus the publisher's verbatim-batch retry make an
+    acked-then-bounced commit impossible to double-apply and an
+    unacked-landed one impossible to lose or duplicate on retry."""
+    from surge_tpu import SurgeCommandBusinessLogic, create_engine, default_config
+    from surge_tpu.engine.entity import CommandSuccess
+    from surge_tpu.log.file import FileLog
+    from surge_tpu.models import counter
+
+    broker = LogServer(FileLog(str(tmp_path / "b")))
+    port = broker.start()
+    cfg = default_config().with_overrides({
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 10,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.aggregate.publish-timeout-ms": 2000,
+        "surge.engine.num-partitions": 2,
+    })
+
+    async def scenario():
+        nonlocal broker
+        log = GrpcLogTransport(f"127.0.0.1:{port}", config=cfg)
+        engine = create_engine(
+            SurgeCommandBusinessLogic(
+                aggregate_name="counter", model=counter.CounterModel(),
+                state_format=counter.state_formatting(),
+                event_format=counter.event_formatting()),
+            log=log, config=cfg)
+        await engine.start()
+        acked = {f"agg-{i}": 0 for i in range(4)}
+
+        async def send_ok(agg):
+            for _ in range(120):
+                r = await engine.aggregate_for(agg).send_command(
+                    counter.Increment(agg))
+                if isinstance(r, CommandSuccess):
+                    acked[agg] += 1
+                    return
+                await asyncio.sleep(0.1)
+            raise AssertionError(f"command never succeeded for {agg}")
+
+        for bounce in range(3):
+            for agg in acked:
+                await send_ok(agg)
+            broker.stop(grace=0.05)
+            await asyncio.sleep(0.3)
+            broker = LogServer(FileLog(str(tmp_path / "b")))
+            broker._port = port
+            assert broker.start() == port
+            for agg in acked:
+                await send_ok(agg)
+
+        for agg, n in acked.items():
+            st = await engine.aggregate_for(agg).get_state()
+            assert (st.count, st.version) == (n, n), (agg, st, n)
+        await engine.stop()
+        log.close()
+
+    asyncio.run(scenario())
+    broker.stop()

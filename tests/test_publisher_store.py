@@ -13,6 +13,7 @@ from surge_tpu.config import default_config
 from surge_tpu.engine.publisher import (
     PartitionPublisher,
     PublishFailedError,
+    PublisherNotReadyError,
 )
 from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
 from surge_tpu.models import counter
@@ -136,7 +137,9 @@ def test_zombie_fenced_batch_fails_and_shuts_down_when_not_owner():
 
         # an impostor takes over the transactional id (new process owns the partition)
         log.transactional_producer(pub.transactional_id)
-        with pytest.raises(PublishFailedError):
+        with pytest.raises((PublishFailedError, PublisherNotReadyError)):
+            # ownership is gone: the publisher shuts down and the held
+            # batch's waiter is released with the shutdown error
             await pub.publish("a", [event_rec("a", b"zombie")], "r1")
         assert pub.stats.fences == 1
         assert pub.state == "stopped"  # not owner -> shutdown
@@ -147,19 +150,25 @@ def test_zombie_fenced_batch_fails_and_shuts_down_when_not_owner():
 
 
 def test_fenced_but_still_owner_reinitializes_and_serves_again():
+    """Fencing while still the owner is now TRANSPARENT to the caller: the
+    in-flight batch rides the verbatim-retry stash across the re-init (new
+    epoch) and commits exactly once — no error surfaces, nothing doubles."""
     async def scenario():
         log = make_log()
         indexer, pub = await start_stack(log, still_owner=lambda: True)
 
         log.transactional_producer(pub.transactional_id)  # fence it once
-        with pytest.raises(PublishFailedError):
-            await pub.publish("a", [event_rec("a", b"lost")], "r1")
+        await pub.publish("a", [event_rec("a", b"held")], "r1")
         await pub.wait_ready(5.0)  # re-initialized with a fresh epoch
         assert pub.stats.reinitializations == 1
         assert pub.state == "processing"
+        assert [r.value for r in log.read("events", 0)] == [b"held"]
 
-        await pub.publish("a", [event_rec("a", b"retry")], "r1-retry")
-        assert [r.value for r in log.read("events", 0)] == [b"retry"]
+        await pub.publish("a", [event_rec("a", b"next")], "r2")
+        assert [r.value for r in log.read("events", 0)] == [b"held", b"next"]
+        # a late same-request_id retry of the held batch is absorbed
+        await pub.publish("a", [event_rec("a", b"held")], "r1")
+        assert [r.value for r in log.read("events", 0)] == [b"held", b"next"]
         await pub.stop()
         await indexer.stop()
 
@@ -502,6 +511,79 @@ def test_non_transactional_mid_batch_failure_resumes_exactly_once():
         pub._refresh_watermark()
         for agg in ("a", "b", "c"):
             assert pub.is_aggregate_state_current(agg), agg
+
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_background_loops_survive_internal_bugs():
+    """The flush loop, progress loop, and indexer partition loops must never
+    die silently on an unexpected exception (the partition would stall with
+    no root cause): one poisoned iteration logs and the next works."""
+    import unittest.mock as mock
+
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log)
+
+        # 1. flush loop: one publish blows up unexpectedly -> the batch's
+        # waiter gets an error eventually (or times out), but the NEXT tick
+        # still publishes
+        real = PartitionPublisher._publish_batch
+        calls = {"n": 0}
+
+        async def boom(self, batch):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("bookkeeping bug")
+            return await real(self, batch)
+
+        with mock.patch.object(PartitionPublisher, "_publish_batch", boom):
+            t1 = asyncio.create_task(pub.publish(
+                "a", [event_rec("a", b"e1"), state_rec("a", b"s1")], "r1"))
+            # first tick eats the bug; the loop must survive it
+            await asyncio.sleep(0.15)
+            assert pub._flush_task.running
+            t2 = asyncio.create_task(pub.publish(
+                "a", [event_rec("a", b"e2"), state_rec("a", b"s2")], "r2"))
+            await asyncio.wait_for(t2, 5.0)
+        assert calls["n"] >= 2
+        end_after = log.end_offset("state", 0)
+        assert end_after >= 2  # init flush record + the second batch
+        # the poisoned batch's waiter is FAILED (never left hanging): the
+        # entity ladder retries with the same request_id
+        with pytest.raises(Exception):
+            await asyncio.wait_for(t1, 2.0)
+
+        # 2. progress loop: watermark refresh raising must not kill it
+        with mock.patch.object(type(indexer), "indexed_watermark",
+                               side_effect=RuntimeError("store glitch")):
+            await asyncio.sleep(0.05)
+        assert pub._progress_task.running
+
+        # 3. indexer loop: transient read failures retry instead of dying
+        real_read = log.read
+        fails = {"n": 0}
+
+        def flaky_read(topic, partition, *a, **k):
+            if fails["n"] < 2:
+                fails["n"] += 1
+                raise ConnectionError("broker briefly unreachable")
+            return real_read(topic, partition, *a, **k)
+
+        with mock.patch.object(log, "read", side_effect=flaky_read):
+            prod = log.transactional_producer("seed")
+            prod.begin()
+            prod.send(state_rec("z", b"zv"))
+            prod.commit()
+            for _ in range(100):
+                if indexer.store.get("z") == b"zv":
+                    break
+                await asyncio.sleep(0.05)
+        assert indexer.store.get("z") == b"zv"
+        assert fails["n"] == 2
 
         await pub.stop()
         await indexer.stop()
