@@ -234,6 +234,14 @@ def run_sweep(artifact_path: str = ARTIFACT, *,
     caller to apply to a subsequent full-scale run via the SURGE_BENCH_* env)."""
     sys.path.insert(0, REPO)
     art = Artifact(artifact_path)
+    try:
+        import subprocess
+
+        art.update(repo_commit=subprocess.run(
+            ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip())
+    except Exception:  # noqa: BLE001 — provenance only
+        pass
 
     t0 = time.perf_counter()
     import jax
