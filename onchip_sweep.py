@@ -246,7 +246,13 @@ def run_sweep(artifact_path: str = ARTIFACT, *,
     t0 = time.perf_counter()
     import jax
 
-    devices = jax.devices()  # may hang ~25 min and raise if the pool is down
+    try:
+        devices = jax.devices()  # may hang ~25 min, raise if the pool is down
+    except Exception as exc:
+        # the leftover artifact must say WHY there is no on-chip data
+        art.update(claim_error=f"{type(exc).__name__}: {str(exc)[:300]}",
+                   claim_s=round(time.perf_counter() - t0, 1))
+        raise
     claim_s = time.perf_counter() - t0
     platform = devices[0].platform
     art.update(platform=platform, device=str(devices[0]),
