@@ -88,8 +88,9 @@ def _probe_link(jax) -> dict:
     return out
 
 
-def _smoke_corpus(cache_dir: str, num_agg: int, num_events: int):
-    """Build-or-load the smoke corpus + packed wire (cached across attempts).
+def ensure_corpus_cache(cache_dir: str, num_agg: int, num_events: int,
+                        seed: int) -> None:
+    """Build the corpus + packed wire at ``cache_dir`` unless already cached.
 
     Crash-safe: the cache is only trusted when its ``complete.json`` marker —
     written LAST — exists and records the same corpus sizes; anything else
@@ -98,31 +99,36 @@ def _smoke_corpus(cache_dir: str, num_agg: int, num_events: int):
     exact outcome this module exists to prevent."""
     import shutil
 
-    from bench import load_corpus, make_engine, save_corpus
+    from bench import make_engine, save_corpus
     from surge_tpu.replay.corpus import synth_counter_corpus
-    from surge_tpu.replay.engine import ResidentWire
 
     marker = os.path.join(cache_dir, "complete.json")
     want = {"num_aggregates": num_agg, "num_events": num_events}
-    valid = False
     if os.path.exists(marker):
         try:
             with open(marker) as f:
-                valid = json.load(f) == want
+                if json.load(f) == want:
+                    return
         except (OSError, ValueError):
-            valid = False
-    if not valid:
-        shutil.rmtree(cache_dir, ignore_errors=True)
-        os.makedirs(cache_dir)
-        corpus = synth_counter_corpus(num_agg, num_events, seed=43,
-                                      sort_by_length=True)
-        save_corpus(corpus, cache_dir)
-        make_engine().pack_resident(corpus.events).save(
-            os.path.join(cache_dir, "wire"))
-        tmp = marker + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(want, f)
-        os.replace(tmp, marker)
+            pass
+    shutil.rmtree(cache_dir, ignore_errors=True)
+    os.makedirs(cache_dir)
+    corpus = synth_counter_corpus(num_agg, num_events, seed=seed,
+                                  sort_by_length=True)
+    save_corpus(corpus, cache_dir)
+    make_engine().pack_resident(corpus.events).save(
+        os.path.join(cache_dir, "wire"))
+    tmp = marker + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(want, f)
+    os.replace(tmp, marker)
+
+
+def _smoke_corpus(cache_dir: str, num_agg: int, num_events: int):
+    """Build-or-load the smoke corpus + packed wire (cached across attempts)."""
+    from surge_tpu.replay.engine import ResidentWire
+
+    ensure_corpus_cache(cache_dir, num_agg, num_events, seed=43)
     expected = {
         "count": np.load(os.path.join(cache_dir, "expected_count.npy")),
         "version": np.load(os.path.join(cache_dir, "expected_version.npy")),

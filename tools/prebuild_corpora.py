@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Pre-build the on-chip sweep's corpora on the host CPU.
+
+Run this BEFORE the TPU retry loop so a successful tunnel claim spends its
+window measuring, not synthesizing: the smoke cache (50k/5M) and the full
+corpus (1M/100M, with packed wire) land on disk and `onchip_sweep.run_sweep`
+finds both via its crash-safe markers.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# host-only: never touch the tunneled backend from this process
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.pop("AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+SMOKE = os.environ.get("SURGE_ONCHIP_CACHE", "/tmp/corpus_smoke5m")
+FULL = os.environ.get("SURGE_ONCHIP_FULL", "/tmp/corpus_full100m")
+
+
+if __name__ == "__main__":
+    from onchip_sweep import ensure_corpus_cache
+
+    t0 = time.perf_counter()
+    ensure_corpus_cache(SMOKE, 50_000, 5_000_000, seed=43)
+    print(f"smoke cache ready: {SMOKE} ({time.perf_counter() - t0:.1f}s)",
+          flush=True)
+    t0 = time.perf_counter()
+    # seed 42 = bench.py main's corpus, so sweep results are comparable
+    ensure_corpus_cache(FULL, 1_000_000, 100_000_000, seed=42)
+    print(f"full cache ready: {FULL} ({time.perf_counter() - t0:.1f}s)",
+          flush=True)
+    print("prebuild done", flush=True)

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Background TPU claim/sweep retry loop.
+
+Each axon claim pends up to ~25 minutes before the pool answers; four rounds
+of single-shot attempts produced zero on-chip artifacts.  This loop keeps one
+claim outstanding at a time for the whole session: run `onchip_sweep` as a
+subprocess (fresh process per attempt — a failed backend poisons the jax
+runtime it initialized in), check whether `BENCH_ONCHIP.json` banked real
+silicon numbers, and stop the moment it did.
+
+Stand this down (kill the process) before the driver's own bench run so two
+claims never race on the tunnel.  Writes a heartbeat log to its stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "BENCH_ONCHIP.json")
+FULL = os.environ.get("SURGE_ONCHIP_FULL", "/tmp/corpus_full100m")
+DEADLINE_UTC = os.environ.get("SURGE_RETRY_DEADLINE", "")  # "HH:MM" today, UTC
+
+
+def _deadline_epoch() -> float:
+    """Resolve HH:MM (UTC, today — or tomorrow if already past) to an epoch
+    once at startup, so an attempt that pends across midnight still stops."""
+    if not DEADLINE_UTC:
+        return float("inf")
+    try:
+        hh, mm = (int(x) for x in DEADLINE_UTC.split(":"))
+    except ValueError:
+        return float("inf")
+    now = time.time()
+    g = time.gmtime(now)
+    import calendar
+
+    target = calendar.timegm((g.tm_year, g.tm_mon, g.tm_mday, hh, mm, 0, 0, 0, 0))
+    return target if target > now else target + 86400.0
+
+
+DEADLINE_EPOCH = _deadline_epoch()
+
+
+def banked() -> bool:
+    """True only when the artifact holds at least one real on-chip measurement
+    (every smoke row can be an {"error": ...} dict — those don't count)."""
+    try:
+        with open(ARTIFACT) as f:
+            art = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if art.get("platform") in (None, "cpu"):
+        return False
+    return any(c.get("verified") and "events_per_sec" in c
+               for c in art.get("smoke", {}).get("configs", []))
+
+
+def main() -> None:
+    attempt = 0
+    while not banked():
+        if time.time() >= DEADLINE_EPOCH:
+            print(f"[{time.strftime('%H:%M:%S')}] deadline {DEADLINE_UTC}Z "
+                  "reached; standing down", flush=True)
+            return
+        attempt += 1
+        # trust the corpus only once its last-written marker exists — a dir
+        # alone may be a partial build (prebuild killed mid-synth)
+        full = FULL if os.path.exists(os.path.join(FULL, "complete.json")) else ""
+        cmd = [sys.executable, os.path.join(REPO, "onchip_sweep.py")]
+        if full:
+            cmd.append(full)
+        print(f"[{time.strftime('%H:%M:%S')}] attempt {attempt}: {cmd}",
+              flush=True)
+        t0 = time.perf_counter()
+        proc = subprocess.run(cmd, cwd=REPO)
+        dt = time.perf_counter() - t0
+        print(f"[{time.strftime('%H:%M:%S')}] attempt {attempt} exited "
+              f"rc={proc.returncode} after {dt:.0f}s", flush=True)
+        if banked():
+            break
+        # pool answered fast (hard refuse) -> don't hammer; pool pended the
+        # full ~25 min -> re-queue immediately, the wait IS the backoff
+        time.sleep(120 if dt < 300 else 10)
+    if banked():
+        print(f"[{time.strftime('%H:%M:%S')}] on-chip artifact banked; done",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
