@@ -103,7 +103,7 @@ def ensure_corpus_cache(cache_dir: str, num_agg: int, num_events: int,
     from surge_tpu.replay.corpus import synth_counter_corpus
 
     marker = os.path.join(cache_dir, "complete.json")
-    want = {"num_aggregates": num_agg, "num_events": num_events}
+    want = {"num_aggregates": num_agg, "num_events": num_events, "seed": seed}
     if os.path.exists(marker):
         try:
             with open(marker) as f:

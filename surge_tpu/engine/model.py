@@ -129,6 +129,12 @@ class ReplaySpec:
     registry: SchemaRegistry
     handlers: ReplayHandlers
     init_record: Dict[str, Any] = field(default_factory=dict)
+    #: optional AssociativeFold (surge_tpu.replay.seqpar) — when present, the
+    #: replay engine's ``auto`` tile backend folds each tile by lift +
+    #: order-preserving tree reduction instead of a sequential time scan
+    #: (~58 µs/step loop machinery on the v5e, BENCH_ONCHIP.json), and the
+    #: time axis can shard across a mesh. Law-checked on first use.
+    associative: Any = None
 
     def init_state_tree(self) -> StateTree:
         """Scalar init record with schema-complete columns (missing fields → 0)."""

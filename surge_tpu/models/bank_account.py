@@ -189,6 +189,7 @@ def make_replay_spec() -> ReplaySpec:
         registry=make_registry(),
         handlers=ReplayHandlers({CREATED: created, UPDATED: updated}),
         init_record={"created": False, "owner_code": 0, "security_code_code": 0, "balance": 0.0},
+        associative=make_associative_fold(),
     )
 
 

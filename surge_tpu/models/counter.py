@@ -191,6 +191,7 @@ def make_replay_spec() -> ReplaySpec:
         handlers=ReplayHandlers({INCREMENTED: incremented, DECREMENTED: decremented,
                                  UNSERIALIZABLE: unserializable}),
         init_record={"count": 0, "version": 0},
+        associative=make_associative_fold(),
     )
 
 

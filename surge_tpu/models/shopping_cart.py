@@ -151,6 +151,7 @@ def make_replay_spec() -> ReplaySpec:
         registry=make_registry(),
         handlers=ReplayHandlers({ADDED: added, REMOVED: removed, CHECKED_OUT: checked_out}),
         init_record={"item_count": 0, "total_cents": 0, "checked_out": False, "version": 0},
+        associative=make_associative_fold(),
     )
 
 

@@ -180,13 +180,7 @@ def replay_time_sharded(afold: AssociativeFold, spec, events: Mapping[str, Any],
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if validate:
-        # keyed on the (fold, spec) PAIR: the laws tie a decomposition to one
-        # spec's handlers — the same fold against a different spec must be
-        # re-checked, not skipped
-        vkey = (fold_key(afold), _spec_key(spec))
-        if vkey not in _VALIDATED:
-            check_associative_fold(afold, spec)
-            _VALIDATED.add(vkey)
+        ensure_validated(afold, spec)
 
     n_dev = int(np.prod(mesh.devices.shape))
     t = next(iter(events.values())).shape[0]
@@ -235,6 +229,18 @@ _PROGRAMS: dict = {}
 
 #: structural fold keys that already passed check_associative_fold
 _VALIDATED: set = set()
+
+
+def ensure_validated(afold: AssociativeFold, spec) -> None:
+    """Law-check ``afold`` against ``spec`` once per structural (fold, spec)
+    pair — keyed on the PAIR because the laws tie a decomposition to one
+    spec's handlers; the same fold against a different spec must be
+    re-checked, not skipped. Shared by the time-sharded replay and the
+    engine's assoc tile backend."""
+    vkey = (fold_key(afold), _spec_key(spec))
+    if vkey not in _VALIDATED:
+        check_associative_fold(afold, spec)
+        _VALIDATED.add(vkey)
 
 
 def _hash_or_id(v):
