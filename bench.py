@@ -121,7 +121,11 @@ def make_engine():
         # sweep overrides with whatever measures best on chip
         "surge.replay.time-chunk": int(os.environ.get("SURGE_BENCH_TIME_CHUNK", 64)),
         "surge.replay.dispatch": os.environ.get("SURGE_BENCH_DISPATCH", "switch"),
-        "surge.replay.tile-backend": os.environ.get("SURGE_BENCH_TILE", "xla"),
+        # auto: assoc tree fold for models with an AssociativeFold, dense
+        # pre-gathered tiles on accelerators (the r5 on-chip redesign)
+        "surge.replay.tile-backend": os.environ.get("SURGE_BENCH_TILE", "auto"),
+        "surge.replay.resident-layout": os.environ.get("SURGE_BENCH_LAYOUT",
+                                                       "auto"),
         "surge.replay.upload-chunk-mb": int(
             os.environ.get("SURGE_BENCH_UPLOAD_CHUNK_MB", 0)),
         # single corpus, explicit warm: exact buffer length, no bucket padding
@@ -284,6 +288,8 @@ def replay_child(corpus_dir: str) -> None:
         "knobs": {"dispatch": engine._dispatch, "unroll": engine._unroll,
                   "time_chunk": engine.time_chunk, "batch": engine.batch_size,
                   "tile": engine._tile_backend,
+                  "layout": engine._resident_layout,
+                  "densify_s": round(engine.stats["densify_s"], 2),
                   "upload_chunk_mb": engine.config.get_int(
                       "surge.replay.upload-chunk-mb", 0)},
         **extra_timing,

@@ -151,15 +151,19 @@ def _engine(overrides: dict, unroll: int):
 
 
 def _run_config(wire, expected, *, dispatch="switch", unroll=1, time_chunk=128,
-                tile="xla", chunk_mb=0, passes=3) -> dict:
+                tile="auto", layout="auto", batch=8192, chunk_mb=0,
+                passes=3) -> dict:
     """Upload + warm + throwaway + timed passes for one knob combination."""
     cfg = {"dispatch": dispatch, "unroll": unroll, "time_chunk": time_chunk,
-           "tile": tile, "chunk_mb": chunk_mb}
+           "tile": tile, "layout": layout, "batch": batch,
+           "chunk_mb": chunk_mb}
     try:
         eng = _engine({
             "surge.replay.time-chunk": time_chunk,
             "surge.replay.dispatch": dispatch,
             "surge.replay.tile-backend": tile,
+            "surge.replay.resident-layout": layout,
+            "surge.replay.batch-size": batch,
             "surge.replay.upload-chunk-mb": chunk_mb,
         }, unroll)
         t0 = time.perf_counter()
@@ -205,20 +209,22 @@ def _run_streamed(wire, expected, segments: int) -> dict:
 
 
 SMOKE_CONFIGS = (
-    dict(dispatch="switch", unroll=1),
-    dict(dispatch="select", unroll=1),
-    dict(dispatch="switch", unroll=8),
-    dict(dispatch="select", unroll=8),
-    dict(dispatch="select", unroll=4, time_chunk=256),
-    # narrower tiles cut the time-axis tail padding (measured on CPU at 10M:
-    # pad 1.80 -> 1.30 and +11% rate at tc=32); whether the extra tile count
-    # pays for itself against the TPU's per-tile loop cost is exactly what
-    # this sweep decides (VERDICT r4 weak #4)
-    dict(dispatch="switch", unroll=1, time_chunk=64),
-    dict(dispatch="switch", unroll=1, time_chunk=32),
-    dict(dispatch="switch", unroll=1, chunk_mb=16),
-    dict(dispatch="select", unroll=1, tile="pallas"),
-    dict(dispatch="select", unroll=4, tile="pallas"),
+    # expected winner after the r5 redesign: dense pre-gathered tiles + the
+    # assoc tree-reduction fold + u16 single-fetch pull (all defaults)
+    dict(),
+    # isolate each r5 lever against the winner
+    dict(tile="xla"),                      # dense tiles, sequential scan
+    dict(tile="assoc", layout="flat"),     # per-pass gather, tree fold
+    dict(tile="xla", layout="flat"),       # the r4 baseline program
+    # dispatch form + pallas kernel comparison on the dense layout
+    dict(dispatch="select"),
+    dict(dispatch="select", tile="pallas"),
+    # tile geometry under assoc: pad ratio vs tile count
+    dict(time_chunk=64),
+    dict(time_chunk=256),
+    dict(batch=32768),
+    # upload pipelining (the one-time cost; chunked H2D measured 25% faster)
+    dict(chunk_mb=16),
 )
 
 
@@ -310,12 +316,13 @@ def run_sweep(artifact_path: str = ARTIFACT, *,
         }
         full: dict = {"num_events": int(fwire.num_events), "configs": []}
         art.update(full=full)
-        contenders = [dict(dispatch="switch", unroll=1)]
+        contenders = [dict()]  # all-auto defaults (dense + assoc where legal)
         if best:
             contenders.append({k: best[k] for k in
                                ("dispatch", "unroll", "time_chunk", "tile",
-                                "chunk_mb") if k in best})
-        contenders.append(dict(dispatch="switch", unroll=1, chunk_mb=16))
+                                "layout", "batch", "chunk_mb") if k in best})
+        contenders.append(dict(chunk_mb=16))
+        contenders.append(dict(tile="xla", layout="flat"))  # r4 baseline delta
         seen: set = set()
         for kw in contenders:
             key = tuple(sorted(kw.items()))
@@ -345,7 +352,9 @@ def best_to_env(best: dict) -> dict:
     return {"SURGE_BENCH_DISPATCH": str(best.get("dispatch", "switch")),
             "SURGE_BENCH_UNROLL": str(best.get("unroll", 1)),
             "SURGE_BENCH_TIME_CHUNK": str(best.get("time_chunk", 128)),
-            "SURGE_BENCH_TILE": str(best.get("tile", "xla")),
+            "SURGE_BENCH_TILE": str(best.get("tile", "auto")),
+            "SURGE_BENCH_LAYOUT": str(best.get("layout", "auto")),
+            "SURGE_BENCH_BATCH": str(best.get("batch", 8192)),
             "SURGE_BENCH_UPLOAD_CHUNK_MB": str(best.get("chunk_mb", 0))}
 
 
