@@ -144,20 +144,15 @@ def replay_child(corpus_dir: str) -> None:
     platform = devices[0].platform
     log(f"child backend up: platform={platform} devices={devices}")
 
-    # On a real accelerator, bank a machine-readable on-chip artifact IMMEDIATELY
-    # (smoke-scale sweep over the prepared knobs -> BENCH_ONCHIP.json, rewritten
-    # after every measurement) before betting the window on the full-scale run;
-    # the sweep's winning knobs then tune this child's headline measurement.
-    if platform != "cpu" and os.environ.get("SURGE_BENCH_ONCHIP", "1") == "1":
-        try:
-            import onchip_sweep
-
-            best = onchip_sweep.run_sweep()
-            for k, v in onchip_sweep.best_to_env(best).items():
-                os.environ.setdefault(k, v)  # explicit user knobs win
-            log(f"on-chip smoke sweep banked (BENCH_ONCHIP.json); best={best}")
-        except Exception as exc:  # noqa: BLE001 — sweep failure must not void the run
-            log(f"on-chip sweep failed (continuing to full scale): {exc!r}")
+    # Pre-r5 the smoke sweep ran FIRST to convert a rare claim window into an
+    # artifact before betting on full scale. Claims are instant now, and the
+    # sweep measurably degrades subsequent uploads in the same process
+    # (100 MB put: 0.34 s clean → 3.1 s post-sweep, gc+sync doesn't recover
+    # it) — so the full-scale measurement runs on the clean runtime and the
+    # sweep banks BENCH_ONCHIP.json AFTERWARDS (see end of this function).
+    # Smoke-best knob feedback is retired for the same reason its gating kept
+    # rejecting it: smoke rates are latency-floored noise; the auto defaults
+    # ARE the measured-best full-scale config.
 
     from surge_tpu.models.counter import make_replay_spec
 
@@ -233,6 +228,9 @@ def replay_child(corpus_dir: str) -> None:
             # not replay — the timed pass still re-uploads its per-replay
             # inputs and re-folds every event
             engine.warm_resident(resident)
+            # under the dense layout the warm pass runs the one-time tile
+            # gather — a COLD cost, charged to replay_s below
+            densify_s = engine.stats["densify_s"]
             engine.replay_resident(resident)
             engine.stats["windows"] = 0  # count only the timed pass's windows
             warm_compiles = engine.num_compiles()
@@ -245,9 +243,24 @@ def replay_child(corpus_dir: str) -> None:
             if engine.num_compiles() != warm_compiles:
                 log(f"WARNING: {engine.num_compiles() - warm_compiles} "
                     f"program(s) compiled INSIDE the timed window (warmup gap)")
-            replay_s = prepare_s + fold_s
+            # steady regime: the corpus is resident (standby refresh,
+            # repeated rebuilds) — where the accelerator is transfer-free.
+            # snapshot the timed pass's window count first so the payload
+            # reports it un-inflated by these extra passes
+            timed_windows = engine.stats["windows"]
+            steady_s = fold_s
+            for _ in range(2):
+                t0 = time.perf_counter()
+                result = engine.replay_resident(resident)
+                steady_s = min(steady_s, time.perf_counter() - t0)
+            engine.stats["windows"] = timed_windows
+            replay_s = prepare_s + densify_s + fold_s
             extra_timing = {"upload_s": round(resident.upload_s, 2),
+                            "densify_s": round(densify_s, 2),
                             "fold_s": round(fold_s, 2),
+                            "steady_replay_s": round(steady_s, 3),
+                            "steady_events_per_sec": round(
+                                corpus.num_events / steady_s),
                             "wire_mb": round(resident.wire_bytes / 1e6, 1)}
     else:
         t0 = time.perf_counter()
@@ -287,7 +300,7 @@ def replay_child(corpus_dir: str) -> None:
         "num_aggregates": corpus.num_aggregates,
         "knobs": {"dispatch": engine._dispatch, "unroll": engine._unroll,
                   "time_chunk": engine.time_chunk, "batch": engine.batch_size,
-                  "tile": engine._tile_backend,
+                  "tile": engine.tile_backend,
                   "layout": engine._resident_layout,
                   "densify_s": round(engine.stats["densify_s"], 2),
                   "upload_chunk_mb": engine.config.get_int(
@@ -298,6 +311,20 @@ def replay_child(corpus_dir: str) -> None:
         f"{eps:,.0f} events/s (pad {payload['pad_ratio']}, pack {payload['pack_s']}s, "
         f"{payload['windows']} windows, {payload['compiles']} programs, verified)")
     print(json.dumps(payload), flush=True)
+
+    # the measurement is on stdout; NOW bank the on-chip sweep artifact (its
+    # runtime-degrading side effects can no longer touch the timed numbers)
+    if platform != "cpu" and os.environ.get("SURGE_BENCH_ONCHIP", "1") == "1":
+        try:
+            import onchip_sweep
+
+            # corpus_dir holds expected_* arrays + the packed wire, which is
+            # exactly run_sweep's full-corpus layout — bank the full-scale
+            # sweep section too, not just smoke
+            best = onchip_sweep.run_sweep(full_corpus_dir=corpus_dir)
+            log(f"on-chip sweep banked (BENCH_ONCHIP.json); smoke best={best}")
+        except Exception as exc:  # noqa: BLE001 — artifact-only, never voids the run
+            log(f"on-chip sweep failed (artifact may be partial): {exc!r}")
 
 
 def _device_resident_fold_rate(engine, corpus) -> float:
@@ -491,7 +518,8 @@ def _merge_replay(payload: dict, child: dict, cpu_eps: float) -> None:
     payload["vs_baseline"] = round(child["events_per_sec"] / cpu_eps, 2) if cpu_eps else 0
     for k in ("platform", "aggregates_per_sec", "replay_s", "pad_ratio", "pack_s",
               "h2d_s", "windows", "compiles", "device_fold_events_per_sec",
-              "upload_s", "fold_s", "wire_mb", "stream_segments", "knobs"):
+              "upload_s", "densify_s", "fold_s", "steady_replay_s",
+              "steady_events_per_sec", "wire_mb", "stream_segments", "knobs"):
         if k in child:
             payload[k] = child[k]
     # End-to-end cold-start accounting (VERDICT r4 missing #3), matching how
@@ -621,8 +649,30 @@ def main() -> None:
         if os.environ.get("SURGE_BENCH_TPU", "1") == "1" and tpu_possible:
             tpu_child = run_replay_child(dict(orig_env), corpus_dir, "tpu")
             if tpu_child is not None and tpu_child["platform"] != "cpu":
-                _merge_replay(payload, tpu_child, cpu_eps)
+                # record the silicon numbers unconditionally; the HEADLINE
+                # takes the platform whose END-TO-END cold replay is faster.
+                # Through this tunnel the cold path is transfer-bound (h2d +
+                # the ~25 MB/s d2h state pull), so the host can win cold while
+                # the chip wins the steady resident regime by ~2× and the pure
+                # fold by ~25× — all three are recorded (docs/roofline.md)
+                for k in ("events_per_sec", "replay_s", "steady_replay_s",
+                          "steady_events_per_sec", "device_fold_events_per_sec",
+                          "upload_s", "densify_s", "fold_s", "pad_ratio",
+                          "knobs"):
+                    if k in tpu_child:
+                        payload[f"tpu_{k}"] = tpu_child[k]
+                if cpu_eps and tpu_child.get("steady_events_per_sec"):
+                    payload["vs_baseline_tpu_steady"] = round(
+                        tpu_child["steady_events_per_sec"] / cpu_eps, 2)
+                if tpu_child["events_per_sec"] >= payload["value"]:
+                    _merge_replay(payload, tpu_child, cpu_eps)
+                else:
+                    log("tpu cold end-to-end below the host number; headline "
+                        "stays cpu (tpu_* fields + BENCH_ONCHIP.json carry "
+                        "the silicon evidence)")
                 log(f"speedup vs scalar CPU fold: {payload['vs_baseline']}x "
+                    f"cold on {payload['platform']}; tpu steady "
+                    f"{payload.get('vs_baseline_tpu_steady', 0)}x "
                     f"(target >=50x)")
                 emit(payload)
             elif tpu_child is not None:

@@ -242,8 +242,9 @@ def run_sweep(artifact_path: str = ARTIFACT, *,
               smoke_aggregates: int = 50_000, smoke_events: int = 5_000_000,
               smoke_cache: str | None = None,
               full_corpus_dir: str | None = None) -> dict:
-    """The whole sweep.  Returns the best smoke config's knob dict (for the
-    caller to apply to a subsequent full-scale run via the SURGE_BENCH_* env)."""
+    """The whole sweep.  Returns the best smoke config's knob dict (smoke
+    rates are tunnel-latency-floored — informational, not a tuning signal;
+    the ``full`` section of the artifact carries the decisive numbers)."""
     sys.path.insert(0, REPO)
     art = Artifact(artifact_path)
     try:
@@ -343,19 +344,6 @@ def run_sweep(artifact_path: str = ARTIFACT, *,
 
     art.update(done=True)
     return best
-
-
-def best_to_env(best: dict) -> dict:
-    """Map a sweep row back onto the SURGE_BENCH_* knobs bench.py reads."""
-    if not best:
-        return {}
-    return {"SURGE_BENCH_DISPATCH": str(best.get("dispatch", "switch")),
-            "SURGE_BENCH_UNROLL": str(best.get("unroll", 1)),
-            "SURGE_BENCH_TIME_CHUNK": str(best.get("time_chunk", 128)),
-            "SURGE_BENCH_TILE": str(best.get("tile", "auto")),
-            "SURGE_BENCH_LAYOUT": str(best.get("layout", "auto")),
-            "SURGE_BENCH_BATCH": str(best.get("batch", 8192)),
-            "SURGE_BENCH_UPLOAD_CHUNK_MB": str(best.get("chunk_mb", 0))}
 
 
 if __name__ == "__main__":

@@ -529,18 +529,10 @@ class ReplayEngine:
             raise ValueError(
                 f"unknown surge.replay.tile-backend "
                 f"{self._tile_backend!r} (auto|xla|pallas|assoc)")
-        if self._tile_backend == "auto":
-            # assoc when the model ships a (law-checked) decomposition: the
-            # tree reduction replaces the scan's per-step loop machinery —
-            # the measured on-chip bottleneck (BENCH_ONCHIP.json r5) — and
-            # degrades to the identical result by the homomorphism law.
-            # assoc's pairwise tree needs a power-of-two tile width; a config
-            # that yields an odd width falls back to the scan (only an
-            # EXPLICIT tile-backend=assoc raises on it)
-            w = self.resident_tile_width()
-            self._tile_backend = (
-                "assoc" if getattr(spec, "associative", None) is not None
-                and (w & (w - 1)) == 0 else "xla")
+        # "auto" resolves lazily (the choice is backend-dependent and reading
+        # the backend here would initialize it in engine-constructing
+        # processes that never dispatch)
+        self._tile_backend_resolved: str | None = None
         # resident tile layout: "dense" pre-gathers every tile once per corpus
         # (the per-lane gather is half the on-chip fold cost), "flat" gathers
         # per pass, "auto" picks dense when the buffers fit the HBM budget
@@ -1345,6 +1337,25 @@ class ReplayEngine:
                         jnp.asarray(i0s_p), jnp.asarray(tb_p), np.int32(k_n))
         return slab, plan.padded_slots
 
+    @property
+    def tile_backend(self) -> str:
+        """The resolved tile backend. ``auto`` picks the scanless assoc tree
+        fold only where it measured faster: models shipping a (law-checked)
+        ``AssociativeFold``, power-of-two tile width, and a non-CPU backend —
+        on chip the scan pays ~58 µs/step loop machinery (assoc fold 467M vs
+        scan 60M ev/s, BENCH_ONCHIP.json r5), while the 1-core host runs the
+        scan ~2× FASTER than the tree (401M vs 188M ev/s). Only an EXPLICIT
+        ``tile-backend = assoc`` raises on an unsupported spec/width."""
+        if self._tile_backend != "auto":
+            return self._tile_backend
+        if self._tile_backend_resolved is None:
+            w = self.resident_tile_width()
+            self._tile_backend_resolved = (
+                "assoc" if getattr(self.spec, "associative", None) is not None
+                and (w & (w - 1)) == 0
+                and jax.default_backend() != "cpu" else "xla")
+        return self._tile_backend_resolved
+
     def _plan_for(self, resident: "ResidentCorpus") -> "ResidentPlan":
         """The corpus's tile plan, cached on the corpus (plan geometry only
         depends on engine config + corpus lengths; recomputing the host-side
@@ -1388,6 +1399,10 @@ class ReplayEngine:
             # dense trades memory (pad_ratio × corpus, k_cap-padded) for the
             # accelerator's slow per-lane gather; the host gathers fine and
             # the extra RSS breaks bounded-memory restores
+            return False
+        if plan.padded_slots < 16_000_000:
+            # the densify dispatch+compile carries ~1 s of fixed cost — below
+            # this scale the per-pass gather it saves never adds up to that
             return False
         return self._dense_bytes(resident, plan) <= self._dense_cap_mb * 1024 * 1024
 
@@ -1456,7 +1471,7 @@ class ReplayEngine:
 
         wire = WireFormat(self.spec.registry, dict(key))
         tile = _make_tile_dense(self.spec, wire, width, bs, self._unroll,
-                                self._dispatch, self._tile_backend)
+                                self._dispatch, self.tile_backend)
 
         def fold(slab_state, dense_words, dense_sides, lens_all, ord_all,
                  i0s, t_bases):
@@ -1684,7 +1699,7 @@ class ReplayEngine:
 
         wire = WireFormat(self.spec.registry, dict(key))
         tile = _make_tile(self.spec, wire, width, bs, self._unroll,
-                          self._dispatch, self._tile_backend)
+                          self._dispatch, self.tile_backend)
 
         def fold(slab_state, flat_wire, side_flat, starts_all, lens_all,
                  ord_all, i0s, t_bases, k_n):

@@ -164,7 +164,7 @@ def _sharded_program(engine, key: frozenset, width: int, bs: int, k_cap: int):
 
     wire = WireFormat(engine.spec.registry, dict(key))
     tile = _make_tile(engine.spec, wire, width, bs, engine._unroll,
-                      engine._dispatch, engine._tile_backend)
+                      engine._dispatch, engine.tile_backend)
 
     def local_fold(slab_state, flat_wire, side_flat, starts_all, lens_all,
                    ord_all, i0s, t_bases, k_n):
