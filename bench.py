@@ -312,20 +312,6 @@ def replay_child(corpus_dir: str) -> None:
         f"{payload['windows']} windows, {payload['compiles']} programs, verified)")
     print(json.dumps(payload), flush=True)
 
-    # the measurement is on stdout; NOW bank the on-chip sweep artifact (its
-    # runtime-degrading side effects can no longer touch the timed numbers)
-    if platform != "cpu" and os.environ.get("SURGE_BENCH_ONCHIP", "1") == "1":
-        try:
-            import onchip_sweep
-
-            # corpus_dir holds expected_* arrays + the packed wire, which is
-            # exactly run_sweep's full-corpus layout — bank the full-scale
-            # sweep section too, not just smoke
-            best = onchip_sweep.run_sweep(full_corpus_dir=corpus_dir)
-            log(f"on-chip sweep banked (BENCH_ONCHIP.json); smoke best={best}")
-        except Exception as exc:  # noqa: BLE001 — artifact-only, never voids the run
-            log(f"on-chip sweep failed (artifact may be partial): {exc!r}")
-
 
 def _device_resident_fold_rate(engine, corpus) -> float:
     """Slots/s of the compiled fold with every input already on device (carry
@@ -680,6 +666,20 @@ def main() -> None:
             else:
                 payload["tpu_error"] = "tpu replay child failed (see stderr)"
                 emit(payload)
+            # bank the BENCH_ONCHIP.json sweep in its OWN subprocess now that
+            # the child released the device: a fresh runtime keeps the
+            # artifact's probe/fold numbers clean (an in-process sweep both
+            # degrades later uploads ~10× and, run after the measurement,
+            # banks degraded numbers itself)
+            if os.environ.get("SURGE_BENCH_ONCHIP", "1") == "1":
+                log("banking on-chip sweep artifact (separate process)...")
+                sweep = subprocess.run(
+                    [sys.executable,
+                     os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "onchip_sweep.py"), corpus_dir],
+                    env=dict(orig_env), stdout=subprocess.DEVNULL)
+                log(f"on-chip sweep exited rc={sweep.returncode} "
+                    "(BENCH_ONCHIP.json)")
         elif not tpu_possible:
             log("no accelerator platform configured in the environment; done")
     finally:

@@ -1342,8 +1342,8 @@ class ReplayEngine:
         """The resolved tile backend. ``auto`` picks the scanless assoc tree
         fold only where it measured faster: models shipping a (law-checked)
         ``AssociativeFold``, power-of-two tile width, and a non-CPU backend —
-        on chip the scan pays ~58 µs/step loop machinery (assoc fold 467M vs
-        scan 60M ev/s, BENCH_ONCHIP.json r5), while the 1-core host runs the
+        on chip the scan pays ~58 µs/step loop machinery (assoc fold ~7× the
+        scan at full scale, BENCH_ONCHIP.json r5), while the 1-core host runs the
         scan ~2× FASTER than the tree (401M vs 188M ev/s). Only an EXPLICIT
         ``tile-backend = assoc`` raises on an unsupported spec/width."""
         if self._tile_backend != "auto":

@@ -37,6 +37,17 @@ def _replay(layout: str, tile: str, events, **cfg):
     return eng.replay_resident(eng.prepare_resident(events))
 
 
+def test_auto_tile_backend_resolves_per_backend():
+    """``auto`` must resolve to the scan on CPU hosts (the tree measured ~2×
+    slower there) even though counter ships an AssociativeFold; explicit
+    ``assoc`` is always honored."""
+    eng = ReplayEngine(counter.make_replay_spec())
+    assert eng.tile_backend == "xla"  # conftest pins the cpu backend
+    eng2 = ReplayEngine(counter.make_replay_spec(), config=Config({
+        "surge.replay.tile-backend": "assoc"}))
+    assert eng2.tile_backend == "assoc"
+
+
 @pytest.mark.parametrize("tile", ["xla", "assoc"])
 def test_dense_layout_matches_flat(tile):
     """Dense pre-gathered tiles fold to exactly the flat-gather states."""
