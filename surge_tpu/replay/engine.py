@@ -1209,9 +1209,7 @@ class ReplayEngine:
                             v.astype(jnp.float32), jnp.uint32)
                     elif dt == np.bool_ or dt.itemsize < 4:
                         v = v.astype(jnp.uint32)
-                    elif dt == np.dtype(np.uint32):
-                        v = v
-                    else:
+                    elif dt != np.dtype(np.uint32):
                         v = jax.lax.bitcast_convert_type(v, jnp.uint32)
                     cols.append(v)
                 return jnp.stack(cols)
