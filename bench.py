@@ -670,8 +670,12 @@ def main() -> None:
             # the child released the device: a fresh runtime keeps the
             # artifact's probe/fold numbers clean (an in-process sweep both
             # degrades later uploads ~10× and, run after the measurement,
-            # banks degraded numbers itself)
-            if os.environ.get("SURGE_BENCH_ONCHIP", "1") == "1":
+            # banks degraded numbers itself). Only when the child actually
+            # reached silicon — if its claim hung into UNAVAILABLE, a sweep
+            # attempt would just hang the same ~25 min again
+            if (os.environ.get("SURGE_BENCH_ONCHIP", "1") == "1"
+                    and tpu_child is not None
+                    and tpu_child["platform"] != "cpu"):
                 log("banking on-chip sweep artifact (separate process)...")
                 sweep = subprocess.run(
                     [sys.executable,
