@@ -191,6 +191,149 @@ def _run_config(wire, expected, *, dispatch="switch", unroll=1, time_chunk=128,
         return {**cfg, "error": f"{type(e).__name__}: {str(e)[:400]}"}
 
 
+def _verify_families(on_row=None) -> list:
+    """Every model family + the collective programs verified ON THIS BACKEND,
+    through the same resident path the flagship benches (auto knobs: dense
+    layout + assoc fold where the family ships one): bank_account (f32 +
+    vocab side columns, wide pull), shopping_cart (bool state), the
+    three-family mixed batch, and the seqpar time-sharded program on a
+    1-device mesh. Each row: family, sizes, verified, seconds. ``on_row``
+    (rows -> None) fires after every row so the caller can re-bank the
+    artifact incrementally — a tunnel drop mid-family keeps earlier rows."""
+    import random
+
+    import jax
+
+    from surge_tpu.codec.tensor import encode_events_columnar
+    from surge_tpu.config import Config
+    from surge_tpu.engine.model import fold_events
+    from surge_tpu.models import bank_account, counter, shopping_cart
+    from surge_tpu.replay import ReplayEngine
+    from surge_tpu.testing import (random_bank_log, random_cart_log,
+                                   random_counter_log)
+
+    rng = random.Random(17)
+    rows: list = []
+
+    def bank(row):
+        rows.append(row)
+        if on_row is not None:
+            on_row(rows)
+
+    def single_family(name, model, spec, logs, fields, encode=None):
+        t0 = time.perf_counter()
+        try:
+            truth = [fold_events(model, None, log) for log in logs]
+            enc_logs = ([[encode(e) for e in log] for log in logs]
+                        if encode else logs)
+            ev = encode_events_columnar(spec.registry, enc_logs)
+            eng = ReplayEngine(spec, config=Config({
+                "surge.replay.batch-size": 256,
+                "surge.replay.time-chunk": 32}))
+            res = eng.replay_resident(eng.prepare_resident(ev))
+            ok = True
+            for i, t in enumerate(truth):
+                for f in fields:
+                    want = getattr(t, f) if t is not None else 0
+                    got = res.states[f][i]
+                    if isinstance(want, float):
+                        ok &= abs(float(got) - want) < 1e-4
+                    else:
+                        ok &= bool(got) == bool(want) if isinstance(
+                            want, bool) else int(got) == int(want)
+            bank({"family": name, "aggregates": len(logs),
+                         "events": res.num_events, "tile": eng.tile_backend,
+                         "verified": bool(ok),
+                         "s": round(time.perf_counter() - t0, 1)})
+        except Exception as e:  # noqa: BLE001 — record, don't kill the sweep
+            bank({"family": name,
+                         "error": f"{type(e).__name__}: {str(e)[:200]}"})
+
+    vocab = bank_account.Vocab()
+    single_family(
+        "bank_account", bank_account.BankAccountModel(),
+        bank_account.make_replay_spec(),
+        [random_bank_log(rng, f"b{i}") for i in range(301)],
+        fields=("balance",),
+        encode=lambda e: bank_account.encode_event(vocab, e))
+    single_family(
+        "shopping_cart", shopping_cart.CartModel(),
+        shopping_cart.make_replay_spec(),
+        [random_cart_log(rng, f"c{i}") for i in range(301)],
+        fields=("item_count", "total_cents", "checked_out", "version"))
+
+    # three families in ONE batch (tagged-union columns, masked dispatch)
+    t0 = time.perf_counter()
+    try:
+        from surge_tpu.replay.mixed import combine_replay_specs
+
+        mixed = combine_replay_specs({
+            "counter": counter.make_replay_spec(),
+            "cart": shopping_cart.make_replay_spec(),
+            "bank": bank_account.make_replay_spec()})
+        models = {"counter": counter.CounterModel(),
+                  "cart": shopping_cart.CartModel(),
+                  "bank": bank_account.BankAccountModel()}
+        makers = {"counter": random_counter_log, "cart": random_cart_log,
+                  "bank": random_bank_log}
+        tagged, truths = [], []
+        for i in range(240):
+            kind = ("counter", "cart", "bank")[i % 3]
+            log = makers[kind](rng, f"m{i}")
+            truths.append((kind, fold_events(models[kind], None, log)))
+            if kind == "bank":
+                log = [bank_account.encode_event(vocab, e) for e in log]
+            tagged.append((kind, log))
+        colev = mixed.encode_logs(tagged)
+        eng = ReplayEngine(mixed.spec, config=Config({
+            "surge.replay.batch-size": 64, "surge.replay.time-chunk": 8}))
+        tags = [m for m, _ in tagged]
+        res = eng.replay_resident(eng.prepare_resident(colev),
+                                  init_carry=mixed.init_carry(tags))
+        decoded = mixed.decode_states(tags, res.states)
+        ok = all(
+            (t is None) or
+            (kind == "counter" and d.count == t.count) or
+            (kind == "cart" and d.total_cents == t.total_cents) or
+            (kind == "bank" and abs(d.balance - t.balance) < 1e-4)
+            for (kind, t), d in zip(truths, decoded))
+        bank({"family": "mixed(counter+cart+bank)", "aggregates": 240,
+                     "events": res.num_events, "verified": bool(ok),
+                     "s": round(time.perf_counter() - t0, 1)})
+    except Exception as e:  # noqa: BLE001
+        bank({"family": "mixed",
+                     "error": f"{type(e).__name__}: {str(e)[:200]}"})
+
+    # seqpar time-sharded program on a 1-device mesh of THIS backend
+    t0 = time.perf_counter()
+    try:
+        from surge_tpu.codec.tensor import encode_events
+        from surge_tpu.replay.seqpar import replay_time_sharded
+
+        mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        model = counter.CounterModel()
+        spec = counter.make_replay_spec()
+        logs = [random_counter_log(rng, f"s{i}") for i in range(24)]
+        enc = encode_events(spec.registry, logs)
+        events = {"type_id": enc.type_ids.T.astype(np.int32)}
+        for cname, col in enc.cols.items():
+            events[cname] = col.T
+        out = replay_time_sharded(counter.make_associative_fold(), spec,
+                                  events, mesh)
+        truth = [fold_events(model, None, log) for log in logs]
+        ok = all(int(out["count"][i]) == (t.count if t else 0)
+                 and int(out["version"][i]) == (t.version if t else 0)
+                 for i, t in enumerate(truth))
+        bank({"family": "seqpar_time_sharded", "aggregates": len(logs),
+                     "events": sum(len(l) for l in logs),
+                     "verified": bool(ok),
+                     "s": round(time.perf_counter() - t0, 1)})
+    except Exception as e:  # noqa: BLE001
+        bank({"family": "seqpar_time_sharded",
+                     "error": f"{type(e).__name__}: {str(e)[:200]}"})
+    return rows
+
+
 def _run_streamed(wire, expected, segments: int) -> dict:
     cfg = {"streamed_segments": segments}
     try:
@@ -341,6 +484,11 @@ def run_sweep(artifact_path: str = ARTIFACT, *,
                if c.get("verified") and "events_per_sec" in c]
         full["best"] = max(fok, key=lambda c: c["events_per_sec"]) if fok else {}
         art.update(full=full)
+
+    # every model family + the collective programs, verified on this backend
+    # (compile-heavy ~5 min — run LAST so a window drop keeps the perf rows,
+    # banked row-by-row so a drop mid-family keeps the earlier families)
+    _verify_families(on_row=lambda rows: art.update(families=rows))
 
     art.update(done=True)
     return best

@@ -30,7 +30,85 @@ from surge_tpu.engine.entity import (
 )
 from surge_tpu.engine.model import fold_events
 
-__all__ = ["StubAggregateRef", "StubEngine", "assert_replay_matches_scalar"]
+__all__ = ["StubAggregateRef", "StubEngine", "assert_replay_matches_scalar",
+           "random_counter_log", "random_cart_log", "random_bank_log"]
+
+
+# --------------------------------------------------------------------------------------
+# random-but-semantically-valid event logs for the fixture families — shared by
+# the mixed-replay golden test and the on-chip verification sweep; also a
+# worked example of driving a model's command path to produce test logs
+# (tests/test_replay_golden.py keeps its own batch-form generators with
+# different length distributions)
+# --------------------------------------------------------------------------------------
+
+def random_counter_log(rng, agg: str) -> list:
+    """A counter-family event log via the REAL command path (inc/dec/noop)."""
+    from surge_tpu.models import counter
+
+    model = counter.CounterModel()
+    state, log = None, []
+    for _ in range(rng.randrange(0, 25)):
+        r = rng.random()
+        if r < 0.6:
+            cmd = counter.Increment(agg)
+        elif r < 0.9:
+            cmd = counter.Decrement(agg)
+        else:
+            cmd = counter.CreateNoOpEvent(agg)
+        for e in model.process_command(state, cmd):
+            state = model.handle_event(state, e)
+            log.append(e)
+    return log
+
+
+def random_cart_log(rng, agg: str) -> list:
+    """A shopping-cart log: add/remove/checkout until checked out."""
+    from surge_tpu.models import shopping_cart
+
+    model = shopping_cart.CartModel()
+    state, log = None, []
+    for _ in range(rng.randrange(0, 20)):
+        if state is not None and state.checked_out:
+            break
+        try:
+            r = rng.random()
+            if r < 0.6:
+                cmd = shopping_cart.AddItem(agg, rng.randrange(1, 50),
+                                            rng.randrange(1, 4),
+                                            rng.randrange(100, 900))
+            elif r < 0.9:
+                cmd = shopping_cart.RemoveItem(agg, rng.randrange(1, 50),
+                                               rng.randrange(1, 3),
+                                               rng.randrange(100, 900))
+            else:
+                cmd = shopping_cart.Checkout(agg)
+            events = model.process_command(state, cmd)
+        except Exception:  # noqa: BLE001 — rejected command, try another
+            continue
+        for e in events:
+            state = model.handle_event(state, e)
+            log.append(e)
+    return log
+
+
+def random_bank_log(rng, agg: str) -> list:
+    """A bank-account log of RAW domain events (encode with
+    ``bank_account.encode_event(vocab, e)`` before replay); ~20% orphan
+    updates exercise the created-gate."""
+    from surge_tpu.models import bank_account
+
+    log = []
+    if rng.random() < 0.8:
+        log.append(bank_account.BankAccountCreated(agg, f"owner{agg}",
+                                                   f"sec{agg}", 100.0))
+        bal = 100.0
+        for _ in range(rng.randrange(0, 12)):
+            bal += rng.randrange(1, 40) * 0.25
+            log.append(bank_account.BankAccountUpdated(agg, bal))
+    else:
+        log.append(bank_account.BankAccountUpdated(agg, 42.0))  # orphan
+    return log
 
 
 def assert_replay_matches_scalar(model, replay_spec, logs,

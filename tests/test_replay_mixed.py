@@ -12,59 +12,11 @@ from surge_tpu.engine.model import fold_events
 from surge_tpu.models import bank_account, counter, shopping_cart
 from surge_tpu.replay import ReplayEngine
 from surge_tpu.replay.mixed import combine_replay_specs
-
-
-def _counter_log(rng, agg):
-    model = counter.CounterModel()
-    state, log = None, []
-    for _ in range(rng.randrange(0, 25)):
-        cmd = (counter.Increment(agg) if rng.random() < 0.7
-               else counter.Decrement(agg))
-        for e in model.process_command(state, cmd):
-            state = model.handle_event(state, e)
-            log.append(e)
-    return log
-
-
-def _cart_log(rng, agg):
-    model = shopping_cart.CartModel()
-    state, log = None, []
-    for _ in range(rng.randrange(0, 20)):
-        if state is not None and state.checked_out:
-            break
-        try:
-            r = rng.random()
-            if r < 0.6:
-                cmd = shopping_cart.AddItem(agg, rng.randrange(1, 50),
-                                            rng.randrange(1, 4),
-                                            rng.randrange(100, 900))
-            elif r < 0.9:
-                cmd = shopping_cart.RemoveItem(agg, rng.randrange(1, 50),
-                                               rng.randrange(1, 3),
-                                               rng.randrange(100, 900))
-            else:
-                cmd = shopping_cart.Checkout(agg)
-            events = model.process_command(state, cmd)
-        except Exception:
-            continue
-        for e in events:
-            state = model.handle_event(state, e)
-            log.append(e)
-    return log
-
-
-def _bank_log(rng, agg):
-    log = []
-    if rng.random() < 0.8:
-        log.append(bank_account.BankAccountCreated(agg, f"owner{agg}",
-                                                   f"sec{agg}", 100.0))
-        bal = 100.0
-        for _ in range(rng.randrange(0, 12)):
-            bal += rng.randrange(1, 40) * 0.25
-            log.append(bank_account.BankAccountUpdated(agg, bal))
-    else:
-        log.append(bank_account.BankAccountUpdated(agg, 42.0))  # orphan
-    return log
+from surge_tpu.testing import (
+    random_bank_log as _bank_log,
+    random_cart_log as _cart_log,
+    random_counter_log as _counter_log,
+)
 
 
 @pytest.mark.parametrize("path", ["columnar", "resident"])
