@@ -554,6 +554,11 @@ class ReplayEngine:
         self._resident_dense_folds: dict = {}
         # on-device fresh init-slab builders per b_pad (zero host transfers)
         self._slab_programs: dict = {}
+        # the two state-pull finalize programs (wide/narrow), built once per
+        # engine — jax.jit's own shape cache handles differing batch sizes
+        # (streamed pieces are rebuilt per call; a per-corpus cache would
+        # re-jit them inside timed passes)
+        self._finalize_programs: dict = {}
         # distinct (fold-variant, window-shape) signatures — every entry corresponds
         # to one XLA compilation (shapes are static under jit), counted without any
         # private JAX internals
@@ -1160,34 +1165,37 @@ class ReplayEngine:
         init_sorted, ord_sorted = _apply_perm(perm, init_carry, ordinal_base)
         slab, padded = self._dispatch_resident(resident, init_sorted, ord_sorted)
         # the single synchronization of the whole replay
-        return ReplayResult(states=self._pull_states(resident, slab),
-                            num_aggregates=b,
-                            num_events=resident.num_events,
-                            padded_events=padded)
+        return ReplayResult(
+            states=self._pull_states(slab, b, resident.perm, resident.cache),
+            num_aggregates=b, num_events=resident.num_events,
+            padded_events=padded)
 
-    def _pull_states(self, resident: "ResidentCorpus", slab: Mapping[str, Any]
-                     ) -> dict[str, np.ndarray]:
+    def _pull_states(self, slab: Mapping[str, Any], b: int,
+                     perm: Optional[np.ndarray],
+                     cache: Optional[dict] = None) -> dict[str, np.ndarray]:
         """One-round-trip state pull: un-perm + truncate + bitcast-pack every
         column into a single u32 matrix ON DEVICE, fetch once, un-bitcast on
         the host. Each materialization of a computed device buffer costs a
         full tunnel round trip (~65-100 ms measured); per-field ``np.asarray``
-        paid it once per column."""
-        b = resident.lengths.shape[0]
+        paid it once per column. ``cache`` (a per-corpus dict) memoizes the
+        device inverse-perm; omit it for throwaway corpora (streamed pieces).
+        """
         fields = self.spec.registry.state.fields
         if any(np.dtype(f.dtype).itemsize > 4 for f in fields):
             # >32-bit columns don't fit the u32 packing — per-field pull
             out_sorted = {name: np.asarray(col)[:b]
                           for name, col in slab.items()}
-            return _unapply_perm(resident.perm, out_sorted)
-        inv = resident.cache.get("invperm")
+            return _unapply_perm(perm, out_sorted)
+        inv = cache.get("invperm") if cache is not None else None
         if inv is None:
-            if resident.perm is not None:
+            if perm is not None:
                 invp = np.empty((b,), np.int32)
-                invp[resident.perm] = np.arange(b, dtype=np.int32)
+                invp[perm] = np.arange(b, dtype=np.int32)
             else:
                 invp = np.arange(b, dtype=np.int32)
             inv = jnp.asarray(invp)
-            resident.cache["invperm"] = inv
+            if cache is not None:
+                cache["invperm"] = inv
         names = [f.name for f in fields]
         dts = [np.dtype(f.dtype) for f in fields]
         # all-integer/bool states ride the half-width wire: measured tunnel
@@ -1196,7 +1204,7 @@ class ReplayEngine:
         # device-computed fit flags halves it; any overflowing column
         # triggers one wide refetch (correctness never depends on the guess)
         narrow_ok = not any(np.issubdtype(dt, np.floating) for dt in dts)
-        wide_prog = resident.cache.get("finalize_wide")
+        wide_prog = self._finalize_programs.get("wide")
         if wide_prog is None:
 
             def finalize_wide(sl, ip):
@@ -1215,7 +1223,7 @@ class ReplayEngine:
                 return jnp.stack(cols)
 
             wide_prog = jax.jit(finalize_wide)
-            resident.cache["finalize_wide"] = wide_prog
+            self._finalize_programs["wide"] = wide_prog
 
         def decode_wide(mat):
             out: dict[str, np.ndarray] = {}
@@ -1233,7 +1241,7 @@ class ReplayEngine:
         if not narrow_ok:
             return decode_wide(np.asarray(wide_prog(slab, inv)))
 
-        narrow_prog = resident.cache.get("finalize_narrow")
+        narrow_prog = self._finalize_programs.get("narrow")
         if narrow_prog is None:
 
             def finalize_narrow(sl, ip):
@@ -1256,7 +1264,7 @@ class ReplayEngine:
                 return jnp.concatenate(cols + [jnp.stack(flags)])
 
             narrow_prog = jax.jit(finalize_narrow)
-            resident.cache["finalize_narrow"] = narrow_prog
+            self._finalize_programs["narrow"] = narrow_prog
 
         buf16 = np.asarray(narrow_prog(slab, inv))  # the one device→host fetch
         nf = len(fields)
@@ -1601,13 +1609,19 @@ class ReplayEngine:
                 {k: v[lanes] for k, v in init_sorted.items()},
                 None if ord_sorted is None else ord_sorted[lanes])
             padded += pad
+            # hold ONLY what the sync pass needs — keeping the piece corpus
+            # itself would pin every piece's wire buffers in HBM at once
             pieces.append((lanes, slab))  # ...fold dispatched, NOT synced
-        # one sync pass over every piece, then global unsort
+        # one sync pass over every piece — a single packed fetch per piece
+        # (every materialized buffer costs a full tunnel round trip; the old
+        # per-piece-per-field np.asarray paid pieces × fields of them), then
+        # global unsort
         out_sorted = {f.name: np.empty((b,), dtype=f.dtype)
                       for f in state_fields}
         for lanes, slab in pieces:
-            for name, col in slab.items():
-                out_sorted[name][lanes] = np.asarray(col)[: lanes.shape[0]]
+            piece_states = self._pull_states(slab, int(lanes.shape[0]), None)
+            for name, col in piece_states.items():
+                out_sorted[name][lanes] = col
         return ReplayResult(states=_unapply_perm(perm, out_sorted),
                             num_aggregates=b,
                             num_events=w.num_events, padded_events=padded)
