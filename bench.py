@@ -334,19 +334,25 @@ def _device_resident_fold_rate(engine, corpus) -> float:
     packed_dev = jax.device_put(packed)
     side_dev = {k: jax.device_put(v) for k, v in side.items()}
     ord_dev = jax.device_put(np.zeros((bs,), dtype=np.int32))
+    def fetch_barrier(c):
+        # a real device→host fetch of one element: block_until_ready can
+        # return before execution completes on the tunneled relay, and the
+        # fetch's data dependency forces the whole chained sequence to finish
+        next(iter(np.asarray(v)[:1] for v in c.values()))
+
     carry = engine._carry_slice(None, 0, bs, bs)
     carry = fold(carry, packed_dev, side_dev, ord_dev)  # warm/compile
-    jax.block_until_ready(carry)
+    fetch_barrier(carry)
     # calibrate iterations to a ~2s measurement
     t0 = time.perf_counter()
     carry = fold(carry, packed_dev, side_dev, ord_dev)
-    jax.block_until_ready(carry)
+    fetch_barrier(carry)
     per_iter = max(time.perf_counter() - t0, 1e-5)
     iters = max(int(2.0 / per_iter), 3)
     t0 = time.perf_counter()
     for _ in range(iters):
         carry = fold(carry, packed_dev, side_dev, ord_dev)
-    jax.block_until_ready(carry)
+    fetch_barrier(carry)
     dt = time.perf_counter() - t0
     return iters * chunk * bs / dt
 
