@@ -109,7 +109,12 @@ def load_corpus(root: str):
 
 def make_engine():
     """The bench replay engine (shared by parent pack and replay children so
-    the wire form and tile plan agree)."""
+    the wire form and tile plan agree).
+
+    SURGE_BENCH_PROFILE=1 attaches the per-stage replay profiler (a DEBUG
+    registry + surge_tpu.replay.profiler): the child payload then carries a
+    per-stage encode/h2d/compile/dispatch/fetch breakdown. Off by default —
+    the headline numbers always come from the unprofiled hot path."""
     from surge_tpu.config import default_config
     from surge_tpu.models.counter import make_replay_spec
     from surge_tpu.replay.engine import ReplayEngine
@@ -132,9 +137,17 @@ def make_engine():
         # on the (timed) upload
         "surge.replay.resident-len-bucket": "exact",
     })
+    profiler = None
+    if os.environ.get("SURGE_BENCH_PROFILE", "0") == "1":
+        from surge_tpu.metrics import Metrics, RecordingLevel, engine_metrics
+        from surge_tpu.replay.profiler import ReplayProfiler
+
+        registry = Metrics(recording_level=RecordingLevel.DEBUG)
+        profiler = ReplayProfiler.if_enabled(registry, engine_metrics(registry))
     return ReplayEngine(make_replay_spec(),
                         config=cfg,
-                        unroll=int(os.environ.get("SURGE_BENCH_UNROLL", 1)))
+                        unroll=int(os.environ.get("SURGE_BENCH_UNROLL", 1)),
+                        profiler=profiler)
 
 
 def replay_child(corpus_dir: str) -> None:
@@ -307,6 +320,9 @@ def replay_child(corpus_dir: str) -> None:
                       "surge.replay.upload-chunk-mb", 0)},
         **extra_timing,
     }
+    if engine.profiler is not None:
+        payload["profile"] = engine.profiler.summary()
+        log(f"profile breakdown: {payload['profile']}")
     log(f"child replay: {corpus.num_events:,} events in {replay_s:.2f}s -> "
         f"{eps:,.0f} events/s (pad {payload['pad_ratio']}, pack {payload['pack_s']}s, "
         f"{payload['windows']} windows, {payload['compiles']} programs, verified)")

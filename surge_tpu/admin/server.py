@@ -15,6 +15,10 @@ SERVICE = "surge_tpu.admin.SurgeAdmin"
 METHODS = {
     "GetHealth": (pb.Empty, pb.HealthTreeReply),
     "GetMetrics": (pb.Empty, pb.MetricsReply),
+    # OpenMetrics text exposition (the scrape payload over gRPC); reuses the
+    # bytes-carrying MetricsReply — routing is by this table, not the
+    # descriptor, so no proto regeneration is needed (grpcio-tools absent)
+    "GetMetricsText": (pb.Empty, pb.MetricsReply),
     "ListComponents": (pb.Empty, pb.RegistrationsReply),
     "RestartComponent": (pb.ComponentRequest, pb.ComponentReply),
     "StopEngine": (pb.Empty, pb.ComponentReply),
@@ -43,6 +47,18 @@ class AdminServer:
             "values": reg.get_metrics(),
             "descriptions": reg.metric_descriptions(),
         }).encode())
+
+    async def GetMetricsText(self, request, context) -> pb.MetricsReply:
+        """The registry in OpenMetrics text format, health-plane counters
+        included — byte-identical to what the HTTP scrape endpoint serves."""
+        from surge_tpu.metrics.exposition import health_collector, render_openmetrics
+
+        text = render_openmetrics(
+            self.engine.metrics_registry,
+            collectors=[health_collector(
+                getattr(self.engine, "health_bus", None),
+                getattr(self.engine, "health_supervisor", None))])
+        return pb.MetricsReply(metrics_json=text.encode())
 
     async def ListComponents(self, request, context) -> pb.RegistrationsReply:
         return pb.RegistrationsReply(
@@ -100,6 +116,11 @@ class AdminClient:
     async def metrics(self) -> dict:
         reply = await self._calls["GetMetrics"](pb.Empty())
         return json.loads(reply.metrics_json)
+
+    async def metrics_text(self) -> str:
+        """OpenMetrics text payload (scrape-over-gRPC)."""
+        reply = await self._calls["GetMetricsText"](pb.Empty())
+        return reply.metrics_json.decode()
 
     async def components(self) -> list:
         return list((await self._calls["ListComponents"](pb.Empty())).names)

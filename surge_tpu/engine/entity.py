@@ -217,10 +217,15 @@ class AggregateEntity:
         # (ActorWithTracing's around-receive + PersistentActor.scala:166-168)
         span = None
         if self.tracer is not None:
+            from surge_tpu.tracing import inject_context
+
             span = self.tracer.start_span(
                 f"entity.{type(env.message).__name__}", headers=env.headers)
             span.set_attribute("aggregate_id", self.aggregate_id)
             span.set_attribute("partition", self.partition)
+            # downstream hops (the publisher's publish span) chain under the
+            # receive span, completing the ref→router→shard→entity→publisher line
+            env.headers = inject_context(span.context, env.headers)
         try:
             await self._handle_inner(env)
             if span is not None and env.reply.done() and not env.reply.cancelled():
@@ -321,7 +326,9 @@ class AggregateEntity:
                 try:
                     with self.metrics.publish_timer.time():
                         await asyncio.wait_for(
-                            self.publisher.publish(self.aggregate_id, records, request_id),
+                            self.publisher.publish(self.aggregate_id, records,
+                                                   request_id,
+                                                   headers=env.headers),
                             timeout=self.timeouts.publish_timeout_s)
                     self.state = new_state
                     resolve_future(env.reply, CommandSuccess(new_state))

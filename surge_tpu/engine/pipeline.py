@@ -152,6 +152,8 @@ class SurgeEngine(Controllable):
                 local_host=self.local_host, region_creator=self._create_region,
                 remote_deliver=remote_deliver,
                 dr_standby=self.config.get_bool("surge.engine.dr-standby-enabled"))
+        self.router.tracer = tracer  # routing-hop spans (None = zero overhead)
+        self.metrics_server = None  # started on demand by serve_metrics()
         self._rebalance_listeners: List[Callable] = []
         self._indexer_listener: Optional[Callable] = None
 
@@ -200,8 +202,27 @@ class SurgeEngine(Controllable):
                 await self.loop_prober.stop()
             raise
 
+    def serve_metrics(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start the OpenMetrics HTTP scrape endpoint for this engine's
+        registry (health-bus + supervisor counters included); returns the
+        bound port. Stopped automatically by :meth:`stop`."""
+        from surge_tpu.metrics.exposition import MetricsHTTPServer, health_collector
+
+        if self.metrics_server is not None:
+            return self.metrics_server.bound_port
+        self.metrics_server = MetricsHTTPServer(
+            self.metrics_registry, host=host, port=port,
+            collectors=[health_collector(self.health_bus,
+                                         self.health_supervisor)])
+        return self.metrics_server.start()
+
     async def stop(self) -> Ack:
         self.status = EngineStatus.STOPPING
+        if self.metrics_server is not None:
+            # shutdown() blocks until the serve_forever poll notices (plus a
+            # thread join) — off the event loop so in-flight replies never stall
+            server, self.metrics_server = self.metrics_server, None
+            await asyncio.get_running_loop().run_in_executor(None, server.stop)
         if self._indexer_listener is not None:
             self.tracker.unregister(self._indexer_listener)
             self._indexer_listener = None
@@ -250,7 +271,7 @@ class SurgeEngine(Controllable):
             still_owner=lambda p=partition: (
                 self.tracker.assignments.partition_to_host().get(p) == self.local_host),
             on_signal=self.health_bus.signal_fn(f"publisher-{partition}"),
-            metrics=self.metrics)
+            metrics=self.metrics, tracer=self.tracer)
         shard = Shard(
             f"{self.logic.aggregate_name}-{partition}",
             lambda aggregate_id, on_passivate, on_stopped: AggregateEntity(
@@ -258,7 +279,8 @@ class SurgeEngine(Controllable):
                 fetch_state=self.indexer.get_aggregate_bytes, partition=partition,
                 config=self.config, on_passivate=on_passivate, on_stopped=on_stopped,
                 metrics=self.metrics, tracer=self.tracer),
-            buffer_limit=self.config.get_int("surge.aggregate.passivation-buffer-limit", 1000))
+            buffer_limit=self.config.get_int("surge.aggregate.passivation-buffer-limit", 1000),
+            tracer=self.tracer)
         return _Region(partition, publisher, shard)
 
     # -- health -------------------------------------------------------------------------
@@ -364,6 +386,19 @@ class SurgeEngine(Controllable):
         return self.mesh
 
     async def rebuild_from_events(self):
+        """Traced wrapper around :meth:`_rebuild_from_events_inner` — the bulk
+        restore is the engine's single heaviest operation, so it gets a span of
+        its own (root unless the caller nests it)."""
+        if self.tracer is None:
+            return await self._rebuild_from_events_inner()
+        with self.tracer.start_span("engine.rebuild-from-events") as span:
+            result = await self._rebuild_from_events_inner()
+            span.set_attribute("num_events", result.num_events)
+            span.set_attribute("num_aggregates", result.num_aggregates)
+            span.set_attribute("backend", result.backend)
+            return result
+
+    async def _rebuild_from_events_inner(self):
         """Rebuild the materialized store by folding the events topic through the
         configured replay backend, then bring the indexer current.
 

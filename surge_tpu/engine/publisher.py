@@ -29,7 +29,7 @@ from __future__ import annotations
 import asyncio
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Protocol, Sequence
+from typing import Callable, Dict, List, Mapping, Optional, Protocol, Sequence
 
 from surge_tpu.common import BackgroundTask, fail_future, logger, resolve_future
 from surge_tpu.config import Config, default_config
@@ -81,7 +81,7 @@ class PartitionPublisher:
                  config: Config | None = None, transactional_id_prefix: str = "surge",
                  still_owner: Callable[[], bool] = lambda: True,
                  on_signal: Callable[[str, str], None] | None = None,
-                 metrics=None) -> None:
+                 metrics=None, tracer=None) -> None:
         self.log = log
         self.state_topic = state_topic
         self.events_topic = events_topic
@@ -95,6 +95,7 @@ class PartitionPublisher:
         self.state = "uninitialized"
         self.stats = PublisherStats()
         self.metrics = metrics  # EngineMetrics quiver (optional)
+        self.tracer = tracer  # None = zero-overhead path
         self._producer = None
         self._pending: List[_Pending] = []
         self._in_flight: Dict[str, int] = {}  # aggregate_id -> max state offset published
@@ -200,13 +201,31 @@ class PartitionPublisher:
     # -- publish path -------------------------------------------------------------------
 
     async def publish(self, aggregate_id: str, records: Sequence[LogRecord],
-                      request_id: str) -> None:
+                      request_id: str,
+                      headers: Optional[Mapping[str, str]] = None) -> None:
         """Queue records for the next flush transaction; resolves at commit.
 
         Raises :class:`PublishFailedError` if the batch fails — callers (the aggregate
         entity's persistence ladder, KTablePersistenceSupport.scala:71-156) retry with
         the SAME ``request_id`` so a commit that actually landed is not repeated.
+
+        ``headers`` may carry a W3C trace context: the publish span (queue →
+        commit ack, the hop the reference wraps around its producer publish)
+        then chains under the caller's entity span.
         """
+        if self.tracer is None:
+            return await self._publish_inner(aggregate_id, records, request_id)
+        span = self.tracer.start_span("publisher.publish",
+                                      headers=headers or {})
+        span.set_attribute("aggregate_id", aggregate_id)
+        span.set_attribute("partition", self.partition)
+        span.set_attribute("records", len(records))
+        with span:  # records exceptions + finishes
+            return await self._publish_inner(aggregate_id, records, request_id)
+
+    async def _publish_inner(self, aggregate_id: str,
+                             records: Sequence[LogRecord],
+                             request_id: str) -> None:
         if self.state not in ("processing", "waiting_for_ktable", "initializing"):
             raise PublisherNotReadyError(f"publisher state={self.state}")
         if request_id in self._completed:
@@ -329,8 +348,20 @@ class PartitionPublisher:
             asyncio.get_running_loop().create_future()
         for p in batch:
             self._committing[p.request_id] = outcome
+        # the flush-transaction span is a ROOT: one commit serves many pending
+        # publishes, each already tracked by its own publisher.publish span
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start_span("publisher.flush")
+            span.set_attribute("partition", self.partition)
+            span.set_attribute("batch_publishes", len(batch))
+            span.set_attribute("batch_records", len(records))
         try:
-            await self._publish_batch_inner(batch, records, outcome)
+            if span is None:
+                await self._publish_batch_inner(batch, records, outcome)
+            else:
+                with span:
+                    await self._publish_batch_inner(batch, records, outcome)
         finally:
             if not outcome.done():
                 outcome.set_result(RuntimeError("publish batch aborted"))

@@ -53,6 +53,9 @@ class RouterBase(Controllable):
         self.partition_by = partition_by
         self.remote_deliver = remote_deliver
         self.pending_limit = pending_limit
+        # assigned by the engine after construction (None = zero-overhead path);
+        # the routing hop's span mirrors KafkaPartitionShardRouterActor:216
+        self.tracer = None
         self._regions: Dict[int, object] = {}
         self._pending: Dict[int, List[Tuple[str, Envelope]]] = {}
         self._started = False
@@ -69,17 +72,39 @@ class RouterBase(Controllable):
 
     def deliver(self, aggregate_id: str, env: Envelope) -> None:
         """deliverMessage:205-222 — resolve owner, local-or-remote dispatch."""
-        partition = self.partition_for(aggregate_id)
-        owner = self.owner_of(partition)
-        if owner is None:
-            buf = self._pending.setdefault(partition, [])
-            if len(buf) >= self.pending_limit:
-                fail_future(env.reply, NoRouteError(
-                    f"no owner for partition {partition} and buffer full"))
+        span = None
+        if self.tracer is not None:
+            from surge_tpu.tracing import inject_context
+
+            span = self.tracer.start_span(
+                f"{self.health_name}.deliver", headers=env.headers)
+            span.set_attribute("aggregate_id", aggregate_id)
+            env.headers = inject_context(span.context, env.headers)
+        try:
+            partition = self.partition_for(aggregate_id)
+            owner = self.owner_of(partition)
+            if span is not None:
+                span.set_attribute("partition", partition)
+                span.set_attribute("owner", "" if owner is None else str(owner))
+                span.set_attribute(
+                    "remote", owner is not None and owner != self.local_host)
+            if owner is None:
+                buf = self._pending.setdefault(partition, [])
+                if len(buf) >= self.pending_limit:
+                    err = NoRouteError(
+                        f"no owner for partition {partition} and buffer full")
+                    if span is not None:
+                        span.record_exception(err)
+                    fail_future(env.reply, err)
+                    return
+                buf.append((aggregate_id, env))
+                if span is not None:
+                    span.add_event("buffered")
                 return
-            buf.append((aggregate_id, env))
-            return
-        self._dispatch(owner, partition, aggregate_id, env)
+            self._dispatch(owner, partition, aggregate_id, env)
+        finally:
+            if span is not None:
+                span.finish()
 
     def _dispatch(self, owner: HostPort, partition: int, aggregate_id: str,
                   env: Envelope) -> None:

@@ -27,25 +27,45 @@ class Shard:
     """Owns the live entities of one partition."""
 
     def __init__(self, name: str, entity_factory: EntityFactory,
-                 buffer_limit: int = 1000) -> None:
+                 buffer_limit: int = 1000, tracer=None) -> None:
         self.name = name
         self.entity_factory = entity_factory
         self.buffer_limit = buffer_limit
+        self.tracer = tracer
         self._entities: Dict[str, AggregateEntity] = {}
         self._passivating: Dict[str, List[Envelope]] = {}
 
     # -- delivery -----------------------------------------------------------------------
 
     def deliver(self, aggregate_id: str, env: Envelope) -> None:
-        if aggregate_id in self._passivating:
-            buf = self._passivating[aggregate_id]
-            if len(buf) >= self.buffer_limit:
-                fail_future(env.reply, BufferFullError(
-                    f"{self.name}: passivation buffer full for {aggregate_id}"))
+        span = None
+        if self.tracer is not None:
+            from surge_tpu.tracing import inject_context
+
+            # the Shard hop's span (getOrCreateEntity + mailbox handoff);
+            # context re-injected so the entity's receive span chains under it
+            span = self.tracer.start_span("shard.deliver", headers=env.headers)
+            span.set_attribute("aggregate_id", aggregate_id)
+            span.set_attribute("shard", self.name)
+            env.headers = inject_context(span.context, env.headers)
+        try:
+            if aggregate_id in self._passivating:
+                buf = self._passivating[aggregate_id]
+                if len(buf) >= self.buffer_limit:
+                    err = BufferFullError(
+                        f"{self.name}: passivation buffer full for {aggregate_id}")
+                    if span is not None:
+                        span.record_exception(err)
+                    fail_future(env.reply, err)
+                    return
+                buf.append(env)
+                if span is not None:
+                    span.add_event("buffered-passivating")
                 return
-            buf.append(env)
-            return
-        self._get_or_create(aggregate_id).deliver(env)
+            self._get_or_create(aggregate_id).deliver(env)
+        finally:
+            if span is not None:
+                span.finish()
 
     def _get_or_create(self, aggregate_id: str) -> AggregateEntity:
         entity = self._entities.get(aggregate_id)

@@ -57,12 +57,16 @@ class HealthSignalBus:
     def __init__(self, buffer_size: int = 25) -> None:
         self._recent: CircularBuffer[HealthSignal] = CircularBuffer(buffer_size)
         self._subscribers: List[Callable[[HealthSignal], None]] = []
+        # lifetime emit counts by severity level — the ring buffer forgets,
+        # the scrape surface (metrics/exposition.health_collector) must not
+        self.signal_counts: Dict[str, int] = {}
 
     def emit(self, name: str, level: str = "warning", source: str = "",
              metadata: Optional[dict] = None) -> HealthSignal:
         signal = HealthSignal(name=name, level=level, source=source,
                               metadata=metadata or {})
         self._recent.push(signal)
+        self.signal_counts[level] = self.signal_counts.get(level, 0) + 1
         for fn in list(self._subscribers):
             try:
                 fn(signal)
@@ -205,6 +209,12 @@ class HealthSupervisor:
 
     def registered(self) -> List[str]:
         return sorted(self._registrations)
+
+    def restart_counts(self) -> Dict[str, int]:
+        """Restarts driven per registered component (scrape-surface view of
+        each registration's budget consumption)."""
+        return {name: reg.restarts
+                for name, reg in self._registrations.items()}
 
     async def restart_component(self, name: str) -> None:
         """Operator-initiated restart of a registered component (the JMX MBean

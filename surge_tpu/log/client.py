@@ -157,7 +157,8 @@ class GrpcLogTransport:
     without duplicating an acked-but-reply-lost commit."""
 
     def __init__(self, target, config=None,
-                 auto_create_partitions: int = 1) -> None:
+                 auto_create_partitions: int = 1, tracer=None) -> None:
+        self.tracer = tracer  # client-side broker-call spans (None = zero cost)
         if isinstance(target, str):
             self.targets = [t.strip() for t in target.split(",") if t.strip()]
         else:
@@ -205,16 +206,40 @@ class GrpcLogTransport:
             self.generation += 1
             self._connect(self.targets.index(self.target) + 1)
 
+    def _span_and_metadata(self, name: str, **attrs):
+        """(span, gRPC metadata) for one broker call — the traceparent crosses
+        to the LogServer as call metadata so the broker's span chains under the
+        client's. WaitForAppend is excluded: a tailing indexer's long-poll
+        ticks would drown every other span."""
+        if self.tracer is None or name == "WaitForAppend":
+            return None, None
+        from surge_tpu.tracing import inject_context
+
+        span = self.tracer.start_span(f"log.{name}")
+        span.set_attribute("broker", self.target)
+        for k, v in attrs.items():
+            span.set_attribute(k, v)
+        return span, tuple(inject_context(span.context).items())
+
     def _invoke(self, name: str, request, timeout: float = 10.0):
         """Call with broker failover: UNAVAILABLE rolls to the next target and
         retries, up to one full cycle through the broker list. DEADLINE retries
         in place — a slow-but-alive broker must NOT be treated as dead (writing
         to a follower while its leader still serves would fork the logs)."""
+        span, metadata = self._span_and_metadata(name)
+        if span is None:
+            return self._invoke_attempts(name, request, timeout, metadata, span)
+        with span:  # records exceptions + finishes
+            return self._invoke_attempts(name, request, timeout, metadata, span)
+
+    def _invoke_attempts(self, name: str, request, timeout: float,
+                         metadata, span):
         last = None
         for attempt in range(max(len(self.targets), 1) + 1):
             gen = self.generation
             try:
-                return self._calls[name](request, timeout=timeout)
+                return self._calls[name](request, timeout=timeout,
+                                         metadata=metadata)
             except grpc.RpcError as exc:
                 code = exc.code() if hasattr(exc, "code") else None
                 # CANCELLED happens when another thread's failover closed the
@@ -224,6 +249,9 @@ class GrpcLogTransport:
                                 grpc.StatusCode.CANCELLED):
                     raise
                 last = exc
+                if span is not None:
+                    span.add_event("retry", {"attempt": attempt,
+                                             "code": str(code)})
                 if attempt >= max(len(self.targets), 1):
                     break
                 if (code == grpc.StatusCode.UNAVAILABLE
@@ -272,6 +300,19 @@ class GrpcLogTransport:
     def _transact(self, token: int, op: str, records: Sequence[LogRecord],
                   seq: int = 0, attempts: int = 4,
                   generation: Optional[int] = None) -> pb.TxnReply:
+        span, metadata = self._span_and_metadata(
+            "Transact", op=op, txn_seq=seq, records=len(records))
+        if span is None:
+            return self._transact_attempts(token, op, records, seq, attempts,
+                                           generation, metadata, span)
+        with span:  # records exceptions + finishes
+            return self._transact_attempts(token, op, records, seq, attempts,
+                                           generation, metadata, span)
+
+    def _transact_attempts(self, token: int, op: str,
+                           records: Sequence[LogRecord], seq: int,
+                           attempts: int, generation: Optional[int],
+                           metadata, span) -> pb.TxnReply:
         request = pb.TxnRequest(
             producer_token=token, op=op, txn_seq=seq,
             records=[record_to_msg(r) for r in records])
@@ -287,7 +328,8 @@ class GrpcLogTransport:
                     "broker failover: producer must re-open")
             try:
                 reply = self._calls["Transact"](request,
-                                                timeout=self._transact_timeout)
+                                                timeout=self._transact_timeout,
+                                                metadata=metadata)
             except grpc.RpcError as exc:
                 # Reply loss / transient broker trouble: retry the SAME txn_seq
                 # so a commit the server did apply is answered from its dedup
@@ -309,6 +351,9 @@ class GrpcLogTransport:
                         raise ProducerFencedError(
                             f"broker failover after {exc.code()}")
                     raise
+                if span is not None:
+                    span.add_event("retry", {"attempt": attempt,
+                                             "code": str(code)})
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 0.4)
                 continue

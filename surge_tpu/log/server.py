@@ -164,8 +164,9 @@ class LogServer:
 
     def __init__(self, log, host: str = "127.0.0.1", port: int = 0,
                  config=None, max_workers: int = 32,
-                 replicate_to: Optional[list] = None) -> None:
+                 replicate_to: Optional[list] = None, tracer=None) -> None:
         self.log = log
+        self.tracer = tracer  # broker-side transact spans (None = zero cost)
         self._host = host
         self._port = port
         self._config = config
@@ -294,6 +295,24 @@ class LogServer:
             last_txn_seq=max(dedup.last_seq, pending_max))
 
     def Transact(self, request: pb.TxnRequest, context) -> pb.TxnReply:
+        if self.tracer is None:
+            return self._transact_impl(request, context)
+        # the client ships its traceparent as call metadata: the broker-side
+        # span joins the same trace as the publisher's flush that caused it
+        headers = {k: v for k, v in (context.invocation_metadata() or ())
+                   if isinstance(v, str)}
+        with self.tracer.start_span("log.server.transact",
+                                    headers=headers) as span:
+            span.set_attribute("op", request.op)
+            span.set_attribute("txn_seq", request.txn_seq)
+            span.set_attribute("records", len(request.records))
+            reply = self._transact_impl(request, context)
+            if not reply.ok:
+                span.status = "error"
+                span.set_attribute("error_kind", reply.error_kind)
+            return reply
+
+    def _transact_impl(self, request: pb.TxnRequest, context) -> pb.TxnReply:
         state = self._producers.get(request.producer_token)
         if state is None:
             if request.producer_token in self._fenced_tokens:

@@ -197,6 +197,15 @@ class EngineMetrics:
     fence_counter: Sensor = field(init=False)
     replay_events_per_sec: Sensor = field(init=False)
     live_entities: Sensor = field(init=False)
+    standby_lag: Sensor = field(init=False)
+    # per-stage replay profile (DEBUG level: free at INFO, populated by
+    # surge_tpu.replay.profiler when a profiler is attached to the engine)
+    replay_encode_timer: Timer = field(init=False)
+    replay_h2d_timer: Timer = field(init=False)
+    replay_compile_timer: Timer = field(init=False)
+    replay_dispatch_timer: Timer = field(init=False)
+    replay_fetch_timer: Timer = field(init=False)
+    replay_profile_windows: Sensor = field(init=False)
 
     def __post_init__(self) -> None:
         m, MI = self.registry, MetricInfo
@@ -237,6 +246,26 @@ class EngineMetrics:
         self.standby_lag = m.gauge(MI(
             "surge.state-store.standby-lag",
             "records behind on partitions this node is warm standby for"))
+        dbg = RecordingLevel.DEBUG
+        self.replay_encode_timer = m.timer(MI(
+            "surge.replay.profile.encode-timer",
+            "ms host-side wire-packing/bucketing per replay window"), level=dbg)
+        self.replay_h2d_timer = m.timer(MI(
+            "surge.replay.profile.h2d-timer",
+            "ms transferring a replay window/corpus host-to-device"), level=dbg)
+        self.replay_compile_timer = m.timer(MI(
+            "surge.replay.profile.compile-timer",
+            "ms of fold dispatches that triggered an XLA compile"), level=dbg)
+        self.replay_dispatch_timer = m.timer(MI(
+            "surge.replay.profile.dispatch-timer",
+            "ms of steady (pre-compiled) fold dispatches"), level=dbg)
+        self.replay_fetch_timer = m.timer(MI(
+            "surge.replay.profile.fetch-timer",
+            "ms from dispatch to the fetch barrier closing device time "
+            "(a real device-to-host fetch, never block_until_ready)"), level=dbg)
+        self.replay_profile_windows = m.counter(MI(
+            "surge.replay.profile.windows",
+            "replay windows/tiles observed by the profiler"), level=dbg)
         # Deprecation aliases for the r4 renames (ADVICE r4): dashboards keyed
         # to the old identifiers — including a timer's .min/.max/.p99
         # sub-metrics — keep working for a release window; the alias providers

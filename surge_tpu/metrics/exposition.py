@@ -1,0 +1,273 @@
+"""OpenMetrics/Prometheus text exposition of the metrics registry.
+
+The registry's reference only ever rendered HTML (``Metrics.as_html``,
+Metrics.scala:270-281 — a JMX-era operator view); a production scrape surface
+needs the OpenMetrics text format instead. This module renders every registered
+provider as a correctly-typed family:
+
+- :class:`~surge_tpu.metrics.statistics.Count` → ``counter`` (``_total`` sample);
+- :class:`~surge_tpu.metrics.statistics.TimeBucketHistogram` → a full
+  ``histogram`` family with cumulative ``_bucket``/``_sum``/``_count`` series
+  (the lone p99 point the snapshot export reports is a lossy projection — the
+  scrape carries the whole distribution, ``+Inf`` bucket included);
+- everything else (gauge / EWMA / min / max / rate) → ``gauge``.
+
+Dotted registry names sanitize to Prometheus names (``surge.engine.command-rate``
+→ ``surge_engine_command_rate``); a timer's ``<name>.p99`` histogram provider is
+exported as the ``<name>_ms`` histogram family so it cannot collide with the
+timer's EWMA gauge. Extra collectors (health-bus signal counts, supervisor
+restart counts — :func:`health_collector`) contribute labelled families to the
+same payload.
+
+Serving: :class:`MetricsHTTPServer` is a stdlib ``http.server`` scrape endpoint
+(no third-party dependency); the AdminServer exposes the same text over gRPC as
+``GetMetricsText`` (admin/server.py).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from surge_tpu.metrics import Metrics
+from surge_tpu.metrics.statistics import Count, TimeBucketHistogram
+
+__all__ = [
+    "CONTENT_TYPE",
+    "Family",
+    "MetricsHTTPServer",
+    "Sample",
+    "health_collector",
+    "render_openmetrics",
+]
+
+#: the OpenMetrics 1.0 content type (Prometheus also accepts it)
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_NAME_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name: str) -> str:
+    """Dotted/dashed registry name → valid Prometheus metric name."""
+    out = _NAME_BAD_CHARS.sub("_", name)
+    if not _NAME_OK.match(out):
+        out = "_" + out
+    return out
+
+
+def escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(text: str) -> str:
+    return (text.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def format_value(v: float) -> str:
+    """Shortest exact rendering; +Inf/-Inf/NaN per the OpenMetrics grammar."""
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+@dataclass(frozen=True)
+class Sample:
+    """One sample line: ``name+suffix{labels} value``."""
+
+    suffix: str  # "", "_total", "_bucket", "_sum", "_count"
+    labels: Tuple[Tuple[str, str], ...]
+    value: float
+
+
+@dataclass
+class Family:
+    """One metric family (the unit a collector contributes)."""
+
+    name: str  # already-sanitized Prometheus name
+    mtype: str  # "gauge" | "counter" | "histogram"
+    help: str
+    samples: List[Sample] = field(default_factory=list)
+
+
+def _render_family(lines: List[str], fam: Family) -> None:
+    if fam.help:
+        lines.append(f"# HELP {fam.name} {escape_help(fam.help)}")
+    lines.append(f"# TYPE {fam.name} {fam.mtype}")
+    for s in fam.samples:
+        if s.labels:
+            body = ",".join(f'{k}="{escape_label_value(v)}"'
+                            for k, v in s.labels)
+            lines.append(f"{fam.name}{s.suffix}{{{body}}} "
+                         f"{format_value(s.value)}")
+        else:
+            lines.append(f"{fam.name}{s.suffix} {format_value(s.value)}")
+
+
+def _histogram_family(name: str, help_text: str,
+                      h: TimeBucketHistogram) -> Family:
+    fam = Family(name=name, mtype="histogram", help=help_text)
+    for bound, cum in h.bucket_counts():
+        fam.samples.append(Sample("_bucket", (("le", format_value(bound)),),
+                                  float(cum)))
+    fam.samples.append(Sample("_sum", (), h.sum_value))
+    fam.samples.append(Sample("_count", (), float(h.total_count)))
+    return fam
+
+
+def _label_tuple(tags) -> Tuple[Tuple[str, str], ...]:
+    """MetricInfo.tags as label pairs; non-pair tags are ignored."""
+    out = []
+    for t in tags or ():
+        if isinstance(t, (tuple, list)) and len(t) == 2:
+            out.append((sanitize_name(str(t[0])), str(t[1])))
+    return tuple(out)
+
+
+def registry_families(registry: Metrics) -> List[Family]:
+    """Every registered metric as a typed family, registry order (sorted)."""
+    families: List[Family] = []
+    for name, reg in sorted(registry._metrics.items()):
+        provider = reg.provider
+        labels = _label_tuple(reg.info.tags)
+        if isinstance(provider, TimeBucketHistogram):
+            # a timer registers its distribution under "<timer>.p99"; the
+            # histogram family drops that projection suffix and marks the unit
+            base = name[:-len(".p99")] if name.endswith(".p99") else name
+            fam = _histogram_family(sanitize_name(base) + "_ms",
+                                    reg.info.description, provider)
+            if labels:
+                fam.samples = [Sample(s.suffix, labels + s.labels, s.value)
+                               for s in fam.samples]
+            families.append(fam)
+        elif isinstance(provider, Count):
+            fam = Family(name=sanitize_name(name), mtype="counter",
+                         help=reg.info.description)
+            fam.samples.append(Sample("_total", labels, provider.get_value()))
+            families.append(fam)
+        else:
+            fam = Family(name=sanitize_name(name), mtype="gauge",
+                         help=reg.info.description)
+            fam.samples.append(Sample("", labels, provider.get_value()))
+            families.append(fam)
+    return families
+
+
+#: a collector contributes extra families to one exposition pass
+Collector = Callable[[], Iterable[Family]]
+
+
+def health_collector(bus=None, supervisor=None) -> Collector:
+    """Families for the health plane: signal counts by severity level from the
+    :class:`~surge_tpu.health.HealthSignalBus` and per-component restart counts
+    from the :class:`~surge_tpu.health.HealthSupervisor` (the JMX health-MBean
+    numbers, now scrapeable)."""
+
+    def collect() -> Iterable[Family]:
+        out: List[Family] = []
+        if bus is not None:
+            fam = Family(name="surge_health_signals", mtype="counter",
+                         help="health signals emitted onto the bus, by level")
+            # snapshot: emit() mutates on the event-loop thread while this
+            # runs on the HTTP scrape thread — iterating live would 500 a
+            # scrape on a concurrent first-seen-level insert
+            counts = dict(bus.signal_counts)
+            for level in sorted(counts):
+                fam.samples.append(Sample(
+                    "_total", (("level", level),), float(counts[level])))
+            out.append(fam)
+        if supervisor is not None:
+            fam = Family(name="surge_health_component_restarts",
+                         mtype="counter",
+                         help="supervisor-driven restarts per registered "
+                              "component")
+            for comp, n in sorted(supervisor.restart_counts().items()):
+                fam.samples.append(Sample(
+                    "_total", (("component", comp),), float(n)))
+            out.append(fam)
+        return out
+
+    return collect
+
+
+def render_openmetrics(registry: Metrics,
+                       collectors: Sequence[Collector] = ()) -> str:
+    """The full OpenMetrics payload, ``# EOF``-terminated."""
+    lines: List[str] = []
+    for fam in registry_families(registry):
+        _render_family(lines, fam)
+    for collect in collectors:
+        for fam in collect():
+            _render_family(lines, fam)
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsHTTPServer:
+    """Stdlib scrape endpoint: ``GET /metrics`` (or ``/``) renders the registry.
+
+    No third-party server dependency — a ``ThreadingHTTPServer`` on a daemon
+    thread, same zero-footprint philosophy as the hand-written gRPC glue. Bind
+    with ``port=0`` to take an ephemeral port (returned by :meth:`start`).
+    """
+
+    def __init__(self, registry: Metrics, host: str = "127.0.0.1",
+                 port: int = 0,
+                 collectors: Sequence[Collector] = ()) -> None:
+        self.registry = registry
+        self.collectors = list(collectors)
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.bound_port: Optional[int] = None
+
+    def start(self) -> int:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                if self.path.split("?")[0] not in ("/", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render_openmetrics(
+                        outer.registry, outer.collectors).encode()
+                except Exception as exc:  # noqa: BLE001 — scrape must answer
+                    self.send_error(500, repr(exc))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # silence per-scrape noise
+                pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self.bound_port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"metrics-scrape-{self.bound_port}", daemon=True)
+        self._thread.start()
+        return self.bound_port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -11,7 +11,7 @@ from __future__ import annotations
 import bisect
 import time
 from collections import deque
-from typing import Deque, List, Protocol, Sequence, Tuple
+from typing import Callable, Deque, List, Protocol, Sequence, Tuple
 
 
 class MetricValueProvider(Protocol):
@@ -88,10 +88,13 @@ class ExponentialWeightedMovingAverage:
 
 class RateHistogram:
     """Events/second over a sliding window (statistics/RateHistogram.scala; the
-    registry exposes 1/5/15-minute variants)."""
+    registry exposes 1/5/15-minute variants). ``clock`` is injectable so rate
+    assertions can run against a frozen time source instead of ``time.time``."""
 
-    def __init__(self, window_s: float) -> None:
+    def __init__(self, window_s: float,
+                 clock: Callable[[], float] = time.time) -> None:
         self.window_s = window_s
+        self._clock = clock
         self._events: Deque[Tuple[float, float]] = deque()  # (timestamp, weight)
         self._sum = 0.0
 
@@ -107,14 +110,17 @@ class RateHistogram:
             self._sum -= w
 
     def get_value(self) -> float:
-        self._evict(time.time())
+        self._evict(self._clock())
         return self._sum / self.window_s
 
 
 class TimeBucketHistogram:
     """Counts of recorded durations falling into fixed latency buckets
     (statistics/TimeBucketHistogram.scala analog). ``get_value`` reports the p-th
-    percentile estimate (upper bucket bound)."""
+    percentile estimate (upper bucket bound). The full distribution —
+    ``bucket_counts()`` (cumulative), ``total_count``, ``sum_value`` — backs the
+    OpenMetrics ``_bucket``/``_sum``/``_count`` series
+    (:mod:`surge_tpu.metrics.exposition`)."""
 
     def __init__(self, buckets_ms: Sequence[float] = (1, 5, 10, 25, 50, 100, 250, 500,
                                                       1000, 2500, 5000, 10000),
@@ -123,12 +129,19 @@ class TimeBucketHistogram:
         self.counts: List[int] = [0] * (len(self.bounds) + 1)
         self.percentile = percentile
         self._total = 0
+        self._sum = 0.0
 
     def update(self, value: float, timestamp: float) -> None:
         self.counts[bisect.bisect_left(self.bounds, value)] += 1
         self._total += 1
+        self._sum += value
 
     def get_value(self) -> float:
+        """Percentile estimate. An overflow-bucket hit reports the largest
+        FINITE bound: a ``float("inf")`` here broke every numeric export (JSON
+        has no Infinity; the text format would emit a non-plottable point) —
+        the true unbounded tail is visible in the exposition's ``+Inf`` bucket
+        instead."""
         if self._total == 0:
             return 0.0
         target = self.percentile * self._total
@@ -136,5 +149,24 @@ class TimeBucketHistogram:
         for i, c in enumerate(self.counts):
             seen += c
             if seen >= target:
-                return self.bounds[i] if i < len(self.bounds) else float("inf")
+                return self.bounds[i] if i < len(self.bounds) else self.bounds[-1]
         return self.bounds[-1]
+
+    @property
+    def total_count(self) -> int:
+        return self._total
+
+    @property
+    def sum_value(self) -> float:
+        return self._sum
+
+    def bucket_counts(self) -> List[Tuple[float, int]]:
+        """Cumulative ``(upper_bound, count)`` pairs ending with ``(+Inf, total)``
+        — exactly the Prometheus/OpenMetrics histogram contract."""
+        out: List[Tuple[float, int]] = []
+        running = 0
+        for bound, c in zip(self.bounds, self.counts):
+            running += c
+            out.append((bound, running))
+        out.append((float("inf"), self._total))
+        return out

@@ -36,7 +36,7 @@ class EngineNode:
     def __init__(self, logic, control_plane_target: str, log,
                  node_name: str, config: Config | None = None,
                  advertise_host: str = "127.0.0.1",
-                 cluster_sharding: bool = False) -> None:
+                 cluster_sharding: bool = False, tracer=None) -> None:
         self.config = config or default_config()
         if cluster_sharding:
             self.config = self.config.with_overrides({
@@ -47,12 +47,17 @@ class EngineNode:
         self.client = ControlPlaneClient(control_plane_target, self.local,
                                          config=self.config,
                                          on_peers=self._on_peers)
-        self.deliver = GrpcRemoteDeliver(logic, config=self.config)
+        self.deliver = GrpcRemoteDeliver(logic, config=self.config,
+                                         tracer=tracer)
+        if tracer is not None and hasattr(log, "tracer"):
+            # broker-hop spans: a GrpcLogTransport (or LogServer-shaped peer)
+            # exposes a settable tracer; other log impls simply lack the attr
+            log.tracer = tracer
         self.engine = SurgeEngine(
             logic, log=log, config=self.config, local_host=self.local,
             tracker=self.client.tracker, remote_deliver=self.deliver,
             membership=self.client.membership,
-            shard_allocation=self.client.allocation)
+            shard_allocation=self.client.allocation, tracer=tracer)
         self.server = NodeTransportServer(self.engine)
         self._advertise_host = advertise_host
 

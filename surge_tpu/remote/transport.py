@@ -64,6 +64,18 @@ class NodeTransportServer:
 
         fut: "asyncio.Future" = asyncio.get_running_loop().create_future()
         env = Envelope(message=message, reply=fut, headers=dict(request.headers))
+        span = None
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is not None:
+            # receive-side transport span: child of the sender's forward span
+            # via the traceparent riding the request headers
+            from surge_tpu.tracing import inject_context
+
+            span = tracer.start_span("transport.receive", headers=env.headers)
+            span.set_attribute("aggregate_id", request.aggregate_id)
+            span.set_attribute("partition", request.partition)
+            span.set_attribute("kind", kind)
+            env.headers = inject_context(span.context, env.headers)
         try:
             # the sender already resolved ownership to this node: deliver into the
             # addressed partition's local region (no re-route — see deliver_local)
@@ -71,7 +83,12 @@ class NodeTransportServer:
                                              env)
             result = await fut
         except Exception as exc:  # noqa: BLE001 — routing errors surface as failure
+            if span is not None:
+                span.record_exception(exc)
             return pb.DeliverReply(outcome="failure", error=repr(exc))
+        finally:
+            if span is not None:
+                span.finish()
 
         if isinstance(message, GetState):
             if result is None:
@@ -113,9 +130,10 @@ class GrpcRemoteDeliver:
     caller's future (ask semantics preserved across the wire)."""
 
     def __init__(self, logic, addresses: Dict[HostPort, str] | None = None,
-                 timeout_s: float = 30.0, config=None) -> None:
+                 timeout_s: float = 30.0, config=None, tracer=None) -> None:
         self.logic = logic
         self.config = config  # TLS when surge.grpc.tls.enabled (remote/security.py)
+        self.tracer = tracer  # forward-hop spans (None = zero overhead)
         # HostPort -> "host:port" gRPC target; defaults to the HostPort itself
         self.addresses = dict(addresses or {})
         self.timeout_s = timeout_s
@@ -157,15 +175,30 @@ class GrpcRemoteDeliver:
 
     def __call__(self, owner: HostPort, partition: int, aggregate_id: str,
                  env: Envelope) -> None:
+        span = None
+        if self.tracer is not None:
+            # sender-side transport span: child of the router span, open until
+            # the remote reply resolves (the cross-node hop's wall time)
+            from surge_tpu.tracing import inject_context
+
+            span = self.tracer.start_span("remote.deliver", headers=env.headers)
+            span.set_attribute("aggregate_id", aggregate_id)
+            span.set_attribute("partition", partition)
+            span.set_attribute("owner", str(owner))
+            env.headers = inject_context(span.context, env.headers)
         try:
             request = self._encode(partition, aggregate_id, env)
         except Exception as exc:  # noqa: BLE001 — unserializable command etc.
+            if span is not None:
+                span.record_exception(exc)
+                span.finish()
             fail_future(env.reply, exc)
             return
         # chain after the aggregate's previous in-flight forward (FIFO per aggregate)
         key = (owner, aggregate_id)
         prev = self._chains.get(key)
-        task = asyncio.ensure_future(self._forward_after(prev, owner, request, env))
+        task = asyncio.ensure_future(
+            self._forward_after(prev, owner, request, env, span))
         self._chains[key] = task
         task.add_done_callback(lambda t, k=key: self._chain_done(k, t))
         self._inflight.add(task)
@@ -176,10 +209,15 @@ class GrpcRemoteDeliver:
             del self._chains[key]
 
     async def _forward_after(self, prev: Optional[asyncio.Task], owner: HostPort,
-                             request: pb.DeliverRequest, env: Envelope) -> None:
+                             request: pb.DeliverRequest, env: Envelope,
+                             span=None) -> None:
         if prev is not None:
             await asyncio.wait({prev})  # _forward never raises; outcome irrelevant
-        await self._forward(owner, request, env)
+        try:
+            await self._forward(owner, request, env)
+        finally:
+            if span is not None:
+                span.finish()
 
     def _encode(self, partition: int, aggregate_id: str,
                 env: Envelope) -> pb.DeliverRequest:

@@ -503,10 +503,14 @@ class ReplayEngine:
 
     def __init__(self, spec: ReplaySpec, config: Config | None = None,
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 mesh_axis: Optional[str] = None, unroll: int = 1) -> None:
+                 mesh_axis: Optional[str] = None, unroll: int = 1,
+                 profiler=None) -> None:
         self.spec = spec
         self.config = config or default_config()
         self.mesh = mesh
+        # optional surge_tpu.replay.profiler.ReplayProfiler: every hook below
+        # is behind one `is None` check so the default path pays nothing
+        self.profiler = profiler
         # batch-axis name: explicit arg > surge.replay.mesh-axes (first entry)
         if mesh_axis is None:
             mesh_axis = (self.config.get_str("surge.replay.mesh-axes", "data")
@@ -614,6 +618,15 @@ class ReplayEngine:
 
     # -- helpers ------------------------------------------------------------------------
 
+    def _fetch_stage(self):
+        """Profiler context for a device→host state pull (the fetch barrier
+        that closes the chunk's device time); no-op without a profiler."""
+        if self.profiler is None:
+            from contextlib import nullcontext
+
+            return nullcontext()
+        return self.profiler.stage("fetch")
+
     def _carry_struct(self) -> StateTree:
         return {f.name: None for f in self.spec.registry.state.fields}
 
@@ -705,8 +718,9 @@ class ReplayEngine:
                 {k: v[start:stop] for k, v in enc.cols.items()}, bs,
                 derived_cols=enc.derived_cols,
                 ordinal_base=None if ordinal_base is None else ordinal_base[start:stop])
-            for name in out:
-                out[name][start:stop] = np.asarray(carry[name])[: stop - start]
+            with self._fetch_stage():
+                for name in out:
+                    out[name][start:stop] = np.asarray(carry[name])[: stop - start]
             padded += bs * scanned
 
         return ReplayResult(states=out, num_aggregates=b,
@@ -763,12 +777,13 @@ class ReplayEngine:
             carry, scanned = self._fold_window(carry, enc.type_ids, enc.cols, bs,
                                                derived_cols=enc.derived_cols,
                                                ordinal_base=ob)
-            for name in out:
-                chunk_states = np.asarray(carry[name])[: stop - start]
-                if idxs is None:
-                    out[name][start:stop] = chunk_states
-                else:
-                    out[name][idxs] = chunk_states
+            with self._fetch_stage():
+                for name in out:
+                    chunk_states = np.asarray(carry[name])[: stop - start]
+                    if idxs is None:
+                        out[name][start:stop] = chunk_states
+                    else:
+                        out[name][idxs] = chunk_states
             padded += bs * scanned
             total_events += int(enc.lengths.sum())
         return ReplayResult(states=out, num_aggregates=b,
@@ -858,9 +873,22 @@ class ReplayEngine:
             self.stats["h2d_s"] += t2 - t1
             self.stats["windows"] += 1
             scanned += width
-            self._signatures.add(
-                (key, packed.shape, tuple((k, v.shape) for k, v in sorted(side.items()))))
-            carry = fold(carry, *window)
+            sig = (key, packed.shape,
+                   tuple((k, v.shape) for k, v in sorted(side.items())))
+            first_dispatch = sig not in self._signatures
+            self._signatures.add(sig)
+            if self.profiler is None:
+                carry = fold(carry, *window)
+            else:
+                self.profiler.count_windows()
+                self.profiler.record("encode", t1 - t0, width=width)
+                self.profiler.record("h2d", t2 - t1, width=width)
+                # a fresh signature means this dispatch pays the XLA compile;
+                # steady dispatches only pay the async host-side handoff
+                with self.profiler.stage(
+                        "compile" if first_dispatch else "dispatch",
+                        width=width, batch=bs):
+                    carry = fold(carry, *window)
         return carry, scanned
 
     # -- resident-corpus path (single upload, on-device densify) ------------------------
@@ -917,7 +945,11 @@ class ReplayEngine:
         guard = max(self.resident_tile_width(), _WIRE_GUARD_MIN)
         packed = np.pad(packed, ((0, guard), (0, 0)))
         side_flat = {k: np.pad(v, (0, guard)) for k, v in side_flat.items()}
-        self.stats["pack_s"] += time.perf_counter() - t0
+        pack_elapsed = time.perf_counter() - t0
+        self.stats["pack_s"] += pack_elapsed
+        if self.profiler is not None:
+            self.profiler.record("encode", pack_elapsed,
+                                 events=to_pack.num_events, kind="pack_resident")
         # lengths/starts are in the PACKED stream's aggregate-id order; the
         # grouped path then permutes the lane VIEW only (indirection), the
         # ungrouped path already permuted the stream itself
@@ -1014,6 +1046,10 @@ class ReplayEngine:
         jax.block_until_ready(flat_wire)
         upload_s = time.perf_counter() - t0
         self.stats["h2d_s"] += upload_s
+        if self.profiler is not None:
+            self.profiler.record(
+                "h2d", upload_s, kind="upload_resident",
+                bytes=packed_b.nbytes + sum(v.nbytes for v in side_b.values()))
         return ResidentCorpus(
             derived_key=dict(w.derived_key), flat_wire=flat_wire,
             flat_side=flat_side, starts=w.starts,
@@ -1163,10 +1199,29 @@ class ReplayEngine:
                                 num_aggregates=0, num_events=0, padded_events=0)
         perm = resident.perm
         init_sorted, ord_sorted = _apply_perm(perm, init_carry, ordinal_base)
-        slab, padded = self._dispatch_resident(resident, init_sorted, ord_sorted)
-        # the single synchronization of the whole replay
+        if self.profiler is None:
+            slab, padded = self._dispatch_resident(resident, init_sorted,
+                                                   ord_sorted)
+            # the single synchronization of the whole replay
+            states = self._pull_states(slab, b, resident.perm, resident.cache)
+        else:
+            with self.profiler.replay_pass("replay.resident", aggregates=b,
+                                           events=resident.num_events):
+                n0 = self.num_compiles()
+                t0 = time.perf_counter()
+                slab, padded = self._dispatch_resident(resident, init_sorted,
+                                                       ord_sorted)
+                self.profiler.record(
+                    "compile" if self.num_compiles() > n0 else "dispatch",
+                    time.perf_counter() - t0, aggregates=b)
+                # the fetch stage IS the single sync: a real device→host pull
+                # whose data dependency closes every chained tile program
+                # (fetch-barrier discipline — never block_until_ready)
+                with self.profiler.stage("fetch", aggregates=b):
+                    states = self._pull_states(slab, b, resident.perm,
+                                               resident.cache)
         return ReplayResult(
-            states=self._pull_states(slab, b, resident.perm, resident.cache),
+            states=states,
             num_aggregates=b, num_events=resident.num_events,
             padded_events=padded)
 
@@ -1321,6 +1376,8 @@ class ReplayEngine:
                 continue
             k_cap = self._plan_cap(k_n)
             self.stats["windows"] += k_n
+            if self.profiler is not None:
+                self.profiler.count_windows(k_n)
             if use_dense:
                 dw, ds, i0s_d, tbs_d = self._dense_tiles(
                     resident, plan, bs, i0s, t_bases, k_cap)
@@ -1619,7 +1676,8 @@ class ReplayEngine:
         out_sorted = {f.name: np.empty((b,), dtype=f.dtype)
                       for f in state_fields}
         for lanes, slab in pieces:
-            piece_states = self._pull_states(slab, int(lanes.shape[0]), None)
+            with self._fetch_stage():
+                piece_states = self._pull_states(slab, int(lanes.shape[0]), None)
             for name, col in piece_states.items():
                 out_sorted[name][lanes] = col
         return ReplayResult(states=_unapply_perm(perm, out_sorted),

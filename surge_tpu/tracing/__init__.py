@@ -17,7 +17,10 @@ SurgeGenericBusinessLogicTrait.scala:33), with :class:`InMemoryTracer` for tests
 
 from __future__ import annotations
 
+import json
+import random
 import re
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -25,6 +28,7 @@ from typing import Callable, Dict, List, Mapping, Optional
 
 __all__ = [
     "InMemoryTracer",
+    "JsonlSpanExporter",
     "NoopTracer",
     "Span",
     "SpanContext",
@@ -120,12 +124,30 @@ class Span:
 
 
 class Tracer:
-    """Span factory with an exporter hook."""
+    """Span factory with an exporter hook and head-based probability sampling.
+
+    ``sample_rate`` is the probability a NEW trace (root span) is sampled; the
+    decision rides the W3C ``sampled`` flag so every downstream hop — including
+    remote ones — honors the head's verdict without its own coin flip. Unsampled
+    spans are still created (context propagation stays intact, attributes are
+    cheap dict writes) but never reach the exporter.
+    """
 
     def __init__(self, service: str = "surge",
-                 exporter: Optional[Callable[[Span], None]] = None) -> None:
+                 exporter: Optional[Callable[[Span], None]] = None,
+                 sample_rate: float = 1.0,
+                 seed: Optional[int] = None) -> None:
         self.service = service
         self._exporter = exporter
+        self.sample_rate = sample_rate
+        self._rng = random.Random(seed)
+
+    def _sample_root(self) -> bool:
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        return self._rng.random() < self.sample_rate
 
     def start_span(self, name: str,
                    parent: Optional[SpanContext | Span] = None,
@@ -139,11 +161,12 @@ class Tracer:
                               sampled=parent_ctx.sampled)
             return Span(name=name, context=ctx, parent_id=parent_ctx.span_id,
                         _tracer=self)
-        ctx = SpanContext(trace_id=_new_trace_id(), span_id=_new_span_id())
+        ctx = SpanContext(trace_id=_new_trace_id(), span_id=_new_span_id(),
+                          sampled=self._sample_root())
         return Span(name=name, context=ctx, _tracer=self)
 
     def _on_finished(self, span: Span) -> None:
-        if self._exporter is not None:
+        if self._exporter is not None and span.context.sampled:
             self._exporter(span)
 
 
@@ -157,9 +180,60 @@ class NoopTracer(Tracer):
 class InMemoryTracer(Tracer):
     """Collects finished spans for assertions (test exporter)."""
 
-    def __init__(self, service: str = "surge") -> None:
+    def __init__(self, service: str = "surge", sample_rate: float = 1.0,
+                 seed: Optional[int] = None) -> None:
         self.finished: List[Span] = []
-        super().__init__(service=service, exporter=self.finished.append)
+        super().__init__(service=service, exporter=self.finished.append,
+                         sample_rate=sample_rate, seed=seed)
 
     def spans_named(self, name: str) -> List[Span]:
         return [s for s in self.finished if s.name == name]
+
+
+class JsonlSpanExporter:
+    """Span exporter appending one JSON object per finished span to a file.
+
+    The production-shaped sink for the no-SDK tracer: the JSONL stream is what
+    an OTel collector sidecar (or plain ``jq``) tails. Thread-safe — spans
+    finish on the event loop AND on executor/log-client threads — and flushed
+    per span so a crash loses at most the span being written.
+
+    Usage: ``tracer = Tracer(exporter=JsonlSpanExporter(path), sample_rate=0.1)``.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        self._file = open(path, "a", encoding="utf-8")
+
+    def __call__(self, span: Span) -> None:
+        record = {
+            "name": span.name,
+            "trace_id": span.context.trace_id,
+            "span_id": span.context.span_id,
+            "parent_id": span.parent_id,
+            "start_time": span.start_time,
+            "end_time": span.end_time,
+            "duration_ms": span.duration_ms,
+            "status": span.status,
+            "attributes": span.attributes,
+            "events": [{"time": t, "name": n, "attributes": a}
+                       for t, n, a in span.events],
+        }
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._file.closed:
+                return
+            self._file.write(line + "\n")
+            self._file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._file.closed:
+                self._file.close()
+
+    def __enter__(self) -> "JsonlSpanExporter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -45,6 +45,14 @@ def test_admin_introspection_and_controls():
         assert metrics["values"]["surge.engine.command-rate.one-minute-rate"] > 0
         assert "surge.aggregate.state-fetch-timer" in metrics["descriptions"]
 
+        # OpenMetrics exposition over gRPC: typed families, EOF-terminated,
+        # health counters joined in
+        text = await client.metrics_text()
+        assert text.endswith("# EOF\n")
+        assert "# TYPE surge_engine_command_rate_one_minute_rate gauge" in text
+        assert "surge_aggregate_command_handling_timer_ms_bucket" in text
+        assert "# TYPE surge_health_signals counter" in text
+
         comps = await client.components()
         assert "state-store" in comps  # the engine registers its indexer
 

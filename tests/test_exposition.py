@@ -1,0 +1,194 @@
+"""OpenMetrics exposition: grammar validation, golden payload, scrape endpoint,
+and the instrument-catalog contract (every predeclared EngineMetrics instrument
+appears in the export AND in the docs metric catalog)."""
+
+import os
+import re
+import urllib.request
+
+from surge_tpu.health import HealthSignalBus, HealthSupervisor
+from surge_tpu.metrics import MetricInfo, Metrics, engine_metrics
+from surge_tpu.metrics.exposition import (
+    MetricsHTTPServer,
+    health_collector,
+    render_openmetrics,
+    sanitize_name,
+)
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "metrics.om")
+
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(gauge|counter|histogram)$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"            # sample name
+    r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\})?"  # labels
+    r" (-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|[+-]Inf|NaN)$")     # value
+
+
+def validate_openmetrics(text: str) -> dict:
+    """Minimal OpenMetrics grammar check; returns {family: (type, samples)}.
+
+    Enforces the parts a scraper depends on: EOF terminator, every sample under
+    a declared TYPE, counter samples suffixed ``_total``, histogram series
+    limited to ``_bucket``/``_sum``/``_count`` with cumulative buckets ending
+    in a ``+Inf`` bucket that equals ``_count``.
+    """
+    assert text.endswith("# EOF\n"), "payload must end with # EOF"
+    lines = text.splitlines()
+    assert lines[-1] == "# EOF"
+    families: dict = {}
+    for ln in lines[:-1]:
+        if ln.startswith("# HELP "):
+            m = _HELP_RE.match(ln)
+            assert m, f"bad HELP line: {ln!r}"
+            continue
+        if ln.startswith("# TYPE "):
+            m = _TYPE_RE.match(ln)
+            assert m, f"bad TYPE line: {ln!r}"
+            name, mtype = m.group(1), m.group(2)
+            assert name not in families, f"duplicate TYPE for {name}"
+            families[name] = (mtype, [])
+            continue
+        assert not ln.startswith("#"), f"unknown comment line: {ln!r}"
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"bad sample line: {ln!r}"
+        sample_name, labels_raw, value = m.group(1), m.group(2), m.group(3)
+        fam_name = None
+        for suffix in ("", "_total", "_bucket", "_sum", "_count"):
+            cand = sample_name[: len(sample_name) - len(suffix)] \
+                if suffix and sample_name.endswith(suffix) else (
+                    sample_name if not suffix else None)
+            if cand in families:
+                fam_name = cand
+                break
+        assert fam_name is not None, f"sample without TYPE: {ln!r}"
+        mtype, samples = families[fam_name]
+        suffix = sample_name[len(fam_name):]
+        if mtype == "counter":
+            assert suffix == "_total", f"counter sample must be _total: {ln!r}"
+        elif mtype == "histogram":
+            assert suffix in ("_bucket", "_sum", "_count"), ln
+        else:
+            assert suffix == "", f"gauge sample must be bare: {ln!r}"
+        samples.append((suffix, labels_raw or "", value))
+    # histogram invariants: cumulative buckets, +Inf bucket == _count
+    for name, (mtype, samples) in families.items():
+        if mtype != "histogram":
+            continue
+        buckets = [(lr, float(v)) for s, lr, v in samples if s == "_bucket"]
+        counts = [float(v) for s, _, v in samples if s == "_count"]
+        assert buckets and len(counts) == 1, name
+        values = [v for _, v in buckets]
+        assert values == sorted(values), f"{name} buckets not cumulative"
+        assert 'le="+Inf"' in buckets[-1][0], f"{name} missing +Inf bucket"
+        assert buckets[-1][1] == counts[0], f"{name} +Inf != _count"
+    return families
+
+
+def golden_engine_metrics():
+    """The canonical deterministic recording sequence behind the golden file
+    (tools/regen_golden_metrics.py re-renders it)."""
+    em = engine_metrics()
+    em.state_fetch_timer.record_ms(5.0)
+    em.state_fetch_timer.record_ms(15.0)
+    em.command_handling_timer.record_ms(2.0)
+    em.publish_failure_counter.record()
+    em.fence_counter.record(2)
+    em.live_entities.record(7)
+    em.standby_lag.record(3)
+    em.replay_timer.record_ms(120000.0)  # overflow bucket: +Inf only in export
+    return em
+
+
+def test_render_matches_golden():
+    text = render_openmetrics(golden_engine_metrics().registry)
+    validate_openmetrics(text)
+    with open(GOLDEN_PATH) as f:
+        golden = f.read()
+    assert text == golden, (
+        "OpenMetrics payload drifted from tests/golden/metrics.om — if the "
+        "change is intentional run tools/regen_golden_metrics.py and update "
+        "the docs/observability.md metric catalog")
+
+
+def test_every_engine_instrument_in_export_and_docs_catalog():
+    em = engine_metrics()
+    text = render_openmetrics(em.registry)
+    families = validate_openmetrics(text)
+    docs = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                             "observability.md")).read()
+    for dotted in em.registry.get_metrics():
+        fam = sanitize_name(dotted[:-len(".p99")] + "_ms"
+                            if dotted.endswith(".p99") else dotted)
+        assert fam in families, f"{dotted} missing from the export"
+        base = dotted[:-len(".p99")] if dotted.endswith(".p99") else dotted
+        base = re.sub(r"\.(min|max)$", "", base)
+        assert base in docs, f"{base} missing from the docs metric catalog"
+    # histogram series carry buckets, not a lone p99 point
+    assert families[sanitize_name("surge.replay.rebuild-timer") + "_ms"][0] \
+        == "histogram"
+
+
+def test_label_escaping_and_name_sanitization():
+    m = Metrics()
+    m.gauge(MetricInfo("weird.metric-name/x", "helps\nwith\\newlines",
+                       tags=(("topic", 'a"b\\c\nd'),))).record(1)
+    text = render_openmetrics(m)
+    validate_openmetrics(text)
+    assert "weird_metric_name_x" in text
+    assert '\\"b\\\\c\\nd' in text  # escaped quote, backslash, newline
+
+
+def test_health_collector_joins_export():
+    bus = HealthSignalBus()
+    sup = HealthSupervisor(bus)
+    bus.emit("publisher-0.fenced", "error", source="publisher-0")
+    bus.emit("state-store.lag", "warning", source="state-store")
+    bus.emit("state-store.lag", "warning", source="state-store")
+
+    class _Dummy:
+        async def restart(self):
+            pass
+
+        async def shutdown(self):
+            pass
+
+    sup.register("state-store", _Dummy(), restart_patterns=[])
+    sup._registrations["state-store"].restarts = 2
+    text = render_openmetrics(Metrics(),
+                              collectors=[health_collector(bus, sup)])
+    validate_openmetrics(text)
+    assert 'surge_health_signals_total{level="error"} 1' in text
+    assert 'surge_health_signals_total{level="warning"} 2' in text
+    assert ('surge_health_component_restarts_total{component="state-store"} 2'
+            in text)
+
+
+def test_http_scrape_endpoint():
+    em = engine_metrics()
+    em.live_entities.record(4)
+    bus = HealthSignalBus()
+    bus.emit("x.y", "trace")
+    server = MetricsHTTPServer(em.registry, collectors=[health_collector(bus)])
+    port = server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            body = resp.read().decode()
+        families = validate_openmetrics(body)
+        assert "surge_engine_live_entities" in families
+        assert 'surge_health_signals_total{level="trace"} 1' in body
+        # unknown paths 404, the scrape loop stays up
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as resp:
+            assert resp.status == 200
+    finally:
+        server.stop()

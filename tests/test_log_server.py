@@ -42,6 +42,39 @@ def rec(topic, key, value, partition=0):
     return LogRecord(topic=topic, key=key, value=value, partition=partition)
 
 
+def test_broker_hop_spans_share_one_trace():
+    """Client-side log.Transact span and the broker-side log.server.transact
+    span join on one trace id — the traceparent crosses as gRPC call metadata.
+    Reads get log.<Method> spans; WaitForAppend long-polls are excluded."""
+    from surge_tpu.tracing import InMemoryTracer
+
+    client_tracer, server_tracer = InMemoryTracer(), InMemoryTracer()
+    server = LogServer(InMemoryLog(), tracer=server_tracer)
+    port = server.start()
+    log = GrpcLogTransport(f"127.0.0.1:{port}", tracer=client_tracer)
+    try:
+        log.create_topic(TopicSpec("t", 1))
+        p = log.transactional_producer("txn-span")
+        p.begin()
+        p.send(rec("t", "k", b"v"))
+        p.commit()
+        log.read("t", 0)
+
+        tx = client_tracer.spans_named("log.Transact")[0]
+        assert tx.attributes["op"] == "commit"
+        srv = server_tracer.spans_named("log.server.transact")
+        # the open-producer flow performs broker-side transacts too; find the
+        # one continuing the CLIENT's commit trace
+        joined = [s for s in srv if s.context.trace_id == tx.context.trace_id]
+        assert joined and joined[0].parent_id == tx.context.span_id
+        assert joined[0].attributes["op"] == "commit"
+        assert client_tracer.spans_named("log.Read")
+        assert not client_tracer.spans_named("log.WaitForAppend")
+    finally:
+        log.close()
+        server.stop()
+
+
 def test_transaction_atomic_multi_topic_commit_over_wire(broker):
     log = broker()
     log.create_topic(TopicSpec("events", 2))

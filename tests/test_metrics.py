@@ -98,5 +98,77 @@ def test_engine_metrics_quiver_names():
                  "surge.replay.rebuild-timer",
                  "surge.engine.command-rate.one-minute-rate",
                  "surge.producer.fences",
-                 "surge.engine.live-entities"):
+                 "surge.engine.live-entities",
+                 "surge.state-store.standby-lag",
+                 "surge.replay.profile.encode-timer",
+                 "surge.replay.profile.fetch-timer"):
         assert name in snap, name
+
+
+def test_engine_metrics_fields_all_declared():
+    """Regression: standby_lag was assigned in __post_init__ without a
+    field(init=False) declaration like its siblings — every attribute the
+    quiver assigns must be a declared dataclass field."""
+    import dataclasses
+
+    from surge_tpu.metrics import EngineMetrics
+
+    em = engine_metrics()
+    declared = {f.name for f in dataclasses.fields(EngineMetrics)}
+    assigned = set(vars(em))
+    assert assigned <= declared, assigned - declared
+    assert "standby_lag" in declared
+
+
+def test_timer_time_async():
+    import asyncio
+
+    async def scenario():
+        m = Metrics()
+        t = m.timer(MetricInfo("async-t"))
+
+        async def work():
+            await asyncio.sleep(0.01)
+            return 42
+
+        assert await t.time_async(work()) == 42
+        # exceptions still record the elapsed time and propagate
+        async def boom():
+            await asyncio.sleep(0.01)
+            raise RuntimeError("x")
+
+        try:
+            await t.time_async(boom())
+        except RuntimeError:
+            pass
+        return m.get_metrics()
+
+    snap = asyncio.run(scenario())
+    assert snap["async-t.min"] >= 5.0  # both awaits took >= ~10ms
+    assert snap["async-t.max"] >= snap["async-t.min"]
+
+
+def test_rate_histogram_injectable_clock():
+    now = [60.0]
+    r = RateHistogram(window_s=60.0, clock=lambda: now[0])
+    for i in range(60):
+        r.update(1.0, float(i))  # ts 0..59, all inside the frozen window
+    assert r.get_value() == 1.0
+    now[0] = 90.0  # half the marks age out, deterministically
+    assert r.get_value() == 0.5
+    now[0] = 200.0
+    assert r.get_value() == 0.0
+
+
+def test_time_bucket_histogram_overflow_is_finite():
+    h = TimeBucketHistogram(buckets_ms=(10, 100), percentile=0.99)
+    for _ in range(100):
+        h.update(5000.0, 0)  # everything lands past the last bound
+    v = h.get_value()
+    assert v == 100  # largest FINITE bound, never float("inf")
+    # the unbounded tail is still visible in the histogram series
+    buckets = h.bucket_counts()
+    assert buckets[-1] == (float("inf"), 100)
+    assert buckets[-2] == (100, 0)
+    assert h.total_count == 100
+    assert h.sum_value == 500000.0

@@ -99,6 +99,55 @@ def test_cross_node_commands_and_reads():
     asyncio.run(scenario())
 
 
+def test_traceparent_propagates_across_remote_hop_and_back():
+    """One trace follows a command over the wire: the ask span on node A, the
+    forward span in A's transport, the receive span in B's server, and B's
+    entity span all share one trace id — and the reply resolves the ask."""
+    from surge_tpu.tracing import InMemoryTracer
+
+    tracer_a, tracer_b = InMemoryTracer(), InMemoryTracer()
+    tracers = {A: tracer_a, B: tracer_b}
+
+    async def scenario():
+        log = InMemoryLog()
+        tracker = PartitionTracker()
+        engines, servers, delivers = {}, {}, {}
+        for host in (A, B):
+            deliver = GrpcRemoteDeliver(make_logic(), tracer=tracers[host])
+            delivers[host] = deliver
+            engines[host] = create_engine(
+                make_logic(), log=log, config=CFG, local_host=host,
+                tracker=tracker, remote_deliver=deliver, tracer=tracers[host])
+        for host in (A, B):
+            await engines[host].start()
+            servers[host] = NodeTransportServer(engines[host])
+            port = await servers[host].start()
+            for d in delivers.values():
+                d.set_address(host, f"127.0.0.1:{port}")
+        tracker.update({A: [0, 1], B: [2, 3]})
+        remote_agg = next(f"agg-{i}" for i in range(50)
+                          if engines[A].router.partition_for(f"agg-{i}") in (2, 3))
+        r = await engines[A].aggregate_for(remote_agg).send_command(
+            counter.Increment(remote_agg))
+        assert isinstance(r, CommandSuccess) and r.state.count == 1
+        await _teardown(engines, servers, delivers)
+
+    asyncio.run(scenario())
+
+    ask = tracer_a.spans_named("aggregate-ref.ProcessMessage")[0]
+    tid = ask.context.trace_id
+    fwd = tracer_a.spans_named("remote.deliver")[0]
+    recv = tracer_b.spans_named("transport.receive")[0]
+    entity = tracer_b.spans_named("entity.ProcessMessage")[0]
+    assert fwd.context.trace_id == tid
+    assert recv.context.trace_id == tid  # traceparent survived the wire
+    assert recv.parent_id == fwd.context.span_id
+    assert entity.context.trace_id == tid
+    # ...and back: the forward span closed only after the remote reply resolved
+    assert ask.status == "ok" and fwd.end_time is not None
+    assert fwd.end_time >= recv.start_time
+
+
 def test_missing_command_format_fails_fast():
     async def scenario():
         log, tracker, engines, servers, delivers = await _two_nodes(with_commands=False)

@@ -1,0 +1,114 @@
+"""Per-stage replay profiler: stage coverage on the streaming and resident
+paths, span emission, DEBUG gating (disabled at INFO = engine holds None)."""
+
+import numpy as np
+
+from surge_tpu.codec.tensor import ColumnarEvents
+from surge_tpu.config import default_config
+from surge_tpu.metrics import Metrics, RecordingLevel, engine_metrics
+from surge_tpu.models.counter import make_replay_spec
+from surge_tpu.replay.engine import ReplayEngine
+from surge_tpu.replay.profiler import ReplayProfiler
+from surge_tpu.tracing import InMemoryTracer
+
+CFG = default_config().with_overrides({
+    "surge.replay.batch-size": 64,
+    "surge.replay.time-chunk": 16,
+})
+
+
+def make_events(n_agg=32, n_per=20):
+    n = n_agg * n_per
+    return ColumnarEvents(
+        num_aggregates=n_agg,
+        agg_idx=np.repeat(np.arange(n_agg, dtype=np.int32), n_per),
+        type_ids=np.zeros(n, dtype=np.int32),
+        cols={"increment_by": np.ones(n, dtype=np.int64),
+              "decrement_by": np.zeros(n, dtype=np.int64)},
+        derived_cols={"sequence_number": "ordinal"})
+
+
+def make_profiled_engine(tracer=None):
+    registry = Metrics(recording_level=RecordingLevel.DEBUG)
+    prof = ReplayProfiler.if_enabled(registry, engine_metrics(registry),
+                                     tracer=tracer)
+    assert prof is not None
+    return ReplayEngine(make_replay_spec(), config=CFG, profiler=prof), prof, registry
+
+
+def test_if_enabled_gates_on_recording_level():
+    assert ReplayProfiler.if_enabled(Metrics()) is None  # INFO: hot path free
+    assert ReplayProfiler.if_enabled(
+        Metrics(recording_level=RecordingLevel.DEBUG)) is not None
+    assert ReplayProfiler.if_enabled(
+        Metrics(recording_level=RecordingLevel.TRACE)) is not None
+
+
+def test_streaming_path_stage_breakdown():
+    engine, prof, registry = make_profiled_engine()
+    ev = make_events()
+    res = engine.replay_columnar(ev)
+    assert (res.states["count"] == 20).all()
+    s = prof.summary()
+    # windowed path: pack + transfer + (first-dispatch) compile + fetch
+    assert s["encode"]["count"] > 0
+    assert s["h2d"]["count"] > 0
+    assert s["compile"]["count"] > 0  # first window paid the XLA compile
+    assert s["fetch"]["count"] > 0
+    assert s["total_accounted_s"] > 0
+    # windows counts DISPATCHED windows (engine-reported), not record() calls
+    assert s["windows"] == engine.stats["windows"]
+    # the per-stage timings also landed in the DEBUG registry instruments
+    snap = registry.get_metrics()
+    assert snap["surge.replay.profile.windows"] == engine.stats["windows"]
+    assert snap["surge.replay.profile.compile-timer.max"] > 0
+    # a re-fold of the same shapes is steady: dispatch, not compile
+    before = s["compile"]["count"]
+    engine.replay_columnar(ev)
+    s2 = prof.summary()
+    assert s2["compile"]["count"] == before
+    assert s2["dispatch"]["count"] > 0
+
+
+def test_resident_path_emits_pass_and_stage_spans():
+    tracer = InMemoryTracer()
+    engine, prof, _ = make_profiled_engine(tracer=tracer)
+    ev = make_events()
+    resident = engine.prepare_resident(ev)
+    res = engine.replay_resident(resident)
+    assert (res.states["count"] == 20).all()
+    s = prof.summary()
+    assert s["encode"]["count"] > 0  # pack_resident
+    assert s["h2d"]["count"] > 0  # upload_resident
+    assert s["fetch"]["count"] > 0  # the single state pull
+    names = [sp.name for sp in tracer.finished]
+    assert "replay.resident" in names
+    assert "replay.fetch" in names
+    # stage spans parent under the pass span, one trace per pass
+    pass_span = tracer.spans_named("replay.resident")[0]
+    fetch = tracer.spans_named("replay.fetch")[0]
+    assert fetch.context.trace_id == pass_span.context.trace_id
+    assert fetch.parent_id == pass_span.context.span_id
+    assert pass_span.attributes["events"] == ev.num_events
+
+
+def test_unprofiled_engine_holds_none_and_matches_results():
+    plain = ReplayEngine(make_replay_spec(), config=CFG)
+    assert plain.profiler is None
+    engine, _, _ = make_profiled_engine()
+    ev = make_events()
+    a = plain.replay_columnar(ev)
+    b = engine.replay_columnar(ev)
+    assert (a.states["count"] == b.states["count"]).all()
+    assert (a.states["version"] == b.states["version"]).all()
+
+
+def test_summary_reset():
+    engine, prof, _ = make_profiled_engine()
+    engine.replay_columnar(make_events(8, 4))
+    assert prof.summary()["total_accounted_s"] > 0
+    prof.reset()
+    s = prof.summary()
+    assert s["total_accounted_s"] == 0
+    assert all(s[k]["count"] == 0 for k in
+               ("encode", "h2d", "compile", "dispatch", "fetch"))

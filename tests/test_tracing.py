@@ -64,6 +64,57 @@ def test_noop_tracer_collects_nothing():
         pass  # no exporter, no error
 
 
+def test_head_sampling_drops_unsampled_traces():
+    tracer = InMemoryTracer(sample_rate=0.5, seed=7)
+    sampled = 0
+    for _ in range(200):
+        root = tracer.start_span("op")
+        # children inherit the head decision — no per-hop coin flips
+        child = tracer.start_span("child", parent=root)
+        assert child.context.sampled == root.context.sampled
+        child.finish()
+        root.finish()
+        sampled += root.context.sampled
+    # only sampled spans reached the exporter, roots and children alike
+    assert len(tracer.finished) == 2 * sampled
+    assert 40 < sampled < 160  # probabilistic but seeded: loose bounds
+
+
+def test_sampling_decision_rides_traceparent():
+    tracer = InMemoryTracer(sample_rate=0.0)
+    root = tracer.start_span("op")
+    assert not root.context.sampled
+    headers = inject_context(root.context)
+    assert headers["traceparent"].endswith("-00")
+    # a downstream (fully-sampling) tracer still honors the head's verdict
+    downstream = InMemoryTracer(sample_rate=1.0)
+    child = downstream.start_span("hop", headers=headers)
+    child.finish()
+    assert not child.context.sampled
+    assert downstream.finished == []
+
+
+def test_jsonl_exporter_roundtrip(tmp_path):
+    import json
+
+    from surge_tpu.tracing import JsonlSpanExporter, Tracer
+
+    path = str(tmp_path / "spans.jsonl")
+    with JsonlSpanExporter(path) as exporter:
+        tracer = Tracer(exporter=exporter)
+        with tracer.start_span("outer") as outer:
+            outer.set_attribute("k", 1)
+            with tracer.start_span("inner", parent=outer) as inner:
+                inner.add_event("checkpoint", {"n": 2})
+    lines = [json.loads(l) for l in open(path)]
+    assert [r["name"] for r in lines] == ["inner", "outer"]
+    assert lines[0]["parent_id"] == lines[1]["span_id"]
+    assert lines[0]["trace_id"] == lines[1]["trace_id"]
+    assert lines[0]["events"][0]["name"] == "checkpoint"
+    assert lines[1]["attributes"] == {"k": 1}
+    assert lines[0]["duration_ms"] >= 0
+
+
 def test_engine_trace_continuity_ref_to_entity():
     """The ask span and the entity receive span share one trace id."""
     from surge_tpu import SurgeCommandBusinessLogic, CommandSuccess, create_engine, default_config
@@ -91,10 +142,24 @@ def test_engine_trace_continuity_ref_to_entity():
     asyncio.run(scenario())
 
     asks = tracer.spans_named("aggregate-ref.ProcessMessage")
+    routes = tracer.spans_named("router.deliver")
+    shards = tracer.spans_named("shard.deliver")
     receives = tracer.spans_named("entity.ProcessMessage")
+    publishes = tracer.spans_named("publisher.publish")
     assert len(asks) == 2 and len(receives) == 2
-    # continuity: entity span is a child in the same trace
-    assert receives[0].context.trace_id == asks[0].context.trace_id
-    assert receives[0].parent_id == asks[0].context.span_id
+    # continuity: every hop of command #1 rides ONE trace, parent-chained
+    # ref → router → shard → entity → publisher
+    tid = asks[0].context.trace_id
+    assert routes[0].context.trace_id == tid
+    assert routes[0].parent_id == asks[0].context.span_id
+    assert shards[0].context.trace_id == tid
+    assert shards[0].parent_id == routes[0].context.span_id
+    assert receives[0].context.trace_id == tid
+    assert receives[0].parent_id == shards[0].context.span_id
     assert receives[0].attributes["aggregate_id"] == "agg1"
     assert receives[0].status == "ok"
+    # the successful command published; its publish span chains under the
+    # entity receive span (the rejected command publishes nothing)
+    assert publishes, "expected a publisher.publish span"
+    assert publishes[0].context.trace_id == tid
+    assert publishes[0].parent_id == receives[0].context.span_id

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Regenerate tests/golden/metrics.om from the canonical recording sequence.
+
+Run after an intentional change to the exposition format or the predeclared
+EngineMetrics instrument set, then update the docs/observability.md catalog to
+match (tests/test_exposition.py enforces both)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+from surge_tpu.metrics.exposition import render_openmetrics  # noqa: E402
+from test_exposition import GOLDEN_PATH, golden_engine_metrics  # noqa: E402
+
+os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+text = render_openmetrics(golden_engine_metrics().registry)
+with open(GOLDEN_PATH, "w") as f:
+    f.write(text)
+print(f"wrote {GOLDEN_PATH} ({len(text.splitlines())} lines)")
