@@ -547,6 +547,116 @@ def _merge_replay(payload: dict, child: dict, cpu_eps: float) -> None:
                 payload["num_events"] / first / cpu_eps, 2)
 
 
+def restore_bench() -> dict:
+    """SURGE_BENCH_RESTORE=1: full vs checkpointed cold start (docs/compaction.md).
+
+    Builds an events topic, checkpoints it at the head, appends a tail, then
+    times ``restore_from_events`` from offset 0 against the checkpoint+tail
+    route — reporting events folded and wall seconds for each, asserting the
+    stores come out byte-identical and the checkpointed route folds strictly
+    fewer events. Knobs: SURGE_BENCH_RESTORE_EVENTS (total, default 200k),
+    SURGE_BENCH_RESTORE_TAIL (tail fraction, default 0.1),
+    SURGE_BENCH_RESTORE_BACKEND (cpu|tpu, default the platform's replay
+    backend: cpu here in the parent)."""
+    import random
+    import shutil
+    import tempfile
+
+    from surge_tpu.config import default_config
+    from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
+    from surge_tpu.models import counter
+    from surge_tpu.serialization import SerializedMessage
+    from surge_tpu.store import CheckpointStore, CheckpointWriter, restore_from_events
+    from surge_tpu.store.kv import InMemoryKeyValueStore
+
+    total = int(os.environ.get("SURGE_BENCH_RESTORE_EVENTS", 200_000))
+    tail_frac = float(os.environ.get("SURGE_BENCH_RESTORE_TAIL", 0.1))
+    backend = os.environ.get("SURGE_BENCH_RESTORE_BACKEND", "cpu")
+    n_agg = max(total // 10, 1)
+    model = counter.CounterModel()
+    evt_fmt = counter.event_formatting()
+    state_fmt = counter.state_formatting()
+    deserialize_event = lambda b: evt_fmt.read_event(  # noqa: E731
+        SerializedMessage(key="", value=b))
+    serialize_state = lambda a, s: state_fmt.write_state(s).value  # noqa: E731
+
+    log_t = InMemoryLog()
+    log_t.create_topic(TopicSpec("events", 4))
+    prod = log_t.transactional_producer("bench")
+    rng = random.Random(11)
+    seqs: dict = {}
+
+    def publish(n: int) -> None:
+        prod.begin()
+        for i in range(n):
+            a = f"agg-{rng.randrange(n_agg)}"
+            seqs[a] = seqs.get(a, 0) + 1
+            ev = (counter.CountIncremented(a, 1, seqs[a])
+                  if rng.random() < 0.8
+                  else counter.CountDecremented(a, 1, seqs[a]))
+            prod.send(LogRecord(topic="events", key=a,
+                                value=evt_fmt.write_event(ev).value,
+                                partition=hash(a) % 4))
+            if i % 5000 == 4999:
+                prod.commit()
+                prod.begin()
+        prod.commit()
+
+    head = total - int(total * tail_frac)
+    publish(head)
+    ck_dir = tempfile.mkdtemp(prefix="surge-bench-ckpt-")
+    out: dict = {}
+    try:
+        writer = CheckpointWriter(
+            log_t, "events", model, CheckpointStore(ck_dir, fsync=False),
+            serialize_state=serialize_state,
+            deserialize_event=deserialize_event,
+            deserialize_state=state_fmt.read_state)
+        t0 = time.perf_counter()
+        ckpt = writer.write_now()
+        out["restore_checkpoint_write_s"] = round(time.perf_counter() - t0, 3)
+        publish(total - head)
+
+        cfg = default_config().with_overrides({
+            "surge.replay.backend": backend,
+            "surge.replay.restore-spill-events": -1})
+        full_store, ckpt_store = InMemoryKeyValueStore(), InMemoryKeyValueStore()
+        t0 = time.perf_counter()
+        full = restore_from_events(
+            log_t, "events", full_store, deserialize_event=deserialize_event,
+            serialize_state=serialize_state, model=model,
+            replay_spec=counter.make_replay_spec(), config=cfg)
+        full_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        tail = restore_from_events(
+            log_t, "events", ckpt_store, deserialize_event=deserialize_event,
+            serialize_state=serialize_state, model=model,
+            replay_spec=counter.make_replay_spec(), config=cfg,
+            checkpoint=ckpt, deserialize_state=state_fmt.read_state)
+        ckpt_s = time.perf_counter() - t0
+        mismatch = sum(
+            1 for k in set(full_store._data) | set(ckpt_store._data)
+            if full_store.get(k) != ckpt_store.get(k))
+        if mismatch or tail.num_events >= full.num_events:
+            raise AssertionError(
+                f"checkpointed restore invariant broken: {mismatch} mismatched "
+                f"aggregates, {tail.num_events} vs {full.num_events} events")
+        out.update({
+            "restore_backend": backend,
+            "restore_full_events_folded": full.num_events,
+            "restore_full_s": round(full_s, 3),
+            "restore_ckpt_events_folded": tail.num_events,
+            "restore_ckpt_s": round(ckpt_s, 3),
+            "restore_speedup": round(full_s / ckpt_s, 2) if ckpt_s else 0.0,
+        })
+        log(f"restore bench ({backend}): full {full.num_events} events "
+            f"{full_s:.2f}s vs checkpointed {tail.num_events} events "
+            f"{ckpt_s:.2f}s ({out['restore_speedup']}x, byte-identical)")
+        return out
+    finally:
+        shutil.rmtree(ck_dir, ignore_errors=True)
+
+
 def main() -> None:
     orig_env = dict(os.environ)
     # the parent NEVER initializes the tunneled backend — pin it to the host CPU
@@ -587,6 +697,14 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — phase 2 must not void phase 1
             log(f"steady-state latency phase failed: {exc!r}")
             payload["latency_error"] = f"{type(exc).__name__}: {exc}"
+
+    # -- optional restore phase: full vs checkpointed cold start ------------------
+    if os.environ.get("SURGE_BENCH_RESTORE", "0") == "1":
+        try:
+            payload.update(restore_bench())
+        except Exception as exc:  # noqa: BLE001 — must not void the headline
+            log(f"restore bench phase failed: {exc!r}")
+            payload["restore_error"] = f"{type(exc).__name__}: {exc}"
 
     t0 = time.perf_counter()
     corpus = synth_counter_corpus(num_aggregates, num_events, seed=42,

@@ -22,6 +22,11 @@ METHODS = {
     "ListComponents": (pb.Empty, pb.RegistrationsReply),
     "RestartComponent": (pb.ComponentRequest, pb.ComponentReply),
     "StopEngine": (pb.Empty, pb.ComponentReply),
+    # log compaction / checkpoint plane (docs/compaction.md). Message reuse,
+    # same as GetMetricsText: ComponentRequest.name carries the topic ("" =
+    # every compacted topic); the stats ride MetricsReply as JSON
+    "CompactLog": (pb.ComponentRequest, pb.MetricsReply),
+    "WriteCheckpoint": (pb.Empty, pb.ComponentReply),
 }
 
 
@@ -76,6 +81,36 @@ class AdminServer:
         except Exception as exc:  # noqa: BLE001 — operator gets the failure back
             return pb.ComponentReply(ok=False, detail=repr(exc))
 
+    async def CompactLog(self, request, context) -> pb.MetricsReply:
+        """Force a compaction pass over the engine's compacted topics (or just
+        ``request.name``) — the operator-triggered path of the background
+        compactor, ratio thresholds bypassed. Returns the per-partition stats."""
+        stats = await self.engine.compactor.compact_once(
+            request.name or None, force=True)
+        return pb.MetricsReply(metrics_json=json.dumps(
+            [s.as_dict() for s in stats]).encode())
+
+    async def WriteCheckpoint(self, request, context) -> pb.ComponentReply:
+        """Advance the checkpoint materializer to the current end offsets and
+        publish a checkpoint now (the pre-maintenance 'bound my next cold
+        start' op)."""
+        writer = getattr(self.engine, "checkpoint_writer", None)
+        if writer is None:
+            return pb.ComponentReply(
+                ok=False,
+                detail="no checkpoint writer (surge.store.checkpoint.path unset)")
+        try:
+            import asyncio
+
+            ckpt = await asyncio.get_running_loop().run_in_executor(
+                None, writer.write_now)
+            return pb.ComponentReply(
+                ok=True, detail=json.dumps({
+                    "seq": ckpt.seq, "aggregates": ckpt.num_aggregates,
+                    "events_covered": ckpt.events_covered()}))
+        except Exception as exc:  # noqa: BLE001 — operator gets the failure back
+            return pb.ComponentReply(ok=False, detail=repr(exc))
+
     async def StopEngine(self, request, context) -> pb.ComponentReply:
         try:
             await self.engine.stop()
@@ -127,6 +162,15 @@ class AdminClient:
 
     async def restart_component(self, name: str) -> tuple[bool, str]:
         r = await self._calls["RestartComponent"](pb.ComponentRequest(name=name))
+        return r.ok, r.detail
+
+    async def compact_log(self, topic: str = "") -> list:
+        """Force a compaction pass; returns per-partition stats dicts."""
+        r = await self._calls["CompactLog"](pb.ComponentRequest(name=topic))
+        return json.loads(r.metrics_json)
+
+    async def write_checkpoint(self) -> tuple[bool, str]:
+        r = await self._calls["WriteCheckpoint"](pb.Empty())
         return r.ok, r.detail
 
     async def stop_engine(self) -> tuple[bool, str]:

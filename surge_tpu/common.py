@@ -89,14 +89,34 @@ class BackgroundTask:
 
     async def stop(self) -> None:
         task, self._task = self._task, None
-        if task is not None and not task.done():
-            task.cancel()
-            if task is asyncio.current_task():
-                return  # self-stop: the cancellation lands at our next await point
+        if task is None or task.done():
+            return
+        task.cancel()
+        if task is asyncio.current_task():
+            return  # self-stop: the cancellation lands at our next await point
+        # A single cancel() is not enough on Python 3.10: asyncio.wait_for can
+        # swallow a cancellation that races a timeout or a completing inner
+        # future (bpo-37658 family), so a loop built on wait_for keeps running
+        # and a bare `await task` hangs forever — the tier-1 cluster-test hang
+        # (stop chains stuck on the indexer during set_partitions). Re-issue
+        # the cancel on a short deadline until the task actually ends.
+        for attempt in range(120):
             try:
-                await task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001 — stop is best-effort
-                pass
+                await asyncio.wait_for(asyncio.shield(task), timeout=0.25)
+                return
+            except asyncio.TimeoutError:
+                task.cancel()
+                if attempt == 19:
+                    logger.warning("background task %s ignored cancellation "
+                                   "for 5s; re-cancelling", self._name)
+            except asyncio.CancelledError:
+                if task.done():
+                    return  # the task ended cancelled — the normal stop path
+                raise  # stop() itself was cancelled
+            except Exception:  # noqa: BLE001 — stop is best-effort
+                return
+        logger.error("background task %s failed to stop after repeated "
+                     "cancellation; abandoning the await", self._name)
 
     @property
     def running(self) -> bool:

@@ -156,6 +156,34 @@ class SurgeEngine(Controllable):
         self.metrics_server = None  # started on demand by serve_metrics()
         self._rebalance_listeners: List[Callable] = []
         self._indexer_listener: Optional[Callable] = None
+        # log compaction + state checkpoints (docs/compaction.md): the
+        # compactor exists unconditionally so the admin CompactLog RPC can
+        # always force a pass; its background scheduler only runs when enabled
+        from surge_tpu.log.compactor import LogCompactor
+
+        self.compactor = LogCompactor(
+            self.log, config=self.config, topics=[logic.state_topic],
+            metrics=self.metrics,
+            on_signal=self.health_bus.signal_fn("log-compactor"))
+        self.checkpoint_writer = None
+        ckpt_path = self.config.get_str("surge.store.checkpoint.path", "")
+        if ckpt_path and logic.events_topic:
+            from surge_tpu.store.checkpoint import (CheckpointStore,
+                                                    CheckpointWriter)
+
+            self._checkpoint_store = CheckpointStore(
+                ckpt_path,
+                keep=self.config.get_int("surge.store.checkpoint.keep", 2))
+            self.checkpoint_writer = CheckpointWriter(
+                self.log, logic.events_topic, logic.model,
+                self._checkpoint_store,
+                serialize_state=lambda a, s: logic.state_format.write_state(s).value,
+                deserialize_event=self._deserialize_event,
+                deserialize_state=logic.state_format.read_state,
+                config=self.config, metrics=self.metrics,
+                on_signal=self.health_bus.signal_fn("checkpoint-writer"))
+        else:
+            self._checkpoint_store = None
 
     # -- lifecycle (SurgeMessagePipeline.scala:185-240) ----------------------------------
 
@@ -183,6 +211,16 @@ class SurgeEngine(Controllable):
                         self._indexer_partitions()))
                 self.tracker.register(self._indexer_listener, replay_current=False)
             await self.indexer.start()
+            if self.config.get_bool("surge.log.compaction.enabled"):
+                await self.compactor.start()
+                self.health_supervisor.register(
+                    "log-compactor", self.compactor,
+                    restart_patterns=[RegexMatcher(r"log-compactor.*fatal")])
+            if self.checkpoint_writer is not None:
+                await self.checkpoint_writer.start()
+                self.health_supervisor.register(
+                    "checkpoint-writer", self.checkpoint_writer,
+                    restart_patterns=[RegexMatcher(r"checkpoint-writer.*fatal")])
             await self.router.start()
             if not self._external_tracker and not self.tracker.assignments.assignments:
                 # single-node mode: self-assign every partition (no external control
@@ -231,6 +269,9 @@ class SurgeEngine(Controllable):
             await self.loop_prober.stop()
         await self.router.stop()  # stops regions (shards + publishers)
         await self.indexer.stop()
+        await self.compactor.stop()
+        if self.checkpoint_writer is not None:
+            await self.checkpoint_writer.stop()
         self.surge_model.close()
         self.status = EngineStatus.STOPPED
         return Ack()
@@ -453,19 +494,34 @@ class SurgeEngine(Controllable):
                         result.num_aggregates, result.num_events, result.backend)
             return result
 
+        # checkpointed cold start: fold only the tail past the newest durable
+        # checkpoint's watermarks (docs/compaction.md). None when no checkpoint
+        # store is configured or none has been written yet — then the fold
+        # runs from offset 0 exactly as before. latest() reads + decodes the
+        # whole checkpoint file, so it runs in the executor with the fold.
         result = await asyncio.get_running_loop().run_in_executor(None, lambda: restore_from_events(
             self.log, self.logic.events_topic, self.indexer.store,
-            deserialize_event=lambda b: evt_fmt.read_event(SerializedMessage(key="", value=b)),
+            deserialize_event=self._deserialize_event,
             serialize_state=lambda agg_id, st: state_fmt.write_state(st).value,
             model=self.logic.model, replay_spec=spec,
             encode_event=getattr(self.logic, "encode_event", None),
             decode_state=getattr(self.logic, "decode_state", None),
-            config=self.config, mesh=mesh, partitions=owned))
+            config=self.config, mesh=mesh, partitions=owned,
+            checkpoint=(self._checkpoint_store.latest()
+                        if self._checkpoint_store is not None else None),
+            deserialize_state=state_fmt.read_state,
+            encode_state=getattr(self.logic, "encode_state", None)))
         self._overlay_snapshots_and_prime(owned)
         self._record_replay_metrics(result, rebuild_t0)
         logger.info("rebuild_from_events: %d aggregates from %d events via %s",
                     result.num_aggregates, result.num_events, result.backend)
         return result
+
+    def _deserialize_event(self, raw: bytes):
+        from surge_tpu.serialization import SerializedMessage
+
+        return self.logic.event_format.read_event(
+            SerializedMessage(key="", value=raw))
 
     def _record_replay_metrics(self, result, t0: float) -> None:
         """Feed the predeclared replay instruments (SURVEY §5.5): fold wall

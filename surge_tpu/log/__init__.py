@@ -19,6 +19,7 @@ from surge_tpu.log.transport import (
 )
 from surge_tpu.log.memory import InMemoryLog
 from surge_tpu.log.file import FileLog
+from surge_tpu.log.compactor import CompactionStats, LogCompactor
 
 
 def __getattr__(name):
@@ -33,7 +34,9 @@ def __getattr__(name):
     raise AttributeError(name)
 
 __all__ = [
+    "CompactionStats",
     "FileLog",
+    "LogCompactor",
     "GrpcLogTransport",
     "LogServer",
     "InMemoryLog",

@@ -411,6 +411,18 @@ class GrpcLogTransport:
             topic=topic, partition=partition))
         return {m.key: msg_to_record(m) for m in reply.records}
 
+    def compact_topic(self, topic: str, partition: int) -> dict:
+        """Trigger broker-side compaction of one compacted-topic partition;
+        returns the CompactionStats dict. Raises RuntimeError when the broker
+        refuses (replicating leader, non-compacted topic)."""
+        import json
+
+        reply = self._invoke("CompactTopic", pb.ReadRequest(
+            topic=topic, partition=partition))
+        if not reply.ok:
+            raise RuntimeError(f"CompactTopic failed: {reply.error}")
+        return json.loads(reply.records[0].value)
+
     async def wait_for_append(self, topic: str, partition: int,
                               after_offset: int) -> None:
         loop = asyncio.get_running_loop()

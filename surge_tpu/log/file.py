@@ -11,9 +11,26 @@ Layout under the root directory::
     commits.log           the COMMIT JOURNAL: one JSON line per transaction listing
                           [topic, partition, base_offset, count, seg_end_pos] per
                           touched partition, fsynced after the data blocks
+    compaction.json       the COMPACTION RECOVERY MANIFEST: per compacted
+                          partition, the current segment file (generational
+                          name), its post-swap frontier (end_offset/end_pos),
+                          and the clean state (clean_end/clean_count) feeding
+                          the dirty-ratio scheduler (surge_tpu.log.compactor)
     data/{topic}-{p}.seg  one segment file per topic-partition: a sequence of
                           compressed blocks (surge_tpu.log.segment), one per
-                          transaction per partition
+                          transaction per partition. After a compaction the
+                          current file is data/{topic}-{p}.g{N}.seg — blocks
+                          are latest-record-per-key with sparse offsets, and
+                          the manifest names which generation is live
+
+**Compaction crash-safety.** ``compact_partition`` writes the rewritten segment to a
+``.tmp`` beside the new generational name, fsyncs it, renames it into place (an atomic
+publish of a complete file), and only then rewrites the manifest — the real commit
+point, since recovery resolves each partition's file through the manifest. A crash at
+any earlier step leaves the manifest pointing at the intact old segment and at most an
+orphaned file that recovery sweeps. Journal lines written after the swap carry
+positions in the new file (appends continue at its end), so recovery uses the journal
+frontier when it is ahead of the manifest's and the manifest frontier otherwise.
 
 **Crash atomicity.** A transaction is durable iff its journal line is. Data blocks are
 written and fsynced *before* the journal line, so on recovery every journaled block is
@@ -64,6 +81,7 @@ class _Partition:
         self.blocks: List[Tuple[int, int, int]] = []  # (base_offset, file_pos, count)
         self.end_offset = 0
         self.end_pos = 0  # durable end of the segment file
+        self.gen = 0  # compaction generation (bumped on every segment swap)
         self.file = None  # append handle, opened lazily
         # decoded-block LRU keyed by file_pos: a tailing indexer re-reads the last
         # block every poll and a rebuild walks blocks in order; both hit the cache
@@ -94,6 +112,8 @@ class FileLog(LogBase):
         self._topics: Dict[str, TopicSpec] = {}
         self._epochs: Dict[str, int] = {}
         self._parts: Dict[Tuple[str, int], _Partition] = {}
+        self._clean: Dict[Tuple[str, int], Tuple[int, int]] = {}
+        self._manifest: Dict[str, Dict[str, dict]] = {}  # topic -> str(p) -> entry
         self._append_events: Dict[Tuple[str, int], asyncio.Event] = {}
         os.makedirs(os.path.join(root, "data"), exist_ok=True)
         self._journal_path = os.path.join(root, "commits.log")
@@ -115,6 +135,25 @@ class FileLog(LogBase):
         if os.path.exists(epochs_path):
             with open(epochs_path) as f:
                 self._epochs = {k: int(v) for k, v in json.load(f).items()}
+        # compaction manifest: names each compacted partition's CURRENT segment
+        # file (generational) and the frontier at swap time. Loaded before the
+        # journal scan so frontier resolution and block rebuild run against the
+        # live file, and orphans of interrupted swaps can be swept.
+        manifest_path = os.path.join(self.root, "compaction.json")
+        if os.path.exists(manifest_path):
+            with open(manifest_path) as f:
+                self._manifest = json.load(f)
+        for topic, parts in self._manifest.items():
+            for p_str, entry in parts.items():
+                key = (topic, int(p_str))
+                part = self._parts.get(key)
+                if part is None:
+                    continue  # manifest for a topic dropped from topics.json
+                part.path = os.path.join(self.root, entry["file"])
+                part.gen = int(entry.get("gen", 0))
+                self._clean[key] = (int(entry.get("clean_end", 0)),
+                                    int(entry.get("clean_count", 0)))
+        self._sweep_orphans()
 
         # journal scan: the durable frontier of every partition. A torn tail line
         # (crash mid-journal-write) is truncated away so the reopened append handle
@@ -145,6 +184,13 @@ class FileLog(LogBase):
         # stale higher frontier is superseded.
         for key, part in self._parts.items():
             end_offset, end_pos = durable.get(key, (0, 0))
+            entry = self._manifest.get(key[0], {}).get(str(key[1]))
+            if entry is not None and int(entry["end_offset"]) >= end_offset:
+                # no post-swap appends journaled: the journal's positions refer
+                # to the pre-compaction file — the manifest frontier (recorded
+                # at swap time against the live generational file) supersedes
+                end_offset = int(entry["end_offset"])
+                end_pos = int(entry["end_pos"])
             size = os.path.getsize(part.path) if os.path.exists(part.path) else 0
             if size > end_pos:  # torn tail from a crashed commit
                 with open(part.path, "r+b") as f:
@@ -181,6 +227,41 @@ class FileLog(LogBase):
 
     def _seg_path(self, topic: str, partition: int) -> str:
         return os.path.join(self.root, "data", f"{topic}-{partition}.seg")
+
+    def _gen_path(self, topic: str, partition: int, gen: int) -> str:
+        return os.path.join(self.root, "data", f"{topic}-{partition}.g{gen}.seg")
+
+    def _sweep_orphans(self) -> None:
+        """Delete stale segment generations and interrupted-swap leftovers: any
+        ``{topic}-{p}[.gN].seg[.tmp]`` that is not some partition's current
+        file. A crash between the tmp-write/rename and the manifest update
+        leaves exactly these; the manifest still names the intact old file."""
+        live = {os.path.basename(p.path) for p in self._parts.values()}
+        stems = set()  # every name a known partition could own, any generation
+        for topic, p in self._parts:
+            stems.add((f"{topic}-{p}.seg", ""))
+            stems.add((f"{topic}-{p}.g", ".seg"))
+        data_dir = os.path.join(self.root, "data")
+        try:
+            names = os.listdir(data_dir)
+        except OSError:
+            return
+        for name in names:
+            if name in live:
+                continue
+            stem = name[:-4] if name.endswith(".tmp") else name
+            owned = any(
+                stem == prefix if not suffix else (
+                    stem.startswith(prefix) and stem.endswith(suffix)
+                    and stem[len(prefix):-len(suffix)].isdigit())
+                for prefix, suffix in stems)
+            if not owned:
+                continue
+            try:
+                os.unlink(os.path.join(data_dir, name))
+                logger.info("swept orphan segment %s", name)
+            except OSError:
+                pass
 
     def _persist_json(self, name: str, obj) -> None:
         path = os.path.join(self.root, name)
@@ -297,13 +378,22 @@ class FileLog(LogBase):
     # -- reads ----------------------------------------------------------------------------
 
     def _decode_block_at(self, part: _Partition, topic: str, p: int,
-                         file_pos: int) -> List[LogRecord]:
+                         file_pos: int, path: Optional[str] = None,
+                         gen: Optional[int] = None) -> List[LogRecord]:
+        """Decode one block. ``path``/``gen`` carry a reader's consistent
+        snapshot: block positions are only meaningful against the segment file
+        they were snapshotted with, and a concurrent compaction swaps the
+        file — the gen guard keeps stale decodes out of the fresh cache."""
         with self._lock:  # cache read-modify-write must not race concurrent evictions
-            hit = part._cache.get(file_pos)
-            if hit is not None:
-                part._cache.move_to_end(file_pos)
-                return hit
-        with open(part.path, "rb") as f:  # decode outside the lock (idempotent)
+            fresh = gen is None or part.gen == gen
+            if fresh:
+                hit = part._cache.get(file_pos)
+                if hit is not None:
+                    part._cache.move_to_end(file_pos)
+                    return hit
+            if path is None:
+                path = part.path
+        with open(path, "rb") as f:  # decode outside the lock (idempotent)
             f.seek(file_pos)
             header = f.read(seg.HEADER_SIZE)
             plen = seg.header_payload_len(header)
@@ -312,7 +402,7 @@ class FileLog(LogBase):
         # approximate decoded footprint: payload bytes + per-record overhead
         size = sum(len(r.value or b"") + len(r.key or "") + 64 for r in recs)
         with self._lock:
-            if file_pos not in part._cache:
+            if (gen is None or part.gen == gen) and file_pos not in part._cache:
                 part._cache[file_pos] = recs
                 part._cache_sizes[file_pos] = size
                 part._cache_bytes += size
@@ -326,24 +416,33 @@ class FileLog(LogBase):
              max_records: Optional[int] = None,
              isolation: str = "read_committed") -> Sequence[LogRecord]:
         del isolation  # only journaled (committed) blocks are ever indexed
-        with self._lock:
-            part = self._parts.get((topic, partition))
-            if part is None:  # parity with InMemoryLog: reads never create topics
-                return []
-            blocks = list(part.blocks)
-        out: List[LogRecord] = []
-        limit = max_records if max_records is not None else None
-        for base, pos, count in blocks:
-            if base + count <= from_offset:
-                continue
-            recs = self._decode_block_at(part, topic, partition, pos)
-            for r in recs:
-                if r.offset < from_offset:
-                    continue
-                out.append(r)
-                if limit is not None and len(out) >= limit:
-                    return out
-        return out
+        while True:
+            with self._lock:
+                part = self._parts.get((topic, partition))
+                if part is None:  # parity with InMemoryLog: reads never create topics
+                    return []
+                blocks = list(part.blocks)
+                path, gen = part.path, part.gen
+            out: List[LogRecord] = []
+            limit = max_records if max_records is not None else None
+            try:
+                for base, pos, count in blocks:
+                    if base + count <= from_offset:
+                        continue
+                    recs = self._decode_block_at(part, topic, partition, pos,
+                                                 path, gen)
+                    for r in recs:
+                        if r.offset < from_offset:
+                            continue
+                        out.append(r)
+                        if limit is not None and len(out) >= limit:
+                            return out
+                return out
+            except (FileNotFoundError, seg.BlockCorruptError):
+                with self._lock:
+                    if part.gen == gen:
+                        raise  # real corruption, not a concurrent compaction
+                # the segment was swapped mid-read: retry on the new snapshot
 
     def end_offset(self, topic: str, partition: int,
                    isolation: str = "read_committed") -> int:
@@ -351,6 +450,130 @@ class FileLog(LogBase):
         with self._lock:
             self.topic(topic)
             return self._parts[(topic, partition)].end_offset
+
+    # -- compaction ---------------------------------------------------------------------
+
+    def compact_partition(self, topic: str, partition: int, *,
+                          tombstone_retention_s: float = 0.0,
+                          now: Optional[float] = None):
+        """Rewrite one partition's segment to latest-record-per-key with
+        tombstone GC (policy: surge_tpu.log.compactor.select_retained),
+        crash-safely: tmp write → fsync → rename to the next generational
+        file → manifest update (the commit point, see module docstring).
+        Offsets and ``end_offset`` are preserved; retained records regroup
+        into one block per contiguous offset run."""
+        from surge_tpu.log.compactor import CompactionStats, select_retained
+
+        t0 = time.perf_counter()
+        with self._lock:
+            self.topic(topic)
+            part = self._parts[(topic, partition)]
+            blocks = list(part.blocks)
+            frontier_off, frontier_pos = part.end_offset, part.end_pos
+            old_path, gen = part.path, part.gen
+        records: List[LogRecord] = []
+        for base, pos, count in blocks:  # decode outside the lock (immutable)
+            records.extend(self._decode_block_at(part, topic, partition, pos,
+                                                 old_path, gen))
+        retained, dropped_tombstones = select_retained(
+            records, now=now if now is not None else time.time(),
+            tombstone_retention_s=tombstone_retention_s)
+        stats = lambda after_bytes, after_n, dur: CompactionStats(  # noqa: E731
+            topic=topic, partition=partition,
+            records_before=len(records), records_after=after_n,
+            bytes_before=frontier_pos, bytes_after=after_bytes,
+            tombstones_dropped=dropped_tombstones, duration_s=dur)
+        if len(retained) == len(records):
+            # nothing to drop: record the clean pass (dirty ratio resets)
+            # without churning a new segment generation. Clean frontier is the
+            # SNAPSHOT frontier — records appended since it were never
+            # examined and must stay dirty for the next pass
+            with self._lock:
+                if part.gen == gen:  # lost race with another compactor: skip
+                    self._clean[(topic, partition)] = (frontier_off,
+                                                       len(retained))
+                    self._write_manifest_entry(topic, partition, part)
+            return stats(frontier_pos, len(retained),
+                         time.perf_counter() - t0)
+
+        # rewrite: contiguous offset runs become blocks (decode assigns
+        # offsets base+i, so a block must never span a compaction hole)
+        runs: List[List[LogRecord]] = []
+        for r in retained:
+            if runs and r.offset == runs[-1][-1].offset + 1:
+                runs[-1].append(r)
+            else:
+                runs.append([r])
+        new_path = self._gen_path(topic, partition, gen + 1)
+        tmp = new_path + ".tmp"
+        new_blocks: List[Tuple[int, int, int]] = []
+        with open(tmp, "wb") as f:
+            pos = 0
+            for run in runs:
+                block = seg.encode_block(run, run[0].offset)
+                new_blocks.append((run[0].offset, pos, len(run)))
+                f.write(block)
+                pos += len(block)
+            clean_size = pos
+            f.flush()
+            if self._fsync:
+                os.fsync(f.fileno())
+        try:
+            with self._lock:
+                if part.gen != gen:
+                    raise RuntimeError(
+                        f"{topic}[{partition}] compacted concurrently")
+                # blocks committed after our snapshot move over verbatim: copy
+                # the byte tail [frontier_pos, end_pos) and shift its positions
+                tail_blocks = part.blocks[len(blocks):]
+                if part.end_pos > frontier_pos:
+                    with open(old_path, "rb") as src, open(tmp, "ab") as dst:
+                        src.seek(frontier_pos)
+                        dst.write(src.read(part.end_pos - frontier_pos))
+                        dst.flush()
+                        if self._fsync:
+                            os.fsync(dst.fileno())
+                os.replace(tmp, new_path)
+                if self._fsync:
+                    _fsync_dir(os.path.dirname(new_path))
+                # manifest update — the commit point: recovery now resolves
+                # this partition through the new generational file
+                shift = clean_size - frontier_pos
+                if part.file is not None:
+                    part.file.close()
+                    part.file = None
+                part.path = new_path
+                part.gen = gen + 1
+                part.blocks = new_blocks + [(b, p + shift, c)
+                                            for b, p, c in tail_blocks]
+                part.end_pos += shift
+                part._cache.clear()
+                part._cache_sizes.clear()
+                part._cache_bytes = 0
+                self._clean[(topic, partition)] = (frontier_off, len(retained))
+                self._write_manifest_entry(topic, partition, part)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        try:  # stale readers holding the old snapshot retry on FileNotFoundError
+            os.unlink(old_path)
+        except OSError:
+            pass
+        return stats(clean_size, len(retained), time.perf_counter() - t0)
+
+    def _write_manifest_entry(self, topic: str, partition: int,
+                              part: _Partition) -> None:
+        clean_end, clean_count = self._clean[(topic, partition)]
+        self._manifest.setdefault(topic, {})[str(partition)] = {
+            "file": os.path.relpath(part.path, self.root),
+            "gen": part.gen,
+            "clean_end": clean_end, "clean_count": clean_count,
+            "end_offset": part.end_offset, "end_pos": part.end_pos,
+        }
+        self._persist_json("compaction.json", self._manifest)
 
     def close(self) -> None:
         with self._lock:

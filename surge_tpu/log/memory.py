@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import threading
 import time
+from bisect import bisect_left
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 from surge_tpu.log.transport import (
@@ -71,6 +72,15 @@ class LogBase:
                 out[r.key] = r
         return out
 
+    def compaction_state(self, topic: str, partition: int) -> Dict[str, int]:
+        """Clean frontier of the last compaction pass: ``clean_end`` (offsets
+        below it were compacted) and ``clean_count`` (records retained by that
+        pass). The dirty-ratio scheduler (surge_tpu.log.compactor) reads this;
+        backends update it from ``compact_partition``."""
+        clean = getattr(self, "_clean", {})
+        end, count = clean.get((topic, partition), (0, 0))
+        return {"clean_end": end, "clean_count": count}
+
     def _notify_append(self, touched) -> None:
         for tp in touched:
             ev = self._append_events.get(tp)
@@ -92,11 +102,24 @@ class LogBase:
 
 
 class InMemoryLog(LogBase):
-    """In-process :class:`surge_tpu.log.transport.LogTransport` implementation."""
+    """In-process :class:`surge_tpu.log.transport.LogTransport` implementation.
+
+    Partition storage is a list of records **sorted by offset but possibly
+    sparse**: compaction (``compact_partition``) drops superseded records while
+    every survivor keeps its original offset and ``end_offset`` keeps counting —
+    the same observable contract a compacted Kafka partition has. A per-key
+    latest-record index is maintained incrementally on append, so
+    ``latest_by_key`` (the state-topic restore view) is O(keys) instead of a
+    full-partition re-scan per call.
+    """
 
     def __init__(self, auto_create_partitions: int = 1) -> None:
         self._topics: Dict[str, TopicSpec] = {}
         self._partitions: Dict[Tuple[str, int], List[LogRecord]] = {}
+        self._ends: Dict[Tuple[str, int], int] = {}  # next offset to assign
+        # incrementally-maintained compaction view: key -> latest non-tombstone
+        self._latest: Dict[Tuple[str, int], Dict[str, LogRecord]] = {}
+        self._clean: Dict[Tuple[str, int], Tuple[int, int]] = {}
         self._epochs: Dict[str, int] = {}
         self._lock = threading.RLock()
         self._auto_create_partitions = auto_create_partitions
@@ -112,6 +135,8 @@ class InMemoryLog(LogBase):
             self._topics[spec.name] = spec
             for p in range(spec.partitions):
                 self._partitions[(spec.name, p)] = []
+                self._ends[(spec.name, p)] = 0
+                self._latest[(spec.name, p)] = {}
 
     # -- producers ----------------------------------------------------------------------
 
@@ -127,15 +152,22 @@ class InMemoryLog(LogBase):
             touched = set()
             for r in records:
                 self.topic(r.topic)  # auto-create
-                part = self._partitions.get((r.topic, r.partition))
+                key = (r.topic, r.partition)
+                part = self._partitions.get(key)
                 if part is None:
                     raise KeyError(f"{r.topic}[{r.partition}] does not exist")
                 assigned = LogRecord(
                     topic=r.topic, key=r.key, value=r.value, partition=r.partition,
-                    headers=dict(r.headers), offset=len(part), timestamp=now)
+                    headers=dict(r.headers), offset=self._ends[key], timestamp=now)
                 part.append(assigned)
+                self._ends[key] += 1
+                if r.key is not None:
+                    if r.value is None:
+                        self._latest[key].pop(r.key, None)  # tombstone
+                    else:
+                        self._latest[key][r.key] = assigned
                 out.append(assigned)
-                touched.add((r.topic, r.partition))
+                touched.add(key)
         self._notify_append(touched)
         return out
 
@@ -147,15 +179,62 @@ class InMemoryLog(LogBase):
         del isolation  # open transactions are producer-side buffers; log is all-stable
         with self._lock:
             part = self._partitions.get((topic, partition), [])
-            end = len(part) if max_records is None else min(len(part), from_offset + max_records)
-            return list(part[from_offset:end])
+            # offsets are sorted but may be sparse after compaction: bisect to
+            # the first record at/after from_offset instead of list-slicing
+            start = bisect_left(part, from_offset, key=lambda r: r.offset)
+            end = len(part) if max_records is None else min(len(part),
+                                                            start + max_records)
+            return list(part[start:end])
 
     def end_offset(self, topic: str, partition: int,
                    isolation: str = "read_committed") -> int:
         del isolation
         with self._lock:
             self.topic(topic)
-            return len(self._partitions[(topic, partition)])
+            return self._ends[(topic, partition)]
+
+    def latest_by_key(self, topic: str, partition: int,
+                      isolation: str = "read_committed") -> Mapping[str, LogRecord]:
+        del isolation
+        with self._lock:
+            self.topic(topic)
+            # records are immutable (frozen dataclass): sharing them is safe
+            return dict(self._latest[(topic, partition)])
+
+    # -- compaction ---------------------------------------------------------------------
+
+    def compact_partition(self, topic: str, partition: int, *,
+                          tombstone_retention_s: float = 0.0,
+                          now: Optional[float] = None):
+        """Rewrite one partition to latest-record-per-key with tombstone GC
+        (surge_tpu.log.compactor picks the retained set). Offsets and
+        ``end_offset`` are preserved; only superseded records disappear."""
+        from surge_tpu.log.compactor import CompactionStats, select_retained
+
+        t0 = time.perf_counter()
+        with self._lock:
+            self.topic(topic)
+            key = (topic, partition)
+            part = self._partitions[key]
+            before = len(part)
+            bytes_before = sum(_record_bytes(r) for r in part)
+            retained, dropped_tombstones = select_retained(
+                part, now=now if now is not None else time.time(),
+                tombstone_retention_s=tombstone_retention_s)
+            self._partitions[key] = retained
+            self._clean[key] = (self._ends[key], len(retained))
+            bytes_after = sum(_record_bytes(r) for r in retained)
+            return CompactionStats(
+                topic=topic, partition=partition,
+                records_before=before, records_after=len(retained),
+                bytes_before=bytes_before, bytes_after=bytes_after,
+                tombstones_dropped=dropped_tombstones,
+                duration_s=time.perf_counter() - t0)
+
+
+def _record_bytes(r: LogRecord) -> int:
+    """Approximate storage footprint of one record (stats/dirty-ratio input)."""
+    return len(r.value or b"") + len(r.key or "") + 32
 
 class InMemoryTxnProducer:
     """Transactional producer handle; one per transactional id, epoch-fenced."""

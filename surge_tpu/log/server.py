@@ -125,6 +125,11 @@ METHODS = {
     "ApplyDedup": (pb.ApplyDedupRequest, pb.ReplicateReply),
     "ReplicationStatus": (pb.ReplicationStatusRequest,
                           pb.ReplicationStatusReply),
+    # broker-side log compaction (surge_tpu.log.compactor). Message reuse —
+    # routing is by this table, not the descriptor, so no proto regeneration:
+    # ReadRequest carries (topic, partition); the TxnReply answers ok/error
+    # and one RecordMsg whose value holds the CompactionStats JSON
+    "CompactTopic": (pb.ReadRequest, pb.TxnReply),
 }
 
 
@@ -1152,6 +1157,46 @@ class LogServer:
         latest = self.log.latest_by_key(request.topic, request.partition)
         return pb.LatestByKeyReply(records=[record_to_msg(r)
                                             for r in latest.values()])
+
+    def CompactTopic(self, request: pb.ReadRequest, context) -> pb.TxnReply:
+        """Compact one partition of a compacted topic broker-side (the
+        operator/CLI trigger). Refused on a replicating leader: followers
+        mirror a gap-free prefix of this log, and compaction holes would read
+        as replication gaps."""
+        import json as _json
+
+        if self._repl_targets:
+            return pb.TxnReply(ok=False, error_kind="state",
+                               error="compaction unavailable on a "
+                                     "replicating leader")
+        if not hasattr(self.log, "compact_partition"):
+            return pb.TxnReply(ok=False, error_kind="state",
+                               error=f"{type(self.log).__name__} does not "
+                                     "support compaction")
+        # NON-mutating lookup: log.topic() would auto-create, persisting a
+        # junk topic from a mistyped operator request
+        spec = getattr(self.log, "_topics", {}).get(request.topic)
+        if spec is None:
+            return pb.TxnReply(ok=False, error_kind="state",
+                               error=f"unknown topic {request.topic!r}")
+        if not spec.compacted:
+            return pb.TxnReply(ok=False, error_kind="state",
+                               error=f"topic {request.topic!r} is not "
+                                     "compacted")
+        from surge_tpu.config import default_config as _dc
+
+        retention = (self._config or _dc()).get_seconds(
+            "surge.log.compaction.tombstone-retention-ms", 60_000)
+        try:
+            stats = self.log.compact_partition(
+                request.topic, request.partition,
+                tombstone_retention_s=retention)
+        except Exception as exc:  # noqa: BLE001 — operator gets it back
+            return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
+        msg = pb.RecordMsg(topic=request.topic, partition=request.partition,
+                           has_key=True, key="stats", has_value=True,
+                           value=_json.dumps(stats.as_dict()).encode())
+        return pb.TxnReply(ok=True, records=[msg])
 
     def WaitForAppend(self, request: pb.WaitRequest, context) -> pb.WaitReply:
         def check() -> bool:

@@ -206,6 +206,18 @@ class EngineMetrics:
     replay_dispatch_timer: Timer = field(init=False)
     replay_fetch_timer: Timer = field(init=False)
     replay_profile_windows: Sensor = field(init=False)
+    # log compaction + state checkpoints (surge_tpu.log.compactor /
+    # surge_tpu.store.checkpoint — the bounded-cold-start subsystem)
+    compaction_runs: Sensor = field(init=False)
+    compaction_bytes_reclaimed: Sensor = field(init=False)
+    compaction_records_dropped: Sensor = field(init=False)
+    compaction_timer: Timer = field(init=False)
+    compaction_max_dirty_ratio: Sensor = field(init=False)
+    checkpoint_writes: Sensor = field(init=False)
+    checkpoint_events_folded: Sensor = field(init=False)
+    checkpoint_timer: Timer = field(init=False)
+    checkpoint_age: Sensor = field(init=False)
+    checkpoint_lag_events: Sensor = field(init=False)
 
     def __post_init__(self) -> None:
         m, MI = self.registry, MetricInfo
@@ -266,6 +278,36 @@ class EngineMetrics:
         self.replay_profile_windows = m.counter(MI(
             "surge.replay.profile.windows",
             "replay windows/tiles observed by the profiler"), level=dbg)
+        self.compaction_runs = m.counter(MI(
+            "surge.log.compaction.runs", "partition compaction passes"))
+        self.compaction_bytes_reclaimed = m.counter(MI(
+            "surge.log.compaction.bytes-reclaimed",
+            "segment bytes reclaimed by compaction"))
+        self.compaction_records_dropped = m.counter(MI(
+            "surge.log.compaction.records-dropped",
+            "superseded records + GC'd tombstones dropped by compaction"))
+        self.compaction_timer = m.timer(MI(
+            "surge.log.compaction.duration-timer",
+            "ms per partition compaction pass"))
+        self.compaction_max_dirty_ratio = m.gauge(MI(
+            "surge.log.compaction.max-dirty-ratio",
+            "max dirty ratio across compacted partitions at the last "
+            "scheduler wake"))
+        self.checkpoint_writes = m.counter(MI(
+            "surge.store.checkpoint.writes", "state checkpoints written"))
+        self.checkpoint_events_folded = m.counter(MI(
+            "surge.store.checkpoint.events-folded",
+            "events folded by the incremental checkpoint materializer"))
+        self.checkpoint_timer = m.timer(MI(
+            "surge.store.checkpoint.duration-timer",
+            "ms per checkpoint advance+write"))
+        self.checkpoint_age = m.gauge(MI(
+            "surge.store.checkpoint.age-seconds",
+            "seconds since the newest durable checkpoint"))
+        self.checkpoint_lag_events = m.gauge(MI(
+            "surge.store.checkpoint.lag-events",
+            "events committed past the newest checkpoint's watermarks "
+            "(the cold-start tail a restore would fold)"))
         # Deprecation aliases for the r4 renames (ADVICE r4): dashboards keyed
         # to the old identifiers — including a timer's .min/.max/.p99
         # sub-metrics — keep working for a release window; the alias providers

@@ -1785,13 +1785,17 @@ class ReplayEngine:
         return jitted
 
     def replay_ragged(self, logs: Sequence[Sequence[Any]],
-                      encode: Callable[[Any], Any] | None = None) -> ReplayResult:
+                      encode: Callable[[Any], Any] | None = None,
+                      init_carry: Mapping[str, Any] | None = None) -> ReplayResult:
         """Length-bucketed replay of ragged logs (SURVEY.md §5.7).
 
         Groups aggregates by log length into padded buckets, folds each bucket, and
         scatters results back into original order. ``encode`` (if given) maps each raw
         event to its tensor-schema form first — e.g. bank_account's host-side Vocab
-        dictionary encoding.
+        dictionary encoding. ``init_carry`` (``{field: [len(logs)]}``, e.g. from
+        :meth:`carry_from_states` over checkpoint snapshots) resumes each
+        aggregate's fold from its snapshot instead of the init record — the
+        bounded tail fold of a checkpointed cold start.
         """
         from surge_tpu.codec.tensor import encode_events
 
@@ -1807,7 +1811,9 @@ class ReplayEngine:
             idxs = groups[cap]
             sub = [logs[i] for i in idxs]
             enc = encode_events(self.spec.registry, sub, pad_to=cap)
-            res = self.replay_encoded(enc)
+            sub_init = (None if init_carry is None else
+                        {k: np.asarray(v)[idxs] for k, v in init_carry.items()})
+            res = self.replay_encoded(enc, init_carry=sub_init)
             for name in out:
                 out[name][idxs] = res.states[name]
             total_events += res.num_events

@@ -13,6 +13,7 @@ north-star workload (SURVEY.md §3.3, BASELINE.md).
 
 from surge_tpu.store.kv import InMemoryKeyValueStore, KeyValueStore
 from surge_tpu.store.indexer import StateStoreIndexer
+from surge_tpu.store.checkpoint import Checkpoint, CheckpointStore, CheckpointWriter
 from surge_tpu.store.restore import (
     RestoreResult,
     restore_from_events,
@@ -21,6 +22,9 @@ from surge_tpu.store.restore import (
 )
 
 __all__ = [
+    "Checkpoint",
+    "CheckpointStore",
+    "CheckpointWriter",
     "InMemoryKeyValueStore",
     "KeyValueStore",
     "StateStoreIndexer",

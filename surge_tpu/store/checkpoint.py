@@ -1,0 +1,393 @@
+"""Aggregate-state checkpoints — the bounded-cold-start half of the compaction PR.
+
+``restore_from_events`` folds the events topic from offset 0: O(total history) per
+cold start. A **checkpoint** is an atomic snapshot of every aggregate's folded state
+together with the exact per-partition event-offset watermarks the fold had consumed —
+so a cold start becomes *load checkpoint, TPU-fold only the tail* (the
+checkpoint/resume contract ROADMAP and SURVEY.md §5.4 promise: the tensor carry
+resumes from ``ReplayEngine.carry_from_states``).
+
+Three pieces:
+
+- :class:`Checkpoint` — the value: ``seq``, events ``watermarks`` (partition → next
+  offset), and ``states`` (aggregate id → ``serialize_state`` bytes; ``None`` marks an
+  aggregate whose fold produced ``None`` — it must still resume from ``None``, not
+  from the model's initial state).
+- :class:`CheckpointStore` — durable directory of ``ckpt-<seq>.ck`` files. Writes are
+  crash-atomic (tmp write → fsync → rename → directory fsync) and pruned to the
+  newest N; a torn or unreadable newest file falls back to the previous one. The
+  payload reuses the segment block codec (surge_tpu.log.segment): states ride as
+  key/value records — tombstone framing for ``None`` states — CRC-checked and
+  native-compressed when the codec is built.
+- :class:`CheckpointWriter` — the incremental materializer: a supervised background
+  task that tails the events topic with the scalar (cpu) fold, advancing its own
+  state map from the previous checkpoint instead of re-folding history, and writes a
+  checkpoint on a publisher-style cadence (interval + min-events gate). Consistency
+  is by construction: the watermark is captured before each advance and every state
+  in the file is the fold of exactly the events below it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from surge_tpu.common import Ack, BackgroundTask, Controllable, logger
+from surge_tpu.config import Config, default_config
+from surge_tpu.log import segment as seg
+from surge_tpu.log.file import _fsync_dir
+from surge_tpu.log.transport import LogRecord, page_keyed_records
+
+__all__ = ["Checkpoint", "CheckpointStore", "CheckpointWriter"]
+
+_MAGIC = b"SCKP"
+_HEADER = struct.Struct("<4sI")  # magic | header_json_len
+
+
+@dataclass(frozen=True)
+class Checkpoint:
+    """One consistent (states, watermarks) snapshot of an events topic's fold.
+
+    ``partitions`` records each aggregate's source partition so a
+    partition-scoped restore (multi-node cold start: 1/N of the work) can take
+    only the snapshots it owns and never write unowned aggregates into the
+    local store."""
+
+    seq: int
+    topic: str
+    created_at: float
+    watermarks: Dict[int, int] = field(default_factory=dict)
+    states: Dict[str, Optional[bytes]] = field(default_factory=dict)
+    partitions: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def num_aggregates(self) -> int:
+        return len(self.states)
+
+    def events_covered(self) -> int:
+        return sum(self.watermarks.values())
+
+    def partition_of(self, agg_id: str) -> int:
+        return self.partitions.get(agg_id, 0)
+
+
+class CheckpointStore:
+    """Durable checkpoint directory with atomic writes and keep-N pruning."""
+
+    def __init__(self, path: str, keep: int = 2, fsync: bool = True) -> None:
+        self.path = path
+        self.keep = max(int(keep), 1)
+        self._fsync = fsync
+        os.makedirs(path, exist_ok=True)
+
+    def _file(self, seq: int) -> str:
+        return os.path.join(self.path, f"ckpt-{seq:012d}.ck")
+
+    def sequences(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.path):
+            if name.startswith("ckpt-") and name.endswith(".ck"):
+                try:
+                    out.append(int(name[5:-3]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def write(self, ckpt: Checkpoint) -> str:
+        """Atomically publish ``ckpt`` and prune old generations."""
+        path = self._file(ckpt.seq)
+        tmp = path + ".tmp"
+        # states ride the segment block codec: key/value records with
+        # tombstone framing for folded-to-None aggregates, grouped into one
+        # block run per source partition (the codec stamps a whole block with
+        # one partition) so scoped multi-node restores can take only the
+        # partitions they own. Key order within a partition keeps a
+        # checkpoint's bytes deterministic for its contents.
+        by_part: Dict[int, list] = {}
+        for k in sorted(ckpt.states):
+            by_part.setdefault(ckpt.partition_of(k), []).append(k)
+        block_partitions: List[int] = []
+        blocks: List[bytes] = []
+        chunk = 65536  # bound the per-block buffer for huge stores
+        base = 0
+        for p in sorted(by_part):
+            keys = by_part[p]
+            for i in range(0, len(keys), chunk):
+                records = [LogRecord(topic=ckpt.topic, key=k,
+                                     value=ckpt.states[k], partition=p)
+                           for k in keys[i:i + chunk]]
+                blocks.append(seg.encode_block(records, base))
+                block_partitions.append(p)
+                base += len(records)
+        header = json.dumps({
+            "version": 1, "seq": ckpt.seq, "topic": ckpt.topic,
+            "created_at": ckpt.created_at,
+            "watermarks": {str(p): off for p, off in ckpt.watermarks.items()},
+            "count": len(ckpt.states),
+            "block_partitions": block_partitions,
+        }).encode()
+        with open(tmp, "wb") as f:
+            f.write(_HEADER.pack(_MAGIC, len(header)))
+            f.write(header)
+            for block in blocks:
+                f.write(block)
+            f.flush()
+            if self._fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if self._fsync:
+            _fsync_dir(self.path)
+        self.prune()
+        return path
+
+    def prune(self) -> None:
+        for old in self.sequences()[: -self.keep]:
+            try:
+                os.unlink(self._file(old))
+            except OSError:
+                pass
+
+    def load(self, seq: int) -> Checkpoint:
+        path = self._file(seq)
+        with open(path, "rb") as f:
+            data = f.read()
+        magic, hlen = _HEADER.unpack_from(data, 0)
+        if magic != _MAGIC:
+            raise ValueError(f"{path}: not a checkpoint file")
+        header = json.loads(data[_HEADER.size: _HEADER.size + hlen])
+        states: Dict[str, Optional[bytes]] = {}
+        partitions: Dict[str, int] = {}
+        block_parts = list(header.get("block_partitions", []))
+        pos = _HEADER.size + hlen
+        bi = 0
+        while pos < len(data):
+            p = int(block_parts[bi]) if bi < len(block_parts) else 0
+            records, pos = seg.decode_block(data, pos, header["topic"], p)
+            for r in records:
+                states[r.key] = r.value
+                partitions[r.key] = p
+            bi += 1
+        if len(states) != header["count"] or bi != len(block_parts):
+            raise ValueError(f"{path}: truncated checkpoint "
+                             f"({len(states)} != {header['count']} states)")
+        return Checkpoint(
+            seq=int(header["seq"]), topic=header["topic"],
+            created_at=float(header["created_at"]),
+            watermarks={int(p): int(off)
+                        for p, off in header["watermarks"].items()},
+            states=states, partitions=partitions)
+
+    def latest(self) -> Optional[Checkpoint]:
+        """Newest loadable checkpoint; a torn/corrupt newer file (crash during
+        an unsynced write) falls back to its predecessor, never errors out the
+        cold start."""
+        for s in reversed(self.sequences()):
+            try:
+                return self.load(s)
+            except Exception as exc:  # noqa: BLE001 — fall back, loudly
+                logger.warning("checkpoint %d unreadable (%s: %s); trying "
+                               "predecessor", s, type(exc).__name__, exc)
+        return None
+
+
+class CheckpointWriter(Controllable):
+    """Incremental checkpoint materializer for one events topic.
+
+    Config knobs (docs/compaction.md):
+
+    - ``surge.store.checkpoint.interval-ms`` — write cadence (publisher-style
+      timed tick; a tick with nothing newly folded writes nothing).
+    - ``surge.store.checkpoint.min-events`` — don't write until at least this
+      many events were folded since the last checkpoint.
+    - ``surge.store.checkpoint.keep`` — generations retained on disk.
+    """
+
+    health_name = "checkpoint-writer"
+
+    def __init__(self, log, events_topic: str, model, store: CheckpointStore,
+                 *, serialize_state: Callable[[str, Any], bytes],
+                 deserialize_event: Callable[[bytes], Any],
+                 deserialize_state: Callable[[bytes], Any] | None = None,
+                 partitions: Optional[Sequence[int]] = None,
+                 config: Config | None = None, metrics=None,
+                 on_signal: Callable[[str, str], None] | None = None) -> None:
+        self.log = log
+        self.events_topic = events_topic
+        self.model = model
+        self.store = store
+        self.serialize_state = serialize_state
+        self.deserialize_event = deserialize_event
+        self.deserialize_state = deserialize_state
+        self.partitions = (sorted(partitions) if partitions is not None
+                           else None)
+        self.config = config or default_config()
+        self.metrics = metrics
+        self.on_signal = on_signal or (lambda name, level: None)
+        self._interval_s = self.config.get_seconds(
+            "surge.store.checkpoint.interval-ms", 30_000)
+        self._min_events = self.config.get_int(
+            "surge.store.checkpoint.min-events", 1)
+        self._states: Dict[str, Any] = {}
+        self._partitions_of: Dict[str, int] = {}
+        self._watermarks: Dict[int, int] = {}
+        self._seq = 0
+        self._last_written_at: Optional[float] = None
+        self._events_since_write = 0
+        self._resumed = False
+        # write_now runs on executor threads from BOTH the background loop and
+        # the admin WriteCheckpoint RPC: without mutual exclusion two advances
+        # would fold the same tail twice into the shared state map
+        self._write_lock = threading.Lock()
+        self._task = BackgroundTask(self._loop, "checkpoint-writer")
+
+    # -- lifecycle ----------------------------------------------------------------------
+
+    async def start(self) -> Ack:
+        self._task.start()
+        return Ack()
+
+    async def stop(self) -> Ack:
+        await self._task.stop()
+        return Ack()
+
+    @property
+    def running(self) -> bool:
+        return self._task.running
+
+    # -- materialization ----------------------------------------------------------------
+
+    def _parts(self) -> List[int]:
+        return (self.partitions if self.partitions is not None
+                else list(range(self.log.num_partitions(self.events_topic))))
+
+    def _resume(self) -> None:
+        """Continue from the newest durable checkpoint instead of re-folding
+        history. Without a state deserializer the writer starts from scratch —
+        correct, just a one-time O(history) first advance."""
+        self._resumed = True
+        ckpt = self.store.latest()
+        if ckpt is None:
+            return
+        self._seq = ckpt.seq
+        self._last_written_at = ckpt.created_at
+        if self.deserialize_state is None:
+            logger.warning(
+                "checkpoint writer for %s: no state deserializer — cannot "
+                "resume from seq %d, re-folding from offset 0",
+                self.events_topic, ckpt.seq)
+            return
+        self._watermarks = dict(ckpt.watermarks)
+        self._partitions_of = dict(ckpt.partitions)
+        for agg_id, raw in ckpt.states.items():
+            self._states[agg_id] = (None if raw is None
+                                    else self.deserialize_state(raw))
+
+    def advance(self) -> int:
+        """Fold every event between the last-consumed watermarks and the
+        current end offsets into the state map; returns events folded. The
+        watermark for each partition is captured before its scan, so the map
+        is always the fold of exactly ``self._watermarks``."""
+        if not self._resumed:
+            self._resume()
+        folded = 0
+        initial = getattr(self.model, "initial_state", None)
+        handle = getattr(self.model, "handle_event", None)
+        from surge_tpu.engine.model import fold_events
+
+        for p in self._parts():
+            start = self._watermarks.get(p, 0)
+            end = self.log.end_offset(self.events_topic, p)
+            if end <= start:
+                continue
+            for rec in page_keyed_records(self.log, self.events_topic, p,
+                                          start=start, upto=end):
+                agg_id = rec.key
+                self._partitions_of[agg_id] = p
+                if agg_id not in self._states:
+                    self._states[agg_id] = (initial(agg_id)
+                                            if initial is not None else None)
+                event = self.deserialize_event(rec.value)
+                if handle is not None:
+                    self._states[agg_id] = handle(self._states[agg_id], event)
+                else:
+                    self._states[agg_id] = fold_events(
+                        self.model, self._states[agg_id], [event])
+                folded += 1
+            self._watermarks[p] = end
+        self._events_since_write += folded
+        return folded
+
+    def build(self) -> Checkpoint:
+        from surge_tpu.store.restore import _with_aggregate_id
+
+        states: Dict[str, Optional[bytes]] = {}
+        for agg_id, state in self._states.items():
+            if state is None:
+                states[agg_id] = None
+            else:
+                states[agg_id] = self.serialize_state(
+                    agg_id, _with_aggregate_id(state, agg_id))
+        return Checkpoint(seq=self._seq + 1, topic=self.events_topic,
+                          created_at=time.time(),
+                          watermarks=dict(self._watermarks), states=states,
+                          partitions=dict(self._partitions_of))
+
+    def write_now(self) -> Checkpoint:
+        """Advance to the current end offsets and publish a checkpoint
+        unconditionally (admin RPC / shutdown hook). Blocking — callers on the
+        event loop run it in an executor; serialized against the background
+        loop's own writes."""
+        t0 = time.perf_counter()
+        with self._write_lock:
+            folded = self.advance()
+            ckpt = self.build()
+            self.store.write(ckpt)
+            self._seq = ckpt.seq
+            self._last_written_at = ckpt.created_at
+            self._events_since_write = 0
+        if self.metrics is not None:
+            self.metrics.checkpoint_writes.record()
+            self.metrics.checkpoint_events_folded.record(folded)
+            self.metrics.checkpoint_timer.record_ms(
+                (time.perf_counter() - t0) * 1000.0)
+        logger.info("checkpoint %d for %s: %d aggregates, %d events covered "
+                    "(%d newly folded)", ckpt.seq, self.events_topic,
+                    ckpt.num_aggregates, ckpt.events_covered(), folded)
+        return ckpt
+
+    def lag(self) -> int:
+        """Events committed past the last checkpoint's watermarks."""
+        return sum(
+            max(self.log.end_offset(self.events_topic, p)
+                - self._watermarks.get(p, 0), 0)
+            for p in self._parts()) + self._events_since_write
+
+    # -- loop ---------------------------------------------------------------------------
+
+    async def _loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(self._interval_s)
+            try:
+                if self.metrics is not None:
+                    self.metrics.checkpoint_lag_events.record(self.lag())
+                    if self._last_written_at is not None:
+                        self.metrics.checkpoint_age.record(
+                            time.time() - self._last_written_at)
+                if self.lag() >= self._min_events:
+                    await loop.run_in_executor(None, self.write_now)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — keep the cadence alive
+                logger.exception("checkpoint write failed; retrying in %.1fs",
+                                 self._interval_s)
+                try:
+                    self.on_signal("surge.store.checkpoint-error", "error")
+                except Exception:  # noqa: BLE001
+                    logger.exception("on_signal failed")

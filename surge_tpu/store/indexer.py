@@ -202,6 +202,11 @@ class StateStoreIndexer(Controllable):
             while True:
                 try:
                     offset = self._watermarks[partition]
+                    # end captured BEFORE the read: an empty read then proves
+                    # [offset, end) held only compacted-away records — anything
+                    # committed after the capture has offset >= end and stays
+                    # past the fast-forwarded watermark
+                    end = self.log.end_offset(self.state_topic, partition)
                     records = self.log.read(self.state_topic, partition,
                                             offset, max_records=self._max_poll)
                     if records:
@@ -209,6 +214,13 @@ class StateStoreIndexer(Controllable):
                         self._watermarks[partition] = records[-1].offset + 1
                         backoff = 0.25  # reset only on a FULL success, so a
                         continue        # poison _apply still escalates
+                    if end > offset:
+                        # compaction hole at the tail of our position: without
+                        # this the watermark would stall below end_offset
+                        # forever and the publisher's lag gate would never open
+                        self._watermarks[partition] = end
+                        backoff = 0.25
+                        continue
                     await asyncio.wait_for(
                         self.log.wait_for_append(self.state_topic, partition,
                                                  offset),

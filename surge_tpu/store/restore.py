@@ -18,12 +18,15 @@ SURVEY.md §5.4 TPU mapping).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Sequence
 
 from surge_tpu.config import Config, default_config
 from surge_tpu.engine.model import ReplaySpec, fold_events
 from surge_tpu.store.kv import KeyValueStore
+
+_log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -58,7 +61,10 @@ def restore_from_events(
         encode_event: Callable[[Any], Any] | None = None,
         decode_state: Callable[[str, Any], Any] | None = None,
         config: Config | None = None, mesh=None,
-        partitions: Optional[Sequence[int]] = None) -> RestoreResult:
+        partitions: Optional[Sequence[int]] = None,
+        checkpoint=None,
+        deserialize_state: Callable[[bytes], Any] | None = None,
+        encode_state: Callable[[str, Any], Any] | None = None) -> RestoreResult:
     """Fold the whole events topic into per-aggregate states and write them back.
 
     Backend comes from ``surge.replay.backend``: ``tpu`` batches the fold through
@@ -66,11 +72,39 @@ def restore_from_events(
     maps raw events into tensor-schema form, e.g. Vocab dictionary encoding, and
     ``decode_state`` post-processes each decoded state given its aggregate id);
     ``cpu`` runs the scalar per-aggregate fold (requires ``model``).
+
+    ``checkpoint`` (a :class:`surge_tpu.store.checkpoint.Checkpoint` plus
+    ``deserialize_state`` to reopen its snapshots) bounds the cold start: only
+    events past the checkpoint's per-partition watermarks are read and folded —
+    on top of the snapshot states — and untouched aggregates restore their
+    checkpointed bytes verbatim. The resulting store is byte-identical to the
+    full fold on both backends (golden-tested); ``encode_state`` (mirroring
+    ``encode_event``) maps a domain snapshot into tensor-schema form for the
+    tpu carry when the two differ.
     """
     cfg = config or default_config()
     backend = cfg.get_str("surge.replay.backend", "tpu")
     parts = list(partitions if partitions is not None
                  else range(log.num_partitions(events_topic)))
+    if checkpoint is not None and deserialize_state is None:
+        raise ValueError("checkpointed restore requires `deserialize_state`")
+    if checkpoint is not None:
+        tail = sum(max(log.end_offset(events_topic, p)
+                       - checkpoint.watermarks.get(p, 0), 0) for p in parts)
+        spill = cfg.get_int("surge.replay.restore-spill-events", 1_000_000)
+        if not (0 <= spill < tail):
+            return _restore_events_checkpointed(
+                log, events_topic, store, parts, checkpoint=checkpoint,
+                deserialize_event=deserialize_event,
+                serialize_state=serialize_state,
+                deserialize_state=deserialize_state, model=model,
+                replay_spec=replay_spec, encode_event=encode_event,
+                decode_state=decode_state, encode_state=encode_state,
+                backend=backend, cfg=cfg, mesh=mesh)
+        # a tail large enough to spill gets the bounded-memory full restore —
+        # correct, just not checkpoint-accelerated
+        _log.warning("checkpoint tail (%d events) exceeds the spill "
+                     "threshold; falling back to the full restore", tail)
 
     # Bounded-memory route (VERDICT r4 missing #4): above the spill threshold
     # the whole-topic dict of per-event Python objects below would OOM — a
@@ -141,6 +175,98 @@ def restore_from_events(
             state = decode_state(agg_id, state)
         store.put(agg_id, serialize_state(agg_id, state))
     return RestoreResult(num_aggregates=len(agg_ids), num_events=num_events,
+                         watermarks=watermarks, backend=backend)
+
+
+def _restore_events_checkpointed(log, events_topic: str, store, parts, *,
+                                 checkpoint, deserialize_event,
+                                 serialize_state, deserialize_state,
+                                 model, replay_spec, encode_event,
+                                 decode_state, encode_state,
+                                 backend, cfg, mesh) -> RestoreResult:
+    """Bounded cold start: checkpoint snapshots + fold of the post-watermark
+    tail only. Invariant (golden-tested): the store this produces is
+    byte-identical to the full fold from offset 0 on both backends —
+    ``fold(init, head + tail) == fold(fold(init, head), tail)`` plus the
+    checkpoint writer serializing with the same ``serialize_state``."""
+    from surge_tpu.log.transport import page_keyed_records
+
+    watermarks: Dict[int, int] = {p: log.end_offset(events_topic, p)
+                                  for p in parts}
+    logs: Dict[str, list] = {}
+    num_events = 0
+    for p in parts:
+        for rec in page_keyed_records(
+                log, events_topic, p,
+                start=checkpoint.watermarks.get(p, 0), upto=watermarks[p]):
+            logs.setdefault(rec.key, []).append(deserialize_event(rec.value))
+            num_events += 1
+    # scoped restore (multi-node: parts ⊂ all): take only the snapshots whose
+    # source partition this node owns — unowned aggregates must never enter
+    # the local store, matching the full fold's per-partition scan
+    part_set = set(int(p) for p in parts)
+    owned_states = {a: raw for a, raw in checkpoint.states.items()
+                    if checkpoint.partition_of(a) in part_set}
+
+    def snapshot(agg_id):
+        """(present, state): a checkpointed None must resume from None, not
+        from the model's initial state — only truly-new aggregates start
+        fresh."""
+        if agg_id not in owned_states:
+            return False, None
+        raw = owned_states[agg_id]
+        return True, (None if raw is None else deserialize_state(raw))
+
+    agg_ids = list(logs)
+    if backend == "cpu":
+        if model is None:
+            raise ValueError("cpu replay backend requires `model`")
+        states = []
+        for a in agg_ids:
+            present, init = snapshot(a)
+            if not present and hasattr(model, "initial_state"):
+                init = model.initial_state(a)
+            states.append(fold_events(model, init, logs[a]))
+    elif backend == "tpu":
+        if replay_spec is None:
+            raise ValueError("tpu replay backend requires `replay_spec`")
+        from surge_tpu.codec.tensor import decode_states, encode_states
+        from surge_tpu.replay.engine import ReplayEngine
+
+        engine = ReplayEngine(replay_spec, config=cfg, mesh=mesh)
+        carry = engine.init_carry_np(max(len(agg_ids), 1))
+        for i, a in enumerate(agg_ids):
+            present, st = snapshot(a)
+            if not present or st is None:
+                continue  # init record — the tensor form of the None state
+            if encode_state is not None:
+                st = encode_state(a, st)
+            row = encode_states(replay_spec.registry.state, [st])
+            for name in carry:
+                carry[name][i] = row[name][0]
+        result = engine.replay_ragged([logs[a] for a in agg_ids],
+                                      encode=encode_event, init_carry=carry)
+        states = decode_states(replay_spec.registry.state, result.states)
+    else:
+        raise ValueError(f"unknown replay backend {backend!r}")
+
+    for agg_id, state in zip(agg_ids, states):
+        if state is None:
+            continue
+        state = _with_aggregate_id(state, agg_id)
+        if decode_state is not None and backend == "tpu":
+            state = decode_state(agg_id, state)
+        store.put(agg_id, serialize_state(agg_id, state))
+    # untouched aggregates restore their checkpointed bytes verbatim (the
+    # writer serialized them with this same serialize_state, so bytes match
+    # the full fold exactly); folded-to-None snapshots stay unwritten, like
+    # the full fold's `state is None` skip
+    for agg_id, raw in owned_states.items():
+        if agg_id in logs or raw is None:
+            continue
+        store.put(agg_id, raw)
+    num_aggregates = len(set(owned_states) | set(logs))
+    return RestoreResult(num_aggregates=num_aggregates, num_events=num_events,
                          watermarks=watermarks, backend=backend)
 
 

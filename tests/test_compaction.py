@@ -1,0 +1,365 @@
+"""Log compaction subsystem: retained-set policy, backend rewrites (in-memory +
+file with the crash-safe generational swap), dirty-ratio scheduling, indexer
+behavior over compaction holes, and the operator surfaces (admin RPC, CLI).
+
+The crash test is the tentpole's safety contract: a compactor killed between
+the ``.tmp`` write and the manifest update must leave recovery reading the OLD
+segment, never a torn or half-swapped one.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import grpc
+import pytest
+
+from surge_tpu import SurgeCommandBusinessLogic, create_engine, default_config
+from surge_tpu.admin import AdminClient, AdminServer
+from surge_tpu.log import FileLog, InMemoryLog, LogRecord, TopicSpec
+from surge_tpu.log.compactor import (
+    LogCompactor,
+    dirty_ratio,
+    select_retained,
+)
+from surge_tpu.models import counter
+from surge_tpu.store import StateStoreIndexer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fill(log, topic="state", keys=5, records=40, partition=0, tombstone=None):
+    prod = log.transactional_producer(f"fill-{topic}-{partition}-{time.time()}")
+    for i in range(records):
+        prod.begin()
+        prod.send(LogRecord(topic=topic, key=f"k{i % keys}",
+                            value=f"v{i}".encode(), partition=partition))
+        prod.commit()
+    if tombstone is not None:
+        prod.begin()
+        prod.send(LogRecord(topic=topic, key=tombstone, value=None,
+                            partition=partition))
+        prod.commit()
+
+
+# -- policy -----------------------------------------------------------------------------
+
+
+def test_select_retained_latest_per_key_and_tombstone_gc():
+    now = time.time()
+    recs = [
+        LogRecord(topic="t", key="a", value=b"1", offset=0, timestamp=now - 100),
+        LogRecord(topic="t", key=None, value=b"", offset=1, timestamp=now),  # marker
+        LogRecord(topic="t", key="b", value=b"2", offset=2, timestamp=now - 100),
+        LogRecord(topic="t", key="a", value=b"3", offset=3, timestamp=now - 50),
+        LogRecord(topic="t", key="b", value=None, offset=4, timestamp=now - 90),
+        LogRecord(topic="t", key="c", value=b"4", offset=5, timestamp=now - 10),
+    ]
+    # young tombstone retained
+    retained, dropped = select_retained(recs, now=now, tombstone_retention_s=3600)
+    assert [r.offset for r in retained] == [3, 4, 5]
+    assert dropped == 0
+    # expired tombstone GC'd; keyless marker always dropped
+    retained, dropped = select_retained(recs, now=now, tombstone_retention_s=10)
+    assert [r.offset for r in retained] == [3, 5]
+    assert dropped == 1
+    # the final record survives even as an expired tombstone (keep-tail)
+    tail = recs + [LogRecord(topic="t", key="c", value=None, offset=6,
+                             timestamp=now - 90)]
+    retained, dropped = select_retained(tail, now=now, tombstone_retention_s=10)
+    assert retained[-1].offset == 6
+    assert dropped == 1  # only b's tombstone; c's was resurrected by keep-tail
+
+
+# -- in-memory backend ------------------------------------------------------------------
+
+
+def test_inmemory_compaction_preserves_log_contract():
+    log = InMemoryLog()
+    log.create_topic(TopicSpec("state", 1, compacted=True))
+    _fill(log, records=40, keys=5, tombstone="k0")
+    end = log.end_offset("state", 0)
+    latest = {k: (r.offset, r.value) for k, r in log.latest_by_key("state", 0).items()}
+
+    stats = log.compact_partition("state", 0, tombstone_retention_s=0.0)
+    assert stats.records_dropped > 0 and stats.bytes_reclaimed > 0
+    # offsets, end_offset and the compacted view are all preserved
+    assert log.end_offset("state", 0) == end
+    assert {k: (r.offset, r.value)
+            for k, r in log.latest_by_key("state", 0).items()} == latest
+    offsets = [r.offset for r in log.read("state", 0)]
+    assert offsets == sorted(offsets) and offsets[-1] == end - 1
+    # reads from inside a hole land on the next surviving record
+    assert log.read("state", 0, from_offset=1)[0].offset >= 1
+    # appends continue at the preserved end offset
+    prod = log.transactional_producer("after")
+    prod.begin()
+    prod.send(LogRecord(topic="state", key="k9", value=b"post"))
+    rec = prod.commit()[0]
+    assert rec.offset == end
+    assert dirty_ratio(log, "state", 0) > 0
+
+
+def test_inmemory_latest_by_key_is_incremental_index():
+    log = InMemoryLog()
+    log.create_topic(TopicSpec("state", 1, compacted=True))
+    _fill(log, records=30, keys=3, tombstone="k1")
+    # the index answers without a partition scan: mutate the backing list to
+    # prove reads don't re-derive it (white-box, but that is the point)
+    view = log.latest_by_key("state", 0)
+    assert set(view) == {"k0", "k2"}
+    log._partitions[("state", 0)].clear()
+    assert set(log.latest_by_key("state", 0)) == {"k0", "k2"}
+
+
+# -- file backend -----------------------------------------------------------------------
+
+
+def test_file_compaction_survives_reopen(tmp_path):
+    root = str(tmp_path / "log")
+    log = FileLog(root)
+    log.create_topic(TopicSpec("state", 2, compacted=True))
+    for p in (0, 1):
+        _fill(log, records=30, keys=4, partition=p, tombstone="k0")
+    views = {p: {k: (r.offset, r.value)
+                 for k, r in log.latest_by_key("state", p).items()}
+             for p in (0, 1)}
+    ends = {p: log.end_offset("state", p) for p in (0, 1)}
+    st = log.compact_partition("state", 0, tombstone_retention_s=1e9)
+    assert st.bytes_reclaimed > 0
+    log.close()
+
+    log2 = FileLog(root)
+    for p in (0, 1):
+        assert log2.end_offset("state", p) == ends[p]
+        assert {k: (r.offset, r.value)
+                for k, r in log2.latest_by_key("state", p).items()} == views[p]
+    # appends after reopen continue the preserved offset space, and a second
+    # compaction (new generation) still round-trips
+    prod = log2.transactional_producer("again")
+    prod.begin()
+    prod.send(LogRecord(topic="state", key="k1", value=b"post", partition=0))
+    assert prod.commit()[0].offset == ends[0]
+    log2.compact_partition("state", 0, tombstone_retention_s=0.0)
+    log2.close()
+    log3 = FileLog(root)
+    assert log3.end_offset("state", 0) == ends[0] + 1
+    assert log3.latest_by_key("state", 0)["k1"].value == b"post"
+    log3.close()
+    # exactly one live segment per partition remains in data/
+    segs = [n for n in os.listdir(os.path.join(root, "data"))
+            if n.startswith("state-0")]
+    assert len(segs) == 1, segs
+
+
+def test_file_compaction_crash_between_tmp_and_manifest(tmp_path, monkeypatch):
+    """Kill the compactor after the .tmp write but before the swap commits:
+    recovery must read the OLD segment bit-for-bit and sweep the orphan."""
+    root = str(tmp_path / "log")
+    log = FileLog(root)
+    log.create_topic(TopicSpec("state", 1, compacted=True))
+    _fill(log, records=25, keys=3)
+    before_recs = [(r.offset, r.key, r.value) for r in log.read("state", 0)]
+    before_view = {k: (r.offset, r.value)
+                   for k, r in log.latest_by_key("state", 0).items()}
+
+    real_replace = os.replace
+
+    def crash_replace(src, dst):
+        if src.endswith(".seg.tmp"):  # the compactor's rename — "crash" here
+            raise OSError("injected crash between tmp write and rename")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", crash_replace)
+    with pytest.raises(OSError, match="injected crash"):
+        log.compact_partition("state", 0, tombstone_retention_s=0.0)
+    monkeypatch.undo()
+    log.close()  # no clean shutdown help: recovery does the work
+
+    log2 = FileLog(root)
+    assert [(r.offset, r.key, r.value)
+            for r in log2.read("state", 0)] == before_recs
+    assert {k: (r.offset, r.value)
+            for k, r in log2.latest_by_key("state", 0).items()} == before_view
+    # the interrupted swap left no .tmp / orphan generation behind
+    leftovers = [n for n in os.listdir(os.path.join(root, "data"))
+                 if ".tmp" in n or ".g" in n]
+    assert leftovers == [], leftovers
+    # and a re-run of the compaction completes normally
+    st = log2.compact_partition("state", 0, tombstone_retention_s=0.0)
+    assert st.bytes_reclaimed > 0
+    assert {k: (r.offset, r.value)
+            for k, r in log2.latest_by_key("state", 0).items()} == before_view
+    log2.close()
+
+
+def test_file_compaction_crash_after_rename_before_manifest(tmp_path, monkeypatch):
+    """The other half of the swap window: the generational file is renamed into
+    place but the manifest write dies. The manifest still names the old file,
+    so recovery reads it and sweeps the newer orphan generation."""
+    root = str(tmp_path / "log")
+    log = FileLog(root)
+    log.create_topic(TopicSpec("state", 1, compacted=True))
+    _fill(log, records=25, keys=3)
+    before_recs = [(r.offset, r.key, r.value) for r in log.read("state", 0)]
+
+    real_persist = FileLog._persist_json
+
+    def crash_persist(self, name, obj):
+        if name == "compaction.json":
+            raise OSError("injected crash before manifest update")
+        return real_persist(self, name, obj)
+
+    monkeypatch.setattr(FileLog, "_persist_json", crash_persist)
+    with pytest.raises(OSError, match="injected crash"):
+        log.compact_partition("state", 0, tombstone_retention_s=0.0)
+    monkeypatch.undo()
+    log.close()
+
+    log2 = FileLog(root)
+    assert [(r.offset, r.key, r.value)
+            for r in log2.read("state", 0)] == before_recs
+    leftovers = [n for n in os.listdir(os.path.join(root, "data"))
+                 if ".tmp" in n or ".g" in n]
+    assert leftovers == [], leftovers
+    log2.close()
+
+
+# -- indexer over holes -----------------------------------------------------------------
+
+
+def test_indexer_fast_forwards_over_compaction_hole():
+    async def scenario():
+        log = InMemoryLog()
+        log.create_topic(TopicSpec("state", 1, compacted=True))
+        _fill(log, records=30, keys=3)
+        idx = StateStoreIndexer(log, "state", config=default_config().with_overrides(
+            {"surge.state-store.commit-interval-ms": 10}))
+        await idx.start()
+        for _ in range(200):
+            if idx.total_lag() == 0:
+                break
+            await asyncio.sleep(0.01)
+        assert idx.total_lag() == 0
+
+        # wind the indexer back (a restart analog), compact the log so its
+        # resume offset now points into a hole — the tail loop must
+        # fast-forward to end_offset instead of stalling forever
+        idx._watermarks[0] = 5
+        log.compact_partition("state", 0, tombstone_retention_s=0.0)
+        for _ in range(200):
+            if idx.indexed_watermark("state", 0) >= log.end_offset("state", 0):
+                break
+            await asyncio.sleep(0.01)
+        assert idx.indexed_watermark("state", 0) == log.end_offset("state", 0)
+        assert idx.total_lag() == 0
+        await idx.stop()
+
+    asyncio.run(scenario())
+
+
+# -- scheduler --------------------------------------------------------------------------
+
+
+def test_compactor_dirty_ratio_scheduling():
+    async def scenario():
+        log = InMemoryLog()
+        log.create_topic(TopicSpec("state", 1, compacted=True))
+        log.create_topic(TopicSpec("events", 1))  # non-compacted: never touched
+        _fill(log, records=50, keys=5)
+        _fill(log, topic="events", records=10, keys=10)
+        cfg = default_config().with_overrides({
+            "surge.log.compaction.min-dirty-records": 10,
+            "surge.log.compaction.min-dirty-ratio": 0.5,
+            "surge.log.compaction.tombstone-retention-ms": 0,
+        })
+        comp = LogCompactor(log, config=cfg)
+        assert dirty_ratio(log, "state", 0) == 1.0
+        stats = await comp.compact_once()
+        assert [s.topic for s in stats] == ["state"]
+        assert dirty_ratio(log, "state", 0) == 0.0
+        # below both gates now: a second pass is a no-op…
+        assert await comp.compact_once() == []
+        # …until enough new dirt accumulates
+        _fill(log, records=9, keys=1)
+        assert await comp.compact_once() == []  # 9 < min-dirty-records
+        _fill(log, records=20, keys=1)
+        stats = await comp.compact_once()
+        assert len(stats) == 1 and stats[0].records_dropped > 0
+        # forced pass (the admin path) ignores the gates
+        assert len(await comp.compact_once(force=True)) == 1
+        assert log.end_offset("events", 0) == 10  # untouched
+
+    asyncio.run(scenario())
+
+
+# -- admin RPC --------------------------------------------------------------------------
+
+
+def test_admin_compact_rpc_and_background_compactor():
+    async def scenario():
+        cfg = default_config().with_overrides({
+            "surge.producer.flush-interval-ms": 5,
+            "surge.producer.ktable-check-interval-ms": 5,
+            "surge.state-store.commit-interval-ms": 20,
+            "surge.engine.num-partitions": 2,
+            "surge.log.compaction.enabled": True,
+            "surge.log.compaction.interval-ms": 60_000,  # RPC does the work
+        })
+        engine = create_engine(SurgeCommandBusinessLogic(
+            aggregate_name="counter", model=counter.CounterModel(),
+            state_format=counter.state_formatting(),
+            event_format=counter.event_formatting()), config=cfg)
+        await engine.start()
+        for i in range(30):
+            await engine.aggregate_for(f"a-{i % 4}").send_command(
+                counter.Increment(f"a-{i % 4}"))
+        assert "log-compactor" in engine.health_supervisor.registered()
+
+        admin = AdminServer(engine)
+        port = await admin.start()
+        client = AdminClient(grpc.aio.insecure_channel(f"127.0.0.1:{port}"))
+        stats = await client.compact_log()
+        assert stats and all(s["topic"] == "counter-state" for s in stats)
+        assert sum(s["bytes_reclaimed"] for s in stats) > 0
+        values = engine.metrics_registry.get_metrics()
+        assert values["surge.log.compaction.runs"] >= len(stats)
+        # no checkpoint path configured: the RPC reports that, not a crash
+        ok, detail = await client.write_checkpoint()
+        assert not ok and "checkpoint" in detail
+        # the engine still serves and the store survives a post-compaction read
+        r = await engine.aggregate_for("a-1").send_command(
+            counter.Increment("a-1"))
+        assert r.state.count > 1
+        await admin.stop()
+        await engine.stop()
+
+    asyncio.run(scenario())
+
+
+# -- CLI --------------------------------------------------------------------------------
+
+
+def test_compact_log_cli_smoke(tmp_path):
+    root = str(tmp_path / "log")
+    log = FileLog(root)
+    log.create_topic(TopicSpec("state", 2, compacted=True))
+    for p in (0, 1):
+        _fill(log, records=25, keys=3, partition=p)
+    log.close()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "compact_log.py"),
+         root, "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout)
+    assert out["bytes_reclaimed"] > 0
+    assert {s["partition"] for s in out["partitions"]} == {0, 1}
+    # the compacted root reopens clean and serves the compacted view
+    log2 = FileLog(root)
+    assert set(log2.latest_by_key("state", 0)) == {"k0", "k1", "k2"}
+    log2.close()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Offline FileLog compaction: rewrite a log root's compacted topics in place.
+
+The operator-side entry to surge_tpu.log.compactor — compact a cold (or live:
+the swap is crash-safe and readers retry) FileLog root without an engine,
+printing per-partition stats and total bytes reclaimed::
+
+    python tools/compact_log.py /var/lib/surge/log
+    python tools/compact_log.py /var/lib/surge/log --topic counter-state --json
+    python tools/compact_log.py /var/lib/surge/log --tombstone-retention-ms 0
+
+Exit code 0 on success; 2 when the root holds no compacted topics.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", help="FileLog root directory")
+    ap.add_argument("--topic", action="append", default=None,
+                    help="compact only this topic (repeatable; default: every "
+                         "compacted topic in the root)")
+    ap.add_argument("--tombstone-retention-ms", type=float, default=60_000.0,
+                    help="drop tombstones older than this (default 60s; 0 = "
+                         "GC every tombstone immediately)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of the table")
+    args = ap.parse_args(argv)
+
+    from surge_tpu.log import FileLog
+
+    log = FileLog(args.root)
+    try:
+        names = (args.topic if args.topic
+                 else sorted(t.name for t in log._topics.values()
+                             if t.compacted))
+        all_stats = []
+        for name in names:
+            spec = log._topics.get(name)  # non-mutating: no typo auto-create
+            if spec is None:
+                print(f"skipping {name!r}: no such topic", file=sys.stderr)
+                continue
+            if not spec.compacted:
+                print(f"skipping {name!r}: not a compacted topic",
+                      file=sys.stderr)
+                continue
+            for p in range(spec.partitions):
+                all_stats.append(log.compact_partition(
+                    name, p,
+                    tombstone_retention_s=args.tombstone_retention_ms / 1000.0))
+        if not all_stats:
+            print("no compacted topics found", file=sys.stderr)
+            return 2
+        reclaimed = sum(s.bytes_reclaimed for s in all_stats)
+        dropped = sum(s.records_dropped for s in all_stats)
+        if args.json:
+            print(json.dumps({
+                "partitions": [s.as_dict() for s in all_stats],
+                "bytes_reclaimed": reclaimed, "records_dropped": dropped}))
+        else:
+            for s in all_stats:
+                print(f"{s.topic}[{s.partition}]: {s.records_before} -> "
+                      f"{s.records_after} records, "
+                      f"{s.bytes_reclaimed} bytes reclaimed "
+                      f"({s.tombstones_dropped} tombstones GC'd, "
+                      f"{s.duration_s * 1000:.1f} ms)")
+            print(f"total: {reclaimed} bytes reclaimed, "
+                  f"{dropped} records dropped")
+        return 0
+    finally:
+        log.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
