@@ -397,24 +397,25 @@ def run_replay_child(env: dict, corpus_dir: str, label: str) -> dict | None:
 # phase 2: steady-state command latency (no accelerator involved)
 # --------------------------------------------------------------------------------------
 
-def steady_state_latency(seconds: float) -> dict:
+def steady_state_latency(seconds: float, overrides: dict | None = None,
+                         ladder: list | None = None) -> dict:
     """The full command path on one node, reference-default envelope: concurrent
     per-aggregate workers issue sequential Increment commands through
     ``aggregate_for().send_command`` against a FileLog (fsync on commit) with the
-    50 ms flush tick, so each command's latency = handling + wait-for-tick + one
-    durable transaction commit — directly comparable to the reference's
-    flush-interval + Kafka txn commit envelope (core reference.conf:20-21).
+    event-driven group-commit publisher, so each command's latency = handling +
+    adaptive linger + one durable group-commit transaction — directly comparable
+    to the reference's flush-interval + Kafka txn commit envelope (core
+    reference.conf:20-21; the fixed 50 ms flush tick this phase used to measure
+    is now the `linger_ms=50, max_in_flight=1` row of ``producer_sweep``).
 
-    A WORKER LADDER shows the per-partition batched transactions breaking past
-    the one-command-per-envelope floor (VERDICT r4 weak #3 / next #8): each
-    50 ms tick commits every partition's accumulated commands in ONE durable
-    txn, so commands/s scales with concurrency at a near-flat p50 until the
-    1-core host's event loop saturates — ``commands_per_txn`` measures the
-    batching directly (journal commits counted at the FileLog). Partition-
-    COUNT scaling cannot manifest on a single core (measured: 1 vs 8
-    partitions within noise at every rung — there is no second core for
-    another partition's commit path to run on); ``host_cores`` records that
-    context, and the headline rung stays 64 workers for r4 comparability."""
+    A WORKER LADDER shows the per-partition group commits breaking past the
+    one-command-per-envelope floor (VERDICT r4 weak #3 / next #8): each lane
+    commits its accumulated commands in ONE durable txn whose journal fsync is
+    shared across lanes (FileLog group-commit round), so commands/s scales
+    with concurrency at a near-flat p50 until the host's event loop saturates —
+    ``commands_per_txn`` measures the batching directly (journal commits
+    counted at the FileLog). ``overrides``/``ladder`` parameterize the
+    producer-knob sweep rows."""
     import asyncio
     import shutil
     import tempfile
@@ -428,31 +429,59 @@ def steady_state_latency(seconds: float) -> dict:
     from surge_tpu.log.file import FileLog
     from surge_tpu.models import counter
 
+    # server tuning (documented in docs/operations.md): the command path
+    # hands off between the event loop, the journal group-sync thread and
+    # executor threads constantly; the default 5 ms GIL switch interval turns
+    # every handoff into a latency cliff on a busy loop
+    sys.setswitchinterval(0.0005)
+
     base_workers = int(os.environ.get("SURGE_BENCH_LATENCY_WORKERS", 64))
     default_ladder = [base_workers, 256, 1024]
-    ladder = []
-    for tok in os.environ.get("SURGE_BENCH_LATENCY_LADDER", "").split(","):
-        try:
-            w = int(tok)
-        except ValueError:
-            continue  # empty element / typo: skip, never void the phase
-        if w > 0:
-            ladder.append(w)
+    if ladder is None:
+        ladder = []
+        for tok in os.environ.get("SURGE_BENCH_LATENCY_LADDER", "").split(","):
+            try:
+                w = int(tok)
+            except ValueError:
+                continue  # empty element / typo: skip, never void the phase
+            if w > 0:
+                ladder.append(w)
     if not ladder:
         ladder = default_ladder
     cfg = default_config()
+    if overrides:
+        cfg = cfg.with_overrides(overrides)
     flush_ms = cfg.get_int("surge.producer.flush-interval-ms")
+    linger_ms = cfg.get_int("surge.producer.linger-ms")
+    max_in_flight = cfg.get_int("surge.producer.max-in-flight")
     root = tempfile.mkdtemp(prefix="surge-bench-latency-")
+
+    broker = (overrides or {}).get("bench.broker", "inproc")
 
     async def scenario() -> dict:
         flog = FileLog(os.path.join(root, "log"))
         journal = flog._journal_path
+        log_server = None
+        transport = None
+        engine_log = flog
+        if broker == "grpc":
+            # the over-the-wire command path: a loopback LogServer over the
+            # same durable FileLog, so max-in-flight's pipelined Transact
+            # window (client seq dispatch + broker in-order gate) is actually
+            # exercised — in-process logs collapse to one commit in flight
+            from surge_tpu.log.client import GrpcLogTransport
+            from surge_tpu.log.server import LogServer
+
+            log_server = LogServer(flog, port=0, config=cfg)
+            port = log_server.start()
+            transport = GrpcLogTransport(f"127.0.0.1:{port}", config=cfg)
+            engine_log = transport
         engine = create_engine(
             SurgeCommandBusinessLogic(
                 aggregate_name="counter", model=counter.CounterModel(),
                 state_format=counter.state_formatting(),
                 event_format=counter.event_formatting()),
-            log=flog, config=cfg)
+            log=engine_log, config=cfg)
         await engine.start()
 
         latencies: list = []
@@ -494,7 +523,12 @@ def steady_state_latency(seconds: float) -> dict:
                 "commands_per_txn": round(n / max(txns, 1), 1),
                 "commands": n,
             })
+        pstats = engine.producer_stats()
         await engine.stop()
+        if transport is not None:
+            transport.close()
+        if log_server is not None:
+            log_server.stop()
         flog.close()
 
         base = rungs[0]
@@ -509,12 +543,63 @@ def steady_state_latency(seconds: float) -> dict:
             "num_partitions": cfg.get_int("surge.engine.num-partitions"),
             "host_cores": os.cpu_count(),
             "flush_interval_ms": flush_ms,
+            "linger_ms": linger_ms,
+            "max_in_flight": max_in_flight,
+            "broker": broker,
+            "producer_stats": pstats,
         }
 
     try:
         return asyncio.run(scenario())
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+def producer_sweep(seconds: float) -> list:
+    """Sweep the group-commit knobs at one fixed rung — the before/after
+    evidence for the adaptive publisher. The ``linger_ms=50, max_in_flight=1,
+    broker=inproc`` row approximates the retired fixed 50 ms flush tick with
+    one serial transaction lane; the grpc rows exercise the pipelined
+    Transact window against a loopback broker (in-process logs always run
+    one commit in flight, so in-flight only moves the wire rows).
+
+    Env: SURGE_BENCH_SWEEP_WORKERS (256), SURGE_BENCH_SWEEP_SECONDS
+    (min(seconds, 3))."""
+    workers = int(os.environ.get("SURGE_BENCH_SWEEP_WORKERS", 256))
+    secs = float(os.environ.get("SURGE_BENCH_SWEEP_SECONDS",
+                                min(seconds, 3.0)))
+    combos = [
+        (50, 1, "inproc"),  # the pre-group-commit fixed-tick envelope
+        (5, 1, "inproc"),
+        (2, 1, "inproc"),   # the shipped default
+        (0, 1, "inproc"),
+        (2, 1, "grpc"),     # pipelining off, over the wire
+        (2, 4, "grpc"),     # the shipped default window, over the wire
+        (2, 8, "grpc"),
+    ]
+    rows = []
+    for linger, inflight, broker in combos:
+        try:
+            stats = steady_state_latency(secs, overrides={
+                "surge.producer.linger-ms": linger,
+                "surge.producer.max-in-flight": inflight,
+                "bench.broker": broker,
+            }, ladder=[workers])
+        except Exception as exc:  # noqa: BLE001 — one combo must not void the sweep
+            log(f"sweep combo linger={linger} in_flight={inflight} "
+                f"broker={broker} failed: {exc!r}")
+            rows.append({"linger_ms": linger, "max_in_flight": inflight,
+                         "broker": broker,
+                         "error": f"{type(exc).__name__}: {exc}"})
+            continue
+        rung = stats["throughput_ladder"][0]
+        row = {"linger_ms": linger, "max_in_flight": inflight,
+               "broker": broker, **rung}
+        rows.append(row)
+        log(f"sweep linger={linger}ms in_flight={inflight} broker={broker}: "
+            f"{rung['commands_per_sec']} cmds/s p50 {rung['p50_ms']}ms "
+            f"p99 {rung['p99_ms']}ms ({rung['commands_per_txn']} cmds/txn)")
+    return rows
 
 
 # --------------------------------------------------------------------------------------
@@ -687,6 +772,26 @@ def main() -> None:
     except ValueError:
         latency_seconds = 0.0
         payload["latency_error"] = "unparseable SURGE_BENCH_LATENCY_SECONDS"
+
+    # SURGE_BENCH_LADDER=1: command-path fast path — regenerate the
+    # throughput ladder + producer sweep WITHOUT the 100M-event corpus
+    # build/replay (the replay numbers are untouched by producer work, and
+    # the corpus build dominates a full run's wall clock)
+    if os.environ.get("SURGE_BENCH_LADDER", "0") == "1":
+        payload = {"metric": "commands_per_sec", "value": 0,
+                   "unit": "commands/s"}
+        secs = latency_seconds if latency_seconds > 0 else 5.0
+        stats = steady_state_latency(secs)
+        payload.update(stats)
+        payload["value"] = stats["peak_commands_per_sec"]
+        log(f"ladder fast path: p50 {stats['command_p50_ms']}ms at "
+            f"{stats['latency_workers']} workers, peak "
+            f"{stats['peak_commands_per_sec']} commands/s")
+        if os.environ.get("SURGE_BENCH_SWEEP", "1") == "1":
+            payload["producer_sweep"] = producer_sweep(secs)
+        emit(payload)
+        return
+
     if latency_seconds > 0:
         try:
             stats = steady_state_latency(latency_seconds)
@@ -694,6 +799,12 @@ def main() -> None:
                 f"p99 {stats['command_p99_ms']}ms, "
                 f"{stats['commands_per_sec']} commands/s")
             payload.update(stats)
+            if os.environ.get("SURGE_BENCH_SWEEP", "1") == "1":
+                try:
+                    payload["producer_sweep"] = producer_sweep(latency_seconds)
+                except Exception as exc:  # noqa: BLE001
+                    log(f"producer sweep failed: {exc!r}")
+                    payload["sweep_error"] = f"{type(exc).__name__}: {exc}"
         except Exception as exc:  # noqa: BLE001 — phase 2 must not void phase 1
             log(f"steady-state latency phase failed: {exc!r}")
             payload["latency_error"] = f"{type(exc).__name__}: {exc}"

@@ -27,6 +27,27 @@ def _env_key(key: str) -> str:
 #: max poll records), :228-260 (health windows).
 DEFAULTS: dict[str, Any] = {
     # --- log / producer (reference: surge.kafka.publisher.*) ---
+    # group-commit flush triggers (the Kafka producer linger.ms /
+    # batch.size analog): a batch commits when the FIRST pending publish has
+    # lingered this long OR the batch hits max-records/max-bytes, whichever
+    # comes first. An idle engine therefore commits a lone command in
+    # ~linger time; a loaded engine fills batches.
+    "surge.producer.linger-ms": 2,
+    "surge.producer.batch-max-records": 512,
+    "surge.producer.batch-max-bytes": 4 << 20,
+    # bounded pipelining (max.in.flight.requests.per.connection analog):
+    # how many publish transactions one partition lane may have in flight
+    # concurrently. >1 requires a transport with pipelined commits (the gRPC
+    # log client); exactly-once rests on the broker's per-producer txn_seq
+    # dedup + in-order apply gate. In-process logs fall back to 1 (their
+    # commit latency IS the group-commit pacing).
+    "surge.producer.max-in-flight": 4,
+    # backpressure: publishes past this many queued records await a slot
+    # instead of growing the lane queue without bound under overload
+    "surge.producer.pending-max-records": 16_384,
+    # housekeeping tick: fenced-reinit retries, verbatim-retry pacing and
+    # dedup-TTL purges run on this cadence (pre-group-commit it was the
+    # fixed flush tick; the flush itself is event-driven now)
     "surge.producer.flush-interval-ms": 50,
     "surge.producer.slow-transaction-warning-ms": 1_000,
     "surge.producer.ktable-check-interval-ms": 500,
@@ -54,6 +75,10 @@ DEFAULTS: dict[str, Any] = {
     "surge.aggregate.passivation-buffer-limit": 1000,
     # --- serialization (core reference.conf:73-76) ---
     "surge.serialization.thread-pool-size": 32,
+    # command-path fast path: event batches at most this long serialize
+    # INLINE on the event loop instead of paying the thread-pool hop (~80us
+    # per command) — big payloads still offload. 0 = always off-thread.
+    "surge.serialization.inline-max-events": 4,
     # --- replay engine (new: the TPU north star; BASELINE.json replayBackend=tpu) ---
     "surge.replay.backend": "tpu",  # tpu | cpu (scalar fold)
     "surge.replay.restore-on-start": False,  # engine cold start folds the events topic
@@ -110,6 +135,11 @@ DEFAULTS: dict[str, Any] = {
     # landing. Beyond the cap (fresh/empty replicas) the follower stays out
     # until catch_up bulk-copies it. 0 disables auto-resync.
     "surge.log.replication-auto-resync-max-records": 10_000,
+    # pipelined transactions: how long the broker's in-order apply gate
+    # waits for a missing predecessor txn_seq (a pipelined window arriving
+    # out of order) before answering retriable — the client retries the
+    # same seq, preserving exactly-once
+    "surge.log.txn-inorder-timeout-ms": 3_000,
     # --- health (common reference.conf:228-260) ---
     "surge.health.window-frequency-ms": 10_000,
     "surge.health.window-buffer-size": 10,

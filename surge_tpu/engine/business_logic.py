@@ -67,6 +67,12 @@ class SurgeModel:
         self.logic = logic
         cfg = config or default_config()
         self._own_pool = pool is None
+        # command-path fast path: short event batches serialize INLINE —
+        # the executor hop (submit + wakeup) costs more than serializing a
+        # small payload, and at engine throughput it is a per-command tax.
+        # 0 keeps every batch off-thread (the reference's behavior).
+        self._inline_max_events = cfg.get_int(
+            "surge.serialization.inline-max-events", 4)
         self.pool = pool or ThreadPoolExecutor(
             max_workers=cfg.get_int("surge.serialization.thread-pool-size", 32),
             thread_name_prefix="surge-serde")
@@ -76,6 +82,9 @@ class SurgeModel:
                                 publish_state: bool = True) -> List[LogRecord]:
         import asyncio
 
+        if len(events) <= self._inline_max_events > 0:
+            return self._serialize_sync(aggregate_id, partition, state,
+                                        list(events), publish_state)
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             self.pool, self._serialize_sync, aggregate_id, partition, state,

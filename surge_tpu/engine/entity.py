@@ -95,6 +95,50 @@ class EntityCrashed(Exception):
     """The entity died mid-processing (persistence exhaustion or init failure)."""
 
 
+class _Mailbox:
+    """Minimal mailbox: a deque plus one waiter future. ``asyncio.Queue.get``
+    under ``wait_for`` costs a wrapper task + timeout machinery per message —
+    a real tax at engine throughput; here an idle entity parks on a bare
+    future and the idle timeout is a single ``call_later`` handle."""
+
+    __slots__ = ("_items", "_waiter")
+
+    def __init__(self) -> None:
+        from collections import deque
+
+        self._items: "deque[Envelope]" = deque()
+        self._waiter: Optional["asyncio.Future[Optional[Envelope]]"] = None
+
+    def put_nowait(self, env: Envelope) -> None:
+        w = self._waiter
+        if w is not None and not w.done():
+            self._waiter = None
+            w.set_result(env)
+        else:
+            self._items.append(env)
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def get_nowait(self) -> Envelope:
+        return self._items.popleft()
+
+    async def get_or_idle(self, idle_s: float) -> Optional[Envelope]:
+        """Next envelope, or None after ``idle_s`` with no delivery."""
+        if self._items:
+            return self._items.popleft()
+        loop = asyncio.get_running_loop()
+        waiter: "asyncio.Future[Optional[Envelope]]" = loop.create_future()
+        self._waiter = waiter
+        timer = loop.call_later(idle_s, resolve_future, waiter, None)
+        try:
+            return await waiter
+        finally:
+            timer.cancel()
+            if self._waiter is waiter:
+                self._waiter = None
+
+
 class AggregateEntity:
     """One live aggregate: mailbox task + FSM state."""
 
@@ -121,8 +165,13 @@ class AggregateEntity:
         self._idle_s = self.config.get_seconds("surge.aggregate.idle-passivation-ms", 30_000)
         self.state_name = "created"
         self.state: Any = None
-        self._mailbox: "asyncio.Queue[Envelope]" = asyncio.Queue()
+        self._mailbox = _Mailbox()
         self._task: Optional[asyncio.Task] = None
+        # request-id source: one urandom draw per ENTITY (not per command —
+        # uuid4's syscall is measurable at engine throughput); a restart makes
+        # a fresh entity, so prefix+counter stays globally unique
+        self._rid_prefix = uuid.uuid4().hex[:16]
+        self._rid_n = 0
 
     # -- public surface -----------------------------------------------------------------
 
@@ -152,9 +201,8 @@ class AggregateEntity:
             await self._initialize()
             self.state_name = "free_to_process"
             while True:
-                try:
-                    env = await asyncio.wait_for(self._mailbox.get(), timeout=self._idle_s)
-                except asyncio.TimeoutError:
+                env = await self._mailbox.get_or_idle(self._idle_s)
+                if env is None:
                     self.on_passivate(self.aggregate_id)  # parent starts buffering now
                     break
                 try:
@@ -320,7 +368,8 @@ class AggregateEntity:
                 resolve_future(env.reply, CommandFailure(exc))
                 return
 
-            request_id = uuid.uuid4().hex
+            self._rid_n += 1
+            request_id = f"{self._rid_prefix}-{self._rid_n}"
             last_error: Optional[Exception] = None
             for _ in range(self.retry.publish_max_retries + 1):
                 try:

@@ -357,6 +357,30 @@ class SurgeEngine(Controllable):
                             status="up" if self.indexer.running else "down"),
             ])
 
+    def producer_stats(self) -> Dict[str, float]:
+        """Aggregated group-commit lane stats across this node's partitions
+        (the operator view of the adaptive publisher: how well batching and
+        pipelining are doing). Sums counters, maxes peaks."""
+        out = {"flushes": 0, "records_published": 0, "batches_failed": 0,
+               "fences": 0, "reinitializations": 0, "dedup_hits": 0,
+               "max_batch_records": 0, "inflight_peak": 0, "lanes": 0}
+        for _p, region in self.router.regions():
+            s = region.publisher.stats
+            out["lanes"] += 1
+            out["flushes"] += s.flushes
+            out["records_published"] += s.records_published
+            out["batches_failed"] += s.batches_failed
+            out["fences"] += s.fences
+            out["reinitializations"] += s.reinitializations
+            out["dedup_hits"] += s.dedup_hits
+            out["max_batch_records"] = max(out["max_batch_records"],
+                                           s.max_batch_records)
+            out["inflight_peak"] = max(out["inflight_peak"], s.inflight_peak)
+        if out["flushes"]:
+            out["records_per_flush"] = round(
+                out["records_published"] / out["flushes"], 2)
+        return out
+
     def owned_partitions(self) -> List[int]:
         """The partitions this node owns per the tracker — or ALL partitions when
         no assignments exist yet (single-node cold start self-assigns everything;

@@ -11,25 +11,44 @@ KafkaProducerActorImpl.scala:182-528) as an asyncio FSM:
 - ``waiting_for_ktable``: hold publishes until the state store has indexed everything
   already on the state topic (lag == 0, :341-376) so ``is_aggregate_state_current``
   answers are sound from the first command, then
-- ``processing``: batch all pending publishes on a flush tick into ONE transaction
-  spanning events + state topics (:397-453); on commit, acknowledge every batched
-  publisher and track the published aggregates as **in-flight by state-topic offset**
-  until the store's indexed watermark passes them (:580-699) — the gap that
-  ``is_aggregate_state_current`` (:530-540) reports.
+- ``processing``: **event-driven group commit** (the Kafka producer's
+  linger.ms/batch.size triggers replacing the fixed flush tick this file used
+  to run): the first queued publish wakes the lane, the batch commits after
+  ``surge.producer.linger-ms`` — or immediately once it hits
+  ``batch-max-records``/``batch-max-bytes`` — as ONE transaction spanning
+  events + state topics (:397-453). An idle lane therefore acks a lone
+  command in ~linger time; a loaded lane fills batches. Commits run OFF the
+  event loop (a dedicated lane thread, or pipelined transport futures), so
+  the lanes of different partitions commit concurrently — the single-writer
+  guarantee is per aggregate and aggregates hash to partitions, making
+  cross-partition serialization pure overhead. Transports exposing
+  ``commit_pipelined`` (the gRPC log client) additionally keep a bounded
+  window of ``surge.producer.max-in-flight`` transactions in flight per lane,
+  relying on the broker's replicated per-producer ``txn_seq`` dedup plus its
+  in-order apply gate for exactly-once. On commit, acknowledge every batched
+  publisher and track the published aggregates as **in-flight by state-topic
+  offset** until the store's indexed watermark passes them (:580-699) — the
+  gap that ``is_aggregate_state_current`` (:530-540) reports.
 - Fencing (``ProducerFencedError``) fails the open batch, then either re-initializes
   (still partition owner: new epoch re-fences the impostor) or shuts down (ownership
   lost) — :502-528.
 - Duplicate publish suppression by request id with a TTL (the ``PublishTracker``
   analog, :580-608) so an entity retrying a publish whose commit actually landed does
   not double-write.
+
+Backpressure: publishes past ``surge.producer.pending-max-records`` queued
+records await lane headroom instead of growing memory without bound under
+overload; callers see added latency, never an unbounded queue.
 """
 
 from __future__ import annotations
 
 import asyncio
 import time
-from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Mapping, Optional, Protocol, Sequence
+from collections import deque
+from dataclasses import dataclass
+from typing import (Callable, Deque, Dict, List, Mapping, Optional, Protocol,
+                    Sequence)
 
 from surge_tpu.common import BackgroundTask, fail_future, logger, resolve_future
 from surge_tpu.config import Config, default_config
@@ -58,6 +77,74 @@ class _Pending:
     aggregate_id: str
     records: List[LogRecord]
     future: "asyncio.Future[None]"
+    nbytes: int = 0
+
+
+class _Batch:
+    """One group-commit unit: the pendings drained together, their flattened
+    records, and (pipelined transports) the commit handle pinning the batch's
+    txn_seq so an unknown-outcome batch retries VERBATIM under the same
+    sequence number."""
+
+    __slots__ = ("pendings", "records", "handle", "attempts", "index",
+                 "dispatch_error", "outcome")
+
+    def __init__(self, pendings: List[_Pending], records: List[LogRecord],
+                 index: int) -> None:
+        self.pendings = pendings
+        self.records = records
+        self.handle = None
+        self.attempts = 0
+        self.index = index  # dispatch order: retries must replay oldest-first
+        self.dispatch_error: Optional[Exception] = None
+        #: the current commit attempt's outcome (None = success, exception =
+        #: why it failed); registered under _committing for every request id
+        #: the moment the batch FORMS — a caller-timeout retry arriving while
+        #: the commit task is still being scheduled must join, never re-queue
+        self.outcome: Optional["asyncio.Future[Optional[Exception]]"] = None
+
+
+class _Signal:
+    """Level-triggered wakeup for ONE waiter (the flush loop), without
+    ``wait_for(event.wait(), t)``: that wrapper costs a task per wait and —
+    py3.10's wait_for — can swallow a cancellation racing its timeout,
+    leaving the loop task uncancellable (the BackgroundTask.stop hang class
+    fixed in surge_tpu.common). A bare awaited future cancels cleanly."""
+
+    __slots__ = ("_set", "_waiter")
+
+    def __init__(self) -> None:
+        self._set = False
+        self._waiter: Optional["asyncio.Future[None]"] = None
+
+    def set(self) -> None:
+        if not self._set:
+            self._set = True
+            w = self._waiter
+            if w is not None and not w.done():
+                w.set_result(None)
+
+    def clear(self) -> None:
+        self._set = False
+
+    def is_set(self) -> bool:
+        return self._set
+
+    async def wait(self, timeout: float) -> bool:
+        """True iff set (possibly before the timeout elapsed)."""
+        if self._set:
+            return True
+        loop = asyncio.get_running_loop()
+        w: "asyncio.Future[None]" = loop.create_future()
+        self._waiter = w
+        timer = loop.call_later(timeout, resolve_future, w, None)
+        try:
+            await w
+        finally:
+            timer.cancel()
+            if self._waiter is w:
+                self._waiter = None
+        return self._set
 
 
 @dataclass
@@ -71,6 +158,8 @@ class PublisherStats:
     reinitializations: int = 0
     dedup_hits: int = 0
     in_flight: int = 0
+    max_batch_records: int = 0
+    inflight_peak: int = 0
 
 
 class PartitionPublisher:
@@ -103,9 +192,30 @@ class PartitionPublisher:
         # request_id -> outcome future of the batch currently committing it; retries of
         # an in-flight request join the commit instead of re-queueing (exactly-once)
         self._committing: Dict[str, "asyncio.Future[Optional[Exception]]"] = {}
+        # aggregate_id -> live commit-batch refcount: a write mid-commit is
+        # ahead of the store even though it sits in neither _pending nor
+        # _in_flight yet — is_aggregate_state_current must see it
+        self._committing_aggs: Dict[str, int] = {}
         self._watermark = 0
         self._ready = asyncio.Event()
+        # housekeeping tick: fenced-reinit retries, verbatim-retry pacing,
+        # dedup purges (the flush itself is event-driven; pre-group-commit
+        # this interval WAS the fixed flush tick, so configs lowering it for
+        # fast tests keep their meaning as the recovery cadence)
         self._flush_interval = self.config.get_seconds("surge.producer.flush-interval-ms", 50)
+        # group-commit triggers: the legacy flush tick stays an upper bound on
+        # linger so configs tuned for the old fixed tick never get slower
+        self._linger_s = min(
+            self.config.get_seconds("surge.producer.linger-ms", 2),
+            self._flush_interval)
+        self._batch_max_records = max(1, self.config.get_int(
+            "surge.producer.batch-max-records", 512))
+        self._batch_max_bytes = max(1, self.config.get_int(
+            "surge.producer.batch-max-bytes", 4 << 20))
+        self._pending_max = max(1, self.config.get_int(
+            "surge.producer.pending-max-records", 16_384))
+        self._max_in_flight = max(1, self.config.get_int(
+            "surge.producer.max-in-flight", 4))
         self._check_interval = self.config.get_seconds("surge.producer.ktable-check-interval-ms", 500)
         self._slow_txn_s = self.config.get_seconds("surge.producer.slow-transaction-warning-ms", 1000)
         self._dedup_ttl_s = self.config.get_seconds(
@@ -125,16 +235,31 @@ class PartitionPublisher:
         # offset-alignment loop a short `committed` list.
         self._partial_records: Dict[str, List[LogRecord]] = {}
         self._partial_touched: Dict[str, float] = {}  # request_id -> last retry time
-        # transactional mode: a commit whose OUTCOME IS UNKNOWN (transport
-        # died, fencing mid-flight) keeps its batch here and retries it
-        # VERBATIM under the same txn_seq — the broker's (now
-        # restart-durable) dedup then answers a commit that actually landed,
-        # instead of a re-batched different payload being appended beside it.
-        # Kafka's producer retries fixed batches for exactly this reason.
-        self._retry_batch: Optional[List[_Pending]] = None
-        self._retry_attempts = 0
+        # transactional mode: commits whose OUTCOME IS UNKNOWN (transport
+        # died, fencing mid-flight) keep their batches here — in dispatch
+        # order — and retry them VERBATIM under the same txn_seq BEFORE any
+        # new pendings commit; the broker's (restart-durable, replicated)
+        # dedup then answers a commit that actually landed, instead of a
+        # re-batched different payload being appended beside it. Kafka's
+        # producer retries fixed batches for exactly this reason. A pipelined
+        # window can strand up to max-in-flight batches at once.
+        self._retry_batches: Deque[_Batch] = deque()
         self._retry_max = self.config.get_int(
             "surge.producer.publish-retry-max", 8)
+        # flush machinery: _wake = a pending exists, _batch_full = a size/bytes
+        # trigger fired, _pending_room = backpressure gate (multi-waiter,
+        # rare path — a plain Event is fine there)
+        self._wake = _Signal()
+        self._batch_full = _Signal()
+        self._pending_room = asyncio.Event()
+        self._pending_room.set()
+        self._pending_bytes = 0
+        self._first_pending_t: Optional[float] = None
+        self._batch_counter = 0
+        self._inflight = 0
+        self._slots = asyncio.Semaphore(self._max_in_flight)
+        self._commit_tasks: set = set()
+        self._lane_pool = None  # single-thread commit lane (lazy; off-loop fsync)
         self._flush_task = BackgroundTask(self._flush_loop, f"publisher-flush-{partition}")
         self._progress_task = BackgroundTask(self._progress_loop, f"publisher-progress-{partition}")
 
@@ -153,23 +278,43 @@ class PartitionPublisher:
                 fail_future(p.future, PublisherNotReadyError(f"init failed: {exc}"))
             self._pending.clear()
             raise
+        # pipelining depth: transports without pipelined commits (in-process
+        # logs) run ONE commit in flight per lane — the commit's own latency
+        # then paces the group commit, growing batches under load instead of
+        # queueing linger-sized ones behind the lane thread
+        depth = self._max_in_flight if self._pipeline_capable() else 1
+        self._slots = asyncio.Semaphore(depth)
         self._flush_task.start()
         self._progress_task.start()
 
     async def stop(self) -> None:
         self.state = "stopped"
         self._ready.clear()
+        self._pending_room.set()  # release backpressure waiters to the state check
         await self._flush_task.stop()
         await self._progress_task.stop()
+        if self._commit_tasks:
+            # let in-flight commits resolve their waiters; cancel stragglers
+            done, still = await asyncio.wait(list(self._commit_tasks),
+                                             timeout=5.0)
+            for t in still:
+                t.cancel()
+            if still:
+                await asyncio.gather(*still, return_exceptions=True)
         for p in self._pending:
             fail_future(p.future, PublisherNotReadyError("publisher stopped"))
         self._pending.clear()
-        if self._retry_batch is not None:
-            for p in self._retry_batch:
+        self._pending_bytes = 0
+        while self._retry_batches:
+            batch = self._retry_batches.popleft()
+            for p in batch.pendings:
                 fail_future(p.future,
                             PublisherNotReadyError("publisher stopped"))
-            self._retry_batch = None
-            self._retry_attempts = 0
+        self._committing.clear()
+        self._committing_aggs.clear()
+        if self._lane_pool is not None:
+            self._lane_pool.shutdown(wait=False)
+            self._lane_pool = None
 
     async def _initialize(self) -> None:
         """Open producer (fences zombies), commit the flush record, gate on store lag."""
@@ -200,10 +345,14 @@ class PartitionPublisher:
 
     # -- publish path -------------------------------------------------------------------
 
-    async def publish(self, aggregate_id: str, records: Sequence[LogRecord],
-                      request_id: str,
-                      headers: Optional[Mapping[str, str]] = None) -> None:
-        """Queue records for the next flush transaction; resolves at commit.
+    def publish(self, aggregate_id: str, records: Sequence[LogRecord],
+                request_id: str,
+                headers: Optional[Mapping[str, str]] = None):
+        """Queue records for the next group commit; the returned awaitable
+        resolves at commit. The hot path returns a BARE FUTURE (no coroutine,
+        so the entity's ``asyncio.wait_for`` needs no wrapper task — a real
+        per-command cost at engine throughput); dedup joins, backpressure and
+        the traced path return a coroutine.
 
         Raises :class:`PublishFailedError` if the batch fails — callers (the aggregate
         entity's persistence ladder, KTablePersistenceSupport.scala:71-156) retry with
@@ -214,28 +363,78 @@ class PartitionPublisher:
         then chains under the caller's entity span.
         """
         if self.tracer is None:
-            return await self._publish_inner(aggregate_id, records, request_id)
+            if (self.state == "processing"
+                    and request_id not in self._completed
+                    and not self._retry_batches
+                    and request_id not in self._committing
+                    and len(self._pending) < self._pending_max):
+                return self._queue_pending(aggregate_id, records, request_id)
+            return self._publish_slow(aggregate_id, records, request_id)
+        return self._publish_traced(aggregate_id, records, request_id, headers)
+
+    async def _publish_traced(self, aggregate_id: str,
+                              records: Sequence[LogRecord], request_id: str,
+                              headers: Optional[Mapping[str, str]]) -> None:
         span = self.tracer.start_span("publisher.publish",
                                       headers=headers or {})
         span.set_attribute("aggregate_id", aggregate_id)
         span.set_attribute("partition", self.partition)
         span.set_attribute("records", len(records))
         with span:  # records exceptions + finishes
-            return await self._publish_inner(aggregate_id, records, request_id)
+            return await self._publish_slow(aggregate_id, records, request_id)
 
-    async def _publish_inner(self, aggregate_id: str,
-                             records: Sequence[LogRecord],
-                             request_id: str) -> None:
+    def _queue_pending(self, aggregate_id: str, records: Sequence[LogRecord],
+                       request_id: str) -> "asyncio.Future[None]":
+        """Hot path: enqueue for the next group commit, return the ack future."""
+        nbytes = 0
+        for r in records:
+            nbytes += ((len(r.value) if r.value else 0)
+                       + (len(r.key) if r.key else 0) + 24)
+        fut: "asyncio.Future[None]" = asyncio.get_running_loop().create_future()
+        pending = _Pending(request_id, aggregate_id, list(records), fut, nbytes)
+        self._pending.append(pending)
+        self._pending_bytes += nbytes
+        if self._first_pending_t is None:
+            self._first_pending_t = time.monotonic()
+        self._wake.set()
+        if (len(self._pending) >= self._batch_max_records
+                or self._pending_bytes >= self._batch_max_bytes):
+            self._batch_full.set()
+        # caller timed out (future cancelled): withdraw the queued write so a
+        # same-request_id retry does not double-queue it. If the flush already
+        # drained it, the commit may still land — then the retry is absorbed
+        # by the _completed dedup (or joins the in-flight commit / in-limbo
+        # batch).
+        fut.add_done_callback(lambda f: self._withdraw(pending)
+                              if f.cancelled() else None)
+        return fut
+
+    def _withdraw(self, pending: _Pending) -> None:
+        try:
+            self._pending.remove(pending)
+            self._pending_bytes = max(0, self._pending_bytes - pending.nbytes)
+        except ValueError:
+            pass
+
+    async def _publish_slow(self, aggregate_id: str,
+                            records: Sequence[LogRecord],
+                            request_id: str) -> None:
         if self.state not in ("processing", "waiting_for_ktable", "initializing"):
             raise PublisherNotReadyError(f"publisher state={self.state}")
         if request_id in self._completed:
             self.stats.dedup_hits += 1
             return
-        if self._retry_batch is not None:
-            for sp in self._retry_batch:
+        for rb in self._retry_batches:
+            for sp in rb.pendings:
                 if sp.request_id == request_id:
-                    # this request rides the in-limbo batch: join its outcome
+                    # this request rides the in-limbo batch: join its outcome.
+                    # If the original caller's timeout CANCELLED the waiter
+                    # future, swap in a fresh one — the retry resolves
+                    # whatever future the pending holds, and the rejoiner
+                    # must see the batch's outcome, not the old cancellation.
                     self.stats.dedup_hits += 1
+                    if sp.future.cancelled():
+                        sp.future = asyncio.get_running_loop().create_future()
                     await asyncio.shield(sp.future)
                     return
         committing = self._committing.get(request_id)
@@ -247,29 +446,28 @@ class PartitionPublisher:
             if outcome is not None:
                 raise PublishFailedError(str(outcome))
             return
-        fut: "asyncio.Future[None]" = asyncio.get_running_loop().create_future()
-        pending = _Pending(request_id, aggregate_id, list(records), fut)
-        self._pending.append(pending)
-        try:
-            await fut
-        except asyncio.CancelledError:
-            # caller timed out: withdraw the queued write so a same-request_id retry
-            # does not double-queue it. If the flush already drained it, the commit may
-            # still land — then the retry is absorbed by the _completed dedup.
-            try:
-                self._pending.remove(pending)
-            except ValueError:
-                pass
-            raise
+        # backpressure: overload queues no further than pending-max — the
+        # caller waits for lane headroom (memory stays bounded; the entity's
+        # publish timeout is the escape hatch if the lane never drains)
+        while (len(self._pending) >= self._pending_max
+               and self.state in ("processing", "waiting_for_ktable",
+                                  "initializing")):
+            self._pending_room.clear()
+            await self._pending_room.wait()
+        if self.state not in ("processing", "waiting_for_ktable", "initializing"):
+            raise PublisherNotReadyError(f"publisher state={self.state}")
+        await self._queue_pending(aggregate_id, records, request_id)
 
     def is_aggregate_state_current(self, aggregate_id: str) -> bool:
         """True iff nothing published for this aggregate is still ahead of the store's
         indexed watermark and nothing is pending (KafkaProducerActorImpl.scala:530-540)."""
         if any(p.aggregate_id == aggregate_id for p in self._pending):
             return False
-        if self._retry_batch is not None and any(
-                p.aggregate_id == aggregate_id for p in self._retry_batch):
-            return False  # an in-limbo write is ahead of the store by definition
+        if self._committing_aggs.get(aggregate_id):
+            return False  # a commit is in flight for this aggregate right now
+        for rb in self._retry_batches:
+            if any(p.aggregate_id == aggregate_id for p in rb.pendings):
+                return False  # an in-limbo write is ahead of the store by definition
         off = self._in_flight.get(aggregate_id)
         if off is None:
             return True
@@ -279,14 +477,13 @@ class PartitionPublisher:
 
     async def _flush_loop(self) -> None:
         # the loop must be unkillable by a bug: _publish_batch fails batches
-        # on expected errors, but an escape here (e.g. from post-commit
-        # bookkeeping) would end the task SILENTLY and every later command on
-        # this partition would time out with no root cause — same hazard
-        # class as the broker's replication worker
+        # on expected errors, but an escape here would end the task SILENTLY
+        # and every later command on this partition would time out with no
+        # root cause — same hazard class as the broker's replication worker
         while True:
-            await asyncio.sleep(self._flush_interval)
-            batch: List[_Pending] = []
             try:
+                # wake-on-first-pending, or the housekeeping tick
+                await self._wake.wait(self._flush_interval)
                 if self.state in ("fenced", "waiting_for_ktable"):
                     # a fencing-triggered re-init that RAISED mid-way (broker
                     # briefly unreachable — it may already have advanced state
@@ -295,31 +492,156 @@ class PartitionPublisher:
                     # dead-but-running forever. _handle_fenced also covers
                     # the lost-ownership shutdown path.
                     await self._handle_fenced()
-                if (self._retry_batch is not None
-                        and self.state == "processing"):
-                    # in-limbo batch retries VERBATIM before any new pendings
-                    # commit (same txn_seq -> the broker dedup can answer it)
-                    await self._publish_batch(self._retry_batch)
+                if self._retry_batches and self.state == "processing":
+                    # in-limbo batches retry VERBATIM, oldest dispatch first,
+                    # before any new pendings commit (same txn_seq -> the
+                    # broker dedup can answer a commit that landed); the
+                    # pipeline drains first so the retry runs alone
+                    await self._drain_inflight()
+                    if self._retry_batches and self.state == "processing":
+                        rb = self._retry_batches[0]
+                        await self._publish_batch(rb)
+                        if self._retry_batches and self._retry_batches[0] is rb:
+                            # still failing: pace the next attempt on the tick
+                            await asyncio.sleep(self._flush_interval)
                 elif self._pending and self.state == "processing":
-                    batch, self._pending = self._pending, []
-                    await self._publish_batch(batch)
+                    await self._await_linger()
+                    if self.state == "processing":
+                        batch = self._take_batch()
+                        if batch is not None:
+                            await self._dispatch(batch)
                 self._purge_dedup()
-            except Exception as exc:  # noqa: BLE001 — log loudly, keep flushing
+            except Exception:  # noqa: BLE001 — log loudly, keep flushing
                 logger.exception("flush loop iteration failed on %s[%d]; "
                                  "continuing", self.state_topic, self.partition)
-                # the drained batch's waiters must not hang forever: fail
-                # them so the entity ladder retries with the same request_id.
-                # (If the commit actually landed before the escape, the
-                # broker's txn_seq cache absorbs the replay while the broker
-                # lives; across a broker RESTART that cache is rebuilt from
-                # the __txn_state records it persists with each commit.)
-                for p in batch:
-                    fail_future(p.future, PublishFailedError(
-                        f"flush loop error: {exc}"))
                 try:
                     self.on_signal("surge.producer.flush-loop-error", "error")
                 except Exception:  # noqa: BLE001 — a raising signal sink must
                     logger.exception("on_signal failed")  # not kill the loop
+
+    async def _await_linger(self) -> None:
+        """Hold the batch open until linger elapses from the FIRST pending —
+        or a size/bytes trigger fires first (wake-on-full)."""
+        if self._linger_s <= 0 or self._first_pending_t is None:
+            return
+        deadline = self._first_pending_t + self._linger_s
+        while not self._batch_full.is_set() and self.state == "processing":
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            if not await self._batch_full.wait(remaining):
+                return
+
+    def _take_batch(self) -> Optional[_Batch]:
+        """Drain up to batch-max-records pendings into one commit unit."""
+        if not self._pending:
+            self._wake.clear()
+            self._batch_full.clear()
+            self._first_pending_t = None
+            return None
+        now = time.monotonic()
+        formed_at = self._first_pending_t if self._first_pending_t is not None else now
+        if len(self._pending) <= self._batch_max_records:
+            pendings, self._pending = self._pending, []
+        else:
+            pendings = self._pending[:self._batch_max_records]
+            del self._pending[:self._batch_max_records]
+        self._pending_bytes = max(
+            0, self._pending_bytes - sum(p.nbytes for p in pendings))
+        self._pending_room.set()
+        if self._pending:
+            self._first_pending_t = now  # leftover pendings restart the linger
+            if (len(self._pending) < self._batch_max_records
+                    and self._pending_bytes < self._batch_max_bytes):
+                self._batch_full.clear()
+        else:
+            self._wake.clear()
+            self._batch_full.clear()
+            self._first_pending_t = None
+        records = [r for p in pendings for r in p.records]
+        self._batch_counter += 1
+        if self.metrics is not None:
+            self.metrics.producer_linger_timer.record_ms((now - formed_at) * 1000.0)
+            self.metrics.producer_lane_pending.record(len(self._pending))
+        batch = _Batch(pendings, records, self._batch_counter)
+        # register the mid-commit join point NOW (not when the commit task
+        # first runs): between drain and task start a caller-timeout retry
+        # must find its request in _committing, or it would double-queue
+        batch.outcome = asyncio.get_running_loop().create_future()
+        for p in pendings:
+            self._committing[p.request_id] = batch.outcome
+            self._committing_aggs[p.aggregate_id] = \
+                self._committing_aggs.get(p.aggregate_id, 0) + 1
+        return batch
+
+    def _pipeline_capable(self) -> bool:
+        return (self._transactions_enabled
+                and not self._single_record_opt_in
+                and self._producer is not None
+                and hasattr(self._producer, "commit_pipelined"))
+
+    def _start_pipelined(self, batch: _Batch) -> None:
+        """Assign the batch's txn_seq and ship its Transact NOW (in dispatch
+        order, on the loop) — the await happens in the commit task. A dispatch
+        failure is recorded on the batch and surfaces through the shared
+        commit-failure ladder."""
+        try:
+            if getattr(self._producer, "in_transaction", False):
+                self._producer.abort()  # local buffer left by a failed dispatch
+            self._producer.begin()
+            for r in batch.records:
+                self._producer.send(r)
+            batch.handle = self._producer.commit_pipelined()
+        except Exception as exc:  # noqa: BLE001
+            batch.dispatch_error = exc
+
+    async def _dispatch(self, batch: _Batch) -> None:
+        """Acquire an in-flight slot, ship the commit, return to batching."""
+        await self._slots.acquire()
+        self._inflight += 1
+        if self._inflight > self.stats.inflight_peak:
+            self.stats.inflight_peak = self._inflight
+        if self.metrics is not None:
+            self.metrics.producer_in_flight.record(self._inflight)
+        if self._pipeline_capable():
+            self._start_pipelined(batch)
+        task = asyncio.ensure_future(self._commit_task(batch))
+        self._commit_tasks.add(task)
+        task.add_done_callback(self._commit_tasks.discard)
+
+    async def _commit_task(self, batch: _Batch) -> None:
+        try:
+            await self._publish_batch(batch)
+        except asyncio.CancelledError:
+            # publisher stopping: the drained batch's waiters must not hang
+            for p in batch.pendings:
+                fail_future(p.future,
+                            PublisherNotReadyError("publisher stopped"))
+            raise
+        except Exception as exc:  # noqa: BLE001 — post-commit bookkeeping bug
+            logger.exception("publish batch escaped on %s[%d]; failing its "
+                             "waiters", self.state_topic, self.partition)
+            # fail the waiters so the entity ladder retries with the same
+            # request_id. (If the commit actually landed before the escape,
+            # the broker's restart-durable txn_seq cache absorbs the replay.)
+            for p in batch.pendings:
+                fail_future(p.future, PublishFailedError(
+                    f"publish batch error: {exc}"))
+            try:
+                self.on_signal("surge.producer.flush-loop-error", "error")
+            except Exception:  # noqa: BLE001
+                logger.exception("on_signal failed")
+        finally:
+            self._inflight -= 1
+            if self.metrics is not None:
+                self.metrics.producer_in_flight.record(self._inflight)
+            self._slots.release()
+
+    async def _drain_inflight(self) -> None:
+        """Wait for every dispatched commit to resolve (retry/stop barrier)."""
+        while self._commit_tasks:
+            await asyncio.wait(list(self._commit_tasks))
+            await asyncio.sleep(0)  # let done-callbacks run
 
     async def _progress_loop(self) -> None:
         while True:
@@ -337,66 +659,138 @@ class PartitionPublisher:
         self.stats.in_flight = len(self._in_flight)
 
     async def flush_now(self) -> None:
-        """Immediate flush (test/shutdown hook; production path is the timed tick)."""
-        if self._pending and self.state == "processing":
-            batch, self._pending = self._pending, []
+        """Immediate flush (test/shutdown hook; production path is event-driven)."""
+        while self._pending and self.state == "processing":
+            await self._drain_inflight()
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if self._pipeline_capable():
+                self._start_pipelined(batch)
             await self._publish_batch(batch)
 
-    async def _publish_batch(self, batch: List[_Pending]) -> None:
-        records = [r for p in batch for r in p.records]
-        outcome: "asyncio.Future[Optional[Exception]]" = \
-            asyncio.get_running_loop().create_future()
-        for p in batch:
-            self._committing[p.request_id] = outcome
+    async def _publish_batch(self, batch: _Batch) -> None:
+        outcome = batch.outcome
+        if outcome is None or outcome.done():
+            # a RETRY attempt (the previous attempt resolved its outcome):
+            # fresh join point under the same request ids. The aggregate
+            # refcount stays as _take_batch counted it — the batch was never
+            # terminal in between.
+            outcome = asyncio.get_running_loop().create_future()
+            batch.outcome = outcome
+            for p in batch.pendings:
+                self._committing[p.request_id] = outcome
         # the flush-transaction span is a ROOT: one commit serves many pending
         # publishes, each already tracked by its own publisher.publish span
         span = None
         if self.tracer is not None:
             span = self.tracer.start_span("publisher.flush")
             span.set_attribute("partition", self.partition)
-            span.set_attribute("batch_publishes", len(batch))
-            span.set_attribute("batch_records", len(records))
+            span.set_attribute("batch_publishes", len(batch.pendings))
+            span.set_attribute("batch_records", len(batch.records))
         try:
             if span is None:
-                await self._publish_batch_inner(batch, records, outcome)
+                await self._publish_batch_inner(batch, outcome)
             else:
                 with span:
-                    await self._publish_batch_inner(batch, records, outcome)
+                    await self._publish_batch_inner(batch, outcome)
         finally:
             if not outcome.done():
                 outcome.set_result(RuntimeError("publish batch aborted"))
-            for p in batch:
-                self._committing.pop(p.request_id, None)
+            # unregister only when the batch is TERMINAL (committed, or its
+            # waiters failed); a stashed in-limbo batch keeps its entries —
+            # the slow path's retry-join runs BEFORE the committing-join, so
+            # a rejoining request still lands on the verbatim retry
+            if not any(b is batch for b in self._retry_batches):
+                for p in batch.pendings:
+                    self._committing.pop(p.request_id, None)
+                    n = self._committing_aggs.get(p.aggregate_id, 0)
+                    if n <= 1:
+                        self._committing_aggs.pop(p.aggregate_id, None)
+                    else:
+                        self._committing_aggs[p.aggregate_id] = n - 1
 
-    async def _publish_batch_inner(self, batch: List[_Pending],
-                                   records: List[LogRecord],
+    def _lane(self):
+        """The lane's single commit thread: producer calls stay strictly
+        ordered while fsync-heavy commits run OFF the event loop, letting
+        other partitions' lanes (and the loop itself) proceed."""
+        if self._lane_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._lane_pool = ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix=f"surge-commit-{self.partition}")
+        return self._lane_pool
+
+    def _commit_txn_blocking(self, batch: _Batch) -> List[LogRecord]:
+        if getattr(self._producer, "in_transaction", False):
+            self._producer.abort()  # buffer left open by a failed attempt
+        self._producer.begin()
+        for r in batch.records:
+            self._producer.send(r)
+        return list(self._producer.commit())
+
+    def _commit_nontxn_blocking(self, batch: _Batch) -> List[LogRecord]:
+        # per-record appends: a mid-batch failure must not re-append any
+        # already-written record on the entity's same-request_id retry, so
+        # the appended records themselves are kept per request and retries
+        # resume after them (contributing the full list to `committed` so
+        # the offset-alignment loop stays 1:1 with p.records)
+        committed: List[LogRecord] = []
+        for p in batch.pendings:
+            done = self._partial_records.setdefault(p.request_id, [])
+            self._partial_touched[p.request_id] = time.time()
+            for i in range(len(done), len(p.records)):
+                done.append(self._producer.send_immediate(p.records[i]))
+            committed.extend(done)
+        # every append landed: the batch is durable, drop the resume state
+        for p in batch.pendings:
+            self._partial_records.pop(p.request_id, None)
+            self._partial_touched.pop(p.request_id, None)
+        return committed
+
+    async def _commit_batch(self, batch: _Batch) -> List[LogRecord]:
+        """Route one batch to its commit path; raises what the commit raised."""
+        if batch.dispatch_error is not None:
+            exc, batch.dispatch_error = batch.dispatch_error, None
+            raise exc
+        loop = asyncio.get_running_loop()
+        if not self._transactions_enabled:
+            return await loop.run_in_executor(
+                self._lane(), self._commit_nontxn_blocking, batch)
+        if self._single_record_opt_in and len(batch.records) == 1:
+            return [await loop.run_in_executor(
+                self._lane(), self._producer.send_immediate, batch.records[0])]
+        h = batch.handle
+        if h is not None:
+            if h.future.done() and (h.future.cancelled()
+                                    or h.future.exception() is not None):
+                # verbatim retry: same txn_seq on the same producer. A
+                # producer re-opened since (new epoch after fencing) cannot
+                # reuse the old token's seq — re-dispatch fresh below; the
+                # broker's reopen absorption / numbering-past-pending-seqs
+                # keeps a landed commit from doubling.
+                if getattr(h, "producer", None) is self._producer:
+                    self._producer.retry_pipelined(h)
+                else:
+                    batch.handle = None
+                    return await self._commit_batch(batch)
+            return await asyncio.wrap_future(batch.handle.future)
+        if self._pipeline_capable():
+            self._start_pipelined(batch)
+            if batch.dispatch_error is not None:
+                exc, batch.dispatch_error = batch.dispatch_error, None
+                raise exc
+            return await asyncio.wrap_future(batch.handle.future)
+        return await loop.run_in_executor(
+            self._lane(), self._commit_txn_blocking, batch)
+
+    async def _publish_batch_inner(self, batch: _Batch,
                                    outcome: "asyncio.Future[Optional[Exception]]") -> None:
+        records = batch.records
         t0 = time.perf_counter()
         try:
-            if not self._transactions_enabled:
-                # per-record appends: a mid-batch failure must not re-append any
-                # already-written record on the entity's same-request_id retry, so
-                # the appended records themselves are kept per request and retries
-                # resume after them (contributing the full list to `committed` so
-                # the offset-alignment loop below stays 1:1 with p.records)
-                committed = []
-                for p in batch:
-                    done = self._partial_records.setdefault(p.request_id, [])
-                    self._partial_touched[p.request_id] = time.time()
-                    for i in range(len(done), len(p.records)):
-                        done.append(self._producer.send_immediate(p.records[i]))
-                    committed.extend(done)
-                # every append landed: the batch is durable, drop the resume state
-                for p in batch:
-                    self._partial_records.pop(p.request_id, None)
-                    self._partial_touched.pop(p.request_id, None)
-            elif self._single_record_opt_in and len(records) == 1:
-                committed = [self._producer.send_immediate(records[0])]
-            else:
-                self._producer.begin()
-                for r in records:
-                    self._producer.send(r)
-                committed = list(self._producer.commit())
+            committed = await self._commit_batch(batch)
         except ProducerFencedError as exc:
             self.stats.fences += 1
             if self.metrics is not None:
@@ -409,10 +803,10 @@ class PartitionPublisher:
                 # replicated/durable dedup absorbs a landed commit
                 self._stash_or_exhaust(batch, exc)
             else:
-                for p in batch:
+                for p in batch.pendings:
                     fail_future(p.future, PublishFailedError(
                         f"publisher for partition {self.partition} was fenced"))
-            await self._handle_fenced()
+            self._note_fenced()
             return
         except Exception as exc:  # noqa: BLE001 — transport failure: outcome unknown
             self.stats.batches_failed += 1
@@ -428,64 +822,96 @@ class PartitionPublisher:
                 self._stash_or_exhaust(batch, exc)
             else:
                 # non-transactional mode has its own per-record resume state
-                for p in batch:
+                for p in batch.pendings:
                     fail_future(p.future, PublishFailedError(str(exc)))
             return
 
         elapsed = time.perf_counter() - t0
         if self.metrics is not None:
             self.metrics.flush_timer.record_ms(elapsed * 1000.0)
+            self.metrics.producer_batch_records.record(len(records))
+            self.metrics.producer_batch_commits.record()
+        if len(records) > self.stats.max_batch_records:
+            self.stats.max_batch_records = len(records)
         if elapsed > self._slow_txn_s:
             logger.warning("slow publish transaction: %.3fs on %s[%d]",
                            elapsed, self.state_topic, self.partition)
         # in-flight tracking: the max state-topic offset per aggregate in this commit
         by_index = iter(committed)
         now = time.time()
-        for p in batch:
+        for p in batch.pendings:
             max_state_off = None
             for _ in p.records:
                 rec = next(by_index)
                 if rec.topic == self.state_topic:
                     max_state_off = rec.offset if max_state_off is None else max(max_state_off, rec.offset)
             if max_state_off is not None:
-                self._in_flight[p.aggregate_id] = max_state_off
+                cur = self._in_flight.get(p.aggregate_id)
+                if cur is None or max_state_off > cur:
+                    self._in_flight[p.aggregate_id] = max_state_off
             self._completed[p.request_id] = now
             resolve_future(p.future, None)
         outcome.set_result(None)
-        if batch is self._retry_batch:
-            self._retry_batch = None
-            self._retry_attempts = 0
+        try:
+            self._retry_batches.remove(batch)
+        except ValueError:
+            pass
         self.stats.flushes += 1
         self.stats.records_published += len(records)
         self.stats.in_flight = len(self._in_flight)
 
-    def _stash_or_exhaust(self, batch: List[_Pending], exc: Exception) -> None:
+    def _note_fenced(self) -> None:
+        """Mark the lane fenced; the flush loop's next tick runs the
+        re-initialize-or-shutdown ladder (one reinit even when several
+        pipelined commits observe the fence concurrently)."""
+        if self.state == "processing":
+            self.state = "fenced"
+            self._ready.clear()
+
+    def _stash_or_exhaust(self, batch: _Batch, exc: Exception) -> None:
         """Keep an unknown-outcome batch for verbatim retry, bounded: after
         publish-retry-max attempts its waiters fail (the entity ladder takes
         over) and the batch is dropped — a deterministically-failing batch
-        must not block the partition forever."""
-        if self._retry_batch is None:
-            self._retry_batch = batch
-            self._retry_attempts = 1
-        elif batch is not self._retry_batch:
-            # a DIFFERENT batch failed while one is already in limbo (e.g. a
-            # flush_now drain): only one verbatim-retry slot exists — fail the
-            # newcomer's waiters so their entities retry, and leave the
-            # in-limbo batch's accounting untouched
-            for p in batch:
-                fail_future(p.future, PublishFailedError(str(exc)))
-            return
+        must not block the partition forever. Up to a pipelined window of
+        batches can be in limbo at once; they retry in dispatch order."""
+        if not any(b is batch for b in self._retry_batches):
+            if len(self._retry_batches) >= self._max_in_flight + 1:
+                # more limbo than the pipeline window can produce (e.g. a
+                # flush_now drain during limbo): fail the newcomer's waiters
+                # so their entities retry, leaving the window's accounting
+                # untouched
+                for p in batch.pendings:
+                    fail_future(p.future, PublishFailedError(str(exc)))
+                return
+            batch.attempts = 1
+            at = 0
+            for i, b in enumerate(self._retry_batches):
+                if b.index > batch.index:
+                    break
+                at = i + 1
+            self._retry_batches.insert(at, batch)
         else:
-            self._retry_attempts += 1
-        if self._retry_attempts > self._retry_max:
+            batch.attempts += 1
+        if batch.attempts > self._retry_max:
             logger.error(
                 "publish batch on %s[%d] failed %d verbatim retries (%s); "
                 "failing its waiters", self.state_topic, self.partition,
-                self._retry_attempts, exc)
-            for p in batch:
+                batch.attempts, exc)
+            for p in batch.pendings:
                 fail_future(p.future, PublishFailedError(str(exc)))
-            self._retry_batch = None
-            self._retry_attempts = 0
+            try:
+                self._retry_batches.remove(batch)
+            except ValueError:
+                pass
+            if batch.handle is not None and getattr(batch.handle, "seq", 0):
+                # the dropped batch CONSUMED a txn_seq at dispatch; abandoning
+                # it would leave a permanent hole the broker's in-order gate
+                # blocks every later seq behind. Force the lane through the
+                # re-initialize ladder: the re-opened producer resumes its
+                # numbering from the broker's acked/applied frontier, closing
+                # the hole (and later in-limbo batches re-dispatch fresh on
+                # the new producer).
+                self._note_fenced()
         else:
             self.on_signal("surge.producer.publish-retry", "warning")
 

@@ -23,6 +23,10 @@ from surge_tpu.engine.partition import (
     partition_by_up_to_colon,
     partition_for_key,
 )
+# module-level, NOT inside deliver(): a per-message import statement costs a
+# sys.modules lookup on every delivery even when tracing is active, and the
+# tracer=None path must stay a single `is None` check
+from surge_tpu.tracing import inject_context
 
 # region_creator(partition) -> a Shard-like object (deliver(agg_id, env) + async stop())
 RegionCreator = Callable[[int], object]
@@ -74,8 +78,6 @@ class RouterBase(Controllable):
         """deliverMessage:205-222 — resolve owner, local-or-remote dispatch."""
         span = None
         if self.tracer is not None:
-            from surge_tpu.tracing import inject_context
-
             span = self.tracer.start_span(
                 f"{self.health_name}.deliver", headers=env.headers)
             span.set_attribute("aggregate_id", aggregate_id)

@@ -14,6 +14,10 @@ from typing import Callable, Dict, List
 
 from surge_tpu.common import fail_future, logger
 from surge_tpu.engine.entity import AggregateEntity, Envelope
+# module-level, NOT inside deliver(): a per-message import statement costs a
+# sys.modules lookup on every delivery even when tracing is active, and the
+# tracer=None path must stay a single `is None` check
+from surge_tpu.tracing import inject_context
 
 # factory(aggregate_id, on_passivate, on_stopped) -> started-or-startable entity
 EntityFactory = Callable[..., AggregateEntity]
@@ -40,8 +44,6 @@ class Shard:
     def deliver(self, aggregate_id: str, env: Envelope) -> None:
         span = None
         if self.tracer is not None:
-            from surge_tpu.tracing import inject_context
-
             # the Shard hop's span (getOrCreateEntity + mailbox handoff);
             # context re-injected so the entity's receive span chains under it
             span = self.tracer.start_span("shard.deliver", headers=env.headers)

@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import threading
 import time
+from concurrent.futures import Future as _ConcurrentFuture
 from typing import Dict, List, Mapping, Optional, Sequence
 
 import grpc
@@ -40,6 +41,23 @@ def _raise_for(reply: pb.TxnReply) -> None:
     raise RuntimeError(f"log server error: {reply.error}")
 
 
+class PipelinedCommit:
+    """One in-flight pipelined transaction: the txn_seq assigned at dispatch,
+    the records it carries, and the concurrent future its Transact resolves.
+    ``retry()``-by-the-publisher resends the SAME seq + records verbatim so a
+    commit whose reply was lost is answered from the broker's dedup cache
+    instead of being appended twice."""
+
+    __slots__ = ("seq", "records", "future", "producer")
+
+    def __init__(self, seq: int, records: List[LogRecord],
+                 producer: "GrpcTxnProducer") -> None:
+        self.seq = seq
+        self.records = records
+        self.producer = producer
+        self.future: "_ConcurrentFuture" = _ConcurrentFuture()
+
+
 class GrpcTxnProducer:
     """Client half of a server-side transactional producer (one token).
 
@@ -48,6 +66,14 @@ class GrpcTxnProducer:
     the server answers a replayed sequence from its cached reply instead of
     appending the transaction twice (the Kafka idempotent-producer role,
     KafkaProducerActorImpl.scala:161-165 `enable.idempotence`).
+
+    ``commit_pipelined`` is the bounded-window variant (the
+    max.in.flight.requests.per.connection role): the seq is assigned at
+    dispatch and the Transact ships from the transport's pipeline pool
+    WITHOUT waiting for earlier replies — the broker's per-producer in-order
+    apply gate sequences them, and its dedup window (not just the last seq)
+    answers replays anywhere in the window. The caller bounds how many
+    dispatches it keeps un-awaited (``surge.producer.max-in-flight``).
     """
 
     def __init__(self, transport: "GrpcLogTransport", token: int,
@@ -120,6 +146,25 @@ class GrpcTxnProducer:
         _raise_for(reply)
         return [msg_to_record(m) for m in reply.records]
 
+    def commit_pipelined(self) -> PipelinedCommit:
+        """Dispatch the buffered transaction without awaiting the reply."""
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        records, self._buffer = self._buffer, None
+        seq = self._next_seq
+        self._next_seq += 1
+        handle = PipelinedCommit(seq, list(records), self)
+        self._transport._submit_transact(self, handle)
+        return handle
+
+    def retry_pipelined(self, handle: PipelinedCommit) -> PipelinedCommit:
+        """Resend a failed pipelined commit VERBATIM (same seq, same records)."""
+        if not handle.future.done():
+            raise TransactionStateError("pipelined commit still in flight")
+        handle.future = _ConcurrentFuture()
+        self._transport._submit_transact(self, handle)
+        return handle
+
     def abort(self) -> None:
         if self._buffer is None:
             raise TransactionStateError("no open transaction")
@@ -181,6 +226,10 @@ class GrpcLogTransport:
         self._auto_create_partitions = auto_create_partitions
         self._topics: Dict[str, TopicSpec] = {}  # local spec cache
         self._lock = threading.Lock()
+        # pipelined Transact dispatch pool (sync stubs block a thread per
+        # in-flight call): sized for several lanes' windows; lazy so
+        # non-pipelining users never pay the threads
+        self._pipeline_pool = None
         self._connect(0)
 
     def _connect(self, index: int) -> None:
@@ -296,6 +345,35 @@ class GrpcLogTransport:
         return GrpcTxnProducer(self, reply.producer_token,
                                generation=self.generation,
                                next_seq=reply.last_txn_seq + 1)
+
+    def _submit_transact(self, producer: GrpcTxnProducer,
+                         handle: PipelinedCommit) -> None:
+        """Ship one pipelined commit from the pipeline pool; the handle's
+        future resolves with the committed records (offsets assigned) or the
+        same exceptions the synchronous ``commit()`` raises."""
+        if self._pipeline_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._lock:
+                if self._pipeline_pool is None:
+                    self._pipeline_pool = ThreadPoolExecutor(
+                        max_workers=16, thread_name_prefix="surge-txn-pipe")
+        self._pipeline_pool.submit(self._pipelined_call, producer, handle)
+
+    def _pipelined_call(self, producer: GrpcTxnProducer,
+                        handle: PipelinedCommit) -> None:
+        try:
+            reply = self._transact(producer._token, "commit", handle.records,
+                                   seq=handle.seq,
+                                   generation=producer._generation)
+            producer._check_fence(reply)
+            _raise_for(reply)
+            handle.future.set_result([msg_to_record(m) for m in reply.records])
+        except ProducerFencedError as exc:
+            producer._fenced = True
+            handle.future.set_exception(exc)
+        except BaseException as exc:  # noqa: BLE001 — surface to the awaiter
+            handle.future.set_exception(exc)
 
     def _transact(self, token: int, op: str, records: Sequence[LogRecord],
                   seq: int = 0, attempts: int = 4,
@@ -440,4 +518,7 @@ class GrpcLogTransport:
                 await asyncio.sleep(0.1)
 
     def close(self) -> None:
+        if self._pipeline_pool is not None:
+            self._pipeline_pool.shutdown(wait=False)
+            self._pipeline_pool = None
         self._channel.close()

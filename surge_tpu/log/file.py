@@ -32,12 +32,28 @@ orphaned file that recovery sweeps. Journal lines written after the swap carry
 positions in the new file (appends continue at its end), so recovery uses the journal
 frontier when it is ahead of the manifest's and the manifest frontier otherwise.
 
-**Crash atomicity.** A transaction is durable iff its journal line is. Data blocks are
-written and fsynced *before* the journal line, so on recovery every journaled block is
-present; segment bytes beyond the last journaled end position (a torn write from a
-crashed commit) are truncated away. This mirrors the role Kafka's transaction markers
-play for read_committed consumers (SurgeStateStoreConsumer.scala:38) with a
-single-node journal instead of a two-phase broker protocol.
+**Crash atomicity.** A transaction is durable iff its journal line is. Small data
+blocks (up to ``_EMBED_MAX_BYTES`` compressed) are EMBEDDED in their journal line
+(base64), so the journal is a self-contained WAL for the command path: the segment
+write stays in the page cache (no per-file fsync) and recovery backfills any
+missing or garbled segment tail from the journaled payloads. Oversized blocks
+(bulk loads) keep the old discipline — data fsynced *before* the journal line.
+Segment bytes beyond the last journaled end position (a torn write from a crashed
+commit) are truncated away; a journaled position whose segment bytes are absent or
+corrupt is re-materialized from the embedded payload, and only clamped away when
+no payload exists (a pre-WAL journal, or an oversized block lost under
+``fsync="none"``). This mirrors the role Kafka's transaction markers play for
+read_committed consumers (SurgeStateStoreConsumer.scala:38) with a single-node
+journal instead of a two-phase broker protocol.
+
+**Group commit.** Under ``fsync="commit"`` the journal fsync — the only fsync on
+the small-transaction path — is a shared round: concurrent committers (the
+per-partition publisher lanes, or a broker's handler threads) elect a leader that
+fsyncs once for every journal line written so far; the rest wait for the round
+covering their line. One ~ms fsync therefore acknowledges a whole group of
+transactions (the Aurora-style WAL group commit the command path's latency
+budget rests on), instead of each transaction paying fsyncs for every touched
+segment file plus the journal while holding the log lock.
 
 Producers reuse :class:`InMemoryTxnProducer` — the transactional/fencing protocol is
 identical; only ``_append`` differs (journaled disk commit vs list append).
@@ -46,18 +62,26 @@ identical; only ``_append`` differs (journaled disk commit vs list append).
 from __future__ import annotations
 
 import asyncio
+import base64
 import json
 import os
 import threading
 import time
 import zlib
 from collections import OrderedDict
+from concurrent.futures import Future as ConcurrentFuture
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from surge_tpu.common import logger
 from surge_tpu.log import segment as seg
 from surge_tpu.log.memory import InMemoryTxnProducer, LogBase
 from surge_tpu.log.transport import LogRecord, TopicSpec
+
+
+#: compressed blocks at most this large ride inside their journal line (the
+#: WAL fast path: no per-segment-file fsync). Bigger blocks (bulk loads) fsync
+#: their segment file before the journal line, exactly as before.
+_EMBED_MAX_BYTES = 256 << 10
 
 
 def _fsync_dir(path: str) -> None:
@@ -80,7 +104,12 @@ class _Partition:
         self.path = path
         self.blocks: List[Tuple[int, int, int]] = []  # (base_offset, file_pos, count)
         self.end_offset = 0
-        self.end_pos = 0  # durable end of the segment file
+        self.end_pos = 0  # applied end of the segment file
+        #: offsets < this survived a journal fsync round — the read_committed
+        #: frontier readers see (applied-but-unsynced records stay invisible,
+        #: like records of an open Kafka transaction); == end_offset under
+        #: fsync="none" and immediately after recovery
+        self.durable_offset = 0
         self.gen = 0  # compaction generation (bumped on every segment swap)
         self.file = None  # append handle, opened lazily
         # decoded-block LRU keyed by file_pos: a tailing indexer re-reads the last
@@ -117,8 +146,24 @@ class FileLog(LogBase):
         self._append_events: Dict[Tuple[str, int], asyncio.Event] = {}
         os.makedirs(os.path.join(root, "data"), exist_ok=True)
         self._journal_path = os.path.join(root, "commits.log")
+        # group-commit round state (one shared journal-fsync worker):
+        # _gc_written = journal bytes successfully written+flushed (candidates
+        # for the next round), _gc_durable = bytes covered by a completed
+        # fsync, _gc_waiters = (target, concurrent.Future) pairs resolved as
+        # rounds complete. ONE worker thread fsyncs for everyone — blocking
+        # committers wait their future, pipelined committers await it — so a
+        # whole wave of transactions across every partition lane costs one
+        # fsync and one thread handoff. Lock order: the main log lock may
+        # acquire _gc_cv's lock, never the reverse.
+        self._gc_cv = threading.Condition()
+        self._gc_written = 0
+        self._gc_durable = 0
+        self._gc_waiters: List[Tuple[int, "ConcurrentFuture"]] = []
+        self._gc_thread: Optional[threading.Thread] = None
+        self._gc_stop = False
         self._recover()
         self._journal = open(self._journal_path, "ab")
+        self._gc_written = self._gc_durable = self._journal.tell()
 
     # -- recovery -------------------------------------------------------------------------
 
@@ -157,8 +202,13 @@ class FileLog(LogBase):
 
         # journal scan: the durable frontier of every partition. A torn tail line
         # (crash mid-journal-write) is truncated away so the reopened append handle
-        # never concatenates the next entry onto garbage.
+        # never concatenates the next entry onto garbage. WAL-mode lines carry
+        # their data blocks inline ("blk", base64 per touched partition) — those
+        # payloads are collected by segment-file start position so the
+        # per-partition pass below can re-materialize segment bytes the page
+        # cache lost (the data files are no longer fsynced per commit).
         durable: Dict[Tuple[str, int], Tuple[int, int]] = {}  # -> (end_offset, end_pos)
+        payloads: Dict[Tuple[str, int], Dict[int, str]] = {}  # -> {start_pos: b64}
         if os.path.exists(self._journal_path):
             good_end = 0
             with open(self._journal_path, "rb") as f:
@@ -170,8 +220,16 @@ class FileLog(LogBase):
                     if not line.endswith(b"\n"):
                         break  # complete JSON but no newline: still a torn write
                     good_end += len(line)
-                    for topic, p, base, count, end_pos in entry["parts"]:
+                    blks = entry.get("blk") or [None] * len(entry["parts"])
+                    for (topic, p, base, count, end_pos), b64 in zip(
+                            entry["parts"], blks):
                         durable[(topic, p)] = (base + count, end_pos)
+                        if b64:
+                            declen = (len(b64) * 3) // 4 - (
+                                2 if b64.endswith("==") else
+                                1 if b64.endswith("=") else 0)
+                            payloads.setdefault((topic, p), {})[
+                                end_pos - declen] = b64
             if os.path.getsize(self._journal_path) > good_end:
                 with open(self._journal_path, "r+b") as f:
                     f.truncate(good_end)
@@ -185,12 +243,19 @@ class FileLog(LogBase):
         for key, part in self._parts.items():
             end_offset, end_pos = durable.get(key, (0, 0))
             entry = self._manifest.get(key[0], {}).get(str(key[1]))
-            if entry is not None and int(entry["end_offset"]) >= end_offset:
-                # no post-swap appends journaled: the journal's positions refer
-                # to the pre-compaction file — the manifest frontier (recorded
-                # at swap time against the live generational file) supersedes
-                end_offset = int(entry["end_offset"])
-                end_pos = int(entry["end_pos"])
+            min_backfill_pos = 0
+            if entry is not None:
+                if int(entry["end_offset"]) >= end_offset:
+                    # no post-swap appends journaled: the journal's positions
+                    # refer to the pre-compaction file — the manifest frontier
+                    # (recorded at swap time against the live generational
+                    # file) supersedes
+                    end_offset = int(entry["end_offset"])
+                    end_pos = int(entry["end_pos"])
+                # journaled payloads BELOW the swap frontier describe the
+                # pre-compaction file; splicing them into the generational
+                # file would corrupt it
+                min_backfill_pos = int(entry["end_pos"])
             size = os.path.getsize(part.path) if os.path.exists(part.path) else 0
             if size > end_pos:  # torn tail from a crashed commit
                 with open(part.path, "r+b") as f:
@@ -202,21 +267,47 @@ class FileLog(LogBase):
                     data = f.read(min(end_pos, size))
             pos = 0
             good_offset = 0
+            repaired = False
             part.blocks = []
-            while pos < len(data):
+            embedded = payloads.get(key, {})
+            backfilled: set = set()  # positions already spliced (loop guard)
+            while pos < end_pos:
                 try:
                     codec, base, count, unlen, plen, crc, start = seg.read_block_header(
                         data, pos)
-                except seg.BlockCorruptError:
-                    break
-                # unordered writeback can persist a block's header page but garble
-                # its payload — verify the CRC now so the clamp catches it here
-                # rather than a reader crashing on it later
-                if zlib.crc32(data[start:start + plen]) & 0xFFFFFFFF != crc:
-                    break
+                    # unordered writeback can persist a block's header page but
+                    # garble its payload — verify the CRC now so the clamp/
+                    # backfill catches it here rather than a reader crashing
+                    if zlib.crc32(data[start:start + plen]) & 0xFFFFFFFF != crc:
+                        raise seg.BlockCorruptError("payload crc mismatch")
+                except (seg.BlockCorruptError, IndexError):
+                    # absent or garbled segment bytes at a journaled position:
+                    # re-materialize the block from its journal payload (the
+                    # WAL commit mode embeds it); the splice preserves every
+                    # later block's position because the payload's length IS
+                    # the block's on-disk length
+                    b64 = (embedded.get(pos)
+                           if pos >= min_backfill_pos and pos not in backfilled
+                           else None)
+                    if b64 is None:
+                        break  # pre-WAL journal or oversized block: clamp
+                    backfilled.add(pos)
+                    block = base64.b64decode(b64)
+                    data = data[:pos] + block + data[pos + len(block):]
+                    repaired = True
+                    continue
                 part.blocks.append((base, pos, count))
                 good_offset = base + count
                 pos = start + plen
+            if repaired:
+                with open(part.path, "wb") as f:
+                    f.write(data[:pos])
+                    f.flush()
+                    if self._fsync:
+                        os.fsync(f.fileno())
+                logger.info("backfilled %s[%d] to pos %d from journal payloads",
+                            key[0], key[1], pos)
+                size = pos
             if pos < end_pos:  # journal ran ahead of the data: clamp to intact prefix
                 part.end_offset, part.end_pos = good_offset, pos
                 if size > pos:
@@ -224,6 +315,9 @@ class FileLog(LogBase):
                         f.truncate(pos)
             else:
                 part.end_offset, part.end_pos = end_offset, end_pos
+            # everything recovered came from a durable journal: the
+            # read_committed frontier restarts at the applied end
+            part.durable_offset = part.end_offset
 
     def _seg_path(self, topic: str, partition: int) -> str:
         return os.path.join(self.root, "data", f"{topic}-{partition}.seg")
@@ -289,91 +383,221 @@ class FileLog(LogBase):
 
     # -- producers (protocol shared with the in-memory log) -------------------------------
 
-    def transactional_producer(self, transactional_id: str) -> InMemoryTxnProducer:
+    def transactional_producer(self, transactional_id: str) -> "FileTxnProducer":
         with self._lock:
             epoch = self._next_epoch(transactional_id)
             self._persist_json("epochs.json", self._epochs)
-            return InMemoryTxnProducer(self, transactional_id, epoch)
+            return FileTxnProducer(self, transactional_id, epoch)
 
     def _append(self, records: Sequence[LogRecord]) -> List[LogRecord]:
         """One transaction: per-partition blocks + one journal line. Atomic under
         the commit journal (see module docstring)."""
+        with self._lock:
+            out, my_target, touched, marks = self._append_locked(records)
+        return self._append_finish(out, my_target, touched, marks)
+
+    def _append_fenced(self, transactional_id: str, epoch: int,
+                       records: Sequence[LogRecord]) -> List[LogRecord]:
+        # epoch check + append atomic under the lock; the group-commit fsync
+        # round runs OUTSIDE it (LogBase._append_fenced docstring) so readers
+        # and other committers never queue behind the disk
+        with self._lock:
+            self._check_epoch(transactional_id, epoch)
+            out, my_target, touched, marks = self._append_locked(records)
+        return self._append_finish(out, my_target, touched, marks)
+
+    def _append_finish(self, out: List[LogRecord], my_target: int,
+                       touched, marks) -> List[LogRecord]:
+        if touched:
+            # durability outside the log lock: join the group-commit round
+            # covering this transaction's journal line (one shared fsync acks
+            # the whole group) while other committers write theirs
+            if self._fsync:
+                self._commit_sync(my_target)
+            self._mark_durable(marks)
+            self._notify_append(touched)
+        return out
+
+    def _mark_durable(self, marks) -> None:
+        """Advance the read_committed frontier of every partition a (now
+        durable) transaction touched — readers see the records only from
+        here on, so a crash that loses an unsynced journal line can never
+        un-happen something a consumer already observed."""
+        with self._lock:
+            for part, end in marks:
+                if end > part.durable_offset:
+                    part.durable_offset = end
+
+    def _append_locked(self, records: Sequence[LogRecord]):
+        """Phase 1 of one transaction (caller holds the log lock): assign
+        offsets, write blocks + the journal line (page cache), stage indexes.
+        Returns (records_with_offsets, journal_target, touched_partitions)."""
         if not records:
-            return []
+            return [], 0, set(), []
         out: List[LogRecord] = []
         now = time.time()
-        with self._lock:
-            grouped: Dict[Tuple[str, int], List[LogRecord]] = {}
-            for r in records:
-                self.topic(r.topic)
-                key = (r.topic, r.partition)
-                if key not in self._parts:
-                    raise KeyError(f"{r.topic}[{r.partition}] does not exist")
-                assigned = LogRecord(
-                    topic=r.topic, key=r.key, value=r.value, partition=r.partition,
-                    headers=dict(r.headers),
-                    offset=self._parts[key].end_offset + len(grouped.get(key, [])),
-                    timestamp=now)
-                grouped.setdefault(key, []).append(assigned)
-                out.append(assigned)
+        grouped: Dict[Tuple[str, int], List[LogRecord]] = {}
+        for r in records:
+            self.topic(r.topic)
+            key = (r.topic, r.partition)
+            if key not in self._parts:
+                raise KeyError(f"{r.topic}[{r.partition}] does not exist")
+            assigned = LogRecord(
+                topic=r.topic, key=r.key, value=r.value, partition=r.partition,
+                headers=dict(r.headers),
+                offset=self._parts[key].end_offset + len(grouped.get(key, [])),
+                timestamp=now)
+            grouped.setdefault(key, []).append(assigned)
+            out.append(assigned)
 
-            entry_parts = []
-            # (partition, base_offset, old_pos, new_pos, count)
-            staged: List[Tuple[_Partition, int, int, int, int]] = []
-            journal_pos = self._journal.tell()
-            try:
-                for (topic, p), recs in grouped.items():
-                    part = self._parts[(topic, p)]
-                    base = part.end_offset
-                    block = seg.encode_block(recs, base)
-                    if part.file is None:
-                        existed = os.path.exists(part.path)
-                        part.file = open(part.path, "ab")
-                        if self._fsync and not existed:
-                            _fsync_dir(os.path.dirname(part.path))
-                    part.file.write(block)
-                    part.file.flush()
+        entry_parts = []
+        entry_blocks = []  # base64 payloads (None for oversized blocks)
+        # (partition, base_offset, old_pos, new_pos, count)
+        staged: List[Tuple[_Partition, int, int, int, int]] = []
+        journal_pos = self._journal.tell()
+        try:
+            for (topic, p), recs in grouped.items():
+                part = self._parts[(topic, p)]
+                base = part.end_offset
+                block = seg.encode_block(recs, base)
+                if part.file is None:
+                    existed = os.path.exists(part.path)
+                    part.file = open(part.path, "ab")
+                    if self._fsync and not existed:
+                        _fsync_dir(os.path.dirname(part.path))
+                part.file.write(block)
+                part.file.flush()
+                if len(block) <= _EMBED_MAX_BYTES:
+                    # WAL fast path: the journal line carries the block, so
+                    # the segment write may stay in the page cache —
+                    # recovery re-materializes it from the payload
+                    entry_blocks.append(
+                        base64.b64encode(block).decode("ascii"))
+                else:
+                    entry_blocks.append(None)
                     if self._fsync:
                         os.fsync(part.file.fileno())
-                    new_pos = part.end_pos + len(block)
-                    entry_parts.append([topic, p, base, len(recs), new_pos])
-                    staged.append((part, base, part.end_pos, new_pos, len(recs)))
+                new_pos = part.end_pos + len(block)
+                entry_parts.append([topic, p, base, len(recs), new_pos])
+                staged.append((part, base, part.end_pos, new_pos, len(recs)))
 
-                # the commit point: journal line durable => transaction durable
-                self._journal.write((json.dumps({"parts": entry_parts}) + "\n").encode())
-                self._journal.flush()
-                if self._fsync:
-                    os.fsync(self._journal.fileno())
-            except BaseException:
-                # physical rollback: a failed commit must leave no orphan block below
-                # a later transaction's journaled frontier (recovery would resurrect
-                # it as committed data with overlapping offsets). Truncate every
-                # partition the transaction touched — including the one whose own
-                # write/flush raised, which was never staged but may hold torn bytes
-                # past its durable end_pos.
-                for key in grouped:
-                    part = self._parts[key]
-                    if part.file is not None:
-                        part.file.truncate(part.end_pos)
-                        part.file.seek(0, os.SEEK_END)
-                # a journal flush that failed after a partial OS write leaves a torn
-                # half-line that would make recovery discard every LATER committed
-                # transaction — roll the journal back to its pre-transaction length
-                try:
-                    self._journal.truncate(journal_pos)
-                    self._journal.seek(0, os.SEEK_END)
-                except OSError:
-                    logger.exception("journal rollback failed; commits.log may hold "
-                                     "a torn line until restart")
-                raise
+            # the commit point: journal line durable => transaction durable
+            self._journal.write((json.dumps(
+                {"parts": entry_parts, "blk": entry_blocks}) + "\n").encode())
+            self._journal.flush()
+            my_target = self._journal.tell()
+            with self._gc_cv:
+                if my_target > self._gc_written:
+                    self._gc_written = my_target
+        except BaseException:
+            # physical rollback: a failed commit must leave no orphan block below
+            # a later transaction's journaled frontier (recovery would resurrect
+            # it as committed data with overlapping offsets). Truncate every
+            # partition the transaction touched — including the one whose own
+            # write/flush raised, which was never staged but may hold torn bytes
+            # past its durable end_pos.
+            for key in grouped:
+                part = self._parts[key]
+                if part.file is not None:
+                    part.file.truncate(part.end_pos)
+                    part.file.seek(0, os.SEEK_END)
+            # a journal flush that failed after a partial OS write leaves a torn
+            # half-line that would make recovery discard every LATER committed
+            # transaction — roll the journal back to its pre-transaction length
+            try:
+                self._journal.truncate(journal_pos)
+                self._journal.seek(0, os.SEEK_END)
+            except OSError:
+                logger.exception("journal rollback failed; commits.log may hold "
+                                 "a torn line until restart")
+            raise
 
-            touched = set(grouped)
-            for part, base, old_pos, new_pos, count in staged:
-                part.blocks.append((base, old_pos, count))
-                part.end_pos = new_pos
-                part.end_offset = base + count
-        self._notify_append(touched)
-        return out
+        touched = set(grouped)
+        for part, base, old_pos, new_pos, count in staged:
+            part.blocks.append((base, old_pos, count))
+            part.end_pos = new_pos
+            part.end_offset = base + count
+        return (out, my_target, touched,
+                [(part, base + count) for part, base, _op, _np, count
+                 in staged])
+
+    def _commit_sync(self, my_target: int) -> None:
+        """Block until journal bytes ``< my_target`` are fsynced (one shared
+        round per group of committers). A round's fsync failure raises into
+        every commit it covered (the publisher retry ladder owns recovery)."""
+        self._enqueue_sync(my_target).result()
+
+    def _enqueue_sync(self, my_target: int) -> "ConcurrentFuture":
+        """Register a durability waiter with the group-sync worker; the
+        returned future resolves (None) once a completed fsync covers
+        ``my_target``, or carries the round's exception."""
+        fut: "ConcurrentFuture" = ConcurrentFuture()
+        with self._gc_cv:
+            if self._gc_durable >= my_target:
+                fut.set_result(None)
+                return fut
+            if self._gc_stop:
+                fut.set_exception(RuntimeError("log closed"))
+                return fut
+            self._gc_waiters.append((my_target, fut))
+            if self._gc_thread is None:
+                self._gc_thread = threading.Thread(
+                    target=self._gc_loop, name="surge-log-groupsync",
+                    daemon=True)
+                self._gc_thread.start()
+            self._gc_cv.notify_all()
+        return fut
+
+    def _gc_loop(self) -> None:
+        """The group-sync worker: one fsync per round covers every journal
+        line written before it, resolving all covered waiters at once.
+
+        Waiter futures are ALWAYS resolved OUTSIDE _gc_cv: a done-callback
+        chained on one (the pipelined commit's visibility publish) takes the
+        main log lock, and a committer holding the main lock registers
+        waiters under _gc_cv — resolving under _gc_cv would invert the
+        documented lock order and deadlock."""
+        while True:
+            with self._gc_cv:
+                while not self._gc_waiters and not self._gc_stop:
+                    self._gc_cv.wait(0.5)
+                if self._gc_stop:
+                    waiters, self._gc_waiters = self._gc_waiters, []
+                else:
+                    waiters = None
+                    target = self._gc_written
+            if waiters is not None:
+                for _t, fut in waiters:
+                    if not fut.done():
+                        fut.set_exception(RuntimeError("log closed"))
+                return
+            err: Optional[BaseException] = None
+            try:
+                os.fsync(self._journal.fileno())
+            except BaseException as exc:  # noqa: BLE001 — fail this round's waiters
+                err = exc
+            ready: List[Tuple[int, "ConcurrentFuture"]] = []
+            with self._gc_cv:
+                if err is None:
+                    if target > self._gc_durable:
+                        self._gc_durable = target
+                    keep = []
+                    for t, fut in self._gc_waiters:
+                        (ready if t <= self._gc_durable else keep).append(
+                            (t, fut))
+                    self._gc_waiters = keep
+                else:
+                    # durability unknown: fail everyone queued — a blocking
+                    # commit raises, a pipelined handle retries via
+                    # retry_pipelined (re-joining a later round; the records
+                    # are already placed, nothing re-appends)
+                    ready, self._gc_waiters = self._gc_waiters, []
+            for _t, fut in ready:
+                if not fut.done():
+                    if err is None:
+                        fut.set_result(None)
+                    else:
+                        fut.set_exception(err)
 
     # -- reads ----------------------------------------------------------------------------
 
@@ -415,24 +639,28 @@ class FileLog(LogBase):
     def read(self, topic: str, partition: int, from_offset: int = 0,
              max_records: Optional[int] = None,
              isolation: str = "read_committed") -> Sequence[LogRecord]:
-        del isolation  # only journaled (committed) blocks are ever indexed
+        del isolation  # reads serve the DURABLE frontier (read_committed):
+        # an applied-but-unsynced group-commit transaction stays invisible —
+        # like records of an open Kafka transaction — so a crash that loses
+        # an unsynced journal line can never un-happen observed records
         while True:
             with self._lock:
                 part = self._parts.get((topic, partition))
                 if part is None:  # parity with InMemoryLog: reads never create topics
                     return []
+                durable = part.durable_offset if self._fsync else part.end_offset
                 blocks = list(part.blocks)
                 path, gen = part.path, part.gen
             out: List[LogRecord] = []
             limit = max_records if max_records is not None else None
             try:
                 for base, pos, count in blocks:
-                    if base + count <= from_offset:
+                    if base + count <= from_offset or base >= durable:
                         continue
                     recs = self._decode_block_at(part, topic, partition, pos,
                                                  path, gen)
                     for r in recs:
-                        if r.offset < from_offset:
+                        if r.offset < from_offset or r.offset >= durable:
                             continue
                         out.append(r)
                         if limit is not None and len(out) >= limit:
@@ -446,10 +674,11 @@ class FileLog(LogBase):
 
     def end_offset(self, topic: str, partition: int,
                    isolation: str = "read_committed") -> int:
-        del isolation
+        del isolation  # durable frontier, matching read() (read_committed)
         with self._lock:
             self.topic(topic)
-            return self._parts[(topic, partition)].end_offset
+            part = self._parts[(topic, partition)]
+            return part.durable_offset if self._fsync else part.end_offset
 
     # -- compaction ---------------------------------------------------------------------
 
@@ -576,9 +805,94 @@ class FileLog(LogBase):
         self._persist_json("compaction.json", self._manifest)
 
     def close(self) -> None:
+        with self._gc_cv:
+            self._gc_stop = True
+            self._gc_cv.notify_all()
+        gc_thread = self._gc_thread
+        if gc_thread is not None:
+            gc_thread.join(2.0)
+            self._gc_thread = None
         with self._lock:
             self._journal.close()
             for part in self._parts.values():
                 if part.file is not None:
                     part.file.close()
                     part.file = None
+
+
+class FilePipelinedCommit:
+    """One pipelined FileLog transaction: already APPLIED to the log (offsets
+    assigned) but NOT yet visible to readers — the read_committed frontier
+    (and the append notify) advances only when a group-sync round makes its
+    journal line durable, which also resolves the future. ``retry_pipelined``
+    re-joins a later round — the records never re-append, so the publisher's
+    verbatim retry contract holds for the in-process transport too."""
+
+    __slots__ = ("future", "producer", "target", "records_out", "marks",
+                 "touched")
+
+    def __init__(self, producer: "FileTxnProducer", target: int,
+                 records_out: List[LogRecord]) -> None:
+        self.producer = producer
+        self.target = target
+        self.records_out = records_out
+        self.marks = []
+        self.touched = set()
+        self.future: "ConcurrentFuture" = ConcurrentFuture()
+
+
+class FileTxnProducer(InMemoryTxnProducer):
+    """FileLog producer: the shared transactional/fencing protocol plus
+    pipelined group commits — ``commit_pipelined`` applies the transaction
+    synchronously (fast: no fsync under the log lock) and returns a handle
+    whose future resolves when the shared journal-fsync round covers it, so
+    a publisher lane overlaps durability waits across its in-flight window
+    and every lane's round rides ONE fsync."""
+
+    def commit_pipelined(self) -> FilePipelinedCommit:
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        records, self._buffer = self._buffer, None
+        log: FileLog = self._log
+        with log._lock:
+            log._check_epoch(self.transactional_id, self.epoch)
+            out, my_target, touched, marks = log._append_locked(records)
+        handle = FilePipelinedCommit(self, my_target, list(out))
+        handle.marks = marks
+        handle.touched = touched
+        if log._fsync and touched:
+            # visibility (durable frontier + append notify) advances with the
+            # round, in _chain_sync's resolution — readers must never observe
+            # records a crash could still erase
+            self._chain_sync(handle)
+        else:
+            if touched:
+                log._mark_durable(marks)
+                log._notify_append(touched)
+            handle.future.set_result(handle.records_out)
+        return handle
+
+    def retry_pipelined(self, handle: FilePipelinedCommit) -> FilePipelinedCommit:
+        """Re-await durability for an already-applied transaction (a failed
+        fsync round): join a fresh round, never re-append."""
+        if not handle.future.done():
+            raise TransactionStateError("pipelined commit still in flight")
+        handle.future = ConcurrentFuture()
+        self._chain_sync(handle)
+        return handle
+
+    def _chain_sync(self, handle: FilePipelinedCommit) -> None:
+        log = self._log
+        fut = handle.future
+
+        def _resolve(sync_fut) -> None:
+            exc = sync_fut.exception()
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                # durable now: publish to readers, then resolve the committer
+                log._mark_durable(handle.marks)
+                log._notify_append(handle.touched)
+                fut.set_result(handle.records_out)
+
+        log._enqueue_sync(handle.target).add_done_callback(_resolve)

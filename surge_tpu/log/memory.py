@@ -60,6 +60,17 @@ class LogBase:
                     f"producer {transactional_id!r} epoch {epoch} fenced by "
                     f"epoch {self._epochs.get(transactional_id)}")
 
+    def _append_fenced(self, transactional_id: str, epoch: int,
+                       records: Sequence[LogRecord]) -> List[LogRecord]:
+        """Epoch-check + append as one atomic step (the fencing window a
+        commit must close). Subclasses whose append has a slow durability
+        phase (FileLog's group-commit fsync) override this to run that phase
+        OUTSIDE the log lock — holding the lock across an fsync would
+        serialize every reader behind the disk."""
+        with self._lock:
+            self._check_epoch(transactional_id, epoch)
+            return self._append(records)
+
     def latest_by_key(self, topic: str, partition: int,
                       isolation: str = "read_committed") -> Mapping[str, LogRecord]:
         out: Dict[str, LogRecord] = {}
@@ -273,11 +284,10 @@ class InMemoryTxnProducer:
         # fencing is re-checked inside the atomic append's lock window
         if self._buffer is None:
             raise TransactionStateError("no open transaction")
-        with self._log._lock:
-            self._log._check_epoch(self.transactional_id, self.epoch)
-            records = self._buffer
-            self._buffer = None
-            return self._log._append(records)
+        records = self._buffer
+        self._buffer = None
+        return self._log._append_fenced(self.transactional_id, self.epoch,
+                                        records)
 
     def abort(self) -> None:
         if self._buffer is None:
@@ -285,9 +295,8 @@ class InMemoryTxnProducer:
         self._buffer = None
 
     def send_immediate(self, record: LogRecord) -> LogRecord:
-        with self._log._lock:
-            self._log._check_epoch(self.transactional_id, self.epoch)
-            if self._buffer is not None:
-                raise TransactionStateError(
-                    "send_immediate inside an open transaction")
-            return self._log._append([record])[0]
+        if self._buffer is not None:
+            raise TransactionStateError(
+                "send_immediate inside an open transaction")
+        return self._log._append_fenced(self.transactional_id, self.epoch,
+                                        [record])[0]

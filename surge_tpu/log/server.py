@@ -46,34 +46,60 @@ from surge_tpu.log.transport import (
     TransactionStateError,
 )
 
+#: how many recent (txn_seq -> reply/locator) entries each producer keeps: a
+#: pipelined client can replay any seq in its in-flight window after a reply
+#: loss, not just the newest — sized comfortably above any sane
+#: surge.producer.max-in-flight
+_DEDUP_WINDOW = 128
+
+
 class _TxnDedup:
     """Idempotency state for ONE transactional id — shared across producer
-    re-opens (and, via replication, across broker failover): the last committed
-    txn_seq and its reply. One commit is in flight per producer at a time (the
-    publisher is the partition's single writer), so the most recent entry is
-    enough to answer any replay the client can send."""
+    re-opens (and, via replication, across broker failover). With pipelined
+    transactions up to a WINDOW of commits can be in flight per producer, so
+    alongside the newest committed txn_seq (``last_seq``) a bounded window of
+    recent replies (``replies``) and committed-record locations (``locators``)
+    is kept — a replayed seq anywhere in the window is answered from cache,
+    never appended twice. ``applied_seq`` is the in-order apply frontier: the
+    highest seq appended LOCALLY (it runs ahead of ``last_seq`` while a
+    replicated commit awaits its follower ack)."""
 
-    __slots__ = ("last_seq", "last_reply", "locator")
+    __slots__ = ("last_seq", "applied_seq", "last_reply", "locator",
+                 "replies", "locators")
 
     def __init__(self) -> None:
         self.last_seq = 0
+        self.applied_seq = 0
         self.last_reply: Optional[pb.TxnReply] = None
         #: committed-record locations [(topic, partition, offset), ...] for
         #: last_seq, recovered from __txn_state after a broker restart — the
         #: lost reply is rebuilt by re-reading the records at these offsets
         self.locator: Optional[list] = None
+        #: seq -> cached ok-reply for the recent window
+        self.replies: "OrderedDict[int, pb.TxnReply]" = OrderedDict()
+        #: seq -> committed-record locator for the recent window (survives
+        #: restarts via the "w" field of __txn_state)
+        self.locators: "OrderedDict[int, list]" = OrderedDict()
+
+    def cache_reply(self, seq: int, reply: pb.TxnReply) -> None:
+        self.replies[seq] = reply
+        while len(self.replies) > _DEDUP_WINDOW:
+            self.replies.popitem(last=False)
 
 
 class _ProducerState:
     """Server-side producer handle bound to its txn id's dedup state."""
 
-    __slots__ = ("txn_id", "producer", "dedup", "lock", "fresh")
+    __slots__ = ("txn_id", "producer", "dedup", "lock", "cond", "fresh")
 
     def __init__(self, txn_id: str, producer, dedup: _TxnDedup) -> None:
         self.txn_id = txn_id
         self.producer = producer
         self.dedup = dedup
         self.lock = threading.Lock()
+        #: in-order apply gate: a pipelined seq arriving ahead of its
+        #: predecessor waits here until the predecessor applies
+        self.cond = threading.Condition(self.lock)
         #: True until this producer's first Transact: gates the
         #: duplicate-absorption of a reopen-retried batch at last_seq+1
         self.fresh = True
@@ -210,6 +236,10 @@ class LogServer:
             "surge.log.replication-isr-timeout-ms", 10_000)
         self._repl_auto_resync_cap = cfg.get_int(
             "surge.log.replication-auto-resync-max-records", 10_000)
+        # pipelined transactions: how long the in-order apply gate waits for a
+        # missing predecessor seq before answering retriable
+        self._inorder_timeout_s = cfg.get_seconds(
+            "surge.log.txn-inorder-timeout-ms", 3_000)
         self._repl_target_state: Dict[str, _TargetState] = {
             t: _TargetState() for t in self._repl_targets}
         # rejoin-probe transport: ONE cached channel per target, stubs derived
@@ -290,14 +320,15 @@ class LogServer:
                                                _TxnDedup())
             self._producers[token] = _ProducerState(
                 request.transactional_id, producer, dedup)
-        # a seq still awaiting replication counts: the new producer must number
-        # PAST it, or its first commit could collide with the in-limbo batch
+        # a seq still awaiting replication counts, as does one applied locally
+        # but not yet acked: the new producer must number PAST them, or its
+        # first commit could collide with an in-limbo batch
         pending_max = max(
             (s for (tid, s) in list(self._repl_pending)
              if tid == request.transactional_id), default=0)
         return pb.OpenProducerReply(
             producer_token=token,
-            last_txn_seq=max(dedup.last_seq, pending_max))
+            last_txn_seq=max(dedup.last_seq, dedup.applied_seq, pending_max))
 
     def Transact(self, request: pb.TxnRequest, context) -> pb.TxnReply:
         if self.tracer is None:
@@ -333,107 +364,218 @@ class LogServer:
                                      "(broker restarted?)",
                                error_kind="fenced")
         records = [msg_to_record(m) for m in request.records]
+        seq = request.txn_seq
+        deadline = time.monotonic() + self._inorder_timeout_s
+        join_item: Optional[_ReplItem] = None
+        sync_handle = None  # pipelined inner-log commit awaiting its round
+        committed: list = []
         with state.lock:
             dedup = state.dedup
             fresh = state.fresh
-            if request.txn_seq:
+            if seq:
                 # only a SEQ-FUL transact consumes the reopen-freshness: the
                 # publisher's unsequenced epoch flush record must not eat the
                 # one-shot absorption window its stashed batch needs
                 state.fresh = False
-            # idempotency window (txn_seq > 0): a replayed seq means the client
-            # lost our reply and retried — answer from cache, never append
-            # twice. The cache survives broker restarts via __txn_state (the
-            # reply is rebuilt from the recorded offsets on first replay), and
-            # a replay is only honored for the IDENTICAL payload — answering a
-            # different batch from the cache would silently drop its records.
-            if request.txn_seq:
-                if request.txn_seq == dedup.last_seq:
-                    reply = dedup.last_reply or self._rebuild_cached_reply(dedup)
-                    if reply is not None:
-                        cached = [msg_to_record(m) for m in reply.records]
-                        if reply.ok and not _same_payload(cached, records):
+            while True:
+                if seq:
+                    # idempotency window: a replayed seq means the client lost
+                    # our reply and retried — answer from the dedup window
+                    # (any seq a pipelined client can still replay), never
+                    # append twice. The cache survives broker restarts via
+                    # __txn_state (replies are rebuilt from the recorded
+                    # offsets on first replay), and a replay is only honored
+                    # for the IDENTICAL payload — answering a different batch
+                    # from the cache would silently drop its records.
+                    if seq <= dedup.last_seq:
+                        return self._replay_answer(dedup, seq, records)
+                    if (fresh and seq == dedup.last_seq + 1 and dedup.last_seq
+                            and seq > dedup.applied_seq):
+                        # reopen-retry absorption: a publisher whose commit
+                        # landed but whose broker bounced re-opens (numbering
+                        # resumes at last+1) and retries the SAME batch under
+                        # the new seq. Only a producer's FIRST transact can be
+                        # such a replay — later identical consecutive batches
+                        # are legitimate traffic (engine payloads embed
+                        # monotonic versions, but raw clients may repeat
+                        # bytes).
+                        reply = (dedup.replies.get(dedup.last_seq)
+                                 or dedup.last_reply
+                                 or self._rebuild_cached_reply(dedup))
+                        if reply is not None and reply.ok:
+                            cached = [msg_to_record(m) for m in reply.records]
+                            if _same_payload(cached, records):
+                                self._ack_seq(state.txn_id, dedup, seq, reply,
+                                              cached)
+                                state.cond.notify_all()
+                                return reply
+                    # a previous attempt of this seq appended locally but
+                    # timed out waiting for replication: re-join that item,
+                    # never re-append. The payload must MATCH — the client may
+                    # only reuse a seq for the identical batch (a different
+                    # batch acked from this item's cache would silently lose
+                    # its records)
+                    pending = self._repl_pending.get((state.txn_id, seq))
+                    if pending is not None:
+                        if not _same_payload(pending.records, records):
                             return pb.TxnReply(
                                 ok=False, error_kind="state",
-                                error=f"txn_seq {request.txn_seq} reused with "
-                                      "a different payload (its original "
-                                      "batch already committed)")
-                        return reply
-                    return pb.TxnReply(ok=False, error="duplicate txn_seq with "
-                                       "no cached reply", error_kind="state")
-                if request.txn_seq < dedup.last_seq:
-                    return pb.TxnReply(
-                        ok=False, error_kind="state",
-                        error=f"stale txn_seq {request.txn_seq} "
-                              f"(last {dedup.last_seq})")
-                if (fresh and request.txn_seq == dedup.last_seq + 1
-                        and dedup.last_seq):
-                    # reopen-retry absorption: a publisher whose commit landed
-                    # but whose broker bounced re-opens (numbering resumes at
-                    # last+1) and retries the SAME batch under the new seq.
-                    # Only a producer's FIRST transact can be such a replay —
-                    # later identical consecutive batches are legitimate
-                    # traffic (engine payloads embed monotonic versions, but
-                    # raw clients may repeat bytes).
-                    reply = (dedup.last_reply
-                             or self._rebuild_cached_reply(dedup))
-                    if reply is not None and reply.ok:
-                        cached = [msg_to_record(m) for m in reply.records]
-                        if _same_payload(cached, records):
-                            dedup.last_seq = request.txn_seq
-                            self._persist_txn_state(
-                                state.txn_id, request.txn_seq,
-                                [msg_to_record(m) for m in reply.records])
-                            return reply
-                # a previous attempt of this seq appended locally but timed out
-                # waiting for replication: re-join that item, never re-append.
-                # The payload must MATCH — the client may only reuse a seq for
-                # the identical batch (a different batch acked from this item's
-                # cache would silently lose its records)
-                pending = self._repl_pending.get((state.txn_id, request.txn_seq))
-                if pending is not None:
-                    if not _same_payload(pending.records, records):
-                        return pb.TxnReply(
-                            ok=False, error_kind="state",
-                            error=f"txn_seq {request.txn_seq} reused with a "
-                                  "different payload while its original batch "
-                                  "awaits replication")
-                    return self._finish_replicated(state, request.txn_seq, pending)
+                                error=f"txn_seq {seq} reused with a "
+                                      "different payload while its original "
+                                      "batch awaits replication")
+                        join_item = pending
+                        break
+                    # in-order apply gate: a pipelined seq whose predecessor
+                    # has not applied yet waits its turn (bounded — the client
+                    # retries the same seq on a retriable answer, preserving
+                    # exactly-once)
+                    if seq > dedup.applied_seq + 1:
+                        if time.monotonic() >= deadline:
+                            return pb.TxnReply(
+                                ok=False, error_kind="retriable",
+                                error=f"txn_seq {seq} waiting for in-order "
+                                      f"predecessor (applied "
+                                      f"{dedup.applied_seq}); retry the same "
+                                      "txn_seq")
+                        state.cond.wait(
+                            min(0.1, deadline - time.monotonic()))
+                        continue
+                    if seq <= dedup.applied_seq:
+                        # applied, but neither the ack window nor the pending
+                        # map holds it — the replication worker is finalizing
+                        # it right now. Wait for the bookkeeping, then answer
+                        # from the cache.
+                        if time.monotonic() >= deadline:
+                            return pb.TxnReply(
+                                ok=False, error_kind="retriable",
+                                error=f"txn_seq {seq} applied; ack "
+                                      "bookkeeping still in flight — retry "
+                                      "the same txn_seq")
+                        state.cond.wait(0.05)
+                        continue
+                try:
+                    if request.op == "commit":
+                        producer = state.producer
+                        if (not self._repl_targets
+                                and hasattr(producer, "commit_pipelined")):
+                            # pipelined inner log (FileLog): APPLY under the
+                            # lock, await DURABILITY outside it — the next
+                            # pipelined seq of this producer then applies
+                            # while this one's journal round runs, so
+                            # max-in-flight overlaps the fsync wait too, not
+                            # just the network RTT
+                            producer.begin()
+                            for r in records:
+                                producer.send(r)
+                            sync_handle = producer.commit_pipelined()
+                            committed = list(sync_handle.records_out)
+                        else:
+                            producer.begin()
+                            for r in records:
+                                producer.send(r)
+                            committed = producer.commit()
+                    elif request.op == "abort":
+                        # transactions buffer client-side; nothing to discard here
+                        committed = []
+                    elif request.op == "send_immediate":
+                        committed = [state.producer.send_immediate(r)
+                                     for r in records]
+                    else:
+                        return pb.TxnReply(ok=False, error_kind="state",
+                                           error=f"unknown op {request.op!r}")
+                except ProducerFencedError as exc:
+                    return pb.TxnReply(ok=False, error=str(exc), error_kind="fenced")
+                except TransactionStateError as exc:
+                    return pb.TxnReply(ok=False, error=str(exc), error_kind="state")
+                except Exception as exc:  # noqa: BLE001 — surface inner-log failures
+                    logger.exception("log server transact failed")
+                    return pb.TxnReply(ok=False, error=repr(exc), error_kind="other")
+                if seq:
+                    dedup.applied_seq = seq
+                    state.cond.notify_all()  # wake the next pipelined seq
+                if self._repl_targets and committed:
+                    join_item = self._enqueue_replication(committed,
+                                                          state.txn_id, seq)
+                    break
+                if sync_handle is not None:
+                    break  # await durability outside the lock
+                reply = pb.TxnReply(ok=True,
+                                    records=[record_to_msg(r) for r in committed])
+                if seq:
+                    self._ack_seq(state.txn_id, dedup, seq, reply, committed)
+                return reply
+        # OUTSIDE the producer lock: await the replication ack / the journal
+        # group-sync round. Later seqs in the pipelined window apply (and
+        # enqueue, in order) meanwhile — the wait overlaps across the window
+        # instead of serializing the producer.
+        if join_item is not None:
+            return self._finish_replicated(state, seq, join_item)
+        for attempt in range(3):
             try:
-                if request.op == "commit":
-                    state.producer.begin()
-                    for r in records:
-                        state.producer.send(r)
-                    committed = state.producer.commit()
-                elif request.op == "abort":
-                    # transactions buffer client-side; nothing to discard here
-                    committed = []
-                elif request.op == "send_immediate":
-                    committed = [state.producer.send_immediate(r)
-                                 for r in records]
-                else:
-                    return pb.TxnReply(ok=False, error_kind="state",
-                                       error=f"unknown op {request.op!r}")
-            except ProducerFencedError as exc:
-                return pb.TxnReply(ok=False, error=str(exc), error_kind="fenced")
-            except TransactionStateError as exc:
-                return pb.TxnReply(ok=False, error=str(exc), error_kind="state")
-            except Exception as exc:  # noqa: BLE001 — surface inner-log failures
-                logger.exception("log server transact failed")
-                return pb.TxnReply(ok=False, error=repr(exc), error_kind="other")
-            if self._repl_targets and committed:
-                item = self._enqueue_replication(committed, state.txn_id,
-                                                 request.txn_seq)
-                return self._finish_replicated(state, request.txn_seq, item)
+                sync_handle.future.result()  # gc worker always resolves
+                break
+            except Exception as exc:  # noqa: BLE001 — fsync round failed
+                # the records ARE applied; durability is unknown. Re-join a
+                # later round a couple of times (a transient hiccup heals
+                # here); persistent fsync failure is a dying disk — surface
+                # it, the client's ladder and the operator take over.
+                if attempt == 2:
+                    logger.error("journal sync failed for txn_seq %d: %r",
+                                 seq, exc)
+                    return pb.TxnReply(
+                        ok=False, error_kind="other",
+                        error=f"journal sync failed: {exc!r}")
+                state.producer.retry_pipelined(sync_handle)
+        with state.lock:
             reply = pb.TxnReply(ok=True,
                                 records=[record_to_msg(r) for r in committed])
-            if request.txn_seq:
-                dedup.last_seq = request.txn_seq
-                dedup.last_reply = reply
-                dedup.locator = None
-                self._persist_txn_state(state.txn_id, request.txn_seq,
-                                        committed)
-            return reply
+            if seq:
+                self._ack_seq(state.txn_id, state.dedup, seq, reply, committed)
+                state.cond.notify_all()  # a replay may be polling for the ack
+        return reply
+
+    def _ack_seq(self, txn_id: str, dedup: _TxnDedup, seq: int,
+                 reply: pb.TxnReply, committed) -> None:
+        """Acknowledge one committed seq into the dedup window + durable
+        __txn_state (non-replicated commits, the replication worker's
+        finalize, follower ingest, and reopen absorption all converge here)."""
+        dedup.cache_reply(seq, reply)
+        if seq > dedup.last_seq:
+            dedup.last_reply = reply
+            dedup.last_seq = seq
+            dedup.locator = None
+        if seq > dedup.applied_seq:
+            dedup.applied_seq = seq
+        self._persist_txn_state(txn_id, seq, committed)
+
+    def _replay_answer(self, dedup: _TxnDedup, seq: int,
+                       records) -> pb.TxnReply:
+        """Answer a replayed (already-acked) seq from the dedup window."""
+        reply = dedup.replies.get(seq)
+        if reply is None and seq == dedup.last_seq:
+            reply = dedup.last_reply or self._rebuild_cached_reply(dedup)
+        if reply is None:
+            loc = dedup.locators.get(seq)
+            if loc is not None:
+                reply = self._rebuild_from_locator(loc)
+                if reply is not None:
+                    dedup.cache_reply(seq, reply)
+        if reply is None:
+            if seq < dedup.last_seq:
+                return pb.TxnReply(
+                    ok=False, error_kind="state",
+                    error=f"stale txn_seq {seq} (last {dedup.last_seq})")
+            return pb.TxnReply(ok=False, error="duplicate txn_seq with "
+                               "no cached reply", error_kind="state")
+        if reply.ok:
+            cached = [msg_to_record(m) for m in reply.records]
+            if not _same_payload(cached, records):
+                return pb.TxnReply(
+                    ok=False, error_kind="state",
+                    error=f"txn_seq {seq} reused with "
+                          "a different payload (its original "
+                          "batch already committed)")
+        return reply
 
     # -- replication: leader side ---------------------------------------------------------
 
@@ -655,13 +797,10 @@ class LogServer:
                 if item.seq > dedup.last_seq:
                     # reply BEFORE seq: a lock-free reader that observes the
                     # new last_seq must never see the previous reply
-                    dedup.last_reply = pb.TxnReply(
+                    self._ack_seq(item.txn_id, dedup, item.seq, pb.TxnReply(
                         ok=True,
-                        records=[record_to_msg(r) for r in item.records])
-                    dedup.last_seq = item.seq
-                    dedup.locator = None
-                    self._persist_txn_state(item.txn_id, item.seq,
-                                            item.records)
+                        records=[record_to_msg(r) for r in item.records]),
+                        item.records)
                 self._repl_pending.pop((item.txn_id, item.seq), None)
             item.error = None
             # pop BEFORE waking the waiter: a client that gets its commit
@@ -933,12 +1072,9 @@ class LogServer:
                     dedup = self._txn_dedup.setdefault(
                         request.transactional_id, _TxnDedup())
                     if request.txn_seq > dedup.last_seq:
-                        dedup.last_seq = request.txn_seq
-                        dedup.last_reply = pb.TxnReply(
-                            ok=True, records=list(request.records))
-                        dedup.locator = None
-                        self._persist_txn_state(
-                            request.transactional_id, request.txn_seq,
+                        self._ack_seq(
+                            request.transactional_id, dedup, request.txn_seq,
+                            pb.TxnReply(ok=True, records=list(request.records)),
                             [msg_to_record(m) for m in request.records])
                 return pb.ReplicateReply(ok=True)
             except Exception as exc:  # noqa: BLE001
@@ -961,10 +1097,12 @@ class LogServer:
 
     def _recover_txn_state(self) -> None:
         """Rebuild the dedup table from the __txn_state records a previous
-        life of this broker persisted with each seq-ful commit: last_seq
-        survives the restart (OpenProducer resumes the client's numbering)
-        and a replayed seq is answered by re-reading the committed records at
-        their recorded offsets instead of appending them a second time."""
+        life of this broker persisted with each seq-ful commit: last_seq (and
+        the recent-seq locator WINDOW a pipelined client can still replay)
+        survives the restart — OpenProducer resumes the client's numbering,
+        and a replayed seq anywhere in the window is answered by re-reading
+        the committed records at their recorded offsets instead of appending
+        them a second time."""
         import json as _json
 
         known = getattr(self.log, "_topics", {})
@@ -980,8 +1118,11 @@ class LogServer:
             dedup = self._txn_dedup.setdefault(key, _TxnDedup())
             if seq > dedup.last_seq:
                 dedup.last_seq = seq
+                dedup.applied_seq = max(dedup.applied_seq, seq)
                 dedup.last_reply = None
                 dedup.locator = [tuple(x) for x in obj.get("r", [])]
+                for s, loc in obj.get("w", []):
+                    dedup.locators[int(s)] = [tuple(x) for x in loc]
                 recovered += 1
         if recovered:
             logger.info("recovered %d txn dedup entries from %s",
@@ -989,14 +1130,36 @@ class LogServer:
 
     def _persist_txn_state(self, txn_id: str, seq: int, records) -> None:
         """Durably record (txn_id -> seq, committed-record locations) in the
-        inner log. Best-effort: a failure only re-opens the restart-window
-        duplicate risk, it must never fail the commit it annotates.
-        ``records`` carry their committed offsets (LogRecord or RecordMsg)."""
+        inner log — plus the recent-seq locator window ("w"), so a pipelined
+        client's replay of a non-newest seq survives a broker restart too.
+        Best-effort: a failure only re-opens the restart-window duplicate
+        risk, it must never fail the commit it annotates. ``records`` carry
+        their committed offsets (LogRecord or RecordMsg)."""
         import json as _json
 
         try:
             locator = [[r.topic, r.partition, r.offset] for r in records]
-            value = _json.dumps({"s": int(seq), "r": locator}).encode()
+            dedup = self._txn_dedup.get(txn_id)
+            window: list = []
+            newest = seq
+            if dedup is not None:
+                dedup.locators[seq] = locator
+                while len(dedup.locators) > _DEDUP_WINDOW:
+                    dedup.locators.popitem(last=False)
+                # persist only the newest few locators: __txn_state is written
+                # per commit, so an O(_DEDUP_WINDOW) payload would be serious
+                # write amplification on the hot path. 16 comfortably covers
+                # any sane surge.producer.max-in-flight (restart replays can
+                # only reach back one in-flight window).
+                window = [[s, loc] for s, loc in dedup.locators.items()][-16:]
+                # out-of-order acks (pipelined durability waits) must never
+                # leave a LOWER "s" as the compacted-latest record: persist
+                # the acked frontier (paired with ITS locator), not this
+                # call's seq
+                newest = max(seq, dedup.last_seq)
+                locator = dedup.locators.get(newest, locator)
+            value = _json.dumps(
+                {"s": int(newest), "r": locator, "w": window}).encode()
             with self._txn_state_lock:
                 known = getattr(self.log, "_topics", {})
                 if TXN_STATE_TOPIC not in known:
@@ -1014,20 +1177,25 @@ class LogServer:
             logger.exception("txn-state persist failed "
                              "(restart dedup window open)")
 
-    def _rebuild_cached_reply(self, dedup: _TxnDedup) -> Optional[pb.TxnReply]:
-        """Reconstruct a recovered seq's lost reply from its locator by
-        re-reading the committed records where the log holds them."""
-        if dedup.locator is None:
-            return None
+    def _rebuild_from_locator(self, locator) -> Optional[pb.TxnReply]:
+        """Reconstruct a lost reply by re-reading the committed records at
+        their recorded (topic, partition, offset) locations."""
         msgs = []
-        for t, part, off in dedup.locator:
+        for t, part, off in locator:
             recs = self.log.read(str(t), int(part), from_offset=int(off),
                                  max_records=1)
             if not recs or recs[0].offset != int(off):
                 return None  # locator points past a truncated/foreign log
             msgs.append(record_to_msg(recs[0]))
-        reply = pb.TxnReply(ok=True, records=msgs)
-        dedup.last_reply = reply
+        return pb.TxnReply(ok=True, records=msgs)
+
+    def _rebuild_cached_reply(self, dedup: _TxnDedup) -> Optional[pb.TxnReply]:
+        """Reconstruct a recovered last_seq's lost reply from its locator."""
+        if dedup.locator is None:
+            return None
+        reply = self._rebuild_from_locator(dedup.locator)
+        if reply is not None:
+            dedup.last_reply = reply
         return reply
 
     def DedupSnapshot(self, request: pb.DedupSnapshotRequest,
@@ -1057,7 +1225,10 @@ class LogServer:
                 if entry.HasField("last_reply"):
                     dedup.last_reply = pb.TxnReply()
                     dedup.last_reply.CopyFrom(entry.last_reply)
+                    dedup.cache_reply(entry.last_seq, dedup.last_reply)
                 dedup.last_seq = entry.last_seq
+                if entry.last_seq > dedup.applied_seq:
+                    dedup.applied_seq = entry.last_seq
                 dedup.locator = None
                 if dedup.last_reply is not None and dedup.last_reply.ok:
                     self._persist_txn_state(

@@ -12,7 +12,6 @@ the ~20 predeclared engine metrics (Metrics.scala:20-115) via :func:`engine_metr
 from __future__ import annotations
 
 import time
-from contextlib import contextmanager
 from dataclasses import dataclass, field
 from enum import IntEnum
 from typing import Dict, List, Optional
@@ -81,6 +80,24 @@ class Sensor:
             p.update(value, ts)
 
 
+class _TimerContext:
+    """Slots-based timing context: ``@contextmanager`` generators cost ~10us
+    per use, and the engine opens several timer contexts per command."""
+
+    __slots__ = ("_sensor", "_t0")
+
+    def __init__(self, sensor: Sensor) -> None:
+        self._sensor = sensor
+
+    def __enter__(self) -> "_TimerContext":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._sensor.record((time.perf_counter() - self._t0) * 1000.0)
+        return False
+
+
 class Timer:
     """EWMA + min/max/p99 over millisecond durations (the reference timer shape)."""
 
@@ -90,13 +107,8 @@ class Timer:
     def record_ms(self, ms: float) -> None:
         self._sensor.record(ms)
 
-    @contextmanager
-    def time(self):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.record_ms((time.perf_counter() - t0) * 1000.0)
+    def time(self) -> _TimerContext:
+        return _TimerContext(self._sensor)
 
     async def time_async(self, awaitable):
         t0 = time.perf_counter()
@@ -195,6 +207,13 @@ class EngineMetrics:
     error_rate: Sensor = field(init=False)
     publish_failure_counter: Sensor = field(init=False)
     fence_counter: Sensor = field(init=False)
+    # group-commit publisher lane instruments (surge_tpu.engine.publisher):
+    # batch formation, adaptive linger, and the pipelined in-flight window
+    producer_batch_records: Sensor = field(init=False)
+    producer_batch_commits: Sensor = field(init=False)
+    producer_linger_timer: Timer = field(init=False)
+    producer_in_flight: Sensor = field(init=False)
+    producer_lane_pending: Sensor = field(init=False)
     replay_events_per_sec: Sensor = field(init=False)
     live_entities: Sensor = field(init=False)
     standby_lag: Sensor = field(init=False)
@@ -249,6 +268,24 @@ class EngineMetrics:
             "surge.producer.publish-failures", "failed publish batches"))
         self.fence_counter = m.counter(MI(
             "surge.producer.fences", "producer fencing events"))
+        self.producer_batch_records = m.gauge(MI(
+            "surge.producer.batch-records",
+            "records in the last committed publish batch (group-commit size)"))
+        self.producer_batch_commits = m.counter(MI(
+            "surge.producer.batch-commits",
+            "committed publish batches (group commits)"))
+        self.producer_linger_timer = m.timer(MI(
+            "surge.producer.linger-timer",
+            "ms a batch's FIRST publish waited from enqueue to commit "
+            "dispatch (the adaptive linger actually paid)"))
+        self.producer_in_flight = m.gauge(MI(
+            "surge.producer.in-flight-txns",
+            "pipelined publish transactions in flight on the last lane to "
+            "record (bounded by surge.producer.max-in-flight)"))
+        self.producer_lane_pending = m.gauge(MI(
+            "surge.producer.lane-pending",
+            "publishes still queued in the recording lane after a batch "
+            "was drained (backpressure indicator)"))
         self.replay_events_per_sec = m.gauge(MI(
             "surge.replay.rebuild-events-per-sec",
             "events/s of the latest bulk rebuild, end to end (compare "

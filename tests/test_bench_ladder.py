@@ -1,0 +1,41 @@
+"""Smoke test for bench.py's SURGE_BENCH_LADDER=1 fast path: the command-path
+throughput ladder must be regenerable WITHOUT the 100M-event corpus build, and
+its JSON payload must carry the keys the BENCH artifact (and the driver's
+last-line-wins parse) depend on."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_ladder_fast_path_emits_expected_json():
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SURGE_BENCH_LADDER": "1",
+        "SURGE_BENCH_LATENCY_SECONDS": "0.4",
+        "SURGE_BENCH_LATENCY_LADDER": "8",
+        "SURGE_BENCH_SWEEP": "0",  # the sweep has its own knobs; smoke stays fast
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON payload on stdout: {proc.stdout!r}"
+    payload = json.loads(lines[-1])  # last line wins for the driver
+    for key in ("metric", "value", "unit", "commands_per_sec",
+                "command_p50_ms", "command_p99_ms", "peak_commands_per_sec",
+                "throughput_ladder", "linger_ms", "max_in_flight",
+                "producer_stats"):
+        assert key in payload, f"{key} missing from the ladder payload"
+    assert payload["metric"] == "commands_per_sec"
+    assert payload["value"] == payload["peak_commands_per_sec"] > 0
+    rung = payload["throughput_ladder"][0]
+    assert rung["workers"] == 8
+    assert rung["commands"] > 0 and rung["commands_per_txn"] >= 1
+    # the corpus phases really were skipped
+    assert "num_events" not in payload and "cpu_baseline_events_per_sec" not in payload

@@ -483,7 +483,10 @@ def test_non_transactional_mid_batch_failure_resumes_exactly_once():
                 await t
         pub._producer.send_immediate = real_send
 
-        # entity retry ladder: same request ids, same records
+        # entity retry ladder: same request ids, same records. The indexer is
+        # frozen first so the in-flight offsets below can't be cleared by a
+        # watermark that races past them (group commits ack fast now).
+        await indexer.stop()
         r1 = asyncio.ensure_future(
             pub.publish("a", [event_rec("a", b"e-a"), state_rec("a", b"s-a")], "r1"))
         r2 = asyncio.ensure_future(
@@ -503,10 +506,17 @@ def test_non_transactional_mid_batch_failure_resumes_exactly_once():
         # offset alignment: every aggregate's in-flight offset is its real state
         # offset, and the watermark clears them once indexed
         for agg in ("a", "b", "c"):
-            off = pub._in_flight.get(agg)
-            assert off is not None
             rec = next(r for r in log.read("state", 0) if r.key == agg)
-            assert off == rec.offset
+            off = pub._in_flight.get(agg)
+            if off is not None:
+                assert off == rec.offset
+            else:
+                # entry already cleared: only legal when the indexed
+                # watermark passed the record (e.g. "a", whose state record
+                # landed on the FIRST attempt and was indexed before the
+                # indexer froze)
+                assert pub._watermark > rec.offset, agg
+        await indexer.start()
         await asyncio.sleep(0.1)  # let the indexer catch up
         pub._refresh_watermark()
         for agg in ("a", "b", "c"):
@@ -541,12 +551,12 @@ def test_background_loops_survive_internal_bugs():
             return await real(self, batch)
 
         with mock.patch.object(PartitionPublisher, "_publish_batch", boom):
-            t1 = asyncio.create_task(pub.publish(
+            t1 = asyncio.ensure_future(pub.publish(
                 "a", [event_rec("a", b"e1"), state_rec("a", b"s1")], "r1"))
             # first tick eats the bug; the loop must survive it
             await asyncio.sleep(0.15)
             assert pub._flush_task.running
-            t2 = asyncio.create_task(pub.publish(
+            t2 = asyncio.ensure_future(pub.publish(
                 "a", [event_rec("a", b"e2"), state_rec("a", b"s2")], "r2"))
             await asyncio.wait_for(t2, 5.0)
         assert calls["n"] >= 2
@@ -587,5 +597,204 @@ def test_background_loops_survive_internal_bugs():
 
         await pub.stop()
         await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+# -- group-commit failure semantics (the lanes/pipelining contract) ----------------------
+
+
+def test_verbatim_retry_batch_replays_before_new_pendings():
+    """An unknown-outcome batch must retry VERBATIM (same payload) before any
+    new pending commits: its records land AHEAD of later publishes on the
+    log, exactly once, and the original waiters resolve on the retry."""
+    import unittest.mock as mock
+
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log)
+
+        real_commit = pub._producer.commit
+        boom = {"armed": True}
+
+        def flaky_commit():
+            if boom["armed"]:
+                raise ConnectionError("transport died mid-commit")
+            return real_commit()
+
+        with mock.patch.object(pub._producer, "commit", flaky_commit):
+            t1 = asyncio.ensure_future(
+                pub.publish("a", [event_rec("a", b"first")], "r1"))
+            for _ in range(100):
+                await asyncio.sleep(0.005)
+                if pub._retry_batches:
+                    break
+            assert pub._retry_batches, "batch should be stashed for retry"
+            assert not t1.done()  # waiter rides the verbatim retry
+            t2 = asyncio.ensure_future(
+                pub.publish("b", [event_rec("b", b"second")], "r2"))
+            await asyncio.sleep(0.02)
+            boom["armed"] = False  # transport heals
+            await asyncio.gather(t1, t2)
+        # retry-before-new-pendings: first's record precedes second's
+        assert [r.value for r in log.read("events", 0)] == [b"first", b"second"]
+        assert not pub._retry_batches
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_caller_timeout_rejoins_in_limbo_batch_exactly_once():
+    """A caller that times out while its batch is IN LIMBO and retries with
+    the same request_id must join the batch's eventual outcome — never queue
+    a second copy (double-append) nor inherit the old cancellation."""
+    import unittest.mock as mock
+
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log)
+
+        real_commit = pub._producer.commit
+        fail = {"n": 2}  # fail the first attempt AND the first verbatim retry
+
+        def flaky_commit():
+            if fail["n"] > 0:
+                fail["n"] -= 1
+                raise ConnectionError("transport flapping")
+            return real_commit()
+
+        with mock.patch.object(pub._producer, "commit", flaky_commit):
+            t1 = asyncio.ensure_future(
+                pub.publish("a", [event_rec("a", b"e1")], "req-1"))
+            await asyncio.sleep(0.02)
+            assert pub._retry_batches
+            t1.cancel()  # the caller's publish timeout fires
+            try:
+                await t1
+            except asyncio.CancelledError:
+                pass
+            # entity ladder retries the SAME request while the batch is in limbo
+            rejoin = asyncio.ensure_future(
+                pub.publish("a", [event_rec("a", b"e1")], "req-1"))
+            await asyncio.wait_for(rejoin, 5.0)
+        assert pub.stats.dedup_hits == 1
+        assert [r.value for r in log.read("events", 0)] == [b"e1"]  # exactly once
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_lane_independence_one_lanes_broker_error_spares_the_other():
+    """Per-partition lanes fail independently: a broker error on one
+    partition's lane must not fail (or block) another lane's batch."""
+    import unittest.mock as mock
+
+    async def scenario():
+        log = InMemoryLog()
+        log.create_topic(TopicSpec("events", 2))
+        log.create_topic(TopicSpec("state", 2, compacted=True))
+        indexer = StateStoreIndexer(log, "state", config=CFG)
+        await indexer.start()
+        pub0 = PartitionPublisher(log, "state", "events", 0, indexer, config=CFG)
+        pub1 = PartitionPublisher(log, "state", "events", 1, indexer, config=CFG)
+        await pub0.start()
+        await pub1.start()
+        await pub0.wait_ready(5.0)
+        await pub1.wait_ready(5.0)
+
+        def dead_commit():
+            raise ConnectionError("broker gone for partition 0")
+
+        with mock.patch.object(pub0._producer, "commit", dead_commit):
+            t0 = asyncio.ensure_future(pub0.publish(
+                "a", [LogRecord(topic="events", key="a", value=b"x0",
+                                partition=0)], "r0"))
+            # lane 1 commits happily while lane 0 churns its retry ladder
+            for i in range(3):
+                await asyncio.wait_for(pub1.publish(
+                    f"b{i}", [LogRecord(topic="events", key=f"b{i}",
+                                        value=b"y%d" % i, partition=1)],
+                    f"r1-{i}"), 5.0)
+        assert [r.value for r in log.read("events", 1)] == [b"y0", b"y1", b"y2"]
+        assert log.read("events", 0) == []  # nothing half-written on lane 0
+        assert not t0.done()  # still riding lane 0's verbatim retry
+        # broker heals: the in-limbo batch commits exactly once
+        await asyncio.wait_for(t0, 5.0)
+        assert [r.value for r in log.read("events", 0)] == [b"x0"]
+        await pub0.stop()
+        await pub1.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_fencing_mid_lane_with_pipelined_filelog_commits(tmp_path):
+    """FileLog lanes are pipeline-capable (group-sync rounds): fencing the
+    producer between pipelined dispatches must stash the affected batch,
+    re-initialize, and commit exactly once — no loss, no double-apply."""
+    from surge_tpu.log.file import FileLog
+
+    async def scenario():
+        log = FileLog(str(tmp_path / "log"))
+        log.create_topic(TopicSpec("events", 1))
+        log.create_topic(TopicSpec("state", 1, compacted=True))
+        indexer = StateStoreIndexer(log, "state", config=CFG)
+        await indexer.start()
+        pub = PartitionPublisher(log, "state", "events", 0, indexer,
+                                 config=CFG, still_owner=lambda: True)
+        await pub.start()
+        await pub.wait_ready(5.0)
+        assert pub._pipeline_capable()  # FileLog exposes commit_pipelined
+
+        await pub.publish("a", [event_rec("a", b"before")], "r0")
+        log.transactional_producer(pub.transactional_id)  # fence mid-lane
+        await asyncio.wait_for(
+            pub.publish("a", [event_rec("a", b"held")], "r1"), 10.0)
+        await pub.wait_ready(5.0)
+        assert pub.stats.reinitializations == 1
+        # a late same-request retry of the held batch is absorbed
+        await pub.publish("a", [event_rec("a", b"held")], "r1")
+        assert [r.value for r in log.read("events", 0)] == [b"before", b"held"]
+        await pub.stop()
+        await indexer.stop()
+        log.close()
+
+    asyncio.run(scenario())
+
+
+def test_pipelined_window_overlaps_commits_on_filelog(tmp_path):
+    """max-in-flight > 1 on a pipelined transport: multiple batches may be in
+    flight concurrently, every ack is durable, and nothing is lost or
+    reordered within an aggregate."""
+    from surge_tpu.log.file import FileLog
+
+    async def scenario():
+        log = FileLog(str(tmp_path / "log"))
+        log.create_topic(TopicSpec("events", 1))
+        log.create_topic(TopicSpec("state", 1, compacted=True))
+        indexer = StateStoreIndexer(log, "state", config=CFG)
+        await indexer.start()
+        cfg = CFG.with_overrides({"surge.producer.linger-ms": 0,
+                                  "surge.producer.max-in-flight": 4})
+        pub = PartitionPublisher(log, "state", "events", 0, indexer, config=cfg)
+        await pub.start()
+        await pub.wait_ready(5.0)
+
+        async def stream(agg, n):
+            for i in range(n):
+                await pub.publish(agg, [event_rec(agg, b"%s-%d" % (
+                    agg.encode(), i))], f"{agg}-{i}")
+
+        await asyncio.gather(*(stream(f"agg{j}", 10) for j in range(6)))
+        values = [r.value for r in log.read("events", 0)]
+        assert len(values) == 60 and len(set(values)) == 60  # exactly once
+        for j in range(6):
+            seq = [v for v in values if v.startswith(b"agg%d-" % j)]
+            assert seq == sorted(seq, key=lambda v: int(v.split(b"-")[-1]))
+        await pub.stop()
+        await indexer.stop()
+        log.close()
 
     asyncio.run(scenario())

@@ -163,3 +163,89 @@ def test_engine_trace_continuity_ref_to_entity():
     assert publishes, "expected a publisher.publish span"
     assert publishes[0].context.trace_id == tid
     assert publishes[0].parent_id == receives[0].context.span_id
+
+
+def test_tracer_none_deliver_paths_never_touch_tracing_machinery():
+    """Satellite micro-assert: the per-message ``inject_context`` imports are
+    hoisted to module level in router/shard, and the tracer=None hot path must
+    stay a single `is None` check — if any deliver() touches the tracing
+    machinery per message, this raising stand-in detonates."""
+    import asyncio
+    import unittest.mock as mock
+
+    from surge_tpu.engine import router as router_mod
+    from surge_tpu.engine import shard as shard_mod
+    from surge_tpu.engine.entity import Envelope
+    from surge_tpu.engine.partition import HostPort, PartitionTracker
+    from surge_tpu.engine.router import SurgePartitionRouter
+
+    # the hoist itself: module-level names, not per-call imports
+    assert hasattr(router_mod, "inject_context")
+    assert hasattr(shard_mod, "inject_context")
+
+    def detonate(*a, **k):
+        raise AssertionError("tracer=None path touched tracing machinery")
+
+    class _Region:
+        def __init__(self):
+            self.delivered = []
+
+        def deliver(self, aggregate_id, env):
+            self.delivered.append(aggregate_id)
+
+        async def stop(self):
+            pass
+
+    async def scenario():
+        host = HostPort("localhost", 1)
+        tracker = PartitionTracker()
+        region = _Region()
+        router = SurgePartitionRouter(
+            num_partitions=2, tracker=tracker, local_host=host,
+            region_creator=lambda p: region)
+        await router.start()
+        tracker.update({host: [0, 1]})
+        with mock.patch.object(router_mod, "inject_context", detonate), \
+                mock.patch.object(shard_mod, "inject_context", detonate):
+            fut = asyncio.get_running_loop().create_future()
+            router.deliver("agg-1", Envelope(message="m", reply=fut))
+        assert region.delivered == ["agg-1"]
+        await router.stop()
+
+    asyncio.run(scenario())
+
+
+def test_tracer_none_shard_deliver_zero_tracing_cost():
+    """Same micro-assert for the Shard hop, through a real Shard."""
+    import asyncio
+    import unittest.mock as mock
+
+    from surge_tpu.engine import shard as shard_mod
+    from surge_tpu.engine.entity import Envelope
+    from surge_tpu.engine.shard import Shard
+
+    class _Entity:
+        state_name = "running"
+
+        def __init__(self, aggregate_id, on_passivate, on_stopped):
+            self.aggregate_id = aggregate_id
+            self.mail = []
+
+        def start(self):
+            pass
+
+        def deliver(self, env):
+            self.mail.append(env)
+
+    async def scenario():
+        shard = Shard("t-0", _Entity, tracer=None)
+
+        def detonate(*a, **k):
+            raise AssertionError("tracer=None path touched tracing machinery")
+
+        with mock.patch.object(shard_mod, "inject_context", detonate):
+            fut = asyncio.get_running_loop().create_future()
+            shard.deliver("agg", Envelope(message="m", reply=fut))
+        assert shard.live_entity("agg").mail
+
+    asyncio.run(scenario())
