@@ -555,6 +555,152 @@ def steady_state_latency(seconds: float, overrides: dict | None = None,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def failover_bench() -> dict:
+    """SURGE_BENCH_FAILOVER=1: kill the replicated log leader under load and
+    measure the unavailability window while PROVING zero-loss/zero-duplicate
+    delivery (docs/operations.md failover runbook).
+
+    A leader⇄follower broker pair runs with auto-promotion armed; worker
+    threads drive sequential commits through the publisher-protocol retry
+    ladder (verbatim retry, reopen-on-fence — the txn-seq dedup window owns
+    exactly-once); mid-run the leader is hard-killed. Reported:
+
+    - ``failover_unavailability_ms`` — the longest gap between consecutive
+      successful acks across all workers (the outage the client actually saw);
+    - ``acked_commits`` / ``lost`` / ``duplicated`` — ledger vs the promoted
+      leader's log (both MUST be 0).
+
+    Env: SURGE_BENCH_FAILOVER_WORKERS (16), SURGE_BENCH_FAILOVER_SECONDS (6;
+    the kill lands ~40% in)."""
+    import threading
+
+    from surge_tpu.config import Config
+    from surge_tpu.log import (GrpcLogTransport, InMemoryLog, LogRecord,
+                               LogServer, TopicSpec)
+    from surge_tpu.log.transport import NotLeaderError, ProducerFencedError
+
+    workers = int(os.environ.get("SURGE_BENCH_FAILOVER_WORKERS", 16))
+    seconds = float(os.environ.get("SURGE_BENCH_FAILOVER_SECONDS", 6.0))
+    cfg = Config(overrides={
+        "surge.log.replication-ack-timeout-ms": 1_500,
+        "surge.log.replication-isr-timeout-ms": 2_000,
+        "surge.log.failover.probe-interval-ms": 150,
+        "surge.log.failover.probe-failures": 2,
+    })
+    lport, fport = _free_ports(2)
+    follower = LogServer(InMemoryLog(), port=fport,
+                         follower_of=f"127.0.0.1:{lport}", auto_promote=True,
+                         config=cfg)
+    follower.start()
+    leader = LogServer(InMemoryLog(), port=lport,
+                       replicate_to=[f"127.0.0.1:{fport}"], config=cfg)
+    leader.start()
+    targets = f"127.0.0.1:{lport},127.0.0.1:{fport}"
+    setup = GrpcLogTransport(targets, config=cfg)
+    setup.create_topic(TopicSpec("ev", 1))
+
+    stop_at = time.monotonic() + seconds
+    kill_at = time.monotonic() + 0.4 * seconds
+    acked_lock = threading.Lock()
+    acked: list = []          # payloads acked to the "user"
+    ack_times: list = []      # monotonic stamps of every successful ack
+
+    def worker(w: int) -> None:
+        client = GrpcLogTransport(targets, config=cfg)
+        producer = None
+        i = 0
+        try:
+            while time.monotonic() < stop_at:
+                payload = f"w{w}-{i}".encode()
+                deadline = time.monotonic() + 30.0
+                while True:
+                    try:
+                        if producer is None:
+                            producer = client.transactional_producer(
+                                f"bench-fo-{w}")
+                        producer.begin()
+                        producer.send(LogRecord(topic="ev", key=f"w{w}",
+                                                value=payload, partition=0))
+                        producer.commit()
+                        break
+                    except (ProducerFencedError, NotLeaderError):
+                        producer = None
+                    except Exception:  # noqa: BLE001 — broker mid-failover
+                        if producer is not None and producer.in_transaction:
+                            producer.abort()
+                        time.sleep(0.05)
+                    if time.monotonic() > deadline:
+                        return  # counted as in-doubt, never acked
+                with acked_lock:
+                    acked.append(payload)
+                    ack_times.append(time.monotonic())
+                i += 1
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(workers)]
+    for t in threads:
+        t.start()
+    killed_at = None
+    while time.monotonic() < stop_at:
+        if killed_at is None and time.monotonic() >= kill_at:
+            leader.kill()
+            killed_at = time.monotonic()
+            log("failover bench: leader killed")
+        time.sleep(0.02)
+    for t in threads:
+        t.join(60.0)
+
+    if killed_at is not None:
+        deadline = time.monotonic() + 30
+        while follower.role != "leader" and time.monotonic() < deadline:
+            time.sleep(0.02)
+    # unavailability: the longest gap between consecutive acks anywhere
+    # (covers the kill → promotion → first post-failover ack window)
+    gaps = [b - a for a, b in zip(ack_times, ack_times[1:])]
+    unavailability_ms = round(max(gaps) * 1000.0, 1) if gaps else None
+    present: dict = {}
+    for r in follower.log.read("ev", 0):
+        present[r.value] = present.get(r.value, 0) + 1
+    lost = sum(1 for p in acked if present.get(p, 0) == 0)
+    duplicated = sum(1 for p in acked if present.get(p, 0) > 1)
+    setup.close()
+    leader.stop()
+    follower.stop()
+    out = {
+        "failover_unavailability_ms": unavailability_ms,
+        "acked_commits": len(acked),
+        "lost": lost,
+        "duplicated": duplicated,
+        "promoted": follower.role == "leader",
+        "epoch": follower.epoch,
+        "workers": workers,
+        "seconds": seconds,
+    }
+    if lost or duplicated:
+        out["FAILED"] = "acked-record loss or duplication detected"
+    log(f"failover bench: {len(acked)} acked, lost={lost} "
+        f"duplicated={duplicated}, unavailability "
+        f"{unavailability_ms}ms, promoted={out['promoted']}")
+    return out
+
+
+def _free_ports(n: int) -> list:
+    import socket
+
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 def producer_sweep(seconds: float) -> list:
     """Sweep the group-commit knobs at one fixed rung — the before/after
     evidence for the adaptive publisher. The ``linger_ms=50, max_in_flight=1,
@@ -777,6 +923,17 @@ def main() -> None:
     # throughput ladder + producer sweep WITHOUT the 100M-event corpus
     # build/replay (the replay numbers are untouched by producer work, and
     # the corpus build dominates a full run's wall clock)
+    # SURGE_BENCH_FAILOVER=1: leader-kill chaos bench — unavailability
+    # window + zero-loss/zero-duplicate proof, no corpus build
+    if os.environ.get("SURGE_BENCH_FAILOVER", "0") == "1":
+        payload = {"metric": "failover_unavailability_ms", "value": 0,
+                   "unit": "ms"}
+        stats = failover_bench()
+        payload.update(stats)
+        payload["value"] = stats.get("failover_unavailability_ms") or 0
+        emit(payload)
+        return
+
     if os.environ.get("SURGE_BENCH_LADDER", "0") == "1":
         payload = {"metric": "commands_per_sec", "value": 0,
                    "unit": "commands/s"}

@@ -27,6 +27,11 @@ METHODS = {
     # every compacted topic); the stats ride MetricsReply as JSON
     "CompactLog": (pb.ComponentRequest, pb.MetricsReply),
     "WriteCheckpoint": (pb.Empty, pb.ComponentReply),
+    # fault-injection plane (surge_tpu.testing.faults) against the ENGINE's
+    # in-process log — the broker-side twin is LogService.ArmFaults.
+    # ComponentRequest.name carries "arm:<seed>:<plan>" ("arm:7:flaky-network",
+    # "arm:0:{json}"), "disarm", or "status"; stats ride MetricsReply as JSON
+    "ArmFaults": (pb.ComponentRequest, pb.MetricsReply),
 }
 
 
@@ -111,6 +116,48 @@ class AdminServer:
         except Exception as exc:  # noqa: BLE001 — operator gets the failure back
             return pb.ComponentReply(ok=False, detail=repr(exc))
 
+    async def ArmFaults(self, request, context) -> pb.MetricsReply:
+        """Arm/disarm/inspect a fault plane on the engine's IN-PROCESS log
+        (FileLog WAL sites; chaos against a remote broker goes through the
+        broker's own ArmFaults RPC / tools/chaos.py instead)."""
+        from surge_tpu.testing.faults import FaultPlane
+
+        op, _, rest = (request.name or "status").partition(":")
+        log = self.engine.log
+        try:
+            if op == "arm":
+                seed_str, _, spec = rest.partition(":")
+                try:
+                    seed = int(seed_str or 0)
+                except ValueError:
+                    seed, spec = 0, rest  # bare "arm:<plan>" (no seed)
+                plane = FaultPlane.from_spec(spec, seed=seed,
+                                             metrics=self.engine.metrics)
+                current = getattr(log, "faults", None)
+                if current is None:
+                    if not hasattr(log, "faults"):
+                        return pb.MetricsReply(metrics_json=json.dumps(
+                            {"error": f"{type(log).__name__} has no fault "
+                                      "hooks; arm the broker instead"}
+                        ).encode())
+                    log.faults = plane
+                else:
+                    current.arm(plane.rules, seed=plane.seed)
+            elif op == "disarm":
+                plane = getattr(log, "faults", None)
+                if plane is not None:
+                    plane.disarm()
+            elif op != "status":
+                return pb.MetricsReply(metrics_json=json.dumps(
+                    {"error": f"unknown op {op!r}"}).encode())
+            plane = getattr(log, "faults", None)
+            stats = plane.stats() if plane is not None else {
+                "rules": [], "injected": 0, "crashed": None}
+            return pb.MetricsReply(metrics_json=json.dumps(stats).encode())
+        except Exception as exc:  # noqa: BLE001 — operator gets it back
+            return pb.MetricsReply(metrics_json=json.dumps(
+                {"error": repr(exc)}).encode())
+
     async def StopEngine(self, request, context) -> pb.ComponentReply:
         try:
             await self.engine.stop()
@@ -172,6 +219,22 @@ class AdminClient:
     async def write_checkpoint(self) -> tuple[bool, str]:
         r = await self._calls["WriteCheckpoint"](pb.Empty())
         return r.ok, r.detail
+
+    async def arm_faults(self, spec: str, seed: int = 0) -> dict:
+        """Arm a named plan / JSON rules on the engine's in-process log;
+        ``seed`` pins the plane's deterministic schedule for reproducibility
+        (the broker-side twin takes it via TxnRequest.txn_seq)."""
+        r = await self._calls["ArmFaults"](
+            pb.ComponentRequest(name=f"arm:{seed}:{spec}"))
+        return json.loads(r.metrics_json)
+
+    async def disarm_faults(self) -> dict:
+        r = await self._calls["ArmFaults"](pb.ComponentRequest(name="disarm"))
+        return json.loads(r.metrics_json)
+
+    async def fault_stats(self) -> dict:
+        r = await self._calls["ArmFaults"](pb.ComponentRequest(name="status"))
+        return json.loads(r.metrics_json)
 
     async def stop_engine(self) -> tuple[bool, str]:
         r = await self._calls["StopEngine"](pb.Empty())

@@ -140,6 +140,29 @@ DEFAULTS: dict[str, Any] = {
     # out of order) before answering retriable — the client retries the
     # same seq, preserving exactly-once
     "surge.log.txn-inorder-timeout-ms": 3_000,
+    # --- leader failover (KIP-101/KIP-279 epoch fencing; docs/operations.md) ---
+    # a follower started with follower_of= may probe its leader and promote
+    # itself once the prober declares it dead (probe-failures consecutive
+    # failures at probe-interval). The declare threshold is the availability/
+    # split-brain dial: promotion while the leader still serves forks the log.
+    "surge.log.failover.auto-promote": False,
+    "surge.log.failover.probe-interval-ms": 1_000,
+    "surge.log.failover.probe-failures": 3,
+    # a peer NEVER seen alive gets probe-failures x this grace before being
+    # declared dead (a follower booting first must not promote over a leader
+    # that is still starting; bounded so a truly absent leader still fails over)
+    "surge.log.failover.bootstrap-grace-factor": 10,
+    # --- FileLog WAL journal rotation ---
+    # rotate commits.log (which embeds WAL payloads) once its durable bytes
+    # exceed this: segments are fsynced first, then a frontier line opens the
+    # fresh journal and os.replace GCs the old generation. 0 disables.
+    "surge.log.journal-rotate-bytes": 64 << 20,
+    # --- fault-injection plane (surge_tpu.testing.faults) ---
+    # a named plan (e.g. "flaky-network") or JSON rule list armed at broker/
+    # FileLog construction; empty = no plane, hooks cost one attribute check.
+    # Runtime arming: the broker's ArmFaults RPC (tools/chaos.py).
+    "surge.log.faults.plan": "",
+    "surge.log.faults.seed": 0,
     # --- health (common reference.conf:228-260) ---
     "surge.health.window-frequency-ms": 10_000,
     "surge.health.window-buffer-size": 10,

@@ -124,6 +124,11 @@ class SurgeEngine(Controllable):
         # the same way, SurgeMessagePipeline.scala:56-87)
         self.metrics_registry = Metrics()
         self.metrics = engine_metrics(self.metrics_registry)
+        if getattr(self.log, "metrics", False) is None:
+            # a broker-backed transport (GrpcLogTransport) counts its
+            # failover rolls / NOT_LEADER redirects into this engine's
+            # registry (surge.log.failover.*) unless the caller wired its own
+            self.log.metrics = self.metrics
         self.tracer = tracer  # None = tracing disabled (zero per-message overhead)
         self.health_bus = HealthSignalBus(
             self.config.get_int("surge.health.signal-buffer-size", 25))

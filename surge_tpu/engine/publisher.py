@@ -52,7 +52,11 @@ from typing import (Callable, Deque, Dict, List, Mapping, Optional, Protocol,
 
 from surge_tpu.common import BackgroundTask, fail_future, logger, resolve_future
 from surge_tpu.config import Config, default_config
-from surge_tpu.log.transport import LogRecord, ProducerFencedError
+from surge_tpu.log.transport import (
+    LogRecord,
+    NotLeaderError,
+    ProducerFencedError,
+)
 
 
 class PublishFailedError(Exception):
@@ -923,7 +927,18 @@ class PartitionPublisher:
         if self.still_owner():
             self.stats.reinitializations += 1
             self.on_signal("surge.producer.reinitializing", "warning")
-            await self._initialize()
+            try:
+                await self._initialize()
+            except NotLeaderError as exc:
+                # the broker cluster is mid-failover (every reachable broker
+                # is a follower; promotion has not landed yet): stay fenced
+                # and retry on the housekeeping tick — a warning, not the
+                # error-spam an exception escape would log
+                self.state = "fenced"
+                self.on_signal("surge.producer.waiting-for-leader", "warning")
+                logger.warning(
+                    "publisher %s[%d] waiting for a log leader: %s",
+                    self.state_topic, self.partition, exc)
         else:
             self.on_signal("surge.producer.shutdown-not-owner", "warning")
             # runs inside the flush loop: mark stopped now, cancel the loops from a

@@ -14,11 +14,95 @@ warning with the same "possible starvation" message intent.
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from typing import Callable, Optional
 
 from surge_tpu.common import logger
 from surge_tpu.config import Config, default_config
+
+
+class BrokerLivenessProber:
+    """Thread-based peer-liveness prober for the (synchronous) log broker:
+    pings a target on an interval and declares it DEAD after a streak of
+    consecutive failures — the failure-detector half of automatic leader
+    failover (``surge.log.failover.*``). A follower runs one against its
+    leader; ``on_dead`` fires exactly once (self-promotion), after which the
+    prober retires itself.
+
+    Deliberately conservative: one slow probe never kills a leader — only an
+    unbroken failure streak does — and the declare threshold × interval is
+    the unavailability floor an operator tunes against split-brain risk
+    (docs/operations.md failover runbook)."""
+
+    def __init__(self, target: str, ping: Callable[[], None],
+                 config: Config | None = None,
+                 on_dead: Optional[Callable[[], None]] = None,
+                 on_signal: Optional[Callable[[str, str], None]] = None) -> None:
+        cfg = config or default_config()
+        self.target = target
+        self.interval_s = cfg.get_seconds(
+            "surge.log.failover.probe-interval-ms", 1_000)
+        self.failures_needed = max(1, cfg.get_int(
+            "surge.log.failover.probe-failures", 3))
+        self._ping = ping
+        self._on_dead = on_dead or (lambda: None)
+        self._on_signal = on_signal or (lambda name, level: None)
+        #: bootstrap grace: a peer NEVER seen alive is probably still booting
+        #: (follower started first) — promoting over it would split the brain
+        #: the moment it arrives, so the declare threshold is multiplied
+        #: until the first successful probe. Bounded, not infinite: a leader
+        #: that truly never comes up must still fail over eventually.
+        self.bootstrap_factor = max(1, cfg.get_int(
+            "surge.log.failover.bootstrap-grace-factor", 10))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.failure_streak = 0
+        self.declared_dead = False
+        self.probes = 0
+        self.ever_alive = False
+
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name=f"surge-broker-prober-{self.target}",
+                daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(self.interval_s + 2.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.probes += 1
+            try:
+                self._ping()
+                self.failure_streak = 0
+                self.ever_alive = True
+            except Exception as exc:  # noqa: BLE001 — the failure being counted
+                needed = self.failures_needed * (
+                    1 if self.ever_alive else self.bootstrap_factor)
+                self.failure_streak += 1
+                logger.warning("broker %s probe failed (%d/%d): %r",
+                               self.target, self.failure_streak,
+                               needed, exc)
+                self._on_signal("broker.probe-failed", "warning")
+                if self.failure_streak >= needed:
+                    self.declared_dead = True
+                    logger.error("broker %s declared DEAD after %d "
+                                 "consecutive probe failures", self.target,
+                                 self.failure_streak)
+                    self._on_signal("broker.dead", "error")
+                    try:
+                        self._on_dead()
+                    except Exception:  # noqa: BLE001
+                        logger.exception("on_dead callback failed")
+                    return  # one-shot: the promotion owns what happens next
 
 
 class EventLoopProber:

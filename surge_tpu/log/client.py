@@ -17,6 +17,7 @@ import asyncio
 import threading
 import time
 from concurrent.futures import Future as _ConcurrentFuture
+from random import Random
 from typing import Dict, List, Mapping, Optional, Sequence
 
 import grpc
@@ -25,6 +26,7 @@ from surge_tpu.log import log_service_pb2 as pb
 from surge_tpu.log.server import METHODS, SERVICE, msg_to_record, record_to_msg
 from surge_tpu.log.transport import (
     LogRecord,
+    NotLeaderError,
     ProducerFencedError,
     TopicSpec,
     TransactionStateError,
@@ -202,8 +204,13 @@ class GrpcLogTransport:
     without duplicating an acked-but-reply-lost commit."""
 
     def __init__(self, target, config=None,
-                 auto_create_partitions: int = 1, tracer=None) -> None:
+                 auto_create_partitions: int = 1, tracer=None,
+                 metrics=None) -> None:
         self.tracer = tracer  # client-side broker-call spans (None = zero cost)
+        self.metrics = metrics  # EngineMetrics quiver: failover counters (optional)
+        #: jitter source for failover/redirect backoff: simultaneous clients
+        #: re-probing a promoting broker must not arrive in lockstep
+        self._rng = Random()
         if isinstance(target, str):
             self.targets = [t.strip() for t in target.split(",") if t.strip()]
         else:
@@ -254,6 +261,35 @@ class GrpcLogTransport:
                 return  # another caller already rolled
             self.generation += 1
             self._connect(self.targets.index(self.target) + 1)
+        if self.metrics is not None:
+            self.metrics.failover_rolls.record()
+
+    def _redirect(self, from_generation: int, target: str) -> bool:
+        """Follow a NOT_LEADER redirect: reconnect to the hinted broker
+        (learning it if absent from the endpoint list) and bump the
+        generation so producers opened against the old broker re-open. A
+        hint pointing at the broker we are already on is a follower whose
+        leader has not promoted yet — not followable; the caller backs off
+        (jittered) and retries instead."""
+        if not target:
+            return False
+        with self._lock:
+            if self.generation != from_generation:
+                return True  # another caller already moved
+            if target == self.target:
+                return False
+            if target not in self.targets:
+                self.targets.append(target)
+            self.generation += 1
+            self._connect(self.targets.index(target))
+        if self.metrics is not None:
+            self.metrics.failover_redirects.record()
+        return True
+
+    def _jittered(self, backoff: float) -> float:
+        """Randomized sleep in [backoff/2, backoff): retry storms against a
+        broker mid-promotion decorrelate instead of arriving in waves."""
+        return backoff * (0.5 + 0.5 * self._rng.random())
 
     def _span_and_metadata(self, name: str, **attrs):
         """(span, gRPC metadata) for one broker call — the traceparent crosses
@@ -306,7 +342,7 @@ class GrpcLogTransport:
                 if (code == grpc.StatusCode.UNAVAILABLE
                         and len(self.targets) > 1):
                     self._failover(gen)
-                time.sleep(0.05)
+                time.sleep(self._jittered(0.1))
         raise last
 
     # -- topics ---------------------------------------------------------------------------
@@ -339,12 +375,31 @@ class GrpcLogTransport:
     # -- producers ------------------------------------------------------------------------
 
     def transactional_producer(self, transactional_id: str) -> GrpcTxnProducer:
-        reply = self._invoke("OpenProducer",
-                             pb.OpenProducerRequest(
-                                 transactional_id=transactional_id))
-        return GrpcTxnProducer(self, reply.producer_token,
-                               generation=self.generation,
-                               next_seq=reply.last_txn_seq + 1)
+        """Open a producer ON THE LEADER: a follower answers a NOT_LEADER
+        redirect, which is followed (hint) or retried with jittered backoff
+        (mid-promotion: the follower IS the next leader, it just has not
+        promoted yet) — the publisher's re-init ladder sits above this, so
+        bounded patience here beats failing fast."""
+        backoff = 0.1
+        last_error = ""
+        for attempt in range(8):
+            gen = self.generation
+            reply = self._invoke("OpenProducer",
+                                 pb.OpenProducerRequest(
+                                     transactional_id=transactional_id))
+            if not reply.error_kind:
+                return GrpcTxnProducer(self, reply.producer_token,
+                                       generation=self.generation,
+                                       next_seq=reply.last_txn_seq + 1)
+            last_error = reply.error
+            if reply.error_kind != "not_leader":
+                raise TransactionStateError(reply.error)
+            if not self._redirect(gen, reply.leader_hint):
+                time.sleep(self._jittered(backoff))
+                backoff = min(backoff * 2, 1.0)
+        raise NotLeaderError(
+            f"no leader found for producer open after redirects: {last_error}",
+            leader_hint="")
 
     def _submit_transact(self, producer: GrpcTxnProducer,
                          handle: PipelinedCommit) -> None:
@@ -432,7 +487,23 @@ class GrpcLogTransport:
                 if span is not None:
                     span.add_event("retry", {"attempt": attempt,
                                              "code": str(code)})
-                time.sleep(backoff)
+                time.sleep(self._jittered(backoff))
+                backoff = min(backoff * 2, 0.4)
+                continue
+            if not reply.ok and reply.error_kind == "not_leader":
+                # the broker we were writing to is (now) a follower: follow
+                # its redirect (or wait out a promotion with jittered
+                # backoff), then surface as fencing — the publisher re-opens
+                # on the leader and the replicated txn-dedup window keeps a
+                # landed commit from doubling.
+                if generation is not None:
+                    self._redirect(generation, reply.leader_hint)
+                    raise ProducerFencedError(
+                        f"NOT_LEADER: {reply.error} "
+                        f"(hint {reply.leader_hint or 'none'})")
+                if attempt == attempts - 1:
+                    raise NotLeaderError(reply.error, reply.leader_hint)
+                time.sleep(self._jittered(backoff))
                 backoff = min(backoff * 2, 0.4)
                 continue
             if not reply.ok and reply.error_kind == "retriable" and seq:
@@ -444,7 +515,7 @@ class GrpcLogTransport:
                 if attempt == attempts - 1:
                     raise ProducerFencedError(
                         f"replication unresolved: {reply.error}")
-                time.sleep(backoff)
+                time.sleep(self._jittered(backoff))
                 backoff = min(backoff * 2, 0.4)
                 continue
             return reply
@@ -488,6 +559,56 @@ class GrpcLogTransport:
         reply = self._invoke("LatestByKey", pb.OffsetRequest(
             topic=topic, partition=partition))
         return {m.key: msg_to_record(m) for m in reply.records}
+
+    # -- broker admin plane ---------------------------------------------------------------
+
+    def broker_status(self) -> dict:
+        """The connected broker's role/epoch/leader-hint view (failover
+        introspection; the chaos CLI's status command)."""
+        import json
+
+        reply = self._invoke("BrokerStatus", pb.ListTopicsRequest())
+        if not reply.ok:
+            raise RuntimeError(f"BrokerStatus failed: {reply.error}")
+        return json.loads(reply.records[0].value)
+
+    def promote_follower(self, replicate_to: Optional[Sequence[str]] = None
+                         ) -> dict:
+        """Promote the CONNECTED broker to leader (admin failover trigger);
+        returns its new broker status."""
+        import json
+
+        req = pb.TxnRequest(op="promote")
+        if replicate_to is not None:
+            req.records.append(pb.RecordMsg(has_value=True, value=json.dumps(
+                {"replicate_to": list(replicate_to)}).encode()))
+        reply = self._invoke("PromoteFollower", req)
+        if not reply.ok:
+            raise RuntimeError(f"PromoteFollower failed: {reply.error}")
+        return json.loads(reply.records[0].value)
+
+    def arm_faults(self, spec: str, seed: int = 0) -> dict:
+        """Arm a named fault plan or JSON rule list on the connected broker
+        (surge_tpu.testing.faults); returns the plane's stats."""
+        return self._faults_op("arm", spec, seed)
+
+    def disarm_faults(self) -> dict:
+        return self._faults_op("disarm", "", 0)
+
+    def fault_stats(self) -> dict:
+        return self._faults_op("status", "", 0)
+
+    def _faults_op(self, op: str, spec: str, seed: int) -> dict:
+        import json
+
+        req = pb.TxnRequest(op=op, txn_seq=seed)
+        if spec:
+            req.records.append(pb.RecordMsg(has_value=True,
+                                            value=spec.encode()))
+        reply = self._invoke("ArmFaults", req)
+        if not reply.ok:
+            raise RuntimeError(f"ArmFaults({op}) failed: {reply.error}")
+        return json.loads(reply.records[0].value)
 
     def compact_topic(self, topic: str, partition: int) -> dict:
         """Trigger broker-side compaction of one compacted-topic partition;

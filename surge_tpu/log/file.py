@@ -132,11 +132,29 @@ class FileLog(LogBase):
     for tests/benches).
     """
 
+    #: rotate the commit journal once this many bytes are durably covered
+    #: (surge.log.journal-rotate-bytes overrides via the ``journal_rotate_bytes``
+    #: parameter); 0 disables rotation
+    DEFAULT_JOURNAL_ROTATE_BYTES = 64 << 20
+
     def __init__(self, root: str, fsync: str = "commit",
-                 auto_create_partitions: int = 1) -> None:
+                 auto_create_partitions: int = 1,
+                 journal_rotate_bytes: Optional[int] = None,
+                 faults=None) -> None:
         self.root = root
         self._fsync = fsync == "commit"
         self._auto_create_partitions = auto_create_partitions
+        if journal_rotate_bytes is None:
+            from surge_tpu.config import default_config
+
+            journal_rotate_bytes = default_config().get_int(
+                "surge.log.journal-rotate-bytes",
+                self.DEFAULT_JOURNAL_ROTATE_BYTES)
+        self._rotate_bytes = journal_rotate_bytes
+        #: armed fault plane (surge_tpu.log.transport.FaultInjector) or None;
+        #: sites: journal.write (torn), fsync.journal / fsync.segment,
+        #: crash.journal.post-write
+        self.faults = faults
         self._lock = threading.RLock()
         self._topics: Dict[str, TopicSpec] = {}
         self._epochs: Dict[str, int] = {}
@@ -406,6 +424,24 @@ class FileLog(LogBase):
             out, my_target, touched, marks = self._append_locked(records)
         return self._append_finish(out, my_target, touched, marks)
 
+    def append_verbatim(self, records: Sequence[LogRecord],
+                        allow_gaps: bool = False) -> List[LogRecord]:
+        """Append leader-assigned records AS-IS — offsets and timestamps
+        preserved so a replica's segment files converge byte-identically with
+        its leader's (the follower half of ship-on-commit replication;
+        ``allow_gaps`` for catch_up over a compacted leader partition)."""
+        with self._lock:
+            out, my_target, touched, marks = self._append_locked(
+                records, verbatim=True, allow_gaps=allow_gaps)
+        return self._append_finish(out, my_target, touched, marks)
+
+    def applied_end_offset(self, topic: str, partition: int) -> int:
+        """The applied frontier (ahead of the durable ``end_offset`` while a
+        group-sync round is open) — replica gap checks measure against this."""
+        with self._lock:
+            self.topic(topic)
+            return self._parts[(topic, partition)].end_offset
+
     def _append_finish(self, out: List[LogRecord], my_target: int,
                        touched, marks) -> List[LogRecord]:
         if touched:
@@ -414,6 +450,14 @@ class FileLog(LogBase):
             # the whole group) while other committers write theirs
             if self._fsync:
                 self._commit_sync(my_target)
+            elif self._rotate_bytes and my_target > self._rotate_bytes:
+                # no group-sync worker runs under fsync="none", so rotation
+                # must trigger from the append path or commits.log (which
+                # embeds WAL payloads) grows without bound
+                try:
+                    self._maybe_rotate_journal()
+                except Exception:  # noqa: BLE001 — rotation is opportunistic
+                    logger.exception("journal rotation failed; will retry")
             self._mark_durable(marks)
             self._notify_append(touched)
         return out
@@ -428,10 +472,17 @@ class FileLog(LogBase):
                 if end > part.durable_offset:
                     part.durable_offset = end
 
-    def _append_locked(self, records: Sequence[LogRecord]):
+    def _append_locked(self, records: Sequence[LogRecord],
+                       verbatim: bool = False, allow_gaps: bool = False):
         """Phase 1 of one transaction (caller holds the log lock): assign
         offsets, write blocks + the journal line (page cache), stage indexes.
-        Returns (records_with_offsets, journal_target, touched_partitions)."""
+        Returns (records_with_offsets, journal_target, touched_partitions).
+
+        ``verbatim`` (replica ingest) keeps the caller's offsets AND
+        timestamps — a replica converges byte-identically with its leader —
+        splitting each partition's records into contiguous-offset runs (one
+        block per run; a block's decode assigns ``base+i``, so it must never
+        span an offset hole)."""
         if not records:
             return [], 0, set(), []
         out: List[LogRecord] = []
@@ -442,11 +493,22 @@ class FileLog(LogBase):
             key = (r.topic, r.partition)
             if key not in self._parts:
                 raise KeyError(f"{r.topic}[{r.partition}] does not exist")
-            assigned = LogRecord(
-                topic=r.topic, key=r.key, value=r.value, partition=r.partition,
-                headers=dict(r.headers),
-                offset=self._parts[key].end_offset + len(grouped.get(key, [])),
-                timestamp=now)
+            if verbatim:
+                prev = grouped.get(key)
+                expect = (prev[-1].offset + 1 if prev
+                          else self._parts[key].end_offset)
+                if r.offset < expect or (r.offset > expect and not allow_gaps):
+                    raise ValueError(
+                        f"verbatim append at {r.topic}[{r.partition}]@"
+                        f"{r.offset} but applied end is {expect}")
+                assigned = r
+            else:
+                assigned = LogRecord(
+                    topic=r.topic, key=r.key, value=r.value,
+                    partition=r.partition, headers=dict(r.headers),
+                    offset=self._parts[key].end_offset
+                    + len(grouped.get(key, [])),
+                    timestamp=now)
             grouped.setdefault(key, []).append(assigned)
             out.append(assigned)
 
@@ -458,38 +520,71 @@ class FileLog(LogBase):
         try:
             for (topic, p), recs in grouped.items():
                 part = self._parts[(topic, p)]
-                base = part.end_offset
-                block = seg.encode_block(recs, base)
+                # contiguous-offset runs (one block each); the assign path is
+                # always a single run
+                runs: List[List[LogRecord]] = [[recs[0]]]
+                for r in recs[1:]:
+                    if r.offset == runs[-1][-1].offset + 1:
+                        runs[-1].append(r)
+                    else:
+                        runs.append([r])
                 if part.file is None:
                     existed = os.path.exists(part.path)
                     part.file = open(part.path, "ab")
                     if self._fsync and not existed:
                         _fsync_dir(os.path.dirname(part.path))
-                part.file.write(block)
-                part.file.flush()
-                if len(block) <= _EMBED_MAX_BYTES:
-                    # WAL fast path: the journal line carries the block, so
-                    # the segment write may stay in the page cache —
-                    # recovery re-materializes it from the payload
-                    entry_blocks.append(
-                        base64.b64encode(block).decode("ascii"))
-                else:
-                    entry_blocks.append(None)
-                    if self._fsync:
-                        os.fsync(part.file.fileno())
-                new_pos = part.end_pos + len(block)
-                entry_parts.append([topic, p, base, len(recs), new_pos])
-                staged.append((part, base, part.end_pos, new_pos, len(recs)))
+                pos = part.end_pos
+                for run in runs:
+                    base = run[0].offset
+                    block = seg.encode_block(run, base)
+                    part.file.write(block)
+                    part.file.flush()
+                    if len(block) <= _EMBED_MAX_BYTES:
+                        # WAL fast path: the journal line carries the block,
+                        # so the segment write may stay in the page cache —
+                        # recovery re-materializes it from the payload
+                        entry_blocks.append(
+                            base64.b64encode(block).decode("ascii"))
+                    else:
+                        entry_blocks.append(None)
+                        if self._fsync:
+                            if self.faults is not None:
+                                self.faults.on_fsync("segment")
+                            os.fsync(part.file.fileno())
+                    new_pos = pos + len(block)
+                    entry_parts.append([topic, p, base, len(run), new_pos])
+                    staged.append((part, base, pos, new_pos, len(run)))
+                    pos = new_pos
 
             # the commit point: journal line durable => transaction durable
-            self._journal.write((json.dumps(
-                {"parts": entry_parts, "blk": entry_blocks}) + "\n").encode())
+            line = (json.dumps(
+                {"parts": entry_parts, "blk": entry_blocks}) + "\n").encode()
+            if self.faults is not None:
+                torn = self.faults.torn("journal.write", line)
+                if torn is not None:
+                    # crash mid-journal-write: the torn prefix reaches the OS
+                    # (as a real power cut would leave it) and the process
+                    # "dies" here — recovery must discard the torn tail
+                    self._journal.write(torn)
+                    self._journal.flush()
+                    from surge_tpu.testing.faults import SimulatedCrash
+
+                    raise SimulatedCrash("journal.write torn")
+            self._journal.write(line)
             self._journal.flush()
+            if self.faults is not None:
+                # crash AFTER the durable-intent write: recovery must KEEP it
+                self.faults.crash_point("journal.post-write")
             my_target = self._journal.tell()
             with self._gc_cv:
                 if my_target > self._gc_written:
                     self._gc_written = my_target
-        except BaseException:
+        except BaseException as _append_exc:
+            if type(_append_exc).__name__ == "SimulatedCrash":
+                # a simulated crash leaves the torn bytes in place — the
+                # physical rollback below would undo the very state recovery
+                # is being tested against
+                raise
             # physical rollback: a failed commit must leave no orphan block below
             # a later transaction's journaled frontier (recovery would resurrect
             # it as committed data with overlapping offsets). Truncate every
@@ -573,6 +668,8 @@ class FileLog(LogBase):
                 return
             err: Optional[BaseException] = None
             try:
+                if self.faults is not None:
+                    self.faults.on_fsync("journal")
                 os.fsync(self._journal.fileno())
             except BaseException as exc:  # noqa: BLE001 — fail this round's waiters
                 err = exc
@@ -598,6 +695,73 @@ class FileLog(LogBase):
                         fut.set_result(None)
                     else:
                         fut.set_exception(err)
+            if err is None and self._rotate_bytes:
+                try:
+                    self._maybe_rotate_journal()
+                except Exception:  # noqa: BLE001 — rotation is opportunistic
+                    logger.exception("journal rotation failed; will retry "
+                                     "after the next sync round")
+
+    # -- journal rotation -----------------------------------------------------------------
+
+    def _maybe_rotate_journal(self) -> None:
+        """Rotate ``commits.log`` once its durable bytes exceed the rotation
+        threshold: the journal embeds WAL payloads, so unrotated it grows
+        without bound (ROADMAP follow-up). A rotation generation is safe to
+        retire only when every segment byte it backs is durable on its own —
+        so the segments are fsynced FIRST, then a fresh journal whose first
+        line records every partition's frontier atomically replaces the old
+        one (write tmp → fsync → rename → dir fsync). A crash before the
+        rename recovers from the old journal; after it, from the frontier
+        line. ``os.replace`` IS the old generation's GC."""
+        if self._fsync:
+            with self._gc_cv:
+                if self._gc_durable < self._rotate_bytes:
+                    return
+        with self._lock:
+            if not self._fsync and self._journal.tell() < self._rotate_bytes:
+                return  # raced another committer's rotation
+            with self._gc_cv:
+                # quiesced check under both locks: no committer can be writing
+                # (they hold the log lock) and nothing written is unsynced
+                # (the durable counter only advances in fsync mode)
+                if self._gc_stop or self._gc_waiters or (
+                        self._fsync
+                        and self._gc_written != self._gc_durable):
+                    return
+            # segments first: after rotation the old journal's embedded
+            # payloads are gone, so the segment files must stand alone
+            for part in self._parts.values():
+                if part.end_pos <= 0 or not os.path.exists(part.path):
+                    continue
+                if self._fsync:
+                    fd = os.open(part.path, os.O_RDONLY)
+                    try:
+                        os.fsync(fd)
+                    finally:
+                        os.close(fd)
+            entry_parts = [[t, p, part.end_offset, 0, part.end_pos]
+                           for (t, p), part in self._parts.items()
+                           if part.end_offset or part.end_pos]
+            line = (json.dumps({"parts": entry_parts,
+                                "blk": [None] * len(entry_parts),
+                                "rotated": True}) + "\n").encode()
+            tmp = self._journal_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(line)
+                f.flush()
+                if self._fsync:
+                    os.fsync(f.fileno())
+            old_size = self._journal.tell()
+            self._journal.close()
+            os.replace(tmp, self._journal_path)
+            if self._fsync:
+                _fsync_dir(self.root)
+            self._journal = open(self._journal_path, "ab")
+            with self._gc_cv:
+                self._gc_written = self._gc_durable = self._journal.tell()
+            logger.info("rotated commit journal (%d -> %d bytes)",
+                        old_size, self._journal.tell())
 
     # -- reads ----------------------------------------------------------------------------
 
@@ -680,17 +844,140 @@ class FileLog(LogBase):
             part = self._parts[(topic, partition)]
             return part.durable_offset if self._fsync else part.end_offset
 
+    # -- failover truncation --------------------------------------------------------------
+
+    def truncate_partition(self, topic: str, partition: int,
+                           to_offset: int) -> int:
+        """Drop every record at offset >= ``to_offset`` — the KIP-101 role: a
+        deposed leader truncates its divergent unreplicated tail to the new
+        leader's epoch-start offset before rejoining as a follower.
+
+        Crash-safe via the same generational-swap discipline as compaction:
+        the surviving prefix is rewritten to the next generation file (tmp →
+        fsync → rename), the manifest is updated, and a frontier journal line
+        is appended + fsynced so recovery can never resurrect the truncated
+        tail from embedded WAL payloads. Returns the records dropped."""
+        with self._lock:
+            self.topic(topic)
+            key = (topic, partition)
+            part = self._parts[key]
+            if part.end_offset <= to_offset:
+                return 0
+            # blocks wholly below the cut survive VERBATIM (their file-prefix
+            # bytes and positions are unchanged); only blocks at/past the cut
+            # are decoded — the boundary block partially re-encoded, later
+            # ones dropped — so truncation costs O(truncated tail), not
+            # O(partition)
+            split = len(part.blocks)
+            for i, (base, pos, count) in enumerate(part.blocks):
+                if base + count > to_offset:
+                    split = i
+                    break
+            keep_blocks = list(part.blocks[:split])
+            prefix_end = (part.blocks[split][1] if split < len(part.blocks)
+                          else part.end_pos)
+            boundary: List[LogRecord] = []
+            dropped = 0
+            for base, pos, count in part.blocks[split:]:
+                for r in self._decode_block_at(part, topic, partition, pos,
+                                               part.path, part.gen):
+                    if r.offset < to_offset:
+                        boundary.append(r)
+                    else:
+                        dropped += 1
+            runs: List[List[LogRecord]] = []
+            for r in boundary:
+                if runs and r.offset == runs[-1][-1].offset + 1:
+                    runs[-1].append(r)
+                else:
+                    runs.append([r])
+            new_path = self._gen_path(topic, partition, part.gen + 1)
+            tmp = new_path + ".tmp"
+            new_blocks: List[Tuple[int, int, int]] = keep_blocks
+            with open(tmp, "wb") as f:
+                if prefix_end:
+                    with open(part.path, "rb") as src:
+                        while src.tell() < prefix_end:
+                            chunk = src.read(min(1 << 20,
+                                                 prefix_end - src.tell()))
+                            if not chunk:
+                                raise RuntimeError(
+                                    f"{part.path} shorter than its indexed "
+                                    f"prefix {prefix_end}")
+                            f.write(chunk)
+                pos = prefix_end
+                for run in runs:
+                    block = seg.encode_block(run, run[0].offset)
+                    new_blocks.append((run[0].offset, pos, len(run)))
+                    f.write(block)
+                    pos += len(block)
+                f.flush()
+                if self._fsync:
+                    os.fsync(f.fileno())
+            old_path = part.path
+            os.replace(tmp, new_path)
+            if self._fsync:
+                _fsync_dir(os.path.dirname(new_path))
+            if part.file is not None:
+                part.file.close()
+                part.file = None
+            part.path = new_path
+            part.gen += 1
+            part.blocks = new_blocks
+            # the log now ENDS at to_offset: offsets in [last kept + 1,
+            # to_offset) are compaction holes, not reclaimed numbers — the
+            # next append (or replicated record) continues at to_offset,
+            # matching the new leader's numbering
+            part.end_offset = min(part.end_offset, to_offset)
+            part.end_pos = pos
+            part.durable_offset = min(part.durable_offset, part.end_offset)
+            part._cache.clear()
+            part._cache_sizes.clear()
+            part._cache_bytes = 0
+            survivors = sum(c for _b, _p, c in keep_blocks) + len(boundary)
+            clean_end, clean_count = self._clean.get(key, (0, 0))
+            self._clean[key] = (min(clean_end, part.end_offset),
+                                min(clean_count, survivors))
+            self._write_manifest_entry(topic, partition, part)
+            # frontier journal line: recovery's last-line-wins frontier must
+            # reflect the truncation even before the next append (and stale
+            # embedded payloads must never re-materialize the dropped tail —
+            # the manifest's end_pos gates backfill below it)
+            self._journal.write((json.dumps(
+                {"parts": [[topic, partition, part.end_offset, 0,
+                            part.end_pos]], "blk": [None],
+                 "trunc": True}) + "\n").encode())
+            self._journal.flush()
+            if self._fsync:
+                os.fsync(self._journal.fileno())
+            with self._gc_cv:
+                target = self._journal.tell()
+                if target > self._gc_written:
+                    self._gc_written = target
+                if target > self._gc_durable:
+                    self._gc_durable = target
+            try:
+                if old_path != new_path:
+                    os.unlink(old_path)
+            except OSError:
+                pass
+            return dropped
+
     # -- compaction ---------------------------------------------------------------------
 
     def compact_partition(self, topic: str, partition: int, *,
                           tombstone_retention_s: float = 0.0,
-                          now: Optional[float] = None):
+                          now: Optional[float] = None,
+                          upto_offset: Optional[int] = None):
         """Rewrite one partition's segment to latest-record-per-key with
         tombstone GC (policy: surge_tpu.log.compactor.select_retained),
         crash-safely: tmp write → fsync → rename to the next generational
         file → manifest update (the commit point, see module docstring).
         Offsets and ``end_offset`` are preserved; retained records regroup
-        into one block per contiguous offset run."""
+        into one block per contiguous offset run. ``upto_offset`` bounds the
+        pass to blocks wholly below it (the replication compaction barrier:
+        leader and follower compact the identical prefix; later blocks move
+        over verbatim like any post-snapshot tail)."""
         from surge_tpu.log.compactor import CompactionStats, select_retained
 
         t0 = time.perf_counter()
@@ -699,6 +986,16 @@ class FileLog(LogBase):
             part = self._parts[(topic, partition)]
             blocks = list(part.blocks)
             frontier_off, frontier_pos = part.end_offset, part.end_pos
+            if upto_offset is not None and upto_offset < frontier_off:
+                split = len(blocks)
+                for i, (base, pos, count) in enumerate(blocks):
+                    if base + count > upto_offset:
+                        split = i
+                        break
+                blocks = blocks[:split]
+                frontier_off = upto_offset
+                frontier_pos = (part.blocks[split][1] if split < len(part.blocks)
+                                else part.end_pos)
             old_path, gen = part.path, part.gen
         records: List[LogRecord] = []
         for base, pos, count in blocks:  # decode outside the lock (immutable)

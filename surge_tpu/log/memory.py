@@ -204,6 +204,78 @@ class InMemoryLog(LogBase):
             self.topic(topic)
             return self._ends[(topic, partition)]
 
+    def applied_end_offset(self, topic: str, partition: int) -> int:
+        """The applied frontier — identical to ``end_offset`` in memory (no
+        durability lag); FileLog's differs while an fsync round is open."""
+        return self.end_offset(topic, partition)
+
+    # -- replica ingest -----------------------------------------------------------------
+
+    def append_verbatim(self, records: Sequence[LogRecord],
+                        allow_gaps: bool = False) -> List[LogRecord]:
+        """Append leader-assigned records AS-IS — offsets AND timestamps
+        preserved, so a replica converges byte-identically with its leader
+        (the follower half of ship-on-commit replication and catch_up).
+        Offsets must continue each partition's applied end; with
+        ``allow_gaps`` (catch_up over a compacted leader partition) they may
+        jump forward, never backward."""
+        with self._lock:
+            touched = set()
+            for r in records:
+                self.topic(r.topic)
+                key = (r.topic, r.partition)
+                part = self._partitions.get(key)
+                if part is None:
+                    raise KeyError(f"{r.topic}[{r.partition}] does not exist")
+                end = self._ends[key]
+                if r.offset < end or (r.offset > end and not allow_gaps):
+                    raise ValueError(
+                        f"verbatim append at {r.topic}[{r.partition}]@"
+                        f"{r.offset} but applied end is {end}")
+                part.append(r)
+                self._ends[key] = r.offset + 1
+                if r.key is not None:
+                    if r.value is None:
+                        self._latest[key].pop(r.key, None)  # tombstone
+                    else:
+                        self._latest[key][r.key] = r
+                touched.add(key)
+        self._notify_append(touched)
+        return list(records)
+
+    # -- failover truncation ------------------------------------------------------------
+
+    def truncate_partition(self, topic: str, partition: int,
+                           to_offset: int) -> int:
+        """Drop every record at offset >= ``to_offset`` (the KIP-101 role: a
+        deposed leader truncates its divergent unreplicated tail to the new
+        leader's epoch-start offset). Returns how many records were dropped."""
+        with self._lock:
+            self.topic(topic)
+            key = (topic, partition)
+            part = self._partitions[key]
+            cut = bisect_left(part, to_offset, key=lambda r: r.offset)
+            dropped = part[cut:]
+            if not dropped and self._ends[key] <= to_offset:
+                return 0
+            del part[cut:]
+            self._ends[key] = min(self._ends[key], to_offset)
+            # rebuild the per-key latest index for this partition: a dropped
+            # record may have superseded (or tombstoned) a surviving one
+            latest: Dict[str, LogRecord] = {}
+            for r in part:
+                if r.key is None:
+                    continue
+                if r.value is None:
+                    latest.pop(r.key, None)
+                else:
+                    latest[r.key] = r
+            self._latest[key] = latest
+            clean_end, clean_count = self._clean.get(key, (0, 0))
+            if clean_end > to_offset:
+                self._clean[key] = (to_offset, min(clean_count, len(part)))
+            return len(dropped)
+
     def latest_by_key(self, topic: str, partition: int,
                       isolation: str = "read_committed") -> Mapping[str, LogRecord]:
         del isolation
@@ -216,10 +288,14 @@ class InMemoryLog(LogBase):
 
     def compact_partition(self, topic: str, partition: int, *,
                           tombstone_retention_s: float = 0.0,
-                          now: Optional[float] = None):
+                          now: Optional[float] = None,
+                          upto_offset: Optional[int] = None):
         """Rewrite one partition to latest-record-per-key with tombstone GC
         (surge_tpu.log.compactor picks the retained set). Offsets and
-        ``end_offset`` are preserved; only superseded records disappear."""
+        ``end_offset`` are preserved; only superseded records disappear.
+        ``upto_offset`` bounds the pass to records below it (the replication
+        compaction barrier compacts the same prefix on leader and follower;
+        the tail stays verbatim)."""
         from surge_tpu.log.compactor import CompactionStats, select_retained
 
         t0 = time.perf_counter()
@@ -229,11 +305,19 @@ class InMemoryLog(LogBase):
             part = self._partitions[key]
             before = len(part)
             bytes_before = sum(_record_bytes(r) for r in part)
+            if upto_offset is None:
+                head, tail = part, []
+                frontier = self._ends[key]
+            else:
+                cut = bisect_left(part, upto_offset, key=lambda r: r.offset)
+                head, tail = part[:cut], part[cut:]
+                frontier = upto_offset
             retained, dropped_tombstones = select_retained(
-                part, now=now if now is not None else time.time(),
+                head, now=now if now is not None else time.time(),
                 tombstone_retention_s=tombstone_retention_s)
+            retained = retained + tail
             self._partitions[key] = retained
-            self._clean[key] = (self._ends[key], len(retained))
+            self._clean[key] = (frontier, len(retained) - len(tail))
             bytes_after = sum(_record_bytes(r) for r in retained)
             return CompactionStats(
                 topic=topic, partition=partition,

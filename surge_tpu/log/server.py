@@ -90,7 +90,9 @@ class _TxnDedup:
 class _ProducerState:
     """Server-side producer handle bound to its txn id's dedup state."""
 
-    __slots__ = ("txn_id", "producer", "dedup", "lock", "cond", "fresh")
+    __slots__ = ("txn_id", "producer", "dedup", "lock", "cond", "fresh",
+                 "alias_floor", "alias_ceiling", "alias_budget",
+                 "alias_joins")
 
     def __init__(self, txn_id: str, producer, dedup: _TxnDedup) -> None:
         self.txn_id = txn_id
@@ -103,18 +105,38 @@ class _ProducerState:
         #: True until this producer's first Transact: gates the
         #: duplicate-absorption of a reopen-retried batch at last_seq+1
         self.fresh = True
+        #: in-limbo alias window (set by OpenProducer): seqs in
+        #: (alias_floor, alias_ceiling] were APPLIED but not ACKED when this
+        #: producer opened — its numbering starts past them, so its first
+        #: transacts may be verbatim retries of exactly those batches under
+        #: NEW seqs. Up to alias_budget such retries are joined/answered
+        #: from the original (payload-matched), never appended twice.
+        self.alias_floor = 0
+        self.alias_ceiling = 0
+        self.alias_budget = 0
+        #: alias seq -> ORIGINAL in-limbo seq it matched: a retriable-timeout
+        #: retry of the alias must re-join the same original, never append
+        self.alias_joins: Dict[int, int] = {}
 
 
 class _ReplItem:
-    """One ordered replication unit: a committed batch (or bare topic create)."""
+    """One ordered replication unit: a committed batch, a bare topic create,
+    or a compaction BARRIER (kind="barrier": the worker runs the leader-side
+    pass bounded to the in-sync followers' frontier and ships the manifest so
+    every follower applies the identical generational swap)."""
 
-    __slots__ = ("specs", "records", "txn_id", "seq", "done", "error")
+    __slots__ = ("specs", "records", "txn_id", "seq", "done", "error",
+                 "kind", "manifest", "result")
 
-    def __init__(self, specs, records, txn_id: str = "", seq: int = 0) -> None:
+    def __init__(self, specs, records, txn_id: str = "", seq: int = 0,
+                 kind: str = "", manifest: Optional[dict] = None) -> None:
         self.specs = specs
         self.records = records
         self.txn_id = txn_id
         self.seq = seq
+        self.kind = kind
+        self.manifest = manifest
+        self.result = None  # barrier: the leader-side CompactionStats
         self.done = threading.Event()
         self.error: Optional[str] = None
 
@@ -134,6 +156,16 @@ class _TargetState:
 #: record locations); rebuilt into the dedup table at startup so idempotency
 #: survives a broker restart (the Kafka producer-state-snapshot role)
 TXN_STATE_TOPIC = "__txn_state"
+
+#: compacted broker-internal topic persisting this broker's leader-epoch view
+#: (the KIP-101 leader-epoch-checkpoint file role): key "epoch" -> {"e": N},
+#: key "epoch_start" -> the end offsets recorded at promotion, which a fenced
+#: ex-leader truncates its divergent tail to
+META_TOPIC = "__broker_meta"
+
+#: broker-internal topics are self-maintained on EACH side — never replicated,
+#: resynced, compared, or copied by catch_up
+INTERNAL_TOPICS = frozenset({TXN_STATE_TOPIC, META_TOPIC})
 
 SERVICE = "surge_tpu.log.LogService"
 METHODS = {
@@ -156,6 +188,17 @@ METHODS = {
     # ReadRequest carries (topic, partition); the TxnReply answers ok/error
     # and one RecordMsg whose value holds the CompactionStats JSON
     "CompactTopic": (pb.ReadRequest, pb.TxnReply),
+    # broker admin plane (message reuse, same convention as CompactTopic):
+    # ArmFaults — TxnRequest.op arm|disarm|status, records[0].value carries a
+    #   named fault plan or a JSON rule list (surge_tpu.testing.faults); the
+    #   TxnReply's record value answers the plane's stats JSON.
+    # PromoteFollower — TxnRequest.records[0].value optionally carries
+    #   {"replicate_to": [...]}; promotes this broker to leader at epoch+1.
+    # BrokerStatus — role/epoch/leader-hint/epoch-start JSON in the reply
+    #   record (the failover prober's and a fenced ex-leader's view).
+    "ArmFaults": (pb.TxnRequest, pb.TxnReply),
+    "PromoteFollower": (pb.TxnRequest, pb.TxnReply),
+    "BrokerStatus": (pb.ListTopicsRequest, pb.TxnReply),
 }
 
 
@@ -183,6 +226,17 @@ def _same_payload(committed, retried) -> bool:
                for a, b in zip(committed, retried))
 
 
+def _same_payload_and_headers(committed, retried) -> bool:
+    """Stricter batch identity for CROSS-seq matching (the reopen alias
+    window): a verbatim retry carries identical headers too, while a
+    genuinely new batch that merely repeats topic/key/value bytes usually
+    differs there (trace context, request ids) — comparing them shrinks the
+    false-absorption surface to byte-for-byte-identical batches."""
+    return _same_payload(committed, retried) and all(
+        dict(a.headers) == dict(b.headers)
+        for a, b in zip(committed, retried))
+
+
 def msg_to_record(m: pb.RecordMsg) -> LogRecord:
     return LogRecord(topic=m.topic, key=m.key if m.has_key else None,
                      value=m.value if m.has_value else None,
@@ -195,15 +249,24 @@ class LogServer:
 
     def __init__(self, log, host: str = "127.0.0.1", port: int = 0,
                  config=None, max_workers: int = 32,
-                 replicate_to: Optional[list] = None, tracer=None) -> None:
+                 replicate_to: Optional[list] = None, tracer=None,
+                 follower_of: Optional[str] = None,
+                 auto_promote: Optional[bool] = None,
+                 advertised: Optional[str] = None,
+                 faults=None, metrics=None) -> None:
         self.log = log
         self.tracer = tracer  # broker-side transact spans (None = zero cost)
+        self.metrics = metrics  # EngineMetrics quiver (optional): failover/fault counters
         self._host = host
         self._port = port
         self._config = config
         self._max_workers = max_workers
         self._server: Optional[grpc.Server] = None
         self.bound_port: Optional[int] = None
+        #: address other nodes should reach this broker at (NOT_LEADER
+        #: redirects, ship-carried leader hints); defaulted from the bound
+        #: port at start() when not given
+        self.advertised = advertised
         self._producers: Dict[int, "_ProducerState"] = {}  # by token
         self._txn_dedup: Dict[str, _TxnDedup] = {}  # by transactional id
         self._fenced_tokens: "OrderedDict[int, None]" = OrderedDict()
@@ -254,6 +317,45 @@ class LogServer:
         # -- replication (follower side): ordered ingest of leader batches
         self._replica_lock = threading.Lock()
         self._replica_producer = None
+        # -- leader epoch & role (KIP-101/KIP-279 role): every replication
+        # batch carries the shipper's epoch; a follower refuses stale epochs,
+        # a deposed leader learns it was fenced and demotes (truncating its
+        # divergent unreplicated tail to the new leader's epoch-start).
+        # Explicit roles are OPT-IN (follower_of= / PromoteFollower): a plain
+        # LogServer keeps the seed semantics — accepts everything — so
+        # existing single-broker and legacy-failover setups are untouched.
+        self._role_lock = threading.RLock()
+        self._follower_of = follower_of
+        self.role = "follower" if follower_of else "leader"
+        #: where writes should go when this broker is not the leader: the
+        #: configured leader, the last Replicate's advertised source, or the
+        #: peer whose higher epoch fenced us
+        self.leader_hint: str = follower_of or ""
+        self.epoch = 0 if follower_of else 1
+        self.epoch_start: Dict[str, Dict[int, int]] = {}  # at OUR promotion
+        self._meta_producer = None
+        self._recover_meta()
+        self._demoting = False
+        #: armed fault plane (surge_tpu.log.transport.FaultInjector) — param,
+        #: else config (surge.log.faults.plan), else None (hooks cost one
+        #: attribute check). Runtime arming via the ArmFaults RPC.
+        if faults is None:
+            from surge_tpu.log.transport import load_fault_plane
+
+            faults = load_fault_plane(cfg)
+        self.faults = faults
+        if self.faults is not None:
+            self.faults.on_crash = lambda point: self.kill()
+        self._dead = False  # set by kill(): every later RPC answers UNAVAILABLE
+        self.kill_done = None  # threading.Event from kill()'s socket close
+        # automatic promotion: a follower probing its leader declares it dead
+        # after N consecutive failures and promotes itself (the health-prober
+        # driven failover path). Opt-in via auto_promote= or config.
+        if auto_promote is None:
+            auto_promote = cfg.get_bool("surge.log.failover.auto-promote",
+                                        False)
+        self._auto_promote = bool(auto_promote) and follower_of is not None
+        self._leader_prober = None
 
     # -- handlers (sync; called on the server thread pool) --------------------------------
 
@@ -300,6 +402,12 @@ class LogServer:
 
     def OpenProducer(self, request: pb.OpenProducerRequest,
                      context) -> pb.OpenProducerReply:
+        if self.role != "leader":
+            # a follower must never open producers: accepted writes would
+            # fork the log the moment the leader appends — redirect instead
+            return pb.OpenProducerReply(
+                error=f"broker is a {self.role}, not the leader",
+                error_kind="not_leader", leader_hint=self.leader_hint)
         producer = self.log.transactional_producer(request.transactional_id)
         with self._token_lock:
             # prune tokens this open just fenced (the inner log fenced their
@@ -318,19 +426,28 @@ class LogServer:
             # idempotency numbering instead of colliding with it
             dedup = self._txn_dedup.setdefault(request.transactional_id,
                                                _TxnDedup())
-            self._producers[token] = _ProducerState(
-                request.transactional_id, producer, dedup)
+            state = _ProducerState(request.transactional_id, producer, dedup)
+            self._producers[token] = state
         # a seq still awaiting replication counts, as does one applied locally
         # but not yet acked: the new producer must number PAST them, or its
         # first commit could collide with an in-limbo batch
         pending_max = max(
             (s for (tid, s) in list(self._repl_pending)
              if tid == request.transactional_id), default=0)
-        return pb.OpenProducerReply(
-            producer_token=token,
-            last_txn_seq=max(dedup.last_seq, dedup.applied_seq, pending_max))
+        last = max(dedup.last_seq, dedup.applied_seq, pending_max)
+        # the numbered-past window: the client may now re-send those very
+        # batches under fresh seqs — arm the alias absorber for them
+        state.alias_floor = dedup.last_seq
+        state.alias_ceiling = last
+        state.alias_budget = max(0, last - dedup.last_seq)
+        return pb.OpenProducerReply(producer_token=token, last_txn_seq=last)
 
     def Transact(self, request: pb.TxnRequest, context) -> pb.TxnReply:
+        if self.role != "leader":
+            return pb.TxnReply(
+                ok=False, error_kind="not_leader",
+                error=f"broker is a {self.role}, not the leader",
+                leader_hint=self.leader_hint)
         if self.tracer is None:
             return self._transact_impl(request, context)
         # the client ships its traceparent as call metadata: the broker-side
@@ -409,6 +526,54 @@ class LogServer:
                                               cached)
                                 state.cond.notify_all()
                                 return reply
+                    orig = state.alias_joins.get(seq)
+                    if orig is not None:
+                        # a retried alias seq (its earlier join answered
+                        # retriable): re-join the SAME original — by pending
+                        # item if still replicating, from the cache once the
+                        # worker finalized it
+                        pending = self._repl_pending.get(
+                            (state.txn_id, orig))
+                        if pending is not None:
+                            join_item = pending
+                            break
+                        reply = dedup.replies.get(orig)
+                        if reply is None:
+                            loc = dedup.locators.get(orig)
+                            if loc is not None:
+                                reply = self._rebuild_from_locator(loc)
+                        if reply is not None and reply.ok:
+                            self._ack_seq(state.txn_id, dedup, seq, reply,
+                                          [msg_to_record(m)
+                                           for m in reply.records])
+                            state.cond.notify_all()
+                            return reply
+                        # original vanished without a trace (poisoned +
+                        # window-evicted): fall through to the normal path
+                    if state.alias_budget > 0 and seq > dedup.applied_seq:
+                        # reopen ALIAS window: this producer's numbering was
+                        # started PAST seqs that were applied but not acked
+                        # at open (replication in flight when the previous
+                        # life died). Its first transacts may be verbatim
+                        # retries of exactly those batches under new seqs —
+                        # payload-match them against the in-limbo items and
+                        # the recent-reply window, join/answer, never append
+                        # the same batch twice (the failover-bench dup class).
+                        alias = self._alias_match(state, records)
+                        if alias is not None:
+                            kind, hit = alias
+                            state.alias_budget -= 1
+                            if kind == "pending":
+                                state.alias_joins[seq] = hit.seq
+                                join_item = hit
+                                break
+                            # already resolved: answer from its cached reply,
+                            # acked under the NEW seq as well
+                            self._ack_seq(state.txn_id, dedup, seq, hit,
+                                          [msg_to_record(m)
+                                           for m in hit.records])
+                            state.cond.notify_all()
+                            return hit
                     # a previous attempt of this seq appended locally but
                     # timed out waiting for replication: re-join that item,
                     # never re-append. The payload must MATCH — the client may
@@ -493,12 +658,32 @@ class LogServer:
                 if seq:
                     dedup.applied_seq = seq
                     state.cond.notify_all()  # wake the next pipelined seq
+                if self.faults is not None:
+                    # applied locally, nothing replicated/acked yet: the
+                    # canonical lost-unreplicated-tail crash point
+                    self.faults.crash_point("transact.post-apply")
                 if self._repl_targets and committed:
                     join_item = self._enqueue_replication(committed,
                                                           state.txn_id, seq)
+                    if self.faults is not None:
+                        # queued for replication, client not yet acked
+                        self.faults.crash_point("transact.post-enqueue")
                     break
                 if sync_handle is not None:
                     break  # await durability outside the lock
+                if committed and self.role != "leader":
+                    # demoted BETWEEN the entry role gate and this ack (a
+                    # higher epoch fenced us mid-commit, clearing the repl
+                    # targets): the records are now part of OUR divergent
+                    # tail, destined for truncation — acking them would lose
+                    # an acknowledged write. Refuse; the client re-opens on
+                    # the new leader and retries (its dedup has no trace of
+                    # this batch, so it appends there exactly once).
+                    return pb.TxnReply(
+                        ok=False, error_kind="not_leader",
+                        error="demoted while committing; write NOT "
+                              "acknowledged — retry on the leader",
+                        leader_hint=self.leader_hint)
                 reply = pb.TxnReply(ok=True,
                                     records=[record_to_msg(r) for r in committed])
                 if seq:
@@ -527,6 +712,14 @@ class LogServer:
                         error=f"journal sync failed: {exc!r}")
                 state.producer.retry_pipelined(sync_handle)
         with state.lock:
+            if self.role != "leader":
+                # demoted while awaiting the journal round (see the in-lock
+                # twin of this check): never ack a divergent-tail write
+                return pb.TxnReply(
+                    ok=False, error_kind="not_leader",
+                    error="demoted while committing; write NOT "
+                          "acknowledged — retry on the leader",
+                    leader_hint=self.leader_hint)
             reply = pb.TxnReply(ok=True,
                                 records=[record_to_msg(r) for r in committed])
             if seq:
@@ -547,6 +740,30 @@ class LogServer:
         if seq > dedup.applied_seq:
             dedup.applied_seq = seq
         self._persist_txn_state(txn_id, seq, committed)
+
+    def _alias_match(self, state: "_ProducerState", records):
+        """Find the in-limbo (or since-resolved) seq in this reopened
+        producer's alias window whose batch matches ``records`` verbatim.
+        Returns ("pending", _ReplItem) to join, ("reply", TxnReply) to answer
+        from cache, or None (a genuinely new batch). Caller holds the state
+        lock; advances the floor so one original is never matched twice."""
+        dedup = state.dedup
+        for s in range(state.alias_floor + 1, state.alias_ceiling + 1):
+            pending = self._repl_pending.get((state.txn_id, s))
+            if pending is not None and _same_payload_and_headers(
+                    pending.records, records):
+                state.alias_floor = s
+                return ("pending", pending)
+            reply = dedup.replies.get(s)
+            if reply is None:
+                loc = dedup.locators.get(s)
+                if loc is not None:
+                    reply = self._rebuild_from_locator(loc)
+            if reply is not None and reply.ok and _same_payload_and_headers(
+                    [msg_to_record(m) for m in reply.records], records):
+                state.alias_floor = s
+                return ("reply", reply)
+        return None
 
     def _replay_answer(self, dedup: _TxnDedup, seq: int,
                        records) -> pb.TxnReply:
@@ -614,8 +831,17 @@ class LogServer:
         if item.error:
             return pb.TxnReply(ok=False, error_kind="retriable",
                                error=f"replication failed: {item.error}")
-        return pb.TxnReply(ok=True,
-                           records=[record_to_msg(r) for r in item.records])
+        reply = pb.TxnReply(ok=True,
+                            records=[record_to_msg(r) for r in item.records])
+        if seq and seq != item.seq:
+            # alias join (reopened producer re-sent an in-limbo batch under a
+            # NEW seq): the worker finalized the ORIGINAL seq; the alias seq
+            # must enter the dedup window too, so its own replays hit cache
+            with state.lock:
+                self._ack_seq(state.txn_id, state.dedup, seq, reply,
+                              item.records)
+                state.cond.notify_all()
+        return reply
 
     def _insync_count(self) -> int:
         """Size of the in-sync set, leader included (min.insync semantics)."""
@@ -686,6 +912,21 @@ class LogServer:
                         if self._repl_queue and self._repl_queue[0] is head:
                             self._repl_queue.pop(0)
                     self._repl_pending.pop((head.txn_id, head.seq), None)
+                    if head.seq:
+                        # the records ARE durably applied on this leader (a
+                        # skipped ship cannot un-append them; the follower
+                        # re-converges via resync/catch_up) — ack the seq
+                        # into the dedup cache so the client's verbatim
+                        # retry is answered from it instead of livelocking
+                        # on "bookkeeping in flight" forever
+                        dedup = self._txn_dedup.setdefault(head.txn_id,
+                                                           _TxnDedup())
+                        if head.seq > dedup.last_seq:
+                            self._ack_seq(
+                                head.txn_id, dedup, head.seq,
+                                pb.TxnReply(ok=True, records=[
+                                    record_to_msg(r) for r in head.records]),
+                                head.records)
                     head.error = ("poisoned: repeated replication worker "
                                   "exceptions (see broker log)")
                     head.done.set()
@@ -727,6 +968,27 @@ class LogServer:
             if self._repl_stop:
                 return backoff
             item = self._repl_queue[0] if self._repl_queue else None
+        if self.faults is not None and item is not None:
+            # deterministic poison-path site: an injected exception here is
+            # exactly the "head item makes the worker raise" class the
+            # strike counter in _replication_loop bounds
+            self.faults.raise_point("repl.iteration")
+        if item is not None and item.kind == "barrier":
+            err = self._prepare_barrier(item)
+            if err is not None:
+                if err.startswith("retry:"):
+                    item.error = err
+                    time.sleep(backoff)
+                    return min(backoff * 2, 1.0)
+                # a failing leader-side pass is not retriable: fail the
+                # barrier past the queue, loudly
+                with self._repl_cv:
+                    if self._repl_queue and self._repl_queue[0] is item:
+                        self._repl_queue.pop(0)
+                item.error = err
+                item.done.set()
+                logger.error("compaction barrier failed leader-side: %s", err)
+                return backoff
         now = time.monotonic()
         blocking_err = None
         for target in self._repl_targets:
@@ -815,6 +1077,45 @@ class LogServer:
         time.sleep(backoff)
         return min(backoff * 2, 1.0)
 
+    def _prepare_barrier(self, item: _ReplItem) -> Optional[str]:
+        """Leader half of the compaction barrier, run by the worker when the
+        barrier reaches the queue head (every item enqueued before it is on
+        every in-sync follower): bound the pass to the in-sync followers'
+        minimum frontier, compact the leader, and stage the manifest the
+        followers will replay identically. Idempotent across ship retries —
+        the bound, timestamp and expected outcome are pinned on first run."""
+        import json as _json
+
+        m = item.manifest
+        if "upto" in m:
+            return None  # already prepared; ships are retrying
+        topic, p = m["topic"], int(m["partition"])
+        ends = []
+        for target, st in self._repl_target_state.items():
+            if not st.in_sync:
+                continue  # out-of-sync followers re-converge via catch_up
+            try:
+                ends.append(self._remote_end_offset(target, topic, p))
+            except Exception as exc:  # noqa: BLE001 — follower hiccup: retry
+                self._drop_probe_transport(target)
+                return f"retry: barrier frontier probe failed: {target}: {exc!r}"
+        upto = min(ends) if ends else self._applied_end(topic, p)
+        try:
+            stats = self.log.compact_partition(
+                topic, p, tombstone_retention_s=float(m["retention_s"]),
+                now=float(m["now"]), upto_offset=upto)
+        except Exception as exc:  # noqa: BLE001 — surfaced to the operator
+            return f"leader compaction failed: {exc!r}"
+        m["upto"] = upto
+        m["expect_clean_count"] = \
+            self.log.compaction_state(topic, p)["clean_count"]
+        item.result = stats
+        # the manifest rides a topic-less record so _queued_counts never
+        # mistakes it for a queued data record
+        item.records = [LogRecord(topic="", key="barrier",
+                                  value=_json.dumps(m).encode())]
+        return None
+
     def _queued_counts(self) -> Dict[tuple, int]:
         """(topic, partition) -> records still in the replication queue (the
         head item included — commits apply locally BEFORE they enqueue)."""
@@ -885,7 +1186,7 @@ class LogServer:
             lags: list = []  # (spec, partition, theirs, ours)
             total = 0
             for spec in self._topic_specs():
-                if spec.name == TXN_STATE_TOPIC:
+                if spec.name in INTERNAL_TOPICS:
                     # broker-internal dedup annotations are self-maintained on
                     # EACH side (one record per locally-observed commit), so
                     # their offsets legitimately differ — comparing or pushing
@@ -974,7 +1275,7 @@ class LogServer:
         try:
             queued = self._queued_counts()
             for spec in self._topic_specs():
-                if spec.name == TXN_STATE_TOPIC:
+                if spec.name in INTERNAL_TOPICS:
                     continue  # self-maintained per side; see _resync_follower
                 for p in range(spec.partitions or 1):
                     if time.monotonic() >= deadline:
@@ -992,6 +1293,10 @@ class LogServer:
 
     def _ship(self, target: str, item: _ReplItem,
               timeout: Optional[float] = None) -> Optional[str]:
+        if self.faults is not None:
+            err = self.faults.on_ship(target)
+            if err is not None:
+                return f"{target}: {err}"
         try:
             call = self._repl_channels.get(target)
             if call is None:
@@ -1006,9 +1311,18 @@ class LogServer:
             reply = call(pb.ReplicateRequest(
                 topics=item.specs,
                 records=[record_to_msg(r) for r in item.records],
-                transactional_id=item.txn_id, txn_seq=item.seq),
+                transactional_id=item.txn_id, txn_seq=item.seq,
+                leader_epoch=self.epoch, kind=item.kind,
+                leader_target=self._my_target()),
                 timeout=timeout or self._repl_ack_timeout_s)
             if not reply.ok:
+                if reply.leader_epoch > self.epoch:
+                    # the peer fenced us: a newer leader exists — this broker
+                    # is deposed. Demote NOW (truncate the divergent tail,
+                    # rejoin as a follower) instead of retrying forever.
+                    self._demote(reply.leader_epoch, target)
+                    return (f"{target}: fenced by epoch {reply.leader_epoch} "
+                            "(this broker is deposed)")
                 return f"{target}: {reply.error}"
             return None
         except Exception as exc:  # noqa: BLE001 — follower down / transport error
@@ -1018,6 +1332,33 @@ class LogServer:
     # -- replication: follower side -------------------------------------------------------
 
     def Replicate(self, request: pb.ReplicateRequest, context) -> pb.ReplicateReply:
+        # epoch fence BEFORE ingest (KIP-101 role): a batch from a stale
+        # epoch is a deposed leader still shipping — refuse it and tell it
+        # the epoch that fenced it. A HIGHER epoch is the live leader: adopt
+        # it (persisted, so the fence survives a restart) and remember its
+        # address for NOT_LEADER redirects.
+        if request.leader_epoch:
+            with self._role_lock:
+                if request.leader_epoch < self.epoch:
+                    return pb.ReplicateReply(
+                        ok=False, leader_epoch=self.epoch,
+                        error=f"stale leader epoch {request.leader_epoch} "
+                              f"(current {self.epoch}) — fenced")
+                if request.leader_epoch > self.epoch:
+                    was_active_leader = (self.role == "leader"
+                                         and bool(self._repl_targets))
+                    self.epoch = request.leader_epoch
+                    self._persist_meta("epoch", {"e": self.epoch})
+                    if was_active_leader:
+                        # split-brain resolution: higher epoch wins — this
+                        # replicating leader is deposed by the inbound stream
+                        self._demote(request.leader_epoch,
+                                     request.leader_target or None,
+                                     adopt_epoch=False)
+                if request.leader_target:
+                    self.leader_hint = request.leader_target
+        if request.kind == "barrier":
+            return self._apply_compaction_barrier(request)
         with self._replica_lock:
             try:
                 known = getattr(self.log, "_topics", {})
@@ -1037,7 +1378,7 @@ class LogServer:
                 for r in records:
                     tp = (r.topic, r.partition)
                     if tp not in expected:
-                        expected[tp] = self.log.end_offset(r.topic, r.partition)
+                        expected[tp] = self._applied_end(r.topic, r.partition)
                     if r.offset < expected[tp]:
                         continue  # already applied
                     if r.offset > expected[tp]:
@@ -1049,24 +1390,10 @@ class LogServer:
                     to_apply.append(r)
                     expected[tp] += 1
                 if to_apply:
-                    if self._replica_producer is None:
-                        self._replica_producer = self.log.transactional_producer(
-                            "__replica__")
-                    self._replica_producer.begin()
-                    for r in to_apply:
-                        self._replica_producer.send(r)
-                    applied = self._replica_producer.commit()
-                    for got, want in zip(applied, to_apply):
-                        if (got.offset != want.offset
-                                or got.partition != want.partition
-                                or got.topic != want.topic):
-                            # out of sync with the leader — loud, unrecoverable
-                            # without a re-sync (catch_up from an empty log)
-                            return pb.ReplicateReply(
-                                ok=False,
-                                error=f"offset mismatch: applied "
-                                      f"{got.topic}[{got.partition}]@{got.offset}"
-                                      f" != leader @{want.offset}")
+                    # verbatim ingest: leader-assigned offsets AND timestamps
+                    # preserved, so replica segments converge byte-identically
+                    # (the compaction barrier's golden-compare rests on this)
+                    self._append_replica(to_apply)
                 # carry the idempotency dedup so failover retries hit the cache
                 if request.transactional_id and request.txn_seq:
                     dedup = self._txn_dedup.setdefault(
@@ -1081,6 +1408,77 @@ class LogServer:
                 logger.exception("replica ingest failed")
                 return pb.ReplicateReply(ok=False, error=repr(exc))
 
+    def _applied_end(self, topic: str, partition: int) -> int:
+        """The applied frontier (FileLog's runs ahead of its durable
+        ``end_offset`` while a group-sync round is open) — replica gap checks
+        must measure against what is APPLIED, not what is readable."""
+        fn = getattr(self.log, "applied_end_offset", None)
+        return fn(topic, partition) if fn is not None else \
+            self.log.end_offset(topic, partition)
+
+    def _append_replica(self, records, allow_gaps: bool = False):
+        """Verbatim append with the inner log's native support, falling back
+        to the producer path for third-party LogTransport implementations
+        (offsets then re-checked by the caller's gap scan)."""
+        verbatim = getattr(self.log, "append_verbatim", None)
+        if verbatim is not None:
+            return verbatim(records, allow_gaps=allow_gaps)
+        if self._replica_producer is None:
+            self._replica_producer = self.log.transactional_producer(
+                "__replica__")
+        self._replica_producer.begin()
+        for r in records:
+            self._replica_producer.send(r)
+        applied = self._replica_producer.commit()
+        for got, want in zip(applied, records):
+            if (got.offset != want.offset or got.partition != want.partition
+                    or got.topic != want.topic):
+                raise RuntimeError(
+                    f"offset mismatch: applied {got.topic}"
+                    f"[{got.partition}]@{got.offset} != leader @{want.offset}")
+        return applied
+
+    def _apply_compaction_barrier(self, request: pb.ReplicateRequest
+                                  ) -> pb.ReplicateReply:
+        """Follower half of the barrier: run the SAME bounded compaction pass
+        the leader ran (same upto/now/retention against identical records —
+        select_retained is pure, so the generational swap converges
+        byte-identically) and verify the outcome against the manifest."""
+        import json as _json
+
+        try:
+            manifest = _json.loads(request.records[0].value)
+            topic = manifest["topic"]
+            p = int(manifest["partition"])
+            upto = int(manifest["upto"])
+            with self._replica_lock:
+                have = self._applied_end(topic, p)
+                if have < upto:
+                    return pb.ReplicateReply(
+                        ok=False,
+                        error=f"barrier ahead of replica: {topic}[{p}] at "
+                              f"{have} < {upto} — retry after the gap heals")
+                if not hasattr(self.log, "compact_partition"):
+                    return pb.ReplicateReply(
+                        ok=False, error=f"{type(self.log).__name__} does not "
+                                        "support compaction")
+                self.log.compact_partition(
+                    topic, p,
+                    tombstone_retention_s=float(manifest["retention_s"]),
+                    now=float(manifest["now"]), upto_offset=upto)
+                mine = self.log.compaction_state(topic, p)["clean_count"]
+                want = int(manifest["expect_clean_count"])
+                if mine != want:
+                    return pb.ReplicateReply(
+                        ok=False,
+                        error=f"barrier divergence on {topic}[{p}]: replica "
+                              f"retained {mine} records, leader {want} — "
+                              "wipe and catch_up")
+            return pb.ReplicateReply(ok=True)
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("compaction barrier failed")
+            return pb.ReplicateReply(ok=False, error=repr(exc))
+
     def ReplicationStatus(self, request: pb.ReplicationStatusRequest,
                           context) -> pb.ReplicationStatusReply:
         """Operator view of the in-sync set (the under-replicated-partitions
@@ -1092,6 +1490,340 @@ class LogServer:
             min_insync=status["min_insync"],
             insync_count=status["insync_count"],
             queue_depth=status["queue_depth"])
+
+    # -- leader epoch, roles & failover ---------------------------------------------------
+
+    def _my_target(self) -> str:
+        if self.advertised:
+            return self.advertised
+        if self.bound_port:
+            return f"{self._host}:{self.bound_port}"
+        return ""
+
+    def _recover_meta(self) -> None:
+        """Rebuild this broker's epoch view from the compacted __broker_meta
+        topic (the KIP-101 leader-epoch-checkpoint role): a restarted deposed
+        leader must come back already knowing the epoch that fenced it."""
+        import json as _json
+
+        known = getattr(self.log, "_topics", {})
+        if META_TOPIC not in known:
+            return
+        try:
+            latest = self.log.latest_by_key(META_TOPIC, 0)
+            rec = latest.get("epoch")
+            if rec is not None:
+                self.epoch = max(self.epoch, int(_json.loads(rec.value)["e"]))
+            rec = latest.get("epoch_start")
+            if rec is not None:
+                obj = _json.loads(rec.value)
+                if int(obj.get("e", 0)) == self.epoch:
+                    self.epoch_start = {
+                        t: {int(p): int(off) for p, off in parts.items()}
+                        for t, parts in obj.get("starts", {}).items()}
+        except Exception:  # noqa: BLE001 — a broken meta topic must not
+            logger.exception("broker meta recovery failed")  # block startup
+
+    def _persist_meta(self, key: str, obj: dict) -> None:
+        """Durably annotate the broker's epoch state. Best-effort like
+        __txn_state: a failure only weakens fence persistence across a
+        restart, never the live protocol (epochs re-propagate on the next
+        Replicate)."""
+        import json as _json
+
+        try:
+            with self._txn_state_lock:
+                known = getattr(self.log, "_topics", {})
+                if META_TOPIC not in known:
+                    self.log.create_topic(TopicSpec(META_TOPIC, 1,
+                                                    compacted=True))
+                if self._meta_producer is None:
+                    self._meta_producer = self.log.transactional_producer(
+                        "__broker_meta_writer__")
+                self._meta_producer.begin()
+                self._meta_producer.send(LogRecord(
+                    topic=META_TOPIC, key=key,
+                    value=_json.dumps(obj).encode(), partition=0))
+                self._meta_producer.commit()
+        except Exception:  # noqa: BLE001
+            logger.exception("broker meta persist failed")
+
+    def broker_status(self) -> dict:
+        """Role/epoch view (the BrokerStatus RPC payload): what the failover
+        prober, the chaos CLI, and a fenced ex-leader's truncation read."""
+        with self._role_lock:
+            return {"role": self.role, "epoch": self.epoch,
+                    "leader_hint": self.leader_hint,
+                    "target": self._my_target(),
+                    # str partition keys: identical shape whether read
+                    # in-process or through the RPC's JSON roundtrip
+                    "epoch_start": {t: {str(p): off for p, off in parts.items()}
+                                    for t, parts in self.epoch_start.items()},
+                    "replicate_to": list(self._repl_targets)}
+
+    def promote(self, replicate_to: Optional[list] = None) -> dict:
+        """Follower → leader promotion (admin PromoteFollower RPC, or the
+        leader-death prober). Bumps the epoch past every one this broker has
+        seen, records the EPOCH-START offsets — the truncation floor a fenced
+        ex-leader rolls its divergent tail back to — persists both, and
+        starts replicating to ``replicate_to`` (default: the old leader, so
+        the pair inverts; it re-joins through the fence → truncate →
+        catch_up → ISR-rejoin path). Idempotent on an existing leader."""
+        with self._role_lock:
+            if self.role == "leader":
+                return self.broker_status()
+            self._adopt_leader_epoch()
+            # floor of 2: every ACTIVE leader initializes at epoch 1, so a
+            # follower that never learned its leader's epoch (leader down
+            # since before this follower's first probe) must still mint an
+            # epoch that FENCES it — promoting 0 -> 1 would collide, and
+            # equal epochs pass every fence (silent two-leader split brain)
+            self.epoch = max(self.epoch + 1, 2)
+            starts: Dict[str, Dict[int, int]] = {}
+            for spec in self._topic_specs():
+                if spec.name in INTERNAL_TOPICS:
+                    continue
+                starts[spec.name] = {
+                    p: self._applied_end(spec.name, p)
+                    for p in range(spec.partitions or 1)}
+            self.epoch_start = starts
+            self._persist_meta("epoch", {"e": self.epoch})
+            self._persist_meta("epoch_start",
+                               {"e": self.epoch,
+                                "starts": {t: {str(p): off
+                                               for p, off in parts.items()}
+                                           for t, parts in starts.items()}})
+            targets = list(replicate_to) if replicate_to is not None else (
+                [self._follower_of] if self._follower_of else [])
+            self._repl_targets = [t for t in targets if t]
+            for t in self._repl_targets:
+                st = self._repl_target_state.setdefault(t, _TargetState())
+                # presumed dead until a probe proves otherwise: commits must
+                # not block the isr-timeout on a corpse
+                st.in_sync = False
+                st.failing_since = None
+                st.next_probe = time.monotonic() + 1.0
+            self.role = "leader"
+            self.leader_hint = self._my_target()
+            if self._leader_prober is not None:
+                self._leader_prober.stop()
+                self._leader_prober = None
+            if self._repl_targets and self._server is not None and (
+                    self._repl_thread is None
+                    or not self._repl_thread.is_alive()):
+                self._repl_stop = False
+                self._repl_thread = threading.Thread(
+                    target=self._replication_loop,
+                    name="surge-log-replication", daemon=True)
+                self._repl_thread.start()
+            logger.warning("PROMOTED to leader at epoch %d (epoch-start %s)",
+                           self.epoch,
+                           {t: p for t, p in list(starts.items())[:4]})
+            if self.metrics is not None:
+                self.metrics.failover_promotions.record()
+            return self.broker_status()
+
+    def _demote(self, new_epoch: int, fencer: Optional[str],
+                adopt_epoch: bool = True) -> None:
+        """A higher epoch fenced this leader: stop writing, fail the queue,
+        truncate the divergent unreplicated tail to the new leader's
+        epoch-start offsets (KIP-101), wipe the local dedup view and re-pull
+        log + dedup from the new leader (catch_up), then serve as a follower.
+        Never raises — a failing step leaves the broker demoted-but-behind,
+        which the new leader's rejoin probe (or operator catch_up) heals."""
+        with self._role_lock:
+            if self._demoting:
+                return
+            self._demoting = True
+        try:
+            with self._role_lock:
+                logger.error(
+                    "FENCED: leader epoch %d deposed by epoch %d (%s); "
+                    "demoting to follower", self.epoch, new_epoch,
+                    fencer or "unknown peer")
+                if adopt_epoch and new_epoch > self.epoch:
+                    self.epoch = new_epoch
+                    self._persist_meta("epoch", {"e": self.epoch})
+                self.role = "follower"
+                if fencer:
+                    self.leader_hint = fencer
+                self._repl_targets = []
+                self._repl_stop = True
+                # fail every queued item: their waiters answer retriable and
+                # the clients' redirect/reopen ladder moves to the new leader
+                with self._repl_cv:
+                    stranded, self._repl_queue = self._repl_queue, []
+                    self._repl_cv.notify_all()
+                self._repl_pending.clear()
+                for it in stranded:
+                    it.error = f"fenced by epoch {new_epoch}"
+                    it.done.set()
+            if self.metrics is not None:
+                self.metrics.failover_fencings.record()
+            if fencer:
+                self._truncate_to_leader(fencer)
+        finally:
+            with self._role_lock:
+                self._demoting = False
+
+    def _truncate_to_leader(self, leader_target: str) -> None:
+        """KIP-101 divergence repair: roll every partition back to the new
+        leader's epoch-start offset (the shared prefix — the follower held
+        exactly that much when it promoted, and this broker holds at least as
+        much), then re-pull records + dedup from the leader."""
+        try:
+            status = self._remote_broker_status(leader_target)
+            starts = status.get("epoch_start", {})
+            truncated = 0
+            fn = getattr(self.log, "truncate_partition", None)
+            for topic, parts in starts.items():
+                if topic in INTERNAL_TOPICS:
+                    continue
+                for p, start in parts.items():
+                    p = int(p)
+                    mine = self._applied_end(topic, p)
+                    if mine > int(start) and fn is not None:
+                        truncated += fn(topic, p, int(start))
+            if truncated:
+                logger.warning(
+                    "truncated %d divergent unreplicated record(s) to the "
+                    "new leader's epoch-start offsets", truncated)
+                if self.metrics is not None:
+                    self.metrics.failover_truncated_records.record(truncated)
+            # the truncated seqs' dedup entries point at dropped records; the
+            # new leader's table is authoritative — rebuild from it
+            with self._replica_lock:
+                self._txn_dedup.clear()
+            self.catch_up(leader_target)
+        except Exception:  # noqa: BLE001 — demoted-but-behind is recoverable
+            logger.exception(
+                "post-fence truncation/catch-up from %s failed; this "
+                "follower stays behind until the leader's rejoin probe or an "
+                "operator catch_up heals it", leader_target)
+
+    def _remote_broker_status(self, target: str) -> dict:
+        import json as _json
+
+        reply = self._probe_stub(target, "BrokerStatus",
+                                 pb.ListTopicsRequest, pb.TxnReply)(
+            pb.ListTopicsRequest(), timeout=2.0)
+        if not reply.ok or not reply.records:
+            raise RuntimeError(f"BrokerStatus on {target} failed: "
+                               f"{reply.error}")
+        return _json.loads(reply.records[0].value)
+
+    def _adopt_leader_epoch(self) -> None:
+        """Best-effort raise of this follower's epoch view to its leader's
+        (normally the Replicate stream carries it; a follower that never saw
+        a batch would otherwise promote to an epoch EQUAL to the live
+        leader's, and the fence could not tell them apart). Unreachable
+        leader — the usual promotion trigger — keeps the known epoch."""
+        if not self._follower_of:
+            return
+        try:
+            status = self._remote_broker_status(self._follower_of)
+            remote = int(status.get("epoch", 0))
+            if remote > self.epoch:
+                self.epoch = remote
+                self._persist_meta("epoch", {"e": self.epoch})
+        except Exception:  # noqa: BLE001 — leader dead: promote past known
+            pass
+
+    def _confirm_leadership(self) -> None:
+        """Split-brain guard at start (KIP-279 flavor): a restarting broker
+        configured as leader asks its replication targets whether a higher
+        epoch exists BEFORE serving writes — a deposed leader that crashed
+        and came back must not accept commits it would later truncate.
+        Unreachable targets are presumed dead followers (serve on)."""
+        for target in list(self._repl_targets):
+            try:
+                status = self._remote_broker_status(target)
+            except Exception:  # noqa: BLE001 — dead follower: fine
+                continue
+            if int(status.get("epoch", 0)) > self.epoch:
+                self._demote(int(status["epoch"]),
+                             status.get("target") or target)
+                return
+
+    def kill(self) -> None:
+        """Hard-stop (the fault plane's crash action): close the socket NOW,
+        no grace — in-flight calls answer UNAVAILABLE, exactly what a killed
+        process looks like to clients. The inner log is left as-is (a crash
+        does not flush)."""
+        self._dead = True
+        server, self._server = self._server, None
+        #: threading.Event set once the socket is fully closed (grpc's stop
+        #: is non-blocking, so this is safe even from a handler thread —
+        #: never WAIT on it from one, the in-flight call is part of what it
+        #: tracks). Tests wait on it before rebinding the port.
+        self.kill_done = server.stop(0) if server is not None else None
+        with self._repl_cv:
+            self._repl_stop = True
+            self._repl_cv.notify_all()
+        if self._leader_prober is not None:
+            self._leader_prober.stop()
+            self._leader_prober = None
+
+    # -- broker admin RPCs ----------------------------------------------------------------
+
+    def BrokerStatus(self, request: pb.ListTopicsRequest,
+                     context) -> pb.TxnReply:
+        import json as _json
+
+        return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+            has_key=True, key="status", has_value=True,
+            value=_json.dumps(self.broker_status()).encode())])
+
+    def PromoteFollower(self, request: pb.TxnRequest, context) -> pb.TxnReply:
+        import json as _json
+
+        try:
+            replicate_to = None
+            if request.records and request.records[0].has_value:
+                obj = _json.loads(request.records[0].value or b"{}")
+                replicate_to = obj.get("replicate_to")
+            status = self.promote(replicate_to)
+            return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+                has_key=True, key="status", has_value=True,
+                value=_json.dumps(status).encode())])
+        except Exception as exc:  # noqa: BLE001 — operator gets it back
+            logger.exception("promotion failed")
+            return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
+
+    def ArmFaults(self, request: pb.TxnRequest, context) -> pb.TxnReply:
+        """Runtime fault-plane arming (the chaos CLI's RPC): op "arm" with a
+        named plan or JSON rule list in records[0].value, "disarm", or
+        "status". The armed plane hooks this broker AND its inner log."""
+        import json as _json
+
+        from surge_tpu.testing.faults import FaultPlane
+
+        try:
+            if request.op == "arm":
+                spec = (request.records[0].value or b"").decode()
+                seed = int(request.txn_seq)
+                plane = FaultPlane.from_spec(spec, seed=seed,
+                                             metrics=self.metrics)
+                if self.faults is None:
+                    self.faults = plane
+                    self.faults.on_crash = lambda point: self.kill()
+                else:
+                    self.faults.arm(plane.rules, seed=seed)
+                if hasattr(self.log, "faults"):
+                    self.log.faults = self.faults  # FileLog WAL sites
+            elif request.op == "disarm":
+                if self.faults is not None:
+                    self.faults.disarm()
+            elif request.op != "status":
+                return pb.TxnReply(ok=False, error_kind="state",
+                                   error=f"unknown op {request.op!r}")
+            stats = self.faults.stats() if self.faults is not None else {
+                "rules": [], "injected": 0, "crashed": None}
+            return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+                has_key=True, key="faults", has_value=True,
+                value=_json.dumps(stats).encode())])
+        except Exception as exc:  # noqa: BLE001 — operator gets it back
+            return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
 
     # -- durable idempotency (__txn_state) ------------------------------------------------
 
@@ -1265,32 +1997,24 @@ class LogServer:
             reply = leader._calls["ListTopics"](pb.ListTopicsRequest())
             known = getattr(self.log, "_topics", {})
             for spec_msg in reply.topics:
+                if spec_msg.name in INTERNAL_TOPICS:
+                    continue  # self-maintained per side (see _resync_follower)
                 if spec_msg.name not in known:
                     self.log.create_topic(TopicSpec(
                         spec_msg.name, spec_msg.partitions or 1,
                         spec_msg.compacted))
                 for p in range(spec_msg.partitions or 1):
                     while True:  # page: one unbounded Read would blow the gRPC
-                        start = self.log.end_offset(spec_msg.name, p)
+                        start = self._applied_end(spec_msg.name, p)
                         records = leader.read(spec_msg.name, p,
                                               from_offset=start,
                                               max_records=1000)
                         if not records:
                             break
                         with self._replica_lock:
-                            if self._replica_producer is None:
-                                self._replica_producer = \
-                                    self.log.transactional_producer("__replica__")
-                            self._replica_producer.begin()
-                            for r in records:
-                                self._replica_producer.send(r)
-                            applied = self._replica_producer.commit()
-                        for got, want in zip(applied, records):
-                            if got.offset != want.offset:
-                                raise RuntimeError(
-                                    f"catch_up offset mismatch on "
-                                    f"{spec_msg.name}[{p}]: {got.offset} != "
-                                    f"{want.offset}")
+                            # verbatim, gaps allowed: a compacted leader
+                            # partition legitimately has offset holes
+                            self._append_replica(records, allow_gaps=True)
                         copied += len(records)
             # dedup table AFTER records: any commit finalized before this
             # point is either in the copied records (its seq then also in
@@ -1331,15 +2055,12 @@ class LogServer:
 
     def CompactTopic(self, request: pb.ReadRequest, context) -> pb.TxnReply:
         """Compact one partition of a compacted topic broker-side (the
-        operator/CLI trigger). Refused on a replicating leader: followers
-        mirror a gap-free prefix of this log, and compaction holes would read
-        as replication gaps."""
+        operator/CLI trigger). On a replicating leader the pass rides the
+        replication stream as a BARRIER item, so every in-sync follower
+        applies the identical generational swap — the pre-barrier refusal is
+        gone (ROADMAP item closed)."""
         import json as _json
 
-        if self._repl_targets:
-            return pb.TxnReply(ok=False, error_kind="state",
-                               error="compaction unavailable on a "
-                                     "replicating leader")
         if not hasattr(self.log, "compact_partition"):
             return pb.TxnReply(ok=False, error_kind="state",
                                error=f"{type(self.log).__name__} does not "
@@ -1354,20 +2075,65 @@ class LogServer:
             return pb.TxnReply(ok=False, error_kind="state",
                                error=f"topic {request.topic!r} is not "
                                      "compacted")
-        from surge_tpu.config import default_config as _dc
-
-        retention = (self._config or _dc()).get_seconds(
-            "surge.log.compaction.tombstone-retention-ms", 60_000)
         try:
-            stats = self.log.compact_partition(
-                request.topic, request.partition,
-                tombstone_retention_s=retention)
+            stats = self.compact_partition(request.topic, request.partition)
         except Exception as exc:  # noqa: BLE001 — operator gets it back
             return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
         msg = pb.RecordMsg(topic=request.topic, partition=request.partition,
                            has_key=True, key="stats", has_value=True,
                            value=_json.dumps(stats.as_dict()).encode())
         return pb.TxnReply(ok=True, records=[msg])
+
+    # -- compactor surface: a LogCompactor can schedule THIS SERVER as its
+    # log, so the dirty-ratio scheduler on a replicated leader routes every
+    # pass through the barrier instead of compacting the inner log behind
+    # the replication stream's back
+
+    @property
+    def _topics(self):
+        return getattr(self.log, "_topics", {})
+
+    def end_offset(self, topic: str, partition: int,
+                   isolation: str = "read_committed") -> int:
+        return self.log.end_offset(topic, partition, isolation=isolation)
+
+    def compaction_state(self, topic: str, partition: int) -> Dict[str, int]:
+        return self.log.compaction_state(topic, partition)
+
+    def compact_partition(self, topic: str, partition: int, *,
+                          tombstone_retention_s: Optional[float] = None,
+                          now: Optional[float] = None):
+        """Replication-aware compaction entry (CompactTopic RPC, LogCompactor
+        scheduler): barrier-replicated on a leader with followers, direct on
+        an unreplicated broker. Refused on a follower — its leader drives
+        compaction through the stream."""
+        from surge_tpu.config import default_config as _dc
+
+        if tombstone_retention_s is None:
+            tombstone_retention_s = (self._config or _dc()).get_seconds(
+                "surge.log.compaction.tombstone-retention-ms", 60_000)
+        if self.role != "leader":
+            raise RuntimeError(
+                f"compaction must run on the leader ({self.leader_hint or 'unknown'}); "
+                f"this broker is a {self.role}")
+        if not self._repl_targets:
+            return self.log.compact_partition(
+                topic, partition, tombstone_retention_s=tombstone_retention_s,
+                now=now)
+        item = _ReplItem([], [], kind="barrier", manifest={
+            "topic": topic, "partition": partition,
+            "retention_s": tombstone_retention_s,
+            "now": now if now is not None else time.time()})
+        with self._repl_cv:
+            self._repl_queue.append(item)
+            self._repl_cv.notify()
+        if not item.done.wait(2 * self._repl_ack_timeout_s):
+            raise RuntimeError(
+                "compaction barrier timed out awaiting the in-sync set "
+                f"({item.error or 'still queued'})")
+        if item.error:
+            raise RuntimeError(f"compaction barrier failed: {item.error}")
+        return item.result
 
     def WaitForAppend(self, request: pb.WaitRequest, context) -> pb.WaitReply:
         def check() -> bool:
@@ -1389,13 +2155,47 @@ class LogServer:
 
     # -- lifecycle ------------------------------------------------------------------------
 
+    def _wrap_handler(self, name: str, fn):
+        """Per-RPC interception: a killed broker answers UNAVAILABLE (its
+        socket may still be draining), the fault plane's rpc.* sites apply
+        (drop / delay / reorder / dup / error), and a SimulatedCrash escaping
+        a handler surfaces as UNAVAILABLE — exactly what a crashed process
+        looks like from the client side."""
+
+        def handler(request, context):
+            if self._dead:
+                context.abort(grpc.StatusCode.UNAVAILABLE,
+                              "broker killed (fault injection)")
+            plane = self.faults
+            if plane is not None:
+                rule = plane.on_rpc(name)
+                if rule is not None:
+                    if rule.action == "drop":
+                        context.abort(grpc.StatusCode.UNAVAILABLE,
+                                      "fault injected: message dropped")
+                    elif rule.action == "error":
+                        context.abort(grpc.StatusCode.UNAVAILABLE,
+                                      f"fault injected: {rule.error}")
+                    elif rule.action == "dup":
+                        fn(request, context)  # duplicate delivery: run twice
+            try:
+                return fn(request, context)
+            except Exception as exc:
+                if type(exc).__name__ == "SimulatedCrash":
+                    context.abort(grpc.StatusCode.UNAVAILABLE,
+                                  f"broker crashed: {exc}")
+                raise
+
+        return handler
+
     def start(self) -> int:
         from surge_tpu.remote.security import server_credentials, tls_enabled
 
         rpc = {}
         for name, (req_cls, reply_cls) in METHODS.items():
             rpc[name] = grpc.unary_unary_rpc_method_handler(
-                getattr(self, name), request_deserializer=req_cls.FromString,
+                self._wrap_handler(name, getattr(self, name)),
+                request_deserializer=req_cls.FromString,
                 response_serializer=reply_cls.SerializeToString)
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=self._max_workers))
@@ -1407,16 +2207,58 @@ class LogServer:
                 address, server_credentials(self._config))
         else:
             self.bound_port = self._server.add_insecure_port(address)
+        if not self.bound_port:
+            raise RuntimeError(f"could not bind log server to {address}")
+        if self.advertised is None:
+            self.advertised = f"{self._host}:{self.bound_port}"
+        if self.role == "leader" and not self.leader_hint:
+            self.leader_hint = self._my_target()
+        if self._repl_targets:
+            # split-brain guard, BEFORE the socket serves: a restarting
+            # "leader" may have been deposed while down — ask the peers, so
+            # not even one write can land on a stale epoch
+            self._confirm_leadership()
         self._server.start()
-        if self._repl_targets and self._repl_thread is None:
+        if self._repl_targets and self._repl_thread is None \
+                and not self._repl_stop:
             self._repl_stop = False
             self._repl_thread = threading.Thread(
                 target=self._replication_loop, name="surge-log-replication",
                 daemon=True)
             self._repl_thread.start()
+        if self._follower_of:
+            # learn the leader's current epoch up front (best effort): the
+            # fence must hold even if this follower promotes before ever
+            # receiving a batch
+            with self._role_lock:
+                self._adopt_leader_epoch()
+        if self._auto_promote and self._leader_prober is None:
+            from surge_tpu.health.prober import BrokerLivenessProber
+
+            def _ping() -> None:
+                self._remote_broker_status(self._follower_of)
+
+            self._leader_prober = BrokerLivenessProber(
+                self._follower_of, _ping, config=self._config,
+                on_dead=self._on_leader_dead)
+            self._leader_prober.start()
         return self.bound_port
 
+    def _on_leader_dead(self) -> None:
+        """The liveness prober declared the leader dead: self-promote."""
+        if self.role == "leader" or self._dead:
+            return
+        logger.error("leader %s declared dead by the liveness prober; "
+                     "auto-promoting", self._follower_of)
+        try:
+            self.promote()
+        except Exception:  # noqa: BLE001 — stay follower, prober keeps going
+            logger.exception("auto-promotion failed")
+
     def stop(self, grace: float = 1.0) -> None:
+        if self._leader_prober is not None:
+            self._leader_prober.stop()
+            self._leader_prober = None
         if self._repl_thread is not None:
             with self._repl_cv:
                 self._repl_stop = True

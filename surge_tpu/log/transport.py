@@ -27,6 +27,45 @@ class ProducerFencedError(Exception):
     is a zombie and must never write again (KafkaProducerActorImpl.scala:502-510)."""
 
 
+class NotLeaderError(Exception):
+    """The addressed broker is a follower (or a fenced ex-leader); writes must
+    go to the leader. ``leader_hint`` carries its address when known."""
+
+    def __init__(self, message: str, leader_hint: str = "") -> None:
+        super().__init__(message)
+        self.leader_hint = leader_hint
+
+
+class FaultInjector(Protocol):
+    """The hook surface the log substrate consults when a fault plane is
+    armed (:class:`surge_tpu.testing.faults.FaultPlane` is the one
+    implementation; production code only depends on this seam, so the
+    testing package never loads unless a plan is actually armed).
+
+    Every hook is called at a named SITE; an unarmed plane answers None /
+    returns without effect, so hot paths pay one attribute check."""
+
+    def on_rpc(self, method: str): ...
+
+    def on_ship(self, target: str) -> Optional[str]: ...
+
+    def on_fsync(self, which: str) -> None: ...
+
+    def torn(self, site: str, data: bytes) -> Optional[bytes]: ...
+
+    def crash_point(self, name: str) -> None: ...
+
+
+def load_fault_plane(config) -> Optional[FaultInjector]:
+    """Build the configured fault plane (``surge.log.faults.plan``), lazily
+    importing the testing package only when a plan is armed."""
+    if config is None or not config.get_str("surge.log.faults.plan", ""):
+        return None
+    from surge_tpu.testing.faults import FaultPlane
+
+    return FaultPlane.from_config(config)
+
+
 class TransactionStateError(Exception):
     """Illegal transaction op for the current state (commit without begin, etc.)."""
 

@@ -237,6 +237,15 @@ class EngineMetrics:
     checkpoint_timer: Timer = field(init=False)
     checkpoint_age: Sensor = field(init=False)
     checkpoint_lag_events: Sensor = field(init=False)
+    # leader failover + fault-injection plane (surge_tpu.log.server /
+    # surge_tpu.log.client / surge_tpu.testing.faults)
+    failover_promotions: Sensor = field(init=False)
+    failover_fencings: Sensor = field(init=False)
+    failover_truncated_records: Sensor = field(init=False)
+    failover_redirects: Sensor = field(init=False)
+    failover_rolls: Sensor = field(init=False)
+    faults_injected: Sensor = field(init=False)
+    faults_armed: Sensor = field(init=False)
 
     def __post_init__(self) -> None:
         m, MI = self.registry, MetricInfo
@@ -345,6 +354,32 @@ class EngineMetrics:
             "surge.store.checkpoint.lag-events",
             "events committed past the newest checkpoint's watermarks "
             "(the cold-start tail a restore would fold)"))
+        self.failover_promotions = m.counter(MI(
+            "surge.log.failover.promotions",
+            "follower-to-leader promotions performed by this process's "
+            "broker (admin RPC or leader-death prober)"))
+        self.failover_fencings = m.counter(MI(
+            "surge.log.failover.fencings",
+            "leader-epoch fences observed: this broker was deposed and "
+            "demoted to follower"))
+        self.failover_truncated_records = m.counter(MI(
+            "surge.log.failover.truncated-records",
+            "divergent unreplicated records truncated on demotion "
+            "(KIP-101 tail rollback to the new leader's epoch-start)"))
+        self.failover_redirects = m.counter(MI(
+            "surge.log.failover.redirects",
+            "NOT_LEADER redirects this client followed to the hinted leader"))
+        self.failover_rolls = m.counter(MI(
+            "surge.log.failover.client-rolls",
+            "broker-endpoint-list failovers after UNAVAILABLE (the client "
+            "rolled to the next broker)"))
+        self.faults_injected = m.counter(MI(
+            "surge.log.faults.injected",
+            "faults fired by the armed fault-injection plane"))
+        self.faults_armed = m.gauge(MI(
+            "surge.log.faults.armed",
+            "fault rules currently armed on this process's plane "
+            "(0 outside chaos experiments)"))
         # Deprecation aliases for the r4 renames (ADVICE r4): dashboards keyed
         # to the old identifiers — including a timer's .min/.max/.p99
         # sub-metrics — keep working for a release window; the alias providers

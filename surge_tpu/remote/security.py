@@ -76,8 +76,17 @@ def secure_channel(target: str, config: Optional[Config]) -> grpc.aio.Channel:
     return grpc.aio.insecure_channel(target)
 
 
+#: sync channels own their subchannels instead of sharing the process-global
+#: pool: a broker-liveness probe that finds a peer down must not poison a
+#: fresh client channel to the same address with a cached TRANSIENT_FAILURE
+#: for the backoff window (failover clients reconnect to rebound/promoted
+#: brokers immediately, not after the pooled subchannel's backoff elapses)
+_SYNC_CHANNEL_OPTIONS = (("grpc.use_local_subchannel_pool", 1),)
+
+
 def secure_sync_channel(target: str, config: Optional[Config]) -> grpc.Channel:
     """Synchronous-channel variant of :func:`secure_channel` (blocking clients)."""
     if tls_enabled(config):
-        return grpc.secure_channel(target, channel_credentials(config))
-    return grpc.insecure_channel(target)
+        return grpc.secure_channel(target, channel_credentials(config),
+                                   options=_SYNC_CHANNEL_OPTIONS)
+    return grpc.insecure_channel(target, options=_SYNC_CHANNEL_OPTIONS)

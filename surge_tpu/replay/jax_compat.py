@@ -1,0 +1,26 @@
+"""Version-bridging shims for the narrow slice of jax API the replay engine
+uses where the surface moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` (where the
+skip-the-replication-check kwarg is ``check_rep``) to ``jax.shard_map`` (where
+it was renamed ``check_vma``); images pinned to 0.4.x only ship the
+experimental spelling, and 0.7+ hard-removes it. One call site, one shim.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` wherever it lives, with the vma/rep kwarg bridged."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as exp_sm
+
+    return exp_sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)

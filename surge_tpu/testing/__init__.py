@@ -1,0 +1,45 @@
+"""Test support for applications built on surge_tpu.
+
+Two halves:
+
+- :mod:`surge_tpu.testing.support` — the mockable-engine pattern
+  (:class:`StubAggregateRef` / :class:`StubEngine`), replay golden-check
+  helpers, and the random model-driven log generators. Everything that used
+  to live in the old single-module ``surge_tpu/testing.py`` re-exports from
+  here unchanged.
+- :mod:`surge_tpu.testing.faults` — the deterministic, seedable
+  fault-injection plane (:class:`FaultPlane`) the log broker, the FileLog
+  WAL, and the chaos tooling hook into: drop/delay/duplicate transport
+  messages, fail or stall fsync rounds, tear journal writes, crash a broker
+  at named crash points. Armable from tests, from config
+  (``surge.log.faults.plan``), and at runtime via the broker's ``ArmFaults``
+  RPC (``tools/chaos.py``).
+"""
+
+from surge_tpu.testing.support import (  # noqa: F401
+    StubAggregateRef,
+    StubEngine,
+    assert_replay_matches_scalar,
+    random_bank_log,
+    random_cart_log,
+    random_counter_log,
+)
+from surge_tpu.testing.faults import (  # noqa: F401
+    FaultPlane,
+    FaultRule,
+    NAMED_PLANS,
+    SimulatedCrash,
+)
+
+__all__ = [
+    "StubAggregateRef",
+    "StubEngine",
+    "assert_replay_matches_scalar",
+    "random_counter_log",
+    "random_cart_log",
+    "random_bank_log",
+    "FaultPlane",
+    "FaultRule",
+    "NAMED_PLANS",
+    "SimulatedCrash",
+]

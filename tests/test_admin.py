@@ -74,3 +74,32 @@ def test_admin_introspection_and_controls():
         await channel.close()
 
     asyncio.run(scenario())
+
+
+def test_admin_arm_faults_on_engine_log(tmp_path):
+    """ArmFaults over the engine admin plane: arms the fault plane on the
+    engine's in-process FileLog (WAL sites), reports stats, disarms."""
+    from surge_tpu.log import FileLog
+
+    async def scenario():
+        log = FileLog(str(tmp_path / "log"), fsync="none")
+        engine = create_engine(make_logic(), log=log, config=CFG)
+        await engine.start()
+        admin = AdminServer(engine)
+        port = await admin.start()
+        channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        client = AdminClient(channel)
+
+        stats = await client.arm_faults("fsync-hiccup")
+        assert stats["rules"][0]["site"] == "fsync.journal"
+        assert log.faults is not None
+        assert (await client.fault_stats())["rules"]
+        stats = await client.disarm_faults()
+        assert stats["rules"] == []
+
+        await channel.close()
+        await admin.stop()
+        await engine.stop()
+        log.close()
+
+    asyncio.run(scenario())

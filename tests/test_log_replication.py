@@ -306,9 +306,11 @@ def test_follower_rejoins_via_catch_up_mid_traffic():
         assert leader.replication_status()["replicas"][f"127.0.0.1:{fport}"] is False
 
         copied = follower.catch_up(f"127.0.0.1:{lport}")
-        # 7 data records (3 + dead-window + 3 committed while out) plus their
-        # __txn_state dedup annotations ride along
-        assert copied == 14
+        # 7 data records (3 + dead-window + 3 committed while out); broker-
+        # internal topics (__txn_state, __broker_meta) are self-maintained
+        # per side and never copied — the dedup table travels via the
+        # DedupSnapshot merge below instead
+        assert copied == 7
         assert sum(1 for _ in follower.log.read("events", 0)) == 7
         # catch_up must also carry the txn-dedup table: a failover client
         # retrying an in-flight seq would otherwise re-append records this
@@ -970,3 +972,106 @@ def test_engine_exact_counts_across_repeated_broker_bounces(tmp_path):
 
     asyncio.run(scenario())
     broker.stop()
+
+
+# -- fault-plane-driven failure semantics (surge_tpu.testing.faults) ------------------
+# The ad-hoc-monkeypatch era of these scenarios is over: the same shared,
+# seedable plane the chaos tests use drives ship failures and worker bugs.
+
+
+def test_isr_eviction_and_auto_resync_via_fault_plane():
+    """Blackholed ships (plane: ship.* drop) evict the follower from the
+    in-sync set after the isr-timeout — commits proceed at min-insync —
+    and DISARMING the plane lets the leader's probe auto-resync the small
+    lag and re-admit the follower, no operator catch_up involved."""
+    from surge_tpu.testing.faults import FaultPlane, FaultRule
+
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    leader = LogServer(InMemoryLog(), config=_degrade_cfg(),
+                       replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    client = GrpcLogTransport(f"127.0.0.1:{lport}", config=_degrade_cfg())
+    try:
+        client.create_topic(TopicSpec("events", 1))
+        p = client.transactional_producer("txn-0")
+        out = _commit_retrying(p, rec("events", "k0", b"v0"))
+        assert out[0].offset == 0
+
+        leader.faults = FaultPlane([FaultRule(site="ship.*", action="drop",
+                                              times=None)])
+        for i in range(1, 4):
+            _commit_retrying(p, rec("events", f"k{i}", f"v{i}".encode()))
+        status = leader.replication_status()
+        assert status["replicas"][f"127.0.0.1:{fport}"] is False  # evicted
+        assert follower.log.end_offset("events", 0) == 1  # lag accrued
+
+        leader.faults.disarm()  # network heals: probe pushes the lag itself
+        import time as _t
+
+        deadline = _t.perf_counter() + 15
+        while _t.perf_counter() < deadline:
+            if leader.replication_status()["replicas"][f"127.0.0.1:{fport}"]:
+                break
+            _t.sleep(0.1)
+        assert leader.replication_status()["replicas"][f"127.0.0.1:{fport}"]
+        assert [r.value for r in follower.log.read("events", 0)] == \
+            [b"v0", b"v1", b"v2", b"v3"]
+    finally:
+        client.close()
+        leader.stop()
+        follower.stop()
+
+
+def test_replication_poison_path_via_fault_plane():
+    """A head item that makes the worker RAISE repeatedly (plane:
+    raise.repl.iteration) is failed past the queue after the bounded strike
+    count (~17s of backoff); the batch — durably applied on the leader — is
+    acked into the dedup cache so the client's verbatim retry converges on
+    offset 0 instead of livelocking, the worker survives, and later commits
+    replicate normally (the skipped batch reaches the follower through the
+    gap-triggered resync). Degraded loudly, never stuck silently."""
+    from surge_tpu.testing.faults import FaultPlane, FaultRule
+
+    cfg = _degrade_cfg(**{"surge.log.txn-inorder-timeout-ms": 200})
+    follower = LogServer(InMemoryLog())
+    fport = follower.start()
+    leader = LogServer(InMemoryLog(), config=cfg,
+                       replicate_to=[f"127.0.0.1:{fport}"])
+    lport = leader.start()
+    client = GrpcLogTransport(f"127.0.0.1:{lport}", config=cfg)
+    try:
+        client.create_topic(TopicSpec("events", 1))
+        p = client.transactional_producer("txn-0")
+        # every iteration with a queued item raises; the 20-strike poison
+        # bound then fails the head item past the queue
+        leader.faults = FaultPlane([FaultRule(site="raise.repl.iteration",
+                                              action="error", times=None,
+                                              error="poisoned head item")])
+        # the publisher-protocol retry ladder rides through the poison
+        # window; exactly-once: the batch lands at offset 0 ONCE
+        out = _commit_retrying(p, rec("events", "k", b"poisoned"),
+                               attempts=120)
+        assert out[0].offset == 0
+
+        import time as _t
+
+        assert not leader._repl_queue, "poisoned item never failed past"
+        assert leader._repl_thread.is_alive()  # the worker survived
+        assert leader.log.end_offset("events", 0) == 1  # never appended twice
+
+        leader.faults.disarm()
+        # fresh traffic replicates again, and the resync path heals the
+        # follower's gap from the skipped ship
+        out = _commit_retrying(p, rec("events", "k2", b"after"))
+        assert out[0].offset == 1
+        deadline = _t.perf_counter() + 15
+        while _t.perf_counter() < deadline and (
+                follower.log.end_offset("events", 0) < 2):
+            _t.sleep(0.1)
+        follower_vals = [r.value for r in follower.log.read("events", 0)]
+        assert follower_vals == [b"poisoned", b"after"]
+    finally:
+        client.close()
+        leader.stop()
+        follower.stop()

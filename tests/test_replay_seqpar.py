@@ -7,6 +7,7 @@ import random
 
 import jax
 import numpy as np
+import pytest
 
 from surge_tpu.codec.tensor import encode_events
 from surge_tpu.engine.model import fold_events
@@ -16,7 +17,11 @@ from surge_tpu.replay.seqpar import replay_time_sharded
 
 def _mesh():
     devs = jax.devices()
-    assert len(devs) == 8
+    if len(devs) < 8:
+        # conftest forces 8 host devices via xla_force_host_platform_device_count;
+        # a platform that cannot (real accelerator with fewer chips) lacks the
+        # capability this suite shards over — skip, don't fail
+        pytest.skip(f"time-sharded replay needs 8 devices, have {len(devs)}")
     return jax.sharding.Mesh(np.array(devs), ("data",))
 
 

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Chaos CLI: arm a fault plan against a RUNNING log broker and watch it.
+
+The operator entry to the fault-injection plane (surge_tpu.testing.faults)
+over the broker's admin RPCs::
+
+    python tools/chaos.py arm 127.0.0.1:16001 flaky-network --seed 7
+    python tools/chaos.py arm 127.0.0.1:16001 '{"rules": [{"site": "crash.transact.post-apply", "action": "crash"}]}'
+    python tools/chaos.py status 127.0.0.1:16001
+    python tools/chaos.py disarm 127.0.0.1:16001
+    python tools/chaos.py broker 127.0.0.1:16001     # role/epoch/leader view
+    python tools/chaos.py promote 127.0.0.1:16002    # failover drill
+    python tools/chaos.py plans                      # list named plans
+
+``arm`` takes a NAMED plan (see ``plans``) or a JSON rule list / object;
+after arming it reports the plane's stats, and with ``--watch`` polls the
+broker until the plan's rules are exhausted (or the broker dies — which for
+crash plans is the expected outcome, reported as such).
+
+Exit code 0 on success; 3 when --watch ends with the broker unreachable
+(crash plans: that IS the outcome); 2 on bad arguments.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("command",
+                    choices=["arm", "disarm", "status", "broker", "promote",
+                             "plans"])
+    ap.add_argument("target", nargs="?", help="broker host:port")
+    ap.add_argument("plan", nargs="?",
+                    help="named fault plan or JSON rules (arm only)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="deterministic schedule seed (arm only)")
+    ap.add_argument("--watch", action="store_true",
+                    help="after arming, poll until every rule is exhausted "
+                         "or the broker goes down")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="--watch poll interval seconds")
+    args = ap.parse_args(argv)
+
+    if args.command == "plans":
+        from surge_tpu.testing.faults import NAMED_PLANS
+
+        for name, factory in sorted(NAMED_PLANS.items()):
+            rules = [r.as_dict() for r in factory()]
+            print(f"{name}: {json.dumps(rules)}")
+        return 0
+
+    if not args.target:
+        print("a broker target is required", file=sys.stderr)
+        return 2
+
+    from surge_tpu.log import GrpcLogTransport
+
+    client = GrpcLogTransport(args.target)
+    try:
+        if args.command == "broker":
+            print(json.dumps(client.broker_status(), indent=2))
+            return 0
+        if args.command == "promote":
+            print(json.dumps(client.promote_follower(), indent=2))
+            return 0
+        if args.command == "status":
+            print(json.dumps(client.fault_stats(), indent=2))
+            return 0
+        if args.command == "disarm":
+            print(json.dumps(client.disarm_faults(), indent=2))
+            return 0
+        # arm
+        if not args.plan:
+            print("arm needs a named plan or JSON rules "
+                  "(see `chaos.py plans`)", file=sys.stderr)
+            return 2
+        stats = client.arm_faults(args.plan, seed=args.seed)
+        print(json.dumps(stats, indent=2))
+        if not args.watch:
+            return 0
+        while True:
+            time.sleep(args.interval)
+            try:
+                stats = client.fault_stats()
+            except Exception as exc:  # noqa: BLE001 — broker gone
+                print(json.dumps({"outcome": "broker unreachable "
+                                             "(crash plans: expected)",
+                                  "error": str(exc)[:200]}))
+                return 3
+            exhausted = all(r["times"] is not None
+                            and r["fired"] >= r["times"]
+                            for r in stats["rules"])
+            print(json.dumps({"injected": stats["injected"],
+                              "crashed": stats["crashed"],
+                              "exhausted": exhausted}))
+            if exhausted or stats["crashed"]:
+                print(json.dumps({"outcome": "plan complete", **stats}))
+                return 0
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
