@@ -568,7 +568,13 @@ def failover_bench() -> dict:
     - ``failover_unavailability_ms`` — the longest gap between consecutive
       successful acks across all workers (the outage the client actually saw);
     - ``acked_commits`` / ``lost`` / ``duplicated`` — ledger vs the promoted
-      leader's log (both MUST be 0).
+      leader's log (both MUST be 0);
+    - ``failover_timeline`` — the machine-readable failover story merged from
+      BOTH brokers' flight recorders (host-monotonic timestamps): promotion
+      decision → promotion → fence → truncation → first acked post-failover
+      commit. The fence/truncation legs come from restarting the killed
+      ex-leader against the new leader after the load phase — the full
+      KIP-101 rejoin, reconstructed without reading a single log line.
 
     Env: SURGE_BENCH_FAILOVER_WORKERS (16), SURGE_BENCH_FAILOVER_SECONDS (6;
     the kill lands ~40% in)."""
@@ -656,6 +662,24 @@ def failover_bench() -> dict:
         deadline = time.monotonic() + 30
         while follower.role != "leader" and time.monotonic() < deadline:
             time.sleep(0.02)
+    # rejoin leg: restart the killed ex-leader (same inner log, same flight
+    # recorder) against the new leader — its split-brain guard finds the
+    # higher epoch BEFORE serving, records the fence, truncates the divergent
+    # tail and catches up, completing the flight-recorded failover story
+    relit = None
+    if killed_at is not None and follower.role == "leader":
+        if leader.kill_done is not None:
+            leader.kill_done.wait(10)
+        try:
+            relit = LogServer(leader.log, port=lport,
+                              replicate_to=[f"127.0.0.1:{fport}"],
+                              flight=leader.flight, config=cfg)
+            relit.start()
+            deadline = time.monotonic() + 20
+            while relit.role != "follower" and time.monotonic() < deadline:
+                time.sleep(0.05)
+        except Exception as exc:  # noqa: BLE001 — timeline then incomplete
+            log(f"failover bench: ex-leader rejoin failed: {exc!r}")
     # unavailability: the longest gap between consecutive acks anywhere
     # (covers the kill → promotion → first post-failover ack window)
     gaps = [b - a for a, b in zip(ack_times, ack_times[1:])]
@@ -665,7 +689,15 @@ def failover_bench() -> dict:
         present[r.value] = present.get(r.value, 0) + 1
     lost = sum(1 for p in acked if present.get(p, 0) == 0)
     duplicated = sum(1 for p in acked if present.get(p, 0) > 1)
+    # the failover timeline, reconstructed from both brokers' black boxes
+    from surge_tpu.observability import merge_dumps, reconstruct_failover
+
+    dumps = [leader.flight.dump(), follower.flight.dump()]
+    merged = merge_dumps(dumps)
+    recon = reconstruct_failover(merged)
     setup.close()
+    if relit is not None:
+        relit.stop()
     leader.stop()
     follower.stop()
     out = {
@@ -677,12 +709,20 @@ def failover_bench() -> dict:
         "epoch": follower.epoch,
         "workers": workers,
         "seconds": seconds,
+        "failover_timeline": {
+            "events": merged,
+            "phases": recon["phases"],
+            "complete": recon["complete"],
+            "decision_to_first_ack_ms": recon["span_ms"],
+        },
     }
     if lost or duplicated:
         out["FAILED"] = "acked-record loss or duplication detected"
     log(f"failover bench: {len(acked)} acked, lost={lost} "
         f"duplicated={duplicated}, unavailability "
-        f"{unavailability_ms}ms, promoted={out['promoted']}")
+        f"{unavailability_ms}ms, promoted={out['promoted']}, "
+        f"timeline complete={recon['complete']} "
+        f"(decision->first-ack {recon['span_ms']}ms)")
     return out
 
 

@@ -23,6 +23,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", _platform)
 
 
+def pytest_configure(config):
+    # the tier-1 budget rests on `-m 'not slow'`: register the marker so a
+    # typo'd @pytest.mark.sloow fails the -W error audit instead of silently
+    # joining tier-1 (chaos soaks and minutes-long benches must stay out)
+    config.addinivalue_line(
+        "markers", "slow: minutes-long soak/bench tests excluded from the "
+                   "tier-1 `-m 'not slow'` run")
+
+
 def free_ports(n: int = 1) -> list:
     """Distinct ephemeral ports: all sockets stay bound until every port is
     chosen, so two consecutive calls cannot hand back the same port."""

@@ -269,6 +269,9 @@ class AggregateEntity:
 
             span = self.tracer.start_span(
                 f"entity.{type(env.message).__name__}", headers=env.headers)
+            # active for this entity task: the command/publish timers recorded
+            # inside _handle_inner capture this trace as their exemplar
+            span.activate()
             span.set_attribute("aggregate_id", self.aggregate_id)
             span.set_attribute("partition", self.partition)
             # downstream hops (the publisher's publish span) chain under the

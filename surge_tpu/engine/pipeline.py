@@ -122,7 +122,12 @@ class SurgeEngine(Controllable):
         # observability plane: metrics registry + health signal bus + supervisor
         # (SurgeMessagePipeline wires the SlidingHealthSignalStreamProvider + Metrics
         # the same way, SurgeMessagePipeline.scala:56-87)
-        self.metrics_registry = Metrics()
+        # surge.metrics.exemplars: timers' histograms capture the active
+        # trace id per recording (OpenMetrics exemplars — a p99 publish
+        # bucket links to one JSONL trace). Opt-in: the engine hot path
+        # records several timers per command.
+        self.metrics_registry = Metrics(
+            exemplars=self.config.get_bool("surge.metrics.exemplars", False))
         self.metrics = engine_metrics(self.metrics_registry)
         if getattr(self.log, "metrics", False) is None:
             # a broker-backed transport (GrpcLogTransport) counts its

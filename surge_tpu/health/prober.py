@@ -38,9 +38,14 @@ class BrokerLivenessProber:
     def __init__(self, target: str, ping: Callable[[], None],
                  config: Config | None = None,
                  on_dead: Optional[Callable[[], None]] = None,
-                 on_signal: Optional[Callable[[str, str], None]] = None) -> None:
+                 on_signal: Optional[Callable[[str, str], None]] = None,
+                 flight=None) -> None:
         cfg = config or default_config()
         self.target = target
+        #: optional FlightRecorder: the promotion DECISION (leader declared
+        #: dead) is the failover timeline's opening event — it must be
+        #: reconstructable even though no RPC ever carries it
+        self.flight = flight
         self.interval_s = cfg.get_seconds(
             "surge.log.failover.probe-interval-ms", 1_000)
         self.failures_needed = max(1, cfg.get_int(
@@ -98,6 +103,11 @@ class BrokerLivenessProber:
                                  "consecutive probe failures", self.target,
                                  self.failure_streak)
                     self._on_signal("broker.dead", "error")
+                    if self.flight is not None:
+                        self.flight.record("role.promote-decision",
+                                           dead_leader=self.target,
+                                           failure_streak=self.failure_streak,
+                                           probes=self.probes)
                     try:
                         self._on_dead()
                     except Exception:  # noqa: BLE001

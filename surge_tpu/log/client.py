@@ -587,6 +587,31 @@ class GrpcLogTransport:
             raise RuntimeError(f"PromoteFollower failed: {reply.error}")
         return json.loads(reply.records[0].value)
 
+    def log_metrics_text(self) -> str:
+        """The connected broker's OpenMetrics payload (its own registry:
+        surge.log.replication.*/journal.*/txn.* + per-follower lag families)
+        over the GetMetricsText RPC — scrape-over-gRPC, no scrape port
+        needed."""
+        reply = self._invoke("GetMetricsText", pb.ListTopicsRequest())
+        if not reply.ok:
+            raise RuntimeError(f"GetMetricsText failed: {reply.error}")
+        return reply.records[0].value.decode()
+
+    def flight_dump(self, last: Optional[int] = None) -> dict:
+        """The connected broker's flight-recorder dump (merge-ready envelope,
+        surge_tpu.observability.merge_dumps); ``last`` keeps only the newest
+        N events (the chaos CLI's tail view)."""
+        import json
+
+        req = pb.ReadRequest()
+        if last is not None:
+            req.has_max = True
+            req.max_records = last
+        reply = self._invoke("DumpFlight", req)
+        if not reply.ok:
+            raise RuntimeError(f"DumpFlight failed: {reply.error}")
+        return json.loads(reply.records[0].value)
+
     def arm_faults(self, spec: str, seed: int = 0) -> dict:
         """Arm a named fault plan or JSON rule list on the connected broker
         (surge_tpu.testing.faults); returns the plane's stats."""

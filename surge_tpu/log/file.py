@@ -155,6 +155,14 @@ class FileLog(LogBase):
         #: sites: journal.write (torn), fsync.journal / fsync.segment,
         #: crash.journal.post-write
         self.faults = faults
+        #: broker observability hooks, wired by a hosting LogServer (both
+        #: None-guarded on the hot path): ``broker_metrics`` is a
+        #: surge_tpu.metrics.broker.BrokerMetrics quiver fed by the group-sync
+        #: worker (fsync round duration/occupancy, WAL bytes, rotations);
+        #: ``flight`` a surge_tpu.observability.FlightRecorder that gets the
+        #: journal-rotation events
+        self.broker_metrics = None
+        self.flight = None
         self._lock = threading.RLock()
         self._topics: Dict[str, TopicSpec] = {}
         self._epochs: Dict[str, int] = {}
@@ -667,6 +675,7 @@ class FileLog(LogBase):
                         fut.set_exception(RuntimeError("log closed"))
                 return
             err: Optional[BaseException] = None
+            round_t0 = time.perf_counter()
             try:
                 if self.faults is not None:
                     self.faults.on_fsync("journal")
@@ -689,6 +698,12 @@ class FileLog(LogBase):
                     # retry_pipelined (re-joining a later round; the records
                     # are already placed, nothing re-appends)
                     ready, self._gc_waiters = self._gc_waiters, []
+            bm = self.broker_metrics
+            if bm is not None and err is None:
+                bm.journal_fsync_round_timer.record_ms(
+                    (time.perf_counter() - round_t0) * 1000.0)
+                bm.journal_round_occupancy.record(len(ready))
+                bm.journal_wal_bytes.record(target)
             for _t, fut in ready:
                 if not fut.done():
                     if err is None:
@@ -760,6 +775,13 @@ class FileLog(LogBase):
             self._journal = open(self._journal_path, "ab")
             with self._gc_cv:
                 self._gc_written = self._gc_durable = self._journal.tell()
+            if self.broker_metrics is not None:
+                self.broker_metrics.journal_rotations.record()
+                self.broker_metrics.journal_wal_bytes.record(
+                    self._journal.tell())
+            if self.flight is not None:
+                self.flight.record("journal.rotate", old_bytes=old_size,
+                                   new_bytes=self._journal.tell())
             logger.info("rotated commit journal (%d -> %d bytes)",
                         old_size, self._journal.tell())
 

@@ -126,7 +126,7 @@ class _ReplItem:
     every follower applies the identical generational swap)."""
 
     __slots__ = ("specs", "records", "txn_id", "seq", "done", "error",
-                 "kind", "manifest", "result")
+                 "kind", "manifest", "result", "index", "cum_records")
 
     def __init__(self, specs, records, txn_id: str = "", seq: int = 0,
                  kind: str = "", manifest: Optional[dict] = None) -> None:
@@ -139,17 +139,30 @@ class _ReplItem:
         self.result = None  # barrier: the leader-side CompactionStats
         self.done = threading.Event()
         self.error: Optional[str] = None
+        #: enqueue bookkeeping for the per-follower lag gauges: this item's
+        #: position in the cumulative enqueue count, and the cumulative
+        #: record count THROUGH it (0 until queued — probe/resync ships use
+        #: synthetic items that never enter the queue)
+        self.index = 0
+        self.cum_records = 0
 
 
 class _TargetState:
     """Leader-side in-sync tracking for one replication target."""
 
-    __slots__ = ("in_sync", "failing_since", "next_probe")
+    __slots__ = ("in_sync", "failing_since", "next_probe", "shipped_index",
+                 "shipped_records")
 
     def __init__(self) -> None:
         self.in_sync = True
         self.failing_since: Optional[float] = None
         self.next_probe = 0.0
+        #: acked-through marks (absolute, idempotent under re-ship): the
+        #: enqueue index / cumulative record count of the newest queue item
+        #: this follower acked — per-follower lag = enqueue counters minus
+        #: these (surge_log_replication_lag_records{follower=...})
+        self.shipped_index = 0
+        self.shipped_records = 0
 
 
 #: compacted broker-internal topic persisting (txn_id -> last committed seq +
@@ -199,6 +212,15 @@ METHODS = {
     "ArmFaults": (pb.TxnRequest, pb.TxnReply),
     "PromoteFollower": (pb.TxnRequest, pb.TxnReply),
     "BrokerStatus": (pb.ListTopicsRequest, pb.TxnReply),
+    # broker observability plane (message reuse, same convention as above):
+    # GetMetricsText — the broker registry (surge.log.replication.*/journal.*/
+    #   txn.*) + per-follower lag collector rendered as OpenMetrics text in
+    #   the reply record's value (byte-identical to the metrics_port scrape).
+    # DumpFlight — the flight recorder's merge-ready dump as JSON in the
+    #   reply record's value; ReadRequest.max_records (has_max) limits to the
+    #   newest N events (the chaos CLI's tail).
+    "GetMetricsText": (pb.ListTopicsRequest, pb.TxnReply),
+    "DumpFlight": (pb.ReadRequest, pb.TxnReply),
 }
 
 
@@ -253,10 +275,28 @@ class LogServer:
                  follower_of: Optional[str] = None,
                  auto_promote: Optional[bool] = None,
                  advertised: Optional[str] = None,
-                 faults=None, metrics=None) -> None:
+                 faults=None, metrics=None, broker_metrics=None,
+                 flight=None, metrics_port: Optional[int] = None) -> None:
+        from surge_tpu.metrics.broker import broker_metrics as _broker_metrics
+        from surge_tpu.observability.flight import FlightRecorder
+
         self.log = log
         self.tracer = tracer  # broker-side transact spans (None = zero cost)
-        self.metrics = metrics  # EngineMetrics quiver (optional): failover/fault counters
+        #: this broker's own instrument registry (surge.log.replication.* /
+        #: journal.* / txn.* — docs/observability.md broker catalog), exposed
+        #: over GetMetricsText and the optional metrics_port scrape endpoint
+        self.broker_metrics = broker_metrics if broker_metrics is not None \
+            else _broker_metrics()
+        #: EngineMetrics quiver when an engine hosts this broker; the broker
+        #: quiver carries twin failover/fault sensors, so a standalone broker
+        #: counts them into its own scrape
+        self.metrics = metrics if metrics is not None else self.broker_metrics
+        #: bounded black-box event ring (role transitions, epoch bumps,
+        #: truncations, barriers, fault firings — DumpFlight RPC / crash dump)
+        self.flight = flight if flight is not None else FlightRecorder()
+        self._metrics_port = metrics_port
+        self._metrics_server = None
+        self.metrics_bound_port: Optional[int] = None
         self._host = host
         self._port = port
         self._config = config
@@ -346,6 +386,32 @@ class LogServer:
         self.faults = faults
         if self.faults is not None:
             self.faults.on_crash = lambda point: self.kill()
+            self.faults.flight = self.flight  # fault firings join the ring
+        # replication progress (cumulative enqueue counters + per-target
+        # acked-through marks) — what the per-follower lag gauges read
+        self._repl_enq_items = 0
+        self._repl_enq_records = 0
+        # observer state the BrokerStatus RPC reports: a rejoining fenced
+        # ex-leader is visibly mid-catch_up, not indistinguishable from a
+        # healthy follower (ISSUE 5 satellite)
+        self.catch_up_state: dict = {"state": "idle"}
+        self.last_applied_epoch_start: Dict[str, Dict[str, int]] = {}
+        self.last_truncation: Optional[dict] = None
+        self._flight_first_ack = False  # armed by promote(): the next acked
+        # seq-ful commit records txn.first-ack (the failover timeline's close)
+        self._flight_dump_dir = cfg.get_str("surge.log.flight.dump-dir", "")
+        # inner-log observability hooks (FileLog WAL rounds/rotations); the
+        # attributes exist only on logs that instrument them. Overwrite
+        # unconditionally: a broker RESTARTED over an already-instrumented
+        # log (the rejoin path re-wraps the same FileLog) must re-point the
+        # hooks at ITS quiver/ring, or journal metrics freeze on the dead
+        # server's registry
+        if hasattr(self.log, "broker_metrics"):
+            self.log.broker_metrics = self.broker_metrics
+        if hasattr(self.log, "flight"):
+            self.log.flight = self.flight
+        self.broker_metrics.repl_epoch.record(self.epoch)
+        self.broker_metrics.repl_insync_replicas.record(self._insync_count())
         self._dead = False  # set by kill(): every later RPC answers UNAVAILABLE
         self.kill_done = None  # threading.Event from kill()'s socket close
         # automatic promotion: a follower probing its leader declares it dead
@@ -369,9 +435,7 @@ class LogServer:
             # best-effort wait — the ordered queue guarantees it lands before
             # any subsequent batch either way
             item = _ReplItem([request.spec], [])
-            with self._repl_cv:
-                self._repl_queue.append(item)
-                self._repl_cv.notify()
+            self._enqueue_item(item)
             item.done.wait(self._repl_ack_timeout_s)
         return pb.TopicReply(found=True, spec=request.spec)
 
@@ -440,6 +504,7 @@ class LogServer:
         state.alias_floor = dedup.last_seq
         state.alias_ceiling = last
         state.alias_budget = max(0, last - dedup.last_seq)
+        self.broker_metrics.txn_alias_window.record(state.alias_budget)
         return pb.OpenProducerReply(producer_token=token, last_txn_seq=last)
 
     def Transact(self, request: pb.TxnRequest, context) -> pb.TxnReply:
@@ -449,7 +514,8 @@ class LogServer:
                 error=f"broker is a {self.role}, not the leader",
                 leader_hint=self.leader_hint)
         if self.tracer is None:
-            return self._transact_impl(request, context)
+            return self._note_first_ack(self._transact_impl(request, context),
+                                        request)
         # the client ships its traceparent as call metadata: the broker-side
         # span joins the same trace as the publisher's flush that caused it
         headers = {k: v for k, v in (context.invocation_metadata() or ())
@@ -463,7 +529,19 @@ class LogServer:
             if not reply.ok:
                 span.status = "error"
                 span.set_attribute("error_kind", reply.error_kind)
-            return reply
+            return self._note_first_ack(reply, request)
+
+    def _note_first_ack(self, reply: pb.TxnReply,
+                        request: pb.TxnRequest) -> pb.TxnReply:
+        """Flight-record the first seq-ful commit acked after a promotion —
+        the failover timeline's closing phase (clients are provably being
+        served by the new leader again)."""
+        if self._flight_first_ack and reply.ok and request.txn_seq:
+            self._flight_first_ack = False  # benign race: first-match wins
+            self.flight.record("txn.first-ack", epoch=self.epoch,
+                               txn_seq=request.txn_seq,
+                               records=len(reply.records))
+        return reply
 
     def _transact_impl(self, request: pb.TxnRequest, context) -> pb.TxnReply:
         state = self._producers.get(request.producer_token)
@@ -486,10 +564,13 @@ class LogServer:
         join_item: Optional[_ReplItem] = None
         sync_handle = None  # pipelined inner-log commit awaiting its round
         committed: list = []
+        gate_t0: Optional[float] = None  # set when the in-order gate holds us
         with state.lock:
             dedup = state.dedup
             fresh = state.fresh
             if seq:
+                self.broker_metrics.txn_pipelined_depth.record(
+                    max(0, seq - dedup.last_seq))
                 # only a SEQ-FUL transact consumes the reopen-freshness: the
                 # publisher's unsequenced epoch flush record must not eat the
                 # one-shot absorption window its stashed batch needs
@@ -595,6 +676,8 @@ class LogServer:
                     # retries the same seq on a retriable answer, preserving
                     # exactly-once)
                     if seq > dedup.applied_seq + 1:
+                        if gate_t0 is None:
+                            gate_t0 = time.monotonic()
                         if time.monotonic() >= deadline:
                             return pb.TxnReply(
                                 ok=False, error_kind="retriable",
@@ -618,6 +701,13 @@ class LogServer:
                                       "the same txn_seq")
                         state.cond.wait(0.05)
                         continue
+                if gate_t0 is not None:
+                    # the gate released us: how long a pipelined seq stalled
+                    # for its predecessor (high values = window too deep or a
+                    # predecessor wedged in a slow round)
+                    self.broker_metrics.txn_inorder_wait_timer.record_ms(
+                        (time.monotonic() - gate_t0) * 1000.0)
+                    gate_t0 = None
                 try:
                     if request.op == "commit":
                         producer = state.producer
@@ -739,6 +829,7 @@ class LogServer:
             dedup.locator = None
         if seq > dedup.applied_seq:
             dedup.applied_seq = seq
+        self.broker_metrics.txn_dedup_window.record(len(dedup.replies))
         self._persist_txn_state(txn_id, seq, committed)
 
     def _alias_match(self, state: "_ProducerState", records):
@@ -796,6 +887,30 @@ class LogServer:
 
     # -- replication: leader side ---------------------------------------------------------
 
+    def _enqueue_item(self, item: _ReplItem) -> None:
+        """The one place items enter the ordered queue: assigns the enqueue
+        index / cumulative record count the per-follower lag gauges measure
+        against, registers seq-ful items as pending, wakes the worker."""
+        with self._repl_cv:
+            self._repl_enq_items += 1
+            self._repl_enq_records += len(item.records)
+            item.index = self._repl_enq_items
+            item.cum_records = self._repl_enq_records
+            self._repl_queue.append(item)
+            if item.seq:
+                self._repl_pending[(item.txn_id, item.seq)] = item
+            self._repl_cv.notify()
+
+    def _repl_progress(self, target: str) -> tuple:
+        """(lag_batches, lag_records) for one follower — enqueue counters
+        minus its acked-through marks (the broker_collector scrape view)."""
+        st = self._repl_target_state.get(target)
+        if st is None:
+            return 0, 0
+        with self._repl_cv:
+            return (max(0, self._repl_enq_items - st.shipped_index),
+                    max(0, self._repl_enq_records - st.shipped_records))
+
     def _enqueue_replication(self, committed, txn_id: str, seq: int) -> _ReplItem:
         specs = []
         seen = set()
@@ -807,11 +922,7 @@ class LogServer:
                                              partitions=spec.partitions,
                                              compacted=spec.compacted))
         item = _ReplItem(specs, list(committed), txn_id, seq)
-        with self._repl_cv:
-            self._repl_queue.append(item)
-            if seq:
-                self._repl_pending[(txn_id, seq)] = item
-            self._repl_cv.notify()
+        self._enqueue_item(item)
         return item
 
     def _finish_replicated(self, state: "_ProducerState", seq: int,
@@ -996,7 +1107,13 @@ class LogServer:
             if st.in_sync:
                 if item is None:
                     continue  # idle probe pass: nothing to ship
+                ship_t0 = time.perf_counter()
                 err = self._ship(target, item)
+                # timer only for a clean first-try ship: a gap-resync rescue
+                # below can take seconds and would pollute a histogram
+                # documented as ms-per-head-item-ship
+                clean_ship_ms = (None if err is not None else
+                                 (time.perf_counter() - ship_t0) * 1000.0)
                 if err is not None and "gap:" in err and now >= st.next_probe:
                     # reachable but BEHIND (e.g. restarted empty while the
                     # min-insync floor forbids dropping it): every ship would
@@ -1012,6 +1129,12 @@ class LogServer:
                             "drops", target, err)
                 if err is None:
                     st.failing_since = None
+                    if item.index:  # queued item acked: advance the marks
+                        st.shipped_index = item.index
+                        st.shipped_records = item.cum_records
+                        if clean_ship_ms is not None:
+                            self.broker_metrics.repl_ship_timer.record_ms(
+                                clean_ship_ms)
                     continue
                 if st.failing_since is None:
                     st.failing_since = now
@@ -1020,6 +1143,11 @@ class LogServer:
                         and insync_after_drop >= self._repl_min_insync):
                     st.in_sync = False
                     st.next_probe = now + 1.0
+                    self.broker_metrics.repl_isr_churn.record()
+                    self.broker_metrics.repl_insync_replicas.record(
+                        self._insync_count())
+                    self.flight.record("isr.drop", follower=target,
+                                       error=err[:200])
                     logger.error(
                         "follower %s dropped from the in-sync set after "
                         "%.0fs of failures (%s); commits proceed with "
@@ -1037,6 +1165,17 @@ class LogServer:
                 if err is None:
                     st.in_sync = True
                     st.failing_since = None
+                    # resync proved a complete prefix net of the queue: the
+                    # follower holds everything not still queued
+                    with self._repl_cv:
+                        st.shipped_index = (self._repl_enq_items
+                                            - len(self._repl_queue))
+                        st.shipped_records = self._repl_enq_records - sum(
+                            len(it.records) for it in self._repl_queue)
+                    self.broker_metrics.repl_isr_churn.record()
+                    self.broker_metrics.repl_insync_replicas.record(
+                        self._insync_count())
+                    self.flight.record("isr.rejoin", follower=target)
                     logger.warning("follower %s re-joined the in-sync set",
                                    target)
                 else:
@@ -1070,6 +1209,8 @@ class LogServer:
             # its own finalized item still counted in queue_depth
             with self._repl_cv:
                 self._repl_queue.pop(0)
+                depth = len(self._repl_queue)
+            self.broker_metrics.repl_queue_depth.record(depth)
             item.done.set()
             return 0.05
         item.error = blocking_err  # visible to a waiter that times out
@@ -1109,6 +1250,8 @@ class LogServer:
         m["upto"] = upto
         m["expect_clean_count"] = \
             self.log.compaction_state(topic, p)["clean_count"]
+        self.flight.record("compaction.barrier", topic=topic, partition=p,
+                           upto=upto, clean_count=m["expect_clean_count"])
         item.result = stats
         # the manifest rides a topic-less record so _queued_counts never
         # mistakes it for a queued data record
@@ -1234,6 +1377,7 @@ class LogServer:
                                      timeout=1.0)
                     if err is not None:
                         return err
+                    self.broker_metrics.repl_catchup_records.record(len(batch))
                     theirs = batch[-1].offset + 1
             if total:
                 # dedup table rides along: the pushed records' (txn_id, seq)
@@ -1347,14 +1491,21 @@ class LogServer:
                 if request.leader_epoch > self.epoch:
                     was_active_leader = (self.role == "leader"
                                          and bool(self._repl_targets))
+                    deposed_epoch = self.epoch
+                    self.flight.record("epoch.bump",
+                                       old_epoch=self.epoch,
+                                       new_epoch=request.leader_epoch,
+                                       source=request.leader_target or "ship")
                     self.epoch = request.leader_epoch
                     self._persist_meta("epoch", {"e": self.epoch})
+                    self.broker_metrics.repl_epoch.record(self.epoch)
                     if was_active_leader:
                         # split-brain resolution: higher epoch wins — this
                         # replicating leader is deposed by the inbound stream
                         self._demote(request.leader_epoch,
                                      request.leader_target or None,
-                                     adopt_epoch=False)
+                                     adopt_epoch=False,
+                                     old_epoch=deposed_epoch)
                 if request.leader_target:
                     self.leader_hint = request.leader_target
         if request.kind == "barrier":
@@ -1474,6 +1625,8 @@ class LogServer:
                         error=f"barrier divergence on {topic}[{p}]: replica "
                               f"retained {mine} records, leader {want} — "
                               "wipe and catch_up")
+            self.flight.record("compaction.barrier-apply", topic=topic,
+                               partition=p, upto=upto, clean_count=mine)
             return pb.ReplicateReply(ok=True)
         except Exception as exc:  # noqa: BLE001
             logger.exception("compaction barrier failed")
@@ -1559,7 +1712,17 @@ class LogServer:
                     # in-process or through the RPC's JSON roundtrip
                     "epoch_start": {t: {str(p): off for p, off in parts.items()}
                                     for t, parts in self.epoch_start.items()},
-                    "replicate_to": list(self._repl_targets)}
+                    "replicate_to": list(self._repl_targets),
+                    # rejoin observability (ISSUE 5 satellite): a fenced
+                    # ex-leader is visibly mid-recovery — catch_up progress
+                    # plus the epoch-start offsets its truncation last
+                    # applied, vs indistinguishable from a healthy follower
+                    "catch_up": dict(self.catch_up_state),
+                    "last_applied_epoch_start":
+                        {t: dict(p) for t, p in
+                         self.last_applied_epoch_start.items()},
+                    "last_truncation": (dict(self.last_truncation)
+                                        if self.last_truncation else None)}
 
     def promote(self, replicate_to: Optional[list] = None) -> dict:
         """Follower → leader promotion (admin PromoteFollower RPC, or the
@@ -1619,28 +1782,44 @@ class LogServer:
             logger.warning("PROMOTED to leader at epoch %d (epoch-start %s)",
                            self.epoch,
                            {t: p for t, p in list(starts.items())[:4]})
-            if self.metrics is not None:
-                self.metrics.failover_promotions.record()
+            self.metrics.failover_promotions.record()
+            self.broker_metrics.repl_epoch.record(self.epoch)
+            self.broker_metrics.repl_insync_replicas.record(
+                self._insync_count())
+            self._flight_first_ack = True
+            self.flight.record(
+                "role.promote", epoch=self.epoch,
+                replicate_to=list(self._repl_targets),
+                epoch_start={t: {str(p): off for p, off in parts.items()}
+                             for t, parts in list(starts.items())[:8]})
             return self.broker_status()
 
     def _demote(self, new_epoch: int, fencer: Optional[str],
-                adopt_epoch: bool = True) -> None:
+                adopt_epoch: bool = True,
+                old_epoch: Optional[int] = None) -> None:
         """A higher epoch fenced this leader: stop writing, fail the queue,
         truncate the divergent unreplicated tail to the new leader's
         epoch-start offsets (KIP-101), wipe the local dedup view and re-pull
         log + dedup from the new leader (catch_up), then serve as a follower.
         Never raises — a failing step leaves the broker demoted-but-behind,
-        which the new leader's rejoin probe (or operator catch_up) heals."""
+        which the new leader's rejoin probe (or operator catch_up) heals.
+        ``old_epoch``: the DEPOSED epoch, for callers (Replicate's inbound
+        split-brain path) that already adopted the fencing epoch before
+        demoting — without it the fence would log/record N deposed by N."""
         with self._role_lock:
             if self._demoting:
                 return
             self._demoting = True
         try:
             with self._role_lock:
+                deposed = old_epoch if old_epoch is not None else self.epoch
                 logger.error(
                     "FENCED: leader epoch %d deposed by epoch %d (%s); "
-                    "demoting to follower", self.epoch, new_epoch,
+                    "demoting to follower", deposed, new_epoch,
                     fencer or "unknown peer")
+                self.flight.record("role.fence", old_epoch=deposed,
+                                   new_epoch=new_epoch,
+                                   fencer=fencer or "unknown")
                 if adopt_epoch and new_epoch > self.epoch:
                     self.epoch = new_epoch
                     self._persist_meta("epoch", {"e": self.epoch})
@@ -1658,8 +1837,8 @@ class LogServer:
                 for it in stranded:
                     it.error = f"fenced by epoch {new_epoch}"
                     it.done.set()
-            if self.metrics is not None:
-                self.metrics.failover_fencings.record()
+            self.metrics.failover_fencings.record()
+            self.broker_metrics.repl_epoch.record(self.epoch)
             if fencer:
                 self._truncate_to_leader(fencer)
         finally:
@@ -1684,12 +1863,23 @@ class LogServer:
                     mine = self._applied_end(topic, p)
                     if mine > int(start) and fn is not None:
                         truncated += fn(topic, p, int(start))
+            # observable rejoin state (BrokerStatus): which epoch-start this
+            # fenced ex-leader rolled back to, and how much it dropped
+            self.last_applied_epoch_start = {
+                t: {str(p): int(off) for p, off in parts.items()}
+                for t, parts in starts.items()}
+            self.last_truncation = {"records": truncated,
+                                    "epoch": int(status.get("epoch", 0)),
+                                    "leader": leader_target,
+                                    "wall": time.time()}
+            self.flight.record("log.truncate", records=truncated,
+                               leader=leader_target,
+                               epoch=int(status.get("epoch", 0)))
             if truncated:
                 logger.warning(
                     "truncated %d divergent unreplicated record(s) to the "
                     "new leader's epoch-start offsets", truncated)
-                if self.metrics is not None:
-                    self.metrics.failover_truncated_records.record(truncated)
+                self.metrics.failover_truncated_records.record(truncated)
             # the truncated seqs' dedup entries point at dropped records; the
             # new leader's table is authoritative — rebuild from it
             with self._replica_lock:
@@ -1751,6 +1941,7 @@ class LogServer:
         process looks like to clients. The inner log is left as-is (a crash
         does not flush)."""
         self._dead = True
+        self.flight.record("broker.kill", role=self.role, epoch=self.epoch)
         server, self._server = self._server, None
         #: threading.Event set once the socket is fully closed (grpc's stop
         #: is non-blocking, so this is safe even from a handler thread —
@@ -1763,6 +1954,23 @@ class LogServer:
         if self._leader_prober is not None:
             self._leader_prober.stop()
             self._leader_prober = None
+        self._stop_metrics_server()
+        if self._flight_dump_dir:
+            # the black-box survives the "crash": the recorder's ring is
+            # dumped where a post-mortem (or the timeline merge) finds it
+            import os as _os
+
+            self.flight.dump_to(_os.path.join(
+                self._flight_dump_dir,
+                f"flight-{self.bound_port or id(self)}.json"))
+
+    def _stop_metrics_server(self) -> None:
+        server, self._metrics_server = self._metrics_server, None
+        if server is not None:
+            try:
+                server.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
 
     # -- broker admin RPCs ----------------------------------------------------------------
 
@@ -1773,6 +1981,35 @@ class LogServer:
         return pb.TxnReply(ok=True, records=[pb.RecordMsg(
             has_key=True, key="status", has_value=True,
             value=_json.dumps(self.broker_status()).encode())])
+
+    def metrics_text(self) -> str:
+        """The broker's OpenMetrics payload: its registry (journal/txn/
+        replication instruments + failover counters) plus the live
+        per-follower lag collector — what the scrape port serves and the
+        GetMetricsText RPC ships."""
+        from surge_tpu.metrics.broker import broker_collector
+        from surge_tpu.metrics.exposition import render_openmetrics
+
+        return render_openmetrics(self.broker_metrics.registry,
+                                  collectors=[broker_collector(self)])
+
+    def GetMetricsText(self, request: pb.ListTopicsRequest,
+                       context) -> pb.TxnReply:
+        try:
+            text = self.metrics_text()
+        except Exception as exc:  # noqa: BLE001 — a scrape must answer
+            return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
+        return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+            has_key=True, key="metrics", has_value=True,
+            value=text.encode())])
+
+    def DumpFlight(self, request: pb.ReadRequest, context) -> pb.TxnReply:
+        import json as _json
+
+        last = request.max_records if request.has_max else None
+        return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+            has_key=True, key="flight", has_value=True,
+            value=_json.dumps(self.flight.dump(last)).encode())])
 
     def PromoteFollower(self, request: pb.TxnRequest, context) -> pb.TxnReply:
         import json as _json
@@ -1807,6 +2044,7 @@ class LogServer:
                 if self.faults is None:
                     self.faults = plane
                     self.faults.on_crash = lambda point: self.kill()
+                    self.faults.flight = self.flight
                 else:
                     self.faults.arm(plane.rules, seed=seed)
                 if hasattr(self.log, "faults"):
@@ -1993,6 +2231,9 @@ class LogServer:
 
         leader = GrpcLogTransport(leader_target, config=self._config)
         copied = 0
+        self.catch_up_state = {"state": "running", "from": leader_target,
+                               "records": 0, "wall": time.time()}
+        self.flight.record("catchup.start", leader=leader_target)
         try:
             reply = leader._calls["ListTopics"](pb.ListTopicsRequest())
             known = getattr(self.log, "_topics", {})
@@ -2021,6 +2262,17 @@ class LogServer:
             # this snapshot) or will be gap-checked-shipped post-rejoin
             snap = leader._calls["DedupSnapshot"](pb.DedupSnapshotRequest())
             self._merge_dedup_entries(snap.entries)
+            self.catch_up_state = {"state": "done", "from": leader_target,
+                                   "records": copied, "wall": time.time()}
+            self.flight.record("catchup.done", leader=leader_target,
+                               records=copied)
+            if copied:
+                self.broker_metrics.repl_catchup_records.record(copied)
+        except BaseException as exc:
+            self.catch_up_state = {"state": "failed", "from": leader_target,
+                                   "records": copied, "wall": time.time(),
+                                   "error": repr(exc)[:200]}
+            raise
         finally:
             leader.close()
         return copied
@@ -2124,9 +2376,7 @@ class LogServer:
             "topic": topic, "partition": partition,
             "retention_s": tombstone_retention_s,
             "now": now if now is not None else time.time()})
-        with self._repl_cv:
-            self._repl_queue.append(item)
-            self._repl_cv.notify()
+        self._enqueue_item(item)
         if not item.done.wait(2 * self._repl_ack_timeout_s):
             raise RuntimeError(
                 "compaction barrier timed out awaiting the in-sync set "
@@ -2211,6 +2461,17 @@ class LogServer:
             raise RuntimeError(f"could not bind log server to {address}")
         if self.advertised is None:
             self.advertised = f"{self._host}:{self.bound_port}"
+        if not self.flight.name:
+            self.flight.name = self.advertised
+        if self._metrics_port is not None and self._metrics_server is None:
+            from surge_tpu.metrics.broker import broker_collector
+            from surge_tpu.metrics.exposition import MetricsHTTPServer
+
+            self._metrics_server = MetricsHTTPServer(
+                self.broker_metrics.registry, host=self._host,
+                port=self._metrics_port,
+                collectors=[broker_collector(self)])
+            self.metrics_bound_port = self._metrics_server.start()
         if self.role == "leader" and not self.leader_hint:
             self.leader_hint = self._my_target()
         if self._repl_targets:
@@ -2240,7 +2501,7 @@ class LogServer:
 
             self._leader_prober = BrokerLivenessProber(
                 self._follower_of, _ping, config=self._config,
-                on_dead=self._on_leader_dead)
+                on_dead=self._on_leader_dead, flight=self.flight)
             self._leader_prober.start()
         return self.bound_port
 
@@ -2256,6 +2517,7 @@ class LogServer:
             logger.exception("auto-promotion failed")
 
     def stop(self, grace: float = 1.0) -> None:
+        self._stop_metrics_server()
         if self._leader_prober is not None:
             self._leader_prober.stop()
             self._leader_prober = None

@@ -119,10 +119,16 @@ class Timer:
 
 
 class Metrics:
-    """The registry (Metrics.scala:126-228)."""
+    """The registry (Metrics.scala:126-228).
 
-    def __init__(self, recording_level: RecordingLevel = RecordingLevel.INFO) -> None:
+    ``exemplars=True`` makes every timer's histogram capture the active trace
+    id per recording (OpenMetrics exemplars — docs/observability.md); off by
+    default so the engine hot path pays nothing."""
+
+    def __init__(self, recording_level: RecordingLevel = RecordingLevel.INFO,
+                 exemplars: bool = False) -> None:
         self.recording_level = recording_level
+        self.exemplars = exemplars
         self._sensors: Dict[str, Sensor] = {}
         self._metrics: Dict[str, _Registered] = {}
 
@@ -158,7 +164,7 @@ class Metrics:
             s.add_metric(MetricInfo(f"{info.name}.min", f"min of {info.name}"), Min(), self)
             s.add_metric(MetricInfo(f"{info.name}.max", f"max of {info.name}"), Max(), self)
             s.add_metric(MetricInfo(f"{info.name}.p99", f"p99 of {info.name}"),
-                         TimeBucketHistogram(), self)
+                         TimeBucketHistogram(exemplars=self.exemplars), self)
         return Timer(s)
 
     def rate(self, info: MetricInfo, level: RecordingLevel = RecordingLevel.INFO) -> Sensor:

@@ -1,0 +1,203 @@
+"""Broker-side metrics quiver + scrape collector for the log broker.
+
+PR 4 grew the broker a replication stream, epoch-fenced failover, a WAL
+journal with group-commit fsync rounds, and a pipelined-transaction dedup
+window — none of it observable at runtime (the engine-side ``EngineMetrics``
+quiver only sees the client half). :class:`BrokerMetrics` is the broker's own
+predeclared instrument set, one registry per :class:`~surge_tpu.log.server.
+LogServer`:
+
+- ``surge.log.replication.*`` — in-sync set size, ISR churn, epoch, ordered
+  replication-queue depth, auto-resync/catch_up progress;
+- ``surge.log.journal.*`` — fsync round duration (full histogram: the group
+  commit's latency floor), round occupancy (commits acknowledged per fsync),
+  journal rotations, WAL bytes;
+- ``surge.log.txn.*`` — in-order gate wait, dedup/alias window occupancy,
+  pipelined window depth;
+- plus the ``surge.log.failover.*`` / ``surge.log.faults.*`` counters (same
+  names as the engine quiver's) so a standalone broker's scrape carries its
+  own promotion/fencing/truncation counts.
+
+Per-follower gauges (lag in records and batches, in-sync flags) are labelled
+families the registry cannot key — :func:`broker_collector` computes them
+from live ``LogServer`` state at scrape time, the same contract as
+``health_collector``. Timers capture OpenMetrics exemplars (the registry is
+built with ``exemplars=True``): a broker-side histogram bucket links to the
+trace that landed in it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional
+
+from surge_tpu.metrics import MetricInfo, Metrics, Sensor, Timer
+from surge_tpu.metrics.exposition import Family, Sample
+
+__all__ = ["BrokerMetrics", "broker_collector", "broker_metrics"]
+
+
+@dataclass
+class BrokerMetrics:
+    """The standard broker instrument set, created once per LogServer."""
+
+    registry: Metrics
+    # replication (leader side)
+    repl_insync_replicas: Sensor = field(init=False)
+    repl_isr_churn: Sensor = field(init=False)
+    repl_queue_depth: Sensor = field(init=False)
+    repl_epoch: Sensor = field(init=False)
+    repl_catchup_records: Sensor = field(init=False)
+    repl_ship_timer: Timer = field(init=False)
+    # WAL journal (FileLog group-commit rounds)
+    journal_fsync_round_timer: Timer = field(init=False)
+    journal_round_occupancy: Sensor = field(init=False)
+    journal_rotations: Sensor = field(init=False)
+    journal_wal_bytes: Sensor = field(init=False)
+    # pipelined transactions / idempotency window
+    txn_inorder_wait_timer: Timer = field(init=False)
+    txn_dedup_window: Sensor = field(init=False)
+    txn_alias_window: Sensor = field(init=False)
+    txn_pipelined_depth: Sensor = field(init=False)
+    # failover + fault-plane counters (shared names with EngineMetrics so a
+    # broker without an engine-wired quiver still counts them — the LogServer
+    # falls back to this quiver when metrics= is not given)
+    failover_promotions: Sensor = field(init=False)
+    failover_fencings: Sensor = field(init=False)
+    failover_truncated_records: Sensor = field(init=False)
+    faults_injected: Sensor = field(init=False)
+    faults_armed: Sensor = field(init=False)
+
+    def __post_init__(self) -> None:
+        m, MI = self.registry, MetricInfo
+        self.repl_insync_replicas = m.gauge(MI(
+            "surge.log.replication.insync-replicas",
+            "size of the in-sync replica set, this leader included "
+            "(min.insync semantics; commits need this many acks)"))
+        self.repl_isr_churn = m.counter(MI(
+            "surge.log.replication.isr-churn",
+            "in-sync-set membership changes (drops + rejoins) — sustained "
+            "churn means a follower is flapping"))
+        self.repl_queue_depth = m.gauge(MI(
+            "surge.log.replication.queue-depth",
+            "items in the ordered replication queue after the last finalize "
+            "(commits awaiting the in-sync set)"))
+        self.repl_epoch = m.gauge(MI(
+            "surge.log.replication.epoch",
+            "this broker's current leader epoch (KIP-101 fence view)"))
+        self.repl_catchup_records = m.counter(MI(
+            "surge.log.replication.catchup-records",
+            "records pushed to rejoining followers by leader auto-resync "
+            "(the replica fetch-loop role)"))
+        self.repl_ship_timer = m.timer(MI(
+            "surge.log.replication.ship-timer",
+            "ms per successful leader->follower Replicate ship of the "
+            "ordered queue's head item"))
+        self.journal_fsync_round_timer = m.timer(MI(
+            "surge.log.journal.fsync-round-timer",
+            "ms per WAL group-commit fsync round (the shared journal fsync "
+            "every concurrent committer rides)"))
+        self.journal_round_occupancy = m.gauge(MI(
+            "surge.log.journal.round-occupancy",
+            "commit waiters acknowledged by the last fsync round (how much "
+            "of the group-commit amortization one fsync bought)"))
+        self.journal_rotations = m.counter(MI(
+            "surge.log.journal.rotations",
+            "WAL journal rotations (segments fsynced, frontier line written, "
+            "old generation GC'd)"))
+        self.journal_wal_bytes = m.gauge(MI(
+            "surge.log.journal.wal-bytes",
+            "bytes in the live commits.log journal after the last fsync "
+            "round / rotation (embedded WAL payloads included)"))
+        self.txn_inorder_wait_timer = m.timer(MI(
+            "surge.log.txn.inorder-wait-timer",
+            "ms a pipelined txn_seq waited at the in-order apply gate for "
+            "its predecessor to apply"))
+        self.txn_dedup_window = m.gauge(MI(
+            "surge.log.txn.dedup-window",
+            "cached replies in the acking producer's dedup window at the "
+            "last ack (replays anywhere in it answer from cache)"))
+        self.txn_alias_window = m.gauge(MI(
+            "surge.log.txn.alias-window",
+            "in-limbo seqs armed for reopen-alias absorption at the last "
+            "OpenProducer (applied-but-unacked batches the reopened "
+            "producer may verbatim-retry under new seqs)"))
+        self.txn_pipelined_depth = m.gauge(MI(
+            "surge.log.txn.pipelined-depth",
+            "how far past the acked frontier the last arriving txn_seq ran "
+            "(the live pipelined window depth, bounded by "
+            "surge.producer.max-in-flight)"))
+        self.failover_promotions = m.counter(MI(
+            "surge.log.failover.promotions",
+            "follower-to-leader promotions performed by this broker"))
+        self.failover_fencings = m.counter(MI(
+            "surge.log.failover.fencings",
+            "leader-epoch fences observed: this broker was deposed and "
+            "demoted to follower"))
+        self.failover_truncated_records = m.counter(MI(
+            "surge.log.failover.truncated-records",
+            "divergent unreplicated records truncated on demotion "
+            "(KIP-101 tail rollback to the new leader's epoch-start)"))
+        self.faults_injected = m.counter(MI(
+            "surge.log.faults.injected",
+            "faults fired by the armed fault-injection plane"))
+        self.faults_armed = m.gauge(MI(
+            "surge.log.faults.armed",
+            "fault rules currently armed on this broker's plane "
+            "(0 outside chaos experiments)"))
+
+
+def broker_metrics(registry: Optional[Metrics] = None) -> BrokerMetrics:
+    """A broker quiver on its own registry (exemplar capture on: broker-side
+    histograms record inside the Transact span, so buckets link to traces)."""
+    return BrokerMetrics(registry if registry is not None
+                         else Metrics(exemplars=True))
+
+
+def broker_collector(server):
+    """Per-follower replication families computed from live LogServer state
+    at scrape time (the registry cannot key one gauge per follower):
+
+    - ``surge_log_replication_lag_records{follower}`` — records enqueued for
+      replication that this follower has not acked yet;
+    - ``surge_log_replication_lag_batches{follower}`` — same, in ordered
+      queue items;
+    - ``surge_log_replication_in_sync{follower}`` — 1 in the in-sync set;
+    - ``surge_log_broker_is_leader`` — 1 on the leader, 0 on a follower.
+    """
+
+    def collect() -> Iterable[Family]:
+        out: List[Family] = []
+        targets = list(server._repl_targets)
+        if targets:
+            lag_r = Family(name="surge_log_replication_lag_records",
+                           mtype="gauge",
+                           help="records enqueued for replication but not "
+                                "yet acked by this follower")
+            lag_b = Family(name="surge_log_replication_lag_batches",
+                           mtype="gauge",
+                           help="replication-queue items not yet acked by "
+                                "this follower")
+            insync = Family(name="surge_log_replication_in_sync",
+                            mtype="gauge",
+                            help="1 while this follower is in the in-sync "
+                                 "set (commits wait on it)")
+            for target in targets:
+                st = server._repl_target_state.get(target)
+                if st is None:
+                    continue
+                items, records = server._repl_progress(target)
+                label = (("follower", target),)
+                lag_b.samples.append(Sample("", label, float(items)))
+                lag_r.samples.append(Sample("", label, float(records)))
+                insync.samples.append(Sample("", label,
+                                             1.0 if st.in_sync else 0.0))
+            out.extend([lag_r, lag_b, insync])
+        role = Family(name="surge_log_broker_is_leader", mtype="gauge",
+                      help="1 while this broker serves as the leader")
+        role.samples.append(Sample("", (),
+                                   1.0 if server.role == "leader" else 0.0))
+        out.append(role)
+        return out
+
+    return collect

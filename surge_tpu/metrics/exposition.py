@@ -82,11 +82,14 @@ def format_value(v: float) -> str:
 
 @dataclass(frozen=True)
 class Sample:
-    """One sample line: ``name+suffix{labels} value``."""
+    """One sample line: ``name+suffix{labels} value`` plus an optional
+    OpenMetrics exemplar (``# {trace_id="..."} value timestamp``) linking a
+    histogram bucket to the trace that produced one of its observations."""
 
     suffix: str  # "", "_total", "_bucket", "_sum", "_count"
     labels: Tuple[Tuple[str, str], ...]
     value: float
+    exemplar: Optional[Tuple[str, float, float]] = None  # (trace_id, value, ts)
 
 
 @dataclass
@@ -107,18 +110,24 @@ def _render_family(lines: List[str], fam: Family) -> None:
         if s.labels:
             body = ",".join(f'{k}="{escape_label_value(v)}"'
                             for k, v in s.labels)
-            lines.append(f"{fam.name}{s.suffix}{{{body}}} "
-                         f"{format_value(s.value)}")
+            line = (f"{fam.name}{s.suffix}{{{body}}} "
+                    f"{format_value(s.value)}")
         else:
-            lines.append(f"{fam.name}{s.suffix} {format_value(s.value)}")
+            line = f"{fam.name}{s.suffix} {format_value(s.value)}"
+        if s.exemplar is not None:
+            trace_id, obs, ts = s.exemplar
+            line += (f' # {{trace_id="{escape_label_value(trace_id)}"}} '
+                     f"{format_value(obs)} {ts:.3f}")
+        lines.append(line)
 
 
 def _histogram_family(name: str, help_text: str,
                       h: TimeBucketHistogram) -> Family:
     fam = Family(name=name, mtype="histogram", help=help_text)
-    for bound, cum in h.bucket_counts():
+    exemplars = h.exemplars()
+    for i, (bound, cum) in enumerate(h.bucket_counts()):
         fam.samples.append(Sample("_bucket", (("le", format_value(bound)),),
-                                  float(cum)))
+                                  float(cum), exemplar=exemplars.get(i)))
     fam.samples.append(Sample("_sum", (), h.sum_value))
     fam.samples.append(Sample("_count", (), float(h.total_count)))
     return fam
@@ -146,7 +155,8 @@ def registry_families(registry: Metrics) -> List[Family]:
             fam = _histogram_family(sanitize_name(base) + "_ms",
                                     reg.info.description, provider)
             if labels:
-                fam.samples = [Sample(s.suffix, labels + s.labels, s.value)
+                fam.samples = [Sample(s.suffix, labels + s.labels, s.value,
+                                      exemplar=s.exemplar)
                                for s in fam.samples]
             families.append(fam)
         elif isinstance(provider, Count):

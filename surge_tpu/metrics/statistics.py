@@ -13,6 +13,8 @@ import time
 from collections import deque
 from typing import Callable, Deque, List, Protocol, Sequence, Tuple
 
+from surge_tpu.tracing import active_trace_id
+
 
 class MetricValueProvider(Protocol):
     def update(self, value: float, timestamp: float) -> None: ...
@@ -120,21 +122,40 @@ class TimeBucketHistogram:
     percentile estimate (upper bucket bound). The full distribution —
     ``bucket_counts()`` (cumulative), ``total_count``, ``sum_value`` — backs the
     OpenMetrics ``_bucket``/``_sum``/``_count`` series
-    (:mod:`surge_tpu.metrics.exposition`)."""
+    (:mod:`surge_tpu.metrics.exposition`).
+
+    With ``exemplars=True`` each recording also captures the ACTIVE trace id
+    (:func:`surge_tpu.tracing.active_trace_id` — the span the recording thread
+    or task is inside of), keeping the newest exemplar per bucket; the
+    exposition renders them in OpenMetrics ``# {trace_id="..."}`` syntax so a
+    p99 latency bucket links straight to one JSONL trace that landed in it."""
 
     def __init__(self, buckets_ms: Sequence[float] = (1, 5, 10, 25, 50, 100, 250, 500,
                                                       1000, 2500, 5000, 10000),
-                 percentile: float = 0.99) -> None:
+                 percentile: float = 0.99, exemplars: bool = False) -> None:
         self.bounds: List[float] = sorted(buckets_ms)
         self.counts: List[int] = [0] * (len(self.bounds) + 1)
         self.percentile = percentile
         self._total = 0
         self._sum = 0.0
+        #: bucket index -> (trace_id, recorded value, unix timestamp); None
+        #: when exemplar capture is off (the default — no per-update overhead)
+        self._exemplars: "dict[int, Tuple[str, float, float]] | None" = (
+            {} if exemplars else None)
 
     def update(self, value: float, timestamp: float) -> None:
-        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        idx = bisect.bisect_left(self.bounds, value)
+        self.counts[idx] += 1
         self._total += 1
         self._sum += value
+        if self._exemplars is not None:
+            trace_id = active_trace_id()
+            if trace_id is not None:
+                self._exemplars[idx] = (trace_id, value, timestamp)
+
+    def exemplars(self) -> "dict[int, Tuple[str, float, float]]":
+        """Newest captured exemplar per bucket index (empty when disabled)."""
+        return dict(self._exemplars) if self._exemplars else {}
 
     def get_value(self) -> float:
         """Percentile estimate. An overflow-bucket hit reports the largest

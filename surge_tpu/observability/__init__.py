@@ -1,0 +1,19 @@
+"""Cluster observability plane: the broker flight recorder and the failover
+timeline reconstruction it feeds (docs/observability.md, docs/operations.md).
+
+The metrics/tracing half of the telemetry plane lives in
+:mod:`surge_tpu.metrics` / :mod:`surge_tpu.tracing`; this package holds the
+black-box pieces — bounded in-memory event recording at the sites a
+post-incident analysis needs, and the merge/reconstruction tooling that turns
+per-broker dumps into one ordered story.
+"""
+
+from surge_tpu.observability.flight import (
+    FlightRecorder,
+    merge_dumps,
+    reconstruct_failover,
+    same_clock_domain,
+)
+
+__all__ = ["FlightRecorder", "merge_dumps", "reconstruct_failover",
+           "same_clock_domain"]

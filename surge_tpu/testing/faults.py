@@ -139,6 +139,10 @@ class FaultPlane:
         #: crash hook installed by the component hosting the plane (the
         #: broker's hard-stop); called once, before SimulatedCrash raises
         self.on_crash: Optional[Callable[[str], None]] = None
+        #: optional FlightRecorder (surge_tpu.observability): every fired
+        #: rule joins the host's black-box ring, so a post-incident timeline
+        #: shows which injected fault preceded which transition
+        self.flight = None
         self.injected = 0
         self.crashed: Optional[str] = None  # first crash point that fired
 
@@ -215,6 +219,9 @@ class FaultPlane:
                 self.injected += 1
                 if self.metrics is not None:
                     self.metrics.faults_injected.record()
+                if self.flight is not None:
+                    self.flight.record("fault.fire", site=site,
+                                       action=rule.action)
                 return rule
         return None
 

@@ -17,6 +17,7 @@ SurgeGenericBusinessLogicTrait.scala:33), with :class:`InMemoryTracer` for tests
 
 from __future__ import annotations
 
+import contextvars
 import json
 import random
 import re
@@ -33,9 +34,26 @@ __all__ = [
     "Span",
     "SpanContext",
     "Tracer",
+    "active_trace_id",
     "extract_context",
     "inject_context",
 ]
+
+#: the span the current context is inside of (set by ``with span:``) — what
+#: OpenMetrics exemplars read so a histogram bucket can link to the trace that
+#: produced its sample (contextvars: isolated per thread AND per asyncio task)
+_ACTIVE_SPAN: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "surge_active_span", default=None)
+
+
+def active_trace_id() -> Optional[str]:
+    """Trace id of the innermost SAMPLED span the caller is running under, or
+    None — the exemplar source for histograms (an unsampled trace has no
+    exported spans to link to, so it yields no exemplar either)."""
+    span = _ACTIVE_SPAN.get()
+    if span is not None and span.context.sampled:
+        return span.context.trace_id
+    return None
 
 _TRACEPARENT = "traceparent"
 _RE_TRACEPARENT = re.compile(
@@ -87,6 +105,7 @@ class Span:
     events: List[tuple] = field(default_factory=list)
     status: str = "ok"  # "ok" | "error"
     _tracer: Optional["Tracer"] = field(default=None, repr=False)
+    _cv_token: Optional[object] = field(default=None, repr=False, compare=False)
 
     def set_attribute(self, key: str, value: object) -> "Span":
         self.attributes[key] = value
@@ -103,7 +122,32 @@ class Span:
         self.add_event("exception", {"type": type(exc).__name__, "message": str(exc)})
         return self
 
+    def activate(self) -> "Span":
+        """Make this span the context's ACTIVE span (what exemplar capture
+        reads) without a ``with`` block — for call sites that manage
+        ``finish()`` manually, like the entity's receive span. ``finish()``
+        (and ``__exit__``) deactivates."""
+        if self._cv_token is None:
+            self._cv_token = _ACTIVE_SPAN.set(self)
+        return self
+
+    def _deactivate(self) -> None:
+        if self._cv_token is None:
+            return
+        token, self._cv_token = self._cv_token, None
+        # only restore the snapshot if THIS span is still the active one:
+        # finishing a stored span from another context (callback, timeout
+        # handler) or out of nesting order must never clobber an unrelated
+        # still-open span's activation
+        if _ACTIVE_SPAN.get() is not self:
+            return
+        try:
+            _ACTIVE_SPAN.reset(token)
+        except ValueError:  # token from another context; we ARE active: clear
+            _ACTIVE_SPAN.set(None)
+
     def finish(self) -> None:
+        self._deactivate()
         if self.end_time is None:
             self.end_time = time.time()
             if self._tracer is not None:
@@ -115,12 +159,12 @@ class Span:
 
     # context-manager sugar
     def __enter__(self) -> "Span":
-        return self
+        return self.activate()
 
     def __exit__(self, exc_type, exc, tb) -> None:
         if exc is not None:
             self.record_exception(exc)
-        self.finish()
+        self.finish()  # deactivates too
 
 
 class Tracer:

@@ -1,13 +1,17 @@
-"""OpenMetrics exposition: grammar validation, golden payload, scrape endpoint,
-and the instrument-catalog contract (every predeclared EngineMetrics instrument
-appears in the export AND in the docs metric catalog)."""
+"""OpenMetrics exposition: grammar validation, golden payloads (engine AND
+broker registries), exemplars, scrape endpoints, and the instrument-catalog
+contract (every Sensor registered in any Metrics registry appears in the
+export AND in the docs metric catalog)."""
 
 import os
 import re
 import urllib.request
 
+import pytest
+
 from surge_tpu.health import HealthSignalBus, HealthSupervisor
 from surge_tpu.metrics import MetricInfo, Metrics, engine_metrics
+from surge_tpu.metrics.broker import broker_metrics
 from surge_tpu.metrics.exposition import (
     MetricsHTTPServer,
     health_collector,
@@ -16,14 +20,19 @@ from surge_tpu.metrics.exposition import (
 )
 
 GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "metrics.om")
+BROKER_GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden",
+                                  "metrics_broker.om")
 
 _HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
 _TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
                       r"(gauge|counter|histogram)$")
+_VALUE = r"-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|[+-]Inf|NaN"
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"            # sample name
     r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\})?"  # labels
-    r" (-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|[+-]Inf|NaN)$")     # value
+    rf" ({_VALUE})"                                                   # value
+    # optional OpenMetrics exemplar: # {trace_id="..."} value timestamp
+    rf"( # \{{trace_id=\"[0-9a-f]{{32}}\"\}} (?:{_VALUE}) [0-9.]+)?$")
 
 
 def validate_openmetrics(text: str) -> dict:
@@ -54,6 +63,9 @@ def validate_openmetrics(text: str) -> dict:
         m = _SAMPLE_RE.match(ln)
         assert m, f"bad sample line: {ln!r}"
         sample_name, labels_raw, value = m.group(1), m.group(2), m.group(3)
+        if m.group(4):  # exemplars only make sense on histogram buckets
+            assert sample_name.endswith("_bucket"), \
+                f"exemplar on a non-bucket sample: {ln!r}"
         fam_name = None
         for suffix in ("", "_total", "_bucket", "_sum", "_count"):
             cand = sample_name[: len(sample_name) - len(suffix)] \
@@ -101,6 +113,33 @@ def golden_engine_metrics():
     return em
 
 
+def golden_broker_metrics():
+    """The broker registry's canonical deterministic recording sequence
+    (tools/regen_golden_metrics.py re-renders it into metrics_broker.om)."""
+    bm = broker_metrics()
+    bm.repl_insync_replicas.record(2)
+    bm.repl_isr_churn.record()
+    bm.repl_queue_depth.record(3)
+    bm.repl_epoch.record(2)
+    bm.repl_catchup_records.record(1000)
+    bm.repl_ship_timer.record_ms(4.0)
+    bm.journal_fsync_round_timer.record_ms(1.5)
+    bm.journal_fsync_round_timer.record_ms(30.0)
+    bm.journal_round_occupancy.record(6)
+    bm.journal_rotations.record()
+    bm.journal_wal_bytes.record(1 << 20)
+    bm.txn_inorder_wait_timer.record_ms(12.0)
+    bm.txn_dedup_window.record(5)
+    bm.txn_alias_window.record(1)
+    bm.txn_pipelined_depth.record(4)
+    bm.failover_promotions.record()
+    bm.failover_fencings.record()
+    bm.failover_truncated_records.record(2)
+    bm.faults_injected.record(3)
+    bm.faults_armed.record(2)
+    return bm
+
+
 def test_render_matches_golden():
     text = render_openmetrics(golden_engine_metrics().registry)
     validate_openmetrics(text)
@@ -112,22 +151,82 @@ def test_render_matches_golden():
         "the docs/observability.md metric catalog")
 
 
-def test_every_engine_instrument_in_export_and_docs_catalog():
-    em = engine_metrics()
-    text = render_openmetrics(em.registry)
+def test_broker_render_matches_golden():
+    text = render_openmetrics(golden_broker_metrics().registry)
+    families = validate_openmetrics(text)
+    # the acceptance families: replication instruments + the journal
+    # fsync-round histogram, full _bucket/_sum/_count series
+    assert "surge_log_replication_insync_replicas" in families
+    assert families["surge_log_journal_fsync_round_timer_ms"][0] \
+        == "histogram"
+    with open(BROKER_GOLDEN_PATH) as f:
+        golden = f.read()
+    assert text == golden, (
+        "broker OpenMetrics payload drifted from tests/golden/"
+        "metrics_broker.om — if the change is intentional run "
+        "tools/regen_golden_metrics.py and update the docs/observability.md "
+        "broker catalog (golden and catalog are coupled; regen both "
+        "together)")
+
+
+@pytest.mark.parametrize("quiver_factory,golden_path", [
+    (engine_metrics, GOLDEN_PATH),
+    (broker_metrics, BROKER_GOLDEN_PATH),
+], ids=["engine", "broker"])
+def test_every_instrument_in_export_docs_catalog_and_golden(quiver_factory,
+                                                            golden_path):
+    """Catalog completeness across EVERY registry (engine AND broker): each
+    registered Sensor appears in the rendered export, in the docs metric
+    catalog, and in the regenerated golden file."""
+    quiver = quiver_factory()
+    text = render_openmetrics(quiver.registry)
     families = validate_openmetrics(text)
     docs = open(os.path.join(os.path.dirname(__file__), "..", "docs",
                              "observability.md")).read()
-    for dotted in em.registry.get_metrics():
+    with open(golden_path) as f:
+        golden_families = validate_openmetrics(f.read())
+    for dotted in quiver.registry.get_metrics():
         fam = sanitize_name(dotted[:-len(".p99")] + "_ms"
                             if dotted.endswith(".p99") else dotted)
         assert fam in families, f"{dotted} missing from the export"
+        assert fam in golden_families, (
+            f"{dotted} missing from {os.path.basename(golden_path)} — run "
+            "tools/regen_golden_metrics.py (golden and catalog are coupled; "
+            "regen both together)")
         base = dotted[:-len(".p99")] if dotted.endswith(".p99") else dotted
         base = re.sub(r"\.(min|max)$", "", base)
         assert base in docs, f"{base} missing from the docs metric catalog"
     # histogram series carry buckets, not a lone p99 point
-    assert families[sanitize_name("surge.replay.rebuild-timer") + "_ms"][0] \
-        == "histogram"
+    sample = ("surge.replay.rebuild-timer"
+              if quiver_factory is engine_metrics
+              else "surge.log.journal.fsync-round-timer")
+    assert families[sanitize_name(sample) + "_ms"][0] == "histogram"
+
+
+def test_exemplar_renders_and_passes_grammar():
+    """A histogram recording inside an active sampled span captures the trace
+    id; the exposition renders it in OpenMetrics exemplar syntax on exactly
+    that bucket, and the grammar validator accepts it."""
+    from surge_tpu.tracing import InMemoryTracer
+
+    m = Metrics(exemplars=True)
+    timer = m.timer(MetricInfo("surge.test.exemplar-timer", "exemplar test"))
+    tracer = InMemoryTracer()
+    with tracer.start_span("publish") as span:
+        timer.record_ms(7.0)
+    timer.record_ms(3.0)  # outside any span: no exemplar captured
+    text = render_openmetrics(m)
+    validate_openmetrics(text)
+    want = f'# {{trace_id="{span.context.trace_id}"}} 7 '
+    bucket_lines = [ln for ln in text.splitlines() if want in ln]
+    assert len(bucket_lines) == 1, text
+    assert 'le="10"' in bucket_lines[0]  # 7ms lands in the 10ms bucket
+    # unsampled spans yield no exemplar (nothing exported to link to)
+    m2 = Metrics(exemplars=True)
+    t2 = m2.timer(MetricInfo("surge.test.unsampled-timer", "x"))
+    with InMemoryTracer(sample_rate=0.0).start_span("p"):
+        t2.record_ms(7.0)
+    assert "trace_id" not in render_openmetrics(m2)
 
 
 def test_label_escaping_and_name_sanitization():

@@ -1,0 +1,405 @@
+"""Flight recorder + failover-timeline reconstruction: ring-buffer semantics,
+the merge/reconstruction library on CANNED dumps (no live brokers — the
+tier-1-safe smoke for tools/flight_timeline.py), the broker's DumpFlight /
+GetMetricsText RPCs, the crash auto-dump, and the chaos CLI's status tail."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from conftest import free_ports
+from surge_tpu.config import Config
+from surge_tpu.log import GrpcLogTransport, InMemoryLog, LogRecord, LogServer, TopicSpec
+from surge_tpu.observability import FlightRecorder, merge_dumps, reconstruct_failover
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- ring buffer ----------------------------------------------------------------------
+
+
+def test_recorder_is_bounded_and_ordered():
+    rec = FlightRecorder(capacity=16, name="b1")
+    for i in range(40):
+        rec.record("tick", i=i)
+    events = rec.events()
+    assert len(events) == 16  # ring: oldest 24 evicted
+    assert [e["i"] for e in events] == list(range(24, 40))
+    assert [e["seq"] for e in events] == list(range(25, 41))  # seq never resets
+    monos = [e["mono"] for e in events]
+    assert monos == sorted(monos)
+    assert rec.events(last=3) == events[-3:]
+    assert rec.events(last=0) == []  # 0 means none, not "the whole ring"
+    dump = rec.dump()
+    assert dump["recorder"] == "b1" and dump["node"] and dump["pid"]
+    assert len(dump["events"]) == 16
+
+
+def test_recorder_dump_to_is_best_effort(tmp_path):
+    rec = FlightRecorder(name="b")
+    rec.record("x")
+    path = str(tmp_path / "flight.json")
+    rec.dump_to(path)
+    assert json.load(open(path))["events"][0]["type"] == "x"
+    rec.dump_to(str(tmp_path / "no-such-dir" / "f.json"))  # must not raise
+
+
+# -- canned-dump merge + reconstruction (the timeline-tool smoke) ---------------------
+
+
+def _canned_dumps():
+    """Two brokers' dumps of one failover, same host (mono comparable): the
+    wall clocks are deliberately SKEWED so a wall-ordered merge would get the
+    fence/truncate order wrong — monotonic ordering must win."""
+    base = 1000.0
+
+    def ev(seq, mono_off, etype, wall_skew=0.0, **attrs):
+        return {"seq": seq, "mono": base + mono_off,
+                "wall": 1.7e9 + mono_off + wall_skew, "type": etype, **attrs}
+
+    follower = {"recorder": "127.0.0.1:16002", "node": "host-a", "pid": 42,
+                "events": [
+                    ev(1, 0.010, "role.promote-decision",
+                       dead_leader="127.0.0.1:16001", failure_streak=2),
+                    ev(2, 0.012, "role.promote", epoch=2),
+                    ev(3, 0.090, "txn.first-ack", epoch=2, txn_seq=7),
+                ]}
+    exleader = {"recorder": "127.0.0.1:16001", "node": "host-a", "pid": 43,
+                "events": [
+                    ev(1, 0.000, "broker.kill", role="leader", epoch=1),
+                    # wall skewed 5s EARLY: a wall merge would front-run it
+                    ev(2, 0.450, "role.fence", old_epoch=1, new_epoch=2,
+                       wall_skew=-5.0),
+                    ev(3, 0.460, "log.truncate", records=3, wall_skew=-5.0),
+                ]}
+    return follower, exleader
+
+
+def test_merge_orders_by_monotonic_on_one_host():
+    follower, exleader = _canned_dumps()
+    merged = merge_dumps([follower, exleader])
+    assert [e["type"] for e in merged] == [
+        "broker.kill", "role.promote-decision", "role.promote",
+        "txn.first-ack", "role.fence", "log.truncate"]
+    assert {e["recorder"] for e in merged} == {"127.0.0.1:16001",
+                                              "127.0.0.1:16002"}
+
+
+def test_merge_falls_back_to_wall_across_hosts():
+    follower, exleader = _canned_dumps()
+    exleader["node"] = "host-b"  # different clock domain: mono incomparable
+    merged = merge_dumps([follower, exleader])
+    # the skewed wall stamps now order the fence/truncate first — exactly why
+    # same-host merges must use monotonic time
+    assert [e["type"] for e in merged][:2] == ["role.fence", "log.truncate"]
+
+
+def test_reconstruct_failover_phases_from_canned_dumps():
+    merged = merge_dumps(list(_canned_dumps()))
+    recon = reconstruct_failover(merged)
+    assert recon["complete"]
+    phases = recon["phases"]
+    assert phases["promotion_decision"]["failure_streak"] == 2
+    assert phases["promotion"]["epoch"] == 2
+    assert phases["fence"]["new_epoch"] == 2
+    assert phases["truncation"]["records"] == 3
+    assert phases["first_acked_commit"]["txn_seq"] == 7
+    assert recon["span_ms"] == 80.0  # decision 0.010 -> first ack 0.090
+
+
+def test_reconstruct_reports_missing_phases():
+    follower, _ = _canned_dumps()
+    recon = reconstruct_failover(merge_dumps([follower]))
+    assert not recon["complete"]
+    assert recon["phases"]["fence"] is None
+    assert recon["phases"]["truncation"] is None
+    # manual promotion (no prober decision) still anchors the timeline
+    manual = {"recorder": "b", "node": "h", "events": [
+        {"seq": 1, "mono": 1.0, "wall": 1.0, "type": "role.promote",
+         "epoch": 2}]}
+    recon = reconstruct_failover(merge_dumps([manual]))
+    assert recon["phases"]["promotion_decision"]["type"] == "role.promote"
+
+
+def test_reconstruct_anchors_to_the_newest_promotion():
+    """A ring holding TWO incidents must not stitch incident 1's promotion to
+    incident 2's fence and call the mix 'complete': phases anchor to the
+    newest promotion, so an unhealed incident 1 stays visibly unhealed."""
+    def ev(seq, mono, etype, **attrs):
+        return {"seq": seq, "mono": mono, "wall": mono, "type": etype,
+                **attrs}
+
+    ring = {"recorder": "b", "node": "h", "events": [
+        # incident 1: promotion only — ex-leader never rejoined (no fence)
+        ev(1, 1.0, "role.promote-decision", incident=1),
+        ev(2, 1.1, "role.promote", epoch=2, incident=1),
+        ev(3, 1.2, "txn.first-ack", epoch=2, incident=1),
+        # incident 2: a later, complete failover
+        ev(4, 9.0, "role.promote-decision", incident=2),
+        ev(5, 9.1, "role.promote", epoch=3, incident=2),
+        ev(6, 9.2, "txn.first-ack", epoch=3, incident=2),
+        ev(7, 9.5, "role.fence", new_epoch=3, incident=2),
+        ev(8, 9.6, "log.truncate", records=1, incident=2),
+    ]}
+    recon = reconstruct_failover(merge_dumps([ring]))
+    assert recon["complete"]
+    assert all(e["incident"] == 2 for e in recon["phases"].values())
+    # drop incident 2's promotion events: incident 1 alone must NOT borrow
+    # incident 2's fence/truncate
+    ring["events"] = [e for e in ring["events"] if e["incident"] == 1
+                      or e["type"] in ("role.fence", "log.truncate")]
+    recon = reconstruct_failover(merge_dumps([ring]))
+    assert recon["phases"]["promotion"]["incident"] == 1
+    assert recon["phases"]["fence"]["incident"] == 2  # later events DO count
+    # ...but a ring truncated before any promotion reconstructs nothing
+    assert reconstruct_failover(merge_dumps([{
+        "recorder": "b", "node": "h",
+        "events": [ev(1, 1.0, "role.fence", new_epoch=2)]}]))["phases"][
+            "fence"] is None
+
+
+def test_flight_timeline_cli_on_canned_dumps(tmp_path):
+    """tools/flight_timeline.py end to end on canned dump FILES (no brokers):
+    human view, --json view, and the incomplete-reconstruction exit code."""
+    follower, exleader = _canned_dumps()
+    fpath, lpath = str(tmp_path / "f.json"), str(tmp_path / "l.json")
+    json.dump(follower, open(fpath, "w"))
+    json.dump(exleader, open(lpath, "w"))
+    cli = os.path.join(REPO, "tools", "flight_timeline.py")
+
+    out = subprocess.run([sys.executable, cli, fpath, lpath],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "reconstruction complete" in out.stdout
+    assert "decision -> first ack: 80.0ms" in out.stdout
+
+    out = subprocess.run([sys.executable, cli, fpath, lpath, "--json"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    payload = json.loads(out.stdout)
+    assert payload["complete"] and len(payload["events"]) == 6
+
+    out = subprocess.run([sys.executable, cli, fpath],  # follower alone
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 1
+    assert "MISSING" in out.stdout
+
+    # cross-host: offsets must come from the wall key the merge ordered by
+    # (monotonic stamps are incomparable across hosts — offsets from them
+    # would contradict the printed order)
+    exleader["node"] = "host-b"
+    json.dump(exleader, open(lpath, "w"))
+    out = subprocess.run([sys.executable, cli, fpath, lpath],
+                         capture_output=True, text=True, timeout=60)
+    assert "cross-host: wall-clock ordering" in out.stdout
+    offsets = [float(ln.strip().split("ms")[0].lstrip("+"))
+               for ln in out.stdout.splitlines()
+               if ln.strip().startswith("+")][:6]  # the merged event lines
+    assert offsets == sorted(offsets), out.stdout
+    assert offsets[0] == 0.0
+
+
+# -- live broker plane ----------------------------------------------------------------
+
+
+FAST_CFG = Config(overrides={
+    "surge.log.replication-ack-timeout-ms": 1_500,
+    "surge.log.replication-isr-timeout-ms": 600,
+})
+
+
+def _pair(config=FAST_CFG, **leader_kw):
+    lport, fport = free_ports(2)
+    follower = LogServer(InMemoryLog(), port=fport,
+                         follower_of=f"127.0.0.1:{lport}", config=config)
+    follower.start()
+    leader = LogServer(InMemoryLog(), port=lport,
+                       replicate_to=[f"127.0.0.1:{fport}"], config=config,
+                       **leader_kw)
+    leader.start()
+    return leader, follower, lport, fport
+
+
+def test_broker_flight_rpc_and_failover_timeline_reconstruction():
+    """A real promote→fence→truncate cycle is reconstructable purely from the
+    two brokers' DumpFlight RPCs — the acceptance path, in-process scale."""
+    leader, follower, lport, fport = _pair()
+    try:
+        client = GrpcLogTransport(f"127.0.0.1:{lport}", config=FAST_CFG)
+        client.create_topic(TopicSpec("ev", 1))
+        p = client.transactional_producer("t")
+        p.begin()
+        p.send(LogRecord(topic="ev", key="k", value=b"v0"))
+        p.commit()
+
+        fclient = GrpcLogTransport(f"127.0.0.1:{fport}", config=FAST_CFG)
+        fclient.promote_follower(replicate_to=[f"127.0.0.1:{lport}"])
+        # first post-promotion ack on the new leader
+        p2 = fclient.transactional_producer("t2")
+        p2.begin()
+        p2.send(LogRecord(topic="ev", key="k", value=b"v1"))
+        p2.commit()
+        # the old leader learns of the fence from the new leader's probe/ship;
+        # wait for the WHOLE demotion (truncate + catch_up run after the role
+        # flips — dumping at the flip would race the log.truncate event)
+        deadline = time.time() + 10
+        while leader.catch_up_state.get("state") != "done" \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert leader.role == "follower"
+        assert leader.catch_up_state.get("state") == "done"
+
+        merged = merge_dumps([client.flight_dump(), fclient.flight_dump()])
+        recon = reconstruct_failover(merged)
+        assert recon["phases"]["promotion"]["epoch"] == 2
+        assert recon["phases"]["fence"] is not None
+        assert recon["phases"]["truncation"] is not None
+        assert recon["phases"]["first_acked_commit"] is not None
+        # both brokers' events interleave in one monotonic order
+        monos = [e["mono"] for e in merged]
+        assert monos == sorted(monos)
+        assert {e["recorder"] for e in merged} == {f"127.0.0.1:{lport}",
+                                                   f"127.0.0.1:{fport}"}
+        # BrokerStatus satellite: the fenced ex-leader is VISIBLY a rejoiner
+        status = client.broker_status()
+        assert status["catch_up"]["state"] == "done"
+        assert status["last_truncation"]["epoch"] == 2
+        assert status["last_applied_epoch_start"]["ev"]["0"] == 1
+        # ...while the never-fenced new leader shows a clean slate
+        fresh = fclient.broker_status()
+        assert fresh["catch_up"]["state"] == "idle"
+        assert fresh["last_truncation"] is None
+        client.close()
+        fclient.close()
+    finally:
+        leader.stop()
+        follower.stop()
+
+
+def test_broker_metrics_scrape_rpc_and_port():
+    """GetMetricsText + the optional scrape port serve a grammar-valid
+    payload carrying the surge.log.replication.* lag and surge.log.journal.*
+    families (the acceptance scrape), byte-identical across both surfaces."""
+    import urllib.request
+
+    from tests.test_exposition import validate_openmetrics
+
+    leader, follower, lport, fport = _pair(metrics_port=0)
+    try:
+        client = GrpcLogTransport(f"127.0.0.1:{lport}", config=FAST_CFG)
+        client.create_topic(TopicSpec("ev", 1))
+        p = client.transactional_producer("t")
+        for i in range(3):
+            p.begin()
+            p.send(LogRecord(topic="ev", key="k", value=f"v{i}".encode()))
+            p.commit()
+        text = client.log_metrics_text()
+        families = validate_openmetrics(text)
+        assert "surge_log_replication_insync_replicas" in families
+        assert "surge_log_replication_lag_records" in families
+        assert "surge_log_replication_lag_batches" in families
+        assert "surge_log_journal_fsync_round_timer_ms" in families
+        assert "surge_log_txn_dedup_window" in families
+        assert f'follower="127.0.0.1:{fport}"' in text
+        # acked commits: the follower's lag gauges read 0
+        assert f'surge_log_replication_lag_records{{follower="127.0.0.1:'\
+               f'{fport}"}} 0' in text
+        with urllib.request.urlopen(
+                "http://127.0.0.1:"
+                f"{leader.metrics_bound_port}/metrics") as resp:
+            body = resp.read().decode()
+        validate_openmetrics(body)
+        assert "surge_log_broker_is_leader 1" in body
+        client.close()
+    finally:
+        leader.stop()
+        follower.stop()
+
+
+def test_restarted_broker_rewires_inner_log_hooks(tmp_path):
+    """A broker RESTARTED over the same FileLog (the rejoin path) must
+    re-point the log's journal metrics/flight hooks at ITS quiver/ring —
+    not leave them frozen on the dead server's."""
+    from surge_tpu.log import FileLog
+
+    flog = FileLog(str(tmp_path), fsync="none")
+    s1 = LogServer(flog)
+    assert flog.broker_metrics is s1.broker_metrics
+    assert flog.flight is s1.flight
+    s2 = LogServer(flog)
+    assert flog.broker_metrics is s2.broker_metrics
+    assert flog.flight is s2.flight
+    flog.close()
+
+
+def test_fault_firings_join_flight_ring_and_crash_auto_dumps(tmp_path):
+    """Armed-fault firings are flight-recorded, and a fault-plane crash trip
+    auto-dumps the ring to surge.log.flight.dump-dir."""
+    cfg = Config(overrides={
+        "surge.log.replication-ack-timeout-ms": 1_500,
+        "surge.log.flight.dump-dir": str(tmp_path),
+    })
+    lport, = free_ports(1)
+    leader = LogServer(InMemoryLog(), port=lport, config=cfg)
+    leader.start()
+    try:
+        client = GrpcLogTransport(f"127.0.0.1:{lport}", config=cfg)
+        client.create_topic(TopicSpec("ev", 1))
+        client.arm_faults(json.dumps({"rules": [
+            {"site": "crash.transact.post-apply", "action": "crash",
+             "after": 1}]}), seed=1)
+        p = client.transactional_producer("t")
+        p.begin()
+        p.send(LogRecord(topic="ev", key="k", value=b"v0"))
+        p.commit()  # seen=1 <= after: no fire
+        p.begin()
+        p.send(LogRecord(topic="ev", key="k", value=b"v1"))
+        try:
+            p.commit()  # the crash point fires: broker hard-stops
+        except Exception:  # noqa: BLE001 — UNAVAILABLE, as a real crash
+            pass
+        dump_path = str(tmp_path / f"flight-{lport}.json")
+        deadline = time.time() + 5
+        while not os.path.exists(dump_path) and time.time() < deadline:
+            time.sleep(0.05)
+        dump = json.load(open(dump_path))
+        types = [e["type"] for e in dump["events"]]
+        assert "fault.fire" in types and "broker.kill" in types
+        fired = next(e for e in dump["events"] if e["type"] == "fault.fire")
+        assert fired["site"] == "crash.transact.post-apply"
+        client.close()
+    finally:
+        leader.stop()
+
+
+def test_chaos_cli_status_includes_flight_tail_and_lag():
+    """tools/chaos.py status (satellite): the one-command chaos debug view —
+    fault-plane stats + flight tail + replication-lag gauges."""
+    cli = os.path.join(REPO, "tools", "chaos.py")
+    leader, follower, lport, fport = _pair()
+    try:
+        client = GrpcLogTransport(f"127.0.0.1:{lport}", config=FAST_CFG)
+        client.create_topic(TopicSpec("ev", 1))
+        client.arm_faults("fsync-hiccup", seed=3)
+        out = subprocess.run(
+            [sys.executable, cli, "status", f"127.0.0.1:{lport}"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr[-500:]
+        status = json.loads(out.stdout)
+        assert status["seed"] == 3  # fault stats still lead the payload
+        assert isinstance(status["flight_tail"], list)
+        assert any(ln.startswith("surge_log_replication_lag_records")
+                   for ln in status["replication_lag"])
+        # the flight subcommand dumps the full merge-ready envelope
+        out = subprocess.run(
+            [sys.executable, cli, "flight", f"127.0.0.1:{lport}"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0
+        dump = json.loads(out.stdout)
+        assert dump["recorder"] == f"127.0.0.1:{lport}"
+        client.close()
+    finally:
+        leader.stop()
+        follower.stop()

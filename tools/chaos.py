@@ -10,12 +10,18 @@ over the broker's admin RPCs::
     python tools/chaos.py disarm 127.0.0.1:16001
     python tools/chaos.py broker 127.0.0.1:16001     # role/epoch/leader view
     python tools/chaos.py promote 127.0.0.1:16002    # failover drill
+    python tools/chaos.py flight 127.0.0.1:16001     # full flight-recorder dump
+    python tools/chaos.py metrics 127.0.0.1:16001    # broker OpenMetrics text
     python tools/chaos.py plans                      # list named plans
 
 ``arm`` takes a NAMED plan (see ``plans``) or a JSON rule list / object;
 after arming it reports the plane's stats, and with ``--watch`` polls the
 broker until the plan's rules are exhausted (or the broker dies — which for
 crash plans is the expected outcome, reported as such).
+
+``status`` reports the fault plane's stats PLUS the broker's flight-recorder
+tail (``--tail N``, default 20) and its current replication-lag gauges, so a
+chaos run is debuggable from one command without attaching a scraper.
 
 Exit code 0 on success; 3 when --watch ends with the broker unreachable
 (crash plans: that IS the outcome); 2 on bad arguments.
@@ -34,7 +40,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("command",
                     choices=["arm", "disarm", "status", "broker", "promote",
-                             "plans"])
+                             "flight", "metrics", "plans"])
     ap.add_argument("target", nargs="?", help="broker host:port")
     ap.add_argument("plan", nargs="?",
                     help="named fault plan or JSON rules (arm only)")
@@ -45,6 +51,8 @@ def main(argv=None) -> int:
                          "or the broker goes down")
     ap.add_argument("--interval", type=float, default=1.0,
                     help="--watch poll interval seconds")
+    ap.add_argument("--tail", type=int, default=20,
+                    help="flight-recorder events shown by status")
     args = ap.parse_args(argv)
 
     if args.command == "plans":
@@ -69,8 +77,29 @@ def main(argv=None) -> int:
         if args.command == "promote":
             print(json.dumps(client.promote_follower(), indent=2))
             return 0
+        if args.command == "flight":
+            print(json.dumps(client.flight_dump(), indent=2))
+            return 0
+        if args.command == "metrics":
+            print(client.log_metrics_text(), end="")
+            return 0
         if args.command == "status":
-            print(json.dumps(client.fault_stats(), indent=2))
+            # one debuggable view: plane stats + the black-box tail + the
+            # replication-lag gauges, no scraper required
+            out = dict(client.fault_stats())
+            try:
+                out["flight_tail"] = client.flight_dump(
+                    last=args.tail)["events"]
+            except Exception as exc:  # noqa: BLE001 — older broker
+                out["flight_tail"] = f"unavailable: {exc!r}"
+            try:
+                out["replication_lag"] = [
+                    line for line in client.log_metrics_text().splitlines()
+                    if line.startswith(("surge_log_replication_lag",
+                                        "surge_log_replication_in_sync"))]
+            except Exception as exc:  # noqa: BLE001 — older broker
+                out["replication_lag"] = f"unavailable: {exc!r}"
+            print(json.dumps(out, indent=2))
             return 0
         if args.command == "disarm":
             print(json.dumps(client.disarm_faults(), indent=2))

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Merge broker flight-recorder dumps into one ordered failover timeline.
+
+Dumps come from ``tools/chaos.py flight <broker>`` (live), from the broker's
+crash auto-dump files (``surge.log.flight.dump-dir``), or from
+``SURGE_BENCH_FAILOVER=1``'s payload. Each dump is the JSON envelope
+:meth:`surge_tpu.observability.FlightRecorder.dump` writes::
+
+    python tools/flight_timeline.py leader.json follower.json
+    python tools/chaos.py flight 127.0.0.1:16001 > l.json
+    python tools/chaos.py flight 127.0.0.1:16002 > f.json
+    python tools/flight_timeline.py l.json f.json --json
+
+Output: the merged, time-ordered event stream (monotonic ordering when every
+dump came from one host — CLOCK_MONOTONIC is host-shared and NTP-step-proof —
+wall-clock ordering otherwise) followed by the reconstructed failover phases:
+promotion decision → promotion → fence → truncation → first acked
+post-failover commit (docs/operations.md "reading a failover timeline").
+
+Exit code 0 when the reconstruction is complete, 1 when phases are missing
+(still prints what it found), 2 on bad input.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _fmt_event(ev: dict, t0: float, key: str) -> str:
+    extras = {k: v for k, v in ev.items()
+              if k not in ("seq", "mono", "wall", "type", "recorder")}
+    extra = (" " + json.dumps(extras, sort_keys=True)) if extras else ""
+    return (f"+{(ev.get(key, 0.0) - t0) * 1000.0:10.1f}ms "
+            f"{ev.get('recorder', '?'):>21s}  {ev['type']}{extra}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="+", help="flight dump JSON files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged timeline + phases as one JSON "
+                         "object instead of the human view")
+    args = ap.parse_args(argv)
+
+    from surge_tpu.observability import (
+        merge_dumps,
+        reconstruct_failover,
+        same_clock_domain,
+    )
+
+    dumps = []
+    for path in args.dumps:
+        try:
+            with open(path) as f:
+                dumps.append(json.load(f))
+        except (OSError, ValueError) as exc:
+            print(f"cannot read dump {path}: {exc}", file=sys.stderr)
+            return 2
+
+    merged = merge_dumps(dumps)
+    recon = reconstruct_failover(merged)
+    if args.json:
+        print(json.dumps({"events": merged, **recon}, indent=2))
+        return 0 if recon["complete"] else 1
+
+    if not merged:
+        print("no events in any dump")
+        return 1
+    # offsets must use the SAME key the merge ordered by: monotonic stamps
+    # from different hosts are incomparable and would print garbage offsets
+    key = "mono" if same_clock_domain(dumps) else "wall"
+    t0 = merged[0].get(key, 0.0)
+    print(f"merged timeline ({len(merged)} events from "
+          f"{len(args.dumps)} dumps"
+          + ("" if key == "mono"
+             else "; cross-host: wall-clock ordering") + "):")
+    for ev in merged:
+        print(" ", _fmt_event(ev, t0, key))
+    print("\nfailover phases:")
+    for name, ev in recon["phases"].items():
+        if ev is None:
+            print(f"  {name:22s} MISSING")
+        else:
+            print(f"  {name:22s} {_fmt_event(ev, t0, key)}")
+    if recon["span_ms"] is not None:
+        print(f"\ndecision -> first ack: {recon['span_ms']}ms")
+    print("reconstruction complete" if recon["complete"]
+          else "reconstruction INCOMPLETE")
+    return 0 if recon["complete"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,9 +1,12 @@
 #!/usr/bin/env python
-"""Regenerate tests/golden/metrics.om from the canonical recording sequence.
+"""Regenerate the OpenMetrics goldens from the canonical recording sequences:
+tests/golden/metrics.om (engine registry) and tests/golden/metrics_broker.om
+(broker registry).
 
-Run after an intentional change to the exposition format or the predeclared
-EngineMetrics instrument set, then update the docs/observability.md catalog to
-match (tests/test_exposition.py enforces both)."""
+Run after an intentional change to the exposition format or either
+predeclared instrument set, then update the docs/observability.md catalogs to
+match — golden and catalog are COUPLED (tests/test_exposition.py enforces
+both); regen both together."""
 
 import os
 import sys
@@ -12,10 +15,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 
 from surge_tpu.metrics.exposition import render_openmetrics  # noqa: E402
-from test_exposition import GOLDEN_PATH, golden_engine_metrics  # noqa: E402
+from test_exposition import (  # noqa: E402
+    BROKER_GOLDEN_PATH,
+    GOLDEN_PATH,
+    golden_broker_metrics,
+    golden_engine_metrics,
+)
 
-os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
-text = render_openmetrics(golden_engine_metrics().registry)
-with open(GOLDEN_PATH, "w") as f:
-    f.write(text)
-print(f"wrote {GOLDEN_PATH} ({len(text.splitlines())} lines)")
+for path, quiver in ((GOLDEN_PATH, golden_engine_metrics()),
+                     (BROKER_GOLDEN_PATH, golden_broker_metrics())):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    text = render_openmetrics(quiver.registry)
+    with open(path, "w") as f:
+        f.write(text)
+    print(f"wrote {path} ({len(text.splitlines())} lines)")
