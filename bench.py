@@ -177,10 +177,13 @@ def replay_child(corpus_dir: str) -> None:
     # time is the flat pack + upload + all folds. Gather programs depend on
     # the buffer's static length, so they are warmed on the REAL buffer with
     # zero-length no-op folds (state untouched) before the timed fold pass.
-    # SURGE_BENCH_RESIDENT=0 falls back to the streaming window path, whose
-    # fixed-shape programs ARE warmable corpus-free: one all-padding
-    # [width, batch] window per ladder width + the full chunk.
-    resident_mode = os.environ.get("SURGE_BENCH_RESIDENT", "1") == "1"
+    # SURGE_BENCH_STREAMING=1 (or the legacy SURGE_BENCH_RESIDENT=0 spelling)
+    # falls back to the streaming window path, whose fixed-shape programs ARE
+    # warmable corpus-free: one all-padding [width, batch] window per ladder
+    # width + the full chunk. (SURGE_BENCH_RESIDENT=1 itself now selects the
+    # read-plane fast path in main() and never reaches a replay child.)
+    resident_mode = (os.environ.get("SURGE_BENCH_STREAMING", "0") != "1"
+                     and os.environ.get("SURGE_BENCH_RESIDENT", "1") == "1")
     bs = engine.batch_size
     if not resident_mode:
         union_cols = {f.name: np.zeros((bs, 1), dtype=f.dtype)
@@ -928,6 +931,239 @@ def restore_bench() -> dict:
         shutil.rmtree(ck_dir, ignore_errors=True)
 
 
+def resident_bench() -> dict:
+    """SURGE_BENCH_RESIDENT=1 fast path: the device-resident state plane
+    (docs/replay.md "Resident state plane").
+
+    Three measurements, each PAIRED + INTERLEAVED per the BENCH_NOTES.md
+    round-6 protocol (this host's single runs swing 2-3x; only same-round
+    pairs and cross-round medians count):
+
+    1. **Read ladder** — k concurrent readers issuing read-side projections
+       (batches of SURGE_BENCH_RESIDENT_BATCH aggregates, the read-heavy
+       workload the plane exists for): the batched-gather lane (every
+       concurrent call coalesces into one device gather + a single
+       fetch-barriered pull + a batch-materialized decode) vs the host KV
+       path (per-key store bytes + state deserialize, exactly the engine's
+       fallback — measured sync, its best case). Medians over >=3
+       interleaved rounds per rung; a secondary single-read row records the
+       per-getState surface, whose per-call asyncio cost the host path does
+       not pay.
+    2. **Refresh-loop sustained folds** — committed batches appended while
+       the standing refresh loop folds them into the slab; events/s over the
+       whole append->caught-up window.
+    3. **Command-path guard** — one BENCH_LADDER-style rung with the plane
+       enabled vs disabled, interleaved: the refresh loop must not regress
+       the write path it shares the event loop with.
+
+    Knobs: SURGE_BENCH_RESIDENT_AGGREGATES (4096), _EVENTS_PER (8),
+    _ROUNDS (3), _BATCH (projection size, 256), _LOOPS (projections per
+    worker per rung, 2), _READS (single reads per worker, 30), _LADDER
+    ("16,64,256,1024"), _FOLD_EVENTS (60000), _GUARD (1; 0 skips phase 3),
+    _GUARD_SECONDS (3.0), _GUARD_WORKERS (64)."""
+    import asyncio
+    import statistics
+
+    from surge_tpu.config import default_config
+    from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
+    from surge_tpu.models import counter
+    from surge_tpu.replay.resident_state import ResidentStatePlane
+    from surge_tpu.serialization import SerializedMessage
+    from surge_tpu.store.kv import InMemoryKeyValueStore
+    from surge_tpu.store.restore import restore_from_events
+
+    n_agg = int(os.environ.get("SURGE_BENCH_RESIDENT_AGGREGATES", 4096))
+    events_per = int(os.environ.get("SURGE_BENCH_RESIDENT_EVENTS_PER", 8))
+    rounds = max(int(os.environ.get("SURGE_BENCH_RESIDENT_ROUNDS", 3)), 1)
+    batch = int(os.environ.get("SURGE_BENCH_RESIDENT_BATCH", 256))
+    loops = int(os.environ.get("SURGE_BENCH_RESIDENT_LOOPS", 2))
+    reads_per_worker = int(os.environ.get("SURGE_BENCH_RESIDENT_READS", 30))
+    ladder = [int(w) for w in os.environ.get(
+        "SURGE_BENCH_RESIDENT_LADDER", "16,64,256,1024").split(",") if w]
+    fold_events = int(os.environ.get("SURGE_BENCH_RESIDENT_FOLD_EVENTS", 60_000))
+
+    evt_fmt = counter.event_formatting()
+    state_fmt = counter.state_formatting()
+    npart = 4
+    aggs = [f"agg-{i}" for i in range(n_agg)]
+    seqs = {a: 0 for a in aggs}
+
+    log_t = InMemoryLog()
+    log_t.create_topic(TopicSpec("events", npart))
+    prod = log_t.transactional_producer("bench")
+
+    def publish(agg_events) -> None:
+        prod.begin()
+        for i, (a, ev) in enumerate(agg_events):
+            prod.send(LogRecord(topic="events", key=a,
+                                value=evt_fmt.write_event(ev).value,
+                                partition=hash(a) % npart))
+            if i % 5000 == 4999:
+                prod.commit()
+                prod.begin()
+        prod.commit()
+
+    def make_batch(n: int):
+        batch = []
+        for i in range(n):
+            a = aggs[(i * 7919) % n_agg]
+            seqs[a] += 1
+            batch.append((a, counter.CountIncremented(a, 1, seqs[a])))
+        return batch
+
+    publish(make_batch(n_agg * events_per))
+
+    # the host read path the engine falls back to: indexed KV bytes + the
+    # state deserialize chain
+    host_store = InMemoryKeyValueStore()
+    restore_from_events(
+        log_t, "events", host_store,
+        deserialize_event=lambda b: evt_fmt.read_event(
+            SerializedMessage(key="", value=b)),
+        serialize_state=lambda a, s: state_fmt.write_state(s).value,
+        model=counter.CounterModel(), replay_spec=counter.make_replay_spec(),
+        config=default_config().with_overrides(
+            {"surge.replay.backend": "cpu"}))
+
+    def host_read(agg: str):
+        return state_fmt.read_state(host_store.get(agg))
+
+    out: dict = {"resident_aggregates": n_agg,
+                 "resident_seed_events": n_agg * events_per,
+                 "resident_rounds": rounds}
+
+    async def scenario() -> None:
+        plane = ResidentStatePlane(
+            log_t, "events", counter.make_replay_spec(),
+            config=default_config().with_overrides({
+                "surge.replay.resident.capacity": max(n_agg, 8),
+                "surge.replay.resident.refresh-interval-ms": 10,
+            }),
+            deserialize_event=lambda b: evt_fmt.read_event(
+                SerializedMessage(key="", value=b)),
+            serialize_state=lambda a, s: state_fmt.write_state(s).value)
+        t0 = time.perf_counter()
+        await plane.start()
+        out["resident_seed_s"] = round(time.perf_counter() - t0, 2)
+        log(f"resident plane seeded: {plane.occupancy()} aggregates in "
+            f"{out['resident_seed_s']}s")
+
+        def ids_for(w: int, j: int):
+            return [aggs[(w * batch + j * 137 + x) % n_agg]
+                    for x in range(batch)]
+
+        async def dev_worker(w: int) -> None:
+            for j in range(loops):
+                got = await plane.read_many(ids_for(w, j))
+                if len(got) != batch:
+                    raise RuntimeError("resident projection missed")
+
+        async def host_worker(w: int) -> None:
+            for j in range(loops):
+                for a in ids_for(w, j):
+                    if host_read(a) is None:
+                        raise RuntimeError("host read missed")
+
+        async def dev_single(w: int) -> None:
+            for j in range(reads_per_worker):
+                hit, st = await plane.read_state(aggs[(w * 9176 + j * 31) % n_agg])
+                if not hit or st is None:
+                    raise RuntimeError("resident read missed")
+
+        async def rung(workers: int, fn, per_worker: int) -> float:
+            t0 = time.perf_counter()
+            await asyncio.gather(*(fn(w) for w in range(workers)))
+            return workers * per_worker / (time.perf_counter() - t0)
+
+        # warmup: compile every rung's padded gather bucket outside the
+        # measured rounds (jit caches per shape)
+        for w in ladder:
+            await rung(w, dev_worker, loops * batch)
+        await rung(max(ladder), dev_single, reads_per_worker)
+
+        per_rung: dict = {w: {"device": [], "host": []} for w in ladder}
+        singles = []
+        for rnd in range(rounds):
+            for w in ladder:
+                # alternate intra-round order so neither side always runs
+                # into the other's cache/GC wake
+                order = (("host", host_worker), ("device", dev_worker))
+                if rnd % 2:
+                    order = order[::-1]
+                for name, fn in order:
+                    per_rung[w][name].append(
+                        await rung(w, fn, loops * batch))
+            singles.append(await rung(max(ladder), dev_single,
+                                      reads_per_worker))
+        gathers0, rows0 = plane.stats["gathers"], plane.stats["gathered_rows"]
+        out["resident_read_batch"] = batch
+        out["resident_read_ladder"] = [{
+            "workers": w,
+            "device_reads_per_sec": round(statistics.median(per_rung[w]["device"])),
+            "host_reads_per_sec": round(statistics.median(per_rung[w]["host"])),
+            "device_vs_host": round(statistics.median(per_rung[w]["device"])
+                                    / statistics.median(per_rung[w]["host"]), 2),
+            "device_rounds": [round(x) for x in per_rung[w]["device"]],
+            "host_rounds": [round(x) for x in per_rung[w]["host"]],
+        } for w in ladder]
+        out["resident_single_reads_per_sec"] = round(statistics.median(singles))
+        out["resident_gather_rows_per_gather"] = round(rows0 / max(gathers0, 1), 1)
+        for r in out["resident_read_ladder"]:
+            log(f"read ladder @{r['workers']}x{batch}: device "
+                f"{r['device_reads_per_sec']} vs host "
+                f"{r['host_reads_per_sec']} reads/s ({r['device_vs_host']}x)")
+        log(f"single-read surface @{max(ladder)}: "
+            f"{out['resident_single_reads_per_sec']} reads/s")
+
+        # -- sustained incremental folds through the standing refresh loop --
+        folded0 = plane.stats["folded_events"]
+        t0 = time.perf_counter()
+        publish(make_batch(fold_events))
+        while plane.lag_records() > 0:
+            await asyncio.sleep(0.01)
+        fold_s = time.perf_counter() - t0
+        folded = plane.stats["folded_events"] - folded0
+        out["resident_fold_events"] = folded
+        out["resident_fold_s"] = round(fold_s, 2)
+        out["resident_fold_events_per_sec"] = round(folded / fold_s)
+        out["resident_fold_rounds"] = plane.stats["rounds"]
+        log(f"refresh loop: {folded} events folded in {fold_s:.2f}s "
+            f"({out['resident_fold_events_per_sec']} ev/s sustained)")
+        await plane.stop()
+
+    asyncio.run(scenario())
+
+    # -- command-path guard: the refresh loop must not cost the write path --
+    if os.environ.get("SURGE_BENCH_RESIDENT_GUARD", "1") == "1":
+        secs = float(os.environ.get("SURGE_BENCH_RESIDENT_GUARD_SECONDS", 3.0))
+        workers = int(os.environ.get("SURGE_BENCH_RESIDENT_GUARD_WORKERS", 64))
+        guard: dict = {"off": [], "on": []}
+        for rnd in range(rounds):
+            order = (("off", False), ("on", True))
+            if rnd % 2:
+                order = order[::-1]
+            for name, enabled in order:
+                stats = steady_state_latency(secs, overrides={
+                    "surge.replay.resident.enabled": enabled,
+                }, ladder=[workers])
+                guard[name].append({"commands_per_sec": stats["commands_per_sec"],
+                                    "p50_ms": stats["command_p50_ms"]})
+        med = lambda rows, k: statistics.median(r[k] for r in rows)  # noqa: E731
+        out["resident_command_guard"] = {
+            "workers": workers, "seconds": secs, "rounds": guard,
+            "plane_off_commands_per_sec": round(med(guard["off"], "commands_per_sec")),
+            "plane_on_commands_per_sec": round(med(guard["on"], "commands_per_sec")),
+            "plane_off_p50_ms": round(med(guard["off"], "p50_ms"), 2),
+            "plane_on_p50_ms": round(med(guard["on"], "p50_ms"), 2),
+        }
+        g = out["resident_command_guard"]
+        log(f"command guard @{workers}w: plane on "
+            f"{g['plane_on_commands_per_sec']} vs off "
+            f"{g['plane_off_commands_per_sec']} cmds/s (medians, "
+            f"p50 {g['plane_on_p50_ms']} vs {g['plane_off_p50_ms']} ms)")
+    return out
+
+
 def main() -> None:
     orig_env = dict(os.environ)
     # the parent NEVER initializes the tunneled backend — pin it to the host CPU
@@ -971,6 +1207,21 @@ def main() -> None:
         stats = failover_bench()
         payload.update(stats)
         payload["value"] = stats.get("failover_unavailability_ms") or 0
+        emit(payload)
+        return
+
+    # SURGE_BENCH_RESIDENT=1: device-resident read-plane fast path — read
+    # ladder + refresh-loop folds + command guard, no corpus build. The full
+    # corpus run below still replays through the resident layout by default;
+    # SURGE_BENCH_STREAMING=1 (or the legacy SURGE_BENCH_RESIDENT=0) selects
+    # the streaming window path there instead.
+    if os.environ.get("SURGE_BENCH_RESIDENT", "0") == "1":
+        payload = {"metric": "resident_reads_per_sec", "value": 0,
+                   "unit": "reads/s"}
+        stats = resident_bench()
+        payload.update(stats)
+        payload["value"] = max(r["device_reads_per_sec"]
+                               for r in stats["resident_read_ladder"])
         emit(payload)
         return
 
@@ -1032,7 +1283,8 @@ def main() -> None:
         # one-time wire pack (the log-segment build analog, SURVEY §5.4): cold
         # replays mmap this and stream it straight onto the device. Skipped
         # when the streaming path is benched — no child would read it.
-        if os.environ.get("SURGE_BENCH_RESIDENT", "1") == "1":
+        if (os.environ.get("SURGE_BENCH_STREAMING", "0") != "1"
+                and os.environ.get("SURGE_BENCH_RESIDENT", "1") == "1"):
             t0 = time.perf_counter()
             make_engine().pack_resident(corpus.events).save(
                 os.path.join(corpus_dir, "wire"))

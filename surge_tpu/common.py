@@ -47,6 +47,22 @@ class Controllable:
         return await self.stop()
 
 
+class DecodedState:
+    """An already-deserialized aggregate state handed back by a state fetch.
+
+    The resident state plane (surge_tpu.replay.resident_state) materializes
+    domain states from device tensor rows, so routing them through the
+    byte-oriented fetch contract would serialize + immediately re-deserialize
+    every hit. A fetch returning ``DecodedState(state)`` tells the entity to
+    adopt ``state`` directly. Defined here (jax-free) so the core engine never
+    imports the replay stack just to recognize the marker."""
+
+    __slots__ = ("state",)
+
+    def __init__(self, state: Any) -> None:
+        self.state = state
+
+
 class CircularBuffer(Generic[T]):
     """Fixed-capacity ring (CircularBuffer.scala analog; health bus keeps the last N
     signals in one of these — HealthSignalBus.scala:177)."""

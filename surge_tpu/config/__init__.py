@@ -115,6 +115,23 @@ DEFAULTS: dict[str, Any] = {
     # aggregates per chunk for the throwaway restore segment (peak host
     # memory of the bounded tpu path = one chunk's decoded events)
     "surge.replay.restore-chunk-aggregates": 65536,
+    # --- device-resident materialized state plane (replay/resident_state.py) ---
+    # keep the KTable-equivalent state RESIDENT on device after the cold-start
+    # replay, fold committed batches into it incrementally, and answer
+    # getState/projections from batched device gathers (ROADMAP item 2)
+    "surge.replay.resident.enabled": False,
+    # hot-set bound: aggregates resident in the device slab at once; the
+    # overflow spills to a host-side dict at its exact fold point and
+    # re-admits on its next event
+    "surge.replay.resident.capacity": 65536,
+    # staleness bound for plane-served reads: a read falls back to the host
+    # KV store when its partition's fold watermark lags the committed log by
+    # more than this many records (entity init always demands lag 0)
+    "surge.replay.resident.max-lag-records": 4096,
+    # refresh loop: records pulled per partition per fold round, and how long
+    # an idle round waits on wait_for_append before re-polling
+    "surge.replay.resident.refresh-max-poll-records": 4096,
+    "surge.replay.resident.refresh-interval-ms": 50,
     # --- log broker replication (acks=all role, common reference.conf:112-124) ---
     # how long a commit waits for the follower ack before failing back to the
     # client (which retries the same txn_seq and re-joins the queued item)

@@ -33,7 +33,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
 
-from surge_tpu.common import fail_future, logger, resolve_future
+from surge_tpu.common import DecodedState, fail_future, logger, resolve_future
 from surge_tpu.config import Config, RetryConfig, TimeoutConfig, default_config
 from surge_tpu.engine.business_logic import SurgeModel
 from surge_tpu.engine.model import RejectedCommand
@@ -245,6 +245,17 @@ class AggregateEntity:
             try:
                 with self.metrics.state_fetch_timer.time():
                     data = self.fetch_state(self.aggregate_id)
+                    if inspect.isawaitable(data):
+                        # async fetch backends (the device-resident state
+                        # plane's batched gather lane) — the sync KV path
+                        # never pays an await
+                        data = await data
+                if isinstance(data, DecodedState):
+                    # the resident plane hands back an already-materialized
+                    # domain state; re-serializing it through the byte
+                    # contract would undo the gather's amortization
+                    self.state = data.state
+                    return
                 with self.metrics.deserialization_timer.time():
                     self.state = (self.surge_model.deserialize_state(data)
                                   if data is not None else self._initial_state())

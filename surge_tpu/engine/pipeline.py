@@ -175,6 +175,28 @@ class SurgeEngine(Controllable):
             self.log, config=self.config, topics=[logic.state_topic],
             metrics=self.metrics,
             on_signal=self.health_bus.signal_fn("log-compactor"))
+        # device-resident materialized state plane (docs/replay.md): the
+        # KTable-equivalent slab stays on device after the cold-start replay,
+        # a standing refresh loop folds committed batches into it, and
+        # getState / projections are answered from batched device gathers
+        # with the host KV store as the staleness/coverage fallback
+        self.resident_plane = None
+        if (self.config.get_bool("surge.replay.resident.enabled")
+                and logic.events_topic):
+            spec = logic.replay_spec()
+            if spec is not None:
+                from surge_tpu.replay.resident_state import ResidentStatePlane
+
+                self.resident_plane = ResidentStatePlane(
+                    self.log, logic.events_topic, spec, config=self.config,
+                    partitions=[],  # assigned at start (follows the indexer)
+                    deserialize_event=self._deserialize_event,
+                    serialize_state=lambda a, s: logic.state_format.write_state(s).value,
+                    encode_event=getattr(logic, "encode_event", None),
+                    decode_state=getattr(logic, "decode_state", None),
+                    derived_cols=getattr(logic, "derived_cols", None),
+                    mesh=self._resolve_mesh(), metrics=self.metrics,
+                    on_signal=self.health_bus.signal_fn("resident-plane"))
         self.checkpoint_writer = None
         ckpt_path = self.config.get_str("surge.store.checkpoint.path", "")
         if ckpt_path and logic.events_topic:
@@ -217,10 +239,19 @@ class SurgeEngine(Controllable):
             self.indexer.set_partitions(self._indexer_partitions())
             if self._indexer_listener is None:
                 self._indexer_listener = (
-                    lambda _asg, _ch: self.indexer.set_partitions(
-                        self._indexer_partitions()))
+                    lambda _asg, _ch: self._retarget_partitions())
                 self.tracker.register(self._indexer_listener, replay_current=False)
             await self.indexer.start()
+            if self.resident_plane is not None:
+                # the plane follows the same assignment as the indexer; it
+                # seeds its slab from the events topic (cold-start replay that
+                # stays on device), so it starts AFTER the indexer is tailing —
+                # reads fall back to the host store until the seed lands
+                self.resident_plane.set_partitions(self._indexer_partitions())
+                await self.resident_plane.start()
+                self.health_supervisor.register(
+                    "resident-plane", self.resident_plane,
+                    restart_patterns=[RegexMatcher(r"resident-plane.*fatal")])
             if self.config.get_bool("surge.log.compaction.enabled"):
                 await self.compactor.start()
                 self.health_supervisor.register(
@@ -278,6 +309,8 @@ class SurgeEngine(Controllable):
         if self.loop_prober is not None:
             await self.loop_prober.stop()
         await self.router.stop()  # stops regions (shards + publishers)
+        if self.resident_plane is not None:
+            await self.resident_plane.stop()
         await self.indexer.stop()
         await self.compactor.stop()
         if self.checkpoint_writer is not None:
@@ -309,12 +342,62 @@ class SurgeEngine(Controllable):
 
     # -- regions -------------------------------------------------------------------------
 
+    def _retarget_partitions(self) -> None:
+        """Rebalance fan-out: the indexer AND the resident plane follow the
+        tracker's view of this node's partitions together, so the plane's
+        fold watermarks always cover exactly what the host store tails."""
+        parts = self._indexer_partitions()
+        self.indexer.set_partitions(parts)
+        if self.resident_plane is not None:
+            self.resident_plane.set_partitions(parts)
+
+    def _fetch_state(self, aggregate_id: str):
+        """Entity-init state fetch: the resident plane first (one coalesced
+        device gather, ``require_current`` — a command folded on stale state
+        would fork the aggregate), host KV store on any miss. Sync KV path
+        when no plane is wired (the entity never awaits then)."""
+        if self.resident_plane is None or not self.resident_plane.running:
+            return self.indexer.get_aggregate_bytes(aggregate_id)
+
+        async def fetch():
+            from surge_tpu.common import DecodedState
+
+            hit, state = await self.resident_plane.read_state(
+                aggregate_id, require_current=True)
+            if hit:
+                return DecodedState(state)
+            return self.indexer.get_aggregate_bytes(aggregate_id)
+
+        return fetch()
+
+    async def project_states(self, aggregate_ids, *,
+                             require_current: bool = False) -> Dict[str, object]:
+        """Read-side projection over many aggregates: every resident hit rides
+        ONE batched device gather + a single fetch-barriered pull; misses
+        (not resident, stale beyond ``surge.replay.resident.max-lag-records``,
+        revoked, or no plane at all) are served from the host KV store.
+        Returns ``{aggregate_id: state}``, omitting ids with no state."""
+        out: Dict[str, object] = {}
+        missing = list(aggregate_ids)
+        if self.resident_plane is not None and self.resident_plane.running:
+            hits = await self.resident_plane.project(
+                missing, require_current=require_current)
+            out.update(hits)
+            missing = [a for a in missing if a not in hits]
+        for agg in missing:
+            data = self.indexer.get_aggregate_bytes(agg)
+            if data is not None:
+                out[agg] = self.logic.state_format.read_state(data)
+        return out
+
     def _create_region(self, partition: int) -> _Region:
         if partition not in self.indexer.partitions:
             # a region implies serving this partition: its publisher's lag gate
             # needs the indexer tailing it even if the tracker view disagrees
             self.indexer.set_partitions(
                 sorted(set(self.indexer.partitions) | {partition}))
+            if self.resident_plane is not None:
+                self.resident_plane.set_partitions(self.indexer.partitions)
         publisher = PartitionPublisher(
             self.log, self.logic.state_topic, self.logic.events_topic or None,
             partition, self.indexer, config=self.config,
@@ -327,7 +410,7 @@ class SurgeEngine(Controllable):
             f"{self.logic.aggregate_name}-{partition}",
             lambda aggregate_id, on_passivate, on_stopped: AggregateEntity(
                 aggregate_id, self.surge_model, publisher,
-                fetch_state=self.indexer.get_aggregate_bytes, partition=partition,
+                fetch_state=self._fetch_state, partition=partition,
                 config=self.config, on_passivate=on_passivate, on_stopped=on_stopped,
                 metrics=self.metrics, tracer=self.tracer),
             buffer_limit=self.config.get_int("surge.aggregate.passivation-buffer-limit", 1000),
@@ -356,16 +439,23 @@ class SurgeEngine(Controllable):
         self.metrics.standby_lag.record(
             self.indexer.lag_for(self.standby_partitions()))
         router_h = self.router.health()
+        components = [
+            HealthCheck(name="router",
+                        status="up" if router_h["status"] == "up" else "down",
+                        components=regions),
+            HealthCheck(name="state-store",
+                        status="up" if self.indexer.running else "down"),
+        ]
+        if self.resident_plane is not None:
+            # degraded, not down: reads fall back to the host store, the
+            # engine keeps serving
+            components.append(HealthCheck(
+                name="resident-plane",
+                status="up" if self.resident_plane.running else "degraded"))
         return HealthCheck(
             name=self.logic.aggregate_name,
             status="up" if self.status == EngineStatus.RUNNING else "down",
-            components=[
-                HealthCheck(name="router",
-                            status="up" if router_h["status"] == "up" else "down",
-                            components=regions),
-                HealthCheck(name="state-store",
-                            status="up" if self.indexer.running else "down"),
-            ])
+            components=components)
 
     def producer_stats(self) -> Dict[str, float]:
         """Aggregated group-commit lane stats across this node's partitions

@@ -203,6 +203,10 @@ class GrpcLogTransport:
     thanks to replicated txn-dedup state, resumes its idempotency numbering
     without duplicating an acked-but-reply-lost commit."""
 
+    #: reads/end_offset are blocking RPCs here — callers sharing an event
+    #: loop (the resident plane's freshness checks) must ride the executor
+    is_remote = True
+
     def __init__(self, target, config=None,
                  auto_create_partitions: int = 1, tracer=None,
                  metrics=None) -> None:

@@ -230,7 +230,16 @@ class EngineMetrics:
     replay_compile_timer: Timer = field(init=False)
     replay_dispatch_timer: Timer = field(init=False)
     replay_fetch_timer: Timer = field(init=False)
+    replay_refresh_timer: Timer = field(init=False)
     replay_profile_windows: Sensor = field(init=False)
+    # device-resident materialized state plane (surge_tpu.replay.resident_state):
+    # the on-chip KTable's occupancy, incremental-fold cadence and read lane
+    resident_occupancy: Sensor = field(init=False)
+    resident_fold_round_timer: Timer = field(init=False)
+    resident_fold_lag: Sensor = field(init=False)
+    resident_gather_batch: Sensor = field(init=False)
+    resident_fallbacks: Sensor = field(init=False)
+    resident_evictions: Sensor = field(init=False)
     # log compaction + state checkpoints (surge_tpu.log.compactor /
     # surge_tpu.store.checkpoint — the bounded-cold-start subsystem)
     compaction_runs: Sensor = field(init=False)
@@ -327,9 +336,37 @@ class EngineMetrics:
             "surge.replay.profile.fetch-timer",
             "ms from dispatch to the fetch barrier closing device time "
             "(a real device-to-host fetch, never block_until_ready)"), level=dbg)
+        self.replay_refresh_timer = m.timer(MI(
+            "surge.replay.profile.refresh-timer",
+            "ms per incremental resident-plane refresh round "
+            "(encode + h2d + fold dispatch of one committed batch)"),
+            level=dbg)
         self.replay_profile_windows = m.counter(MI(
             "surge.replay.profile.windows",
             "replay windows/tiles observed by the profiler"), level=dbg)
+        self.resident_occupancy = m.gauge(MI(
+            "surge.replay.resident.slab-occupancy",
+            "aggregates resident in the on-device state slab"))
+        self.resident_fold_round_timer = m.timer(MI(
+            "surge.replay.resident.fold-round-timer",
+            "ms per incremental fold round (committed batch -> slab)"))
+        self.resident_fold_lag = m.gauge(MI(
+            "surge.replay.resident.fold-lag-records",
+            "events committed past the plane's fold watermarks (reads fall "
+            "back to the host store beyond "
+            "surge.replay.resident.max-lag-records)"))
+        self.resident_gather_batch = m.gauge(MI(
+            "surge.replay.resident.gather-batch-size",
+            "reads coalesced into the last device gather (the d2h "
+            "amortization the batched read path exists for)"))
+        self.resident_fallbacks = m.counter(MI(
+            "surge.replay.resident.fallback-reads",
+            "reads answered by the host KV store instead of the device "
+            "slab (not resident, stale, revoked or poisoned)"))
+        self.resident_evictions = m.counter(MI(
+            "surge.replay.resident.evictions",
+            "aggregates evicted from the slab to the host spill "
+            "(capacity pressure)"))
         self.compaction_runs = m.counter(MI(
             "surge.log.compaction.runs", "partition compaction passes"))
         self.compaction_bytes_reclaimed = m.counter(MI(

@@ -1225,6 +1225,25 @@ class ReplayEngine:
             num_aggregates=b, num_events=resident.num_events,
             padded_events=padded)
 
+    def fold_resident_slab(self, resident: "ResidentCorpus",
+                           init_carry: Mapping[str, Any] | None = None,
+                           ordinal_base: np.ndarray | None = None
+                           ) -> tuple[dict, int]:
+        """Fold a prepared resident corpus and return the DEVICE state slab
+        instead of pulling states to the host: ``({field: [b_pad] device
+        array}, padded_slots)``. Rows are in the corpus's SORTED lane order
+        (``resident.perm`` maps sorted rank → original aggregate index; None =
+        identity) and rows past ``b`` are padding.
+
+        This is the seeding half of the resident state plane
+        (surge_tpu.replay.resident_state): a cold-start replay whose result
+        STAYS on device — the caller gathers rows into its own slab with zero
+        device→host traffic. ``init_carry``/``ordinal_base`` are in the
+        original aggregate order, exactly like :meth:`replay_resident`."""
+        init_sorted, ord_sorted = _apply_perm(resident.perm, init_carry,
+                                              ordinal_base)
+        return self._dispatch_resident(resident, init_sorted, ord_sorted)
+
     def _pull_states(self, slab: Mapping[str, Any], b: int,
                      perm: Optional[np.ndarray],
                      cache: Optional[dict] = None) -> dict[str, np.ndarray]:

@@ -18,6 +18,12 @@ roofline analysis reasons about (docs/roofline.md):
   forces the chained programs to finish (bench.py). ``block_until_ready`` can
   return before execution completes on the tunneled relay, so it is never used
   to close device time.
+- ``refresh`` — one incremental fold round of the resident state plane
+  (surge_tpu.replay.resident_state): encode + h2d + dispatch of a committed
+  batch into the on-device slab. The plane also reports its pack time under
+  ``encode`` and its window dispatches under ``compile``/``dispatch``, so
+  incremental folds break down in the per-stage profile exactly like
+  cold-start passes; ``refresh`` is the per-round umbrella.
 
 Each stage occurrence feeds the DEBUG-level ``surge.replay.profile.*`` timers
 in :class:`~surge_tpu.metrics.EngineMetrics` (free at INFO: the sensors are
@@ -53,6 +59,7 @@ _STAGE_TIMERS = {
     "compile": "replay_compile_timer",
     "dispatch": "replay_dispatch_timer",
     "fetch": "replay_fetch_timer",
+    "refresh": "replay_refresh_timer",
 }
 
 #: stages that dispatch device work — annotated into XLA profiles

@@ -204,22 +204,23 @@ def _sharded_program(engine, key: frozenset, width: int, bs: int, k_cap: int):
     return jitted
 
 
-def replay_resident_sharded(engine, sharded: ShardedResident,
-                            init_carry: Mapping[str, Any] | None = None,
-                            ordinal_base: Optional[np.ndarray] = None
-                            ) -> ReplayResult:
-    """Fold a :class:`ShardedResident` across the engine's mesh. Results come
-    back in the ORIGINAL aggregate order of the packed corpus."""
+def fold_resident_sharded(engine, sharded: ShardedResident,
+                          init_carry: Mapping[str, Any] | None = None,
+                          ordinal_base: Optional[np.ndarray] = None):
+    """Fold a :class:`ShardedResident` and return the DEVICE slab —
+    ``{field: [n_dev, b_pad] sharded array}`` — without the host pull.
+
+    Row ``[d, j]`` holds sorted-rank lane ``sharded.deals[d][j]`` (rows past
+    each deal's length are padding). The mesh half of
+    :meth:`ReplayEngine.fold_resident_slab`, used by the resident state plane
+    to keep a cold-start replay's states on device; ``replay_resident_sharded``
+    is this plus one pull + reassembly."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     w = sharded.wire_host
     b = sharded.b
     state_fields = engine.spec.registry.state.fields
-    if b == 0:
-        return ReplayResult(states={f.name: np.zeros((0,), dtype=f.dtype)
-                                    for f in state_fields},
-                            num_aggregates=0, num_events=0, padded_events=0)
     perm = w.perm
     n_dev, b_pad = sharded.n_dev, sharded.b_pad
     key = frozenset(w.derived_key.items())
@@ -261,7 +262,24 @@ def replay_resident_sharded(engine, sharded: ShardedResident,
                         jax.device_put(i0s, shard2),
                         jax.device_put(tbs, shard2),
                         jax.device_put(kn, shard1))
+    return slab_dev
 
+
+def replay_resident_sharded(engine, sharded: ShardedResident,
+                            init_carry: Mapping[str, Any] | None = None,
+                            ordinal_base: Optional[np.ndarray] = None
+                            ) -> ReplayResult:
+    """Fold a :class:`ShardedResident` across the engine's mesh. Results come
+    back in the ORIGINAL aggregate order of the packed corpus."""
+    b = sharded.b
+    state_fields = engine.spec.registry.state.fields
+    if b == 0:
+        return ReplayResult(states={f.name: np.zeros((0,), dtype=f.dtype)
+                                    for f in state_fields},
+                            num_aggregates=0, num_events=0, padded_events=0)
+    perm = sharded.wire_host.perm
+    slab_dev = fold_resident_sharded(engine, sharded, init_carry=init_carry,
+                                     ordinal_base=ordinal_base)
     # single pull; reassemble original order through deal + perm
     out_sorted = {name: np.empty((b,), dtype=f.dtype)
                   for name, f in ((f.name, f) for f in state_fields)}

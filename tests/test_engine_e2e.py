@@ -153,6 +153,76 @@ def test_rebalance_listener_sees_assignments():
     asyncio.run(scenario())
 
 
+def test_resident_plane_serves_reads_end_to_end():
+    """surge.replay.resident.enabled: the engine wires the device-resident
+    state plane — entity init consults it first (DecodedState, no byte
+    round-trip), project_states batches hits into one gather, a tracker
+    rebalance retargets plane partitions with the indexer, and the health
+    tree grows a resident-plane component."""
+    async def scenario():
+        cfg = CFG.with_overrides({
+            "surge.replay.resident.enabled": True,
+            "surge.replay.resident.refresh-interval-ms": 10,
+            "surge.aggregate.idle-passivation-ms": 40,
+        })
+        engine = create_engine(make_logic(), config=cfg)
+        await engine.start()
+        plane = engine.resident_plane
+        assert plane is not None and plane.running
+        assert plane.partitions == [0, 1, 2, 3]
+        aggs = [f"agg{i}" for i in range(8)]
+        for agg in aggs:
+            r = await engine.aggregate_for(agg).send_command(counter.Increment(agg))
+            assert isinstance(r, CommandSuccess), r
+        for _ in range(300):
+            if plane.lag_records() == 0 and plane.occupancy() == len(aggs):
+                break
+            await asyncio.sleep(0.02)
+        assert plane.occupancy() == len(aggs)
+
+        # read-side projection: every hit rides the batched gather lane
+        proj = await engine.project_states(aggs + ["never-seen"])
+        assert set(proj) == set(aggs)
+        assert all(proj[a].count == 1 for a in aggs)
+        assert plane.stats["gathers"] >= 1
+
+        # passivate, then re-init: the entity state comes from the PLANE
+        # (require_current) and the next command folds on top of it
+        await asyncio.sleep(0.15)
+        gathered = plane.stats["gathered_rows"]
+        r = await engine.aggregate_for("agg0").send_command(counter.Increment("agg0"))
+        assert isinstance(r, CommandSuccess) and r.state.count == 2
+        assert plane.stats["gathered_rows"] > gathered
+
+        hc = engine.health_check()
+        assert any(c.name == "resident-plane" and c.status == "up"
+                   for c in hc.components)
+
+        # rebalance: the plane follows the indexer's partition view
+        engine.tracker.update({engine.local_host: [0, 1]})
+        assert set(plane.partitions) >= {0, 1}
+        assert set(plane.partitions) == set(engine.indexer.partitions)
+        await engine.stop()
+        assert not plane.running
+
+    asyncio.run(scenario())
+
+
+def test_resident_plane_disabled_by_default():
+    async def scenario():
+        engine = create_engine(make_logic(), config=CFG)
+        assert engine.resident_plane is None
+        await engine.start()
+        r = await engine.aggregate_for("a").send_command(counter.Increment("a"))
+        assert isinstance(r, CommandSuccess)
+        # no plane: projections come straight from the host KV store
+        proj = await engine.project_states(["a", "ghost"])
+        assert set(proj) == {"a"} and proj["a"].count == 1
+        await engine.stop()
+
+    asyncio.run(scenario())
+
+
 def test_mesh_sharding_flag_builds_replay_mesh():
     """The enable-mesh-sharding flag must have a real consumer: without an explicit
     mesh, engine replay builds a 1-D data mesh over all visible devices (8 on the

@@ -112,3 +112,68 @@ def test_summary_reset():
     assert s["total_accounted_s"] == 0
     assert all(s[k]["count"] == 0 for k in
                ("encode", "h2d", "compile", "dispatch", "fetch"))
+
+
+def test_refresh_stage_covers_incremental_folds():
+    """The resident plane's incremental folds land in the per-stage profile
+    like cold-start passes: `refresh` is the per-round umbrella, its host
+    pack shows under `encode`, the first window under `compile` and repeats
+    under `dispatch`."""
+    import asyncio
+
+    from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
+    from surge_tpu.models.counter import (CountIncremented, event_formatting,
+                                          state_formatting)
+    from surge_tpu.replay.profiler import ReplayProfiler
+    from surge_tpu.replay.resident_state import ResidentStatePlane
+    from surge_tpu.serialization import SerializedMessage
+
+    registry = Metrics(recording_level=RecordingLevel.DEBUG)
+    prof = ReplayProfiler.if_enabled(registry, engine_metrics(registry))
+    evt, st = event_formatting(), state_formatting()
+    log = InMemoryLog()
+    log.create_topic(TopicSpec("events", 1))
+
+    def append(events):
+        prod = log.transactional_producer("t")
+        prod.begin()
+        for ev in events:
+            msg = evt.write_event(ev)
+            prod.send(LogRecord(topic="events", partition=0,
+                                key=msg.key, value=msg.value))
+        prod.commit()
+
+    async def scenario():
+        plane = ResidentStatePlane(
+            log, "events", make_replay_spec(),
+            config=default_config().with_overrides({
+                "surge.replay.batch-size": 16, "surge.replay.time-chunk": 8,
+                "surge.replay.resident.refresh-interval-ms": 5}),
+            deserialize_event=lambda raw: evt.read_event(
+                SerializedMessage(key="", value=raw)),
+            serialize_state=lambda a, s: st.write_state(s).value,
+            profiler=prof)
+        await plane.start()
+        try:
+            append([CountIncremented(f"a{i}", 1, 1) for i in range(8)])
+            for _ in range(200):
+                if plane.lag_records() == 0 and plane.stats["rounds"] > 0:
+                    break
+                await asyncio.sleep(0.01)
+            append([CountIncremented(f"a{i}", 1, 2) for i in range(8)])
+            for _ in range(200):
+                if plane.lag_records() == 0 and plane.stats["rounds"] > 1:
+                    break
+                await asyncio.sleep(0.01)
+        finally:
+            await plane.stop()
+        return plane
+
+    plane = asyncio.run(scenario())
+    s = prof.summary()
+    assert s["refresh"]["count"] == plane.stats["rounds"] >= 2
+    assert s["encode"]["count"] >= s["refresh"]["count"]
+    assert s["compile"]["count"] > 0   # first refresh window paid the compile
+    assert s["dispatch"]["count"] > 0  # the repeat round reused the program
+    snap = registry.get_metrics()
+    assert snap["surge.replay.profile.refresh-timer.max"] > 0
