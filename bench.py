@@ -729,6 +729,218 @@ def failover_bench() -> dict:
     return out
 
 
+def handoff_bench() -> dict:
+    """SURGE_BENCH_HANDOFF=1: paired interleaved ladder (medians only, per
+    the BENCH_NOTES round-6 protocol — single runs swing 2-3x on this host)
+    comparing the three ways a partition leader moves:
+
+    - ``handoff`` — planned HandoffPartition under load: bulk slice ship
+      while serving, then fence -> journal-tail ship -> dedup push ->
+      promote -> demote. Unavailability = the longest gap in the POOLED ack
+      stream of all workers (the cluster-wide write outage, same metric as
+      the failover bench — a single worker's private stall inside its retry
+      ladder does not register); the fenced span is bounded by the TAIL
+      appended during the bulk phase, never by log size.
+    - ``kill`` — the PR-4 kill-failover under the same load: hard-kill the
+      leader, prober-declared death, promotion. The unavailability floor
+      includes the probe-failure detection window a planned handoff skips.
+    - ``replay`` — full-replay cold start: how long an EMPTY standby takes
+      to catch_up the whole preloaded log (the log-size-bound transfer a
+      handoff performs UNFENCED). Runs with NO worker load — it measures
+      pure transfer time against an idle leader, a different quantity than
+      the two unavailability arms, compared only for its log-size scaling.
+
+    Every round runs all three arms interleaved against fresh broker pairs
+    with the same preload. Env: SURGE_BENCH_HANDOFF_WORKERS (8),
+    SURGE_BENCH_HANDOFF_SECONDS (4), SURGE_BENCH_HANDOFF_PRELOAD (3000),
+    SURGE_BENCH_HANDOFF_ROUNDS (3)."""
+    import statistics
+    import threading
+
+    from surge_tpu.config import Config
+    from surge_tpu.log import (GrpcLogTransport, InMemoryLog, LogRecord,
+                               LogServer, TopicSpec)
+    from surge_tpu.log.transport import NotLeaderError, ProducerFencedError
+
+    workers = int(os.environ.get("SURGE_BENCH_HANDOFF_WORKERS", 8))
+    seconds = float(os.environ.get("SURGE_BENCH_HANDOFF_SECONDS", 4.0))
+    preload = int(os.environ.get("SURGE_BENCH_HANDOFF_PRELOAD", 3000))
+    rounds = int(os.environ.get("SURGE_BENCH_HANDOFF_ROUNDS", 3))
+    cfg = Config(overrides={
+        "surge.log.replication-ack-timeout-ms": 1_500,
+        "surge.log.replication-isr-timeout-ms": 2_000,
+        "surge.log.failover.probe-interval-ms": 150,
+        "surge.log.failover.probe-failures": 2,
+    })
+
+    def build_pair():
+        lport, fport = _free_ports(2)
+        follower = LogServer(InMemoryLog(), port=fport,
+                             follower_of=f"127.0.0.1:{lport}",
+                             auto_promote=True, config=cfg)
+        follower.start()
+        leader = LogServer(InMemoryLog(), port=lport,
+                           replicate_to=[f"127.0.0.1:{fport}"], config=cfg)
+        leader.start()
+        setup = GrpcLogTransport(f"127.0.0.1:{lport}", config=cfg)
+        setup.create_topic(TopicSpec("ev", 1))
+        producer = setup.transactional_producer("preload")
+        done = 0
+        while done < preload:
+            n = min(500, preload - done)
+            producer.begin()
+            for i in range(n):
+                producer.send(LogRecord(topic="ev", key=f"p{done + i}",
+                                        value=b"x" * 64, partition=0))
+            producer.commit()
+            done += n
+        setup.close()
+        return leader, follower, lport, fport
+
+    def run_arm(kind: str) -> dict:
+        leader, follower, lport, fport = build_pair()
+        targets = f"127.0.0.1:{lport},127.0.0.1:{fport}"
+        stop_at = time.monotonic() + seconds
+        move_at = time.monotonic() + 0.4 * seconds
+        acked_lock = threading.Lock()
+        acked: list = []
+        ack_times: list = []
+
+        def worker(w: int) -> None:
+            client = GrpcLogTransport(targets, config=cfg)
+            producer = None
+            i = 0
+            try:
+                while time.monotonic() < stop_at:
+                    payload = f"{kind}-w{w}-{i}".encode()
+                    deadline = time.monotonic() + 30.0
+                    while True:
+                        try:
+                            if producer is None:
+                                producer = client.transactional_producer(
+                                    f"ho-{kind}-{w}")
+                            producer.begin()
+                            producer.send(LogRecord(
+                                topic="ev", key=f"w{w}", value=payload,
+                                partition=0))
+                            producer.commit()
+                            break
+                        except (ProducerFencedError, NotLeaderError):
+                            producer = None
+                        except Exception:  # noqa: BLE001 — mid-transition
+                            if producer is not None and producer.in_transaction:
+                                producer.abort()
+                            time.sleep(0.05)
+                        if time.monotonic() > deadline:
+                            return
+                    with acked_lock:
+                        acked.append(payload)
+                        ack_times.append(time.monotonic())
+                    i += 1
+            finally:
+                client.close()
+
+        out: dict = {"kind": kind}
+        threads = []
+        if kind != "replay":
+            threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                       for w in range(workers)]
+            for t in threads:
+                t.start()
+        moved = False
+        admin = None
+        try:
+            while time.monotonic() < stop_at:
+                if not moved and time.monotonic() >= move_at:
+                    moved = True
+                    if kind == "handoff":
+                        admin = GrpcLogTransport(f"127.0.0.1:{lport}",
+                                                 config=cfg)
+                        out["handoff_stats"] = admin.handoff_partition(
+                            f"127.0.0.1:{fport}")
+                    elif kind == "kill":
+                        leader.kill()
+                    else:  # replay: full cold start of an EMPTY standby
+                        (sport,) = _free_ports(1)
+                        standby = LogServer(InMemoryLog(), port=sport,
+                                            config=cfg)
+                        t0 = time.perf_counter()
+                        copied = standby.catch_up(f"127.0.0.1:{lport}")
+                        out["replay_cold_start_ms"] = round(
+                            (time.perf_counter() - t0) * 1000.0, 1)
+                        out["replay_records"] = copied
+                        standby.stop()
+                        break
+                time.sleep(0.02)
+            for t in threads:
+                t.join(60.0)
+            if kind != "replay":
+                deadline = time.monotonic() + 30
+                winner = follower  # the destination/promoted broker
+                while winner.role != "leader" and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                gaps = [b - a for a, b in zip(ack_times, ack_times[1:])]
+                out["unavailability_ms"] = (round(max(gaps) * 1000.0, 1)
+                                            if gaps else None)
+                out["acked"] = len(acked)
+                present: dict = {}
+                for r in winner.log.read("ev", 0):
+                    present[r.value] = present.get(r.value, 0) + 1
+                out["lost"] = sum(1 for p in acked
+                                  if present.get(p, 0) == 0)
+                out["duplicated"] = sum(1 for p in acked
+                                        if present.get(p, 0) > 1)
+                out["promoted"] = winner.role == "leader"
+        finally:
+            if admin is not None:
+                admin.close()
+            leader.stop()
+            follower.stop()
+        return out
+
+    arms: dict = {"handoff": [], "kill": [], "replay": []}
+    for rnd in range(rounds):
+        for kind in ("handoff", "kill", "replay"):  # interleaved, paired
+            try:
+                row = run_arm(kind)
+            except Exception as exc:  # noqa: BLE001 — one arm, not the ladder
+                log(f"handoff bench round {rnd} {kind} FAILED: {exc!r}")
+                row = {"kind": kind, "error": repr(exc)}
+            row["round"] = rnd
+            arms[kind].append(row)
+            log(f"handoff bench round {rnd} {kind}: "
+                f"{ {k: v for k, v in row.items() if k != 'handoff_stats'} }")
+    med = lambda rows, k: statistics.median(  # noqa: E731
+        r[k] for r in rows if r.get(k) is not None)
+    out = {
+        "workers": workers, "seconds": seconds, "preload": preload,
+        "rounds": rounds, "arms": arms,
+        "handoff_unavailability_ms_median": med(arms["handoff"],
+                                                "unavailability_ms"),
+        "kill_unavailability_ms_median": med(arms["kill"],
+                                             "unavailability_ms"),
+        "replay_cold_start_ms_median": med(arms["replay"],
+                                           "replay_cold_start_ms"),
+        "handoff_fence_ms_median": statistics.median(
+            r["handoff_stats"]["fence_ms"] for r in arms["handoff"]
+            if "handoff_stats" in r),
+        "handoff_tail_records_median": statistics.median(
+            r["handoff_stats"].get("tail_records", 0)
+            for r in arms["handoff"] if "handoff_stats" in r),
+        "lost": sum(r.get("lost", 0) for rows in arms.values()
+                    for r in rows),
+        "duplicated": sum(r.get("duplicated", 0) for rows in arms.values()
+                          for r in rows),
+    }
+    log(f"handoff bench medians: planned {out['handoff_unavailability_ms_median']}ms "
+        f"(fence {out['handoff_fence_ms_median']}ms, tail "
+        f"{out['handoff_tail_records_median']} records) vs kill "
+        f"{out['kill_unavailability_ms_median']}ms vs full-replay cold start "
+        f"{out['replay_cold_start_ms_median']}ms over {preload} records; "
+        f"lost={out['lost']} duplicated={out['duplicated']}")
+    return out
+
+
 def _free_ports(n: int) -> list:
     import socket
 
@@ -1207,6 +1419,17 @@ def main() -> None:
         stats = failover_bench()
         payload.update(stats)
         payload["value"] = stats.get("failover_unavailability_ms") or 0
+        emit(payload)
+        return
+
+    # SURGE_BENCH_HANDOFF=1: planned-handoff ladder — handoff vs
+    # kill-failover vs full-replay cold start, paired interleaved medians
+    if os.environ.get("SURGE_BENCH_HANDOFF", "0") == "1":
+        payload = {"metric": "handoff_unavailability_ms", "value": 0,
+                   "unit": "ms"}
+        stats = handoff_bench()
+        payload.update(stats)
+        payload["value"] = stats.get("handoff_unavailability_ms_median") or 0
         emit(payload)
         return
 

@@ -82,6 +82,32 @@ class BrokerLivenessProber:
             thread.join(self.interval_s + 2.0)
         self._thread = None
 
+    def reset(self) -> None:
+        """Re-arm after a retired declaration (quorum candidacy lost its vote
+        round: the leader may yet return, or the true new leader's stream
+        will repoint us) — clears the dead verdict and restarts probing.
+        Callable from the prober's own on_dead callback: the current run is
+        RETIRING (it returns right after on_dead), so start() must spawn a
+        fresh thread instead of seeing the still-alive current one and
+        doing nothing."""
+        self.declared_dead = False
+        self.failure_streak = 0
+        if self._thread is threading.current_thread():
+            self._thread = None
+        self.start()
+
+    def retarget(self, target: str) -> None:
+        """Point the prober at a NEW leader (cluster repoint after another
+        broker won promotion): fresh streak, bootstrap grace re-applies until
+        the new leader is seen alive once."""
+        self.stop()
+        self.target = target
+        self.failure_streak = 0
+        self.declared_dead = False
+        self.ever_alive = False
+        self._stop.clear()
+        self.start()
+
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
             self.probes += 1

@@ -546,6 +546,13 @@ class GrpcLogTransport:
         return self._invoke("EndOffset", pb.OffsetRequest(
             topic=topic, partition=partition)).end_offset
 
+    def high_watermark(self, topic: str, partition: int) -> int:
+        """The quorum-acked frontier of one partition on the CONNECTED
+        broker: what its follower-served ``read_committed`` reads are gated
+        on (on a leader / ungated partition this equals the applied end)."""
+        return self._invoke("EndOffset", pb.OffsetRequest(
+            topic=topic, partition=partition)).high_watermark
+
     def replication_status(self) -> dict:
         """The connected broker's in-sync set (empty targets on a follower /
         unreplicated broker): {"replicas": {target: in_sync}, "min_insync",
@@ -590,6 +597,33 @@ class GrpcLogTransport:
         if not reply.ok:
             raise RuntimeError(f"PromoteFollower failed: {reply.error}")
         return json.loads(reply.records[0].value)
+
+    def handoff_partition(self, to: str, timeout: float = 60.0) -> dict:
+        """Planned leadership transfer: the CONNECTED broker (must be the
+        leader) ships its log to ``to`` as checkpoint-codec slices, fences,
+        ships the journal tail + dedup table, promotes ``to`` and demotes
+        itself. Returns the handoff stats (bulk/tail records, fence ms,
+        handoff epoch). CAVEAT: the unfenced bulk phase scales with how far
+        ``to`` is behind — on a DEADLINE_EXCEEDED the server-side handoff
+        may still be running AND may still complete; check ``broker_status``
+        (or ``chaos.py cluster``) before retrying or killing anything."""
+        import json
+
+        req = pb.TxnRequest(op="handoff", records=[pb.RecordMsg(
+            has_value=True, value=json.dumps({"to": to}).encode())])
+        reply = self._invoke("HandoffPartition", req, timeout=timeout)
+        if not reply.ok:
+            raise RuntimeError(f"HandoffPartition failed: {reply.error}")
+        return json.loads(reply.records[0].value)
+
+    def kill_broker(self) -> None:
+        """Remote hard-stop of the CONNECTED broker (chaos drills: same
+        semantics as a fault-plane crash — the socket closes NOW, so the
+        reply itself may be lost; unreachable counts as success)."""
+        try:
+            self._calls["ArmFaults"](pb.TxnRequest(op="kill"), timeout=5.0)
+        except grpc.RpcError:
+            pass  # the kill raced the reply: that IS the success mode
 
     def log_metrics_text(self) -> str:
         """The connected broker's OpenMetrics payload (its own registry:

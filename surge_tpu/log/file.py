@@ -713,13 +713,58 @@ class FileLog(LogBase):
             if err is None and self._rotate_bytes:
                 try:
                     self._maybe_rotate_journal()
+                    # a never-idle leader defeats the opportunistic path
+                    # forever (new lines land between every round and its
+                    # quiesce check) — past the hard ceiling, rotate by FORCE:
+                    # take the log lock as a barrier and make the quiesced
+                    # invariant true instead of waiting for it
+                    with self._gc_cv:
+                        durable = self._gc_durable
+                    if durable >= 2 * self._rotate_bytes:
+                        self._force_rotate_journal()
                 except Exception:  # noqa: BLE001 — rotation is opportunistic
                     logger.exception("journal rotation failed; will retry "
                                      "after the next sync round")
 
     # -- journal rotation -----------------------------------------------------------------
 
-    def _maybe_rotate_journal(self) -> None:
+    def _force_rotate_journal(self) -> None:
+        """Size-forced rotation BARRIER (run by the group-sync worker once
+        the durable journal passes twice the rotate threshold): under
+        sustained load the opportunistic quiesce check never passes — some
+        committer has always written a line since the last round — so the WAL
+        would grow without bound. The force path inverts the discipline: take
+        the MAIN log lock first (no appender can start a new journal line),
+        fsync everything already written, resolve the covered waiters, and
+        rotate while the quiesced invariant is held BY THE LOCK rather than
+        by luck. Commit latency pays one rotation inline — bounded by segment
+        fsyncs + one rename — which is the explicit trade against an
+        unbounded commits.log."""
+        with self._lock:
+            with self._gc_cv:
+                if self._gc_stop:
+                    return
+                target = self._gc_written
+            if target > self._gc_durable:
+                if self.faults is not None:
+                    self.faults.on_fsync("journal")
+                os.fsync(self._journal.fileno())
+            ready: List[Tuple[int, "ConcurrentFuture"]] = []
+            with self._gc_cv:
+                if target > self._gc_durable:
+                    self._gc_durable = target
+                keep = []
+                for t, fut in self._gc_waiters:
+                    (ready if t <= self._gc_durable else keep).append((t, fut))
+                self._gc_waiters = keep
+            for _t, fut in ready:
+                # resolving under the (reentrant) log lock is safe: the only
+                # callback chained on these futures re-takes this same lock
+                if not fut.done():
+                    fut.set_result(None)
+            self._maybe_rotate_journal(forced=True)
+
+    def _maybe_rotate_journal(self, forced: bool = False) -> None:
         """Rotate ``commits.log`` once its durable bytes exceed the rotation
         threshold: the journal embeds WAL payloads, so unrotated it grows
         without bound (ROADMAP follow-up). A rotation generation is safe to
@@ -781,9 +826,11 @@ class FileLog(LogBase):
                     self._journal.tell())
             if self.flight is not None:
                 self.flight.record("journal.rotate", old_bytes=old_size,
-                                   new_bytes=self._journal.tell())
-            logger.info("rotated commit journal (%d -> %d bytes)",
-                        old_size, self._journal.tell())
+                                   new_bytes=self._journal.tell(),
+                                   forced=forced)
+            logger.info("rotated commit journal (%d -> %d bytes%s)",
+                        old_size, self._journal.tell(),
+                        ", forced" if forced else "")
 
     # -- reads ----------------------------------------------------------------------------
 

@@ -126,7 +126,8 @@ class _ReplItem:
     every follower applies the identical generational swap)."""
 
     __slots__ = ("specs", "records", "txn_id", "seq", "done", "error",
-                 "kind", "manifest", "result", "index", "cum_records")
+                 "kind", "manifest", "result", "index", "cum_records",
+                 "acks")
 
     def __init__(self, specs, records, txn_id: str = "", seq: int = 0,
                  kind: str = "", manifest: Optional[dict] = None) -> None:
@@ -145,6 +146,10 @@ class _ReplItem:
         #: synthetic items that never enter the queue)
         self.index = 0
         self.cum_records = 0
+        #: followers that acked THIS item's ship (the quorum the finalize
+        #: pass counts; per-target ships are in order, so ack sets are
+        #: prefix-closed along the queue)
+        self.acks: set = set()
 
 
 class _TargetState:
@@ -159,8 +164,11 @@ class _TargetState:
         self.next_probe = 0.0
         #: acked-through marks (absolute, idempotent under re-ship): the
         #: enqueue index / cumulative record count of the newest queue item
-        #: this follower acked — per-follower lag = enqueue counters minus
-        #: these (surge_log_replication_lag_records{follower=...})
+        #: this follower acked. Doubles as this follower's CURSOR into the
+        #: ordered queue — each in-sync target advances independently, so a
+        #: quorum of fast followers can ack a commit while a slow one is
+        #: still catching the same items — and feeds the per-follower lag
+        #: gauges (surge_log_replication_lag_records{follower=...})
         self.shipped_index = 0
         self.shipped_records = 0
 
@@ -221,6 +229,23 @@ METHODS = {
     #   newest N events (the chaos CLI's tail).
     "GetMetricsText": (pb.ListTopicsRequest, pb.TxnReply),
     "DumpFlight": (pb.ReadRequest, pb.TxnReply),
+    # quorum cluster plane (message reuse, same convention as above):
+    # VoteLeader — txn_seq carries the CANDIDATE epoch, records[0].value a
+    #   JSON {"candidate": addr, "leader": presumed-dead addr}; the reply
+    #   record answers {"granted", "epoch", "reason", "role", "leader_hint",
+    #   "leader_alive"}. One vote per epoch, persisted in __broker_meta.
+    # FetchSlice — standby bulk pull: ReadRequest names (topic, partition,
+    #   from_offset, max_records); the reply record's value is ONE
+    #   checkpoint-codec partition slice (store/checkpoint.py blocks).
+    # InstallSlice — handoff bulk push: records[0].value carries slice
+    #   bytes; the standby verbatim-ingests them (leader refuses).
+    # HandoffPartition — planned leadership transfer: records[0].value =
+    #   {"to": target}; bulk slice ship → fence → journal-tail ship → dedup
+    #   push → promote dest → demote; the reply record carries the stats.
+    "VoteLeader": (pb.TxnRequest, pb.TxnReply),
+    "FetchSlice": (pb.ReadRequest, pb.TxnReply),
+    "InstallSlice": (pb.TxnRequest, pb.TxnReply),
+    "HandoffPartition": (pb.TxnRequest, pb.TxnReply),
 }
 
 
@@ -276,7 +301,8 @@ class LogServer:
                  auto_promote: Optional[bool] = None,
                  advertised: Optional[str] = None,
                  faults=None, metrics=None, broker_metrics=None,
-                 flight=None, metrics_port: Optional[int] = None) -> None:
+                 flight=None, metrics_port: Optional[int] = None,
+                 quorum_peers: Optional[list] = None) -> None:
         from surge_tpu.metrics.broker import broker_metrics as _broker_metrics
         from surge_tpu.observability.flight import FlightRecorder
 
@@ -335,6 +361,15 @@ class LogServer:
         # catch_up). min-insync=len(targets)+1 restores strict acks=all.
         self._repl_min_insync = cfg.get_int(
             "surge.log.replication-min-insync", 1)
+        # quorum acks: how many replicas (this leader INCLUDED) must hold a
+        # commit before the client is acked. 0 = every in-sync follower (the
+        # strict PR-4 behavior); 2 in a 3-broker cluster is the classic
+        # majority posture — the slowest follower drops off the ack path
+        # while the ordered queue still delivers to it. Pair with
+        # surge.log.replication-min-insync >= the same quorum, or a shrunken
+        # ISR can ack below the intended durability.
+        self._repl_min_insync_acks = cfg.get_int(
+            "surge.log.replication.min-insync-acks", 0)
         self._repl_isr_timeout_s = cfg.get_seconds(
             "surge.log.replication-isr-timeout-ms", 10_000)
         self._repl_auto_resync_cap = cfg.get_int(
@@ -373,6 +408,51 @@ class LogServer:
         self.leader_hint: str = follower_of or ""
         self.epoch = 0 if follower_of else 1
         self.epoch_start: Dict[str, Dict[int, int]] = {}  # at OUR promotion
+        # -- majority-quorum promotion (the vote layer over the epoch fence):
+        # quorum_peers names the cluster membership — pass the SAME full
+        # list (this broker included; it is dropped by address wherever the
+        # peer set is consulted) to every broker. A prober-driven promotion
+        # then needs a strict majority of the cluster — each peer answers
+        # one VoteLeader per epoch, after double-checking leader liveness
+        # from ITS vantage — so a follower that merely lost its own link to
+        # the leader can never mint a second acking leader. Empty = the
+        # PR-4 pairwise behavior.
+        peers = (list(quorum_peers) if quorum_peers is not None else
+                 [t.strip() for t in
+                  cfg.get_str("surge.log.quorum.peers", "").split(",")
+                  if t.strip()])
+        self._quorum_peers = [p for p in peers if p]
+        self._vote_timeout_s = cfg.get_seconds(
+            "surge.log.quorum.vote-timeout-ms", 1_000)
+        self._vote_rounds = max(1, cfg.get_int(
+            "surge.log.quorum.vote-rounds", 5))
+        #: epoch -> candidate this broker voted for (one vote per epoch,
+        #: persisted in __broker_meta so a bounced voter cannot double-vote)
+        self._voted: Dict[int, str] = {}
+        self._max_vote_epoch = 0  # highest epoch this broker CAMPAIGNED for
+        #: a voter that just granted someone else stands its own candidacy
+        #: down until here — the winner's first ship repoints it long before
+        self._stand_down_until = 0.0
+        # -- per-partition high-watermark: the quorum-acked frontier.
+        # Leader: advanced by the finalize pass, shipped with every
+        # Replicate; follower: the last shipped value, gating what
+        # follower-served read_committed reads may observe.
+        self._hwm: Dict[tuple, int] = {}
+        #: serialized-hwm cache for record-less ships (beacons, rejoin
+        #: probes — the high-rate repeat case); None = rebuild on next use
+        self._hwm_wire: Optional[str] = None
+        # -- live handoff state: while fenced, Transact/OpenProducer answer
+        # not_leader (empty hint — clients hold in place) and the handoff
+        # waits for the in-flight counter to drain before shipping the tail
+        self._handoff_fence = False
+        #: claimed atomically with the role check in HandoffPartition — the
+        #: fence only goes up at phase 2, so this flag (not the fence) is
+        #: what stops a second handoff from racing the long unfenced bulk
+        self._handoff_active = False
+        self._inflight_txn = 0
+        #: catch_up's bulk lane (FetchSlice); flips off permanently after the
+        #: first broker that cannot serve slices
+        self._catchup_slices = True
         self._meta_producer = None
         self._recover_meta()
         self._demoting = False
@@ -413,6 +493,7 @@ class LogServer:
         self.broker_metrics.repl_epoch.record(self.epoch)
         self.broker_metrics.repl_insync_replicas.record(self._insync_count())
         self._dead = False  # set by kill(): every later RPC answers UNAVAILABLE
+        self._closed = False  # set by stop(): halts an in-flight campaign
         self.kill_done = None  # threading.Event from kill()'s socket close
         # automatic promotion: a follower probing its leader declares it dead
         # after N consecutive failures and promotes itself (the health-prober
@@ -420,7 +501,11 @@ class LogServer:
         if auto_promote is None:
             auto_promote = cfg.get_bool("surge.log.failover.auto-promote",
                                         False)
-        self._auto_promote = bool(auto_promote) and follower_of is not None
+        # quorum-peer brokers keep auto-promotion armed across role changes:
+        # a deposed leader becomes a follower that must campaign in the NEXT
+        # failover too (the prober itself only runs while role=="follower")
+        self._auto_promote = bool(auto_promote) and (
+            follower_of is not None or bool(self._quorum_peers))
         self._leader_prober = None
 
     # -- handlers (sync; called on the server thread pool) --------------------------------
@@ -466,9 +551,16 @@ class LogServer:
 
     def OpenProducer(self, request: pb.OpenProducerRequest,
                      context) -> pb.OpenProducerReply:
-        if self.role != "leader":
+        if self.role != "leader" or self._handoff_fence:
             # a follower must never open producers: accepted writes would
-            # fork the log the moment the leader appends — redirect instead
+            # fork the log the moment the leader appends — redirect instead.
+            # A handoff fence answers with an EMPTY hint: the destination is
+            # not promoted yet, so clients hold in place (jittered backoff)
+            # for the tail-sized window instead of ping-ponging.
+            if self._handoff_fence:
+                return pb.OpenProducerReply(
+                    error="leadership handing off; retry shortly",
+                    error_kind="not_leader", leader_hint="")
             return pb.OpenProducerReply(
                 error=f"broker is a {self.role}, not the leader",
                 error_kind="not_leader", leader_hint=self.leader_hint)
@@ -508,11 +600,33 @@ class LogServer:
         return pb.OpenProducerReply(producer_token=token, last_txn_seq=last)
 
     def Transact(self, request: pb.TxnRequest, context) -> pb.TxnReply:
-        if self.role != "leader":
-            return pb.TxnReply(
-                ok=False, error_kind="not_leader",
-                error=f"broker is a {self.role}, not the leader",
-                leader_hint=self.leader_hint)
+        # fence check and in-flight increment under ONE lock hold: the
+        # handoff raises the fence under this lock and then waits for the
+        # in-flight count to drain — a lock-free check could pass the fence,
+        # park, and commit AFTER the drain declared the log stable (the tail
+        # ship would miss an acked record). Post-increment, the fence
+        # provably waits for this call.
+        with self._role_lock:
+            if self.role != "leader" or self._handoff_fence:
+                if self._handoff_fence:
+                    # empty hint: the handoff destination is not promoted
+                    # yet — the client holds in place for the tail window
+                    return pb.TxnReply(
+                        ok=False, error_kind="not_leader",
+                        error="leadership handing off; retry shortly",
+                        leader_hint="")
+                return pb.TxnReply(
+                    ok=False, error_kind="not_leader",
+                    error=f"broker is a {self.role}, not the leader",
+                    leader_hint=self.leader_hint)
+            self._inflight_txn += 1
+        try:
+            return self._transact_traced(request, context)
+        finally:
+            with self._role_lock:
+                self._inflight_txn -= 1
+
+    def _transact_traced(self, request: pb.TxnRequest, context) -> pb.TxnReply:
         if self.tracer is None:
             return self._note_first_ack(self._transact_impl(request, context),
                                         request)
@@ -969,6 +1083,7 @@ class LogServer:
         return {"replicas": {t: st.in_sync
                              for t, st in self._repl_target_state.items()},
                 "min_insync": self._repl_min_insync,
+                "min_insync_acks": self._repl_min_insync_acks,
                 "insync_count": self._insync_count(),
                 "queue_depth": depth}
 
@@ -1061,13 +1176,24 @@ class LogServer:
         return err
 
     def _replication_iteration(self, backoff: float) -> float:
-        """One wait-for-head-item attempt; returns the next backoff (the
-        outer loop repeats and owns the stop check).
+        """One pass of the per-target replication machinery; returns the next
+        backoff (the outer loop repeats and owns the stop check).
 
-        The wait also breaks WITHOUT an item when an out-of-sync follower's
-        probe is due: rejoin must not depend on traffic (an idle broker would
-        otherwise never re-admit a healed follower until the next commit) —
-        the Kafka replica fetch loop runs regardless of produce activity."""
+        Each in-sync follower advances an independent CURSOR through the
+        ordered queue (its ``shipped_index``), so a quorum of fast followers
+        can carry a commit to its ack while a slow-but-alive one still
+        drains the same items — head-of-line blocking holds PER FOLLOWER
+        (a follower stays a gap-free prefix), not across the set. The
+        finalize pass then acks every queue-prefix item whose quorum is met
+        (``surge.log.replication.min-insync-acks``; 0 = every in-sync
+        follower, the strict PR-4 behavior), advances the per-partition
+        high-watermark, and GC's items that every in-sync follower holds.
+
+        The wait also breaks WITHOUT ship work when an out-of-sync
+        follower's probe is due: rejoin must not depend on traffic (an idle
+        broker would otherwise never re-admit a healed follower until the
+        next commit) — the Kafka replica fetch loop runs regardless of
+        produce activity."""
         with self._repl_cv:
             while not self._repl_queue and not self._repl_stop:
                 self._repl_cv.wait(0.5)
@@ -1078,40 +1204,50 @@ class LogServer:
                     break
             if self._repl_stop:
                 return backoff
-            item = self._repl_queue[0] if self._repl_queue else None
-        if self.faults is not None and item is not None:
+            queue = list(self._repl_queue)
+            base = self._repl_enq_items - len(queue)  # items GC'd so far
+        if self.faults is not None and queue:
             # deterministic poison-path site: an injected exception here is
             # exactly the "head item makes the worker raise" class the
             # strike counter in _replication_loop bounds
             self.faults.raise_point("repl.iteration")
-        if item is not None and item.kind == "barrier":
-            err = self._prepare_barrier(item)
+        head = queue[0] if queue else None
+        if head is not None and head.kind == "barrier":
+            # a barrier at the queue HEAD has every predecessor on every
+            # in-sync follower (GC only passes fully-shipped items) — the
+            # invariant its frontier-bounded pass rests on
+            err = self._prepare_barrier(head)
             if err is not None:
                 if err.startswith("retry:"):
-                    item.error = err
+                    head.error = err
                     time.sleep(backoff)
                     return min(backoff * 2, 1.0)
                 # a failing leader-side pass is not retriable: fail the
                 # barrier past the queue, loudly
                 with self._repl_cv:
-                    if self._repl_queue and self._repl_queue[0] is item:
+                    if self._repl_queue and self._repl_queue[0] is head:
                         self._repl_queue.pop(0)
-                item.error = err
-                item.done.set()
+                head.error = err
+                head.done.set()
                 logger.error("compaction barrier failed leader-side: %s", err)
                 return backoff
         now = time.monotonic()
         blocking_err = None
+        progress = False
         for target in self._repl_targets:
             st = self._repl_target_state[target]
             if st.in_sync:
-                if item is None:
-                    continue  # idle probe pass: nothing to ship
+                pos = max(0, st.shipped_index - base)
+                if pos >= len(queue):
+                    continue  # fully caught up; nothing to ship this pass
+                item = queue[pos]
+                if item.kind == "barrier" and item is not head:
+                    continue  # barriers ship only from the head (see above)
                 ship_t0 = time.perf_counter()
                 err = self._ship(target, item)
                 # timer only for a clean first-try ship: a gap-resync rescue
                 # below can take seconds and would pollute a histogram
-                # documented as ms-per-head-item-ship
+                # documented as ms-per-queue-item-ship
                 clean_ship_ms = (None if err is not None else
                                  (time.perf_counter() - ship_t0) * 1000.0)
                 if err is not None and "gap:" in err and now >= st.next_probe:
@@ -1129,13 +1265,22 @@ class LogServer:
                             "drops", target, err)
                 if err is None:
                     st.failing_since = None
-                    if item.index:  # queued item acked: advance the marks
+                    progress = True
+                    if item.index:  # queued item acked: advance the cursor
                         st.shipped_index = item.index
                         st.shipped_records = item.cum_records
+                        item.acks.add(target)
                         if clean_ship_ms is not None:
                             self.broker_metrics.repl_ship_timer.record_ms(
                                 clean_ship_ms)
+                        if self._repl_min_insync_acks > 0:
+                            # quorum acks: wake waiters the moment THIS ack
+                            # completes a quorum — the remaining targets
+                            # (including a stalling one whose ship blocks on
+                            # its timeout) ship after, off the ack path
+                            self._finalize_pass(queue)
                     continue
+                item.error = err  # visible to a waiter that times out
                 if st.failing_since is None:
                     st.failing_since = now
                 insync_after_drop = self._insync_count() - 1
@@ -1159,14 +1304,20 @@ class LogServer:
             elif now >= st.next_probe:
                 # budgeted probe: push any small lag (auto-resync — a
                 # one-shot catch_up can never converge under live traffic),
-                # then prove the write path with a ship (head item or an
-                # empty Replicate on the idle pass)
-                err = self._try_resync_and_ship(target, item)
+                # then prove the write path with a ship (this follower's
+                # next queue item, or an empty Replicate on the idle pass)
+                pos = max(0, st.shipped_index - base)
+                probe_item = (queue[pos] if pos < len(queue)
+                              and (queue[pos].kind != "barrier"
+                                   or queue[pos] is head) else None)
+                err = self._try_resync_and_ship(target, probe_item)
                 if err is None:
                     st.in_sync = True
                     st.failing_since = None
                     # resync proved a complete prefix net of the queue: the
-                    # follower holds everything not still queued
+                    # follower holds everything not still queued — its
+                    # cursor restarts at the queue tail's base (idempotent
+                    # gap-checked re-ships absorb any overlap)
                     with self._repl_cv:
                         st.shipped_index = (self._repl_enq_items
                                             - len(self._repl_queue))
@@ -1188,11 +1339,49 @@ class LogServer:
                     # (blackholed peer) must not be due again immediately,
                     # or every commit in degraded mode pays it
                     st.next_probe = time.monotonic() + 1.0
-        if item is None:
+        if not queue:
             return backoff  # idle probe pass: nothing to finalize
-        if blocking_err is None:
-            # finalize BEFORE waking waiters: dedup cache advanced and the
-            # pending entry dropped even if no client ever retries the seq
+        finalized = self._finalize_pass(queue)
+        if finalized or progress:
+            return 0.05
+        if blocking_err is not None:
+            logger.warning("replication attempt failed: %s", blocking_err)
+            time.sleep(backoff)
+            return min(backoff * 2, 1.0)
+        # nothing shipped, nothing finalized, no error: every reachable
+        # cursor is past the queue but a quorum is still outstanding (e.g.
+        # min-insync-acks above the live replica count) — wait, don't spin
+        # (the top-of-pass cv wait returns immediately on a non-empty queue)
+        time.sleep(min(backoff, 0.1))
+        return min(backoff * 2, 1.0)
+
+    def _quorum_needed(self, item: _ReplItem, insync_targets: list) -> bool:
+        """Whether this queue item's ack set satisfies its quorum. Barriers
+        and topic creates always need every in-sync follower (their
+        correctness rests on set-wide convergence); data batches ack at
+        ``min-insync-acks`` replicas (leader included), 0 = all in-sync."""
+        quorum = self._repl_min_insync_acks
+        if quorum <= 0 or item.kind == "barrier" or not item.records:
+            return all(t in item.acks for t in insync_targets)
+        return 1 + len(item.acks) >= quorum
+
+    def _finalize_pass(self, queue: list) -> bool:
+        """Ack every queue-prefix item whose quorum is met (dedup cache
+        advanced, per-partition high-watermark raised), beacon the fresh hwm
+        to fully-caught-up followers, and only THEN wake the waiters — a
+        client whose commit just acked may immediately read a follower, so
+        the follower's read gate must already admit the records when the ack
+        reply leaves this broker. Finally GC items every in-sync follower
+        holds. Per-target ships are in order, so quorum satisfaction is
+        prefix-monotone — the scan stops at the first unsatisfied item."""
+        insync = [t for t in self._repl_targets
+                  if self._repl_target_state[t].in_sync]
+        finalized: list = []
+        for item in queue:
+            if item.done.is_set():
+                continue
+            if not self._quorum_needed(item, insync):
+                break
             if item.seq:
                 dedup = self._txn_dedup.setdefault(item.txn_id, _TxnDedup())
                 if item.seq > dedup.last_seq:
@@ -1204,19 +1393,56 @@ class LogServer:
                         item.records)
                 self._repl_pending.pop((item.txn_id, item.seq), None)
             item.error = None
-            # pop BEFORE waking the waiter: a client that gets its commit
-            # reply and immediately asks ReplicationStatus must not see
-            # its own finalized item still counted in queue_depth
+            self._advance_hwm(item.records)
+            finalized.append(item)
+        if finalized:
+            # hwm beacon BEFORE waking waiters: a follower that acked before
+            # the quorum completed carries a stale high-watermark — an empty
+            # ship refreshes its gate, so read-your-committed-writes holds on
+            # followers the moment the client's ack lands (best-effort: a
+            # failed beacon only delays visibility until the next data ship)
             with self._repl_cv:
+                depth0 = len(self._repl_queue)
+                base0 = self._repl_enq_items - depth0
+            for t in insync:
+                st = self._repl_target_state[t]
+                if st.shipped_index - base0 >= depth0:
+                    self._ship(t, _ReplItem([], []), timeout=1.0)
+            for item in finalized:
+                item.done.set()
+        # GC: pop items that are finalized AND on every in-sync follower —
+        # out-of-sync followers never pin the queue (they re-converge via
+        # resync/catch_up, which reads the log directly)
+        with self._repl_cv:
+            while self._repl_queue:
+                h = self._repl_queue[0]
+                if not h.done.is_set() or any(
+                        self._repl_target_state[t].shipped_index < h.index
+                        for t in insync):
+                    break
                 self._repl_queue.pop(0)
-                depth = len(self._repl_queue)
-            self.broker_metrics.repl_queue_depth.record(depth)
-            item.done.set()
-            return 0.05
-        item.error = blocking_err  # visible to a waiter that times out
-        logger.warning("replication attempt failed: %s", blocking_err)
-        time.sleep(backoff)
-        return min(backoff * 2, 1.0)
+            depth = len(self._repl_queue)
+        self.broker_metrics.repl_queue_depth.record(depth)
+        return bool(finalized)
+
+    def _advance_hwm(self, records) -> None:
+        """Raise the per-partition high-watermark past a quorum-acked batch
+        (the min acked-through frontier the quorum provably holds); gauges
+        the hwm lag of the partitions the batch touched."""
+        lag = 0
+        touched = set()
+        for r in records:
+            if not r.topic or r.topic in INTERNAL_TOPICS:
+                continue
+            tp = (r.topic, r.partition)
+            touched.add(tp)
+            if r.offset + 1 > self._hwm.get(tp, 0):
+                self._hwm[tp] = r.offset + 1
+                self._hwm_wire = None  # serialized map cache is stale
+        for tp in touched:
+            lag += max(0, self._applied_end(*tp) - self._hwm.get(tp, 0))
+        if touched:
+            self.broker_metrics.hwm_lag_records.record(lag)
 
     def _prepare_barrier(self, item: _ReplItem) -> Optional[str]:
         """Leader half of the compaction barrier, run by the worker when the
@@ -1381,25 +1607,10 @@ class LogServer:
                     theirs = batch[-1].offset + 1
             if total:
                 # dedup table rides along: the pushed records' (txn_id, seq)
-                # advanced on the leader only while the follower was out.
-                # Chunked (a long-lived leader's table can be large — each
-                # entry embeds its cached reply) and budgeted by the probe
-                # deadline rather than a fixed per-call second.
-                snap = self.DedupSnapshot(pb.DedupSnapshotRequest(), None)
-                push = self._probe_stub(target, "ApplyDedup",
-                                        pb.ApplyDedupRequest,
-                                        pb.ReplicateReply)
-                entries = list(snap.entries)
-                for lo in range(0, len(entries), 500):
-                    left = deadline - time.monotonic()
-                    if left <= 0:
-                        return (f"{target}: probe budget exhausted "
-                                "(dedup push); continuing next probe")
-                    reply = push(pb.ApplyDedupRequest(
-                        entries=entries[lo: lo + 500]),
-                        timeout=max(left, 0.2))
-                    if not reply.ok:
-                        return f"{target}: dedup push failed: {reply.error}"
+                # advanced on the leader only while the follower was out
+                err = self._push_dedup_to(target, deadline=deadline)
+                if err is not None:
+                    return err
             return None
         except Exception as exc:  # noqa: BLE001 — still down / transport error
             self._drop_probe_transport(target)
@@ -1435,6 +1646,63 @@ class LogServer:
             self._drop_probe_transport(target)
             return f"{target}: {exc!r}"
 
+    def _push_dedup_to(self, target: str,
+                       deadline: Optional[float] = None) -> Optional[str]:
+        """Chunked DedupSnapshot → ApplyDedup push (resync rejoin AND the
+        handoff's phase 4 — the exactly-once-critical transfer lives in ONE
+        place). Chunked because a long-lived leader's table can be large
+        (each entry embeds its cached reply); ``deadline`` budgets the whole
+        push (the resync probe's budget), else each chunk gets a fixed 5s.
+        Returns an error string (None = fully pushed)."""
+        snap = self.DedupSnapshot(pb.DedupSnapshotRequest(), None)
+        push = self._probe_stub(target, "ApplyDedup", pb.ApplyDedupRequest,
+                                pb.ReplicateReply)
+        entries = list(snap.entries)
+        for lo in range(0, len(entries), 500):
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return (f"{target}: probe budget exhausted "
+                            "(dedup push); continuing next probe")
+                timeout = max(left, 0.2)
+            else:
+                timeout = 5.0
+            reply = push(pb.ApplyDedupRequest(entries=entries[lo: lo + 500]),
+                         timeout=timeout)
+            if not reply.ok:
+                return f"{target}: dedup push failed: {reply.error}"
+        return None
+
+    def _ship_hwm_json(self, item: _ReplItem) -> str:
+        """The high-watermark map this ship carries. When a SINGLE follower
+        ack completes the quorum (one in-sync follower under acks=all, or
+        min-insync-acks=2), the shipped batch's own end offsets are included
+        optimistically: the moment the receiving follower applies it, leader
+        + itself ARE the quorum, so it may serve those records immediately —
+        follower reads then never lag the ack by a beacon round."""
+        import json as _json
+
+        optimistic = bool(item.records) and (
+            self._repl_min_insync_acks == 2 or (
+                self._repl_min_insync_acks <= 0
+                and self._insync_count() <= 2))
+        if not optimistic:
+            # the map is byte-identical between hwm advances (beacons,
+            # rejoin probes, AND data ships outside the single-ack-quorum
+            # shapes) — serialize once per advance, not once per ship
+            if self._hwm_wire is None:
+                hwm = {f"{t}|{p}": off for (t, p), off in self._hwm.items()}
+                self._hwm_wire = _json.dumps(hwm) if hwm else ""
+            return self._hwm_wire
+        hwm = {f"{t}|{p}": off for (t, p), off in self._hwm.items()}
+        for r in item.records:
+            if not r.topic or r.topic in INTERNAL_TOPICS:
+                continue
+            key = f"{r.topic}|{r.partition}"
+            if r.offset + 1 > hwm.get(key, 0):
+                hwm[key] = r.offset + 1
+        return _json.dumps(hwm) if hwm else ""
+
     def _ship(self, target: str, item: _ReplItem,
               timeout: Optional[float] = None) -> Optional[str]:
         if self.faults is not None:
@@ -1457,7 +1725,8 @@ class LogServer:
                 records=[record_to_msg(r) for r in item.records],
                 transactional_id=item.txn_id, txn_seq=item.seq,
                 leader_epoch=self.epoch, kind=item.kind,
-                leader_target=self._my_target()),
+                leader_target=self._my_target(),
+                high_watermarks=self._ship_hwm_json(item)),
                 timeout=timeout or self._repl_ack_timeout_s)
             if not reply.ok:
                 if reply.leader_epoch > self.epoch:
@@ -1506,9 +1775,29 @@ class LogServer:
                                      request.leader_target or None,
                                      adopt_epoch=False,
                                      old_epoch=deposed_epoch)
+        repoint = False
+        if request.leader_epoch:
+            with self._role_lock:
                 if request.leader_target:
                     self.leader_hint = request.leader_target
+                    if (self.role == "follower"
+                            and request.leader_target != self._my_target()
+                            and request.leader_target != self._follower_of):
+                        # cluster repoint: a DIFFERENT broker won promotion —
+                        # follow its stream, and aim the liveness prober at
+                        # it (fresh streak + bootstrap grace) so the next
+                        # failover campaigns about the right leader
+                        self._follower_of = request.leader_target
+                        repoint = True
+        if repoint:
+            # outside the role lock: retargeting joins the old prober thread
+            # (bounded, but a post-promotion first ship must not serialize
+            # behind it)
+            self._ensure_prober()
         if request.kind == "barrier":
+            # a barrier's hwm map carries no optimistic entries (its records
+            # are the manifest, not data): safe to adopt up front
+            self._adopt_shipped_hwm(request.high_watermarks)
             return self._apply_compaction_barrier(request)
         with self._replica_lock:
             try:
@@ -1554,6 +1843,12 @@ class LogServer:
                             request.transactional_id, dedup, request.txn_seq,
                             pb.TxnReply(ok=True, records=list(request.records)),
                             [msg_to_record(m) for m in request.records])
+                # adopt the shipped hwm only now that the batch is APPLIED:
+                # a quorum-completing ship's optimistic entries vouch for
+                # THIS replica holding the records — adopting before a
+                # gap-refused ingest would park the read gate above records
+                # this replica never got, and the gate is monotonic
+                self._adopt_shipped_hwm(request.high_watermarks)
                 return pb.ReplicateReply(ok=True)
             except Exception as exc:  # noqa: BLE001
                 logger.exception("replica ingest failed")
@@ -1674,6 +1969,18 @@ class LogServer:
                     self.epoch_start = {
                         t: {int(p): int(off) for p, off in parts.items()}
                         for t, parts in obj.get("starts", {}).items()}
+            rec = latest.get("vote")
+            if rec is not None:
+                obj = _json.loads(rec.value)
+                e = int(obj.get("e", 0))
+                if e:
+                    # one vote per epoch survives the restart: a bounced
+                    # voter must not grant the SAME epoch to a second
+                    # candidate (the double-vote split-brain). Only the
+                    # newest vote is compacted-latest, which suffices —
+                    # VoteLeader also refuses epochs at or below it.
+                    self._voted[e] = str(obj.get("c", ""))
+                    self._max_vote_epoch = max(self._max_vote_epoch, e)
         except Exception:  # noqa: BLE001 — a broken meta topic must not
             logger.exception("broker meta recovery failed")  # block startup
 
@@ -1722,26 +2029,82 @@ class LogServer:
                         {t: dict(p) for t, p in
                          self.last_applied_epoch_start.items()},
                     "last_truncation": (dict(self.last_truncation)
-                                        if self.last_truncation else None)}
+                                        if self.last_truncation else None),
+                    # quorum-plane observability (chaos.py status reads
+                    # these to explain WHY a follower read is servable):
+                    # the per-partition quorum-acked frontier this broker
+                    # gates reads on, and the vote-cluster shape
+                    "high_watermarks": self._hwm_by_topic(),
+                    "quorum": self._quorum_view(),
+                    "handoff_fence": self._handoff_fence}
 
-    def promote(self, replicate_to: Optional[list] = None) -> dict:
-        """Follower → leader promotion (admin PromoteFollower RPC, or the
-        leader-death prober). Bumps the epoch past every one this broker has
-        seen, records the EPOCH-START offsets — the truncation floor a fenced
-        ex-leader rolls its divergent tail back to — persists both, and
-        starts replicating to ``replicate_to`` (default: the old leader, so
-        the pair inverts; it re-joins through the fence → truncate →
-        catch_up → ISR-rejoin path). Idempotent on an existing leader."""
+    def _hwm_by_topic(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for (t, p), off in sorted(self._hwm.items()):
+            out.setdefault(t, {})[str(p)] = off
+        return out
+
+    def _applied_ends(self) -> Dict[str, int]:
+        """Per-partition applied frontiers ("topic|p" -> end), internal
+        topics excluded (self-maintained per side, offsets incomparable) —
+        the campaign's log-completeness evidence."""
+        out: Dict[str, int] = {}
+        for spec in self._topic_specs():
+            if spec.name in INTERNAL_TOPICS:
+                continue
+            for p in range(spec.partitions or 1):
+                out[f"{spec.name}|{p}"] = self._applied_end(spec.name, p)
+        return out
+
+    def _quorum_others(self) -> list:
+        """The quorum peer set minus this broker (configs pass the same
+        full cluster list to every member)."""
+        me = self._my_target()
+        return [p for p in self._quorum_peers if p and p != me]
+
+    def _quorum_view(self) -> dict:
+        others = self._quorum_others()
+        cluster = len(others) + 1 if others else 1
+        return {"peers": others,
+                "cluster_size": cluster,
+                "majority": cluster // 2 + 1,
+                "min_insync_acks": self._repl_min_insync_acks,
+                "max_vote_epoch": self._max_vote_epoch}
+
+    def promote(self, replicate_to: Optional[list] = None,
+                at_epoch: Optional[int] = None) -> dict:
+        """Follower → leader promotion (admin PromoteFollower RPC, the
+        leader-death prober, or a won campaign). Bumps the epoch past every
+        one this broker has seen — or mints exactly ``at_epoch``, the epoch a
+        quorum campaign collected its votes FOR (votes are per-epoch; a
+        higher self-chosen epoch would be one nobody granted) — records the
+        EPOCH-START offsets — the truncation floor a fenced ex-leader rolls
+        its divergent tail back to — persists both, and starts replicating to
+        ``replicate_to`` (default: every quorum peer when configured, else
+        the old leader, so the pair inverts; each re-joins through the
+        fence → truncate → catch_up → ISR-rejoin path). Idempotent on an
+        existing leader."""
         with self._role_lock:
             if self.role == "leader":
                 return self.broker_status()
             self._adopt_leader_epoch()
+            if at_epoch is not None and self.epoch >= at_epoch:
+                # the campaign's mandate went stale between the vote count
+                # and this lock: another winner's epoch already reached us.
+                # Minting max(seen)+1 here would be an epoch NOBODY voted
+                # for — it would fence the legitimately elected leader and
+                # get its quorum-acked tail truncated. Abort; the caller
+                # stands down and the prober re-arms.
+                raise RuntimeError(
+                    f"stale campaign mandate: voted epoch {at_epoch} but "
+                    f"epoch {self.epoch} already seen")
             # floor of 2: every ACTIVE leader initializes at epoch 1, so a
             # follower that never learned its leader's epoch (leader down
             # since before this follower's first probe) must still mint an
             # epoch that FENCES it — promoting 0 -> 1 would collide, and
             # equal epochs pass every fence (silent two-leader split brain)
-            self.epoch = max(self.epoch + 1, 2)
+            self.epoch = max(self.epoch + 1, 2,
+                             at_epoch if at_epoch is not None else 0)
             starts: Dict[str, Dict[int, int]] = {}
             for spec in self._topic_specs():
                 if spec.name in INTERNAL_TOPICS:
@@ -1756,8 +2119,14 @@ class LogServer:
                                 "starts": {t: {str(p): off
                                                for p, off in parts.items()}
                                            for t, parts in starts.items()}})
-            targets = list(replicate_to) if replicate_to is not None else (
-                [self._follower_of] if self._follower_of else [])
+            if replicate_to is not None:
+                targets = list(replicate_to)
+            elif self._quorum_peers:
+                # cluster promotion: replicate to EVERY peer (the deposed
+                # leader included — it re-joins through the fence path)
+                targets = self._quorum_others()
+            else:
+                targets = [self._follower_of] if self._follower_of else []
             self._repl_targets = [t for t in targets if t]
             for t in self._repl_targets:
                 st = self._repl_target_state.setdefault(t, _TargetState())
@@ -1840,7 +2209,12 @@ class LogServer:
             self.metrics.failover_fencings.record()
             self.broker_metrics.repl_epoch.record(self.epoch)
             if fencer:
+                self._follower_of = fencer
                 self._truncate_to_leader(fencer)
+                # a deposed leader re-enters the failover rotation: probe the
+                # broker that fenced it, so the NEXT leader death finds every
+                # surviving broker campaigning (not just the original pair)
+                self._ensure_prober()
         finally:
             with self._role_lock:
                 self._demoting = False
@@ -2027,6 +2401,108 @@ class LogServer:
             logger.exception("promotion failed")
             return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
 
+    def VoteLeader(self, request: pb.TxnRequest, context) -> pb.TxnReply:
+        """One quorum-promotion vote (txn_seq = the CANDIDATE epoch). Granted
+        only when ALL of: this broker is not itself a live leader, the
+        candidate epoch exceeds every epoch this broker has seen or voted,
+        this epoch's one vote is unspent, and the presumed-dead leader is
+        unreachable from THIS broker's vantage too (the prober's verdict
+        when it has one, else a direct probe) — a candidate that merely lost
+        its own link to the leader fails that last check on every healthy
+        peer and can never reach a majority. Votes persist in __broker_meta:
+        a bounced voter cannot double-vote."""
+        import json as _json
+
+        self.broker_metrics.quorum_vote_requests.record()
+        obj = {}
+        if request.records and request.records[0].has_value:
+            try:
+                obj = _json.loads(request.records[0].value or b"{}")
+            except ValueError:
+                pass
+        candidate = str(obj.get("candidate", ""))
+        presumed_dead = str(obj.get("leader", ""))
+        cand_epoch = int(request.txn_seq)
+
+        def answer(granted: bool, reason: str,
+                   leader_alive: bool = False, hint: str = "") -> pb.TxnReply:
+            self.flight.record("quorum.vote", candidate=candidate,
+                               epoch=cand_epoch, granted=granted,
+                               reason=reason)
+            return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+                has_key=True, key="vote", has_value=True,
+                value=_json.dumps({
+                    "granted": granted, "reason": reason,
+                    "epoch": max(self.epoch, self._max_vote_epoch),
+                    "role": self.role, "leader_alive": leader_alive,
+                    "leader_hint": hint or self.leader_hint}).encode())])
+
+        if not candidate or cand_epoch <= 0:
+            return answer(False, "malformed")
+        with self._role_lock:
+            if self.role == "leader":
+                # an ACKING leader answering RPCs is alive by construction —
+                # the candidate's liveness view is wrong, not ours
+                return answer(False, "voter-is-leader", leader_alive=True,
+                              hint=self._my_target())
+            already = self._voted.get(cand_epoch)
+            if already is not None:
+                if already == candidate:
+                    # idempotent re-grant: the candidate's first reply was
+                    # lost — our vote at this epoch is already its
+                    return answer(True, "granted")
+                return answer(False, "already-voted")
+            if cand_epoch <= max(self.epoch, self._max_vote_epoch):
+                return answer(False, "stale-epoch")
+            # up-to-date check (the Raft §5.4.1 safety role): deny a
+            # candidate whose log is BEHIND this voter's. Every quorum-acked
+            # commit lives on at least one member of any majority, so with
+            # this check the elected leader provably holds all of them — a
+            # freshly-restarted broker still mid-catch-up cannot win over a
+            # complete peer and silently drop acked records.
+            cand_ends = obj.get("ends")
+            if isinstance(cand_ends, dict):
+                for key, mine in self._applied_ends().items():
+                    if mine > int(cand_ends.get(key, 0)):
+                        return answer(False, "log-behind")
+        # leader-liveness double-check OUTSIDE the role lock (network probe):
+        # our own prober's standing verdict when it watches that address,
+        # else one direct probe, budgeted under the candidate's vote timeout
+        if presumed_dead and presumed_dead != candidate:
+            prober = self._leader_prober
+            if (prober is not None and prober.target == presumed_dead
+                    and prober.declared_dead):
+                pass  # we independently concluded dead — grant path
+            else:
+                try:
+                    # FRESH channel for the verdict: a cached probe channel
+                    # that failed while the leader was booting sits in gRPC
+                    # connect-backoff and would report a LIVE leader dead —
+                    # the exact wrong answer to cast a vote on
+                    self._drop_probe_transport(presumed_dead)
+                    self._probe_stub(presumed_dead, "BrokerStatus",
+                                     pb.ListTopicsRequest, pb.TxnReply)(
+                        pb.ListTopicsRequest(),
+                        timeout=max(0.2, 0.75 * self._vote_timeout_s))
+                    return answer(False, "leader-alive", leader_alive=True,
+                                  hint=presumed_dead)
+                except Exception:  # noqa: BLE001 — unreachable from here too
+                    self._drop_probe_transport(presumed_dead)
+        with self._role_lock:
+            already = self._voted.get(cand_epoch)
+            if already is not None and already != candidate:
+                return answer(False, "already-voted")  # raced another grant
+            self._voted[cand_epoch] = candidate
+            self._max_vote_epoch = max(self._max_vote_epoch, cand_epoch)
+            self._persist_meta("vote", {"e": cand_epoch, "c": candidate})
+            # our vote promised the candidate this epoch: hold our own
+            # candidacy down long enough for its promotion (its first ship
+            # repoints us much sooner)
+            self._stand_down_until = time.monotonic() + max(
+                2.0, self._vote_timeout_s * self._vote_rounds)
+        self.broker_metrics.quorum_votes_granted.record()
+        return answer(True, "granted")
+
     def ArmFaults(self, request: pb.TxnRequest, context) -> pb.TxnReply:
         """Runtime fault-plane arming (the chaos CLI's RPC): op "arm" with a
         named plan or JSON rule list in records[0].value, "disarm", or
@@ -2052,6 +2528,15 @@ class LogServer:
             elif request.op == "disarm":
                 if self.faults is not None:
                     self.faults.disarm()
+            elif request.op == "kill":
+                # remote hard-stop (chaos CLI `cluster --kill`): same crash
+                # semantics as a fault-plane kill — socket closes NOW, this
+                # very reply races the shutdown (the caller treats
+                # UNAVAILABLE as success)
+                self.kill()
+                return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+                    has_key=True, key="faults", has_value=True,
+                    value=b'{"killed": true}')])
             elif request.op != "status":
                 return pb.TxnReply(ok=False, error_kind="state",
                                    error=f"unknown op {request.op!r}")
@@ -2247,9 +2732,8 @@ class LogServer:
                 for p in range(spec_msg.partitions or 1):
                     while True:  # page: one unbounded Read would blow the gRPC
                         start = self._applied_end(spec_msg.name, p)
-                        records = leader.read(spec_msg.name, p,
-                                              from_offset=start,
-                                              max_records=1000)
+                        records = self._pull_page(leader, spec_msg.name, p,
+                                                  start)
                         if not records:
                             break
                         with self._replica_lock:
@@ -2277,11 +2761,345 @@ class LogServer:
             leader.close()
         return copied
 
+    def _pull_page(self, leader, topic: str, p: int, start: int) -> list:
+        """One catch_up page: the FetchSlice bulk lane first (ONE RPC hands
+        back a block-encoded CRC-checked slice of up to 2000 records — the
+        standby resume path, paying the block codec instead of per-record
+        protobuf), degrading permanently to paged Read against a broker
+        without the RPC."""
+        if self._catchup_slices:
+            from surge_tpu.store.checkpoint import decode_partition_slice
+
+            try:
+                req = pb.ReadRequest(topic=topic, partition=p,
+                                     from_offset=start, has_max=True,
+                                     max_records=2000)
+                reply = leader._calls["FetchSlice"](req, timeout=10.0)
+                if reply.ok and reply.records:
+                    _header, records = decode_partition_slice(
+                        bytes(reply.records[0].value))
+                    return records
+                if not reply.ok:
+                    # the broker HAS the RPC but this page failed (a racing
+                    # compaction, a transient read error): page via Read and
+                    # keep the bulk lane for the next page
+                    logger.info("FetchSlice %s[%d]@%d refused by %s (%s); "
+                                "paging via Read", topic, p, start,
+                                leader.target, reply.error)
+            except grpc.RpcError as exc:
+                if exc.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    # an older broker without the RPC: every page would fail
+                    # the same way — degrade permanently
+                    logger.info("FetchSlice unsupported by %s; catch_up "
+                                "falls back to paged Read permanently",
+                                leader.target)
+                    self._catchup_slices = False
+                else:
+                    # DEADLINE_EXCEEDED / UNAVAILABLE etc.: this page over
+                    # this link, not the lane — Read pages it, the next page
+                    # tries the slice lane again
+                    logger.info("FetchSlice %s[%d]@%d failed transiently "
+                                "(%s); paging via Read", topic, p, start,
+                                exc.code())
+            except Exception:  # noqa: BLE001 — codec mismatch
+                logger.info("FetchSlice slice from %s undecodable; catch_up "
+                            "falls back to paged Read permanently",
+                            leader.target)
+                self._catchup_slices = False
+        return list(leader.read(topic, p, from_offset=start,
+                                max_records=1000))
+
+    # -- partition slices & live handoff --------------------------------------------------
+
+    def FetchSlice(self, request: pb.ReadRequest, context) -> pb.TxnReply:
+        """Standby bulk pull: one checkpoint-codec partition slice (the
+        segment block codec — CRC-checked pages, leader-assigned offsets
+        preserved) from ``from_offset``, at most ``max_records`` records.
+        This is a replication-plane RPC, NOT a consumer read: it serves the
+        APPLIED frontier ungated (a standby must mirror records the quorum
+        has not acked yet, exactly like the Replicate stream)."""
+        from surge_tpu.store.checkpoint import encode_partition_slice
+
+        try:
+            cap = request.max_records if request.has_max else 2000
+            recs = self.log.read(request.topic, request.partition,
+                                 from_offset=request.from_offset,
+                                 max_records=cap)
+            data = encode_partition_slice(list(recs), request.topic,
+                                          request.partition,
+                                          base=request.from_offset)
+            return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+                topic=request.topic, partition=request.partition,
+                has_key=True, key="slice", has_value=True, value=data)])
+        except Exception as exc:  # noqa: BLE001 — puller gets it back
+            logger.exception("FetchSlice failed")
+            return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
+
+    def InstallSlice(self, request: pb.TxnRequest, context) -> pb.TxnReply:
+        """Handoff bulk push: verbatim-ingest one partition slice. Refused on
+        a leader (ingesting foreign offsets there would fork the log — the
+        same reason followers refuse producer opens, inverted) and on gaps:
+        a slice must start at or below this replica's applied end (holes
+        INSIDE it are legitimate compaction gaps; records already held are
+        idempotent-skipped). Topics must exist first — the shipper creates
+        them with the right partition count via CreateTopic."""
+        from surge_tpu.store.checkpoint import decode_partition_slice
+
+        if self.role == "leader":
+            return pb.TxnReply(ok=False, error_kind="state",
+                               error="a leader does not ingest slices")
+        try:
+            header, records = decode_partition_slice(
+                bytes(request.records[0].value))
+            topic, p = header["topic"], int(header["partition"])
+            spec = getattr(self.log, "_topics", {}).get(topic)
+            if spec is None:
+                return pb.TxnReply(
+                    ok=False, error_kind="state",
+                    error=f"unknown topic {topic!r}: CreateTopic first "
+                          "(auto-create would guess the partition count)")
+            with self._replica_lock:
+                end = self._applied_end(topic, p)
+                to_apply = [r for r in records if r.offset >= end]
+                # the slice's read base anchors the gap check: a head hole in
+                # [base, first record) is a compaction gap the SOURCE vouches
+                # for (it read from base and found nothing below the first
+                # record) — only a slice whose whole extent starts above our
+                # end hides genuinely missing records
+                base = int(header.get("base",
+                                      records[0].offset if records else 0))
+                if to_apply and base > end and not any(
+                        r.offset <= end for r in records):
+                    return pb.TxnReply(
+                        ok=False, error_kind="state",
+                        error=f"gap: slice base {base} (first record "
+                              f"{to_apply[0].offset}) but replica end is "
+                              f"{end}")
+                if to_apply:
+                    self._append_replica(to_apply, allow_gaps=True)
+            return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+                topic=topic, partition=p, has_key=True, key="installed",
+                has_value=True,
+                value=str(len(to_apply)).encode())])
+        except Exception as exc:  # noqa: BLE001 — shipper gets it back
+            logger.exception("InstallSlice failed")
+            return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
+
+    def _ship_slices_to(self, target: str, page: int = 2000) -> int:
+        """Push every record ``target`` lacks as checkpoint-codec slices
+        (InstallSlice), topic specs first — the bulk lane of standby sync
+        and handoff. Returns records shipped. Raises on a refused install
+        (the caller owns retry/abort policy)."""
+        shipped = 0
+        install = self._probe_stub(target, "InstallSlice", pb.TxnRequest,
+                                   pb.TxnReply)
+        create = self._probe_stub(target, "CreateTopic",
+                                  pb.CreateTopicRequest, pb.TopicReply)
+        from surge_tpu.store.checkpoint import encode_partition_slice
+
+        for spec in self._topic_specs():
+            if spec.name in INTERNAL_TOPICS:
+                continue  # self-maintained per side (see _resync_follower)
+            create(pb.CreateTopicRequest(spec=pb.TopicSpecMsg(
+                name=spec.name, partitions=spec.partitions,
+                compacted=spec.compacted)), timeout=2.0)
+            for p in range(spec.partitions or 1):
+                # bounded passes, not while-True: under sustained append a
+                # moving frontier must not pin the bulk phase forever — the
+                # fenced tail pass finishes whatever is left
+                for _pass in range(1000):
+                    theirs = self._remote_end_offset(target, spec.name, p)
+                    ours = self._applied_end(spec.name, p)
+                    if theirs >= ours:
+                        break
+                    batch = list(self.log.read(spec.name, p,
+                                               from_offset=theirs,
+                                               max_records=page))
+                    if not batch:
+                        break  # compacted hole at the tail
+                    # base=theirs: a head hole in [theirs, batch[0]) is a
+                    # compaction gap this read vouches for — the installer
+                    # may ingest past it (state topics ARE compacted)
+                    data = encode_partition_slice(batch, spec.name, p,
+                                                  base=theirs)
+                    reply = install(pb.TxnRequest(
+                        op="install", records=[pb.RecordMsg(
+                            topic=spec.name, partition=p, has_key=True,
+                            key="slice", has_value=True, value=data)]),
+                        timeout=self._repl_ack_timeout_s)
+                    if not reply.ok:
+                        raise RuntimeError(
+                            f"InstallSlice {spec.name}[{p}] on {target} "
+                            f"refused: {reply.error}")
+                    shipped += len(batch)
+        if shipped:
+            self.broker_metrics.handoff_shipped_records.record(shipped)
+        return shipped
+
+    def HandoffPartition(self, request: pb.TxnRequest, context) -> pb.TxnReply:
+        """Planned leadership transfer (admin RPC): move this leader's role
+        to ``{"to": target}`` deliberately — bulk slice ship (unfenced:
+        clients keep committing), fence + drain, journal-tail slice ship,
+        dedup push, promote the destination (which fences us at the handoff
+        epoch), demote in place. Planned unavailability is the FENCED span —
+        bounded by the tail appended during the bulk phase, never by log
+        size."""
+        import json as _json
+
+        obj = {}
+        if request.records and request.records[0].has_value:
+            try:
+                obj = _json.loads(request.records[0].value or b"{}")
+            except ValueError:
+                pass
+        to = str(obj.get("to", ""))
+        if not to:
+            return pb.TxnReply(ok=False, error_kind="state",
+                               error='HandoffPartition needs {"to": target}')
+        with self._role_lock:
+            if self.role != "leader":
+                return pb.TxnReply(ok=False, error_kind="not_leader",
+                                   error=f"broker is a {self.role}",
+                                   leader_hint=self.leader_hint)
+            if self._handoff_active or self._handoff_fence:
+                return pb.TxnReply(ok=False, error_kind="state",
+                                   error="a handoff is already in progress")
+            # claim INSIDE the role lock: a second HandoffPartition arriving
+            # during the (long, unfenced) bulk phase must refuse here — two
+            # overlapping handoffs would race their fences and epochs
+            self._handoff_active = True
+        try:
+            stats = self._handoff_to(to)
+            return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+                has_key=True, key="handoff", has_value=True,
+                value=_json.dumps(stats).encode())])
+        except Exception as exc:  # noqa: BLE001 — operator gets it back
+            logger.exception("handoff to %s failed", to)
+            return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
+        finally:
+            with self._role_lock:
+                self._handoff_active = False
+
+    def _handoff_to(self, to: str) -> dict:
+        me = self._my_target()
+        stats: dict = {"from": me, "to": to}
+        self.flight.record("handoff.start", to=to)
+        # phase 1: BULK — unfenced; the destination converges to within the
+        # live append rate while clients keep committing
+        t0 = time.perf_counter()
+        stats["bulk_records"] = self._ship_slices_to(to)
+        stats["bulk_ms"] = round((time.perf_counter() - t0) * 1000.0, 2)
+        # phase 2: FENCE — stop intake (Transact/OpenProducer answer
+        # not_leader with an EMPTY hint: clients hold in place), drain
+        # in-flight commits and the replication queue so the log is stable
+        fence_t0 = time.perf_counter()
+        with self._role_lock:
+            self._handoff_fence = True
+        self.flight.record("handoff.fence", to=to)
+        try:
+            deadline = time.monotonic() + 2.0 * self._repl_ack_timeout_s
+            while time.monotonic() < deadline:
+                with self._role_lock:
+                    inflight = self._inflight_txn
+                with self._repl_cv:
+                    # quorum-FINALIZED is the drain bar, not queue-empty:
+                    # under min-insync-acks a slow in-sync follower pins
+                    # finalized items in the queue until its cursor passes
+                    # them, and the tail slice ship reads the log directly —
+                    # undelivered ships to OTHER followers don't matter
+                    undone = sum(1 for i in self._repl_queue
+                                 if not i.done.is_set())
+                if inflight == 0 and undone == 0:
+                    break
+                time.sleep(0.01)
+            else:
+                raise RuntimeError(
+                    "handoff drain timed out (in-flight commits or "
+                    "unfinalized replication items never quiesced)")
+            # phase 3: TAIL — everything appended since the bulk pass (the
+            # journal tail; this, not log size, bounds the fenced span)
+            stats["tail_records"] = self._ship_slices_to(to)
+            # phase 4: dedup push — the destination answers in-flight seq
+            # replays from cache, exactly-once across the handoff
+            err = self._push_dedup_to(to)
+            if err is not None:
+                raise RuntimeError(f"dedup push refused: {err}")
+            if self.faults is not None:
+                self.faults.crash_point("handoff.pre-promote")
+            # phase 5: promote the destination — it fences us at the handoff
+            # epoch; every other peer repoints off its first ship
+            reply = self._probe_stub(to, "PromoteFollower", pb.TxnRequest,
+                                     pb.TxnReply)(
+                pb.TxnRequest(op="promote"),
+                timeout=2.0 * self._repl_ack_timeout_s)
+            if not reply.ok:
+                raise RuntimeError(f"destination refused promotion: "
+                                   f"{reply.error}")
+            import json as _json
+
+            status = _json.loads(reply.records[0].value)
+            new_epoch = int(status.get("epoch", 0))
+            stats["epoch"] = new_epoch
+            if self.faults is not None:
+                self.faults.crash_point("handoff.post-promote")
+            # phase 6: demote in place (truncation is a no-op — everything
+            # shipped pre-promotion; catch_up pulls the nothing we lack)
+            self._demote(new_epoch, to)
+        finally:
+            with self._role_lock:
+                self._handoff_fence = False
+        fence_ms = round((time.perf_counter() - fence_t0) * 1000.0, 2)
+        stats["fence_ms"] = fence_ms
+        self.broker_metrics.handoff_fence_timer.record_ms(fence_ms)
+        self.flight.record("handoff.done", **{k: v for k, v in stats.items()
+                                              if k != "from"})
+        logger.warning("handoff to %s complete: %s", to, stats)
+        return stats
+
+    def _adopt_shipped_hwm(self, high_watermarks: str) -> None:
+        """Follower half of the high-watermark protocol: every Replicate
+        (data, rejoin probe, or post-finalize beacon) carries the leader's
+        quorum-acked frontier — adopt it monotonically. The gate may run
+        AHEAD of this replica's applied end harmlessly (reads only ever see
+        applied records); it must never run backwards, or a record already
+        served to a consumer would turn invisible."""
+        if not high_watermarks:
+            return
+        import json as _json
+
+        try:
+            shipped = _json.loads(high_watermarks)
+        except ValueError:
+            return
+        for key, off in shipped.items():
+            topic, _, p = key.rpartition("|")
+            tp = (topic, int(p))
+            if int(off) > self._hwm.get(tp, 0):
+                self._hwm[tp] = int(off)
+                self._hwm_wire = None  # this replica may promote and ship
+
+    def _read_gate(self, topic: str, partition: int) -> Optional[int]:
+        """The follower-served read ceiling for one partition: the shipped
+        high-watermark, or None when this partition is ungated (leader
+        reads; a follower that never received a hwm ship keeps the PR-4
+        serve-everything behavior — legacy pairs, operator catch_up
+        replicas)."""
+        if self.role == "leader":
+            return None
+        return self._hwm.get((topic, partition))
+
     def Read(self, request: pb.ReadRequest, context) -> pb.ReadReply:
         max_records = request.max_records if request.has_max else None
         recs = self.log.read(request.topic, request.partition,
                              from_offset=request.from_offset,
                              max_records=max_records)
+        gate = self._read_gate(request.topic, request.partition)
+        if gate is not None and recs and recs[-1].offset >= gate:
+            # hwm gate: records applied here but not provably quorum-held
+            # stay invisible — like records of an open transaction. A
+            # failover that truncates them can then never un-serve a read.
+            recs = [r for r in recs if r.offset < gate]
+            self.broker_metrics.hwm_gated_reads.record()
         return pb.ReadReply(records=[record_to_msg(r) for r in recs])
 
     def EndOffset(self, request: pb.OffsetRequest, context) -> pb.OffsetReply:
@@ -2291,19 +3109,38 @@ class LogServer:
         # the topic at the wrong partitioning and the later resync ship's
         # create-if-missing would skip it — a silently mis-partitioned
         # replica. Unknown topic/partition simply holds nothing: offset 0.
+        # end_offset stays the APPLIED frontier (the leader's gap checks and
+        # lag scans measure against it); high_watermark reports the
+        # quorum-acked frontier alongside — what follower-served
+        # read_committed reads are gated on.
         known = getattr(self.log, "_topics", None)
         if known is not None:
             spec = known.get(request.topic)
             if spec is None or request.partition >= spec.partitions:
                 return pb.OffsetReply(end_offset=0)
-        return pb.OffsetReply(
-            end_offset=self.log.end_offset(request.topic, request.partition))
+        end = self.log.end_offset(request.topic, request.partition)
+        gate = self._read_gate(request.topic, request.partition)
+        if gate is None:
+            hwm = self._hwm.get((request.topic, request.partition))
+            # an ungated partition serves everything it has applied; a
+            # replicating leader reports its live quorum frontier
+            gate = end if hwm is None else hwm
+        return pb.OffsetReply(end_offset=end, high_watermark=min(gate, end))
 
     def LatestByKey(self, request: pb.OffsetRequest,
                     context) -> pb.LatestByKeyReply:
         latest = self.log.latest_by_key(request.topic, request.partition)
+        recs = list(latest.values())
+        gate = self._read_gate(request.topic, request.partition)
+        if gate is not None and any(r.offset >= gate for r in recs):
+            # same hwm gate as Read: a key whose newest version is not
+            # provably quorum-held stays invisible (an older below-gate
+            # version may already be compacted away — hiding the key beats
+            # serving a record a failover could erase)
+            recs = [r for r in recs if r.offset < gate]
+            self.broker_metrics.hwm_gated_reads.record()
         return pb.LatestByKeyReply(records=[record_to_msg(r)
-                                            for r in latest.values()])
+                                            for r in recs])
 
     def CompactTopic(self, request: pb.ReadRequest, context) -> pb.TxnReply:
         """Compact one partition of a compacted topic broker-side (the
@@ -2493,7 +3330,21 @@ class LogServer:
             # receiving a batch
             with self._role_lock:
                 self._adopt_leader_epoch()
-        if self._auto_promote and self._leader_prober is None:
+        self._ensure_prober()
+        return self.bound_port
+
+    def _ensure_prober(self) -> None:
+        """Aim the leader-liveness prober at the CURRENT leader (start(),
+        demotion, and cluster repoints all land here): started fresh when
+        missing, retargeted — fresh failure streak, bootstrap grace
+        re-applied — when the leader moved. No-op on leaders, on brokers
+        without auto-promotion, and on dead brokers."""
+        if not self._auto_promote or self._dead:
+            return
+        if self.role != "follower" or not self._follower_of:
+            return
+        prober = self._leader_prober
+        if prober is None:
             from surge_tpu.health.prober import BrokerLivenessProber
 
             def _ping() -> None:
@@ -2503,11 +3354,26 @@ class LogServer:
                 self._follower_of, _ping, config=self._config,
                 on_dead=self._on_leader_dead, flight=self.flight)
             self._leader_prober.start()
-        return self.bound_port
+        elif prober.target != self._follower_of:
+            prober.retarget(self._follower_of)
 
     def _on_leader_dead(self) -> None:
-        """The liveness prober declared the leader dead: self-promote."""
-        if self.role == "leader" or self._dead:
+        """The liveness prober declared the leader dead: campaign for a
+        cluster majority when quorum peers are configured (one prober's
+        liveness view alone can no longer mint a leader), else the PR-4
+        pairwise self-promotion."""
+        if self.role == "leader" or self._dead or self._closed:
+            return
+        if self._quorum_peers:
+            logger.error("leader %s declared dead by the liveness prober; "
+                         "campaigning for a cluster majority",
+                         self._follower_of)
+            try:
+                self._campaign_for_leadership()
+            except Exception:  # noqa: BLE001 — stay follower, re-arm prober
+                logger.exception("leadership campaign failed")
+                if self._leader_prober is not None:
+                    self._leader_prober.reset()
             return
         logger.error("leader %s declared dead by the liveness prober; "
                      "auto-promoting", self._follower_of)
@@ -2516,7 +3382,120 @@ class LogServer:
         except Exception:  # noqa: BLE001 — stay follower, prober keeps going
             logger.exception("auto-promotion failed")
 
+    def _campaign_for_leadership(self) -> bool:
+        """Majority-vote promotion rounds (the Raft-flavored layer over the
+        KIP-101 epoch fence): mint a candidate epoch above every epoch this
+        broker has seen OR campaigned, ask every quorum peer for its vote
+        (each peer re-checks leader liveness from ITS vantage), and promote
+        only on a strict cluster majority — self-vote included. Losing every
+        round stands the candidacy down and re-arms the prober: the leader
+        may yet return, or the true winner's first ship repoints us. Returns
+        True when this broker promoted."""
+        import json as _json
+
+        me = self._my_target()
+        others = self._quorum_others()
+        cluster = len(others) + 1
+        needed = cluster // 2 + 1
+        backoff = 0.05
+        for rnd in range(self._vote_rounds):
+            if self._dead or self._closed or self.role == "leader":
+                return self.role == "leader"
+            stand_down = self._stand_down_until - time.monotonic()
+            if stand_down > 0:
+                # we just granted a peer this round: give its promotion the
+                # head start our vote promised it
+                time.sleep(min(stand_down, 1.0))
+                continue
+            with self._role_lock:
+                epoch = max(self.epoch, self._max_vote_epoch, 1) + 1
+                self._max_vote_epoch = epoch
+                self._voted[epoch] = me  # self-vote: our one vote this epoch
+                self._persist_meta("vote", {"e": epoch, "c": me})
+            grants, alive_hint = 1, None
+            self.flight.record("quorum.campaign", epoch=epoch, round=rnd,
+                               needed=needed, cluster=cluster)
+            request = pb.TxnRequest(op="vote", txn_seq=epoch, records=[
+                pb.RecordMsg(has_value=True, value=_json.dumps(
+                    {"candidate": me,
+                     "leader": self._follower_of or "",
+                     # the up-to-date check's evidence (Raft §5.4.1 role):
+                     # a voter holding MORE log than this denies — any
+                     # majority then contains a holder of every
+                     # quorum-acked commit, so the winner has them all
+                     "ends": self._applied_ends()}).encode())])
+            for peer in others:
+                if grants >= needed:
+                    continue
+                try:
+                    reply = self._probe_stub(
+                        peer, "VoteLeader", pb.TxnRequest, pb.TxnReply)(
+                        request, timeout=self._vote_timeout_s)
+                except Exception:  # noqa: BLE001 — dead peer grants nothing
+                    self._drop_probe_transport(peer)
+                    continue
+                if not reply.ok or not reply.records:
+                    continue
+                verdict = _json.loads(reply.records[0].value or b"{}")
+                if verdict.get("granted"):
+                    grants += 1
+                    continue
+                peer_epoch = int(verdict.get("epoch", 0))
+                if peer_epoch > self._max_vote_epoch:
+                    # a peer has seen further: campaign above it next round
+                    self._max_vote_epoch = peer_epoch
+                if verdict.get("leader_alive"):
+                    alive_hint = verdict.get("leader_hint") or peer
+            if alive_hint is not None:
+                # a peer can still reach the leader (or IS a live leader):
+                # our link is what died, not the leader — stand down and
+                # keep probing instead of splitting the brain
+                self.broker_metrics.quorum_stand_downs.record()
+                self.flight.record("quorum.stand-down", epoch=epoch,
+                                   reason="leader-alive", via=alive_hint)
+                logger.warning(
+                    "campaign for epoch %d stood down: a quorum peer still "
+                    "reaches the leader (via %s)", epoch, alive_hint)
+                if self._leader_prober is not None:
+                    self._leader_prober.reset()
+                return False
+            if grants >= needed:
+                if self._dead or self._closed:
+                    # stop()/kill() landed mid-round: a majority collected
+                    # for a broker that no longer serves must not promote
+                    return False
+                self.broker_metrics.quorum_elections_won.record()
+                self.flight.record("quorum.win", epoch=epoch, grants=grants,
+                                   needed=needed, cluster=cluster)
+                logger.warning("campaign WON epoch %d with %d/%d votes; "
+                               "promoting", epoch, grants, cluster)
+                self.promote(at_epoch=epoch)
+                return True
+            self.flight.record("quorum.no-majority", epoch=epoch,
+                               grants=grants, needed=needed)
+            time.sleep(self._jittered_backoff(backoff))
+            backoff = min(backoff * 2, 0.5)
+        self.broker_metrics.quorum_stand_downs.record()
+        self.flight.record("quorum.stand-down", reason="no-majority",
+                           rounds=self._vote_rounds)
+        logger.error("no majority after %d campaign rounds; standing down "
+                     "(prober re-armed)", self._vote_rounds)
+        if self._leader_prober is not None:
+            self._leader_prober.reset()
+        return False
+
+    def _jittered_backoff(self, backoff: float) -> float:
+        """Randomized sleep in [backoff/2, backoff): two candidates whose
+        campaigns split the vote must not retry in lockstep forever."""
+        import random
+
+        return backoff * (0.5 + 0.5 * random.random())
+
     def stop(self, grace: float = 1.0) -> None:
+        # a campaign already running on the prober thread checks this flag
+        # every round (and before promoting): a STOPPED broker must not win
+        # an election and repoint the cluster at its closed socket
+        self._closed = True
         self._stop_metrics_server()
         if self._leader_prober is not None:
             self._leader_prober.stop()

@@ -55,6 +55,8 @@ class FaultInjector(Protocol):
 
     def crash_point(self, name: str) -> None: ...
 
+    def raise_point(self, site: str) -> None: ...
+
 
 def load_fault_plane(config) -> Optional[FaultInjector]:
     """Build the configured fault plane (``surge.log.faults.plan``), lazily

@@ -14,6 +14,12 @@ LogServer`:
   journal rotations, WAL bytes;
 - ``surge.log.txn.*`` — in-order gate wait, dedup/alias window occupancy,
   pipelined window depth;
+- ``surge.log.quorum.*`` — the majority-vote promotion layer: VoteLeader
+  requests answered/granted, elections won, campaigns stood down;
+- ``surge.log.hwm.*`` — the per-partition high-watermark (quorum-acked
+  frontier): applied-vs-hwm lag, follower reads clamped by the gate;
+- ``surge.log.handoff.*`` — planned leadership transfer: fence duration,
+  records shipped as checkpoint-codec slices;
 - plus the ``surge.log.failover.*`` / ``surge.log.faults.*`` counters (same
   names as the engine quiver's) so a standalone broker's scrape carries its
   own promotion/fencing/truncation counts.
@@ -59,6 +65,17 @@ class BrokerMetrics:
     txn_dedup_window: Sensor = field(init=False)
     txn_alias_window: Sensor = field(init=False)
     txn_pipelined_depth: Sensor = field(init=False)
+    # majority-quorum promotion (vote layer)
+    quorum_vote_requests: Sensor = field(init=False)
+    quorum_votes_granted: Sensor = field(init=False)
+    quorum_elections_won: Sensor = field(init=False)
+    quorum_stand_downs: Sensor = field(init=False)
+    # per-partition high-watermark (quorum-acked frontier)
+    hwm_lag_records: Sensor = field(init=False)
+    hwm_gated_reads: Sensor = field(init=False)
+    # planned partition handoff
+    handoff_fence_timer: Timer = field(init=False)
+    handoff_shipped_records: Sensor = field(init=False)
     # failover + fault-plane counters (shared names with EngineMetrics so a
     # broker without an engine-wired quiver still counts them — the LogServer
     # falls back to this quiver when metrics= is not given)
@@ -127,6 +144,42 @@ class BrokerMetrics:
             "how far past the acked frontier the last arriving txn_seq ran "
             "(the live pipelined window depth, bounded by "
             "surge.producer.max-in-flight)"))
+        self.quorum_vote_requests = m.counter(MI(
+            "surge.log.quorum.vote-requests",
+            "VoteLeader RPCs answered by this broker (each candidate's "
+            "campaign asks every peer once per epoch)"))
+        self.quorum_votes_granted = m.counter(MI(
+            "surge.log.quorum.votes-granted",
+            "VoteLeader requests this broker granted (one vote per epoch, "
+            "persisted — a bounced voter cannot double-vote)"))
+        self.quorum_elections_won = m.counter(MI(
+            "surge.log.quorum.elections-won",
+            "campaigns this broker won with a strict cluster majority "
+            "(each win is followed by a promotion)"))
+        self.quorum_stand_downs = m.counter(MI(
+            "surge.log.quorum.stand-downs",
+            "campaigns abandoned without a majority (voters unreachable, "
+            "leader proven alive from a peer's vantage, or a higher epoch "
+            "seen) — the split-brain window the vote layer closes"))
+        self.hwm_lag_records = m.gauge(MI(
+            "surge.log.hwm.lag-records",
+            "applied-frontier minus high-watermark across the partitions "
+            "the last finalized batch touched (records applied on the "
+            "leader but not yet quorum-acked)"))
+        self.hwm_gated_reads = m.counter(MI(
+            "surge.log.hwm.gated-reads",
+            "follower-served reads clamped by the shipped high-watermark "
+            "(records applied locally but not provably quorum-held stayed "
+            "invisible)"))
+        self.handoff_fence_timer = m.timer(MI(
+            "surge.log.handoff.fence-timer",
+            "ms the handoff fence was up per planned leadership transfer "
+            "(drain + journal-tail ship + dedup push + promote — the "
+            "client-visible unavailability bound)"))
+        self.handoff_shipped_records = m.counter(MI(
+            "surge.log.handoff.shipped-records",
+            "records shipped to handoff destinations as checkpoint-codec "
+            "partition slices (bulk phase + fenced tail)"))
         self.failover_promotions = m.counter(MI(
             "surge.log.failover.promotions",
             "follower-to-leader promotions performed by this broker"))

@@ -10,10 +10,11 @@ per-broker dumps into one ordered story.
 
 from surge_tpu.observability.flight import (
     FlightRecorder,
+    host_wall_offset,
     merge_dumps,
     reconstruct_failover,
     same_clock_domain,
 )
 
 __all__ = ["FlightRecorder", "merge_dumps", "reconstruct_failover",
-           "same_clock_domain"]
+           "same_clock_domain", "host_wall_offset"]

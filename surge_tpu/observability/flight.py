@@ -31,7 +31,7 @@ from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["FlightRecorder", "merge_dumps", "reconstruct_failover",
-           "same_clock_domain"]
+           "same_clock_domain", "host_wall_offset"]
 
 
 def same_clock_domain(dumps: Sequence[dict]) -> bool:
@@ -87,9 +87,14 @@ class FlightRecorder:
         return out
 
     def dump(self, last: Optional[int] = None) -> dict:
-        """The merge-ready dump envelope: events + clock-domain identity."""
+        """The merge-ready dump envelope: events + clock-domain identity.
+        ``dumped_mono``/``dumped_wall`` pair the host's two clocks at ONE
+        instant — the header :func:`merge_dumps` estimates the per-host
+        mono↔wall offset from, so cross-host merges survive wall-clock skew
+        during the incident."""
         return {"recorder": self.name, "node": self.node, "pid": os.getpid(),
-                "dumped_wall": time.time(), "events": self.events(last)}
+                "dumped_wall": time.time(), "dumped_mono": time.monotonic(),
+                "events": self.events(last)}
 
     def dump_to(self, path: str, last: Optional[int] = None) -> None:
         """Write the dump as JSON (the crash auto-dump sink). Best-effort:
@@ -101,25 +106,51 @@ class FlightRecorder:
             pass
 
 
+def host_wall_offset(dump: dict) -> Optional[float]:
+    """The per-host mono→wall offset estimated from the dump HEADER: the
+    recorder stamps both clocks at the same instant when dumping, so
+    ``dumped_wall - dumped_mono`` maps any of this host's monotonic stamps
+    onto its wall timeline AS OF DUMP TIME. Placing events at
+    ``offset + ev.mono`` instead of their raw ``wall`` stamp makes the
+    cross-host merge immune to wall steps/skew DURING the incident (the NTP
+    correction that lands mid-failover and would otherwise scramble raw wall
+    ordering) — only the residual skew between hosts at dump time remains.
+    None for a legacy dump without the header pair (raw wall fallback)."""
+    dw, dm = dump.get("dumped_wall"), dump.get("dumped_mono")
+    if dw is None or dm is None:
+        return None
+    return float(dw) - float(dm)
+
+
 def merge_dumps(dumps: Sequence[dict]) -> List[dict]:
     """Merge several brokers' dumps into one ordered timeline.
 
     Each returned event gains ``recorder`` (who recorded it). Ordering: by
     ``mono`` when every dump came from the same host (CLOCK_MONOTONIC is
     host-shared, comparable across the brokers' processes and immune to NTP
-    steps), else by ``wall``; ties break by wall then per-recorder seq."""
+    steps); across hosts by the ESTIMATED wall time ``host_wall_offset(dump)
+    + ev.mono`` (per-host mono↔wall offsets from the dump headers — wall
+    steps during the incident cannot scramble the order), falling back to
+    each event's raw ``wall`` stamp only for legacy dumps without the header
+    pair. Ties break by wall then per-recorder seq."""
     merged: List[dict] = []
     same_clock = same_clock_domain(dumps)
     for d in dumps:
         who = d.get("recorder") or d.get("node") or "?"
+        offset = host_wall_offset(d)
         for ev in d.get("events", ()):
             e = dict(ev)
             e["recorder"] = who
+            e["_est_wall"] = (offset + e.get("mono", 0.0)
+                              if offset is not None else e.get("wall", 0.0))
             merged.append(e)
     key = ((lambda e: (e.get("mono", 0.0), e.get("wall", 0.0), e.get("seq", 0)))
            if same_clock else
-           (lambda e: (e.get("wall", 0.0), e.get("seq", 0))))
+           (lambda e: (e.get("_est_wall", 0.0), e.get("wall", 0.0),
+                       e.get("seq", 0))))
     merged.sort(key=key)
+    for e in merged:
+        del e["_est_wall"]
     return merged
 
 

@@ -27,8 +27,11 @@ match sites by ``fnmatch`` pattern:
   line's bytes are written, then :class:`SimulatedCrash` raises (recovery
   must discard the torn tail).
 - ``crash.<point>`` — named crash points (``crash.transact.post-apply``,
-  ``crash.repl.pre-ship`` …): the broker hard-stops (socket closes, in-flight
-  calls answer UNAVAILABLE) exactly there.
+  ``crash.repl.pre-ship``, the handoff's ``crash.handoff.pre-promote`` /
+  ``crash.handoff.post-promote`` …): the broker hard-stops (socket closes,
+  in-flight calls answer UNAVAILABLE) exactly there. Cluster-scale RPC sites
+  ride the same ``rpc.*`` pattern (``rpc.VoteLeader`` drops starve a quorum;
+  ``rpc.InstallSlice`` drops stall a handoff's bulk phase).
 
 **Determinism.** One seeded :class:`random.Random` drives every probability
 draw and reorder hold, in call order, under a lock — the same seed against
@@ -121,6 +124,16 @@ NAMED_PLANS: Dict[str, Callable[[], List[FaultRule]]] = {
     # tear the next journal write mid-line and crash
     "torn-journal": lambda: [
         FaultRule(site="journal.write", action="torn", fraction=0.5)],
+    # cluster-scale: drop every VoteLeader RPC this broker receives — a
+    # candidate that cannot reach this voter must fail its majority and
+    # stand down instead of promoting on its own liveness view
+    "vote-blackhole": lambda: [
+        FaultRule(site="rpc.VoteLeader", action="drop", times=None)],
+    # kill the old leader mid-handoff, AFTER the journal tail shipped but
+    # BEFORE the destination promoted: the handoff must fail cleanly (no
+    # second leader minted) and the normal kill-failover path takes over
+    "handoff-crash-pre-promote": lambda: [
+        FaultRule(site="crash.handoff.pre-promote", action="crash")],
 }
 
 

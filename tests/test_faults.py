@@ -172,6 +172,71 @@ def test_rotation_bounds_journal_and_survives_restart(tmp_path):
     relog.close()
 
 
+def test_forced_rotation_bounds_never_idle_leader_and_survives_crash(tmp_path):
+    """A never-idle leader defeats the opportunistic quiesce check — a tight
+    pipelined-commit loop keeps a fresh journal line in flight across every
+    sync round, so the quiesced rotation never fires and the WAL would grow
+    without bound. Past twice the threshold the size-forced barrier must
+    rotate anyway (taking the log lock to MAKE the quiesced invariant true),
+    and crashing right after a forced rotation must recover every record on
+    both sides of the forced boundary."""
+    from surge_tpu.observability import FlightRecorder
+
+    root = str(tmp_path / "log")
+    rotate = 4096
+    flog = FileLog(root, fsync="commit", journal_rotate_bytes=rotate)
+    flog.flight = FlightRecorder(name="b1", capacity=512)
+    flog.create_topic(TopicSpec("ev", 1))
+    prod = flog.transactional_producer("t")
+    payload = os.urandom(700)
+
+    def rotations():
+        return [e for e in flog.flight.events()
+                if e["type"] == "journal.rotate"]
+
+    handles = []
+    drained = 0
+    n = 0
+    max_seen = 0
+    deadline = time.time() + 30.0
+    while not any(e.get("forced") for e in rotations()):
+        assert time.time() < deadline, "forced rotation never fired"
+        prod.begin()
+        prod.send(LogRecord(topic="ev", key=f"k{n}", value=payload))
+        handles.append(prod.commit_pipelined())
+        n += 1
+        # a real publisher lane: bounded in-flight window, refilled the
+        # moment the round resolves the oldest — so every sync round ends
+        # with fresh lines already pending and the quiesce check keeps
+        # failing, without the unthrottled loop starving the gc worker
+        if n - drained >= 32:
+            handles[drained].future.result(timeout=10.0)
+            drained += 1
+        max_seen = max(max_seen, _journal_size(root))
+    # bounded: sustained load overshoots the 2x force ceiling by the
+    # in-flight window plus whatever lands while the barrier waits for the
+    # log lock — but stays within the same order of magnitude, not log-sized
+    assert max_seen <= 16 * rotate, f"WAL grew unbounded ({max_seen} bytes)"
+
+    # a couple of post-forced-boundary commits, then crash (copytree, no
+    # close): recovery must serve both sides of the FORCED boundary
+    for i in range(3):
+        _commit(flog, prod, "ev", f"post{i}", b"tail")
+    crash_root = str(tmp_path / "crash")
+    shutil.copytree(root, crash_root)
+    for h in handles:
+        h.future.result(timeout=10.0)  # all durable before the clean close
+    flog.close()
+
+    relog = FileLog(crash_root, fsync="commit")
+    keys = [r.key for r in relog.read("ev", 0)]
+    assert keys == [f"k{i}" for i in range(n)] + [f"post{i}" for i in range(3)]
+    prod2 = relog.transactional_producer("t")
+    _commit(relog, prod2, "ev", "alive", b"1")
+    assert [r.key for r in relog.read("ev", 0)][-1] == "alive"
+    relog.close()
+
+
 def test_crash_recovery_across_rotation_boundary(tmp_path):
     """Commit → rotate → commit more → crash (copytree, no close): recovery
     must serve BOTH sides of the rotation boundary — pre-rotation records now

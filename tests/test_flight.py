@@ -9,6 +9,8 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 from conftest import free_ports
 from surge_tpu.config import Config
 from surge_tpu.log import GrpcLogTransport, InMemoryLog, LogRecord, LogServer, TopicSpec
@@ -94,6 +96,76 @@ def test_merge_falls_back_to_wall_across_hosts():
     # the skewed wall stamps now order the fence/truncate first — exactly why
     # same-host merges must use monotonic time
     assert [e["type"] for e in merged][:2] == ["role.fence", "log.truncate"]
+
+
+def _three_host_skewed_dumps(with_headers=True):
+    """Three brokers on three HOSTS (mono bases incomparable) telling one
+    failover, with wall clocks that were WRONG during the incident and
+    NTP-stepped back to true before the dumps: the promoted follower ran 5s
+    slow, the third voter 3s fast. A raw-wall merge front-runs the promotion
+    before the leader even died; the ``dumped_mono``/``dumped_wall`` header
+    pair lets :func:`merge_dumps` estimate each host's mono↔wall offset and
+    recover the true order. ``with_headers=False`` strips the header pair
+    (legacy dumps) to show the raw-wall fallback scrambling."""
+    dump_t = 30.0  # dump time (seconds after incident start), clocks healed
+
+    def host(recorder, node, mono_base, incident_skew, events):
+        evs = [{"seq": i + 1, "mono": mono_base + t,
+                "wall": 1.7e9 + t + incident_skew, "type": etype, **attrs}
+               for i, (t, etype, attrs) in enumerate(events)]
+        d = {"recorder": recorder, "node": node, "pid": 1, "events": evs}
+        if with_headers:
+            d["dumped_mono"] = mono_base + dump_t
+            d["dumped_wall"] = 1.7e9 + dump_t  # stepped back to true by now
+        return d
+
+    exleader = host("127.0.0.1:16001", "host-a", 100.0, 0.0, [
+        (0.00, "broker.kill", {"role": "leader", "epoch": 1}),
+        (0.45, "role.fence", {"old_epoch": 1, "new_epoch": 2}),
+        (0.46, "log.truncate", {"records": 3}),
+    ])
+    promoted = host("127.0.0.1:16002", "host-b", 2000.0, -5.0, [
+        (0.10, "role.promote-decision",
+         {"dead_leader": "127.0.0.1:16001", "failure_streak": 2}),
+        (0.12, "role.promote", {"epoch": 2}),
+        (0.50, "txn.first-ack", {"epoch": 2, "txn_seq": 7}),
+    ])
+    voter = host("127.0.0.1:16003", "host-c", 777.0, 3.0, [
+        (0.11, "vote.grant", {"candidate": "127.0.0.1:16002", "epoch": 2}),
+    ])
+    return [exleader, promoted, voter]
+
+
+TRUE_ORDER = ["broker.kill", "role.promote-decision", "vote.grant",
+              "role.promote", "role.fence", "log.truncate", "txn.first-ack"]
+
+
+def test_three_host_merge_estimates_offsets_from_dump_headers():
+    from surge_tpu.observability import host_wall_offset
+    dumps = _three_host_skewed_dumps()
+    assert host_wall_offset(dumps[0]) == 1.7e9 + 30.0 - 130.0
+    merged = merge_dumps(dumps)
+    assert [e["type"] for e in merged] == TRUE_ORDER
+    # and the merged 3-host story reconstructs the full failover
+    recon = reconstruct_failover(merged)
+    assert recon["complete"]
+    assert recon["phases"]["promotion"]["epoch"] == 2
+    assert recon["span_ms"] == pytest.approx(400.0)  # decision 0.10 -> ack 0.50
+
+
+def test_three_host_merge_without_headers_falls_back_to_raw_wall():
+    """Legacy dumps (no header pair): raw wall is all we have, and the
+    incident-time skew scrambles the story — the promoted follower's whole
+    timeline front-runs the kill. This is the failure mode the header
+    estimate exists to fix."""
+    dumps = _three_host_skewed_dumps(with_headers=False)
+    assert all("dumped_mono" not in d for d in dumps)
+    from surge_tpu.observability import host_wall_offset
+    assert host_wall_offset(dumps[0]) is None
+    merged = merge_dumps(dumps)
+    types = [e["type"] for e in merged]
+    assert types != TRUE_ORDER
+    assert types.index("role.promote") < types.index("broker.kill")
 
 
 def test_reconstruct_failover_phases_from_canned_dumps():

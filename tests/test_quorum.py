@@ -1,0 +1,716 @@
+"""Quorum cluster plane: majority-vote promotion (VoteLeader campaigns, one
+vote per epoch, stand-downs), quorum acks with the per-partition
+high-watermark gating follower-served reads, checkpoint-codec partition
+slices (FetchSlice/InstallSlice), live partition handoff, and the 3-broker
+double-failure chaos schedules (3-seed fast variant in tier-1; the long soak
+is ``slow``)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from conftest import free_ports
+from surge_tpu.config import Config
+from surge_tpu.log import (
+    GrpcLogTransport,
+    InMemoryLog,
+    LogRecord,
+    LogServer,
+    TopicSpec,
+)
+from surge_tpu.log import log_service_pb2 as pb
+from surge_tpu.store.checkpoint import (
+    decode_partition_slice,
+    encode_partition_slice,
+)
+from surge_tpu.testing.faults import FaultPlane, FaultRule
+
+QUORUM_CFG = Config(overrides={
+    "surge.log.replication-ack-timeout-ms": 1_500,
+    "surge.log.replication-isr-timeout-ms": 600,
+    "surge.log.failover.probe-interval-ms": 150,
+    "surge.log.failover.probe-failures": 2,
+    "surge.log.quorum.vote-timeout-ms": 600,
+    "surge.log.quorum.vote-rounds": 6,
+})
+
+
+def rec(topic, key, value, partition=0, offset=0):
+    return LogRecord(topic=topic, key=key, value=value, partition=partition,
+                     offset=offset)
+
+
+def _trio(config=QUORUM_CFG, auto_promote=True, extra=None):
+    """3-broker cluster: one leader replicating to two followers, every
+    broker holding the SAME full quorum-peer list (self included — dropped
+    by address wherever the peer set is consulted)."""
+    cfg = config
+    if extra:
+        cfg = Config(overrides={**config.overrides, **extra})
+    ports = free_ports(3)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    followers = []
+    for i in (1, 2):
+        f = LogServer(InMemoryLog(), port=ports[i], follower_of=addrs[0],
+                      auto_promote=auto_promote, config=cfg,
+                      quorum_peers=addrs)
+        f.start()
+        followers.append(f)
+    leader = LogServer(InMemoryLog(), port=ports[0],
+                       replicate_to=[addrs[1], addrs[2]], config=cfg,
+                       quorum_peers=addrs, auto_promote=auto_promote)
+    leader.start()
+    return leader, followers, addrs
+
+
+def _stop_all(*servers):
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:  # noqa: BLE001 — already killed
+            pass
+
+
+def _commit_n(client, txn_id, n, topic="ev", prefix="v", timeout=30.0):
+    acked = []
+    producer = None
+    from surge_tpu.log.transport import NotLeaderError, ProducerFencedError
+
+    for i in range(n):
+        payload = f"{prefix}-{i}".encode()
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                if producer is None:
+                    producer = client.transactional_producer(txn_id)
+                producer.begin()
+                producer.send(rec(topic, f"k{i}", payload))
+                producer.commit()
+                break
+            except (ProducerFencedError, NotLeaderError):
+                producer = None
+            except Exception:  # noqa: BLE001 — broker mid-failover
+                if producer is not None and producer.in_transaction:
+                    producer.abort()
+                time.sleep(0.05)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"commit {i} never acked")
+        acked.append(payload)
+    return acked
+
+
+def _assert_exactly_once(log, topic, acked, partitions=1):
+    present = []
+    for p in range(partitions):
+        present.extend(r.value for r in log.read(topic, p))
+    for payload in acked:
+        n = present.count(payload)
+        assert n == 1, f"acked payload {payload!r} appears {n} times"
+
+
+def _wait_leader(servers, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [s for s in servers if s.role == "leader" and not s._dead]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise TimeoutError("no (single) leader emerged")
+
+
+# -- partition slice codec ------------------------------------------------------------
+
+
+def test_partition_slice_roundtrip_with_compaction_holes():
+    records = [rec("ev", f"k{o}", f"v{o}".encode(), offset=o)
+               for o in (0, 1, 2, 5, 6, 9)]  # holes at 3-4, 7-8 (compaction)
+    data = encode_partition_slice(records, "ev", 0)
+    header, out = decode_partition_slice(data)
+    assert header["topic"] == "ev" and header["count"] == 6
+    assert header["blocks"] == 3  # one block per contiguous-offset run
+    assert [(r.offset, r.key, r.value) for r in out] == \
+        [(r.offset, r.key, r.value) for r in records]
+
+
+def test_partition_slice_rejects_truncation_and_garbage():
+    records = [rec("ev", f"k{o}", b"x" * 50, offset=o) for o in range(20)]
+    data = encode_partition_slice(records, "ev", 0)
+    with pytest.raises(Exception):
+        decode_partition_slice(data[:-30])  # torn tail
+    with pytest.raises(ValueError):
+        decode_partition_slice(b"JUNK" + data[4:])  # bad magic
+
+
+# -- vote semantics -------------------------------------------------------------------
+
+
+def _vote_req(candidate, leader, epoch):
+    return pb.TxnRequest(op="vote", txn_seq=epoch, records=[pb.RecordMsg(
+        has_value=True, value=json.dumps(
+            {"candidate": candidate, "leader": leader}).encode())])
+
+
+def _verdict(reply):
+    assert reply.ok
+    return json.loads(reply.records[0].value)
+
+
+def test_vote_denied_while_leader_alive_then_granted_after_death():
+    leader, (f1, f2), addrs = _trio(auto_promote=False)
+    try:
+        # a live LEADER never grants: it is the proof the candidate is wrong
+        v = _verdict(leader.VoteLeader(_vote_req(addrs[1], addrs[0], 5), None))
+        assert not v["granted"] and v["reason"] == "voter-is-leader"
+        assert v["leader_alive"]
+        # a follower that can still REACH the leader denies too
+        v = _verdict(f2.VoteLeader(_vote_req(addrs[1], addrs[0], 5), None))
+        assert not v["granted"] and v["reason"] == "leader-alive"
+        leader.kill()
+        if leader.kill_done is not None:
+            leader.kill_done.wait(10)
+        # leader unreachable from the voter's vantage too: granted
+        v = _verdict(f2.VoteLeader(_vote_req(addrs[1], addrs[0], 6), None))
+        assert v["granted"]
+        # one vote per epoch: a SECOND candidate at the same epoch is denied
+        v = _verdict(f2.VoteLeader(_vote_req(addrs[2], addrs[0], 6), None))
+        assert not v["granted"] and v["reason"] == "already-voted"
+        # the SAME candidate re-asking its epoch is re-granted (idempotent)
+        v = _verdict(f2.VoteLeader(_vote_req(addrs[1], addrs[0], 6), None))
+        assert v["granted"]
+        # stale epochs (at or below the max seen/voted) are refused
+        v = _verdict(f2.VoteLeader(_vote_req(addrs[2], addrs[0], 6 - 1), None))
+        assert not v["granted"] and v["reason"] == "stale-epoch"
+    finally:
+        _stop_all(leader, f1, f2)
+
+
+def test_vote_survives_voter_restart():
+    """A bounced voter must not grant the SAME epoch to a second candidate:
+    the vote persists in __broker_meta."""
+    leader, (f1, f2), addrs = _trio(auto_promote=False)
+    try:
+        leader.kill()
+        if leader.kill_done is not None:
+            leader.kill_done.wait(10)
+        v = _verdict(f2.VoteLeader(_vote_req(addrs[1], addrs[0], 7), None))
+        assert v["granted"]
+        inner = f2.log
+        f2.stop()
+        f2b = LogServer(inner, port=int(addrs[2].rsplit(":", 1)[1]),
+                        follower_of=addrs[0], config=QUORUM_CFG,
+                        quorum_peers=addrs)
+        # no start() needed: the vote table is recovered at construction
+        v = _verdict(f2b.VoteLeader(_vote_req(addrs[2], addrs[0], 7), None))
+        assert not v["granted"] and v["reason"] in ("already-voted",
+                                                    "stale-epoch")
+        v = _verdict(f2b.VoteLeader(_vote_req(addrs[1], addrs[0], 7), None))
+        assert v["granted"] or v["reason"] == "stale-epoch"
+        f2 = f2b
+    finally:
+        _stop_all(leader, f1, f2)
+
+
+# -- majority promotion ---------------------------------------------------------------
+
+
+def test_majority_promotion_on_leader_kill_and_cluster_repoint():
+    leader, (f1, f2), addrs = _trio()
+    client = GrpcLogTransport(",".join(addrs), config=QUORUM_CFG)
+    try:
+        client.create_topic(TopicSpec("ev", 1))
+        acked = _commit_n(client, "t-q", 6, prefix="pre")
+        leader.kill()
+        winner = _wait_leader([f1, f2])
+        loser = f2 if winner is f1 else f1
+        # the winner minted its epoch from a strict majority (flight proof)
+        types = [e["type"] for e in winner.flight.events()]
+        assert "quorum.win" in types
+        assert winner.epoch >= 2
+        # the losing follower repointed: stream + prober now aim at the winner
+        winner_addr = winner.advertised
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (loser.leader_hint == winner_addr
+                    and loser._follower_of == winner_addr
+                    and loser._leader_prober is not None
+                    and loser._leader_prober.target == winner_addr):
+                break
+            time.sleep(0.05)
+        assert loser._follower_of == winner_addr, "loser never repointed"
+        assert loser._leader_prober.target == winner_addr
+        # cluster keeps serving exactly-once through the new leader
+        acked += _commit_n(client, "t-q", 6, prefix="post")
+        _assert_exactly_once(winner.log, "ev", acked)
+        status = client.broker_status()
+        assert status["quorum"]["cluster_size"] == 3
+        assert status["quorum"]["majority"] == 2
+    finally:
+        client.close()
+        _stop_all(leader, f1, f2)
+
+
+def test_candidate_without_majority_stands_down_no_split_brain():
+    """vote-blackhole on every voter: a candidate that cannot reach a quorum
+    must NEVER promote on its own liveness view — then, once votes flow
+    again, the re-armed prober drives a successful campaign."""
+    leader, (f1, f2), addrs = _trio(extra={
+        "surge.log.quorum.vote-rounds": 3})
+    try:
+        for f in (f1, f2):
+            f.faults = FaultPlane(
+                [FaultRule(site="rpc.VoteLeader", action="drop", times=None)])
+            f.faults.on_crash = lambda point: None
+        leader.kill()
+        # both campaign, neither can reach the other's vote: both stand down
+        deadline = time.monotonic() + 8
+        stood_down = set()
+        while time.monotonic() < deadline and len(stood_down) < 2:
+            for f in (f1, f2):
+                if any(e["type"] == "quorum.stand-down"
+                       for e in f.flight.events()):
+                    stood_down.add(id(f))
+            assert f1.role == "follower" and f2.role == "follower", \
+                "a minority candidate promoted (split-brain window!)"
+            time.sleep(0.05)
+        assert len(stood_down) == 2, "candidates never stood down"
+        # heal the vote path: the reset probers re-declare and a campaign wins
+        for f in (f1, f2):
+            f.faults.disarm()
+        winner = _wait_leader([f1, f2], timeout=30.0)
+        assert winner.role == "leader"
+    finally:
+        _stop_all(leader, f1, f2)
+
+
+# -- quorum acks & high-watermark -----------------------------------------------------
+
+
+def test_quorum_acks_mask_failing_follower():
+    """min-insync-acks=2 in a 3-broker cluster: commits ack off the leader +
+    ONE follower while ships to the other are blackholed — well inside the
+    ISR timeout that acks=all would have to wait out."""
+    leader, (f1, f2), addrs = _trio(auto_promote=False, extra={
+        "surge.log.replication.min-insync-acks": 2,
+        "surge.log.replication-isr-timeout-ms": 60_000,  # stays "in sync"
+    })
+    client = GrpcLogTransport(addrs[0], config=QUORUM_CFG)
+    try:
+        client.create_topic(TopicSpec("ev", 1))
+        acked = _commit_n(client, "t-acks", 3, prefix="both")
+        # blackhole ships to f2 only; f2 stays in the (60s-timeout) ISR
+        leader.faults = FaultPlane(
+            [FaultRule(site=f"ship.{addrs[2]}", action="drop", times=None)])
+        leader.faults.on_crash = lambda point: None
+        t0 = time.monotonic()
+        acked += _commit_n(client, "t-acks", 3, prefix="quorum", timeout=20.0)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10.0, (
+            f"quorum acks took {elapsed:.1f}s — they waited on the "
+            "blackholed follower")
+        # the quorum replica serves everything; exactly-once on the leader
+        _assert_exactly_once(leader.log, "ev", acked)
+        c1 = GrpcLogTransport(addrs[1], config=QUORUM_CFG)
+        try:
+            assert [r.value for r in c1.read("ev", 0)] == acked
+            assert c1.high_watermark("ev", 0) == len(acked)
+        finally:
+            c1.close()
+        # the blackholed follower holds (and therefore serves) only the
+        # pre-fault prefix — nothing beyond its shipped high-watermark
+        c2 = GrpcLogTransport(addrs[2], config=QUORUM_CFG)
+        try:
+            assert [r.value for r in c2.read("ev", 0)] == acked[:3]
+            assert c2.high_watermark("ev", 0) == 3
+        finally:
+            c2.close()
+        status = leader.replication_status()
+        assert status["min_insync_acks"] == 2
+    finally:
+        client.close()
+        _stop_all(leader, f1, f2)
+
+
+def test_hwm_gate_clamps_follower_reads_and_end_offset_reports_it():
+    """The gate itself, deterministically: a follower holding records ABOVE
+    its shipped high-watermark serves only the records below it — applied
+    but not provably quorum-held stays invisible, like an open txn."""
+    (port,) = free_ports(1)
+    f = LogServer(InMemoryLog(), port=port, follower_of="127.0.0.1:1",
+                  config=QUORUM_CFG)
+    try:
+        f.log.create_topic(TopicSpec("ev", 1))
+        f.log.append_verbatim([rec("ev", f"k{o}", f"v{o}".encode(), offset=o)
+                               for o in range(4)])
+        f._hwm[("ev", 0)] = 2  # the last shipped quorum frontier
+        reply = f.Read(pb.ReadRequest(topic="ev", partition=0,
+                                      from_offset=0), None)
+        assert [m.value for m in reply.records] == [b"v0", b"v1"]
+        off = f.EndOffset(pb.OffsetRequest(topic="ev", partition=0), None)
+        assert off.end_offset == 4 and off.high_watermark == 2
+        # an UNGATED partition (no hwm ever shipped) keeps PR-4 semantics
+        f.log.create_topic(TopicSpec("legacy", 1))
+        f.log.append_verbatim([rec("legacy", "k", b"v", offset=0)])
+        reply = f.Read(pb.ReadRequest(topic="legacy", partition=0,
+                                      from_offset=0), None)
+        assert [m.value for m in reply.records] == [b"v"]
+        # BrokerStatus surfaces the per-partition hwm (chaos.py's view)
+        assert f.broker_status()["high_watermarks"]["ev"]["0"] == 2
+    finally:
+        f.stop()
+
+
+def test_follower_reads_see_commit_the_moment_it_acks():
+    """Read-your-committed-writes on followers: the finalize pass beacons
+    the raised hwm BEFORE waking the committer, so a read against either
+    follower immediately after the ack must already see the record."""
+    leader, (f1, f2), addrs = _trio(auto_promote=False)
+    client = GrpcLogTransport(addrs[0], config=QUORUM_CFG)
+    readers = [GrpcLogTransport(a, config=QUORUM_CFG) for a in addrs[1:]]
+    try:
+        client.create_topic(TopicSpec("ev", 1))
+        p = client.transactional_producer("t-ryw")
+        for i in range(8):
+            p.begin()
+            p.send(rec("ev", f"k{i}", f"v{i}".encode()))
+            p.commit()
+            for r in readers:
+                values = [x.value for x in r.read("ev", 0)]
+                assert f"v{i}".encode() in values, (
+                    f"commit {i} acked but invisible on follower "
+                    f"{r.target} (hwm beacon lost the race)")
+    finally:
+        client.close()
+        for r in readers:
+            r.close()
+        _stop_all(leader, f1, f2)
+
+
+# -- slices over the wire -------------------------------------------------------------
+
+
+def test_fetch_and_install_slice_rpcs():
+    leader, (f1, f2), addrs = _trio(auto_promote=False)
+    client = GrpcLogTransport(addrs[0], config=QUORUM_CFG)
+    try:
+        client.create_topic(TopicSpec("ev", 1))
+        acked = _commit_n(client, "t-slice", 10)
+        reply = client._calls["FetchSlice"](pb.ReadRequest(
+            topic="ev", partition=0, from_offset=2, has_max=True,
+            max_records=5), timeout=5.0)
+        assert reply.ok
+        header, records = decode_partition_slice(bytes(
+            reply.records[0].value))
+        assert header["from"] == 2 and len(records) == 5
+        assert records[0].offset == 2
+        # a leader refuses installs (foreign offsets would fork its log)
+        install_req = pb.TxnRequest(op="install", records=[pb.RecordMsg(
+            topic="ev", partition=0, has_value=True,
+            value=bytes(reply.records[0].value))])
+        refused = leader.InstallSlice(install_req, None)
+        assert not refused.ok and "leader" in refused.error
+        # a fresh standby ingests slices (idempotent over what it holds)
+        (sport,) = free_ports(1)
+        standby = LogServer(InMemoryLog(), port=sport, config=QUORUM_CFG,
+                            follower_of=addrs[0])
+        try:
+            standby.log.create_topic(TopicSpec("ev", 1))
+            # gap refused: the slice starts past the standby's end
+            refused = standby.InstallSlice(install_req, None)
+            assert not refused.ok and "gap" in refused.error
+            full = client._calls["FetchSlice"](pb.ReadRequest(
+                topic="ev", partition=0, from_offset=0), timeout=5.0)
+            ok = standby.InstallSlice(pb.TxnRequest(op="install", records=[
+                pb.RecordMsg(topic="ev", partition=0, has_value=True,
+                             value=bytes(full.records[0].value))]), None)
+            assert ok.ok
+            assert [r.value for r in standby.log.read("ev", 0)] == acked
+        finally:
+            standby.stop()
+    finally:
+        client.close()
+        _stop_all(leader, f1, f2)
+
+
+def test_install_slice_accepts_vouched_compaction_hole():
+    """A slice read FROM the destination's end whose head records were
+    compacted away at the source carries ``base <= end`` — the installer
+    must ingest past the hole (state topics ARE compacted; refusing would
+    abort every handoff after a compaction pass). The same gap UNVOUCHED
+    (no base: could be genuinely missing records) stays refused."""
+    (sport,) = free_ports(1)
+    standby = LogServer(InMemoryLog(), port=sport, config=QUORUM_CFG,
+                        follower_of="127.0.0.1:9")  # never started: no probes
+    try:
+        standby.log.create_topic(TopicSpec("ev", 1))
+        head = [rec("ev", f"k{i}", f"v{i}".encode(), offset=i)
+                for i in range(5)]
+        ok = standby.InstallSlice(pb.TxnRequest(records=[pb.RecordMsg(
+            topic="ev", partition=0, has_value=True,
+            value=encode_partition_slice(head, "ev", 0, base=0))]), None)
+        assert ok.ok, ok.error
+        # offsets 5..6 compacted away at the source; the shipper read from
+        # the destination's end (5), so the hole is vouched by base=5
+        tail = [rec("ev", f"k{i}", f"v{i}".encode(), offset=i)
+                for i in (7, 8, 9)]
+        unvouched = standby.InstallSlice(pb.TxnRequest(records=[
+            pb.RecordMsg(topic="ev", partition=0, has_value=True,
+                         value=encode_partition_slice(tail, "ev", 0))]), None)
+        assert not unvouched.ok and "gap" in unvouched.error
+        vouched = standby.InstallSlice(pb.TxnRequest(records=[
+            pb.RecordMsg(topic="ev", partition=0, has_value=True,
+                         value=encode_partition_slice(tail, "ev", 0,
+                                                      base=5))]), None)
+        assert vouched.ok, vouched.error
+        assert [r.offset for r in standby.log.read("ev", 0)] == [
+            0, 1, 2, 3, 4, 7, 8, 9]
+    finally:
+        standby.stop()
+
+
+def test_catch_up_uses_slice_lane():
+    leader, (f1, f2), addrs = _trio(auto_promote=False)
+    client = GrpcLogTransport(addrs[0], config=QUORUM_CFG)
+    try:
+        client.create_topic(TopicSpec("ev", 2))
+        p = client.transactional_producer("t-cu")
+        for i in range(30):
+            p.begin()
+            p.send(rec("ev", f"k{i}", f"v{i}".encode(), partition=i % 2))
+            p.commit()
+        (sport,) = free_ports(1)
+        standby = LogServer(InMemoryLog(), port=sport, config=QUORUM_CFG)
+        try:
+            copied = standby.catch_up(addrs[0])
+            assert copied == 30
+            assert standby._catchup_slices, "slice lane silently disabled"
+            for part in (0, 1):
+                want = [r.value for r in leader.log.read("ev", part)]
+                assert [r.value for r in standby.log.read("ev", part)] == want
+        finally:
+            standby.stop()
+    finally:
+        client.close()
+        _stop_all(leader, f1, f2)
+
+
+# -- live handoff ---------------------------------------------------------------------
+
+
+def test_handoff_moves_leadership_under_load_exactly_once():
+    leader, (f1, f2), addrs = _trio()
+    client = GrpcLogTransport(",".join(addrs), config=QUORUM_CFG)
+    admin = GrpcLogTransport(addrs[0], config=QUORUM_CFG)
+    try:
+        client.create_topic(TopicSpec("ev", 1))
+        acked = _commit_n(client, "t-ho", 20, prefix="pre")
+        stop = threading.Event()
+        side: dict = {"acked": [], "error": None}
+
+        def writer():
+            c = GrpcLogTransport(",".join(addrs), config=QUORUM_CFG)
+            try:
+                i = 0
+                while not stop.is_set():
+                    side["acked"] += _commit_n(c, "t-ho-live", 1,
+                                               prefix=f"live{i}",
+                                               timeout=30.0)
+                    i += 1
+            except Exception as exc:  # noqa: BLE001
+                side["error"] = exc
+            finally:
+                c.close()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        stats = admin.handoff_partition(addrs[1])
+        time.sleep(0.3)
+        stop.set()
+        t.join(30.0)
+        assert side["error"] is None, f"live writer died: {side['error']!r}"
+        assert stats["epoch"] >= 2 and stats["fence_ms"] > 0
+        # destination leads, the ex-leader demoted IN PLACE (no kill)
+        assert f1.role == "leader" and leader.role == "follower"
+        assert not leader._handoff_fence
+        # planned move: epoch fenced exactly once, writers never lost a byte
+        _assert_exactly_once(f1.log, "ev", acked + side["acked"])
+        # the non-destination follower repointed to the new leader
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                f2._follower_of != addrs[1]:
+            time.sleep(0.05)
+        assert f2._follower_of == addrs[1]
+        # the flight ring tells the handoff story end to end
+        types = [e["type"] for e in leader.flight.events()]
+        for expected in ("handoff.start", "handoff.fence", "handoff.done"):
+            assert expected in types
+    finally:
+        client.close()
+        admin.close()
+        _stop_all(leader, f1, f2)
+
+
+def test_handoff_crash_pre_promote_fails_clean_failover_takes_over():
+    """Kill the old leader at crash.handoff.pre-promote (tail shipped, dest
+    NOT yet promoted): no second leader is minted by the broken handoff, and
+    the normal prober-driven failover path recovers the cluster."""
+    lport, fport = free_ports(2)
+    laddr, faddr = f"127.0.0.1:{lport}", f"127.0.0.1:{fport}"
+    follower = LogServer(InMemoryLog(), port=fport, follower_of=laddr,
+                         auto_promote=True, config=QUORUM_CFG)
+    follower.start()
+    leader = LogServer(InMemoryLog(), port=lport, replicate_to=[faddr],
+                       config=QUORUM_CFG)
+    leader.start()
+    client = GrpcLogTransport(f"{laddr},{faddr}", config=QUORUM_CFG)
+    try:
+        client.create_topic(TopicSpec("ev", 1))
+        acked = _commit_n(client, "t-hc", 8)
+        client.arm_faults("handoff-crash-pre-promote", seed=1)
+        admin = GrpcLogTransport(laddr, config=QUORUM_CFG)
+        with pytest.raises(Exception):
+            admin.handoff_partition(faddr, timeout=20.0)
+        admin.close()
+        assert leader._dead, "crash point never fired"
+        assert follower.role != "leader" or follower.epoch >= 2
+        # the prober path takes over: the follower promotes normally
+        deadline = time.monotonic() + 20
+        while follower.role != "leader" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert follower.role == "leader"
+        _assert_exactly_once(follower.log, "ev", acked)
+        acked += _commit_n(client, "t-hc", 4, prefix="after")
+        _assert_exactly_once(follower.log, "ev", acked)
+    finally:
+        client.close()
+        _stop_all(leader, follower)
+
+
+# -- 3-broker chaos: double failure ---------------------------------------------------
+
+
+def _double_failure_round(seed: int, commits: int = 10) -> None:
+    """Kill the leader, let a majority elect a successor, restart the dead
+    broker as a follower, then kill the NEW leader while the restarted one
+    may still be catching up: a second majority (2 of 3, the relit broker
+    voting) must elect again — 0 lost / 0 duplicated across both failovers,
+    merged flight timeline complete, at most one promotion per epoch.
+
+    min-insync-acks=2: every acked commit provably lives on two of the
+    three replicas — the durability posture that makes 0-lost possible at
+    all across a double failure (with the PR-4 default a freshly-promoted
+    leader whose ISR shrank to itself could ack a commit and die with it)
+    — and the VoteLeader up-to-date check then guarantees the elected
+    successor is a replica that holds them."""
+    leader, (f1, f2), addrs = _trio(extra={
+        "surge.log.replication.min-insync-acks": 2})
+    relit = None
+    client = GrpcLogTransport(",".join(addrs), config=QUORUM_CFG)
+    try:
+        client.create_topic(TopicSpec("ev", 1))
+        client.arm_faults(json.dumps({"rules": [
+            {"site": "rpc.Transact", "action": "reorder", "p": 0.15,
+             "times": None, "delay_ms": 20.0},
+            {"site": "ship.*", "action": "drop", "p": 0.1, "times": None},
+        ]}), seed=seed)
+        acked = _commit_n(client, f"t-df-{seed}", commits, prefix="p1",
+                          timeout=60.0)
+        leader.kill()
+        if leader.kill_done is not None:
+            leader.kill_done.wait(10)
+        w1 = _wait_leader([f1, f2], timeout=30.0)
+        acked += _commit_n(client, f"t-df-{seed}", commits, prefix="p2",
+                           timeout=60.0)
+        # restart the first casualty as a follower of the new leader (same
+        # inner log + flight ring: the timeline keeps one story per broker)
+        relit = LogServer(leader.log, port=int(addrs[0].rsplit(":", 1)[1]),
+                          follower_of=w1.advertised, auto_promote=True,
+                          config=QUORUM_CFG, quorum_peers=addrs,
+                          flight=leader.flight)
+        relit.start()
+        # second failure: kill the new leader while the relit broker may
+        # still be mid-catch-up — the surviving pair is a strict majority
+        w1.kill()
+        if w1.kill_done is not None:
+            w1.kill_done.wait(10)
+        survivors = [s for s in (relit, f1, f2) if s is not w1]
+        w2 = _wait_leader(survivors, timeout=40.0)
+        acked += _commit_n(client, f"t-df-{seed}", commits, prefix="p3",
+                           timeout=90.0)
+        _assert_exactly_once(w2.log, "ev", acked)
+        # merged story from every broker's black box
+        from surge_tpu.observability import merge_dumps
+
+        merged = merge_dumps([leader.flight.dump(), f1.flight.dump(),
+                              f2.flight.dump()])
+        promotions = [e for e in merged if e["type"] == "role.promote"]
+        assert len(promotions) >= 2
+        epochs = [e["epoch"] for e in promotions]
+        assert len(epochs) == len(set(epochs)), (
+            f"two promotions minted the same epoch: {epochs} — "
+            "split brain (two acking leaders in one epoch)")
+        wins = [e for e in merged if e["type"] == "quorum.win"]
+        assert len(wins) >= 2, "promotions happened without majorities"
+    finally:
+        client.close()
+        _stop_all(*(s for s in (leader, relit, f1, f2) if s is not None))
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_double_failure_deterministic_seeds(seed):
+    """Tier-1 fast variant of the cluster soak: three fixed seeds, two
+    sequential leader kills each, majority re-election both times."""
+    _double_failure_round(seed)
+
+
+@pytest.mark.slow
+def test_cluster_chaos_soak_randomized_schedules():
+    """Minutes-long seeded soak across many double-failure schedules."""
+    for seed in range(40, 48):
+        _double_failure_round(seed, commits=20)
+
+
+# -- chaos CLI: cluster & handoff -----------------------------------------------------
+
+
+def test_chaos_cli_cluster_and_handoff_smoke():
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cli = os.path.join(repo, "tools", "chaos.py")
+
+    def run(*argv):
+        out = subprocess.run([sys.executable, cli, *argv],
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, (argv, out.stderr[-500:])
+        return out.stdout
+
+    leader, (f1, f2), addrs = _trio(auto_promote=False)
+    try:
+        cluster_arg = ",".join(addrs)
+        out = json.loads(run("cluster", cluster_arg))
+        assert out["verdict"] == "ok: exactly one leader"
+        assert out["leaders"] == [addrs[0]]
+        assert out["brokers"][addrs[1]]["role"] == "follower"
+        assert out["brokers"][addrs[0]]["quorum"]["cluster_size"] == 3
+        # arm a plan everywhere from one invocation
+        out = json.loads(run("cluster", cluster_arg, "--arm", "fsync-hiccup",
+                             "--seed", "5"))
+        for addr in addrs:
+            assert out["brokers"][addr]["faults"]["rules"], addr
+        # planned handoff from the CLI
+        stats = json.loads(run("handoff", addrs[0], addrs[1]))
+        assert stats["to"] == addrs[1] and stats["epoch"] >= 2
+        assert f1.role == "leader"
+        out = json.loads(run("cluster", cluster_arg))
+        assert out["leaders"] == [addrs[1]]
+        # kill one broker from the cluster command
+        out = json.loads(run("cluster", cluster_arg, "--kill", addrs[2]))
+        assert out["brokers"][addrs[2]] == {"killed": True}
+        assert f2._dead
+    finally:
+        _stop_all(leader, f1, f2)

@@ -13,6 +13,19 @@ over the broker's admin RPCs::
     python tools/chaos.py flight 127.0.0.1:16001     # full flight-recorder dump
     python tools/chaos.py metrics 127.0.0.1:16001    # broker OpenMetrics text
     python tools/chaos.py plans                      # list named plans
+    python tools/chaos.py cluster 127.0.0.1:16001,127.0.0.1:16002,127.0.0.1:16003
+    python tools/chaos.py cluster <t1,t2,t3> --arm flaky-network --seed 7
+    python tools/chaos.py cluster <t1,t2,t3> --kill 127.0.0.1:16001
+    python tools/chaos.py handoff 127.0.0.1:16001 127.0.0.1:16002
+
+``cluster`` drives N brokers from ONE invocation: with no flags it prints a
+per-broker summary (role, epoch, in-sync view, per-partition high-watermarks,
+quorum shape, armed faults) plus a cluster verdict (exactly one leader?);
+``--arm PLAN`` arms the same seeded plan on every broker; ``--kill ADDR``
+hard-stops one of them (the reply races the socket close — unreachable IS
+success). ``handoff <from> <to>`` moves the leader role deliberately (bulk
+slice ship -> fence -> journal-tail ship -> dedup push -> promote -> demote)
+and prints the stats, fenced-span ms included.
 
 ``arm`` takes a NAMED plan (see ``plans``) or a JSON rule list / object;
 after arming it reports the plane's stats, and with ``--watch`` polls the
@@ -40,12 +53,20 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("command",
                     choices=["arm", "disarm", "status", "broker", "promote",
-                             "flight", "metrics", "plans"])
-    ap.add_argument("target", nargs="?", help="broker host:port")
+                             "flight", "metrics", "plans", "cluster",
+                             "handoff"])
+    ap.add_argument("target", nargs="?",
+                    help="broker host:port (cluster: comma-separated list; "
+                         "handoff: the FROM broker)")
     ap.add_argument("plan", nargs="?",
-                    help="named fault plan or JSON rules (arm only)")
+                    help="named fault plan or JSON rules (arm only); the TO "
+                         "broker (handoff only)")
     ap.add_argument("--seed", type=int, default=0,
                     help="deterministic schedule seed (arm only)")
+    ap.add_argument("--arm", dest="cluster_arm", default=None,
+                    help="cluster: arm this plan on every broker")
+    ap.add_argument("--kill", dest="cluster_kill", default=None,
+                    help="cluster: hard-stop this broker (host:port)")
     ap.add_argument("--watch", action="store_true",
                     help="after arming, poll until every rule is exhausted "
                          "or the broker goes down")
@@ -68,6 +89,19 @@ def main(argv=None) -> int:
         return 2
 
     from surge_tpu.log import GrpcLogTransport
+
+    if args.command == "cluster":
+        return _cluster(args)
+    if args.command == "handoff":
+        if not args.plan:
+            print("handoff needs <from> <to>", file=sys.stderr)
+            return 2
+        client = GrpcLogTransport(args.target)
+        try:
+            print(json.dumps(client.handoff_partition(args.plan), indent=2))
+            return 0
+        finally:
+            client.close()
 
     client = GrpcLogTransport(args.target)
     try:
@@ -133,6 +167,64 @@ def main(argv=None) -> int:
                 return 0
     finally:
         client.close()
+
+
+def _cluster(args) -> int:
+    """One invocation across N brokers: arm / kill / summarize. The summary
+    is the quorum-plane debugging view — per-broker role+epoch+hwm (why a
+    follower read is or is not servable) and a cluster-level verdict that
+    exactly one broker is leading."""
+    from surge_tpu.log import GrpcLogTransport
+
+    targets = [t.strip() for t in args.target.split(",") if t.strip()]
+    if len(targets) < 2:
+        print("cluster needs a comma-separated broker list", file=sys.stderr)
+        return 2
+    out = {"brokers": {}, "leaders": []}
+    rc = 0
+    for target in targets:
+        client = GrpcLogTransport(target)
+        try:
+            if args.cluster_kill == target:
+                client.kill_broker()
+                out["brokers"][target] = {"killed": True}
+                continue
+            if args.cluster_arm:
+                client.arm_faults(args.cluster_arm, seed=args.seed)
+            status = client.broker_status()
+            row = {
+                "role": status["role"],
+                "epoch": status["epoch"],
+                "leader_hint": status.get("leader_hint", ""),
+                "high_watermarks": status.get("high_watermarks", {}),
+                "quorum": status.get("quorum", {}),
+                "handoff_fence": status.get("handoff_fence", False),
+                "catch_up": status.get("catch_up", {}),
+            }
+            try:
+                row["faults"] = client.fault_stats()
+            except Exception as exc:  # noqa: BLE001 — older broker
+                row["faults"] = f"unavailable: {exc!r}"
+            if status["role"] == "leader":
+                out["leaders"].append(target)
+                try:
+                    row["replication"] = client.replication_status()
+                except Exception:  # noqa: BLE001
+                    pass
+            out["brokers"][target] = row
+        except Exception as exc:  # noqa: BLE001 — broker down: report, go on
+            out["brokers"][target] = {"unreachable": str(exc)[:200]}
+        finally:
+            client.close()
+    out["verdict"] = ("ok: exactly one leader"
+                      if len(out["leaders"]) == 1 else
+                      f"DEGRADED: {len(out['leaders'])} leaders")
+    if args.cluster_kill and args.cluster_kill not in targets:
+        print(f"--kill target {args.cluster_kill} not in the cluster list",
+              file=sys.stderr)
+        rc = 2
+    print(json.dumps(out, indent=2))
+    return rc
 
 
 if __name__ == "__main__":
