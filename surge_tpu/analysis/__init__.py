@@ -1,0 +1,37 @@
+"""surgelint — repo-native static analysis for concurrency, config, and
+catalog invariants (docs/static-analysis.md).
+
+Entry points: ``tools/surgelint.py`` (CLI), :func:`run_paths` (library,
+what tests/test_lint.py drives), :func:`all_rules` (the registry).
+"""
+
+from surge_tpu.analysis.core import (
+    DEFAULT_TARGETS,
+    Finding,
+    ModuleContext,
+    RepoContext,
+    Report,
+    Rule,
+    all_rules,
+    collect_files,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
+from surge_tpu.analysis.reporters import render_json, render_text
+
+__all__ = [
+    "DEFAULT_TARGETS",
+    "Finding",
+    "ModuleContext",
+    "RepoContext",
+    "Report",
+    "Rule",
+    "all_rules",
+    "collect_files",
+    "load_baseline",
+    "render_json",
+    "render_text",
+    "run_paths",
+    "write_baseline",
+]

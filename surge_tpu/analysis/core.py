@@ -1,0 +1,423 @@
+"""surgelint core — rule registry, per-file visitor pipeline, pragmas, baseline.
+
+The framework half of ``surge_tpu.analysis`` (the rules live in
+``surge_tpu.analysis.rules``): repo-native static analysis distilled from this
+repo's actual bug history — awaits under threading locks, blocking syscalls on
+the event loop, the py3.10 ``asyncio.wait_for`` cancellation swallow, orphaned
+tasks, config/metric registry drift, jit impurity and proto route drift
+(docs/static-analysis.md catalogs each rule and the incident it encodes).
+
+Two rule shapes:
+
+- **module rules** (`Rule.check_module`) get a parsed :class:`ModuleContext`
+  per file and emit findings from its AST;
+- **repo rules** (`Rule.check_repo`, ``repo_scope=True``) get a
+  :class:`RepoContext` holding EVERY canonical target module plus the
+  cross-file registries (config defaults, docs texts, golden metric families)
+  and emit findings anywhere — they always run over the full canonical
+  surface and are never path-filtered, so a ``--changed`` run cannot miss a
+  drift that anchors in a file the user didn't touch.
+
+Suppression is per line: ``# surgelint: disable=<rule>[,<rule>]  # <why>``
+on the finding's line. A justification comment is REQUIRED — a bare disable
+is itself reported (``pragma-justification``). Suppressions are tallied in
+the report so hand-waving accumulates visibly. Findings that predate the
+suite live in the checked-in baseline (``.surgelint-baseline.json``), keyed
+by (rule, path, stripped source line) so line drift does not invalidate it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "ModuleContext",
+    "RepoContext",
+    "Report",
+    "register",
+    "all_rules",
+    "run_paths",
+    "collect_files",
+    "load_baseline",
+    "write_baseline",
+    "baseline_key",
+    "DEFAULT_TARGETS",
+    "PRAGMA_RE",
+]
+
+#: the canonical lint surface (tier-1 runs the suite over exactly this set)
+DEFAULT_TARGETS = ("surge_tpu", "tools", "bench.py")
+
+#: generated / vendored files never scanned
+EXCLUDED_BASENAMES = frozenset({"log_service_pb2.py"})
+EXCLUDED_DIRS = frozenset({"__pycache__", ".git", "lint_fixtures"})
+
+PRAGMA_RE = re.compile(
+    r"#\s*surgelint:\s*disable=([A-Za-z0-9_,-]+)\s*(?:#\s*(\S.*))?$")
+
+
+@dataclass
+class Finding:
+    """One defect at one location. ``snippet`` (the stripped source line) is
+    the line-drift-proof half of the baseline key."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    snippet: str = ""
+    suppressed: bool = False
+    justification: str = ""
+
+    def sort_key(self) -> Tuple[str, int, str]:
+        return (self.path, self.line, self.rule)
+
+    def as_dict(self) -> dict:
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "message": self.message}
+        if self.suppressed:
+            d["suppressed"] = True
+            d["justification"] = self.justification
+        return d
+
+
+class Rule:
+    """Base rule. Subclasses set ``id``/``summary`` and implement one of
+    ``check_module`` (per-file AST) or ``check_repo`` (cross-file)."""
+
+    id: str = ""
+    summary: str = ""
+    #: repo rules aggregate over every canonical target before emitting
+    repo_scope: bool = False
+
+    def check_module(self, ctx: "ModuleContext") -> Iterator[Finding]:
+        return iter(())
+
+    def check_repo(self, ctx: "RepoContext") -> Iterator[Finding]:
+        return iter(())
+
+    # -- shared helper -------------------------------------------------------------
+
+    def finding(self, ctx: "ModuleContext", node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(rule=self.id, path=ctx.rel_path, line=line,
+                       message=message, snippet=ctx.line_text(line))
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls: type) -> type:
+    """Class decorator adding a rule to the global registry (idempotent —
+    re-imports under pytest must not duplicate)."""
+    rule = rule_cls()
+    if not rule.id:
+        raise ValueError(f"rule {rule_cls.__name__} has no id")
+    _REGISTRY[rule.id] = rule
+    return rule_cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    import surge_tpu.analysis.rules  # noqa: F401 — populates the registry
+    return dict(_REGISTRY)
+
+
+# -- module / repo contexts --------------------------------------------------------
+
+
+class ModuleContext:
+    """One parsed file plus the lookups every rule wants: physical lines,
+    pragma map, dotted-name rendering, scope-aware walks."""
+
+    def __init__(self, path: str, rel_path: str, source: str,
+                 tree: ast.AST) -> None:
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._pragmas: Optional[Dict[int, Tuple[List[str], str]]] = None
+
+    @classmethod
+    def parse(cls, path: str, repo_root: str) -> "ModuleContext":
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+        rel = os.path.relpath(path, repo_root)
+        return cls(path, rel, source, tree)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    @property
+    def pragmas(self) -> Dict[int, Tuple[List[str], str]]:
+        """line -> (disabled rule ids, justification)."""
+        if self._pragmas is None:
+            self._pragmas = {}
+            for i, text in enumerate(self.lines, start=1):
+                m = PRAGMA_RE.search(text)
+                if m:
+                    rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+                    self._pragmas[i] = (rules, (m.group(2) or "").strip())
+        return self._pragmas
+
+    # -- AST helpers ----------------------------------------------------------------
+
+    @staticmethod
+    def dotted(node: ast.AST) -> Optional[str]:
+        """Render a Name/Attribute chain as ``a.b.c`` (None for anything else)."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    @staticmethod
+    def walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+        """Walk descendants WITHOUT entering nested function/lambda/class
+        scopes (their bodies execute elsewhere — an executor thunk's blocking
+        call is the point of the thunk, not an event-loop stall)."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            child = stack.pop()
+            yield child
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(child))
+
+    def functions(self) -> Iterator[ast.AST]:
+        """Every function def in the file, any nesting depth."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def async_functions(self) -> Iterator[ast.AsyncFunctionDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                yield node
+
+
+class RepoContext:
+    """Every canonical target module parsed once, plus lazy cross-file
+    registries. Repo rules read these; the runner restricts their findings to
+    the user-requested path set."""
+
+    def __init__(self, repo_root: str, modules: List[ModuleContext]) -> None:
+        self.repo_root = repo_root
+        self.modules = modules
+        self._docs: Dict[str, str] = {}
+
+    def doc_text(self, rel: str) -> str:
+        if rel not in self._docs:
+            path = os.path.join(self.repo_root, rel)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    self._docs[rel] = f.read()
+            except OSError:
+                self._docs[rel] = ""
+        return self._docs[rel]
+
+    def module(self, rel_path: str) -> Optional[ModuleContext]:
+        for m in self.modules:
+            if m.rel_path == rel_path:
+                return m
+        return None
+
+
+# -- file collection ---------------------------------------------------------------
+
+
+def collect_files(targets: Sequence[str], repo_root: str) -> List[str]:
+    """Expand dirs to .py files, skipping generated/vendored ones. A
+    nonexistent target raises — a typo'd path in a CI hook must not lint
+    nothing and report clean forever."""
+    out: List[str] = []
+    for target in targets:
+        path = target if os.path.isabs(target) else os.path.join(repo_root, target)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"lint target does not exist: {target}")
+        if os.path.isfile(path):
+            if path.endswith(".py") and os.path.basename(path) not in EXCLUDED_BASENAMES:
+                out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames if d not in EXCLUDED_DIRS)
+            for name in sorted(filenames):
+                if name.endswith(".py") and name not in EXCLUDED_BASENAMES:
+                    out.append(os.path.join(dirpath, name))
+    seen, uniq = set(), []
+    for p in out:
+        rp = os.path.realpath(p)
+        if rp not in seen:
+            seen.add(rp)
+            uniq.append(p)
+    return uniq
+
+
+# -- baseline ----------------------------------------------------------------------
+
+
+def baseline_key(f: Finding) -> Tuple[str, str, str]:
+    return (f.rule, f.path, f.snippet)
+
+
+def load_baseline(path: str) -> Counter:
+    """Multiset of (rule, path, snippet) keys the repo has accepted."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except OSError:
+        return Counter()
+    return Counter((e["rule"], e["path"], e.get("snippet", ""))
+                   for e in data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    entries = [{"rule": f.rule, "path": f.path, "snippet": f.snippet,
+                "message": f.message}
+               for f in sorted(findings, key=Finding.sort_key)]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=1)
+        fh.write("\n")
+
+
+# -- runner ------------------------------------------------------------------------
+
+
+@dataclass
+class Report:
+    """One run's outcome: what fires, what was hushed, what predates us."""
+
+    findings: List[Finding] = field(default_factory=list)      # actionable
+    suppressed: List[Finding] = field(default_factory=list)    # pragma'd, justified
+    baselined: List[Finding] = field(default_factory=list)     # accepted debt
+    errors: List[str] = field(default_factory=list)            # unparsable files
+    files_scanned: int = 0
+    rules_run: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.findings or self.errors) else 0
+
+    def tally(self) -> Dict[str, int]:
+        c: Counter = Counter(f.rule for f in self.findings)
+        return dict(sorted(c.items()))
+
+    def suppression_tally(self) -> Dict[str, int]:
+        c: Counter = Counter(f.rule for f in self.suppressed)
+        return dict(sorted(c.items()))
+
+
+def _apply_pragmas(findings: List[Finding], ctx_by_rel: Dict[str, ModuleContext],
+                   report: Report) -> List[Finding]:
+    """Split pragma-disabled findings out; a disable without a justification
+    comment is converted into a ``pragma-justification`` finding so silent
+    hushing is impossible."""
+    kept: List[Finding] = []
+    for f in findings:
+        ctx = ctx_by_rel.get(f.path)
+        pragma = ctx.pragmas.get(f.line) if ctx else None
+        if pragma and f.rule in pragma[0]:
+            if not pragma[1]:
+                kept.append(Finding(
+                    rule="pragma-justification", path=f.path, line=f.line,
+                    message=(f"disable={f.rule} needs an inline justification "
+                             "(`# surgelint: disable=... # <why>`)"),
+                    snippet=f.snippet))
+            else:
+                f.suppressed = True
+                f.justification = pragma[1]
+                report.suppressed.append(f)
+            continue
+        kept.append(f)
+    return kept
+
+
+def run_paths(paths: Sequence[str], repo_root: str,
+              select: Optional[Sequence[str]] = None,
+              baseline_path: Optional[str] = None) -> Report:
+    """Run the suite over ``paths`` (files or directories, repo-root
+    relative or absolute). Repo-scope rules always run over the canonical
+    DEFAULT_TARGETS, unfiltered — cross-file invariants hold or fail
+    repo-wide regardless of the requested path set."""
+    report = Report()
+    rules = all_rules()
+    if select:
+        unknown = sorted(set(select) - set(rules))
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {', '.join(unknown)}")
+        rules = {rid: r for rid, r in rules.items() if rid in select}
+    report.rules_run = sorted(rules)
+
+    requested_files = collect_files(paths, repo_root)
+    ctx_by_rel: Dict[str, ModuleContext] = {}
+    contexts: List[ModuleContext] = []
+    for path in requested_files:
+        try:
+            ctx = ModuleContext.parse(path, repo_root)
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            report.errors.append(f"{os.path.relpath(path, repo_root)}: {exc}")
+            continue
+        contexts.append(ctx)
+        ctx_by_rel[ctx.rel_path] = ctx
+    report.files_scanned = len(contexts)
+
+    raw: List[Finding] = []
+    module_rules = [r for r in rules.values() if not r.repo_scope]
+    for ctx in contexts:
+        for rule in module_rules:
+            raw.extend(rule.check_module(ctx))
+
+    repo_rules = [r for r in rules.values() if r.repo_scope]
+    if repo_rules:
+        # aggregate over the FULL canonical surface so cross-file invariants
+        # (key read in a file outside `paths`) hold under --changed runs
+        canon_files = collect_files(DEFAULT_TARGETS, repo_root)
+        canon_ctx: List[ModuleContext] = []
+        for path in canon_files:
+            rel = os.path.relpath(path, repo_root)
+            if rel in ctx_by_rel:
+                canon_ctx.append(ctx_by_rel[rel])
+                continue
+            try:
+                ctx = ModuleContext.parse(path, repo_root)
+            except (SyntaxError, UnicodeDecodeError, OSError):
+                continue  # already reported if requested; else not our file
+            canon_ctx.append(ctx)
+            ctx_by_rel[ctx.rel_path] = ctx
+        repo_ctx = RepoContext(repo_root, canon_ctx)
+        for rule in repo_rules:
+            # repo-rule findings are NEVER path-filtered: a cross-file drift
+            # often anchors in a file the user didn't touch (the DEFAULTS
+            # row, the proto file) — dropping it there would make a
+            # --changed run lie about the invariant it exists to guard
+            raw.extend(rule.check_repo(repo_ctx))
+
+    raw = _apply_pragmas(raw, ctx_by_rel, report)
+
+    baseline = load_baseline(baseline_path) if baseline_path else Counter()
+    remaining = Counter(baseline)
+    kept: List[Finding] = []
+    for f in sorted(raw, key=Finding.sort_key):
+        key = baseline_key(f)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            report.baselined.append(f)
+        else:
+            kept.append(f)
+    report.findings = kept
+    return report

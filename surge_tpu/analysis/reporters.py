@@ -1,0 +1,58 @@
+"""surgelint reporters — human text and machine JSON renderings of a Report."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from surge_tpu.analysis.core import Report
+
+__all__ = ["render_text", "render_json"]
+
+
+def render_text(report: Report, verbose: bool = False) -> str:
+    out: List[str] = []
+    for f in report.findings:
+        out.append(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+        if f.snippet:
+            out.append(f"    {f.snippet}")
+    if report.errors:
+        out.append("")
+        for e in report.errors:
+            out.append(f"error: {e}")
+    out.append("")
+    tally = report.tally()
+    if tally:
+        out.append("findings by rule: "
+                   + ", ".join(f"{r}={n}" for r, n in tally.items()))
+    stally = report.suppression_tally()
+    if stally:
+        out.append("suppressed (justified pragmas): "
+                   + ", ".join(f"{r}={n}" for r, n in stally.items()))
+        if verbose:
+            for f in report.suppressed:
+                out.append(f"  {f.path}:{f.line}: [{f.rule}] — {f.justification}")
+    if report.baselined:
+        out.append(f"baselined: {len(report.baselined)} accepted finding(s) "
+                   "(.surgelint-baseline.json)")
+    status = "FAILED" if report.exit_code else "clean"
+    out.append(f"surgelint: {status} — {len(report.findings)} finding(s), "
+               f"{len(report.suppressed)} suppressed, "
+               f"{len(report.baselined)} baselined, "
+               f"{report.files_scanned} file(s), "
+               f"{len(report.rules_run)} rule(s)")
+    return "\n".join(out)
+
+
+def render_json(report: Report) -> str:
+    return json.dumps({
+        "findings": [f.as_dict() for f in report.findings],
+        "suppressed": [f.as_dict() for f in report.suppressed],
+        "baselined": [f.as_dict() for f in report.baselined],
+        "errors": report.errors,
+        "files_scanned": report.files_scanned,
+        "rules_run": report.rules_run,
+        "tally": report.tally(),
+        "suppression_tally": report.suppression_tally(),
+        "exit_code": report.exit_code,
+    }, indent=1)

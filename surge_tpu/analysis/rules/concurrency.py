@@ -1,0 +1,286 @@
+"""Concurrency rules — the lock/await/cancellation defect classes this repo
+has actually shipped (and hand-caught in review) since PR 3.
+
+Each rule's docstring names the historical incident it encodes; the fixture
+corpus under tests/lint_fixtures/ pins the exact shapes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from surge_tpu.analysis.core import Finding, ModuleContext, Rule, register
+
+_THREADING_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+
+
+def _leaf_name(node: ast.AST) -> Optional[str]:
+    """`self._role_lock` -> `_role_lock`; bare `lock` -> `lock`."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _threading_lock_names(ctx: ModuleContext) -> Set[str]:
+    """Leaf names bound (anywhere in the module) to a threading Lock/RLock/
+    Condition constructor call. Matching With items by leaf name deliberately
+    crosses class boundaries: `with other._lock:` around an await is exactly
+    as deadlock-prone as `with self._lock:`."""
+    names: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call)
+                and ctx.dotted(value.func) in _THREADING_LOCK_CTORS):
+            continue
+        # only count the bare-name ctors when threading itself is imported —
+        # `Condition()` from asyncio would be a false positive
+        if isinstance(value.func, ast.Name) and "import threading" not in ctx.source \
+                and "from threading import" not in ctx.source:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            leaf = _leaf_name(t)
+            if leaf:
+                names.add(leaf)
+    return names
+
+
+@register
+class AwaitUnderLock(Rule):
+    """An ``await`` lexically inside a ``with <threading lock>`` body.
+
+    History: the PR-3 fsync-inside-producer-lock stall (replication acks had
+    to move OUTSIDE the lock so the pipelined window overlaps fsync) and the
+    PR-7 review round that re-unified Transact's fence check + in-flight
+    increment under ONE role-lock hold. A threading lock held across an await
+    blocks every OTHER event-loop task that needs it — the loop itself can
+    deadlock if the lock's holder is resumed by a callback the lock blocks.
+    """
+
+    id = "await-under-lock"
+    summary = "await inside a `with threading.Lock/RLock/Condition` body"
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        lock_names = _threading_lock_names(ctx)
+        if not lock_names:
+            return
+        for fn in ctx.async_functions():
+            yield from self._scan(ctx, fn, lock_names, held=None)
+
+    def _scan(self, ctx: ModuleContext, node: ast.AST, lock_names: Set[str],
+              held: Optional[str]) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue  # separate execution context
+            now_held = held
+            if isinstance(child, ast.With):
+                for item in child.items:
+                    expr = item.context_expr
+                    # unwrap `with lock:` vs `with lock_factory():`
+                    leaf = _leaf_name(expr)
+                    if leaf in lock_names:
+                        now_held = leaf
+            if isinstance(child, ast.Await) and now_held:
+                yield self.finding(
+                    ctx, child,
+                    f"await while holding threading lock `{now_held}` — the "
+                    "event loop (and every task needing the lock) stalls until "
+                    "this resumes; move the await outside the lock hold")
+                continue
+            yield from self._scan(ctx, child, lock_names, now_held)
+
+
+_BLOCKING_CALLS: Dict[str, str] = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "os.fsync": "dispatch through the log's group-sync worker or an executor",
+    "os.fdatasync": "dispatch through an executor",
+    "subprocess.run": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_call": "use `asyncio.create_subprocess_exec`",
+    "grpc.insecure_channel": "use `grpc.aio.insecure_channel` (the sync "
+                             "channel's RPCs block the loop)",
+    "grpc.secure_channel": "use `grpc.aio.secure_channel`",
+}
+
+
+@register
+class BlockingInAsync(Rule):
+    """A blocking syscall on the event loop: ``time.sleep``/``os.fsync``/sync
+    file I/O/sync gRPC channels/executor ``Future.result()`` directly inside
+    an ``async def`` (thunks handed to ``run_in_executor``/``to_thread`` are
+    nested defs or lambdas and are exempt by scope).
+
+    History: the PR-3 WAL rebuild existed precisely because per-commit
+    ``os.fsync`` on the loop serialized every committer behind 1.3–45 ms of
+    9p fsync; the event-loop prober (``surge.event-loop-prober.*``) was built
+    to catch survivors of this class at runtime — this rule catches them at
+    review time.
+    """
+
+    id = "blocking-in-async"
+    summary = "blocking call (sleep/fsync/file I/O/sync gRPC/Future.result) in async def"
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ctx.async_functions():
+            submit_vars = self._executor_submit_vars(fn)
+            for node in ctx.walk_scope(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = ctx.dotted(node.func)
+                if dotted in _BLOCKING_CALLS:
+                    yield self.finding(
+                        ctx, node,
+                        f"`{dotted}(...)` blocks the event loop inside "
+                        f"`async def {fn.name}` — {_BLOCKING_CALLS[dotted]}")
+                elif isinstance(node.func, ast.Name) and node.func.id == "open":
+                    yield self.finding(
+                        ctx, node,
+                        f"sync file I/O (`open`) inside `async def {fn.name}` "
+                        "blocks the event loop — read/write via "
+                        "`loop.run_in_executor` (9p fsync on this class of "
+                        "host runs 1.3-45ms)")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "result" and not node.args
+                      and self._is_executor_future(node.func.value, submit_vars)):
+                    yield self.finding(
+                        ctx, node,
+                        f"`Future.result()` on an executor future inside "
+                        f"`async def {fn.name}` parks the loop until the "
+                        "worker finishes — await "
+                        "`asyncio.wrap_future(...)` instead")
+
+    @staticmethod
+    def _executor_submit_vars(fn: ast.AST) -> Set[str]:
+        """Names assigned from `<executor>.submit(...)` in this function."""
+        out: Set[str] = set()
+        for node in ModuleContext.walk_scope(fn):
+            if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr == "submit"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    @staticmethod
+    def _is_executor_future(receiver: ast.AST, submit_vars: Set[str]) -> bool:
+        """`pool.submit(...).result()` or `fut.result()` where fut came from
+        a `.submit(...)` in the same function. asyncio futures' `.result()`
+        is non-blocking, so a bare receiver is NOT flagged."""
+        if isinstance(receiver, ast.Call) and isinstance(receiver.func, ast.Attribute) \
+                and receiver.func.attr == "submit":
+            return True
+        return isinstance(receiver, ast.Name) and receiver.id in submit_vars
+
+
+@register
+class WaitforCancellationSwallow(Rule):
+    """Bare ``asyncio.wait_for`` in a retry/poll loop (or on a task) without
+    the shield + re-cancel pattern.
+
+    History: the tier-1 cluster-test hang that silently truncated the suite
+    for two PRs — py3.10's ``wait_for`` swallows a cancellation that races a
+    timeout or a completing inner future (bpo-37658 family), so a loop built
+    on it keeps running after ``task.cancel()`` and the stop chain hangs
+    forever. ``BackgroundTask.stop`` re-cancels on a deadline loop over
+    ``wait_for(asyncio.shield(task), ...)``; the publisher's ``_Signal`` and
+    the entity's ``_Mailbox`` exist to avoid the shape entirely. Inside a
+    loop, wrap the awaitable in ``asyncio.shield`` and re-cancel on timeout
+    (common.py:BackgroundTask.stop), or use a ``_Mailbox``/``_Signal``.
+    """
+
+    id = "waitfor-cancellation-swallow"
+    summary = "asyncio.wait_for in a loop (or on a task) without shield+re-cancel"
+
+    _WAITFOR = {"asyncio.wait_for", "wait_for"}
+    _TASK_CTORS = {"asyncio.create_task", "asyncio.ensure_future",
+                   "create_task", "ensure_future"}
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ctx.async_functions():
+            task_vars = self._task_vars(ctx, fn)
+            yield from self._scan(ctx, fn, task_vars, in_loop=False)
+
+    def _task_vars(self, ctx: ModuleContext, fn: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in ctx.walk_scope(fn):
+            if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)
+                    and ctx.dotted(node.value.func) in self._TASK_CTORS):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _scan(self, ctx: ModuleContext, node: ast.AST, task_vars: Set[str],
+              in_loop: bool) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            child_in_loop = in_loop or isinstance(child, (ast.While, ast.For,
+                                                          ast.AsyncFor))
+            if isinstance(child, ast.Call) and ctx.dotted(child.func) in self._WAITFOR \
+                    and child.args:
+                inner = child.args[0]
+                shielded = (isinstance(inner, ast.Call)
+                            and ctx.dotted(inner.func) in ("asyncio.shield", "shield"))
+                on_task = isinstance(inner, ast.Name) and inner.id in task_vars
+                if not shielded and (child_in_loop or on_task):
+                    where = ("on a task" if on_task and not child_in_loop
+                             else "in a loop")
+                    yield self.finding(
+                        ctx, child,
+                        f"bare `asyncio.wait_for` {where}: py3.10 can swallow "
+                        "a cancellation racing the timeout (bpo-37658) and the "
+                        "loop outlives `task.cancel()` — wrap the awaitable in "
+                        "`asyncio.shield` and re-cancel on timeout "
+                        "(BackgroundTask.stop), or use a _Mailbox/_Signal")
+                    continue  # don't re-flag the inner call
+            yield from self._scan(ctx, child, task_vars, child_in_loop)
+
+
+@register
+class OrphanTask(Rule):
+    """``asyncio.create_task`` / ``ensure_future`` whose result is dropped on
+    the floor — nothing retains, awaits, or supervises it.
+
+    History: every supervised loop in this repo runs under
+    ``BackgroundTask`` (common.py) precisely because a dropped task handle
+    (a) can be garbage-collected mid-flight, (b) swallows its exception until
+    interpreter exit, and (c) cannot be stopped — the health supervisor's
+    restart contract needs the handle. Retain the task (attr/list), await it,
+    or wrap the loop in ``BackgroundTask``; genuine fire-and-forget teardown
+    needs a justified pragma.
+    """
+
+    id = "orphan-task"
+    summary = "create_task/ensure_future result dropped (not retained or supervised)"
+
+    _CTORS = {"asyncio.create_task", "asyncio.ensure_future",
+              "create_task", "ensure_future"}
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            dotted = ctx.dotted(call.func)
+            if dotted not in self._CTORS:
+                # also catch `loop.create_task(...)` / `get_event_loop().create_task`
+                if not (isinstance(call.func, ast.Attribute)
+                        and call.func.attr in ("create_task", "ensure_future")):
+                    continue
+            yield self.finding(
+                ctx, node,
+                "task handle dropped: the task can be GC'd mid-flight and its "
+                "exception is silently swallowed — retain it, await it, or "
+                "supervise it with BackgroundTask")

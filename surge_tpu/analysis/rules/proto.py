@@ -1,0 +1,192 @@
+"""proto-drift — the protoless pb2 regen's three-way contract.
+
+This repo regenerates ``surge_tpu/log/log_service_pb2.py`` WITHOUT protoc
+(tools/regen_log_proto.py patches the serialized FileDescriptorProto), keeps
+``proto/log_service.proto`` in sync BY HAND, and routes message-reuse RPCs
+through the hand-rolled ``METHODS`` table in ``surge_tpu/log/server.py``
+rather than the descriptor. Three artifacts, zero compiler checks — PR 4's
+regen shipped with the .proto comment block lagging the table until review
+caught it. :func:`check_proto_drift` diffs all three pairwise:
+
+- proto-file rpcs (declared + the ``//   Name(Req) returns (Reply)``
+  message-reuse comment block) vs the METHODS route table;
+- proto-file declared rpcs vs the pb2 descriptor's service;
+- proto-file message fields (name = number) vs the pb2 descriptor's messages.
+
+Inputs are injectable so the fixture corpus can exercise every drift class
+without touching the real artifacts.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from surge_tpu.analysis.core import Finding, RepoContext, Rule, register
+
+PROTO_PATH = "proto/log_service.proto"
+SERVER_PATH = "surge_tpu/log/server.py"
+
+_RPC_RE = re.compile(r"^\s*rpc\s+(\w+)\s*\(\s*(\w+)\s*\)\s*returns\s*\(\s*(\w+)\s*\)",
+                     re.M)
+_REUSE_RE = re.compile(r"^\s*//\s{1,4}(\w+)\((\w+)\)\s+returns\s+\((\w+)\)", re.M)
+_MESSAGE_RE = re.compile(r"^\s*message\s+(\w+)\s*\{(.*?)\}", re.M | re.S)
+_FIELD_RE = re.compile(
+    r"^\s*(?:repeated\s+|optional\s+)?(?:map\s*<[^>]*>|[\w.]+)\s+(\w+)\s*=\s*(\d+)\s*;",
+    re.M)
+
+Sig = Tuple[str, str]  # (request message, reply message)
+
+
+def parse_proto(text: str) -> Tuple[Dict[str, Sig], Dict[str, Sig],
+                                    Dict[str, Dict[str, int]]]:
+    """(declared rpcs, message-reuse comment rpcs, message fields)."""
+    # reuse rpcs live IN comments; everything else parses comment-stripped
+    # (a `}` inside a comment would otherwise truncate a message body)
+    reuse = {m.group(1): (m.group(2), m.group(3))
+             for m in _REUSE_RE.finditer(text)}
+    stripped = re.sub(r"//[^\n]*", "", text)
+    declared = {m.group(1): (m.group(2), m.group(3))
+                for m in _RPC_RE.finditer(stripped)}
+    messages: Dict[str, Dict[str, int]] = {}
+    for m in _MESSAGE_RE.finditer(stripped):
+        messages[m.group(1)] = {f.group(1): int(f.group(2))
+                                for f in _FIELD_RE.finditer(m.group(2))}
+    return declared, reuse, messages
+
+
+def parse_methods_table(source: str) -> Dict[str, Sig]:
+    """The METHODS route table from log/server.py, read via AST (no import
+    side effects, works on fixture snippets too)."""
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "METHODS"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        table: Dict[str, Sig] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(v, ast.Tuple)
+                    and len(v.elts) == 2):
+                continue
+            req, reply = (e.attr if isinstance(e, ast.Attribute) else
+                          e.id if isinstance(e, ast.Name) else "?"
+                          for e in v.elts)
+            table[k.value] = (req, reply)
+        return table
+    return {}
+
+
+def descriptor_state() -> Tuple[Dict[str, Sig], Dict[str, Dict[str, int]]]:
+    """(service methods, message fields) from the live pb2 descriptor."""
+    from google.protobuf import descriptor_pb2
+    from surge_tpu.log import log_service_pb2 as pb
+
+    fd = descriptor_pb2.FileDescriptorProto()
+    pb.DESCRIPTOR.CopyToProto(fd)
+    services: Dict[str, Sig] = {}
+    for svc in fd.service:
+        for method in svc.method:
+            services[method.name] = (method.input_type.split(".")[-1],
+                                     method.output_type.split(".")[-1])
+    messages = {m.name: {f.name: f.number for f in m.field}
+                for m in fd.message_type}
+    return services, messages
+
+
+def check_proto_drift(
+    proto_text: str,
+    methods: Dict[str, Sig],
+    pb2_services: Optional[Dict[str, Sig]] = None,
+    pb2_messages: Optional[Dict[str, Dict[str, int]]] = None,
+) -> List[str]:
+    """Pairwise drift between the .proto contract, the METHODS route table
+    and the pb2 descriptor. Returns human-readable drift lines (empty = in
+    sync). pb2 sides are optional so text-only fixtures stay cheap."""
+    declared, reuse, proto_messages = parse_proto(proto_text)
+    all_proto = {**declared, **reuse}
+    drift: List[str] = []
+
+    for name in sorted(set(all_proto) - set(methods)):
+        drift.append(f"rpc `{name}` is in proto/log_service.proto but has no "
+                     "METHODS route in log/server.py")
+    for name in sorted(set(methods) - set(all_proto)):
+        drift.append(f"METHODS route `{name}` is not in proto/log_service.proto "
+                     "(declare it, or document it in the message-reuse comment "
+                     "block)")
+    for name in sorted(set(methods) & set(all_proto)):
+        if methods[name] != all_proto[name]:
+            drift.append(
+                f"rpc `{name}` signature drift: proto says "
+                f"{all_proto[name][0]} -> {all_proto[name][1]}, METHODS routes "
+                f"{methods[name][0]} -> {methods[name][1]}")
+
+    if pb2_services is not None:
+        for name in sorted(set(declared) - set(pb2_services)):
+            drift.append(f"declared rpc `{name}` is missing from the pb2 "
+                         "descriptor service — run tools/regen_log_proto.py")
+        for name in sorted(set(pb2_services) - set(declared)):
+            drift.append(f"pb2 descriptor rpc `{name}` is not declared in "
+                         "proto/log_service.proto — sync the .proto by hand")
+        for name in sorted(set(declared) & set(pb2_services)):
+            if declared[name] != pb2_services[name]:
+                drift.append(
+                    f"rpc `{name}` signature drift: proto says "
+                    f"{declared[name][0]} -> {declared[name][1]}, pb2 has "
+                    f"{pb2_services[name][0]} -> {pb2_services[name][1]}")
+
+    if pb2_messages is not None:
+        for msg in sorted(set(proto_messages) - set(pb2_messages)):
+            drift.append(f"message `{msg}` is in the .proto but not the pb2 "
+                         "descriptor — run tools/regen_log_proto.py")
+        for msg in sorted(set(proto_messages) & set(pb2_messages)):
+            proto_fields, pb2_fields = proto_messages[msg], pb2_messages[msg]
+            for fname in sorted(set(proto_fields) - set(pb2_fields)):
+                drift.append(f"field `{msg}.{fname}` is in the .proto but not "
+                             "the pb2 descriptor — run tools/regen_log_proto.py")
+            for fname in sorted(set(pb2_fields) - set(proto_fields)):
+                drift.append(f"field `{msg}.{fname}` is in the pb2 descriptor "
+                             "but not the .proto — the protoless regen added "
+                             "it; sync proto/log_service.proto by hand")
+            for fname in sorted(set(proto_fields) & set(pb2_fields)):
+                if proto_fields[fname] != pb2_fields[fname]:
+                    drift.append(
+                        f"field `{msg}.{fname}` number drift: .proto says "
+                        f"{proto_fields[fname]}, pb2 has {pb2_fields[fname]}")
+        sigs = {n for sig in {**methods, **all_proto}.values() for n in sig}
+        for missing in sorted(sigs - set(pb2_messages) - {"?"}):
+            drift.append(f"message `{missing}` referenced by an rpc signature "
+                         "does not exist in the pb2 descriptor")
+    return drift
+
+
+def repo_drift(repo_root: str) -> List[str]:
+    """The real repo's three-way check (what --check and the lint rule run)."""
+    with open(os.path.join(repo_root, PROTO_PATH), encoding="utf-8") as f:
+        proto_text = f.read()
+    with open(os.path.join(repo_root, SERVER_PATH), encoding="utf-8") as f:
+        methods = parse_methods_table(f.read())
+    if not methods:
+        return [f"no METHODS table found in {SERVER_PATH}"]
+    services, messages = descriptor_state()
+    return check_proto_drift(proto_text, methods, services, messages)
+
+
+@register
+class ProtoDrift(Rule):
+    id = "proto-drift"
+    summary = "proto file / METHODS route table / pb2 descriptor out of sync"
+    repo_scope = True
+
+    def check_repo(self, ctx: RepoContext) -> Iterator[Finding]:
+        try:
+            lines = repo_drift(ctx.repo_root)
+        except Exception as exc:
+            yield Finding(rule=self.id, path=PROTO_PATH, line=1,
+                          message=f"proto drift check failed: {exc}")
+            return
+        for msg in lines:
+            yield Finding(rule=self.id, path=PROTO_PATH, line=1, message=msg)

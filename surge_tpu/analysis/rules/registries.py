@@ -1,0 +1,235 @@
+"""Registry-sync rules — config keys and metric instruments vs their
+registries, docs rows and golden catalogs.
+
+These are the static twins of the runtime coupling tests
+(tests/test_exposition.py's catalog-completeness parametrization and the
+golden `.om` comparisons): the runtime tests prove REGISTERED instruments are
+cataloged, but only see registries a test happens to construct; these rules
+read every creation site in the source, so an instrument or config key added
+in a module no test renders still cannot drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from surge_tpu.analysis.core import Finding, ModuleContext, RepoContext, Rule, register
+
+CONFIG_MODULE = "surge_tpu/config/__init__.py"
+OPERATIONS_DOC = "docs/operations.md"
+OBSERVABILITY_DOC = "docs/observability.md"
+GOLDEN_PATHS = ("tests/golden/metrics.om", "tests/golden/metrics_broker.om")
+#: instrument-creation modules the golden files render end to end — names
+#: created here must ALSO appear in a golden (regen + docs move together)
+GOLDEN_COUPLED_MODULES = ("surge_tpu/metrics/__init__.py",
+                          "surge_tpu/metrics/broker.py")
+
+_ACCESSORS = frozenset({"get", "get_int", "get_float", "get_bool", "get_str",
+                        "get_seconds", "get_int_list"})
+
+#: `surge.log.compaction.{enabled, interval-ms}` rows and `surge.producer.*`
+#: wildcard mentions both count as documentation
+_BRACE_RE = re.compile(r"(surge\.[\w.-]*?)\{([^}]*)\}")
+_PLAIN_RE = re.compile(r"surge\.[\w-]+(?:\.[\w*-]+)*")
+
+
+def documented_keys(text: str) -> Tuple[Set[str], Set[str]]:
+    """(exact keys, wildcard prefixes) mentioned in a markdown doc."""
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    for m in _BRACE_RE.finditer(text):
+        prefix = m.group(1)
+        for item in m.group(2).split(","):
+            # rows annotate keys in place — `{linger-ms (2 — the linger
+            # trigger), batch-max-records (512)}` — so each item's KEY is its
+            # first token; annotation fragments produced by commas inside a
+            # parenthetical yield garbage tokens that match no read key
+            token = item.strip().strip("`").split()
+            if token:
+                exact.add(prefix + token[0].strip("`"))
+    for m in _PLAIN_RE.finditer(text):
+        key = m.group(0)
+        if key.endswith(".*"):
+            prefixes.add(key[:-1])  # keep the trailing dot
+        elif "{" not in key:
+            exact.add(key.rstrip("."))
+    return exact, prefixes
+
+
+def _is_documented(key: str, exact: Set[str], prefixes: Set[str]) -> bool:
+    return key in exact or any(key.startswith(p) for p in prefixes)
+
+
+def config_reads(ctx: ModuleContext) -> List[Tuple[str, int]]:
+    """(key, line) for every typed-accessor read of a literal surge.* key."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ACCESSORS and node.args):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value.startswith("surge."):
+            out.append((arg.value, node.lineno))
+    return out
+
+
+def _string_constants(ctx: ModuleContext) -> Set[str]:
+    return {n.value for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            and n.value.startswith("surge.")}
+
+
+@register
+class ConfigKeyRegistry(Rule):
+    """Every ``surge.*`` config key read in code must exist in the
+    ``surge_tpu/config`` DEFAULTS registry AND have a row in
+    docs/operations.md; a DEFAULTS key nothing reads is dead weight.
+
+    History: by PR 7 a dozen keys (``surge.log.quorum.*``,
+    ``surge.store.checkpoint.*``, ``surge.metrics.exemplars``, …) were read
+    straight through ``Config.get`` fallbacks without a DEFAULTS row — their
+    env-override spelling was invisible, ``with_overrides`` keyword
+    canonicalization silently missed them, and the operations doc lagged the
+    code. The registry IS the contract; this rule machine-checks it.
+    """
+
+    id = "config-key-registry"
+    summary = "surge.* key read without a DEFAULTS row / docs row, or never read"
+    repo_scope = True
+
+    def check_repo(self, ctx: RepoContext) -> Iterator[Finding]:
+        try:
+            from surge_tpu.config import DEFAULTS
+        except Exception as exc:  # pragma: no cover — config import is jax-free
+            yield Finding(rule=self.id, path=CONFIG_MODULE, line=1,
+                          message=f"cannot import config DEFAULTS: {exc}")
+            return
+        cfg_ctx = ctx.module(CONFIG_MODULE)
+        doc_exact, doc_prefixes = documented_keys(ctx.doc_text(OPERATIONS_DOC))
+
+        reads: Dict[str, Tuple[ModuleContext, int]] = {}
+        mentioned: Set[str] = set()
+        for mod in ctx.modules:
+            # typed accessor bundles (TimeoutConfig/RetryConfig) read keys
+            # from inside the config module itself — those reads count; its
+            # string CONSTANTS don't (the DEFAULTS dict would mark every key
+            # "mentioned" and blind the dead-row check)
+            for key, line in config_reads(mod):
+                reads.setdefault(key, (mod, line))
+            if mod.rel_path != CONFIG_MODULE:
+                mentioned |= _string_constants(mod)
+
+        for key in sorted(reads):
+            mod, line = reads[key]
+            if key not in DEFAULTS:
+                yield Finding(
+                    rule=self.id, path=mod.rel_path, line=line,
+                    message=(f"config key `{key}` is read here but has no "
+                             "DEFAULTS row in surge_tpu/config — its env "
+                             "override spelling and with_overrides keyword "
+                             "canonicalization are invisible; register it"),
+                    snippet=mod.line_text(line))
+                # a docs row for an unregistered key is reported once the
+                # DEFAULTS row exists; one drift, one finding
+                continue
+            if not _is_documented(key, doc_exact, doc_prefixes):
+                yield Finding(
+                    rule=self.id, path=mod.rel_path, line=line,
+                    message=(f"config key `{key}` has no row in "
+                             f"{OPERATIONS_DOC} — add it to the config table"),
+                    snippet=mod.line_text(line))
+
+        for key in sorted(DEFAULTS):
+            line = self._defaults_line(cfg_ctx, key)
+            if key not in reads and key not in mentioned:
+                yield Finding(
+                    rule=self.id, path=CONFIG_MODULE, line=line,
+                    message=(f"DEFAULTS key `{key}` is never read in "
+                             "surge_tpu/tools/bench.py — dead registry row "
+                             "(remove it or wire the feature that reads it)"),
+                    snippet=cfg_ctx.line_text(line) if cfg_ctx else "")
+            if key not in reads and not _is_documented(key, doc_exact,
+                                                       doc_prefixes):
+                # read keys already reported their missing docs row above
+                yield Finding(
+                    rule=self.id, path=CONFIG_MODULE, line=line,
+                    message=(f"DEFAULTS key `{key}` has no row in "
+                             f"{OPERATIONS_DOC} — add it to the config table"),
+                    snippet=cfg_ctx.line_text(line) if cfg_ctx else "")
+
+    @staticmethod
+    def _defaults_line(cfg_ctx: Optional[ModuleContext], key: str) -> int:
+        if cfg_ctx is None:
+            return 1
+        needle = f'"{key}"'
+        for i, text in enumerate(cfg_ctx.lines, start=1):
+            if needle in text:
+                return i
+        return 1
+
+
+@register
+class MetricCatalog(Rule):
+    """Instrument names created in code must appear in the
+    docs/observability.md catalog; names created in the engine/broker quiver
+    modules must ALSO be in the golden ``.om`` files.
+
+    History: the golden/catalog coupling (PR 1, extended to the broker in
+    PR 5) is enforced at runtime only for registries the exposition tests
+    construct — the multilanguage gateway's timers drifted out of the docs
+    catalog unnoticed because no test renders that registry. This rule reads
+    every ``MetricInfo("surge.…")`` creation site instead.
+    """
+
+    id = "metric-catalog"
+    summary = "MetricInfo name missing from docs catalog / golden .om files"
+    repo_scope = True
+
+    def check_repo(self, ctx: RepoContext) -> Iterator[Finding]:
+        try:
+            from surge_tpu.metrics.exposition import sanitize_name
+        except Exception:  # pragma: no cover
+            def sanitize_name(n: str) -> str:
+                return re.sub(r"[^a-zA-Z0-9_:]", "_", n)
+        docs = ctx.doc_text(OBSERVABILITY_DOC)
+        golden_families: Set[str] = set()
+        for rel in GOLDEN_PATHS:
+            for m in re.finditer(r"^# TYPE (\S+) ", ctx.doc_text(rel), re.M):
+                golden_families.add(m.group(1))
+
+        for mod in ctx.modules:
+            for name, line in self._instrument_names(mod):
+                if name not in docs:
+                    yield Finding(
+                        rule=self.id, path=mod.rel_path, line=line,
+                        message=(f"instrument `{name}` is missing from the "
+                                 f"{OBSERVABILITY_DOC} metric catalog"),
+                        snippet=mod.line_text(line))
+                if mod.rel_path in GOLDEN_COUPLED_MODULES:
+                    fam = sanitize_name(name)
+                    if not any(g == fam or g.startswith(fam + "_")
+                               for g in golden_families):
+                        yield Finding(
+                            rule=self.id, path=mod.rel_path, line=line,
+                            message=(f"instrument `{name}` is missing from the "
+                                     "golden .om files — run tools/"
+                                     "regen_golden_metrics.py (golden and docs "
+                                     "catalog move together)"),
+                            snippet=mod.line_text(line))
+
+    @staticmethod
+    def _instrument_names(mod: ModuleContext) -> Iterator[Tuple[str, int]]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = node.func.id if isinstance(node.func, ast.Name) else (
+                node.func.attr if isinstance(node.func, ast.Attribute) else None)
+            if leaf not in ("MetricInfo", "MI") or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                    and arg.value.startswith("surge."):
+                yield arg.value, node.lineno

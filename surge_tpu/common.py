@@ -139,6 +139,75 @@ class BackgroundTask:
         return self._task is not None and not self._task.done()
 
 
+async def cancel_safe_wait_for(awaitable, timeout: float):
+    """Drop-in ``asyncio.wait_for`` without the py3.10 cancellation swallow.
+
+    ``asyncio.wait_for`` can swallow an outer cancellation that races a
+    timeout or a completing inner future (bpo-37658 family): it catches the
+    ``CancelledError``, returns the inner result, and the caller's loop keeps
+    running after ``task.cancel()`` — the tier-1 cluster-test hang. Built on
+    ``asyncio.wait``, which never catches cancellation: a racing cancel stays
+    pending on the task (``_must_cancel``) and fires at the caller's next
+    await point instead of vanishing.
+
+    Same contract as ``wait_for``: returns the result, raises
+    ``asyncio.TimeoutError`` on timeout (the awaitable is cancelled AND
+    awaited first, exactly like ``wait_for``'s ``_cancel_and_wait`` — that
+    extra suspension point matters: a caller cancelled while parked here must
+    die at the timeout boundary, not run one more loop body), propagates the
+    awaitable's exception. If the awaitable completes in the cancel window —
+    beating the timeout's cancel — its real result/exception is returned/
+    raised rather than masked as TimeoutError (and rather than rotting as an
+    unretrieved task exception).
+    """
+    task = asyncio.ensure_future(awaitable)
+    try:
+        done, _ = await asyncio.wait((task,), timeout=timeout)
+    except BaseException:
+        task.cancel()
+        try:
+            # bpo-32751 parity: the inner task must not outlive wait_for —
+            # its cleanup (e.g. a publish lane's finally) finishes before the
+            # caller's CancelledError propagates
+            await asyncio.wait((task,))
+        finally:
+            if task.done() and not task.cancelled():
+                task.exception()  # retrieve: don't rot as 'never retrieved'
+        raise
+    if task in done:
+        return task.result()
+    task.cancel()
+    try:
+        await asyncio.wait((task,))  # cancellation of the CALLER lands here too
+    except BaseException:
+        if task.done() and not task.cancelled():
+            task.exception()  # retrieve before the caller's cancel wins
+        raise
+    if not task.cancelled():
+        return task.result()  # completion (or a real failure) beat the cancel
+    raise asyncio.TimeoutError
+
+
+def spawn_reaped(registry: set, coro: Coroutine[Any, Any, Any],
+                 what: str) -> "asyncio.Task":
+    """Spawn a fire-and-forget coroutine WITHOUT orphaning it: the task is
+    retained in ``registry`` (so it cannot be garbage-collected mid-flight),
+    discarded when done, and a non-cancellation failure is logged instead of
+    rotting until interpreter exit. The house pattern behind the orphan-task
+    lint rule — use this (or BackgroundTask for loops) wherever the result
+    genuinely has no awaiter."""
+    task = asyncio.ensure_future(coro)
+    registry.add(task)
+
+    def _reap(t: "asyncio.Task") -> None:
+        registry.discard(t)
+        if not t.cancelled() and t.exception() is not None:
+            logger.error("%s failed", what, exc_info=t.exception())
+
+    task.add_done_callback(_reap)
+    return task
+
+
 def resolve_future(fut: "asyncio.Future[T]", value: T) -> None:
     if not fut.done():
         fut.set_result(value)

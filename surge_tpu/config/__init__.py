@@ -54,6 +54,9 @@ DEFAULTS: dict[str, Any] = {
     "surge.producer.enable-transactions": True,
     # publish dedup window (the PublishTracker 60s TTL, KafkaProducerActorImpl.scala:580-608)
     "surge.producer.publish-dedup-ttl-ms": 60_000,
+    # verbatim retries of an unknown-outcome batch before its waiters fail
+    # over to the entity retry ladder
+    "surge.producer.publish-retry-max": 8,
     # --- state store / ktable (reference: surge.kafka-streams.*) ---
     "surge.state-store.commit-interval-ms": 3_000,
     "surge.state-store.restore-max-poll-records": 500,
@@ -79,6 +82,10 @@ DEFAULTS: dict[str, Any] = {
     # INLINE on the event loop instead of paying the thread-pool hop (~80us
     # per command) — big payloads still offload. 0 = always off-thread.
     "surge.serialization.inline-max-events": 4,
+    # --- metrics ---
+    # capture OpenMetrics exemplars (trace id per histogram bucket) on the
+    # ENGINE registry; broker registries are always exemplar-on
+    "surge.metrics.exemplars": False,
     # --- replay engine (new: the TPU north star; BASELINE.json replayBackend=tpu) ---
     "surge.replay.backend": "tpu",  # tpu | cpu (scalar fold)
     "surge.replay.restore-on-start": False,  # engine cold start folds the events topic
@@ -96,6 +103,31 @@ DEFAULTS: dict[str, Any] = {
     "surge.replay.length-buckets": "64,256,1024,4096",
     "surge.replay.mesh-axes": "data",
     "surge.replay.donate-carry": True,
+    # scan-step dispatch ("switch" = lax.switch over schema branches,
+    # "select" = compute-all-and-select) and the tile-loop backend ("auto"
+    # picks the scanless assoc tree fold for models shipping AssociativeFold)
+    "surge.replay.dispatch": "switch",  # switch | select
+    "surge.replay.tile-backend": "auto",  # auto | xla | pallas | assoc
+    # resident tile layout: "dense" pre-gathers every tile once per corpus
+    # when the buffers fit dense-cap-mb of HBM; "flat" gathers per pass
+    "surge.replay.resident-layout": "auto",  # auto | flat | dense
+    "surge.replay.dense-cap-mb": 2048,
+    # bucket resident-corpus row lengths to powers of two ("pow2") so the
+    # jit cache sees few shapes, or keep exact lengths ("exact")
+    "surge.replay.resident-len-bucket": "pow2",  # pow2 | exact
+    # chunked H2D upload: pieces of this many MB pipeline over high-latency
+    # links and reassemble on device (0 = single put; single-device resident
+    # path only — the sharded upload already ships per-device pieces)
+    "surge.replay.upload-chunk-mb": 0,
+    # overlap segment-stream uploads with replay dispatches in N segments
+    # (0/1 = plain upload+replay)
+    "surge.replay.upload-stream-segments": 0,
+    # columnar-segment cold start: keep the whole wire corpus resident on
+    # device ("resident") or stream per-window ("streaming"); mesh-sharded
+    # restores always stream
+    "surge.replay.segment-backend": "resident",  # resident | streaming
+    # cache the packed wire tensors alongside the segment for re-replays
+    "surge.replay.segment-wire-cache": True,
     # columnar-segment cold start: when set, rebuild_from_events streams this
     # segment (building it once from the topics if absent) instead of folding
     # per-event Python objects
@@ -132,6 +164,23 @@ DEFAULTS: dict[str, Any] = {
     # an idle round waits on wait_for_append before re-polling
     "surge.replay.resident.refresh-max-poll-records": 4096,
     "surge.replay.resident.refresh-interval-ms": 50,
+    # --- state checkpoints (surge_tpu.store.checkpoint; compaction.md) ---
+    # directory for atomic checkpoint files ("" disables the writer); the
+    # incremental writer materializes on interval + min-events cadence and
+    # retains the newest `keep` checkpoints
+    "surge.store.checkpoint.path": "",
+    "surge.store.checkpoint.interval-ms": 30_000,
+    "surge.store.checkpoint.min-events": 1,
+    "surge.store.checkpoint.keep": 2,
+    # --- broker-side log compaction (surge_tpu.log.compactor; compaction.md) ---
+    # dirty-ratio scheduler: a pass runs when dirty/total >= min-dirty-ratio
+    # AND dirty records >= min-dirty-records, checked every interval;
+    # tombstones older than the retention are GC'd
+    "surge.log.compaction.enabled": False,
+    "surge.log.compaction.interval-ms": 30_000,
+    "surge.log.compaction.min-dirty-ratio": 0.5,
+    "surge.log.compaction.min-dirty-records": 64,
+    "surge.log.compaction.tombstone-retention-ms": 60_000,
     # --- log broker replication (acks=all role, common reference.conf:112-124) ---
     # how long a commit waits for the follower ack before failing back to the
     # client (which retries the same txn_seq and re-joins the queued item)
@@ -152,6 +201,11 @@ DEFAULTS: dict[str, Any] = {
     # landing. Beyond the cap (fresh/empty replicas) the follower stays out
     # until catch_up bulk-copies it. 0 disables auto-resync.
     "surge.log.replication-auto-resync-max-records": 10_000,
+    # quorum acks: replicas (leader included) that must hold a commit before
+    # it acks; 0 = every in-sync replica (strict acks=all). N < replicas
+    # trades the straggler's ship timeout out of commit latency and gates
+    # follower reads at the quorum-acked high-watermark.
+    "surge.log.replication.min-insync-acks": 0,
     # pipelined transactions: how long the broker's in-order apply gate
     # waits for a missing predecessor txn_seq (a pipelined window arriving
     # out of order) before answering retriable — the client retries the
@@ -169,6 +223,17 @@ DEFAULTS: dict[str, Any] = {
     # declared dead (a follower booting first must not promote over a leader
     # that is still starting; bounded so a truly absent leader still fails over)
     "surge.log.failover.bootstrap-grace-factor": 10,
+    # --- quorum cluster (majority-vote promotion; docs/operations.md) ---
+    # full symmetric cluster membership (comma-separated, the SAME list on
+    # every broker); non-empty switches prober-declared leader death from
+    # self-promotion to VoteLeader campaigns
+    "surge.log.quorum.peers": "",
+    "surge.log.quorum.vote-timeout-ms": 1_000,  # per-peer VoteLeader RPC
+    "surge.log.quorum.vote-rounds": 5,  # campaign rounds before stand-down
+    # --- flight recorder ---
+    # directory the broker auto-dumps its flight ring to when the fault
+    # plane hard-kills it ("" disables; live dumps via the DumpFlight RPC)
+    "surge.log.flight.dump-dir": "",
     # --- FileLog WAL journal rotation ---
     # rotate commits.log (which embeds WAL payloads) once its durable bytes
     # exceed this: segments are fsynced first, then a frontier line opens the

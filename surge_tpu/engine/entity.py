@@ -33,7 +33,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
 
-from surge_tpu.common import DecodedState, fail_future, logger, resolve_future
+from surge_tpu.common import (DecodedState, cancel_safe_wait_for, fail_future,
+                              logger, resolve_future)
 from surge_tpu.config import Config, RetryConfig, TimeoutConfig, default_config
 from surge_tpu.engine.business_logic import SurgeModel
 from surge_tpu.engine.model import RejectedCommand
@@ -388,7 +389,7 @@ class AggregateEntity:
             for _ in range(self.retry.publish_max_retries + 1):
                 try:
                     with self.metrics.publish_timer.time():
-                        await asyncio.wait_for(
+                        await cancel_safe_wait_for(
                             self.publisher.publish(self.aggregate_id, records,
                                                    request_id,
                                                    headers=env.headers),

@@ -50,7 +50,8 @@ from dataclasses import dataclass
 from typing import (Callable, Deque, Dict, List, Mapping, Optional, Protocol,
                     Sequence)
 
-from surge_tpu.common import BackgroundTask, fail_future, logger, resolve_future
+from surge_tpu.common import (BackgroundTask, fail_future, logger,
+                              resolve_future, spawn_reaped)
 from surge_tpu.config import Config, default_config
 from surge_tpu.log.transport import (
     LogRecord,
@@ -255,6 +256,7 @@ class PartitionPublisher:
         # rare path — a plain Event is fine there)
         self._wake = _Signal()
         self._batch_full = _Signal()
+        self._self_stops: set = set()  # not-owner teardown tasks (reaped)
         self._pending_room = asyncio.Event()
         self._pending_room.set()
         self._pending_bytes = 0
@@ -942,9 +944,13 @@ class PartitionPublisher:
         else:
             self.on_signal("surge.producer.shutdown-not-owner", "warning")
             # runs inside the flush loop: mark stopped now, cancel the loops from a
-            # separate task (a task cannot await its own cancellation)
+            # separate task (a task cannot await its own cancellation);
+            # retained + reaped so the teardown can't be GC'd mid-stop and a
+            # failing stop logs instead of rotting
             self.state = "stopped"
-            asyncio.ensure_future(self.stop())
+            spawn_reaped(self._self_stops, self.stop(),
+                         f"publisher {self.state_topic}[{self.partition}] "
+                         "not-owner self-stop")
 
     def _purge_dedup(self) -> None:
         cutoff = time.time() - self._dedup_ttl_s

@@ -13,7 +13,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Tuple
 
-from surge_tpu.common import Ack, Controllable, fail_future, logger
+from surge_tpu.common import (Ack, Controllable, fail_future, logger,
+                              spawn_reaped)
 from surge_tpu.engine.entity import Envelope
 from surge_tpu.engine.partition import (
     AssignmentChanges,
@@ -61,6 +62,7 @@ class RouterBase(Controllable):
         # the routing hop's span mirrors KafkaPartitionShardRouterActor:216
         self.tracer = None
         self._regions: Dict[int, object] = {}
+        self._region_stops: set = set()  # in-flight region teardowns (reaped)
         self._pending: Dict[int, List[Tuple[str, Envelope]]] = {}
         self._started = False
 
@@ -142,7 +144,8 @@ class RouterBase(Controllable):
         region = self._regions.pop(partition, None)
         if region is not None:
             logger.info("%s: stopping %s region %d", self.health_name, why, partition)
-            asyncio.ensure_future(region.stop())
+            spawn_reaped(self._region_stops, region.stop(),
+                         f"{self.health_name} region {partition} stop")
 
     def _drain_pending(self) -> None:
         """Dispatch buffered deliveries whose owner is now known."""

@@ -19,14 +19,14 @@ Equivalents of the reference health stack (SURVEY.md §5.3/§5.5):
 
 from __future__ import annotations
 
-import asyncio
 import re
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Pattern, Sequence
 
-from surge_tpu.common import Ack, CircularBuffer, Controllable, logger
+from surge_tpu.common import (Ack, CircularBuffer, Controllable, logger,
+                              spawn_reaped)
 from surge_tpu.config import Config, default_config
 
 __all__ = [
@@ -186,6 +186,10 @@ class HealthSupervisor:
         self._threshold = cfg.get_int("surge.health.window-buffer-size", 10)
         self._registrations: Dict[str, _Registration] = {}
         self._started = False
+        # restart/shutdown dispatches in flight: retained so a failing
+        # action surfaces its exception instead of dying silently with a
+        # GC'd task (the supervisor IS the last line of defense)
+        self._actions: set = set()
 
     def start(self) -> None:
         if not self._started:
@@ -228,9 +232,11 @@ class HealthSupervisor:
         for reg in self._registrations.values():
             reg.window.add(signal)
             if any(m.matches(signal, reg.window) for m in reg.shutdown_matchers):
-                asyncio.ensure_future(self._shutdown(reg, signal))
+                spawn_reaped(self._actions, self._shutdown(reg, signal),
+                             f"supervisor shutdown of {reg.name}")
             elif any(m.matches(signal, reg.window) for m in reg.restart_matchers):
-                asyncio.ensure_future(self._restart(reg, signal))
+                spawn_reaped(self._actions, self._restart(reg, signal),
+                             f"supervisor restart of {reg.name}")
 
     async def _restart(self, reg: _Registration, signal: HealthSignal) -> None:
         if reg.restarts >= self.max_restarts:

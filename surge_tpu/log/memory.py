@@ -19,6 +19,7 @@ import time
 from bisect import bisect_left
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
+from surge_tpu.common import cancel_safe_wait_for
 from surge_tpu.log.transport import (
     LogRecord,
     ProducerFencedError,
@@ -107,7 +108,7 @@ class LogBase:
                 ev = asyncio.Event()
                 self._append_events[tp] = ev
             try:
-                await asyncio.wait_for(ev.wait(), timeout=0.5)
+                await cancel_safe_wait_for(ev.wait(), timeout=0.5)
             except asyncio.TimeoutError:
                 pass  # re-check end_offset (guards against lost wakeups across loops)
 

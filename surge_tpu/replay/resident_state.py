@@ -63,7 +63,8 @@ import numpy as np
 
 from surge_tpu.codec.tensor import encode_events, encode_events_columnar
 from surge_tpu.codec.wire import WireFormat
-from surge_tpu.common import Ack, BackgroundTask, Controllable, logger
+from surge_tpu.common import (Ack, BackgroundTask, Controllable, logger,
+                              spawn_reaped)
 from surge_tpu.config import Config, default_config
 from surge_tpu.engine.model import ReplaySpec
 from surge_tpu.log.transport import page_keyed_records
@@ -180,6 +181,7 @@ class ResidentStatePlane(Controllable):
         # read gather lane
         self._pending: List[Tuple[str, asyncio.Future]] = []
         self._draining = False
+        self._drain_tasks: set = set()
 
         self._task: Optional[BackgroundTask] = None
         self._running = False
@@ -1147,7 +1149,11 @@ class ResidentStatePlane(Controllable):
     def _kick_drain(self) -> None:
         if not self._draining:
             self._draining = True
-            asyncio.ensure_future(self._drain_reads())
+            # retained + reaped: if the drain task were GC'd mid-flight,
+            # _draining would stay True forever and the gather lane would
+            # wedge; an escaping failure logs instead of rotting
+            spawn_reaped(self._drain_tasks, self._drain_reads(),
+                         "resident gather-lane drain")
 
     async def _drain_reads(self) -> None:
         """The gather lane: coalesce every queued read — single ``read_state``

@@ -22,7 +22,8 @@ from __future__ import annotations
 import asyncio
 from typing import Callable, Dict, List, Optional, Sequence
 
-from surge_tpu.common import Ack, BackgroundTask, Controllable, logger
+from surge_tpu.common import (Ack, BackgroundTask, Controllable,
+                              cancel_safe_wait_for, logger, spawn_reaped)
 from surge_tpu.config import Config, default_config
 from surge_tpu.log.transport import LogRecord
 from surge_tpu.store.kv import KeyValueStore, create_store
@@ -53,6 +54,7 @@ class StateStoreIndexer(Controllable):
         # revoke); a re-grant chains its new loop behind this so two loops never
         # tail one partition concurrently
         self._stopping: Dict[int, asyncio.Task] = {}
+        self._chains: set = set()  # stop→restart chains in flight (reaped)
         self._running = False
         self._state_listeners: List[Callable[[str], None]] = []
 
@@ -133,7 +135,8 @@ class StateStoreIndexer(Controllable):
                     self._tasks[p] = t
                     t.start()
 
-            asyncio.ensure_future(chain())
+            spawn_reaped(self._chains, chain(),
+                         f"indexer {self.state_topic}[{p}] restart chain")
             return
         t = BackgroundTask(self._make_partition_loop(p),
                            f"indexer-{self.state_topic}-{p}")
@@ -221,7 +224,7 @@ class StateStoreIndexer(Controllable):
                         self._watermarks[partition] = end
                         backoff = 0.25
                         continue
-                    await asyncio.wait_for(
+                    await cancel_safe_wait_for(
                         self.log.wait_for_append(self.state_topic, partition,
                                                  offset),
                         timeout=self._poll_timeout)

@@ -1,0 +1,14 @@
+"""Known-bad: awaits lexically inside threading-lock bodies."""
+import threading
+
+
+class Broker:
+    def __init__(self):
+        self._role_lock = threading.RLock()
+        self.cond = threading.Condition(self._role_lock)
+
+    async def transact(self, batch):
+        with self._role_lock:
+            await self._replicate(batch)  # line 12: await under RLock
+        with self.cond:
+            return await self._finalize()  # line 14: await under Condition

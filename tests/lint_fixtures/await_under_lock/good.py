@@ -1,0 +1,30 @@
+"""Known-good: lock holds are await-free; awaits happen outside, or under an
+asyncio.Lock (which is built for exactly this)."""
+import asyncio
+import threading
+
+
+class Broker:
+    def __init__(self):
+        self._role_lock = threading.RLock()
+        self._aio_lock = asyncio.Lock()
+
+    async def transact(self, batch):
+        with self._role_lock:
+            fenced = self._check_fence(batch)
+        if not fenced:
+            await self._replicate(batch)
+        async with self._aio_lock:
+            await self._finalize()
+
+    def snapshot(self):
+        with self._role_lock:
+            return dict(self._state)
+
+    async def dispatch(self, loop):
+        # a nested thunk handed to an executor runs OFF the loop: its body
+        # is a separate execution context, not an await under the lock
+        def _locked_io():
+            with self._role_lock:
+                return self._fsync()
+        return await loop.run_in_executor(None, _locked_io)

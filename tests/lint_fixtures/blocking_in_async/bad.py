@@ -1,0 +1,17 @@
+"""Known-bad: blocking syscalls directly on the event loop."""
+import os
+import time
+
+import grpc
+
+
+class Journal:
+    async def flush(self, executor):
+        time.sleep(0.01)  # line 10: sleep on the loop
+        with open("journal.log", "ab") as f:  # line 11: sync file I/O
+            os.fsync(f.fileno())  # line 12: fsync on the loop
+        fut = executor.submit(self._sync_round)
+        return fut.result()  # line 14: executor future blocks the loop
+
+    async def dial(self, target):
+        return grpc.insecure_channel(target)  # line 17: sync gRPC channel

@@ -1,0 +1,29 @@
+"""Known-good: the same operations dispatched correctly — async sleeps,
+executor thunks for file I/O, aio channels, awaited executor futures."""
+import asyncio
+import os
+import time
+
+import grpc
+
+
+class Journal:
+    async def flush(self, loop, executor):
+        await asyncio.sleep(0.01)
+
+        def _sync_round():
+            with open("journal.log", "ab") as f:  # executor thunk: off-loop
+                os.fsync(f.fileno())
+                time.sleep(0.001)
+        await loop.run_in_executor(executor, _sync_round)
+        fut = executor.submit(_sync_round)
+        return await asyncio.wrap_future(fut)
+
+    async def dial(self, target):
+        return grpc.aio.insecure_channel(target)
+
+    def sync_maintenance(self):
+        # a plain def may block all it wants — it runs on a worker thread
+        time.sleep(0.01)
+        with open("journal.log", "ab") as f:
+            os.fsync(f.fileno())

@@ -1,0 +1,7 @@
+"""Known-bad: reads a surge.* key that has no DEFAULTS row (and no docs row)."""
+from surge_tpu.config import default_config
+
+
+def load():
+    cfg = default_config()
+    return cfg.get_int("surge.lint-fixture.unregistered-key", 7)  # line 7

@@ -1,0 +1,8 @@
+"""Known-good: reads only registered, documented keys."""
+from surge_tpu.config import default_config
+
+
+def load():
+    cfg = default_config()
+    return (cfg.get_str("surge.replay.backend", "tpu"),
+            cfg.get_int("surge.replay.batch-size", 8192))

@@ -1,0 +1,17 @@
+"""Known-bad: Python side effects inside a staged fold."""
+import time
+
+import jax
+
+TRACE_LOG = []
+CACHE = {}
+
+
+def build(width):
+    def fold(carry, window):
+        print("folding", width)  # line 12: trace-time print
+        TRACE_LOG.append(window)  # line 13: closed-over mutation
+        t0 = time.time()  # line 14: wall-clock read baked into the trace
+        CACHE["last"] = carry  # line 15: closed-over subscript assignment
+        return carry, t0
+    return jax.jit(fold)

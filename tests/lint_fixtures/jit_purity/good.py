@@ -1,0 +1,21 @@
+"""Known-good: staged folds mutate only their own locals and call only
+array ops; timestamps arrive as arguments."""
+import jax
+import jax.numpy as jnp
+
+
+def build(width):
+    def fold(carry, window, now):
+        parts = []
+        parts.append(carry)  # local list: trace-time assembly is fine
+        acc = {}
+        acc["w"] = window  # local dict subscript is fine
+        return jnp.add(carry, window) + now
+
+    return jax.jit(fold)
+
+
+def host_side(records, stats):
+    # unstaged host code may print/mutate freely
+    print("decoded", len(records))
+    stats.append(len(records))

@@ -1,0 +1,6 @@
+"""Known-bad: creates an instrument missing from the docs catalog."""
+from surge_tpu.metrics import MetricInfo, Metrics
+
+
+def build(m: Metrics):
+    return m.timer(MetricInfo("surge.lint-fixture.mystery-timer", "x"))  # line 6

@@ -1,0 +1,6 @@
+"""Known-good: creates only cataloged instruments."""
+from surge_tpu.metrics import MetricInfo, Metrics
+
+
+def build(m: Metrics):
+    return m.timer(MetricInfo("surge.aggregate.command-handling-timer", "x"))

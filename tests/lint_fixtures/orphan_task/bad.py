@@ -1,0 +1,10 @@
+"""Known-bad: task handles dropped on the floor."""
+import asyncio
+
+
+class Engine:
+    def kick(self):
+        asyncio.ensure_future(self._refresh())  # line 7: dropped
+
+    def schedule(self, loop):
+        loop.create_task(self._refresh())  # line 10: dropped (loop method)

@@ -1,0 +1,21 @@
+"""Known-good: every spawned task is retained, awaited, or supervised."""
+import asyncio
+
+from surge_tpu.common import BackgroundTask
+
+
+class Engine:
+    def __init__(self):
+        self._tasks = set()
+        self._loop_task = BackgroundTask(self._refresh, "engine-refresh")
+
+    def kick(self):
+        task = asyncio.ensure_future(self._refresh())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    def start(self):
+        self._loop_task.start()
+
+    async def once(self):
+        await asyncio.create_task(self._refresh())

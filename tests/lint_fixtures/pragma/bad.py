@@ -1,0 +1,7 @@
+"""Known-bad: a disable pragma without the required justification comment."""
+import asyncio
+
+
+class Engine:
+    def kick(self):
+        asyncio.ensure_future(self._go())  # surgelint: disable=orphan-task

@@ -1,0 +1,7 @@
+"""Known-good: a justified suppression — tallied, not failed."""
+import asyncio
+
+
+class Engine:
+    def kick(self):
+        asyncio.ensure_future(self._go())  # surgelint: disable=orphan-task  # teardown is fire-and-forget by design; stop() reaps it

@@ -1,0 +1,12 @@
+"""Known-bad: bare asyncio.wait_for in a poll loop and on a task."""
+import asyncio
+
+
+class Poller:
+    async def run(self):
+        while True:
+            await asyncio.wait_for(self._poll(), timeout=0.5)  # line 8: loop
+
+    async def join(self):
+        task = asyncio.create_task(self._poll())
+        await asyncio.wait_for(task, timeout=1.0)  # line 12: on a task

@@ -1,0 +1,17 @@
+"""Known-good: the shield + re-cancel pattern (BackgroundTask.stop), and a
+one-shot wait_for outside any loop."""
+import asyncio
+
+
+class Poller:
+    async def stop(self, task):
+        task.cancel()
+        for _ in range(120):
+            try:
+                await asyncio.wait_for(asyncio.shield(task), timeout=0.25)
+                return
+            except asyncio.TimeoutError:
+                task.cancel()
+
+    async def ask(self, fut):
+        return await asyncio.wait_for(fut, timeout=1.0)

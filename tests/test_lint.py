@@ -1,0 +1,360 @@
+"""surgelint — the repo-native static analysis suite (surge_tpu/analysis).
+
+Three layers:
+
+- per-rule fixture corpus (tests/lint_fixtures/): every shipped rule catches
+  its known-bad snippet at EXACT rule ids + line numbers and stays quiet on
+  the known-good one;
+- framework mechanics: pragma suppression (justification required, tallied),
+  baseline round-trip, JSON reporter, CLI smoke;
+- the tier-1 gate: the full suite over surge_tpu/, tools/ and bench.py must
+  come back with ZERO unbaselined findings inside the time budget — a new
+  finding fails tier-1 until it is fixed, justified inline, or explicitly
+  baselined (docs/static-analysis.md).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from surge_tpu.analysis import (
+    DEFAULT_TARGETS,
+    ModuleContext,
+    RepoContext,
+    all_rules,
+    render_json,
+    run_paths,
+    write_baseline,
+)
+from surge_tpu.analysis.rules.proto import (
+    check_proto_drift,
+    parse_methods_table,
+    parse_proto,
+    repo_drift,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+BASELINE = os.path.join(REPO, ".surgelint-baseline.json")
+
+
+def _module_findings(rule_id: str, path: str):
+    rule = all_rules()[rule_id]
+    ctx = ModuleContext.parse(path, REPO)
+    return sorted((f.rule, f.line) for f in rule.check_module(ctx))
+
+
+def _repo_rule_findings(rule_id: str, path: str):
+    """Run a repo-scope rule with ONLY the fixture as its module set (real
+    DEFAULTS / docs / goldens as the registries), filtered to the fixture."""
+    rule = all_rules()[rule_id]
+    ctx = ModuleContext.parse(path, REPO)
+    repo_ctx = RepoContext(REPO, [ctx])
+    return sorted((f.rule, f.line) for f in rule.check_repo(repo_ctx)
+                  if f.path == ctx.rel_path)
+
+
+# -- per-rule fixture corpus ---------------------------------------------------------
+
+MODULE_RULE_CASES = [
+    ("await-under-lock", "await_under_lock", [12, 14]),
+    ("blocking-in-async", "blocking_in_async", [10, 11, 12, 14, 17]),
+    ("waitfor-cancellation-swallow", "waitfor_cancellation_swallow", [8, 12]),
+    ("orphan-task", "orphan_task", [7, 10]),
+    ("jit-purity", "jit_purity", [12, 13, 14, 15]),
+]
+
+
+@pytest.mark.parametrize("rule_id,fixture,bad_lines", MODULE_RULE_CASES,
+                         ids=[c[0] for c in MODULE_RULE_CASES])
+def test_module_rule_fixture_corpus(rule_id, fixture, bad_lines):
+    bad = _module_findings(rule_id, os.path.join(FIXTURES, fixture, "bad.py"))
+    assert bad == [(rule_id, ln) for ln in bad_lines], bad
+    good = _module_findings(rule_id, os.path.join(FIXTURES, fixture, "good.py"))
+    assert good == [], good
+
+
+@pytest.mark.parametrize("rule_id,fixture,bad_lines", [
+    ("config-key-registry", "config_key_registry", [7]),
+    ("metric-catalog", "metric_catalog", [6]),
+], ids=["config-key-registry", "metric-catalog"])
+def test_repo_rule_fixture_corpus(rule_id, fixture, bad_lines):
+    bad = _repo_rule_findings(rule_id,
+                              os.path.join(FIXTURES, fixture, "bad.py"))
+    assert bad == [(rule_id, ln) for ln in bad_lines], bad
+    good = _repo_rule_findings(rule_id,
+                               os.path.join(FIXTURES, fixture, "good.py"))
+    assert good == [], good
+
+
+def test_metric_catalog_golden_coupling(tmp_path):
+    """An instrument created in a golden-coupled module (the engine/broker
+    quivers) must ALSO be in a golden .om file — docs row alone is not
+    enough, because golden and catalog regen together."""
+    mod_dir = tmp_path / "surge_tpu" / "metrics"
+    mod_dir.mkdir(parents=True)
+    mod = mod_dir / "broker.py"
+    mod.write_text(
+        "from surge_tpu.metrics import MetricInfo, Metrics\n"
+        "def build(m):\n"
+        "    return m.timer(MetricInfo('surge.lint-fixture.golden-gap', 'x'))\n")
+    (tmp_path / "docs").mkdir()
+    # documented, so only the golden half fires
+    (tmp_path / "docs" / "observability.md").write_text(
+        "| `surge.lint-fixture.golden-gap` | timer | documented |\n")
+    (tmp_path / "tests" / "golden").mkdir(parents=True)
+    (tmp_path / "tests" / "golden" / "metrics.om").write_text(
+        "# TYPE surge_other_metric gauge\n")
+    (tmp_path / "tests" / "golden" / "metrics_broker.om").write_text("")
+    rule = all_rules()["metric-catalog"]
+    ctx = ModuleContext.parse(str(mod), str(tmp_path))
+    found = list(rule.check_repo(RepoContext(str(tmp_path), [ctx])))
+    assert len(found) == 1 and "golden" in found[0].message, found
+
+
+# -- proto-drift ---------------------------------------------------------------------
+
+_FIXTURE_METHODS = {"Ping": ("PingRequest", "PingReply"),
+                    "Status": ("PingRequest", "PingReply")}
+_FIXTURE_PB2_SERVICES = {"Ping": ("PingRequest", "PingReply")}
+_FIXTURE_PB2_MESSAGES = {"PingRequest": {"name": 1},
+                         "PingReply": {"ok": 1, "error": 2}}
+
+
+def test_proto_drift_good_fixture_is_clean():
+    text = open(os.path.join(FIXTURES, "proto_drift", "good.proto")).read()
+    assert check_proto_drift(text, _FIXTURE_METHODS, _FIXTURE_PB2_SERVICES,
+                             _FIXTURE_PB2_MESSAGES) == []
+
+
+def test_proto_drift_bad_fixture_catches_every_class():
+    text = open(os.path.join(FIXTURES, "proto_drift", "bad.proto")).read()
+    drift = "\n".join(check_proto_drift(
+        text, _FIXTURE_METHODS, _FIXTURE_PB2_SERVICES, _FIXTURE_PB2_MESSAGES))
+    # rpc signature drift between proto and METHODS
+    assert "rpc `Ping` signature drift" in drift
+    # proto rpc with no route / METHODS route not in proto
+    assert "`Orphan`" in drift
+    assert "METHODS route `Status` is not in" in drift
+    # pb2-descriptor field the hand-synced .proto lost
+    assert "field `PingReply.error` is in the pb2 descriptor" in drift
+
+
+def test_proto_drift_real_repo_in_sync():
+    """The shipped artifacts are in sync (what `regen_log_proto.py --check`
+    runs; the proto-drift rule rides the same function in the full suite)."""
+    assert repo_drift(REPO) == []
+
+
+def test_parse_helpers_read_the_real_artifacts():
+    declared, reuse, messages = parse_proto(
+        open(os.path.join(REPO, "proto", "log_service.proto")).read())
+    assert "Transact" in declared and "HandoffPartition" in reuse
+    assert messages["ReplicateRequest"]["high_watermarks"] == 8
+    methods = parse_methods_table(
+        open(os.path.join(REPO, "surge_tpu", "log", "server.py")).read())
+    assert methods["Transact"] == ("TxnRequest", "TxnReply")
+    assert set(declared) | set(reuse) == set(methods)
+
+
+# -- pragmas, baseline, reporters ----------------------------------------------------
+
+def test_pragma_requires_justification():
+    report = run_paths([os.path.join(FIXTURES, "pragma", "bad.py")], REPO,
+                       select=["orphan-task"])
+    assert [(f.rule, f.line) for f in report.findings] == \
+        [("pragma-justification", 7)]
+    assert report.suppressed == []
+
+
+def test_justified_pragma_suppresses_and_tallies():
+    report = run_paths([os.path.join(FIXTURES, "pragma", "good.py")], REPO,
+                       select=["orphan-task"])
+    assert report.findings == [] and report.exit_code == 0
+    assert report.suppression_tally() == {"orphan-task": 1}
+    assert "fire-and-forget" in report.suppressed[0].justification
+
+
+def test_baseline_roundtrip(tmp_path):
+    bad = os.path.join(FIXTURES, "orphan_task", "bad.py")
+    first = run_paths([bad], REPO, select=["orphan-task"])
+    assert len(first.findings) == 2
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), first.findings)
+    second = run_paths([bad], REPO, select=["orphan-task"],
+                       baseline_path=str(baseline))
+    assert second.findings == [] and second.exit_code == 0
+    assert len(second.baselined) == 2
+    # a NEW finding (beyond the baselined multiset) still fails
+    data = json.loads(baseline.read_text())
+    data["findings"] = data["findings"][:1]
+    baseline.write_text(json.dumps(data))
+    third = run_paths([bad], REPO, select=["orphan-task"],
+                      baseline_path=str(baseline))
+    assert len(third.findings) == 1 and third.exit_code == 1
+
+
+def test_json_reporter_schema():
+    report = run_paths([os.path.join(FIXTURES, "orphan_task", "bad.py")],
+                       REPO, select=["orphan-task"])
+    payload = json.loads(render_json(report))
+    assert payload["exit_code"] == 1
+    assert payload["tally"] == {"orphan-task": 2}
+    f = payload["findings"][0]
+    assert set(f) >= {"rule", "path", "line", "message"}
+    assert f["path"].startswith("tests/lint_fixtures/")
+
+
+def test_unknown_rule_id_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        run_paths(["bench.py"], REPO, select=["no-such-rule"])
+
+
+def test_nonexistent_target_is_an_error_not_a_clean_run():
+    """A typo'd path in a CI hook must not lint nothing and stay green."""
+    with pytest.raises(FileNotFoundError, match="no/such/path"):
+        run_paths(["no/such/path"], REPO, select=["orphan-task"])
+
+
+def test_cli_json_smoke():
+    """One subprocess smoke: --format=json over a fixture, selected rule."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "surgelint.py"),
+         os.path.join(FIXTURES, "orphan_task", "bad.py"),
+         "--select", "orphan-task", "--format=json", "--no-baseline"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1, out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["tally"] == {"orphan-task": 2}
+
+
+# -- the recommended replacement actually works --------------------------------------
+
+def test_cancel_safe_wait_for_does_not_swallow_cancellation():
+    """The helper the waitfor-cancellation-swallow rule prescribes: a loop
+    built on it dies on the FIRST cancel even when the inner awaitable
+    completes in the same tick (the py3.10 wait_for swallow interleaving)."""
+    from surge_tpu.common import cancel_safe_wait_for
+
+    async def scenario():
+        ev = asyncio.Event()
+        spins = 0
+
+        async def loop():
+            nonlocal spins
+            while True:
+                try:
+                    await cancel_safe_wait_for(ev.wait(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    continue
+                spins += 1
+
+        task = asyncio.ensure_future(loop())
+        await asyncio.sleep(0.02)
+        task.cancel()          # cancel and completion race on one tick
+        ev.set()
+        for _ in range(50):
+            if task.done():
+                break
+            await asyncio.sleep(0.01)
+        assert task.cancelled(), "loop survived task.cancel()"
+        assert spins <= 1
+
+    asyncio.run(scenario())
+
+
+def test_cancel_safe_wait_for_timeout_and_result():
+    from surge_tpu.common import cancel_safe_wait_for
+
+    async def scenario():
+        async def quick():
+            return 42
+        assert await cancel_safe_wait_for(quick(), timeout=1.0) == 42
+        with pytest.raises(asyncio.TimeoutError):
+            await cancel_safe_wait_for(asyncio.Event().wait(), timeout=0.01)
+
+    asyncio.run(scenario())
+
+
+def test_cancel_safe_wait_for_completion_beats_the_timeout_cancel():
+    """An awaitable that completes (or fails for real) inside the timeout's
+    cancel window surfaces its actual result/exception — not a masking
+    TimeoutError plus an unretrieved-task warning."""
+    from surge_tpu.common import cancel_safe_wait_for
+
+    async def scenario():
+        async def refuses_cancel_then_fails():
+            try:
+                await asyncio.sleep(60)
+            except asyncio.CancelledError:
+                raise RuntimeError("producer fenced") from None
+
+        with pytest.raises(RuntimeError, match="producer fenced"):
+            await cancel_safe_wait_for(refuses_cancel_then_fails(),
+                                       timeout=0.01)
+
+        async def refuses_cancel_then_succeeds():
+            try:
+                await asyncio.sleep(60)
+            except asyncio.CancelledError:
+                return "committed"
+
+        assert await cancel_safe_wait_for(refuses_cancel_then_succeeds(),
+                                          timeout=0.01) == "committed"
+
+    asyncio.run(scenario())
+
+
+def test_cancel_safe_wait_for_inner_does_not_outlive_cancelled_caller():
+    """bpo-32751 parity with wait_for: when the CALLER is cancelled, the
+    inner awaitable's cleanup finishes before the CancelledError propagates
+    out of the helper."""
+    from surge_tpu.common import cancel_safe_wait_for
+
+    async def scenario():
+        cleaned_up = asyncio.Event()
+
+        async def inner():
+            try:
+                await asyncio.sleep(60)
+            except asyncio.CancelledError:
+                await asyncio.sleep(0.02)  # slow cleanup must still finish
+                cleaned_up.set()
+                raise
+
+        async def caller():
+            await cancel_safe_wait_for(inner(), timeout=30)
+
+        t = asyncio.ensure_future(caller())
+        await asyncio.sleep(0.02)
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        assert cleaned_up.is_set(), "inner cleanup outlived the caller"
+
+    asyncio.run(scenario())
+
+
+# -- the tier-1 gate -----------------------------------------------------------------
+
+def test_full_suite_zero_unbaselined_findings_in_budget():
+    """`python tools/surgelint.py` over the canonical surface: zero
+    unbaselined, unsuppressed findings, no parse errors, inside the time
+    budget (nominally <10s; the assert allows this container's documented
+    2-3x load swing)."""
+    t0 = time.perf_counter()
+    report = run_paths(list(DEFAULT_TARGETS), REPO, baseline_path=BASELINE)
+    elapsed = time.perf_counter() - t0
+    assert report.errors == [], report.errors
+    assert report.findings == [], "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in report.findings)
+    assert report.exit_code == 0
+    assert report.files_scanned > 80  # the whole canonical surface, not a subset
+    assert len(report.rules_run) >= 8
+    assert elapsed < 25.0, f"surgelint took {elapsed:.1f}s (budget 10s nominal)"

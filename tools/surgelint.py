@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""surgelint CLI — run the repo-native static analysis suite.
+
+    python tools/surgelint.py                       # canonical surface
+    python tools/surgelint.py surge_tpu/log         # one subtree
+    python tools/surgelint.py --changed             # only git-dirty files
+    python tools/surgelint.py --format=json         # machine consumption
+    python tools/surgelint.py --select await-under-lock,orphan-task
+    python tools/surgelint.py --write-baseline      # accept current findings
+    python tools/surgelint.py --list-rules
+
+Exit 0 = no unbaselined, unsuppressed findings. The rule catalog (what each
+rule catches, the historical bug it encodes, how to suppress) lives in
+docs/static-analysis.md. Cross-file rules (config-key-registry,
+metric-catalog, proto-drift) always aggregate over the full canonical surface
+even under --changed/path filters, so a filtered run cannot miss a drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from surge_tpu.analysis import (  # noqa: E402
+    DEFAULT_TARGETS,
+    all_rules,
+    render_json,
+    render_text,
+    run_paths,
+    write_baseline,
+)
+
+BASELINE_PATH = os.path.join(REPO, ".surgelint-baseline.json")
+
+
+#: non-module artifacts the repo-scope rules read: a dirty one must trigger
+#: a run even when no .py file changed (the drift may live in the artifact)
+ARTIFACT_PREFIXES = ("proto/", "docs/", "tests/golden/")
+
+
+def changed_paths() -> tuple:
+    """(changed .py files under the canonical targets, whether a repo-rule
+    artifact is dirty) — the fast local loop before a full run."""
+    out = subprocess.run(
+        ["git", "status", "--porcelain", "-uall"], cwd=REPO,
+        capture_output=True, text=True, check=True).stdout
+    paths = set()
+    for line in out.splitlines():
+        paths.add(line[3:].split(" -> ")[-1].strip())
+    diff = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD"], cwd=REPO,
+        capture_output=True, text=True, check=True).stdout
+    paths.update(diff.splitlines())
+    targets = []
+    artifacts_dirty = False
+    for p in sorted(paths):
+        if not os.path.exists(os.path.join(REPO, p)):
+            continue  # deleted file
+        if p.startswith(ARTIFACT_PREFIXES):
+            artifacts_dirty = True
+        if p.endswith(".py") and any(
+                p == t or p.startswith(t.rstrip("/") + "/")
+                for t in DEFAULT_TARGETS):
+            targets.append(os.path.join(REPO, p))
+    return targets, artifacts_dirty
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="surgelint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*",
+                        help=f"files/dirs to lint (default: {' '.join(DEFAULT_TARGETS)})")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only git-dirty files (working tree vs "
+                             "HEAD; committed changes need a full run)")
+    parser.add_argument("--select", default="",
+                        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--baseline", default=BASELINE_PATH,
+                        help="baseline file (default: .surgelint-baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report baselined findings too")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept all current findings into the baseline")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="show suppressed findings with justifications")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(all_rules().items()):
+            scope = "repo " if rule.repo_scope else "file "
+            print(f"{rid:32s} [{scope}] {rule.summary}")
+        return 0
+
+    if args.write_baseline and (args.paths or args.changed or args.select):
+        # a filtered run must never overwrite the FULL baseline with its
+        # subset — accepted debt elsewhere would silently vanish
+        print("surgelint: --write-baseline always runs the full canonical "
+              "surface with every rule; ignoring path/rule filters",
+              file=sys.stderr)
+        args.paths, args.changed, args.select = [], False, ""
+
+    if args.changed:
+        paths, artifacts_dirty = changed_paths()
+        if not paths and not artifacts_dirty:
+            print("surgelint: no changed files under the canonical targets")
+            return 0
+        # dirty proto/docs/golden with no .py change: still run (paths may be
+        # empty — repo-scope rules aggregate over the canonical surface)
+    else:
+        paths = args.paths or list(DEFAULT_TARGETS)
+
+    select = [r.strip() for r in args.select.split(",") if r.strip()] or None
+    baseline = None if (args.no_baseline or args.write_baseline) else args.baseline
+    t0 = time.perf_counter()
+    try:
+        report = run_paths(paths, REPO, select=select, baseline_path=baseline)
+    except (ValueError, FileNotFoundError) as exc:
+        print(f"surgelint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        accepted = [f for f in report.findings
+                    if f.rule != "pragma-justification"]  # justify or remove
+        write_baseline(args.baseline, accepted)
+        print(f"surgelint: wrote {len(accepted)} finding(s) to "
+              f"{os.path.relpath(args.baseline, REPO)}")
+        return 0
+
+    if args.format == "json":
+        print(render_json(report))
+    else:
+        print(render_text(report, verbose=args.verbose))
+        print(f"({time.perf_counter() - t0:.2f}s)")
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
