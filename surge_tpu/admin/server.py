@@ -32,6 +32,12 @@ METHODS = {
     # ComponentRequest.name carries "arm:<seed>:<plan>" ("arm:7:flaky-network",
     # "arm:0:{json}"), "disarm", or "status"; stats ride MetricsReply as JSON
     "ArmFaults": (pb.ComponentRequest, pb.MetricsReply),
+    # engine flight recorder (surge_tpu.observability.flight): the merge-ready
+    # dump envelope as JSON — engine lane events (publisher lane transitions,
+    # rebalances, resident-plane moves, health restarts, SLO breaches)
+    # interleave with broker DumpFlight dumps on one incident timeline.
+    # ComponentRequest.name optionally carries the tail size ("last:50")
+    "DumpFlight": (pb.ComponentRequest, pb.MetricsReply),
 }
 
 
@@ -53,9 +59,13 @@ class AdminServer:
 
     async def GetMetrics(self, request, context) -> pb.MetricsReply:
         reg = self.engine.metrics_registry
+        flight = getattr(self.engine, "flight", None)
         return pb.MetricsReply(metrics_json=json.dumps({
             "values": reg.get_metrics(),
             "descriptions": reg.metric_descriptions(),
+            # ring occupancy + dropped-event count: the operator's tell that
+            # the bounded flight ring wrapped mid-incident
+            "flight": flight.stats() if flight is not None else None,
         }).encode())
 
     async def GetMetricsText(self, request, context) -> pb.MetricsReply:
@@ -69,6 +79,24 @@ class AdminServer:
                 getattr(self.engine, "health_bus", None),
                 getattr(self.engine, "health_supervisor", None))])
         return pb.MetricsReply(metrics_json=text.encode())
+
+    async def DumpFlight(self, request, context) -> pb.MetricsReply:
+        """The engine flight recorder's merge-ready dump (ring stats —
+        occupancy + dropped-event count — ride the envelope, so an operator
+        can tell when the bounded ring wrapped mid-incident)."""
+        last = None
+        name = request.name or ""
+        if name.startswith("last:"):
+            try:
+                last = int(name.partition(":")[2])
+            except ValueError:
+                last = None
+        flight = getattr(self.engine, "flight", None)
+        if flight is None:
+            return pb.MetricsReply(metrics_json=json.dumps(
+                {"error": "engine has no flight recorder"}).encode())
+        return pb.MetricsReply(
+            metrics_json=json.dumps(flight.dump(last)).encode())
 
     async def ListComponents(self, request, context) -> pb.RegistrationsReply:
         return pb.RegistrationsReply(
@@ -203,6 +231,13 @@ class AdminClient:
         """OpenMetrics text payload (scrape-over-gRPC)."""
         reply = await self._calls["GetMetricsText"](pb.Empty())
         return reply.metrics_json.decode()
+
+    async def flight_dump(self, last: Optional[int] = None) -> dict:
+        """The engine's flight-recorder dump (merge-ready envelope: feed it
+        to merge_dumps alongside broker dumps for one incident timeline)."""
+        name = f"last:{last}" if last is not None else ""
+        r = await self._calls["DumpFlight"](pb.ComponentRequest(name=name))
+        return json.loads(r.metrics_json)
 
     async def components(self) -> list:
         return list((await self._calls["ListComponents"](pb.Empty())).names)

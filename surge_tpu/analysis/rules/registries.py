@@ -20,11 +20,17 @@ from surge_tpu.analysis.core import Finding, ModuleContext, RepoContext, Rule, r
 CONFIG_MODULE = "surge_tpu/config/__init__.py"
 OPERATIONS_DOC = "docs/operations.md"
 OBSERVABILITY_DOC = "docs/observability.md"
-GOLDEN_PATHS = ("tests/golden/metrics.om", "tests/golden/metrics_broker.om")
+GOLDEN_PATHS = ("tests/golden/metrics.om", "tests/golden/metrics_broker.om",
+                "tests/golden/metrics_fleet.om")
 #: instrument-creation modules the golden files render end to end — names
 #: created here must ALSO appear in a golden (regen + docs move together)
 GOLDEN_COUPLED_MODULES = ("surge_tpu/metrics/__init__.py",
-                          "surge_tpu/metrics/broker.py")
+                          "surge_tpu/metrics/broker.py",
+                          "surge_tpu/metrics/fleet.py")
+#: SLO definitions reference merged-payload FAMILY names — every family an
+#: ``SLO(...)`` cites must be rendered by some golden exposition, or the
+#: objective watches a metric nothing emits (a dead objective never pages)
+SLO_MODULE = "surge_tpu/observability/slo.py"
 
 _ACCESSORS = frozenset({"get", "get_int", "get_float", "get_bool", "get_str",
                         "get_seconds", "get_int_list"})
@@ -201,6 +207,18 @@ class MetricCatalog(Rule):
                 golden_families.add(m.group(1))
 
         for mod in ctx.modules:
+            if mod.rel_path == SLO_MODULE:
+                for fam, line in self._slo_families(mod):
+                    if not any(g == fam or g.startswith(fam + "_")
+                               for g in golden_families):
+                        yield Finding(
+                            rule=self.id, path=mod.rel_path, line=line,
+                            message=(f"SLO references family `{fam}` which "
+                                     "no golden exposition renders — a dead "
+                                     "objective (fix the family name, or "
+                                     "catalog+regen the instrument it "
+                                     "watches)"),
+                            snippet=mod.line_text(line))
             for name, line in self._instrument_names(mod):
                 if name not in docs:
                     yield Finding(
@@ -219,6 +237,29 @@ class MetricCatalog(Rule):
                                      "regen_golden_metrics.py (golden and docs "
                                      "catalog move together)"),
                             snippet=mod.line_text(line))
+
+    @staticmethod
+    def _slo_families(mod: ModuleContext) -> Iterator[Tuple[str, int]]:
+        """(family, line) for every ``SLO(... family=/good_family=...)``
+        literal in the SLO module (positional ``family`` is arg index 1)."""
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = node.func.id if isinstance(node.func, ast.Name) else (
+                node.func.attr if isinstance(node.func, ast.Attribute)
+                else None)
+            if leaf != "SLO":
+                continue
+            literals = []
+            if len(node.args) > 1:
+                literals.append(node.args[1])
+            literals.extend(kw.value for kw in node.keywords
+                            if kw.arg in ("family", "good_family"))
+            for arg in literals:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                                str) \
+                        and arg.value:
+                    yield arg.value, node.lineno
 
     @staticmethod
     def _instrument_names(mod: ModuleContext) -> Iterator[Tuple[str, int]]:

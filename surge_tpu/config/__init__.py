@@ -86,6 +86,17 @@ DEFAULTS: dict[str, Any] = {
     # capture OpenMetrics exemplars (trace id per histogram bucket) on the
     # ENGINE registry; broker registries are always exemplar-on
     "surge.metrics.exemplars": False,
+    # --- fleet telemetry plane (observability/federation.py + slo.py) ---
+    # per-target fetch timeout of one federation pass (HTTP scrape or
+    # GetMetricsText RPC); a slower target answers up{instance}=0 and keeps
+    # serving its last payload with a staleness stamp
+    "surge.fleet.scrape-timeout-ms": 2_000,
+    # multiwindow burn-rate alerting (Google-SRE style): a breach fires only
+    # when BOTH the fast and the slow window burn over the threshold.
+    # 14.4 = the classic 1h/5m page pair's rate (budget exhausted in ~2 days)
+    "surge.slo.fast-window-ms": 300_000,
+    "surge.slo.slow-window-ms": 3_600_000,
+    "surge.slo.burn-threshold": 14.4,
     # --- replay engine (new: the TPU north star; BASELINE.json replayBackend=tpu) ---
     "surge.replay.backend": "tpu",  # tpu | cpu (scalar fold)
     "surge.replay.restore-on-start": False,  # engine cold start folds the events topic
@@ -273,6 +284,9 @@ DEFAULTS: dict[str, Any] = {
     # --- engine ---
     "surge.engine.num-partitions": 8,
     "surge.engine.dr-standby-enabled": False,
+    # engine-side flight-recorder ring size (events); the admin DumpFlight
+    # RPC and BrokerStatus-style stats report occupancy + dropped count
+    "surge.engine.flight-capacity": 1024,
 }
 
 

@@ -138,6 +138,17 @@ class SurgeEngine(Controllable):
         self.health_bus = HealthSignalBus(
             self.config.get_int("surge.health.signal-buffer-size", 25))
         self.health_supervisor = HealthSupervisor(self.health_bus, self.config)
+        # engine-side flight recorder (the broker ring's twin): publisher
+        # lane transitions, rebalance fan-out, resident-plane moves and
+        # health-bus restarts land here; DumpFlight on the admin RPC pulls
+        # the merge-ready envelope so engine + broker dumps interleave into
+        # one cross-host incident timeline (tools/flight_timeline.py)
+        from surge_tpu.observability.flight import FlightRecorder
+
+        self.flight = FlightRecorder(
+            capacity=self.config.get_int("surge.engine.flight-capacity", 1024),
+            name=f"engine:{logic.aggregate_name}", role="engine")
+        self.health_bus.subscribe(self._flight_health_signal)
         from surge_tpu.health.prober import EventLoopProber
 
         self.loop_prober = (EventLoopProber(
@@ -196,7 +207,8 @@ class SurgeEngine(Controllable):
                     decode_state=getattr(logic, "decode_state", None),
                     derived_cols=getattr(logic, "derived_cols", None),
                     mesh=self._resolve_mesh(), metrics=self.metrics,
-                    on_signal=self.health_bus.signal_fn("resident-plane"))
+                    on_signal=self.health_bus.signal_fn("resident-plane"),
+                    flight=self.flight)
         self.checkpoint_writer = None
         ckpt_path = self.config.get_str("surge.store.checkpoint.path", "")
         if ckpt_path and logic.events_topic:
@@ -342,11 +354,25 @@ class SurgeEngine(Controllable):
 
     # -- regions -------------------------------------------------------------------------
 
+    def _flight_health_signal(self, signal) -> None:
+        """Health-bus tap for the flight ring: restarts and error-level
+        signals are incident-timeline material; trace/warning chatter is not
+        (the bounded ring must survive to the post-mortem)."""
+        if (signal.level == "error"
+                or signal.name.startswith("health.component-")):
+            self.flight.record("health.signal", name=signal.name,
+                               level=signal.level, source=signal.source)
+
     def _retarget_partitions(self) -> None:
         """Rebalance fan-out: the indexer AND the resident plane follow the
         tracker's view of this node's partitions together, so the plane's
         fold watermarks always cover exactly what the host store tails."""
+        prev = set(self.indexer.partitions)
         parts = self._indexer_partitions()
+        if set(parts) != prev:
+            self.flight.record("rebalance.retarget",
+                               granted=sorted(set(parts) - prev),
+                               revoked=sorted(prev - set(parts)))
         self.indexer.set_partitions(parts)
         if self.resident_plane is not None:
             self.resident_plane.set_partitions(parts)
@@ -405,7 +431,7 @@ class SurgeEngine(Controllable):
             still_owner=lambda p=partition: (
                 self.tracker.assignments.partition_to_host().get(p) == self.local_host),
             on_signal=self.health_bus.signal_fn(f"publisher-{partition}"),
-            metrics=self.metrics, tracer=self.tracer)
+            metrics=self.metrics, tracer=self.tracer, flight=self.flight)
         shard = Shard(
             f"{self.logic.aggregate_name}-{partition}",
             lambda aggregate_id, on_passivate, on_stopped: AggregateEntity(

@@ -175,7 +175,7 @@ class PartitionPublisher:
                  config: Config | None = None, transactional_id_prefix: str = "surge",
                  still_owner: Callable[[], bool] = lambda: True,
                  on_signal: Callable[[str, str], None] | None = None,
-                 metrics=None, tracer=None) -> None:
+                 metrics=None, tracer=None, flight=None) -> None:
         self.log = log
         self.state_topic = state_topic
         self.events_topic = events_topic
@@ -190,6 +190,10 @@ class PartitionPublisher:
         self.stats = PublisherStats()
         self.metrics = metrics  # EngineMetrics quiver (optional)
         self.tracer = tracer  # None = zero-overhead path
+        #: engine flight recorder (optional): lane transitions — group-commit
+        #: dispatch / verbatim retry / fence / rejoin — land in the same ring
+        #: the broker events merge with on an incident timeline
+        self.flight = flight
         self._producer = None
         self._pending: List[_Pending] = []
         self._in_flight: Dict[str, int] = {}  # aggregate_id -> max state offset published
@@ -506,6 +510,11 @@ class PartitionPublisher:
                     await self._drain_inflight()
                     if self._retry_batches and self.state == "processing":
                         rb = self._retry_batches[0]
+                        if self.flight is not None:
+                            self.flight.record(
+                                "lane.retry", partition=self.partition,
+                                batch=rb.index, attempt=rb.attempts,
+                                records=len(rb.records))
                         await self._publish_batch(rb)
                         if self._retry_batches and self._retry_batches[0] is rb:
                             # still failing: pace the next attempt on the tick
@@ -609,6 +618,10 @@ class PartitionPublisher:
             self.stats.inflight_peak = self._inflight
         if self.metrics is not None:
             self.metrics.producer_in_flight.record(self._inflight)
+        if self.flight is not None:
+            self.flight.record("lane.dispatch", partition=self.partition,
+                               batch=batch.index, records=len(batch.records),
+                               inflight=self._inflight)
         if self._pipeline_capable():
             self._start_pipelined(batch)
         task = asyncio.ensure_future(self._commit_task(batch))
@@ -873,6 +886,9 @@ class PartitionPublisher:
         if self.state == "processing":
             self.state = "fenced"
             self._ready.clear()
+            if self.flight is not None:
+                self.flight.record("lane.fence", partition=self.partition,
+                                   fences=self.stats.fences)
 
     def _stash_or_exhaust(self, batch: _Batch, exc: Exception) -> None:
         """Keep an unknown-outcome batch for verbatim retry, bounded: after
@@ -931,6 +947,10 @@ class PartitionPublisher:
             self.on_signal("surge.producer.reinitializing", "warning")
             try:
                 await self._initialize()
+                if self.flight is not None:
+                    self.flight.record(
+                        "lane.rejoin", partition=self.partition,
+                        reinitializations=self.stats.reinitializations)
             except NotLeaderError as exc:
                 # the broker cluster is mid-failover (every reachable broker
                 # is a follower; promotion has not landed yet): stay fenced

@@ -260,6 +260,7 @@ class GrpcLogTransport:
                 response_deserializer=reply_cls.FromString)
 
     def _failover(self, from_generation: int) -> None:
+        t0 = time.perf_counter()
         with self._lock:
             if self.generation != from_generation:
                 return  # another caller already rolled
@@ -267,6 +268,8 @@ class GrpcLogTransport:
             self._connect(self.targets.index(self.target) + 1)
         if self.metrics is not None:
             self.metrics.failover_rolls.record()
+            self.metrics.failover_redirect_timer.record_ms(
+                (time.perf_counter() - t0) * 1000.0)
 
     def _redirect(self, from_generation: int, target: str) -> bool:
         """Follow a NOT_LEADER redirect: reconnect to the hinted broker
@@ -277,6 +280,7 @@ class GrpcLogTransport:
         (jittered) and retries instead."""
         if not target:
             return False
+        t0 = time.perf_counter()
         with self._lock:
             if self.generation != from_generation:
                 return True  # another caller already moved
@@ -288,12 +292,24 @@ class GrpcLogTransport:
             self._connect(self.targets.index(target))
         if self.metrics is not None:
             self.metrics.failover_redirects.record()
+            self.metrics.failover_redirect_timer.record_ms(
+                (time.perf_counter() - t0) * 1000.0)
         return True
 
     def _jittered(self, backoff: float) -> float:
         """Randomized sleep in [backoff/2, backoff): retry storms against a
         broker mid-promotion decorrelate instead of arriving in waves."""
         return backoff * (0.5 + 0.5 * self._rng.random())
+
+    def _backoff_sleep(self, backoff: float) -> None:
+        """Jittered retry sleep, recorded into the client failover backoff
+        histogram (with the active span's trace id as the bucket exemplar
+        when the registry captures them — the patience a command actually
+        paid riding out a failover is visible AND traceable)."""
+        delay = self._jittered(backoff)
+        time.sleep(delay)
+        if self.metrics is not None:
+            self.metrics.failover_backoff_timer.record_ms(delay * 1000.0)
 
     def _span_and_metadata(self, name: str, **attrs):
         """(span, gRPC metadata) for one broker call — the traceparent crosses
@@ -302,9 +318,13 @@ class GrpcLogTransport:
         ticks would drown every other span."""
         if self.tracer is None or name == "WaitForAppend":
             return None, None
-        from surge_tpu.tracing import inject_context
+        from surge_tpu.tracing import active_span, inject_context
 
-        span = self.tracer.start_span(f"log.{name}")
+        # parent on the caller's active span (the publisher's flush span —
+        # copied into the pipeline pool's threads at dispatch): the broker
+        # call's span, and every failover-histogram exemplar recorded under
+        # it, carries the ORIGINATING command's trace id
+        span = self.tracer.start_span(f"log.{name}", parent=active_span())
         span.set_attribute("broker", self.target)
         for k, v in attrs.items():
             span.set_attribute(k, v)
@@ -346,7 +366,7 @@ class GrpcLogTransport:
                 if (code == grpc.StatusCode.UNAVAILABLE
                         and len(self.targets) > 1):
                     self._failover(gen)
-                time.sleep(self._jittered(0.1))
+                self._backoff_sleep(0.1)
         raise last
 
     # -- topics ---------------------------------------------------------------------------
@@ -399,7 +419,7 @@ class GrpcLogTransport:
             if reply.error_kind != "not_leader":
                 raise TransactionStateError(reply.error)
             if not self._redirect(gen, reply.leader_hint):
-                time.sleep(self._jittered(backoff))
+                self._backoff_sleep(backoff)
                 backoff = min(backoff * 2, 1.0)
         raise NotLeaderError(
             f"no leader found for producer open after redirects: {last_error}",
@@ -417,7 +437,15 @@ class GrpcLogTransport:
                 if self._pipeline_pool is None:
                     self._pipeline_pool = ThreadPoolExecutor(
                         max_workers=16, thread_name_prefix="surge-txn-pipe")
-        self._pipeline_pool.submit(self._pipelined_call, producer, handle)
+        # carry the caller's contextvars (the active span above all) into
+        # the pool thread: a retry/backoff recorded there captures the
+        # dispatching command's trace id as its histogram exemplar instead
+        # of reading an empty context
+        import contextvars
+
+        ctx = contextvars.copy_context()
+        self._pipeline_pool.submit(ctx.run, self._pipelined_call, producer,
+                                   handle)
 
     def _pipelined_call(self, producer: GrpcTxnProducer,
                         handle: PipelinedCommit) -> None:
@@ -491,7 +519,7 @@ class GrpcLogTransport:
                 if span is not None:
                     span.add_event("retry", {"attempt": attempt,
                                              "code": str(code)})
-                time.sleep(self._jittered(backoff))
+                self._backoff_sleep(backoff)
                 backoff = min(backoff * 2, 0.4)
                 continue
             if not reply.ok and reply.error_kind == "not_leader":
@@ -507,7 +535,7 @@ class GrpcLogTransport:
                         f"(hint {reply.leader_hint or 'none'})")
                 if attempt == attempts - 1:
                     raise NotLeaderError(reply.error, reply.leader_hint)
-                time.sleep(self._jittered(backoff))
+                self._backoff_sleep(backoff)
                 backoff = min(backoff * 2, 0.4)
                 continue
             if not reply.ok and reply.error_kind == "retriable" and seq:
@@ -519,7 +547,7 @@ class GrpcLogTransport:
                 if attempt == attempts - 1:
                     raise ProducerFencedError(
                         f"replication unresolved: {reply.error}")
-                time.sleep(self._jittered(backoff))
+                self._backoff_sleep(backoff)
                 backoff = min(backoff * 2, 0.4)
                 continue
             return reply

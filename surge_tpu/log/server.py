@@ -2036,7 +2036,11 @@ class LogServer:
                     # gates reads on, and the vote-cluster shape
                     "high_watermarks": self._hwm_by_topic(),
                     "quorum": self._quorum_view(),
-                    "handoff_fence": self._handoff_fence}
+                    "handoff_fence": self._handoff_fence,
+                    # flight-ring occupancy + dropped-event count: whether
+                    # the bounded ring wrapped mid-incident (a truncated
+                    # DumpFlight story is tellable from the status alone)
+                    "flight": self.flight.stats()}
 
     def _hwm_by_topic(self) -> Dict[str, Dict[str, int]]:
         out: Dict[str, Dict[str, int]] = {}

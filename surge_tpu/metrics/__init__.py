@@ -259,6 +259,14 @@ class EngineMetrics:
     failover_truncated_records: Sensor = field(init=False)
     failover_redirects: Sensor = field(init=False)
     failover_rolls: Sensor = field(init=False)
+    # client-side failover latency histograms (surge_tpu.log.client): the
+    # redirect/roll reconnect cost and the jittered backoff actually slept —
+    # their buckets carry OpenMetrics exemplars when the registry has
+    # exemplar capture on (the active-span contextvar is threaded through
+    # the pipelined retry pool, so a failover bucket links to the command
+    # trace that rode through the failover)
+    failover_redirect_timer: Timer = field(init=False)
+    failover_backoff_timer: Timer = field(init=False)
     faults_injected: Sensor = field(init=False)
     faults_armed: Sensor = field(init=False)
 
@@ -416,6 +424,16 @@ class EngineMetrics:
             "surge.log.failover.client-rolls",
             "broker-endpoint-list failovers after UNAVAILABLE (the client "
             "rolled to the next broker)"))
+        self.failover_redirect_timer = m.timer(MI(
+            "surge.log.failover.redirect-timer",
+            "ms per client reconnect onto a hinted/next broker (NOT_LEADER "
+            "redirect follow or UNAVAILABLE endpoint roll) — the wiring "
+            "half of client-visible failover latency"))
+        self.failover_backoff_timer = m.timer(MI(
+            "surge.log.failover.backoff-timer",
+            "ms actually slept per jittered client retry backoff "
+            "(mid-promotion waits; the patience half of client-visible "
+            "failover latency)"))
         self.faults_injected = m.counter(MI(
             "surge.log.faults.injected",
             "faults fired by the armed fault-injection plane"))

@@ -228,13 +228,21 @@ class MetricsHTTPServer:
     No third-party server dependency — a ``ThreadingHTTPServer`` on a daemon
     thread, same zero-footprint philosophy as the hand-written gRPC glue. Bind
     with ``port=0`` to take an ephemeral port (returned by :meth:`start`).
+
+    ``render`` overrides the payload source entirely (the federated scraper
+    serves its MERGED exposition — a fresh federation pass per GET — through
+    this hook instead of a registry); ``registry`` may then be ``None``.
     """
 
-    def __init__(self, registry: Metrics, host: str = "127.0.0.1",
+    def __init__(self, registry: Optional[Metrics], host: str = "127.0.0.1",
                  port: int = 0,
-                 collectors: Sequence[Collector] = ()) -> None:
+                 collectors: Sequence[Collector] = (),
+                 render: Optional[Callable[[], str]] = None) -> None:
+        if registry is None and render is None:
+            raise ValueError("need a registry or a render callable")
         self.registry = registry
         self.collectors = list(collectors)
+        self.render = render
         self._host = host
         self._port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -250,8 +258,9 @@ class MetricsHTTPServer:
                     self.send_error(404)
                     return
                 try:
-                    body = render_openmetrics(
-                        outer.registry, outer.collectors).encode()
+                    body = (outer.render() if outer.render is not None
+                            else render_openmetrics(
+                                outer.registry, outer.collectors)).encode()
                 except Exception as exc:  # noqa: BLE001 — scrape must answer
                     self.send_error(500, repr(exc))
                     return

@@ -1,0 +1,98 @@
+"""Fleet-level metrics quiver: the federated scraper's own instruments.
+
+The engine and broker quivers describe ONE process; the fleet quiver
+describes the act of watching all of them — how the federation pass went
+(``surge.fleet.*``: targets up, scrape latency, staleness of the oldest
+cached payload) and what the SLO burn-rate engine concluded from the merged
+payload (``surge.slo.*``: objectives evaluated, breaches fired, the worst
+burn rate observed). One registry per
+:class:`~surge_tpu.observability.federation.FederatedScraper`; its families
+join the federated exposition itself, so the fleet scrape is self-describing
+(a dashboard can alert on ``surge_fleet_up_targets`` falling below the fleet
+size from the same payload it graphs the fleet with).
+
+Golden/catalog coupled like the engine and broker quivers: every instrument
+here must appear in ``tests/golden/metrics_fleet.om`` AND the
+docs/observability.md catalog (``tools/regen_golden_metrics.py`` regenerates
+the golden; surgelint's ``metric-catalog`` rule and the runtime
+catalog-completeness test both enforce the coupling).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from surge_tpu.metrics import MetricInfo, Metrics, Sensor, Timer
+
+__all__ = ["FleetMetrics", "fleet_metrics"]
+
+
+@dataclass
+class FleetMetrics:
+    """The standard fleet instrument set, created once per federated scraper."""
+
+    registry: Metrics
+    # federation pass health
+    fleet_targets: Sensor = field(init=False)
+    fleet_up_targets: Sensor = field(init=False)
+    fleet_scrape_timer: Timer = field(init=False)
+    fleet_scrape_errors: Sensor = field(init=False)
+    fleet_merged_families: Sensor = field(init=False)
+    fleet_max_staleness: Sensor = field(init=False)
+    # SLO burn-rate engine
+    slo_objectives: Sensor = field(init=False)
+    slo_evaluations: Sensor = field(init=False)
+    slo_breaches: Sensor = field(init=False)
+    slo_active_breaches: Sensor = field(init=False)
+    slo_max_burn_rate: Sensor = field(init=False)
+
+    def __post_init__(self) -> None:
+        m, MI = self.registry, MetricInfo
+        self.fleet_targets = m.gauge(MI(
+            "surge.fleet.targets",
+            "scrape targets registered with the federated scraper"))
+        self.fleet_up_targets = m.gauge(MI(
+            "surge.fleet.up-targets",
+            "targets that answered the last federation pass (the merged "
+            "payload's up{instance} gauges, summed)"))
+        self.fleet_scrape_timer = m.timer(MI(
+            "surge.fleet.scrape-timer",
+            "ms per full federation pass (every target scraped "
+            "concurrently, slowest answer bounds the round)"))
+        self.fleet_scrape_errors = m.counter(MI(
+            "surge.fleet.scrape-errors",
+            "per-target scrape failures (timeout, refused, bad payload) "
+            "across all federation passes"))
+        self.fleet_merged_families = m.gauge(MI(
+            "surge.fleet.merged-families",
+            "metric families in the last merged exposition (fleet self-"
+            "instruments included)"))
+        self.fleet_max_staleness = m.gauge(MI(
+            "surge.fleet.max-staleness-seconds",
+            "age of the OLDEST per-target payload served in the last merged "
+            "exposition (a down target's cached families keep serving with "
+            "this staleness stamp until it answers again)"))
+        self.slo_objectives = m.gauge(MI(
+            "surge.slo.objectives",
+            "SLO definitions the burn-rate engine evaluates per pass"))
+        self.slo_evaluations = m.counter(MI(
+            "surge.slo.evaluations",
+            "SLO evaluation passes run over the federated payload"))
+        self.slo_breaches = m.counter(MI(
+            "surge.slo.breaches",
+            "burn-rate breaches fired (fast AND slow window over the "
+            "threshold — the Google-SRE multiwindow page condition)"))
+        self.slo_active_breaches = m.gauge(MI(
+            "surge.slo.active-breaches",
+            "objectives currently in breach (degraded-not-down: the health "
+            "bus carries an `slo` component while this is nonzero)"))
+        self.slo_max_burn_rate = m.gauge(MI(
+            "surge.slo.max-burn-rate",
+            "worst fast-window burn rate across objectives at the last "
+            "evaluation (1.0 = spending error budget exactly at the "
+            "objective's sustainable rate)"))
+
+
+def fleet_metrics(registry: Optional[Metrics] = None) -> FleetMetrics:
+    return FleetMetrics(registry if registry is not None else Metrics())

@@ -1,13 +1,23 @@
-"""Cluster observability plane: the broker flight recorder and the failover
-timeline reconstruction it feeds (docs/observability.md, docs/operations.md).
+"""Fleet observability plane: flight recorders (broker AND engine), the
+failover-timeline reconstruction they feed, the federated scraper that merges
+every fleet member's OpenMetrics payload into one exposition, and the SLO
+burn-rate engine evaluated on top of it (docs/observability.md,
+docs/operations.md).
 
 The metrics/tracing half of the telemetry plane lives in
 :mod:`surge_tpu.metrics` / :mod:`surge_tpu.tracing`; this package holds the
-black-box pieces — bounded in-memory event recording at the sites a
-post-incident analysis needs, and the merge/reconstruction tooling that turns
-per-broker dumps into one ordered story.
+black-box and fleet-level pieces — bounded in-memory event recording at the
+sites a post-incident analysis needs, the merge/reconstruction tooling that
+turns per-process dumps into one ordered story, cross-fleet scrape
+federation, and multiwindow burn-rate alerting over the merged payload.
 """
 
+from surge_tpu.observability.federation import (
+    FederatedScraper,
+    ScrapeTarget,
+    parse_openmetrics,
+    target_from_spec,
+)
 from surge_tpu.observability.flight import (
     FlightRecorder,
     host_wall_offset,
@@ -15,6 +25,9 @@ from surge_tpu.observability.flight import (
     reconstruct_failover,
     same_clock_domain,
 )
+from surge_tpu.observability.slo import DEFAULT_SLOS, SLO, SLOEngine
 
-__all__ = ["FlightRecorder", "merge_dumps", "reconstruct_failover",
-           "same_clock_domain", "host_wall_offset"]
+__all__ = ["DEFAULT_SLOS", "FederatedScraper", "FlightRecorder", "SLO",
+           "SLOEngine", "ScrapeTarget", "host_wall_offset", "merge_dumps",
+           "parse_openmetrics", "reconstruct_failover", "same_clock_domain",
+           "target_from_spec"]

@@ -1,0 +1,482 @@
+"""Federated scrape: every engine/broker payload merged into ONE exposition.
+
+A quorum cluster (PR 7) plus a fleet of engines leaves an operator scraping N
+brokers and M engines by hand — `tools/chaos.py metrics` per broker, an HTTP
+port per engine — and eyeballing raw families with no way to tell whose
+`surge_log_replication_epoch` is whose. :class:`FederatedScraper` is the
+Prometheus-federation answer, self-hosted (no Prometheus dependency, same
+zero-footprint philosophy as the stdlib scrape server):
+
+- every registered :class:`ScrapeTarget` is pulled CONCURRENTLY per pass,
+  each with its own timeout — one hung broker cannot stall the fleet view;
+- payloads merge into one grammar-valid OpenMetrics exposition where every
+  sample gains ``instance``/``role`` labels (the Prometheus federation
+  labelling convention), duplicate family names across engine and broker
+  registries collapse into one ``TYPE`` block, and a cross-registry TYPE
+  conflict re-homes the later family under ``<name>_<type>`` instead of
+  emitting a grammar-violating duplicate declaration;
+- a down target keeps serving its LAST payload with a staleness stamp
+  (``surge_fleet_scrape_staleness_seconds{instance=...}``) and an
+  ``up{instance=...} 0`` gauge — the fleet view degrades, it never lies by
+  omission;
+- the scraper's own :class:`~surge_tpu.metrics.fleet.FleetMetrics` quiver
+  (``surge.fleet.*`` / ``surge.slo.*``) joins the same payload, and an
+  attached :class:`~surge_tpu.observability.slo.SLOEngine` is evaluated
+  after every pass;
+- :meth:`serve` exposes the merged payload from the scraper's own scrape
+  port (one federation pass per GET), and ``tools/chaos.py fleet`` /
+  ``tools/surgetop.py`` drive the same object from the CLI.
+
+Target addressing: ``role@address`` strings — ``broker@host:port`` scrapes
+over the log-service ``GetMetricsText`` RPC, ``engine@host:port`` over the
+admin-service ``GetMetricsText`` RPC, and ``role@http://host:port/metrics``
+over plain HTTP (any exposition endpoint, including another federated
+scraper).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import wait as _futures_wait
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from surge_tpu.common import logger
+from surge_tpu.config import Config, default_config
+from surge_tpu.metrics.exposition import (
+    Family,
+    MetricsHTTPServer,
+    Sample,
+    _render_family,
+    registry_families,
+    sanitize_name,
+)
+from surge_tpu.metrics.fleet import FleetMetrics, fleet_metrics
+from surge_tpu.metrics.statistics import Count as _Count
+from surge_tpu.metrics.statistics import TimeBucketHistogram as _TBHist
+
+
+def _registry_shapes(registry):
+    """(family name, type) for every registered metric — the exposition's
+    naming rules without touching provider values."""
+    for dotted, reg in registry._metrics.items():
+        if isinstance(reg.provider, _TBHist):
+            base = dotted[:-len(".p99")] if dotted.endswith(".p99") else dotted
+            yield sanitize_name(base) + "_ms", "histogram"
+        elif isinstance(reg.provider, _Count):
+            yield sanitize_name(dotted), "counter"
+        else:
+            yield sanitize_name(dotted), "gauge"
+
+__all__ = ["FederatedScraper", "ScrapeTarget", "parse_openmetrics",
+           "target_from_spec"]
+
+#: labels the federation layer owns; same-named labels in a target payload
+#: are renamed ``exported_<label>`` (the Prometheus honor_labels=false rule)
+RESERVED_LABELS = ("instance", "role")
+
+_HELP_RE = re.compile(r"^# HELP (\S+) ?(.*)$")
+_TYPE_RE = re.compile(r"^# TYPE (\S+) (\S+)$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[^ ]+)"
+    r"(?: # \{trace_id=\"(?P<trace>[0-9a-f]+)\"\}"
+    r" (?P<exval>[^ ]+) (?P<exts>[0-9.]+))?$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_SUFFIXES = ("_total", "_bucket", "_sum", "_count", "")
+
+
+def _unescape(value: str) -> str:
+    out: List[str] = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            out.append({"n": "\n", '"': '"', "\\": "\\"}.get(
+                value[i + 1], value[i + 1]))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def parse_openmetrics(text: str) -> List[Family]:
+    """Parse an exposition back into :class:`Family` objects (the inverse of
+    ``render_openmetrics``, for re-labelling and re-emission). Lenient where
+    a federating scraper must be: untyped samples become implicit gauge
+    families (a target payload must not take the whole merge down), unknown
+    comment lines are skipped, parsing stops at ``# EOF``."""
+    helps: Dict[str, str] = {}
+    families: Dict[str, Family] = {}
+    order: List[str] = []
+
+    def family_of(sample_name: str) -> Tuple[Family, str]:
+        for suffix in _SUFFIXES:
+            if suffix and not sample_name.endswith(suffix):
+                continue
+            cand = sample_name[: len(sample_name) - len(suffix)] \
+                if suffix else sample_name
+            if cand in families:
+                return families[cand], suffix
+        fam = Family(name=sample_name, mtype="gauge",
+                     help=helps.get(sample_name, ""))
+        families[sample_name] = fam
+        order.append(sample_name)
+        return fam, ""
+
+    for line in text.splitlines():
+        if line == "# EOF":
+            break
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            m = _HELP_RE.match(line)
+            if m:
+                helps[m.group(1)] = m.group(2)
+            continue
+        if line.startswith("# TYPE "):
+            m = _TYPE_RE.match(line)
+            if m and m.group(1) not in families:
+                families[m.group(1)] = Family(
+                    name=m.group(1), mtype=m.group(2),
+                    help=helps.get(m.group(1), ""))
+                order.append(m.group(1))
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        fam, suffix = family_of(m.group("name"))
+        labels = tuple((k, _unescape(v))
+                       for k, v in _LABEL_RE.findall(m.group("labels") or ""))
+        exemplar = None
+        if m.group("trace"):
+            exemplar = (m.group("trace"), float(m.group("exval")),
+                        float(m.group("exts")))
+        fam.samples.append(Sample(suffix, labels, float(m.group("value")),
+                                  exemplar=exemplar))
+    return [families[name] for name in order]
+
+
+@dataclass
+class ScrapeTarget:
+    """One fleet member's scrape surface. ``fetch`` (tests, in-process
+    registries) overrides the address-derived fetcher entirely."""
+
+    instance: str
+    role: str = "broker"
+    address: str = ""
+    fetch: Optional[Callable[[], str]] = None
+
+
+def target_from_spec(spec: str) -> ScrapeTarget:
+    """``role@address`` → target (bare ``host:port`` defaults to broker)."""
+    role, sep, addr = spec.partition("@")
+    if not sep:
+        role, addr = "broker", spec
+    instance = re.sub(r"^https?://", "", addr).split("/")[0]
+    return ScrapeTarget(instance=instance, role=role.strip(),
+                        address=addr.strip())
+
+
+class FederatedScraper:
+    """Pulls every registered target concurrently and serves one merged,
+    instance-labelled OpenMetrics exposition (module docstring)."""
+
+    def __init__(self, targets: Sequence[ScrapeTarget | str] = (),
+                 config: Config | None = None,
+                 metrics: Optional[FleetMetrics] = None,
+                 clock: Callable[[], float] = time.time,
+                 slo=None) -> None:
+        self.config = config or default_config()
+        self.targets: List[ScrapeTarget] = [
+            target_from_spec(t) if isinstance(t, str) else t for t in targets]
+        self._timeout = self.config.get_seconds(
+            "surge.fleet.scrape-timeout-ms", 2_000)
+        self.metrics = metrics if metrics is not None else fleet_metrics()
+        self._clock = clock
+        #: instance -> {"families", "ts", "up", "error"} — a down target's
+        #: last-good families keep serving with a staleness stamp
+        self._cache: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._grpc_fetchers: Dict[str, Callable[[], str]] = {}
+        self._grpc_channels: List = []  # closed by stop()
+        #: optional surge_tpu.observability.slo.SLOEngine evaluated per pass
+        self.slo = slo
+        self._server: Optional[MetricsHTTPServer] = None
+        self._stopped = False
+        #: single-use stash of the merge scrape_once built for the SLO pass,
+        #: so an immediately-following render/row-extract reuses it instead
+        #: of re-merging every cached payload (stale-by-milliseconds only)
+        self._merged_stash: Optional[List[Family]] = None
+
+    # -- fetch --------------------------------------------------------------------------
+
+    def _fetcher(self, target: ScrapeTarget) -> Callable[[], str]:
+        if target.fetch is not None:
+            return target.fetch
+        if target.address.startswith(("http://", "https://")):
+            url = target.address
+            if "://" in url and "/" not in url.split("://", 1)[1]:
+                url += "/metrics"
+
+            def fetch_http() -> str:
+                with urllib.request.urlopen(url, timeout=self._timeout) as r:
+                    return r.read().decode()
+
+            return fetch_http
+        key = f"{target.role}@{target.address}"
+        # cache under the lock: serve() runs scrape_once on concurrent HTTP
+        # handler threads — two first GETs must not open two channels for
+        # one target (the loser would be unreferenced AND unclosable)
+        with self._lock:
+            hit = self._grpc_fetchers.get(key)
+            if hit is None:
+                hit = (self._admin_fetcher(target.address)
+                       if target.role == "engine"
+                       else self._broker_fetcher(target.address))
+                self._grpc_fetchers[key] = hit
+        return hit
+
+    def _channel(self, address: str):
+        """One cached sync channel per address, closed by :meth:`stop`."""
+        from surge_tpu.remote.security import secure_sync_channel
+
+        channel = secure_sync_channel(address, self.config)
+        self._grpc_channels.append(channel)
+        return channel
+
+    def _broker_fetcher(self, address: str) -> Callable[[], str]:
+        """Scrape-over-gRPC against the log service (no scrape port needed)."""
+        from surge_tpu.log import log_service_pb2 as pb
+        from surge_tpu.log.server import SERVICE
+
+        channel = self._channel(address)
+        call = channel.unary_unary(
+            f"/{SERVICE}/GetMetricsText",
+            request_serializer=pb.ListTopicsRequest.SerializeToString,
+            response_deserializer=pb.TxnReply.FromString)
+
+        def fetch() -> str:
+            reply = call(pb.ListTopicsRequest(), timeout=self._timeout)
+            if not reply.ok:
+                raise RuntimeError(f"GetMetricsText failed: {reply.error}")
+            return reply.records[0].value.decode()
+
+        return fetch
+
+    def _admin_fetcher(self, address: str) -> Callable[[], str]:
+        """Scrape-over-gRPC against an engine's admin service."""
+        from surge_tpu.admin import admin_pb2 as pb
+        from surge_tpu.admin.server import SERVICE
+
+        channel = self._channel(address)
+        call = channel.unary_unary(
+            f"/{SERVICE}/GetMetricsText",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.MetricsReply.FromString)
+
+        def fetch() -> str:
+            return call(pb.Empty(), timeout=self._timeout).metrics_json.decode()
+
+        return fetch
+
+    # -- the federation pass ------------------------------------------------------------
+
+    def scrape_once(self) -> dict:
+        """One pass: every target concurrently, per-target timeout; updates
+        the per-target cache, the fleet quiver, and the attached SLO engine.
+        Returns ``{"targets", "up", "errors": {instance: error}}``."""
+        t0 = self._clock()
+        # pool management under the lock: serve() runs this concurrently on
+        # HTTP handler threads, and stop() may tear the pool down mid-GET
+        with self._lock:
+            if self._pool is None and self.targets and not self._stopped:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=min(len(self.targets), 16),
+                    thread_name_prefix="surge-fleet-scrape")
+            pool = self._pool
+        if pool is None:
+            return {"targets": len(self.targets), "up": 0,
+                    "errors": {"": "scraper stopped"} if self._stopped else {}}
+        futures = {t.instance: pool.submit(self._fetcher(t))
+                   for t in self.targets}
+        # per-target network timeouts bound each fetch; the pass deadline is
+        # a belt on top so a misbehaving fetcher cannot wedge the fleet view
+        _futures_wait(list(futures.values()), timeout=self._timeout * 2 + 1.0)
+        errors: Dict[str, str] = {}
+        up = 0
+        for target in self.targets:
+            fut = futures[target.instance]
+            try:
+                if not fut.done():
+                    raise TimeoutError(
+                        f"scrape exceeded {self._timeout:.1f}s")
+                families = parse_openmetrics(fut.result())
+            except Exception as exc:  # noqa: BLE001 — one target must not kill the pass
+                errors[target.instance] = repr(exc)
+                self.metrics.fleet_scrape_errors.record()
+                with self._lock:
+                    entry = self._cache.setdefault(
+                        target.instance, {"families": [], "ts": None})
+                    entry["up"] = False
+                    entry["error"] = repr(exc)
+                continue
+            up += 1
+            with self._lock:
+                self._cache[target.instance] = {
+                    "families": families, "ts": self._clock(),
+                    "up": True, "error": None}
+        self.metrics.fleet_targets.record(len(self.targets))
+        self.metrics.fleet_up_targets.record(up)
+        self.metrics.fleet_scrape_timer.record_ms(
+            (self._clock() - t0) * 1000.0)
+        if self.slo is not None:
+            try:
+                merged = self.merged_families()
+                self.slo.evaluate(merged, now=self._clock())
+                self._merged_stash = merged
+            except Exception:  # noqa: BLE001 — SLO math must not break the scrape
+                logger.exception("SLO evaluation failed")
+        return {"targets": len(self.targets), "up": up, "errors": errors}
+
+    # -- merge --------------------------------------------------------------------------
+
+    def _relabel(self, fam: Family, target: ScrapeTarget) -> Family:
+        base = (("instance", target.instance), ("role", target.role))
+        out = Family(name=fam.name, mtype=fam.mtype, help=fam.help)
+        for s in fam.samples:
+            kept = tuple((k if k not in RESERVED_LABELS else f"exported_{k}",
+                          v) for k, v in s.labels)
+            out.samples.append(Sample(s.suffix, base + kept, s.value,
+                                      exemplar=s.exemplar))
+        return out
+
+    def merged_families(self) -> List[Family]:
+        """The merged exposition as families, sorted by name: fleet
+        self-instruments + every cached target payload (instance/role
+        labelled) + ``up`` and per-instance staleness gauges."""
+        merged: Dict[str, Family] = {}
+
+        def absorb(fam: Family) -> None:
+            hit = merged.get(fam.name)
+            if hit is None:
+                merged[fam.name] = fam
+                return
+            if hit.mtype != fam.mtype:
+                # a cross-registry TYPE conflict: re-home under a
+                # type-suffixed name instead of emitting a duplicate TYPE
+                renamed = Family(name=f"{fam.name}_{fam.mtype}",
+                                 mtype=fam.mtype, help=fam.help,
+                                 samples=fam.samples)
+                absorb(renamed)
+                return
+            hit.samples.extend(fam.samples)
+
+        up = Family(name="up", mtype="gauge",
+                    help="1 if the instance answered the last federation "
+                         "pass (0 = serving its last payload, stale)")
+        stale = Family(name="surge_fleet_scrape_staleness_seconds",
+                       mtype="gauge",
+                       help="age of the payload served for this instance "
+                            "(grows while the target is down)")
+        now = self._clock()
+        max_staleness = 0.0
+        with self._lock:
+            cache = {k: dict(v) for k, v in self._cache.items()}
+        for target in self.targets:
+            entry = cache.get(target.instance)
+            labels = (("instance", target.instance), ("role", target.role))
+            up.samples.append(Sample(
+                "", labels, 1.0 if entry and entry.get("up") else 0.0))
+            if entry is None or entry.get("ts") is None:
+                continue  # never scraped: nothing cached to serve or stamp
+            age = max(0.0, now - entry["ts"])
+            max_staleness = max(max_staleness, age)
+            stale.samples.append(Sample("", labels, age))
+            for fam in entry["families"]:
+                absorb(self._relabel(fam, target))
+        self.metrics.fleet_max_staleness.record(max_staleness)
+        absorb(up)
+        absorb(stale)
+        # self-instruments join the same payload. The merged-families gauge
+        # must be recorded BEFORE the registry VALUE snapshot (so this
+        # pass's own number renders) yet count exactly what absorb() will
+        # produce — names/types are static, so simulate the absorption
+        # without values (federating another federated scraper collides on
+        # these very names and must not overcount)
+        names = {name: fam.mtype for name, fam in merged.items()}
+
+        def would_add(name: str, mtype: str) -> int:
+            hit = names.get(name)
+            if hit is None:
+                names[name] = mtype
+                return 1
+            if hit == mtype:
+                return 0
+            return would_add(f"{name}_{mtype}", mtype)
+
+        added = sum(would_add(n, m)
+                    for n, m in _registry_shapes(self.metrics.registry))
+        self.metrics.fleet_merged_families.record(len(merged) + added)
+        for fam in registry_families(self.metrics.registry):
+            absorb(fam)
+        return [merged[name] for name in sorted(merged)]
+
+    def last_merged(self) -> List[Family]:
+        """The families the most recent ``scrape_once`` built for its SLO
+        pass (single-use stash — a back-to-back render/row-extract reuses
+        that pass's own merge instead of re-merging every payload), or a
+        fresh merge when nothing is stashed."""
+        stash, self._merged_stash = self._merged_stash, None
+        return stash if stash is not None else self.merged_families()
+
+    def render(self) -> str:
+        """The merged exposition from CACHE (``# EOF``-terminated; no pass)."""
+        lines: List[str] = []
+        for fam in self.last_merged():
+            _render_family(lines, fam)
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    def scrape_and_render(self) -> str:
+        """One federation pass, then the merged payload (what the scrape
+        port serves per GET)."""
+        self.scrape_once()
+        return self.render()
+
+    # -- serving ------------------------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Serve the merged exposition from the scraper's own scrape port
+        (a fresh federation pass per GET); returns the bound port."""
+        if self._server is not None:
+            return self._server.bound_port
+        self._server = MetricsHTTPServer(None, host=host, port=port,
+                                         render=self.scrape_and_render)
+        return self._server.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            server, self._server = self._server, None
+            server.stop()
+        with self._lock:
+            self._stopped = True
+            pool, self._pool = self._pool, None
+            channels, self._grpc_channels = self._grpc_channels, []
+            self._grpc_fetchers.clear()
+        if pool is not None:
+            pool.shutdown(wait=False)
+        for channel in channels:
+            try:
+                channel.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass

@@ -44,17 +44,28 @@ def same_clock_domain(dumps: Sequence[dict]) -> bool:
 class FlightRecorder:
     """Bounded ring buffer of ``(seq, mono, wall, type, attrs)`` events.
 
-    One per broker. Thread-safe: the sites span gRPC handler threads, the
-    replication worker, the group-sync thread and the liveness prober.
+    One per broker (and, since the fleet telemetry plane, one per ENGINE —
+    publisher lane transitions, rebalance fan-out, resident-plane moves and
+    health-bus restarts land in the same envelope shape, so engine and broker
+    dumps interleave through :func:`merge_dumps` into one incident timeline).
+    Thread-safe: the sites span gRPC handler threads, the replication worker,
+    the group-sync thread and the liveness prober.
     """
 
-    def __init__(self, capacity: int = 1024, name: str = "") -> None:
+    def __init__(self, capacity: int = 1024, name: str = "",
+                 role: str = "broker") -> None:
         self._ring: "deque" = deque(maxlen=max(capacity, 8))
         self._lock = threading.Lock()
         self._seq = 0
+        #: events the bounded ring evicted to make room — an operator reading
+        #: a mid-incident dump must be able to tell the ring wrapped
+        self._dropped = 0
         #: who recorded (the broker's advertised address, set lazily at
         #: start() — dumps from several brokers must be tellable apart)
         self.name = name
+        #: which lane this recorder's events belong to on a merged timeline
+        #: ("broker" | "engine"); carried in the dump envelope
+        self.role = role
         self.node = socket.gethostname()
 
     def record(self, etype: str, **attrs) -> None:
@@ -63,10 +74,23 @@ class FlightRecorder:
         try:
             with self._lock:
                 self._seq += 1
+                if len(self._ring) == self._ring.maxlen:
+                    self._dropped += 1
                 self._ring.append((self._seq, time.monotonic(), time.time(),
                                    etype, attrs or None))
         except Exception:  # noqa: BLE001 — observability must stay passive
             pass
+
+    def stats(self) -> dict:
+        """Ring occupancy view for status surfaces (BrokerStatus / the engine
+        admin plane): whether the bounded ring has wrapped mid-incident."""
+        with self._lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> dict:
+        return {"events": len(self._ring),
+                "capacity": self._ring.maxlen,
+                "dropped": self._dropped}
 
     def __len__(self) -> int:
         with self._lock:
@@ -76,6 +100,10 @@ class FlightRecorder:
         """The recorded events, oldest first (``last`` keeps only the tail)."""
         with self._lock:
             items = list(self._ring)
+        return self._format_events(items, last)
+
+    @staticmethod
+    def _format_events(items, last: Optional[int]) -> List[dict]:
         if last is not None:
             items = items[-last:] if last > 0 else []
         out = []
@@ -91,10 +119,16 @@ class FlightRecorder:
         ``dumped_mono``/``dumped_wall`` pair the host's two clocks at ONE
         instant — the header :func:`merge_dumps` estimates the per-host
         mono↔wall offset from, so cross-host merges survive wall-clock skew
-        during the incident."""
+        during the incident. Stats and events snapshot under ONE lock hold:
+        a mid-incident dump's dropped count must describe exactly the event
+        list it ships, not the ring three records later."""
+        with self._lock:
+            stats = self._stats_locked()
+            items = list(self._ring)
         return {"recorder": self.name, "node": self.node, "pid": os.getpid(),
+                "role": self.role, "stats": stats,
                 "dumped_wall": time.time(), "dumped_mono": time.monotonic(),
-                "events": self.events(last)}
+                "events": self._format_events(items, last)}
 
     def dump_to(self, path: str, last: Optional[int] = None) -> None:
         """Write the dump as JSON (the crash auto-dump sink). Best-effort:
@@ -137,10 +171,12 @@ def merge_dumps(dumps: Sequence[dict]) -> List[dict]:
     same_clock = same_clock_domain(dumps)
     for d in dumps:
         who = d.get("recorder") or d.get("node") or "?"
+        lane = d.get("role") or "broker"
         offset = host_wall_offset(d)
         for ev in d.get("events", ()):
             e = dict(ev)
             e["recorder"] = who
+            e["lane"] = lane
             e["_est_wall"] = (offset + e.get("mono", 0.0)
                               if offset is not None else e.get("wall", 0.0))
             merged.append(e)
@@ -174,7 +210,13 @@ def reconstruct_failover(merged: Sequence[dict]) -> dict:
 
     Returns ``{"phases": {name: event-or-None}, "complete": bool,
     "span_ms": float-or-None}`` — ``span_ms`` is decision → first ack in
-    host-monotonic time (same-host dumps; None when either end is missing)."""
+    host-monotonic time (same-host dumps; None when either end is missing).
+
+    Tolerates timelines with NO broker-shaped events at all — a merged set
+    holding only engine-lane dumps (lane transitions, rebalances, SLO
+    breaches) reconstructs to all-None phases with ``complete=False``
+    instead of raising, and events missing ``mono`` stamps (hand-built or
+    legacy dumps) simply yield no span."""
     merged = list(merged)
     phases: Dict[str, Optional[dict]] = {n: None for n in _PHASE_NAMES}
     promo_idx = max((i for i, e in enumerate(merged)
@@ -196,7 +238,9 @@ def reconstruct_failover(merged: Sequence[dict]) -> dict:
     span_ms = None
     start, end = phases["promotion_decision"], phases["first_acked_commit"]
     if (start is not None and end is not None
-            and start.get("recorder") == end.get("recorder")):
+            and start.get("recorder") == end.get("recorder")
+            and start.get("mono") is not None
+            and end.get("mono") is not None):
         # both phases are recorded by the PROMOTING broker (its prober
         # decides, its Transact acks), so their monotonic stamps share a
         # clock; a mismatch means hand-built dumps — no comparable span

@@ -1,0 +1,309 @@
+"""SLO burn-rate engine: declarative objectives over the federated payload.
+
+Raw histograms answer "what IS the p99"; an operator needs "are we meeting
+the target, and how fast are we spending the error budget if not". This
+module implements the Google-SRE multiwindow burn-rate method over the
+instruments the repo already ships, evaluated from the
+:class:`~surge_tpu.observability.federation.FederatedScraper`'s merged
+families (one evaluation per federation pass — no second collection path):
+
+- an :class:`SLO` names a metric FAMILY in the merged payload plus an
+  objective (the fraction of good events): ``latency`` objectives read a
+  histogram family (good = observations at or under ``threshold`` ms),
+  ``availability`` objectives read a bad-event counter against a
+  good-event counter (attempts = bad + good), and ``bound`` objectives
+  sample a gauge per pass (good = the gauge satisfies the bound —
+  staleness/lag style targets, and the fleet-level ``up`` gauge);
+- the engine keeps a cumulative-snapshot history per objective and computes
+  the **burn rate** — bad-fraction over a window divided by the error budget
+  ``1 - objective`` — over a FAST and a SLOW window
+  (``surge.slo.fast-window-ms`` / ``surge.slo.slow-window-ms``); a breach
+  fires only when BOTH windows exceed ``surge.slo.burn-threshold`` (fast
+  alone = noise spike, slow alone = old news: the multiwindow page
+  condition);
+- a breach increments ``surge.slo.breaches``, flips the ``slo`` health
+  component to **degraded** (never down — an SLO page must not trip restart
+  supervision), emits an ``slo.breach.<name>`` signal on the attached health
+  bus, and stamps an ``slo.breach`` flight-recorder event so the breach
+  appears on reconstructed incident timelines next to the promotion/fence
+  events that caused it.
+
+Every objective must reference a CATALOGED instrument — surgelint's
+``metric-catalog`` rule and ``tests/test_lint.py`` reject an ``SLO`` whose
+``family``/``good_family`` appears in no golden exposition (no dead
+objectives watching metrics nothing emits).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from surge_tpu.config import Config, default_config
+from surge_tpu.health import HealthCheck
+
+__all__ = ["DEFAULT_SLOS", "SLO", "SLOEngine"]
+
+
+@dataclass(frozen=True)
+class SLO:
+    """One declarative objective over a merged-payload family.
+
+    ``kind``:
+      - ``latency`` — ``family`` is a histogram (``_bucket``/``_count``);
+        good events are observations with value <= ``threshold`` (ms);
+      - ``availability`` — ``family`` is a BAD-event counter and
+        ``good_family`` the GOOD-event counter (both ``_total`` samples);
+        total = bad + good, so a window of 100% failures burns at full
+        rate instead of dividing by a success counter that never moved;
+      - ``bound`` — ``family`` is a gauge; each instance sample per
+        evaluation is one observation, bad when it violates ``threshold``
+        per ``op`` (``"gt"``: bad when value > threshold; ``"lt"``: bad
+        when value < threshold).
+    """
+
+    name: str
+    family: str
+    kind: str  # "latency" | "availability" | "bound"
+    objective: float  # fraction of good events, e.g. 0.99
+    threshold: float = 0.0
+    op: str = "gt"  # bound kind only: which violation direction is "bad"
+    good_family: str = ""  # availability kind only
+    description: str = ""
+
+    @property
+    def budget(self) -> float:
+        """The error budget: the bad fraction the objective tolerates."""
+        return max(1.0 - self.objective, 1e-9)
+
+
+#: the shipped fleet objectives — every family cited here is rendered by a
+#: golden exposition (tests/golden/*.om), which tests/test_lint.py enforces
+DEFAULT_SLOS: Tuple[SLO, ...] = (
+    SLO("command-latency",
+        family="surge_aggregate_command_handling_timer_ms",
+        kind="latency", objective=0.99, threshold=100.0,
+        description="99% of commands handle in <= 100ms"),
+    SLO("publish-availability",
+        family="surge_producer_publish_failures",
+        good_family="surge_producer_batch_commits",
+        kind="availability", objective=0.999,
+        description="99.9% of publish batches commit (failures are "
+                    "dominated by broker failover windows)"),
+    SLO("resident-staleness",
+        family="surge_replay_resident_fold_lag_records",
+        kind="bound", objective=0.99, threshold=4096.0, op="gt",
+        description="the resident plane's fold lag stays within the "
+                    "read-path staleness bound"),
+    SLO("quorum-hwm-lag",
+        family="surge_log_hwm_lag_records",
+        kind="bound", objective=0.99, threshold=10_000.0, op="gt",
+        description="the quorum-acked high-watermark keeps up with the "
+                    "applied frontier"),
+    SLO("fleet-up",
+        family="up",
+        kind="bound", objective=0.99, threshold=1.0, op="lt",
+        description="every fleet member answers its scrape (an instance "
+                    "down burns this objective's budget)"),
+)
+
+
+@dataclass
+class _Track:
+    """Cumulative (bad, total) snapshots for one objective, newest last."""
+
+    history: Deque[Tuple[float, float, float]] = field(default_factory=deque)
+    breached: bool = False
+    burn_fast: float = 0.0
+    burn_slow: float = 0.0
+    last_bad_fraction: float = 0.0
+
+
+class SLOEngine:
+    """Evaluates a set of objectives from merged families per pass."""
+
+    def __init__(self, slos: Sequence[SLO] = DEFAULT_SLOS,
+                 config: Config | None = None, metrics=None,
+                 on_signal=None, flight=None,
+                 clock=time.time) -> None:
+        cfg = config or default_config()
+        self.slos = list(slos)
+        self.fast_window_s = cfg.get_seconds("surge.slo.fast-window-ms",
+                                             300_000)
+        self.slow_window_s = cfg.get_seconds("surge.slo.slow-window-ms",
+                                             3_600_000)
+        self.burn_threshold = cfg.get_float("surge.slo.burn-threshold", 14.4)
+        self.metrics = metrics  # FleetMetrics quiver (optional)
+        self.on_signal = on_signal or (lambda name, level: None)
+        self.flight = flight  # FlightRecorder (optional): breaches join the ring
+        self._clock = clock
+        self._tracks: Dict[str, _Track] = {s.name: _Track() for s in self.slos}
+
+    # -- extraction ---------------------------------------------------------------------
+
+    @staticmethod
+    def _counts(slo: SLO, families: Dict[str, object]) -> Tuple[float, float]:
+        """Cumulative (bad, total) for one objective, summed across every
+        instance's samples in the merged payload."""
+        fam = families.get(slo.family)
+        if slo.kind == "latency":
+            if fam is None:
+                return 0.0, 0.0
+            good = bad = total = 0.0
+            # per-instance histograms: within one instance's label set, the
+            # good count is the cumulative bucket at the largest bound <=
+            # threshold; totals come from _count
+            per_inst: Dict[tuple, Dict[str, float]] = {}
+            for s in fam.samples:
+                inst = tuple(kv for kv in s.labels if kv[0] == "instance")
+                slot = per_inst.setdefault(inst, {"good": 0.0, "total": 0.0})
+                if s.suffix == "_count":
+                    slot["total"] = s.value
+                elif s.suffix == "_bucket":
+                    le = dict(s.labels).get("le", "")
+                    try:
+                        bound = float(le.replace("+Inf", "inf"))
+                    except ValueError:
+                        continue
+                    if bound <= slo.threshold:
+                        slot["good"] = max(slot["good"], s.value)
+            for slot in per_inst.values():
+                good += slot["good"]
+                total += slot["total"]
+            bad = max(total - good, 0.0)
+            return bad, total
+        if slo.kind == "availability":
+            bad = sum(s.value for s in fam.samples) if fam is not None else 0.0
+            good_fam = families.get(slo.good_family)
+            good = (sum(s.value for s in good_fam.samples)
+                    if good_fam is not None else 0.0)
+            # attempts = failures + successes: a window of pure failures
+            # must burn at full rate, not divide by a success counter that
+            # never moved (total=0 would read as burn 0 mid-outage)
+            return bad, bad + good
+        # bound: each instance gauge sample this pass is one observation
+        if fam is None:
+            return 0.0, 0.0
+        bad = total = 0.0
+        for s in fam.samples:
+            if s.suffix:
+                continue
+            total += 1.0
+            violated = (s.value > slo.threshold if slo.op == "gt"
+                        else s.value < slo.threshold)
+            if violated:
+                bad += 1.0
+        return bad, total
+
+    # -- burn-rate math -----------------------------------------------------------------
+
+    def _burn(self, track: _Track, window_s: float, now: float,
+              budget: float, cumulative: bool) -> float:
+        """Bad-fraction over the window / error budget. ``cumulative``
+        snapshots (counters, histograms) difference the window's endpoints;
+        per-pass snapshots (bound gauges) sum the window's observations."""
+        hist = [h for h in track.history if h[0] >= now - window_s]
+        if not hist:
+            return 0.0
+        older = [h for h in track.history if h[0] < now - window_s]
+        if not older and len(hist) < 2:
+            # the engine's first-ever snapshot trivially satisfies BOTH
+            # windows at once — one cold-start sample (a member caught
+            # mid-restart, a cumulative counter's lifetime total) must not
+            # page; persistence needs at least a second observation
+            return 0.0
+        if cumulative:
+            # increase()-style: delta vs the newest snapshot BEFORE the
+            # window, or vs the window's own first snapshot when the engine
+            # is younger than the window — a cold first scrape of a
+            # long-running fleet must not attribute its whole cumulative
+            # history to one window. Counter resets (a restarted process)
+            # clamp at 0 rather than going negative.
+            base = older[-1] if older else hist[0]
+            bad = max(hist[-1][1] - base[1], 0.0)
+            total = max(hist[-1][2] - base[2], 0.0)
+        else:
+            bad = sum(h[1] for h in hist)
+            total = sum(h[2] for h in hist)
+        if total <= 0.0:
+            return 0.0
+        return (bad / total) / budget
+
+    # -- evaluation ---------------------------------------------------------------------
+
+    def evaluate(self, families, now: Optional[float] = None) -> List[dict]:
+        """One evaluation pass over merged families (a list or a
+        name-keyed dict); returns the per-objective status rows."""
+        now = self._clock() if now is None else now
+        if not isinstance(families, dict):
+            families = {f.name: f for f in families}
+        rows: List[dict] = []
+        active = 0
+        max_burn = 0.0
+        for slo in self.slos:
+            track = self._tracks[slo.name]
+            bad, total = self._counts(slo, families)
+            track.history.append((now, bad, total))
+            while (len(track.history) > 2
+                   and track.history[1][0] < now - self.slow_window_s):
+                # keep ONE snapshot older than the slow window: cumulative
+                # deltas need the pre-window base
+                track.history.popleft()
+            cumulative = slo.kind in ("latency", "availability")
+            track.burn_fast = self._burn(track, self.fast_window_s, now,
+                                         slo.budget, cumulative)
+            track.burn_slow = self._burn(track, self.slow_window_s, now,
+                                         slo.budget, cumulative)
+            breached = (track.burn_fast >= self.burn_threshold
+                        and track.burn_slow >= self.burn_threshold)
+            if breached and not track.breached:
+                if self.metrics is not None:
+                    self.metrics.slo_breaches.record()
+                self.on_signal(f"slo.breach.{slo.name}", "warning")
+                if self.flight is not None:
+                    self.flight.record(
+                        "slo.breach", objective=slo.name,
+                        burn_fast=round(track.burn_fast, 2),
+                        burn_slow=round(track.burn_slow, 2),
+                        threshold=self.burn_threshold)
+            elif track.breached and not breached:
+                self.on_signal(f"slo.recovered.{slo.name}", "trace")
+                if self.flight is not None:
+                    self.flight.record("slo.recovered", objective=slo.name)
+            track.breached = breached
+            if breached:
+                active += 1
+            max_burn = max(max_burn, track.burn_fast)
+            rows.append(self.status_row(slo))
+        if self.metrics is not None:
+            self.metrics.slo_objectives.record(len(self.slos))
+            self.metrics.slo_evaluations.record()
+            self.metrics.slo_active_breaches.record(active)
+            self.metrics.slo_max_burn_rate.record(max_burn)
+        return rows
+
+    def status_row(self, slo: SLO) -> dict:
+        track = self._tracks[slo.name]
+        return {"objective": slo.name, "kind": slo.kind,
+                "target": slo.objective,
+                "burn_fast": round(track.burn_fast, 3),
+                "burn_slow": round(track.burn_slow, 3),
+                "breached": track.breached,
+                "description": slo.description}
+
+    def status(self) -> List[dict]:
+        """Per-objective burn/breach rows (what ``surgetop`` renders)."""
+        return [self.status_row(s) for s in self.slos]
+
+    def breached(self) -> List[str]:
+        return [s.name for s in self.slos if self._tracks[s.name].breached]
+
+    def health_component(self) -> HealthCheck:
+        """The ``slo`` component for a health tree: degraded while any
+        objective burns over threshold, never down — an SLO page means "go
+        look", not "restart things"."""
+        names = self.breached()
+        return HealthCheck(name="slo",
+                           status="degraded" if names else "up")

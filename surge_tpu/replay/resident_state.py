@@ -108,7 +108,7 @@ class ResidentStatePlane(Controllable):
                  derived_cols: Mapping[str, str] | None = None,
                  mesh=None, metrics=None,
                  on_signal: Callable[[str, str], None] | None = None,
-                 profiler=None) -> None:
+                 profiler=None, flight=None) -> None:
         self.log = log
         self.events_topic = events_topic
         self.spec = spec
@@ -122,6 +122,10 @@ class ResidentStatePlane(Controllable):
         self.metrics = metrics  # EngineMetrics (resident_* instruments) or None
         self.on_signal = on_signal or (lambda name, level: None)
         self.profiler = profiler
+        #: engine flight recorder (optional): seed/evict/re-anchor moves are
+        #: incident-timeline material (a rebalance purging slab rows explains
+        #: the fallback-read spike that follows it)
+        self.flight = flight
 
         self.capacity = max(
             self.config.get_int("surge.replay.resident.capacity", 65536), 8)
@@ -431,6 +435,11 @@ class ResidentStatePlane(Controllable):
                         or self._anchor_gen.get(p, 0) != gens.get(p, 0)):
                     self._purge_partition(p)
                     self._watermarks.pop(p, None)
+        if self.flight is not None:
+            self.flight.record("resident.seed",
+                               partitions=sorted(ends),
+                               resident=len(self._dir),
+                               spilled=len(self._spill))
 
     def _seed_scan_fold(self, ends: Dict[int, int]) -> None:
         logs: Dict[str, list] = {}
@@ -571,6 +580,9 @@ class ResidentStatePlane(Controllable):
             self._purge_partition(p)  # defensive: must never double-fold
             self._watermarks[p] = 0
             self._anchor_gen[p] = self._anchor_gen.get(p, 0) + 1
+        if self.flight is not None:
+            self.flight.record("resident.re-anchor", granted=added,
+                               revoked=removed, resident=len(self._dir))
         self._record_gauges()
 
     def _purge_partition(self, p: int) -> None:
@@ -945,6 +957,10 @@ class ResidentStatePlane(Controllable):
         self.stats["evictions"] += len(victims)
         if self.metrics is not None:
             self.metrics.resident_evictions.record(len(victims))
+        if self.flight is not None:
+            self.flight.record("resident.evict", count=len(victims),
+                               resident=len(self._dir),
+                               spilled=len(self._spill))
 
     # -- pulls / decode -----------------------------------------------------------------
 

@@ -34,6 +34,7 @@ __all__ = [
     "Span",
     "SpanContext",
     "Tracer",
+    "active_span",
     "active_trace_id",
     "extract_context",
     "inject_context",
@@ -44,6 +45,14 @@ __all__ = [
 #: produced its sample (contextvars: isolated per thread AND per asyncio task)
 _ACTIVE_SPAN: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
     "surge_active_span", default=None)
+
+
+def active_span() -> Optional["Span"]:
+    """The span the current context is inside of, or None — the parenting
+    anchor for spans started on the caller's behalf (the log client parents
+    its broker-call spans here so a pipelined retry's failover histograms
+    carry the ORIGINATING command's trace id, not a fresh root's)."""
+    return _ACTIVE_SPAN.get()
 
 
 def active_trace_id() -> Optional[str]:

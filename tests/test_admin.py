@@ -68,6 +68,23 @@ def test_admin_introspection_and_controls():
         ok, _ = await client.restart_component("no-such-thing")
         assert not ok
 
+        # engine flight recorder over the admin plane: the command above
+        # dispatched at least one group commit (lane.dispatch), and the
+        # restart-driven health signal was tapped into the same ring
+        dump = await client.flight_dump()
+        types = [e["type"] for e in dump["events"]]
+        assert "lane.dispatch" in types
+        assert any(e["type"] == "health.signal"
+                   and e["name"] == "health.component-restarted"
+                   for e in dump["events"])
+        assert dump["role"] == "engine"  # merges as the engine lane
+        assert dump["stats"]["dropped"] == 0
+        assert dump["stats"]["events"] == len(types)
+        tail = await client.flight_dump(last=1)
+        assert len(tail["events"]) == 1
+        # ring occupancy + dropped count also ride the GetMetrics status
+        assert (await client.metrics())["flight"]["capacity"] == 1024
+
         ok, detail = await client.stop_engine()
         assert ok and engine.status == EngineStatus.STOPPED
         await admin.stop()

@@ -12,6 +12,7 @@ import pytest
 from surge_tpu.health import HealthSignalBus, HealthSupervisor
 from surge_tpu.metrics import MetricInfo, Metrics, engine_metrics
 from surge_tpu.metrics.broker import broker_metrics
+from surge_tpu.metrics.fleet import fleet_metrics
 from surge_tpu.metrics.exposition import (
     MetricsHTTPServer,
     health_collector,
@@ -22,6 +23,12 @@ from surge_tpu.metrics.exposition import (
 GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "metrics.om")
 BROKER_GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden",
                                   "metrics_broker.om")
+# the fleet golden is the MERGED federated payload (rendered by
+# test_federation.golden_fleet_scrape); the fleet quiver's own families are
+# part of it, so the catalog-completeness parametrization below can hold the
+# fleet registry to the same golden/docs coupling as engine and broker
+FLEET_GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden",
+                                 "metrics_fleet.om")
 
 _HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
 _TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
@@ -84,17 +91,33 @@ def validate_openmetrics(text: str) -> dict:
         else:
             assert suffix == "", f"gauge sample must be bare: {ln!r}"
         samples.append((suffix, labels_raw or "", value))
-    # histogram invariants: cumulative buckets, +Inf bucket == _count
+    # histogram invariants: cumulative buckets, +Inf bucket == _count — PER
+    # LABEL SET (a federated payload repeats one histogram family per
+    # instance; each instance's series must hold the invariants on its own)
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+    def series_key(labels_raw: str) -> frozenset:
+        return frozenset((k, v) for k, v in label_re.findall(labels_raw)
+                         if k != "le")
+
     for name, (mtype, samples) in families.items():
         if mtype != "histogram":
             continue
-        buckets = [(lr, float(v)) for s, lr, v in samples if s == "_bucket"]
-        counts = [float(v) for s, _, v in samples if s == "_count"]
-        assert buckets and len(counts) == 1, name
-        values = [v for _, v in buckets]
-        assert values == sorted(values), f"{name} buckets not cumulative"
-        assert 'le="+Inf"' in buckets[-1][0], f"{name} missing +Inf bucket"
-        assert buckets[-1][1] == counts[0], f"{name} +Inf != _count"
+        buckets: dict = {}
+        counts: dict = {}
+        for s, lr, v in samples:
+            if s == "_bucket":
+                buckets.setdefault(series_key(lr), []).append(
+                    (lr, float(v)))
+            elif s == "_count":
+                counts.setdefault(series_key(lr), []).append(float(v))
+        assert buckets and set(buckets) == set(counts), name
+        for key, series in buckets.items():
+            assert len(counts[key]) == 1, f"{name} duplicate _count"
+            values = [v for _, v in series]
+            assert values == sorted(values), f"{name} buckets not cumulative"
+            assert 'le="+Inf"' in series[-1][0], f"{name} missing +Inf bucket"
+            assert series[-1][1] == counts[key][0], f"{name} +Inf != _count"
     return families
 
 
@@ -172,7 +195,8 @@ def test_broker_render_matches_golden():
 @pytest.mark.parametrize("quiver_factory,golden_path", [
     (engine_metrics, GOLDEN_PATH),
     (broker_metrics, BROKER_GOLDEN_PATH),
-], ids=["engine", "broker"])
+    (fleet_metrics, FLEET_GOLDEN_PATH),
+], ids=["engine", "broker", "fleet"])
 def test_every_instrument_in_export_docs_catalog_and_golden(quiver_factory,
                                                             golden_path):
     """Catalog completeness across EVERY registry (engine AND broker): each
@@ -197,9 +221,9 @@ def test_every_instrument_in_export_docs_catalog_and_golden(quiver_factory,
         base = re.sub(r"\.(min|max)$", "", base)
         assert base in docs, f"{base} missing from the docs metric catalog"
     # histogram series carry buckets, not a lone p99 point
-    sample = ("surge.replay.rebuild-timer"
-              if quiver_factory is engine_metrics
-              else "surge.log.journal.fsync-round-timer")
+    sample = {engine_metrics: "surge.replay.rebuild-timer",
+              broker_metrics: "surge.log.journal.fsync-round-timer",
+              fleet_metrics: "surge.fleet.scrape-timer"}[quiver_factory]
     assert families[sanitize_name(sample) + "_ms"][0] == "histogram"
 
 

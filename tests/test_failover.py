@@ -116,6 +116,64 @@ def _assert_exactly_once(log, topic, acked, partitions=1):
 # -- roles & redirects ----------------------------------------------------------------
 
 
+def test_client_failover_histograms_record_and_carry_exemplars():
+    """The client-side failover histograms (redirect reconnect + jittered
+    backoff) record on the retry path, and — with exemplar capture on and an
+    active sampled span — their buckets link to the commanding trace:
+    the last ROADMAP item-6 leg."""
+    from surge_tpu.metrics import Metrics, engine_metrics
+    from surge_tpu.metrics.exposition import render_openmetrics
+    from surge_tpu.tracing import InMemoryTracer
+
+    leader, follower, lport, fport = _pair()
+    try:
+        em = engine_metrics(Metrics(exemplars=True))
+        tracer = InMemoryTracer()
+        # connect to the FOLLOWER: OpenProducer answers NOT_LEADER with the
+        # leader hint, the transport reconnects (redirect timer) — all under
+        # an active sampled span, as a command's publish path would be
+        client = GrpcLogTransport(f"127.0.0.1:{fport}", config=FAST_CFG,
+                                  metrics=em, tracer=tracer)
+        client.create_topic(TopicSpec("ev", 1))
+        with tracer.start_span("cmd") as span:
+            producer = client.transactional_producer("t")
+        values = em.registry.get_metrics()
+        assert values["surge.log.failover.redirects"] == 1.0
+        assert values["surge.log.failover.redirect-timer.p99"] > 0.0
+        text = render_openmetrics(em.registry)
+        assert (f'trace_id="{span.context.trace_id}"') in text
+        bucket_lines = [ln for ln in text.splitlines()
+                        if "surge_log_failover_redirect_timer_ms_bucket"
+                        in ln and "trace_id" in ln]
+        assert bucket_lines, text  # the redirect bucket carries the exemplar
+        # the backoff histogram records the jittered sleep actually paid
+        with tracer.start_span("retry"):
+            client._backoff_sleep(0.004)
+        assert em.registry.get_metrics()[
+            "surge.log.failover.backoff-timer.p99"] > 0.0
+        assert "surge_log_failover_backoff_timer_ms_bucket" in \
+            render_openmetrics(em.registry)
+
+        # context threading: a pipelined commit dispatched from inside a
+        # span ships on a POOL thread, yet its broker-call span is a child
+        # of the dispatching span (copied contextvars + active-span parent)
+        with tracer.start_span("flush") as flush_span:
+            producer.begin()
+            producer.send(rec("ev", "k", b"v"))
+            handle = producer.commit_pipelined()
+        handle.future.result(timeout=10)
+        transact_spans = [s for s in tracer.spans_named("log.Transact")
+                          if s.attributes.get("txn_seq") == handle.seq]
+        assert transact_spans, [s.name for s in tracer.finished]
+        assert transact_spans[0].context.trace_id == \
+            flush_span.context.trace_id
+        assert transact_spans[0].parent_id == flush_span.context.span_id
+        client.close()
+    finally:
+        leader.stop()
+        follower.stop()
+
+
 def test_follower_refuses_writes_and_client_follows_redirect():
     leader, follower, lport, fport = _pair()
     try:

@@ -1,0 +1,333 @@
+"""Federated scrape: parser round trip, instance/role labelling, the
+down-target / duplicate-family / type-conflict / skewed-staleness edge cases,
+the fleet golden payload (canned engine+broker targets, coupled into
+tools/regen_golden_metrics.py), and the live 3-broker + 1-engine federation
+over real GetMetricsText RPCs + an HTTP scrape endpoint."""
+
+import os
+
+from conftest import free_ports
+from surge_tpu.log import GrpcLogTransport, InMemoryLog, LogRecord, LogServer, TopicSpec
+from surge_tpu.metrics import engine_metrics
+from surge_tpu.metrics.exposition import (
+    Family,
+    MetricsHTTPServer,
+    Sample,
+    render_openmetrics,
+)
+from surge_tpu.metrics.fleet import fleet_metrics
+from surge_tpu.observability import (
+    FederatedScraper,
+    ScrapeTarget,
+    parse_openmetrics,
+    target_from_spec,
+)
+from tests.test_exposition import (
+    golden_broker_metrics,
+    golden_engine_metrics,
+    validate_openmetrics,
+)
+
+FLEET_GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden",
+                                 "metrics_fleet.om")
+
+
+# -- parser ---------------------------------------------------------------------------
+
+
+def test_parser_round_trips_engine_registry():
+    em = golden_engine_metrics()
+    text = render_openmetrics(em.registry)
+    fams = {f.name: f for f in parse_openmetrics(text)}
+    # typed families survive with their samples
+    assert fams["surge_engine_live_entities"].mtype == "gauge"
+    assert fams["surge_engine_live_entities"].samples[0].value == 7.0
+    assert fams["surge_producer_publish_failures"].mtype == "counter"
+    hist = fams["surge_aggregate_state_fetch_timer_ms"]
+    assert hist.mtype == "histogram"
+    suffixes = {s.suffix for s in hist.samples}
+    assert suffixes == {"_bucket", "_sum", "_count"}
+
+
+def test_parser_reads_exemplars_and_label_escapes():
+    text = ('# TYPE t_ms histogram\n'
+            't_ms_bucket{le="10"} 1 # {trace_id="' + "ab" * 16 + '"} 7 1.5\n'
+            't_ms_sum 7\nt_ms_count 1\n'
+            '# TYPE g gauge\n'
+            'g{topic="a\\"b\\\\c\\nd"} 2\n'
+            'untyped_sample 3\n'
+            '# EOF\n')
+    fams = {f.name: f for f in parse_openmetrics(text)}
+    bucket = fams["t_ms"].samples[0]
+    assert bucket.exemplar == ("ab" * 16, 7.0, 1.5)
+    assert fams["g"].samples[0].labels == (("topic", 'a"b\\c\nd'),)
+    assert fams["untyped_sample"].mtype == "gauge"  # lenient fallback
+
+
+# -- merge ----------------------------------------------------------------------------
+
+
+def _scraper(targets, clock=lambda: 1000.0, **kw):
+    return FederatedScraper(targets, clock=clock, **kw)
+
+
+def test_merge_labels_every_sample_with_instance_and_role():
+    em, bm = golden_engine_metrics(), golden_broker_metrics()
+    s = _scraper([
+        ScrapeTarget("e1", "engine",
+                     fetch=lambda: render_openmetrics(em.registry)),
+        ScrapeTarget("b1", "broker",
+                     fetch=lambda: render_openmetrics(bm.registry)),
+    ])
+    assert s.scrape_once() == {"targets": 2, "up": 2, "errors": {}}
+    text = s.render()
+    families = validate_openmetrics(text)
+    # per-instance labels on merged samples + the up gauges
+    assert 'surge_engine_live_entities{instance="e1",role="engine"} 7' in text
+    assert ('surge_log_replication_insync_replicas'
+            '{instance="b1",role="broker"} 2') in text
+    assert 'up{instance="e1",role="engine"} 1' in text
+    assert 'up{instance="b1",role="broker"} 1' in text
+    # duplicate family names across the two registries merge under ONE
+    # TYPE declaration with both instances' samples
+    assert text.count("# TYPE surge_log_failover_promotions counter") == 1
+    fam = families["surge_log_failover_promotions"]
+    labels = {lr for suffix, lr, _v in fam[1] if suffix == "_total"}
+    assert labels == {'instance="e1",role="engine"',
+                      'instance="b1",role="broker"'}
+    # fleet self-instruments join the same payload
+    assert "surge_fleet_up_targets 2" in text
+
+
+def test_down_target_serves_stale_payload_with_up_zero():
+    em = golden_engine_metrics()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise ConnectionError("target died")
+        return render_openmetrics(em.registry)
+
+    now = {"t": 1000.0}
+    s = _scraper([ScrapeTarget("e1", "engine", fetch=flaky)],
+                 clock=lambda: now["t"])
+    assert s.scrape_once()["up"] == 1
+    now["t"] = 1030.0
+    summary = s.scrape_once()
+    assert summary["up"] == 0 and "e1" in summary["errors"]
+    text = s.render()
+    validate_openmetrics(text)
+    # the payload still renders: up flips to 0, the cached families keep
+    # serving, and the staleness stamp carries their age
+    assert 'up{instance="e1",role="engine"} 0' in text
+    assert 'surge_engine_live_entities{instance="e1",role="engine"} 7' in text
+    assert ('surge_fleet_scrape_staleness_seconds'
+            '{instance="e1",role="engine"} 30') in text
+    assert "surge_fleet_max_staleness_seconds 30" in text
+
+
+def test_never_scraped_target_renders_up_zero_only():
+    s = _scraper([ScrapeTarget("gone", "broker",
+                               fetch=lambda: (_ for _ in ()).throw(
+                                   ConnectionError("refused")))])
+    s.scrape_once()
+    text = s.render()
+    validate_openmetrics(text)
+    assert 'up{instance="gone",role="broker"} 0' in text
+    assert 'staleness_seconds{instance="gone"' not in text  # nothing cached
+
+
+def test_type_conflict_rehomes_under_type_suffixed_name():
+    a = "# TYPE foo gauge\nfoo 1\n# EOF\n"
+    b = "# TYPE foo counter\nfoo_total 2\n# EOF\n"
+    s = _scraper([ScrapeTarget("x", "engine", fetch=lambda: a),
+                  ScrapeTarget("y", "broker", fetch=lambda: b)])
+    s.scrape_once()
+    text = s.render()
+    families = validate_openmetrics(text)
+    assert families["foo"][0] == "gauge"
+    assert families["foo_counter"][0] == "counter"  # re-homed, not dropped
+
+
+def test_reserved_labels_from_targets_are_renamed():
+    payload = ('# TYPE g gauge\n'
+               'g{instance="liar",role="fake"} 5\n# EOF\n')
+    s = _scraper([ScrapeTarget("real", "broker", fetch=lambda: payload)])
+    s.scrape_once()
+    text = s.render()
+    validate_openmetrics(text)
+    assert ('g{instance="real",role="broker",'
+            'exported_instance="liar",exported_role="fake"} 5') in text
+
+
+def test_skewed_staleness_stamps_per_instance():
+    """Two targets whose payloads aged differently carry DIFFERENT stamps —
+    the fleet view never averages staleness away."""
+    em, bm = golden_engine_metrics(), golden_broker_metrics()
+    healthy = lambda: render_openmetrics(em.registry)  # noqa: E731
+    calls = {"n": 0}
+
+    def dies_after_first(_bm=bm):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise TimeoutError("skewed")
+        return render_openmetrics(_bm.registry)
+
+    now = {"t": 0.0}
+    s = _scraper([ScrapeTarget("fresh", "engine", fetch=healthy),
+                  ScrapeTarget("stale", "broker", fetch=dies_after_first)],
+                 clock=lambda: now["t"])
+    s.scrape_once()
+    now["t"] = 60.0
+    s.scrape_once()
+    text = s.render()
+    assert ('surge_fleet_scrape_staleness_seconds'
+            '{instance="fresh",role="engine"} 0') in text
+    assert ('surge_fleet_scrape_staleness_seconds'
+            '{instance="stale",role="broker"} 60') in text
+
+
+# -- golden ---------------------------------------------------------------------------
+
+
+def golden_fleet_scrape() -> FederatedScraper:
+    """The canonical deterministic federation: the engine and broker golden
+    recording sequences as two canned targets under a pinned clock
+    (tools/regen_golden_metrics.py re-renders this into metrics_fleet.om).
+    Exercises the real merge: instance/role labelling, duplicate-family
+    collapse (the shared failover/faults counters), up + staleness gauges,
+    and the fleet self-instruments."""
+    em, bm = golden_engine_metrics(), golden_broker_metrics()
+    scraper = FederatedScraper(
+        [ScrapeTarget("engine-0", "engine",
+                      fetch=lambda: render_openmetrics(em.registry)),
+         ScrapeTarget("broker-0", "broker",
+                      fetch=lambda: render_openmetrics(bm.registry))],
+        metrics=fleet_metrics(), clock=lambda: 1_700_000_000.0)
+    scraper.scrape_once()
+    return scraper
+
+
+def test_fleet_render_matches_golden():
+    text = golden_fleet_scrape().render()
+    validate_openmetrics(text)
+    with open(FLEET_GOLDEN_PATH) as f:
+        golden = f.read()
+    assert text == golden, (
+        "federated OpenMetrics payload drifted from tests/golden/"
+        "metrics_fleet.om — if the change is intentional run "
+        "tools/regen_golden_metrics.py and update the docs/observability.md "
+        "fleet catalog (golden and catalog are coupled; regen both together)")
+
+
+# -- live federation (3 brokers + 1 engine) -------------------------------------------
+
+
+def test_live_federation_three_brokers_one_engine():
+    """The acceptance shape: three real LogServers scraped over their
+    GetMetricsText RPC plus one engine registry over a real HTTP scrape
+    endpoint, merged into one grammar-valid payload with per-instance labels
+    and up gauges — then one broker dies and the payload degrades honestly."""
+    ports = free_ports(3)
+    brokers = []
+    try:
+        for port in ports:
+            srv = LogServer(InMemoryLog(), port=port)
+            srv.start()
+            brokers.append(srv)
+        client = GrpcLogTransport(f"127.0.0.1:{ports[0]}")
+        client.create_topic(TopicSpec("ev", 1))
+        p = client.transactional_producer("t")
+        p.begin()
+        p.send(LogRecord(topic="ev", key="k", value=b"v"))
+        p.commit()
+        client.close()
+
+        em = engine_metrics()
+        em.live_entities.record(3)
+        http = MetricsHTTPServer(em.registry)
+        http_port = http.start()
+        try:
+            specs = [f"broker@127.0.0.1:{p}" for p in ports]
+            specs.append(f"engine@http://127.0.0.1:{http_port}/metrics")
+            scraper = FederatedScraper(specs)
+            try:
+                summary = scraper.scrape_once()
+                assert summary == {"targets": 4, "up": 4, "errors": {}}
+                text = scraper.render()
+                families = validate_openmetrics(text)
+                for port in ports:
+                    assert (f'up{{instance="127.0.0.1:{port}",'
+                            f'role="broker"}} 1') in text
+                assert (f'up{{instance="127.0.0.1:{http_port}",'
+                        f'role="engine"}} 1') in text
+                # per-broker registries merged under one TYPE block each
+                fam = families["surge_log_journal_fsync_round_timer_ms"]
+                assert fam[0] == "histogram"
+                assert ('surge_engine_live_entities'
+                        f'{{instance="127.0.0.1:{http_port}",'
+                        'role="engine"} 3') in text
+                # the scraper's own scrape port serves the same merge
+                fleet_port = scraper.serve()
+                import urllib.request
+
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{fleet_port}/metrics") as resp:
+                    body = resp.read().decode()
+                validate_openmetrics(body)
+                assert "surge_fleet_up_targets 4" in body
+                # one broker dies: the next pass still renders, up drops
+                brokers[1].stop()
+                summary = scraper.scrape_once()
+                assert summary["up"] == 3
+                text = scraper.render()
+                validate_openmetrics(text)
+                assert (f'up{{instance="127.0.0.1:{ports[1]}",'
+                        'role="broker"} 0') in text
+            finally:
+                scraper.stop()
+        finally:
+            http.stop()
+    finally:
+        for b in brokers:
+            try:
+                b.stop()
+            except Exception:  # noqa: BLE001 — one already stopped
+                pass
+
+
+def test_target_from_spec_parsing():
+    t = target_from_spec("broker@127.0.0.1:16001")
+    assert (t.role, t.address, t.instance) == (
+        "broker", "127.0.0.1:16001", "127.0.0.1:16001")
+    t = target_from_spec("engine@http://host:9464/metrics")
+    assert t.role == "engine" and t.instance == "host:9464"
+    t = target_from_spec("127.0.0.1:16002")  # bare addr defaults to broker
+    assert t.role == "broker"
+
+
+def test_merged_families_returns_sorted_families():
+    em = golden_engine_metrics()
+    s = _scraper([ScrapeTarget("e", "engine",
+                               fetch=lambda: render_openmetrics(em.registry))])
+    s.scrape_once()
+    names = [f.name for f in s.merged_families()]
+    assert names == sorted(names)
+
+
+def test_family_dataclass_reuse():
+    """The parser emits the exposition module's own Family/Sample types, so
+    merged families re-render through the same _render_family path."""
+    fams = parse_openmetrics("# TYPE x gauge\nx 1\n# EOF\n")
+    assert isinstance(fams[0], Family)
+    assert isinstance(fams[0].samples[0], Sample)
+
+
+def test_scrape_and_render_one_call():
+    em = golden_engine_metrics()
+    s = _scraper([ScrapeTarget("e", "engine",
+                               fetch=lambda: render_openmetrics(em.registry))])
+    text = s.scrape_and_render()
+    validate_openmetrics(text)
+    assert 'up{instance="e",role="engine"} 1' in text

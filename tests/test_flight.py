@@ -39,6 +39,63 @@ def test_recorder_is_bounded_and_ordered():
     assert len(dump["events"]) == 16
 
 
+def test_recorder_counts_dropped_events_and_reports_stats():
+    """The bounded ring must be able to tell an operator it wrapped: stats
+    carry occupancy + dropped count, and the dump envelope ships them."""
+    rec = FlightRecorder(capacity=16, name="b1")
+    assert rec.stats() == {"events": 0, "capacity": 16, "dropped": 0}
+    for i in range(40):
+        rec.record("tick", i=i)
+    assert rec.stats() == {"events": 16, "capacity": 16, "dropped": 24}
+    dump = rec.dump()
+    assert dump["stats"]["dropped"] == 24
+    assert dump["role"] == "broker"  # default lane
+    eng = FlightRecorder(name="engine:x", role="engine")
+    eng.record("lane.dispatch", partition=0)
+    assert eng.dump()["role"] == "engine"
+
+
+def test_merge_tags_each_event_with_its_dump_lane():
+    broker = {"recorder": "b1", "node": "h", "events": [
+        {"seq": 1, "mono": 1.0, "wall": 1.0, "type": "broker.kill"}]}
+    engine = {"recorder": "engine:c", "node": "h", "role": "engine",
+              "events": [
+                  {"seq": 1, "mono": 2.0, "wall": 2.0, "type": "lane.fence"},
+                  {"seq": 2, "mono": 3.0, "wall": 3.0, "type": "lane.rejoin"},
+              ]}
+    merged = merge_dumps([broker, engine])
+    assert [(e["type"], e["lane"]) for e in merged] == [
+        ("broker.kill", "broker"), ("lane.fence", "engine"),
+        ("lane.rejoin", "engine")]
+
+
+def test_reconstruct_tolerates_engine_lane_only_dumps():
+    """A merged set with NO broker-shaped events (engine lane only) must
+    reconstruct to all-missing phases, not raise — and events without mono
+    stamps yield span None instead of a KeyError."""
+    engine = {"recorder": "engine:c", "node": "h", "role": "engine",
+              "events": [
+                  {"seq": 1, "mono": 1.0, "wall": 1.0, "type": "lane.fence",
+                   "partition": 0},
+                  {"seq": 2, "mono": 2.0, "wall": 2.0,
+                   "type": "rebalance.retarget", "granted": [1]},
+                  {"seq": 3, "mono": 3.0, "wall": 3.0, "type": "slo.breach",
+                   "objective": "fleet-up"},
+              ]}
+    recon = reconstruct_failover(merge_dumps([engine]))
+    assert recon["complete"] is False
+    assert all(v is None for v in recon["phases"].values())
+    assert recon["span_ms"] is None
+    # a promotion whose decision/ack events lack mono stamps: no span
+    stampless = {"recorder": "b", "node": "h", "events": [
+        {"seq": 1, "type": "role.promote-decision"},
+        {"seq": 2, "type": "role.promote", "epoch": 2},
+        {"seq": 3, "type": "txn.first-ack"}]}
+    recon = reconstruct_failover(merge_dumps([stampless]))
+    assert recon["span_ms"] is None
+    assert recon["phases"]["promotion"]["epoch"] == 2
+
+
 def test_recorder_dump_to_is_best_effort(tmp_path):
     rec = FlightRecorder(name="b")
     rec.record("x")
@@ -271,6 +328,54 @@ def test_flight_timeline_cli_on_canned_dumps(tmp_path):
                if ln.strip().startswith("+")][:6]  # the merged event lines
     assert offsets == sorted(offsets), out.stdout
     assert offsets[0] == 0.0
+
+
+def test_flight_timeline_cli_engine_lane(tmp_path):
+    """--engine interleaves an engine-lane dump: events print with the
+    [engine] lane tag in causal position, and an engine-only input reports
+    MISSING phases (exit 1) instead of crashing."""
+    follower, exleader = _canned_dumps()
+    engine = {"recorder": "engine:counter", "node": "host-a", "pid": 9,
+              "role": "engine",  # dumps from the admin RPC carry this
+              "events": [
+                  {"seq": 1, "mono": 1000.005, "wall": 1.7e9 + 0.005,
+                   "type": "lane.fence", "partition": 0},
+                  {"seq": 2, "mono": 1000.100, "wall": 1.7e9 + 0.100,
+                   "type": "lane.rejoin", "partition": 0},
+              ]}
+    fpath = str(tmp_path / "f.json")
+    lpath = str(tmp_path / "l.json")
+    epath = str(tmp_path / "e.json")
+    json.dump(follower, open(fpath, "w"))
+    json.dump(exleader, open(lpath, "w"))
+    json.dump(engine, open(epath, "w"))
+    cli = os.path.join(REPO, "tools", "flight_timeline.py")
+
+    out = subprocess.run(
+        [sys.executable, cli, fpath, lpath, "--engine", epath],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "lanes: broker, engine" in out.stdout
+    lines = out.stdout.splitlines()
+    fence_idx = next(i for i, ln in enumerate(lines)
+                     if "[engine]" in ln and "lane.fence" in ln)
+    # the engine lane fence (t=5ms) sits between the broker kill (t=0) and
+    # the promotion decision (t=10ms) — one interleaved story
+    assert "broker.kill" in lines[fence_idx - 1]
+    assert "role.promote-decision" in lines[fence_idx + 1]
+    assert "reconstruction complete" in out.stdout
+
+    out = subprocess.run([sys.executable, cli, epath],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 1  # engine-only: phases missing, not a crash
+    assert "MISSING" in out.stdout
+    assert "[engine]" in out.stdout  # auto-detected from the envelope
+
+    out = subprocess.run(
+        [sys.executable, cli, fpath, lpath, "--engine", epath, "--json"],
+        capture_output=True, text=True, timeout=60)
+    payload = json.loads(out.stdout)
+    assert {e["lane"] for e in payload["events"]} == {"broker", "engine"}
 
 
 # -- live broker plane ----------------------------------------------------------------

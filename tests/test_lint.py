@@ -118,6 +118,61 @@ def test_metric_catalog_golden_coupling(tmp_path):
     assert len(found) == 1 and "golden" in found[0].message, found
 
 
+def test_slo_definitions_must_reference_cataloged_instruments(tmp_path):
+    """An SLO citing a family no golden exposition renders is a DEAD
+    objective (it watches a metric nothing emits, so it can never page) —
+    the metric-catalog rule rejects it; a golden-backed family passes."""
+    mod_dir = tmp_path / "surge_tpu" / "observability"
+    mod_dir.mkdir(parents=True)
+    mod = mod_dir / "slo.py"
+    mod.write_text(
+        "from dataclasses import dataclass\n"
+        "@dataclass(frozen=True)\n"
+        "class SLO:\n"
+        "    name: str; family: str; kind: str; objective: float\n"
+        "    good_family: str = ''\n"
+        "LIVE = SLO('ok', family='surge_real_family', kind='bound',\n"
+        "           objective=0.99)\n"
+        "DEAD = SLO('dead', family='surge_ghost_family', kind='bound',\n"
+        "           objective=0.99)\n"
+        "DEAD_TOTAL = SLO('dead2', family='surge_real_family',\n"
+        "                 kind='availability', objective=0.99,\n"
+        "                 good_family='surge_ghost_total')\n")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text("")
+    (tmp_path / "tests" / "golden").mkdir(parents=True)
+    (tmp_path / "tests" / "golden" / "metrics.om").write_text(
+        "# TYPE surge_real_family gauge\n")
+    (tmp_path / "tests" / "golden" / "metrics_broker.om").write_text("")
+    (tmp_path / "tests" / "golden" / "metrics_fleet.om").write_text("")
+    rule = all_rules()["metric-catalog"]
+    ctx = ModuleContext.parse(str(mod), str(tmp_path))
+    found = [f for f in rule.check_repo(RepoContext(str(tmp_path), [ctx]))
+             if "SLO references" in f.message]
+    assert sorted(f.message.split("`")[1] for f in found) == [
+        "surge_ghost_family", "surge_ghost_total"], found
+
+
+def test_shipped_default_slos_are_all_golden_backed():
+    """The runtime half of the no-dead-objectives gate: every family the
+    shipped DEFAULT_SLOS cite is rendered by a checked-in golden."""
+    import re as _re
+
+    from surge_tpu.observability import DEFAULT_SLOS
+
+    golden_families = set()
+    for name in ("metrics.om", "metrics_broker.om", "metrics_fleet.om"):
+        with open(os.path.join(REPO, "tests", "golden", name)) as f:
+            golden_families |= set(
+                _re.findall(r"^# TYPE (\S+) ", f.read(), _re.M))
+    for slo in DEFAULT_SLOS:
+        for fam in filter(None, (slo.family, slo.good_family)):
+            assert any(g == fam or g.startswith(fam + "_")
+                       for g in golden_families), (
+                f"SLO {slo.name!r} references {fam!r}, which no golden "
+                "exposition renders — a dead objective")
+
+
 # -- proto-drift ---------------------------------------------------------------------
 
 _FIXTURE_METHODS = {"Ping": ("PingRequest", "PingReply"),

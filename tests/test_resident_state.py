@@ -54,7 +54,7 @@ def make_log():
 
 
 def make_plane(log, *, capacity=64, max_lag=4096, metrics=None, profiler=None,
-               partitions=None, overrides=None):
+               partitions=None, overrides=None, flight=None):
     cfg = default_config().with_overrides({
         "surge.replay.resident.capacity": capacity,
         "surge.replay.resident.max-lag-records": max_lag,
@@ -69,7 +69,7 @@ def make_plane(log, *, capacity=64, max_lag=4096, metrics=None, profiler=None,
         deserialize_event=lambda raw: EVT.read_event(
             SerializedMessage(key="", value=raw)),
         serialize_state=lambda a, s: STATE.write_state(s).value,
-        metrics=metrics, profiler=profiler)
+        metrics=metrics, profiler=profiler, flight=flight)
 
 
 class Expected:
@@ -232,7 +232,11 @@ def test_eviction_spills_exact_fold_point_and_readmits():
         for agg in first:
             evs.extend(exp.events(agg, 5))
         append_events(log, evs)
-        plane = make_plane(log, capacity=8)  # 8 is the plane's floor
+        from surge_tpu.observability import FlightRecorder
+
+        flight = FlightRecorder(name="engine:t", role="engine")
+        plane = make_plane(log, capacity=8,  # 8 is the plane's floor
+                           flight=flight)
         await plane.start()
         try:
             assert plane.resident_ids() == sorted(first)
@@ -244,6 +248,12 @@ def test_eviction_spills_exact_fold_point_and_readmits():
             await wait_caught_up(plane)
             assert plane.stats["evictions"] == 8
             assert plane.resident_ids() == sorted(second)
+            # the seed and the eviction are incident-timeline material
+            types = [e["type"] for e in flight.events()]
+            assert "resident.seed" in types and "resident.evict" in types
+            evict = next(e for e in flight.events()
+                         if e["type"] == "resident.evict")
+            assert evict["count"] == 8 and evict["spilled"] == 8
             # evicted rows re-admit at their exact fold point on their next
             # event: 5 seeded + 2 incremental = scalar fold of all 7
             evs = []
@@ -271,12 +281,18 @@ def test_rebalance_revoke_purges_regrant_refolds():
         for agg in aggs:
             evs.extend(exp.events(agg, 4))
         append_events(log, evs)
-        plane = make_plane(log)
+        from surge_tpu.observability import FlightRecorder
+
+        flight = FlightRecorder(name="engine:t", role="engine")
+        plane = make_plane(log, flight=flight)
         await plane.start()
         try:
             victim = [a for a in aggs if part_of(a) == 1]
             assert victim
             plane.set_partitions([0, 2, 3])
+            reanchor = [e for e in flight.events()
+                        if e["type"] == "resident.re-anchor"]
+            assert reanchor and reanchor[-1]["revoked"] == [1]
             # a revoked partition's aggregates must never be servable
             for agg in victim:
                 hit, _ = await plane.read_state(agg)

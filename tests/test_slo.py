@@ -1,0 +1,234 @@
+"""SLO burn-rate engine: window math per objective kind, the multiwindow
+page condition, breach -> health-bus/flight/instrument side effects and
+recovery, and the acceptance path — a seeded chaos kill driving a fleet-up
+breach that flips the `slo` health component, lands on the merged incident
+timeline, and shows in `surgetop --once --format=json`."""
+
+import json
+
+from conftest import free_ports
+from surge_tpu.config import Config
+from surge_tpu.health import HealthSignalBus
+from surge_tpu.log import InMemoryLog, LogServer
+from surge_tpu.metrics.exposition import Family, Sample, render_openmetrics
+from surge_tpu.metrics.fleet import fleet_metrics
+from surge_tpu.observability import (
+    DEFAULT_SLOS,
+    FederatedScraper,
+    FlightRecorder,
+    SLO,
+    SLOEngine,
+    merge_dumps,
+    reconstruct_failover,
+)
+
+FAST_CFG = Config(overrides={
+    "surge.slo.fast-window-ms": 10_000,
+    "surge.slo.slow-window-ms": 40_000,
+    "surge.slo.burn-threshold": 2.0,
+})
+
+
+def _gauge(name, *samples):
+    fam = Family(name=name, mtype="gauge", help="")
+    for labels, value in samples:
+        fam.samples.append(Sample("", labels, value))
+    return {name: fam}
+
+
+def _counter(name, value):
+    fam = Family(name=name, mtype="counter", help="")
+    fam.samples.append(Sample("_total", (("instance", "i"),), value))
+    return {name: fam}
+
+
+# -- per-kind extraction --------------------------------------------------------------
+
+
+def test_latency_kind_reads_buckets_per_instance():
+    slo = SLO("lat", family="t_ms", kind="latency", objective=0.9,
+              threshold=10.0)
+    fam = Family(name="t_ms", mtype="histogram", help="")
+    for inst, counts in (("a", (8.0, 10.0)), ("b", (1.0, 5.0))):
+        labels = (("instance", inst),)
+        fam.samples.append(Sample("_bucket", labels + (("le", "10"),),
+                                  counts[0]))
+        fam.samples.append(Sample("_bucket", labels + (("le", "+Inf"),),
+                                  counts[1]))
+        fam.samples.append(Sample("_count", labels, counts[1]))
+    bad, total = SLOEngine._counts(slo, {"t_ms": fam})
+    # a: 8/10 good -> 2 bad; b: 1/5 good -> 4 bad
+    assert (bad, total) == (6.0, 15.0)
+
+
+def test_availability_kind_differences_counters():
+    slo = SLO("avail", family="bad", good_family="good",
+              kind="availability", objective=0.99)
+    fams = {**_counter("bad", 3.0), **_counter("good", 100.0)}
+    # attempts = bad + good: a pure-failure window burns at full rate
+    assert SLOEngine._counts(slo, fams) == (3.0, 103.0)
+    # missing good counter: every attempt observed was bad
+    assert SLOEngine._counts(slo, _counter("bad", 3.0)) == (3.0, 3.0)
+
+
+def test_bound_kind_direction():
+    gt = SLO("lag", family="g", kind="bound", objective=0.9, threshold=5.0,
+             op="gt")
+    lt = SLO("up", family="g", kind="bound", objective=0.9, threshold=1.0,
+             op="lt")
+    fams = _gauge("g", ((("instance", "a"),), 7.0), ((("instance", "b"),), 3.0))
+    assert SLOEngine._counts(gt, fams) == (1.0, 2.0)  # 7 > 5 is bad
+    fams = _gauge("g", ((("instance", "a"),), 0.0), ((("instance", "b"),), 1.0))
+    assert SLOEngine._counts(lt, fams) == (1.0, 2.0)  # 0 < 1 is bad
+
+
+# -- multiwindow condition ------------------------------------------------------------
+
+
+def test_breach_requires_both_windows_and_recovers():
+    """A fast-window spike alone never pages; sustained burn does; recovery
+    emits the trace signal and clears the component."""
+    sigs = []
+    flight = FlightRecorder(role="engine")
+    metrics = fleet_metrics()
+    eng = SLOEngine(
+        [SLO("avail", family="bad", good_family="good",
+             kind="availability", objective=0.9)],
+        config=FAST_CFG, metrics=metrics,
+        on_signal=lambda n, l: sigs.append((n, l)), flight=flight)
+
+    def fams(bad, good):
+        return {**_counter("bad", bad), **_counter("good", good)}
+
+    # t=0..30: clean traffic fills the slow window with good events
+    for t in range(0, 31, 5):
+        eng.evaluate(fams(0.0, t * 20.0), now=float(t))
+    assert eng.breached() == []
+    # t=35: a 100-event ALL-BAD burst — the fast window burns (100 bad of
+    # its ~300-event delta = burn 3.3), the slow window is diluted by the
+    # 600 good events before it (burn ~1.4): a spike alone never pages
+    eng.evaluate(fams(100.0, 600.0), now=35.0)
+    row = eng.status()[0]
+    assert row["burn_fast"] >= 2.0 > row["burn_slow"], row
+    assert not row["breached"]
+    assert eng.health_component().status == "up"
+    # sustained all-bad traffic (good counter frozen): the slow window
+    # crosses too -> ONE breach
+    for t in range(40, 75, 5):
+        eng.evaluate(fams(100.0 + (t - 35) * 16, 600.0), now=float(t))
+    assert eng.breached() == ["avail"]
+    assert sigs.count(("slo.breach.avail", "warning")) == 1
+    assert [e["type"] for e in flight.events()] == ["slo.breach"]
+    assert eng.health_component().status == "degraded"
+    assert metrics.registry.get_metrics()["surge.slo.breaches"] == 1.0
+    # recovery: clean traffic ages the burn out of both windows
+    bad = 100.0 + (70 - 35) * 16
+    for t in range(75, 140, 5):
+        eng.evaluate(fams(bad, 600.0 + (t - 70) * 200.0), now=float(t))
+    assert eng.breached() == []
+    assert ("slo.recovered.avail", "trace") in sigs
+    assert [e["type"] for e in flight.events()] == ["slo.breach",
+                                                    "slo.recovered"]
+    assert eng.health_component().status == "up"
+
+
+def test_counter_reset_clamps_instead_of_negative_burn():
+    eng = SLOEngine([SLO("a", family="bad", good_family="good",
+                         kind="availability", objective=0.9)],
+                    config=FAST_CFG)
+    eng.evaluate({**_counter("bad", 50.0), **_counter("good", 100.0)}, now=0.0)
+    # the process restarted: cumulative counters went backwards
+    rows = eng.evaluate({**_counter("bad", 0.0), **_counter("good", 5.0)},
+                        now=5.0)
+    assert rows[0]["burn_fast"] >= 0.0  # clamped, not negative/NaN
+
+
+def test_missing_family_is_no_data_not_a_breach():
+    eng = SLOEngine([SLO("lag", family="absent", kind="bound",
+                         objective=0.9, threshold=1.0)], config=FAST_CFG)
+    for t in range(0, 60, 5):
+        eng.evaluate({}, now=float(t))
+    assert eng.breached() == []
+
+
+# -- acceptance: chaos kill -> breach -> health/timeline/surgetop ---------------------
+
+
+def test_chaos_kill_drives_breach_onto_health_bus_timeline_and_surgetop():
+    """The ISSUE 9 acceptance path at in-process scale: a broker dies mid
+    federation, the fleet-up objective burns over threshold in both (tiny)
+    windows, and the breach (a) flips the health-bus `slo` component via its
+    signal, (b) lands as a flight event that merges into the incident
+    timeline next to the broker's own events, (c) shows in the surgetop
+    JSON snapshot."""
+    import sys
+    sys.path.insert(0, f"{__file__.rsplit('/tests/', 1)[0]}/tools")
+    import surgetop
+
+    import time as _time
+
+    port, = free_ports(1)
+    broker = LogServer(InMemoryLog(), port=port)
+    broker.start()
+    bus = HealthSignalBus()
+    engine_flight = FlightRecorder(name="engine:acc", role="engine")
+    now = {"t": _time.time()}
+    slo = SLOEngine(
+        [SLO("fleet-up", family="up", kind="bound", objective=0.9,
+             threshold=1.0, op="lt")],
+        config=FAST_CFG, on_signal=bus.signal_fn("slo"),
+        flight=engine_flight, clock=lambda: now["t"])
+    scraper = FederatedScraper([f"broker@127.0.0.1:{port}"], slo=slo,
+                               clock=lambda: now["t"])
+    try:
+        assert scraper.scrape_once()["up"] == 1
+        # seeded chaos kill: the fault plane's op=kill through the client
+        from surge_tpu.log import GrpcLogTransport
+
+        killer = GrpcLogTransport(f"127.0.0.1:{port}")
+        killer.kill_broker()
+        killer.close()
+        for _ in range(12):
+            now["t"] += 5.0  # advance both burn windows
+            scraper.scrape_once()
+            if slo.breached():
+                break
+        assert slo.breached() == ["fleet-up"]
+        broker_dump = broker.flight.dump()  # in-process: survives the kill
+        # (a) the health-bus slo component flipped (degraded, not down)
+        assert slo.health_component().status == "degraded"
+        assert any(s.name == "slo.breach.fleet-up" for s in bus.recent())
+        # (b) the breach is on the merged engine+broker incident timeline
+        merged = merge_dumps([broker_dump, engine_flight.dump()])
+        breach = [e for e in merged if e["type"] == "slo.breach"]
+        assert breach and breach[0]["lane"] == "engine"
+        assert breach[0]["objective"] == "fleet-up"
+        recon = reconstruct_failover(merged)  # engine-lane + broker events:
+        assert recon["complete"] is False      # tolerated, not raised
+        # (c) surgetop's snapshot over the same scraper shows the breach
+        snap = surgetop.snapshot(scraper)
+        assert snap["breached"] == ["fleet-up"]
+        assert snap["instances"][0]["up"] is False
+        json.dumps(snap)  # machine-readable end to end
+    finally:
+        scraper.stop()
+        try:
+            broker.stop()
+        except Exception:  # noqa: BLE001 — already killed
+            pass
+
+
+def test_default_slos_evaluate_over_the_fleet_golden():
+    """The shipped objectives run over the canned federated payload without
+    error and stay quiet on its healthy numbers."""
+    from tests.test_federation import golden_fleet_scrape
+
+    scraper = golden_fleet_scrape()
+    eng = SLOEngine(DEFAULT_SLOS, metrics=scraper.metrics,
+                    clock=lambda: 1_700_000_000.0)
+    rows = eng.evaluate(scraper.merged_families())
+    assert {r["objective"] for r in rows} == {s.name for s in DEFAULT_SLOS}
+    assert eng.breached() == []
+    # the slo gauges joined the scraper's registry -> next render carries them
+    text = render_openmetrics(scraper.metrics.registry)
+    assert "surge_slo_objectives 5" in text

@@ -1,0 +1,140 @@
+"""surgetop live console + chaos.py fleet subcommand: row extraction from
+merged families, table rendering, and the tier-1 CLI smokes (`surgetop --once
+--format=json` and `chaos.py fleet` against live brokers)."""
+
+import json
+import os
+import sys
+
+from conftest import free_ports
+from surge_tpu.log import InMemoryLog, LogServer
+from surge_tpu.metrics import engine_metrics
+from surge_tpu.metrics.exposition import MetricsHTTPServer, render_openmetrics
+from surge_tpu.observability import FederatedScraper, ScrapeTarget
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import chaos  # noqa: E402
+import surgetop  # noqa: E402
+from tests.test_exposition import (  # noqa: E402
+    golden_broker_metrics,
+    golden_engine_metrics,
+    validate_openmetrics,
+)
+
+
+def _canned_scraper():
+    em, bm = golden_engine_metrics(), golden_broker_metrics()
+    s = FederatedScraper(
+        [ScrapeTarget("b1", "broker",
+                      fetch=lambda: render_openmetrics(bm.registry)),
+         ScrapeTarget("e1", "engine",
+                      fetch=lambda: render_openmetrics(em.registry))],
+        clock=lambda: 42.0)
+    s.scrape_once()
+    return s
+
+
+def test_fleet_rows_extract_per_instance_columns():
+    rows = surgetop.fleet_rows(_canned_scraper())
+    by_inst = {r["instance"]: r for r in rows}
+    b1, e1 = by_inst["b1"], by_inst["e1"]
+    assert b1["role"] == "broker" and b1["up"] and b1["staleness_s"] == 0.0
+    assert b1["epoch"] == 2.0          # golden broker recording
+    assert b1["hwm-lag"] == 0.0        # registered but never recorded
+    assert e1["entities"] == 7.0       # golden engine recording
+    assert e1["epoch"] is None         # engines carry no broker epoch
+    assert e1["hwm-lag"] is None       # nor any hwm gauge at all
+
+
+def test_render_table_handles_missing_columns_and_breaches():
+    scraper = _canned_scraper()
+    rows = surgetop.fleet_rows(scraper)
+    slo_status = [{"objective": "fleet-up", "target": 0.99,
+                   "burn_fast": 25.0, "burn_slow": 20.0, "breached": True,
+                   "kind": "bound", "description": ""}]
+    frame = surgetop.render_table(
+        rows, slo_status, {"targets": 2, "up": 2, "errors": {}})
+    assert "BREACHED: fleet-up" in frame.splitlines()[0]
+    assert "max SLO burn 25.00" in frame.splitlines()[0]
+    assert any("b1" in ln and "broker" in ln for ln in frame.splitlines())
+    assert "-" in frame  # absent columns render as dashes, not crashes
+    assert "BREACH" in frame
+
+
+def test_surgetop_once_json_smoke_against_live_brokers(capsys):
+    """The tier-1 CLI smoke: one JSON snapshot over real brokers."""
+    ports = free_ports(2)
+    brokers = []
+    try:
+        for port in ports:
+            srv = LogServer(InMemoryLog(), port=port)
+            srv.start()
+            brokers.append(srv)
+        em = engine_metrics()
+        em.live_entities.record(9)
+        http = MetricsHTTPServer(em.registry)
+        http_port = http.start()
+        try:
+            rc = surgetop.main([
+                ",".join(f"broker@127.0.0.1:{p}" for p in ports),
+                f"engine@http://127.0.0.1:{http_port}/metrics",
+                "--once", "--format=json"])
+            assert rc == 0
+            snap = json.loads(capsys.readouterr().out)
+            assert snap["summary"] == {"targets": 3, "up": 3, "errors": {}}
+            assert {r["role"] for r in snap["instances"]} == {"broker",
+                                                              "engine"}
+            engine_row = next(r for r in snap["instances"]
+                              if r["role"] == "engine")
+            assert engine_row["entities"] == 9.0
+            # the default SLO set evaluated (quiet on a healthy fleet)
+            assert {s["objective"] for s in snap["slo"]} >= {"fleet-up"}
+            assert snap["breached"] == []
+        finally:
+            http.stop()
+    finally:
+        for b in brokers:
+            b.stop()
+
+
+def test_surgetop_table_once_smoke(capsys):
+    port, = free_ports(1)
+    broker = LogServer(InMemoryLog(), port=port)
+    broker.start()
+    try:
+        rc = surgetop.main([f"broker@127.0.0.1:{port}", "--once", "--no-slo"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "surgetop — 1/1 up" in out
+        assert f"127.0.0.1:{port}" in out
+    finally:
+        broker.stop()
+
+
+def test_chaos_fleet_prints_merged_exposition(capsys):
+    """chaos.py fleet: the federated payload from the CLI, grammar-valid,
+    instance-labelled, with up gauges."""
+    ports = free_ports(2)
+    brokers = []
+    try:
+        for port in ports:
+            srv = LogServer(InMemoryLog(), port=port)
+            srv.start()
+            brokers.append(srv)
+        spec = ",".join(f"broker@127.0.0.1:{p}" for p in ports)
+        rc = chaos.main(["fleet", spec])
+        assert rc == 0
+        out = capsys.readouterr().out
+        validate_openmetrics(out)
+        for port in ports:
+            assert f'up{{instance="127.0.0.1:{port}",role="broker"}} 1' in out
+        assert "surge_fleet_up_targets 2" in out
+    finally:
+        for b in brokers:
+            b.stop()
+
+
+def test_chaos_fleet_needs_specs(capsys):
+    assert chaos.main(["fleet", " , "]) == 2

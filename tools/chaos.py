@@ -17,6 +17,8 @@ over the broker's admin RPCs::
     python tools/chaos.py cluster <t1,t2,t3> --arm flaky-network --seed 7
     python tools/chaos.py cluster <t1,t2,t3> --kill 127.0.0.1:16001
     python tools/chaos.py handoff 127.0.0.1:16001 127.0.0.1:16002
+    python tools/chaos.py fleet broker@127.0.0.1:16001,engine@127.0.0.1:7001
+    python tools/chaos.py fleet <specs> --serve 9464
 
 ``cluster`` drives N brokers from ONE invocation: with no flags it prints a
 per-broker summary (role, epoch, in-sync view, per-partition high-watermarks,
@@ -36,6 +38,13 @@ crash plans is the expected outcome, reported as such).
 tail (``--tail N``, default 20) and its current replication-lag gauges, so a
 chaos run is debuggable from one command without attaching a scraper.
 
+``fleet`` federates EVERY target's OpenMetrics payload (``role@addr`` specs:
+``broker@host:port`` over the log-service GetMetricsText RPC,
+``engine@host:port`` over the admin RPC, ``role@http://...`` plain HTTP)
+into one instance/role-labelled exposition on stdout — or keeps serving it
+from a scrape port with ``--serve PORT`` (0 = ephemeral; Ctrl-C stops). The
+live table view over the same pass is ``tools/surgetop.py``.
+
 Exit code 0 on success; 3 when --watch ends with the broker unreachable
 (crash plans: that IS the outcome); 2 on bad arguments.
 """
@@ -54,7 +63,7 @@ def main(argv=None) -> int:
     ap.add_argument("command",
                     choices=["arm", "disarm", "status", "broker", "promote",
                              "flight", "metrics", "plans", "cluster",
-                             "handoff"])
+                             "handoff", "fleet"])
     ap.add_argument("target", nargs="?",
                     help="broker host:port (cluster: comma-separated list; "
                          "handoff: the FROM broker)")
@@ -74,6 +83,10 @@ def main(argv=None) -> int:
                     help="--watch poll interval seconds")
     ap.add_argument("--tail", type=int, default=20,
                     help="flight-recorder events shown by status")
+    ap.add_argument("--serve", type=int, default=None, metavar="PORT",
+                    help="fleet: serve the merged exposition from this "
+                         "scrape port (0 = ephemeral) instead of printing "
+                         "one pass")
     args = ap.parse_args(argv)
 
     if args.command == "plans":
@@ -90,6 +103,8 @@ def main(argv=None) -> int:
 
     from surge_tpu.log import GrpcLogTransport
 
+    if args.command == "fleet":
+        return _fleet(args)
     if args.command == "cluster":
         return _cluster(args)
     if args.command == "handoff":
@@ -167,6 +182,33 @@ def main(argv=None) -> int:
                 return 0
     finally:
         client.close()
+
+
+def _fleet(args) -> int:
+    """Federated scrape from the CLI: one merged, instance/role-labelled
+    OpenMetrics exposition over every ``role@addr`` target — printed once,
+    or served continuously from the scraper's own scrape port."""
+    from surge_tpu.observability import FederatedScraper
+
+    specs = [t.strip() for t in args.target.split(",") if t.strip()]
+    if not specs:
+        print("fleet needs role@addr specs", file=sys.stderr)
+        return 2
+    scraper = FederatedScraper(specs)
+    try:
+        if args.serve is None:
+            print(scraper.scrape_and_render(), end="")
+            return 0
+        port = scraper.serve(port=args.serve)
+        print(f"serving federated scrape on http://127.0.0.1:{port}/metrics "
+              f"({len(specs)} targets); Ctrl-C stops", file=sys.stderr)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            return 0
+    finally:
+        scraper.stop()
 
 
 def _cluster(args) -> int:

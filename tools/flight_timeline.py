@@ -1,21 +1,32 @@
 #!/usr/bin/env python
-"""Merge broker flight-recorder dumps into one ordered failover timeline.
+"""Merge flight-recorder dumps into one ordered incident timeline.
 
 Dumps come from ``tools/chaos.py flight <broker>`` (live), from the broker's
-crash auto-dump files (``surge.log.flight.dump-dir``), or from
-``SURGE_BENCH_FAILOVER=1``'s payload. Each dump is the JSON envelope
+crash auto-dump files (``surge.log.flight.dump-dir``), from the ENGINE admin
+RPC (``AdminClient.flight_dump()``), or from ``SURGE_BENCH_FAILOVER=1``'s
+payload. Each dump is the JSON envelope
 :meth:`surge_tpu.observability.FlightRecorder.dump` writes::
 
     python tools/flight_timeline.py leader.json follower.json
     python tools/chaos.py flight 127.0.0.1:16001 > l.json
     python tools/chaos.py flight 127.0.0.1:16002 > f.json
     python tools/flight_timeline.py l.json f.json --json
+    python tools/flight_timeline.py l.json f.json --engine engine.json
+
+``--engine FILE`` (repeatable) adds an ENGINE-lane dump: its events —
+publisher lane transitions, rebalance fan-out, resident-plane moves,
+health-bus restarts, SLO breaches — interleave with the broker events so one
+timeline shows the broker kill AND the engine-side fence/rejoin it caused.
+(Engine dumps pulled over the admin RPC already carry ``role: engine`` and
+need no flag; the flag force-tags hand-saved files.)
 
 Output: the merged, time-ordered event stream (monotonic ordering when every
 dump came from one host — CLOCK_MONOTONIC is host-shared and NTP-step-proof —
-wall-clock ordering otherwise) followed by the reconstructed failover phases:
-promotion decision → promotion → fence → truncation → first acked
-post-failover commit (docs/operations.md "reading a failover timeline").
+wall-clock ordering otherwise), each line tagged with its lane, followed by
+the reconstructed failover phases: promotion decision → promotion → fence →
+truncation → first acked post-failover commit (docs/operations.md "reading a
+failover timeline"). An engine-lane-only input yields the merged stream with
+all phases missing — reported, not raised.
 
 Exit code 0 when the reconstruction is complete, 1 when phases are missing
 (still prints what it found), 2 on bad input.
@@ -31,15 +42,26 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 def _fmt_event(ev: dict, t0: float, key: str) -> str:
     extras = {k: v for k, v in ev.items()
-              if k not in ("seq", "mono", "wall", "type", "recorder")}
+              if k not in ("seq", "mono", "wall", "type", "recorder", "lane")}
     extra = (" " + json.dumps(extras, sort_keys=True)) if extras else ""
+    lane = ev.get("lane", "broker")
     return (f"+{(ev.get(key, 0.0) - t0) * 1000.0:10.1f}ms "
-            f"{ev.get('recorder', '?'):>21s}  {ev['type']}{extra}")
+            f"[{lane:>6s}] {ev.get('recorder', '?'):>21s}  "
+            f"{ev['type']}{extra}")
+
+
+def _load(path: str):
+    with open(path) as f:
+        return json.load(f)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("dumps", nargs="+", help="flight dump JSON files")
+    ap.add_argument("--engine", action="append", default=[],
+                    metavar="FILE",
+                    help="engine-lane dump file (repeatable); events are "
+                         "tagged [engine] on the merged timeline")
     ap.add_argument("--json", action="store_true",
                     help="emit the merged timeline + phases as one JSON "
                          "object instead of the human view")
@@ -52,13 +74,16 @@ def main(argv=None) -> int:
     )
 
     dumps = []
-    for path in args.dumps:
-        try:
-            with open(path) as f:
-                dumps.append(json.load(f))
-        except (OSError, ValueError) as exc:
-            print(f"cannot read dump {path}: {exc}", file=sys.stderr)
-            return 2
+    try:
+        for path in args.dumps:
+            dumps.append(_load(path))
+        for path in args.engine:
+            dump = _load(path)
+            dump["role"] = "engine"  # force-tag hand-saved files
+            dumps.append(dump)
+    except (OSError, ValueError) as exc:
+        print(f"cannot read dump {path}: {exc}", file=sys.stderr)
+        return 2
 
     merged = merge_dumps(dumps)
     recon = reconstruct_failover(merged)
@@ -73,8 +98,9 @@ def main(argv=None) -> int:
     # from different hosts are incomparable and would print garbage offsets
     key = "mono" if same_clock_domain(dumps) else "wall"
     t0 = merged[0].get(key, 0.0)
+    lanes = sorted({e.get("lane", "broker") for e in merged})
     print(f"merged timeline ({len(merged)} events from "
-          f"{len(args.dumps)} dumps"
+          f"{len(dumps)} dumps; lanes: {', '.join(lanes)}"
           + ("" if key == "mono"
              else "; cross-host: wall-clock ordering") + "):")
     for ev in merged:

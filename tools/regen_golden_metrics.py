@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """Regenerate the OpenMetrics goldens from the canonical recording sequences:
-tests/golden/metrics.om (engine registry) and tests/golden/metrics_broker.om
-(broker registry).
+tests/golden/metrics.om (engine registry), tests/golden/metrics_broker.om
+(broker registry), and tests/golden/metrics_fleet.om (the MERGED federated
+payload over canned engine+broker targets — instance/role labels, up and
+staleness gauges, fleet self-instruments).
 
-Run after an intentional change to the exposition format or either
-predeclared instrument set, then update the docs/observability.md catalogs to
-match — golden and catalog are COUPLED (tests/test_exposition.py enforces
-both); regen both together."""
+Run after an intentional change to the exposition format, any predeclared
+instrument set, or the federation merge, then update the docs/observability.md
+catalogs to match — golden and catalog are COUPLED (tests/test_exposition.py
+and surgelint's metric-catalog rule enforce both); regen all together."""
 
 import os
 import sys
@@ -21,11 +23,14 @@ from test_exposition import (  # noqa: E402
     golden_broker_metrics,
     golden_engine_metrics,
 )
+from test_federation import FLEET_GOLDEN_PATH, golden_fleet_scrape  # noqa: E402
 
-for path, quiver in ((GOLDEN_PATH, golden_engine_metrics()),
-                     (BROKER_GOLDEN_PATH, golden_broker_metrics())):
+for path, text in (
+        (GOLDEN_PATH, render_openmetrics(golden_engine_metrics().registry)),
+        (BROKER_GOLDEN_PATH,
+         render_openmetrics(golden_broker_metrics().registry)),
+        (FLEET_GOLDEN_PATH, golden_fleet_scrape().render())):
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    text = render_openmetrics(quiver.registry)
     with open(path, "w") as f:
         f.write(text)
     print(f"wrote {path} ({len(text.splitlines())} lines)")

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""surgetop — the fleet's live console, driven off ONE federated scrape.
+
+A `top`-style, curses-free view of every engine and broker in the fleet:
+per-instance role, liveness, scrape staleness, leader epoch, high-watermark
+lag, WAL fsync round time, resident-slab occupancy, live entities and command
+rate — plus the SLO burn-rate table (fast/slow window burn per objective,
+breaches highlighted). One `FederatedScraper` pass per refresh; nothing here
+talks to more than the scrape surfaces::
+
+    python tools/surgetop.py broker@127.0.0.1:16001,broker@127.0.0.1:16002 \
+        engine@127.0.0.1:7001
+    python tools/surgetop.py broker@127.0.0.1:16001 --interval 5
+    python tools/surgetop.py broker@127.0.0.1:16001 --once --format=json
+
+Targets are ``role@address`` specs (comma- or space-separated):
+``broker@host:port`` scrapes the log-service `GetMetricsText` RPC,
+``engine@host:port`` the admin-service one, ``role@http://...`` any plain
+exposition endpoint. ``--once --format=json`` emits one machine-readable
+snapshot (scripting + the tier-1 smoke); without ``--once`` the console
+redraws every ``--interval`` seconds until interrupted.
+
+SLO evaluation uses the shipped ``DEFAULT_SLOS`` (docs/observability.md);
+window/threshold knobs come from ``surge.slo.*`` config (env-overridable:
+``SURGE_SLO_FAST_WINDOW_MS`` etc.). ``--no-slo`` turns the table off.
+
+Exit code 0 on success (even with targets down — that is a finding, not a
+failure), 2 on bad arguments.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+#: (header, merged-family, format) — the per-instance columns; families
+#: absent for a role (no slab on a broker) render as "-"
+_COLUMNS = (
+    ("epoch", "surge_log_replication_epoch", "{:.0f}"),
+    ("leader", "surge_log_broker_is_leader", "{:.0f}"),
+    ("hwm-lag", "surge_log_hwm_lag_records", "{:.0f}"),
+    ("fsync-ms", "surge_log_journal_fsync_round_timer", "{:.2f}"),
+    ("slab", "surge_replay_resident_slab_occupancy", "{:.0f}"),
+    ("entities", "surge_engine_live_entities", "{:.0f}"),
+    ("cmd/s", "surge_engine_command_rate_one_minute_rate", "{:.1f}"),
+)
+
+
+def _sample_value(families, name, instance, suffix=""):
+    fam = families.get(name)
+    if fam is None:
+        return None
+    for s in fam.samples:
+        if s.suffix == suffix and dict(s.labels).get("instance") == instance:
+            return s.value
+    return None
+
+
+def fleet_rows(scraper, families=None):
+    """One dict per target from the merged families: the console table's
+    data, importable for tests and scripting."""
+    if families is None:
+        families = {f.name: f for f in scraper.last_merged()}
+    rows = []
+    for t in scraper.targets:
+        row = {"instance": t.instance, "role": t.role,
+               "up": bool(_sample_value(families, "up", t.instance)),
+               "staleness_s": _sample_value(
+                   families, "surge_fleet_scrape_staleness_seconds",
+                   t.instance)}
+        for header, family, _fmt in _COLUMNS:
+            row[header] = _sample_value(families, family, t.instance)
+        rows.append(row)
+    return rows
+
+
+def _fmt(value, fmt="{}"):
+    if value is None:
+        return "-"
+    try:
+        return fmt.format(value)
+    except (ValueError, TypeError):
+        return str(value)
+
+
+def render_table(rows, slo_status, summary) -> str:
+    """The console frame as one string (testable without a TTY)."""
+    headers = (["instance", "role", "up", "stale-s"]
+               + [h for h, _f, _m in _COLUMNS])
+    table = []
+    for row in rows:
+        table.append([
+            row["instance"], row["role"], "1" if row["up"] else "0",
+            _fmt(row["staleness_s"], "{:.1f}"),
+        ] + [_fmt(row[h], m) for h, _f, m in _COLUMNS])
+    widths = [max(len(h), *(len(r[i]) for r in table)) if table else len(h)
+              for i, h in enumerate(headers)]
+    max_burn = max((s["burn_fast"] for s in slo_status), default=0.0)
+    breached = [s["objective"] for s in slo_status if s["breached"]]
+    lines = [f"surgetop — {summary['up']}/{summary['targets']} up"
+             + (f", max SLO burn {max_burn:.2f}" if slo_status else "")
+             + (f", BREACHED: {','.join(breached)}" if breached else "")
+             + (f", scrape errors: {sorted(summary['errors'])}"
+                if summary["errors"] else "")]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for r in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    if slo_status:
+        lines.append("")
+        lines.append("objective            target   burn-fast  burn-slow  "
+                     "state")
+        for s in slo_status:
+            lines.append(f"{s['objective']:<20s} {s['target']:<8g} "
+                         f"{s['burn_fast']:<10.2f} {s['burn_slow']:<10.2f} "
+                         f"{'BREACH' if s['breached'] else 'ok'}")
+    return "\n".join(lines)
+
+
+def snapshot(scraper) -> dict:
+    """One federation pass → the machine-readable console state."""
+    summary = scraper.scrape_once()
+    rows = fleet_rows(scraper)
+    slo_status = scraper.slo.status() if scraper.slo is not None else []
+    return {"summary": summary, "instances": rows, "slo": slo_status,
+            "breached": (scraper.slo.breached()
+                         if scraper.slo is not None else [])}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("targets", nargs="+",
+                    help="role@address specs (comma- or space-separated)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds (live mode)")
+    ap.add_argument("--once", action="store_true",
+                    help="one snapshot, no redraw loop")
+    ap.add_argument("--format", choices=["table", "json"], default="table")
+    ap.add_argument("--no-slo", action="store_true",
+                    help="skip SLO evaluation")
+    args = ap.parse_args(argv)
+
+    from surge_tpu.observability import (DEFAULT_SLOS, FederatedScraper,
+                                         SLOEngine)
+
+    specs = [s for arg in args.targets for s in arg.split(",") if s.strip()]
+    if not specs:
+        print("no targets", file=sys.stderr)
+        return 2
+    scraper = FederatedScraper(specs)
+    if not args.no_slo:
+        scraper.slo = SLOEngine(DEFAULT_SLOS, metrics=scraper.metrics,
+                                flight=None)
+    try:
+        while True:
+            snap = snapshot(scraper)
+            if args.format == "json":
+                print(json.dumps(snap, indent=None if args.once else 2))
+            else:
+                frame = render_table(snap["instances"], snap["slo"],
+                                     snap["summary"])
+                if not args.once:
+                    # ANSI clear + home: the curses-free redraw
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(frame)
+                sys.stdout.flush()
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        scraper.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
