@@ -462,7 +462,7 @@ def steady_state_latency(seconds: float, overrides: dict | None = None,
     broker = (overrides or {}).get("bench.broker", "inproc")
 
     async def scenario() -> dict:
-        flog = FileLog(os.path.join(root, "log"))
+        flog = FileLog(os.path.join(root, "log"), config=cfg)
         journal = flog._journal_path
         log_server = None
         transport = None
@@ -556,6 +556,76 @@ def steady_state_latency(seconds: float, overrides: dict | None = None,
         return asyncio.run(scenario())
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+def native_paired_ladder(seconds: float, rounds: int = 3,
+                         rungs=(64, 1024), broker: str = "inproc") -> dict:
+    """PAIRED interleaved native-on vs native-off command-path ladder (the
+    BENCH_NOTES round-6 protocol: single runs swing 2-3x on this host's 9p
+    fsync + 2-vCPU GIL, so only same-host interleaved medians count). Each
+    round runs BOTH arms back to back against fresh FileLogs; medians over
+    >= 3 rounds per rung decide. The native arm is csrc/txn.cc end to end
+    (batch decode + WAL format + staged journal + lazy segments + native
+    read decode); the off arm pins surge.log.native.enabled=false AND the
+    ambient read-decode switch, i.e. the bit-identical pure-Python path."""
+    import statistics as _st
+
+    from surge_tpu.log import native_gate
+
+    arms = {"native_on": True, "native_off": False}
+    if not native_gate.available():
+        log("native library unbuilt: the on-arm would silently measure the "
+            "Python path — run csrc/build.sh first")
+    raw: dict = {a: {w: [] for w in rungs} for a in arms}
+    for rnd in range(rounds):
+        # alternate arm order per round: this host's episodic collapses
+        # (CPU steal; BENCH_NOTES round-6) would otherwise bias whichever
+        # arm systematically runs adjacent to them
+        order = list(arms.items()) if rnd % 2 == 0 else \
+            list(arms.items())[::-1]
+        for arm, enabled in order:  # interleaved within each round
+            native_gate.set_decode_enabled(enabled)
+            try:
+                stats = steady_state_latency(
+                    seconds,
+                    overrides={"surge.log.native.enabled": enabled,
+                               "bench.broker": broker},
+                    ladder=list(rungs))
+            finally:
+                native_gate.set_decode_enabled(None)
+            for rung in stats["throughput_ladder"]:
+                raw[arm][rung["workers"]].append(rung)
+            log(f"round {rnd + 1}/{rounds} {arm}: " + ", ".join(
+                f"{r['workers']}w {r['commands_per_sec']} cmd/s "
+                f"p50 {r['p50_ms']}ms"
+                for r in stats["throughput_ladder"]))
+    med = lambda xs: round(_st.median(xs), 2)  # noqa: E731
+    out = {"protocol": {"rounds": rounds, "seconds_per_rung": seconds,
+                        "rungs": list(rungs), "broker": broker,
+                        "native_available": native_gate.available(),
+                        "interleaved": True, "medians": True},
+           "rungs": []}
+    for w in rungs:
+        row = {"workers": w}
+        for arm in arms:
+            samples = raw[arm][w]
+            row[arm] = {
+                "commands_per_sec_median": med(
+                    [s["commands_per_sec"] for s in samples]),
+                "p50_ms_median": med([s["p50_ms"] for s in samples]),
+                "p99_ms_median": med([s["p99_ms"] for s in samples]),
+                "commands_per_txn_median": med(
+                    [s["commands_per_txn"] for s in samples]),
+                "rounds": [s["commands_per_sec"] for s in samples],
+            }
+        off = row["native_off"]["commands_per_sec_median"]
+        row["speedup_median"] = round(
+            row["native_on"]["commands_per_sec_median"] / max(off, 1), 3)
+        out["rungs"].append(row)
+        log(f"{w}w medians: native_on "
+            f"{row['native_on']['commands_per_sec_median']} cmd/s vs "
+            f"native_off {off} cmd/s -> {row['speedup_median']}x")
+    return out
 
 
 def failover_bench() -> dict:
@@ -1452,6 +1522,22 @@ def main() -> None:
         payload = {"metric": "commands_per_sec", "value": 0,
                    "unit": "commands/s"}
         secs = latency_seconds if latency_seconds > 0 else 5.0
+        # SURGE_BENCH_NATIVE=1 (the r07 protocol): paired interleaved
+        # native-on vs native-off medians at the 64 + 1024 rungs
+        if os.environ.get("SURGE_BENCH_NATIVE", "0") == "1":
+            rounds = int(os.environ.get("SURGE_BENCH_NATIVE_ROUNDS", 3))
+            rungs = [int(t) for t in os.environ.get(
+                "SURGE_BENCH_LATENCY_LADDER", "").split(",")
+                if t.strip().isdigit()] or [64, 1024]
+            paired = native_paired_ladder(
+                secs, rounds=rounds, rungs=rungs,
+                broker=os.environ.get("SURGE_BENCH_NATIVE_BROKER", "inproc"))
+            payload["native_paired_ladder"] = paired
+            payload["value"] = max(
+                r["native_on"]["commands_per_sec_median"]
+                for r in paired["rungs"])
+            emit(payload)
+            return
         stats = steady_state_latency(secs)
         payload.update(stats)
         payload["value"] = stats["peak_commands_per_sec"]

@@ -30,6 +30,26 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: minutes-long soak/bench tests excluded from the "
                    "tier-1 `-m 'not slow'` run")
+    # build the csrc/ native libraries once per session when a compiler is
+    # present (incremental — ~free when up to date), so tier-1 exercises the
+    # native hot path instead of always taking the Python fallback. Without
+    # a compiler the libraries stay absent and native-only tests skip with
+    # a reason (see tests/test_native_gate.py / test_abi_drift.py).
+    import shutil
+    import subprocess
+
+    if (shutil.which("g++")
+            and os.environ.get("SURGE_SKIP_NATIVE_BUILD", "0") != "1"):
+        build = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "csrc", "build.sh")
+        try:
+            proc = subprocess.run(["sh", build], capture_output=True,
+                                  timeout=120)
+            if proc.returncode != 0:
+                print(f"csrc/build.sh failed (native tests will skip): "
+                      f"{proc.stderr.decode(errors='replace')[-500:]}")
+        except Exception as exc:  # noqa: BLE001 — the build is best-effort
+            print(f"csrc/build.sh unavailable: {exc!r}")
 
 
 def free_ports(n: int = 1) -> list:

@@ -1,15 +1,47 @@
 #!/bin/sh
-# Build the native libraries into csrc/build/ (picked up by surge_tpu.store.native and
-# surge_tpu.log.segment via ctypes). Requires only g++; no external dependencies.
+# Build the native libraries into csrc/build/ (picked up by surge_tpu.store.native,
+# surge_tpu.log.segment and surge_tpu.log.native_gate via ctypes). Requires only
+# g++; no external dependencies.
+#
+# Incremental: a library is rebuilt only when one of its sources is newer than
+# the built .so, so conftest can invoke this once per test session for ~free.
+# Link to a UNIQUE temp name (PID-suffixed: concurrent sessions both running
+# this script must not interleave writes into one tmp) then atomically rename,
+# so a process that has the current .so dlopen'd never sees a truncated file.
 set -e
 cd "$(dirname "$0")"
 mkdir -p build
-# Link to a temp name then atomically rename, so a process that has the current .so
-# dlopen'd never sees a truncated file.
-g++ -O2 -std=c++17 -shared -fPIC -Wall -o build/.libsurge_store.so.tmp store.cc
-mv build/.libsurge_store.so.tmp build/libsurge_store.so
-if [ -f segment.cc ]; then
-  g++ -O2 -std=c++17 -shared -fPIC -Wall -o build/.libsurge_segment.so.tmp segment.cc
-  mv build/.libsurge_segment.so.tmp build/libsurge_segment.so
+
+stale() {  # stale <target> <src>... -> 0 (build needed) | 1 (up to date)
+  target="$1"
+  shift
+  [ -f "$target" ] || return 0
+  for src in "$@"; do
+    [ "$src" -nt "$target" ] && return 0
+  done
+  return 1
+}
+
+built=""
+if stale build/libsurge_store.so store.cc; then
+  g++ -O2 -std=c++17 -shared -fPIC -Wall -o "build/.libsurge_store.so.tmp.$$" store.cc
+  mv "build/.libsurge_store.so.tmp.$$" build/libsurge_store.so
+  built="$built libsurge_store.so"
 fi
-echo "built: $(ls build)"
+if [ -f segment.cc ] && stale build/libsurge_segment.so segment.cc; then
+  g++ -O2 -std=c++17 -shared -fPIC -Wall -o "build/.libsurge_segment.so.tmp.$$" segment.cc
+  mv "build/.libsurge_segment.so.tmp.$$" build/libsurge_segment.so
+  built="$built libsurge_segment.so"
+fi
+# txn.cc links segment.cc in, so its block bytes are identical-by-construction
+# with the standalone segment codec
+if [ -f txn.cc ] && stale build/libsurge_txn.so txn.cc segment.cc; then
+  g++ -O2 -std=c++17 -shared -fPIC -Wall -o "build/.libsurge_txn.so.tmp.$$" txn.cc segment.cc
+  mv "build/.libsurge_txn.so.tmp.$$" build/libsurge_txn.so
+  built="$built libsurge_txn.so"
+fi
+if [ -n "$built" ]; then
+  echo "built:$built"
+else
+  echo "up to date: $(ls build)"
+fi

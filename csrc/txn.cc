@@ -1,0 +1,804 @@
+// Native broker hot path: Transact batch decode, in-order/dedup gate kernel,
+// and WAL journal-line formatting in one C++ call off the GIL.
+//
+// The reference keeps its broker hot path in compiled code (Kafka's log append
+// and RocksDB's native store, PAPER.md §2.9); this file is the first-party
+// equivalent for surge_tpu's broker: the per-record work of a commit — record
+// framing, SLZ block compression, CRC, base64 WAL embedding and the JSON
+// journal line — happens in ONE ctypes call instead of several Python passes
+// per record. Compiled together with segment.cc into libsurge_segment_txn
+// (csrc/build.sh), so block bytes are identical-by-construction with the
+// Python segment codec.
+//
+// Byte-identity contract (enforced by tests/test_native_gate.py): for the same
+// records, `surge_txn_format` must produce EXACTLY the bytes of
+// surge_tpu.log.file._append_locked's Python path — segment.encode_block per
+// contiguous run, then `json.dumps({"parts": [...], "blk": [...]}) + "\n"`
+// with CPython's default separators and ensure_ascii escaping. Every decision
+// of `surge_txn_decide` must equal native_gate._py_decide. Change either side
+// only in lockstep.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include <errno.h>
+#include <unistd.h>
+
+// from segment.cc (compiled into the same shared object)
+extern "C" {
+size_t surge_lz_bound(size_t n);
+size_t surge_lz_compress(const uint8_t* src, size_t n, uint8_t* dst,
+                         size_t dst_cap);
+uint32_t surge_crc32(const uint8_t* src, size_t n);
+}
+
+namespace {
+
+// -- protobuf wire primitives (TxnRequest/RecordMsg field numbers are pinned
+// by proto/log_service.proto; the regen tool never renumbers) ----------------
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+};
+
+uint64_t get_varint(Cursor& c) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (c.p < c.end && shift < 64) {
+    uint8_t b = *c.p++;
+    v |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) return v;
+    shift += 7;
+  }
+  c.ok = false;
+  return 0;
+}
+
+bool get_len(Cursor& c, const uint8_t** out, size_t* n) {
+  uint64_t len = get_varint(c);
+  if (!c.ok || c.p + len > c.end) {
+    c.ok = false;
+    return false;
+  }
+  *out = c.p;
+  *n = static_cast<size_t>(len);
+  c.p += len;
+  return true;
+}
+
+void skip_field(Cursor& c, uint32_t wire_type) {
+  switch (wire_type) {
+    case 0:
+      get_varint(c);
+      break;
+    case 1:
+      if (c.p + 8 > c.end) c.ok = false; else c.p += 8;
+      break;
+    case 2: {
+      const uint8_t* d;
+      size_t n;
+      get_len(c, &d, &n);
+      break;
+    }
+    case 5:
+      if (c.p + 4 > c.end) c.ok = false; else c.p += 4;
+      break;
+    default:
+      c.ok = false;
+  }
+}
+
+// -- batch model --------------------------------------------------------------
+
+struct Rec {
+  const uint8_t* key = nullptr;
+  size_t key_len = 0;
+  bool has_key = false;
+  const uint8_t* value = nullptr;
+  size_t value_len = 0;
+  bool has_value = false;
+  std::vector<std::pair<std::pair<const uint8_t*, size_t>,
+                        std::pair<const uint8_t*, size_t>>> headers;
+  int32_t group = -1;
+};
+
+struct GroupOut {
+  int64_t block_off = 0;
+  int64_t block_len = 0;
+  int64_t new_pos = 0;
+  int32_t embedded = 0;
+};
+
+struct Batch {
+  std::string buf;  // owned copy of the input bytes; Rec fields point into it
+  std::vector<Rec> recs;
+  std::vector<std::string> group_topics;
+  std::vector<int32_t> group_parts;
+  std::vector<std::vector<uint32_t>> group_members;  // arrival order per group
+  uint64_t token = 0;
+  uint64_t seq = 0;
+  int32_t op = -1;  // 0 commit | 1 abort | 2 send_immediate | -1 other
+  std::vector<int32_t> rec_groups;
+  // format outputs
+  std::string line;
+  std::string blocks;
+  std::vector<GroupOut> gout;
+  std::vector<int64_t> offsets;
+};
+
+int32_t group_of(Batch* b, const uint8_t* topic, size_t topic_len,
+                 int32_t partition,
+                 std::map<std::pair<std::string, int32_t>, int32_t>& idx) {
+  std::string t(reinterpret_cast<const char*>(topic), topic_len);
+  auto key = std::make_pair(std::move(t), partition);
+  auto it = idx.find(key);
+  if (it != idx.end()) return it->second;
+  int32_t g = static_cast<int32_t>(b->group_topics.size());
+  b->group_topics.push_back(key.first);
+  b->group_parts.push_back(partition);
+  b->group_members.emplace_back();
+  idx.emplace(std::move(key), g);
+  return g;
+}
+
+bool parse_record(const uint8_t* data, size_t n, Rec* rec,
+                  const uint8_t** topic, size_t* topic_len,
+                  int32_t* partition) {
+  Cursor c{data, data + n};
+  *topic = nullptr;
+  *topic_len = 0;
+  *partition = 0;
+  while (c.p < c.end && c.ok) {
+    uint64_t tag = get_varint(c);
+    if (!c.ok) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wt = static_cast<uint32_t>(tag & 7);
+    switch (field) {
+      case 1:  // topic
+        if (wt != 2 || !get_len(c, topic, topic_len)) return false;
+        break;
+      case 2:  // has_key
+        if (wt != 0) return false;
+        rec->has_key = get_varint(c) != 0;
+        break;
+      case 3:  // key
+        if (wt != 2 || !get_len(c, &rec->key, &rec->key_len)) return false;
+        break;
+      case 4:  // has_value
+        if (wt != 0) return false;
+        rec->has_value = get_varint(c) != 0;
+        break;
+      case 5:  // value
+        if (wt != 2 || !get_len(c, &rec->value, &rec->value_len)) return false;
+        break;
+      case 6:  // partition
+        if (wt != 0) return false;
+        *partition = static_cast<int32_t>(get_varint(c));
+        break;
+      case 7: {  // headers map entry
+        if (wt != 2) return false;
+        const uint8_t* ent;
+        size_t ent_n;
+        if (!get_len(c, &ent, &ent_n)) return false;
+        Cursor hc{ent, ent + ent_n};
+        const uint8_t* hk = nullptr;
+        size_t hk_n = 0;
+        const uint8_t* hv = nullptr;
+        size_t hv_n = 0;
+        while (hc.p < hc.end && hc.ok) {
+          uint64_t htag = get_varint(hc);
+          if (!hc.ok) return false;
+          uint32_t hf = static_cast<uint32_t>(htag >> 3);
+          uint32_t hwt = static_cast<uint32_t>(htag & 7);
+          if (hf == 1 && hwt == 2) {
+            if (!get_len(hc, &hk, &hk_n)) return false;
+          } else if (hf == 2 && hwt == 2) {
+            if (!get_len(hc, &hv, &hv_n)) return false;
+          } else {
+            skip_field(hc, hwt);
+            if (!hc.ok) return false;
+          }
+        }
+        // proto3 omits default (empty) map keys/values: absent = empty.
+        // Map semantics: a duplicate key's LAST entry wins (protobuf merges
+        // map entries that way) — keep one header per key, like the Python
+        // side's dict.
+        static const uint8_t kEmpty = 0;
+        const uint8_t* kp = hk ? hk : &kEmpty;
+        bool replaced = false;
+        for (auto& existing : rec->headers) {
+          if (existing.first.second == hk_n &&
+              std::memcmp(existing.first.first, kp, hk_n) == 0) {
+            existing.second = {hv ? hv : &kEmpty, hv_n};
+            replaced = true;
+            break;
+          }
+        }
+        if (!replaced) {
+          rec->headers.push_back({{kp, hk_n}, {hv ? hv : &kEmpty, hv_n}});
+        }
+        break;
+      }
+      case 8:  // offset (ignored: the assign path numbers records itself)
+      case 9:  // timestamp (ignored: the append stamps the batch)
+        skip_field(c, wt);
+        if (!c.ok) return false;
+        break;
+      default:
+        skip_field(c, wt);
+        if (!c.ok) return false;
+    }
+  }
+  return c.ok;
+}
+
+// -- record framing (the exact layout of segment.encode_records) -------------
+
+void put_uvarint(std::string& out, uint64_t n) {
+  while (n >= 0x80) {
+    out.push_back(static_cast<char>((n & 0x7F) | 0x80));
+    n >>= 7;
+  }
+  out.push_back(static_cast<char>(n));
+}
+
+void frame_record(std::string& out, const Rec& r, double timestamp) {
+  uint8_t flags = (r.has_key ? 1 : 0) | (r.has_value ? 0 : 2);
+  out.push_back(static_cast<char>(flags));
+  if (r.has_key) {
+    put_uvarint(out, r.key_len);
+    out.append(reinterpret_cast<const char*>(r.key), r.key_len);
+  }
+  if (r.has_value) {
+    put_uvarint(out, r.value_len);
+    out.append(reinterpret_cast<const char*>(r.value), r.value_len);
+  }
+  put_uvarint(out, r.headers.size());
+  // headers in sorted key order — the canonical framing (see
+  // segment.encode_records): protobuf map iteration/wire orders are
+  // backend-dependent, so byte-identity across the native/Python paths
+  // demands one canonical order. UTF-8 bytewise == codepoint order.
+  auto headers = r.headers;
+  std::sort(headers.begin(), headers.end(),
+            [](const auto& a, const auto& b) {
+              int c = std::memcmp(
+                  a.first.first, b.first.first,
+                  std::min(a.first.second, b.first.second));
+              if (c != 0) return c < 0;
+              return a.first.second < b.first.second;
+            });
+  for (const auto& h : headers) {
+    put_uvarint(out, h.first.second);
+    out.append(reinterpret_cast<const char*>(h.first.first), h.first.second);
+    put_uvarint(out, h.second.second);
+    out.append(reinterpret_cast<const char*>(h.second.first), h.second.second);
+  }
+  char ts[8];
+  std::memcpy(ts, &timestamp, 8);  // IEEE-754 little-endian, like struct "<d"
+  out.append(ts, 8);
+}
+
+// block header struct "<4sB3xQIIII" (segment.py _HEADER)
+void put_block_header(std::string& out, uint8_t codec, uint64_t base,
+                      uint32_t count, uint32_t unlen, uint32_t plen,
+                      uint32_t crc) {
+  out.append("SSEG", 4);
+  out.push_back(static_cast<char>(codec));
+  out.append(3, '\0');
+  char tmp[8];
+  std::memcpy(tmp, &base, 8);
+  out.append(tmp, 8);
+  std::memcpy(tmp, &count, 4);
+  out.append(tmp, 4);
+  std::memcpy(tmp, &unlen, 4);
+  out.append(tmp, 4);
+  std::memcpy(tmp, &plen, 4);
+  out.append(tmp, 4);
+  std::memcpy(tmp, &crc, 4);
+  out.append(tmp, 4);
+}
+
+// -- json helpers (CPython json.dumps default formatting) --------------------
+
+void json_escape_utf8(std::string& out, const std::string& s) {
+  static const char* hex = "0123456789abcdef";
+  out.push_back('"');
+  size_t i = 0;
+  const size_t n = s.size();
+  while (i < n) {
+    unsigned char b = static_cast<unsigned char>(s[i]);
+    if (b == '"' || b == '\\') {
+      out.push_back('\\');
+      out.push_back(static_cast<char>(b));
+      ++i;
+    } else if (b >= 0x20 && b < 0x7F) {
+      out.push_back(static_cast<char>(b));
+      ++i;
+    } else if (b < 0x20) {
+      switch (b) {
+        case '\b': out += "\\b"; break;
+        case '\t': out += "\\t"; break;
+        case '\n': out += "\\n"; break;
+        case '\f': out += "\\f"; break;
+        case '\r': out += "\\r"; break;
+        default:
+          out += "\\u00";
+          out.push_back(hex[b >> 4]);
+          out.push_back(hex[b & 0xF]);
+      }
+      ++i;
+    } else {
+      // 0x7F (DEL; CPython json escapes every byte outside 0x20..0x7E)
+      // or a non-ASCII UTF-8 sequence: emit the ensure_ascii escape
+      uint32_t cp = 0;
+      int extra = 0;
+      if (b < 0x80) { cp = b; }
+      else if ((b & 0xE0) == 0xC0) { cp = b & 0x1F; extra = 1; }
+      else if ((b & 0xF0) == 0xE0) { cp = b & 0x0F; extra = 2; }
+      else if ((b & 0xF8) == 0xF0) { cp = b & 0x07; extra = 3; }
+      else { cp = 0xFFFD; }
+      if (extra > 0 && i + extra < n) {
+        for (int k = 1; k <= extra; ++k)
+          cp = (cp << 6) | (static_cast<unsigned char>(s[i + k]) & 0x3F);
+        i += extra + 1;
+      } else if (extra > 0) {
+        cp = 0xFFFD;
+        i = n;
+      } else {
+        ++i;
+      }
+      auto put4 = [&](uint32_t u) {
+        out += "\\u";
+        out.push_back(hex[(u >> 12) & 0xF]);
+        out.push_back(hex[(u >> 8) & 0xF]);
+        out.push_back(hex[(u >> 4) & 0xF]);
+        out.push_back(hex[u & 0xF]);
+      };
+      if (cp > 0xFFFF) {
+        cp -= 0x10000;
+        put4(0xD800 + (cp >> 10));
+        put4(0xDC00 + (cp & 0x3FF));
+      } else {
+        put4(cp);
+      }
+    }
+  }
+  out.push_back('"');
+}
+
+void json_int(std::string& out, int64_t v) {
+  char tmp[24];
+  std::snprintf(tmp, sizeof(tmp), "%lld", static_cast<long long>(v));
+  out += tmp;
+}
+
+// -- base64 (standard alphabet, padded — matches base64.b64encode) -----------
+
+void b64_append(std::string& out, const uint8_t* src, size_t n) {
+  static const char* tbl =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  size_t i = 0;
+  for (; i + 3 <= n; i += 3) {
+    uint32_t v = (src[i] << 16) | (src[i + 1] << 8) | src[i + 2];
+    out.push_back(tbl[(v >> 18) & 63]);
+    out.push_back(tbl[(v >> 12) & 63]);
+    out.push_back(tbl[(v >> 6) & 63]);
+    out.push_back(tbl[v & 63]);
+  }
+  if (i + 1 == n) {
+    uint32_t v = src[i] << 16;
+    out.push_back(tbl[(v >> 18) & 63]);
+    out.push_back(tbl[(v >> 12) & 63]);
+    out += "==";
+  } else if (i + 2 == n) {
+    uint32_t v = (src[i] << 16) | (src[i + 1] << 8);
+    out.push_back(tbl[(v >> 18) & 63]);
+    out.push_back(tbl[(v >> 12) & 63]);
+    out.push_back(tbl[(v >> 6) & 63]);
+    out.push_back('=');
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a serialized TxnRequest (proto/log_service.proto field numbers) into a
+// batch handle: records decoded, grouped by (topic, partition) in
+// first-occurrence order. Returns NULL on malformed input (caller falls back
+// to the Python path).
+void* surge_txn_parse_request(const uint8_t* data, size_t n) {
+  Batch* b = new Batch();
+  b->buf.assign(reinterpret_cast<const char*>(data), n);
+  const uint8_t* base = reinterpret_cast<const uint8_t*>(b->buf.data());
+  Cursor c{base, base + n};
+  std::map<std::pair<std::string, int32_t>, int32_t> gidx;
+  while (c.p < c.end && c.ok) {
+    uint64_t tag = get_varint(c);
+    if (!c.ok) break;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wt = static_cast<uint32_t>(tag & 7);
+    if (field == 1 && wt == 0) {
+      b->token = get_varint(c);
+    } else if (field == 2 && wt == 2) {
+      const uint8_t* op;
+      size_t op_n;
+      if (!get_len(c, &op, &op_n)) break;
+      std::string s(reinterpret_cast<const char*>(op), op_n);
+      b->op = (s == "commit") ? 0 : (s == "abort") ? 1
+              : (s == "send_immediate") ? 2 : -1;
+    } else if (field == 3 && wt == 2) {
+      const uint8_t* rec_data;
+      size_t rec_n;
+      if (!get_len(c, &rec_data, &rec_n)) break;
+      Rec rec;
+      const uint8_t* topic;
+      size_t topic_len;
+      int32_t partition;
+      if (!parse_record(rec_data, rec_n, &rec, &topic, &topic_len,
+                        &partition)) {
+        c.ok = false;
+        break;
+      }
+      rec.group = group_of(b, topic ? topic : reinterpret_cast<const uint8_t*>(""),
+                           topic_len, partition, gidx);
+      b->group_members[rec.group].push_back(
+          static_cast<uint32_t>(b->recs.size()));
+      b->rec_groups.push_back(rec.group);
+      b->recs.push_back(std::move(rec));
+    } else if (field == 4 && wt == 0) {
+      b->seq = get_varint(c);
+    } else {
+      skip_field(c, wt);
+    }
+  }
+  if (!c.ok) {
+    delete b;
+    return nullptr;
+  }
+  return b;
+}
+
+// Parse a packed record batch (the in-process path: Python packs LogRecords in
+// ONE pass; see native_gate.pack_records). meta rows per record:
+//   [topic_idx, partition, flags, klen, vlen, nh, (hklen, hvlen) * nh]
+// flags bit0 = has_key, bit1 = tombstone. blob = key|value|hk|hv bytes
+// back-to-back in meta order; topics = topic bytes back-to-back, one entry per
+// distinct topic, lengths in topic_lens.
+void* surge_txn_parse_packed(const int64_t* meta, size_t meta_len,
+                             const uint8_t* blob, size_t blob_len,
+                             const uint8_t* topics, const int64_t* topic_lens,
+                             size_t ntopics) {
+  Batch* b = new Batch();
+  b->buf.assign(reinterpret_cast<const char*>(blob), blob_len);
+  const uint8_t* bb = reinterpret_cast<const uint8_t*>(b->buf.data());
+  std::vector<std::string> topic_names(ntopics);
+  {
+    size_t off = 0;
+    for (size_t i = 0; i < ntopics; ++i) {
+      topic_names[i].assign(reinterpret_cast<const char*>(topics) + off,
+                            static_cast<size_t>(topic_lens[i]));
+      off += static_cast<size_t>(topic_lens[i]);
+    }
+  }
+  std::map<std::pair<std::string, int32_t>, int32_t> gidx;
+  size_t mi = 0;
+  size_t bo = 0;
+  bool ok = true;
+  while (mi < meta_len) {
+    if (mi + 6 > meta_len) { ok = false; break; }
+    int64_t topic_idx = meta[mi];
+    int32_t partition = static_cast<int32_t>(meta[mi + 1]);
+    int64_t flags = meta[mi + 2];
+    int64_t klen = meta[mi + 3];
+    int64_t vlen = meta[mi + 4];
+    int64_t nh = meta[mi + 5];
+    mi += 6;
+    if (topic_idx < 0 || static_cast<size_t>(topic_idx) >= ntopics
+        || klen < 0 || vlen < 0 || nh < 0
+        || mi + 2 * static_cast<size_t>(nh) > meta_len) { ok = false; break; }
+    Rec rec;
+    rec.has_key = (flags & 1) != 0;
+    rec.has_value = (flags & 2) == 0;
+    if (rec.has_key) {
+      if (bo + klen > blob_len) { ok = false; break; }
+      rec.key = bb + bo;
+      rec.key_len = static_cast<size_t>(klen);
+      bo += static_cast<size_t>(klen);
+    }
+    if (rec.has_value) {
+      if (bo + vlen > blob_len) { ok = false; break; }
+      rec.value = bb + bo;
+      rec.value_len = static_cast<size_t>(vlen);
+      bo += static_cast<size_t>(vlen);
+    }
+    for (int64_t h = 0; h < nh; ++h) {
+      int64_t hk = meta[mi];
+      int64_t hv = meta[mi + 1];
+      mi += 2;
+      if (hk < 0 || hv < 0 || bo + hk + hv > blob_len) { ok = false; break; }
+      const uint8_t* kp = bb + bo;
+      bo += static_cast<size_t>(hk);
+      const uint8_t* vp = bb + bo;
+      bo += static_cast<size_t>(hv);
+      rec.headers.push_back({{kp, static_cast<size_t>(hk)},
+                             {vp, static_cast<size_t>(hv)}});
+    }
+    if (!ok) break;
+    const std::string& tname = topic_names[static_cast<size_t>(topic_idx)];
+    rec.group = group_of(b, reinterpret_cast<const uint8_t*>(tname.data()),
+                         tname.size(), partition, gidx);
+    b->group_members[rec.group].push_back(
+        static_cast<uint32_t>(b->recs.size()));
+    b->rec_groups.push_back(rec.group);
+    b->recs.push_back(std::move(rec));
+  }
+  if (!ok || bo != blob_len) {
+    delete b;
+    return nullptr;
+  }
+  return b;
+}
+
+void surge_txn_free(void* h) { delete static_cast<Batch*>(h); }
+
+int64_t surge_txn_nrecords(void* h) {
+  return static_cast<int64_t>(static_cast<Batch*>(h)->recs.size());
+}
+
+uint64_t surge_txn_seq(void* h) { return static_cast<Batch*>(h)->seq; }
+
+uint64_t surge_txn_token(void* h) { return static_cast<Batch*>(h)->token; }
+
+int32_t surge_txn_op(void* h) { return static_cast<Batch*>(h)->op; }
+
+int64_t surge_txn_ngroups(void* h) {
+  return static_cast<int64_t>(static_cast<Batch*>(h)->group_topics.size());
+}
+
+const char* surge_txn_group_meta(void* h, int64_t g, int64_t* topic_len,
+                                 int32_t* partition, int64_t* count) {
+  Batch* b = static_cast<Batch*>(h);
+  if (g < 0 || static_cast<size_t>(g) >= b->group_topics.size())
+    return nullptr;
+  const std::string& t = b->group_topics[static_cast<size_t>(g)];
+  *topic_len = static_cast<int64_t>(t.size());
+  *partition = b->group_parts[static_cast<size_t>(g)];
+  *count = static_cast<int64_t>(b->group_members[static_cast<size_t>(g)].size());
+  return t.data();
+}
+
+const int32_t* surge_txn_rec_groups(void* h, size_t* n) {
+  Batch* b = static_cast<Batch*>(h);
+  *n = b->rec_groups.size();
+  return b->rec_groups.data();
+}
+
+// Format the whole transaction: one segment block per group (the assign path
+// is always a single contiguous run per partition), compressed + CRC'd exactly
+// like segment.encode_block, plus the journal line
+// `{"parts": [[topic, p, base, count, new_pos], ...], "blk": [b64|null, ...]}\n`
+// with blocks <= embed_max riding the line base64-embedded (the WAL fast
+// path). bases/pos0 are per group (the caller reads them under the log lock).
+// Returns 0 on success.
+int32_t surge_txn_format(void* h, const int64_t* bases, const int64_t* pos0,
+                         double timestamp, int64_t embed_max) {
+  Batch* b = static_cast<Batch*>(h);
+  const size_t ngroups = b->group_topics.size();
+  b->blocks.clear();
+  b->gout.assign(ngroups, GroupOut());
+  b->offsets.assign(b->recs.size(), 0);
+  std::string payload;
+  std::string parts_json = "{\"parts\": [";
+  std::string blk_json = "\"blk\": [";
+  std::vector<uint8_t> comp;
+  for (size_t g = 0; g < ngroups; ++g) {
+    const auto& members = b->group_members[g];
+    payload.clear();
+    for (size_t i = 0; i < members.size(); ++i) {
+      b->offsets[members[i]] = bases[g] + static_cast<int64_t>(i);
+      frame_record(payload, b->recs[members[i]], timestamp);
+    }
+    // compression decision identical to segment.slz_compress: use the
+    // compressed form only when it is strictly smaller
+    const uint8_t* pl = reinterpret_cast<const uint8_t*>(payload.data());
+    size_t cap = surge_lz_bound(payload.size());
+    comp.resize(cap);
+    size_t cn = payload.empty()
+        ? 0 : surge_lz_compress(pl, payload.size(), comp.data(), cap);
+    uint8_t codec = 0;
+    const uint8_t* stored = pl;
+    size_t stored_n = payload.size();
+    if (cn != 0 && cn < payload.size()) {
+      codec = 1;
+      stored = comp.data();
+      stored_n = cn;
+    }
+    uint32_t crc = surge_crc32(stored, stored_n);
+    GroupOut& out = b->gout[g];
+    out.block_off = static_cast<int64_t>(b->blocks.size());
+    put_block_header(b->blocks, codec, static_cast<uint64_t>(bases[g]),
+                     static_cast<uint32_t>(members.size()),
+                     static_cast<uint32_t>(payload.size()),
+                     static_cast<uint32_t>(stored_n), crc);
+    b->blocks.append(reinterpret_cast<const char*>(stored), stored_n);
+    out.block_len = static_cast<int64_t>(b->blocks.size()) - out.block_off;
+    out.new_pos = pos0[g] + out.block_len;
+    out.embedded = out.block_len <= embed_max ? 1 : 0;
+    if (g) {
+      parts_json += ", ";
+      blk_json += ", ";
+    }
+    parts_json += "[";
+    json_escape_utf8(parts_json, b->group_topics[g]);
+    parts_json += ", ";
+    json_int(parts_json, b->group_parts[g]);
+    parts_json += ", ";
+    json_int(parts_json, bases[g]);
+    parts_json += ", ";
+    json_int(parts_json, static_cast<int64_t>(members.size()));
+    parts_json += ", ";
+    json_int(parts_json, out.new_pos);
+    parts_json += "]";
+    if (out.embedded) {
+      blk_json.push_back('"');
+      b64_append(blk_json,
+                 reinterpret_cast<const uint8_t*>(b->blocks.data())
+                     + out.block_off,
+                 static_cast<size_t>(out.block_len));
+      blk_json.push_back('"');
+    } else {
+      blk_json += "null";
+    }
+  }
+  b->line.clear();
+  b->line.reserve(parts_json.size() + blk_json.size() + 8);
+  b->line += parts_json;
+  b->line += "], ";
+  b->line += blk_json;
+  b->line += "]}\n";
+  return 0;
+}
+
+const uint8_t* surge_txn_line(void* h, size_t* n) {
+  Batch* b = static_cast<Batch*>(h);
+  *n = b->line.size();
+  return reinterpret_cast<const uint8_t*>(b->line.data());
+}
+
+const uint8_t* surge_txn_blocks(void* h, size_t* n) {
+  Batch* b = static_cast<Batch*>(h);
+  *n = b->blocks.size();
+  return reinterpret_cast<const uint8_t*>(b->blocks.data());
+}
+
+int32_t surge_txn_group_out(void* h, int64_t g, int64_t* block_off,
+                            int64_t* block_len, int32_t* embedded,
+                            int64_t* new_pos) {
+  Batch* b = static_cast<Batch*>(h);
+  if (g < 0 || static_cast<size_t>(g) >= b->gout.size()) return -1;
+  const GroupOut& out = b->gout[static_cast<size_t>(g)];
+  *block_off = out.block_off;
+  *block_len = out.block_len;
+  *embedded = out.embedded;
+  *new_pos = out.new_pos;
+  return 0;
+}
+
+const int64_t* surge_txn_offsets(void* h, size_t* n) {
+  Batch* b = static_cast<Batch*>(h);
+  *n = b->offsets.size();
+  return b->offsets.data();
+}
+
+// The in-order/dedup gate decision kernel — the scalar half of the broker's
+// per-producer Transact gate (window/alias/pending bookkeeping stays in
+// Python, which owns that state). Must stay in lockstep with
+// native_gate._py_decide:
+//   0 ACCEPT        apply now (seq == applied+1, or unsequenced)
+//   1 REPLAY        seq <= last acked: answer from the dedup window
+//   2 MAYBE_REOPEN  first seq of a reopened producer at last+1: absorption
+//                   candidate (payload match decides, in Python)
+//   3 WAIT          a predecessor has not applied: hold at the in-order gate
+//   4 FINALIZING    applied but not acked: ack bookkeeping is in flight
+int32_t surge_txn_decide(uint64_t seq, uint64_t last_seq, uint64_t applied_seq,
+                         int32_t fresh) {
+  if (seq == 0) return 0;
+  if (seq <= last_seq) return 1;
+  if (fresh && seq == last_seq + 1 && last_seq != 0 && seq > applied_seq)
+    return 2;
+  if (seq > applied_seq + 1) return 3;
+  if (seq <= applied_seq) return 4;
+  return 0;
+}
+
+// One write(+fsync) for a whole group-commit round's journal buffers: the
+// group-sync worker hands the round's concatenated lines here, paying a single
+// GIL-free call instead of a Python write/flush per commit. n == 0 with
+// do_fsync performs a bare fsync (the off-lock half of the round).
+// Returns bytes written, or -errno.
+int64_t surge_wal_append(int32_t fd, const uint8_t* buf, size_t n,
+                         int32_t do_fsync) {
+  size_t done = 0;
+  while (done < n) {
+    ssize_t w = ::write(fd, buf + done, n - done);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -static_cast<int64_t>(errno);
+    }
+    done += static_cast<size_t>(w);
+  }
+  if (do_fsync) {
+    if (::fsync(fd) != 0) return -static_cast<int64_t>(errno);
+  }
+  return static_cast<int64_t>(done);
+}
+
+// Batch record-index decode: walk an (uncompressed) segment block payload and
+// emit one fixed-width index row per record —
+//   [flags, key_off, key_len, val_off, val_len, hdr_off, hdr_cnt]
+// plus the timestamp array, so the Python side builds records with slices
+// instead of a per-byte uvarint walk (the resident plane's refresh loop and
+// every FileLog read ride this). Returns bytes consumed, or -1 on a
+// malformed/truncated payload (caller falls back to the Python decoder).
+int64_t surge_seg_index(const uint8_t* payload, size_t n, int64_t count,
+                        int64_t* out_rows, double* out_ts) {
+  size_t pos = 0;
+  auto uvarint = [&](uint64_t* v) -> bool {
+    *v = 0;
+    int shift = 0;
+    while (pos < n && shift < 64) {
+      uint8_t b = payload[pos++];
+      *v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return true;
+      shift += 7;
+    }
+    return false;
+  };
+  for (int64_t i = 0; i < count; ++i) {
+    if (pos >= n) return -1;
+    uint8_t flags = payload[pos++];
+    int64_t* row = out_rows + i * 7;
+    row[0] = flags;
+    row[1] = row[2] = row[3] = row[4] = 0;
+    if (flags & 1) {
+      uint64_t klen;
+      if (!uvarint(&klen) || pos + klen > n) return -1;
+      row[1] = static_cast<int64_t>(pos);
+      row[2] = static_cast<int64_t>(klen);
+      pos += klen;
+    }
+    if (!(flags & 2)) {
+      uint64_t vlen;
+      if (!uvarint(&vlen) || pos + vlen > n) return -1;
+      row[3] = static_cast<int64_t>(pos);
+      row[4] = static_cast<int64_t>(vlen);
+      pos += vlen;
+    }
+    uint64_t nh;
+    if (!uvarint(&nh)) return -1;
+    row[5] = static_cast<int64_t>(pos);
+    row[6] = static_cast<int64_t>(nh);
+    for (uint64_t hdr = 0; hdr < nh; ++hdr) {
+      uint64_t len;
+      if (!uvarint(&len) || pos + len > n) return -1;
+      pos += len;
+      if (!uvarint(&len) || pos + len > n) return -1;
+      pos += len;
+    }
+    if (pos + 8 > n) return -1;
+    std::memcpy(out_ts + i, payload + pos, 8);
+    pos += 8;
+  }
+  return static_cast<int64_t>(pos);
+}
+
+}  // extern "C"

@@ -250,6 +250,13 @@ DEFAULTS: dict[str, Any] = {
     # exceed this: segments are fsynced first, then a frontier line opens the
     # fresh journal and os.replace GCs the old generation. 0 disables.
     "surge.log.journal-rotate-bytes": 64 << 20,
+    # --- native broker hot path (csrc/txn.cc via log/native_gate) ---
+    # operator kill-switch for the C++ batch path: Transact payload decode,
+    # the in-order/dedup gate kernel, WAL journal formatting, the per-round
+    # journal append, lazy segment materialization and the segment read
+    # decoder. false (or an unbuilt csrc/) falls back to the bit-identical
+    # pure-Python path everywhere.
+    "surge.log.native.enabled": True,
     # --- fault-injection plane (surge_tpu.testing.faults) ---
     # a named plan (e.g. "flaky-network") or JSON rule list armed at broker/
     # FileLog construction; empty = no plane, hooks cost one attribute check.

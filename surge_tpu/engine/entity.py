@@ -30,7 +30,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, List, Optional, Sequence
 
 from surge_tpu.common import (DecodedState, cancel_safe_wait_for, fail_future,
@@ -70,11 +70,18 @@ class ApplyEvents:
     events: Sequence[Any]
 
 
-@dataclass
 class Envelope:
-    message: Any
-    reply: "asyncio.Future[Any]"
-    headers: dict = field(default_factory=dict)  # trace context rides here
+    """One mailbox delivery. A plain __slots__ class, not a dataclass: one
+    Envelope is built per command and the generated dataclass __init__ +
+    default_factory machinery was measurable at engine throughput."""
+
+    __slots__ = ("message", "reply", "headers")
+
+    def __init__(self, message: Any, reply: "asyncio.Future[Any]",
+                 headers: dict | None = None) -> None:
+        self.message = message
+        self.reply = reply
+        self.headers = headers if headers is not None else {}  # trace context
 
 
 @dataclass
@@ -153,6 +160,12 @@ class AggregateEntity:
         self.aggregate_id = aggregate_id
         self.surge_model = surge_model
         self.model = surge_model.logic.model
+        # resolved once: process_command/handle_events run per command — the
+        # attribute walk (and the handle_events getattr per fold) is pure
+        # per-call overhead on the Transact path
+        self._model_process = self.model.process_command
+        self._model_batch_fold = getattr(self.model, "handle_events", None)
+        self._model_fold = getattr(self.model, "handle_event", None)
         self.publisher = publisher
         self.fetch_state = fetch_state
         self.partition = partition
@@ -324,7 +337,7 @@ class AggregateEntity:
         self.metrics.command_rate.record()
         try:
             with self.metrics.command_handling_timer.time():
-                result = self.model.process_command(self.state, command)
+                result = self._model_process(self.state, command)
                 if inspect.isawaitable(result):
                     result = await result
                 events = list(result)
@@ -350,16 +363,17 @@ class AggregateEntity:
         old_state = self.state
         try:
             with self.metrics.event_handling_timer.time():
-                batch_fold = getattr(self.model, "handle_events", None)
+                batch_fold = self._model_batch_fold
                 if batch_fold is not None:
                     # async/batch fold (AsyncAggregateCommandModel.handleEvents)
                     new_state = batch_fold(old_state, events)
                     if inspect.isawaitable(new_state):
                         new_state = await new_state
                 else:
+                    fold = self._model_fold
                     new_state = old_state
                     for ev in events:
-                        new_state = self.model.handle_event(new_state, ev)
+                        new_state = fold(new_state, ev)
         except Exception as exc:  # noqa: BLE001 — fold failure → error ACK, no persist
             self.metrics.error_rate.record()
             resolve_future(env.reply, CommandFailure(exc))

@@ -22,7 +22,7 @@ import time
 from enum import Enum
 from typing import Callable, Dict, List, Optional
 
-from surge_tpu.common import Ack, Controllable, logger
+from surge_tpu.common import Ack, Controllable, DecodedState, logger
 from surge_tpu.config import Config, default_config
 from surge_tpu.engine.business_logic import SurgeCommandBusinessLogic, SurgeModel
 from surge_tpu.engine.entity import AggregateEntity, Envelope
@@ -386,8 +386,6 @@ class SurgeEngine(Controllable):
             return self.indexer.get_aggregate_bytes(aggregate_id)
 
         async def fetch():
-            from surge_tpu.common import DecodedState
-
             hit, state = await self.resident_plane.read_state(
                 aggregate_id, require_current=True)
             if hit:

@@ -1,0 +1,364 @@
+"""Native broker hot path — ctypes binding for csrc/txn.cc (libsurge_txn).
+
+One C++ call per Transact batch decodes the payload records, and one more
+formats the whole WAL journal entry — segment blocks (SLZ + CRC), base64
+embedding and the JSON journal line — off the GIL, replacing several
+per-record Python passes (``msg_to_record``, ``segment.encode_records``,
+``base64``/``json`` per commit). The in-order/dedup gate's scalar decision
+kernel (:func:`decide`) lives in the same library; window/alias/pending
+bookkeeping stays in Python, which owns that state — Python remains the
+control plane, C++ the per-record data plane.
+
+Fallback contract: every native entry point has a pure-Python twin in this
+module (:func:`py_decide`, :func:`py_format_journal`) producing **bit-identical
+decisions and journal bytes** — enforced by the randomized property test in
+tests/test_native_gate.py. When the library is unbuilt (``csrc/build.sh``)
+or ``surge.log.native.enabled=false``, callers take the Python twins; an
+unbuilt checkout behaves byte-for-byte like the native one.
+"""
+
+from __future__ import annotations
+
+import base64
+import ctypes
+import json
+from array import array
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = [
+    "ACCEPT", "REPLAY", "MAYBE_REOPEN", "WAIT", "FINALIZING",
+    "NativeBatch", "available", "batch_from_request", "decide", "enabled",
+    "pack_records", "py_decide", "py_format_journal", "wal_append",
+]
+
+# gate decisions (csrc/txn.cc surge_txn_decide — keep in lockstep)
+ACCEPT = 0        #: apply now (seq == applied+1, or unsequenced)
+REPLAY = 1        #: seq <= last acked: answer from the dedup window
+MAYBE_REOPEN = 2  #: reopened producer's first seq at last+1: absorption candidate
+WAIT = 3          #: predecessor not applied: hold at the in-order gate
+FINALIZING = 4    #: applied but not acked: ack bookkeeping in flight
+
+_C = ctypes
+_i64p = _C.POINTER(_C.c_int64)
+_i32p = _C.POINTER(_C.c_int32)
+_u8p = _C.c_char_p
+#: ABI contract with csrc/txn.cc (checked by tests/test_abi_drift.py)
+TXN_SIGNATURES = {
+    "surge_txn_parse_request": ((_u8p, _C.c_size_t), _C.c_void_p),
+    "surge_txn_parse_packed": ((_i64p, _C.c_size_t, _u8p, _C.c_size_t,
+                                _u8p, _i64p, _C.c_size_t), _C.c_void_p),
+    "surge_txn_free": ((_C.c_void_p,), None),
+    "surge_txn_nrecords": ((_C.c_void_p,), _C.c_int64),
+    "surge_txn_seq": ((_C.c_void_p,), _C.c_uint64),
+    "surge_txn_token": ((_C.c_void_p,), _C.c_uint64),
+    "surge_txn_op": ((_C.c_void_p,), _C.c_int32),
+    "surge_txn_ngroups": ((_C.c_void_p,), _C.c_int64),
+    "surge_txn_group_meta": ((_C.c_void_p, _C.c_int64, _i64p, _i32p, _i64p),
+                             _C.c_void_p),
+    "surge_txn_rec_groups": ((_C.c_void_p, _C.POINTER(_C.c_size_t)), _i32p),
+    "surge_txn_format": ((_C.c_void_p, _i64p, _i64p, _C.c_double,
+                          _C.c_int64), _C.c_int32),
+    "surge_txn_line": ((_C.c_void_p, _C.POINTER(_C.c_size_t)), _C.c_void_p),
+    "surge_txn_blocks": ((_C.c_void_p, _C.POINTER(_C.c_size_t)), _C.c_void_p),
+    "surge_txn_group_out": ((_C.c_void_p, _C.c_int64, _i64p, _i64p, _i32p,
+                             _i64p), _C.c_int32),
+    "surge_txn_offsets": ((_C.c_void_p, _C.POINTER(_C.c_size_t)), _i64p),
+    "surge_txn_decide": ((_C.c_uint64, _C.c_uint64, _C.c_uint64, _C.c_int32),
+                         _C.c_int32),
+    "surge_wal_append": ((_C.c_int32, _u8p, _C.c_size_t, _C.c_int32),
+                         _C.c_int64),
+    "surge_seg_index": ((_u8p, _C.c_size_t, _C.c_int64, _i64p,
+                         _C.POINTER(_C.c_double)), _C.c_int64),
+}
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        # deferred: surge_tpu.store's package __init__ imports back into
+        # surge_tpu.log at interpreter startup (checkpoint -> file)
+        from surge_tpu.store.native import load_native_library
+
+        _lib = load_native_library("libsurge_txn.so", TXN_SIGNATURES)
+    return _lib
+
+
+def available() -> bool:
+    """Whether libsurge_txn.so is built and loadable."""
+    return _load() is not None
+
+
+def enabled(config) -> bool:
+    """Native hot path usable under this config: library built AND
+    ``surge.log.native.enabled`` (default true — the flag is the operator
+    kill-switch; an unbuilt library degrades silently either way)."""
+    return config.get_bool("surge.log.native.enabled", True) and available()
+
+
+_decode_enabled: Optional[bool] = None
+
+
+def set_decode_enabled(value: Optional[bool]) -> None:
+    """Force the read-path decode switch (bench arms / tests): True/False pin
+    it (True still requires the library), None re-derives from the ambient
+    config + availability on next use."""
+    global _decode_enabled
+    _decode_enabled = None if value is None else (bool(value) and available())
+
+
+def decode_enabled() -> bool:
+    """Whether the segment read path's native record-index decode is on —
+    the same kill-switch as the append path, read from the ambient config
+    (the decoder has no per-call config handle) and cached. Tests reset by
+    assigning ``native_gate._decode_enabled = None`` (or False to force the
+    Python walk)."""
+    global _decode_enabled
+    if _decode_enabled is None:
+        try:
+            from surge_tpu.config import default_config
+
+            _decode_enabled = (default_config().get_bool(
+                "surge.log.native.enabled", True) and available())
+        except Exception:  # pragma: no cover — config import cycle guard
+            _decode_enabled = available()
+    return _decode_enabled
+
+
+# -- gate decision kernel ---------------------------------------------------------------
+
+
+def py_decide(seq: int, last_seq: int, applied_seq: int, fresh: bool) -> int:
+    """Pure-Python twin of csrc/txn.cc:surge_txn_decide (the fallback gate).
+    The property test proves every (seq, state) agrees with the native kernel."""
+    if not seq:
+        return ACCEPT
+    if seq <= last_seq:
+        return REPLAY
+    if fresh and seq == last_seq + 1 and last_seq and seq > applied_seq:
+        return MAYBE_REOPEN
+    if seq > applied_seq + 1:
+        return WAIT
+    if seq <= applied_seq:
+        return FINALIZING
+    return ACCEPT
+
+
+def decide(seq: int, last_seq: int, applied_seq: int, fresh: bool) -> int:
+    """Gate decision via the native kernel when built, else the Python twin."""
+    lib = _load()
+    if lib is None:
+        return py_decide(seq, last_seq, applied_seq, fresh)
+    return lib.surge_txn_decide(seq, last_seq, applied_seq, 1 if fresh else 0)
+
+
+# -- batch handle -----------------------------------------------------------------------
+
+
+class NativeBatch:
+    """One decoded Transact batch held in native memory. ``groups`` is the
+    [(topic, partition, count)] list in first-occurrence order — the same
+    grouping (and block order) the Python append path produces."""
+
+    __slots__ = ("_lib", "_h", "groups", "nrecords")
+
+    def __init__(self, lib, handle) -> None:
+        self._lib = lib
+        self._h = handle
+        self.nrecords = int(lib.surge_txn_nrecords(handle))
+        tl = _C.c_int64()
+        part = _C.c_int32()
+        count = _C.c_int64()
+        groups: List[Tuple[str, int, int]] = []
+        for g in range(int(lib.surge_txn_ngroups(handle))):
+            ptr = lib.surge_txn_group_meta(handle, g, _C.byref(tl),
+                                           _C.byref(part), _C.byref(count))
+            groups.append((_C.string_at(ptr, tl.value).decode("utf-8"),
+                           part.value, count.value))
+        self.groups = groups
+
+    def close(self) -> None:
+        h, self._h = self._h, None
+        if h:
+            self._lib.surge_txn_free(h)
+
+    def __del__(self) -> None:  # pragma: no cover — close() is the normal path
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def rec_groups(self) -> Sequence[int]:
+        """Per-record group index, arrival order (for locator construction)."""
+        n = _C.c_size_t()
+        ptr = self._lib.surge_txn_rec_groups(self._h, _C.byref(n))
+        return ptr[:n.value]
+
+    def format(self, bases: Sequence[int], positions: Sequence[int],
+               timestamp: float, embed_max: int):
+        """One native call: frame + compress + CRC every group's block, build
+        the journal line (embedded base64 payloads included). Returns
+        ``(line, blocks, gouts, offsets)`` — ``gouts`` per group is
+        ``(block_off, block_len, embedded, new_pos)``; ``offsets`` are the
+        assigned record offsets in arrival order."""
+        lib, h = self._lib, self._h
+        n = len(self.groups)
+        rc = lib.surge_txn_format(h, (_C.c_int64 * n)(*bases),
+                                  (_C.c_int64 * n)(*positions),
+                                  timestamp, embed_max)
+        if rc != 0:  # pragma: no cover — format cannot fail on a parsed batch
+            raise RuntimeError(f"surge_txn_format failed ({rc})")
+        sz = _C.c_size_t()
+        line = _C.string_at(lib.surge_txn_line(h, _C.byref(sz)), sz.value)
+        blocks = _C.string_at(lib.surge_txn_blocks(h, _C.byref(sz)), sz.value)
+        off = _C.c_int64()
+        blen = _C.c_int64()
+        emb = _C.c_int32()
+        pos = _C.c_int64()
+        gouts = []
+        for g in range(n):
+            lib.surge_txn_group_out(h, g, _C.byref(off), _C.byref(blen),
+                                    _C.byref(emb), _C.byref(pos))
+            gouts.append((off.value, blen.value, emb.value, pos.value))
+        optr = lib.surge_txn_offsets(h, _C.byref(sz))
+        return line, blocks, gouts, optr[:sz.value]
+
+
+def batch_from_request(request) -> Optional[NativeBatch]:
+    """Decode a pb TxnRequest's records in ONE native call from its serialized
+    bytes — no per-record Python, no ``msg_to_record``. None when the library
+    is unbuilt or the wire bytes don't parse (caller takes the Python path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    data = request.SerializeToString()
+    h = lib.surge_txn_parse_request(data, len(data))
+    if not h:
+        return None
+    return NativeBatch(lib, h)
+
+
+def pack_records(records) -> Optional[NativeBatch]:
+    """Decode a LogRecord batch into a native handle: ONE Python pass packs
+    the fields (the in-process commit path has no wire form to parse), the
+    native side re-groups and owns the bytes. None when unbuilt."""
+    lib = _load()
+    if lib is None:
+        return None
+    meta = array("q")
+    ext = meta.extend
+    parts: List[bytes] = []
+    append = parts.append
+    topic_idx = {}
+    topic_blob: List[bytes] = []
+    topic_lens = array("q")
+    for r in records:
+        t = r.topic
+        g = topic_idx.get(t)
+        if g is None:
+            g = topic_idx[t] = len(topic_idx)
+            tb = t.encode("utf-8")
+            topic_blob.append(tb)
+            topic_lens.append(len(tb))
+        key = r.key
+        value = r.value
+        flags = 0
+        klen = 0
+        vlen = 0
+        if key is not None:
+            kb = key.encode("utf-8")
+            flags = 1
+            klen = len(kb)
+            append(kb)
+        if value is None:
+            flags |= 2
+        else:
+            vlen = len(value)
+            append(value)
+        headers = r.headers
+        if headers:
+            row = [g, r.partition, flags, klen, vlen, len(headers)]
+            for hk, hv in headers.items():
+                hkb = hk.encode("utf-8")
+                hvb = hv.encode("utf-8")
+                append(hkb)
+                append(hvb)
+                row.append(len(hkb))
+                row.append(len(hvb))
+            ext(row)
+        else:
+            ext((g, r.partition, flags, klen, vlen, 0))
+    blob = b"".join(parts)
+    meta_c = (_C.c_int64 * len(meta)).from_buffer(meta) if meta else None
+    lens_c = ((_C.c_int64 * len(topic_lens)).from_buffer(topic_lens)
+              if topic_lens else None)
+    h = lib.surge_txn_parse_packed(meta_c, len(meta), blob, len(blob),
+                                   b"".join(topic_blob), lens_c,
+                                   len(topic_lens))
+    if not h:
+        return None
+    return NativeBatch(lib, h)
+
+
+def wal_append(fd: int, buf: bytes, do_fsync: bool) -> int:
+    """write()+fsync() in one GIL-free native call (the group-sync worker's
+    per-round journal append). Raises OSError like os.write/os.fsync would."""
+    lib = _load()
+    n = lib.surge_wal_append(fd, buf, len(buf), 1 if do_fsync else 0)
+    if n < 0:
+        import os as _os
+
+        raise OSError(-n, _os.strerror(-n))
+    return n
+
+
+# -- pure-Python format twin (fallback + property-test reference) -----------------------
+
+
+def py_format_journal(records, bases: Sequence[int],
+                      positions: Sequence[int], timestamp: float,
+                      embed_max: int):
+    """The Python journal formatter — exactly the bytes FileLog's pre-native
+    append produced (segment.encode_block per group + json/base64 line), in
+    the same ``(line, blocks, gouts, offsets)`` shape as
+    :meth:`NativeBatch.format`. The property test asserts bit-identity against
+    the native formatter for randomized batches."""
+    from surge_tpu.log import segment as seg
+    from surge_tpu.log.transport import LogRecord
+
+    grouped = {}
+    order: List[Tuple[str, int]] = []
+    offsets: List[int] = []
+    rec_slots: List[Tuple[int, int]] = []  # (group idx, index within group)
+    for r in records:
+        gkey = (r.topic, r.partition)
+        members = grouped.get(gkey)
+        if members is None:
+            members = grouped[gkey] = []
+            order.append(gkey)
+        rec_slots.append((order.index(gkey), len(members)))
+        members.append(r)
+    entry_parts = []
+    entry_blocks = []
+    blocks = b""
+    gouts = []
+    for g, gkey in enumerate(order):
+        base = bases[g]
+        run = [LogRecord(topic=r.topic, key=r.key, value=r.value,
+                         partition=r.partition, headers=dict(r.headers),
+                         offset=base + i, timestamp=timestamp)
+               for i, r in enumerate(grouped[gkey])]
+        block = seg.encode_block(run, base)
+        new_pos = positions[g] + len(block)
+        embedded = 1 if len(block) <= embed_max else 0
+        entry_parts.append([gkey[0], gkey[1], base, len(run), new_pos])
+        entry_blocks.append(
+            base64.b64encode(block).decode("ascii") if embedded else None)
+        gouts.append((len(blocks), len(block), embedded, new_pos))
+        blocks += block
+    for g, i in rec_slots:
+        offsets.append(bases[g] + i)
+    line = (json.dumps({"parts": entry_parts, "blk": entry_blocks})
+            + "\n").encode()
+    return line, blocks, gouts, offsets

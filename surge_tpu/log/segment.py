@@ -19,7 +19,6 @@ Record layout inside the (uncompressed) payload:
 from __future__ import annotations
 
 import ctypes
-import os
 import struct
 import zlib
 from typing import List, Optional, Tuple
@@ -32,10 +31,16 @@ CODEC_SLZ = 1
 _HEADER = struct.Struct("<4sB3xQIIII")
 HEADER_SIZE = _HEADER.size
 
-_LIB_PATHS = [
-    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-                 "csrc", "build", "libsurge_segment.so"),
-]
+#: ABI contract with csrc/segment.cc (checked by tests/test_abi_drift.py)
+SEGMENT_SIGNATURES = {
+    "surge_lz_bound": ((ctypes.c_size_t,), ctypes.c_size_t),
+    "surge_lz_compress": ((ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                           ctypes.c_size_t), ctypes.c_size_t),
+    "surge_lz_decompress": ((ctypes.c_char_p, ctypes.c_size_t,
+                             ctypes.c_char_p, ctypes.c_size_t),
+                            ctypes.c_size_t),
+    "surge_crc32": ((ctypes.c_char_p, ctypes.c_size_t), ctypes.c_uint32),
+}
 
 _lib = None
 _lib_checked = False
@@ -46,19 +51,9 @@ def _load():
     if _lib_checked:
         return _lib
     _lib_checked = True
-    for path in _LIB_PATHS:
-        if os.path.exists(path):
-            lib = ctypes.CDLL(path)
-            lib.surge_lz_bound.restype = ctypes.c_size_t
-            lib.surge_lz_bound.argtypes = [ctypes.c_size_t]
-            lib.surge_lz_compress.restype = ctypes.c_size_t
-            lib.surge_lz_compress.argtypes = [
-                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t]
-            lib.surge_lz_decompress.restype = ctypes.c_size_t
-            lib.surge_lz_decompress.argtypes = [
-                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t]
-            _lib = lib
-            break
+    from surge_tpu.store.native import load_native_library
+
+    _lib = load_native_library("libsurge_segment.so", SEGMENT_SIGNATURES)
     return _lib
 
 
@@ -125,7 +120,14 @@ def encode_records(records) -> bytes:
             _put_uvarint(buf, len(r.value))
             buf += r.value
         _put_uvarint(buf, len(r.headers))
-        for hk, hv in r.headers.items():
+        # headers frame in SORTED key order: a record decoded from protobuf
+        # carries its map in backend-dependent iteration order (upb hashes;
+        # the wire has yet another order) — canonicalizing here is what makes
+        # native and Python appends byte-identical for the same record, and
+        # leader/follower segment files converge regardless of which path
+        # built them. UTF-8 byte order == codepoint order, so the C++ twin's
+        # bytewise sort agrees with Python's str sort.
+        for hk, hv in sorted(r.headers.items()):
             hkb, hvb = hk.encode(), hv.encode()
             _put_uvarint(buf, len(hkb))
             buf += hkb
@@ -135,9 +137,61 @@ def encode_records(records) -> bytes:
     return bytes(buf)
 
 
+def _native_index(payload: bytes, count: int, native=None):
+    """Record-index table via csrc/txn.cc surge_seg_index (one native call
+    replaces the per-byte uvarint walk): 7 int64s per record —
+    [flags, key_off, key_len, val_off, val_len, hdr_off, hdr_cnt] — plus the
+    timestamp array. None → caller decodes in Python (library unbuilt,
+    surge.log.native.enabled=false, or malformed payload). ``native``
+    overrides the ambient switch: a FileLog constructed with an explicit
+    config passes its own flag so the kill-switch reaches reads too."""
+    from surge_tpu.log import native_gate
+
+    if native is None:
+        if not native_gate.decode_enabled():
+            return None
+    elif not native or not native_gate.available():
+        return None
+    lib = native_gate._load()
+    rows = (ctypes.c_int64 * (7 * count))()
+    ts = (ctypes.c_double * count)()
+    if lib.surge_seg_index(payload, len(payload), count, rows, ts) < 0:
+        return None
+    # bulk-slice to Python lists: per-element ctypes __getitem__ would cost
+    # more than the uvarint walk it replaces
+    return rows[:], ts[:]
+
+
 def decode_records(payload: bytes, topic: str, partition: int,
-                   base_offset: int, count: int) -> List[LogRecord]:
-    out: List[LogRecord] = []
+                   base_offset: int, count: int,
+                   native=None) -> List[LogRecord]:
+    idx = _native_index(payload, count, native) if count else None
+    if idx is not None:
+        rows, ts = idx
+        out = []
+        for i in range(count):
+            o = i * 7
+            flags = rows[o]
+            key = (payload[rows[o + 1]: rows[o + 1] + rows[o + 2]].decode()
+                   if flags & 1 else None)
+            value = (payload[rows[o + 3]: rows[o + 3] + rows[o + 4]]
+                     if not flags & 2 else None)
+            headers = {}
+            nh = rows[o + 6]
+            if nh:
+                pos = rows[o + 5]
+                for _ in range(nh):
+                    hklen, pos = _get_uvarint(payload, pos)
+                    hk = payload[pos: pos + hklen].decode()
+                    pos += hklen
+                    hvlen, pos = _get_uvarint(payload, pos)
+                    headers[hk] = payload[pos: pos + hvlen].decode()
+                    pos += hvlen
+            out.append(LogRecord(topic=topic, key=key, value=value,
+                                 partition=partition, headers=headers,
+                                 offset=base_offset + i, timestamp=ts[i]))
+        return out
+    out = []
     pos = 0
     for i in range(count):
         flags = payload[pos]
@@ -210,13 +264,15 @@ def read_block_header(data: bytes, pos: int):
     return codec, base, count, unlen, plen, crc, pos + HEADER_SIZE
 
 
-def decode_block(data: bytes, pos: int, topic: str, partition: int
-                 ) -> Tuple[List[LogRecord], int]:
-    """Decode the block at ``pos``; returns (records, next_pos)."""
+def decode_block(data: bytes, pos: int, topic: str, partition: int,
+                 native=None) -> Tuple[List[LogRecord], int]:
+    """Decode the block at ``pos``; returns (records, next_pos). ``native``
+    (None = ambient config) pins the record decoder's native/Python choice —
+    FileLog threads its per-instance kill-switch through here."""
     codec, base, count, unlen, plen, crc, start = read_block_header(data, pos)
     stored = data[start: start + plen]
     if zlib.crc32(stored) != crc:
         raise BlockCorruptError(f"crc mismatch at {pos}")
     payload = slz_decompress(stored, unlen) if codec == CODEC_SLZ else stored
-    return (decode_records(payload, topic, partition, base, count),
+    return (decode_records(payload, topic, partition, base, count, native),
             start + plen)

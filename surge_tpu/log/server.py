@@ -39,6 +39,7 @@ import grpc
 
 from surge_tpu.common import logger
 from surge_tpu.log import log_service_pb2 as pb
+from surge_tpu.log import native_gate
 from surge_tpu.log.transport import (
     LogRecord,
     ProducerFencedError,
@@ -65,11 +66,17 @@ class _TxnDedup:
     replicated commit awaits its follower ack)."""
 
     __slots__ = ("last_seq", "applied_seq", "last_reply", "locator",
-                 "replies", "locators")
+                 "replies", "locators", "persist_gen")
 
     def __init__(self) -> None:
         self.last_seq = 0
         self.applied_seq = 0
+        #: monotonic __txn_state payload generation (allocated under the
+        #: producer state lock): the lock-free write half drops a payload
+        #: that a NEWER generation already persisted past — two pipelined
+        #: seqs resolving in one fsync round must never leave the stale
+        #: window as the compacted-latest record
+        self.persist_gen = 0
         self.last_reply: Optional[pb.TxnReply] = None
         #: committed-record locations [(topic, partition, offset), ...] for
         #: last_seq, recovered from __txn_state after a broker restart — the
@@ -291,6 +298,20 @@ def msg_to_record(m: pb.RecordMsg) -> LogRecord:
                      offset=m.offset, timestamp=m.timestamp)
 
 
+class _CommitRef:
+    """Committed-record location on the native Transact path — just enough
+    for dedup locators (``_persist_txn_state`` reads topic/partition/offset);
+    the reply echoes the request messages, so full LogRecords never
+    materialize."""
+
+    __slots__ = ("topic", "partition", "offset")
+
+    def __init__(self, topic: str, partition: int, offset: int) -> None:
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+
+
 class LogServer:
     """gRPC facade over an in-process log. One instance per broker process."""
 
@@ -378,6 +399,13 @@ class LogServer:
         # missing predecessor seq before answering retriable
         self._inorder_timeout_s = cfg.get_seconds(
             "surge.log.txn-inorder-timeout-ms", 3_000)
+        # native Transact hot path (csrc/txn.cc via log/native_gate): batch
+        # decode + WAL formatting in one C++ call, gate decisions through the
+        # native kernel; None = the bit-identical pure-Python path
+        # (library unbuilt or surge.log.native.enabled=false)
+        self._native = native_gate if native_gate.enabled(cfg) else None
+        self._gate_decide = (native_gate.decide if self._native is not None
+                             else native_gate.py_decide)
         self._repl_target_state: Dict[str, _TargetState] = {
             t: _TargetState() for t in self._repl_targets}
         # rejoin-probe transport: ONE cached channel per target, stubs derived
@@ -388,6 +416,10 @@ class LogServer:
         # duplicate-append window on every broker restart)
         self._txn_state_producer = None
         self._txn_state_lock = threading.Lock()
+        #: txn_id -> newest persisted payload generation (under
+        #: _txn_state_lock): orders the hot path's lock-free annotation
+        #: writes per producer
+        self._txn_persist_gens: Dict[str, int] = {}
         self._recover_txn_state()
         # -- replication (follower side): ordered ingest of leader batches
         self._replica_lock = threading.Lock()
@@ -672,12 +704,25 @@ class LogServer:
                                error="unknown producer token "
                                      "(broker restarted?)",
                                error_kind="fenced")
-        records = [msg_to_record(m) for m in request.records]
         seq = request.txn_seq
+        records: Optional[list] = None
+
+        def _records() -> list:
+            # decoded lazily: only gate slow paths (replays, absorption,
+            # alias matching, pending joins) compare LogRecords — the native
+            # commit path answers from the request messages and never pays
+            # the per-record decode
+            nonlocal records
+            if records is None:
+                records = [msg_to_record(m) for m in request.records]
+            return records
+
         deadline = time.monotonic() + self._inorder_timeout_s
         join_item: Optional[_ReplItem] = None
         sync_handle = None  # pipelined inner-log commit awaiting its round
         committed: list = []
+        nat_offsets = None  # native path: assigned offsets, arrival order
+        nat_refs: Optional[list] = None  # native path: dedup locator refs
         gate_t0: Optional[float] = None  # set when the in-order gate holds us
         with state.lock:
             dedup = state.dedup
@@ -691,6 +736,13 @@ class LogServer:
                 state.fresh = False
             while True:
                 if seq:
+                    # the scalar gate decision runs through the native kernel
+                    # (csrc/txn.cc surge_txn_decide) when built — the same
+                    # classification the Python twin makes, property-tested
+                    # bit-identical; window/alias/pending bookkeeping below
+                    # stays in Python, which owns that state
+                    decision = self._gate_decide(seq, dedup.last_seq,
+                                                 dedup.applied_seq, fresh)
                     # idempotency window: a replayed seq means the client lost
                     # our reply and retried — answer from the dedup window
                     # (any seq a pipelined client can still replay), never
@@ -699,10 +751,9 @@ class LogServer:
                     # offsets on first replay), and a replay is only honored
                     # for the IDENTICAL payload — answering a different batch
                     # from the cache would silently drop its records.
-                    if seq <= dedup.last_seq:
-                        return self._replay_answer(dedup, seq, records)
-                    if (fresh and seq == dedup.last_seq + 1 and dedup.last_seq
-                            and seq > dedup.applied_seq):
+                    if decision == native_gate.REPLAY:
+                        return self._replay_answer(dedup, seq, _records())
+                    if decision == native_gate.MAYBE_REOPEN:
                         # reopen-retry absorption: a publisher whose commit
                         # landed but whose broker bounced re-opens (numbering
                         # resumes at last+1) and retries the SAME batch under
@@ -716,9 +767,9 @@ class LogServer:
                                  or self._rebuild_cached_reply(dedup))
                         if reply is not None and reply.ok:
                             cached = [msg_to_record(m) for m in reply.records]
-                            if _same_payload(cached, records):
-                                self._ack_seq(state.txn_id, dedup, seq, reply,
-                                              cached)
+                            if _same_payload(cached, _records()):
+                                self._ack_seq(state.txn_id, dedup, seq,
+                                              reply, cached)
                                 state.cond.notify_all()
                                 return reply
                     orig = state.alias_joins.get(seq)
@@ -754,7 +805,7 @@ class LogServer:
                         # payload-match them against the in-limbo items and
                         # the recent-reply window, join/answer, never append
                         # the same batch twice (the failover-bench dup class).
-                        alias = self._alias_match(state, records)
+                        alias = self._alias_match(state, _records())
                         if alias is not None:
                             kind, hit = alias
                             state.alias_budget -= 1
@@ -777,7 +828,7 @@ class LogServer:
                     # its records)
                     pending = self._repl_pending.get((state.txn_id, seq))
                     if pending is not None:
-                        if not _same_payload(pending.records, records):
+                        if not _same_payload(pending.records, _records()):
                             return pb.TxnReply(
                                 ok=False, error_kind="state",
                                 error=f"txn_seq {seq} reused with a "
@@ -789,7 +840,7 @@ class LogServer:
                     # has not applied yet waits its turn (bounded — the client
                     # retries the same seq on a retriable answer, preserving
                     # exactly-once)
-                    if seq > dedup.applied_seq + 1:
+                    if decision == native_gate.WAIT:
                         if gate_t0 is None:
                             gate_t0 = time.monotonic()
                         if time.monotonic() >= deadline:
@@ -802,7 +853,7 @@ class LogServer:
                         state.cond.wait(
                             min(0.1, deadline - time.monotonic()))
                         continue
-                    if seq <= dedup.applied_seq:
+                    if decision == native_gate.FINALIZING:
                         # applied, but neither the ack window nor the pending
                         # map holds it — the replication worker is finalizing
                         # it right now. Wait for the bookkeeping, then answer
@@ -825,7 +876,47 @@ class LogServer:
                 try:
                     if request.op == "commit":
                         producer = state.producer
-                        if (not self._repl_targets
+                        use_native = (self._native is not None
+                                      and bool(request.records)
+                                      and not self._repl_targets
+                                      and hasattr(producer, "commit_packed"))
+                        if use_native:
+                            t0 = time.perf_counter()
+                            batch = self._native.batch_from_request(request)
+                            if batch is None:  # unparseable: Python path
+                                self.broker_metrics.native_fallbacks.record()
+                                use_native = False
+                        if use_native:
+                            # native fast path: ONE C++ call decodes the
+                            # payload records, a second formats blocks + the
+                            # WAL line inside the pipelined apply — no
+                            # LogRecord ever materializes. Durability is
+                            # awaited outside the lock exactly like the
+                            # pipelined branch below.
+                            try:
+                                sync_handle, nat_offsets, nat_ts = \
+                                    producer.commit_packed(batch)
+                                # stamp assigned offsets/timestamps onto the
+                                # request messages NOW (under the lock): the
+                                # reply echoes them, and a promotion racing
+                                # in replication targets reads them below
+                                for m, off in zip(request.records,
+                                                  nat_offsets):
+                                    m.offset = off
+                                    m.timestamp = nat_ts
+                                groups = batch.groups
+                                nat_refs = [
+                                    _CommitRef(groups[g][0], groups[g][1],
+                                               off)
+                                    for g, off in zip(batch.rec_groups(),
+                                                      nat_offsets)]
+                            finally:
+                                batch.close()
+                            bm = self.broker_metrics
+                            bm.native_gate_batches.record()
+                            bm.native_batch_decode_timer.record_ms(
+                                (time.perf_counter() - t0) * 1000.0)
+                        elif (not self._repl_targets
                                 and hasattr(producer, "commit_pipelined")):
                             # pipelined inner log (FileLog): APPLY under the
                             # lock, await DURABILITY outside it — the next
@@ -834,13 +925,13 @@ class LogServer:
                             # max-in-flight overlaps the fsync wait too, not
                             # just the network RTT
                             producer.begin()
-                            for r in records:
+                            for r in _records():
                                 producer.send(r)
                             sync_handle = producer.commit_pipelined()
                             committed = list(sync_handle.records_out)
                         else:
                             producer.begin()
-                            for r in records:
+                            for r in _records():
                                 producer.send(r)
                             committed = producer.commit()
                     elif request.op == "abort":
@@ -848,7 +939,7 @@ class LogServer:
                         committed = []
                     elif request.op == "send_immediate":
                         committed = [state.producer.send_immediate(r)
-                                     for r in records]
+                                     for r in _records()]
                     else:
                         return pb.TxnReply(ok=False, error_kind="state",
                                            error=f"unknown op {request.op!r}")
@@ -866,6 +957,11 @@ class LogServer:
                     # applied locally, nothing replicated/acked yet: the
                     # canonical lost-unreplicated-tail crash point
                     self.faults.crash_point("transact.post-apply")
+                if self._repl_targets and nat_offsets is not None:
+                    # a promotion added replication targets between the
+                    # native-eligibility check and here: materialize the
+                    # stamped records (rare race path) and ship them
+                    committed = [msg_to_record(m) for m in request.records]
                 if self._repl_targets and committed:
                     join_item = self._enqueue_replication(committed,
                                                           state.txn_id, seq)
@@ -915,6 +1011,7 @@ class LogServer:
                         ok=False, error_kind="other",
                         error=f"journal sync failed: {exc!r}")
                 state.producer.retry_pipelined(sync_handle)
+        persist_value = None  # (payload bytes, generation) built under lock
         with state.lock:
             if self.role != "leader":
                 # demoted while awaiting the journal round (see the in-lock
@@ -924,18 +1021,39 @@ class LogServer:
                     error="demoted while committing; write NOT "
                           "acknowledged — retry on the leader",
                     leader_hint=self.leader_hint)
-            reply = pb.TxnReply(ok=True,
-                                records=[record_to_msg(r) for r in committed])
+            if nat_offsets is not None:
+                # native path: offsets/timestamps were stamped onto the
+                # request messages at apply time — echo them (no LogRecord →
+                # RecordMsg round trip)
+                reply = pb.TxnReply(ok=True, records=request.records)
+                acked = nat_refs
+            else:
+                reply = pb.TxnReply(ok=True,
+                                    records=[record_to_msg(r)
+                                             for r in committed])
+                acked = committed
             if seq:
-                self._ack_seq(state.txn_id, state.dedup, seq, reply, committed)
+                self._ack_seq(state.txn_id, state.dedup, seq, reply, acked,
+                              persist=False)
+                persist_value = self._txn_state_payload(state.txn_id, seq,
+                                                        acked)
                 state.cond.notify_all()  # a replay may be polling for the ack
+        if persist_value is not None:
+            # the durable __txn_state annotation commits OFF the producer
+            # lock: later seqs of this producer's pipelined window flow while
+            # its journal round runs. The reply still waits for it — a replay
+            # after a broker restart must find the locator.
+            self._txn_state_write(state.txn_id, persist_value)
         return reply
 
     def _ack_seq(self, txn_id: str, dedup: _TxnDedup, seq: int,
-                 reply: pb.TxnReply, committed) -> None:
+                 reply: pb.TxnReply, committed, persist: bool = True) -> None:
         """Acknowledge one committed seq into the dedup window + durable
         __txn_state (non-replicated commits, the replication worker's
-        finalize, follower ingest, and reopen absorption all converge here)."""
+        finalize, follower ingest, and reopen absorption all converge here).
+        ``persist=False`` callers split the durable half out themselves
+        (payload under their lock, write outside it — the hot path's
+        de-fattening; see _transact_impl's tail)."""
         dedup.cache_reply(seq, reply)
         if seq > dedup.last_seq:
             dedup.last_reply = reply
@@ -944,7 +1062,8 @@ class LogServer:
         if seq > dedup.applied_seq:
             dedup.applied_seq = seq
         self.broker_metrics.txn_dedup_window.record(len(dedup.replies))
-        self._persist_txn_state(txn_id, seq, committed)
+        if persist:
+            self._persist_txn_state(txn_id, seq, committed)
 
     def _alias_match(self, state: "_ProducerState", records):
         """Find the in-limbo (or since-resolved) seq in this reopened
@@ -2262,6 +2381,11 @@ class LogServer:
             # new leader's table is authoritative — rebuild from it
             with self._replica_lock:
                 self._txn_dedup.clear()
+                with self._txn_state_lock:
+                    # fresh _TxnDedup objects restart persist_gen at 0: a
+                    # surviving high-water here would silently drop every
+                    # later __txn_state write until the counter caught up
+                    self._txn_persist_gens.clear()
             self.catch_up(leader_target)
         except Exception:  # noqa: BLE001 — demoted-but-behind is recoverable
             logger.exception(
@@ -2593,7 +2717,16 @@ class LogServer:
         client's replay of a non-newest seq survives a broker restart too.
         Best-effort: a failure only re-opens the restart-window duplicate
         risk, it must never fail the commit it annotates. ``records`` carry
-        their committed offsets (LogRecord or RecordMsg)."""
+        their committed offsets (LogRecord, RecordMsg or _CommitRef)."""
+        payload = self._txn_state_payload(txn_id, seq, records)
+        if payload is not None:
+            self._txn_state_write(txn_id, payload)
+
+    def _txn_state_payload(self, txn_id: str, seq: int, records):
+        """Locator-window bookkeeping half of the txn-state persist (run
+        under the producer state lock — it mutates ``dedup.locators``).
+        Returns ``(value, generation)`` — the generation orders lock-free
+        writes of this txn_id's annotations."""
         import json as _json
 
         try:
@@ -2601,7 +2734,10 @@ class LogServer:
             dedup = self._txn_dedup.get(txn_id)
             window: list = []
             newest = seq
+            gen = 0
             if dedup is not None:
+                dedup.persist_gen += 1
+                gen = dedup.persist_gen
                 dedup.locators[seq] = locator
                 while len(dedup.locators) > _DEDUP_WINDOW:
                     dedup.locators.popitem(last=False)
@@ -2617,9 +2753,27 @@ class LogServer:
                 # call's seq
                 newest = max(seq, dedup.last_seq)
                 locator = dedup.locators.get(newest, locator)
-            value = _json.dumps(
-                {"s": int(newest), "r": locator, "w": window}).encode()
+            return (_json.dumps(
+                {"s": int(newest), "r": locator, "w": window}).encode(), gen)
+        except Exception:  # noqa: BLE001 — annotation only, never fail commits
+            logger.exception("txn-state payload failed "
+                             "(restart dedup window open)")
+            return None
+
+    def _txn_state_write(self, txn_id: str, payload) -> None:
+        """Inner-log append half of the txn-state persist (safe outside the
+        producer state lock — serialized by its own lock). ``payload`` is a
+        ``(value, generation)`` pair from _txn_state_payload: a payload whose
+        generation an already-written NEWER one superseded is dropped, so
+        two pipelined seqs resolving in one fsync round can never leave the
+        stale window as the compacted-latest record."""
+        value, gen = payload
+        try:
             with self._txn_state_lock:
+                if gen:
+                    if gen < self._txn_persist_gens.get(txn_id, 0):
+                        return
+                    self._txn_persist_gens[txn_id] = gen
                 known = getattr(self.log, "_topics", {})
                 if TXN_STATE_TOPIC not in known:
                     self.log.create_topic(

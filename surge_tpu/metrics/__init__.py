@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 from surge_tpu.metrics.statistics import (
     Count,
     ExponentialWeightedMovingAverage,
+    FusedTimerStats,
     Max,
     MetricValueProvider,
     Min,
@@ -160,11 +161,25 @@ class Metrics:
     def timer(self, info: MetricInfo, level: RecordingLevel = RecordingLevel.INFO) -> Timer:
         s = self.sensor(info.name, level)
         if info.name not in self._metrics:
-            s.add_metric(info, ExponentialWeightedMovingAverage(), self)
-            s.add_metric(MetricInfo(f"{info.name}.min", f"min of {info.name}"), Min(), self)
-            s.add_metric(MetricInfo(f"{info.name}.max", f"max of {info.name}"), Max(), self)
-            s.add_metric(MetricInfo(f"{info.name}.p99", f"p99 of {info.name}"),
-                         TimeBucketHistogram(exemplars=self.exemplars), self)
+            # ONE fused provider records all four statistics per observation
+            # (the pre-fusion layout dispatched four provider updates per
+            # recording — a real cost at per-command rates); the export names
+            # are unchanged: the fused provider itself reports the EWMA under
+            # the base name, min/max export through views, and .p99 registers
+            # the embedded histogram so the OpenMetrics exposition still sees
+            # a real TimeBucketHistogram
+            fused = FusedTimerStats(TimeBucketHistogram(
+                exemplars=self.exemplars))
+            s.add_metric(info, fused, self)
+            self._register(MetricInfo(f"{info.name}.min",
+                                      f"min of {info.name}"),
+                           fused.min_view())
+            self._register(MetricInfo(f"{info.name}.max",
+                                      f"max of {info.name}"),
+                           fused.max_view())
+            self._register(MetricInfo(f"{info.name}.p99",
+                                      f"p99 of {info.name}"),
+                           fused.histogram)
         return Timer(s)
 
     def rate(self, info: MetricInfo, level: RecordingLevel = RecordingLevel.INFO) -> Sensor:

@@ -65,6 +65,10 @@ class BrokerMetrics:
     txn_dedup_window: Sensor = field(init=False)
     txn_alias_window: Sensor = field(init=False)
     txn_pipelined_depth: Sensor = field(init=False)
+    # native Transact hot path (csrc/txn.cc via log/native_gate)
+    native_batch_decode_timer: Timer = field(init=False)
+    native_gate_batches: Sensor = field(init=False)
+    native_fallbacks: Sensor = field(init=False)
     # majority-quorum promotion (vote layer)
     quorum_vote_requests: Sensor = field(init=False)
     quorum_votes_granted: Sensor = field(init=False)
@@ -144,6 +148,21 @@ class BrokerMetrics:
             "how far past the acked frontier the last arriving txn_seq ran "
             "(the live pipelined window depth, bounded by "
             "surge.producer.max-in-flight)"))
+        self.native_batch_decode_timer = m.timer(MI(
+            "surge.log.native.batch-decode-timer",
+            "ms per native Transact batch: C++ payload decode + gate + "
+            "pipelined apply incl. WAL-entry formatting (csrc/txn.cc; "
+            "compare txn.inorder-wait-timer for gate stalls)"))
+        self.native_gate_batches = m.counter(MI(
+            "surge.log.native.gate-batches",
+            "Transact batches committed through the native decode/gate/"
+            "format path (0 = library unbuilt or "
+            "surge.log.native.enabled=false)"))
+        self.native_fallbacks = m.counter(MI(
+            "surge.log.native.fallbacks",
+            "Transact batches that fell back to the pure-Python path on a "
+            "native-enabled broker (unparseable request bytes — the "
+            "bit-identical fallback contract, not an error)"))
         self.quorum_vote_requests = m.counter(MI(
             "surge.log.quorum.vote-requests",
             "VoteLeader RPCs answered by this broker (each candidate's "

@@ -655,7 +655,14 @@ class ResidentStatePlane(Controllable):
         polling ON the loop every interval is exactly the latency tax the
         command path must not pay. Returns ``(batches, ends)`` — ``ends``
         carries every polled partition's end offset for gauge/fast-forward
-        use without another on-loop log call."""
+        use without another on-loop log call.
+
+        The PR-6 sustained-fold wall was this read's host-side decode: on a
+        FileLog these reads now ride the native record-index decoder
+        (csrc/txn.cc ``surge_seg_index`` via ``segment.decode_records``),
+        guarded by the same ``surge.log.native.enabled`` fallback flag as
+        the broker hot path — unbuilt/disabled checkouts keep the pure-
+        Python uvarint walk, record-identical."""
         batches: Dict[int, list] = {}
         ends: Dict[int, int] = {}
         for p, wm in watermarks.items():

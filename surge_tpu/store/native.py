@@ -11,13 +11,69 @@ from __future__ import annotations
 
 import ctypes
 import os
-from typing import Iterator, Optional, Tuple
+from typing import Dict, Iterator, Optional, Tuple
 
-_LIB_PATHS = [
-    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-                 "csrc", "build", "libsurge_store.so"),
-    os.path.join(os.path.dirname(__file__), "libsurge_store.so"),
-]
+#: csrc/build/ — every first-party native library lives here
+CSRC_BUILD_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+    "csrc", "build")
+
+
+def load_native_library(filename: str, signatures: Dict[str, tuple],
+                        extra_dirs: tuple = ()):
+    """Shared ctypes loader for the csrc/ libraries: resolves ``filename``
+    under ``csrc/build/`` (or ``extra_dirs``), applies the declared
+    ``signatures`` ({symbol: (argtypes, restype)}) and returns the CDLL, or
+    None when the library is unbuilt — callers degrade to their Python path.
+
+    The signature tables are the loader's ABI contract with csrc/*.cc; the
+    tier-1 ABI-drift test (tests/test_abi_drift.py) cross-checks every table
+    against the exported C signatures, because a silent mismatch here would
+    corrupt data rather than crash."""
+    for d in (CSRC_BUILD_DIR, *extra_dirs):
+        path = os.path.join(d, filename)
+        if not os.path.exists(path):
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+            for name, (argtypes, restype) in signatures.items():
+                fn = getattr(lib, name)
+                fn.argtypes = list(argtypes)
+                fn.restype = restype
+        except (AttributeError, OSError) as exc:
+            # a stale build missing a newly-declared symbol, or a corrupt /
+            # wrong-arch .so: DEGRADE (the documented contract), don't crash
+            # FileLog/LogServer construction — rebuild via csrc/build.sh
+            import logging
+
+            logging.getLogger("surge").warning(
+                "native library %s unusable (%s); falling back to the "
+                "pure-Python path — rerun csrc/build.sh", path, exc)
+            return None
+        return lib
+    return None
+
+
+_C = ctypes
+#: ABI contract with csrc/store.cc (checked by tests/test_abi_drift.py)
+STORE_SIGNATURES: Dict[str, tuple] = {
+    "surge_store_new": ((), _C.c_void_p),
+    "surge_store_free": ((_C.c_void_p,), None),
+    "surge_store_put": ((_C.c_void_p, _C.c_char_p, _C.c_size_t,
+                         _C.c_char_p, _C.c_size_t), None),
+    "surge_store_get": ((_C.c_void_p, _C.c_char_p, _C.c_size_t,
+                         _C.POINTER(_C.c_size_t)), _C.POINTER(_C.c_char)),
+    "surge_store_delete": ((_C.c_void_p, _C.c_char_p, _C.c_size_t), None),
+    "surge_store_size": ((_C.c_void_p,), _C.c_size_t),
+    "surge_store_clear": ((_C.c_void_p,), None),
+    "surge_store_iter_new": ((_C.c_void_p,), _C.c_void_p),
+    "surge_store_iter_next": ((_C.c_void_p,
+                               _C.POINTER(_C.POINTER(_C.c_char)),
+                               _C.POINTER(_C.c_size_t),
+                               _C.POINTER(_C.POINTER(_C.c_char)),
+                               _C.POINTER(_C.c_size_t)), _C.c_int),
+    "surge_store_iter_free": ((_C.c_void_p,), None),
+}
 
 _lib = None
 
@@ -26,36 +82,10 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    for path in _LIB_PATHS:
-        if os.path.exists(path):
-            lib = ctypes.CDLL(path)
-            lib.surge_store_new.restype = ctypes.c_void_p
-            lib.surge_store_free.argtypes = [ctypes.c_void_p]
-            lib.surge_store_put.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
-                ctypes.c_char_p, ctypes.c_size_t]
-            lib.surge_store_get.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
-                ctypes.POINTER(ctypes.c_size_t)]
-            lib.surge_store_get.restype = ctypes.POINTER(ctypes.c_char)
-            lib.surge_store_delete.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
-            lib.surge_store_size.argtypes = [ctypes.c_void_p]
-            lib.surge_store_size.restype = ctypes.c_size_t
-            lib.surge_store_clear.argtypes = [ctypes.c_void_p]
-            lib.surge_store_iter_new.argtypes = [ctypes.c_void_p]
-            lib.surge_store_iter_new.restype = ctypes.c_void_p
-            lib.surge_store_iter_next.argtypes = [
-                ctypes.c_void_p,
-                ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
-                ctypes.POINTER(ctypes.c_size_t),
-                ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
-                ctypes.POINTER(ctypes.c_size_t)]
-            lib.surge_store_iter_next.restype = ctypes.c_int
-            lib.surge_store_iter_free.argtypes = [ctypes.c_void_p]
-            _lib = lib
-            return _lib
-    return None
+    _lib = load_native_library(
+        "libsurge_store.so", STORE_SIGNATURES,
+        extra_dirs=(os.path.dirname(__file__),))
+    return _lib
 
 
 def native_available() -> bool:

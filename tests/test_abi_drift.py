@@ -1,0 +1,117 @@
+"""ABI-drift gate: csrc/*.cc exported C signatures vs every ctypes table.
+
+The native loaders (store/native.py, log/segment.py, log/native_gate.py)
+declare their ABI as signature tables; the C side declares it as
+``extern "C"`` function definitions. The loader silently degrades when a
+symbol is MISSING — but a symbol whose signature silently drifted (a param
+added, a scalar became a pointer) would corrupt data rather than crash, so
+this test parses the C sources and cross-checks, both directions:
+
+- every ctypes-declared function exists in its .cc with the same parameter
+  count and per-parameter pointer-ness, and a matching return kind;
+- every exported C function is covered by its loader's table (a new export
+  must be declared, or Python could call it un-prototyped).
+
+Pure text analysis — runs (and gates) even when no compiler is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import re
+
+import pytest
+
+from surge_tpu.log.native_gate import TXN_SIGNATURES
+from surge_tpu.log.segment import SEGMENT_SIGNATURES
+from surge_tpu.store.native import STORE_SIGNATURES
+
+CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "csrc")
+
+#: loader table -> the .cc file whose extern "C" exports it binds
+TABLES = [
+    ("store/native.py STORE_SIGNATURES", STORE_SIGNATURES, "store.cc"),
+    ("log/segment.py SEGMENT_SIGNATURES", SEGMENT_SIGNATURES, "segment.cc"),
+    ("log/native_gate.py TXN_SIGNATURES", TXN_SIGNATURES, "txn.cc"),
+]
+
+_FN = re.compile(
+    r"\n([A-Za-z_][\w :<>*&]*?)[ \t\n]+(surge_\w+)\s*\(([^)]*)\)\s*\{")
+
+
+def _c_exports(filename: str):
+    """{name: (return_kind, [param_kind, ...])} for every exported function
+    DEFINITION in the file (prototypes — ``);`` — are not exports)."""
+    with open(os.path.join(CSRC, filename)) as f:
+        src = f.read()
+    out = {}
+    for ret, name, args in _FN.findall(src):
+        params = []
+        args = args.strip()
+        if args and args != "void":
+            for a in args.split(","):
+                params.append("ptr" if "*" in a else "scalar")
+        ret = ret.strip()
+        kind = ("void" if ret == "void"
+                else "ptr" if "*" in ret else "scalar")
+        out[name] = (kind, params)
+    return out
+
+
+def _ctypes_kind(t) -> str:
+    if t is None:
+        return "void"
+    if t in (ctypes.c_void_p, ctypes.c_char_p, ctypes.c_wchar_p):
+        return "ptr"
+    if isinstance(t, type) and issubclass(t, ctypes._Pointer):
+        return "ptr"
+    return "scalar"
+
+
+@pytest.mark.parametrize("label,table,filename",
+                         TABLES, ids=[t[2] for t in TABLES])
+def test_ctypes_tables_match_c_signatures(label, table, filename):
+    exports = _c_exports(filename)
+    assert exports, f"no extern-C exports parsed from {filename}"
+    for name, (argtypes, restype) in table.items():
+        assert name in exports, (
+            f"{label} declares {name} but {filename} does not define it")
+        c_ret, c_params = exports[name]
+        assert len(argtypes) == len(c_params), (
+            f"{name}: ctypes declares {len(argtypes)} params, "
+            f"{filename} defines {len(c_params)}")
+        assert _ctypes_kind(restype) == c_ret, (
+            f"{name}: ctypes restype kind {_ctypes_kind(restype)!r} vs "
+            f"C return kind {c_ret!r}")
+        for i, (a, c) in enumerate(zip(argtypes, c_params)):
+            assert _ctypes_kind(a) == c, (
+                f"{name} param {i}: ctypes {_ctypes_kind(a)!r} vs C {c!r}")
+
+
+@pytest.mark.parametrize("label,table,filename",
+                         TABLES, ids=[t[2] for t in TABLES])
+def test_every_c_export_is_declared(label, table, filename):
+    exports = _c_exports(filename)
+    undeclared = sorted(set(exports) - set(table))
+    assert not undeclared, (
+        f"{filename} exports {undeclared} but {label} does not declare "
+        "them — add signatures (the loader must never call un-prototyped)")
+
+
+def test_tables_bind_against_built_libraries():
+    """When the libraries are built (conftest builds them when g++ exists),
+    every declared symbol must actually resolve."""
+    from surge_tpu.store.native import load_native_library
+
+    libs = [("libsurge_store.so", STORE_SIGNATURES),
+            ("libsurge_segment.so", SEGMENT_SIGNATURES),
+            ("libsurge_txn.so", TXN_SIGNATURES)]
+    missing = [n for n, _s in libs
+               if not os.path.exists(os.path.join(CSRC, "build", n))]
+    if missing:
+        pytest.skip(f"native libraries not built: {missing} "
+                    "(csrc/build.sh needs g++)")
+    for name, sigs in libs:
+        assert load_native_library(name, sigs) is not None, name

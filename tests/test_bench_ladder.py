@@ -39,3 +39,35 @@ def test_bench_ladder_fast_path_emits_expected_json():
     assert rung["commands"] > 0 and rung["commands_per_txn"] >= 1
     # the corpus phases really were skipped
     assert "num_events" not in payload and "cpu_baseline_events_per_sec" not in payload
+
+
+def test_bench_native_paired_ladder_smoke():
+    """SURGE_BENCH_NATIVE=1: the paired interleaved native-on/native-off
+    ladder (the r07 protocol) emits per-rung medians for BOTH arms plus a
+    speedup ratio, tiny-sized here."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SURGE_BENCH_LADDER": "1",
+        "SURGE_BENCH_NATIVE": "1",
+        "SURGE_BENCH_NATIVE_ROUNDS": "1",
+        "SURGE_BENCH_LATENCY_SECONDS": "0.3",
+        "SURGE_BENCH_LATENCY_LADDER": "8",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON payload on stdout: {proc.stdout!r}"
+    payload = json.loads(lines[-1])
+    paired = payload["native_paired_ladder"]
+    assert paired["protocol"]["interleaved"] and paired["protocol"]["medians"]
+    (rung,) = paired["rungs"]
+    assert rung["workers"] == 8
+    for arm in ("native_on", "native_off"):
+        assert rung[arm]["commands_per_sec_median"] > 0
+        assert rung[arm]["rounds"]
+    assert rung["speedup_median"] > 0
+    assert payload["value"] == rung["native_on"]["commands_per_sec_median"]
