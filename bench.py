@@ -628,6 +628,172 @@ def native_paired_ladder(seconds: float, rounds: int = 3,
     return out
 
 
+def lane_paired_ladder(seconds: float, rounds: int = 3,
+                       rungs=(64, 1024), brokers=("inproc", "grpc")) -> dict:
+    """PAIRED interleaved command-lane ladder (ISSUE 12, the r08 protocol):
+    ``surge.producer.command-lane=direct`` (batch-level ack futures + slim
+    timer waits, this PR's lane) vs ``classic`` (the PR-3 per-command
+    machinery) — both arms native-on, over the inproc AND grpc rungs, arm
+    order alternating per round, medians only (this host's 2-3x run swing,
+    BENCH_NOTES round 6)."""
+    import statistics as _st
+
+    arms = ("direct", "classic")
+    raw: dict = {b: {a: {w: [] for w in rungs} for a in arms}
+                 for b in brokers}
+    for rnd in range(rounds):
+        order = arms if rnd % 2 == 0 else arms[::-1]
+        for broker in brokers:
+            for arm in order:
+                stats = steady_state_latency(
+                    seconds,
+                    overrides={"surge.producer.command-lane": arm,
+                               "bench.broker": broker},
+                    ladder=list(rungs))
+                for rung in stats["throughput_ladder"]:
+                    raw[broker][arm][rung["workers"]].append(rung)
+                log(f"round {rnd + 1}/{rounds} {broker}/{arm}: " + ", ".join(
+                    f"{r['workers']}w {r['commands_per_sec']} cmd/s "
+                    f"p50 {r['p50_ms']}ms"
+                    for r in stats["throughput_ladder"]))
+    med = lambda xs: round(_st.median(xs), 2)  # noqa: E731
+    out = {"protocol": {"rounds": rounds, "seconds_per_rung": seconds,
+                        "rungs": list(rungs), "brokers": list(brokers),
+                        "interleaved": True, "medians": True},
+           "ladders": {}}
+    for broker in brokers:
+        rows = []
+        for w in rungs:
+            row = {"workers": w}
+            for arm in arms:
+                samples = raw[broker][arm][w]
+                row[arm] = {
+                    "commands_per_sec_median": med(
+                        [s["commands_per_sec"] for s in samples]),
+                    "p50_ms_median": med([s["p50_ms"] for s in samples]),
+                    "p99_ms_median": med([s["p99_ms"] for s in samples]),
+                    "rounds": [s["commands_per_sec"] for s in samples],
+                }
+            base = row["classic"]["commands_per_sec_median"]
+            row["speedup_median"] = round(
+                row["direct"]["commands_per_sec_median"] / max(base, 1), 3)
+            rows.append(row)
+            log(f"{broker} {w}w medians: direct "
+                f"{row['direct']['commands_per_sec_median']} vs classic "
+                f"{base} cmd/s -> {row['speedup_median']}x")
+        out["ladders"][broker] = rows
+    return out
+
+
+def resident_feed_paired() -> dict:
+    """PAIRED interleaved resident sustained-fold arms (ISSUE 12): the
+    native feed (batched JSON decode over native record-index read views)
+    vs the per-event Python feed, against the SAME pre-committed FileLog
+    tail — the refresh loop refolds it from a 0-anchor per arm, so both
+    arms fold identical bytes. Medians over >=3 rounds.
+
+    Knobs: SURGE_BENCH_FEED_EVENTS (40000), _AGGREGATES (2048),
+    _ROUNDS (3), _PARTITIONS (4), _MAX_POLL (8192)."""
+    import asyncio
+    import statistics as _st
+
+    from surge_tpu.config import default_config
+    from surge_tpu.log import LogRecord, TopicSpec
+    from surge_tpu.log import native_gate
+    from surge_tpu.log.file import FileLog
+    from surge_tpu.models import counter
+    from surge_tpu.replay.resident_state import ResidentStatePlane
+    from surge_tpu.serialization import SerializedMessage
+
+    import shutil
+    import tempfile
+
+    fold_events = int(os.environ.get("SURGE_BENCH_FEED_EVENTS", 40_000))
+    n_agg = int(os.environ.get("SURGE_BENCH_FEED_AGGREGATES", 2048))
+    rounds = max(int(os.environ.get("SURGE_BENCH_FEED_ROUNDS", 3)), 1)
+    nparts = int(os.environ.get("SURGE_BENCH_FEED_PARTITIONS", 4))
+    max_poll = int(os.environ.get("SURGE_BENCH_FEED_MAX_POLL", 8192))
+    evt_fmt = counter.event_formatting()
+    aggs = [f"agg-{i}" for i in range(n_agg)]
+
+    root = tempfile.mkdtemp(prefix="surge-bench-feed-")
+    flog = FileLog(os.path.join(root, "log"), config=default_config())
+    flog.create_topic(TopicSpec("events", nparts))
+    prod = flog.transactional_producer("feed-bench")
+    seqs = {a: 0 for a in aggs}
+    prod.begin()
+    for i in range(fold_events):
+        a = aggs[(i * 7919) % n_agg]
+        seqs[a] += 1
+        prod.send(LogRecord(
+            topic="events", key=a,
+            value=evt_fmt.write_event(
+                counter.CountIncremented(a, 1, seqs[a])).value,
+            partition=hash(a) % nparts))
+        if i % 5000 == 4999:
+            prod.commit()
+            prod.begin()
+    prod.commit()
+
+    def one_arm(native_feed: bool) -> float:
+        native_gate.set_decode_enabled(native_feed)
+
+        async def scenario() -> float:
+            cfg = default_config().with_overrides({
+                "surge.replay.resident.capacity": max(n_agg, 8),
+                "surge.replay.resident.refresh-interval-ms": 10,
+                "surge.replay.resident.refresh-max-poll-records": max_poll,
+                "surge.replay.resident.native-feed": native_feed,
+            })
+            plane = ResidentStatePlane(
+                flog, "events", counter.make_replay_spec(), config=cfg,
+                partitions=[],  # no seed; the refresh loop refolds from 0
+                deserialize_event=lambda b: evt_fmt.read_event(
+                    SerializedMessage(key="", value=b)),
+                deserialize_events=evt_fmt.read_events_batch,
+                serialize_state=lambda a, s: b"")
+            await plane.start()
+            t0 = time.perf_counter()
+            plane.set_partitions(list(range(nparts)))
+            while plane.lag_records() > 0:
+                await asyncio.sleep(0.005)
+            rate = plane.stats["folded_events"] / (time.perf_counter() - t0)
+            await plane.stop()
+            return rate
+
+        try:
+            return asyncio.run(scenario())
+        finally:
+            native_gate.set_decode_enabled(None)
+
+    raw = {"native_feed": [], "python_feed": []}
+    try:
+        one_arm(True)  # warmup: compile the fold programs outside the rounds
+        for rnd in range(rounds):
+            order = (("native_feed", True), ("python_feed", False))
+            if rnd % 2:
+                order = order[::-1]
+            for name, enabled in order:
+                rate = one_arm(enabled)
+                raw[name].append(round(rate))
+                log(f"feed round {rnd + 1}/{rounds} {name}: "
+                    f"{rate:,.0f} ev/s sustained")
+    finally:
+        flog.close()
+        shutil.rmtree(root, ignore_errors=True)
+    nat = _st.median(raw["native_feed"])
+    pyf = _st.median(raw["python_feed"])
+    return {"protocol": {"rounds": rounds, "fold_events": fold_events,
+                        "aggregates": n_agg, "partitions": nparts,
+                        "max_poll": max_poll, "interleaved": True,
+                        "medians": True,
+                        "native_available": native_gate.available()},
+            "native_feed_events_per_sec_median": round(nat),
+            "python_feed_events_per_sec_median": round(pyf),
+            "speedup_median": round(nat / max(pyf, 1), 3),
+            "rounds": raw}
+
+
 def failover_bench() -> dict:
     """SURGE_BENCH_FAILOVER=1: kill the replicated log leader under load and
     measure the unavailability window while PROVING zero-loss/zero-duplicate
@@ -1518,10 +1684,39 @@ def main() -> None:
         emit(payload)
         return
 
+    # SURGE_BENCH_RESIDENT_FEED=1: paired resident sustained-fold arms —
+    # native feed vs per-event Python feed over the same FileLog tail
+    if os.environ.get("SURGE_BENCH_RESIDENT_FEED", "0") == "1":
+        payload = {"metric": "resident_feed_events_per_sec", "value": 0,
+                   "unit": "events/s"}
+        stats = resident_feed_paired()
+        payload["resident_feed_paired"] = stats
+        payload["value"] = stats["native_feed_events_per_sec_median"]
+        emit(payload)
+        return
+
     if os.environ.get("SURGE_BENCH_LADDER", "0") == "1":
         payload = {"metric": "commands_per_sec", "value": 0,
                    "unit": "commands/s"}
         secs = latency_seconds if latency_seconds > 0 else 5.0
+        # SURGE_BENCH_LANE=1 (the r08 protocol): paired interleaved
+        # direct-lane vs classic-lane medians, inproc AND grpc rungs
+        if os.environ.get("SURGE_BENCH_LANE", "0") == "1":
+            rounds = int(os.environ.get("SURGE_BENCH_LANE_ROUNDS", 3))
+            rungs = [int(t) for t in os.environ.get(
+                "SURGE_BENCH_LATENCY_LADDER", "").split(",")
+                if t.strip().isdigit()] or [64, 1024]
+            brokers = [b.strip() for b in os.environ.get(
+                "SURGE_BENCH_LANE_BROKERS", "inproc,grpc").split(",")
+                if b.strip()]
+            paired = lane_paired_ladder(secs, rounds=rounds, rungs=rungs,
+                                        brokers=brokers)
+            payload["lane_paired_ladder"] = paired
+            payload["value"] = max(
+                r["direct"]["commands_per_sec_median"]
+                for rows in paired["ladders"].values() for r in rows)
+            emit(payload)
+            return
         # SURGE_BENCH_NATIVE=1 (the r07 protocol): paired interleaved
         # native-on vs native-off medians at the 64 + 1024 rungs
         if os.environ.get("SURGE_BENCH_NATIVE", "0") == "1":

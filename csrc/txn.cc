@@ -108,6 +108,11 @@ struct Rec {
   std::vector<std::pair<std::pair<const uint8_t*, size_t>,
                         std::pair<const uint8_t*, size_t>>> headers;
   int32_t group = -1;
+  // verbatim (replica-ingest) batches carry leader-assigned positions; the
+  // assign path leaves these untouched and stamps offsets/timestamp at
+  // format time instead
+  int64_t offset = -1;
+  double ts = 0.0;
 };
 
 struct GroupOut {
@@ -122,6 +127,7 @@ struct Batch {
   std::vector<Rec> recs;
   std::vector<std::string> group_topics;
   std::vector<int32_t> group_parts;
+  std::vector<int64_t> group_bases;  // verbatim: leader-assigned run base
   std::vector<std::vector<uint32_t>> group_members;  // arrival order per group
   uint64_t token = 0;
   uint64_t seq = 0;
@@ -548,6 +554,67 @@ void* surge_txn_parse_packed(const int64_t* meta, size_t meta_len,
   return b;
 }
 
+// Parse a packed VERBATIM batch (replica ingest: leader-assigned offsets and
+// timestamps preserved). meta rows as surge_txn_parse_packed; offsets/ts are
+// per-record arrays in meta order. Records group into CONTIGUOUS-OFFSET RUNS
+// per (topic, partition) — one segment block per run, because a block's
+// decode assigns base+i and must never span an offset hole (the exact
+// grouping of file.py _append_locked_py's verbatim path).
+void* surge_txn_parse_packed_v(const int64_t* meta, size_t meta_len,
+                               const uint8_t* blob, size_t blob_len,
+                               const uint8_t* topics,
+                               const int64_t* topic_lens, size_t ntopics,
+                               const int64_t* offsets, const double* ts) {
+  Batch* b = static_cast<Batch*>(surge_txn_parse_packed(
+      meta, meta_len, blob, blob_len, topics, topic_lens, ntopics));
+  if (!b) return nullptr;
+  // re-group into contiguous-offset runs, ordered EXACTLY like the Python
+  // verbatim path: (topic, partition) buckets in first-occurrence order,
+  // each bucket's runs in record order (a run splits wherever the offset
+  // chain breaks). Record storage order is untouched (offsets[i]/ts[i]
+  // stay aligned with arrival order).
+  for (size_t i = 0; i < b->recs.size(); ++i) {
+    b->recs[i].offset = offsets[i];
+    b->recs[i].ts = ts[i];
+  }
+  // the base parse already bucketed by (topic, partition) in
+  // first-occurrence order with per-bucket members in record order — split
+  // each bucket into runs
+  std::vector<std::string> topics_of = std::move(b->group_topics);
+  std::vector<int32_t> parts_of = std::move(b->group_parts);
+  std::vector<std::vector<uint32_t>> buckets = std::move(b->group_members);
+  b->group_topics.clear();
+  b->group_parts.clear();
+  b->group_bases.clear();
+  b->group_members.clear();
+  b->rec_groups.assign(b->recs.size(), -1);
+  for (size_t t = 0; t < buckets.size(); ++t) {
+    int32_t g = -1;
+    int64_t next = 0;
+    for (uint32_t ri : buckets[t]) {
+      Rec& rec = b->recs[ri];
+      if (g < 0 || rec.offset != next) {
+        g = static_cast<int32_t>(b->group_topics.size());
+        b->group_topics.push_back(topics_of[t]);
+        b->group_parts.push_back(parts_of[t]);
+        b->group_bases.push_back(rec.offset);
+        b->group_members.emplace_back();
+      }
+      next = rec.offset + 1;
+      rec.group = g;
+      b->group_members[static_cast<size_t>(g)].push_back(ri);
+      b->rec_groups[ri] = g;
+    }
+  }
+  return b;
+}
+
+int64_t surge_txn_group_base(void* h, int64_t g) {
+  Batch* b = static_cast<Batch*>(h);
+  if (g < 0 || static_cast<size_t>(g) >= b->group_bases.size()) return -1;
+  return b->group_bases[static_cast<size_t>(g)];
+}
+
 void surge_txn_free(void* h) { delete static_cast<Batch*>(h); }
 
 int64_t surge_txn_nrecords(void* h) {
@@ -589,9 +656,9 @@ const int32_t* surge_txn_rec_groups(void* h, size_t* n) {
 // with blocks <= embed_max riding the line base64-embedded (the WAL fast
 // path). bases/pos0 are per group (the caller reads them under the log lock).
 // Returns 0 on success.
-int32_t surge_txn_format(void* h, const int64_t* bases, const int64_t* pos0,
-                         double timestamp, int64_t embed_max) {
-  Batch* b = static_cast<Batch*>(h);
+static int32_t format_impl(Batch* b, const int64_t* bases,
+                           const int64_t* pos0, double timestamp,
+                           bool per_rec_ts, int64_t embed_max) {
   const size_t ngroups = b->group_topics.size();
   b->blocks.clear();
   b->gout.assign(ngroups, GroupOut());
@@ -600,12 +667,17 @@ int32_t surge_txn_format(void* h, const int64_t* bases, const int64_t* pos0,
   std::string parts_json = "{\"parts\": [";
   std::string blk_json = "\"blk\": [";
   std::vector<uint8_t> comp;
+  // verbatim batches can hold SEVERAL runs of one (topic, partition): each
+  // later run's file position chains off the previous run's new_pos, like
+  // the Python path's sequential `pos = new_pos` walk
+  std::map<std::pair<std::string, int32_t>, int64_t> tp_pos;
   for (size_t g = 0; g < ngroups; ++g) {
     const auto& members = b->group_members[g];
     payload.clear();
     for (size_t i = 0; i < members.size(); ++i) {
       b->offsets[members[i]] = bases[g] + static_cast<int64_t>(i);
-      frame_record(payload, b->recs[members[i]], timestamp);
+      const Rec& r = b->recs[members[i]];
+      frame_record(payload, r, per_rec_ts ? r.ts : timestamp);
     }
     // compression decision identical to segment.slz_compress: use the
     // compressed form only when it is strictly smaller
@@ -631,7 +703,14 @@ int32_t surge_txn_format(void* h, const int64_t* bases, const int64_t* pos0,
                      static_cast<uint32_t>(stored_n), crc);
     b->blocks.append(reinterpret_cast<const char*>(stored), stored_n);
     out.block_len = static_cast<int64_t>(b->blocks.size()) - out.block_off;
-    out.new_pos = pos0[g] + out.block_len;
+    int64_t p0 = pos0[g];
+    if (per_rec_ts) {
+      auto key = std::make_pair(b->group_topics[g], b->group_parts[g]);
+      auto it = tp_pos.find(key);
+      if (it != tp_pos.end()) p0 = it->second;
+      tp_pos[key] = p0 + out.block_len;
+    }
+    out.new_pos = p0 + out.block_len;
     out.embedded = out.block_len <= embed_max ? 1 : 0;
     if (g) {
       parts_json += ", ";
@@ -666,6 +745,23 @@ int32_t surge_txn_format(void* h, const int64_t* bases, const int64_t* pos0,
   b->line += blk_json;
   b->line += "]}\n";
   return 0;
+}
+
+int32_t surge_txn_format(void* h, const int64_t* bases, const int64_t* pos0,
+                         double timestamp, int64_t embed_max) {
+  return format_impl(static_cast<Batch*>(h), bases, pos0, timestamp,
+                    /*per_rec_ts=*/false, embed_max);
+}
+
+// Verbatim twin of surge_txn_format for replica ingest: block bases come
+// from the leader-assigned run bases captured at parse, and every record
+// frames with ITS OWN timestamp — a replica's segment files converge
+// byte-identically with the leader's (file.py _append_locked_py verbatim).
+int32_t surge_txn_format_verbatim(void* h, const int64_t* pos0,
+                                  int64_t embed_max) {
+  Batch* b = static_cast<Batch*>(h);
+  return format_impl(b, b->group_bases.data(), pos0, 0.0,
+                    /*per_rec_ts=*/true, embed_max);
 }
 
 const uint8_t* surge_txn_line(void* h, size_t* n) {
@@ -799,6 +895,281 @@ int64_t surge_seg_index(const uint8_t* payload, size_t n, int64_t count,
     pos += 8;
   }
   return static_cast<int64_t>(pos);
+}
+
+// -- reply legs: packed record-view materializer + wire reply formatter ------
+//
+// The read/reply hot path used to build one frozen-dataclass LogRecord (or
+// one protobuf RecordMsg) per record in Python — ~2.8 us each. These two
+// calls move the per-record work native: surge_reply_index walks a
+// serialized reply's repeated RecordMsg field and emits fixed-width index
+// rows (Python wraps them in lazy decode-on-access views over the reply
+// bytes); surge_reply_format emits the serialized repeated-RecordMsg bytes
+// for a packed record batch in one call (the server's Read reply rides it
+// verbatim through a passthrough gRPC serializer).
+
+// Count the top-level length-delimited occurrences of `field` in a
+// serialized message (the sizing pass for surge_reply_index). -1 on
+// malformed input.
+int64_t surge_reply_count(const uint8_t* data, size_t n, int32_t field) {
+  Cursor c{data, data + n};
+  int64_t count = 0;
+  while (c.p < c.end && c.ok) {
+    uint64_t tag = get_varint(c);
+    if (!c.ok) return -1;
+    uint32_t f = static_cast<uint32_t>(tag >> 3);
+    uint32_t wt = static_cast<uint32_t>(tag & 7);
+    if (f == static_cast<uint32_t>(field) && wt == 2) {
+      const uint8_t* d;
+      size_t dn;
+      if (!get_len(c, &d, &dn)) return -1;
+      ++count;
+    } else {
+      skip_field(c, wt);
+      if (!c.ok) return -1;
+    }
+  }
+  return count;
+}
+
+// Index every RecordMsg in the top-level repeated `field` of a serialized
+// reply. 12 int64s per row:
+//   [flags, topic_off, topic_len, key_off, key_len, val_off, val_len,
+//    partition, offset, hdr_cnt, msg_off, msg_len]
+// flags bit0 = has_key, bit1 = tombstone (has_value false). Offsets are into
+// the reply bytes; Python's lazy views slice on access (headers re-walk
+// [msg_off, msg_off+msg_len) only when touched — hdr_cnt tells them whether
+// to bother). Returns rows written, or -1 on malformed/overflowing input.
+int64_t surge_reply_index(const uint8_t* data, size_t n, int32_t field,
+                          int64_t* rows, size_t max_rows, double* out_ts) {
+  Cursor c{data, data + n};
+  int64_t count = 0;
+  while (c.p < c.end && c.ok) {
+    uint64_t tag = get_varint(c);
+    if (!c.ok) return -1;
+    uint32_t f = static_cast<uint32_t>(tag >> 3);
+    uint32_t wt = static_cast<uint32_t>(tag & 7);
+    if (f != static_cast<uint32_t>(field) || wt != 2) {
+      skip_field(c, wt);
+      if (!c.ok) return -1;
+      continue;
+    }
+    const uint8_t* msg;
+    size_t msg_n;
+    if (!get_len(c, &msg, &msg_n)) return -1;
+    if (static_cast<size_t>(count) >= max_rows) return -1;
+    int64_t* row = rows + count * 12;
+    for (int k = 0; k < 12; ++k) row[k] = 0;
+    row[10] = static_cast<int64_t>(msg - data);
+    row[11] = static_cast<int64_t>(msg_n);
+    out_ts[count] = 0.0;
+    Cursor mc{msg, msg + msg_n};
+    bool has_value = false;
+    while (mc.p < mc.end && mc.ok) {
+      uint64_t mtag = get_varint(mc);
+      if (!mc.ok) return -1;
+      uint32_t mf = static_cast<uint32_t>(mtag >> 3);
+      uint32_t mwt = static_cast<uint32_t>(mtag & 7);
+      const uint8_t* d;
+      size_t dn;
+      switch (mf) {
+        case 1:  // topic
+          if (mwt != 2 || !get_len(mc, &d, &dn)) return -1;
+          row[1] = static_cast<int64_t>(d - data);
+          row[2] = static_cast<int64_t>(dn);
+          break;
+        case 2:  // has_key
+          if (mwt != 0) return -1;
+          if (get_varint(mc)) row[0] |= 1;
+          break;
+        case 3:  // key
+          if (mwt != 2 || !get_len(mc, &d, &dn)) return -1;
+          row[3] = static_cast<int64_t>(d - data);
+          row[4] = static_cast<int64_t>(dn);
+          break;
+        case 4:  // has_value
+          if (mwt != 0) return -1;
+          has_value = get_varint(mc) != 0;
+          break;
+        case 5:  // value
+          if (mwt != 2 || !get_len(mc, &d, &dn)) return -1;
+          row[5] = static_cast<int64_t>(d - data);
+          row[6] = static_cast<int64_t>(dn);
+          break;
+        case 6:  // partition
+          if (mwt != 0) return -1;
+          row[7] = static_cast<int64_t>(get_varint(mc));
+          break;
+        case 7:  // headers map entry (counted; decoded lazily in Python)
+          if (mwt != 2 || !get_len(mc, &d, &dn)) return -1;
+          row[9] += 1;
+          break;
+        case 8:  // offset
+          if (mwt != 0) return -1;
+          row[8] = static_cast<int64_t>(get_varint(mc));
+          break;
+        case 9: {  // timestamp (double, wire type 1)
+          if (mwt != 1 || mc.p + 8 > mc.end) return -1;
+          std::memcpy(out_ts + count, mc.p, 8);
+          mc.p += 8;
+          break;
+        }
+        default:
+          skip_field(mc, mwt);
+          if (!mc.ok) return -1;
+      }
+    }
+    if (!mc.ok) return -1;
+    if (!has_value) row[0] |= 2;
+    ++count;
+  }
+  return count;
+}
+
+namespace {
+
+void put_tag(std::string& out, uint32_t field, uint32_t wt) {
+  put_uvarint(out, (static_cast<uint64_t>(field) << 3) | wt);
+}
+
+void put_len_field(std::string& out, uint32_t field, const uint8_t* d,
+                   size_t n) {
+  put_tag(out, field, 2);
+  put_uvarint(out, n);
+  out.append(reinterpret_cast<const char*>(d), n);
+}
+
+}  // namespace
+
+// Serialize a packed record batch as the repeated RecordMsg `field` of a
+// reply message, proto3-canonically: fields in number order, defaults
+// skipped, headers as map entries in SORTED key order (protobuf map wire
+// order is backend-dependent; one canonical order is what lets the property
+// test compare bytes against the pure-Python twin). meta rows per record:
+//   [topic_idx, partition, flags, klen, vlen, nh, offset, (hklen, hvlen)*nh]
+// flags/blob/topics as surge_txn_parse_packed; ts per record. Returns bytes
+// written into out (capacity out_cap), or -1 (malformed meta / overflow —
+// callers fall back to the Python path).
+int64_t surge_reply_format(const int64_t* meta, size_t meta_len,
+                           const uint8_t* blob, size_t blob_len,
+                           const uint8_t* topics, const int64_t* topic_lens,
+                           size_t ntopics, const double* ts, int32_t field,
+                           uint8_t* out, size_t out_cap) {
+  std::vector<std::pair<const uint8_t*, size_t>> topic_ptrs(ntopics);
+  {
+    size_t off = 0;
+    for (size_t i = 0; i < ntopics; ++i) {
+      topic_ptrs[i] = {topics + off, static_cast<size_t>(topic_lens[i])};
+      off += static_cast<size_t>(topic_lens[i]);
+    }
+  }
+  std::string msg;
+  std::string body;
+  size_t mi = 0;
+  size_t bo = 0;
+  size_t written = 0;
+  size_t rec_i = 0;
+  std::vector<std::pair<std::pair<const uint8_t*, size_t>,
+                        std::pair<const uint8_t*, size_t>>> hdrs;
+  while (mi < meta_len) {
+    if (mi + 7 > meta_len) return -1;
+    int64_t topic_idx = meta[mi];
+    int64_t partition = meta[mi + 1];
+    int64_t flags = meta[mi + 2];
+    int64_t klen = meta[mi + 3];
+    int64_t vlen = meta[mi + 4];
+    int64_t nh = meta[mi + 5];
+    int64_t offset = meta[mi + 6];
+    mi += 7;
+    if (topic_idx < 0 || static_cast<size_t>(topic_idx) >= ntopics
+        || klen < 0 || vlen < 0 || nh < 0
+        || mi + 2 * static_cast<size_t>(nh) > meta_len) return -1;
+    msg.clear();
+    if (topic_ptrs[static_cast<size_t>(topic_idx)].second) {
+      put_len_field(msg, 1, topic_ptrs[static_cast<size_t>(topic_idx)].first,
+                    topic_ptrs[static_cast<size_t>(topic_idx)].second);
+    }
+    const bool has_key = (flags & 1) != 0;
+    const bool tombstone = (flags & 2) != 0;
+    const uint8_t* key = nullptr;
+    const uint8_t* value = nullptr;
+    if (has_key) {
+      if (bo + static_cast<size_t>(klen) > blob_len) return -1;
+      key = blob + bo;
+      bo += static_cast<size_t>(klen);
+      put_tag(msg, 2, 0);
+      msg.push_back(1);
+      if (klen) put_len_field(msg, 3, key, static_cast<size_t>(klen));
+    }
+    if (!tombstone) {
+      if (bo + static_cast<size_t>(vlen) > blob_len) return -1;
+      value = blob + bo;
+      bo += static_cast<size_t>(vlen);
+      put_tag(msg, 4, 0);
+      msg.push_back(1);
+      if (vlen) put_len_field(msg, 5, value, static_cast<size_t>(vlen));
+    }
+    if (partition) {
+      put_tag(msg, 6, 0);
+      put_uvarint(msg, static_cast<uint64_t>(partition));
+    }
+    if (nh) {
+      hdrs.clear();
+      for (int64_t hkx = 0; hkx < nh; ++hkx) {
+        int64_t hk = meta[mi];
+        int64_t hv = meta[mi + 1];
+        mi += 2;
+        if (hk < 0 || hv < 0
+            || bo + static_cast<size_t>(hk + hv) > blob_len) return -1;
+        const uint8_t* kp = blob + bo;
+        bo += static_cast<size_t>(hk);
+        const uint8_t* vp = blob + bo;
+        bo += static_cast<size_t>(hv);
+        hdrs.push_back({{kp, static_cast<size_t>(hk)},
+                        {vp, static_cast<size_t>(hv)}});
+      }
+      std::sort(hdrs.begin(), hdrs.end(), [](const auto& a, const auto& b) {
+        int c = std::memcmp(a.first.first, b.first.first,
+                            std::min(a.first.second, b.first.second));
+        if (c != 0) return c < 0;
+        return a.first.second < b.first.second;
+      });
+      for (const auto& hkv : hdrs) {
+        body.clear();
+        if (hkv.first.second)
+          put_len_field(body, 1, hkv.first.first, hkv.first.second);
+        if (hkv.second.second)
+          put_len_field(body, 2, hkv.second.first, hkv.second.second);
+        put_tag(msg, 7, 2);
+        put_uvarint(msg, body.size());
+        msg += body;
+      }
+    }
+    if (offset) {
+      put_tag(msg, 8, 0);
+      put_uvarint(msg, static_cast<uint64_t>(offset));
+    }
+    uint64_t ts_bits;
+    std::memcpy(&ts_bits, ts + rec_i, 8);
+    if (ts_bits) {
+      put_tag(msg, 9, 1);
+      char tmp[8];
+      std::memcpy(tmp, ts + rec_i, 8);
+      msg.append(tmp, 8);
+    }
+    ++rec_i;
+    // frame: tag(field, len-delimited) + len + msg
+    std::string hdr;
+    put_tag(hdr, static_cast<uint32_t>(field), 2);
+    put_uvarint(hdr, msg.size());
+    if (written + hdr.size() + msg.size() > out_cap) return -1;
+    std::memcpy(out + written, hdr.data(), hdr.size());
+    written += hdr.size();
+    std::memcpy(out + written, msg.data(), msg.size());
+    written += msg.size();
+  }
+  if (bo != blob_len) return -1;
+  return static_cast<int64_t>(written);
 }
 
 }  // extern "C"

@@ -1,6 +1,7 @@
 """surgelint rule modules — importing this package populates the registry."""
 
 from surge_tpu.analysis.rules import concurrency  # noqa: F401
+from surge_tpu.analysis.rules import hotpath  # noqa: F401
 from surge_tpu.analysis.rules import jit  # noqa: F401
 from surge_tpu.analysis.rules import proto  # noqa: F401
 from surge_tpu.analysis.rules import registries  # noqa: F401

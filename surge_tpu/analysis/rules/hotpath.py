@@ -1,0 +1,107 @@
+"""Hot-path asyncio rule — per-item event-loop round-trips on fast paths.
+
+Encodes what ISSUE 12's descent removed from the engine command lane: the
+per-command ``asyncio.wait_for`` wrapper task (replaced by the bare timer
+wait :func:`surge_tpu.common.wait_future`), per-record awaits inside loops,
+and per-call ``asyncio.Future`` construction in per-record loops. Modules
+opt in by carrying a ``surgelint: fast-path-module`` marker comment — the
+rule is about paths where "one more loop hop per command" is a measured
+regression (BENCH_NOTES rounds 6/9), not about background loops, so it
+stays opt-in.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from surge_tpu.analysis.core import Finding, ModuleContext, Rule, register
+
+#: module opt-in marker (a comment anywhere in the file)
+MARKER = "surgelint: fast-path-module"
+
+#: loop iterables that are NOT per-item data walks (bounded retry ladders)
+_EXEMPT_ITER_CALLS = {"range", "enumerate"}
+
+
+def _per_item_loop(node: ast.AST) -> bool:
+    """A ``for`` over data (not a bounded ``range()`` retry ladder)."""
+    if not isinstance(node, (ast.For, ast.AsyncFor)):
+        return False
+    it = node.iter
+    if isinstance(it, ast.Call):
+        fn = it.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        if name in _EXEMPT_ITER_CALLS:
+            return False
+    return True
+
+
+@register
+class HotPathAsyncio(Rule):
+    """Per-item event-loop round-trips in a fast-path-annotated module.
+
+    History: PR 10's paired ladder showed the inproc rungs (1.02–1.04×)
+    were capped by the per-command Python AROUND the native core — the
+    publisher/asyncio machinery. ISSUE 12 removed exactly these shapes:
+
+    - ``asyncio.wait_for(...)`` — a wrapper task + waiter future per call;
+      use ``common.wait_future`` (bare futures) or
+      ``common.cancel_safe_wait_for`` (coroutines) instead;
+    - ``await`` inside a per-record ``for`` loop — one loop hop per item
+      where one batched await would do;
+    - ``asyncio.Future()`` / ``loop.create_future()`` inside a per-record
+      loop — per-call future machinery where a batch-level future would do
+      (the publisher's direct lane shares ONE ack per group commit).
+
+    Opt-in via a ``surgelint: fast-path-module`` comment; slow paths inside
+    such a module suppress per line with a justified pragma.
+    """
+
+    id = "hot-path-asyncio"
+    summary = ("per-item event-loop round-trip (wait_for / await-in-loop / "
+               "per-call Future) in a fast-path module")
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if MARKER not in ctx.source:
+            return
+        for fn in ctx.async_functions():
+            yield from self._scan(ctx, fn, in_loop=False)
+        # asyncio.wait_for is a finding even outside async defs (a sync
+        # helper handing back the coroutine still builds the wrapper task)
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and ctx.dotted(node.func) == "asyncio.wait_for"):
+                yield self.finding(
+                    ctx, node,
+                    "asyncio.wait_for builds a wrapper task + waiter per "
+                    "call — use common.wait_future (bare futures) or "
+                    "common.cancel_safe_wait_for (coroutines) on this "
+                    "fast path")
+
+    def _scan(self, ctx: ModuleContext, node: ast.AST,
+              in_loop: bool) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue  # separate execution context
+            now_in = in_loop or _per_item_loop(child)
+            if in_loop or now_in:
+                if isinstance(child, ast.Await) and now_in:
+                    yield self.finding(
+                        ctx, child,
+                        "await inside a per-item loop: one event-loop hop "
+                        "per record — batch the await (one per group) or "
+                        "move the loop off the fast path")
+                    continue
+                if isinstance(child, ast.Call) and now_in:
+                    name = ctx.dotted(child.func) or ""
+                    if (name == "asyncio.Future"
+                            or name.endswith(".create_future")):
+                        yield self.finding(
+                            ctx, child,
+                            "per-item asyncio.Future construction: use a "
+                            "batch-level future resolved once per group "
+                            "(the direct command lane's shared ack shape)")
+            yield from self._scan(ctx, child, now_in)

@@ -188,6 +188,72 @@ async def cancel_safe_wait_for(awaitable, timeout: float):
     raise asyncio.TimeoutError
 
 
+async def wait_future(fut: "asyncio.Future", timeout: float,
+                      owned: bool = True):
+    """Await a BARE future with a timeout — the per-command de-asyncio'd
+    twin of :func:`cancel_safe_wait_for` for plain futures. One
+    ``call_later`` handle instead of a wrapper task + ``asyncio.wait``'s
+    waiter/callback machinery; at engine throughput that difference is paid
+    once per command (BENCH_NOTES round 9).
+
+    ``owned=True`` (an exclusively-held future, e.g. an ask reply): the
+    timeout CANCELS the future — exactly ``wait_for``'s contract, so a
+    producer resolving late finds it cancelled and no-ops. An OUTER task
+    cancellation also lands on the future (the task cancels what it awaits),
+    and is re-raised — never swallowed, never misread as a timeout.
+
+    ``owned=False`` (a SHARED future, e.g. the publisher direct lane's
+    per-batch ack): the timeout must not cancel what other waiters ride, so
+    this waiter parks on its own future instead and leaves the shared one
+    untouched on timeout AND on outer cancellation.
+    """
+    if fut.done():
+        if not owned and fut.cancelled():
+            # same contract as the shared branch below: a co-holder's
+            # cancellation surfaces retryable, never CancelledError
+            raise RuntimeError("shared future was cancelled by another holder")
+        return fut.result()
+    loop = asyncio.get_running_loop()
+    if owned:
+        timed_out = False
+
+        def _on_timeout() -> None:
+            nonlocal timed_out
+            timed_out = True
+            fut.cancel()
+
+        handle = loop.call_later(timeout, _on_timeout)
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            if timed_out and fut.cancelled():
+                raise asyncio.TimeoutError from None
+            raise
+        finally:
+            handle.cancel()
+    waiter: "asyncio.Future" = loop.create_future()
+
+    def _done(f: "asyncio.Future") -> None:
+        resolve_future(waiter, f)
+
+    fut.add_done_callback(_done)
+    handle = loop.call_later(timeout, resolve_future, waiter, None)
+    try:
+        inner = await waiter
+    finally:
+        handle.cancel()
+        fut.remove_done_callback(_done)
+    if inner is None:
+        raise asyncio.TimeoutError
+    if inner.cancelled():
+        # ANOTHER holder cancelled the shared future. This waiter did not:
+        # surface a plain retryable failure, not CancelledError — a
+        # BaseException here would blow through the caller's retry ladder
+        # and kill a command whose write may well still commit.
+        raise RuntimeError("shared future was cancelled by another holder")
+    return inner.result()
+
+
 def spawn_reaped(registry: set, coro: Coroutine[Any, Any, Any],
                  what: str) -> "asyncio.Task":
     """Spawn a fire-and-forget coroutine WITHOUT orphaning it: the task is

@@ -175,6 +175,12 @@ DEFAULTS: dict[str, Any] = {
     # an idle round waits on wait_for_append before re-polling
     "surge.replay.resident.refresh-max-poll-records": 4096,
     "surge.replay.resident.refresh-interval-ms": 50,
+    # refresh feed fast path (ISSUE 12): decode each round's committed tail
+    # with ONE batch deserialize (e.g. JsonEventFormatting.read_events_batch)
+    # over the native record-index read views, instead of a json.loads +
+    # object build per event. false = the per-event Python feed (the paired
+    # bench arm; also the behavior when the model wires no batch decoder)
+    "surge.replay.resident.native-feed": True,
     # --- state checkpoints (surge_tpu.store.checkpoint; compaction.md) ---
     # directory for atomic checkpoint files ("" disables the writer); the
     # incremental writer materializes on interval + min-events cadence and
@@ -250,6 +256,16 @@ DEFAULTS: dict[str, Any] = {
     # exceed this: segments are fsynced first, then a frontier line opens the
     # fresh journal and os.replace GCs the old generation. 0 disables.
     "surge.log.journal-rotate-bytes": 64 << 20,
+    # --- engine command lane (ISSUE 12: the de-asyncio'd fast path) ---
+    # "direct": entity -> publisher handoff without per-command event-loop
+    # machinery — pendings of one forming batch share a single BATCH-LEVEL
+    # ack future (resolved once per group commit), a timed-out caller's
+    # records stay queued and a same-request_id retry JOINS them (the
+    # request-id dedup keeps exactly-once), and entities await publishes
+    # through a bare timer wait instead of a wrapper task. "classic": the
+    # PR-3 per-command future + cancel-withdraw machinery (the paired bench
+    # arm, and the fallback if a workload depends on withdraw-on-timeout).
+    "surge.producer.command-lane": "direct",
     # --- native broker hot path (csrc/txn.cc via log/native_gate) ---
     # operator kill-switch for the C++ batch path: Transact payload decode,
     # the in-order/dedup gate kernel, WAL journal formatting, the per-round

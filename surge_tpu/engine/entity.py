@@ -27,6 +27,8 @@ Error surface mirrors ACKSuccess/ACKError/ACKRejection (:33-64): domain rejectio
 
 from __future__ import annotations
 
+# surgelint: fast-path-module — the per-command entity FSM (ISSUE 12)
+
 import asyncio
 import inspect
 import uuid
@@ -34,7 +36,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, List, Optional, Sequence
 
 from surge_tpu.common import (DecodedState, cancel_safe_wait_for, fail_future,
-                              logger, resolve_future)
+                              logger, resolve_future, wait_future)
 from surge_tpu.config import Config, RetryConfig, TimeoutConfig, default_config
 from surge_tpu.engine.business_logic import SurgeModel
 from surge_tpu.engine.model import RejectedCommand
@@ -403,11 +405,23 @@ class AggregateEntity:
             for _ in range(self.retry.publish_max_retries + 1):
                 try:
                     with self.metrics.publish_timer.time():
-                        await cancel_safe_wait_for(
-                            self.publisher.publish(self.aggregate_id, records,
-                                                   request_id,
-                                                   headers=env.headers),
-                            timeout=self.timeouts.publish_timeout_s)
+                        aw = self.publisher.publish(self.aggregate_id,
+                                                    records, request_id,
+                                                    headers=env.headers)
+                        if isinstance(aw, asyncio.Future):
+                            # bare ack future (the publish fast path): a
+                            # slim timer wait, no wrapper task. A shared
+                            # batch-level ack (direct lane) must never be
+                            # cancelled by THIS caller's timeout — the
+                            # records stay queued and the retry below joins
+                            # them by request id.
+                            await wait_future(
+                                aw, self.timeouts.publish_timeout_s,
+                                owned=not getattr(self.publisher,
+                                                  "shared_acks", False))
+                        else:
+                            await cancel_safe_wait_for(
+                                aw, timeout=self.timeouts.publish_timeout_s)
                     self.state = new_state
                     resolve_future(env.reply, CommandSuccess(new_state))
                     return

@@ -198,10 +198,16 @@ class SurgeEngine(Controllable):
             if spec is not None:
                 from surge_tpu.replay.resident_state import ResidentStatePlane
 
+                # the refresh feed's batch decoder (one C-level parse per
+                # round) when the event format offers one; None keeps the
+                # per-event path
+                batch_read = getattr(logic.event_format,
+                                     "read_events_batch", None)
                 self.resident_plane = ResidentStatePlane(
                     self.log, logic.events_topic, spec, config=self.config,
                     partitions=[],  # assigned at start (follows the indexer)
                     deserialize_event=self._deserialize_event,
+                    deserialize_events=batch_read,
                     serialize_state=lambda a, s: logic.state_format.write_state(s).value,
                     encode_event=getattr(logic, "encode_event", None),
                     decode_state=getattr(logic, "decode_state", None),

@@ -43,6 +43,8 @@ overload; callers see added latency, never an unbounded queue.
 
 from __future__ import annotations
 
+# surgelint: fast-path-module — the per-command publish lane (ISSUE 12)
+
 import asyncio
 import time
 from collections import deque
@@ -50,8 +52,9 @@ from dataclasses import dataclass
 from typing import (Callable, Deque, Dict, List, Mapping, Optional, Protocol,
                     Sequence)
 
-from surge_tpu.common import (BackgroundTask, fail_future, logger,
-                              resolve_future, spawn_reaped)
+from surge_tpu.common import (BackgroundTask, cancel_safe_wait_for,
+                              fail_future, logger, resolve_future,
+                              spawn_reaped)
 from surge_tpu.config import Config, default_config
 from surge_tpu.log.transport import (
     LogRecord,
@@ -255,6 +258,23 @@ class PartitionPublisher:
         self._retry_batches: Deque[_Batch] = deque()
         self._retry_max = self.config.get_int(
             "surge.producer.publish-retry-max", 8)
+        # command lane (ISSUE 12): "direct" = batch-level ack futures +
+        # queued-request joins (no per-command future/withdraw machinery);
+        # "classic" = the PR-3 per-command path (paired bench arm)
+        self._direct = self.config.get_str(
+            "surge.producer.command-lane", "direct") != "classic"
+        #: entities consult this to pick the right timeout primitive: a
+        #: shared ack must never be cancelled by one caller's timeout
+        self.shared_acks = self._direct
+        #: the forming batch's shared ack future (direct lane). Rotated at
+        #: every batch-max-records boundary so a drained batch NEVER shares
+        #: its ack with still-queued pendings (the one invariant batch-level
+        #: resolution rests on; _take_batch splits only at count boundaries)
+        self._forming_ack: Optional["asyncio.Future[None]"] = None
+        #: request_id -> queued pending's ack: a caller-timeout retry JOINS
+        #: the queued write instead of double-queueing (direct lane's
+        #: replacement for classic's cancel-withdraw callback)
+        self._queued_rids: Dict[str, "asyncio.Future[None]"] = {}
         # flush machinery: _wake = a pending exists, _batch_full = a size/bytes
         # trigger fired, _pending_room = backpressure gate (multi-waiter,
         # rare path — a plain Event is fine there)
@@ -287,6 +307,8 @@ class PartitionPublisher:
             for p in self._pending:
                 fail_future(p.future, PublisherNotReadyError(f"init failed: {exc}"))
             self._pending.clear()
+            self._queued_rids.clear()
+            self._forming_ack = None
             raise
         # pipelining depth: transports without pipelined commits (in-process
         # logs) run ONE commit in flight per lane — the commit's own latency
@@ -315,6 +337,8 @@ class PartitionPublisher:
             fail_future(p.future, PublisherNotReadyError("publisher stopped"))
         self._pending.clear()
         self._pending_bytes = 0
+        self._queued_rids.clear()
+        self._forming_ack = None
         while self._retry_batches:
             batch = self._retry_batches.popleft()
             for p in batch.pendings:
@@ -351,7 +375,8 @@ class PartitionPublisher:
         self._ready.set()
 
     async def wait_ready(self, timeout: float = 30.0) -> None:
-        await asyncio.wait_for(self._ready.wait(), timeout)
+        # cancel-safe (and the fast-path lint's sanctioned coroutine wait)
+        await cancel_safe_wait_for(self._ready.wait(), timeout)
 
     # -- publish path -------------------------------------------------------------------
 
@@ -378,6 +403,15 @@ class PartitionPublisher:
                     and not self._retry_batches
                     and request_id not in self._committing
                     and len(self._pending) < self._pending_max):
+                if self._direct:
+                    ack = self._queued_rids.get(request_id)
+                    if ack is not None:
+                        # caller-timeout retry while the original is still
+                        # queued: join the queued write, never double-queue
+                        self.stats.dedup_hits += 1
+                        if ack.cancelled():
+                            ack = self._refresh_cancelled_ack(ack)
+                        return ack
                 return self._queue_pending(aggregate_id, records, request_id)
             return self._publish_slow(aggregate_id, records, request_id)
         return self._publish_traced(aggregate_id, records, request_id, headers)
@@ -395,12 +429,40 @@ class PartitionPublisher:
 
     def _queue_pending(self, aggregate_id: str, records: Sequence[LogRecord],
                        request_id: str) -> "asyncio.Future[None]":
-        """Hot path: enqueue for the next group commit, return the ack future."""
+        """Hot path: enqueue for the next group commit, return the ack future.
+
+        Direct lane: every pending of the forming batch shares ONE ack
+        future, resolved once at commit — no per-command future creation,
+        no withdraw callback (a timed-out caller's records stay queued; its
+        same-request_id retry joins via ``_queued_rids``). The ack rotates
+        at each batch-max-records boundary so a drained batch never shares
+        its ack with still-queued pendings."""
         nbytes = 0
         for r in records:
             nbytes += ((len(r.value) if r.value else 0)
                        + (len(r.key) if r.key else 0) + 24)
-        fut: "asyncio.Future[None]" = asyncio.get_running_loop().create_future()
+        if self._direct:
+            fut = self._forming_ack
+            if fut is None or fut.done():
+                # done() covers a caller having cancelled the shared ack
+                # outright: new publishes must never ride a dead future
+                fut = self._forming_ack = \
+                    asyncio.get_running_loop().create_future()
+            pending = _Pending(request_id, aggregate_id, list(records), fut,
+                               nbytes)
+            self._pending.append(pending)
+            self._queued_rids[request_id] = fut
+            self._pending_bytes += nbytes
+            if self._first_pending_t is None:
+                self._first_pending_t = time.monotonic()
+            self._wake.set()
+            if len(self._pending) % self._batch_max_records == 0:
+                self._forming_ack = None  # next pending opens a new batch ack
+            if (len(self._pending) >= self._batch_max_records
+                    or self._pending_bytes >= self._batch_max_bytes):
+                self._batch_full.set()
+            return fut
+        fut = asyncio.get_running_loop().create_future()
         pending = _Pending(request_id, aggregate_id, list(records), fut, nbytes)
         self._pending.append(pending)
         self._pending_bytes += nbytes
@@ -419,6 +481,42 @@ class PartitionPublisher:
                               if f.cancelled() else None)
         return fut
 
+    @staticmethod
+    async def _join_shared(fut: "asyncio.Future[None]") -> None:
+        """Join a possibly-SHARED future shielded from this caller's
+        cancellation, with the wait_future(owned=False) contract: a
+        co-holder cancelling the shared future surfaces as a retryable
+        PublishFailedError (the queued records still commit; the retry
+        ladder rejoins by request id), never as CancelledError — while a
+        REAL outer cancellation (which leaves the shared future pending)
+        re-raises untouched."""
+        try:
+            await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            if fut.cancelled():
+                raise PublishFailedError(
+                    "shared batch ack cancelled by another holder; retry")
+            raise
+
+    def _refresh_cancelled_ack(self, old: "asyncio.Future[None]"
+                               ) -> "asyncio.Future[None]":
+        """A caller cancelled a shared batch ack directly (the classic
+        cancel-to-withdraw reflex; the direct lane's own timeout never
+        cancels). The queued records still commit — swap in a fresh future
+        for every pending riding the cancelled one so rejoining retries see
+        the batch's real outcome, not the stale cancellation."""
+        fresh: "asyncio.Future[None]" = \
+            asyncio.get_running_loop().create_future()
+        for p in self._pending:
+            if p.future is old:
+                p.future = fresh
+        for rid, f in self._queued_rids.items():
+            if f is old:
+                self._queued_rids[rid] = fresh
+        if self._forming_ack is old:
+            self._forming_ack = fresh
+        return fresh
+
     def _withdraw(self, pending: _Pending) -> None:
         try:
             self._pending.remove(pending)
@@ -434,6 +532,16 @@ class PartitionPublisher:
         if request_id in self._completed:
             self.stats.dedup_hits += 1
             return
+        if self._direct:
+            ack = self._queued_rids.get(request_id)
+            if ack is not None:
+                # retry of a still-queued request (caller timed out before
+                # the batch formed): join the queued write's batch ack
+                self.stats.dedup_hits += 1
+                if ack.cancelled():
+                    ack = self._refresh_cancelled_ack(ack)
+                await self._join_shared(ack)
+                return
         for rb in self._retry_batches:
             for sp in rb.pendings:
                 if sp.request_id == request_id:
@@ -444,8 +552,8 @@ class PartitionPublisher:
                     # must see the batch's outcome, not the old cancellation.
                     self.stats.dedup_hits += 1
                     if sp.future.cancelled():
-                        sp.future = asyncio.get_running_loop().create_future()
-                    await asyncio.shield(sp.future)
+                        sp.future = asyncio.get_running_loop().create_future()  # surgelint: disable=hot-path-asyncio # rare rejoin slow path, not per-command
+                    await self._join_shared(sp.future)  # surgelint: disable=hot-path-asyncio # rare rejoin slow path, not per-command
                     return
         committing = self._committing.get(request_id)
         if committing is not None:
@@ -466,7 +574,15 @@ class PartitionPublisher:
             await self._pending_room.wait()
         if self.state not in ("processing", "waiting_for_ktable", "initializing"):
             raise PublisherNotReadyError(f"publisher state={self.state}")
-        await self._queue_pending(aggregate_id, records, request_id)
+        ack = self._queue_pending(aggregate_id, records, request_id)
+        if self._direct:
+            # SHIELD the shared batch ack: this coroutine runs under the
+            # entity's cancel-on-timeout wrapper, and a task cancellation
+            # lands on the future it is parked on — unshielded, one caller's
+            # timeout would cancel every sibling publish in the batch
+            await asyncio.shield(ack)
+        else:
+            await ack
 
     def is_aggregate_state_current(self, aggregate_id: str) -> bool:
         """True iff nothing published for this aggregate is still ahead of the store's
@@ -558,9 +674,17 @@ class PartitionPublisher:
         formed_at = self._first_pending_t if self._first_pending_t is not None else now
         if len(self._pending) <= self._batch_max_records:
             pendings, self._pending = self._pending, []
+            self._forming_ack = None  # the next pending opens a fresh ack
         else:
             pendings = self._pending[:self._batch_max_records]
             del self._pending[:self._batch_max_records]
+            # leftovers keep their own ack(s): the rotation at every
+            # batch-max boundary guarantees none of them share the drained
+            # batch's future
+        if self._direct:
+            pop = self._queued_rids.pop
+            for p in pendings:
+                pop(p.request_id, None)
         self._pending_bytes = max(
             0, self._pending_bytes - sum(p.nbytes for p in pendings))
         self._pending_room.set()

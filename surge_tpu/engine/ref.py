@@ -7,9 +7,12 @@ Reference: internal/persistence/AggregateRefTrait.scala:31-102 + the scaladsl su
 
 from __future__ import annotations
 
+# surgelint: fast-path-module — the per-command ask boundary (ISSUE 12)
+
 import asyncio
 from typing import Any, Callable, Optional, Sequence
 
+from surge_tpu.common import wait_future
 from surge_tpu.config import Config, TimeoutConfig, default_config
 from surge_tpu.engine.entity import (
     ApplyEvents,
@@ -54,7 +57,10 @@ class AggregateRef:
         env = Envelope(message=message, reply=fut, headers=headers)
         try:
             self._deliver(self.aggregate_id, env)
-            return await asyncio.wait_for(fut, timeout=self._timeouts.ask_timeout_s)
+            # slim timer wait on the exclusively-owned reply future: no
+            # wrapper task / waiter per ask (a per-command cost at engine
+            # throughput); timeout cancels the reply exactly like wait_for
+            return await wait_future(fut, self._timeouts.ask_timeout_s)
         except asyncio.TimeoutError as exc:
             if span is not None:
                 span.record_exception(exc)

@@ -10,6 +10,8 @@ redelivered — the fresh entity re-initializes from the state store.
 
 from __future__ import annotations
 
+# surgelint: fast-path-module — the per-command delivery hop (ISSUE 12)
+
 from typing import Callable, Dict, List
 
 from surge_tpu.common import fail_future, logger
@@ -105,7 +107,7 @@ class Shard:
 
     async def stop(self) -> None:
         for entity in list(self._entities.values()):
-            await entity.stop()
+            await entity.stop()  # surgelint: disable=hot-path-asyncio # shutdown path, not per-command
         self._entities.clear()
         for buf in self._passivating.values():
             for env in buf:

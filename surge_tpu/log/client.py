@@ -23,6 +23,8 @@ from typing import Dict, List, Mapping, Optional, Sequence
 import grpc
 
 from surge_tpu.log import log_service_pb2 as pb
+from surge_tpu.log import native_gate
+from surge_tpu.log.common import lazy_read_reply, lazy_txn_reply
 from surge_tpu.log.server import METHODS, SERVICE, msg_to_record, record_to_msg
 from surge_tpu.log.transport import (
     LogRecord,
@@ -31,6 +33,21 @@ from surge_tpu.log.transport import (
     TopicSpec,
     TransactionStateError,
 )
+
+#: native reply-leg deserializers (log/common.py): one C++ index call per
+#: reply + lazy decode-on-access views instead of a protobuf parse + one
+#: frozen LogRecord per record. Registered only while the native read
+#: decode is enabled; anything else keeps the protobuf classes.
+_LAZY_DESERIALIZERS = {"Read": lazy_read_reply, "Transact": lazy_txn_reply}
+
+
+def _reply_records(reply) -> List[LogRecord]:
+    """The reply's committed records: lazy views pass through (list), a
+    protobuf reply converts per message (the pre-view path)."""
+    recs = reply.records
+    if isinstance(recs, list):
+        return recs
+    return [msg_to_record(m) for m in recs]
 
 
 def _raise_for(reply: pb.TxnReply) -> None:
@@ -127,7 +144,7 @@ class GrpcTxnProducer:
         self._check_fence(reply)
         _raise_for(reply)
         self._next_seq += 1
-        return [msg_to_record(m) for m in reply.records]
+        return _reply_records(reply)
 
     def commit_unsequenced(self) -> Sequence[LogRecord]:
         """Commit WITHOUT an idempotency seq (txn_seq=0): for epoch markers
@@ -146,7 +163,7 @@ class GrpcTxnProducer:
             raise
         self._check_fence(reply)
         _raise_for(reply)
-        return [msg_to_record(m) for m in reply.records]
+        return _reply_records(reply)
 
     def commit_pipelined(self) -> PipelinedCommit:
         """Dispatch the buffered transaction without awaiting the reply."""
@@ -183,7 +200,7 @@ class GrpcTxnProducer:
         self._check_fence(reply)
         _raise_for(reply)
         self._next_seq += 1
-        return msg_to_record(reply.records[0])
+        return _reply_records(reply)[0]
 
     def _check_fence(self, reply: pb.TxnReply) -> None:
         if not reply.ok and reply.error_kind == "fenced":
@@ -253,11 +270,26 @@ class GrpcLogTransport:
                 pass
         self.target = self.targets[index % len(self.targets)]
         self._channel = secure_sync_channel(self.target, self._config)
+        # an explicit test/bench pin (set_decode_enabled) wins; otherwise
+        # THIS transport's config decides — the operator kill-switch on an
+        # explicitly-configured client must reach its reply decode, not
+        # just the ambient default (the same per-instance-config contract
+        # FileLog's reads honor)
+        pin = native_gate.decode_pinned()
+        if pin is not None:
+            lazy_ok = pin
+        elif self._config is not None:
+            lazy_ok = native_gate.enabled(self._config)
+        else:
+            lazy_ok = native_gate.decode_enabled()
         for name, (req_cls, reply_cls) in METHODS.items():
+            deserializer = reply_cls.FromString
+            if lazy_ok:
+                deserializer = _LAZY_DESERIALIZERS.get(name, deserializer)
             self._calls[name] = self._channel.unary_unary(
                 f"/{SERVICE}/{name}",
                 request_serializer=req_cls.SerializeToString,
-                response_deserializer=reply_cls.FromString)
+                response_deserializer=deserializer)
 
     def _failover(self, from_generation: int) -> None:
         t0 = time.perf_counter()
@@ -455,7 +487,7 @@ class GrpcLogTransport:
                                    generation=producer._generation)
             producer._check_fence(reply)
             _raise_for(reply)
-            handle.future.set_result([msg_to_record(m) for m in reply.records])
+            handle.future.set_result(_reply_records(reply))
         except ProducerFencedError as exc:
             producer._fenced = True
             handle.future.set_exception(exc)
@@ -565,7 +597,7 @@ class GrpcLogTransport:
             req.has_max = True
             req.max_records = max_records
         reply = self._invoke("Read", req)
-        return [msg_to_record(m) for m in reply.records]
+        return _reply_records(reply)
 
     def end_offset(self, topic: str, partition: int,
                    isolation: str = "read_committed") -> int:

@@ -1,0 +1,414 @@
+"""Lazy record views — the native reply/read legs' LogRecord twins.
+
+PR 10's paired ladder showed the remaining broker-path wall is the Python
+wrapped AROUND the native core: the read and reply legs built one frozen
+dataclass :class:`~surge_tpu.log.transport.LogRecord` per record (~2.8 µs
+each) even though most consumers touch only a field or two. This module
+provides __slots__ **views** that decode on access over buffers the native
+layer indexed in one call:
+
+- :class:`SegmentRecordView` — over an (uncompressed) segment block payload
+  indexed by ``csrc/txn.cc surge_seg_index`` (every FileLog read and the
+  resident plane's refresh feed ride this);
+- :class:`WireRecordView` — over a serialized reply's bytes indexed by
+  ``surge_reply_index`` (the gRPC client's Read/Transact reply legs);
+- the lazy reply wrappers (:func:`lazy_read_reply` / :func:`lazy_txn_reply`)
+  the client registers as response deserializers when the native layer is
+  built, falling back to the protobuf classes otherwise.
+
+Contract: a view is **observably identical** to the LogRecord the pre-view
+path built — equality (both directions), ``repr``, field values, tombstone
+``None`` semantics — enforced by tests/test_reply_views.py. Fallback stays
+bit-identical: with the library unbuilt or ``surge.log.native.enabled=false``
+every caller takes the original LogRecord/protobuf path.
+
+:func:`py_reply_format` is the pure-Python twin of ``surge_reply_format``
+(canonical proto3 bytes: fields in number order, defaults skipped, headers
+in sorted key order) — the property test asserts bit-identity.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional
+
+from surge_tpu.log.transport import LogRecord
+
+__all__ = [
+    "SegmentRecordView", "WireRecordView", "lazy_read_reply",
+    "lazy_txn_reply", "materialize", "py_reply_format",
+    "records_from_reply",
+]
+
+_UNSET = object()
+
+
+def _uvarint(data, pos: int):
+    shift = n = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+
+
+class _RecordViewBase:
+    """Field-wise equality/repr shared by every view flavor. Comparison with
+    a real LogRecord works BOTH directions: the dataclass ``__eq__`` answers
+    NotImplemented for a foreign class, so Python reflects into ours."""
+
+    __slots__ = ()
+
+    def __eq__(self, other):
+        if isinstance(other, (_RecordViewBase, LogRecord)):
+            return (self.offset == other.offset
+                    and self.partition == other.partition
+                    and self.key == other.key
+                    and self.value == other.value
+                    and self.topic == other.topic
+                    and self.timestamp == other.timestamp
+                    and dict(self.headers) == dict(other.headers))
+        return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+    # LogRecord itself is unhashable at runtime (its generated __hash__
+    # raises on the headers dict); match that contract
+    __hash__ = None
+
+    def __repr__(self) -> str:  # the dataclass repr, verbatim
+        return (f"LogRecord(topic={self.topic!r}, key={self.key!r}, "
+                f"value={self.value!r}, partition={self.partition!r}, "
+                f"headers={dict(self.headers)!r}, offset={self.offset!r}, "
+                f"timestamp={self.timestamp!r})")
+
+
+def materialize(record) -> LogRecord:
+    """A real LogRecord from any record-shaped object (view or LogRecord) —
+    for callers that genuinely need the frozen dataclass."""
+    if isinstance(record, LogRecord):
+        return record
+    return LogRecord(topic=record.topic, key=record.key, value=record.value,
+                     partition=record.partition,
+                     headers=dict(record.headers), offset=record.offset,
+                     timestamp=record.timestamp)
+
+
+class SegmentRecordView(_RecordViewBase):
+    """One record over a segment block payload + its native index row
+    (``surge_seg_index``: [flags, key_off, key_len, val_off, val_len,
+    hdr_off, hdr_cnt] at ``rows[o:o+7]``). key/value/headers decode on first
+    access and stay cached; the payload is shared by every record of the
+    block."""
+
+    __slots__ = ("_payload", "_rows", "_o", "topic", "partition", "offset",
+                 "timestamp", "_key", "_value", "_headers")
+
+    def __init__(self, payload, rows, o: int, topic: str, partition: int,
+                 offset: int, timestamp: float) -> None:
+        self._payload = payload
+        self._rows = rows
+        self._o = o
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+        self.timestamp = timestamp
+        self._key = _UNSET
+        self._value = _UNSET
+        self._headers = _UNSET
+
+    @property
+    def key(self) -> Optional[str]:
+        k = self._key
+        if k is _UNSET:
+            rows, o = self._rows, self._o
+            k = (self._payload[rows[o + 1]: rows[o + 1] + rows[o + 2]]
+                 .decode() if rows[o] & 1 else None)
+            self._key = k
+        return k
+
+    @property
+    def value(self) -> Optional[bytes]:
+        v = self._value
+        if v is _UNSET:
+            rows, o = self._rows, self._o
+            v = (self._payload[rows[o + 3]: rows[o + 3] + rows[o + 4]]
+                 if not rows[o] & 2 else None)
+            self._value = v
+        return v
+
+    @property
+    def headers(self) -> Dict[str, str]:
+        h = self._headers
+        if h is _UNSET:
+            rows, o = self._rows, self._o
+            h = {}
+            nh = rows[o + 6]
+            if nh:
+                payload = self._payload
+                pos = rows[o + 5]
+                for _ in range(nh):
+                    hklen, pos = _uvarint(payload, pos)
+                    hk = payload[pos: pos + hklen].decode()
+                    pos += hklen
+                    hvlen, pos = _uvarint(payload, pos)
+                    h[hk] = payload[pos: pos + hvlen].decode()
+                    pos += hvlen
+            self._headers = h
+        return h
+
+
+class WireRecordView(_RecordViewBase):
+    """One record over a serialized reply's bytes + its native index row
+    (``surge_reply_index``: [flags, topic_off, topic_len, key_off, key_len,
+    val_off, val_len, partition, offset, hdr_cnt, msg_off, msg_len] at
+    ``rows[o:o+12]``). Everything string/bytes decodes on access; headers
+    re-walk only this record's message slice, and only when touched."""
+
+    __slots__ = ("_buf", "_rows", "_o", "timestamp", "_topic", "_key",
+                 "_value", "_headers")
+
+    def __init__(self, buf: bytes, rows, o: int, timestamp: float) -> None:
+        self._buf = buf
+        self._rows = rows
+        self._o = o
+        self.timestamp = timestamp
+        self._topic = _UNSET
+        self._key = _UNSET
+        self._value = _UNSET
+        self._headers = _UNSET
+
+    @property
+    def topic(self) -> str:
+        t = self._topic
+        if t is _UNSET:
+            rows, o = self._rows, self._o
+            t = self._buf[rows[o + 1]: rows[o + 1] + rows[o + 2]].decode()
+            self._topic = t
+        return t
+
+    @property
+    def key(self) -> Optional[str]:
+        k = self._key
+        if k is _UNSET:
+            rows, o = self._rows, self._o
+            k = (self._buf[rows[o + 3]: rows[o + 3] + rows[o + 4]].decode()
+                 if rows[o] & 1 else None)
+            self._key = k
+        return k
+
+    @property
+    def value(self) -> Optional[bytes]:
+        v = self._value
+        if v is _UNSET:
+            rows, o = self._rows, self._o
+            v = (self._buf[rows[o + 5]: rows[o + 5] + rows[o + 6]]
+                 if not rows[o] & 2 else None)
+            self._value = v
+        return v
+
+    @property
+    def partition(self) -> int:
+        return self._rows[self._o + 7]
+
+    @property
+    def offset(self) -> int:
+        return self._rows[self._o + 8]
+
+    @property
+    def headers(self) -> Dict[str, str]:
+        h = self._headers
+        if h is _UNSET:
+            h = {}
+            rows, o = self._rows, self._o
+            if rows[o + 9]:
+                buf = self._buf
+                pos = rows[o + 10]
+                end = pos + rows[o + 11]
+                while pos < end:
+                    tag, pos = _uvarint(buf, pos)
+                    if tag == 0x3A:  # field 7, len-delimited: one map entry
+                        ent_len, pos = _uvarint(buf, pos)
+                        ent_end = pos + ent_len
+                        hk = hv = ""
+                        while pos < ent_end:
+                            etag, pos = _uvarint(buf, pos)
+                            elen, pos = _uvarint(buf, pos)
+                            if etag == 0x0A:
+                                hk = buf[pos: pos + elen].decode()
+                            elif etag == 0x12:
+                                hv = buf[pos: pos + elen].decode()
+                            pos += elen
+                        h[hk] = hv
+                    else:
+                        pos = _skip_field(buf, pos, tag & 7)
+            self._headers = h
+        return h
+
+
+def _skip_field(buf: bytes, pos: int, wt: int) -> int:
+    if wt == 0:
+        _, pos = _uvarint(buf, pos)
+        return pos
+    if wt == 1:
+        return pos + 8
+    if wt == 2:
+        n, pos = _uvarint(buf, pos)
+        return pos + n
+    if wt == 5:
+        return pos + 4
+    raise ValueError(f"unknown wire type {wt}")
+
+
+def records_from_reply(data: bytes, field: int) -> Optional[List[WireRecordView]]:
+    """Every RecordMsg of the reply's repeated ``field`` as lazy views, or
+    None (library unbuilt / bytes the indexer refuses — callers protobuf-
+    parse instead)."""
+    from surge_tpu.log import native_gate
+
+    idx = native_gate.reply_index(data, field)
+    if idx is None:
+        return None
+    rows, ts = idx
+    width = native_gate.REPLY_ROW_WIDTH
+    return [WireRecordView(data, rows, i * width, ts[i])
+            for i in range(len(ts))]
+
+
+class _LazyReadReply:
+    """ReadReply twin: just the records, as views."""
+
+    __slots__ = ("records",)
+
+    def __init__(self, records: List[WireRecordView]) -> None:
+        self.records = records
+
+
+class _LazyTxnReply:
+    """TxnReply twin: scalar fields parsed once with a tiny wire walk (a
+    handful of fields per reply), records as lazy views."""
+
+    __slots__ = ("ok", "error", "error_kind", "leader_hint", "records")
+
+    def __init__(self, data: bytes, records: List[WireRecordView]) -> None:
+        self.ok = False
+        self.error = ""
+        self.error_kind = ""
+        self.leader_hint = ""
+        self.records = records
+        pos = 0
+        n = len(data)
+        while pos < n:
+            tag, pos = _uvarint(data, pos)
+            field = tag >> 3
+            if field == 1 and tag & 7 == 0:
+                v, pos = _uvarint(data, pos)
+                self.ok = bool(v)
+            elif field in (2, 3, 5) and tag & 7 == 2:
+                slen, pos = _uvarint(data, pos)
+                s = data[pos: pos + slen].decode()
+                pos += slen
+                if field == 2:
+                    self.error = s
+                elif field == 3:
+                    self.error_kind = s
+                else:
+                    self.leader_hint = s
+            else:
+                pos = _skip_field(data, pos, tag & 7)
+
+
+def lazy_read_reply(data: bytes):
+    """Client response deserializer for Read: lazy views over the reply
+    bytes via one native index call; protobuf parse when native is off."""
+    recs = records_from_reply(data, 1)
+    if recs is None:
+        from surge_tpu.log import log_service_pb2 as pb
+
+        return pb.ReadReply.FromString(data)
+    return _LazyReadReply(recs)
+
+
+def lazy_txn_reply(data: bytes):
+    """Client response deserializer for Transact (TxnReply.records is
+    field 4)."""
+    recs = records_from_reply(data, 4)
+    if recs is None:
+        from surge_tpu.log import log_service_pb2 as pb
+
+        return pb.TxnReply.FromString(data)
+    return _LazyTxnReply(data, recs)
+
+
+# -- pure-Python reply-format twin (fallback + property-test reference) -----
+
+
+def _py_uvarint(buf: bytearray, n: int) -> None:
+    while n >= 0x80:
+        buf.append((n & 0x7F) | 0x80)
+        n >>= 7
+    buf.append(n & 0x7F)
+
+
+def py_reply_format(records, field: int) -> bytes:
+    """The canonical serialized repeated-RecordMsg bytes ``csrc/txn.cc
+    surge_reply_format`` emits, in pure Python: proto3 field order, defaults
+    skipped, ``has_key``/``has_value`` as explicit presence bits, headers as
+    map entries in SORTED key order. The property test asserts bit-identity
+    against the native formatter; protobuf's own parser accepts either
+    (field order and map order are reader-irrelevant)."""
+    out = bytearray()
+    rec_tag = (field << 3) | 2
+    for r in records:
+        msg = bytearray()
+        tb = r.topic.encode("utf-8")
+        if tb:
+            msg.append(0x0A)
+            _py_uvarint(msg, len(tb))
+            msg += tb
+        if r.key is not None:
+            msg += b"\x10\x01"
+            kb = r.key.encode("utf-8")
+            if kb:
+                msg.append(0x1A)
+                _py_uvarint(msg, len(kb))
+                msg += kb
+        if r.value is not None:
+            msg += b"\x20\x01"
+            if r.value:
+                msg.append(0x2A)
+                _py_uvarint(msg, len(r.value))
+                msg += r.value
+        if r.partition:
+            msg.append(0x30)
+            _py_uvarint(msg, r.partition & 0xFFFFFFFFFFFFFFFF)
+        for hk, hv in sorted(dict(r.headers).items()):
+            ent = bytearray()
+            hkb = hk.encode("utf-8")
+            hvb = hv.encode("utf-8")
+            if hkb:
+                ent.append(0x0A)
+                _py_uvarint(ent, len(hkb))
+                ent += hkb
+            if hvb:
+                ent.append(0x12)
+                _py_uvarint(ent, len(hvb))
+                ent += hvb
+            msg.append(0x3A)
+            _py_uvarint(msg, len(ent))
+            msg += ent
+        if r.offset:
+            msg.append(0x40)
+            _py_uvarint(msg, r.offset & 0xFFFFFFFFFFFFFFFF)
+        ts = struct.pack("<d", r.timestamp)
+        if ts != b"\x00" * 8:
+            msg.append(0x49)
+            msg += ts
+        _py_uvarint(out, rec_tag)
+        _py_uvarint(out, len(msg))
+        out += msg
+    return bytes(out)

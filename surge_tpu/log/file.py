@@ -525,11 +525,14 @@ class FileLog(LogBase):
 
     def _append_locked(self, records: Sequence[LogRecord],
                        verbatim: bool = False, allow_gaps: bool = False):
-        """Phase 1 of one transaction (caller holds the log lock) — routes to
-        the native batch path when built+enabled (assign path only; verbatim
-        replica ingest keeps the run-splitting Python path)."""
-        if records and not verbatim and self._native is not None:
-            return self._append_locked_native(records)
+        """Phase 1 of one transaction (caller holds the log lock) — routes
+        to the native batch path when built+enabled, on the assign path AND
+        the verbatim replica-ingest path (the follower applies shipped
+        batches off the GIL; PR-10 headroom note closed)."""
+        if records and self._native is not None:
+            if not verbatim:
+                return self._append_locked_native(records)
+            return self._append_locked_verbatim_native(records, allow_gaps)
         return self._append_locked_py(records, verbatim, allow_gaps)
 
     def _append_locked_native(self, records: Sequence[LogRecord]):
@@ -551,7 +554,44 @@ class FileLog(LogBase):
                for r, off in zip(records, offsets)]
         return out, my_target, touched, marks
 
-    def _append_batch_locked(self, batch):
+    def _append_locked_verbatim_native(self, records: Sequence[LogRecord],
+                                       allow_gaps: bool):
+        """Native verbatim phase 1 (replica ingest): ONE C++ call re-groups
+        the leader-assigned records into contiguous-offset runs, frames each
+        run's block with its ORIGINAL timestamps and formats the journal
+        line — replica segment/journal bytes converge byte-identically with
+        a leader that wrote the same records (property-tested). Gap checks
+        mirror :meth:`_append_locked_py`'s verbatim semantics exactly."""
+        batch = self._native.pack_verbatim(records)
+        if batch is None:  # pragma: no cover — library unloadable mid-run
+            return self._append_locked_py(records, True, allow_gaps)
+        try:
+            expected: dict = {}
+            bases = batch.group_bases()
+            for g, (topic, p, count) in enumerate(batch.groups):
+                self.topic(topic)
+                key = (topic, p)
+                part = self._parts.get(key)
+                if part is None:
+                    raise KeyError(f"{topic}[{p}] does not exist")
+                exp = expected.get(key)
+                if exp is None:
+                    exp = part.end_offset
+                base = bases[g]
+                if base < exp or (base > exp and not allow_gaps):
+                    raise ValueError(
+                        f"verbatim append at {topic}[{p}]@{base} but "
+                        f"applied end is {exp}")
+                expected[key] = base + count
+            my_target, touched, marks, _offsets, _now = \
+                self._append_batch_locked(batch, verbatim=True,
+                                          verbatim_bases=bases)
+        finally:
+            batch.close()
+        return list(records), my_target, touched, marks
+
+    def _append_batch_locked(self, batch, verbatim: bool = False,
+                             verbatim_bases=None):
         """Apply one pre-decoded :class:`~surge_tpu.log.native_gate.
         NativeBatch` (caller holds the log lock): format via the native call,
         stage embedded blocks in the lazy pending tail (the group-sync worker
@@ -577,10 +617,21 @@ class FileLog(LogBase):
             if part is None:
                 raise KeyError(f"{topic}[{p}] does not exist")
             parts_objs.append(part)
-            bases.append(part.end_offset)
+            if not verbatim:
+                bases.append(part.end_offset)
             pos0.append(part.end_pos)
-        line, blocks, gouts, offsets = batch.format(bases, pos0, now,
-                                                    _EMBED_MAX_BYTES)
+        if verbatim:
+            # leader-assigned bases (the caller's gap check already pulled
+            # them — one ctypes call per group, not two) and per-record
+            # timestamps; same-partition runs chain their file positions
+            # natively (the Python path's `pos = new_pos` walk)
+            bases = (verbatim_bases if verbatim_bases is not None
+                     else batch.group_bases())
+            line, blocks, gouts, offsets = batch.format_verbatim(
+                pos0, _EMBED_MAX_BYTES)
+        else:
+            line, blocks, gouts, offsets = batch.format(bases, pos0, now,
+                                                        _EMBED_MAX_BYTES)
         # lazy segment materialization needs the group-sync worker (it only
         # runs under fsync="commit") to drain the pending tails
         lazy = self._fsync and self._native_lazy
@@ -592,6 +643,10 @@ class FileLog(LogBase):
         try:
             for g, part in enumerate(parts_objs):
                 boff, blen, embedded, new_pos = gouts[g]
+                # the block's file position: chained for same-partition
+                # verbatim runs (assign-path groups are unique per
+                # partition, where this equals pos0[g])
+                block_pos = new_pos - blen
                 block_mv = mv[boff:boff + blen]
                 if embedded and lazy:
                     if part.pending_bytes > _PENDING_FLUSH_BYTES:
@@ -602,7 +657,7 @@ class FileLog(LogBase):
                     # would pin the whole batch's blocks buffer (incl. any
                     # multi-MB oversized group) while pending_bytes accounts
                     # only the slice — the flush valve would undercount
-                    part.pending[pos0[g]] = bytes(block_mv)
+                    part.pending[block_pos] = bytes(block_mv)
                     part.pending_bytes += blen
                 else:
                     self._flush_pending_locked(part)
@@ -620,7 +675,7 @@ class FileLog(LogBase):
                         if self.faults is not None:
                             self.faults.on_fsync("segment")
                         os.fsync(part.file.fileno())
-                staged.append((part, bases[g], pos0[g], new_pos,
+                staged.append((part, bases[g], block_pos, new_pos,
                                groups[g][2]))
             if self._fsync and self.faults is None and staged_ok:
                 # stage the commit point: the group-sync worker writes every

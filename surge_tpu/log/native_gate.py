@@ -28,7 +28,8 @@ from typing import List, Optional, Sequence, Tuple
 __all__ = [
     "ACCEPT", "REPLAY", "MAYBE_REOPEN", "WAIT", "FINALIZING",
     "NativeBatch", "available", "batch_from_request", "decide", "enabled",
-    "pack_records", "py_decide", "py_format_journal", "wal_append",
+    "pack_records", "pack_verbatim", "py_decide", "py_format_journal",
+    "reply_format", "reply_index", "wal_append",
 ]
 
 # gate decisions (csrc/txn.cc surge_txn_decide — keep in lockstep)
@@ -69,6 +70,20 @@ TXN_SIGNATURES = {
                          _C.c_int64),
     "surge_seg_index": ((_u8p, _C.c_size_t, _C.c_int64, _i64p,
                          _C.POINTER(_C.c_double)), _C.c_int64),
+    # verbatim replica ingest (leader-assigned offsets/timestamps preserved)
+    "surge_txn_parse_packed_v": ((_i64p, _C.c_size_t, _u8p, _C.c_size_t,
+                                  _u8p, _i64p, _C.c_size_t, _i64p,
+                                  _C.POINTER(_C.c_double)), _C.c_void_p),
+    "surge_txn_group_base": ((_C.c_void_p, _C.c_int64), _C.c_int64),
+    "surge_txn_format_verbatim": ((_C.c_void_p, _i64p, _C.c_int64),
+                                  _C.c_int32),
+    # reply legs: packed record-view materializer + wire reply formatter
+    "surge_reply_count": ((_u8p, _C.c_size_t, _C.c_int32), _C.c_int64),
+    "surge_reply_index": ((_u8p, _C.c_size_t, _C.c_int32, _i64p,
+                           _C.c_size_t, _C.POINTER(_C.c_double)), _C.c_int64),
+    "surge_reply_format": ((_i64p, _C.c_size_t, _u8p, _C.c_size_t, _u8p,
+                            _i64p, _C.c_size_t, _C.POINTER(_C.c_double),
+                            _C.c_int32, _u8p, _C.c_size_t), _C.c_int64),
 }
 
 _lib = None
@@ -98,14 +113,23 @@ def enabled(config) -> bool:
 
 
 _decode_enabled: Optional[bool] = None
+_decode_pinned = False  # True only for an EXPLICIT set_decode_enabled pin
 
 
 def set_decode_enabled(value: Optional[bool]) -> None:
     """Force the read-path decode switch (bench arms / tests): True/False pin
     it (True still requires the library), None re-derives from the ambient
     config + availability on next use."""
-    global _decode_enabled
+    global _decode_enabled, _decode_pinned
+    _decode_pinned = value is not None
     _decode_enabled = None if value is None else (bool(value) and available())
+
+
+def decode_pinned() -> Optional[bool]:
+    """The explicit test/bench pin, or None when unpinned — distinct from
+    :func:`decode_enabled`'s ambient-derived cache, so per-instance configs
+    (a transport's own kill-switch) are only overridden by a REAL pin."""
+    return _decode_enabled if _decode_pinned else None
 
 
 def decode_enabled() -> bool:
@@ -195,6 +219,25 @@ class NativeBatch:
         ptr = self._lib.surge_txn_rec_groups(self._h, _C.byref(n))
         return ptr[:n.value]
 
+    def group_bases(self) -> List[int]:
+        """Per-group base offset (verbatim batches: the leader-assigned run
+        base captured at parse; -1 on assign-path batches)."""
+        lib, h = self._lib, self._h
+        return [int(lib.surge_txn_group_base(h, g))
+                for g in range(len(self.groups))]
+
+    def format_verbatim(self, positions: Sequence[int], embed_max: int):
+        """Verbatim twin of :meth:`format` (replica ingest): block bases are
+        the leader-assigned run bases, every record frames with its own
+        timestamp — replica segment bytes converge with the leader's."""
+        lib, h = self._lib, self._h
+        n = len(self.groups)
+        rc = lib.surge_txn_format_verbatim(
+            h, (_C.c_int64 * n)(*positions), embed_max)
+        if rc != 0:  # pragma: no cover — format cannot fail on a parsed batch
+            raise RuntimeError(f"surge_txn_format_verbatim failed ({rc})")
+        return self._format_outputs()
+
     def format(self, bases: Sequence[int], positions: Sequence[int],
                timestamp: float, embed_max: int):
         """One native call: frame + compress + CRC every group's block, build
@@ -209,6 +252,10 @@ class NativeBatch:
                                   timestamp, embed_max)
         if rc != 0:  # pragma: no cover — format cannot fail on a parsed batch
             raise RuntimeError(f"surge_txn_format failed ({rc})")
+        return self._format_outputs()
+
+    def _format_outputs(self):
+        lib, h = self._lib, self._h
         sz = _C.c_size_t()
         line = _C.string_at(lib.surge_txn_line(h, _C.byref(sz)), sz.value)
         blocks = _C.string_at(lib.surge_txn_blocks(h, _C.byref(sz)), sz.value)
@@ -217,7 +264,7 @@ class NativeBatch:
         emb = _C.c_int32()
         pos = _C.c_int64()
         gouts = []
-        for g in range(n):
+        for g in range(len(self.groups)):
             lib.surge_txn_group_out(h, g, _C.byref(off), _C.byref(blen),
                                     _C.byref(emb), _C.byref(pos))
             gouts.append((off.value, blen.value, emb.value, pos.value))
@@ -299,6 +346,189 @@ def pack_records(records) -> Optional[NativeBatch]:
     if not h:
         return None
     return NativeBatch(lib, h)
+
+
+def pack_verbatim(records) -> Optional[NativeBatch]:
+    """Pack a VERBATIM record batch (replica ingest) into a native handle:
+    same one-pass packing as :func:`pack_records` plus the leader-assigned
+    offsets and timestamps; the native side splits contiguous-offset runs
+    into groups (one segment block per run, never spanning an offset hole).
+    None when unbuilt."""
+    lib = _load()
+    if lib is None:
+        return None
+    meta = array("q")
+    ext = meta.extend
+    offsets = array("q")
+    ts = array("d")
+    parts: List[bytes] = []
+    append = parts.append
+    topic_idx = {}
+    topic_blob: List[bytes] = []
+    topic_lens = array("q")
+    for r in records:
+        t = r.topic
+        g = topic_idx.get(t)
+        if g is None:
+            g = topic_idx[t] = len(topic_idx)
+            tb = t.encode("utf-8")
+            topic_blob.append(tb)
+            topic_lens.append(len(tb))
+        key = r.key
+        value = r.value
+        flags = 0
+        klen = 0
+        vlen = 0
+        if key is not None:
+            kb = key.encode("utf-8")
+            flags = 1
+            klen = len(kb)
+            append(kb)
+        if value is None:
+            flags |= 2
+        else:
+            vlen = len(value)
+            append(value)
+        headers = r.headers
+        if headers:
+            row = [g, r.partition, flags, klen, vlen, len(headers)]
+            for hk, hv in headers.items():
+                hkb = hk.encode("utf-8")
+                hvb = hv.encode("utf-8")
+                append(hkb)
+                append(hvb)
+                row.append(len(hkb))
+                row.append(len(hvb))
+            ext(row)
+        else:
+            ext((g, r.partition, flags, klen, vlen, 0))
+        offsets.append(r.offset)
+        ts.append(r.timestamp)
+    blob = b"".join(parts)
+    meta_c = (_C.c_int64 * len(meta)).from_buffer(meta) if meta else None
+    lens_c = ((_C.c_int64 * len(topic_lens)).from_buffer(topic_lens)
+              if topic_lens else None)
+    offs_c = ((_C.c_int64 * len(offsets)).from_buffer(offsets)
+              if offsets else None)
+    ts_c = (_C.c_double * len(ts)).from_buffer(ts) if ts else None
+    h = lib.surge_txn_parse_packed_v(meta_c, len(meta), blob, len(blob),
+                                     b"".join(topic_blob), lens_c,
+                                     len(topic_lens), offs_c, ts_c)
+    if not h:
+        return None
+    return NativeBatch(lib, h)
+
+
+#: RecordMsg index-row width emitted by surge_reply_index (see csrc/txn.cc)
+REPLY_ROW_WIDTH = 12
+
+
+def reply_index(data: bytes, field: int):
+    """Index the repeated RecordMsg ``field`` of a serialized reply in ONE
+    native call: returns ``(rows, ts)`` — ``REPLY_ROW_WIDTH`` int64s per
+    record ([flags, topic_off, topic_len, key_off, key_len, val_off,
+    val_len, partition, offset, hdr_cnt, msg_off, msg_len]) plus the
+    timestamp array — or None (library unbuilt / malformed bytes: callers
+    take the protobuf parse)."""
+    lib = _load()
+    if lib is None:
+        return None
+    count = lib.surge_reply_count(data, len(data), field)
+    if count < 0:
+        return None
+    if count == 0:
+        return [], []
+    rows = (_C.c_int64 * (REPLY_ROW_WIDTH * count))()
+    ts = (_C.c_double * count)()
+    n = lib.surge_reply_index(data, len(data), field, rows, count, ts)
+    if n != count:
+        return None
+    # bulk-slice to Python lists: per-element ctypes __getitem__ costs more
+    # than the decode it replaces
+    return rows[:], ts[:]
+
+
+def reply_format(records, field: int) -> Optional[bytes]:
+    """Serialize ``records`` as the repeated RecordMsg ``field`` of a reply
+    message in ONE native call (proto3 field order, defaults skipped,
+    headers in sorted key order — the canonical form py_reply_format twins).
+    One Python pass packs the fields; no RecordMsg ever materializes. None
+    when the library is unbuilt (callers build the protobuf reply)."""
+    lib = _load()
+    if lib is None:
+        return None
+    # NOTE: this packing loop is the third copy of pack_records' shape (with
+    # pack_verbatim) — deliberately unrolled rather than shared, because the
+    # per-record call is the hot path each variant exists to shrink. The
+    # three stay in lockstep through the bit-identity property tests
+    # (tests/test_native_gate.py, tests/test_reply_views.py); change one
+    # only with its twins.
+    meta = array("q")
+    ext = meta.extend
+    ts = array("d")
+    parts: List[bytes] = []
+    append = parts.append
+    topic_idx = {}
+    topic_blob: List[bytes] = []
+    topic_lens = array("q")
+    cap = 0
+    for r in records:
+        t = r.topic
+        g = topic_idx.get(t)
+        if g is None:
+            g = topic_idx[t] = len(topic_idx)
+            tb = t.encode("utf-8")
+            topic_blob.append(tb)
+            topic_lens.append(len(tb))
+        key = r.key
+        value = r.value
+        flags = 0
+        klen = 0
+        vlen = 0
+        if key is not None:
+            kb = key.encode("utf-8")
+            flags = 1
+            klen = len(kb)
+            append(kb)
+        if value is None:
+            flags |= 2
+        else:
+            vlen = len(value)
+            append(value)
+        headers = r.headers
+        nbytes = klen + vlen + 64
+        if headers:
+            row = [g, r.partition, flags, klen, vlen, len(headers),
+                   r.offset]
+            for hk, hv in headers.items():
+                hkb = hk.encode("utf-8")
+                hvb = hv.encode("utf-8")
+                append(hkb)
+                append(hvb)
+                row.append(len(hkb))
+                row.append(len(hvb))
+                nbytes += len(hkb) + len(hvb) + 24
+            ext(row)
+        else:
+            ext((g, r.partition, flags, klen, vlen, 0, r.offset))
+        ts.append(r.timestamp)
+        # capacity bound in BYTES: topic_lens holds the UTF-8 byte length
+        # (len(t) counts characters — a CJK topic would overflow the buffer
+        # and silently disable the native leg)
+        cap += nbytes + topic_lens[g]
+    if not ts:
+        return b""
+    blob = b"".join(parts)
+    meta_c = (_C.c_int64 * len(meta)).from_buffer(meta)
+    lens_c = (_C.c_int64 * len(topic_lens)).from_buffer(topic_lens)
+    ts_c = (_C.c_double * len(ts)).from_buffer(ts)
+    out = _C.create_string_buffer(cap)
+    n = lib.surge_reply_format(meta_c, len(meta), blob, len(blob),
+                               b"".join(topic_blob), lens_c,
+                               len(topic_lens), ts_c, field, out, cap)
+    if n < 0:
+        return None
+    return out.raw[:n]
 
 
 def wal_append(fd: int, buf: bytes, do_fsync: bool) -> int:

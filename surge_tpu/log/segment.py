@@ -23,6 +23,7 @@ import struct
 import zlib
 from typing import List, Optional, Tuple
 
+from surge_tpu.log.common import SegmentRecordView
 from surge_tpu.log.transport import LogRecord
 
 MAGIC = b"SSEG"
@@ -167,30 +168,13 @@ def decode_records(payload: bytes, topic: str, partition: int,
                    native=None) -> List[LogRecord]:
     idx = _native_index(payload, count, native) if count else None
     if idx is not None:
+        # lazy views over the indexed payload: key/value/headers decode on
+        # access instead of one frozen-dataclass LogRecord per record —
+        # observably identical (equality/repr; tests/test_reply_views.py)
         rows, ts = idx
-        out = []
-        for i in range(count):
-            o = i * 7
-            flags = rows[o]
-            key = (payload[rows[o + 1]: rows[o + 1] + rows[o + 2]].decode()
-                   if flags & 1 else None)
-            value = (payload[rows[o + 3]: rows[o + 3] + rows[o + 4]]
-                     if not flags & 2 else None)
-            headers = {}
-            nh = rows[o + 6]
-            if nh:
-                pos = rows[o + 5]
-                for _ in range(nh):
-                    hklen, pos = _get_uvarint(payload, pos)
-                    hk = payload[pos: pos + hklen].decode()
-                    pos += hklen
-                    hvlen, pos = _get_uvarint(payload, pos)
-                    headers[hk] = payload[pos: pos + hvlen].decode()
-                    pos += hvlen
-            out.append(LogRecord(topic=topic, key=key, value=value,
-                                 partition=partition, headers=headers,
-                                 offset=base_offset + i, timestamp=ts[i]))
-        return out
+        return [SegmentRecordView(payload, rows, i * 7, topic, partition,
+                                  base_offset + i, ts[i])
+                for i in range(count)]
     out = []
     pos = 0
     for i in range(count):

@@ -256,6 +256,15 @@ METHODS = {
 }
 
 
+def _serialize_reply(msg):
+    """Response serializer for every method: native reply legs hand back
+    pre-serialized bytes (csrc/txn.cc surge_reply_format) which pass
+    through untouched; protobuf messages serialize as before."""
+    if isinstance(msg, bytes):
+        return msg
+    return msg.SerializeToString()
+
+
 def record_to_msg(r: LogRecord) -> pb.RecordMsg:
     msg = pb.RecordMsg(topic=r.topic, partition=r.partition,
                        offset=r.offset, timestamp=r.timestamp)
@@ -406,6 +415,11 @@ class LogServer:
         self._native = native_gate if native_gate.enabled(cfg) else None
         self._gate_decide = (native_gate.decide if self._native is not None
                              else native_gate.py_decide)
+        #: ops-plane native-path counters (BrokerStatus `native` row: an
+        #: operator can tell a silently-degraded broker — stale .so, flag
+        #: off — from a native one at a glance)
+        self._native_fallback_count = 0
+        self._native_ingest_count = 0
         self._repl_target_state: Dict[str, _TargetState] = {
             t: _TargetState() for t in self._repl_targets}
         # rejoin-probe transport: ONE cached channel per target, stubs derived
@@ -885,6 +899,7 @@ class LogServer:
                             batch = self._native.batch_from_request(request)
                             if batch is None:  # unparseable: Python path
                                 self.broker_metrics.native_fallbacks.record()
+                                self._native_fallback_count += 1
                                 use_native = False
                         if use_native:
                             # native fast path: ONE C++ call decodes the
@@ -1928,25 +1943,27 @@ class LogServer:
                     if spec.name not in known:
                         self.log.create_topic(TopicSpec(
                             spec.name, spec.partitions or 1, spec.compacted))
-                records = [msg_to_record(m) for m in request.records]
                 # idempotent ingest: a re-shipped batch (reply loss, or overlap
                 # with catch_up) skips records this log already holds; a record
-                # AHEAD of our end offset is a gap — out of sync, loud error
+                # AHEAD of our end offset is a gap — out of sync, loud error.
+                # The scan runs on the pb messages directly — LogRecords
+                # materialize only for the records actually applied (the
+                # native verbatim path then packs them once, off the GIL)
                 expected: Dict[tuple, int] = {}
                 to_apply = []
-                for r in records:
-                    tp = (r.topic, r.partition)
+                for m in request.records:
+                    tp = (m.topic, m.partition)
                     if tp not in expected:
-                        expected[tp] = self._applied_end(r.topic, r.partition)
-                    if r.offset < expected[tp]:
+                        expected[tp] = self._applied_end(m.topic, m.partition)
+                    if m.offset < expected[tp]:
                         continue  # already applied
-                    if r.offset > expected[tp]:
+                    if m.offset > expected[tp]:
                         return pb.ReplicateReply(
                             ok=False,
-                            error=f"gap: leader record {r.topic}"
-                                  f"[{r.partition}]@{r.offset} but replica end "
+                            error=f"gap: leader record {m.topic}"
+                                  f"[{m.partition}]@{m.offset} but replica end "
                                   f"is {expected[tp]} — re-sync via catch_up")
-                    to_apply.append(r)
+                    to_apply.append(msg_to_record(m))
                     expected[tp] += 1
                 if to_apply:
                     # verbatim ingest: leader-assigned offsets AND timestamps
@@ -1987,7 +2004,13 @@ class LogServer:
         (offsets then re-checked by the caller's gap scan)."""
         verbatim = getattr(self.log, "append_verbatim", None)
         if verbatim is not None:
-            return verbatim(records, allow_gaps=allow_gaps)
+            out = verbatim(records, allow_gaps=allow_gaps)
+            if getattr(self.log, "_native", None) is not None:
+                # the follower half of the PR-10 headroom note: shipped
+                # batches applied through the native batch path off the GIL
+                self._native_ingest_count += 1
+                self.broker_metrics.native_ingest_batches.record()
+            return out
         if self._replica_producer is None:
             self._replica_producer = self.log.transactional_producer(
                 "__replica__")
@@ -2159,7 +2182,27 @@ class LogServer:
                     # flight-ring occupancy + dropped-event count: whether
                     # the bounded ring wrapped mid-incident (a truncated
                     # DumpFlight story is tellable from the status alone)
-                    "flight": self.flight.stats()}
+                    "flight": self.flight.stats(),
+                    # native-path health (ISSUE 12 satellite): a broker
+                    # silently degraded to the Python fallback (stale .so,
+                    # flag off) is distinguishable from a native one
+                    "native": self.native_status()}
+
+    def native_status(self) -> dict:
+        """The ops-plane native row: whether the C++ hot path is live on
+        THIS broker, and how often it fell back. ``library`` False with
+        ``enabled`` True is the silently-degraded case (unbuilt/stale .so)
+        surgetop's `native` column and `chaos.py status` surface."""
+        return {"enabled": self._native is not None,
+                "library": native_gate.available(),
+                # the inner log's PINNED read-decode switch (FileLog ties
+                # reads to its own flag); ambient-config logs report the
+                # module-level switch
+                "decode": (getattr(self.log, "_native", None) is not None
+                           if hasattr(self.log, "_native")
+                           else native_gate.decode_enabled()),
+                "fallbacks": self._native_fallback_count,
+                "ingest_batches": self._native_ingest_count}
 
     def _hwm_by_topic(self) -> Dict[str, Dict[str, int]]:
         out: Dict[str, Dict[str, int]] = {}
@@ -3258,7 +3301,22 @@ class LogServer:
             # failover that truncates them can then never un-serve a read.
             recs = [r for r in recs if r.offset < gate]
             self.broker_metrics.hwm_gated_reads.record()
-        return pb.ReadReply(records=[record_to_msg(r) for r in recs])
+        return self._format_read_reply(recs)
+
+    def _format_read_reply(self, recs, fallback_cls=pb.ReadReply):
+        """Serialize a record list as ReadReply-shaped bytes (records =
+        field 1; LatestByKeyReply shares the wire shape) through the native
+        reply formatter (one C++ call, no per-record RecordMsg) — protobuf
+        path when native is off (bit-identical on the wire up to map
+        order, which protobuf readers ignore)."""
+        if self._native is not None and recs:
+            t0 = time.perf_counter()
+            data = self._native.reply_format(recs, 1)
+            if data is not None:
+                self.broker_metrics.native_reply_timer.record_ms(
+                    (time.perf_counter() - t0) * 1000.0)
+                return data
+        return fallback_cls(records=[record_to_msg(r) for r in recs])
 
     def EndOffset(self, request: pb.OffsetRequest, context) -> pb.OffsetReply:
         # NON-mutating membership check, not .topic(): inner logs auto-create
@@ -3297,8 +3355,7 @@ class LogServer:
             # serving a record a failover could erase)
             recs = [r for r in recs if r.offset < gate]
             self.broker_metrics.hwm_gated_reads.record()
-        return pb.LatestByKeyReply(records=[record_to_msg(r)
-                                            for r in recs])
+        return self._format_read_reply(recs, fallback_cls=pb.LatestByKeyReply)
 
     def CompactTopic(self, request: pb.ReadRequest, context) -> pb.TxnReply:
         """Compact one partition of a compacted topic broker-side (the
@@ -3441,7 +3498,7 @@ class LogServer:
             rpc[name] = grpc.unary_unary_rpc_method_handler(
                 self._wrap_handler(name, getattr(self, name)),
                 request_deserializer=req_cls.FromString,
-                response_serializer=reply_cls.SerializeToString)
+                response_serializer=_serialize_reply)
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=self._max_workers))
         self._server.add_generic_rpc_handlers(
@@ -3467,6 +3524,9 @@ class LogServer:
                 port=self._metrics_port,
                 collectors=[broker_collector(self)])
             self.metrics_bound_port = self._metrics_server.start()
+        # the surgetop `native` column: live C++ hot path vs silent fallback
+        self.broker_metrics.native_active.record(
+            1 if self._native is not None and native_gate.available() else 0)
         if self.role == "leader" and not self.leader_hint:
             self.leader_hint = self._my_target()
         if self._repl_targets:

@@ -251,6 +251,7 @@ class EngineMetrics:
     # the on-chip KTable's occupancy, incremental-fold cadence and read lane
     resident_occupancy: Sensor = field(init=False)
     resident_fold_round_timer: Timer = field(init=False)
+    resident_feed_timer: Timer = field(init=False)
     resident_fold_lag: Sensor = field(init=False)
     resident_gather_batch: Sensor = field(init=False)
     resident_fallbacks: Sensor = field(init=False)
@@ -373,6 +374,11 @@ class EngineMetrics:
         self.resident_fold_round_timer = m.timer(MI(
             "surge.replay.resident.fold-round-timer",
             "ms per incremental fold round (committed batch -> slab)"))
+        self.resident_feed_timer = m.timer(MI(
+            "surge.replay.resident.feed-timer",
+            "ms per refresh round's host feed leg: committed-tail read "
+            "(native record-index views) + event deserialize (one batch "
+            "decode on the native feed; surge.replay.resident.native-feed)"))
         self.resident_fold_lag = m.gauge(MI(
             "surge.replay.resident.fold-lag-records",
             "events committed past the plane's fold watermarks (reads fall "

@@ -69,6 +69,9 @@ class BrokerMetrics:
     native_batch_decode_timer: Timer = field(init=False)
     native_gate_batches: Sensor = field(init=False)
     native_fallbacks: Sensor = field(init=False)
+    native_reply_timer: Timer = field(init=False)
+    native_ingest_batches: Sensor = field(init=False)
+    native_active: Sensor = field(init=False)
     # majority-quorum promotion (vote layer)
     quorum_vote_requests: Sensor = field(init=False)
     quorum_votes_granted: Sensor = field(init=False)
@@ -163,6 +166,21 @@ class BrokerMetrics:
             "Transact batches that fell back to the pure-Python path on a "
             "native-enabled broker (unparseable request bytes — the "
             "bit-identical fallback contract, not an error)"))
+        self.native_reply_timer = m.timer(MI(
+            "surge.log.native.reply-timer",
+            "ms per native reply-leg format (csrc/txn.cc "
+            "surge_reply_format: Read/LatestByKey reply bytes emitted in "
+            "one call, no per-record RecordMsg materialization)"))
+        self.native_ingest_batches = m.counter(MI(
+            "surge.log.native.ingest-batches",
+            "replica Replicate batches verbatim-ingested through the "
+            "native path (csrc/txn.cc parse_packed_v + format_verbatim — "
+            "follower apply off the GIL; 0 = Python-path follower)"))
+        self.native_active = m.gauge(MI(
+            "surge.log.native.active",
+            "1 when this broker's native hot path is live (library built "
+            "AND surge.log.native.enabled); 0 = silently-degraded Python "
+            "fallback — the surgetop 'native' column"))
         self.quorum_vote_requests = m.counter(MI(
             "surge.log.quorum.vote-requests",
             "VoteLeader RPCs answered by this broker (each candidate's "

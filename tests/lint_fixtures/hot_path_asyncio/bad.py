@@ -1,0 +1,18 @@
+"""Known-bad: per-item event-loop round-trips in a fast-path module."""
+# surgelint: fast-path-module
+import asyncio
+
+
+class Publisher:
+    async def publish_all(self, records):
+        for r in records:
+            await self.log.append(r)  # line 9: await per record
+
+    async def queue_all(self, loop, records):
+        futs = []
+        for r in records:
+            futs.append(loop.create_future())  # line 14: Future per record
+        return futs
+
+    async def ask(self, fut):
+        return await asyncio.wait_for(fut, 5.0)  # line 18: wrapper task

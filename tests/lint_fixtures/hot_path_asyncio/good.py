@@ -1,0 +1,21 @@
+"""Known-good: batched awaits, batch-level futures, bare timer waits."""
+# surgelint: fast-path-module
+import asyncio
+
+from surge_tpu.common import wait_future
+
+
+class Publisher:
+    async def publish_all(self, records):
+        ack = asyncio.get_running_loop().create_future()  # one per batch
+        for r in records:
+            self._pending.append((r, ack))
+        self._wake.set()
+        await wait_future(ack, 5.0, owned=False)  # one await per batch
+
+    async def retry_ladder(self, fut):
+        for _attempt in range(3):  # bounded retry ladder, not per-record
+            try:
+                return await wait_future(fut, 5.0)
+            except asyncio.TimeoutError:
+                continue

@@ -100,6 +100,19 @@ def test_every_c_export_is_declared(label, table, filename):
         "them — add signatures (the loader must never call un-prototyped)")
 
 
+def test_issue12_exports_declared_both_sides():
+    """The reply formatter, verbatim-ingest and reply-index exports this PR
+    added must stay declared in the ctypes table AND defined in txn.cc (the
+    generic both-direction check above then gates their param counts and
+    pointer-ness) — a revert of either side fails loudly here."""
+    exports = _c_exports("txn.cc")
+    for sym in ("surge_txn_parse_packed_v", "surge_txn_group_base",
+                "surge_txn_format_verbatim", "surge_reply_count",
+                "surge_reply_index", "surge_reply_format"):
+        assert sym in TXN_SIGNATURES, f"{sym} missing from TXN_SIGNATURES"
+        assert sym in exports, f"{sym} missing from csrc/txn.cc"
+
+
 def test_tables_bind_against_built_libraries():
     """When the libraries are built (conftest builds them when g++ exists),
     every declared symbol must actually resolve."""

@@ -71,3 +71,62 @@ def test_bench_native_paired_ladder_smoke():
         assert rung[arm]["rounds"]
     assert rung["speedup_median"] > 0
     assert payload["value"] == rung["native_on"]["commands_per_sec_median"]
+
+
+def test_bench_lane_paired_ladder_smoke():
+    """SURGE_BENCH_LANE=1 (the r08 protocol): the paired interleaved
+    direct-vs-classic command-lane ladder emits per-rung medians for both
+    arms plus a speedup ratio, tiny-sized here (inproc only for speed)."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SURGE_BENCH_LADDER": "1",
+        "SURGE_BENCH_LANE": "1",
+        "SURGE_BENCH_LANE_ROUNDS": "1",
+        "SURGE_BENCH_LANE_BROKERS": "inproc",
+        "SURGE_BENCH_LATENCY_SECONDS": "0.3",
+        "SURGE_BENCH_LATENCY_LADDER": "8",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON payload on stdout: {proc.stdout!r}"
+    payload = json.loads(lines[-1])
+    paired = payload["lane_paired_ladder"]
+    assert paired["protocol"]["interleaved"] and paired["protocol"]["medians"]
+    (rung,) = paired["ladders"]["inproc"]
+    assert rung["workers"] == 8
+    for arm in ("direct", "classic"):
+        assert rung[arm]["commands_per_sec_median"] > 0
+        assert rung[arm]["rounds"]
+    assert rung["speedup_median"] > 0
+    assert payload["value"] == rung["direct"]["commands_per_sec_median"]
+
+
+def test_bench_resident_feed_paired_smoke():
+    """SURGE_BENCH_RESIDENT_FEED=1: the paired native-feed vs Python-feed
+    sustained-fold arms over one FileLog tail emit both medians + ratio."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SURGE_BENCH_RESIDENT_FEED": "1",
+        "SURGE_BENCH_FEED_EVENTS": "4000",
+        "SURGE_BENCH_FEED_AGGREGATES": "512",
+        "SURGE_BENCH_FEED_ROUNDS": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON payload on stdout: {proc.stdout!r}"
+    payload = json.loads(lines[-1])
+    paired = payload["resident_feed_paired"]
+    assert paired["native_feed_events_per_sec_median"] > 0
+    assert paired["python_feed_events_per_sec_median"] > 0
+    assert paired["speedup_median"] > 0
+    assert payload["value"] == paired["native_feed_events_per_sec_median"]

@@ -1,0 +1,498 @@
+"""The de-asyncio'd engine command lane (ISSUE 12).
+
+Batteries:
+
+- the DIRECT lane's mechanics: batch-level ack futures shared across a
+  forming batch and rotated at batch-max boundaries, queued-request joins
+  (a timed-out caller's retry rides the queued write), slim timer waits;
+- cancellation / fencing over the direct lane: caller-timeout rejoin
+  (queued AND mid-commit AND in-limbo), revoke-mid-dispatch, fence-mid-lane
+  with pipelined FileLog commits, publisher not-owner self-stop;
+- the PR-3/4 exactly-once battery parametrized over BOTH lanes and over
+  native-on/native-off — the lane change must be invisible to the
+  exactly-once contract.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from surge_tpu.common import wait_future
+from surge_tpu.config import default_config
+from surge_tpu.engine.publisher import (
+    PartitionPublisher,
+    PublishFailedError,
+    PublisherNotReadyError,
+)
+from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
+from surge_tpu.log import native_gate as ng
+from surge_tpu.store import StateStoreIndexer
+
+from tests.test_native_gate import NATIVE_MODES
+
+LANES = ["direct", "classic"]
+
+
+def _cfg(lane: str, **extra):
+    over = {
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 20,
+        "surge.producer.command-lane": lane,
+    }
+    over.update(extra)
+    return default_config().with_overrides(over)
+
+
+def make_log():
+    log = InMemoryLog()
+    log.create_topic(TopicSpec("events", 1))
+    log.create_topic(TopicSpec("state", 1, compacted=True))
+    return log
+
+
+def event_rec(agg, value):
+    return LogRecord(topic="events", key=agg, value=value, partition=0)
+
+
+async def start_stack(log, cfg, **pub_kwargs):
+    indexer = StateStoreIndexer(log, "state", config=cfg)
+    await indexer.start()
+    pub = PartitionPublisher(log, "state", "events", 0, indexer, config=cfg,
+                             **pub_kwargs)
+    await pub.start()
+    await pub.wait_ready(5.0)
+    return indexer, pub
+
+
+# -- direct-lane mechanics ---------------------------------------------------
+
+
+def test_direct_lane_shares_one_ack_per_forming_batch():
+    """The tentpole shape itself: pendings of one forming batch share ONE
+    future object; the ack rotates at the batch-max-records boundary so a
+    drained batch never shares its ack with still-queued pendings."""
+    async def scenario():
+        log = make_log()
+        cfg = _cfg("direct", **{"surge.producer.linger-ms": 50,
+                                "surge.producer.flush-interval-ms": 50,
+                                "surge.producer.batch-max-records": 3})
+        indexer, pub = await start_stack(log, cfg)
+        acks = [pub.publish("a", [event_rec("a", b"%d" % i)], f"r{i}")
+                for i in range(5)]
+        assert all(isinstance(a, asyncio.Future) for a in acks)
+        # 3-record batch boundary: r0-r2 share one ack, r3-r4 the next
+        assert acks[0] is acks[1] is acks[2]
+        assert acks[3] is acks[4]
+        assert acks[0] is not acks[3]
+        await asyncio.gather(*set(acks))
+        assert [r.value for r in log.read("events", 0)] == \
+            [b"0", b"1", b"2", b"3", b"4"]
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_classic_lane_keeps_per_command_futures():
+    async def scenario():
+        log = make_log()
+        cfg = _cfg("classic", **{"surge.producer.linger-ms": 50})
+        indexer, pub = await start_stack(log, cfg)
+        a1 = pub.publish("a", [event_rec("a", b"x")], "r1")
+        a2 = pub.publish("a", [event_rec("a", b"y")], "r2")
+        assert a1 is not a2
+        await pub.flush_now()
+        await asyncio.gather(a1, a2)
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_direct_caller_timeout_rejoins_queued_write_exactly_once():
+    """A caller whose slim timer wait times out leaves its records QUEUED;
+    the same-request_id retry gets the SAME batch ack (a join, counted as a
+    dedup hit) and the write commits exactly once."""
+    async def scenario():
+        log = make_log()
+        cfg = _cfg("direct", **{"surge.producer.linger-ms": 200,
+                                "surge.producer.flush-interval-ms": 200})
+        indexer, pub = await start_stack(log, cfg)
+        ack = pub.publish("a", [event_rec("a", b"e1")], "req-1")
+        with pytest.raises(asyncio.TimeoutError):
+            await wait_future(ack, 0.01, owned=False)  # entity-style timeout
+        assert not ack.cancelled()  # the shared ack survives the timeout
+        rejoin = pub.publish("a", [event_rec("a", b"e1")], "req-1")
+        assert rejoin is ack
+        assert pub.stats.dedup_hits == 1
+        await pub.flush_now()
+        await wait_future(ack, 5.0, owned=False)
+        assert [r.value for r in log.read("events", 0)] == [b"e1"]
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_direct_cancelled_ack_is_refreshed_for_rejoiners():
+    """A caller that CANCELS the shared ack outright (the classic reflex)
+    must not poison later rejoiners: the retry gets a fresh future wired to
+    the same queued write, which still commits exactly once."""
+    async def scenario():
+        log = make_log()
+        cfg = _cfg("direct", **{"surge.producer.linger-ms": 200,
+                                "surge.producer.flush-interval-ms": 200})
+        indexer, pub = await start_stack(log, cfg)
+        ack = pub.publish("a", [event_rec("a", b"e1")], "req-1")
+        ack.cancel()
+        rejoin = pub.publish("a", [event_rec("a", b"e1")], "req-1")
+        assert rejoin is not ack and not rejoin.done()
+        await pub.flush_now()
+        await wait_future(rejoin, 5.0, owned=False)
+        assert [r.value for r in log.read("events", 0)] == [b"e1"]
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+@pytest.mark.parametrize("lane", LANES)
+def test_caller_timeout_rejoins_mid_commit(lane):
+    """Retry arriving while the batch is MID-COMMIT joins the commit outcome
+    (the _committing registry) on both lanes."""
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log, _cfg(lane))
+        outcome = asyncio.get_running_loop().create_future()
+        pub._committing["req-1"] = outcome
+        join = asyncio.ensure_future(
+            pub.publish("a", [event_rec("a", b"dup")], "req-1"))
+        await asyncio.sleep(0.02)
+        assert not join.done() and pub._pending == []
+        outcome.set_result(None)
+        await join
+        assert log.end_offset("events", 0) == 0  # nothing re-queued
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+@pytest.mark.parametrize("lane", LANES)
+def test_caller_timeout_rejoins_in_limbo_batch(lane):
+    """Retry of a request whose batch is stashed for verbatim retry rides
+    the in-limbo batch on both lanes — exactly once when it heals."""
+    import unittest.mock as mock
+
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log, _cfg(lane))
+        real_commit = pub._producer.commit
+        fail = {"n": 2}
+
+        def flaky_commit():
+            if fail["n"] > 0:
+                fail["n"] -= 1
+                raise ConnectionError("transport flapping")
+            return real_commit()
+
+        with mock.patch.object(pub._producer, "commit", flaky_commit):
+            t1 = asyncio.ensure_future(
+                pub.publish("a", [event_rec("a", b"e1")], "req-1"))
+            for _ in range(200):
+                await asyncio.sleep(0.005)
+                if pub._retry_batches:
+                    break
+            assert pub._retry_batches
+            t1.cancel()
+            try:
+                await t1
+            except asyncio.CancelledError:
+                pass
+            rejoin = asyncio.ensure_future(
+                pub.publish("a", [event_rec("a", b"e1")], "req-1"))
+            await asyncio.wait_for(rejoin, 5.0)
+        assert [r.value for r in log.read("events", 0)] == [b"e1"]
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+# -- fencing over the direct lane --------------------------------------------
+
+
+@pytest.mark.parametrize("lane", LANES)
+def test_revoke_mid_dispatch_not_owner_self_stops(lane):
+    """Fenced while NOT the partition owner: the lane fails the held batch
+    and self-stops; nothing half-writes."""
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log, _cfg(lane),
+                                         still_owner=lambda: False)
+        before = log.end_offset("events", 0)
+        log.transactional_producer(pub.transactional_id)  # impostor fences
+        with pytest.raises((PublishFailedError, PublisherNotReadyError)):
+            await pub.publish("a", [event_rec("a", b"zombie")], "r1")
+        assert pub.stats.fences == 1
+        assert pub.state == "stopped"
+        assert log.end_offset("events", 0) == before
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+@pytest.mark.parametrize("lane", LANES)
+def test_fence_mid_lane_still_owner_transparent(lane):
+    """Fenced while still the owner: the in-flight batch rides the verbatim
+    retry across re-init and commits exactly once, invisibly to callers."""
+    async def scenario():
+        log = make_log()
+        indexer, pub = await start_stack(log, _cfg(lane),
+                                         still_owner=lambda: True)
+        log.transactional_producer(pub.transactional_id)  # fence it once
+        await pub.publish("a", [event_rec("a", b"held")], "r1")
+        await pub.wait_ready(5.0)
+        assert pub.stats.reinitializations == 1
+        assert [r.value for r in log.read("events", 0)] == [b"held"]
+        # a late same-request retry of the held batch is absorbed
+        await pub.publish("a", [event_rec("a", b"held")], "r1")
+        assert [r.value for r in log.read("events", 0)] == [b"held"]
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+@pytest.mark.parametrize("native", NATIVE_MODES)
+@pytest.mark.parametrize("lane", LANES)
+def test_fence_mid_lane_pipelined_filelog(tmp_path, lane, native):
+    """Fencing between pipelined FileLog dispatches: stash, re-init, commit
+    exactly once — over both lanes AND both gates."""
+    from surge_tpu.log.file import FileLog
+
+    async def scenario():
+        cfg = _cfg(lane, **{"surge.log.native.enabled": native})
+        log = FileLog(str(tmp_path / "log"), config=cfg)
+        log.create_topic(TopicSpec("events", 1))
+        log.create_topic(TopicSpec("state", 1, compacted=True))
+        indexer = StateStoreIndexer(log, "state", config=cfg)
+        await indexer.start()
+        pub = PartitionPublisher(log, "state", "events", 0, indexer,
+                                 config=cfg, still_owner=lambda: True)
+        await pub.start()
+        await pub.wait_ready(5.0)
+        assert pub._pipeline_capable()
+        await pub.publish("a", [event_rec("a", b"before")], "r0")
+        log.transactional_producer(pub.transactional_id)  # fence mid-lane
+        await asyncio.wait_for(
+            pub.publish("a", [event_rec("a", b"held")], "r1"), 10.0)
+        await pub.wait_ready(5.0)
+        assert pub.stats.reinitializations == 1
+        await pub.publish("a", [event_rec("a", b"held")], "r1")  # absorbed
+        assert [r.value for r in log.read("events", 0)] == \
+            [b"before", b"held"]
+        await pub.stop()
+        await indexer.stop()
+        log.close()
+
+    asyncio.run(scenario())
+
+
+# -- exactly-once stream battery over lane x native --------------------------
+
+
+@pytest.mark.parametrize("native", NATIVE_MODES)
+@pytest.mark.parametrize("lane", LANES)
+def test_exactly_once_stream_battery(tmp_path, lane, native):
+    """Concurrent per-aggregate streams through pipelined FileLog commits:
+    every record lands exactly once, in order within its aggregate — the
+    PR-3/4 contract, unchanged by the lane mode and the native gate."""
+    from surge_tpu.log.file import FileLog
+
+    async def scenario():
+        cfg = _cfg(lane, **{"surge.log.native.enabled": native,
+                            "surge.producer.linger-ms": 0,
+                            "surge.producer.max-in-flight": 4})
+        log = FileLog(str(tmp_path / "log"), config=cfg)
+        log.create_topic(TopicSpec("events", 1))
+        log.create_topic(TopicSpec("state", 1, compacted=True))
+        indexer = StateStoreIndexer(log, "state", config=cfg)
+        await indexer.start()
+        pub = PartitionPublisher(log, "state", "events", 0, indexer,
+                                 config=cfg)
+        await pub.start()
+        await pub.wait_ready(5.0)
+
+        async def stream(agg, n):
+            for i in range(n):
+                await pub.publish(agg, [event_rec(agg, b"%s-%d" % (
+                    agg.encode(), i))], f"{agg}-{i}")
+
+        await asyncio.gather(*(stream(f"agg{j}", 8) for j in range(5)))
+        values = [r.value for r in log.read("events", 0)]
+        assert len(values) == 40 and len(set(values)) == 40
+        for j in range(5):
+            seq = [v for v in values if v.startswith(b"agg%d-" % j)]
+            assert seq == sorted(seq, key=lambda v: int(v.split(b"-")[-1]))
+        await pub.stop()
+        await indexer.stop()
+        log.close()
+
+    asyncio.run(scenario())
+
+
+# -- the slim wait primitive --------------------------------------------------
+
+
+def test_wait_future_owned_timeout_cancels_and_raises():
+    async def scenario():
+        fut = asyncio.get_running_loop().create_future()
+        with pytest.raises(asyncio.TimeoutError):
+            await wait_future(fut, 0.01)
+        assert fut.cancelled()
+
+    asyncio.run(scenario())
+
+
+def test_wait_future_shared_timeout_leaves_future_alone():
+    async def scenario():
+        fut = asyncio.get_running_loop().create_future()
+        with pytest.raises(asyncio.TimeoutError):
+            await wait_future(fut, 0.01, owned=False)
+        assert not fut.done()
+        fut.set_result("late")
+        assert await wait_future(fut, 1.0, owned=False) == "late"
+
+    asyncio.run(scenario())
+
+
+def test_wait_future_outer_cancel_not_swallowed():
+    """An outer task cancellation must surface as CancelledError — never be
+    misread as a timeout (the py3.10 wait_for swallow class)."""
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        for owned in (True, False):
+            fut = loop.create_future()
+            state = {}
+
+            async def waiter():
+                try:
+                    await wait_future(fut, 5.0, owned=owned)
+                except asyncio.CancelledError:
+                    state["outcome"] = "cancelled"
+                    raise
+                except asyncio.TimeoutError:  # pragma: no cover — the bug
+                    state["outcome"] = "timeout"
+
+            t = asyncio.ensure_future(waiter())
+            await asyncio.sleep(0.01)
+            t.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await t
+            assert state["outcome"] == "cancelled", owned
+            if not owned:
+                assert not fut.done()  # shared future untouched
+
+    asyncio.run(scenario())
+
+
+def test_wait_future_propagates_result_and_exception():
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        f1 = loop.create_future()
+        loop.call_later(0.01, f1.set_result, 42)
+        assert await wait_future(f1, 5.0) == 42
+        f2 = loop.create_future()
+        loop.call_later(0.01, f2.set_exception, ValueError("boom"))
+        with pytest.raises(ValueError):
+            await wait_future(f2, 5.0, owned=False)
+
+    asyncio.run(scenario())
+
+
+def test_direct_slow_path_cancel_does_not_kill_shared_ack():
+    """A slow-path publish (coroutine, cancel-on-timeout wrapper) whose task
+    is cancelled must NOT cancel the shared batch ack its siblings ride —
+    the slow-path tail awaits the ack shielded."""
+    async def scenario():
+        log = make_log()
+        cfg = _cfg("direct", **{"surge.producer.linger-ms": 200,
+                                "surge.producer.flush-interval-ms": 200})
+        indexer, pub = await start_stack(log, cfg)
+        # a sibling on the fast path shares the forming batch's ack
+        sibling = pub.publish("a", [event_rec("a", b"sib")], "r-sib")
+        slow = asyncio.ensure_future(
+            pub._publish_slow("b", [event_rec("b", b"slow")], "r-slow"))
+        await asyncio.sleep(0.01)
+        slow.cancel()
+        try:
+            await slow
+        except asyncio.CancelledError:
+            pass
+        assert not sibling.cancelled()  # the shared ack survived
+        await pub.flush_now()
+        await wait_future(sibling, 5.0, owned=False)
+        assert sorted(r.value for r in log.read("events", 0)) == \
+            [b"sib", b"slow"]  # both queued writes committed exactly once
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())
+
+
+def test_wait_future_shared_inner_cancel_surfaces_as_retryable():
+    """A shared future cancelled by ANOTHER holder surfaces to innocent
+    waiters as a plain retryable RuntimeError, never CancelledError (which
+    would blow through the entity retry ladder)."""
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        loop.call_later(0.01, fut.cancel)
+        with pytest.raises(RuntimeError):
+            await wait_future(fut, 5.0, owned=False)
+
+    asyncio.run(scenario())
+
+
+def test_wait_future_shared_already_cancelled_fast_path():
+    """The done-fast-path honors the shared contract too: an ALREADY
+    cancelled shared future raises the retryable RuntimeError, never
+    CancelledError."""
+    async def scenario():
+        fut = asyncio.get_running_loop().create_future()
+        fut.cancel()
+        with pytest.raises(RuntimeError):
+            await wait_future(fut, 1.0, owned=False)
+
+    asyncio.run(scenario())
+
+
+def test_slow_path_join_converts_coholder_cancel_to_retryable():
+    """A co-holder cancelling the shared ack while a slow-path rejoiner is
+    parked on it surfaces as retryable PublishFailedError to the rejoiner
+    (the retry ladder rejoins by request id) — never CancelledError."""
+    async def scenario():
+        log = make_log()
+        cfg = _cfg("direct", **{"surge.producer.linger-ms": 200,
+                                "surge.producer.flush-interval-ms": 200})
+        indexer, pub = await start_stack(log, cfg)
+        ack = pub.publish("a", [event_rec("a", b"e1")], "req-1")
+        join = asyncio.ensure_future(
+            pub._publish_slow("a", [event_rec("a", b"e1")], "req-1"))
+        await asyncio.sleep(0.01)
+        ack.cancel()  # the co-holder's classic reflex
+        with pytest.raises(PublishFailedError):
+            await join
+        # the records are still queued; the retry commits exactly once
+        rejoin = pub.publish("a", [event_rec("a", b"e1")], "req-1")
+        await pub.flush_now()
+        await wait_future(rejoin, 5.0, owned=False)
+        assert [r.value for r in log.read("events", 0)] == [b"e1"]
+        await pub.stop()
+        await indexer.stop()
+
+    asyncio.run(scenario())

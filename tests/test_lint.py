@@ -68,6 +68,7 @@ MODULE_RULE_CASES = [
     ("waitfor-cancellation-swallow", "waitfor_cancellation_swallow", [8, 12]),
     ("orphan-task", "orphan_task", [7, 10]),
     ("jit-purity", "jit_purity", [12, 13, 14, 15]),
+    ("hot-path-asyncio", "hot_path_asyncio", [9, 14, 18]),
 ]
 
 

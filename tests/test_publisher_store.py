@@ -667,7 +667,10 @@ def test_caller_timeout_rejoins_in_limbo_batch_exactly_once():
         with mock.patch.object(pub._producer, "commit", flaky_commit):
             t1 = asyncio.ensure_future(
                 pub.publish("a", [event_rec("a", b"e1")], "req-1"))
-            await asyncio.sleep(0.02)
+            for _ in range(200):  # until the failed batch is stashed
+                await asyncio.sleep(0.005)
+                if pub._retry_batches:
+                    break
             assert pub._retry_batches
             t1.cancel()  # the caller's publish timeout fires
             try:

@@ -333,6 +333,16 @@ def test_quorum_acks_mask_failing_follower():
         _stop_all(leader, f1, f2)
 
 
+def read_reply(server, request) -> pb.ReadReply:
+    """Normalize an in-process Read answer: the native reply leg hands back
+    pre-serialized ReadReply bytes (what the wire carries); the Python path
+    hands back the message."""
+    reply = server.Read(request, None)
+    if isinstance(reply, bytes):
+        return pb.ReadReply.FromString(reply)
+    return reply
+
+
 def test_hwm_gate_clamps_follower_reads_and_end_offset_reports_it():
     """The gate itself, deterministically: a follower holding records ABOVE
     its shipped high-watermark serves only the records below it — applied
@@ -345,16 +355,16 @@ def test_hwm_gate_clamps_follower_reads_and_end_offset_reports_it():
         f.log.append_verbatim([rec("ev", f"k{o}", f"v{o}".encode(), offset=o)
                                for o in range(4)])
         f._hwm[("ev", 0)] = 2  # the last shipped quorum frontier
-        reply = f.Read(pb.ReadRequest(topic="ev", partition=0,
-                                      from_offset=0), None)
+        reply = read_reply(f, pb.ReadRequest(topic="ev", partition=0,
+                                             from_offset=0))
         assert [m.value for m in reply.records] == [b"v0", b"v1"]
         off = f.EndOffset(pb.OffsetRequest(topic="ev", partition=0), None)
         assert off.end_offset == 4 and off.high_watermark == 2
         # an UNGATED partition (no hwm ever shipped) keeps PR-4 semantics
         f.log.create_topic(TopicSpec("legacy", 1))
         f.log.append_verbatim([rec("legacy", "k", b"v", offset=0)])
-        reply = f.Read(pb.ReadRequest(topic="legacy", partition=0,
-                                      from_offset=0), None)
+        reply = read_reply(f, pb.ReadRequest(topic="legacy", partition=0,
+                                             from_offset=0))
         assert [m.value for m in reply.records] == [b"v"]
         # BrokerStatus surfaces the per-partition hwm (chaos.py's view)
         assert f.broker_status()["high_watermarks"]["ev"]["0"] == 2

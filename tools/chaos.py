@@ -137,6 +137,13 @@ def main(argv=None) -> int:
             # replication-lag gauges, no scraper required
             out = dict(client.fault_stats())
             try:
+                # native-path health: a silently-degraded broker (stale .so
+                # -> Python fallback) is visible at a glance
+                out["native"] = client.broker_status().get(
+                    "native", "unavailable")
+            except Exception as exc:  # noqa: BLE001 — older broker
+                out["native"] = f"unavailable: {exc!r}"
+            try:
                 out["flight_tail"] = client.flight_dump(
                     last=args.tail)["events"]
             except Exception as exc:  # noqa: BLE001 — older broker
@@ -242,6 +249,7 @@ def _cluster(args) -> int:
                 "quorum": status.get("quorum", {}),
                 "handoff_fence": status.get("handoff_fence", False),
                 "catch_up": status.get("catch_up", {}),
+                "native": status.get("native", {}),
             }
             try:
                 row["faults"] = client.fault_stats()
