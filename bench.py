@@ -965,6 +965,54 @@ def failover_bench() -> dict:
     return out
 
 
+def soak_bench() -> dict:
+    """SURGE_BENCH_SOAK=1: the sustained self-healing soak
+    (surge_tpu.cluster.soak) across several seeded chaos schedules on a
+    3+-broker spread cluster — rolling kills (coordinator on odd seeds,
+    partition leaders on even), seeded link faults, AddBroker/RemoveBroker
+    churn, Zipf hot-key skew — each scored by a federated scrape + the SLO
+    burn-rate engine and the autobalancer driving planned per-partition
+    handoffs.
+
+    Env: SURGE_BENCH_SOAK_SEEDS (comma list; default 41,42,43,44),
+    SURGE_BENCH_SOAK_SECONDS (12 per seed), SURGE_BENCH_SOAK_BROKERS (3),
+    SURGE_BENCH_SOAK_PARTITIONS (6), SURGE_BENCH_SOAK_WRITERS (4).
+
+    The verdict aggregates every seed: total acked commits, 0 lost / 0
+    duplicated, exactly-one-leader-per-partition convergence, every SLO page
+    cleared, and the autobalancer decision/move counts from the merged
+    flight timelines."""
+    from surge_tpu.cluster.soak import run_soak
+
+    seeds = [int(s) for s in os.environ.get(
+        "SURGE_BENCH_SOAK_SEEDS", "41,42,43,44").split(",") if s.strip()]
+    seconds = float(os.environ.get("SURGE_BENCH_SOAK_SECONDS", 12.0))
+    brokers = int(os.environ.get("SURGE_BENCH_SOAK_BROKERS", 3))
+    partitions = int(os.environ.get("SURGE_BENCH_SOAK_PARTITIONS", 6))
+    writers = int(os.environ.get("SURGE_BENCH_SOAK_WRITERS", 4))
+    rounds = []
+    for seed in seeds:
+        log(f"soak bench: seed {seed} ({seconds:.0f}s schedule)")
+        rounds.append(run_soak(seed, brokers=brokers, partitions=partitions,
+                               seconds=seconds, writers=writers))
+    verdict_ok = all(
+        r["lost"] == 0 and r["duplicated"] == 0 and r["leaders"]["ok"]
+        and r["converged"] and r["slo_pages"]["cleared"]
+        and not r["writer_errors"] for r in rounds)
+    return {
+        "soak_rounds": rounds,
+        "soak_seeds": seeds,
+        "soak_acked_commits": sum(r["acked_commits"] for r in rounds),
+        "soak_lost": sum(r["lost"] for r in rounds),
+        "soak_duplicated": sum(r["duplicated"] for r in rounds),
+        "soak_pages_raised": sum(r["slo_pages"]["raised"] for r in rounds),
+        "soak_pages_cleared": all(r["slo_pages"]["cleared"] for r in rounds),
+        "soak_balancer_moves": sum(r["balancer_moves"] for r in rounds),
+        "soak_verdict": "ok: self-healed every schedule" if verdict_ok
+        else "DEGRADED: see soak_rounds",
+    }
+
+
 def handoff_bench() -> dict:
     """SURGE_BENCH_HANDOFF=1: paired interleaved ladder (medians only, per
     the BENCH_NOTES round-6 protocol — single runs swing 2-3x on this host)
@@ -1655,6 +1703,19 @@ def main() -> None:
         stats = failover_bench()
         payload.update(stats)
         payload["value"] = stats.get("failover_unavailability_ms") or 0
+        emit(payload)
+        return
+
+    # SURGE_BENCH_SOAK=1: sustained seeded chaos soak — a 3+-broker spread
+    # cluster under rolling kills, link faults, membership churn and Zipf
+    # skew, scored by the SLO engine; the verdict is 0 lost / 0 duplicated,
+    # exactly one leader per partition, every burn-rate page cleared after
+    # its heal, and the autobalancer's decisions on the merged timeline
+    if os.environ.get("SURGE_BENCH_SOAK", "0") == "1":
+        payload = {"metric": "soak_acked_commits", "value": 0, "unit": "ok"}
+        stats = soak_bench()
+        payload.update(stats)
+        payload["value"] = stats.get("soak_acked_commits", 0)
         emit(payload)
         return
 

@@ -1,0 +1,30 @@
+"""Cluster self-healing plane: partition routing, the autobalancer, chaos soak.
+
+The composition layer ROADMAP item 1 asked for: the broker already has
+per-partition vote/fence/hwm machinery (PR 7), a deterministic fault plane
+(PR 4), federated scrape + SLO burn rates (PR 9) and a fenced
+``HandoffPartition`` — this package closes the loop so the fleet survives
+broker churn and load skew without an operator:
+
+- :class:`~surge_tpu.cluster.router.PartitionRouter` — a LogTransport-
+  protocol client that learns the cluster's partition→leader map
+  (``ClusterMeta`` bootstrap fetch) and routes every producer commit and
+  read to the partition's CURRENT leader, invalidating its cache on
+  ``NOT_LEADER``/fence/connect failure;
+- :class:`~surge_tpu.cluster.autobalancer.Autobalancer` — a supervised
+  ``Controllable`` that consumes one federated-scrape pass + the SLO
+  engine's burn rates per cycle, scores brokers on burn/lag/lead-count, and
+  drives planned per-partition ``HandoffPartition`` moves off burning or
+  overloaded brokers (hysteresis, a move budget per window, dry-run mode;
+  every decision lands on its flight recorder);
+- :mod:`~surge_tpu.cluster.soak` — the seeded chaos soak that proves the
+  whole loop: rolling kills, fsync stalls, link faults, membership churn
+  and Zipf hot-key skew on a 3+-broker fleet, scored by the SLO engine with
+  a 0-lost / 0-duplicated / exactly-one-leader-per-partition verdict
+  (``SURGE_BENCH_SOAK=1``; the 3-seed fast variant runs in tier-1).
+"""
+
+from surge_tpu.cluster.autobalancer import Autobalancer
+from surge_tpu.cluster.router import PartitionRouter, RoutedProducer
+
+__all__ = ["Autobalancer", "PartitionRouter", "RoutedProducer"]

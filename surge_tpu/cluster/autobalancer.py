@@ -1,0 +1,275 @@
+"""SLO-driven autobalancer: the control loop that keeps the spread healthy.
+
+One cycle = one federated-scrape pass (which also evaluates the SLO engine)
+plus one ClusterMeta fetch, scored into per-broker rows:
+
+- ``up`` — did the member answer the scrape (down members are failover
+  candidates the COORDINATOR's reassign-grace sweep owns; the balancer only
+  records the observation);
+- ``leads`` — partition indices led (from the assignment map);
+- ``lag`` — the member's ``surge_log_hwm_lag_records`` gauge (how far its
+  applied frontier runs ahead of the quorum-acked one: the load signal);
+- ``burning`` — whether any SLO objective is in breach this cycle.
+
+Decisions: when the lead-count skew across UP members exceeds
+``surge.cluster.balancer.max-lead-skew`` — or an SLO is burning and one up
+member carries a clearly-worst lag — the balancer drives ONE planned
+per-partition ``HandoffPartition`` move per cycle from the busiest member to
+the least loaded, under three brakes: per-partition **hysteresis** (a
+just-moved partition is not moved again within the window), a **move
+budget** per time window, and **dry-run** mode (decide + record, never
+move). Every decision — executed, skipped, or dry — lands on the balancer's
+flight recorder, so a heal is reconstructable from the merged timeline next
+to the broker-side promotion/fence/reassign events it caused.
+
+Supervision: the balancer is a :class:`~surge_tpu.common.Controllable`
+(async start/stop around a daemon thread), registrable with the health
+supervisor like any other component; ``cycle()`` is also directly callable
+for deterministic tests and the chaos soak.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from surge_tpu.common import Ack, Controllable, logger
+from surge_tpu.config import Config, default_config
+from surge_tpu.observability.flight import FlightRecorder
+
+__all__ = ["Autobalancer"]
+
+
+class Autobalancer(Controllable):
+    """Scrape → score → (maybe) move one partition. See the module doc."""
+
+    def __init__(self, scraper, brokers, config: Config | None = None,
+                 slo=None, metrics=None, flight: FlightRecorder | None = None,
+                 transport_factory: Optional[Callable[[str], object]] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        cfg = config or default_config()
+        self.scraper = scraper
+        #: bootstrap broker addresses for the ClusterMeta fetch (any member)
+        self.brokers = ([b.strip() for b in brokers.split(",") if b.strip()]
+                        if isinstance(brokers, str) else list(brokers))
+        self.slo = slo if slo is not None else getattr(scraper, "slo", None)
+        self.metrics = metrics if metrics is not None \
+            else getattr(scraper, "metrics", None)
+        self.flight = flight if flight is not None else FlightRecorder(
+            name="autobalancer", role="balancer")
+        self._clock = clock
+        self.interval_s = cfg.get_seconds(
+            "surge.cluster.balancer.interval-ms", 5_000)
+        self.move_budget = cfg.get_int("surge.cluster.balancer.move-budget",
+                                       4)
+        self.window_s = cfg.get_seconds("surge.cluster.balancer.window-ms",
+                                        60_000)
+        self.hysteresis_s = cfg.get_seconds(
+            "surge.cluster.balancer.hysteresis-ms", 30_000)
+        self.max_lead_skew = max(1, cfg.get_int(
+            "surge.cluster.balancer.max-lead-skew", 1))
+        self.dry_run = cfg.get_bool("surge.cluster.balancer.dry-run", False)
+        self._config = cfg
+        self._transport_factory = transport_factory
+        self._transports: Dict[str, object] = {}
+        #: partition key -> monotonic stamp of OUR last move of it
+        self._last_move: Dict[str, float] = {}
+        #: monotonic stamps of executed moves inside the budget window
+        self._moves: List[float] = []
+        self.cycles = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle (supervised Controllable) ----------------------------------------------
+
+    async def start(self) -> Ack:
+        self.start_sync()
+        return Ack()
+
+    async def stop(self) -> Ack:
+        self.stop_sync()
+        return Ack()
+
+    def start_sync(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run,
+                                            name="surge-autobalancer",
+                                            daemon=True)
+            self._thread.start()
+
+    def stop_sync(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(self.interval_s + 2.0)
+        self._thread = None
+        for t in self._transports.values():
+            try:
+                t.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self._transports.clear()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.cycle()
+            except Exception:  # noqa: BLE001 — the loop must survive a bad pass
+                logger.exception("autobalancer cycle failed; continuing")
+
+    # -- transports -----------------------------------------------------------------------
+
+    def _transport(self, addr: str):
+        hit = self._transports.get(addr)
+        if hit is None:
+            if self._transport_factory is not None:
+                hit = self._transport_factory(addr)
+            else:
+                from surge_tpu.log.client import GrpcLogTransport
+
+                hit = GrpcLogTransport(addr, config=self._config)
+            self._transports[addr] = hit
+        return hit
+
+    def _drop_transport(self, addr: str) -> None:
+        t = self._transports.pop(addr, None)
+        if t is not None:
+            try:
+                t.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _fetch_meta(self) -> Optional[dict]:
+        for addr in list(self.brokers):
+            try:
+                return self._transport(addr).cluster_meta()
+            except Exception:  # noqa: BLE001 — try the next bootstrap broker
+                self._drop_transport(addr)
+        return None
+
+    # -- one decision pass ----------------------------------------------------------------
+
+    def cycle(self) -> dict:
+        """One scrape→score→decide pass; returns the decision record (also
+        flight-recorded). Safe to call directly (tests, the soak's
+        deterministic loop) — the background thread just calls it on a
+        timer."""
+        self.cycles += 1
+        summary = self.scraper.scrape_once()
+        meta = self._fetch_meta()
+        if meta is None:
+            out = {"decision": "skip", "reason": "no-member-reachable",
+                   "errors": summary.get("errors")}
+            self.flight.record("balance.skip", **out)
+            return out
+        merged = self.scraper.last_merged()  # one merge, both extractions
+        up = self.scraper.instance_values("up", merged=merged)
+        lag = self.scraper.instance_values("surge_log_hwm_lag_records",
+                                           merged=merged)
+        assignments: Dict[str, str] = dict(meta.get("assignments") or {})
+        members: List[str] = list(meta.get("members") or [])
+        burning = list(self.slo.breached()) if self.slo is not None else []
+        rows: Dict[str, dict] = {}
+        for m in members:
+            leads = sorted(int(k) for k, v in assignments.items() if v == m)
+            rows[m] = {"up": bool(up.get(m, 0.0)),
+                       "leads": leads,
+                       "lag": float(lag.get(m, 0.0))}
+        if self.metrics is not None:
+            counts = [len(r["leads"]) for r in rows.values() if r["up"]]
+            skew = (max(counts) - min(counts)) if counts else 0
+            self.metrics.balancer_cycles.record()
+            self.metrics.balancer_lead_skew.record(skew)
+        decision = self._decide(rows, burning)
+        decision["cycle"] = self.cycles
+        if burning:
+            decision["burning"] = burning
+        self.flight.record("balance." + ("move" if decision["decision"]
+                                         == "move" else "skip"),
+                           **{k: v for k, v in decision.items()
+                              if k != "decision"})
+        if decision["decision"] == "move" and not decision.get("dry_run"):
+            self._execute(decision)
+        elif (decision["decision"] == "move"  # dry-run
+              or decision.get("reason") in ("hysteresis", "move-budget")):
+            # every decided-but-not-executed move counts here — dry-run,
+            # hysteresis and budget throttling are all operator-visible
+            if self.metrics is not None:
+                self.metrics.balancer_skipped.record()
+        return decision
+
+    def _decide(self, rows: Dict[str, dict], burning: List[str]) -> dict:
+        """Pick (source, destination, partition) or a skip reason. Pure
+        given its inputs — the brakes (hysteresis/budget) read balancer
+        state but mutate nothing until the move executes."""
+        now = self._clock()
+        up_rows = {m: r for m, r in rows.items() if r["up"]}
+        if len(up_rows) < 2:
+            return {"decision": "skip", "reason": "fewer-than-2-up-members",
+                    "rows": rows}
+        busiest = max(up_rows, key=lambda m: (len(up_rows[m]["leads"]),
+                                              up_rows[m]["lag"]))
+        calmest = min(up_rows, key=lambda m: (len(up_rows[m]["leads"]),
+                                              up_rows[m]["lag"]))
+        skew = len(up_rows[busiest]["leads"]) - len(up_rows[calmest]["leads"])
+        hot = None
+        if burning:
+            # SLO burning: attribute to the up member with the clearly-worst
+            # hwm lag (its applied frontier is running away from the quorum)
+            by_lag = sorted(up_rows, key=lambda m: up_rows[m]["lag"],
+                            reverse=True)
+            if (up_rows[by_lag[0]]["lag"] > 0
+                    and up_rows[by_lag[0]]["leads"]
+                    and (len(by_lag) < 2 or up_rows[by_lag[0]]["lag"]
+                         >= 2.0 * up_rows[by_lag[1]]["lag"])):
+                hot = by_lag[0]
+        if hot is None and skew <= self.max_lead_skew:
+            return {"decision": "skip", "reason": "within-skew",
+                    "skew": skew, "rows": rows}
+        source = hot or busiest
+        dest = calmest if calmest != source else min(
+            (m for m in up_rows if m != source),
+            key=lambda m: len(up_rows[m]["leads"]))
+        movable = [p for p in up_rows[source]["leads"]
+                   if now - self._last_move.get(str(p), -1e9)
+                   >= self.hysteresis_s]
+        if not movable:
+            return {"decision": "skip", "reason": "hysteresis",
+                    "source": source, "skew": skew}
+        self._moves = [t for t in self._moves if now - t < self.window_s]
+        if len(self._moves) >= self.move_budget:
+            return {"decision": "skip", "reason": "move-budget",
+                    "budget": self.move_budget, "window_s": self.window_s}
+        return {"decision": "move", "partition": movable[0],
+                "source": source, "dest": dest, "skew": skew,
+                "reason": "slo-burn" if hot else "lead-skew",
+                "dry_run": self.dry_run}
+
+    def _execute(self, decision: dict) -> None:
+        source, dest = decision["source"], decision["dest"]
+        partition = decision["partition"]
+        try:
+            t = self._transport(source)
+            stats = t.cluster_handoff(dest, partition)
+        except Exception as exc:  # noqa: BLE001 — the next cycle re-decides
+            self._drop_transport(source)
+            if self.metrics is not None:
+                self.metrics.balancer_skipped.record()
+            self.flight.record("balance.move-failed", partition=partition,
+                              source=source, dest=dest, error=repr(exc)[:200])
+            logger.warning("balancer move of partition %s %s->%s failed: %r",
+                           partition, source, dest, exc)
+            return
+        now = self._clock()
+        self._last_move[str(partition)] = now
+        self._moves.append(now)
+        if self.metrics is not None:
+            self.metrics.balancer_moves.record()
+        self.flight.record("balance.moved", partition=partition,
+                           source=source, dest=dest,
+                           fence_ms=stats.get("fence_ms"),
+                           tail_records=stats.get("tail_records"))
+        logger.warning("balancer moved partition %s %s -> %s (%s)",
+                       partition, source, dest, decision["reason"])

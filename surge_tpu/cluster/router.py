@@ -1,0 +1,382 @@
+"""PartitionRouter — partition→leader routing under the LogTransport protocol.
+
+With leadership spread (``ClusterMeta``), N brokers each lead a slice of the
+partition indices. A plain :class:`~surge_tpu.log.client.GrpcLogTransport`
+talks to ONE broker and treats every ``NOT_LEADER`` as a whole-broker
+failover; the router instead learns the cluster's partition→leader map once
+(bootstrap fetch from any member) and pins each operation to its partition's
+CURRENT leader:
+
+- one cached child transport per broker address (lazy);
+- a producer (:class:`RoutedProducer`) buffers like any transactional
+  producer and, at commit, ships the batch to the batch's partition leader —
+  re-resolving through a fresh metadata fetch when the broker answers
+  ``NOT_LEADER``/fenced or drops the connection, so a mid-commit handoff or
+  failover costs one retry, not a publisher re-init storm;
+- the leader cache is invalidated **per partition** on every redirect
+  (``invalidate_partition`` — the publisher's fenced→re-init ladder calls it
+  before re-opening), never kept stale forever;
+- exactly-once across moves rests on the broker plane: the txn-dedup table
+  replicates with the partition, so a verbatim retry on the NEW leader is
+  answered from cache (or absorbed by the reopen alias window), never
+  appended twice.
+
+The router implements the LogTransport surface the engine/publisher uses
+(``create_topic``/``topic``/``transactional_producer``/``read``/
+``end_offset``/``latest_by_key``/``wait_for_append``), so it drops in as the
+engine's ``log=`` — the publisher learns the partition→leader map without a
+line of engine code changing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import grpc
+
+from surge_tpu.common import logger
+from surge_tpu.log.client import GrpcLogTransport
+from surge_tpu.log.transport import (
+    LogRecord,
+    NotLeaderError,
+    ProducerFencedError,
+    TopicSpec,
+    TransactionStateError,
+)
+
+__all__ = ["PartitionRouter", "RoutedProducer"]
+
+#: exceptions that mean "this broker is not (or no longer) the partition's
+#: leader — re-resolve and retry", as opposed to logic errors that propagate
+_REROUTE_ERRORS = (ProducerFencedError, NotLeaderError, grpc.RpcError)
+
+
+class RoutedProducer:
+    """Transactional producer over the router: one inner producer per broker
+    the partition map has sent us to, opened lazily and re-opened after a
+    fence. A batch commits on its partition's current leader; the retry
+    ladder re-resolves the leader between attempts."""
+
+    def __init__(self, router: "PartitionRouter", transactional_id: str,
+                 attempts: int = 6) -> None:
+        self._router = router
+        self.transactional_id = transactional_id
+        self._attempts = attempts
+        self._buffer: Optional[List[LogRecord]] = None
+        self._inner: Dict[str, object] = {}  # addr -> GrpcTxnProducer
+        self._fenced = False
+
+    @property
+    def fenced(self) -> bool:
+        return self._fenced
+
+    @property
+    def in_transaction(self) -> bool:
+        return self._buffer is not None
+
+    def begin(self) -> None:
+        if self._buffer is not None:
+            raise TransactionStateError("transaction already open")
+        self._buffer = []
+
+    def send(self, record: LogRecord) -> None:
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        self._buffer.append(record)
+
+    def abort(self) -> None:
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        self._buffer = None  # records never left this process
+
+    def commit(self) -> Sequence[LogRecord]:
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        records, self._buffer = self._buffer, None
+        return self._routed(records, "commit")
+
+    def commit_unsequenced(self) -> Sequence[LogRecord]:
+        """Seq-less commit (epoch markers): same routing, no idempotency
+        number — duplicates are harmless by the caller's contract."""
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        records, self._buffer = self._buffer, None
+        return self._routed(records, "commit_unsequenced")
+
+    def send_immediate(self, record: LogRecord) -> LogRecord:
+        return self._routed([record], "send_immediate")
+
+    def _partition_of(self, records: Sequence[LogRecord]) -> int:
+        parts = {r.partition for r in records}
+        if len(parts) > 1:
+            # a cross-partition batch routes by its FIRST record; the broker
+            # refuses if the partitions live on different leaders (the
+            # engine's lanes are single-partition, so this is the raw-client
+            # edge case, surfaced loudly by the broker's per-partition gate)
+            logger.debug("routed batch spans partitions %s; routing by the "
+                         "first", sorted(parts))
+        return records[0].partition if records else 0
+
+    def _routed(self, records: Sequence[LogRecord], op: str):
+        """Run one producer operation on the partition's current leader,
+        re-resolving the leader between attempts — a retried commit carries
+        the SAME records (and, on the same broker, the same txn_seq), so the
+        broker-plane dedup/alias machinery keeps it exactly-once wherever
+        the partition landed."""
+        partition = self._partition_of(records)
+        last: Optional[BaseException] = None
+        backoff = 0.05
+        for attempt in range(self._attempts):
+            addr = self._router.leader_for(partition,
+                                           refresh=attempt > 0)
+            try:
+                inner = self._inner.get(addr)
+                if inner is None or inner.fenced:
+                    inner = self._router._child(addr).transactional_producer(
+                        self.transactional_id)
+                    self._inner[addr] = inner
+                if op == "send_immediate":
+                    return inner.send_immediate(records[0])
+                inner.begin()
+                for r in records:
+                    inner.send(r)
+                if op == "commit_unsequenced":
+                    return inner.commit_unsequenced()
+                return inner.commit()
+            except TransactionStateError:
+                raise
+            except _REROUTE_ERRORS as exc:
+                last = exc
+                self._inner.pop(addr, None)
+                self._router.invalidate_partition("", partition,
+                                                  suspect=addr)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+        self._fenced = True
+        if last is not None:
+            if isinstance(last, ProducerFencedError):
+                raise last
+            raise ProducerFencedError(
+                f"no leader for partition {partition} after "
+                f"{self._attempts} routed attempts: {last!r}")
+        raise ProducerFencedError(
+            f"no leader for partition {partition} (empty membership?)")
+
+
+class PartitionRouter:
+    """LogTransport-protocol client over a spread cluster (module doc)."""
+
+    is_remote = True
+
+    def __init__(self, targets, config=None, tracer=None,
+                 metrics=None) -> None:
+        if isinstance(targets, str):
+            self.bootstrap = [t.strip() for t in targets.split(",")
+                              if t.strip()]
+        else:
+            self.bootstrap = [t for t in targets if t]
+        if not self.bootstrap:
+            raise ValueError("need at least one bootstrap broker target")
+        self._config = config
+        self.tracer = tracer
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._children: Dict[str, GrpcLogTransport] = {}
+        self._meta: dict = {}
+        self._meta_stale = True
+        #: per-partition leader cache WITH invalidation: a redirect or a
+        #: connect failure evicts the entry (and marks the whole map stale),
+        #: so a moved-back partition never ping-pongs through a dead broker
+        self._leader_cache: Dict[int, str] = {}
+        self._topics: Dict[str, TopicSpec] = {}
+
+    # -- metadata -------------------------------------------------------------------------
+
+    def _child(self, addr: str) -> GrpcLogTransport:
+        with self._lock:
+            child = self._children.get(addr)
+            if child is None:
+                child = GrpcLogTransport(addr, config=self._config,
+                                         tracer=self.tracer,
+                                         metrics=self.metrics)
+                self._children[addr] = child
+        return child
+
+    def _drop_child(self, addr: str) -> None:
+        with self._lock:
+            child = self._children.pop(addr, None)
+        if child is not None:
+            try:
+                child.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+
+    def refresh_meta(self, force: bool = False) -> dict:
+        """Fetch the cluster metadata view from the coordinator (preferred)
+        or any reachable member/bootstrap broker."""
+        with self._lock:
+            if self._meta and not self._meta_stale and not force:
+                return self._meta
+            meta = dict(self._meta)
+        sources: List[str] = []
+        for addr in ([meta.get("coordinator", "")]
+                     + list(meta.get("members", ())) + self.bootstrap):
+            if addr and addr not in sources:
+                sources.append(addr)
+        last: Optional[BaseException] = None
+        for addr in sources:
+            try:
+                fresh = self._child(addr).cluster_meta()
+            except Exception as exc:  # noqa: BLE001 — try the next member
+                last = exc
+                self._drop_child(addr)
+                continue
+            # prefer the coordinator's own answer: a member's cached view
+            # is good enough to route by, but one more hop gets authority
+            coord = fresh.get("coordinator", "")
+            if coord and coord != addr:
+                try:
+                    fresh = self._child(coord).cluster_meta()
+                except Exception:  # noqa: BLE001 — member view still usable
+                    self._drop_child(coord)
+            with self._lock:
+                self._meta = fresh
+                self._meta_stale = False
+                self._leader_cache = {
+                    int(k): str(v) for k, v in
+                    (fresh.get("assignments") or {}).items()}
+            return fresh
+        raise RuntimeError(
+            f"no cluster member reachable for metadata: {last!r}")
+
+    def leader_for(self, partition: int, refresh: bool = False) -> str:
+        """The partition's current leader address (assignment map, falling
+        back to the coordinator for unassigned indices)."""
+        if refresh:
+            with self._lock:
+                self._meta_stale = True
+        with self._lock:
+            hit = None if self._meta_stale else \
+                self._leader_cache.get(partition)
+            coord = self._meta.get("coordinator", "")
+        if hit:
+            return hit
+        meta = self.refresh_meta()
+        addr = (meta.get("assignments") or {}).get(str(partition))
+        return addr or meta.get("coordinator") or coord or self.bootstrap[0]
+
+    def invalidate_partition(self, topic: str, partition: int,
+                             suspect: str = "") -> None:
+        """Evict one partition's cached leader (a redirect or connect
+        failure proved it wrong); the next resolve re-fetches the map."""
+        del topic  # assignment unit is the partition index
+        with self._lock:
+            self._leader_cache.pop(partition, None)
+            self._meta_stale = True
+
+    def cluster_meta(self, op: str = "status", **payload) -> dict:
+        """Pass-through to the coordinator's ClusterMeta plane (mutations
+        route there; status is answered from any member)."""
+        if op == "status":
+            return self.refresh_meta(force=True)
+        meta = self.refresh_meta()
+        coord = meta.get("coordinator") or self.bootstrap[0]
+        out = self._child(coord).cluster_meta(op, **payload)
+        with self._lock:
+            self._meta_stale = True
+        return out
+
+    def _coordinator_child(self) -> GrpcLogTransport:
+        meta = self.refresh_meta()
+        return self._child(meta.get("coordinator") or self.bootstrap[0])
+
+    # -- LogTransport protocol ------------------------------------------------------------
+
+    def create_topic(self, spec: TopicSpec) -> None:
+        self._coordinator_child().create_topic(spec)
+        with self._lock:
+            self._topics[spec.name] = spec
+
+    def topic(self, name: str) -> TopicSpec:
+        with self._lock:
+            hit = self._topics.get(name)
+        if hit is not None:
+            return hit
+        spec = self._coordinator_child().topic(name)
+        with self._lock:
+            self._topics[name] = spec
+        return spec
+
+    def num_partitions(self, name: str) -> int:
+        return self.topic(name).partitions
+
+    def transactional_producer(self, transactional_id: str) -> RoutedProducer:
+        return RoutedProducer(self, transactional_id)
+
+    def read(self, topic: str, partition: int, from_offset: int = 0,
+             max_records: Optional[int] = None,
+             isolation: str = "read_committed") -> Sequence[LogRecord]:
+        return self._routed_call(partition, lambda c: c.read(
+            topic, partition, from_offset=from_offset,
+            max_records=max_records, isolation=isolation))
+
+    def end_offset(self, topic: str, partition: int,
+                   isolation: str = "read_committed") -> int:
+        return self._routed_call(partition, lambda c: c.end_offset(
+            topic, partition, isolation=isolation))
+
+    def high_watermark(self, topic: str, partition: int) -> int:
+        return self._routed_call(
+            partition, lambda c: c.high_watermark(topic, partition))
+
+    def latest_by_key(self, topic: str, partition: int,
+                      isolation: str = "read_committed"
+                      ) -> Mapping[str, LogRecord]:
+        return self._routed_call(partition, lambda c: c.latest_by_key(
+            topic, partition, isolation=isolation))
+
+    async def wait_for_append(self, topic: str, partition: int,
+                              after_offset: int) -> None:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        last: Optional[BaseException] = None
+        for attempt in range(3):
+            # resolve OFF the event loop: a refresh is a blocking metadata
+            # RPC, and this coroutine runs on the engine's loop
+            addr = await loop.run_in_executor(
+                None, lambda a=attempt: self.leader_for(partition, a > 0))
+            try:
+                await self._child(addr).wait_for_append(
+                    topic, partition, after_offset)
+                return
+            except grpc.RpcError as exc:
+                last = exc
+                self.invalidate_partition("", partition, suspect=addr)
+        raise last if last is not None else RuntimeError("unreachable")
+
+    def _routed_call(self, partition: int, op):
+        """Run one read-side operation on the partition's current leader,
+        re-resolving (and invalidating the cached hint) when the ACTUAL
+        call fails — a reader must recover from a dead or moved leader
+        exactly like a producer does, not keep hitting its corpse."""
+        last: Optional[BaseException] = None
+        for attempt in range(3):
+            addr = self.leader_for(partition, refresh=attempt > 0)
+            try:
+                return op(self._child(addr))
+            except grpc.RpcError as exc:
+                last = exc
+                self.invalidate_partition("", partition, suspect=addr)
+        raise last if last is not None else RuntimeError("unreachable")
+
+    def close(self) -> None:
+        with self._lock:
+            children, self._children = list(self._children.values()), {}
+        for child in children:
+            try:
+                child.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass

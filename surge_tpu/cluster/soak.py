@@ -1,0 +1,516 @@
+"""Sustained seeded chaos soak: prove the cluster heals itself.
+
+One soak run builds a 3+-broker spread cluster in-process, drives Zipf
+hot-key-skewed writers through the :class:`PartitionRouter`, and executes a
+seeded schedule of faults while the autobalancer runs its decision cycles:
+
+- **rolling kills** — a broker (the coordinator on odd seeds, a partition
+  leader on even) is hard-killed mid-run and relit over the same log later;
+- **link faults** — a seeded fault plan (ship drops + Transact reorders) is
+  armed on a surviving broker (the ``file`` backend arms fsync hiccups too);
+- **membership churn** — a fresh broker catch-up-syncs through the slice
+  lane, joins via AddBroker, and is RemoveBroker'd again before the end;
+- **skew** — keys are Zipf-distributed, so one partition runs hot.
+
+Scoring is the PR-9 telemetry plane itself: a FederatedScraper pulls every
+broker (a dead one answers ``up{instance}=0``), the SLO engine burns the
+``fleet-up`` objective on tight windows, and the verdict demands that
+
+1. every acked commit appears **exactly once** in the final logs (and no
+   payload, acked or in-doubt, appears twice),
+2. every partition converges to **exactly one leader** the whole fleet
+   agrees on,
+3. every SLO page raised during a fault **clears** after the heal,
+4. the autobalancer's decisions are reconstructable from the **merged
+   flight timeline** (broker + fleet + balancer recorders).
+
+``run_soak(seed)`` returns the verdict dict; ``tests/test_cluster_selfheal``
+runs the 3-seed fast variant in tier-1 and ``SURGE_BENCH_SOAK=1 python
+bench.py`` the long randomized one.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from surge_tpu.common import logger
+from surge_tpu.config import Config
+from surge_tpu.log import (
+    GrpcLogTransport,
+    InMemoryLog,
+    LogRecord,
+    LogServer,
+    TopicSpec,
+)
+from surge_tpu.log.transport import NotLeaderError, ProducerFencedError
+
+__all__ = ["run_soak"]
+
+TOPIC = "ev"
+
+
+def _soak_config(extra: Optional[dict] = None) -> Config:
+    overrides = {
+        "surge.log.replication-ack-timeout-ms": 1_500,
+        "surge.log.replication-isr-timeout-ms": 600,
+        "surge.log.failover.probe-interval-ms": 150,
+        "surge.log.failover.probe-failures": 2,
+        "surge.log.quorum.vote-timeout-ms": 600,
+        "surge.log.quorum.vote-rounds": 8,
+        "surge.log.replication.min-insync-acks": 2,
+        "surge.cluster.reassign-grace-ms": 1_200,
+        "surge.cluster.balancer.interval-ms": 400,
+        "surge.cluster.balancer.move-budget": 8,
+        "surge.cluster.balancer.window-ms": 20_000,
+        "surge.cluster.balancer.hysteresis-ms": 2_000,
+        "surge.cluster.balancer.max-lead-skew": 1,
+        "surge.slo.fast-window-ms": 1_200,
+        "surge.slo.slow-window-ms": 3_000,
+    }
+    overrides.update(extra or {})
+    return Config(overrides=overrides)
+
+
+def _free_ports(n: int) -> List[int]:
+    import socket
+
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _zipf_partition(rng: random.Random, partitions: int) -> int:
+    """Zipf-ish hot-key skew: partition 0 is the hot one (~1/H weight of
+    rank 1), the tail decays as 1/rank."""
+    weights = [1.0 / (rank + 1) for rank in range(partitions)]
+    return rng.choices(range(partitions), weights=weights, k=1)[0]
+
+
+class _Fleet:
+    """The soak's broker pool: live LogServer objects by address (relights
+    replace entries in place; the scraper's fetch closures read through)."""
+
+    def __init__(self, addrs: List[str], cfg: Config) -> None:
+        self.cfg = cfg
+        self.addrs = list(addrs)
+        self.live: Dict[str, LogServer] = {}
+        self.flights: Dict[str, object] = {}
+
+    def start_initial(self) -> None:
+        leader_addr, follower_addrs = self.addrs[0], self.addrs[1:]
+        for addr in follower_addrs:
+            server = LogServer(InMemoryLog(),
+                               port=int(addr.rsplit(":", 1)[1]),
+                               follower_of=leader_addr, auto_promote=True,
+                               config=self.cfg, quorum_peers=self.addrs)
+            server.start()
+            self.live[addr] = server
+            self.flights[addr] = server.flight
+        leader = LogServer(InMemoryLog(),
+                           port=int(leader_addr.rsplit(":", 1)[1]),
+                           replicate_to=follower_addrs, config=self.cfg,
+                           quorum_peers=self.addrs, auto_promote=True)
+        leader.start()
+        self.live[leader_addr] = leader
+        self.flights[leader_addr] = leader.flight
+
+    def scrape_target(self, addr: str):
+        from surge_tpu.observability import ScrapeTarget
+
+        def fetch() -> str:
+            server = self.live.get(addr)
+            if server is None or server._dead:
+                raise RuntimeError(f"{addr} is down")
+            return server.metrics_text()
+
+        return ScrapeTarget(instance=addr, role="broker", fetch=fetch)
+
+    def kill(self, addr: str) -> List[int]:
+        server = self.live[addr]
+        led = server.partitions_led()
+        server.kill()
+        if server.kill_done is not None:
+            server.kill_done.wait(10)
+        return led
+
+    def relight(self, addr: str, follower_of: str) -> LogServer:
+        old = self.live[addr]
+        server = LogServer(old.log, port=int(addr.rsplit(":", 1)[1]),
+                           follower_of=follower_of, auto_promote=True,
+                           config=self.cfg, quorum_peers=self.addrs,
+                           flight=old.flight)  # one story per broker
+        server.start()
+        self.live[addr] = server
+        return server
+
+    def coordinator(self) -> Optional[str]:
+        for addr, server in self.live.items():
+            if server.role == "leader" and not server._dead:
+                return addr
+        return None
+
+    def admin(self, op: str, timeout: float = 20.0, **payload) -> dict:
+        """Run a ClusterMeta mutation against the CURRENT coordinator,
+        riding out elections."""
+        deadline = time.monotonic() + timeout
+        last: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            coord = self.coordinator()
+            if coord is not None:
+                client = GrpcLogTransport(coord, config=self.cfg)
+                try:
+                    return client.cluster_meta(op, **payload)
+                except Exception as exc:  # noqa: BLE001 — mid-election
+                    last = exc
+                finally:
+                    client.close()
+            time.sleep(0.2)
+        raise TimeoutError(f"ClusterMeta {op} never reached a coordinator: "
+                           f"{last!r}")
+
+    def stop_all(self) -> None:
+        for server in self.live.values():
+            try:
+                server.stop()
+            except Exception:  # noqa: BLE001 — already killed
+                pass
+
+
+def _writer(fleet: _Fleet, router, seed: int, w: int, stop: threading.Event,
+            partitions: int, ledger: list, lock: threading.Lock,
+            errors: list) -> None:
+    rng = random.Random(seed * 1009 + w)
+    producer = None
+    i = 0
+    try:
+        while not stop.is_set():
+            p = _zipf_partition(rng, partitions)
+            payload = f"s{seed}-w{w}-{i}-p{p}".encode()
+            deadline = time.monotonic() + 30.0
+            grace = None
+            while True:
+                if stop.is_set():
+                    # drain: one short grace to resolve the in-flight
+                    # payload, then leave it in-doubt (uniqueness is still
+                    # verified — only the ack ledger excludes it)
+                    if grace is None:
+                        grace = time.monotonic() + 2.0
+                    if time.monotonic() > grace:
+                        return
+                if time.monotonic() > deadline:
+                    return  # in-doubt: never acked
+                try:
+                    if producer is None:
+                        producer = router.transactional_producer(
+                            f"soak-{seed}-w{w}")
+                    producer.begin()
+                    producer.send(LogRecord(
+                        topic=TOPIC, key=f"k{w}-{rng.randrange(8)}",
+                        value=payload, partition=p))
+                    producer.commit()
+                    with lock:
+                        ledger.append((p, payload))
+                    break
+                except (ProducerFencedError, NotLeaderError):
+                    producer = None
+                except Exception:  # noqa: BLE001 — broker mid-failover
+                    if producer is not None and producer.in_transaction:
+                        producer.abort()
+                    time.sleep(0.05)
+            i += 1
+            time.sleep(0.002)
+    except Exception as exc:  # noqa: BLE001 — a dead writer fails the soak
+        errors.append(repr(exc))
+
+
+def run_soak(seed: int, brokers: int = 3, partitions: int = 4,
+             seconds: float = 8.0, writers: int = 3,
+             membership_churn: bool = True,
+             config_extra: Optional[dict] = None) -> dict:
+    """One seeded chaos schedule; returns the verdict dict (see module
+    doc). Raises nothing on a failed verdict — callers assert on the
+    fields, so a failing soak reports everything it measured."""
+    from surge_tpu.cluster.autobalancer import Autobalancer
+    from surge_tpu.cluster.router import PartitionRouter
+    from surge_tpu.observability import (FederatedScraper, FlightRecorder,
+                                         SLO, SLOEngine, merge_dumps)
+
+    rng = random.Random(seed)
+    cfg = _soak_config(config_extra)
+    addrs = [f"127.0.0.1:{p}" for p in _free_ports(brokers + 1)]
+    join_addr, addrs = addrs[-1], addrs[:-1]
+    fleet = _Fleet(addrs, cfg)
+    fleet.start_initial()
+    router = None
+    balancer = None
+    scraper = None
+    joiner = None
+    stop = threading.Event()
+    threads: List[threading.Thread] = []
+    try:
+        setup = GrpcLogTransport(addrs[0], config=cfg)
+        setup.create_topic(TopicSpec(TOPIC, partitions))
+        setup.cluster_meta("spread", partitions=partitions)
+        setup.close()
+
+        # telemetry plane: federated scrape over in-process fetchers + the
+        # SLO engine on tight windows; its flight ring carries the pages
+        fleet_flight = FlightRecorder(name="fleet", role="engine")
+        scraper = FederatedScraper(
+            [fleet.scrape_target(a) for a in addrs], config=cfg)
+        scraper.slo = SLOEngine(
+            [SLO("fleet-up", family="up", kind="bound", objective=0.99,
+                 threshold=1.0, op="lt",
+                 description="every member answers its scrape")],
+            config=cfg, metrics=scraper.metrics, flight=fleet_flight)
+        balancer_flight = FlightRecorder(name="autobalancer",
+                                         role="balancer")
+        balancer = Autobalancer(scraper, addrs, config=cfg,
+                                flight=balancer_flight)
+
+        router = PartitionRouter(",".join(addrs), config=cfg)
+        ledger: list = []
+        ledger_lock = threading.Lock()
+        writer_errors: list = []
+        for w in range(writers):
+            t = threading.Thread(
+                target=_writer,
+                args=(fleet, router, seed, w, stop, partitions, ledger,
+                      ledger_lock, writer_errors),
+                daemon=True)
+            t.start()
+            threads.append(t)
+
+        # the seeded schedule
+        t0 = time.monotonic()
+        kill_at = t0 + 0.22 * seconds
+        relight_at = t0 + 0.55 * seconds
+        join_at = t0 + 0.62 * seconds
+        remove_at = t0 + 0.88 * seconds
+        end_at = t0 + seconds
+        kill_coordinator = bool(seed % 2)
+        victim: Optional[str] = None
+        victim_led: List[int] = []
+        faulted: Optional[str] = None
+        relit = False
+        joined = False
+        removed = not membership_churn
+        # link faults on one non-victim broker, seeded
+        fault_plan = json.dumps({"rules": [
+            {"site": "ship.*", "action": "drop", "p": 0.08, "times": None},
+            {"site": "rpc.Transact", "action": "reorder", "p": 0.08,
+             "times": None, "delay_ms": 15.0},
+        ]})
+        while time.monotonic() < end_at:
+            now = time.monotonic()
+            if victim is None and now >= kill_at:
+                coord = fleet.coordinator() or addrs[0]
+                if kill_coordinator:
+                    victim = coord
+                else:
+                    others = [a for a in addrs if a != coord]
+                    victim = others[rng.randrange(len(others))]
+                survivors = [a for a in addrs if a != victim]
+                faulted = survivors[rng.randrange(len(survivors))]
+                client = GrpcLogTransport(faulted, config=cfg)
+                try:
+                    client.arm_faults(fault_plan, seed=seed)
+                finally:
+                    client.close()
+                victim_led = fleet.kill(victim)
+                logger.warning("soak %d: killed %s (coordinator=%s, led "
+                               "%s); faults armed on %s", seed, victim,
+                               kill_coordinator, victim_led, faulted)
+            if victim is not None and not relit and now >= relight_at:
+                follower_of = fleet.coordinator() or \
+                    [a for a in addrs if a != victim][0]
+                fleet.relight(victim, follower_of)
+                relit = True
+            if membership_churn and not joined and now >= join_at:
+                coord = fleet.coordinator()
+                if coord is not None:
+                    joiner = LogServer(
+                        InMemoryLog(),
+                        port=int(join_addr.rsplit(":", 1)[1]),
+                        follower_of=coord, auto_promote=True, config=cfg)
+                    joiner.catch_up(coord)
+                    joiner.start()
+                    fleet.live[join_addr] = joiner
+                    fleet.flights[join_addr] = joiner.flight
+                    fleet.admin("add", addr=join_addr)
+                    joined = True
+            if joined and not removed and now >= remove_at:
+                fleet.admin("remove", addr=join_addr)
+                removed = True
+            try:
+                balancer.cycle()
+            except Exception:  # noqa: BLE001 — a cycle must not end the soak
+                logger.exception("soak balancer cycle failed")
+            time.sleep(0.15)
+        if joined and not removed:
+            fleet.admin("remove", addr=join_addr)
+        # settle: writers drain, faults disarm, the balancer converges
+        stop.set()
+        for t in threads:
+            t.join(45.0)
+        if faulted is not None and not fleet.live[faulted]._dead:
+            client = GrpcLogTransport(faulted, config=cfg)
+            try:
+                client.disarm_faults()
+            except Exception:  # noqa: BLE001 — faulted broker died
+                pass
+            finally:
+                client.close()
+        settle_deadline = time.monotonic() + 25.0
+        converged = False
+        while time.monotonic() < settle_deadline:
+            try:
+                decision = balancer.cycle()
+            except Exception:  # noqa: BLE001
+                decision = {}
+            verdict_leaders = _leader_verdict(fleet, addrs, partitions)
+            if (verdict_leaders["ok"] and not scraper.slo.breached()
+                    and decision.get("decision") == "skip"
+                    and decision.get("reason") in ("within-skew",
+                                                   "fewer-than-2-up-members")):
+                # healed AND balanced: exactly one live leader per
+                # partition, no open pages, and the balancer itself reports
+                # the spread back within its skew bound
+                converged = True
+                break
+            time.sleep(0.3)
+        # final verdicts
+        leaders = _leader_verdict(fleet, addrs, partitions)
+        lost, duplicated, acked = _ledger_verdict(fleet, cfg, ledger,
+                                                  partitions)
+        pages = _page_verdict(fleet_flight)
+        dumps = [f.dump() for f in fleet.flights.values()]
+        dumps += [fleet_flight.dump(), balancer_flight.dump()]
+        timeline = merge_dumps(dumps)
+        balance_events = [e for e in timeline
+                          if str(e.get("type", "")).startswith("balance.")]
+        heal_events = [e for e in timeline if e.get("type") in
+                       ("broker.kill", "cluster.reassign", "quorum.win",
+                        "role.promote", "handoff.partition.done",
+                        "cluster.add", "cluster.remove", "isr.rejoin",
+                        "cluster.meta-apply", "slo.breach",
+                        "slo.recovered")]
+        return {
+            "seed": seed,
+            "acked_commits": acked,
+            "lost": lost,
+            "duplicated": duplicated,
+            "writer_errors": writer_errors,
+            "leaders": leaders,
+            "converged": converged,
+            "slo_pages": pages,
+            "membership_churn": joined and removed,
+            "victim": victim,
+            "victim_was_coordinator": kill_coordinator,
+            "victim_led": victim_led,
+            "balancer_decisions": len(balance_events),
+            "balancer_moves": sum(
+                1 for e in balance_events if e["type"] == "balance.moved"),
+            "heal_events": [e["type"] for e in heal_events],
+            "timeline_events": len(timeline),
+        }
+    finally:
+        stop.set()
+        if balancer is not None:
+            balancer.stop_sync()
+        if scraper is not None:
+            scraper.stop()
+        if router is not None:
+            router.close()
+        fleet.stop_all()
+
+
+def _leader_verdict(fleet: _Fleet, addrs: List[str],
+                    partitions: int) -> dict:
+    """Exactly one leader per partition, agreed by every live broker, and
+    that leader is alive."""
+    claims: Dict[int, set] = {p: set() for p in range(partitions)}
+    views = []
+    for addr, server in fleet.live.items():
+        if server._dead:
+            continue
+        status = server.broker_status()
+        views.append((addr, status.get("assign_epoch", 0),
+                      tuple(sorted((status.get("assignments") or {}).items()))))
+        for p in status.get("partitions_led", ()):
+            claims[int(p)].add(addr)
+    problems = []
+    for p, owners in claims.items():
+        if len(owners) != 1:
+            problems.append(f"partition {p} has {len(owners)} leaders: "
+                            f"{sorted(owners)}")
+        else:
+            owner = next(iter(owners))
+            if fleet.live.get(owner) is None or fleet.live[owner]._dead:
+                problems.append(f"partition {p} led by dead {owner}")
+    newest = max((v[1] for v in views), default=0)
+    maps = {v[2] for v in views if v[1] == newest}
+    if len(maps) > 1:
+        problems.append("brokers at the newest assign epoch disagree on "
+                        "the map")
+    return {"ok": not problems, "problems": problems,
+            "claims": {p: sorted(o) for p, o in claims.items()}}
+
+
+def _ledger_verdict(fleet: _Fleet, cfg: Config, ledger: list,
+                    partitions: int):
+    """0 lost / 0 duplicated: every acked payload exactly once in the final
+    log (read from each partition's current leader), and NO payload —
+    acked or in-doubt — more than once."""
+    by_partition: Dict[int, List[bytes]] = {p: [] for p in range(partitions)}
+    for p, payload in ledger:
+        by_partition[p].append(payload)
+    lost = duplicated = 0
+    meta = fleet.admin("status")
+    for p in range(partitions):
+        owner = (meta.get("assignments") or {}).get(str(p)) \
+            or meta.get("coordinator")
+        server = fleet.live.get(owner)
+        if server is None or server._dead:
+            lost += len(by_partition[p])
+            continue
+        values = [r.value for r in server.log.read(TOPIC, p)]
+        counts: Dict[bytes, int] = {}
+        for v in values:
+            counts[v] = counts.get(v, 0) + 1
+        for payload in by_partition[p]:
+            n = counts.get(payload, 0)
+            if n == 0:
+                lost += 1
+            elif n > 1:
+                duplicated += 1
+        # in-doubt payloads must not appear twice either
+        duplicated += sum(1 for v, n in counts.items()
+                          if n > 1 and v not in by_partition[p])
+    return lost, duplicated, len(ledger)
+
+
+def _page_verdict(fleet_flight) -> dict:
+    """Every SLO page raised during a fault must CLEAR after the heal."""
+    events = fleet_flight.events()
+    raised = [e for e in events if e.get("type") == "slo.breach"]
+    open_pages: Dict[str, int] = {}
+    for e in events:
+        if e.get("type") == "slo.breach":
+            open_pages[e.get("objective", "?")] = \
+                open_pages.get(e.get("objective", "?"), 0) + 1
+        elif e.get("type") == "slo.recovered":
+            open_pages.pop(e.get("objective", "?"), None)
+    return {"raised": len(raised), "still_open": sorted(open_pages),
+            "cleared": not open_pages}

@@ -247,6 +247,24 @@ DEFAULTS: dict[str, Any] = {
     "surge.log.quorum.peers": "",
     "surge.log.quorum.vote-timeout-ms": 1_000,  # per-peer VoteLeader RPC
     "surge.log.quorum.vote-rounds": 5,  # campaign rounds before stand-down
+    # --- cluster self-healing: membership, leadership spread, autobalancer ---
+    # spread partition leadership round-robin across the membership as
+    # topics are created (else: ClusterMeta op "spread" triggers it
+    # explicitly); false keeps the PR-7 whole-broker leadership
+    "surge.cluster.spread": False,
+    # how long a member's ships must keep failing (past the ISR drop)
+    # before the coordinator reassigns its led partitions to survivors
+    "surge.cluster.reassign-grace-ms": 5_000,
+    # autobalancer (surge_tpu/cluster/autobalancer.py): decision cadence,
+    # the planned-move budget per window, per-partition move hysteresis,
+    # the lead-count skew (max-min) that triggers a rebalance, and dry-run
+    # (decide + flight-record, never move)
+    "surge.cluster.balancer.interval-ms": 5_000,
+    "surge.cluster.balancer.move-budget": 4,
+    "surge.cluster.balancer.window-ms": 60_000,
+    "surge.cluster.balancer.hysteresis-ms": 30_000,
+    "surge.cluster.balancer.max-lead-skew": 1,
+    "surge.cluster.balancer.dry-run": False,
     # --- flight recorder ---
     # directory the broker auto-dumps its flight ring to when the fault
     # plane hard-kills it ("" disables; live dumps via the DumpFlight RPC)

@@ -1069,6 +1069,17 @@ class PartitionPublisher:
         if self.still_owner():
             self.stats.reinitializations += 1
             self.on_signal("surge.producer.reinitializing", "warning")
+            # partition-routed transports (surge_tpu.cluster.PartitionRouter)
+            # cache this partition's leader: a fence very often MEANS the
+            # leadership moved, so drop the cached hint before re-opening —
+            # the fresh producer then resolves against the current map
+            # instead of bouncing off the stale endpoint once more
+            invalidate = getattr(self.log, "invalidate_partition", None)
+            if invalidate is not None:
+                try:
+                    invalidate(self.state_topic, self.partition)
+                except Exception:  # noqa: BLE001 — routing hint only
+                    logger.exception("leader-hint invalidation failed")
             try:
                 await self._initialize()
                 if self.flight is not None:

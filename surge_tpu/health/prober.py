@@ -66,6 +66,10 @@ class BrokerLivenessProber:
         self.declared_dead = False
         self.probes = 0
         self.ever_alive = False
+        #: re-arms after a retired declaration (lost campaigns, stand-downs):
+        #: a broker that loses N consecutive elections must STILL detect the
+        #: next real leader death — this counts the proof
+        self.rearms = 0
 
     def start(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -89,10 +93,21 @@ class BrokerLivenessProber:
         Callable from the prober's own on_dead callback: the current run is
         RETIRING (it returns right after on_dead), so start() must spawn a
         fresh thread instead of seeing the still-alive current one and
-        doing nothing."""
+        doing nothing. Callable from ANY other thread too: a retiring run
+        that has not unwound yet is waited out briefly, so the re-arm can
+        never be swallowed by start() observing a corpse as alive (the
+        repeated-election case — stand down, re-arm, stand down, re-arm —
+        must stay armed however many campaigns are lost)."""
         self.declared_dead = False
         self.failure_streak = 0
-        if self._thread is threading.current_thread():
+        self.rearms += 1
+        thread = self._thread
+        if thread is threading.current_thread():
+            self._thread = None
+        elif thread is not None and thread.is_alive():
+            self._stop.set()
+            thread.join(self.interval_s + 2.0)
+            self._stop.clear()
             self._thread = None
         self.start()
 

@@ -239,6 +239,11 @@ class GrpcLogTransport:
         if not self.targets:
             raise ValueError("need at least one broker target")
         self.target = self.targets[0]  # current
+        #: endpoints LEARNED from NOT_LEADER hints (vs the configured
+        #: failover order): a learned hint is advisory and expires — on the
+        #: next redirect, or on a connect failure — so a moved-back
+        #: partition never ping-pongs through a dead ex-leader
+        self._learned: set = set()
         self._config = config
         from surge_tpu.config import default_config as _dc
 
@@ -297,7 +302,19 @@ class GrpcLogTransport:
             if self.generation != from_generation:
                 return  # another caller already rolled
             self.generation += 1
-            self._connect(self.targets.index(self.target) + 1)
+            failed = self.target
+            index = self.targets.index(failed)
+            if failed in self._learned and len(self.targets) > 1:
+                # connect failure on a LEARNED endpoint: evict it from the
+                # rotation entirely (ISSUE 13 satellite — configured targets
+                # are the operator's failover order and stay; a stale hint
+                # kept forever would have every later roll ping-pong
+                # through the dead broker)
+                self.targets.remove(failed)
+                self._learned.discard(failed)
+                self._connect(index % len(self.targets))
+            else:
+                self._connect(index + 1)
         if self.metrics is not None:
             self.metrics.failover_rolls.record()
             self.metrics.failover_redirect_timer.record_ms(
@@ -318,8 +335,18 @@ class GrpcLogTransport:
                 return True  # another caller already moved
             if target == self.target:
                 return False
+            # a fresh hint INVALIDATES earlier learned ones (ISSUE 13
+            # satellite): after handoffs A→B→A the stale B endpoint must
+            # leave the rotation — the endpoint being redirected AWAY from
+            # included — or the next failover cycles through a broker that
+            # may be dead by then
+            stale = [t for t in self._learned if t != target]
+            for t in stale:
+                self.targets.remove(t)
+                self._learned.discard(t)
             if target not in self.targets:
                 self.targets.append(target)
+                self._learned.add(target)
             self.generation += 1
             self._connect(self.targets.index(target))
         if self.metrics is not None:
@@ -643,6 +670,34 @@ class GrpcLogTransport:
             raise RuntimeError(f"BrokerStatus failed: {reply.error}")
         return json.loads(reply.records[0].value)
 
+    def cluster_meta(self, op: str = "status", **payload) -> dict:
+        """The connected broker's cluster-metadata plane (ClusterMeta RPC):
+        ``status`` reads the membership + partition→leader view; the
+        coordinator-only mutations are ``add``/``remove`` (addr=...),
+        ``assign`` (partition=..., to=...) and ``spread`` (partitions=N).
+        Returns the (new) metadata view."""
+        import json
+
+        req = pb.TxnRequest(op=op)
+        if payload:
+            req.records.append(pb.RecordMsg(
+                has_value=True, value=json.dumps(payload).encode()))
+        reply = self._invoke("ClusterMeta", req)
+        if not reply.ok:
+            raise RuntimeError(f"ClusterMeta({op}) failed: {reply.error}")
+        return json.loads(reply.records[0].value)
+
+    def add_broker(self, addr: str) -> dict:
+        """AddBroker: admit a caught-up broker into the membership (run
+        ``catch_up`` on it first; the coordinator refuses a joiner lagging
+        past the auto-resync cap)."""
+        return self.cluster_meta("add", addr=addr)
+
+    def remove_broker(self, addr: str) -> dict:
+        """RemoveBroker: retire a member — its led partitions fail over to
+        the surviving members before the membership record shrinks."""
+        return self.cluster_meta("remove", addr=addr)
+
     def promote_follower(self, replicate_to: Optional[Sequence[str]] = None
                          ) -> dict:
         """Promote the CONNECTED broker to leader (admin failover trigger);
@@ -674,6 +729,22 @@ class GrpcLogTransport:
         reply = self._invoke("HandoffPartition", req, timeout=timeout)
         if not reply.ok:
             raise RuntimeError(f"HandoffPartition failed: {reply.error}")
+        return json.loads(reply.records[0].value)
+
+    def cluster_handoff(self, to: str, partition: int,
+                        timeout: float = 30.0) -> dict:
+        """Per-partition planned leadership transfer (spread mode): the
+        CONNECTED broker must lead ``partition``; it fences just that index,
+        drains it, tail-syncs ``to``, pushes dedup, and flips the assignment
+        through the coordinator. Returns the handoff stats."""
+        import json
+
+        req = pb.TxnRequest(op="handoff", records=[pb.RecordMsg(
+            has_value=True, value=json.dumps(
+                {"to": to, "partition": int(partition)}).encode())])
+        reply = self._invoke("HandoffPartition", req, timeout=timeout)
+        if not reply.ok:
+            raise RuntimeError(f"partition handoff failed: {reply.error}")
         return json.loads(reply.records[0].value)
 
     def kill_broker(self) -> None:

@@ -163,12 +163,17 @@ class _TargetState:
     """Leader-side in-sync tracking for one replication target."""
 
     __slots__ = ("in_sync", "failing_since", "next_probe", "shipped_index",
-                 "shipped_records")
+                 "shipped_records", "probe_failing_since")
 
     def __init__(self) -> None:
         self.in_sync = True
         self.failing_since: Optional[float] = None
         self.next_probe = 0.0
+        #: the reassign sweep's LIVENESS clock (BrokerStatus probes) — kept
+        #: apart from ``failing_since``, which the SHIP path owns: a member
+        #: whose data plane fails while its control plane answers must still
+        #: accrue toward the ISR drop
+        self.probe_failing_since: Optional[float] = None
         #: acked-through marks (absolute, idempotent under re-ship): the
         #: enqueue index / cumulative record count of the newest queue item
         #: this follower acked. Doubles as this follower's CURSOR into the
@@ -241,6 +246,15 @@ METHODS = {
     #   JSON {"candidate": addr, "leader": presumed-dead addr}; the reply
     #   record answers {"granted", "epoch", "reason", "role", "leader_hint",
     #   "leader_alive"}. One vote per epoch, persisted in __broker_meta.
+    # ClusterMeta — the dynamic-membership / partition-spread plane:
+    #   op "status" answers the cluster metadata view (members + membership
+    #   epoch, partition->leader assignments + assignment epoch, coordinator);
+    #   "apply" installs a coordinator broadcast (epoch-guarded); the
+    #   coordinator-only mutations are "add"/"remove" (AddBroker/RemoveBroker:
+    #   rewrite the replicated membership record), "assign" (move ONE
+    #   partition's leadership) and "spread" (round-robin every partition
+    #   index across the membership). Every mutation mints a fresh cluster
+    #   epoch, so stale assignment views are fenced, never split-brained.
     # FetchSlice — standby bulk pull: ReadRequest names (topic, partition,
     #   from_offset, max_records); the reply record's value is ONE
     #   checkpoint-codec partition slice (store/checkpoint.py blocks).
@@ -253,6 +267,7 @@ METHODS = {
     "FetchSlice": (pb.ReadRequest, pb.TxnReply),
     "InstallSlice": (pb.TxnRequest, pb.TxnReply),
     "HandoffPartition": (pb.TxnRequest, pb.TxnReply),
+    "ClusterMeta": (pb.TxnRequest, pb.TxnReply),
 }
 
 
@@ -472,6 +487,35 @@ class LogServer:
             "surge.log.quorum.vote-timeout-ms", 1_000)
         self._vote_rounds = max(1, cfg.get_int(
             "surge.log.quorum.vote-rounds", 5))
+        # -- dynamic membership & per-partition leadership (cluster plane):
+        # the quorum peer list IS the membership record — `_member_epoch`
+        # versions it, and AddBroker/RemoveBroker rewrite it at runtime
+        # through the coordinator (the role=="leader" broker). Partition
+        # leadership spreads by PARTITION INDEX (Surge topics are
+        # co-partitioned: commands, events and state of index p live
+        # together), so `_assignments` maps str(p) -> leader address; empty =
+        # the legacy whole-broker leadership, bit-identical to PR 7.
+        self._member_epoch = 0
+        self._assignments: Dict[str, str] = {}
+        self._assign_epoch = 0
+        #: the cluster epoch the current assignment view was applied AT: a
+        #: broker whose `epoch` has been raised past it (a fence reply, a
+        #: higher-epoch ship) holds a provably-stale map and suspends its
+        #: partition leadership until a metadata refresh lands
+        self._meta_epoch = self.epoch
+        #: partition indices fenced mid-move (per-partition handoff): their
+        #: Transacts answer not_leader with an EMPTY hint (clients hold)
+        self._part_fence: set = set()
+        #: str(p) -> in-flight Transact count (the per-partition drain the
+        #: partition handoff waits on; the global counter stays for the
+        #: whole-broker handoff)
+        self._inflight_parts: Dict[str, int] = {}
+        self._spread_cfg = cfg.get_bool("surge.cluster.spread", False)
+        self._reassign_grace_s = cfg.get_seconds(
+            "surge.cluster.reassign-grace-ms", 5_000)
+        self._next_reassign_check = 0.0
+        self._meta_refresh_lock = threading.Lock()
+        self._meta_refresh_after = 0.0
         #: epoch -> candidate this broker voted for (one vote per epoch,
         #: persisted in __broker_meta so a bounced voter cannot double-vote)
         self._voted: Dict[int, str] = {}
@@ -568,6 +612,18 @@ class LogServer:
             item = _ReplItem([request.spec], [])
             self._enqueue_item(item)
             item.done.wait(self._repl_ack_timeout_s)
+        if (self.role == "leader"
+                and (self._spread_cfg or self._spread_active())
+                and self._quorum_others()):
+            # leadership spread (surge.cluster.spread / an active map): new
+            # partition indices join the round-robin the moment they exist
+            missing = [p for p in range(spec.partitions or 1)
+                       if str(p) not in self._assignments]
+            if missing:
+                try:
+                    self._spread_partitions(spec.partitions or 1)
+                except Exception:  # noqa: BLE001 — spread is best-effort here
+                    logger.exception("partition spread at CreateTopic failed")
         return pb.TopicReply(found=True, spec=request.spec)
 
     def GetTopic(self, request: pb.TopicRequest, context) -> pb.TopicReply:
@@ -597,10 +653,13 @@ class LogServer:
 
     def OpenProducer(self, request: pb.OpenProducerRequest,
                      context) -> pb.OpenProducerReply:
-        if self.role != "leader" or self._handoff_fence:
-            # a follower must never open producers: accepted writes would
-            # fork the log the moment the leader appends — redirect instead.
-            # A handoff fence answers with an EMPTY hint: the destination is
+        if (self.role != "leader" and not self._leads_any()) \
+                or self._handoff_fence:
+            # a broker leading nothing must never open producers: accepted
+            # writes would fork the log the moment a leader appends —
+            # redirect instead. (In spread mode a partition leader accepts
+            # opens; the per-partition Transact gate owns routing.) A
+            # handoff fence answers with an EMPTY hint: the destination is
             # not promoted yet, so clients hold in place (jittered backoff)
             # for the tail-sized window instead of ping-ponging.
             if self._handoff_fence:
@@ -652,25 +711,29 @@ class LogServer:
         # park, and commit AFTER the drain declared the log stable (the tail
         # ship would miss an acked record). Post-increment, the fence
         # provably waits for this call.
+        parts: list = []
         with self._role_lock:
-            if self.role != "leader" or self._handoff_fence:
-                if self._handoff_fence:
-                    # empty hint: the handoff destination is not promoted
-                    # yet — the client holds in place for the tail window
-                    return pb.TxnReply(
-                        ok=False, error_kind="not_leader",
-                        error="leadership handing off; retry shortly",
-                        leader_hint="")
-                return pb.TxnReply(
-                    ok=False, error_kind="not_leader",
-                    error=f"broker is a {self.role}, not the leader",
-                    leader_hint=self.leader_hint)
+            refused = self._write_gate(request.records)
+            if refused is not None:
+                return refused
             self._inflight_txn += 1
+            for m in request.records:
+                key = str(m.partition)
+                if key not in parts:
+                    parts.append(key)
+                    self._inflight_parts[key] = \
+                        self._inflight_parts.get(key, 0) + 1
         try:
             return self._transact_traced(request, context)
         finally:
             with self._role_lock:
                 self._inflight_txn -= 1
+                for key in parts:
+                    left = self._inflight_parts.get(key, 0) - 1
+                    if left <= 0:
+                        self._inflight_parts.pop(key, None)
+                    else:
+                        self._inflight_parts[key] = left
 
     def _transact_traced(self, request: pb.TxnRequest, context) -> pb.TxnReply:
         if self.tracer is None:
@@ -1336,6 +1399,13 @@ class LogServer:
                         and time.monotonic() >= st.next_probe
                         for st in self._repl_target_state.values()):
                     break
+                if (not self._repl_queue and self._assignments
+                        and self.role == "leader"
+                        and time.monotonic() >= self._next_reassign_check):
+                    # the coordinator's member-liveness sweep must run on an
+                    # IDLE cluster too — a dead partition leader with no
+                    # traffic would otherwise never fail over
+                    break
             if self._repl_stop:
                 return backoff
             queue = list(self._repl_queue)
@@ -1469,10 +1539,17 @@ class LogServer:
                     # probe interval rate-limits this to ~1/s per target
                     logger.warning("follower %s rejoin probe: %s", target,
                                    err)
+                    if st.failing_since is None:
+                        # the reassign-grace clock for members that were
+                        # ALREADY out of sync when we started watching them
+                        # (a post-promotion probe of a corpse) — without it
+                        # their led partitions would never fail over
+                        st.failing_since = now
                     # fresh clock, not the iteration's `now`: a slow probe
                     # (blackholed peer) must not be due again immediately,
                     # or every commit in degraded mode pays it
                     st.next_probe = time.monotonic() + 1.0
+        self._maybe_reassign_failed(now)
         if not queue:
             return backoff  # idle probe pass: nothing to finalize
         finalized = self._finalize_pass(queue)
@@ -1662,6 +1739,23 @@ class LogServer:
             pb.OffsetRequest(topic=topic, partition=p),
             timeout=1.0).end_offset
 
+    def _probe_call(self, target: str, method: str, req_cls, reply_cls,
+                    request, timeout: float):
+        """One probe-stub RPC with a single fresh-channel retry on
+        UNAVAILABLE: a cached channel that broke while the peer was down
+        sits in gRPC connect-backoff and answers its stale error for
+        seconds after the peer is back — exactly wrong for control-plane
+        calls that must reach a live peer NOW."""
+        try:
+            return self._probe_stub(target, method, req_cls, reply_cls)(
+                request, timeout=timeout)
+        except grpc.RpcError as exc:
+            if exc.code() != grpc.StatusCode.UNAVAILABLE:
+                raise
+            self._drop_probe_transport(target)
+            return self._probe_stub(target, method, req_cls, reply_cls)(
+                request, timeout=timeout)
+
     def _resync_follower(self, target: str,
                          deadline: Optional[float] = None) -> Optional[str]:
         """Leader-driven re-sync of a SMALL lag (the Kafka replica fetch
@@ -1698,6 +1792,11 @@ class LogServer:
                     # Replicate piggyback / catch_up instead
                     continue
                 for p in range(spec.partitions or 1):
+                    if not self._shippable(spec.name, p):
+                        # spread mode: another leader's partition — ITS
+                        # stream owns lag/divergence there, and a peer
+                        # running ahead of us on it is normal, not diverged
+                        continue
                     if time.monotonic() >= deadline:
                         return f"{target}: probe budget exhausted (lag scan)"
                     theirs = self._remote_end_offset(target, spec.name, p)
@@ -1767,6 +1866,8 @@ class LogServer:
                 if spec.name in INTERNAL_TOPICS:
                     continue  # self-maintained per side; see _resync_follower
                 for p in range(spec.partitions or 1):
+                    if not self._shippable(spec.name, p):
+                        continue  # another spread leader's partition
                     if time.monotonic() >= deadline:
                         return f"{target}: probe budget exhausted (verify)"
                     theirs = self._remote_end_offset(target, spec.name, p)
@@ -1859,11 +1960,32 @@ class LogServer:
                 records=[record_to_msg(r) for r in item.records],
                 transactional_id=item.txn_id, txn_seq=item.seq,
                 leader_epoch=self.epoch, kind=item.kind,
-                leader_target=self._my_target(),
+                # only the COORDINATOR's ships carry a repoint target: a
+                # spread partition leader shipping its slice must not drag
+                # every follower's prober/leader-hint onto itself
+                leader_target=(self._my_target() if self.role == "leader"
+                               else ""),
                 high_watermarks=self._ship_hwm_json(item)),
                 timeout=timeout or self._repl_ack_timeout_s)
             if not reply.ok:
                 if reply.leader_epoch > self.epoch:
+                    if self.role != "leader" and self._spread_active():
+                        # a spread partition leader shipping at a stale
+                        # cluster epoch: adopt the fence, SUSPEND (the write
+                        # gate refuses until a metadata refresh proves we
+                        # still lead our slice), and retry the ship at the
+                        # new epoch — never the whole-broker demotion, our
+                        # led partitions' tails are authoritative
+                        with self._role_lock:
+                            if reply.leader_epoch > self.epoch:
+                                self.epoch = reply.leader_epoch
+                                self._persist_meta("epoch", {"e": self.epoch})
+                                self.broker_metrics.repl_epoch.record(
+                                    self.epoch)
+                        self._kick_meta_refresh()
+                        return (f"{target}: cluster epoch raised to "
+                                f"{reply.leader_epoch}; re-shipping after "
+                                "the metadata refresh")
                     # the peer fenced us: a newer leader exists — this broker
                     # is deposed. Demote NOW (truncate the divergent tail,
                     # rejoin as a follower) instead of retrying forever.
@@ -2111,6 +2233,23 @@ class LogServer:
                     self.epoch_start = {
                         t: {int(p): int(off) for p, off in parts.items()}
                         for t, parts in obj.get("starts", {}).items()}
+            rec = latest.get("cluster")
+            if rec is not None:
+                obj = _json.loads(rec.value)
+                self._member_epoch = int(obj.get("me", 0))
+                self._assign_epoch = int(obj.get("ae", 0))
+                members = [str(m) for m in obj.get("m", []) if m]
+                if members:
+                    self._quorum_peers = members
+                self._assignments = {str(k): str(v)
+                                     for k, v in obj.get("a", {}).items()}
+                # the epoch this view was applied at: a restarted broker
+                # whose epoch record outran it (fenced after the last meta
+                # persist) comes back SUSPENDED until a refresh lands —
+                # never serving a partition the cluster moved while it slept
+                self._meta_epoch = int(obj.get("e", 0))
+            else:
+                self._meta_epoch = self.epoch
             rec = latest.get("vote")
             if rec is not None:
                 obj = _json.loads(rec.value)
@@ -2178,6 +2317,15 @@ class LogServer:
                     # gates reads on, and the vote-cluster shape
                     "high_watermarks": self._hwm_by_topic(),
                     "quorum": self._quorum_view(),
+                    # per-partition leadership view (the exactly-one-leader-
+                    # per-partition invariant is checkable from status alone:
+                    # chaos.py cluster / surgetop read these)
+                    "partitions_led": self.partitions_led(),
+                    "membership": {"epoch": self._member_epoch,
+                                   "members": list(self._quorum_peers)},
+                    "assignments": dict(self._assignments),
+                    "assign_epoch": self._assign_epoch,
+                    "meta_epoch": self._meta_epoch,
                     "handoff_fence": self._handoff_fence,
                     # flight-ring occupancy + dropped-event count: whether
                     # the bounded ring wrapped mid-incident (a truncated
@@ -2236,6 +2384,677 @@ class LogServer:
                 "majority": cluster // 2 + 1,
                 "min_insync_acks": self._repl_min_insync_acks,
                 "max_vote_epoch": self._max_vote_epoch}
+
+    # -- dynamic membership & per-partition leadership spread -----------------------------
+
+    def _spread_active(self) -> bool:
+        return bool(self._assignments)
+
+    def _leads(self, topic: str, partition: int) -> bool:
+        """Whether THIS broker is the write authority for one partition:
+        the assigned leader in spread mode, the whole-broker leader
+        otherwise (and always for unassigned indices / internal topics)."""
+        if topic in INTERNAL_TOPICS:
+            return True  # self-maintained per side, never routed
+        owner = self._assignments.get(str(partition))
+        if owner is None:
+            return self.role == "leader"
+        return owner == self._my_target()
+
+    def _leads_any(self) -> bool:
+        return (self._spread_active()
+                and self._my_target() in self._assignments.values())
+
+    def _shippable(self, topic: str, partition: int) -> bool:
+        """Whether THIS broker's replication stream owns (topic, p): every
+        partition in legacy mode; only the led slice in spread mode —
+        another leader's partitions would read as false lag or divergence
+        in our resync/verify scans."""
+        if not self._spread_active():
+            return True
+        return self._leads(topic, partition)
+
+    def partitions_led(self) -> list:
+        """Sorted partition indices this broker currently leads (the
+        BrokerStatus / surgetop / chaos-CLI spread view)."""
+        if not self._spread_active():
+            return []
+        me = self._my_target()
+        return sorted((int(k) for k, v in self._assignments.items()
+                       if v == me))
+
+    def _write_gate(self, records) -> Optional[pb.TxnReply]:
+        """None = this broker may commit the batch; else the refusing reply.
+        Caller holds the role lock. Legacy (no assignments): the whole-broker
+        role check. Spread mode: every record's partition index must be
+        assigned HERE — a miss redirects with that partition's leader as the
+        hint (per-partition NOT_LEADER), a mid-move fence or a stale
+        metadata view answers an empty hint (hold in place)."""
+        if self._handoff_fence:
+            # empty hint: the handoff destination is not promoted yet — the
+            # client holds in place for the tail window
+            return pb.TxnReply(
+                ok=False, error_kind="not_leader",
+                error="leadership handing off; retry shortly",
+                leader_hint="")
+        if not self._spread_active():
+            if self.role != "leader":
+                return pb.TxnReply(
+                    ok=False, error_kind="not_leader",
+                    error=f"broker is a {self.role}, not the leader",
+                    leader_hint=self.leader_hint)
+            return None
+        me = self._my_target()
+        stale = self.epoch > self._meta_epoch
+        for m in records:
+            if m.topic in INTERNAL_TOPICS:
+                continue
+            key = str(m.partition)
+            owner = self._assignments.get(key)
+            if owner is None:
+                if self.role != "leader":
+                    return pb.TxnReply(
+                        ok=False, error_kind="not_leader",
+                        error=f"partition {key} is unassigned; the "
+                              "coordinator leads it",
+                        leader_hint=self.leader_hint)
+                continue
+            if key in self._part_fence:
+                return pb.TxnReply(
+                    ok=False, error_kind="not_leader",
+                    error=f"partition {key} handing off; retry shortly",
+                    leader_hint="")
+            if owner != me:
+                return pb.TxnReply(
+                    ok=False, error_kind="not_leader",
+                    error=f"partition {key} is led by {owner}",
+                    leader_hint=owner)
+            if stale:
+                # our epoch outran the metadata view (a fence reply, a
+                # higher-epoch ship): the cluster may have MOVED this
+                # partition — refuse until a refresh proves we still lead it
+                self._kick_meta_refresh()
+                return pb.TxnReply(
+                    ok=False, error_kind="not_leader",
+                    error="cluster metadata stale (epoch "
+                          f"{self.epoch} > view {self._meta_epoch}); "
+                          "refresh in flight — retry shortly",
+                    leader_hint="")
+        return None
+
+    def _cluster_meta_view(self) -> dict:
+        """The ClusterMeta payload: everything a broker or client needs to
+        route — who is in the cluster, who leads which partition index, and
+        the epochs guarding both."""
+        me = self._my_target()
+        return {"coordinator": me if self.role == "leader"
+                else self.leader_hint,
+                "epoch": self.epoch,
+                "member_epoch": self._member_epoch,
+                "members": list(self._quorum_peers) or [me],
+                "assign_epoch": self._assign_epoch,
+                "assignments": dict(self._assignments)}
+
+    def _persist_cluster_meta(self) -> None:
+        self._persist_meta("cluster", {
+            "me": self._member_epoch, "ae": self._assign_epoch,
+            "e": self._meta_epoch, "m": list(self._quorum_peers),
+            "a": dict(self._assignments)})
+
+    def _record_cluster_gauges(self) -> None:
+        bm = self.broker_metrics
+        bm.cluster_member_epoch.record(self._member_epoch)
+        bm.cluster_members.record(len(self._quorum_peers))
+        bm.cluster_assign_epoch.record(self._assign_epoch)
+        bm.cluster_partitions_led.record(len(self.partitions_led()))
+
+    def _mutate_cluster_meta(self, members: Optional[list] = None,
+                             assign: Optional[Dict[str, str]] = None,
+                             reason: str = "") -> dict:
+        """Coordinator-only metadata mutation: rewrite the membership record
+        and/or move partition assignments, mint a FRESH cluster epoch (the
+        fence that suspends every stale assignment view), persist, broadcast
+        to every member. Returns the new view."""
+        with self._role_lock:
+            if self.role != "leader":
+                raise RuntimeError(
+                    "cluster metadata mutations run on the coordinator "
+                    f"({self.leader_hint or 'unknown'}); this broker is a "
+                    f"{self.role}")
+            if members is not None:
+                self._quorum_peers = [m for m in members if m]
+                self._member_epoch += 1
+            if assign:
+                for key, addr in assign.items():
+                    if addr:
+                        self._assignments[str(key)] = addr
+                    else:
+                        self._assignments.pop(str(key), None)
+                self._assign_epoch += 1
+            self.epoch += 1
+            self._meta_epoch = self.epoch
+            self._persist_meta("epoch", {"e": self.epoch})
+            self._persist_cluster_meta()
+            self.broker_metrics.repl_epoch.record(self.epoch)
+            # replication targets track the membership: new members are
+            # probed in (out-of-sync until proven), removed ones dropped
+            targets = self._quorum_others()
+            for t in targets:
+                if t not in self._repl_target_state:
+                    st = _TargetState()
+                    st.in_sync = False
+                    st.next_probe = time.monotonic() + 0.2
+                    # cursor starts at the queue tail's base: a joiner owes
+                    # nothing queued before it existed (resync covers holes)
+                    with self._repl_cv:
+                        st.shipped_index = (self._repl_enq_items
+                                            - len(self._repl_queue))
+                    self._repl_target_state[t] = st
+            for gone in [t for t in self._repl_targets if t not in targets]:
+                self._repl_target_state.pop(gone, None)
+            self._repl_targets = targets
+            view = self._cluster_meta_view()
+            self._record_cluster_gauges()
+            self.broker_metrics.repl_insync_replicas.record(
+                self._insync_count())
+        self.flight.record("cluster.meta", reason=reason or "mutate",
+                           epoch=view["epoch"],
+                           member_epoch=view["member_epoch"],
+                           assign_epoch=view["assign_epoch"],
+                           members=len(view["members"]))
+        self._broadcast_cluster_meta(view)
+        if self._repl_targets and self._server is not None:
+            self._start_repl_worker()
+        return view
+
+    def _start_repl_worker(self) -> None:
+        """(Re)arm the replication worker after a role/assignment change —
+        safe against the demote-stopped thread still draining, and against
+        being called FROM the worker itself (a mid-iteration demotion)."""
+        thread = self._repl_thread
+        if (thread is not None and thread.is_alive()
+                and thread is threading.current_thread()):
+            # running ON the worker (ship-fence demotion path): clearing the
+            # stop flag keeps this very thread looping — never join(self)
+            self._repl_stop = False
+            return
+        if thread is not None and thread.is_alive() and self._repl_stop:
+            with self._repl_cv:
+                self._repl_cv.notify_all()
+            thread.join(2.0)
+        with self._role_lock:
+            if self._dead or self._closed:
+                return
+            if self._repl_thread is not None and self._repl_thread.is_alive():
+                if not self._repl_stop:
+                    return  # live worker — keep it
+                return  # still draining its stop; a later ensure() retries
+            self._repl_stop = False
+            self._repl_thread = threading.Thread(
+                target=self._replication_loop,
+                name="surge-log-replication", daemon=True)
+            self._repl_thread.start()
+
+    def _broadcast_cluster_meta(self, view: dict) -> None:
+        """Best-effort push of the new metadata to every other member (an
+        unreachable member learns it from its fence-driven refresh, its
+        catch_up, or the next broadcast)."""
+        import json as _json
+
+        value = _json.dumps(view).encode()
+        delivered = 0
+        for peer in self._quorum_others():
+            try:
+                reply = self._probe_call(
+                    peer, "ClusterMeta", pb.TxnRequest, pb.TxnReply,
+                    pb.TxnRequest(op="apply", records=[pb.RecordMsg(
+                        has_value=True, value=value)]), timeout=2.0)
+                if reply.ok:
+                    delivered += 1
+            except Exception:  # noqa: BLE001 — the member learns it later
+                self._drop_probe_transport(peer)
+        self.flight.record("cluster.broadcast", delivered=delivered,
+                           members=len(self._quorum_others()))
+
+    def _apply_cluster_meta(self, meta: dict, source: str = "") -> bool:
+        """Install a coordinator's metadata view (broadcast push or refresh
+        pull). Epoch-guarded: stale membership/assignment epochs are refused.
+        A partition this broker LED that the view moved elsewhere gets its
+        un-quorum-acked tail truncated to the high-watermark — the orphan
+        records a dead-then-relit leader may hold must never shadow the new
+        leader's timeline (the per-partition KIP-101 rollback)."""
+        lost: list = []
+        repoint = False
+        with self._role_lock:
+            member_epoch = int(meta.get("member_epoch", 0))
+            assign_epoch = int(meta.get("assign_epoch", 0))
+            epoch = int(meta.get("epoch", 0))
+            if (member_epoch < self._member_epoch
+                    or assign_epoch < self._assign_epoch):
+                return False
+            if self.role == "leader" and epoch <= self.epoch:
+                # we are the authoritative coordinator; only a HIGHER-epoch
+                # view (a newer coordinator) may overrule us — and that path
+                # runs through the demotion fence, not a bare apply
+                return False
+            me = self._my_target()
+            old = dict(self._assignments)
+            members = [str(m) for m in meta.get("members", []) if m]
+            self._quorum_peers = members
+            self._member_epoch = member_epoch
+            self._assignments = {str(k): str(v) for k, v in
+                                 (meta.get("assignments") or {}).items()}
+            self._assign_epoch = assign_epoch
+            if epoch > self.epoch:
+                self.epoch = epoch
+                self._persist_meta("epoch", {"e": self.epoch})
+                self.broker_metrics.repl_epoch.record(self.epoch)
+            self._meta_epoch = max(self._meta_epoch, epoch)
+            coordinator = str(meta.get("coordinator", ""))
+            if coordinator and coordinator != me and self.role != "leader":
+                self.leader_hint = coordinator
+                if self._follower_of != coordinator:
+                    self._follower_of = coordinator
+                    repoint = True
+            self._persist_cluster_meta()
+            self._record_cluster_gauges()
+            lost = [key for key, owner in old.items()
+                    if owner == me
+                    and self._assignments.get(key) not in (me, None)]
+        self.flight.record("cluster.meta-apply", source=source or "peer",
+                           epoch=epoch, member_epoch=member_epoch,
+                           assign_epoch=assign_epoch,
+                           lost=lost if lost else None)
+        for key in lost:
+            self._truncate_partition_to_hwm(int(key))
+        if repoint:
+            self._ensure_prober()
+        self._ensure_spread_replication()
+        return True
+
+    def _truncate_partition_to_hwm(self, partition: int) -> None:
+        """Roll one partition index back to its quorum-acked frontier on
+        every topic: records beyond the high-watermark were never provably
+        acked, and the partition's NEW leader will re-ship anything we
+        dropped that actually survived (gap-checked resync)."""
+        fn = getattr(self.log, "truncate_partition", None)
+        if fn is None:
+            return
+        truncated = 0
+        for spec in self._topic_specs():
+            if spec.name in INTERNAL_TOPICS or \
+                    partition >= (spec.partitions or 1):
+                continue
+            hwm = self._hwm.get((spec.name, partition))
+            if hwm is None:
+                continue
+            if self._applied_end(spec.name, partition) > hwm:
+                truncated += fn(spec.name, partition, hwm)
+        if truncated:
+            self.metrics.failover_truncated_records.record(truncated)
+            self.flight.record("cluster.truncate", partition=partition,
+                               records=truncated)
+            logger.warning(
+                "partition %d moved away: truncated %d record(s) past the "
+                "high-watermark (un-quorum-acked orphan tail)",
+                partition, truncated)
+
+    def _ensure_spread_replication(self) -> None:
+        """A spread partition leader ships its commits to every other member
+        exactly like the coordinator does — start/retarget its replication
+        worker whenever the assignment view changes."""
+        start = False
+        with self._role_lock:
+            if self.role == "leader" or self._dead or self._closed:
+                return  # the coordinator path owns its own targets
+            if not self._leads_any():
+                self._repl_targets = []
+                return
+            targets = self._quorum_others()
+            for t in targets:
+                if t not in self._repl_target_state:
+                    st = _TargetState()
+                    with self._repl_cv:
+                        st.shipped_index = (self._repl_enq_items
+                                            - len(self._repl_queue))
+                    self._repl_target_state[t] = st
+            self._repl_targets = targets
+            start = bool(targets) and self._server is not None
+        if start:
+            self._start_repl_worker()
+
+    def _kick_meta_refresh(self) -> None:
+        """Rate-limited async metadata refresh (the suspended-write-gate
+        path): at most one in flight, at most ~2/s."""
+        now = time.monotonic()
+        if now < self._meta_refresh_after:
+            return
+        if not self._meta_refresh_lock.acquire(blocking=False):
+            return
+        self._meta_refresh_after = now + 0.5
+        threading.Thread(target=self._refresh_cluster_meta_locked,
+                         name="surge-cluster-meta-refresh",
+                         daemon=True).start()
+
+    def _refresh_cluster_meta_locked(self) -> None:
+        try:
+            self._refresh_cluster_meta()
+        finally:
+            self._meta_refresh_lock.release()
+
+    def _refresh_cluster_meta(self) -> bool:
+        """Pull the current metadata view from the coordinator (falling back
+        to any member) and install it."""
+        import json as _json
+
+        sources = [self.leader_hint] + self._quorum_others()
+        seen = set()
+        for src in sources:
+            if not src or src in seen or src == self._my_target():
+                continue
+            seen.add(src)
+            try:
+                reply = self._probe_call(src, "ClusterMeta", pb.TxnRequest,
+                                         pb.TxnReply,
+                                         pb.TxnRequest(op="status"),
+                                         timeout=2.0)
+                if not reply.ok or not reply.records:
+                    continue
+                meta = _json.loads(reply.records[0].value)
+            except Exception:  # noqa: BLE001 — try the next member
+                self._drop_probe_transport(src)
+                continue
+            # only a view from the coordinator itself (or one at least as
+            # fresh as our suspension epoch) can prove our map current
+            if self._apply_cluster_meta(meta, source=src):
+                return True
+        return False
+
+    def ClusterMeta(self, request: pb.TxnRequest, context) -> pb.TxnReply:
+        """The dynamic-membership / partition-spread RPC (METHODS table)."""
+        import json as _json
+
+        obj = {}
+        if request.records and request.records[0].has_value:
+            try:
+                obj = _json.loads(request.records[0].value or b"{}")
+            except ValueError:
+                return pb.TxnReply(ok=False, error_kind="state",
+                                   error="malformed ClusterMeta payload")
+
+        def ok(view: dict) -> pb.TxnReply:
+            return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+                has_key=True, key="cluster", has_value=True,
+                value=_json.dumps(view).encode())])
+
+        op = request.op or "status"
+        try:
+            if op == "status":
+                with self._role_lock:
+                    return ok(self._cluster_meta_view())
+            if op == "apply":
+                applied = self._apply_cluster_meta(obj, source="rpc")
+                with self._role_lock:
+                    view = self._cluster_meta_view()
+                view["applied"] = applied
+                return ok(view)
+            # coordinator-only mutations below
+            if self.role != "leader":
+                return pb.TxnReply(
+                    ok=False, error_kind="not_leader",
+                    error=f"ClusterMeta {op!r} runs on the coordinator",
+                    leader_hint=self.leader_hint)
+            if op == "add":
+                return ok(self._add_broker(str(obj.get("addr", ""))))
+            if op == "remove":
+                return ok(self._remove_broker(str(obj.get("addr", ""))))
+            if op == "assign":
+                key = str(obj.get("partition", ""))
+                to = str(obj.get("to", ""))
+                if not key or not to:
+                    return pb.TxnReply(ok=False, error_kind="state",
+                                       error='assign needs {"partition", '
+                                             '"to"}')
+                if to not in self._quorum_peers:
+                    return pb.TxnReply(ok=False, error_kind="state",
+                                       error=f"{to} is not a member")
+                return ok(self._mutate_cluster_meta(assign={key: to},
+                                                    reason="assign"))
+            if op == "spread":
+                return ok(self._spread_partitions(
+                    int(obj.get("partitions", 0))))
+            return pb.TxnReply(ok=False, error_kind="state",
+                               error=f"unknown ClusterMeta op {op!r}")
+        except Exception as exc:  # noqa: BLE001 — operator gets it back
+            logger.exception("ClusterMeta %s failed", op)
+            return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
+
+    def _known_partition_count(self) -> int:
+        count = 0
+        for spec in self._topic_specs():
+            if spec.name in INTERNAL_TOPICS:
+                continue
+            count = max(count, spec.partitions or 1)
+        return count
+
+    def _spread_partitions(self, partitions: int = 0) -> dict:
+        """Round-robin every partition index across the membership (the
+        initial leadership spread; later skew is the autobalancer's job).
+        Members are ordered by current lead count so repeated calls stay
+        stable."""
+        count = partitions or self._known_partition_count()
+        if count <= 0:
+            raise RuntimeError("no topics known and no partition count "
+                               "given; create topics first or pass "
+                               '{"partitions": N}')
+        members = self._spread_members()
+        if not members:
+            raise RuntimeError("no membership configured "
+                               "(quorum_peers / AddBroker first)")
+        assign = {}
+        for p in range(count):
+            key = str(p)
+            if self._assignments.get(key) in members:
+                continue  # already placed on a live member: keep it
+            members.sort(key=lambda m: self._lead_counts(assign).get(m, 0))
+            assign[key] = members[0]
+        if not assign:
+            with self._role_lock:
+                return self._cluster_meta_view()
+        return self._mutate_cluster_meta(assign=assign, reason="spread")
+
+    def _spread_members(self) -> list:
+        """Members eligible to lead partitions: self plus every in-sync
+        target (an out-of-sync member must not be handed leadership)."""
+        me = self._my_target()
+        members = [me]
+        for t in self._quorum_others():
+            st = self._repl_target_state.get(t)
+            if st is None or st.in_sync:
+                members.append(t)
+        return members
+
+    def _lead_counts(self, extra: Optional[Dict[str, str]] = None
+                     ) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        merged = dict(self._assignments)
+        if extra:
+            merged.update(extra)
+        for addr in merged.values():
+            counts[addr] = counts.get(addr, 0) + 1
+        return counts
+
+    def _add_broker(self, addr: str) -> dict:
+        """AddBroker: admit a caught-up broker into the membership. The
+        joiner must already be within the auto-resync cap of this
+        coordinator (catch_up first — the PR-7 slice lane), so it never
+        counts toward a quorum it could not honor."""
+        if not addr:
+            raise RuntimeError('add needs {"addr": "host:port"}')
+        if addr in self._quorum_peers:
+            with self._role_lock:
+                return self._cluster_meta_view()
+        # reachability + catch-up proof: the joiner's applied ends must be
+        # within the auto-resync cap (the leader can close that much itself)
+        lag = 0
+        try:
+            for spec in self._topic_specs():
+                if spec.name in INTERNAL_TOPICS:
+                    continue
+                for p in range(spec.partitions or 1):
+                    theirs = self._remote_end_offset(addr, spec.name, p)
+                    lag += max(0, self._applied_end(spec.name, p) - theirs)
+        except Exception as exc:  # noqa: BLE001 — joiner not serving yet
+            self._drop_probe_transport(addr)
+            raise RuntimeError(
+                f"{addr} is unreachable — start it and run catch_up "
+                f"before AddBroker ({exc!r})") from exc
+        cap = max(self._repl_auto_resync_cap, 0)
+        if cap and lag > cap:
+            raise RuntimeError(
+                f"{addr} lags {lag} records (> auto-resync cap {cap}); "
+                "run catch_up before AddBroker")
+        members = list(self._quorum_peers)
+        if self._my_target() not in members:
+            members.append(self._my_target())
+        members.append(addr)
+        view = self._mutate_cluster_meta(members=members, reason="add")
+        self.flight.record("cluster.add", addr=addr, lag=lag,
+                           member_epoch=view["member_epoch"])
+        return view
+
+    def _remove_broker(self, addr: str) -> dict:
+        """RemoveBroker: retire a member. Its led partitions fail over to
+        the surviving member holding the most log for each (the same
+        up-to-date posture the vote layer enforces)."""
+        if not addr:
+            raise RuntimeError('remove needs {"addr": "host:port"}')
+        if addr == self._my_target():
+            raise RuntimeError("the coordinator cannot remove itself; "
+                               "hand off leadership first")
+        if addr not in self._quorum_peers:
+            with self._role_lock:
+                return self._cluster_meta_view()
+        members = [m for m in self._quorum_peers if m != addr]
+        reassign = self._pick_heirs(
+            [k for k, v in self._assignments.items() if v == addr],
+            exclude=addr)
+        view = self._mutate_cluster_meta(members=members, assign=reassign,
+                                         reason="remove")
+        if reassign:
+            self.broker_metrics.cluster_reassignments.record(len(reassign))
+        self.flight.record("cluster.remove", addr=addr,
+                           reassigned=sorted(reassign) if reassign else None,
+                           member_epoch=view["member_epoch"])
+        # best-effort: tell the removed broker directly so it stops serving
+        # (it is no longer in the membership the broadcast walks)
+        import json as _json
+
+        try:
+            self._probe_call(addr, "ClusterMeta", pb.TxnRequest, pb.TxnReply,
+                             pb.TxnRequest(op="apply", records=[pb.RecordMsg(
+                                 has_value=True,
+                                 value=_json.dumps(view).encode())]),
+                             timeout=2.0)
+        except Exception:  # noqa: BLE001 — it learns via the fence instead
+            self._drop_probe_transport(addr)
+        return view
+
+    def _pick_heirs(self, keys: list, exclude: str) -> Dict[str, str]:
+        """For each partition index, pick the successor leader: the eligible
+        member holding the MOST applied log for it (ties to the least-loaded
+        member) — an acked commit lives on the quorum, and the longest log
+        among the survivors provably holds every quorum-acked record."""
+        heirs: Dict[str, str] = {}
+        candidates = [m for m in self._spread_members() if m != exclude]
+        if not candidates:
+            return heirs
+        me = self._my_target()
+        for key in keys:
+            p = int(key)
+            best, best_end = None, -1
+            counts = self._lead_counts(heirs)
+            for member in sorted(candidates,
+                                 key=lambda m: counts.get(m, 0)):
+                end = 0
+                for spec in self._topic_specs():
+                    if spec.name in INTERNAL_TOPICS or \
+                            p >= (spec.partitions or 1):
+                        continue
+                    try:
+                        end += (self._applied_end(spec.name, p)
+                                if member == me else
+                                self._remote_end_offset(member, spec.name, p))
+                    except Exception:  # noqa: BLE001 — unreachable heir
+                        self._drop_probe_transport(member)
+                        end = -1
+                        break
+                if end > best_end:
+                    best, best_end = member, end
+            if best is not None:
+                heirs[key] = best
+        return heirs
+
+    def _maybe_reassign_failed(self, now: float) -> None:
+        """Coordinator sweep (replication-worker cadence, ~1/s): a member
+        whose ships have been failing past the reassign grace — over and
+        above the ISR drop — loses its led partitions to the surviving
+        members. This is the per-partition failover leg of self-healing:
+        broker death moves ITS slice, not the whole cluster."""
+        if self.role != "leader" or not self._spread_active():
+            return
+        if now < self._next_reassign_check:
+            return
+        self._next_reassign_check = now + 1.0
+        me = self._my_target()
+        for addr in set(self._assignments.values()):
+            if addr == me:
+                continue
+            st = self._repl_target_state.get(addr)
+            if st is None:
+                continue
+            if st.failing_since is None or st.in_sync:
+                # the ISR machinery only observes SHIP failures — an idle
+                # cluster would never notice a dead partition leader. Probe
+                # liveness directly on this sweep's cadence (short timeout:
+                # this runs on the replication worker; a blackholed member
+                # must not stall the ship loop); a false alarm only costs a
+                # planned move, never correctness. The probe tracks its OWN
+                # clock — it must never reset the ship path's
+                # ``failing_since``, or a member whose data plane fails
+                # while its control plane answers would dodge the ISR drop
+                # forever.
+                try:
+                    self._remote_broker_status(addr, timeout=0.75)
+                    st.probe_failing_since = None
+                    continue
+                except Exception:  # noqa: BLE001 — unreachable member
+                    self._drop_probe_transport(addr)
+                    if st.probe_failing_since is None:
+                        st.probe_failing_since = now
+                down_since = st.probe_failing_since
+            else:
+                down_since = st.failing_since
+            if down_since is None or now - down_since \
+                    < self._reassign_grace_s:
+                continue
+            keys = [k for k, v in self._assignments.items() if v == addr]
+            heirs = self._pick_heirs(keys, exclude=addr)
+            if not heirs:
+                continue
+            self.broker_metrics.cluster_reassignments.record(len(heirs))
+            self.flight.record("cluster.reassign", addr=addr,
+                               partitions=sorted(heirs),
+                               reason="member-failed",
+                               failing_s=round(now - down_since, 2))
+            logger.error(
+                "member %s failing for %.1fs: reassigning its partitions "
+                "%s", addr, now - down_since, sorted(heirs.items()))
+            try:
+                self._mutate_cluster_meta(assign=heirs,
+                                          reason="member-failed")
+            except Exception:  # noqa: BLE001 — retried next sweep
+                logger.exception("failed-member reassignment failed")
 
     def promote(self, replicate_to: Optional[list] = None,
                 at_epoch: Optional[int] = None) -> dict:
@@ -2327,7 +3146,20 @@ class LogServer:
                 replicate_to=list(self._repl_targets),
                 epoch_start={t: {str(p): off for p, off in parts.items()}
                              for t, parts in list(starts.items())[:8]})
-            return self.broker_status()
+            spread = self._spread_active()
+            if spread:
+                # claim coordinatorship of the metadata plane: re-stamp the
+                # (unchanged) membership/assignment view at OUR epoch, so
+                # partition leaders suspended by the election fence resume
+                # the moment the broadcast (or their refresh) lands
+                self._meta_epoch = self.epoch
+                self._persist_cluster_meta()
+                self._record_cluster_gauges()
+                view = self._cluster_meta_view()
+            status = self.broker_status()
+        if spread:
+            self._broadcast_cluster_meta(view)
+        return status
 
     def _demote(self, new_epoch: int, fencer: Optional[str],
                 adopt_epoch: bool = True,
@@ -2384,6 +3216,12 @@ class LogServer:
         finally:
             with self._role_lock:
                 self._demoting = False
+        # spread mode: a deposed COORDINATOR usually still leads its slice —
+        # restart the (demote-stopped) replication worker for it, and pull a
+        # fresh metadata view from the new coordinator
+        if self._spread_active():
+            self._ensure_spread_replication()
+            self._kick_meta_refresh()
 
     def _truncate_to_leader(self, leader_target: str) -> None:
         """KIP-101 divergence repair: roll every partition back to the new
@@ -2400,6 +3238,10 @@ class LogServer:
                     continue
                 for p, start in parts.items():
                     p = int(p)
+                    if self._spread_active() and self._leads(topic, p):
+                        # our led slice's tail is authoritative — the new
+                        # COORDINATOR's epoch-start says nothing about it
+                        continue
                     mine = self._applied_end(topic, p)
                     if mine > int(start) and fn is not None:
                         truncated += fn(topic, p, int(start))
@@ -2436,12 +3278,13 @@ class LogServer:
                 "follower stays behind until the leader's rejoin probe or an "
                 "operator catch_up heals it", leader_target)
 
-    def _remote_broker_status(self, target: str) -> dict:
+    def _remote_broker_status(self, target: str,
+                              timeout: float = 2.0) -> dict:
         import json as _json
 
         reply = self._probe_stub(target, "BrokerStatus",
                                  pb.ListTopicsRequest, pb.TxnReply)(
-            pb.ListTopicsRequest(), timeout=2.0)
+            pb.ListTopicsRequest(), timeout=timeout)
         if not reply.ok or not reply.records:
             raise RuntimeError(f"BrokerStatus on {target} failed: "
                                f"{reply.error}")
@@ -2462,7 +3305,12 @@ class LogServer:
                 self.epoch = remote
                 self._persist_meta("epoch", {"e": self.epoch})
         except Exception:  # noqa: BLE001 — leader dead: promote past known
-            pass
+            # drop the channel: a follower starting BEFORE its leader would
+            # otherwise cache a connect-backoff channel here that fails
+            # every later probe-stub RPC to the leader (votes, metadata
+            # refreshes, per-partition handoff flips) until gRPC's backoff
+            # deigns to reconnect
+            self._drop_probe_transport(self._follower_of)
 
     def _confirm_leadership(self) -> None:
         """Split-brain guard at start (KIP-279 flavor): a restarting broker
@@ -2947,6 +3795,14 @@ class LogServer:
             # this snapshot) or will be gap-checked-shipped post-rejoin
             snap = leader._calls["DedupSnapshot"](pb.DedupSnapshotRequest())
             self._merge_dedup_entries(snap.entries)
+            # cluster metadata rides along: a joiner/rejoiner must route and
+            # gate against the CURRENT membership + assignment view, not the
+            # one it last persisted before going down
+            try:
+                meta = leader.cluster_meta()
+                self._apply_cluster_meta(meta, source="catch_up")
+            except Exception:  # noqa: BLE001 — pre-spread leader: fine
+                pass
             self.catch_up_state = {"state": "done", "from": leader_target,
                                    "records": copied, "wall": time.time()}
             self.flight.record("catchup.done", leader=leader_target,
@@ -3046,13 +3902,18 @@ class LogServer:
         them with the right partition count via CreateTopic."""
         from surge_tpu.store.checkpoint import decode_partition_slice
 
-        if self.role == "leader":
-            return pb.TxnReply(ok=False, error_kind="state",
-                               error="a leader does not ingest slices")
         try:
             header, records = decode_partition_slice(
                 bytes(request.records[0].value))
             topic, p = header["topic"], int(header["partition"])
+            if self._leads(topic, p):
+                # the write authority for this partition never ingests
+                # foreign offsets for it — that would fork its own log
+                # (whole-broker leader in legacy mode; per-partition in
+                # spread mode, where the coordinator CAN receive slices
+                # for partitions another broker is handing it)
+                return pb.TxnReply(ok=False, error_kind="state",
+                                   error="a leader does not ingest slices")
             spec = getattr(self.log, "_topics", {}).get(topic)
             if spec is None:
                 return pb.TxnReply(
@@ -3092,12 +3953,8 @@ class LogServer:
         and handoff. Returns records shipped. Raises on a refused install
         (the caller owns retry/abort policy)."""
         shipped = 0
-        install = self._probe_stub(target, "InstallSlice", pb.TxnRequest,
-                                   pb.TxnReply)
         create = self._probe_stub(target, "CreateTopic",
                                   pb.CreateTopicRequest, pb.TopicReply)
-        from surge_tpu.store.checkpoint import encode_partition_slice
-
         for spec in self._topic_specs():
             if spec.name in INTERNAL_TOPICS:
                 continue  # self-maintained per side (see _resync_follower)
@@ -3105,34 +3962,47 @@ class LogServer:
                 name=spec.name, partitions=spec.partitions,
                 compacted=spec.compacted)), timeout=2.0)
             for p in range(spec.partitions or 1):
-                # bounded passes, not while-True: under sustained append a
-                # moving frontier must not pin the bulk phase forever — the
-                # fenced tail pass finishes whatever is left
-                for _pass in range(1000):
-                    theirs = self._remote_end_offset(target, spec.name, p)
-                    ours = self._applied_end(spec.name, p)
-                    if theirs >= ours:
-                        break
-                    batch = list(self.log.read(spec.name, p,
-                                               from_offset=theirs,
-                                               max_records=page))
-                    if not batch:
-                        break  # compacted hole at the tail
-                    # base=theirs: a head hole in [theirs, batch[0]) is a
-                    # compaction gap this read vouches for — the installer
-                    # may ingest past it (state topics ARE compacted)
-                    data = encode_partition_slice(batch, spec.name, p,
-                                                  base=theirs)
-                    reply = install(pb.TxnRequest(
-                        op="install", records=[pb.RecordMsg(
-                            topic=spec.name, partition=p, has_key=True,
-                            key="slice", has_value=True, value=data)]),
-                        timeout=self._repl_ack_timeout_s)
-                    if not reply.ok:
-                        raise RuntimeError(
-                            f"InstallSlice {spec.name}[{p}] on {target} "
-                            f"refused: {reply.error}")
-                    shipped += len(batch)
+                shipped += self._ship_partition_slices(target, spec, p,
+                                                       page=page)
+        return shipped
+
+    def _ship_partition_slices(self, target: str, spec, p: int,
+                               page: int = 2000) -> int:
+        """Push what ``target`` lacks of ONE partition as checkpoint-codec
+        slices — the whole-broker handoff's inner loop, and the spread
+        handoff's per-partition tail ship. The topic must already exist on
+        the target (CreateTopic is idempotent; callers send it first)."""
+        from surge_tpu.store.checkpoint import encode_partition_slice
+
+        install = self._probe_stub(target, "InstallSlice", pb.TxnRequest,
+                                   pb.TxnReply)
+        shipped = 0
+        # bounded passes, not while-True: under sustained append a moving
+        # frontier must not pin the bulk phase forever — the fenced tail
+        # pass finishes whatever is left
+        for _pass in range(1000):
+            theirs = self._remote_end_offset(target, spec.name, p)
+            ours = self._applied_end(spec.name, p)
+            if theirs >= ours:
+                break
+            batch = list(self.log.read(spec.name, p, from_offset=theirs,
+                                       max_records=page))
+            if not batch:
+                break  # compacted hole at the tail
+            # base=theirs: a head hole in [theirs, batch[0]) is a
+            # compaction gap this read vouches for — the installer
+            # may ingest past it (state topics ARE compacted)
+            data = encode_partition_slice(batch, spec.name, p, base=theirs)
+            reply = install(pb.TxnRequest(
+                op="install", records=[pb.RecordMsg(
+                    topic=spec.name, partition=p, has_key=True,
+                    key="slice", has_value=True, value=data)]),
+                timeout=self._repl_ack_timeout_s)
+            if not reply.ok:
+                raise RuntimeError(
+                    f"InstallSlice {spec.name}[{p}] on {target} "
+                    f"refused: {reply.error}")
+            shipped += len(batch)
         if shipped:
             self.broker_metrics.handoff_shipped_records.record(shipped)
         return shipped
@@ -3157,6 +4027,18 @@ class LogServer:
         if not to:
             return pb.TxnReply(ok=False, error_kind="state",
                                error='HandoffPartition needs {"to": target}')
+        if "partition" in obj:
+            # spread mode: move ONE partition index's leadership (the
+            # autobalancer's unit of work), not the whole broker
+            try:
+                stats = self._handoff_partition_to(to, int(obj["partition"]))
+                return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+                    has_key=True, key="handoff", has_value=True,
+                    value=_json.dumps(stats).encode())])
+            except Exception as exc:  # noqa: BLE001 — operator gets it back
+                logger.exception("partition handoff to %s failed", to)
+                return pb.TxnReply(ok=False, error_kind="other",
+                                   error=repr(exc))
         with self._role_lock:
             if self.role != "leader":
                 return pb.TxnReply(ok=False, error_kind="not_leader",
@@ -3257,6 +4139,113 @@ class LogServer:
         logger.warning("handoff to %s complete: %s", to, stats)
         return stats
 
+    def _handoff_partition_to(self, to: str, partition: int) -> dict:
+        """Planned PER-PARTITION leadership transfer (spread mode): fence
+        one partition index, drain its in-flight commits + queued ships,
+        tail-sync the destination on every topic at that index, push the
+        dedup table, flip the assignment through the coordinator, unfence.
+        The fenced span covers one partition's tail — every other partition
+        this broker leads keeps committing throughout."""
+        import json as _json
+
+        key = str(partition)
+        me = self._my_target()
+        with self._role_lock:
+            if not self._spread_active():
+                raise RuntimeError("per-partition handoff needs an active "
+                                   "assignment map (ClusterMeta spread)")
+            owner = self._assignments.get(key, me if self.role == "leader"
+                                          else "")
+            if owner != me:
+                raise RuntimeError(f"partition {key} is led by "
+                                   f"{owner or 'nobody'}, not this broker")
+            if to == me:
+                raise RuntimeError("destination is this broker")
+            if self._quorum_peers and to not in self._quorum_peers:
+                raise RuntimeError(f"{to} is not a cluster member")
+            if key in self._part_fence or self._handoff_fence:
+                raise RuntimeError("a handoff is already in progress for "
+                                   f"partition {key}")
+            self._part_fence.add(key)
+        stats: dict = {"from": me, "to": to, "partition": partition}
+        self.flight.record("handoff.partition.start", partition=partition,
+                           to=to)
+        fence_t0 = time.perf_counter()
+        try:
+            # drain: in-flight commits touching THIS partition, and queued
+            # replication items still awaiting their quorum for it
+            deadline = time.monotonic() + 2.0 * self._repl_ack_timeout_s
+            while time.monotonic() < deadline:
+                with self._role_lock:
+                    inflight = self._inflight_parts.get(key, 0)
+                with self._repl_cv:
+                    undone = sum(
+                        1 for i in self._repl_queue
+                        if not i.done.is_set() and any(
+                            r.partition == partition
+                            and r.topic not in INTERNAL_TOPICS
+                            for r in i.records))
+                if inflight == 0 and undone == 0:
+                    break
+                time.sleep(0.01)
+            else:
+                raise RuntimeError(
+                    f"partition {key} handoff drain timed out")
+            # tail-sync the destination on every topic at this index (the
+            # continuous spread replication keeps it near; this closes the
+            # last records + any resync hole), then push dedup so in-flight
+            # seq replays answer from cache on the new leader
+            create = self._probe_stub(to, "CreateTopic",
+                                      pb.CreateTopicRequest, pb.TopicReply)
+            shipped = 0
+            for spec in self._topic_specs():
+                if spec.name in INTERNAL_TOPICS or \
+                        partition >= (spec.partitions or 1):
+                    continue
+                create(pb.CreateTopicRequest(spec=pb.TopicSpecMsg(
+                    name=spec.name, partitions=spec.partitions,
+                    compacted=spec.compacted)), timeout=2.0)
+                shipped += self._ship_partition_slices(to, spec, partition)
+            stats["tail_records"] = shipped
+            err = self._push_dedup_to(to)
+            if err is not None:
+                raise RuntimeError(f"dedup push refused: {err}")
+            if self.faults is not None:
+                self.faults.crash_point("handoff.partition.pre-assign")
+            # flip the assignment through the coordinator (ourselves, when
+            # this broker IS the coordinator) and adopt the new view NOW —
+            # the unfence below must reveal the new owner, not us
+            if self.role == "leader":
+                view = self._mutate_cluster_meta(assign={key: to},
+                                                 reason="handoff")
+            else:
+                reply = self._probe_call(
+                    self.leader_hint, "ClusterMeta", pb.TxnRequest,
+                    pb.TxnReply,
+                    pb.TxnRequest(op="assign", records=[pb.RecordMsg(
+                        has_value=True, value=_json.dumps(
+                            {"partition": key, "to": to}).encode())]),
+                    timeout=2.0 * self._repl_ack_timeout_s)
+                if not reply.ok:
+                    raise RuntimeError(
+                        f"coordinator refused the assignment flip: "
+                        f"{reply.error}")
+                view = _json.loads(reply.records[0].value)
+                self._apply_cluster_meta(view, source="handoff")
+            stats["assign_epoch"] = int(view.get("assign_epoch", 0))
+            stats["epoch"] = int(view.get("epoch", 0))
+        finally:
+            with self._role_lock:
+                self._part_fence.discard(key)
+        fence_ms = round((time.perf_counter() - fence_t0) * 1000.0, 2)
+        stats["fence_ms"] = fence_ms
+        self.broker_metrics.handoff_fence_timer.record_ms(fence_ms)
+        self.flight.record("handoff.partition.done",
+                           **{k: v for k, v in stats.items() if k != "from"})
+        logger.warning("partition %d handed off to %s: %s", partition, to,
+                       stats)
+        return stats
+
     def _adopt_shipped_hwm(self, high_watermarks: str) -> None:
         """Follower half of the high-watermark protocol: every Replicate
         (data, rejoin probe, or post-finalize beacon) carries the leader's
@@ -3284,7 +4273,12 @@ class LogServer:
         high-watermark, or None when this partition is ungated (leader
         reads; a follower that never received a hwm ship keeps the PR-4
         serve-everything behavior — legacy pairs, operator catch_up
-        replicas)."""
+        replicas). In spread mode the gate is PER PARTITION: a broker is
+        authoritative for its led slice and hwm-gated for everyone else's."""
+        if self._spread_active():
+            if topic in INTERNAL_TOPICS or self._leads(topic, partition):
+                return None
+            return self._hwm.get((topic, partition))
         if self.role == "leader":
             return None
         return self._hwm.get((topic, partition))
@@ -3549,6 +4543,20 @@ class LogServer:
             with self._role_lock:
                 self._adopt_leader_epoch()
         self._ensure_prober()
+        self._record_cluster_gauges()
+        if self._spread_active() and self.role != "leader":
+            # a restarted broker's recovered assignment view may predate
+            # moves made while it was down — and its recovered epoch was
+            # persisted at the same staleness, so the epoch fence alone
+            # cannot catch it. Come back SUSPENDED: the write gate refuses
+            # until a metadata refresh (or a coordinator broadcast) proves
+            # the view current, so a relit ex-leader can never serve a
+            # partition the cluster moved while it slept.
+            with self._role_lock:
+                self._meta_epoch = min(self._meta_epoch, self.epoch - 1)
+            self._kick_meta_refresh()
+        elif self._spread_active():
+            self._ensure_spread_replication()
         return self.bound_port
 
     def _ensure_prober(self) -> None:
@@ -3566,7 +4574,16 @@ class LogServer:
             from surge_tpu.health.prober import BrokerLivenessProber
 
             def _ping() -> None:
-                self._remote_broker_status(self._follower_of)
+                try:
+                    self._remote_broker_status(self._follower_of)
+                except Exception:
+                    # drop the cached channel NOW: one probe that failed
+                    # while the leader was booting would otherwise leave a
+                    # connect-backoff channel poisoning every later probe
+                    # AND every other probe-stub RPC to the same address
+                    # (vote liveness checks, per-partition handoffs)
+                    self._drop_probe_transport(self._follower_of)
+                    raise
 
             self._leader_prober = BrokerLivenessProber(
                 self._follower_of, _ping, config=self._config,
@@ -3612,13 +4629,22 @@ class LogServer:
         import json as _json
 
         me = self._my_target()
-        others = self._quorum_others()
-        cluster = len(others) + 1
-        needed = cluster // 2 + 1
         backoff = 0.05
         for rnd in range(self._vote_rounds):
             if self._dead or self._closed or self.role == "leader":
                 return self.role == "leader"
+            # membership is DYNAMIC: re-read it every round, so an
+            # AddBroker/RemoveBroker landing mid-campaign re-sizes the
+            # majority this very election needs (no restart required) — and
+            # a broker the cluster removed must stop campaigning entirely
+            others = self._quorum_others()
+            if self._quorum_peers and me and me not in self._quorum_peers:
+                self.flight.record("quorum.stand-down", reason="removed")
+                logger.error("this broker was removed from the membership; "
+                             "standing down from the campaign")
+                return False
+            cluster = len(others) + 1
+            needed = cluster // 2 + 1
             stand_down = self._stand_down_until - time.monotonic()
             if stand_down > 0:
                 # we just granted a peer this round: give its promotion the

@@ -83,6 +83,12 @@ class BrokerMetrics:
     # planned partition handoff
     handoff_fence_timer: Timer = field(init=False)
     handoff_shipped_records: Sensor = field(init=False)
+    # dynamic membership & per-partition leadership spread (cluster plane)
+    cluster_member_epoch: Sensor = field(init=False)
+    cluster_members: Sensor = field(init=False)
+    cluster_assign_epoch: Sensor = field(init=False)
+    cluster_partitions_led: Sensor = field(init=False)
+    cluster_reassignments: Sensor = field(init=False)
     # failover + fault-plane counters (shared names with EngineMetrics so a
     # broker without an engine-wired quiver still counts them — the LogServer
     # falls back to this quiver when metrics= is not given)
@@ -217,6 +223,29 @@ class BrokerMetrics:
             "surge.log.handoff.shipped-records",
             "records shipped to handoff destinations as checkpoint-codec "
             "partition slices (bulk phase + fenced tail)"))
+        self.cluster_member_epoch = m.gauge(MI(
+            "surge.cluster.member-epoch",
+            "version of the replicated membership record this broker last "
+            "applied (AddBroker/RemoveBroker bump it; stale views are "
+            "epoch-fenced)"))
+        self.cluster_members = m.gauge(MI(
+            "surge.cluster.members",
+            "brokers in the membership record this broker last applied "
+            "(the dynamic quorum_peers list, self included)"))
+        self.cluster_assign_epoch = m.gauge(MI(
+            "surge.cluster.assign-epoch",
+            "version of the partition->leader assignment map this broker "
+            "last applied (handoffs and failed-member reassignments bump "
+            "it)"))
+        self.cluster_partitions_led = m.gauge(MI(
+            "surge.cluster.partitions-led",
+            "partition indices this broker currently leads under the "
+            "spread assignment map (0 on legacy whole-broker clusters)"))
+        self.cluster_reassignments = m.counter(MI(
+            "surge.cluster.reassignments",
+            "partition leaderships the coordinator moved off failed or "
+            "removed members (the per-partition failover leg of "
+            "self-healing)"))
         self.failover_promotions = m.counter(MI(
             "surge.log.failover.promotions",
             "follower-to-leader promotions performed by this broker"))

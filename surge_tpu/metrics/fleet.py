@@ -46,6 +46,11 @@ class FleetMetrics:
     slo_breaches: Sensor = field(init=False)
     slo_active_breaches: Sensor = field(init=False)
     slo_max_burn_rate: Sensor = field(init=False)
+    # cluster autobalancer (surge_tpu/cluster/autobalancer.py)
+    balancer_cycles: Sensor = field(init=False)
+    balancer_moves: Sensor = field(init=False)
+    balancer_skipped: Sensor = field(init=False)
+    balancer_lead_skew: Sensor = field(init=False)
 
     def __post_init__(self) -> None:
         m, MI = self.registry, MetricInfo
@@ -92,6 +97,23 @@ class FleetMetrics:
             "worst fast-window burn rate across objectives at the last "
             "evaluation (1.0 = spending error budget exactly at the "
             "objective's sustainable rate)"))
+        self.balancer_cycles = m.counter(MI(
+            "surge.cluster.balancer.cycles",
+            "autobalancer decision passes (one federated scrape + SLO "
+            "evaluation + ClusterMeta fetch each)"))
+        self.balancer_moves = m.counter(MI(
+            "surge.cluster.balancer.moves",
+            "planned per-partition HandoffPartition moves the autobalancer "
+            "executed (dry-run decisions are recorded, not counted here)"))
+        self.balancer_skipped = m.counter(MI(
+            "surge.cluster.balancer.skipped",
+            "moves the autobalancer decided but did not execute (dry-run, "
+            "hysteresis, move budget, or the handoff RPC failing)"))
+        self.balancer_lead_skew = m.gauge(MI(
+            "surge.cluster.balancer.lead-skew",
+            "partition lead-count spread (max - min) across up members at "
+            "the last cycle — the imbalance the balancer steers toward "
+            "surge.cluster.balancer.max-lead-skew"))
 
 
 def fleet_metrics(registry: Optional[Metrics] = None) -> FleetMetrics:

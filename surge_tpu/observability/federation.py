@@ -431,6 +431,26 @@ class FederatedScraper:
             absorb(fam)
         return [merged[name] for name in sorted(merged)]
 
+    def instance_values(self, family: str, suffix: str = "",
+                        merged: Optional[List[Family]] = None
+                        ) -> Dict[str, float]:
+        """{instance: value} for one merged family's bare samples — the
+        per-member extraction the autobalancer and surgetop score from.
+        Pass ``merged`` (one ``last_merged()`` call) when extracting several
+        families from the same pass — the stash is single-use, so repeated
+        bare calls would re-merge every payload."""
+        out: Dict[str, float] = {}
+        for fam in (merged if merged is not None else self.last_merged()):
+            if fam.name != family:
+                continue
+            for s in fam.samples:
+                if s.suffix != suffix:
+                    continue
+                inst = dict(s.labels).get("instance")
+                if inst is not None:
+                    out[inst] = s.value
+        return out
+
     def last_merged(self) -> List[Family]:
         """The families the most recent ``scrape_once`` built for its SLO
         pass (single-use stash — a back-to-back render/row-extract reuses

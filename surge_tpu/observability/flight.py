@@ -48,6 +48,9 @@ class FlightRecorder:
     publisher lane transitions, rebalance fan-out, resident-plane moves and
     health-bus restarts land in the same envelope shape, so engine and broker
     dumps interleave through :func:`merge_dumps` into one incident timeline).
+    The cluster autobalancer records into a third lane (``role="balancer"``):
+    a self-healing incident reconstructs end to end — kill, page, grace
+    reassignment, balancer move, page clear — from one merged timeline.
     Thread-safe: the sites span gRPC handler threads, the replication worker,
     the group-sync thread and the liveness prober.
     """

@@ -116,6 +116,10 @@ class _Track:
 
     history: Deque[Tuple[float, float, float]] = field(default_factory=deque)
     breached: bool = False
+    #: evaluation-clock stamp of the CURRENT breach's onset (None while
+    #: healthy) — how long a page has been open, and whether it cleared
+    #: after a heal, readable straight off the status rows
+    breached_at: Optional[float] = None
     burn_fast: float = 0.0
     burn_slow: float = 0.0
     last_bad_fraction: float = 0.0
@@ -259,6 +263,7 @@ class SLOEngine:
             breached = (track.burn_fast >= self.burn_threshold
                         and track.burn_slow >= self.burn_threshold)
             if breached and not track.breached:
+                track.breached_at = now
                 if self.metrics is not None:
                     self.metrics.slo_breaches.record()
                 self.on_signal(f"slo.breach.{slo.name}", "warning")
@@ -271,7 +276,11 @@ class SLOEngine:
             elif track.breached and not breached:
                 self.on_signal(f"slo.recovered.{slo.name}", "trace")
                 if self.flight is not None:
-                    self.flight.record("slo.recovered", objective=slo.name)
+                    self.flight.record(
+                        "slo.recovered", objective=slo.name,
+                        open_s=(round(now - track.breached_at, 2)
+                                if track.breached_at is not None else None))
+                track.breached_at = None
             track.breached = breached
             if breached:
                 active += 1
@@ -291,6 +300,7 @@ class SLOEngine:
                 "burn_fast": round(track.burn_fast, 3),
                 "burn_slow": round(track.burn_slow, 3),
                 "breached": track.breached,
+                "breached_since": track.breached_at,
                 "description": slo.description}
 
     def status(self) -> List[dict]:

@@ -683,6 +683,116 @@ def test_cluster_chaos_soak_randomized_schedules():
         _double_failure_round(seed, commits=20)
 
 
+# -- client leader-hint cache (ISSUE 13 satellite) ------------------------------------
+
+
+def test_client_invalidates_learned_hints_across_two_handoffs():
+    """A→B→A: the endpoint learned from the first redirect must be dropped
+    on the NEXT redirect (and on connect failure), so a moved-back
+    partition never ping-pongs through a broker that may be dead by then."""
+    leader, (f1, f2), addrs = _trio(auto_promote=False)
+    client = GrpcLogTransport(addrs[0], config=QUORUM_CFG)
+    admin = GrpcLogTransport(addrs[0], config=QUORUM_CFG)
+    try:
+        client.create_topic(TopicSpec("ev", 1))
+        acked = _commit_n(client, "t-hint", 4, prefix="a1")
+        # handoff A→B: the next commit is redirected and LEARNS B
+        admin.handoff_partition(addrs[1])
+        acked += _commit_n(client, "t-hint", 4, prefix="b")
+        assert client.target == addrs[1]
+        assert addrs[1] in client.targets and addrs[1] in client._learned
+        # handoff B→A: the redirect back must EVICT the learned B endpoint
+        admin2 = GrpcLogTransport(addrs[1], config=QUORUM_CFG)
+        admin2.handoff_partition(addrs[0])
+        admin2.close()
+        acked += _commit_n(client, "t-hint", 4, prefix="a2")
+        assert client.target == addrs[0]
+        assert addrs[1] not in client.targets, (
+            "stale learned hint kept forever — the regression this test "
+            "pins down")
+        # B dies; commits keep flowing without ever probing the corpse
+        f1.kill()
+        if f1.kill_done is not None:
+            f1.kill_done.wait(10)
+        t0 = time.monotonic()
+        acked += _commit_n(client, "t-hint", 4, prefix="a3", timeout=10.0)
+        assert time.monotonic() - t0 < 8.0, "commits stalled on a dead hint"
+        _assert_exactly_once(leader.log, "ev", acked)
+    finally:
+        client.close()
+        admin.close()
+        _stop_all(leader, f1, f2)
+
+
+# -- prober re-arm under repeated elections (ISSUE 13 satellite) ----------------------
+
+
+def test_prober_rearms_after_repeated_lost_campaigns():
+    """A broker that loses N consecutive campaigns (the stand-down path)
+    must STILL detect the next real leader death: blackholed votes force
+    repeated stand-downs on both followers; once votes flow again a
+    campaign wins, and after killing THAT leader back-to-back the
+    previously-stood-down broker still participates in the next majority."""
+    leader, (f1, f2), addrs = _trio(extra={
+        "surge.log.quorum.vote-rounds": 2})
+    relit = None
+    try:
+        for f in (f1, f2):
+            f.faults = FaultPlane(
+                [FaultRule(site="rpc.VoteLeader", action="drop", times=None)])
+            f.faults.on_crash = lambda point: None
+        leader.kill()
+        if leader.kill_done is not None:
+            leader.kill_done.wait(10)
+        # both followers campaign and stand down REPEATEDLY (>= 2 cycles
+        # each), the prober re-arming after every lost campaign
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            stand_downs = {
+                id(f): sum(1 for e in f.flight.events()
+                           if e["type"] == "quorum.stand-down")
+                for f in (f1, f2)}
+            assert f1.role == "follower" and f2.role == "follower", \
+                "a minority candidate promoted"
+            if all(n >= 2 for n in stand_downs.values()):
+                break
+            time.sleep(0.1)
+        assert all(n >= 2 for n in stand_downs.values()), stand_downs
+        for f in (f1, f2):
+            assert f._leader_prober is not None
+            assert f._leader_prober.rearms >= 2, (
+                "prober was not re-armed after each lost campaign")
+        # heal the vote path: the re-armed probers drive a winning campaign
+        for f in (f1, f2):
+            f.faults.disarm()
+        w1 = _wait_leader([f1, f2], timeout=30.0)
+        loser = f2 if w1 is f1 else f1
+        # back-to-back: relight the first casualty, then kill the NEW
+        # leader — the broker that lost every earlier campaign must still
+        # detect THIS death and reach a majority with the relit voter
+        relit = LogServer(leader.log, port=int(addrs[0].rsplit(":", 1)[1]),
+                          follower_of=w1.advertised, auto_promote=True,
+                          config=QUORUM_CFG, quorum_peers=addrs,
+                          flight=leader.flight)
+        relit.start()
+        time.sleep(0.5)
+        w1.kill()
+        if w1.kill_done is not None:
+            w1.kill_done.wait(10)
+        w2 = _wait_leader([loser, relit], timeout=40.0)
+        assert w2.role == "leader" and w2.epoch > w1.epoch
+        # the cluster still serves exactly-once after the whole ordeal
+        client = GrpcLogTransport(",".join(addrs), config=QUORUM_CFG)
+        try:
+            client.create_topic(TopicSpec("ev", 1))
+            acked = _commit_n(client, "t-rearm", 4)
+            _assert_exactly_once(w2.log, "ev", acked)
+        finally:
+            client.close()
+    finally:
+        _stop_all(*(s for s in (leader, relit, f1, f2) if s is not None))
+
+
 # -- chaos CLI: cluster & handoff -----------------------------------------------------
 
 

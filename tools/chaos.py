@@ -22,12 +22,17 @@ over the broker's admin RPCs::
 
 ``cluster`` drives N brokers from ONE invocation: with no flags it prints a
 per-broker summary (role, epoch, in-sync view, per-partition high-watermarks,
-quorum shape, armed faults) plus a cluster verdict (exactly one leader?);
-``--arm PLAN`` arms the same seeded plan on every broker; ``--kill ADDR``
-hard-stops one of them (the reply races the socket close — unreachable IS
-success). ``handoff <from> <to>`` moves the leader role deliberately (bulk
-slice ship -> fence -> journal-tail ship -> dedup push -> promote -> demote)
-and prints the stats, fenced-span ms included.
+quorum shape, partitions led + membership epoch, armed faults) plus the
+cluster verdicts — exactly one coordinator, and under leadership spread
+exactly ONE leader PER PARTITION agreed by every reachable broker; a failed
+verdict exits 1 so soak harnesses and CI can gate on it. ``--arm PLAN`` arms
+the same seeded plan on every broker; ``--kill ADDR`` hard-stops one of them
+(the reply races the socket close — unreachable IS success).
+``handoff <from> <to>`` moves the leader role deliberately (bulk slice ship
+-> fence -> journal-tail ship -> dedup push -> promote -> demote) and prints
+the stats, fenced-span ms included; ``--partition N`` moves just that
+partition index's leadership (spread clusters). A failed handoff prints the
+error and exits 1.
 
 ``arm`` takes a NAMED plan (see ``plans``) or a JSON rule list / object;
 after arming it reports the plane's stats, and with ``--watch`` polls the
@@ -45,8 +50,10 @@ into one instance/role-labelled exposition on stdout — or keeps serving it
 from a scrape port with ``--serve PORT`` (0 = ephemeral; Ctrl-C stops). The
 live table view over the same pass is ``tools/surgetop.py``.
 
-Exit code 0 on success; 3 when --watch ends with the broker unreachable
-(crash plans: that IS the outcome); 2 on bad arguments.
+Exit code 0 on success; 1 when a verdict fails (``cluster`` with a
+leadership violation, ``handoff`` refused/failed); 3 when --watch ends with
+the broker unreachable (crash plans: that IS the outcome); 2 on bad
+arguments.
 """
 
 import argparse
@@ -87,6 +94,9 @@ def main(argv=None) -> int:
                     help="fleet: serve the merged exposition from this "
                          "scrape port (0 = ephemeral) instead of printing "
                          "one pass")
+    ap.add_argument("--partition", type=int, default=None,
+                    help="handoff: move only this partition index's "
+                         "leadership (spread clusters)")
     args = ap.parse_args(argv)
 
     if args.command == "plans":
@@ -113,8 +123,16 @@ def main(argv=None) -> int:
             return 2
         client = GrpcLogTransport(args.target)
         try:
-            print(json.dumps(client.handoff_partition(args.plan), indent=2))
+            if args.partition is not None:
+                stats = client.cluster_handoff(args.plan, args.partition)
+            else:
+                stats = client.handoff_partition(args.plan)
+            print(json.dumps(stats, indent=2))
             return 0
+        except Exception as exc:  # noqa: BLE001 — a failed handoff must gate
+            print(json.dumps({"verdict": "FAILED",
+                              "error": str(exc)[:500]}, indent=2))
+            return 1
         finally:
             client.close()
 
@@ -231,6 +249,8 @@ def _cluster(args) -> int:
         return 2
     out = {"brokers": {}, "leaders": []}
     rc = 0
+    partition_claims = {}  # partition index -> [brokers claiming leadership]
+    assignment_views = {}  # target -> (assign_epoch, frozen assignment map)
     for target in targets:
         client = GrpcLogTransport(target)
         try:
@@ -247,10 +267,22 @@ def _cluster(args) -> int:
                 "leader_hint": status.get("leader_hint", ""),
                 "high_watermarks": status.get("high_watermarks", {}),
                 "quorum": status.get("quorum", {}),
+                # per-partition leadership spread (ISSUE 13): what this
+                # broker leads and which membership/assignment record
+                # version it is operating under
+                "partitions_led": status.get("partitions_led", []),
+                "membership": status.get("membership", {}),
+                "assign_epoch": status.get("assign_epoch", 0),
                 "handoff_fence": status.get("handoff_fence", False),
                 "catch_up": status.get("catch_up", {}),
                 "native": status.get("native", {}),
             }
+            for p in status.get("partitions_led", []):
+                partition_claims.setdefault(int(p), []).append(target)
+            if status.get("assignments"):
+                assignment_views[target] = (
+                    status.get("assign_epoch", 0),
+                    tuple(sorted(status["assignments"].items())))
             try:
                 row["faults"] = client.fault_stats()
             except Exception as exc:  # noqa: BLE001 — older broker
@@ -266,9 +298,32 @@ def _cluster(args) -> int:
             out["brokers"][target] = {"unreachable": str(exc)[:200]}
         finally:
             client.close()
+    problems = []
+    if len(out["leaders"]) != 1:
+        problems.append(f"{len(out['leaders'])} coordinators")
+    if assignment_views:
+        out["partition_leaders"] = {str(p): owners for p, owners
+                                    in sorted(partition_claims.items())}
+        for p, owners in sorted(partition_claims.items()):
+            if len(owners) != 1:
+                problems.append(
+                    f"partition {p}: {len(owners)} leaders {sorted(owners)}")
+        newest = max(epoch for epoch, _m in assignment_views.values())
+        maps = {m for epoch, m in assignment_views.values()
+                if epoch == newest}
+        if len(maps) > 1:
+            problems.append("brokers at the newest assign epoch disagree "
+                            "on the partition map")
+        all_assigned = {int(k) for _e, m in assignment_views.values()
+                        for k, _v in m}
+        for p in sorted(all_assigned - set(partition_claims)):
+            problems.append(f"partition {p}: no live leader")
     out["verdict"] = ("ok: exactly one leader"
-                      if len(out["leaders"]) == 1 else
-                      f"DEGRADED: {len(out['leaders'])} leaders")
+                      + (" per partition" if assignment_views else "")
+                      if not problems else
+                      "DEGRADED: " + "; ".join(problems))
+    if problems:
+        rc = 1  # soak harnesses / CI gate on this (ISSUE 13 satellite)
     if args.cluster_kill and args.cluster_kill not in targets:
         print(f"--kill target {args.cluster_kill} not in the cluster list",
               file=sys.stderr)

@@ -41,6 +41,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 _COLUMNS = (
     ("epoch", "surge_log_replication_epoch", "{:.0f}"),
     ("leader", "surge_log_broker_is_leader", "{:.0f}"),
+    ("p-led", "surge_cluster_partitions_led", "{:.0f}"),
+    ("m-epoch", "surge_cluster_member_epoch", "{:.0f}"),
     ("native", "surge_log_native_active", "{:.0f}"),
     ("hwm-lag", "surge_log_hwm_lag_records", "{:.0f}"),
     ("fsync-ms", "surge_log_journal_fsync_round_timer", "{:.2f}"),
