@@ -794,6 +794,114 @@ def resident_feed_paired() -> dict:
             "rounds": raw}
 
 
+def anatomy_bench() -> dict:
+    """SURGE_BENCH_ANATOMY=1: traced command phase → the per-leg critical-path
+    attribution table alongside the phase's latency medians (ISSUE 14).
+
+    One engine drives a FileLog-backed gRPC broker with tracing + tail
+    sampling wired on BOTH sides (tail latency threshold 0: every completed
+    trace is kept, budget raised accordingly), closed-loop workers send
+    commands for a few seconds, then both trace rings are dumped, assembled
+    across the process boundary and attributed. Reported:
+
+    - ``command_p50_ms`` / ``command_p99_ms`` — the phase's command-latency
+      medians (same closed-loop shape as the ladder arms, so the table reads
+      against numbers of the usual kind);
+    - ``anatomy`` — the attribution table (per-leg p50/p99/total/share);
+    - ``anatomy_dominant`` / ``anatomy_dominant_share`` — where the time
+      went. The next perf PR starts from this, not from guesses.
+
+    Env: SURGE_BENCH_ANATOMY_SECONDS (3), SURGE_BENCH_ANATOMY_WORKERS (16).
+    """
+    import asyncio
+    import socket
+    import tempfile
+
+    from surge_tpu import SurgeCommandBusinessLogic, create_engine
+    from surge_tpu.config import Config
+    from surge_tpu.log import GrpcLogTransport, LogServer
+    from surge_tpu.log.file import FileLog
+    from surge_tpu.models import counter
+    from surge_tpu.observability.anatomy import (assemble_traces,
+                                                 attribution_table)
+    from surge_tpu.tracing import Tracer
+
+    seconds = float(os.environ.get("SURGE_BENCH_ANATOMY_SECONDS", 3.0))
+    workers = int(os.environ.get("SURGE_BENCH_ANATOMY_WORKERS", 16))
+    cfg = Config(overrides={
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 20,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.engine.num-partitions": 4,
+        "surge.trace.tail.latency-ms": 0,       # keep every completed trace
+        "surge.trace.tail.keep-budget": 100_000,
+        "surge.trace.ring-capacity": 4096,
+    })
+    logic = SurgeCommandBusinessLogic(
+        aggregate_name="anatomy", model=counter.CounterModel(),
+        state_format=counter.state_formatting(),
+        event_format=counter.event_formatting())
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    tmp = tempfile.mkdtemp(prefix="surge-anatomy-")
+    broker_tracer = Tracer(service="broker")
+    server = LogServer(FileLog(os.path.join(tmp, "log"), fsync="commit",
+                               config=cfg),
+                       port=port, config=cfg, tracer=broker_tracer)
+    server.start()
+    engine_tracer = Tracer(service="engine")
+    log = GrpcLogTransport(f"127.0.0.1:{port}", config=cfg,
+                           tracer=engine_tracer)
+    latencies: list = []
+
+    async def phase() -> None:
+        engine = create_engine(logic, log=log, config=cfg,
+                               tracer=engine_tracer)
+        await engine.start()
+        deadline = time.monotonic() + seconds
+
+        async def worker(i: int) -> None:
+            ref = engine.aggregate_for(f"agg{i}")
+            while time.monotonic() < deadline:
+                t0 = time.perf_counter()
+                await ref.send_command(counter.Increment(f"agg{i}"))
+                latencies.append((time.perf_counter() - t0) * 1000.0)
+
+        await asyncio.gather(*(worker(i) for i in range(workers)))
+        await engine.stop()
+        # the rings belong to the tracers, which outlive the engine: dump
+        # after stop so in-flight flush spans have finished
+        self_dump = engine.trace_ring.dump()
+        stats["engine_dump"] = self_dump
+
+    stats: dict = {}
+    try:
+        asyncio.run(phase())
+    finally:
+        broker_dump = (server.trace_ring.dump()
+                       if server.trace_ring is not None else {"traces": []})
+        server.stop()
+    latencies.sort()
+
+    def pct(q: float) -> float:
+        if not latencies:
+            return 0.0
+        return latencies[min(int(q * (len(latencies) - 1)), len(latencies) - 1)]
+
+    table = attribution_table(assemble_traces(
+        [stats.get("engine_dump", {"traces": []}), broker_dump]))
+    return {"anatomy_commands": len(latencies),
+            "command_p50_ms": round(pct(0.50), 3),
+            "command_p99_ms": round(pct(0.99), 3),
+            "anatomy": table["legs"],
+            "anatomy_traces": table["traces"],
+            "anatomy_dominant": table["dominant"],
+            "anatomy_dominant_share": table["dominant_share"]}
+
+
 def failover_bench() -> dict:
     """SURGE_BENCH_FAILOVER=1: kill the replicated log leader under load and
     measure the unavailability window while PROVING zero-loss/zero-duplicate
@@ -1703,6 +1811,17 @@ def main() -> None:
         stats = failover_bench()
         payload.update(stats)
         payload["value"] = stats.get("failover_unavailability_ms") or 0
+        emit(payload)
+        return
+
+    # SURGE_BENCH_ANATOMY=1: traced command phase → the per-leg critical-path
+    # attribution table alongside the phase's latency medians, so the next
+    # perf PR starts from where-the-time-went evidence, not ladder guesses
+    if os.environ.get("SURGE_BENCH_ANATOMY", "0") == "1":
+        payload = {"metric": "command_p99_ms", "value": 0, "unit": "ms"}
+        stats = anatomy_bench()
+        payload.update(stats)
+        payload["value"] = stats.get("command_p99_ms") or 0
         emit(payload)
         return
 

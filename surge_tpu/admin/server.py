@@ -38,6 +38,11 @@ METHODS = {
     # interleave with broker DumpFlight dumps on one incident timeline.
     # ComponentRequest.name optionally carries the tail size ("last:50")
     "DumpFlight": (pb.ComponentRequest, pb.MetricsReply),
+    # tail-kept trace ring (surge_tpu.tracing.tail): the merge-ready trace
+    # dump envelope as JSON — engine-side spans of kept traces assemble with
+    # broker DumpTraces dumps into whole command traces
+    # (observability/anatomy.py). Same "last:N" tail convention as DumpFlight
+    "DumpTraces": (pb.ComponentRequest, pb.MetricsReply),
 }
 
 
@@ -97,6 +102,25 @@ class AdminServer:
                 {"error": "engine has no flight recorder"}).encode())
         return pb.MetricsReply(
             metrics_json=json.dumps(flight.dump(last)).encode())
+
+    async def DumpTraces(self, request, context) -> pb.MetricsReply:
+        """The engine's tail-kept trace ring as a merge-ready dump (the
+        DumpFlight twin for spans). An untraced engine answers an error
+        payload — "nothing kept" and "tracing off" must be tellable apart."""
+        last = None
+        name = request.name or ""
+        if name.startswith("last:"):
+            try:
+                last = int(name.partition(":")[2])
+            except ValueError:
+                last = None
+        ring = getattr(self.engine, "trace_ring", None)
+        if ring is None:
+            return pb.MetricsReply(metrics_json=json.dumps(
+                {"error": "engine has no trace ring (no tracer, or "
+                          "surge.trace.tail.enabled=false)"}).encode())
+        return pb.MetricsReply(
+            metrics_json=json.dumps(ring.dump(last)).encode())
 
     async def ListComponents(self, request, context) -> pb.RegistrationsReply:
         return pb.RegistrationsReply(
@@ -238,6 +262,17 @@ class AdminClient:
         name = f"last:{last}" if last is not None else ""
         r = await self._calls["DumpFlight"](pb.ComponentRequest(name=name))
         return json.loads(r.metrics_json)
+
+    async def trace_dump(self, last: Optional[int] = None) -> dict:
+        """The engine's tail-kept trace-ring dump (merge-ready envelope:
+        feed it to anatomy.assemble_traces alongside broker trace dumps for
+        whole command traces). Raises RuntimeError on an untraced engine."""
+        name = f"last:{last}" if last is not None else ""
+        r = await self._calls["DumpTraces"](pb.ComponentRequest(name=name))
+        payload = json.loads(r.metrics_json)
+        if "error" in payload and "traces" not in payload:
+            raise RuntimeError(payload["error"])
+        return payload
 
     async def components(self) -> list:
         return list((await self._calls["ListComponents"](pb.Empty())).names)

@@ -5,3 +5,4 @@ from surge_tpu.analysis.rules import hotpath  # noqa: F401
 from surge_tpu.analysis.rules import jit  # noqa: F401
 from surge_tpu.analysis.rules import proto  # noqa: F401
 from surge_tpu.analysis.rules import registries  # noqa: F401
+from surge_tpu.analysis.rules import tracing  # noqa: F401

@@ -1,0 +1,156 @@
+"""Tracing rules — span lifecycle defects (ISSUE 14).
+
+With tail-based sampling the cost of a leaked span grew: an unfinished span
+never reaches the exporter OR the tail sampler, so its trace never quiesces —
+the trace buffers until the span-buffer bound evicts it, and a keep-worthy
+incident trace silently vanishes from the ring. Before the tail plane a leak
+just lost one span; now it loses the whole trace's anatomy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Tuple
+
+from surge_tpu.analysis.core import Finding, ModuleContext, Rule, register
+
+
+def _is_start_span(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "start_span")
+
+
+def _scope_items(fn: ast.AST) -> Iterator[Tuple[ast.AST, ast.AST]]:
+    """(node, parent) pairs within one function scope (nested function /
+    lambda / class bodies excluded — they execute elsewhere and are analyzed
+    as their own scopes)."""
+    stack: List[Tuple[ast.AST, ast.AST]] = [(c, fn)
+                                            for c in ast.iter_child_nodes(fn)]
+    while stack:
+        node, parent = stack.pop()
+        yield node, parent
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend((c, node) for c in ast.iter_child_nodes(node))
+
+
+def _finish_on(node: ast.AST, name: str) -> bool:
+    """Whether ``<name>.finish()`` appears anywhere under ``node``."""
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "finish"
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == name):
+            return True
+    return False
+
+
+@register
+class SpanLeak(Rule):
+    """A ``start_span(...)`` whose result is neither used as a context
+    manager nor ``.finish()``ed on every path (except paths included).
+
+    History: the replay profiler's ``record()`` finished its stage span only
+    on the straight-line path (fixed alongside this rule), and the ISSUE-14
+    tail sampler turned that defect class from "one span lost" into "the
+    whole trace's anatomy lost" (module doc). The safe shapes are ``with
+    tracer.start_span(...)``, ``with span:`` after attribute setup, or
+    ``span.finish()`` inside a ``finally``; a span that ESCAPES the function
+    (returned, stored on an attribute, passed as an argument) is someone
+    else's lifecycle and is not flagged here.
+    """
+
+    id = "span-leak"
+    summary = ("start_span result neither context-managed nor finish()ed "
+               "on every path")
+
+    def check_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if "start_span" not in ctx.source:
+            return
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(ctx, fn)
+
+    def _check_function(self, ctx: ModuleContext,
+                        fn: ast.AST) -> Iterator[Finding]:
+        items = list(_scope_items(fn))
+        assigned: Dict[str, ast.Call] = {}
+        for node, parent in items:
+            if not _is_start_span(node):
+                continue
+            if isinstance(parent, ast.withitem):
+                continue  # `with tracer.start_span(...):` — managed
+            if isinstance(parent, ast.Expr):
+                yield self.finding(
+                    ctx, node,
+                    "start_span(...) result discarded — the span can never "
+                    "finish; use `with tracer.start_span(...)` or keep the "
+                    "handle and finish() it in a finally")
+                continue
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                    and isinstance(parent.targets[0], ast.Name):
+                assigned[parent.targets[0].id] = node
+                continue
+            # every other shape (returned, attribute/subscript store, call
+            # argument, tuple element) escapes this scope: lifecycle owned
+            # elsewhere, not analyzable here
+        for name, call in assigned.items():
+            yield from self._check_name(ctx, fn, items, name, call)
+
+    def _check_name(self, ctx: ModuleContext, fn: ast.AST, items,
+                    name: str, call: ast.Call) -> Iterator[Finding]:
+        finish_anywhere = False
+        for node, parent in items:
+            # `with span:` anywhere in the function — managed
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Name) and expr.id == name:
+                        return
+            # finish() inside a finally — covered on every path
+            if isinstance(node, ast.Try) and any(
+                    _finish_on(stmt, name) for stmt in node.finalbody):
+                return
+            if isinstance(node, ast.Name) and node.id == name:
+                if self._escapes(node, parent):
+                    return
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "finish"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == name):
+                finish_anywhere = True
+        if finish_anywhere:
+            yield self.finding(
+                ctx, call,
+                f"span `{name}` is finish()ed only on some paths — an "
+                "exception between start_span and finish() leaks it (and "
+                "its whole trace under tail sampling); move the finish() "
+                "into a finally or use `with`")
+        else:
+            yield self.finding(
+                ctx, call,
+                f"span `{name}` is never finish()ed in this function and "
+                "never escapes it — the span (and its whole trace under "
+                "tail sampling) is leaked")
+
+    @staticmethod
+    def _escapes(node: ast.Name, parent: ast.AST) -> bool:
+        """The span handle leaves this scope: returned/yielded, stored on an
+        attribute or subscript, passed as a call argument, or packed into a
+        tuple (conservatively treated as escaping)."""
+        if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom,
+                               ast.Tuple, ast.List, ast.keyword)):
+            return True
+        if isinstance(parent, ast.Call) and node in parent.args:
+            return True
+        if isinstance(parent, ast.Assign) and node is parent.value and any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                for t in parent.targets):
+            return True
+        return False
+
+    # ``finding`` helper inherited from Rule uses node.lineno — ast.Call
+    # linenos anchor at the call, which is the span's creation site.

@@ -53,6 +53,27 @@ __all__ = ["PartitionRouter", "RoutedProducer"]
 _REROUTE_ERRORS = (ProducerFencedError, NotLeaderError, grpc.RpcError)
 
 
+def _router_span(tracer, name: str, **attrs):
+    """A routing-hop span (ISSUE 14 satellite: resolve/redirect/retry hops
+    were invisible) — opened ONLY under an already-active span, so the
+    command path gets its router legs while unparented pollers (a tailing
+    indexer's reads) cannot root a trace storm. The child transport's
+    broker-call spans parent on this one via ``active_span()``, and their
+    traceparent metadata carries the SAME trace to the broker — an A→B→A
+    redirect stays one contiguous trace."""
+    if tracer is None:
+        return None
+    from surge_tpu.tracing import active_span
+
+    parent = active_span()
+    if parent is None:
+        return None
+    span = tracer.start_span(name, parent=parent)
+    for k, v in attrs.items():
+        span.set_attribute(k, v)
+    return span
+
+
 class RoutedProducer:
     """Transactional producer over the router: one inner producer per broker
     the partition map has sent us to, opened lazily and re-opened after a
@@ -124,8 +145,19 @@ class RoutedProducer:
         re-resolving the leader between attempts — a retried commit carries
         the SAME records (and, on the same broker, the same txn_seq), so the
         broker-plane dedup/alias machinery keeps it exactly-once wherever
-        the partition landed."""
+        the partition landed. Traced callers get a ``router.commit`` span
+        around the whole ladder (redirect events per rerouted attempt), with
+        the inner broker-call spans chained under it."""
         partition = self._partition_of(records)
+        span = _router_span(self._router.tracer, "router.commit",
+                            partition=partition, op=op)
+        if span is None:
+            return self._routed_attempts(records, op, partition, None)
+        with span:  # records exceptions + finishes
+            return self._routed_attempts(records, op, partition, span)
+
+    def _routed_attempts(self, records: Sequence[LogRecord], op: str,
+                         partition: int, span):
         last: Optional[BaseException] = None
         backoff = 0.05
         for attempt in range(self._attempts):
@@ -137,6 +169,9 @@ class RoutedProducer:
                     inner = self._router._child(addr).transactional_producer(
                         self.transactional_id)
                     self._inner[addr] = inner
+                if span is not None:
+                    span.set_attribute("broker", addr)
+                    span.set_attribute("attempts", attempt + 1)
                 if op == "send_immediate":
                     return inner.send_immediate(records[0])
                 inner.begin()
@@ -149,6 +184,10 @@ class RoutedProducer:
                 raise
             except _REROUTE_ERRORS as exc:
                 last = exc
+                if span is not None:
+                    span.add_event("redirect", {
+                        "attempt": attempt, "from": addr,
+                        "error": type(exc).__name__})
                 self._inner.pop(addr, None)
                 self._router.invalidate_partition("", partition,
                                                   suspect=addr)
@@ -215,11 +254,22 @@ class PartitionRouter:
 
     def refresh_meta(self, force: bool = False) -> dict:
         """Fetch the cluster metadata view from the coordinator (preferred)
-        or any reachable member/bootstrap broker."""
+        or any reachable member/bootstrap broker. Traced callers get a
+        ``router.resolve`` span around the actual fetch (cache hits stay
+        span-free — resolve cost, not cache reads, is the anatomy leg)."""
         with self._lock:
             if self._meta and not self._meta_stale and not force:
                 return self._meta
             meta = dict(self._meta)
+        span = _router_span(self.tracer, "router.resolve")
+        if span is None:
+            return self._refresh_meta_fetch(meta)
+        with span:  # records exceptions + finishes
+            fresh = self._refresh_meta_fetch(meta)
+            span.set_attribute("coordinator", fresh.get("coordinator", ""))
+            return fresh
+
+    def _refresh_meta_fetch(self, meta: dict) -> dict:
         sources: List[str] = []
         for addr in ([meta.get("coordinator", "")]
                      + list(meta.get("members", ())) + self.bootstrap):
@@ -361,7 +411,15 @@ class PartitionRouter:
         """Run one read-side operation on the partition's current leader,
         re-resolving (and invalidating the cached hint) when the ACTUAL
         call fails — a reader must recover from a dead or moved leader
-        exactly like a producer does, not keep hitting its corpse."""
+        exactly like a producer does, not keep hitting its corpse. Traced
+        callers get a ``router.call`` span (redirect events per retry)."""
+        span = _router_span(self.tracer, "router.call", partition=partition)
+        if span is None:
+            return self._routed_call_attempts(partition, op, None)
+        with span:  # records exceptions + finishes
+            return self._routed_call_attempts(partition, op, span)
+
+    def _routed_call_attempts(self, partition: int, op, span):
         last: Optional[BaseException] = None
         for attempt in range(3):
             addr = self.leader_for(partition, refresh=attempt > 0)
@@ -369,6 +427,9 @@ class PartitionRouter:
                 return op(self._child(addr))
             except grpc.RpcError as exc:
                 last = exc
+                if span is not None:
+                    span.add_event("redirect", {"attempt": attempt,
+                                                "from": addr})
                 self.invalidate_partition("", partition, suspect=addr)
         raise last if last is not None else RuntimeError("unreachable")
 

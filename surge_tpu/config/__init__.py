@@ -86,6 +86,27 @@ DEFAULTS: dict[str, Any] = {
     # capture OpenMetrics exemplars (trace id per histogram bucket) on the
     # ENGINE registry; broker registries are always exemplar-on
     "surge.metrics.exemplars": False,
+    # --- tracing: tail sampling + kept-trace rings (surge_tpu/tracing/tail) ---
+    # buffer head-sampled spans per trace and KEEP a completed trace iff it
+    # erred, breached tail.latency-ms, or landed in an SLO breach window —
+    # under a bounded keep budget. Only matters when a tracer is wired
+    # (tracer=None keeps every hop at zero cost as before).
+    "surge.trace.tail.enabled": True,
+    # a trace whose slowest span ran at least this is kept (the latency
+    # breach criterion of the tail decision)
+    "surge.trace.tail.latency-ms": 250,
+    # keep budget: at most this many kept traces per budget window; eligible
+    # traces past it are dropped and counted (surge.trace.dropped)
+    "surge.trace.tail.keep-budget": 64,
+    "surge.trace.tail.budget-window-ms": 10_000,
+    # bound on spans buffered for in-flight traces; oldest traces evict past
+    # it (leaked spans must not grow the buffer without bound)
+    "surge.trace.tail.max-buffer-spans": 4096,
+    # how long after an SLO breach every completing trace is kept (the
+    # breach-adjacent anatomy evidence window)
+    "surge.trace.tail.breach-window-ms": 30_000,
+    # kept traces retained per engine/broker ring (DumpTraces RPC source)
+    "surge.trace.ring-capacity": 256,
     # --- fleet telemetry plane (observability/federation.py + slo.py) ---
     # per-target fetch timeout of one federation pass (HTTP scrape or
     # GetMetricsText RPC); a slower target answers up{instance}=0 and keeps

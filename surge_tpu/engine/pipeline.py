@@ -149,6 +149,17 @@ class SurgeEngine(Controllable):
             capacity=self.config.get_int("surge.engine.flight-capacity", 1024),
             name=f"engine:{logic.aggregate_name}", role="engine")
         self.health_bus.subscribe(self._flight_health_signal)
+        # tail-kept trace ring (the flight ring's trace twin, ISSUE 14):
+        # install_tail attaches a TailSampler to the tracer so completed
+        # traces that erred / breached surge.trace.tail.latency-ms / landed
+        # in an SLO breach window are retained; the admin DumpTraces RPC
+        # pulls the merge-ready envelope for cross-process anatomy assembly.
+        # None when tracer=None (the tail plane costs nothing untraced).
+        from surge_tpu.tracing.tail import install_tail
+
+        self.trace_ring = install_tail(
+            tracer, self.config, name=f"engine:{logic.aggregate_name}",
+            role="engine", metrics=self.metrics)
         from surge_tpu.health.prober import EventLoopProber
 
         self.loop_prober = (EventLoopProber(

@@ -86,6 +86,13 @@ class _Pending:
     records: List[LogRecord]
     future: "asyncio.Future[None]"
     nbytes: int = 0
+    #: the publish span's context captured at enqueue (tracer wired only):
+    #: the flush span parents on the batch's FIRST pending's context, so a
+    #: command's trace stays contiguous through the group commit down to the
+    #: broker — including a timed-out caller whose same-request_id retry
+    #: rejoins this queued write (the pending, and so the parenting, is the
+    #: ORIGINAL publish's)
+    trace_ctx: Optional[object] = None
 
 
 class _Batch:
@@ -95,7 +102,7 @@ class _Batch:
     sequence number."""
 
     __slots__ = ("pendings", "records", "handle", "attempts", "index",
-                 "dispatch_error", "outcome")
+                 "dispatch_error", "outcome", "span")
 
     def __init__(self, pendings: List[_Pending], records: List[LogRecord],
                  index: int) -> None:
@@ -105,6 +112,10 @@ class _Batch:
         self.attempts = 0
         self.index = index  # dispatch order: retries must replay oldest-first
         self.dispatch_error: Optional[Exception] = None
+        #: the current attempt's flush span (opened at pipelined dispatch or
+        #: by _publish_batch; cleared when the attempt's span finishes so a
+        #: retry opens a fresh one in the same trace)
+        self.span = None
         #: the current commit attempt's outcome (None = success, exception =
         #: why it failed); registered under _committing for every request id
         #: the moment the batch FORMS — a caller-timeout retry arriving while
@@ -441,6 +452,16 @@ class PartitionPublisher:
         for r in records:
             nbytes += ((len(r.value) if r.value else 0)
                        + (len(r.key) if r.key else 0) + 24)
+        trace_ctx = None
+        if self.tracer is not None:
+            # the caller's publish span (active: _publish_traced queues from
+            # inside `with span:`): the flush span parents on it, keeping
+            # the command's trace contiguous down to the broker
+            from surge_tpu.tracing import active_span
+
+            span = active_span()
+            if span is not None and span.context.sampled:
+                trace_ctx = span.context
         if self._direct:
             fut = self._forming_ack
             if fut is None or fut.done():
@@ -449,7 +470,7 @@ class PartitionPublisher:
                 fut = self._forming_ack = \
                     asyncio.get_running_loop().create_future()
             pending = _Pending(request_id, aggregate_id, list(records), fut,
-                               nbytes)
+                               nbytes, trace_ctx=trace_ctx)
             self._pending.append(pending)
             self._queued_rids[request_id] = fut
             self._pending_bytes += nbytes
@@ -463,7 +484,8 @@ class PartitionPublisher:
                 self._batch_full.set()
             return fut
         fut = asyncio.get_running_loop().create_future()
-        pending = _Pending(request_id, aggregate_id, list(records), fut, nbytes)
+        pending = _Pending(request_id, aggregate_id, list(records), fut,
+                           nbytes, trace_ctx=trace_ctx)
         self._pending.append(pending)
         self._pending_bytes += nbytes
         if self._first_pending_t is None:
@@ -719,18 +741,54 @@ class PartitionPublisher:
                 and self._producer is not None
                 and hasattr(self._producer, "commit_pipelined"))
 
+    def _open_flush_span(self, batch: _Batch):
+        """One commit attempt's flush span, parented on the batch's first
+        traced pending (module doc at _publish_batch); trace ids of the
+        OTHER commands riding the same group commit go on ``trace.links``."""
+        parent = next((p.trace_ctx for p in batch.pendings
+                       if p.trace_ctx is not None), None)
+        span = self.tracer.start_span("publisher.flush", parent=parent)
+        span.set_attribute("partition", self.partition)
+        span.set_attribute("batch_publishes", len(batch.pendings))
+        span.set_attribute("batch_records", len(batch.records))
+        if parent is not None:
+            links = {p.trace_ctx.trace_id for p in batch.pendings
+                     if p.trace_ctx is not None} - {parent.trace_id}
+            if links:
+                span.set_attribute("trace.links", sorted(links))
+        return span
+
     def _start_pipelined(self, batch: _Batch) -> None:
         """Assign the batch's txn_seq and ship its Transact NOW (in dispatch
         order, on the loop) — the await happens in the commit task. A dispatch
         failure is recorded on the batch and surfaces through the shared
         commit-failure ladder."""
         try:
+            if self.tracer is not None and batch.span is None:
+                # opened BEFORE the Transact leaves (and activated around
+                # the dispatch): the transport copies the calling context
+                # into its pipeline pool, so the broker-call span — and the
+                # broker-side span its traceparent seeds — chain under this
+                # flush span instead of rooting fresh traces
+                batch.span = self._open_flush_span(batch)
             if getattr(self._producer, "in_transaction", False):
                 self._producer.abort()  # local buffer left by a failed dispatch
-            self._producer.begin()
-            for r in batch.records:
-                self._producer.send(r)
-            batch.handle = self._producer.commit_pipelined()
+            # activate only if not already active: a re-dispatch from inside
+            # _publish_batch's `with span:` block must not consume the with
+            # block's activation token (deactivating the flush span for the
+            # rest of the attempt — exemplars and child spans would detach)
+            did_activate = (batch.span is not None
+                            and batch.span._cv_token is None)
+            if did_activate:
+                batch.span.activate()
+            try:
+                self._producer.begin()
+                for r in batch.records:
+                    self._producer.send(r)
+                batch.handle = self._producer.commit_pipelined()
+            finally:
+                if did_activate:
+                    batch.span._deactivate()
         except Exception as exc:  # noqa: BLE001
             batch.dispatch_error = exc
 
@@ -823,14 +881,15 @@ class PartitionPublisher:
             batch.outcome = outcome
             for p in batch.pendings:
                 self._committing[p.request_id] = outcome
-        # the flush-transaction span is a ROOT: one commit serves many pending
-        # publishes, each already tracked by its own publisher.publish span
-        span = None
-        if self.tracer is not None:
-            span = self.tracer.start_span("publisher.flush")
-            span.set_attribute("partition", self.partition)
-            span.set_attribute("batch_publishes", len(batch.pendings))
-            span.set_attribute("batch_records", len(batch.records))
+        # the flush-transaction span parents on the batch's FIRST pending's
+        # publish span (command anatomy, ISSUE 14): a single command's trace
+        # is then contiguous ref → entity → publish → flush → broker call.
+        # One commit still serves many pending publishes — the other
+        # commands' trace ids ride the `trace.links` attribute (the OTel
+        # span-link role), each already tracked by its own publish span.
+        span = batch.span
+        if span is None and self.tracer is not None:
+            span = batch.span = self._open_flush_span(batch)
         try:
             if span is None:
                 await self._publish_batch_inner(batch, outcome)
@@ -838,6 +897,7 @@ class PartitionPublisher:
                 with span:
                     await self._publish_batch_inner(batch, outcome)
         finally:
+            batch.span = None  # a retry attempt opens a fresh flush span
             if not outcome.done():
                 outcome.set_result(RuntimeError("publish batch aborted"))
             # unregister only when the batch is TERMINAL (committed, or its
@@ -892,18 +952,30 @@ class PartitionPublisher:
             self._partial_touched.pop(p.request_id, None)
         return committed
 
+    async def _run_lane(self, fn, *args):
+        """Run one blocking commit call on the lane thread. Traced
+        publishers copy the calling context (the flush span above all) into
+        the thread so the transport's broker-call span — read off
+        ``active_span()`` over there — chains under the flush span instead
+        of rooting a fresh trace; untraced publishers pay nothing."""
+        loop = asyncio.get_running_loop()
+        if self.tracer is None:
+            return await loop.run_in_executor(self._lane(), fn, *args)
+        import contextvars
+
+        ctx = contextvars.copy_context()
+        return await loop.run_in_executor(self._lane(), ctx.run, fn, *args)
+
     async def _commit_batch(self, batch: _Batch) -> List[LogRecord]:
         """Route one batch to its commit path; raises what the commit raised."""
         if batch.dispatch_error is not None:
             exc, batch.dispatch_error = batch.dispatch_error, None
             raise exc
-        loop = asyncio.get_running_loop()
         if not self._transactions_enabled:
-            return await loop.run_in_executor(
-                self._lane(), self._commit_nontxn_blocking, batch)
+            return await self._run_lane(self._commit_nontxn_blocking, batch)
         if self._single_record_opt_in and len(batch.records) == 1:
-            return [await loop.run_in_executor(
-                self._lane(), self._producer.send_immediate, batch.records[0])]
+            return [await self._run_lane(
+                self._producer.send_immediate, batch.records[0])]
         h = batch.handle
         if h is not None:
             if h.future.done() and (h.future.cancelled()
@@ -925,8 +997,7 @@ class PartitionPublisher:
                 exc, batch.dispatch_error = batch.dispatch_error, None
                 raise exc
             return await asyncio.wrap_future(batch.handle.future)
-        return await loop.run_in_executor(
-            self._lane(), self._commit_txn_blocking, batch)
+        return await self._run_lane(self._commit_txn_blocking, batch)
 
     async def _publish_batch_inner(self, batch: _Batch,
                                    outcome: "asyncio.Future[Optional[Exception]]") -> None:

@@ -781,6 +781,22 @@ class GrpcLogTransport:
             raise RuntimeError(f"DumpFlight failed: {reply.error}")
         return json.loads(reply.records[0].value)
 
+    def trace_dump(self, last: Optional[int] = None) -> dict:
+        """The connected broker's tail-kept trace-ring dump (merge-ready
+        envelope for surge_tpu.observability.anatomy.assemble_traces);
+        ``last`` keeps only the newest N kept traces. Raises RuntimeError on
+        an untraced broker (no tracer / tail sampling disabled)."""
+        import json
+
+        req = pb.ReadRequest()
+        if last is not None:
+            req.has_max = True
+            req.max_records = last
+        reply = self._invoke("DumpTraces", req)
+        if not reply.ok:
+            raise RuntimeError(f"DumpTraces failed: {reply.error}")
+        return json.loads(reply.records[0].value)
+
     def arm_faults(self, spec: str, seed: int = 0) -> dict:
         """Arm a named fault plan or JSON rule list on the connected broker
         (surge_tpu.testing.faults); returns the plane's stats."""

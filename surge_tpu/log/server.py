@@ -239,8 +239,13 @@ METHODS = {
     # DumpFlight — the flight recorder's merge-ready dump as JSON in the
     #   reply record's value; ReadRequest.max_records (has_max) limits to the
     #   newest N events (the chaos CLI's tail).
+    # DumpTraces — the tail-kept trace ring's merge-ready dump as JSON (same
+    #   envelope discipline as DumpFlight: mono↔wall header pair for skew-
+    #   proof cross-process assembly, observability/anatomy.py);
+    #   ReadRequest.max_records (has_max) limits to the newest N kept traces.
     "GetMetricsText": (pb.ListTopicsRequest, pb.TxnReply),
     "DumpFlight": (pb.ReadRequest, pb.TxnReply),
+    "DumpTraces": (pb.ReadRequest, pb.TxnReply),
     # quorum cluster plane (message reuse, same convention as above):
     # VoteLeader — txn_seq carries the CANDIDATE epoch, records[0].value a
     #   JSON {"candidate": addr, "leader": presumed-dead addr}; the reply
@@ -365,6 +370,16 @@ class LogServer:
         #: bounded black-box event ring (role transitions, epoch bumps,
         #: truncations, barriers, fault firings — DumpFlight RPC / crash dump)
         self.flight = flight if flight is not None else FlightRecorder()
+        #: tail-kept trace ring (surge_tpu.tracing.tail — the DumpTraces RPC
+        #: source). None unless a tracer is wired AND surge.trace.tail.enabled:
+        #: install_tail attaches the tail sampler to the tracer, so broker-side
+        #: spans of erred/slow/breach-window traces are retained for the
+        #: cross-process anatomy assembly (observability/anatomy.py)
+        from surge_tpu.config import default_config as _dc0
+        from surge_tpu.tracing.tail import install_tail
+        self.trace_ring = install_tail(
+            tracer, config or _dc0(), role="broker",
+            metrics=self.broker_metrics)
         self._metrics_port = metrics_port
         self._metrics_server = None
         self.metrics_bound_port: Optional[int] = None
@@ -754,6 +769,22 @@ class LogServer:
                 span.set_attribute("error_kind", reply.error_kind)
             return self._note_first_ack(reply, request)
 
+    def _stamp_leg(self, key: str, ms: float) -> None:
+        """Accumulate one measured wait (gate hold, journal round,
+        replication ack) onto the ACTIVE broker span — the
+        ``log.server.transact`` span entered by _transact_traced on this
+        same handler thread. These ``leg.*`` attributes are what the
+        command-anatomy attributor (observability/anatomy.py) reads: the
+        broker MEASURES its legs instead of the client inferring them.
+        No-op (one None check) on an untraced broker."""
+        if self.tracer is None:
+            return
+        from surge_tpu.tracing import active_span
+
+        span = active_span()
+        if span is not None:
+            span.attributes[key] = float(span.attributes.get(key, 0.0)) + ms
+
     def _note_first_ack(self, reply: pb.TxnReply,
                         request: pb.TxnRequest) -> pb.TxnReply:
         """Flight-record the first seq-ful commit acked after a promotion —
@@ -947,8 +978,10 @@ class LogServer:
                     # the gate released us: how long a pipelined seq stalled
                     # for its predecessor (high values = window too deep or a
                     # predecessor wedged in a slow round)
+                    gate_ms = (time.monotonic() - gate_t0) * 1000.0
                     self.broker_metrics.txn_inorder_wait_timer.record_ms(
-                        (time.monotonic() - gate_t0) * 1000.0)
+                        gate_ms)
+                    self._stamp_leg("leg.gate-wait-ms", gate_ms)
                     gate_t0 = None
                 try:
                     if request.op == "commit":
@@ -1008,10 +1041,18 @@ class LogServer:
                             sync_handle = producer.commit_pipelined()
                             committed = list(sync_handle.records_out)
                         else:
+                            # blocking inner-log commit (replicated leader /
+                            # non-pipelined transport): append + the WAL
+                            # group-commit round ride inside commit() — the
+                            # whole call is the journal leg
+                            fsync_t0 = time.perf_counter()
                             producer.begin()
                             for r in _records():
                                 producer.send(r)
                             committed = producer.commit()
+                            self._stamp_leg(
+                                "leg.fsync-ms",
+                                (time.perf_counter() - fsync_t0) * 1000.0)
                     elif request.op == "abort":
                         # transactions buffer client-side; nothing to discard here
                         committed = []
@@ -1073,9 +1114,13 @@ class LogServer:
         # instead of serializing the producer.
         if join_item is not None:
             return self._finish_replicated(state, seq, join_item)
+        fsync_t0 = time.perf_counter()
         for attempt in range(3):
             try:
                 sync_handle.future.result()  # gc worker always resolves
+                self._stamp_leg(
+                    "leg.fsync-ms",
+                    (time.perf_counter() - fsync_t0) * 1000.0)
                 break
             except Exception as exc:  # noqa: BLE001 — fsync round failed
                 # the records ARE applied; durability is unknown. Re-join a
@@ -1245,7 +1290,11 @@ class LogServer:
         replica count for strict acks=all). Dedup-cache and pending-map
         maintenance happen in the replication worker, so an item whose client
         never retries is still cleaned up."""
-        if not item.done.wait(self._repl_ack_timeout_s):
+        repl_t0 = time.perf_counter()
+        acked_in_time = item.done.wait(self._repl_ack_timeout_s)
+        self._stamp_leg("leg.repl-ms",
+                        (time.perf_counter() - repl_t0) * 1000.0)
+        if not acked_in_time:
             return pb.TxnReply(
                 ok=False, error_kind="retriable",
                 error="replication timeout (commit applied locally; retry the "
@@ -3404,6 +3453,23 @@ class LogServer:
             has_key=True, key="flight", has_value=True,
             value=_json.dumps(self.flight.dump(last)).encode())])
 
+    def DumpTraces(self, request: pb.ReadRequest, context) -> pb.TxnReply:
+        """The tail-kept trace ring's merge-ready dump (DumpFlight's trace
+        twin). An untraced broker (no tracer / tail sampling off) answers a
+        state error rather than an empty envelope — "nothing kept" and
+        "nothing could ever be kept" must be tellable apart."""
+        import json as _json
+
+        if self.trace_ring is None:
+            return pb.TxnReply(
+                ok=False, error_kind="state",
+                error="no trace ring (broker has no tracer, or "
+                      "surge.trace.tail.enabled=false)")
+        last = request.max_records if request.has_max else None
+        return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+            has_key=True, key="traces", has_value=True,
+            value=_json.dumps(self.trace_ring.dump(last)).encode())])
+
     def PromoteFollower(self, request: pb.TxnRequest, context) -> pb.TxnReply:
         import json as _json
 
@@ -3659,6 +3725,7 @@ class LogServer:
         two pipelined seqs resolving in one fsync round can never leave the
         stale window as the compacted-latest record."""
         value, gen = payload
+        t0 = time.perf_counter()
         try:
             with self._txn_state_lock:
                 if gen:
@@ -3680,6 +3747,12 @@ class LogServer:
         except Exception:  # noqa: BLE001 — annotation only, never fail commits
             logger.exception("txn-state persist failed "
                              "(restart dedup window open)")
+        finally:
+            # this inner-log commit rides its own journal round: count it
+            # into the command's journal-fsync leg (the Transact handler's
+            # span is active on this thread), not the unattributed residue
+            self._stamp_leg("leg.fsync-ms",
+                            (time.perf_counter() - t0) * 1000.0)
 
     def _rebuild_from_locator(self, locator) -> Optional[pb.TxnReply]:
         """Reconstruct a lost reply by re-reading the committed records at
@@ -4509,6 +4582,8 @@ class LogServer:
             self.advertised = f"{self._host}:{self.bound_port}"
         if not self.flight.name:
             self.flight.name = self.advertised
+        if self.trace_ring is not None and not self.trace_ring.name:
+            self.trace_ring.name = self.advertised
         if self._metrics_port is not None and self._metrics_server is None:
             from surge_tpu.metrics.broker import broker_collector
             from surge_tpu.metrics.exposition import MetricsHTTPServer

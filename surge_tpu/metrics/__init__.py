@@ -285,6 +285,12 @@ class EngineMetrics:
     failover_backoff_timer: Timer = field(init=False)
     faults_injected: Sensor = field(init=False)
     faults_armed: Sensor = field(init=False)
+    # tail-based trace sampling (surge_tpu.tracing.tail): the engine-side
+    # kept/dropped tallies and the in-flight span-buffer gauge — shared
+    # names with the broker quiver, same pattern as the failover counters
+    trace_kept: Sensor = field(init=False)
+    trace_dropped: Sensor = field(init=False)
+    trace_tail_buffer: Sensor = field(init=False)
 
     def __post_init__(self) -> None:
         m, MI = self.registry, MetricInfo
@@ -462,6 +468,21 @@ class EngineMetrics:
             "surge.log.faults.armed",
             "fault rules currently armed on this process's plane "
             "(0 outside chaos experiments)"))
+        self.trace_kept = m.counter(MI(
+            "surge.trace.kept",
+            "traces the tail sampler kept into this process's trace ring "
+            "(erred, breached surge.trace.tail.latency-ms, landed in an SLO "
+            "breach window, or explicitly marked)"))
+        self.trace_dropped = m.counter(MI(
+            "surge.trace.dropped",
+            "completed or evicted traces the tail sampler dropped "
+            "(sampled-out, over the keep budget, or evicted by the span-"
+            "buffer bound)"))
+        self.trace_tail_buffer = m.gauge(MI(
+            "surge.trace.tail-buffer-spans",
+            "spans buffered for in-flight traces awaiting their tail "
+            "keep/drop decision (bounded by "
+            "surge.trace.tail.max-buffer-spans)"))
         # Deprecation aliases for the r4 renames (ADVICE r4): dashboards keyed
         # to the old identifiers — including a timer's .min/.max/.p99
         # sub-metrics — keep working for a release window; the alias providers

@@ -97,6 +97,12 @@ class BrokerMetrics:
     failover_truncated_records: Sensor = field(init=False)
     faults_injected: Sensor = field(init=False)
     faults_armed: Sensor = field(init=False)
+    # tail-based trace sampling (surge_tpu.tracing.tail) — shared names
+    # with the engine quiver so a standalone broker's scrape carries its
+    # own kept/dropped tallies, like the failover counters above
+    trace_kept: Sensor = field(init=False)
+    trace_dropped: Sensor = field(init=False)
+    trace_tail_buffer: Sensor = field(init=False)
 
     def __post_init__(self) -> None:
         m, MI = self.registry, MetricInfo
@@ -264,6 +270,21 @@ class BrokerMetrics:
             "surge.log.faults.armed",
             "fault rules currently armed on this broker's plane "
             "(0 outside chaos experiments)"))
+        self.trace_kept = m.counter(MI(
+            "surge.trace.kept",
+            "traces the tail sampler kept into this broker's trace ring "
+            "(erred, breached surge.trace.tail.latency-ms, landed in an SLO "
+            "breach window, or explicitly marked)"))
+        self.trace_dropped = m.counter(MI(
+            "surge.trace.dropped",
+            "completed or evicted traces the tail sampler dropped "
+            "(sampled-out, over the keep budget, or evicted by the span-"
+            "buffer bound)"))
+        self.trace_tail_buffer = m.gauge(MI(
+            "surge.trace.tail-buffer-spans",
+            "spans buffered for in-flight traces awaiting their tail "
+            "keep/drop decision (bounded by "
+            "surge.trace.tail.max-buffer-spans)"))
 
 
 def broker_metrics(registry: Optional[Metrics] = None) -> BrokerMetrics:

@@ -46,6 +46,8 @@ class FleetMetrics:
     slo_breaches: Sensor = field(init=False)
     slo_active_breaches: Sensor = field(init=False)
     slo_max_burn_rate: Sensor = field(init=False)
+    # command-anatomy plane (surge_tpu/observability/anatomy.py)
+    trace_assembly_timer: Timer = field(init=False)
     # cluster autobalancer (surge_tpu/cluster/autobalancer.py)
     balancer_cycles: Sensor = field(init=False)
     balancer_moves: Sensor = field(init=False)
@@ -97,6 +99,11 @@ class FleetMetrics:
             "worst fast-window burn rate across objectives at the last "
             "evaluation (1.0 = spending error budget exactly at the "
             "objective's sustainable rate)"))
+        self.trace_assembly_timer = m.timer(MI(
+            "surge.trace.assembly-timer",
+            "ms per cross-process trace assembly + critical-path "
+            "attribution pass over DumpTraces envelopes "
+            "(observability/anatomy.py; tools/trace_anatomy.py)"))
         self.balancer_cycles = m.counter(MI(
             "surge.cluster.balancer.cycles",
             "autobalancer decision passes (one federated scrape + SLO "

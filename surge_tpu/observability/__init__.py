@@ -12,6 +12,12 @@ turns per-process dumps into one ordered story, cross-fleet scrape
 federation, and multiwindow burn-rate alerting over the merged payload.
 """
 
+from surge_tpu.observability.anatomy import (
+    assemble_traces,
+    attribute_trace,
+    attribution_table,
+    dominant_leg,
+)
 from surge_tpu.observability.federation import (
     FederatedScraper,
     ScrapeTarget,
@@ -28,6 +34,7 @@ from surge_tpu.observability.flight import (
 from surge_tpu.observability.slo import DEFAULT_SLOS, SLO, SLOEngine
 
 __all__ = ["DEFAULT_SLOS", "FederatedScraper", "FlightRecorder", "SLO",
-           "SLOEngine", "ScrapeTarget", "host_wall_offset", "merge_dumps",
-           "parse_openmetrics", "reconstruct_failover", "same_clock_domain",
-           "target_from_spec"]
+           "SLOEngine", "ScrapeTarget", "assemble_traces", "attribute_trace",
+           "attribution_table", "dominant_leg", "host_wall_offset",
+           "merge_dumps", "parse_openmetrics", "reconstruct_failover",
+           "same_clock_domain", "target_from_spec"]

@@ -130,7 +130,7 @@ class SLOEngine:
 
     def __init__(self, slos: Sequence[SLO] = DEFAULT_SLOS,
                  config: Config | None = None, metrics=None,
-                 on_signal=None, flight=None,
+                 on_signal=None, flight=None, tail=None, anatomy=None,
                  clock=time.time) -> None:
         cfg = config or default_config()
         self.slos = list(slos)
@@ -142,6 +142,15 @@ class SLOEngine:
         self.metrics = metrics  # FleetMetrics quiver (optional)
         self.on_signal = on_signal or (lambda name, level: None)
         self.flight = flight  # FlightRecorder (optional): breaches join the ring
+        #: TailSampler (optional, ISSUE 14): a breach opens its keep-window
+        #: (breach-adjacent traces become anatomy evidence) and the breach
+        #: event cites the newest kept trace ids as exemplars
+        self.tail = tail
+        #: zero-arg callable returning {"dominant", "dominant_share",
+        #: "traces"} (anatomy.dominant_leg over live/ring dumps) or None —
+        #: a breach then fires a `trace.anatomy` flight event naming the
+        #: dominant critical-path leg
+        self.anatomy = anatomy
         self._clock = clock
         self._tracks: Dict[str, _Track] = {s.name: _Track() for s in self.slos}
 
@@ -267,12 +276,23 @@ class SLOEngine:
                 if self.metrics is not None:
                     self.metrics.slo_breaches.record()
                 self.on_signal(f"slo.breach.{slo.name}", "warning")
+                exemplars = None
+                if self.tail is not None:
+                    # keep breach-adjacent traces (the anatomy evidence) and
+                    # cite the newest already-kept ids on the breach event
+                    try:
+                        self.tail.open_breach_window()
+                        exemplars = self.tail.ring.trace_ids(3)
+                    except Exception:  # noqa: BLE001 — paging must not die
+                        exemplars = None
                 if self.flight is not None:
                     self.flight.record(
                         "slo.breach", objective=slo.name,
                         burn_fast=round(track.burn_fast, 2),
                         burn_slow=round(track.burn_slow, 2),
-                        threshold=self.burn_threshold)
+                        threshold=self.burn_threshold,
+                        exemplar_trace_ids=exemplars or None)
+                self._record_anatomy(slo.name)
             elif track.breached and not breached:
                 self.on_signal(f"slo.recovered.{slo.name}", "trace")
                 if self.flight is not None:
@@ -292,6 +312,25 @@ class SLOEngine:
             self.metrics.slo_active_breaches.record(active)
             self.metrics.slo_max_burn_rate.record(max_burn)
         return rows
+
+    def _record_anatomy(self, objective: str) -> None:
+        """Fire the `trace.anatomy` flight event on a breach: which
+        critical-path leg dominates the tail-kept traces (the where-did-the-
+        time-go answer, right next to the breach on the incident timeline).
+        Best-effort — the anatomy source may need RPCs that fail mid-
+        incident, and a page must still fire without it."""
+        if self.anatomy is None or self.flight is None:
+            return
+        try:
+            verdict = self.anatomy()
+        except Exception:  # noqa: BLE001 — anatomy is evidence, not gating
+            verdict = None
+        if verdict:
+            self.flight.record(
+                "trace.anatomy", objective=objective,
+                dominant_leg=verdict.get("dominant"),
+                share=verdict.get("dominant_share"),
+                traces=verdict.get("traces"))
 
     def status_row(self, slo: SLO) -> dict:
         track = self._tracks[slo.name]

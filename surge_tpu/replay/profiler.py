@@ -122,11 +122,20 @@ class ReplayProfiler:
             span = self.tracer.start_span(f"replay.{stage}",
                                           parent=self._pass_span)
             # retro-dated to the measured interval so the trace timeline
-            # matches the perf_counter numbers the engine recorded
+            # matches the perf_counter numbers the engine recorded — BOTH
+            # clocks: the tail sampler's keep decision and the anatomy
+            # placement read the mono pair first, so a wall-only retro-date
+            # would make a 2s stage look like a 0ms span
             span.start_time = time.time() - seconds
-            for k, v in attrs.items():
-                span.set_attribute(k, v)
-            span.finish()
+            span.start_mono = time.monotonic() - seconds
+            try:
+                for k, v in attrs.items():
+                    span.set_attribute(k, v)
+            finally:
+                # finish unconditionally (span-leak rule): a raising
+                # attribute value must not leak the span — under tail
+                # sampling a leaked span pins its whole trace in the buffer
+                span.finish()
 
     def count_windows(self, n: int = 1) -> None:
         """Engine-reported window/tile dispatch count (one bump per window the

@@ -103,13 +103,22 @@ def extract_context(headers: Mapping[str, str]) -> Optional[SpanContext]:
 
 @dataclass
 class Span:
-    """One operation's span. ``finish`` hands it to the tracer's exporter."""
+    """One operation's span. ``finish`` hands it to the tracer's exporter.
+
+    Carries BOTH clocks: ``start_time``/``end_time`` are wall stamps (the
+    human anchor, and what the JSONL exporter ships), ``start_mono``/
+    ``end_mono`` are ``time.monotonic()`` stamps — the ordering truth the
+    cross-process trace assembly (observability/anatomy.py) places spans by,
+    via the same per-host mono↔wall offset estimation the flight recorder's
+    merge uses, so a skewed wall clock cannot scramble a trace."""
 
     name: str
     context: SpanContext
     parent_id: Optional[str] = None
     start_time: float = field(default_factory=time.time)
     end_time: Optional[float] = None
+    start_mono: float = field(default_factory=time.monotonic)
+    end_mono: Optional[float] = None
     attributes: Dict[str, object] = field(default_factory=dict)
     events: List[tuple] = field(default_factory=list)
     status: str = "ok"  # "ok" | "error"
@@ -159,6 +168,7 @@ class Span:
         self._deactivate()
         if self.end_time is None:
             self.end_time = time.time()
+            self.end_mono = time.monotonic()
             if self._tracer is not None:
                 self._tracer._on_finished(self)
 
@@ -184,6 +194,14 @@ class Tracer:
     remote ones — honors the head's verdict without its own coin flip. Unsampled
     spans are still created (context propagation stays intact, attributes are
     cheap dict writes) but never reach the exporter.
+
+    ``tail`` (a :class:`surge_tpu.tracing.tail.TailSampler`, attached by
+    :func:`surge_tpu.tracing.tail.install_tail`) rides BEHIND the head gate:
+    every head-sampled span is also offered to the tail sampler, which
+    buffers per trace and decides keep/drop only once the trace completes
+    (erred, breached the latency threshold, or landed in an SLO breach
+    window). Head sampling stays the fast-path cost gate; the tail decision
+    rides completed traces only.
     """
 
     def __init__(self, service: str = "surge",
@@ -193,6 +211,7 @@ class Tracer:
         self.service = service
         self._exporter = exporter
         self.sample_rate = sample_rate
+        self.tail = None  # Optional[tail.TailSampler]
         self._rng = random.Random(seed)
 
     def _sample_root(self) -> bool:
@@ -212,15 +231,23 @@ class Tracer:
         if parent_ctx is not None:
             ctx = SpanContext(trace_id=parent_ctx.trace_id, span_id=_new_span_id(),
                               sampled=parent_ctx.sampled)
-            return Span(name=name, context=ctx, parent_id=parent_ctx.span_id,
+            span = Span(name=name, context=ctx, parent_id=parent_ctx.span_id,
                         _tracer=self)
-        ctx = SpanContext(trace_id=_new_trace_id(), span_id=_new_span_id(),
-                          sampled=self._sample_root())
-        return Span(name=name, context=ctx, _tracer=self)
+        else:
+            ctx = SpanContext(trace_id=_new_trace_id(), span_id=_new_span_id(),
+                              sampled=self._sample_root())
+            span = Span(name=name, context=ctx, _tracer=self)
+        if self.tail is not None and ctx.sampled:
+            self.tail.on_start(span)
+        return span
 
     def _on_finished(self, span: Span) -> None:
-        if self._exporter is not None and span.context.sampled:
+        if not span.context.sampled:
+            return
+        if self._exporter is not None:
             self._exporter(span)
+        if self.tail is not None:
+            self.tail.on_finish(span)
 
 
 class NoopTracer(Tracer):

@@ -1,0 +1,18 @@
+"""Known-bad: spans leaked — never finished, happy-path-only, discarded."""
+
+
+class Engine:
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def never_finished(self):
+        span = self.tracer.start_span("op")  # line 9: never finished
+        span.set_attribute("k", 1)
+
+    def happy_path_only(self, work):
+        span = self.tracer.start_span("op")  # line 13: not on except paths
+        work()
+        span.finish()
+
+    def discarded(self):
+        self.tracer.start_span("op")  # line 18: result dropped on the floor

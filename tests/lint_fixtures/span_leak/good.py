@@ -1,0 +1,38 @@
+"""Known-good: context-managed, finally-finished, or escaping spans."""
+
+
+class Engine:
+    def __init__(self, tracer):
+        self.tracer = tracer
+        self.current = None
+
+    def managed(self):
+        with self.tracer.start_span("op") as span:
+            span.set_attribute("k", 1)
+
+    def finally_finished(self, work):
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start_span("op")
+        try:
+            work()
+        finally:
+            if span is not None:
+                span.finish()
+
+    def attrs_then_with(self):
+        span = self.tracer.start_span("op")
+        span.set_attribute("k", 1)
+        with span:
+            pass
+
+    def escapes_return(self):
+        span = self.tracer.start_span("op")
+        return span, {"headers": True}
+
+    def escapes_attribute(self):
+        self.current = self.tracer.start_span("op")
+
+    def escapes_argument(self, sink):
+        span = self.tracer.start_span("op")
+        sink(span)

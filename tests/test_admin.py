@@ -120,3 +120,61 @@ def test_admin_arm_faults_on_engine_log(tmp_path):
         log.close()
 
     asyncio.run(scenario())
+
+
+def test_admin_dump_traces_round_trip():
+    """DumpTraces over the engine admin plane (ISSUE 14): a traced command's
+    tail-kept spans come back in the merge-ready envelope; an untraced
+    engine answers an explicit error, not an empty ring."""
+    import pytest
+
+    from surge_tpu.tracing import Tracer
+
+    async def scenario():
+        tracer = Tracer(service="engine")
+        cfg = CFG.with_overrides({"surge.trace.tail.latency-ms": 0})
+        engine = create_engine(make_logic(), config=cfg, tracer=tracer)
+        await engine.start()
+        await engine.aggregate_for("a-1").send_command(counter.Increment("a-1"))
+        await asyncio.sleep(0.05)
+
+        admin = AdminServer(engine)
+        port = await admin.start()
+        channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        client = AdminClient(channel)
+
+        dump = await client.trace_dump()
+        assert dump["role"] == "engine"
+        assert dump["recorder"] == "engine:counter"
+        names = {s["name"] for e in dump["traces"] for s in e["spans"]}
+        # the whole command chain was tail-kept (latency threshold 0)
+        assert {"aggregate-ref.ProcessMessage", "entity.ProcessMessage",
+                "publisher.publish", "publisher.flush"} <= names
+        # one command trace holds ref AND flush: the flush span parents on
+        # the batch's first publish, keeping the trace contiguous
+        by_tid = {}
+        for e in dump["traces"]:
+            for s in e["spans"]:
+                by_tid.setdefault(e["trace_id"], set()).add(s["name"])
+        assert any({"aggregate-ref.ProcessMessage", "publisher.flush"} <= ns
+                   for ns in by_tid.values())
+        tail = await client.trace_dump(last=1)
+        assert len(tail["traces"]) == 1
+
+        await engine.stop()
+        await admin.stop()
+        await channel.close()
+
+        # untraced engine: explicit error, distinguishable from "nothing kept"
+        engine2 = create_engine(make_logic(), config=CFG)
+        await engine2.start()
+        admin2 = AdminServer(engine2)
+        port2 = await admin2.start()
+        channel2 = grpc.aio.insecure_channel(f"127.0.0.1:{port2}")
+        with pytest.raises(RuntimeError, match="no trace ring"):
+            await AdminClient(channel2).trace_dump()
+        await engine2.stop()
+        await admin2.stop()
+        await channel2.close()
+
+    asyncio.run(scenario())

@@ -1,0 +1,190 @@
+"""Command anatomy (ISSUE 14): cross-process trace assembly under skewed
+wall clocks, the critical-path leg attributor, the attribution table, and
+the tools/trace_anatomy.py CLI smoke."""
+
+import json
+import os
+import sys
+
+from surge_tpu.observability.anatomy import (
+    LEGS,
+    assemble_traces,
+    attribute_trace,
+    attribution_table,
+    dominant_leg,
+)
+
+TID = "a" * 32
+
+
+def _span(name, span_id, parent, start_mono, end_mono, wall_skew,
+          attrs=None, trace_id=TID):
+    """A dump-shape span whose wall stamps are its host's (possibly wrong)
+    clock: wall = mono + wall_skew AT RECORDING TIME."""
+    return {"name": name, "trace_id": trace_id, "span_id": span_id,
+            "parent_id": parent,
+            "start_mono": start_mono, "end_mono": end_mono,
+            "start_wall": start_mono + wall_skew,
+            "end_wall": end_mono + wall_skew,
+            "duration_ms": (end_mono - start_mono) * 1000.0,
+            "status": "ok", "attributes": attrs or {}, "events": []}
+
+
+def _dump(role, recorder, spans, offset, node):
+    """Envelope whose header pair encodes the host's TRUE mono→wall offset
+    (stamped at dump time, after any mid-incident wall step healed)."""
+    return {"recorder": recorder, "node": node, "pid": 1, "role": role,
+            "stats": {}, "dumped_wall": 2000.0 + offset,
+            "dumped_mono": 2000.0,
+            "traces": [{"trace_id": TID, "reason": "latency",
+                        "spans": spans}]}
+
+
+def three_host_dumps():
+    """One command trace across 3 hosts. True engine-host offset is +1000;
+    broker B1's wall clock was 600s BEHIND while its spans recorded (raw
+    wall stamps land before every engine span), broker B2's was 300s ahead.
+    Raw wall ordering would put B1's fsync-carrying span FIRST — before the
+    command even started; the mono↔wall header estimation must restore the
+    true order."""
+    e = [
+        _span("aggregate-ref.ProcessMessage", "e1", None, 10.00, 10.50, 1000),
+        _span("entity.ProcessMessage", "e2", "e1", 10.05, 10.45, 1000),
+        _span("publisher.publish", "e3", "e2", 10.10, 10.44, 1000),
+        _span("publisher.flush", "e4", "e3", 10.12, 10.43, 1000),
+        _span("log.Transact", "e5", "e4", 10.13, 10.20, 1000),
+        _span("log.Transact", "e6", "e4", 10.21, 10.42, 1000),
+    ]
+    # B1: wall clock 600s BEHIND while recording (raw wall ≈ -569, sorts
+    # before the whole command); the header's true offset +980 maps its
+    # mono 30.14 to est wall 1010.14 — inside the FIRST client call
+    b1 = [_span("log.server.transact", "b1", "e5", 30.14, 30.19, -600,
+                attrs={"leg.gate-wait-ms": 2.0})]
+    # B2: wall clock ~690s AHEAD while recording (raw wall ≈ 1700, sorts
+    # after everything); the header's true offset +1310 maps its mono
+    # -299.78 to est wall 1010.22 — inside the SECOND client call
+    b2 = [_span("log.server.transact", "b2", "e6", -299.78, -299.60, 2000,
+                attrs={"leg.fsync-ms": 150.0, "leg.repl-ms": 20.0})]
+    return [
+        _dump("engine", "engine:test", e, 1000.0, "host-e"),
+        _dump("broker", "127.0.0.1:16001", b1, 980.0, "host-b1"),
+        _dump("broker", "127.0.0.1:16002", b2, 1310.0, "host-b2"),
+    ]
+
+
+def test_skewed_clock_assembly_restores_true_order():
+    dumps = three_host_dumps()
+    # the trap is real: raw wall order puts both broker spans BEFORE the
+    # engine's root (B1 600s behind) / after everything (B2 300s ahead)
+    raw = sorted((s for d in dumps for e in d["traces"]
+                  for s in e["spans"]), key=lambda s: s["start_wall"])
+    assert raw[0]["name"] == "log.server.transact"
+    assert raw[-1]["name"] == "log.server.transact"
+    traces = assemble_traces(dumps)
+    spans = traces[TID]
+    order = [s["span_id"] for s in spans]
+    # estimated-wall placement: each broker span sits inside its client call
+    assert order == ["e1", "e2", "e3", "e4", "e5", "b1", "e6", "b2"]
+    assert spans[5]["recorder"] == "127.0.0.1:16001"
+    assert spans[5]["lane"] == "broker"
+    assert spans[0]["keep_reason"] == "latency"
+
+
+def test_attributor_names_the_fsync_leg_despite_the_skew():
+    traces = assemble_traces(three_host_dumps())
+    row = attribute_trace(traces[TID])
+    legs = row["legs"]
+    assert row["duration_ms"] == 500.0
+    assert legs["journal-fsync"] == 150.0        # measured broker attr
+    assert legs["replication-ack"] == 20.0
+    assert legs["gate-wait"] == 2.0
+    assert legs["mailbox-wait"] == 50.0          # entity - root start
+    assert legs["publisher-linger"] == 20.0      # flush - publish start
+    assert legs["lane-dispatch"] == 10.0         # first call - flush start
+    assert all(v >= 0.0 for v in legs.values())
+    # legs are self-times on the critical path: they sum to the root
+    assert abs(sum(legs.values()) - row["duration_ms"]) < 1e-6
+    assert row["dominant"] == "journal-fsync"
+
+
+def test_attribution_table_aggregates_and_filters_poll_traces():
+    dumps = three_host_dumps()
+    # a kept read-poll trace (one bare client span): must not dilute legs
+    poll = _span("log.Read", "p1", None, 50.0, 50.3, 1000, trace_id="b" * 32)
+    dumps[0]["traces"].append({"trace_id": "b" * 32, "reason": "latency",
+                               "spans": [poll]})
+    table = attribution_table(assemble_traces(dumps))
+    assert table["traces"] == 1                  # the command trace only
+    assert list(table["legs"]) == list(LEGS)
+    assert table["dominant"] == "journal-fsync"
+    assert table["dominant_share"] > 0.25
+    assert table["slowest"][0]["trace_id"] == TID
+    # opting in to everything includes the poll trace
+    assert attribution_table(assemble_traces(dumps),
+                             command_only=False)["traces"] == 2
+    verdict = dominant_leg(dumps)
+    assert verdict == {"dominant": "journal-fsync",
+                       "dominant_share": table["dominant_share"],
+                       "traces": 1}
+
+
+def test_router_resolve_leg_is_self_time_not_double_counted():
+    """router.resolve nests UNDER router.commit (and client calls under
+    both): the leg must be router SELF-time — overlapped nested intervals
+    subtracted once, never double-counted past the root duration."""
+    spans = [
+        _span("aggregate-ref.ProcessMessage", "r", None, 0.0, 0.2, 0),
+        _span("router.commit", "rc", "r", 0.0, 0.1, 0),
+        _span("router.resolve", "rr", "rc", 0.01, 0.05, 0),
+        _span("log.Transact", "ct", "rc", 0.05, 0.10, 0),
+    ]
+    dump = _dump("engine", "e", spans, 0.0, "host-e")
+    row = attribute_trace(assemble_traces([dump])[TID])
+    # commit self (100-40-50=10) + resolve self (40) = 50ms of router work
+    assert row["legs"]["router-resolve"] == 50.0
+    assert sum(row["legs"].values()) <= row["duration_ms"] + 1e-6
+
+
+def test_assembly_timer_records_on_the_fleet_quiver():
+    from surge_tpu.metrics.fleet import fleet_metrics
+
+    fm = fleet_metrics()
+    attribution_table(assemble_traces(three_host_dumps()), metrics=fm)
+    values = fm.registry.get_metrics()
+    assert values["surge.trace.assembly-timer.max"] >= 0.0
+
+
+def test_legacy_dump_without_header_pair_falls_back_to_wall():
+    dumps = three_host_dumps()
+    for d in dumps:
+        d.pop("dumped_wall")
+        d.pop("dumped_mono")
+    spans = assemble_traces(dumps)[TID]
+    # raw-wall fallback: the skewed B1 span now sorts first — documented
+    # legacy behavior, which is exactly why the header pair exists
+    assert spans[0]["span_id"] == "b1"
+
+
+def test_trace_anatomy_cli_json_smoke(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import trace_anatomy
+
+    paths = []
+    for i, d in enumerate(three_host_dumps()):
+        p = tmp_path / f"dump{i}.json"
+        p.write_text(json.dumps(d))
+        paths.append(str(p))
+    rc = trace_anatomy.main(paths + ["--once", "--format=json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["traces"] == 1
+    assert out["dominant"] == "journal-fsync"
+    assert out["legs"]["journal-fsync"]["total_ms"] == 150.0
+    assert out["sources"] == 3 and out["errors"] == []
+    # the human table renders too
+    rc = trace_anatomy.main(paths)
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "dominant leg: journal-fsync" in text
+    assert "slowest kept traces:" in text

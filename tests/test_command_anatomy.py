@@ -1,0 +1,413 @@
+"""Command anatomy end to end (ISSUE 14): router-hop trace continuity under
+A→B→A leadership moves, direct-lane rejoin span parenting (native on/off),
+SLO breach → exemplar/anatomy wiring, and the acceptance path — a seeded
+slow-fsync fault on a 3-broker spread cluster behind the PartitionRouter
+whose breached command trace is tail-kept, assembled across engine+broker
+dumps, and attributed to the journal-fsync leg by trace_anatomy.py."""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from conftest import free_ports
+from surge_tpu import SurgeCommandBusinessLogic, create_engine
+from surge_tpu.cluster import PartitionRouter
+from surge_tpu.config import Config
+from surge_tpu.log import (
+    GrpcLogTransport,
+    InMemoryLog,
+    LogRecord,
+    LogServer,
+    TopicSpec,
+)
+from surge_tpu.log.file import FileLog
+from surge_tpu.models import counter
+from surge_tpu.observability import SLO, SLOEngine, merge_dumps
+from surge_tpu.observability.anatomy import assemble_traces, dominant_leg
+from surge_tpu.tracing import InMemoryTracer, Tracer
+from tests.test_native_gate import NATIVE_MODES
+
+CLUSTER_CFG = Config(overrides={
+    "surge.log.replication-ack-timeout-ms": 4_000,
+    "surge.log.replication-isr-timeout-ms": 2_000,
+    "surge.log.replication.min-insync-acks": 2,
+    "surge.trace.tail.latency-ms": 200,
+    "surge.trace.ring-capacity": 512,
+})
+
+
+def make_logic(name="anat"):
+    return SurgeCommandBusinessLogic(
+        aggregate_name=name, model=counter.CounterModel(),
+        state_format=counter.state_formatting(),
+        event_format=counter.event_formatting())
+
+
+def _spread_trio(cfg, tracers=(None, None, None), logs=None, partitions=4):
+    """3 brokers, quorum peers everywhere, leadership spread round-robin."""
+    ports = free_ports(3)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    logs = logs or [InMemoryLog() for _ in range(3)]
+    followers = []
+    for i in (1, 2):
+        f = LogServer(logs[i], port=ports[i], follower_of=addrs[0],
+                      auto_promote=True, config=cfg, quorum_peers=addrs,
+                      tracer=tracers[i])
+        f.start()
+        followers.append(f)
+    leader = LogServer(logs[0], port=ports[0],
+                       replicate_to=[addrs[1], addrs[2]], config=cfg,
+                       quorum_peers=addrs, auto_promote=True,
+                       tracer=tracers[0])
+    leader.start()
+    setup = GrpcLogTransport(addrs[0], config=cfg)
+    view = setup.cluster_meta("spread", partitions=partitions)
+    return leader, followers, addrs, setup, view
+
+
+def _stop_all(*servers):
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:  # noqa: BLE001 — already stopped
+            pass
+
+
+def _wait_applied(client, partition, addr, timeout=5.0):
+    """Poll until the CONNECTED broker's applied assignment view moves
+    ``partition`` to ``addr`` (the redirect trap is only armed then)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        view = client.cluster_meta("status")
+        if (view.get("assignments") or {}).get(str(partition)) == addr:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"assignment of {partition} -> {addr} never applied")
+
+
+# -- satellite 1: router redirect hops are one contiguous trace ----------------------
+
+
+def test_router_redirect_chain_a_b_a_one_contiguous_trace():
+    """Move partition 0's leadership A→B→A under a RoutedProducer: every
+    hop — router.commit spans, their redirect events, the broker-call spans
+    under them, and the broker-side spans on BOTH brokers — lands in ONE
+    trace, chained under the caller's root span."""
+    cfg = Config(overrides={**CLUSTER_CFG.overrides,
+                            "surge.trace.tail.latency-ms": 1e9})
+    broker_tracers = [InMemoryTracer() for _ in range(3)]
+    leader, followers, addrs, setup, view = _spread_trio(
+        cfg, tracers=broker_tracers)
+    tracer = InMemoryTracer()
+    router = PartitionRouter(addrs, config=cfg, tracer=tracer)
+    try:
+        home = view["assignments"]["0"]
+        away = next(a for a in addrs if a != home)
+        home_client = GrpcLogTransport(home, config=cfg)
+        producer = router.transactional_producer("t-aba")
+
+        def commit(payload):
+            producer.begin()
+            producer.send(LogRecord(topic="ev", key="k0", value=payload,
+                                    partition=0))
+            producer.commit()
+
+        root = tracer.start_span("test.root")
+        with root:
+            router.create_topic(TopicSpec("ev", 4))
+            commit(b"v0")                                   # on A
+            setup.cluster_meta("assign", partition=0, to=away)
+            _wait_applied(home_client, 0, away)
+            commit(b"v1")                                   # redirect → B
+            setup.cluster_meta("assign", partition=0, to=home)
+            _wait_applied(GrpcLogTransport(away, config=cfg), 0, home)
+            commit(b"v2")                                   # redirect → A
+        home_client.close()
+
+        tid = root.context.trace_id
+        mine = [s for s in tracer.finished if s.context.trace_id == tid]
+        commits = [s for s in mine if s.name == "router.commit"]
+        assert len(commits) == 3
+        # the two rerouted commits recorded their redirect hops
+        redirected = [s for s in commits
+                      if any(ev[1] == "redirect" for ev in s.events)]
+        assert len(redirected) == 2
+        assert all(s.attributes["attempts"] >= 2 for s in redirected)
+        # broker-call spans chain UNDER the router spans, same trace
+        commit_ids = {s.context.span_id for s in commits}
+        transacts = [s for s in mine if s.name == "log.Transact"]
+        assert transacts and all(s.parent_id in commit_ids
+                                 for s in transacts)
+        # and the trace crossed the wire: BOTH brokers saw it
+        seen_on = [t for t, a in zip(broker_tracers, addrs)
+                   if any(s.context.trace_id == tid
+                          and s.name == "log.server.transact"
+                          for s in t.finished)]
+        assert len(seen_on) >= 2
+        # contiguity: every router.commit chains directly under the root
+        assert all(s.parent_id == root.context.span_id for s in commits)
+    finally:
+        router.close()
+        setup.close()
+        _stop_all(leader, *followers)
+
+
+# -- satellite 2: direct-lane rejoin keeps the originating command's trace -----------
+
+
+@pytest.mark.parametrize("native", NATIVE_MODES)
+def test_direct_lane_rejoin_parents_broker_span_under_command(tmp_path,
+                                                              native):
+    """A caller that times out and rejoins its queued write by request id
+    (command-lane=direct) must still chain the broker log.server.transact
+    span under the ORIGINATING command's trace — the queued pending carries
+    the first publish attempt's span context, and the flush parents on it.
+    Regression over native on/off (the broker-side path differs)."""
+    etracer = InMemoryTracer()
+    btracer = InMemoryTracer()
+    cfg = Config(overrides={
+        "surge.producer.command-lane": "direct",
+        # linger is clamped to the flush tick, so raise BOTH: the 300ms hold
+        # vs the 100ms publish timeout forces the timed-out-then-rejoin path
+        "surge.producer.linger-ms": 300,
+        "surge.producer.flush-interval-ms": 300,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 20,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.aggregate.publish-timeout-ms": 100,
+        "surge.aggregate.publish-max-retries": 8,
+        "surge.engine.num-partitions": 1,
+        "surge.log.native.enabled": native,
+        "surge.trace.tail.latency-ms": 1e9,
+    })
+    server = LogServer(FileLog(str(tmp_path / "log"), fsync="commit",
+                               config=cfg),
+                       config=cfg, tracer=btracer)
+    port = server.start()
+    log = GrpcLogTransport(f"127.0.0.1:{port}", config=cfg, tracer=etracer)
+
+    async def scenario():
+        engine = create_engine(make_logic("rejoin"), log=log, config=cfg,
+                               tracer=etracer)
+        await engine.start()
+        r = await engine.aggregate_for("a1").send_command(
+            counter.Increment("a1"))
+        assert type(r).__name__ == "CommandSuccess", r
+        # the 300ms linger vs the 100ms publish timeout forces at least one
+        # timed-out attempt that REJOINED the queued write by request id
+        stats = [reg.publisher.stats
+                 for _p, reg in engine.router.regions()]
+        assert sum(s.dedup_hits for s in stats) >= 1, \
+            "no rejoin happened — timing knobs no longer force the timeout"
+        await engine.stop()
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        log.close()
+        server.stop()
+
+    # the command trace: ref root → … → >=2 publish attempts → flush →
+    # broker call, all one trace id
+    roots = [s for s in etracer.finished
+             if s.name == "aggregate-ref.ProcessMessage"]
+    assert roots
+    tid = roots[0].context.trace_id
+    mine = {s.name: s for s in etracer.finished
+            if s.context.trace_id == tid}
+    publishes = [s for s in etracer.finished
+                 if s.context.trace_id == tid
+                 and s.name == "publisher.publish"]
+    assert len(publishes) >= 2  # the original + the rejoining retry
+    flush = mine["publisher.flush"]
+    # the flush parents on the ORIGINAL (first) publish attempt's span
+    assert flush.parent_id == publishes[0].context.span_id
+    # and the broker-side span rides the SAME originating trace
+    broker_spans = [s for s in btracer.finished
+                    if s.name == "log.server.transact"
+                    and s.context.trace_id == tid]
+    assert broker_spans, "broker span did not chain under the command trace"
+    client_call = [s for s in etracer.finished
+                   if s.context.trace_id == tid and s.name == "log.Transact"]
+    assert client_call and broker_spans[0].parent_id == \
+        client_call[0].context.span_id
+
+
+# -- SLO wiring: breach → exemplars + breach window + trace.anatomy ------------------
+
+
+def test_slo_breach_opens_tail_window_cites_exemplars_fires_anatomy():
+    from surge_tpu.observability import FlightRecorder
+    from surge_tpu.tracing.tail import TailSampler, TraceRing
+
+    ring = TraceRing(name="engine:t", role="engine")
+    now = [0.0]
+    tail = TailSampler(ring, latency_ms=1e9, clock=lambda: now[0])
+    ring.keep("c" * 32, "latency", [{"name": "s", "trace_id": "c" * 32}])
+    flight = FlightRecorder(role="engine")
+    eng = SLOEngine(
+        [SLO("lag", family="g", kind="bound", objective=0.99,
+             threshold=5.0, op="gt")],
+        config=Config(overrides={"surge.slo.fast-window-ms": 10_000,
+                                 "surge.slo.slow-window-ms": 40_000,
+                                 "surge.slo.burn-threshold": 2.0}),
+        flight=flight, tail=tail,
+        anatomy=lambda: {"dominant": "journal-fsync",
+                         "dominant_share": 0.71, "traces": 4})
+    from surge_tpu.metrics.exposition import Family, Sample
+
+    def fams(value):
+        fam = Family(name="g", mtype="gauge", help="")
+        fam.samples.append(Sample("", (("instance", "i"),), value))
+        return {"g": fam}
+
+    eng.evaluate(fams(9.0), now=0.0)
+    eng.evaluate(fams(9.0), now=5.0)
+    assert eng.breached() == ["lag"]
+    events = flight.events()
+    breach = next(e for e in events if e["type"] == "slo.breach")
+    assert breach["exemplar_trace_ids"] == ["c" * 32]
+    anatomy = next(e for e in events if e["type"] == "trace.anatomy")
+    assert anatomy["dominant_leg"] == "journal-fsync"
+    assert anatomy["share"] == 0.71 and anatomy["traces"] == 4
+    # the breach opened the tail keep-window: a fast trace completing now
+    # is kept as breach evidence
+    assert tail.stats()["breach_window_open"]
+
+
+# -- acceptance: seeded slow-fsync → journal-fsync named dominant --------------------
+
+
+def test_e2e_slow_fsync_anatomy_names_journal_leg(tmp_path, capsys):
+    """ISSUE 14 acceptance: fsync.journal stall (fault plane) on a 3-broker
+    spread cluster behind the PartitionRouter → the breached command's
+    trace is tail-kept on BOTH sides of the process boundary, assembled
+    across engine+broker DumpTraces dumps, and trace_anatomy.py names the
+    journal-fsync leg dominant (>50% of the critical path); the SLO engine
+    stamps `trace.anatomy` onto the merged flight timeline."""
+    cfg = Config(overrides={
+        **CLUSTER_CFG.overrides,
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 20,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.engine.num-partitions": 4,
+    })
+    broker_tracers = [Tracer(service=f"b{i}") for i in range(3)]
+    logs = [FileLog(str(tmp_path / f"b{i}"), fsync="commit", config=cfg)
+            for i in range(3)]
+    leader, followers, addrs, setup, _view = _spread_trio(
+        cfg, tracers=broker_tracers, logs=logs)
+    etracer = Tracer(service="engine")
+    router = PartitionRouter(addrs, config=cfg, tracer=etracer)
+    engine = None
+    dumps = []
+    try:
+        async def scenario():
+            nonlocal engine
+            engine = create_engine(make_logic(), log=router, config=cfg,
+                                   tracer=etracer)
+            await engine.start()
+            agg = "anat-0"
+            part = engine.router.partition_for(agg)
+            target = setup.cluster_meta("status")["assignments"][str(part)]
+            # warm the entity/producer path so the stall lands on the
+            # command's commit alone
+            r = await engine.aggregate_for(agg).send_command(
+                counter.Increment(agg))
+            assert type(r).__name__ == "CommandSuccess", r
+            tclient = GrpcLogTransport(target, config=cfg)
+            try:
+                tclient.arm_faults(json.dumps({"rules": [{
+                    "site": "fsync.journal", "action": "stall",
+                    "delay_ms": 800, "times": 1}]}))
+                t0 = time.perf_counter()
+                r = await engine.aggregate_for(agg).send_command(
+                    counter.Increment(agg))
+                stalled_ms = (time.perf_counter() - t0) * 1000.0
+                assert type(r).__name__ == "CommandSuccess", r
+                assert stalled_ms >= 500.0  # the seeded stall was paid
+            finally:
+                tclient.disarm_faults()
+                tclient.close()
+            await asyncio.sleep(0.4)  # flush spans + tail decisions settle
+            await engine.stop()
+
+        asyncio.run(scenario())
+
+        # pull the rings: engine (in-process; the admin RPC round-trip is
+        # covered in test_admin) + every broker over DumpTraces
+        dumps.append(engine.trace_ring.dump())
+        for a in addrs:
+            c = GrpcLogTransport(a, config=cfg)
+            dumps.append(c.trace_dump())
+            c.close()
+        paths = []
+        for i, d in enumerate(dumps):
+            p = tmp_path / f"trace-dump{i}.json"
+            p.write_text(json.dumps(d))
+            paths.append(str(p))
+
+        # the breached command assembled WHOLE across the process boundary
+        traces = assemble_traces(dumps)
+        whole = [spans for spans in traces.values()
+                 if {"aggregate-ref.ProcessMessage", "publisher.flush",
+                     "log.server.transact"} <= {s["name"] for s in spans}]
+        assert whole, "no cross-process command trace was tail-kept"
+        assert any(s["keep_reason"] == "latency" for s in whole[0])
+        assert {s["lane"] for s in whole[0]} == {"engine", "broker"}
+
+        # the acceptance verdict comes from trace_anatomy.py's JSON output
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import trace_anatomy
+
+        rc = trace_anatomy.main(paths + ["--once", "--format=json"])
+        assert rc == 0
+        table = json.loads(capsys.readouterr().out)
+        assert table["traces"] >= 1
+        assert table["dominant"] == "journal-fsync", table
+        assert table["dominant_share"] > 0.5, table
+        assert table["legs"]["journal-fsync"]["p99"] >= 500.0
+
+        # SLO plane: a breach cites the kept trace and stamps trace.anatomy
+        # onto the engine flight ring, which merges with broker flight dumps
+        # into one incident timeline
+        tail = etracer.tail
+        slo = SLOEngine(
+            [SLO("cmd-lat", family="g", kind="bound", objective=0.99,
+                 threshold=5.0, op="gt")],
+            config=Config(overrides={"surge.slo.fast-window-ms": 10_000,
+                                     "surge.slo.slow-window-ms": 40_000,
+                                     "surge.slo.burn-threshold": 2.0}),
+            flight=engine.flight, tail=tail,
+            anatomy=lambda: dominant_leg(dumps))
+        from surge_tpu.metrics.exposition import Family, Sample
+
+        def fams(value):
+            fam = Family(name="g", mtype="gauge", help="")
+            fam.samples.append(Sample("", (("instance", "i"),), value))
+            return {"g": fam}
+
+        slo.evaluate(fams(9.0), now=0.0)
+        slo.evaluate(fams(9.0), now=5.0)
+        assert slo.breached() == ["cmd-lat"]
+        flight_dumps = [engine.flight.dump()]
+        for a in addrs:
+            c = GrpcLogTransport(a, config=cfg)
+            flight_dumps.append(c.flight_dump())
+            c.close()
+        merged = merge_dumps(flight_dumps)
+        anatomy_ev = [e for e in merged if e["type"] == "trace.anatomy"]
+        assert anatomy_ev, "trace.anatomy missing from the merged timeline"
+        assert anatomy_ev[0]["dominant_leg"] == "journal-fsync"
+        breach_ev = next(e for e in merged if e["type"] == "slo.breach")
+        assert breach_ev["exemplar_trace_ids"]
+    finally:
+        router.close()
+        setup.close()
+        _stop_all(leader, *followers)

@@ -67,6 +67,7 @@ MODULE_RULE_CASES = [
     ("blocking-in-async", "blocking_in_async", [10, 11, 12, 14, 17]),
     ("waitfor-cancellation-swallow", "waitfor_cancellation_swallow", [8, 12]),
     ("orphan-task", "orphan_task", [7, 10]),
+    ("span-leak", "span_leak", [9, 13, 18]),
     ("jit-purity", "jit_purity", [12, 13, 14, 15]),
     ("hot-path-asyncio", "hot_path_asyncio", [9, 14, 18]),
 ]

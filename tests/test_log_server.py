@@ -404,3 +404,48 @@ def test_publisher_pipelines_over_grpc_exactly_once(broker):
         await indexer.stop()
 
     asyncio.run(scenario())
+
+
+def test_dump_traces_rpc_round_trip():
+    """DumpTraces on the log service (ISSUE 14): broker-side tail-kept spans
+    (with the measured leg attrs) come back in the merge-ready envelope;
+    an untraced broker answers an explicit state error."""
+    from surge_tpu.config import Config
+    from surge_tpu.tracing import Tracer
+
+    cfg = Config(overrides={"surge.trace.tail.latency-ms": 0})
+    server = LogServer(InMemoryLog(), tracer=Tracer(service="broker"),
+                       config=cfg)
+    port = server.start()
+    log = GrpcLogTransport(f"127.0.0.1:{port}")
+    try:
+        log.create_topic(TopicSpec("t", 1))
+        p = log.transactional_producer("txn-ring")
+        p.begin()
+        p.send(rec("t", "k", b"v"))
+        p.commit()
+        dump = log.trace_dump()
+        assert dump["role"] == "broker"
+        assert dump["recorder"] == server.advertised
+        spans = [s for e in dump["traces"] for s in e["spans"]]
+        transacts = [s for s in spans if s["name"] == "log.server.transact"]
+        assert transacts
+        # the broker MEASURES its journal leg onto the span (anatomy source)
+        assert any("leg.fsync-ms" in s["attributes"] for s in transacts)
+        # spans carry both clocks for the skew-proof assembly
+        assert all(s["start_mono"] is not None and s["end_mono"] is not None
+                   for s in spans)
+        assert len(log.trace_dump(last=1)["traces"]) == 1
+    finally:
+        log.close()
+        server.stop()
+
+    server2 = LogServer(InMemoryLog())
+    port2 = server2.start()
+    log2 = GrpcLogTransport(f"127.0.0.1:{port2}")
+    try:
+        with pytest.raises(RuntimeError, match="no trace ring"):
+            log2.trace_dump()
+    finally:
+        log2.close()
+        server2.stop()

@@ -138,3 +138,41 @@ def test_chaos_fleet_prints_merged_exposition(capsys):
 
 def test_chaos_fleet_needs_specs(capsys):
     assert chaos.main(["fleet", " , "]) == 2
+
+
+def test_dom_leg_column_renders_from_trace_dumps():
+    """The dominant-leg column (ISSUE 14): a traced broker's DumpTraces
+    feeds the per-instance `dom-leg` cell; fetch-only and untraced targets
+    render "-" instead of failing the console."""
+    from surge_tpu.config import Config
+    from surge_tpu.tracing import Tracer
+
+    cfg = Config(overrides={"surge.trace.tail.latency-ms": 0})
+    server = LogServer(InMemoryLog(), tracer=Tracer(), config=cfg)
+    port = server.start()
+    try:
+        from surge_tpu.log import GrpcLogTransport, LogRecord, TopicSpec
+
+        client = GrpcLogTransport(f"127.0.0.1:{port}")
+        client.create_topic(TopicSpec("t", 1))
+        p = client.transactional_producer("tx")
+        p.begin()
+        p.send(LogRecord(topic="t", key="k", value=b"v", partition=0))
+        p.commit()
+        client.close()
+        scraper = FederatedScraper([f"broker@127.0.0.1:{port}"])
+        scraper.scrape_once()
+        rows = surgetop.fleet_rows(scraper)
+        assert rows[0]["dom-leg"] in (
+            "journal-fsync", "reply-decode", "gate-wait", "other")
+        frame = surgetop.render_table(rows, [], {"up": 1, "targets": 1,
+                                                 "errors": []})
+        assert "dom-leg" in frame.splitlines()[1]
+        # opting out skips the DumpTraces RPCs entirely
+        assert surgetop.fleet_rows(scraper,
+                                   anatomy=False)[0]["dom-leg"] is None
+    finally:
+        server.stop()
+    # canned fetch-only targets (no address): the column is "-"
+    rows = surgetop.fleet_rows(_canned_scraper())
+    assert all(r["dom-leg"] is None for r in rows)

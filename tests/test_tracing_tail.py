@@ -1,0 +1,182 @@
+"""Tail-based trace sampling (ISSUE 14): the per-trace buffer, the keep
+decision (error / latency / breach window / mark), the bounded keep budget
+and span-buffer eviction, the kept-trace ring's merge-ready envelope, and
+the tracer attachment (`install_tail`)."""
+
+import time
+
+from surge_tpu.config import Config
+from surge_tpu.metrics import engine_metrics
+from surge_tpu.tracing import InMemoryTracer, Tracer
+from surge_tpu.tracing.tail import TailSampler, TraceRing, install_tail
+
+
+def make(latency_ms=50.0, keep_budget=64, budget_window_s=10.0,
+         max_buffer_spans=4096, clock=None, metrics=None):
+    ring = TraceRing(capacity=16, name="t", role="engine")
+    sampler = TailSampler(ring, latency_ms=latency_ms, keep_budget=keep_budget,
+                          budget_window_s=budget_window_s,
+                          max_buffer_spans=max_buffer_spans,
+                          metrics=metrics,
+                          clock=clock or time.monotonic)
+    tracer = Tracer()
+    tracer.tail = sampler
+    return tracer, sampler, ring
+
+
+def test_fast_clean_trace_is_dropped_slow_trace_is_kept():
+    tracer, sampler, ring = make(latency_ms=50.0)
+    # fast + clean: sampled out at quiescence
+    with tracer.start_span("fast"):
+        pass
+    assert len(ring) == 0
+    assert sampler.dropped["sampled-out"] == 1
+    # slow: the latency criterion keeps it (duration forged via end stamps)
+    span = tracer.start_span("slow")
+    span.start_time -= 0.2
+    span.start_mono -= 0.2
+    span.finish()
+    assert len(ring) == 1
+    entry = ring.dump()["traces"][0]
+    assert entry["reason"] == "latency"
+    assert entry["trace_id"] == span.context.trace_id
+    assert entry["spans"][0]["name"] == "slow"
+    assert sampler.kept == 1
+
+
+def test_erred_trace_is_kept_with_children():
+    tracer, sampler, ring = make(latency_ms=1e9)
+    root = tracer.start_span("root")
+    child = tracer.start_span("child", parent=root)
+    child.status = "error"
+    child.finish()
+    # decision waits for the whole trace: nothing kept while the root is open
+    assert len(ring) == 0
+    root.finish()
+    assert len(ring) == 1
+    entry = ring.dump()["traces"][0]
+    assert entry["reason"] == "error"
+    assert sorted(s["name"] for s in entry["spans"]) == ["child", "root"]
+
+
+def test_keep_budget_bounds_keeps_and_counts_drops():
+    now = [0.0]
+    tracer, sampler, ring = make(latency_ms=0.0, keep_budget=2,
+                                 budget_window_s=100.0,
+                                 clock=lambda: now[0])
+    for _ in range(5):
+        with tracer.start_span("op"):
+            pass
+    assert sampler.kept == 2
+    assert sampler.dropped["budget"] == 3
+    # window expiry restores the budget
+    now[0] = 200.0
+    with tracer.start_span("op"):
+        pass
+    assert sampler.kept == 3
+
+
+def test_breach_window_and_mark_trace_keep_fast_traces():
+    now = [0.0]
+    tracer, sampler, ring = make(latency_ms=1e9, clock=lambda: now[0])
+    with tracer.start_span("boring"):
+        pass
+    assert len(ring) == 0
+    sampler.open_breach_window(30.0)
+    with tracer.start_span("breach-adjacent"):
+        pass
+    assert ring.dump()["traces"][-1]["reason"] == "breach-window"
+    now[0] = 100.0  # window closed again
+    with tracer.start_span("later"):
+        pass
+    assert len(ring) == 1
+    marked = tracer.start_span("exemplar")
+    sampler.mark_trace(marked.context.trace_id)
+    marked.finish()
+    assert ring.dump()["traces"][-1]["reason"] == "marked"
+
+
+def test_span_buffer_bound_evicts_oldest_in_flight_trace():
+    tracer, sampler, ring = make(latency_ms=0.0, max_buffer_spans=8)
+    leaked = [tracer.start_span(f"leak{i}") for i in range(12)]
+    # a child finishing buffers one span per trace; roots stay open so the
+    # traces never quiesce — the bound evicts the oldest instead
+    for root in leaked:
+        tracer.start_span("child", parent=root).finish()
+    assert sampler.stats()["buffered_spans"] <= 8
+    assert sampler.dropped["buffer"] >= 4
+
+
+def test_head_unsampled_spans_never_reach_the_tail():
+    ring = TraceRing()
+    sampler = TailSampler(ring, latency_ms=0.0)
+    tracer = Tracer(sample_rate=0.0)
+    tracer.tail = sampler
+    with tracer.start_span("unsampled"):
+        pass
+    assert sampler.stats()["buffered_traces"] == 0
+    assert len(ring) == 0
+
+
+def test_metrics_counters_ride_the_quiver():
+    m = engine_metrics()
+    tracer, sampler, ring = make(latency_ms=0.0, metrics=m)
+    with tracer.start_span("kept"):
+        pass
+    values = m.registry.get_metrics()
+    assert values["surge.trace.kept"] == 1.0
+    assert values["surge.trace.tail-buffer-spans"] == 0.0
+    sampler.latency_ms = 1e9
+    with tracer.start_span("dropped"):
+        pass
+    assert m.registry.get_metrics()["surge.trace.dropped"] == 1.0
+
+
+def test_ring_dump_envelope_is_merge_ready_and_bounded():
+    ring = TraceRing(capacity=4, name="broker:1", role="broker")
+    for i in range(6):
+        ring.keep(f"t{i}", "latency", [{"name": "s", "trace_id": f"t{i}"}])
+    dump = ring.dump()
+    assert dump["recorder"] == "broker:1" and dump["role"] == "broker"
+    # the mono↔wall header pair anatomy.py estimates the host offset from
+    assert abs((dump["dumped_wall"] - dump["dumped_mono"])
+               - (time.time() - time.monotonic())) < 1.0
+    assert dump["stats"]["traces"] == 4          # bounded ring wrapped
+    assert dump["stats"]["dropped"] == 2
+    assert dump["stats"]["kept_total"] == 6
+    assert [e["trace_id"] for e in dump["traces"]] == ["t2", "t3", "t4", "t5"]
+    assert [e["trace_id"] for e in ring.dump(2)["traces"]] == ["t4", "t5"]
+    assert ring.trace_ids(3) == ["t5", "t4", "t3"]  # newest first
+
+
+def test_install_tail_is_config_gated_and_idempotent():
+    cfg = Config(overrides={"surge.trace.ring-capacity": 8})
+    tracer = InMemoryTracer()
+    ring = install_tail(tracer, cfg, name="e", role="engine")
+    assert ring is not None and tracer.tail is not None
+    assert tracer.tail.ring is ring
+    # idempotent: a second install (co-resident component) reuses the ring
+    assert install_tail(tracer, cfg, name="other", role="broker") is ring
+    # exporter still sees finished spans (tail rides BEHIND it, not instead)
+    with tracer.start_span("op"):
+        pass
+    assert [s.name for s in tracer.finished] == ["op"]
+    # gated off by config / by tracer=None
+    off = Config(overrides={"surge.trace.tail.enabled": False})
+    assert install_tail(InMemoryTracer(), off) is None
+    assert install_tail(None, cfg) is None
+
+
+def test_late_span_of_a_kept_trace_joins_the_ring():
+    tracer, sampler, ring = make(latency_ms=0.0)
+    root = tracer.start_span("root")
+    root.finish()  # quiesces + keeps
+    assert len(ring) == 1
+    late = tracer.start_span("late", parent=root)
+    late.finish()  # a pipelined retry leg finishing after the decision
+    entries = ring.dump()["traces"]
+    assert len(entries) == 2
+    assert entries[1]["trace_id"] == root.context.trace_id
+    assert entries[1]["spans"][0]["name"] == "late"
+    # the late append reuses the original keep verdict, not a fresh budget slot
+    assert sampler.kept == 1

@@ -35,6 +35,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # trace_anatomy
 
 #: (header, merged-family, format) — the per-instance columns; families
 #: absent for a role (no slab on a broker) render as "-"
@@ -52,6 +53,45 @@ _COLUMNS = (
 )
 
 
+#: per-instance (kept-counter, dominant-leg) memo: a standing console must
+#: not open a fresh channel + DumpTraces RPC per target per frame when the
+#: target kept nothing new since the last frame (or nothing at all, ever)
+_DOM_LEG_CACHE = {}
+
+
+def _dominant_for_target(target, kept, last=64):
+    """The dominant critical-path leg of one target's tail-kept traces
+    (its DumpTraces RPC attributed in isolation) — the `dom-leg` column.
+    ``kept`` is the target's scraped ``surge_trace_kept`` counter: the RPC
+    only fires when it MOVED since the cached frame (None/0 = untraced or
+    nothing kept — no RPC at all). Returns None (rendered "-") for
+    HTTP-only targets, untraced processes, or any fetch failure: the column
+    is evidence when present, never a reason the console fails."""
+    addr = target.address
+    if not addr or addr.startswith("http") or not kept:
+        return None
+    cached = _DOM_LEG_CACHE.get(target.instance)
+    if cached is not None and cached[0] == kept:
+        return cached[1]
+    try:
+        if target.role == "engine":
+            from trace_anatomy import _engine_dump
+
+            dump = _engine_dump(addr, last)
+        else:
+            from trace_anatomy import _broker_dump
+
+            dump = _broker_dump(addr, last)
+        from surge_tpu.observability.anatomy import dominant_leg
+
+        verdict = dominant_leg([dump])
+        leg = verdict["dominant"] if verdict else None
+        _DOM_LEG_CACHE[target.instance] = (kept, leg)
+        return leg
+    except Exception:  # noqa: BLE001 — a down/untraced target shows "-"
+        return None
+
+
 def _sample_value(families, name, instance, suffix=""):
     fam = families.get(name)
     if fam is None:
@@ -62,9 +102,11 @@ def _sample_value(families, name, instance, suffix=""):
     return None
 
 
-def fleet_rows(scraper, families=None):
+def fleet_rows(scraper, families=None, anatomy=True):
     """One dict per target from the merged families: the console table's
-    data, importable for tests and scripting."""
+    data, importable for tests and scripting. ``anatomy`` adds the
+    ``dom-leg`` column — each target's dominant critical-path leg from its
+    tail-kept traces (DumpTraces RPC); "-" for HTTP/untraced/down targets."""
     if families is None:
         families = {f.name: f for f in scraper.last_merged()}
     rows = []
@@ -76,6 +118,10 @@ def fleet_rows(scraper, families=None):
                    t.instance)}
         for header, family, _fmt in _COLUMNS:
             row[header] = _sample_value(families, family, t.instance)
+        kept = _sample_value(families, "surge_trace_kept", t.instance,
+                             suffix="_total")
+        row["dom-leg"] = (_dominant_for_target(t, kept)
+                          if anatomy else None)
         rows.append(row)
     return rows
 
@@ -92,13 +138,14 @@ def _fmt(value, fmt="{}"):
 def render_table(rows, slo_status, summary) -> str:
     """The console frame as one string (testable without a TTY)."""
     headers = (["instance", "role", "up", "stale-s"]
-               + [h for h, _f, _m in _COLUMNS])
+               + [h for h, _f, _m in _COLUMNS] + ["dom-leg"])
     table = []
     for row in rows:
         table.append([
             row["instance"], row["role"], "1" if row["up"] else "0",
             _fmt(row["staleness_s"], "{:.1f}"),
-        ] + [_fmt(row[h], m) for h, _f, m in _COLUMNS])
+        ] + [_fmt(row[h], m) for h, _f, m in _COLUMNS]
+          + [_fmt(row.get("dom-leg"))])
     widths = [max(len(h), *(len(r[i]) for r in table)) if table else len(h)
               for i, h in enumerate(headers)]
     max_burn = max((s["burn_fast"] for s in slo_status), default=0.0)
@@ -122,10 +169,10 @@ def render_table(rows, slo_status, summary) -> str:
     return "\n".join(lines)
 
 
-def snapshot(scraper) -> dict:
+def snapshot(scraper, anatomy=True) -> dict:
     """One federation pass → the machine-readable console state."""
     summary = scraper.scrape_once()
-    rows = fleet_rows(scraper)
+    rows = fleet_rows(scraper, anatomy=anatomy)
     slo_status = scraper.slo.status() if scraper.slo is not None else []
     return {"summary": summary, "instances": rows, "slo": slo_status,
             "breached": (scraper.slo.breached()
@@ -143,6 +190,8 @@ def main(argv=None) -> int:
     ap.add_argument("--format", choices=["table", "json"], default="table")
     ap.add_argument("--no-slo", action="store_true",
                     help="skip SLO evaluation")
+    ap.add_argument("--no-anatomy", action="store_true",
+                    help="skip the dom-leg column (no DumpTraces RPCs)")
     args = ap.parse_args(argv)
 
     from surge_tpu.observability import (DEFAULT_SLOS, FederatedScraper,
@@ -158,7 +207,7 @@ def main(argv=None) -> int:
                                 flight=None)
     try:
         while True:
-            snap = snapshot(scraper)
+            snap = snapshot(scraper, anatomy=not args.no_anatomy)
             if args.format == "json":
                 print(json.dumps(snap, indent=None if args.once else 2))
             else:

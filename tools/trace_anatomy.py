@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""trace_anatomy — assemble tail-kept trace dumps and render the per-leg
+critical-path attribution table (the where-does-the-time-go console).
+
+Dumps come from the broker's ``DumpTraces`` log-service RPC, the engine's
+admin ``DumpTraces`` RPC, or files saved earlier (each the JSON envelope
+:meth:`surge_tpu.tracing.tail.TraceRing.dump` writes)::
+
+    python tools/trace_anatomy.py engine.json broker1.json broker2.json
+    python tools/trace_anatomy.py --broker 127.0.0.1:16001 \
+        --broker 127.0.0.1:16002 --engine 127.0.0.1:7001
+    python tools/trace_anatomy.py --broker 127.0.0.1:16001 --once --format=json
+
+Spans from different processes are placed on one timeline through each
+dump's mono↔wall header pair (skew-proof — docs/observability.md), grouped
+into whole traces, and decomposed into the named critical-path legs (entity
+mailbox wait → publisher linger → lane dispatch → broker gate wait →
+journal fsync → replication ack → reply decode → router resolve). The table
+aggregates kept COMMAND traces into per-leg p50/p99/total/share rows and
+names the dominant leg; ``--format=json`` emits the machine-readable verdict
+(scripting + the tier-1 smoke). ``--once`` is accepted for symmetry with
+surgetop (this tool is always one-shot).
+
+Exit code 0 on success (even with zero attributable traces — that is a
+finding, not a failure), 2 on bad input.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _load_file(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _broker_dump(addr: str, last) -> dict:
+    from surge_tpu.log.client import GrpcLogTransport
+
+    client = GrpcLogTransport(addr)
+    try:
+        return client.trace_dump(last)
+    finally:
+        client.close()
+
+
+def _engine_dump(addr: str, last) -> dict:
+    import asyncio
+
+    import grpc
+
+    from surge_tpu.admin.server import AdminClient
+
+    async def fetch():
+        async with grpc.aio.insecure_channel(addr) as channel:
+            return await AdminClient(channel).trace_dump(last)
+
+    return asyncio.run(fetch())
+
+
+def render_table(table: dict) -> str:
+    """The attribution table as one string (testable without a TTY)."""
+    lines = [f"command anatomy — {table['traces']} trace(s)"
+             + (f", dominant leg: {table['dominant']} "
+                f"({table['dominant_share'] * 100:.1f}% of critical path)"
+                if table["dominant"] else "")]
+    lines.append(f"{'leg':<18s} {'p50 ms':>10s} {'p99 ms':>10s} "
+                 f"{'total ms':>11s} {'share':>7s}")
+    for leg, row in table["legs"].items():
+        lines.append(f"{leg:<18s} {row['p50']:>10.3f} {row['p99']:>10.3f} "
+                     f"{row['total_ms']:>11.3f} {row['share'] * 100:>6.1f}%")
+    if table["slowest"]:
+        lines.append("")
+        lines.append("slowest kept traces:")
+        for r in table["slowest"]:
+            lines.append(f"  {r['trace_id'][:16]:<17s} "
+                         f"{r['duration_ms']:>10.3f}ms  "
+                         f"dominant: {r['dominant']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="*", help="saved trace-dump JSON files")
+    ap.add_argument("--broker", action="append", default=[], metavar="ADDR",
+                    help="live DumpTraces over the log-service RPC "
+                         "(repeatable)")
+    ap.add_argument("--engine", action="append", default=[], metavar="ADDR",
+                    help="live DumpTraces over the engine admin RPC "
+                         "(repeatable)")
+    ap.add_argument("--last", type=int, default=None,
+                    help="newest N kept traces per source")
+    ap.add_argument("--once", action="store_true",
+                    help="accepted for CLI symmetry (always one-shot)")
+    ap.add_argument("--format", choices=["table", "json"], default="table")
+    ap.add_argument("--all-traces", action="store_true",
+                    help="attribute every kept trace, not just "
+                         "command-shaped ones")
+    args = ap.parse_args(argv)
+
+    if not args.dumps and not args.broker and not args.engine:
+        print("no dump files or --broker/--engine targets", file=sys.stderr)
+        return 2
+
+    from surge_tpu.observability.anatomy import (assemble_traces,
+                                                 attribution_table)
+
+    dumps = []
+    try:
+        for path in args.dumps:
+            dumps.append(_load_file(path))
+    except (OSError, ValueError) as exc:
+        print(f"cannot read dump {path}: {exc}", file=sys.stderr)
+        return 2
+    errors = []
+    for addr in args.broker:
+        try:
+            dumps.append(_broker_dump(addr, args.last))
+        except Exception as exc:  # noqa: BLE001 — a down broker is a finding
+            errors.append(f"broker {addr}: {exc}")
+    for addr in args.engine:
+        try:
+            dumps.append(_engine_dump(addr, args.last))
+        except Exception as exc:  # noqa: BLE001 — a down engine is a finding
+            errors.append(f"engine {addr}: {exc}")
+
+    traces = assemble_traces(dumps)
+    table = attribution_table(traces, command_only=not args.all_traces)
+    if args.format == "json":
+        print(json.dumps({**table, "sources": len(dumps),
+                          "errors": errors}))
+    else:
+        for err in errors:
+            print(f"WARN: {err}", file=sys.stderr)
+        print(render_table(table))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
