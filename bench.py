@@ -1768,6 +1768,226 @@ def resident_bench() -> dict:
     return out
 
 
+def mesh_bench() -> dict:
+    """SURGE_BENCH_MESH=1: the mesh-native resident plane + sharded scans on
+    a forced 8-device host mesh (the tier-1 topology; on silicon the same
+    arms run over real chips).
+
+    Three measurements, each PAIRED + INTERLEAVED per the BENCH_NOTES round-6
+    protocol (single runs on this host swing 2-3×; only same-round pairs and
+    cross-round medians count):
+
+    1. **Capacity fold ladder** — steady-state incremental refresh throughput
+       (events/s across publish→caught-up cycles) per rung, where the RUNG IS
+       THE SLAB CAPACITY, arms = ``surge.replay.mesh.gather`` local vs
+       replicated. The refresh scatter is not donated, so every window copies
+       the slab it writes: the replicated arm copies the FULL slab on every
+       replica while the local arm copies one 1/n_dev shard each — the cost
+       that scales with the resident set. The local arm holds flat up the
+       ladder; the replicated arm collapses (that cliff is WHY multi-device
+       is the first-class path for millions of resident aggregates).
+    2. **Read row** — batched ``read_many`` projections per arm: device-local
+       gathers + ONE collective vs gathers against the replicated slab. On
+       forced host devices (shared memory, 2 vCPUs) this row sits near parity
+       — the collective costs and the locality wins cancel; on silicon the
+       replicated arm additionally pays n_dev× HBM for the slab.
+    3. **Sharded-scan row** — QueryEngine grouped-aggregate scan events/s,
+       mesh-sharded vs single-device, over the same columnar chunks.
+
+    Knobs: SURGE_BENCH_MESH_AGGREGATES (512), _ROUNDS (3), _CAP_LADDER
+    ("262144,1048576"), _FOLD_EVENTS (512 per cycle), _FOLD_CYCLES (16),
+    _READ_WORKERS (16), _READ_BATCH (256), _READ_LOOPS (2),
+    _SCAN_EVENTS (200000)."""
+    import asyncio
+    import statistics
+
+    import jax
+
+    from surge_tpu.codec.tensor import encode_events_columnar
+    from surge_tpu.config import default_config
+    from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
+    from surge_tpu.models import counter
+    from surge_tpu.replay.query import Aggregate, Predicate, QueryEngine, ScanQuery
+    from surge_tpu.replay.resident_state import ResidentStatePlane
+    from surge_tpu.serialization import SerializedMessage
+
+    devs = jax.devices()
+    assert len(devs) >= 8, (
+        f"mesh bench needs 8 forced host devices, got {len(devs)} — main() "
+        "must set xla_force_host_platform_device_count before jax init")
+    mesh = jax.sharding.Mesh(np.array(devs[:8]), ("data",))
+
+    n_agg = int(os.environ.get("SURGE_BENCH_MESH_AGGREGATES", 512))
+    rounds = max(int(os.environ.get("SURGE_BENCH_MESH_ROUNDS", 3)), 1)
+    cap_ladder = [int(x) for x in os.environ.get(
+        "SURGE_BENCH_MESH_CAP_LADDER", "262144,1048576").split(",") if x]
+    fold_events = int(os.environ.get("SURGE_BENCH_MESH_FOLD_EVENTS", 512))
+    fold_cycles = int(os.environ.get("SURGE_BENCH_MESH_FOLD_CYCLES", 16))
+    read_workers = int(os.environ.get("SURGE_BENCH_MESH_READ_WORKERS", 16))
+    read_batch = int(os.environ.get("SURGE_BENCH_MESH_READ_BATCH", 256))
+    read_loops = int(os.environ.get("SURGE_BENCH_MESH_READ_LOOPS", 2))
+    scan_events = int(os.environ.get("SURGE_BENCH_MESH_SCAN_EVENTS", 200_000))
+
+    evt_fmt = counter.event_formatting()
+    state_fmt = counter.state_formatting()
+    npart = 4
+    aggs = [f"agg-{i}" for i in range(n_agg)]
+    out: dict = {"mesh_devices": 8, "mesh_aggregates": n_agg,
+                 "mesh_rounds": rounds}
+
+    def make_plane_log():
+        seqs = {a: 0 for a in aggs}
+        log_t = InMemoryLog()
+        log_t.create_topic(TopicSpec("events", npart))
+        prod = log_t.transactional_producer("bench")
+
+        def publish(n: int) -> None:
+            prod.begin()
+            for i in range(n):
+                a = aggs[(i * 7919) % n_agg]
+                seqs[a] += 1
+                ev = counter.CountIncremented(a, 1, seqs[a])
+                prod.send(LogRecord(topic="events", key=a,
+                                    value=evt_fmt.write_event(ev).value,
+                                    partition=hash(a) % npart))
+                if i % 5000 == 4999:
+                    prod.commit()
+                    prod.begin()
+            prod.commit()
+
+        publish(n_agg * 4)  # the seed corpus
+        return log_t, publish
+
+    async def plane_arm(gather: str, cap: int, log_t, publish,
+                        measure_reads: bool):
+        """One arm at one capacity rung: steady-state fold cycles (+ the
+        read row at the first rung). Returns (fold eps, reads/s|None)."""
+        plane = ResidentStatePlane(
+            log_t, "events", counter.make_replay_spec(),
+            config=default_config().with_overrides({
+                "surge.replay.resident.capacity": cap,
+                "surge.replay.resident.refresh-interval-ms": 1,
+                "surge.replay.mesh.gather": gather,
+            }),
+            deserialize_event=lambda b: evt_fmt.read_event(
+                SerializedMessage(key="", value=b)),
+            serialize_state=lambda a, s: state_fmt.write_state(s).value,
+            mesh=mesh)
+        await plane.start()
+        try:
+            publish(fold_events)  # warm the refresh program's shape bucket
+            while plane.lag_records() > 0:
+                await asyncio.sleep(0.002)
+            t0 = time.perf_counter()
+            for _ in range(fold_cycles):
+                publish(fold_events)
+                while plane.lag_records() > 0:
+                    await asyncio.sleep(0.002)
+            eps = fold_cycles * fold_events / (time.perf_counter() - t0)
+            reads = None
+            if measure_reads:
+                async def reader(w: int) -> None:
+                    for j in range(read_loops):
+                        ids = [aggs[(w * read_batch + j * 137 + x) % n_agg]
+                               for x in range(read_batch)]
+                        got = await plane.read_many(ids)
+                        if len(got) != read_batch:
+                            raise RuntimeError("mesh projection missed")
+
+                await reader(0)  # warm the gather bucket
+                t0 = time.perf_counter()
+                await asyncio.gather(*(reader(w)
+                                       for w in range(read_workers)))
+                reads = (read_workers * read_loops * read_batch
+                         / (time.perf_counter() - t0))
+            return eps, reads
+        finally:
+            await plane.stop()
+
+    per_rung: dict = {c: {"local": [], "replicated": []} for c in cap_ladder}
+    read_rows: dict = {"local": [], "replicated": []}
+    for rnd in range(rounds):
+        order = ("replicated", "local") if rnd % 2 else ("local", "replicated")
+        for cap in cap_ladder:
+            for arm in order:
+                log_t, publish = make_plane_log()  # identical fresh log/arm
+                eps, reads = asyncio.run(plane_arm(
+                    arm, cap, log_t, publish,
+                    measure_reads=cap == cap_ladder[0]))
+                per_rung[cap][arm].append(eps)
+                if reads is not None:
+                    read_rows[arm].append(reads)
+    med = statistics.median
+    out["mesh_fold_ladder"] = [{
+        "capacity": c,
+        "events_per_cycle": fold_events,
+        "local_events_per_sec": round(med(per_rung[c]["local"])),
+        "replicated_events_per_sec": round(med(per_rung[c]["replicated"])),
+        "local_vs_replicated": round(med(per_rung[c]["local"])
+                                     / med(per_rung[c]["replicated"]), 2),
+        "local_rounds": [round(x) for x in per_rung[c]["local"]],
+        "replicated_rounds": [round(x) for x in per_rung[c]["replicated"]],
+    } for c in cap_ladder]
+    out["mesh_read_row"] = {
+        "workers": read_workers, "batch": read_batch,
+        "local_reads_per_sec": round(med(read_rows["local"])),
+        "replicated_reads_per_sec": round(med(read_rows["replicated"])),
+        "local_vs_replicated": round(med(read_rows["local"])
+                                     / med(read_rows["replicated"]), 2),
+    }
+    for r in out["mesh_fold_ladder"]:
+        log(f"capacity ladder @{r['capacity']}: local "
+            f"{r['local_events_per_sec']} vs replicated "
+            f"{r['replicated_events_per_sec']} ev/s "
+            f"({r['local_vs_replicated']}x)")
+    rr = out["mesh_read_row"]
+    log(f"read row @{read_workers}x{read_batch}: local "
+        f"{rr['local_reads_per_sec']} vs replicated "
+        f"{rr['replicated_reads_per_sec']} reads/s "
+        f"({rr['local_vs_replicated']}x)")
+
+    # -- sharded-scan throughput row (the query engine) ---------------------
+    import random as _random
+
+    rng = _random.Random(23)
+    spec = counter.make_replay_spec()
+    per_agg = max(scan_events // n_agg, 1)
+    logs = []
+    for i in range(n_agg):
+        logs.append([counter.CountIncremented(str(i), rng.randrange(1, 4),
+                                              k + 1)
+                     for k in range(per_agg)])
+    colev = encode_events_columnar(spec.registry, logs)
+    colev.aggregate_ids = [str(i) for i in range(n_agg)]
+    q = ScanQuery(aggregates=(Aggregate("count"),
+                              Aggregate("sum", "increment_by"),
+                              Aggregate("max", "sequence_number")),
+                  predicates=(Predicate("increment_by", ">=", 2),))
+    scans: dict = {"mesh": [], "single": []}
+    engines = {"mesh": QueryEngine(spec, mesh=mesh),
+               "single": QueryEngine(spec)}
+    for arm, eng in engines.items():
+        eng.scan_chunks([colev], q)  # warm/compile outside the timed rounds
+    for rnd in range(rounds):
+        order = ("single", "mesh") if rnd % 2 else ("mesh", "single")
+        for arm in order:
+            t0 = time.perf_counter()
+            res = engines[arm].scan_chunks([colev], q)
+            scans[arm].append(res.scanned_events
+                              / (time.perf_counter() - t0))
+    out["mesh_scan_row"] = {
+        "events": colev.num_events,
+        "mesh_events_per_sec": round(med(scans["mesh"])),
+        "single_events_per_sec": round(med(scans["single"])),
+        "mesh_vs_single": round(med(scans["mesh"]) / med(scans["single"]), 2),
+    }
+    sr = out["mesh_scan_row"]
+    log(f"scan row @{sr['events']}ev: mesh {sr['mesh_events_per_sec']} vs "
+        f"single {sr['single_events_per_sec']} ev/s "
+        f"({sr['mesh_vs_single']}x)")
+    return out
+
+
 def main() -> None:
     orig_env = dict(os.environ)
     # the parent NEVER initializes the tunneled backend — pin it to the host CPU
@@ -1775,6 +1995,14 @@ def main() -> None:
     os.environ.update(_cpu_env(orig_env))
     for k in ("PALLAS_AXON_POOL_IPS", "AXON_POOL_IPS"):
         os.environ.pop(k, None)
+    if os.environ.get("SURGE_BENCH_MESH", "0") == "1":
+        # the mesh arms need the tier-1 topology: force 8 host devices BEFORE
+        # the first jax backend initialization (flag changes after init are
+        # silently ignored)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
 
     num_aggregates = int(os.environ.get("SURGE_BENCH_AGGREGATES", 1_000_000))
     num_events = int(os.environ.get("SURGE_BENCH_EVENTS", 100_000_000))
@@ -1846,6 +2074,19 @@ def main() -> None:
         stats = handoff_bench()
         payload.update(stats)
         payload["value"] = stats.get("handoff_unavailability_ms_median") or 0
+        emit(payload)
+        return
+
+    # SURGE_BENCH_MESH=1: mesh-native resident plane + sharded scans —
+    # paired interleaved device-local vs replicated-slab arms (fold ladder +
+    # read row) plus the query-engine sharded-scan throughput row
+    if os.environ.get("SURGE_BENCH_MESH", "0") == "1":
+        payload = {"metric": "mesh_fold_events_per_sec", "value": 0,
+                   "unit": "events/s"}
+        stats = mesh_bench()
+        payload.update(stats)
+        payload["value"] = max(r["local_events_per_sec"]
+                               for r in stats["mesh_fold_ladder"])
         emit(payload)
         return
 

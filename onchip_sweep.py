@@ -353,22 +353,34 @@ def _run_streamed(wire, expected, segments: int) -> dict:
 
 SMOKE_CONFIGS = (
     # expected winner after the r5 redesign: dense pre-gathered tiles + the
-    # assoc tree-reduction fold + u16 single-fetch pull (all defaults)
-    dict(),
+    # assoc tree-reduction fold + u16 single-fetch pull. layout='dense' is
+    # EXPLICIT on every dense-claiming row: at smoke scale (~8M padded slots)
+    # the engine's 16M-slot _use_dense floor resolves 'auto' to flat, so the
+    # auto rows would silently duplicate their layout='flat' twins and the
+    # smoke section would never isolate dense vs flat (ADVICE r5)
+    dict(layout="dense"),
     # isolate each r5 lever against the winner
-    dict(tile="xla"),                      # dense tiles, sequential scan
+    dict(tile="xla", layout="dense"),      # dense tiles, sequential scan
     dict(tile="assoc", layout="flat"),     # per-pass gather, tree fold
     dict(tile="xla", layout="flat"),       # the r4 baseline program
     # dispatch form + pallas kernel comparison on the dense layout
-    dict(dispatch="select"),
-    dict(dispatch="select", tile="pallas"),
-    # tile geometry under assoc: pad ratio vs tile count
+    dict(dispatch="select", layout="dense"),
+    dict(dispatch="select", tile="pallas", layout="dense"),
+    # tile geometry under assoc: pad ratio vs tile count (auto layout — the
+    # geometry levers act the same either side of the dense floor)
     dict(time_chunk=64),
     dict(time_chunk=256),
     dict(batch=32768),
     # upload pipelining (the one-time cost; chunked H2D measured 25% faster)
     dict(chunk_mb=16),
 )
+
+#: _run_config's knob defaults — contender dedup keys normalize against these
+#: so a smoke 'best' row spelling every knob explicitly still collides with
+#: the all-auto dict() contender when they are the same config (ADVICE r5:
+#: the most expensive 100M-event config must not run twice)
+_RUN_CONFIG_DEFAULTS = dict(dispatch="switch", unroll=1, time_chunk=128,
+                            tile="auto", layout="auto", batch=8192, chunk_mb=0)
 
 
 def _device_fold_ceiling(corpus_dir: str) -> float | None:
@@ -470,7 +482,7 @@ def run_sweep(artifact_path: str = ARTIFACT, *,
         contenders.append(dict(tile="xla", layout="flat"))  # r4 baseline delta
         seen: set = set()
         for kw in contenders:
-            key = tuple(sorted(kw.items()))
+            key = tuple(sorted({**_RUN_CONFIG_DEFAULTS, **kw}.items()))
             if key in seen:
                 continue
             seen.add(key)

@@ -43,6 +43,13 @@ METHODS = {
     # broker DumpTraces dumps into whole command traces
     # (observability/anatomy.py). Same "last:N" tail convention as DumpFlight
     "DumpTraces": (pb.ComponentRequest, pb.MetricsReply),
+    # TPU scan engine over committed columnar segments (surge_tpu.replay.
+    # query; docs/replay.md "Query engine"). Message reuse, same as
+    # GetMetricsText: ComponentRequest.name carries the query as JSON
+    # (ScanQuery / StateQuery json forms), the result rides MetricsReply as
+    # JSON rows capped at surge.query.max-rows
+    "ScanSegments": (pb.ComponentRequest, pb.MetricsReply),
+    "QueryStates": (pb.ComponentRequest, pb.MetricsReply),
 }
 
 
@@ -210,6 +217,37 @@ class AdminServer:
             return pb.MetricsReply(metrics_json=json.dumps(
                 {"error": repr(exc)}).encode())
 
+    async def ScanSegments(self, request, context) -> pb.MetricsReply:
+        """Filter + grouped-aggregate scan over the engine's committed
+        columnar segment (predicate pushdown, per-aggregate-id grouping,
+        mesh-sharded on device). ``request.name`` is the ScanQuery JSON."""
+        return await self._run_query(request, states=False)
+
+    async def QueryStates(self, request, context) -> pb.MetricsReply:
+        """Fold-then-filter state query over the committed segment (state
+        column predicates + projection). ``request.name`` is the StateQuery
+        JSON."""
+        return await self._run_query(request, states=True)
+
+    async def _run_query(self, request, states: bool) -> pb.MetricsReply:
+        try:
+            q = json.loads(request.name or "{}")
+            result = await (self.engine.query_states(q) if states
+                            else self.engine.query(q))
+            cap = self.engine.config.get_int("surge.query.max-rows", 10_000)
+            return pb.MetricsReply(metrics_json=json.dumps({
+                "rows": result.rows(limit=cap),
+                "num_aggregates": result.num_aggregates,
+                "scanned_events": result.scanned_events,
+                "matched_events": result.matched_events,
+                "chunks": result.chunks,
+                "truncated": result.num_aggregates > cap,
+                "elapsed_ms": round(result.elapsed_s * 1000.0, 3),
+            }).encode())
+        except Exception as exc:  # noqa: BLE001 — operator gets the failure back
+            return pb.MetricsReply(metrics_json=json.dumps(
+                {"error": repr(exc)}).encode())
+
     async def StopEngine(self, request, context) -> pb.ComponentReply:
         try:
             await self.engine.stop()
@@ -305,6 +343,28 @@ class AdminClient:
     async def fault_stats(self) -> dict:
         r = await self._calls["ArmFaults"](pb.ComponentRequest(name="status"))
         return json.loads(r.metrics_json)
+
+    async def scan_segments(self, query: dict) -> dict:
+        """Run a ScanQuery (json form) through the engine's scan engine over
+        its committed columnar segment; returns the rows payload (capped at
+        surge.query.max-rows, ``truncated`` flags the cap). Raises
+        RuntimeError on a refused/failed query."""
+        r = await self._calls["ScanSegments"](
+            pb.ComponentRequest(name=json.dumps(query)))
+        payload = json.loads(r.metrics_json)
+        if "error" in payload and "rows" not in payload:
+            raise RuntimeError(payload["error"])
+        return payload
+
+    async def query_states(self, query: dict) -> dict:
+        """Run a StateQuery (json form): fold-then-filter over state columns
+        with projection; same payload/caps as :meth:`scan_segments`."""
+        r = await self._calls["QueryStates"](
+            pb.ComponentRequest(name=json.dumps(query)))
+        payload = json.loads(r.metrics_json)
+        if "error" in payload and "rows" not in payload:
+            raise RuntimeError(payload["error"])
+        return payload
 
     async def stop_engine(self) -> tuple[bool, str]:
         r = await self._calls["StopEngine"](pb.Empty())

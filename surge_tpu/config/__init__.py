@@ -202,6 +202,27 @@ DEFAULTS: dict[str, Any] = {
     # object build per event. false = the per-event Python feed (the paired
     # bench arm; also the behavior when the model wires no batch decoder)
     "surge.replay.resident.native-feed": True,
+    # --- mesh-native resident plane (surge_tpu.replay.plane_mesh) ---
+    # how a mesh-backed plane resolves reads/folds against its sharded slab:
+    # "local" (default) shards the slab [n_dev, rows] and answers each
+    # batched read with device-local gathers + ONE cross-device collective,
+    # with refresh rounds dealing lanes to their owning shard (one sharded
+    # h2d, zero d2h, 1/n_dev fold work per device); "replicated" keeps the
+    # legacy plain-jit programs whose gathers replicate the slab every read
+    # (the paired-bench baseline arm and the rollback switch)
+    "surge.replay.mesh.gather": "local",  # local | replicated
+    # --- TPU scan engine over columnar segments (surge_tpu.replay.query) ---
+    # event-axis pad bucket of one scan dispatch: chunks pad up to
+    # power-of-two buckets at least this large so streamed chunks reuse a
+    # handful of compiled scan programs
+    "surge.query.chunk-events": 65536,
+    # shard the scan's event axis over the engine's mesh (one psum/pmin/pmax
+    # collective per output column); false scans single-device even when a
+    # mesh is present
+    "surge.query.mesh": True,
+    # row cap of one QueryStates/ScanSegments RPC reply (the full columns are
+    # available in-process through SurgeEngine.query)
+    "surge.query.max-rows": 10_000,
     # --- state checkpoints (surge_tpu.store.checkpoint; compaction.md) ---
     # directory for atomic checkpoint files ("" disables the writer); the
     # incremental writer materializes on interval + min-events cadence and

@@ -738,24 +738,64 @@ class SurgeEngine(Controllable):
         segment if absent (always covering EVERY partition — it is a shared
         artifact), then stream-restore only this node's ``owned`` partitions'
         chunks from it."""
+        from surge_tpu.store.restore import restore_from_segment
+
+        state_fmt = self.logic.state_format
+        self._ensure_segment(segment_path, spec)
+        return restore_from_segment(
+            segment_path, self.indexer.store, replay_spec=spec,
+            serialize_state=lambda agg_id, st: state_fmt.write_state(st).value,
+            decode_state=getattr(self.logic, "decode_state", None),
+            config=self.config, mesh=mesh, partitions=owned)
+
+    def _ensure_segment(self, segment_path: str, spec) -> None:
+        """Build the columnar segment if absent (covering EVERY partition — it
+        is a shared artifact), else auto-extend it with the post-build delta.
+        Blocking; callers run it in the executor. Shared by the segment
+        restore and the query engine (both scan committed chunks)."""
         import os
 
         from surge_tpu.log.columnar import build_segment_from_topic
-        from surge_tpu.store.restore import restore_from_segment
 
         evt_fmt = self.logic.event_format
-        state_fmt = self.logic.state_format
         if not os.path.exists(segment_path):
-            # build to a temp path and rename: a crash mid-build must not leave a
-            # partial file that later cold starts would silently restore from
-            tmp_path = segment_path + ".building"
-            build_segment_from_topic(
-                self.log, self.logic.events_topic, spec.registry,
-                evt_fmt.read_event, tmp_path,
-                encode_event=getattr(self.logic, "encode_event", None),
-                derived_cols=getattr(self.logic, "derived_cols", None),
-                state_topic=self.logic.state_topic)
-            os.replace(tmp_path, segment_path)
+            # build to a UNIQUE temp path and rename: a crash mid-build must
+            # not leave a partial file later cold starts would silently
+            # restore from, and two concurrent builders (queries racing the
+            # first build) must never interleave writes into one tmp file —
+            # each builds a complete segment and the atomic os.replace makes
+            # the last one win whole (a duplicate build is wasted work, never
+            # corruption)
+            import glob
+            import time as _time
+            import uuid
+
+            # sweep partials orphaned by a hard-killed builder (the unique
+            # names never self-heal by overwrite); the age guard protects a
+            # concurrent builder's live tmp file
+            for stale in glob.glob(f"{segment_path}.building.*"):
+                try:
+                    if _time.time() - os.path.getmtime(stale) > 600:
+                        os.unlink(stale)
+                        logger.warning("removed stale segment build %s", stale)
+                except OSError:
+                    pass
+            tmp_path = f"{segment_path}.building.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+            try:
+                build_segment_from_topic(
+                    self.log, self.logic.events_topic, spec.registry,
+                    evt_fmt.read_event, tmp_path,
+                    encode_event=getattr(self.logic, "encode_event", None),
+                    derived_cols=getattr(self.logic, "derived_cols", None),
+                    state_topic=self.logic.state_topic)
+                os.replace(tmp_path, segment_path)
+            finally:
+                # a failed build's uniquely-named partial must not linger
+                if os.path.exists(tmp_path):
+                    try:
+                        os.unlink(tmp_path)
+                    except OSError:
+                        pass
         elif self.config.get_bool("surge.replay.segment-auto-extend", True):
             # incremental maintenance: append delta chunks/snapshots for offsets
             # past the segment's watermarks so THIS restore (and the next one)
@@ -795,11 +835,89 @@ class SurgeEngine(Controllable):
                 finally:
                     os.close(fd)
                     os.unlink(lock_path)
-        return restore_from_segment(
-            segment_path, self.indexer.store, replay_spec=spec,
-            serialize_state=lambda agg_id, st: state_fmt.write_state(st).value,
-            decode_state=getattr(self.logic, "decode_state", None),
-            config=self.config, mesh=mesh, partitions=owned)
+
+    # -- query engine (TPU scans over committed columnar segments) ----------------------
+
+    @property
+    def query_engine(self):
+        """Lazily-built :class:`surge_tpu.replay.query.QueryEngine` for this
+        family (mesh-aware: scans shard their event axis over the replay
+        mesh). The analytics half of the KTable analogy — docs/replay.md
+        "Query engine"."""
+        eng = getattr(self, "_query_engine", None)
+        if eng is None:
+            from surge_tpu.replay.query import QueryEngine
+
+            eng = self._query_engine = QueryEngine(
+                self.logic.replay_spec(), config=self.config,
+                mesh=self._resolve_mesh())
+        return eng
+
+    def _segment_path_for_query(self) -> str:
+        path = self.config.get_str("surge.replay.segment-path", "")
+        if not path:
+            raise ValueError(
+                "query requires surge.replay.segment-path (the committed "
+                "columnar segment the scan engine reads)")
+        return path
+
+    async def query(self, query, partitions=None):
+        """Run a :class:`~surge_tpu.replay.query.ScanQuery` (or its JSON dict
+        form) over the committed columnar segment: predicate-pushdown filter +
+        grouped aggregates keyed by aggregate id, batched (and mesh-sharded)
+        on device. Builds/extends the segment first if needed; the whole scan
+        runs in the executor — the event loop keeps serving commands."""
+        from surge_tpu.replay.query import ScanQuery
+
+        if isinstance(query, dict):
+            query = ScanQuery.from_json(query)
+        path = self._segment_path_for_query()
+        spec = self.logic.replay_spec()
+        loop = asyncio.get_running_loop()
+
+        def run():
+            self._ensure_segment(path, spec)
+            return self.query_engine.scan_segment(
+                path, query,
+                partitions=set(partitions) if partitions is not None else None)
+
+        result = await loop.run_in_executor(None, run)
+        self.metrics.query_scan_timer.record_ms(result.elapsed_s * 1000.0)
+        self.metrics.query_scanned_events.record(result.scanned_events)
+        self.metrics.query_result_rows.record(result.num_aggregates)
+        return result
+
+    async def query_states(self, query, partitions=None):
+        """Run a :class:`~surge_tpu.replay.query.StateQuery` (or its JSON dict
+        form): fold the segment's chunks to current aggregate state through
+        the (mesh-aware) replay engine, filter on state columns, project
+        ``select``. The "every matching aggregate's current state" read the
+        per-key store cannot answer without a full scan."""
+        from surge_tpu.replay.query import StateQuery
+
+        if isinstance(query, dict):
+            query = StateQuery.from_json(query)
+        path = self._segment_path_for_query()
+        spec = self.logic.replay_spec()
+        loop = asyncio.get_running_loop()
+
+        def run():
+            self._ensure_segment(path, spec)
+            from surge_tpu.replay import ReplayEngine
+
+            reng = getattr(self, "_query_replay_engine", None)
+            if reng is None:
+                reng = self._query_replay_engine = ReplayEngine(
+                    spec, config=self.config, mesh=self._resolve_mesh())
+            return self.query_engine.query_states_segment(
+                path, query, reng,
+                partitions=set(partitions) if partitions is not None else None)
+
+        result = await loop.run_in_executor(None, run)
+        self.metrics.query_scan_timer.record_ms(result.elapsed_s * 1000.0)
+        self.metrics.query_scanned_events.record(result.scanned_events)
+        self.metrics.query_result_rows.record(result.num_aggregates)
+        return result
 
 
 class EngineNotRunningError(Exception):

@@ -261,16 +261,25 @@ class ColumnarSegmentWriter:
 
 
 def read_segment(path: str,
-                 partitions: Optional[set] = None) -> Iterator[ColumnarEvents]:
+                 partitions: Optional[set] = None,
+                 columns: Optional[Iterable[str]] = None
+                 ) -> Iterator[ColumnarEvents]:
     """Stream the segment's chunks back as ColumnarEvents (zero-copy frombuffer
     views over the decompressed column bytes). ``partitions`` keeps only chunks
     whose recorded source partition is in the set — chunks without partition
     metadata (pre-scoping segments) always pass, and their payloads are seeked
-    past, not decompressed, when filtered out."""
+    past, not decompressed, when filtered out.
+
+    ``columns`` is the query engine's projection pushdown: when given, only
+    those union columns (plus the structural ``agg_idx``/``type_ids`` and the
+    id payload) are decompressed — every other column payload is seeked past.
+    The yielded chunks then carry exactly the projected ``cols``; callers that
+    need the full schema must not pass ``columns``."""
     import os as _os
 
     if partitions is not None:
         partitions = {int(p) for p in partitions}
+    wanted = None if columns is None else set(columns)
     with open(path, "rb") as f:
         size = _os.fstat(f.fileno()).st_size
         head = f.read(8)
@@ -319,6 +328,11 @@ def read_segment(path: str,
                          else dict(derived))
             arrays = {}
             for name, codec, stored_len, raw_len in meta["cols"]:
+                if (wanted is not None
+                        and name not in ("agg_idx", "type_ids")
+                        and name not in wanted):
+                    f.seek(stored_len, 1)  # projected out: never decompressed
+                    continue
                 dtype = (c_agg if name == "agg_idx"
                          else c_type if name == "type_ids"
                          else c_cols[name])

@@ -256,6 +256,11 @@ class EngineMetrics:
     resident_gather_batch: Sensor = field(init=False)
     resident_fallbacks: Sensor = field(init=False)
     resident_evictions: Sensor = field(init=False)
+    # TPU scan engine over columnar segments (surge_tpu.replay.query): the
+    # analytics plane's scan cadence and coverage
+    query_scan_timer: Timer = field(init=False)
+    query_scanned_events: Sensor = field(init=False)
+    query_result_rows: Sensor = field(init=False)
     # log compaction + state checkpoints (surge_tpu.log.compactor /
     # surge_tpu.store.checkpoint — the bounded-cold-start subsystem)
     compaction_runs: Sensor = field(init=False)
@@ -402,6 +407,18 @@ class EngineMetrics:
             "surge.replay.resident.evictions",
             "aggregates evicted from the slab to the host spill "
             "(capacity pressure)"))
+        self.query_scan_timer = m.timer(MI(
+            "surge.query.scan-timer",
+            "ms per segment scan / state query (device dispatch + the one "
+            "result pull; mesh scans add one collective per output column)"))
+        self.query_scanned_events = m.counter(MI(
+            "surge.query.scanned-events",
+            "events scanned by the query engine (projection pushdown means "
+            "untouched columns were never decompressed)"))
+        self.query_result_rows = m.gauge(MI(
+            "surge.query.result-rows",
+            "aggregates in the last query result (post-filter, pre-RPC "
+            "surge.query.max-rows cap)"))
         self.compaction_runs = m.counter(MI(
             "surge.log.compaction.runs", "partition compaction passes"))
         self.compaction_bytes_reclaimed = m.counter(MI(

@@ -21,9 +21,19 @@ from surge_tpu.replay.engine import (
     make_batch_fold,
 )
 from surge_tpu.replay.mixed import MixedReplay, combine_replay_specs
+from surge_tpu.replay.query import (
+    Aggregate,
+    Predicate,
+    QueryEngine,
+    QueryResult,
+    ScanQuery,
+    StateQuery,
+)
 from surge_tpu.replay.resident_state import ResidentStatePlane
 from surge_tpu.replay.seqpar import AssociativeFold, replay_time_sharded
 
 __all__ = ["ReplayEngine", "ReplayResult", "ResidentWire", "MixedReplay",
            "combine_replay_specs", "AssociativeFold", "replay_time_sharded",
-           "make_step_fn", "make_batch_fold", "ResidentStatePlane"]
+           "make_step_fn", "make_batch_fold", "ResidentStatePlane",
+           "QueryEngine", "ScanQuery", "StateQuery", "Predicate", "Aggregate",
+           "QueryResult"]

@@ -140,7 +140,16 @@ _WIRE_GUARD_MIN = 8192
 
 #: t_base sentinel marking a dense work-list padding entry: past every real
 #: lane length (lengths are int32 event counts ≪ 2^29) yet small enough that
-#: start+t arithmetic stays far from int32 overflow
+#: start+t arithmetic stays far from int32 overflow. Ordinal arithmetic has
+#: the same shape: the fold body computes ``ord_base + t_base`` (pallas) or
+#: ``ord_base + t + 1`` per step (xla/assoc), and ``ord_base`` is itself an
+#: int32 already-folded event count < 2^29, so a sentinel tile's derived
+#: ordinals reach at most 2^30 + width — still far from int32 overflow. A
+#: resumed ``ordinal_base`` ABOVE 2^30 would wrap in a sentinel tile, but
+#: every sentinel slot decodes under a False mask (t ≥ lens for all lanes),
+#: so the wrapped value is provably never folded; the pallas branch clamps
+#: the sentinel before the add anyway so its ord_rel input stays in-range
+#: (see _make_fold_body).
 _NOOP_TILE_T = np.int32(1 << 29)
 
 
@@ -179,9 +188,20 @@ def _make_fold_body(spec: ReplaySpec, wire: WireFormat, width: int, bs: int,
 
     def fold_body(carry, words, sides, lens, ord_base, t_base):
         if pallas_scan is not None:
-            # the dense scan as a VMEM-resident kernel (relative time)
+            # the dense scan as a VMEM-resident kernel (relative time).
+            # t_base is clamped before the ordinal add: a _NOOP_TILE_T
+            # sentinel tile (dense-layout work-list padding) would otherwise
+            # push ord_base + t_base past 2^30, wrapping int32 for resumed
+            # ordinal bases above ~2^30 — harmless (every sentinel slot masks
+            # to padding via the hugely-negative lens - t_base) but the clamp
+            # keeps the kernel's ord_rel input in-range by construction:
+            # ord_base (< 2^29) + the clamped sentinel (2^29 - 1) < 2^30.
+            # Real tiles always have t_base < max lane length ≪ 2^29, so the
+            # clamp is the identity for every tile that folds anything.
+            t_ord = jnp.minimum(jnp.asarray(t_base, jnp.int32),
+                                jnp.int32((1 << 29) - 1))
             return pallas_scan(carry, words, sides, lens - t_base,
-                               ord_base + t_base)
+                               ord_base + t_ord)
 
         if afold is not None:
             # no scan at all: lift every slot of the [width, bs] tile at once,

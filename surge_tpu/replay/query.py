@@ -1,0 +1,840 @@
+"""TPU scan engine over committed columnar segments — the analytics plane.
+
+PAPER.md's KTable analogy has two halves: Surge materializes per-aggregate
+STATE (the resident plane serves that), but the reference never had the other
+half — analytical reads over the event log itself. ``log/columnar.py`` already
+stores committed events as struct-of-arrays chunks, which is exactly the
+layout a vectorized scan wants: this module runs projection / filter /
+grouped-aggregation queries over those chunks as batched device programs,
+turning the event store into a real-time analytics plane no JVM Surge
+deployment could offer (ROADMAP item 4).
+
+Design:
+
+- **Predicate pushdown on typed columns.** A :class:`ScanQuery` carries
+  conjunctive predicates over the union event columns (plus ``type_id`` and an
+  event-type name filter); the segment reader is told exactly which columns
+  the query touches, so untouched column payloads are *seeked past, never
+  decompressed* (``read_segment(columns=...)``) — and inside the device
+  program the predicate mask is fused into the segment reduce, so filtered
+  events cost a compare, not a branch.
+- **Grouped aggregates keyed by aggregate id.** ``count | sum | min | max``
+  per aggregate via one segment-reduce (``.at[agg_idx].add/min/max``) over the
+  flat event axis — no per-aggregate padding, no [B, T] batch materialization.
+  Chunks cover disjoint aggregate ranges (the columnar-segment contract), so
+  chunk results concatenate.
+- **Mesh-sharded scans.** With a mesh, the EVENT axis shards across devices
+  (``shard_map``): each device reduces its slice into full per-aggregate
+  partials, then ONE collective per output (psum / pmin / pmax) replicates the
+  result — the scan scales with devices and only ``[B]``-sized partials cross
+  the interconnect.
+- **Bucketed shapes.** Event and aggregate axes pad to power-of-two buckets
+  (events at least ``surge.query.chunk-events``), so a steady stream of
+  different-sized chunks reuses a handful of compiled programs.
+- **Exactness contract.** Arithmetic happens in the DEVICE dtype of each
+  column (with x64 off an int64 column reduces in int32); the numpy host
+  reference (:func:`scan_reference`) mirrors that bit for bit, and the
+  query-engine tests hold device == reference on every op. Aggregates with
+  zero matched events report 0 for every output (the ``count`` column, always
+  present, is the tell).
+
+Served through ``SurgeEngine.query()`` / ``query_states()`` and the admin
+``ScanSegments`` / ``QueryStates`` RPCs (docs/replay.md "Query engine").
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from surge_tpu.codec.tensor import ColumnarEvents
+from surge_tpu.config import Config, default_config
+
+__all__ = ["Predicate", "Aggregate", "ScanQuery", "StateQuery", "QueryResult",
+           "QueryEngine", "scan_reference", "state_query_reference"]
+
+#: comparison ops a predicate may use (conjunctive; applied on device)
+_OPS = ("==", "!=", "<", "<=", ">", ">=")
+
+
+@dataclass(frozen=True)
+class Predicate:
+    """One comparison over a typed event column (or ``type_id``)."""
+
+    column: str
+    op: str
+    value: float
+
+    def __post_init__(self) -> None:
+        if self.op not in _OPS:
+            raise ValueError(f"unknown predicate op {self.op!r} (one of {_OPS})")
+
+    def as_json(self) -> dict:
+        return {"column": self.column, "op": self.op, "value": self.value}
+
+
+@dataclass(frozen=True)
+class Aggregate:
+    """One grouped aggregate: ``count`` (no column) or ``sum|min|max`` over a
+    column. Output column name: ``count`` / ``<op>_<column>``."""
+
+    op: str
+    column: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.op not in ("count", "sum", "min", "max"):
+            raise ValueError(f"unknown aggregate op {self.op!r}")
+        if self.op != "count" and not self.column:
+            raise ValueError(f"aggregate {self.op!r} needs a column")
+
+    @property
+    def name(self) -> str:
+        return "count" if self.op == "count" else f"{self.op}_{self.column}"
+
+    def as_json(self) -> dict:
+        out: dict = {"op": self.op}
+        if self.column:
+            out["column"] = self.column
+        return out
+
+
+@dataclass(frozen=True)
+class ScanQuery:
+    """Filter + grouped-aggregate scan over event columns, keyed by aggregate.
+
+    ``event_types`` filters by event CLASS name (resolved to type ids against
+    the registry — the typed pushdown the wire format makes free); predicates
+    are conjunctive. A ``count`` output is always computed even when not
+    requested, so zero-match aggregates are distinguishable."""
+
+    aggregates: Tuple[Aggregate, ...]
+    predicates: Tuple[Predicate, ...] = ()
+    event_types: Optional[Tuple[str, ...]] = None
+
+    def as_json(self) -> dict:
+        out: dict = {"aggregates": [a.as_json() for a in self.aggregates],
+                     "predicates": [p.as_json() for p in self.predicates]}
+        if self.event_types is not None:
+            out["event_types"] = list(self.event_types)
+        return out
+
+    @classmethod
+    def from_json(cls, d: Mapping[str, Any]) -> "ScanQuery":
+        return cls(
+            aggregates=tuple(Aggregate(a["op"], a.get("column"))
+                             for a in d.get("aggregates", ())),
+            predicates=tuple(Predicate(p["column"], p["op"], p["value"])
+                             for p in d.get("predicates", ())),
+            event_types=(tuple(d["event_types"])
+                         if d.get("event_types") is not None else None))
+
+    def columns_needed(self) -> List[str]:
+        """Every stored union column this query touches — the projection the
+        segment reader pushes down (``type_id`` / ``type_ids`` ride the chunk
+        header columns and cost nothing extra, for predicates AND
+        aggregates)."""
+        cols: List[str] = []
+        for p in self.predicates:
+            if p.column not in cols and p.column != "type_id":
+                cols.append(p.column)
+        for a in self.aggregates:
+            if a.column and a.column not in cols and a.column != "type_id":
+                cols.append(a.column)
+        return cols
+
+    def signature(self) -> tuple:
+        """Hashable program-cache key: everything that changes the compiled
+        scan (values are traced, so they are NOT part of the key — except
+        each value's integrality, which picks the comparison dtype)."""
+        return (tuple((p.column, p.op, _is_integral(p.value))
+                      for p in self.predicates),
+                tuple((a.op, a.column) for a in self.aggregates),
+                self.event_types is not None)
+
+
+@dataclass(frozen=True)
+class StateQuery:
+    """Projection + filter over FOLDED aggregate state columns: the segment's
+    chunks fold through the (mesh-aware) replay engine, then predicates run
+    over the resulting state columns and ``select`` projects the output."""
+
+    select: Optional[Tuple[str, ...]] = None
+    predicates: Tuple[Predicate, ...] = ()
+    limit: Optional[int] = None
+
+    def as_json(self) -> dict:
+        out: dict = {"predicates": [p.as_json() for p in self.predicates]}
+        if self.select is not None:
+            out["select"] = list(self.select)
+        if self.limit is not None:
+            out["limit"] = self.limit
+        return out
+
+    @classmethod
+    def from_json(cls, d: Mapping[str, Any]) -> "StateQuery":
+        return cls(
+            select=(tuple(d["select"]) if d.get("select") is not None
+                    else None),
+            predicates=tuple(Predicate(p["column"], p["op"], p["value"])
+                             for p in d.get("predicates", ())),
+            limit=d.get("limit"))
+
+
+@dataclass
+class QueryResult:
+    """Grouped scan output: per-aggregate columns in chunk order."""
+
+    aggregate_ids: Optional[List[str]]
+    columns: Dict[str, np.ndarray]
+    num_aggregates: int
+    scanned_events: int
+    matched_events: int
+    chunks: int
+    elapsed_s: float = 0.0
+
+    def rows(self, limit: Optional[int] = None) -> List[dict]:
+        """Row-oriented view (the RPC payload shape): one dict per aggregate."""
+        names = list(self.columns)
+        ids = (self.aggregate_ids if self.aggregate_ids is not None
+               else [str(i) for i in range(self.num_aggregates)])
+        n = self.num_aggregates if limit is None else min(limit,
+                                                          self.num_aggregates)
+        cols = [self.columns[k][:n].tolist() for k in names]
+        return [{"aggregate_id": ids[j],
+                 **{k: cols[i][j] for i, k in enumerate(names)}}
+                for j in range(n)]
+
+
+def _pow2(n: int, lo: int) -> int:
+    cap = lo
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _is_integral(v) -> bool:
+    """Whether a predicate value is exactly an integer (picks the compare
+    dtype: fractional values against integer columns compare in f32 —
+    truncating them to the column dtype would corrupt <=/>=/==/!=)."""
+    try:
+        return float(v).is_integer()
+    except (TypeError, ValueError):
+        return True
+
+
+def _apply_op_np(col, op: str, value):
+    if op == "==":
+        return col == value
+    if op == "!=":
+        return col != value
+    if op == "<":
+        return col < value
+    if op == "<=":
+        return col <= value
+    if op == ">":
+        return col > value
+    return col >= value
+
+
+def _sentinel(op: str, dt: np.dtype):
+    """The identity element min/max partials carry until normalization."""
+    if op == "min":
+        return np.finfo(dt).max if dt.kind == "f" else np.iinfo(dt).max
+    return np.finfo(dt).min if dt.kind == "f" else np.iinfo(dt).min
+
+
+def _normalize_zero_match(out: Dict[str, np.ndarray], query: ScanQuery
+                          ) -> Dict[str, np.ndarray]:
+    """Zero-match aggregates report 0 everywhere: min/max sentinels flip to 0
+    (the always-present ``count`` column is the tell; sum/count are already
+    0). Runs ONCE, after any cross-chunk merge."""
+    count = out["count"]
+    for a in query.aggregates:
+        if a.op in ("min", "max"):
+            col = out[a.name]
+            out[a.name] = np.where(count > 0, col, np.zeros((), col.dtype))
+    return out
+
+
+def _merge_scan_outputs(collected, query: ScanQuery, saw_ids: bool,
+                        has_dup: bool, seen: Dict[str, int]):
+    """Combine per-chunk RAW scan outputs into the final grouped columns.
+
+    Disjoint chunks (the common case, detected while streaming) concatenate;
+    chunks repeating an aggregate id — auto-extended segments append delta
+    chunks continuing base-chunk aggregates — MERGE into one row per id
+    (count/sum add, min/max combine over the sentinel-carrying partials).
+    Returns ``(aggregate_ids | None, columns)`` post-normalization."""
+    agg_specs = [(a.op, a.name) for a in query.aggregates if a.op != "count"]
+    if not (saw_ids and has_dup):
+        parts: Dict[str, List[np.ndarray]] = {}
+        ids: List[str] = []
+        for ids_c, out in collected:
+            for name, col in out.items():
+                parts.setdefault(name, []).append(col)
+            if saw_ids:
+                ids.extend(ids_c)
+        columns = {name: (np.concatenate(arrs) if arrs
+                          else np.zeros((0,), np.int32))
+                   for name, arrs in parts.items()}
+        if not columns:
+            columns = {"count": np.zeros((0,), np.int32)}
+        return (ids if saw_ids else None,
+                _normalize_zero_match(columns, query))
+    b = len(seen)
+    columns = {"count": np.zeros((b,), np.int32)}
+    for ids_c, out in collected:
+        if not ids_c:
+            continue
+        idxs = np.fromiter((seen[a] for a in ids_c), dtype=np.int64,
+                           count=len(ids_c))
+        np.add.at(columns["count"], idxs, out["count"])
+        for op, name in agg_specs:
+            col = out[name]
+            if name not in columns:
+                init = (0 if op == "sum"
+                        else _sentinel(op, np.dtype(col.dtype)))
+                columns[name] = np.full((b,), init, dtype=col.dtype)
+            if op == "sum":
+                np.add.at(columns[name], idxs, col)
+            elif op == "min":
+                np.minimum.at(columns[name], idxs, col)
+            else:
+                np.maximum.at(columns[name], idxs, col)
+    order = [None] * b
+    for a, i in seen.items():
+        order[i] = a
+    return order, _normalize_zero_match(columns, query)
+
+
+class QueryEngine:
+    """Batched (optionally mesh-sharded) scan executor for one model family.
+
+    One engine caches compiled scan programs per (query signature, shape
+    bucket); chunks stream through :meth:`scan_chunks` /
+    :meth:`scan_segment`. ``mesh`` shards the event axis; without one the
+    same program runs single-device."""
+
+    def __init__(self, spec, config: Config | None = None, mesh=None,
+                 mesh_axis: Optional[str] = None) -> None:
+        self.spec = spec
+        self.registry = spec.registry
+        self.config = config or default_config()
+        self.mesh = mesh if self.config.get_bool("surge.query.mesh", True) \
+            else None
+        if mesh_axis is None:
+            mesh_axis = (self.config.get_str("surge.replay.mesh-axes", "data")
+                         .split(",")[0].strip() or "data")
+        self.mesh_axis = mesh_axis
+        # normalized to a power of two: the raw knob value seeds the bucket
+        # ladder, and a non-pow2 floor would produce buckets no device count
+        # divides (shard_map rejects the event-axis sharding outright)
+        self._event_bucket = _pow2(max(
+            self.config.get_int("surge.query.chunk-events", 65536), 1), 1024)
+        self._programs: dict = {}
+        self._col_dtypes = {f.name: np.dtype(f.dtype)
+                            for f in self.registry.union_columns()}
+        self._type_ids = {s.cls.__name__: s.type_id
+                          for s in self.registry.event_schemas}
+        self.stats = {"scans": 0, "chunks": 0, "scanned_events": 0,
+                      "matched_events": 0}
+
+    # -- helpers ------------------------------------------------------------------------
+
+    def _n_dev(self) -> int:
+        return 1 if self.mesh is None else int(np.prod(self.mesh.devices.shape))
+
+    def resolve_type_ids(self, names: Sequence[str]) -> np.ndarray:
+        try:
+            return np.asarray(sorted(self._type_ids[n] for n in names),
+                              dtype=np.int32)
+        except KeyError as exc:
+            raise ValueError(
+                f"unknown event type {exc.args[0]!r} (registry has "
+                f"{sorted(self._type_ids)})") from None
+
+    def _device_dtype(self, dt: np.dtype):
+        """The dtype a column actually reduces in on device: with
+        jax_enable_x64 off (the default) 64-bit columns canonicalize to their
+        32-bit kin — the host reference mirrors this exactly."""
+        import jax
+
+        if not jax.config.read("jax_enable_x64") and dt.itemsize == 8:
+            return np.dtype(np.int32 if dt.kind in "iu" else np.float32)
+        return dt
+
+    def _materialize_columns(self, colev: ColumnarEvents,
+                             needed: Sequence[str]) -> Dict[str, np.ndarray]:
+        """The query's columns from a chunk, deriving declared-derived ones
+        (an ``ordinal`` column is positional — synthesized from agg_idx, the
+        exact inverse of ``columnar._drop_derived``'s verification)."""
+        out: Dict[str, np.ndarray] = {}
+        for name in needed:
+            col = colev.cols.get(name)
+            if col is not None:
+                out[name] = col
+                continue
+            kind = colev.derived_cols.get(name)
+            if kind != "ordinal":
+                raise ValueError(
+                    f"query references column {name!r} which the chunk "
+                    f"neither stores nor derives (has "
+                    f"{sorted(colev.cols) + sorted(colev.derived_cols)})")
+            n = colev.num_events
+            starts = np.zeros(colev.num_aggregates + 1, dtype=np.int64)
+            np.cumsum(np.bincount(colev.agg_idx,
+                                  minlength=colev.num_aggregates),
+                      out=starts[1:])
+            dt = self._col_dtypes.get(name, np.dtype(np.int32))
+            out[name] = (np.arange(n, dtype=np.int64)
+                         - starts[colev.agg_idx] + 1).astype(dt)
+        return out
+
+    # -- the device program -------------------------------------------------------------
+
+    def _program(self, query: ScanQuery, n_bucket: int, b_bucket: int,
+                 col_names: Tuple[str, ...]):
+        key = (query.signature(), n_bucket, b_bucket, col_names)
+        hit = self._programs.get(key)
+        if hit is not None:
+            return hit
+        import jax
+        import jax.numpy as jnp
+
+        dev_dts = {n: self._device_dtype(self._col_dtypes.get(
+            n, np.dtype(np.int32))) for n in col_names}
+        preds = tuple((p.column, p.op, _is_integral(p.value))
+                      for p in query.predicates)
+        aggs = tuple((a.op, a.column, a.name) for a in query.aggregates)
+        has_types = query.event_types is not None
+
+        def local_scan(agg_idx, type_ids, valid, pred_vals, type_allow, cols):
+            mask = valid
+            if has_types:
+                # few allowed ids: an OR of compares beats a gather-based
+                # isin and fuses into the same elementwise pass
+                hit_t = jnp.zeros_like(mask)
+                for j in range(type_allow.shape[0]):
+                    hit_t = hit_t | (type_ids == type_allow[j])
+                mask = mask & hit_t
+            for j, (cname, op, integral) in enumerate(preds):
+                col = type_ids if cname == "type_id" else cols[cname]
+                if not integral and not jnp.issubdtype(col.dtype,
+                                                       jnp.floating):
+                    # fractional value vs integer column: compare in f32
+                    # (exact for |values| < 2^24) — truncating the value to
+                    # the column dtype would corrupt <=/>=/==/!=
+                    col = col.astype(jnp.float32)
+                    v = pred_vals[j].astype(jnp.float32)
+                else:
+                    v = pred_vals[j].astype(col.dtype)
+                if op == "==":
+                    mask = mask & (col == v)
+                elif op == "!=":
+                    mask = mask & (col != v)
+                elif op == "<":
+                    mask = mask & (col < v)
+                elif op == "<=":
+                    mask = mask & (col <= v)
+                elif op == ">":
+                    mask = mask & (col > v)
+                else:
+                    mask = mask & (col >= v)
+            out: dict = {}
+            out["count"] = jnp.zeros((b_bucket,), jnp.int32).at[agg_idx].add(
+                mask.astype(jnp.int32))
+            for op, cname, oname in aggs:
+                if op == "count":
+                    continue
+                col = (type_ids if cname == "type_id" else cols[cname])
+                dt = col.dtype
+                if op == "sum":
+                    out[oname] = jnp.zeros((b_bucket,), dt).at[agg_idx].add(
+                        jnp.where(mask, col, jnp.zeros((), dt)))
+                elif op == "min":
+                    big = (jnp.array(jnp.finfo(dt).max, dt)
+                           if jnp.issubdtype(dt, jnp.floating)
+                           else jnp.array(jnp.iinfo(dt).max, dt))
+                    out[oname] = jnp.full((b_bucket,), big, dt).at[
+                        agg_idx].min(jnp.where(mask, col, big))
+                else:
+                    small = (jnp.array(jnp.finfo(dt).min, dt)
+                             if jnp.issubdtype(dt, jnp.floating)
+                             else jnp.array(jnp.iinfo(dt).min, dt))
+                    out[oname] = jnp.full((b_bucket,), small, dt).at[
+                        agg_idx].max(jnp.where(mask, col, small))
+            return out
+
+        if self.mesh is None or self._n_dev() <= 1:
+            prog = jax.jit(lambda ai, ti, va, pv, ta, cs:
+                           local_scan(ai, ti, va, pv, ta, cs))
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            from surge_tpu.replay.jax_compat import shard_map as _shard_map
+
+            axis = self.mesh_axis
+            pe = P(axis)  # event axis, sharded
+            pr = P()      # replicated (predicate values, type filter, output)
+
+            def sharded(agg_idx, type_ids, valid, pred_vals, type_allow, cols):
+                part = local_scan(agg_idx, type_ids, valid, pred_vals,
+                                  type_allow, cols)
+                # ONE collective per output column: partial per-aggregate
+                # reduces combine across the event shards
+                out: dict = {}
+                for name, v in part.items():
+                    op = next((a[0] for a in aggs if a[2] == name), "count")
+                    if op == "min":
+                        out[name] = jax.lax.pmin(v, axis)
+                    elif op == "max":
+                        out[name] = jax.lax.pmax(v, axis)
+                    else:  # count / sum
+                        out[name] = jax.lax.psum(v, axis)
+                return out
+
+            mapped = _shard_map(
+                sharded, mesh=self.mesh,
+                in_specs=(pe, pe, pe, pr, pr, {n: pe for n in col_names}),
+                out_specs={name: pr for name in
+                           ["count"] + [a[2] for a in aggs
+                                        if a[0] != "count"]},
+                check_vma=False)
+            prog = jax.jit(mapped)
+        self._programs[key] = prog
+        return prog
+
+    # -- chunk / segment scans ----------------------------------------------------------
+
+    def scan_chunk(self, colev: ColumnarEvents, query: ScanQuery
+                   ) -> Dict[str, np.ndarray]:
+        """Scan one chunk; returns ``{output: np[num_aggregates]}`` (always
+        including ``count``). Zero-match aggregates report 0 everywhere."""
+        return _normalize_zero_match(self._raw_scan(colev, query), query)
+
+    def _raw_scan(self, colev: ColumnarEvents, query: ScanQuery
+                  ) -> Dict[str, np.ndarray]:
+        """The device scan of one chunk WITHOUT zero-match normalization:
+        min/max keep their dtype sentinels, so per-chunk partials of a
+        repeated aggregate (delta chunks) stay combinable."""
+        import jax
+
+        b = colev.num_aggregates
+        n = colev.num_events
+        needed = tuple(query.columns_needed())
+        cols_np = self._materialize_columns(colev, needed)
+        n_dev = self._n_dev()
+        n_bucket = _pow2(max(n, 1), max(self._event_bucket, n_dev))
+        b_bucket = _pow2(max(b, 1), 8)
+
+        agg_p = np.zeros((n_bucket,), dtype=np.int32)
+        agg_p[:n] = colev.agg_idx
+        type_p = np.full((n_bucket,), -1, dtype=np.int32)
+        type_p[:n] = colev.type_ids
+        valid = np.zeros((n_bucket,), dtype=bool)
+        valid[:n] = True
+        cols_p: Dict[str, np.ndarray] = {}
+        for name in needed:
+            dt = self._device_dtype(cols_np[name].dtype)
+            cp = np.zeros((n_bucket,), dtype=dt)
+            cp[:n] = cols_np[name].astype(dt)
+            cols_p[name] = cp
+        pred_vals = np.asarray([p.value for p in query.predicates],
+                               dtype=np.float64)
+        type_allow = (self.resolve_type_ids(query.event_types)
+                      if query.event_types is not None
+                      else np.zeros((0,), dtype=np.int32))
+
+        if self.mesh is not None and n_dev > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(self.mesh, P(self.mesh_axis))
+            rep = NamedSharding(self.mesh, P())
+            put_e = lambda a: jax.device_put(a, sh)  # noqa: E731
+            put_r = lambda a: jax.device_put(a, rep)  # noqa: E731
+        else:
+            put_e = put_r = lambda a: a  # noqa: E731
+        prog = self._program(query, n_bucket, b_bucket, needed)
+        out_dev = prog(put_e(agg_p), put_e(type_p), put_e(valid),
+                       put_r(pred_vals), put_r(type_allow),
+                       {k: put_e(v) for k, v in cols_p.items()})
+        return {k: np.asarray(v)[:b] for k, v in out_dev.items()}
+
+    def scan_chunks(self, chunks: Iterable[ColumnarEvents], query: ScanQuery
+                    ) -> QueryResult:
+        """Scan a stream of chunks. Disjoint-aggregate chunks (the base
+        columnar-segment layout) concatenate in chunk order; chunks REPEATING
+        an aggregate id (auto-extended segments append delta chunks whose
+        aggregates continue base chunks) MERGE into one row per id —
+        count/sum add, min/max combine, zero-match normalization runs after
+        the merge. Chunks without aggregate ids cannot be matched across
+        chunks and keep the disjointness contract."""
+        t0 = time.perf_counter()
+        collected: List[Tuple[Optional[List[str]], Dict[str, np.ndarray]]] = []
+        saw_ids = True
+        has_dup = False
+        seen: Dict[str, int] = {}
+        scanned = matched = n_chunks = 0
+        for colev in chunks:
+            out = self._raw_scan(colev, query)
+            collected.append((colev.aggregate_ids, out))
+            scanned += colev.num_events
+            matched += int(out["count"].sum())
+            n_chunks += 1
+            if colev.aggregate_ids is None:
+                saw_ids = False
+            elif saw_ids:
+                for a in colev.aggregate_ids:
+                    if a in seen:
+                        has_dup = True
+                    else:
+                        seen[a] = len(seen)
+        ids, columns = _merge_scan_outputs(collected, query, saw_ids,
+                                           has_dup, seen)
+        self.stats["scans"] += 1
+        self.stats["chunks"] += n_chunks
+        self.stats["scanned_events"] += scanned
+        self.stats["matched_events"] += matched
+        return QueryResult(
+            aggregate_ids=ids, columns=columns,
+            num_aggregates=len(next(iter(columns.values()))),
+            scanned_events=scanned, matched_events=matched, chunks=n_chunks,
+            elapsed_s=time.perf_counter() - t0)
+
+    def scan_segment(self, path: str, query: ScanQuery,
+                     partitions: Optional[set] = None) -> QueryResult:
+        """Scan a committed columnar segment file. Only the columns the query
+        touches are decompressed (projection pushdown into the reader)."""
+        from surge_tpu.log.columnar import read_segment
+
+        return self.scan_chunks(
+            read_segment(path, partitions=partitions,
+                         columns=query.columns_needed()),
+            query)
+
+    # -- state queries (fold + filter + project) ----------------------------------------
+
+    def query_states(self, chunks: Iterable[ColumnarEvents],
+                     query: StateQuery, replay_engine) -> QueryResult:
+        """Fold the chunks' events to per-aggregate STATE through the
+        (mesh-aware) replay engine, then filter on state columns and project
+        ``select`` — the "current state of every matching aggregate" read.
+
+        Chunks REPEATING an aggregate id (auto-extended segments append delta
+        chunks continuing base chunks) fold as CONTINUATIONS: the repeated
+        rows' carries and already-folded event counts seed the delta fold,
+        and the final row is the complete state — one row per id, same as
+        the segment restore. (Snapshot-only aggregates — state publishes with
+        no events at all — live in snapshot sections the tensor fold cannot
+        see; they are a restore concern, not a state-query one.)"""
+        t0 = time.perf_counter()
+        chunk_list = list(chunks)
+        state_names = [f.name for f in self.spec.registry.state.fields]
+        dtypes = {f.name: np.dtype(f.dtype)
+                  for f in self.spec.registry.state.fields}
+        if any(c.aggregate_ids is None for c in chunk_list):
+            # id-less chunks cannot be matched across chunks: keep the
+            # disjoint-aggregate contract verbatim
+            res = replay_engine.replay_columnar_chunks(chunk_list)
+            states, ids_order = res.states, res.aggregate_ids
+            num_events = res.num_events
+        else:
+            init_tree = self.spec.init_state_tree()
+            index: Dict[str, int] = {}
+            ids_order = []
+            states = {n: np.zeros((0,), dtype=dtypes[n])
+                      for n in state_names}
+            folded = np.zeros((0,), dtype=np.int32)  # events per id so far
+            num_events = 0
+            for colev in chunk_list:
+                b_c = colev.num_aggregates
+                ids_c = colev.aggregate_ids
+                rep = [(j, index[a]) for j, a in enumerate(ids_c)
+                       if a in index]
+                init_carry = None
+                ord_base = None
+                if rep:
+                    # continuation: repeated rows resume from their folded
+                    # carry + event count (delta chunks store positional
+                    # columns explicitly, but a derived declaration still
+                    # continues correctly through ordinal_base)
+                    init_carry = {n: np.full((b_c,), init_tree[n],
+                                             dtype=dtypes[n])
+                                  for n in state_names}
+                    ord_base = np.zeros((b_c,), dtype=np.int32)
+                    js = np.asarray([j for j, _ in rep], dtype=np.int64)
+                    ks = np.asarray([k for _, k in rep], dtype=np.int64)
+                    for n in state_names:
+                        init_carry[n][js] = states[n][ks]
+                    ord_base[js] = folded[ks]
+                res = replay_engine.replay_columnar(
+                    colev, init_carry=init_carry, ordinal_base=ord_base)
+                counts_c = np.bincount(colev.agg_idx,
+                                       minlength=b_c).astype(np.int32)
+                num_events += res.num_events
+                new = [j for j, a in enumerate(ids_c) if a not in index]
+                if rep:
+                    for n in state_names:
+                        states[n][ks] = res.states[n][js]
+                    folded[ks] += counts_c[js]
+                if new:
+                    nj = np.asarray(new, dtype=np.int64)
+                    for n in state_names:
+                        states[n] = np.concatenate(
+                            [states[n], res.states[n][nj]])
+                    folded = np.concatenate([folded, counts_c[nj]])
+                    for j in new:
+                        index[ids_c[j]] = len(ids_order)
+                        ids_order.append(ids_c[j])
+        n_rows = len(next(iter(states.values()))) if states else 0
+        mask = np.ones((n_rows,), dtype=bool)
+        for p in query.predicates:
+            if p.column not in states:
+                raise ValueError(
+                    f"state query references unknown state column "
+                    f"{p.column!r} (has {state_names})")
+            mask &= _apply_op_np(states[p.column], p.op, p.value)
+        select = list(query.select) if query.select is not None else state_names
+        for name in select:
+            if name not in states:
+                raise ValueError(f"unknown state column {name!r} in select "
+                                 f"(has {state_names})")
+        idx = np.nonzero(mask)[0]
+        if query.limit is not None:
+            idx = idx[: query.limit]
+        columns = {name: states[name][idx] for name in select}
+        ids = ([ids_order[i] for i in idx]
+               if ids_order is not None else None)
+        self.stats["scans"] += 1
+        self.stats["scanned_events"] += num_events
+        return QueryResult(
+            aggregate_ids=ids, columns=columns, num_aggregates=len(idx),
+            scanned_events=num_events, matched_events=len(idx),
+            chunks=len(chunk_list), elapsed_s=time.perf_counter() - t0)
+
+    def query_states_segment(self, path: str, query: StateQuery,
+                             replay_engine,
+                             partitions: Optional[set] = None) -> QueryResult:
+        from surge_tpu.log.columnar import read_segment
+
+        return self.query_states(read_segment(path, partitions=partitions),
+                                 query, replay_engine)
+
+
+# -- numpy host references (the golden the device scans must equal) ------------------
+
+
+def scan_reference(chunks: Iterable[ColumnarEvents], query: ScanQuery,
+                   registry) -> QueryResult:
+    """Pure-numpy oracle for :meth:`QueryEngine.scan_chunks` — identical
+    dtype discipline (device-canonicalized reduce dtypes), identical
+    zero-match normalization. The query-engine tests hold device == this."""
+    import jax
+
+    def dev_dt(dt: np.dtype) -> np.dtype:
+        if not jax.config.read("jax_enable_x64") and dt.itemsize == 8:
+            return np.dtype(np.int32 if dt.kind in "iu" else np.float32)
+        return dt
+
+    type_ids_of = {s.cls.__name__: s.type_id for s in registry.event_schemas}
+    union_dts = {f.name: np.dtype(f.dtype) for f in registry.union_columns()}
+    collected: List[Tuple[Optional[List[str]], Dict[str, np.ndarray]]] = []
+    saw_ids = True
+    has_dup = False
+    seen: Dict[str, int] = {}
+    total_b = scanned = matched = n_chunks = 0
+    for colev in chunks:
+        b, n = colev.num_aggregates, colev.num_events
+        cols: Dict[str, np.ndarray] = {}
+        for name in query.columns_needed():
+            col = colev.cols.get(name)
+            if col is None and colev.derived_cols.get(name) == "ordinal":
+                starts = np.zeros(b + 1, dtype=np.int64)
+                np.cumsum(np.bincount(colev.agg_idx, minlength=b),
+                          out=starts[1:])
+                col = (np.arange(n, dtype=np.int64)
+                       - starts[colev.agg_idx] + 1).astype(
+                    union_dts.get(name, np.dtype(np.int32)))
+            cols[name] = col.astype(dev_dt(col.dtype))
+        mask = np.ones((n,), dtype=bool)
+        if query.event_types is not None:
+            allow = {type_ids_of[t] for t in query.event_types}
+            mask &= np.isin(colev.type_ids, sorted(allow))
+        for p in query.predicates:
+            col = (colev.type_ids if p.column == "type_id"
+                   else cols[p.column])
+            if not _is_integral(p.value) and col.dtype.kind != "f":
+                # mirror the device program: fractional vs integer compares
+                # in f32, not by truncating the value to the column dtype
+                mask &= _apply_op_np(col.astype(np.float32), p.op,
+                                     np.float32(p.value))
+            else:
+                mask &= _apply_op_np(col, p.op,
+                                     np.asarray(p.value, dtype=col.dtype))
+        count = np.zeros((b,), dtype=np.int32)
+        np.add.at(count, colev.agg_idx, mask.astype(np.int32))
+        out: Dict[str, np.ndarray] = {"count": count}
+        for a in query.aggregates:
+            if a.op == "count":
+                continue
+            col = (colev.type_ids.astype(np.int32) if a.column == "type_id"
+                   else cols[a.column])
+            dt = col.dtype
+            if a.op == "sum":
+                acc = np.zeros((b,), dtype=dt)
+                np.add.at(acc, colev.agg_idx, np.where(mask, col,
+                                                       np.zeros((), dt)))
+            elif a.op == "min":
+                big = _sentinel("min", dt)
+                acc = np.full((b,), big, dtype=dt)
+                np.minimum.at(acc, colev.agg_idx,
+                              np.where(mask, col, np.asarray(big, dt)))
+            else:
+                small = _sentinel("max", dt)
+                acc = np.full((b,), small, dtype=dt)
+                np.maximum.at(acc, colev.agg_idx,
+                              np.where(mask, col, np.asarray(small, dt)))
+            out[a.name] = acc  # raw: sentinels normalize after the merge
+        collected.append((colev.aggregate_ids, out))
+        total_b += b
+        scanned += n
+        matched += int(count.sum())
+        n_chunks += 1
+        if colev.aggregate_ids is None:
+            saw_ids = False
+        elif saw_ids:
+            for a_id in colev.aggregate_ids:
+                if a_id in seen:
+                    has_dup = True
+                else:
+                    seen[a_id] = len(seen)
+    ids, columns = _merge_scan_outputs(collected, query, saw_ids, has_dup,
+                                       seen)
+    return QueryResult(aggregate_ids=ids, columns=columns,
+                       num_aggregates=len(next(iter(columns.values()))),
+                       scanned_events=scanned, matched_events=matched,
+                       chunks=n_chunks)
+
+
+def state_query_reference(states: Mapping[str, np.ndarray],
+                          aggregate_ids: Optional[Sequence[str]],
+                          query: StateQuery) -> QueryResult:
+    """Numpy oracle for :meth:`QueryEngine.query_states`, given already-folded
+    state columns (fold them with the scalar model in tests)."""
+    n = len(next(iter(states.values()))) if states else 0
+    mask = np.ones((n,), dtype=bool)
+    for p in query.predicates:
+        mask &= _apply_op_np(states[p.column], p.op, p.value)
+    idx = np.nonzero(mask)[0]
+    if query.limit is not None:
+        idx = idx[: query.limit]
+    select = list(query.select) if query.select is not None else list(states)
+    return QueryResult(
+        aggregate_ids=([aggregate_ids[i] for i in idx]
+                       if aggregate_ids is not None else None),
+        columns={name: np.asarray(states[name])[idx] for name in select},
+        num_aggregates=len(idx), scanned_events=0, matched_events=len(idx),
+        chunks=1)

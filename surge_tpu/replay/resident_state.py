@@ -140,6 +140,22 @@ class ResidentStatePlane(Controllable):
 
         self.capacity = max(
             self.config.get_int("surge.replay.resident.capacity", 65536), 8)
+        # mesh-native slab (surge_tpu.replay.plane_mesh): "local" shards the
+        # slab [n_dev, per_dev+1] with device-local gather lanes and
+        # per-shard refresh deals; "replicated" keeps the legacy plain-jit
+        # programs whose reads replicate the slab (the paired-bench baseline
+        # arm). Capacity rounds UP to a device multiple so every shard holds
+        # the same row count (the operator's floor is always honored).
+        self._mesh_gather = self.config.get_str(
+            "surge.replay.mesh.gather", "local")
+        if self._mesh_gather not in ("local", "replicated"):
+            raise ValueError(
+                f"unknown surge.replay.mesh.gather {self._mesh_gather!r} "
+                "(local|replicated)")
+        self._meshp = None
+        if mesh is not None:
+            n_dev = int(np.prod(mesh.devices.shape))
+            self.capacity = -(-self.capacity // n_dev) * n_dev
         self.max_lag = self.config.get_int(
             "surge.replay.resident.max-lag-records", 4096)
         self._max_poll = self.config.get_int(
@@ -263,17 +279,32 @@ class ResidentStatePlane(Controllable):
     # -- device programs ----------------------------------------------------------------
 
     def _sharded(self, arr):
-        """Shard a slab column over the mesh axis when a mesh is present."""
+        """The ``mesh.gather = replicated`` arm's slab layout: every device
+        holds the WHOLE column and the plain-jit programs run SPMD over the
+        replica set (n_dev× the scatter/fold work, n_dev× the memory — the
+        baseline the device-local layout is paired against). The old P(axis)
+        1-D sharding is gone: capacity+1 never divides the device count, and
+        arbitrary-index gathers made XLA replicate it per read anyway."""
         if self.mesh is None:
             return arr
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        return jax.device_put(arr, NamedSharding(self.mesh,
-                                                 P(self.engine.mesh_axis)))
+        return jax.device_put(arr, NamedSharding(self.mesh, P()))
+
+    @property
+    def _mesh_local(self) -> bool:
+        return self.mesh is not None and self._mesh_gather == "local"
 
     def _ensure_device_state(self) -> None:
         if self._slab is not None:
+            return
+        if self._mesh_local:
+            from surge_tpu.replay.plane_mesh import MeshPlane
+
+            self._meshp = MeshPlane(self)
+            self._slab, self._ords = self._meshp.init_slab()
+            self._build_programs()
             return
         init = self.spec.init_state_tree()
         cap1 = self.capacity + 1  # +1: the scratch row
@@ -302,6 +333,22 @@ class ResidentStatePlane(Controllable):
         # u32 words per packed field row (2 for a genuine device-64-bit
         # column under jax_enable_x64)
         self._wide_words = [max(dt.itemsize // 4, 1) for dt in dts]
+        # u16 read wire eligibility (shared by both slab layouts)
+        self._narrow_ok = not any(np.issubdtype(dt, np.floating)
+                                  or dt.itemsize > 4 for dt in dts)
+
+        if self._mesh_local:
+            # the sharded-slab programs live in plane_mesh (shard_map:
+            # device-local refresh deals, one-collective gathers); the
+            # single-device jit programs below never build
+            self._refresh_prog = None
+            self._seed_scatter = None
+            self._gather_wide = self._meshp.gather_wide
+            self._gather_narrow = (self._meshp.gather_narrow
+                                   if self._narrow_ok else None)
+            self._fetch_off_loop = jax.default_backend() != "cpu"
+            self._programs_built = True
+            return
 
         def refresh(slab, ords, admit_idx, admit_vals, admit_ord,
                     lane_slots, lane_counts, packed, side):
@@ -346,9 +393,6 @@ class ResidentStatePlane(Controllable):
         # u16 read wire: all-integer/bool schemas pull reads at half width
         # with device-computed fit flags at the tail — one flat buffer, one
         # fetch (the same narrow contract as ReplayEngine._pull_states)
-        self._narrow_ok = not any(np.issubdtype(dt, np.floating)
-                                  or dt.itemsize > 4 for dt in dts)
-
         def gather_narrow(slab, idx):
             cols, flags = [], []
             for name, dt in zip(names, dts):
@@ -545,12 +589,19 @@ class ResidentStatePlane(Controllable):
             vals[k][:n_res] = states[k][:n_res]
         lens_p = np.zeros((k_b,), dtype=np.int32)
         lens_p[:n_res] = lengths[:n_res]
-        # reuse the admission half of the refresh program via seed_scatter on
-        # an identity source: scatter host values through a device_put
-        slab_src = {k: self._sharded(vals[k]) for k in vals}
-        pos = np.arange(k_b, dtype=np.int32)
-        self._slab, self._ords = self._seed_scatter(
-            self._slab, self._ords, slab_src, pos, dst_p, lens_p)
+        if self._mesh_local:
+            # sharded-slab admission: values ride replicated, every device
+            # keeps only the rows it owns (plane_mesh.seed_rows)
+            self._slab, self._ords = self._meshp.seed_rows(
+                self._slab, self._ords, vals, dst_p, lens_p)
+        else:
+            # reuse the admission half of the refresh program via
+            # seed_scatter on an identity source: scatter host values
+            # through a device_put
+            slab_src = {k: self._sharded(vals[k]) for k in vals}
+            pos = np.arange(k_b, dtype=np.int32)
+            self._slab, self._ords = self._seed_scatter(
+                self._slab, self._ords, slab_src, pos, dst_p, lens_p)
         for j, agg in enumerate(ids[:n_res]):
             self._dir[agg] = int(dst[j])
             self._agg_part[agg] = part_of[agg]
@@ -966,7 +1017,9 @@ class ResidentStatePlane(Controllable):
                         f.name: np.full((b_bucket,), init[f.name],
                                         dtype=f.dtype) for f in self._fields}
                 ai, av, ao = noop_idx, noop_vals, noop_ord
-            run = functools.partial(self._refresh_prog, slab, ords, ai, av,
+            refresh = (self._meshp.refresh if self._mesh_local
+                       else self._refresh_prog)
+            run = functools.partial(refresh, slab, ords, ai, av,
                                     ao, lane_slots, counts, packed, side)
             if self.profiler is None:
                 slab, ords = await loop.run_in_executor(None, run)

@@ -106,6 +106,48 @@ def test_bench_lane_paired_ladder_smoke():
     assert payload["value"] == rung["direct"]["commands_per_sec_median"]
 
 
+def test_bench_mesh_paired_ladder_smoke():
+    """SURGE_BENCH_MESH=1: the mesh-native plane's paired interleaved ladder
+    (device-local vs replicated-slab arms) plus the sharded-scan row emit
+    per-arm medians, tiny-sized here."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SURGE_BENCH_MESH": "1",
+        "SURGE_BENCH_MESH_AGGREGATES": "64",
+        "SURGE_BENCH_MESH_ROUNDS": "1",
+        "SURGE_BENCH_MESH_CAP_LADDER": "64",
+        "SURGE_BENCH_MESH_FOLD_EVENTS": "200",
+        "SURGE_BENCH_MESH_FOLD_CYCLES": "2",
+        "SURGE_BENCH_MESH_READ_WORKERS": "4",
+        "SURGE_BENCH_MESH_READ_BATCH": "32",
+        "SURGE_BENCH_MESH_SCAN_EVENTS": "4000",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON payload on stdout: {proc.stdout!r}"
+    payload = json.loads(lines[-1])
+    assert payload["metric"] == "mesh_fold_events_per_sec"
+    assert payload["mesh_devices"] == 8
+    rung = payload["mesh_fold_ladder"][0]
+    for key in ("capacity", "local_events_per_sec",
+                "replicated_events_per_sec", "local_vs_replicated",
+                "local_rounds", "replicated_rounds"):
+        assert key in rung, key
+    assert rung["local_events_per_sec"] > 0
+    assert rung["replicated_events_per_sec"] > 0
+    assert payload["value"] == max(r["local_events_per_sec"]
+                                   for r in payload["mesh_fold_ladder"])
+    row = payload["mesh_read_row"]
+    assert row["local_reads_per_sec"] > 0 and row["replicated_reads_per_sec"] > 0
+    scan = payload["mesh_scan_row"]
+    assert scan["mesh_events_per_sec"] > 0 and scan["single_events_per_sec"] > 0
+
+
 def test_bench_resident_feed_paired_smoke():
     """SURGE_BENCH_RESIDENT_FEED=1: the paired native-feed vs Python-feed
     sustained-fold arms over one FileLog tail emit both medians + ratio."""

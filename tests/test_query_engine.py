@@ -1,0 +1,409 @@
+"""TPU scan engine over committed columnar segments (surge_tpu.replay.query).
+
+The analytics half of the KTable analogy: projection/filter/grouped-aggregate
+scans over struct-of-arrays chunks, on device (and mesh-sharded), must equal
+the pure-numpy host reference on every op — and the admin ``ScanSegments`` /
+``QueryStates`` RPCs must serve the same rows end to end."""
+
+import asyncio
+import os
+import random
+
+import numpy as np
+import pytest
+
+from surge_tpu.codec.tensor import encode_events_columnar
+from surge_tpu.config import Config, default_config
+from surge_tpu.engine.model import fold_events
+from surge_tpu.log.columnar import ColumnarSegmentWriter, read_segment
+from surge_tpu.models import bank_account, counter
+from surge_tpu.replay import ReplayEngine
+from surge_tpu.replay.query import (
+    Aggregate,
+    Predicate,
+    QueryEngine,
+    ScanQuery,
+    StateQuery,
+    scan_reference,
+    state_query_reference,
+)
+
+SPEC = counter.make_replay_spec()
+
+
+def counter_logs(n, max_len, seed):
+    rng = random.Random(seed)
+    logs = []
+    for i in range(n):
+        seq = 0
+        log = []
+        for _ in range(rng.randrange(max_len + 1)):
+            seq += 1
+            kind = rng.randrange(3)
+            if kind == 0:
+                log.append(counter.CountIncremented(str(i), rng.randrange(1, 4),
+                                                    seq))
+            elif kind == 1:
+                log.append(counter.CountDecremented(str(i), rng.randrange(1, 4),
+                                                    seq))
+            else:
+                log.append(counter.NoOpEvent(str(i), seq))
+        logs.append(log)
+    return logs
+
+
+def chunked_colev(logs, chunk_aggs, id_prefix="agg"):
+    """Disjoint-aggregate chunks, the columnar-segment layout."""
+    chunks = []
+    for lo in range(0, len(logs), chunk_aggs):
+        sub = logs[lo: lo + chunk_aggs]
+        colev = encode_events_columnar(SPEC.registry, sub)
+        colev.aggregate_ids = [f"{id_prefix}-{lo + j}" for j in range(len(sub))]
+        chunks.append(colev)
+    return chunks
+
+
+QUERIES = [
+    # unfiltered whole-scan, every aggregate op at once
+    ScanQuery(aggregates=(Aggregate("count"),
+                          Aggregate("sum", "increment_by"),
+                          Aggregate("min", "increment_by"),
+                          Aggregate("max", "sequence_number"))),
+    # typed pushdown: only increments count
+    ScanQuery(aggregates=(Aggregate("count"),
+                          Aggregate("sum", "increment_by")),
+              event_types=("CountIncremented",)),
+    # conjunctive predicates incl. type_id, mixing filter and agg columns
+    ScanQuery(aggregates=(Aggregate("count"),
+                          Aggregate("max", "sequence_number")),
+              predicates=(Predicate("sequence_number", ">", 3),
+                          Predicate("type_id", "!=", 2))),
+    # predicate that matches nothing: zero-match rows report 0 everywhere
+    ScanQuery(aggregates=(Aggregate("count"),
+                          Aggregate("min", "sequence_number"),
+                          Aggregate("max", "increment_by")),
+              predicates=(Predicate("sequence_number", ">=", 10_000),)),
+]
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_scan_chunks_equals_numpy_reference(qi):
+    logs = counter_logs(213, 23, seed=qi + 1)
+    chunks = chunked_colev(logs, 64)
+    q = QUERIES[qi]
+    eng = QueryEngine(SPEC, config=Config({"surge.query.chunk-events": 1024}))
+    got = eng.scan_chunks(chunks, q)
+    want = scan_reference(chunked_colev(logs, 64), q, SPEC.registry)
+    assert got.aggregate_ids == want.aggregate_ids
+    assert got.num_aggregates == want.num_aggregates == 213
+    assert got.matched_events == want.matched_events
+    assert set(got.columns) == set(want.columns)
+    for name in want.columns:
+        assert np.array_equal(got.columns[name], want.columns[name]), name
+
+
+def test_mesh_sharded_scan_equals_reference(mesh8):
+    """The event axis sharded over the 8-device mesh (one psum/pmin/pmax per
+    output) must equal the single-device scan AND the numpy reference."""
+    logs = counter_logs(157, 31, seed=7)
+    chunks = chunked_colev(logs, 80)
+    cfg = Config({"surge.query.chunk-events": 1024})
+    for q in QUERIES:
+        want = scan_reference(chunked_colev(logs, 80), q, SPEC.registry)
+        got = QueryEngine(SPEC, config=cfg, mesh=mesh8).scan_chunks(chunks, q)
+        for name in want.columns:
+            assert np.array_equal(got.columns[name], want.columns[name]), name
+
+
+def test_scan_segment_projection_pushdown(tmp_path):
+    """Scanning a real segment FILE only decompresses the touched columns,
+    and the results match the full-read reference."""
+    logs = counter_logs(130, 17, seed=11)
+    path = str(tmp_path / "events.scol")
+    with ColumnarSegmentWriter(path) as w:
+        for colev in chunked_colev(logs, 48):
+            w.append(colev)
+    q = ScanQuery(aggregates=(Aggregate("count"),
+                              Aggregate("sum", "increment_by")),
+                  predicates=(Predicate("increment_by", ">", 1),))
+    # the pushdown really projects: untouched columns never materialize
+    for colev in read_segment(path, columns=q.columns_needed()):
+        assert sorted(colev.cols) == ["increment_by"]
+    eng = QueryEngine(SPEC, config=Config({"surge.query.chunk-events": 1024}))
+    got = eng.scan_segment(path, q)
+    want = scan_reference(read_segment(path), q, SPEC.registry)
+    assert got.aggregate_ids == want.aggregate_ids
+    for name in want.columns:
+        assert np.array_equal(got.columns[name], want.columns[name]), name
+
+
+def test_bank_account_float_columns_scan(mesh8):
+    """Float union columns (bank_account new_balance) through the sharded
+    scan: sum/min/max in device f32, equal to the reference bit for bit."""
+    vocab = bank_account.Vocab()
+    rng = random.Random(5)
+    spec = bank_account.make_replay_spec()
+    enc_logs = []
+    for i in range(66):
+        log = [bank_account.BankAccountCreated(str(i), f"o{i}", "s", 100.0)]
+        bal = 100.0
+        for _ in range(rng.randrange(0, 9)):
+            bal += rng.randrange(1, 30) * 0.25
+            log.append(bank_account.BankAccountUpdated(str(i), bal))
+        enc_logs.append([bank_account.encode_event(vocab, e) for e in log])
+    colev = encode_events_columnar(spec.registry, enc_logs)
+    colev.aggregate_ids = [str(i) for i in range(66)]
+    q = ScanQuery(aggregates=(Aggregate("count"),
+                              Aggregate("max", "new_balance"),
+                              Aggregate("min", "new_balance"),
+                              Aggregate("sum", "new_balance")),
+                  event_types=("EncodedUpdated",))  # the registered class
+    want = scan_reference([colev], q, spec.registry)
+    for mesh in (None, mesh8):
+        got = QueryEngine(spec, config=Config(
+            {"surge.query.chunk-events": 1024}), mesh=mesh).scan_chunks(
+            [colev], q)
+        for name in want.columns:
+            assert np.array_equal(got.columns[name], want.columns[name]), \
+                (name, mesh is not None)
+
+
+def test_query_states_fold_filter_project(mesh8):
+    """StateQuery: fold chunks to current state (mesh replay engine), filter
+    on state columns, project — equal to the scalar-fold numpy oracle."""
+    logs = counter_logs(97, 19, seed=13)
+    chunks = chunked_colev(logs, 40)
+    model = counter.CounterModel()
+    truth = {"count": [], "version": []}
+    for log in logs:
+        st = fold_events(model, None, log)
+        truth["count"].append(st.count if st else 0)
+        truth["version"].append(st.version if st else 0)
+    states = {k: np.asarray(v, dtype=np.int32) for k, v in truth.items()}
+    ids = [f"agg-{i}" for i in range(97)]
+    q = StateQuery(select=("count",),
+                   predicates=(Predicate("count", ">=", 2),
+                               Predicate("version", "<", 15)),
+                   limit=50)
+    want = state_query_reference(states, ids, q)
+    qeng = QueryEngine(SPEC, config=Config({"surge.query.chunk-events": 1024}))
+    for mesh in (None, mesh8):
+        reng = ReplayEngine(SPEC, config=Config(
+            {"surge.replay.batch-size": 32, "surge.replay.time-chunk": 8}),
+            mesh=mesh)
+        got = qeng.query_states(chunked_colev(logs, 40), q, reng)
+        assert got.aggregate_ids == want.aggregate_ids
+        assert list(got.columns) == ["count"]
+        assert np.array_equal(got.columns["count"], want.columns["count"])
+
+
+def test_fractional_predicate_on_integer_column():
+    """A fractional predicate value against an integer column must compare
+    numerically (in f32), not truncate to the column dtype: `< 2.5` keeps
+    {1, 2}, `>= 2.5` keeps {3}."""
+    logs = counter_logs(40, 9, seed=21)
+    chunks = chunked_colev(logs, 40)
+    for op, pred_val in (("<", 2.5), (">=", 2.5), ("==", 2.5), ("!=", 2.5)):
+        q = ScanQuery(aggregates=(Aggregate("count"),),
+                      predicates=(Predicate("increment_by", op, pred_val),))
+        got = QueryEngine(SPEC, config=Config(
+            {"surge.query.chunk-events": 1024})).scan_chunks(chunks, q)
+        # truth from exact numeric comparison (increment_by in {0..3})
+        colev = chunks[0]
+        vals = colev.cols["increment_by"].astype(np.float64)
+        mask = {"<": vals < 2.5, ">=": vals >= 2.5,
+                "==": vals == 2.5, "!=": vals != 2.5}[op]
+        want = np.zeros((40,), np.int32)
+        np.add.at(want, colev.agg_idx, mask.astype(np.int32))
+        assert np.array_equal(got.columns["count"], want), op
+        ref = scan_reference(chunked_colev(logs, 40), q, SPEC.registry)
+        assert np.array_equal(got.columns["count"], ref.columns["count"]), op
+
+
+def test_aggregate_over_type_id_pseudo_column():
+    """type_id works as an aggregate column, not just a predicate column
+    (it rides the chunk's structural columns — never the projection)."""
+    logs = counter_logs(30, 11, seed=23)
+    chunks = chunked_colev(logs, 30)
+    q = ScanQuery(aggregates=(Aggregate("count"), Aggregate("max", "type_id")))
+    assert q.columns_needed() == []  # nothing to decompress at all
+    got = QueryEngine(SPEC, config=Config(
+        {"surge.query.chunk-events": 1024})).scan_chunks(chunks, q)
+    want = scan_reference(chunked_colev(logs, 30), q, SPEC.registry)
+    assert np.array_equal(got.columns["max_type_id"],
+                          want.columns["max_type_id"])
+
+
+def test_non_pow2_chunk_events_still_shards(mesh8):
+    """A non-power-of-two surge.query.chunk-events must normalize to a bucket
+    every mesh divides — the knob seeds the ladder, it is not the bucket."""
+    logs = counter_logs(25, 7, seed=29)
+    chunks = chunked_colev(logs, 25)
+    q = ScanQuery(aggregates=(Aggregate("count"),))
+    eng = QueryEngine(SPEC, config=Config(
+        {"surge.query.chunk-events": 1100}), mesh=mesh8)
+    assert eng._event_bucket % 8 == 0 and eng._event_bucket >= 1100
+    got = eng.scan_chunks(chunks, q)
+    want = scan_reference(chunked_colev(logs, 25), q, SPEC.registry)
+    assert np.array_equal(got.columns["count"], want.columns["count"])
+
+
+def test_scan_merges_extended_segment_delta_chunks(tmp_path):
+    """Auto-extended segments append delta chunks REPEATING base-chunk
+    aggregates: the scan must merge them into one row per id (count/sum add,
+    min/max combine, zero-match normalization after the merge) — never emit
+    duplicate rows with split partials."""
+    from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
+    from surge_tpu.log.columnar import (build_segment_from_topic,
+                                        extend_segment_from_topic)
+    from surge_tpu.serialization import SerializedMessage
+
+    evt = counter.event_formatting()
+    log = InMemoryLog()
+    log.create_topic(TopicSpec("ev", 1))
+
+    def publish(agg, events):
+        prod = log.transactional_producer("t")
+        prod.begin()
+        for e in events:
+            prod.send(LogRecord(topic="ev", key=agg,
+                                value=evt.write_event(e).value, partition=0))
+        prod.commit()
+
+    publish("a", [counter.CountIncremented("a", 2, 1),
+                  counter.CountIncremented("a", 3, 2)])
+    publish("b", [counter.CountIncremented("b", 1, 1)])
+    path = str(tmp_path / "seg.scol")
+    deser = lambda m: evt.read_event(m)  # noqa: E731
+    build_segment_from_topic(log, "ev", SPEC.registry, deser, path)
+    # post-build delta: 'a' continues, 'c' is new
+    publish("a", [counter.CountIncremented("a", 3, 3)])  # 2-bit wire: ≤ 3
+    publish("c", [counter.CountIncremented("c", 1, 1)])
+    extend_segment_from_topic(log, "ev", SPEC.registry, deser, path)
+
+    q = ScanQuery(aggregates=(Aggregate("count"),
+                              Aggregate("sum", "increment_by"),
+                              Aggregate("min", "increment_by"),
+                              Aggregate("max", "increment_by")))
+    eng = QueryEngine(SPEC, config=Config({"surge.query.chunk-events": 1024}))
+    got = eng.scan_segment(path, q)
+    rows = {r["aggregate_id"]: r for r in got.rows()}
+    assert len(got.aggregate_ids) == len(set(got.aggregate_ids)) == 3
+    assert rows["a"] == {"aggregate_id": "a", "count": 3,
+                         "sum_increment_by": 8, "min_increment_by": 2,
+                         "max_increment_by": 3}
+    assert rows["b"]["count"] == 1 and rows["c"]["count"] == 1
+    # the reference merges identically
+    ref = scan_reference(read_segment(path), q, SPEC.registry)
+    assert ref.aggregate_ids == got.aggregate_ids
+    for name in ref.columns:
+        assert np.array_equal(ref.columns[name], got.columns[name]), name
+
+    # state query: the delta chunk folds as a CONTINUATION of the base
+    # carry — one complete row per id, never a from-init partial
+    sq = StateQuery(select=("count", "version"))
+    sres = eng.query_states_segment(path, sq, ReplayEngine(SPEC, config=Config(
+        {"surge.replay.batch-size": 16, "surge.replay.time-chunk": 8})))
+    srows = {a: {k: v[j] for k, v in sres.columns.items()}
+             for j, a in enumerate(sres.aggregate_ids)}
+    assert len(srows) == 3
+    assert srows["a"] == {"count": 8, "version": 3}
+    assert srows["b"] == {"count": 1, "version": 1}
+    assert srows["c"] == {"count": 1, "version": 1}
+
+
+def test_query_json_round_trip():
+    q = QUERIES[2]
+    assert ScanQuery.from_json(q.as_json()) == q
+    sq = StateQuery(select=("count",), predicates=(
+        Predicate("count", ">", 1),), limit=7)
+    assert StateQuery.from_json(sq.as_json()) == sq
+    with pytest.raises(ValueError):
+        Predicate("c", "~", 1)
+    with pytest.raises(ValueError):
+        Aggregate("sum")  # needs a column
+    with pytest.raises(ValueError):
+        QueryEngine(SPEC).resolve_type_ids(["NoSuchEvent"])
+
+
+def test_engine_query_rpc_round_trip(tmp_path):
+    """SurgeEngine.query()/query_states() + the admin ScanSegments/QueryStates
+    RPCs: commands publish events, the segment builds on first query, and the
+    RPC rows equal the numpy reference over that segment."""
+    import grpc
+
+    from surge_tpu import SurgeCommandBusinessLogic, create_engine
+    from surge_tpu.admin import AdminClient, AdminServer
+
+    seg_path = str(tmp_path / "counter.scol")
+    cfg = default_config().with_overrides({
+        "surge.producer.flush-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 20,
+        "surge.engine.num-partitions": 2,
+        "surge.replay.segment-path": seg_path,
+        "surge.query.max-rows": 4,
+    })
+
+    async def scenario():
+        engine = create_engine(SurgeCommandBusinessLogic(
+            aggregate_name="counter", model=counter.CounterModel(),
+            state_format=counter.state_formatting(),
+            event_format=counter.event_formatting()), config=cfg)
+        await engine.start()
+        try:
+            for i in range(6):
+                ref = engine.aggregate_for(f"q-{i}")
+                for _ in range(i + 1):
+                    await ref.send_command(counter.Increment(f"q-{i}"))
+
+            q = {"aggregates": [{"op": "count"},
+                                {"op": "sum", "column": "increment_by"}],
+                 "event_types": ["CountIncremented"]}
+            result = await engine.query(q)
+            assert os.path.exists(seg_path)  # built on first query
+            want = scan_reference(read_segment(seg_path),
+                                  ScanQuery.from_json(q), SPEC.registry)
+            assert result.aggregate_ids == want.aggregate_ids
+            for name in want.columns:
+                assert np.array_equal(result.columns[name],
+                                      want.columns[name]), name
+            by_id = dict(zip(result.aggregate_ids, result.columns["count"]))
+            assert by_id["q-5"] == 6 and by_id["q-0"] == 1
+
+            admin = AdminServer(engine)
+            port = await admin.start()
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            client = AdminClient(channel)
+            try:
+                payload = await client.scan_segments(q)
+                assert payload["num_aggregates"] == 6
+                assert payload["truncated"] is True  # max-rows=4 capped
+                assert len(payload["rows"]) == 4
+                row = next(r for r in payload["rows"]
+                           if r["aggregate_id"] == "q-3")
+                assert row["count"] == 4 and row["sum_increment_by"] == 4
+
+                sq = {"select": ["count"],
+                      "predicates": [{"column": "count", "op": ">=",
+                                      "value": 4}]}
+                payload = await client.query_states(sq)
+                got_ids = sorted(r["aggregate_id"] for r in payload["rows"])
+                assert got_ids == ["q-3", "q-4", "q-5"]
+                assert all(set(r) == {"aggregate_id", "count"}
+                           for r in payload["rows"])
+
+                with pytest.raises(RuntimeError):
+                    await client.scan_segments(
+                        {"aggregates": [{"op": "sum", "column": "nope"}]})
+
+                # query metrics fed the predeclared instruments
+                vals = engine.metrics_registry.get_metrics()
+                assert vals["surge.query.scanned-events"] > 0
+                assert vals["surge.query.result-rows"] == 3
+            finally:
+                await channel.close()
+                await admin.stop()
+        finally:
+            await engine.stop()
+
+    asyncio.run(scenario())

@@ -629,11 +629,9 @@ def test_resume_with_derived_ordinals_continues_sequence():
     assert np.array_equal(r2.states["version"], corpus.expected_version)
 
 
-@pytest.mark.skipif(
-    __import__("jax").device_count() < 8,
-    reason="the sharded-deal leg needs 8 host devices (conftest forces them "
-           "via xla_force_host_platform_device_count; this platform cannot)")
-def test_grouped_pack_is_indirect_and_exact_everywhere():
+def test_grouped_pack_is_indirect_and_exact_everywhere(mesh8):
+    # mesh8 (not a skipif): the sharded-deal leg MUST run on every tier-1
+    # pass — the fixture fails loudly if the 8 forced host devices are gone
     """A grouped-input corpus (every encode path produces one) packs WITHOUT
     the 100M-event sort: the buffer keeps input order and lanes point at
     their segments by indirection. Every consumer of the wire — plain
@@ -677,10 +675,7 @@ def test_grouped_pack_is_indirect_and_exact_everywhere():
 
     # the sharded mesh deal gathers per-lane slabs straight from the indirect
     # starts (resident_mesh host-side re-pack)
-    import jax
-
-    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("data",))
-    meng = ReplayEngine(counter.make_replay_spec(), config=cfg, mesh=mesh)
+    meng = ReplayEngine(counter.make_replay_spec(), config=cfg, mesh=mesh8)
     sharded = meng.prepare_resident_sharded(wire)
     sres = meng.replay_resident_sharded(sharded)
     np.testing.assert_array_equal(sres.states["count"], corpus.expected_count)

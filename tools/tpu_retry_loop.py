@@ -60,6 +60,15 @@ def banked() -> bool:
                for c in art.get("smoke", {}).get("configs", []))
 
 
+def artifact_platform() -> str | None:
+    """The platform the LAST sweep attempt actually initialized, or None."""
+    try:
+        with open(ARTIFACT) as f:
+            return json.load(f).get("platform")
+    except (OSError, ValueError):
+        return None
+
+
 def main() -> None:
     attempt = 0
     while not banked():
@@ -77,12 +86,30 @@ def main() -> None:
         print(f"[{time.strftime('%H:%M:%S')}] attempt {attempt}: {cmd}",
               flush=True)
         t0 = time.perf_counter()
+        wall_t0 = time.time()
         proc = subprocess.run(cmd, cwd=REPO)
         dt = time.perf_counter() - t0
         print(f"[{time.strftime('%H:%M:%S')}] attempt {attempt} exited "
               f"rc={proc.returncode} after {dt:.0f}s", flush=True)
         if banked():
             break
+        try:  # only trust the platform field THIS attempt wrote — a stale
+            # cpu artifact from an earlier session must not stand the loop
+            # down when the current attempt crashed before writing anything
+            fresh = os.path.getmtime(ARTIFACT) >= wall_t0 - 1
+        except OSError:
+            fresh = False
+        if fresh and artifact_platform() == "cpu":
+            # the sweep came up on the host CPU backend (tunnel env absent or
+            # jax fell back): every retry would re-run the FULL sweep — the
+            # ~5-minute _verify_families pass included — and bank nothing,
+            # hammering until the deadline. That is a hard refuse: stand down
+            # and let the operator fix the tunnel env first (ADVICE r5).
+            print(f"[{time.strftime('%H:%M:%S')}] attempt {attempt} "
+                  "initialized the CPU backend, not a TPU — the tunnel env is "
+                  "absent/broken and retrying cannot bank on-chip numbers; "
+                  "standing down", flush=True)
+            return
         # pool answered fast (hard refuse) -> don't hammer; pool pended the
         # full ~25 min -> re-queue immediately, the wait IS the backoff
         time.sleep(120 if dt < 300 else 10)
