@@ -1571,6 +1571,8 @@ def resident_bench() -> dict:
     from surge_tpu.config import default_config
     from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
     from surge_tpu.models import counter
+    from surge_tpu.replay.ledger import ReplayLedger
+    from surge_tpu.replay.profiler import ReplayProfiler
     from surge_tpu.replay.resident_state import ResidentStatePlane
     from surge_tpu.serialization import SerializedMessage
     from surge_tpu.store.kv import InMemoryKeyValueStore
@@ -1637,6 +1639,12 @@ def resident_bench() -> dict:
                  "resident_rounds": rounds}
 
     async def scenario() -> None:
+        # the device observatory rides the measured plane (the production
+        # default since ISSUE 16): the ledger's per-round accounting is what
+        # the waste-ratio / per-stage rows below read, and the overhead arm
+        # detaches it to prove the riding costs nothing
+        ledger = ReplayLedger(name="bench:resident")
+        observatory = ReplayProfiler.counters()
         plane = ResidentStatePlane(
             log_t, "events", counter.make_replay_spec(),
             config=default_config().with_overrides({
@@ -1645,7 +1653,8 @@ def resident_bench() -> dict:
             }),
             deserialize_event=lambda b: evt_fmt.read_event(
                 SerializedMessage(key="", value=b)),
-            serialize_state=lambda a, s: state_fmt.write_state(s).value)
+            serialize_state=lambda a, s: state_fmt.write_state(s).value,
+            profiler=observatory, ledger=ledger)
         t0 = time.perf_counter()
         await plane.start()
         out["resident_seed_s"] = round(time.perf_counter() - t0, 2)
@@ -1733,6 +1742,56 @@ def resident_bench() -> dict:
         out["resident_fold_rounds"] = plane.stats["rounds"]
         log(f"refresh loop: {folded} events folded in {fold_s:.2f}s "
             f"({out['resident_fold_events_per_sec']} ev/s sustained)")
+
+        # -- the device observatory's read of the same fold window --------
+        summ = ledger.summary()
+        stages = ledger.round_stages_us()
+        med_us = lambda k: (round(statistics.median(stages[k]))  # noqa: E731
+                            if stages[k] else 0)
+        out["resident_waste_ratio"] = round(summ["waste_ratio"], 2)
+        out["resident_us_per_slot"] = round(summ["us_per_slot"], 2)
+        out["resident_stage_medians_us"] = {
+            "feed": med_us("feed_us"), "encode": med_us("encode_us"),
+            "dispatch": med_us("dispatch_us")}
+        s = out["resident_stage_medians_us"]
+        log(f"observatory: waste {out['resident_waste_ratio']}x, "
+            f"{out['resident_us_per_slot']} us/slot, round medians "
+            f"feed {s['feed']} / encode {s['encode']} / "
+            f"dispatch {s['dispatch']} us")
+
+        # -- observatory overhead: ledger+profiler on vs OFF, interleaved --
+        # (the always-on claim: counters are perf_counter pairs + dict adds;
+        # the paired medians must sit inside this host's noise band)
+        obs_cycles = int(os.environ.get("SURGE_BENCH_RESIDENT_OBS_CYCLES", 4))
+        obs_events = int(os.environ.get(
+            "SURGE_BENCH_RESIDENT_OBS_EVENTS", 10_000))
+        if obs_cycles:
+            obs: dict = {"on": [], "off": []}
+            for rnd in range(rounds):
+                order = ("off", "on") if rnd % 2 else ("on", "off")
+                for name in order:
+                    plane.ledger = ledger if name == "on" else None
+                    plane.profiler = observatory if name == "on" else None
+                    t0 = time.perf_counter()
+                    for _ in range(obs_cycles):
+                        publish(make_batch(obs_events))
+                        while plane.lag_records() > 0:
+                            await asyncio.sleep(0.01)
+                    obs[name].append(obs_cycles * obs_events
+                                     / (time.perf_counter() - t0))
+            out["resident_observatory_overhead"] = {
+                "events_per_cycle": obs_events, "cycles": obs_cycles,
+                "on_events_per_sec": round(statistics.median(obs["on"])),
+                "off_events_per_sec": round(statistics.median(obs["off"])),
+                "on_vs_off": round(statistics.median(obs["on"])
+                                   / statistics.median(obs["off"]), 3),
+                "on_rounds": [round(x) for x in obs["on"]],
+                "off_rounds": [round(x) for x in obs["off"]],
+            }
+            o = out["resident_observatory_overhead"]
+            log(f"observatory overhead: on {o['on_events_per_sec']} vs off "
+                f"{o['off_events_per_sec']} ev/s ({o['on_vs_off']}x, "
+                f"medians over {rounds} interleaved rounds)")
         await plane.stop()
 
     asyncio.run(scenario())
@@ -1861,7 +1920,11 @@ def mesh_bench() -> dict:
     async def plane_arm(gather: str, cap: int, log_t, publish,
                         measure_reads: bool):
         """One arm at one capacity rung: steady-state fold cycles (+ the
-        read row at the first rung). Returns (fold eps, reads/s|None)."""
+        read row at the first rung). Returns (fold eps, reads/s|None,
+        the arm's device-observatory ledger summary + stage columns)."""
+        from surge_tpu.replay.ledger import ReplayLedger
+
+        ledger = ReplayLedger(name=f"bench:mesh:{gather}")
         plane = ResidentStatePlane(
             log_t, "events", counter.make_replay_spec(),
             config=default_config().with_overrides({
@@ -1872,7 +1935,7 @@ def mesh_bench() -> dict:
             deserialize_event=lambda b: evt_fmt.read_event(
                 SerializedMessage(key="", value=b)),
             serialize_state=lambda a, s: state_fmt.write_state(s).value,
-            mesh=mesh)
+            mesh=mesh, ledger=ledger)
         await plane.start()
         try:
             publish(fold_events)  # warm the refresh program's shape bucket
@@ -1900,23 +1963,31 @@ def mesh_bench() -> dict:
                                        for w in range(read_workers)))
                 reads = (read_workers * read_loops * read_batch
                          / (time.perf_counter() - t0))
-            return eps, reads
+            summ = ledger.summary()
+            stages = ledger.round_stages_us()
+            obs = {"waste_ratio": summ["waste_ratio"],
+                   "us_per_slot": summ["us_per_slot"],
+                   "stages": stages}
+            return eps, reads, obs
         finally:
             await plane.stop()
 
     per_rung: dict = {c: {"local": [], "replicated": []} for c in cap_ladder}
     read_rows: dict = {"local": [], "replicated": []}
+    obs_rows: dict = {"local": [], "replicated": []}
     for rnd in range(rounds):
         order = ("replicated", "local") if rnd % 2 else ("local", "replicated")
         for cap in cap_ladder:
             for arm in order:
                 log_t, publish = make_plane_log()  # identical fresh log/arm
-                eps, reads = asyncio.run(plane_arm(
+                eps, reads, obs = asyncio.run(plane_arm(
                     arm, cap, log_t, publish,
                     measure_reads=cap == cap_ladder[0]))
                 per_rung[cap][arm].append(eps)
                 if reads is not None:
                     read_rows[arm].append(reads)
+                if cap == cap_ladder[0]:
+                    obs_rows[arm].append(obs)
     med = statistics.median
     out["mesh_fold_ladder"] = [{
         "capacity": c,
@@ -1945,6 +2016,27 @@ def mesh_bench() -> dict:
         f"{rr['local_reads_per_sec']} vs replicated "
         f"{rr['replicated_reads_per_sec']} reads/s "
         f"({rr['local_vs_replicated']}x)")
+
+    # -- the device observatory's read of the first rung, per arm ----------
+    out["mesh_observatory"] = {}
+    for arm in ("local", "replicated"):
+        waste = med(o["waste_ratio"] for o in obs_rows[arm])
+        all_stages = {k: [v for o in obs_rows[arm]
+                          for v in o["stages"][k]]
+                      for k in ("feed_us", "encode_us", "dispatch_us")}
+        out["mesh_observatory"][arm] = {
+            "waste_ratio": round(waste, 2),
+            "us_per_slot": round(med(o["us_per_slot"]
+                                     for o in obs_rows[arm]), 2),
+            "stage_medians_us": {
+                k[:-3]: (round(med(v)) if v else 0)
+                for k, v in all_stages.items()},
+        }
+        o = out["mesh_observatory"][arm]
+        s = o["stage_medians_us"]
+        log(f"observatory [{arm}]: waste {o['waste_ratio']}x, "
+            f"{o['us_per_slot']} us/slot, round medians feed {s['feed']} / "
+            f"encode {s['encode']} / dispatch {s['dispatch']} us")
 
     # -- sharded-scan throughput row (the query engine) ---------------------
     import random as _random

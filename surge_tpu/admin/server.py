@@ -43,6 +43,11 @@ METHODS = {
     # broker DumpTraces dumps into whole command traces
     # (observability/anatomy.py). Same "last:N" tail convention as DumpFlight
     "DumpTraces": (pb.ComponentRequest, pb.MetricsReply),
+    # refresh-round ledger (surge_tpu.replay.ledger): the device
+    # observatory's per-round padding-waste / per-stage anatomy in the same
+    # merge-ready flight envelope (role "ledger"), with the roofline summary
+    # riding alongside. Same "last:N" tail convention as DumpFlight
+    "DumpReplayLedger": (pb.ComponentRequest, pb.MetricsReply),
     # TPU scan engine over committed columnar segments (surge_tpu.replay.
     # query; docs/replay.md "Query engine"). Message reuse, same as
     # GetMetricsText: ComponentRequest.name carries the query as JSON
@@ -128,6 +133,24 @@ class AdminServer:
                           "surge.trace.tail.enabled=false)"}).encode())
         return pb.MetricsReply(
             metrics_json=json.dumps(ring.dump(last)).encode())
+
+    async def DumpReplayLedger(self, request, context) -> pb.MetricsReply:
+        """The refresh-round ledger's merge-ready dump: round / gather /
+        query anatomy events plus the roofline summary rollup. An engine
+        without the resident plane's observatory answers an error payload."""
+        last = None
+        name = request.name or ""
+        if name.startswith("last:"):
+            try:
+                last = int(name.partition(":")[2])
+            except ValueError:
+                last = None
+        ledger = getattr(self.engine, "replay_ledger", None)
+        if ledger is None:
+            return pb.MetricsReply(metrics_json=json.dumps(
+                {"error": "engine has no replay ledger"}).encode())
+        return pb.MetricsReply(
+            metrics_json=json.dumps(ledger.dump(last)).encode())
 
     async def ListComponents(self, request, context) -> pb.RegistrationsReply:
         return pb.RegistrationsReply(
@@ -309,6 +332,19 @@ class AdminClient:
         r = await self._calls["DumpTraces"](pb.ComponentRequest(name=name))
         payload = json.loads(r.metrics_json)
         if "error" in payload and "traces" not in payload:
+            raise RuntimeError(payload["error"])
+        return payload
+
+    async def replay_ledger_dump(self, last: Optional[int] = None) -> dict:
+        """The engine's refresh-round ledger dump (merge-ready envelope +
+        roofline ``summary``: feed it to merge_dumps alongside flight dumps
+        so fold rounds land on the incident timeline). Raises RuntimeError
+        on an engine without the observatory."""
+        name = f"last:{last}" if last is not None else ""
+        r = await self._calls["DumpReplayLedger"](
+            pb.ComponentRequest(name=name))
+        payload = json.loads(r.metrics_json)
+        if "error" in payload and "events" not in payload:
             raise RuntimeError(payload["error"])
         return payload
 

@@ -208,6 +208,19 @@ class MetricCatalog(Rule):
 
         for mod in ctx.modules:
             if mod.rel_path == SLO_MODULE:
+                for name, line in self._slo_names(mod):
+                    # the objective NAME is the operator vocabulary — burn
+                    # pages, surgetop's breach column and the runbooks all
+                    # speak it; an undocumented objective pages in a word
+                    # docs/observability.md cannot explain
+                    if name not in docs:
+                        yield Finding(
+                            rule=self.id, path=mod.rel_path, line=line,
+                            message=(f"SLO objective `{name}` is missing "
+                                     f"from the {OBSERVABILITY_DOC} SLO "
+                                     "table — document its target and what "
+                                     "a burn page means"),
+                            snippet=mod.line_text(line))
                 for fam, line in self._slo_families(mod):
                     if not any(g == fam or g.startswith(fam + "_")
                                for g in golden_families):
@@ -237,6 +250,27 @@ class MetricCatalog(Rule):
                                      "regen_golden_metrics.py (golden and docs "
                                      "catalog move together)"),
                             snippet=mod.line_text(line))
+
+    @staticmethod
+    def _slo_names(mod: ModuleContext) -> Iterator[Tuple[str, int]]:
+        """(objective name, line) for every ``SLO("name", ...)`` literal
+        in the SLO module (positional ``name`` is arg index 0)."""
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = node.func.id if isinstance(node.func, ast.Name) else (
+                node.func.attr if isinstance(node.func, ast.Attribute)
+                else None)
+            if leaf != "SLO":
+                continue
+            literals = list(node.args[:1])
+            literals.extend(kw.value for kw in node.keywords
+                            if kw.arg == "name")
+            for arg in literals:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                                str) \
+                        and arg.value:
+                    yield arg.value, node.lineno
 
     @staticmethod
     def _slo_families(mod: ModuleContext) -> Iterator[Tuple[str, int]]:

@@ -202,6 +202,10 @@ DEFAULTS: dict[str, Any] = {
     # object build per event. false = the per-event Python feed (the paired
     # bench arm; also the behavior when the model wires no batch decoder)
     "surge.replay.resident.native-feed": True,
+    # device observatory (ISSUE 16): refresh rounds retained in the engine's
+    # bounded replay ledger ring (per-round padding-waste / stage timings /
+    # gather legs, dumped via the DumpReplayLedger admin RPC)
+    "surge.replay.resident.ledger-capacity": 512,
     # --- mesh-native resident plane (surge_tpu.replay.plane_mesh) ---
     # how a mesh-backed plane resolves reads/folds against its sharded slab:
     # "local" (default) shards the slab [n_dev, rows] and answers each

@@ -149,6 +149,17 @@ class SurgeEngine(Controllable):
             capacity=self.config.get_int("surge.engine.flight-capacity", 1024),
             name=f"engine:{logic.aggregate_name}", role="engine")
         self.health_bus.subscribe(self._flight_health_signal)
+        # refresh-round ledger (the device observatory): every resident-plane
+        # fold round's padding-waste / per-stage anatomy and every gather
+        # drain's device legs, in the flight envelope shape — DumpReplayLedger
+        # pulls it, merge_dumps interleaves it with flight dumps, and
+        # tools/roofline_record.py snapshots its summary
+        from surge_tpu.replay.ledger import ReplayLedger
+
+        self.replay_ledger = ReplayLedger(
+            capacity=self.config.get_int(
+                "surge.replay.resident.ledger-capacity", 512),
+            name=f"engine:{logic.aggregate_name}")
         # tail-kept trace ring (the flight ring's trace twin, ISSUE 14):
         # install_tail attaches a TailSampler to the tracer so completed
         # traces that erred / breached surge.trace.tail.latency-ms / landed
@@ -214,6 +225,12 @@ class SurgeEngine(Controllable):
                 # per-event path
                 batch_read = getattr(logic.event_format,
                                      "read_events_batch", None)
+                # counter-only profiler, ALWAYS wired (the un-gated "refresh"
+                # umbrella): per-stage seconds/counts accumulate for the
+                # observatory while the surge.replay.profile.* histograms
+                # stay opt-in behind a DEBUG registry (sensor-level gating)
+                from surge_tpu.replay.profiler import ReplayProfiler
+
                 self.resident_plane = ResidentStatePlane(
                     self.log, logic.events_topic, spec, config=self.config,
                     partitions=[],  # assigned at start (follows the indexer)
@@ -225,7 +242,10 @@ class SurgeEngine(Controllable):
                     derived_cols=getattr(logic, "derived_cols", None),
                     mesh=self._resolve_mesh(), metrics=self.metrics,
                     on_signal=self.health_bus.signal_fn("resident-plane"),
-                    flight=self.flight)
+                    profiler=ReplayProfiler.counters(metrics=self.metrics,
+                                                     tracer=tracer),
+                    flight=self.flight, ledger=self.replay_ledger,
+                    tracer=tracer)
         self.checkpoint_writer = None
         ckpt_path = self.config.get_str("surge.store.checkpoint.path", "")
         if ckpt_path and logic.events_topic:
@@ -882,9 +902,7 @@ class SurgeEngine(Controllable):
                 partitions=set(partitions) if partitions is not None else None)
 
         result = await loop.run_in_executor(None, run)
-        self.metrics.query_scan_timer.record_ms(result.elapsed_s * 1000.0)
-        self.metrics.query_scanned_events.record(result.scanned_events)
-        self.metrics.query_result_rows.record(result.num_aggregates)
+        self._record_query(result, "scan")
         return result
 
     async def query_states(self, query, partitions=None):
@@ -914,10 +932,41 @@ class SurgeEngine(Controllable):
                 partitions=set(partitions) if partitions is not None else None)
 
         result = await loop.run_in_executor(None, run)
-        self.metrics.query_scan_timer.record_ms(result.elapsed_s * 1000.0)
-        self.metrics.query_scanned_events.record(result.scanned_events)
-        self.metrics.query_result_rows.record(result.num_aggregates)
+        self._record_query(result, "state")
         return result
+
+    def _record_query(self, result, kind: str) -> None:
+        """Query-engine observability off one scan result: the coarse
+        timers plus the observatory's scan-rows / pushdown-selectivity
+        instruments, the ledger's ``query`` event, and (traced) a
+        retro-dated ``query.scan`` span whose device leg lets trace
+        anatomy attribute a slow query to device dispatch."""
+        m = self.metrics
+        m.query_scan_timer.record_ms(result.elapsed_s * 1000.0)
+        m.query_scanned_events.record(result.scanned_events)
+        m.query_result_rows.record(result.num_aggregates)
+        m.query_scan_rows.record(result.num_aggregates)
+        m.query_pushdown_selectivity.record(
+            result.matched_events / result.scanned_events
+            if result.scanned_events else 0.0)
+        self.replay_ledger.record_query(
+            rows=result.num_aggregates, scanned=result.scanned_events,
+            matched=result.matched_events,
+            elapsed_us=result.elapsed_s * 1e6, kind=kind)
+        if self.tracer is not None:
+            span = self.tracer.start_span("query.scan")
+            # retro-dated on BOTH clocks (the profiler span discipline):
+            # the tail sampler and anatomy read the mono pair first
+            span.start_time = time.time() - result.elapsed_s
+            span.start_mono = time.monotonic() - result.elapsed_s
+            try:
+                span.set_attribute("kind", kind)
+                span.set_attribute("leg.dispatch-ms",
+                                   round(result.elapsed_s * 1000.0, 3))
+                span.set_attribute("rows", result.num_aggregates)
+                span.set_attribute("scanned", result.scanned_events)
+            finally:
+                span.finish()
 
 
 class EngineNotRunningError(Exception):

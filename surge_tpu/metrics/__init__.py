@@ -255,12 +255,25 @@ class EngineMetrics:
     resident_fold_lag: Sensor = field(init=False)
     resident_gather_batch: Sensor = field(init=False)
     resident_fallbacks: Sensor = field(init=False)
+    resident_fallbacks_lag: Sensor = field(init=False)
+    resident_fallbacks_lane_error: Sensor = field(init=False)
+    resident_fallbacks_poison: Sensor = field(init=False)
+    resident_fallbacks_untracked: Sensor = field(init=False)
     resident_evictions: Sensor = field(init=False)
+    # device observatory (replay/ledger.py): per-round padding-waste and
+    # dispatch-efficiency accounting off the refresh-round ledger
+    resident_padding_waste_ratio: Sensor = field(init=False)
+    resident_dispatch_occupancy: Sensor = field(init=False)
+    resident_events_per_dispatch_us: Sensor = field(init=False)
+    resident_round_events: Sensor = field(init=False)
+    resident_shard_skew: Sensor = field(init=False)
     # TPU scan engine over columnar segments (surge_tpu.replay.query): the
     # analytics plane's scan cadence and coverage
     query_scan_timer: Timer = field(init=False)
     query_scanned_events: Sensor = field(init=False)
     query_result_rows: Sensor = field(init=False)
+    query_scan_rows: Sensor = field(init=False)
+    query_pushdown_selectivity: Sensor = field(init=False)
     # log compaction + state checkpoints (surge_tpu.log.compactor /
     # surge_tpu.store.checkpoint — the bounded-cold-start subsystem)
     compaction_runs: Sensor = field(init=False)
@@ -402,11 +415,49 @@ class EngineMetrics:
         self.resident_fallbacks = m.counter(MI(
             "surge.replay.resident.fallback-reads",
             "reads answered by the host KV store instead of the device "
-            "slab (not resident, stale, revoked or poisoned)"))
+            "slab (every cause; the .lag-exceeded/.lane-error/"
+            ".unschema-poison/.untracked splits name why)"))
+        self.resident_fallbacks_lag = m.counter(MI(
+            "surge.replay.resident.fallback-reads.lag-exceeded",
+            "fallback reads whose partition fold watermark lagged past "
+            "surge.replay.resident.max-lag-records (or require_current "
+            "demanded lag 0)"))
+        self.resident_fallbacks_lane_error = m.counter(MI(
+            "surge.replay.resident.fallback-reads.lane-error",
+            "fallback reads failed over by a gather-lane device/decode "
+            "error (the batch went to the host store)"))
+        self.resident_fallbacks_poison = m.counter(MI(
+            "surge.replay.resident.fallback-reads.unschema-poison",
+            "fallback reads of aggregates poisoned off the tensor path "
+            "(an event outside the replay schema)"))
+        self.resident_fallbacks_untracked = m.counter(MI(
+            "surge.replay.resident.fallback-reads.untracked",
+            "fallback reads of aggregates the plane does not track "
+            "(never admitted, revoked, or the plane is stopped/unseeded)"))
         self.resident_evictions = m.counter(MI(
             "surge.replay.resident.evictions",
             "aggregates evicted from the slab to the host spill "
             "(capacity pressure)"))
+        self.resident_padding_waste_ratio = m.gauge(MI(
+            "surge.replay.resident.padding-waste-ratio",
+            "last refresh round's dispatched-to-occupied event-slot ratio "
+            "(pow8 lane bucket x window width over events folded; the "
+            "over-dispatch the fold-efficiency SLO bounds)"))
+        self.resident_dispatch_occupancy = m.gauge(MI(
+            "surge.replay.resident.dispatch-occupancy",
+            "last refresh round's occupied fraction of dispatched event "
+            "slots (1 / padding-waste-ratio)"))
+        self.resident_events_per_dispatch_us = m.gauge(MI(
+            "surge.replay.resident.events-per-dispatch-us",
+            "events folded per microsecond of device fold dispatch in the "
+            "last refresh round (the fold roofline's measured ev/us)"))
+        self.resident_round_events = m.gauge(MI(
+            "surge.replay.resident.round-events",
+            "events folded by the last refresh round"))
+        self.resident_shard_skew = m.gauge(MI(
+            "surge.replay.resident.shard-skew",
+            "last refresh round's max/mean lane-deal imbalance across mesh "
+            "shards (1.0 = perfectly balanced; single-device rounds read 1)"))
         self.query_scan_timer = m.timer(MI(
             "surge.query.scan-timer",
             "ms per segment scan / state query (device dispatch + the one "
@@ -419,6 +470,14 @@ class EngineMetrics:
             "surge.query.result-rows",
             "aggregates in the last query result (post-filter, pre-RPC "
             "surge.query.max-rows cap)"))
+        self.query_scan_rows = m.counter(MI(
+            "surge.query.scan-rows",
+            "result rows emitted by the query engine across scans "
+            "(cumulative twin of the per-scan result-rows gauge)"))
+        self.query_pushdown_selectivity = m.gauge(MI(
+            "surge.query.pushdown-selectivity",
+            "matched/scanned event fraction of the last scan (how much the "
+            "predicate pushdown narrowed before grouping)"))
         self.compaction_runs = m.counter(MI(
             "surge.log.compaction.runs", "partition compaction passes"))
         self.compaction_bytes_reclaimed = m.counter(MI(

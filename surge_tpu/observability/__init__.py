@@ -31,10 +31,16 @@ from surge_tpu.observability.flight import (
     reconstruct_failover,
     same_clock_domain,
 )
+from surge_tpu.observability.roofline import (
+    RooflineRecorder,
+    against_reference,
+    roofline_row,
+)
 from surge_tpu.observability.slo import DEFAULT_SLOS, SLO, SLOEngine
 
-__all__ = ["DEFAULT_SLOS", "FederatedScraper", "FlightRecorder", "SLO",
-           "SLOEngine", "ScrapeTarget", "assemble_traces", "attribute_trace",
+__all__ = ["DEFAULT_SLOS", "FederatedScraper", "FlightRecorder",
+           "RooflineRecorder", "SLO", "SLOEngine", "ScrapeTarget",
+           "against_reference", "assemble_traces", "attribute_trace",
            "attribution_table", "dominant_leg", "host_wall_offset",
            "merge_dumps", "parse_openmetrics", "reconstruct_failover",
-           "same_clock_domain", "target_from_spec"]
+           "roofline_row", "same_clock_domain", "target_from_spec"]

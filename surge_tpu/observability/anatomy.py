@@ -33,6 +33,14 @@ span's wall time into named legs along the ack critical path:
   (``leg.repl-ms``);
 - ``reply-decode`` — client-observed broker-call time not accounted on the
   broker (wire + reply decode);
+- ``gather-coalesce`` / ``device-dispatch`` / ``fetch-barrier`` /
+  ``decode`` — the DEVICE legs (the fold anatomy, ISSUE 16): resident-plane
+  ``resident.gather`` and engine ``query.scan`` spans carry measured
+  ``leg.{coalesce,dispatch,fetch,decode}-ms`` attributes, and the replay
+  profiler's ``replay.dispatch``/``replay.compile``/``replay.fetch`` stage
+  spans map by name — so a stalled refresh dispatch names
+  ``device-dispatch`` dominant the same way a slow WAL names
+  ``journal-fsync``;
 - ``other`` — root residue none of the above claims (reply fan-out, event
   loop scheduling).
 
@@ -57,7 +65,8 @@ __all__ = ["LEGS", "assemble_traces", "attribute_trace", "attribution_table",
 #: attribution legs in critical-path order (the table's row order)
 LEGS = ("mailbox-wait", "command-handling", "publisher-linger",
         "lane-dispatch", "router-resolve", "gate-wait", "journal-fsync",
-        "replication-ack", "reply-decode", "other")
+        "replication-ack", "reply-decode", "gather-coalesce",
+        "device-dispatch", "fetch-barrier", "decode", "other")
 
 #: broker span attributes carrying measured waits (surge_tpu/log/server.py
 #: stamps them on the active ``log.server.transact`` span)
@@ -70,6 +79,25 @@ _BROKER_ATTR_LEGS = (("leg.gate-wait-ms", "gate-wait"),
 #: ``log.Read`` span — aggregating it would dilute every command leg)
 _COMMAND_MARKERS = ("aggregate-ref.", "entity.", "publisher.",
                     "router.commit", "log.server.transact", "log.Transact")
+
+#: span-name prefixes of the device planes (resident gather lane, query
+#: engine, replay profiler stages) — accepted alongside the command markers
+#: so a kept device trace attributes instead of being skipped as noise
+_DEVICE_MARKERS = ("resident.", "query.", "replay.")
+
+#: device span attributes carrying measured leg times (resident_state's
+#: gather spans, pipeline's query spans — measured, not inferred)
+_DEVICE_ATTR_LEGS = (("leg.coalesce-ms", "gather-coalesce"),
+                     ("leg.dispatch-ms", "device-dispatch"),
+                     ("leg.fetch-ms", "fetch-barrier"),
+                     ("leg.decode-ms", "decode"))
+
+#: replay-profiler stage spans carry no leg attributes — their whole
+#: duration IS the leg, mapped by name (host stages encode/h2d stay in
+#: ``other``: they are not device legs)
+_DEVICE_NAME_LEGS = (("replay.dispatch", "device-dispatch"),
+                     ("replay.compile", "device-dispatch"),
+                     ("replay.fetch", "fetch-barrier"))
 
 
 def _place(span: dict, offset: Optional[float]) -> dict:
@@ -188,6 +216,28 @@ def attribute_trace(spans: Sequence[dict]) -> Optional[dict]:
                 legs[leg] += float((b.get("attributes") or {}).get(attr, 0.0))
             except (TypeError, ValueError):
                 pass
+    # device legs (the fold anatomy): gather/query spans claim their
+    # measured leg attributes; attribute-less profiler stage spans map by
+    # name — a span claims via attributes OR name, never both (the
+    # attributes already decompose the span's own duration)
+    for s in spans:
+        name = s.get("name", "")
+        if not name.startswith(_DEVICE_MARKERS):
+            continue
+        attrs = s.get("attributes") or {}
+        claimed = False
+        for attr, leg in _DEVICE_ATTR_LEGS:
+            if attr in attrs:
+                try:
+                    legs[leg] += float(attrs[attr])
+                    claimed = True
+                except (TypeError, ValueError):
+                    pass
+        if not claimed:
+            for prefix, leg in _DEVICE_NAME_LEGS:
+                if name.startswith(prefix):
+                    legs[leg] += _dur(s)
+                    break
     # client-observed broker time the broker itself does not account for:
     # wire + request encode + reply decode
     if client_calls and broker_spans:
@@ -233,6 +283,7 @@ def attribution_table(traces: Dict[str, List[dict]], metrics=None,
     for tid, spans in traces.items():
         if command_only and not any(
                 s.get("name", "").startswith(_COMMAND_MARKERS)
+                or s.get("name", "").startswith(_DEVICE_MARKERS)
                 for s in spans):
             continue
         row = attribute_trace(spans)

@@ -97,6 +97,12 @@ DEFAULT_SLOS: Tuple[SLO, ...] = (
         kind="bound", objective=0.99, threshold=4096.0, op="gt",
         description="the resident plane's fold lag stays within the "
                     "read-path staleness bound"),
+    SLO("resident-fold-efficiency",
+        family="surge_replay_resident_padding_waste_ratio",
+        kind="bound", objective=0.99, threshold=16.0, op="gt",
+        description="refresh rounds keep padding over-dispatch within the "
+                    "pow8-lane x window-tail envelope (waste ratio <= 16x; "
+                    "beyond it ragged traffic is mostly padding the device)"),
     SLO("quorum-hwm-lag",
         family="surge_log_hwm_lag_records",
         kind="bound", objective=0.99, threshold=10_000.0, op="gt",

@@ -20,6 +20,7 @@ from surge_tpu.replay.engine import (
     make_step_fn,
     make_batch_fold,
 )
+from surge_tpu.replay.ledger import ReplayLedger
 from surge_tpu.replay.mixed import MixedReplay, combine_replay_specs
 from surge_tpu.replay.query import (
     Aggregate,
@@ -34,6 +35,7 @@ from surge_tpu.replay.seqpar import AssociativeFold, replay_time_sharded
 
 __all__ = ["ReplayEngine", "ReplayResult", "ResidentWire", "MixedReplay",
            "combine_replay_specs", "AssociativeFold", "replay_time_sharded",
-           "make_step_fn", "make_batch_fold", "ResidentStatePlane",
+           "make_step_fn", "make_batch_fold", "ReplayLedger",
+           "ResidentStatePlane",
            "QueryEngine", "ScanQuery", "StateQuery", "Predicate", "Aggregate",
            "QueryResult"]

@@ -74,6 +74,9 @@ class MeshPlane:
         self._sh4 = NamedSharding(self.mesh, P(self.axis, None, None, None))
         self._rep = NamedSharding(self.mesh, P())
         self._programs: dict = {}
+        #: lane-deal sizes of the last refresh round — the device
+        #: observatory's shard-skew source (max/mean over these)
+        self.last_deal: List[int] = []
 
     # -- layout helpers -------------------------------------------------------------
 
@@ -122,6 +125,7 @@ class MeshPlane:
 
         a_deals, a_b = self._deal(admit_idx)
         l_deals, l_b = self._deal(lane_slots)
+        self.last_deal = [len(d) for d in l_deals]
         per_dev, n_dev = self.per_dev, self.n_dev
         width = packed.shape[0]
         nbytes = packed.shape[2]

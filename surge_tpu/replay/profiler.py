@@ -26,11 +26,23 @@ roofline analysis reasons about (docs/roofline.md):
   cold-start passes; ``refresh`` is the per-round umbrella.
 
 Each stage occurrence feeds the DEBUG-level ``surge.replay.profile.*`` timers
-in :class:`~surge_tpu.metrics.EngineMetrics` (free at INFO: the sensors are
-disabled and the engine holds no profiler at all on the default path), emits a
-span when a tracer is attached, and — when ``jax.profiler`` is importable —
-wraps device-dispatching stages in ``jax.profiler.TraceAnnotation`` so the
-stages line up with XLA ops in a captured device profile.
+in :class:`~surge_tpu.metrics.EngineMetrics`, emits a span when a tracer is
+attached, and — when ``jax.profiler`` is importable — wraps
+device-dispatching stages in ``jax.profiler.TraceAnnotation`` so the stages
+line up with XLA ops in a captured device profile.
+
+Two modes, same names (docs/observability.md):
+
+- **counter-only** (:meth:`ReplayProfiler.counters`) — always on; the
+  resident plane's per-round "refresh" umbrella runs through it. Stage
+  seconds/counts accumulate as plain float/int bumps and the histogram
+  ``record_ms`` calls no-op because the timers' sensors are disabled below
+  DEBUG — the device observatory's per-stage accounting without histogram
+  cost.
+- **full histograms** (:meth:`ReplayProfiler.if_enabled`, or the same
+  counters profiler under a DEBUG registry) — the cold-start replay path's
+  opt-in: every stage occurrence also lands in the
+  ``surge.replay.profile.*`` timer distributions.
 
 Usage::
 
@@ -106,6 +118,21 @@ class ReplayProfiler:
         ``profiler=None`` and every hook short-circuits on one ``is None``)."""
         if registry.recording_level < RecordingLevel.DEBUG:
             return None
+        return cls(metrics=metrics, tracer=tracer, annotate=annotate)
+
+    @classmethod
+    def counters(cls, metrics: Optional[EngineMetrics] = None,
+                 tracer=None, annotate: bool = True) -> "ReplayProfiler":
+        """Counter-only mode: ALWAYS returns a profiler (no recording-level
+        gate). The resident plane's per-round "refresh" umbrella runs through
+        this — cheap always-on accounting (``stage_s``/``stage_n`` float/int
+        bumps, the device observatory's per-stage wall µs) with the histogram
+        cost still opt-in: the ``surge.replay.profile.*`` timers are
+        registered at DEBUG, so at the default INFO recording level their
+        sensors are disabled and ``record_ms`` is a no-op. Raising the
+        registry to DEBUG upgrades the SAME profiler to full-histogram mode
+        with zero call-site changes — the names stay stable across both
+        modes (docs/observability.md, "Two profiler modes")."""
         return cls(metrics=metrics, tracer=tracer, annotate=annotate)
 
     # -- recording ----------------------------------------------------------------------

@@ -69,6 +69,7 @@ from surge_tpu.config import Config, default_config
 from surge_tpu.engine.model import ReplaySpec
 from surge_tpu.log.transport import page_keyed_records
 from surge_tpu.replay.engine import ReplayEngine, make_batch_fold
+from surge_tpu.replay.ledger import shard_skew, waste_ratio
 
 __all__ = ["ResidentStatePlane"]
 
@@ -109,7 +110,8 @@ class ResidentStatePlane(Controllable):
                  derived_cols: Mapping[str, str] | None = None,
                  mesh=None, metrics=None,
                  on_signal: Callable[[str, str], None] | None = None,
-                 profiler=None, flight=None) -> None:
+                 profiler=None, flight=None, ledger=None, tracer=None,
+                 faults=None) -> None:
         self.log = log
         self.events_topic = events_topic
         self.spec = spec
@@ -137,6 +139,17 @@ class ResidentStatePlane(Controllable):
         #: incident-timeline material (a rebalance purging slab rows explains
         #: the fallback-read spike that follows it)
         self.flight = flight
+        #: refresh-round ledger (surge_tpu.replay.ledger.ReplayLedger,
+        #: optional): every round's padding-waste / per-stage anatomy, every
+        #: gather drain's coalesce+device legs — the device observatory
+        self.ledger = ledger
+        #: tracer (optional): the gather lane emits "resident.gather" spans
+        #: carrying leg.{coalesce,dispatch,fetch,decode}-ms attributes, so
+        #: tail-kept traces break down into device legs in trace anatomy
+        self.tracer = tracer
+        #: FaultPlane (optional): the refresh executor passes through the
+        #: "resident.refresh.dispatch" site — the stall-anatomy e2e's hook
+        self._faults = faults
 
         self.capacity = max(
             self.config.get_int("surge.replay.resident.capacity", 65536), 8)
@@ -221,6 +234,17 @@ class ResidentStatePlane(Controllable):
         self._seeded = False
         self.stats = {"rounds": 0, "folded_events": 0, "evictions": 0,
                       "gathers": 0, "gathered_rows": 0, "fallbacks": 0}
+        #: why reads fell back, cumulatively ({cause: n}) — the labeled
+        #: split of the flat fallbacks counter (see _record_fallback)
+        self.fallback_causes: Dict[str, int] = {}
+        self._round_causes: Dict[str, int] = {}  # deltas since last round
+        # per-round fold accounting (reset each refresh round): padded event
+        # slots dispatched vs occupied, device dispatch wall, window count —
+        # the padding-waste ledger's raw material
+        self._round_acc: Dict[str, Any] = {
+            "windows": 0, "dispatched": 0, "occupied": 0, "dispatch_s": 0.0,
+            "lanes": 0, "batch": 0, "width": 0, "evictions": 0}
+        self._pending_t0: Optional[float] = None  # gather coalesce-wait start
 
     def _build_state_materializer(self):
         """Precompiled row → domain-state constructor, the batch read path's
@@ -760,18 +784,21 @@ class ResidentStatePlane(Controllable):
             self._record_gauges()
             return False
         t0 = time.perf_counter()
+        self._round_acc = {
+            "windows": 0, "dispatched": 0, "occupied": 0, "dispatch_s": 0.0,
+            "lanes": 0, "batch": 0, "width": 0, "evictions": 0}
         # the heavy host-side work — per-record deserialize + tensor encode —
         # runs OFF the event loop: a fold round must not stall the command
         # path it shares the loop with (only state mutation + the program
         # dispatches run on-loop, in await-free sections)
         logs, part_of, n_events, poisons = await loop.run_in_executor(
             None, self._decode_batches, batches)
+        feed_s = time.perf_counter() - feed_t0
         if self.metrics is not None:
             # the feed's host leg: committed-tail read (native record-index
             # views) + event deserialize (one batch decode on the native
             # feed) — what the ≥100k ev/s sustained-fold target is about
-            self.metrics.resident_feed_timer.record_ms(
-                (time.perf_counter() - feed_t0) * 1000.0)
+            self.metrics.resident_feed_timer.record_ms(feed_s * 1000.0)
         for agg, p in poisons.items():
             self._poison(agg, p)
         enc_s = time.perf_counter() - t0
@@ -814,10 +841,48 @@ class ResidentStatePlane(Controllable):
             # covers encode+h2d+dispatch of the round (the h2d rides the
             # dispatch on this path — nothing is transferred ahead of it)
             self.profiler.record("encode", enc_s, kind="refresh")
-            self.profiler.record("refresh", elapsed, events=n_events,
-                                 aggregates=len(ids))
+            # the umbrella span carries its measured device legs so the
+            # command anatomy decomposes it instead of binning the whole
+            # round into `other` (the stage spans map by name; an umbrella
+            # maps by attributes — anatomy claims one or the other)
+            self.profiler.record(
+                "refresh", elapsed, events=n_events, aggregates=len(ids),
+                **{"leg.decode-ms": round(feed_s * 1000.0, 3),
+                   "leg.dispatch-ms": round(
+                       self._round_acc["dispatch_s"] * 1000.0, 3)})
+        self._observe_round(n_events, feed_s, enc_s)
         self._record_gauges()
         return True
+
+    def _observe_round(self, n_events: int, feed_s: float,
+                       enc_s: float) -> None:
+        """Device-observatory round close: the padding-waste gauges off the
+        round's slot accounting and the ledger's ``round`` event. Always on —
+        these are the instruments ROADMAP item 2's bucketing work is judged
+        against, and a waste spike you only see under DEBUG never pages."""
+        acc = self._round_acc
+        dispatched, occupied = acc["dispatched"], acc["occupied"]
+        waste = waste_ratio(dispatched, occupied)
+        dispatch_us = acc["dispatch_s"] * 1e6
+        deal = self._meshp.last_deal if self._meshp is not None else None
+        if self.metrics is not None:
+            m = self.metrics
+            m.resident_round_events.record(n_events)
+            m.resident_padding_waste_ratio.record(waste)
+            m.resident_dispatch_occupancy.record(
+                occupied / dispatched if dispatched else 0.0)
+            m.resident_events_per_dispatch_us.record(
+                n_events / dispatch_us if dispatch_us > 0 else 0.0)
+            m.resident_shard_skew.record(shard_skew(deal))
+        if self.ledger is not None:
+            causes, self._round_causes = self._round_causes, {}
+            self.ledger.record_round(
+                events=n_events, lanes=acc["lanes"], windows=acc["windows"],
+                dispatched=dispatched, occupied=occupied,
+                batch=acc["batch"], width=acc["width"],
+                feed_us=feed_s * 1e6, encode_us=enc_s * 1e6,
+                dispatch_us=dispatch_us, deal_sizes=deal,
+                causes=causes or None, evictions=acc["evictions"])
 
     def _decode_batches(self, batches: Dict[int, list]):
         """Executor half of a refresh round: deserialize + encode every
@@ -1006,6 +1071,11 @@ class ResidentStatePlane(Controllable):
         sig = ("refresh", b_bucket, width)
         fresh = sig not in self._signatures
         self._signatures.add(sig)
+        acc = self._round_acc
+        acc["lanes"] += b
+        acc["batch"] = b_bucket
+        acc["width"] = width
+        faults = self._faults
         for packed, side, counts in wins:
             if first:
                 ai, av, ao = admit_idx, admit_vals, admit_ord
@@ -1021,6 +1091,13 @@ class ResidentStatePlane(Controllable):
                        else self._refresh_prog)
             run = functools.partial(refresh, slab, ords, ai, av,
                                     ao, lane_slots, counts, packed, side)
+            if faults is not None:
+                # the stall-anatomy e2e's site, INSIDE the executor thunk so
+                # an armed delay lands in the dispatch stage's measured time
+                run = functools.partial(
+                    (lambda f, thunk: (f.point("resident.refresh.dispatch"),
+                                       thunk())[1]), faults, run)
+            d0 = time.perf_counter()
             if self.profiler is None:
                 slab, ords = await loop.run_in_executor(None, run)
             else:
@@ -1028,6 +1105,12 @@ class ResidentStatePlane(Controllable):
                                          width=width, batch=b_bucket):
                     slab, ords = await loop.run_in_executor(None, run)
                 fresh = False
+            # padding-waste accounting: the program always runs the full
+            # b_bucket × width slot grid; counts carries the occupied slots
+            acc["windows"] += 1
+            acc["dispatched"] += b_bucket * width
+            acc["occupied"] += int(counts.sum())
+            acc["dispatch_s"] += time.perf_counter() - d0
 
         # -- sync commit: publish the folded slab + directory ---------------
         self._slab, self._ords = slab, ords
@@ -1073,12 +1156,16 @@ class ResidentStatePlane(Controllable):
             self._free.append(self._dir.pop(v))
             self._lru.pop(v, None)
         self.stats["evictions"] += len(victims)
+        self._round_acc["evictions"] += len(victims)
         if self.metrics is not None:
             self.metrics.resident_evictions.record(len(victims))
         if self.flight is not None:
             self.flight.record("resident.evict", count=len(victims),
                                resident=len(self._dir),
                                spilled=len(self._spill))
+        if self.ledger is not None:
+            self.ledger.record_evict(len(victims), resident=len(self._dir),
+                                     cause="capacity")
 
     # -- pulls / decode -----------------------------------------------------------------
 
@@ -1174,10 +1261,30 @@ class ResidentStatePlane(Controllable):
             return max(end - self._watermarks.get(p, 0), 0) <= bound
         return self.partition_lag(p) <= bound
 
-    def _record_fallback(self, n: int = 1) -> None:
+    #: fallback cause -> the EngineMetrics counter carrying its split
+    _FALLBACK_CAUSE_SENSORS = {
+        "lag-exceeded": "resident_fallbacks_lag",
+        "lane-error": "resident_fallbacks_lane_error",
+        "unschema-poison": "resident_fallbacks_poison",
+        "untracked": "resident_fallbacks_untracked",
+    }
+
+    def _record_fallback(self, n: int = 1, cause: str = "untracked") -> None:
+        """One or more reads fell back to the host store, and WHY:
+        ``lag-exceeded`` (the partition's fold watermark is too stale for the
+        read's bound), ``lane-error`` (the gather batch failed on device or
+        in decode), ``unschema-poison`` (the aggregate emitted an event
+        outside the replay schema and is host-served for good), ``untracked``
+        (not resident/spilled, revoked, or the plane is stopped/unseeded).
+        The flat total keeps its name; the splits ride
+        ``surge.replay.resident.fallback-reads.<cause>``."""
         self.stats["fallbacks"] += n
+        self.fallback_causes[cause] = self.fallback_causes.get(cause, 0) + n
+        self._round_causes[cause] = self._round_causes.get(cause, 0) + n
         if self.metrics is not None:
             self.metrics.resident_fallbacks.record(n)
+            getattr(self.metrics,
+                    self._FALLBACK_CAUSE_SENSORS[cause]).record(n)
 
     async def read_state(self, aggregate_id: str, *,
                          require_current: bool = False
@@ -1195,11 +1302,13 @@ class ResidentStatePlane(Controllable):
             return (False, None)
         p = self._agg_part.get(aggregate_id)
         if p is None or p not in self._watermarks:
-            self._record_fallback()
+            self._record_fallback(cause="unschema-poison"
+                                  if aggregate_id in self._poisoned
+                                  else "untracked")
             return (False, None)
         ends = await self._ends_for((p,))
         if not self._fresh_enough(p, require_current, ends):
-            self._record_fallback()
+            self._record_fallback(cause="lag-exceeded")
             return (False, None)
         spilled = self._spill.get(aggregate_id)
         if spilled is not None:
@@ -1211,6 +1320,8 @@ class ResidentStatePlane(Controllable):
             self._record_fallback()
             return (False, None)
         fut = asyncio.get_running_loop().create_future()
+        if not self._pending:
+            self._pending_t0 = time.perf_counter()
         self._pending.append((aggregate_id, fut))
         self._touch(aggregate_id)
         self._kick_drain()
@@ -1265,11 +1376,13 @@ class ResidentStatePlane(Controllable):
                 else:
                     stale += 1
             if stale:
-                self._record_fallback(stale)
+                self._record_fallback(stale, cause="lag-exceeded")
             ok = ok_list
         if not ok:
             return {}
         fut = asyncio.get_running_loop().create_future()
+        if not self._pending:
+            self._pending_t0 = time.perf_counter()
         self._pending.append((ok, fut))
         self._kick_drain()
         return await fut
@@ -1297,8 +1410,12 @@ class ResidentStatePlane(Controllable):
         try:
             while self._pending:
                 batch, self._pending = self._pending, []
+                # coalesce wait: first enqueue of this batch → drain start
+                # (the gather-coalesce leg of the read's device anatomy)
+                t0, self._pending_t0 = self._pending_t0, None
+                wait_s = max(time.perf_counter() - t0, 0.0) if t0 else 0.0
                 try:
-                    await self._drain_batch(loop, batch)
+                    await self._drain_batch(loop, batch, wait_s)
                 except Exception:  # noqa: BLE001 — the plane is an optimization:
                     # a device/decode failure must fail the batch over to the
                     # host KV store, never strand its futures (an entity init
@@ -1319,11 +1436,11 @@ class ResidentStatePlane(Controllable):
                             fut.set_result((False, None)
                                            if isinstance(target, str) else {})
                     if n:
-                        self._record_fallback(n)
+                        self._record_fallback(n, cause="lane-error")
         finally:
             self._draining = False
 
-    async def _drain_batch(self, loop, batch) -> None:
+    async def _drain_batch(self, loop, batch, wait_s: float = 0.0) -> None:
         # snapshot slots atomically on the loop; ids evicted since
         # enqueue are served from their (exact) spill rows instead.
         # refs per id: gather position, ("spill", row) or None=miss;
@@ -1369,17 +1486,35 @@ class ResidentStatePlane(Controllable):
             slab = self._slab  # pin: a fold may replace self._slab
             off_loop = self._fetch_off_loop
             rows: Optional[Dict[str, np.ndarray]] = None
+            # device-leg clocks for the observatory: dispatch (gather program
+            # call), fetch-barrier (the d2h asarray), decode (buffer → rows →
+            # domain states) — a u16 overflow refetch accumulates both passes
+            disp_s = fetch_s = dec_s = 0.0
+            t = time.perf_counter()
             if self._gather_narrow is not None:
                 buf = self._gather_narrow(slab, idx)  # dispatch
+                disp_s += time.perf_counter() - t
+                t = time.perf_counter()
                 host = (await loop.run_in_executor(None, np.asarray, buf)
                         if off_loop else np.asarray(buf))
+                fetch_s += time.perf_counter() - t
+                t = time.perf_counter()
                 rows = self._decode_narrow(host, k, k_b)
+                dec_s += time.perf_counter() - t
             if rows is None:  # wide schema, or a u16 overflow refetch
+                t = time.perf_counter()
                 mat, _ = self._gather_wide(slab, self._ords, idx)
+                disp_s += time.perf_counter() - t
+                t = time.perf_counter()
                 host = (await loop.run_in_executor(None, np.asarray, mat)
                         if off_loop else np.asarray(mat))
+                fetch_s += time.perf_counter() - t
+                t = time.perf_counter()
                 rows = self._decode_wide(host, k)
+                dec_s += time.perf_counter() - t
+            t = time.perf_counter()
             states = self._states_of_batch(gather_ids, rows, k)
+            dec_s += time.perf_counter() - t
             # one batched LRU touch for every gathered hit (read_many
             # skips per-id touching on its fast path)
             self._tick += 1
@@ -1388,6 +1523,13 @@ class ResidentStatePlane(Controllable):
             self.stats["gathered_rows"] += k
             if self.metrics is not None:
                 self.metrics.resident_gather_batch.record(k)
+            if self.ledger is not None:
+                self.ledger.record_gather(
+                    reads=len(calls), rows=k, wait_us=wait_s * 1e6,
+                    dispatch_us=disp_s * 1e6, fetch_us=fetch_s * 1e6,
+                    decode_us=dec_s * 1e6)
+            if self.tracer is not None:
+                self._emit_gather_span(wait_s, disp_s, fetch_s, dec_s, k)
         for fut, single, ids, refs, start in calls:
             if fut.done():
                 continue
@@ -1400,10 +1542,13 @@ class ResidentStatePlane(Controllable):
                             ids, states[start:start + len(ids)])))
                     continue
                 out: Dict[str, Any] = {}
-                misses = 0
+                misses = poisons = 0
                 for agg, ref in zip(ids, refs):
                     if ref is None:
-                        misses += 1
+                        if agg in self._poisoned:
+                            poisons += 1
+                        else:
+                            misses += 1
                     elif isinstance(ref, int):
                         out[agg] = states[ref]
                     else:  # exact-fold-point spill row
@@ -1412,6 +1557,8 @@ class ResidentStatePlane(Controllable):
                                   for k, v in ref[1].items()}, 0)
                 if misses:
                     self._record_fallback(misses)
+                if poisons:
+                    self._record_fallback(poisons, cause="unschema-poison")
                 if single:
                     agg = ids[0]
                     fut.set_result((agg in out, out.get(agg)))
@@ -1420,6 +1567,27 @@ class ResidentStatePlane(Controllable):
             except Exception as exc:  # noqa: BLE001 — decode bug
                 if not fut.done():
                     fut.set_exception(exc)
+
+    def _emit_gather_span(self, wait_s: float, disp_s: float, fetch_s: float,
+                          dec_s: float, rows: int) -> None:
+        """One retro-dated ``resident.gather`` span per drained batch, its
+        device legs as ``leg.*-ms`` attributes — the read-side fold anatomy.
+        BOTH clocks are retro-dated to the measured interval (the profiler's
+        span discipline): the tail sampler's keep decision and the anatomy
+        placement read the mono pair first, so a wall-only retro-date would
+        make a stalled 2 s gather look like a 0 ms span."""
+        total = wait_s + disp_s + fetch_s + dec_s
+        span = self.tracer.start_span("resident.gather")
+        span.start_time = time.time() - total
+        span.start_mono = time.monotonic() - total
+        try:
+            span.set_attribute("leg.coalesce-ms", round(wait_s * 1000.0, 3))
+            span.set_attribute("leg.dispatch-ms", round(disp_s * 1000.0, 3))
+            span.set_attribute("leg.fetch-ms", round(fetch_s * 1000.0, 3))
+            span.set_attribute("leg.decode-ms", round(dec_s * 1000.0, 3))
+            span.set_attribute("rows", rows)
+        finally:
+            span.finish()  # unconditional: a leaked span pins its trace
 
     def _state_of(self, aggregate_id: str, record: Mapping[str, Any],
                   _j: int) -> Any:

@@ -293,6 +293,21 @@ class FaultPlane:
         if rule is not None and rule.action == "error":
             raise RuntimeError(f"fault injected at {site}: {rule.error}")
 
+    def point(self, site: str) -> None:
+        """Generic in-process site matched on the BARE site name (no
+        prefix): delay/stall rules hold the caller, error rules raise.
+        The resident plane's refresh executor passes through
+        ``resident.refresh.dispatch`` — the device-observatory stall
+        anatomy e2e arms a delay here and expects the round's
+        device-dispatch leg to dominate its kept tail trace."""
+        rule = self._match(site)
+        if rule is None:
+            return
+        if rule.action in ("delay", "reorder", "stall"):
+            self._sleep(self._hold_s(rule))
+        elif rule.action == "error":
+            raise RuntimeError(f"fault injected at {site}: {rule.error}")
+
     def crash_point(self, name: str) -> None:
         """Named crash point: fires the host's hard-stop then raises."""
         rule = self._match(f"crash.{name}")

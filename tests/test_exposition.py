@@ -133,6 +133,18 @@ def golden_engine_metrics():
     em.live_entities.record(7)
     em.standby_lag.record(3)
     em.replay_timer.record_ms(120000.0)  # overflow bucket: +Inf only in export
+    # the device observatory's round gauges + cause-split fallback counters
+    # (ISSUE 16) — the steady-ragged shape the roofline anchors on
+    em.resident_round_events.record(50)
+    em.resident_padding_waste_ratio.record(9.0)
+    em.resident_dispatch_occupancy.record(1.0 / 9.0)
+    em.resident_events_per_dispatch_us.record(0.125)
+    em.resident_shard_skew.record(1.25)
+    em.resident_fallbacks.record(3)
+    em.resident_fallbacks_lag.record(2)
+    em.resident_fallbacks_poison.record(1)
+    em.query_scan_rows.record(5)
+    em.query_pushdown_selectivity.record(0.4)
     return em
 
 

@@ -231,4 +231,4 @@ def test_default_slos_evaluate_over_the_fleet_golden():
     assert eng.breached() == []
     # the slo gauges joined the scraper's registry -> next render carries them
     text = render_openmetrics(scraper.metrics.registry)
-    assert "surge_slo_objectives 5" in text
+    assert "surge_slo_objectives 6" in text

@@ -19,6 +19,7 @@ over the broker's admin RPCs::
     python tools/chaos.py handoff 127.0.0.1:16001 127.0.0.1:16002
     python tools/chaos.py fleet broker@127.0.0.1:16001,engine@127.0.0.1:7001
     python tools/chaos.py fleet <specs> --serve 9464
+    python tools/chaos.py replay-ledger 127.0.0.1:7001 --last 32
 
 ``cluster`` drives N brokers from ONE invocation: with no flags it prints a
 per-broker summary (role, epoch, in-sync view, per-partition high-watermarks,
@@ -42,6 +43,12 @@ crash plans is the expected outcome, reported as such).
 ``status`` reports the fault plane's stats PLUS the broker's flight-recorder
 tail (``--tail N``, default 20) and its current replication-lag gauges, so a
 chaos run is debuggable from one command without attaching a scraper.
+
+``replay-ledger`` targets an ENGINE admin endpoint (not a broker) and dumps
+its device observatory — the refresh-round ledger envelope (per-round
+padding-waste / per-stage timings / gather legs, plus the roofline summary)
+over the ``DumpReplayLedger`` admin RPC. Pipe it to a file and feed
+``tools/roofline_record.py`` to append a roofline trajectory row.
 
 ``fleet`` federates EVERY target's OpenMetrics payload (``role@addr`` specs:
 ``broker@host:port`` over the log-service GetMetricsText RPC,
@@ -70,7 +77,7 @@ def main(argv=None) -> int:
     ap.add_argument("command",
                     choices=["arm", "disarm", "status", "broker", "promote",
                              "flight", "metrics", "plans", "cluster",
-                             "handoff", "fleet"])
+                             "handoff", "fleet", "replay-ledger"])
     ap.add_argument("target", nargs="?",
                     help="broker host:port (cluster: comma-separated list; "
                          "handoff: the FROM broker)")
@@ -97,6 +104,8 @@ def main(argv=None) -> int:
     ap.add_argument("--partition", type=int, default=None,
                     help="handoff: move only this partition index's "
                          "leadership (spread clusters)")
+    ap.add_argument("--last", type=int, default=None,
+                    help="replay-ledger: newest N ledger rounds")
     args = ap.parse_args(argv)
 
     if args.command == "plans":
@@ -113,6 +122,8 @@ def main(argv=None) -> int:
 
     from surge_tpu.log import GrpcLogTransport
 
+    if args.command == "replay-ledger":
+        return _replay_ledger(args)
     if args.command == "fleet":
         return _fleet(args)
     if args.command == "cluster":
@@ -207,6 +218,29 @@ def main(argv=None) -> int:
                 return 0
     finally:
         client.close()
+
+
+def _replay_ledger(args) -> int:
+    """Device-observatory dump from the CLI: one ``DumpReplayLedger``
+    envelope (refresh rounds + roofline summary) off an ENGINE admin
+    endpoint, printed as JSON — a down/observatory-less engine is a
+    reported finding, exit 1."""
+    import asyncio
+
+    import grpc
+
+    from surge_tpu.admin.server import AdminClient
+
+    async def fetch():
+        async with grpc.aio.insecure_channel(args.target) as channel:
+            return await AdminClient(channel).replay_ledger_dump(args.last)
+
+    try:
+        print(json.dumps(asyncio.run(fetch()), indent=2))
+        return 0
+    except Exception as exc:  # noqa: BLE001 — a down engine is the finding
+        print(json.dumps({"error": str(exc)[:500]}, indent=2))
+        return 1
 
 
 def _fleet(args) -> int:

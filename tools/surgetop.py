@@ -48,6 +48,9 @@ _COLUMNS = (
     ("hwm-lag", "surge_log_hwm_lag_records", "{:.0f}"),
     ("fsync-ms", "surge_log_journal_fsync_round_timer", "{:.2f}"),
     ("slab", "surge_replay_resident_slab_occupancy", "{:.0f}"),
+    ("waste", "surge_replay_resident_padding_waste_ratio", "{:.1f}"),
+    ("ev/us", "surge_replay_resident_events_per_dispatch_us", "{:.2f}"),
+    ("skew", "surge_replay_resident_shard_skew", "{:.2f}"),
     ("entities", "surge_engine_live_entities", "{:.0f}"),
     ("cmd/s", "surge_engine_command_rate_one_minute_rate", "{:.1f}"),
 )

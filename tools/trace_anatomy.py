@@ -15,7 +15,9 @@ Spans from different processes are placed on one timeline through each
 dump's mono↔wall header pair (skew-proof — docs/observability.md), grouped
 into whole traces, and decomposed into the named critical-path legs (entity
 mailbox wait → publisher linger → lane dispatch → broker gate wait →
-journal fsync → replication ack → reply decode → router resolve). The table
+journal fsync → replication ack → reply decode → router resolve — plus the
+DEVICE legs gather-coalesce → device-dispatch → fetch-barrier → decode off
+resident-gather / query-scan / replay-profiler spans). The table
 aggregates kept COMMAND traces into per-leg p50/p99/total/share rows and
 names the dominant leg; ``--format=json`` emits the machine-readable verdict
 (scripting + the tier-1 smoke). ``--once`` is accepted for symmetry with
