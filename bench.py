@@ -794,6 +794,130 @@ def resident_feed_paired() -> dict:
             "rounds": raw}
 
 
+def views_paired() -> dict:
+    """PAIRED interleaved view-read vs scan-per-read reader ladder (ISSUE
+    17): N concurrent readers all want the SAME grouped-aggregate answer —
+    arm A reads the materialized view the resident plane keeps folded (one
+    host merge of per-partition partials per read), arm B answers each read
+    with a from-scratch query-engine scan of the same committed events (the
+    batch ``query()`` path, pre-encoded so the scan arm pays no segment IO).
+    Both arms run back to back per round against the same corpus in the same
+    process, order alternating per round; medians only.
+
+    Knobs: SURGE_BENCH_VIEWS_EVENTS (50000), _AGGREGATES (1024),
+    _ROUNDS (3), _PARTITIONS (4), _LADDER (16,64,256,1024)."""
+    import asyncio
+    import statistics as _st
+
+    from surge_tpu.codec.tensor import encode_events_columnar
+    from surge_tpu.config import default_config
+    from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
+    from surge_tpu.models import counter
+    from surge_tpu.replay.query import Aggregate, QueryEngine, ScanQuery
+    from surge_tpu.replay.resident_state import ResidentStatePlane
+    from surge_tpu.replay.views import MaterializedViews, ViewDef
+    from surge_tpu.serialization import SerializedMessage
+
+    n_events = int(os.environ.get("SURGE_BENCH_VIEWS_EVENTS", 50_000))
+    n_agg = int(os.environ.get("SURGE_BENCH_VIEWS_AGGREGATES", 1024))
+    rounds = max(int(os.environ.get("SURGE_BENCH_VIEWS_ROUNDS", 3)), 1)
+    nparts = int(os.environ.get("SURGE_BENCH_VIEWS_PARTITIONS", 4))
+    ladder = [int(t) for t in os.environ.get(
+        "SURGE_BENCH_VIEWS_LADDER", "16,64,256,1024").split(",") if t]
+
+    evt_fmt = counter.event_formatting()
+    spec = counter.make_replay_spec()
+    aggs = [f"agg-{i}" for i in range(n_agg)]
+    query = ScanQuery(aggregates=(Aggregate("count"),
+                                  Aggregate("sum", "increment_by"),
+                                  Aggregate("max", "sequence_number")))
+
+    mlog = InMemoryLog()
+    mlog.create_topic(TopicSpec("events", nparts))
+    prod = mlog.transactional_producer("views-bench")
+    prod.begin()
+    seqs = {a: 0 for a in aggs}
+    by_agg: dict = {}
+    for i in range(n_events):
+        a = aggs[(i * 7919) % n_agg]
+        seqs[a] += 1
+        ev = counter.CountIncremented(a, 1, seqs[a])
+        by_agg.setdefault(a, []).append(ev)
+        prod.send(LogRecord(topic="events", key=a,
+                            value=evt_fmt.write_event(ev).value,
+                            partition=hash(a) % nparts))
+    prod.commit()
+
+    # arm B's corpus: the identical committed events as one columnar chunk
+    colev = encode_events_columnar(spec.registry, list(by_agg.values()))
+    colev.aggregate_ids = list(by_agg)
+    qe = QueryEngine(spec, config=default_config())
+
+    async def scenario() -> dict:
+        cfg = default_config().with_overrides({
+            "surge.replay.resident.capacity": max(n_agg, 8),
+            "surge.replay.resident.refresh-interval-ms": 10,
+        })
+        plane = ResidentStatePlane(
+            mlog, "events", spec, config=cfg,
+            deserialize_event=lambda b: evt_fmt.read_event(
+                SerializedMessage(key="", value=b)),
+            serialize_state=lambda a, s: b"")
+        views = MaterializedViews(spec, config=cfg)
+        plane.attach_views(views)
+        plane.register_view(ViewDef(name="totals", query=query))
+        await plane.start()
+        while plane.lag_records() > 0:
+            await asyncio.sleep(0.005)
+        loop = asyncio.get_running_loop()
+
+        def view_read():
+            views.snapshot("totals")
+
+        def scan_read():
+            qe.scan_chunks([colev], query)
+
+        async def arm(n_readers: int, fn) -> float:
+            t0 = time.perf_counter()
+            await asyncio.gather(*(loop.run_in_executor(None, fn)
+                                   for _ in range(n_readers)))
+            return n_readers / (time.perf_counter() - t0)
+
+        view_read()
+        scan_read()  # warmup: compile/cache both read paths off the clock
+        rungs = []
+        try:
+            for n in ladder:
+                raw = {"view_read": [], "scan_per_read": []}
+                for rnd in range(rounds):
+                    order = (("view_read", view_read),
+                             ("scan_per_read", scan_read))
+                    if rnd % 2:
+                        order = order[::-1]
+                    for name, fn in order:
+                        raw[name].append(round(await arm(n, fn), 1))
+                view = _st.median(raw["view_read"])
+                scan = _st.median(raw["scan_per_read"])
+                rungs.append({
+                    "readers": n,
+                    "view_read": {"reads_per_sec_median": view,
+                                  "rounds": raw["view_read"]},
+                    "scan_per_read": {"reads_per_sec_median": scan,
+                                      "rounds": raw["scan_per_read"]},
+                    "speedup_median": round(view / max(scan, 1e-9), 3)})
+                log(f"{n} readers medians: view {view:,.0f} reads/s, "
+                    f"scan-per-read {scan:,.0f} reads/s "
+                    f"({rungs[-1]['speedup_median']}x)")
+        finally:
+            await plane.stop()
+        return {"protocol": {"events": n_events, "aggregates": n_agg,
+                             "partitions": nparts, "rounds": rounds,
+                             "interleaved": True, "medians": True},
+                "rungs": rungs}
+
+    return asyncio.run(scenario())
+
+
 def anatomy_bench() -> dict:
     """SURGE_BENCH_ANATOMY=1: traced command phase → the per-leg critical-path
     attribution table alongside the phase's latency medians (ISSUE 14).
@@ -2205,6 +2329,18 @@ def main() -> None:
         stats = resident_feed_paired()
         payload["resident_feed_paired"] = stats
         payload["value"] = stats["native_feed_events_per_sec_median"]
+        emit(payload)
+        return
+
+    # SURGE_BENCH_VIEWS=1: paired interleaved materialized-view-read vs
+    # scan-per-read reader ladder off the resident plane's refresh feed
+    if os.environ.get("SURGE_BENCH_VIEWS", "0") == "1":
+        payload = {"metric": "view_reads_per_sec", "value": 0,
+                   "unit": "reads/s"}
+        stats = views_paired()
+        payload["views_paired"] = stats
+        payload["value"] = max(
+            r["view_read"]["reads_per_sec_median"] for r in stats["rungs"])
         emit(payload)
         return
 

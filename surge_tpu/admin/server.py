@@ -9,7 +9,8 @@ from typing import Optional
 import grpc
 
 from surge_tpu.admin import admin_pb2 as pb
-from surge_tpu.multilanguage.service import generic_handler, unary_callables
+from surge_tpu.multilanguage.service import (generic_handler, stream_callables,
+                                             unary_callables)
 
 SERVICE = "surge_tpu.admin.SurgeAdmin"
 METHODS = {
@@ -55,6 +56,19 @@ METHODS = {
     # JSON rows capped at surge.query.max-rows
     "ScanSegments": (pb.ComponentRequest, pb.MetricsReply),
     "QueryStates": (pb.ComponentRequest, pb.MetricsReply),
+    # incremental materialized views (surge_tpu.replay.views; docs/replay.md
+    # "Materialized views"). ComponentRequest.name carries the view name
+    # ("" / "{}" = the per-view operator summary); the snapshot rides
+    # MetricsReply as JSON (sorted keys + rows, top-k applied)
+    "QueryView": (pb.ComponentRequest, pb.MetricsReply),
+}
+
+#: server-STREAMING methods (same message-reuse discipline):
+#: SubscribeView's ComponentRequest.name carries {"view": ..,
+#: "from_version": ..} as JSON and each MetricsReply frame is one changefeed
+#: entry — a reconciling snapshot (reset) or a per-round delta
+STREAM_METHODS = {
+    "SubscribeView": (pb.ComponentRequest, pb.MetricsReply),
 }
 
 
@@ -271,6 +285,48 @@ class AdminServer:
             return pb.MetricsReply(metrics_json=json.dumps(
                 {"error": repr(exc)}).encode())
 
+    async def QueryView(self, request, context) -> pb.MetricsReply:
+        """Snapshot one materialized view (``request.name`` = view name), or
+        — with an empty name — the per-view operator summary. The snapshot's
+        numpy columns stay in-process; the RPC serves the ``rows`` form."""
+        try:
+            name = (request.name or "").strip()
+            if not name or name == "{}":
+                return pb.MetricsReply(metrics_json=json.dumps(
+                    {"views": await self.engine.view_summary()}).encode())
+            snap = await self.engine.query_view(name)
+            payload = {k: v for k, v in snap.items() if k != "columns"}
+            return pb.MetricsReply(metrics_json=json.dumps(payload).encode())
+        except Exception as exc:  # noqa: BLE001 — operator gets the failure back
+            return pb.MetricsReply(metrics_json=json.dumps(
+                {"error": repr(exc)}).encode())
+
+    async def SubscribeView(self, request, context):
+        """Server-streaming changefeed: one MetricsReply frame per entry.
+        ``request.name`` carries ``{"view": .., "from_version": ..}`` —
+        ``from_version`` absent/null opens with a reconciling snapshot; a
+        resume watermark the delta ring still covers replays exactly the
+        missed deltas (no gap, no dup); anything older gets ONE reconciling
+        snapshot. The stream ends when the engine stops or the view is
+        unregistered (a terminal ``closed`` entry); clients end it any time
+        by cancelling the call."""
+        try:
+            req = json.loads(request.name or "{}")
+            sub = await self.engine.subscribe_view(
+                req["view"], req.get("from_version"))
+        except Exception as exc:  # noqa: BLE001 — operator gets the failure back
+            yield pb.MetricsReply(metrics_json=json.dumps(
+                {"error": repr(exc)}).encode())
+            return
+        try:
+            async for entry in sub:
+                yield pb.MetricsReply(
+                    metrics_json=json.dumps(entry).encode())
+                if entry.get("closed"):
+                    return
+        finally:
+            self.engine.views.unsubscribe(sub)
+
     async def StopEngine(self, request, context) -> pb.ComponentReply:
         try:
             await self.engine.stop()
@@ -285,7 +341,8 @@ class AdminServer:
 
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers(
-            (generic_handler(SERVICE, METHODS, self),))
+            (generic_handler(SERVICE, METHODS, self,
+                             stream_methods=STREAM_METHODS),))
         self.bound_port = add_secure_port(
             self._server, f"{self._host}:{self._port}",
             getattr(self.engine, "config", None))
@@ -303,6 +360,7 @@ class AdminClient:
 
     def __init__(self, channel: grpc.aio.Channel) -> None:
         self._calls = unary_callables(channel, SERVICE, METHODS)
+        self._streams = stream_callables(channel, SERVICE, STREAM_METHODS)
 
     async def health(self) -> dict:
         reply = await self._calls["GetHealth"](pb.Empty())
@@ -401,6 +459,42 @@ class AdminClient:
         if "error" in payload and "rows" not in payload:
             raise RuntimeError(payload["error"])
         return payload
+
+    async def query_view(self, name: str = "") -> dict:
+        """Snapshot one materialized view (sorted keys + rows, top-k
+        applied), or — with no name — the per-view operator summary
+        (``{"views": [...]}``). Raises RuntimeError on a refused query; a
+        DEGRADED view's payload (its ``error`` field set) is a legitimate
+        answer and is returned, not raised."""
+        r = await self._calls["QueryView"](pb.ComponentRequest(name=name))
+        payload = json.loads(r.metrics_json)
+        if "error" in payload and "view" not in payload \
+                and "views" not in payload:
+            raise RuntimeError(payload["error"])
+        return payload
+
+    def subscribe_view(self, view: str, from_version: Optional[int] = None):
+        """Open a changefeed: an async iterator of entry dicts (first a
+        reconciling snapshot or the exactly-missed deltas, then live
+        per-round deltas). Ends on a terminal ``closed`` entry; end it early
+        by breaking out (the call is cancelled). Raises RuntimeError when
+        the subscription is refused (unknown view, no plane)."""
+        call = self._streams["SubscribeView"](pb.ComponentRequest(
+            name=json.dumps({"view": view, "from_version": from_version})))
+
+        async def entries():
+            try:
+                async for r in call:
+                    payload = json.loads(r.metrics_json)
+                    if "error" in payload and "view" not in payload:
+                        raise RuntimeError(payload["error"])
+                    yield payload
+                    if payload.get("closed"):
+                        return
+            finally:
+                call.cancel()
+
+        return entries()
 
     async def stop_engine(self) -> tuple[bool, str]:
         r = await self._calls["StopEngine"](pb.Empty())

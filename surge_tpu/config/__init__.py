@@ -215,6 +215,15 @@ DEFAULTS: dict[str, Any] = {
     # legacy plain-jit programs whose gathers replicate the slab every read
     # (the paired-bench baseline arm and the rollback switch)
     "surge.replay.mesh.gather": "local",  # local | replicated
+    # --- incremental materialized views + changefeeds (replay/views.py) ---
+    # per-view delta ring depth: how many fold rounds a changefeed resume
+    # watermark may lag before SubscribeView answers with a one-shot
+    # reconciling snapshot instead of replaying the missed deltas
+    "surge.replay.views.changefeed-rounds": 256,
+    # group cap of one materialized view (distinct aggregate ids or group-by
+    # keys); a view that overflows degrades to an error state rather than
+    # growing its slab unbounded
+    "surge.replay.views.max-groups": 1_048_576,
     # --- TPU scan engine over columnar segments (surge_tpu.replay.query) ---
     # event-axis pad bucket of one scan dispatch: chunks pad up to
     # power-of-two buckets at least this large so streamed chunks reuse a

@@ -214,11 +214,17 @@ class SurgeEngine(Controllable):
         # getState / projections are answered from batched device gathers
         # with the host KV store as the staleness/coverage fallback
         self.resident_plane = None
+        # incremental materialized views + changefeeds (docs/replay.md
+        # "Materialized views"): registered scan queries the resident plane
+        # folds every refresh round; None when no plane is wired — views NEED
+        # the refresh feed, there is nothing to fold them from without it
+        self.views = None
         if (self.config.get_bool("surge.replay.resident.enabled")
                 and logic.events_topic):
             spec = logic.replay_spec()
             if spec is not None:
                 from surge_tpu.replay.resident_state import ResidentStatePlane
+                from surge_tpu.replay.views import MaterializedViews
 
                 # the refresh feed's batch decoder (one C-level parse per
                 # round) when the event format offers one; None keeps the
@@ -246,6 +252,11 @@ class SurgeEngine(Controllable):
                                                      tracer=tracer),
                     flight=self.flight, ledger=self.replay_ledger,
                     tracer=tracer)
+                self.views = MaterializedViews(
+                    spec, config=self.config, mesh=self._resolve_mesh(),
+                    metrics=self.metrics, ledger=self.replay_ledger,
+                    flight=self.flight)
+                self.resident_plane.attach_views(self.views)
         self.checkpoint_writer = None
         ckpt_path = self.config.get_str("surge.store.checkpoint.path", "")
         if ckpt_path and logic.events_topic:
@@ -358,6 +369,8 @@ class SurgeEngine(Controllable):
         if self.loop_prober is not None:
             await self.loop_prober.stop()
         await self.router.stop()  # stops regions (shards + publishers)
+        if self.views is not None:
+            self.views.close()  # end changefeed subscriptions first
         if self.resident_plane is not None:
             await self.resident_plane.stop()
         await self.indexer.stop()
@@ -967,6 +980,57 @@ class SurgeEngine(Controllable):
                 span.set_attribute("scanned", result.scanned_events)
             finally:
                 span.finish()
+
+    # -- materialized views + changefeeds (docs/replay.md) ------------------------------
+
+    def _require_views(self):
+        if self.views is None:
+            raise RuntimeError(
+                "materialized views need the resident plane "
+                "(surge.replay.resident.enabled) — there is no refresh feed "
+                "to fold them from without it")
+        return self.views
+
+    def register_view(self, view) -> None:
+        """Register a :class:`~surge_tpu.replay.views.ViewDef` (or its JSON
+        dict form). Before the plane's seed it joins the seed fold; on a
+        running plane it parks pending and the plane backfills the committed
+        prefix between refresh rounds."""
+        from surge_tpu.replay.views import ViewDef
+
+        self._require_views()
+        if isinstance(view, dict):
+            view = ViewDef.from_json(view)
+        self.resident_plane.register_view(view)
+
+    def unregister_view(self, name: str) -> bool:
+        return self._require_views().unregister(name)
+
+    async def query_view(self, name: str) -> dict:
+        """Snapshot one materialized view: normalized columns over sorted
+        keys (top-k cut applied), version + fold watermarks. Runs in the
+        executor — a fold round may hold the views lock through a device
+        scan, and the event loop must keep serving commands."""
+        views = self._require_views()
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, views.snapshot, name)
+
+    async def view_summary(self) -> list:
+        """One operator row per registered view (the ``QueryView`` RPC's
+        no-name form, ``chaos.py views`` and surgetop)."""
+        views = self._require_views()
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, views.summary)
+
+    async def subscribe_view(self, name: str, from_version=None):
+        """Open a changefeed subscription (the ``SubscribeView`` RPC):
+        yields per-round delta entries, starting with a reconciling snapshot
+        unless ``from_version`` is a resume watermark the delta ring still
+        covers. Close with ``engine.views.unsubscribe(sub)``."""
+        views = self._require_views()
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: views.subscribe(name, from_version, loop=loop))
 
 
 class EngineNotRunningError(Exception):

@@ -274,6 +274,12 @@ class EngineMetrics:
     query_result_rows: Sensor = field(init=False)
     query_scan_rows: Sensor = field(init=False)
     query_pushdown_selectivity: Sensor = field(init=False)
+    # incremental materialized views + changefeeds (surge_tpu.replay.views):
+    # per-round view folds off the resident plane's refresh feed
+    views_fold_timer: Timer = field(init=False)
+    views_delta_rows: Sensor = field(init=False)
+    views_subscribers: Sensor = field(init=False)
+    views_resume_gap_rounds: Sensor = field(init=False)
     # log compaction + state checkpoints (surge_tpu.log.compactor /
     # surge_tpu.store.checkpoint — the bounded-cold-start subsystem)
     compaction_runs: Sensor = field(init=False)
@@ -478,6 +484,21 @@ class EngineMetrics:
             "surge.query.pushdown-selectivity",
             "matched/scanned event fraction of the last scan (how much the "
             "predicate pushdown narrowed before grouping)"))
+        self.views_fold_timer = m.timer(MI(
+            "surge.replay.views.fold-timer",
+            "ms per materialized-view fold round (all registered views' "
+            "incremental folds of one refresh round's committed tail)"))
+        self.views_delta_rows = m.counter(MI(
+            "surge.replay.views.delta-rows",
+            "changed view rows emitted to changefeed deltas across fold "
+            "rounds"))
+        self.views_subscribers = m.gauge(MI(
+            "surge.replay.views.subscribers",
+            "live changefeed subscriptions across materialized views"))
+        self.views_resume_gap_rounds = m.gauge(MI(
+            "surge.replay.views.resume-gap-rounds",
+            "fold rounds bridged by the last reconciling snapshot (a resume "
+            "watermark older than the delta ring, or from the future)"))
         self.compaction_runs = m.counter(MI(
             "surge.log.compaction.runs", "partition compaction passes"))
         self.compaction_bytes_reclaimed = m.counter(MI(

@@ -37,6 +37,7 @@ from surge_tpu.multilanguage.service import (
     BUSINESS_SERVICE,
     GATEWAY_METHODS,
     GATEWAY_SERVICE,
+    GATEWAY_STREAM_METHODS,
     generic_handler,
     unary_callables,
 )
@@ -182,6 +183,74 @@ class MultilanguageGatewayServer:
         health = self.engine.health_check()
         return pb.HealthReply(status="up" if health.is_healthy() else "down")
 
+    # -- read-side analytics (message reuse; docs/replay.md) ----------------------------
+
+    @staticmethod
+    def _json_reply(name: str, payload: dict) -> pb.GetStateReply:
+        import json
+
+        return pb.GetStateReply(state=pb.AggregateState(
+            aggregate_id=name, payload=json.dumps(payload).encode(),
+            exists=True))
+
+    async def QueryStates(self, request: pb.GetStateRequest,
+                          context) -> pb.GetStateReply:
+        """Fold-then-filter state query through the sidecar: the polyglot
+        app's "every matching aggregate's current state" read.
+        ``aggregate_id`` carries the StateQuery JSON; the reply payload is
+        the same capped rows JSON the admin ``QueryStates`` RPC serves."""
+        import json
+
+        try:
+            q = json.loads(request.aggregate_id or "{}")
+            result = await self.engine.query_states(q)
+            cap = self.engine.config.get_int("surge.query.max-rows", 10_000)
+            return self._json_reply("query", {
+                "rows": result.rows(limit=cap),
+                "num_aggregates": result.num_aggregates,
+                "scanned_events": result.scanned_events,
+                "matched_events": result.matched_events,
+                "truncated": result.num_aggregates > cap,
+            })
+        except Exception as exc:  # noqa: BLE001 — app gets the failure back
+            return self._json_reply("query", {"error": repr(exc)})
+
+    async def QueryView(self, request: pb.GetStateRequest,
+                        context) -> pb.GetStateReply:
+        """Materialized-view snapshot through the sidecar. ``aggregate_id``
+        carries the view name ("" = the per-view operator summary)."""
+        try:
+            name = (request.aggregate_id or "").strip()
+            if not name or name == "{}":
+                return self._json_reply("views", {
+                    "views": await self.engine.view_summary()})
+            snap = await self.engine.query_view(name)
+            return self._json_reply(name, {
+                k: v for k, v in snap.items() if k != "columns"})
+        except Exception as exc:  # noqa: BLE001 — app gets the failure back
+            return self._json_reply("views", {"error": repr(exc)})
+
+    async def SubscribeView(self, request: pb.GetStateRequest, context):
+        """Server-streaming changefeed through the sidecar (the admin
+        ``SubscribeView`` twin): ``aggregate_id`` carries ``{"view",
+        "from_version"}`` JSON, each frame's payload one changefeed entry."""
+        import json
+
+        try:
+            req = json.loads(request.aggregate_id or "{}")
+            sub = await self.engine.subscribe_view(
+                req["view"], req.get("from_version"))
+        except Exception as exc:  # noqa: BLE001 — app gets the failure back
+            yield self._json_reply("views", {"error": repr(exc)})
+            return
+        try:
+            async for entry in sub:
+                yield self._json_reply(entry.get("view", "views"), entry)
+                if entry.get("closed"):
+                    return
+        finally:
+            self.engine.views.unsubscribe(sub)
+
     # -- lifecycle -----------------------------------------------------------------------
 
     async def start(self) -> int:
@@ -189,7 +258,8 @@ class MultilanguageGatewayServer:
 
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers(
-            (generic_handler(GATEWAY_SERVICE, GATEWAY_METHODS, self),))
+            (generic_handler(GATEWAY_SERVICE, GATEWAY_METHODS, self,
+                             stream_methods=GATEWAY_STREAM_METHODS),))
         self.bound_port = add_secure_port(
             self._server, f"{self._host}:{self._port}",
             getattr(self.engine, "config", None))

@@ -29,7 +29,9 @@ from surge_tpu.multilanguage.service import (
     BUSINESS_SERVICE,
     GATEWAY_METHODS,
     GATEWAY_SERVICE,
+    GATEWAY_STREAM_METHODS,
     generic_handler,
+    stream_callables,
     unary_callables,
 )
 
@@ -129,6 +131,8 @@ class SurgeClient:
 
     def __init__(self, channel: grpc.aio.Channel, serdes: SerDeser) -> None:
         self._calls = unary_callables(channel, GATEWAY_SERVICE, GATEWAY_METHODS)
+        self._streams = stream_callables(channel, GATEWAY_SERVICE,
+                                         GATEWAY_STREAM_METHODS)
         self.serdes = serdes
 
     async def forward_command(self, aggregate_id: str, command: Any
@@ -152,3 +156,57 @@ class SurgeClient:
 
     async def health(self) -> str:
         return (await self._calls["HealthCheck"](pb.HealthRequest())).status
+
+    # -- read-side analytics (message reuse; docs/replay.md) ----------------------------
+
+    async def query_states(self, query: dict) -> dict:
+        """Fold-then-filter state query (StateQuery json form) through the
+        gateway; returns the capped rows payload. Raises RuntimeError on a
+        refused/failed query."""
+        import json
+
+        reply = await self._calls["QueryStates"](
+            pb.GetStateRequest(aggregate_id=json.dumps(query)))
+        payload = json.loads(reply.state.payload)
+        if "error" in payload and "rows" not in payload:
+            raise RuntimeError(payload["error"])
+        return payload
+
+    async def query_view(self, name: str = "") -> dict:
+        """Materialized-view snapshot (or, with no name, the per-view
+        operator summary) through the gateway. Raises RuntimeError when the
+        query is refused; a degraded view's payload is returned as-is."""
+        import json
+
+        reply = await self._calls["QueryView"](
+            pb.GetStateRequest(aggregate_id=name))
+        payload = json.loads(reply.state.payload)
+        if "error" in payload and "view" not in payload \
+                and "views" not in payload:
+            raise RuntimeError(payload["error"])
+        return payload
+
+    def subscribe_view(self, view: str, from_version: Optional[int] = None):
+        """Changefeed subscription through the gateway: an async iterator of
+        entry dicts (reconciling snapshot or exactly-missed deltas first,
+        then live per-round deltas). End it early by breaking out; raises
+        RuntimeError when the subscription is refused."""
+        import json
+
+        call = self._streams["SubscribeView"](pb.GetStateRequest(
+            aggregate_id=json.dumps({"view": view,
+                                     "from_version": from_version})))
+
+        async def entries():
+            try:
+                async for reply in call:
+                    payload = json.loads(reply.state.payload)
+                    if "error" in payload and "view" not in payload:
+                        raise RuntimeError(payload["error"])
+                    yield payload
+                    if payload.get("closed"):
+                        return
+            finally:
+                call.cancel()
+
+        return entries()

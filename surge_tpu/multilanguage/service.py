@@ -23,6 +23,18 @@ GATEWAY_METHODS: Dict[str, tuple] = {
     "ForwardCommand": (pb.ForwardCommandRequest, pb.ForwardCommandReply),
     "GetState": (pb.GetStateRequest, pb.GetStateReply),
     "HealthCheck": (pb.HealthRequest, pb.HealthReply),
+    # read-side analytics through the sidecar (message reuse — routed by this
+    # table, not the frozen descriptor): GetStateRequest.aggregate_id carries
+    # the request JSON, GetStateReply.state.payload carries the result JSON
+    "QueryStates": (pb.GetStateRequest, pb.GetStateReply),
+    "QueryView": (pb.GetStateRequest, pb.GetStateReply),
+}
+
+#: server-streaming gateway methods (same message-reuse discipline):
+#: SubscribeView's aggregate_id carries {"view", "from_version"} JSON and
+#: each reply frame's state.payload is one changefeed entry
+GATEWAY_STREAM_METHODS: Dict[str, tuple] = {
+    "SubscribeView": (pb.GetStateRequest, pb.GetStateReply),
 }
 
 BUSINESS_METHODS: Dict[str, tuple] = {
@@ -33,13 +45,22 @@ BUSINESS_METHODS: Dict[str, tuple] = {
 
 
 def generic_handler(service_name: str, methods: Mapping[str, tuple],
-                    implementation: Any) -> grpc.GenericRpcHandler:
+                    implementation: Any,
+                    stream_methods: Mapping[str, tuple] | None = None
+                    ) -> grpc.GenericRpcHandler:
     """Build a server handler mapping each method to ``implementation.<Method>``
-    (an async callable ``(request, context) -> reply``)."""
+    (an async callable ``(request, context) -> reply``). ``stream_methods``
+    entries are server-streaming: the implementation method is an async
+    GENERATOR yielding replies (the changefeed shape — SubscribeView)."""
     rpc_handlers = {}
     for name, (req_cls, reply_cls) in methods.items():
         fn = getattr(implementation, name)
         rpc_handlers[name] = grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=req_cls.FromString,
+            response_serializer=reply_cls.SerializeToString)
+    for name, (req_cls, reply_cls) in (stream_methods or {}).items():
+        fn = getattr(implementation, name)
+        rpc_handlers[name] = grpc.unary_stream_rpc_method_handler(
             fn, request_deserializer=req_cls.FromString,
             response_serializer=reply_cls.SerializeToString)
     return grpc.method_handlers_generic_handler(service_name, rpc_handlers)
@@ -51,6 +72,19 @@ def unary_callables(channel: grpc.aio.Channel, service_name: str,
     out = {}
     for name, (req_cls, reply_cls) in methods.items():
         out[name] = channel.unary_unary(
+            f"/{service_name}/{name}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=reply_cls.FromString)
+    return out
+
+
+def stream_callables(channel: grpc.aio.Channel, service_name: str,
+                     methods: Mapping[str, tuple]) -> Dict[str, Callable]:
+    """Server-streaming client callables ``{method: fn(request) -> call}``
+    where the call is async-iterable over replies (and ``.cancel()``-able)."""
+    out = {}
+    for name, (req_cls, reply_cls) in methods.items():
+        out[name] = channel.unary_stream(
             f"/{service_name}/{name}",
             request_serializer=req_cls.SerializeToString,
             response_deserializer=reply_cls.FromString)

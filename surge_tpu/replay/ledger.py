@@ -80,6 +80,7 @@ class ReplayLedger(FlightRecorder):
             "dispatch_us": 0.0, "encode_us": 0.0, "feed_us": 0.0,
             "gathers": 0, "gathered_rows": 0, "gather_wait_us": 0.0,
             "queries": 0, "query_rows": 0,
+            "view_rounds": 0, "view_delta_rows": 0, "view_fold_us": 0.0,
         }
 
     # -- recording sites ----------------------------------------------------------------
@@ -146,6 +147,18 @@ class ReplayLedger(FlightRecorder):
 
     def record_evict(self, count: int, *, resident: int, cause: str) -> None:
         self.record("evict", count=count, resident=resident, cause=cause)
+
+    def record_view_round(self, *, views: int, rows: int, events: int,
+                          fold_us: float) -> None:
+        """One materialized-view fold round: ``views`` folded the round's
+        ``events`` committed events, emitting ``rows`` changed view rows to
+        the changefeeds (surge_tpu.replay.views)."""
+        t = self.totals
+        t["view_rounds"] += 1
+        t["view_delta_rows"] += rows
+        t["view_fold_us"] += fold_us
+        self.record("view-round", views=views, rows=rows, events=events,
+                    fold_us=round(fold_us, 1))
 
     # -- rollups ------------------------------------------------------------------------
 

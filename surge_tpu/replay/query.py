@@ -54,7 +54,8 @@ from surge_tpu.codec.tensor import ColumnarEvents
 from surge_tpu.config import Config, default_config
 
 __all__ = ["Predicate", "Aggregate", "ScanQuery", "StateQuery", "QueryResult",
-           "QueryEngine", "scan_reference", "state_query_reference"]
+           "QueryEngine", "scan_reference", "state_query_reference",
+           "predicate_mask_np"]
 
 #: comparison ops a predicate may use (conjunctive; applied on device)
 _OPS = ("==", "!=", "<", "<=", ">", ">=")
@@ -103,22 +104,43 @@ class Aggregate:
 
 @dataclass(frozen=True)
 class ScanQuery:
-    """Filter + grouped-aggregate scan over event columns, keyed by aggregate.
+    """Filter + grouped-aggregate scan over event columns.
 
-    ``event_types`` filters by event CLASS name (resolved to type ids against
-    the registry — the typed pushdown the wire format makes free); predicates
-    are conjunctive. A ``count`` output is always computed even when not
-    requested, so zero-match aggregates are distinguishable."""
+    Rows group by aggregate id, or — with ``group_by`` — by the distinct
+    values of one event column (``type_id`` allowed), the classic
+    group-by-dimension rollup. ``event_types`` filters by event CLASS name
+    (resolved to type ids against the registry — the typed pushdown the wire
+    format makes free); ``predicates`` are conjunctive, and each entry of
+    ``or_groups`` is a disjunction (OR) of predicates whose groups AND with
+    each other and with ``predicates`` — CNF, enough for the dashboard-filter
+    shapes the reference's KTable reads cover. A ``count`` output is always
+    computed even when not requested, so zero-match groups are
+    distinguishable."""
 
     aggregates: Tuple[Aggregate, ...]
     predicates: Tuple[Predicate, ...] = ()
     event_types: Optional[Tuple[str, ...]] = None
+    or_groups: Tuple[Tuple[Predicate, ...], ...] = ()
+    group_by: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        # normalize nested sequences so signature()/program keys hash
+        object.__setattr__(self, "or_groups",
+                           tuple(tuple(g) for g in self.or_groups))
+        for g in self.or_groups:
+            if not g:
+                raise ValueError("empty OR-group (would match nothing)")
 
     def as_json(self) -> dict:
         out: dict = {"aggregates": [a.as_json() for a in self.aggregates],
                      "predicates": [p.as_json() for p in self.predicates]}
         if self.event_types is not None:
             out["event_types"] = list(self.event_types)
+        if self.or_groups:
+            out["or_groups"] = [[p.as_json() for p in g]
+                                for g in self.or_groups]
+        if self.group_by is not None:
+            out["group_by"] = self.group_by
         return out
 
     @classmethod
@@ -129,7 +151,17 @@ class ScanQuery:
             predicates=tuple(Predicate(p["column"], p["op"], p["value"])
                              for p in d.get("predicates", ())),
             event_types=(tuple(d["event_types"])
-                         if d.get("event_types") is not None else None))
+                         if d.get("event_types") is not None else None),
+            or_groups=tuple(
+                tuple(Predicate(p["column"], p["op"], p["value"]) for p in g)
+                for g in d.get("or_groups", ())),
+            group_by=d.get("group_by"))
+
+    def all_predicates(self) -> Tuple[Predicate, ...]:
+        """Flat predicate order the device program indexes by: conjunctive
+        predicates first, then each OR-group's members in declaration
+        order."""
+        return self.predicates + tuple(p for g in self.or_groups for p in g)
 
     def columns_needed(self) -> List[str]:
         """Every stored union column this query touches — the projection the
@@ -137,12 +169,15 @@ class ScanQuery:
         header columns and cost nothing extra, for predicates AND
         aggregates)."""
         cols: List[str] = []
-        for p in self.predicates:
+        for p in self.all_predicates():
             if p.column not in cols and p.column != "type_id":
                 cols.append(p.column)
         for a in self.aggregates:
             if a.column and a.column not in cols and a.column != "type_id":
                 cols.append(a.column)
+        if self.group_by and self.group_by != "type_id" \
+                and self.group_by not in cols:
+            cols.append(self.group_by)
         return cols
 
     def signature(self) -> tuple:
@@ -151,6 +186,8 @@ class ScanQuery:
         each value's integrality, which picks the comparison dtype)."""
         return (tuple((p.column, p.op, _is_integral(p.value))
                       for p in self.predicates),
+                tuple(tuple((p.column, p.op, _is_integral(p.value))
+                            for p in g) for g in self.or_groups),
                 tuple((a.op, a.column) for a in self.aggregates),
                 self.event_types is not None)
 
@@ -237,6 +274,56 @@ def _apply_op_np(col, op: str, value):
     if op == ">":
         return col > value
     return col >= value
+
+
+def _pred_mask_one_np(col: np.ndarray, p: Predicate) -> np.ndarray:
+    if not _is_integral(p.value) and col.dtype.kind != "f":
+        # mirror the device program: fractional vs integer compares in f32,
+        # not by truncating the value to the column dtype
+        return _apply_op_np(col.astype(np.float32), p.op, np.float32(p.value))
+    return _apply_op_np(col, p.op, np.asarray(p.value, dtype=col.dtype))
+
+
+def predicate_mask_np(cols: Mapping[str, np.ndarray], type_ids: np.ndarray,
+                      predicates: Sequence[Predicate],
+                      or_groups: Sequence[Sequence[Predicate]] = ()
+                      ) -> np.ndarray:
+    """Host mirror of the device predicate mask, over DEVICE-dtype columns
+    (cast them first — ``QueryEngine._device_dtype``). Conjunctive
+    ``predicates`` AND together; each ``or_groups`` entry ORs internally then
+    ANDs with the rest. Shared by :func:`scan_reference` and the
+    materialized-view oracle so every predicate consumer filters
+    identically."""
+    n = len(type_ids)
+    mask = np.ones((n,), dtype=bool)
+    for p in predicates:
+        col = type_ids if p.column == "type_id" else cols[p.column]
+        mask &= _pred_mask_one_np(col, p)
+    for g in or_groups:
+        hit = np.zeros((n,), dtype=bool)
+        for p in g:
+            col = type_ids if p.column == "type_id" else cols[p.column]
+            hit |= _pred_mask_one_np(col, p)
+        mask &= hit
+    return mask
+
+
+def _group_key_str(v, dt: np.dtype) -> str:
+    """Stable string key for one group-by column value (views and changefeeds
+    key rows by these across processes, so the format is part of the wire
+    contract)."""
+    if dt.kind in "iub":
+        return str(int(v))
+    return repr(float(v))
+
+
+def _factorize_group(col: np.ndarray) -> Tuple[List[str], np.ndarray]:
+    """Distinct values of a DEVICE-dtype group column → (string keys in
+    ascending value order, int32 group index per event)."""
+    vals, inv = np.unique(col, return_inverse=True)
+    dt = np.dtype(col.dtype)
+    return ([_group_key_str(v, dt) for v in vals],
+            inv.astype(np.int32).reshape(-1))
 
 
 def _sentinel(op: str, dt: np.dtype):
@@ -408,19 +495,15 @@ class QueryEngine:
             n, np.dtype(np.int32))) for n in col_names}
         preds = tuple((p.column, p.op, _is_integral(p.value))
                       for p in query.predicates)
+        groups = tuple(tuple((p.column, p.op, _is_integral(p.value))
+                             for p in g) for g in query.or_groups)
         aggs = tuple((a.op, a.column, a.name) for a in query.aggregates)
         has_types = query.event_types is not None
 
         def local_scan(agg_idx, type_ids, valid, pred_vals, type_allow, cols):
-            mask = valid
-            if has_types:
-                # few allowed ids: an OR of compares beats a gather-based
-                # isin and fuses into the same elementwise pass
-                hit_t = jnp.zeros_like(mask)
-                for j in range(type_allow.shape[0]):
-                    hit_t = hit_t | (type_ids == type_allow[j])
-                mask = mask & hit_t
-            for j, (cname, op, integral) in enumerate(preds):
+            def compare(cname, op, integral, j):
+                # one predicate leg, indexed into the FLAT pred_vals vector
+                # (conjunctive predicates first, then OR-group members)
                 col = type_ids if cname == "type_id" else cols[cname]
                 if not integral and not jnp.issubdtype(col.dtype,
                                                        jnp.floating):
@@ -432,17 +515,36 @@ class QueryEngine:
                 else:
                     v = pred_vals[j].astype(col.dtype)
                 if op == "==":
-                    mask = mask & (col == v)
-                elif op == "!=":
-                    mask = mask & (col != v)
-                elif op == "<":
-                    mask = mask & (col < v)
-                elif op == "<=":
-                    mask = mask & (col <= v)
-                elif op == ">":
-                    mask = mask & (col > v)
-                else:
-                    mask = mask & (col >= v)
+                    return col == v
+                if op == "!=":
+                    return col != v
+                if op == "<":
+                    return col < v
+                if op == "<=":
+                    return col <= v
+                if op == ">":
+                    return col > v
+                return col >= v
+
+            mask = valid
+            if has_types:
+                # few allowed ids: an OR of compares beats a gather-based
+                # isin and fuses into the same elementwise pass
+                hit_t = jnp.zeros_like(mask)
+                for j in range(type_allow.shape[0]):
+                    hit_t = hit_t | (type_ids == type_allow[j])
+                mask = mask & hit_t
+            j = 0
+            for cname, op, integral in preds:
+                mask = mask & compare(cname, op, integral, j)
+                j += 1
+            for g in groups:
+                hit = None
+                for cname, op, integral in g:
+                    leg = compare(cname, op, integral, j)
+                    hit = leg if hit is None else hit | leg
+                    j += 1
+                mask = mask & hit
             out: dict = {}
             out["count"] = jnp.zeros((b_bucket,), jnp.int32).at[agg_idx].add(
                 mask.astype(jnp.int32))
@@ -511,27 +613,38 @@ class QueryEngine:
 
     def scan_chunk(self, colev: ColumnarEvents, query: ScanQuery
                    ) -> Dict[str, np.ndarray]:
-        """Scan one chunk; returns ``{output: np[num_aggregates]}`` (always
-        including ``count``). Zero-match aggregates report 0 everywhere."""
-        return _normalize_zero_match(self._raw_scan(colev, query), query)
+        """Scan one chunk; returns ``{output: np[num_groups]}`` (always
+        including ``count``). Zero-match groups report 0 everywhere."""
+        return _normalize_zero_match(self._raw_scan(colev, query)[1], query)
 
     def _raw_scan(self, colev: ColumnarEvents, query: ScanQuery
-                  ) -> Dict[str, np.ndarray]:
+                  ) -> Tuple[Optional[List[str]], Dict[str, np.ndarray]]:
         """The device scan of one chunk WITHOUT zero-match normalization:
         min/max keep their dtype sentinels, so per-chunk partials of a
-        repeated aggregate (delta chunks) stay combinable."""
+        repeated group (delta chunks, per-refresh-round view folds) stay
+        combinable. Returns ``(group keys, raw outputs)`` — keys are the
+        chunk's aggregate ids, or under ``group_by`` the distinct group-column
+        values of THIS chunk as stable strings."""
         import jax
 
-        b = colev.num_aggregates
         n = colev.num_events
         needed = tuple(query.columns_needed())
         cols_np = self._materialize_columns(colev, needed)
+        if query.group_by is not None:
+            gcol = (colev.type_ids if query.group_by == "type_id"
+                    else cols_np[query.group_by])
+            gcol = gcol.astype(self._device_dtype(np.dtype(gcol.dtype)))
+            ids, grp_idx = _factorize_group(gcol)
+            b = len(ids)
+        else:
+            ids, grp_idx = colev.aggregate_ids, colev.agg_idx
+            b = colev.num_aggregates
         n_dev = self._n_dev()
         n_bucket = _pow2(max(n, 1), max(self._event_bucket, n_dev))
         b_bucket = _pow2(max(b, 1), 8)
 
         agg_p = np.zeros((n_bucket,), dtype=np.int32)
-        agg_p[:n] = colev.agg_idx
+        agg_p[:n] = grp_idx
         type_p = np.full((n_bucket,), -1, dtype=np.int32)
         type_p[:n] = colev.type_ids
         valid = np.zeros((n_bucket,), dtype=bool)
@@ -542,7 +655,7 @@ class QueryEngine:
             cp = np.zeros((n_bucket,), dtype=dt)
             cp[:n] = cols_np[name].astype(dt)
             cols_p[name] = cp
-        pred_vals = np.asarray([p.value for p in query.predicates],
+        pred_vals = np.asarray([p.value for p in query.all_predicates()],
                                dtype=np.float64)
         type_allow = (self.resolve_type_ids(query.event_types)
                       if query.event_types is not None
@@ -561,7 +674,7 @@ class QueryEngine:
         out_dev = prog(put_e(agg_p), put_e(type_p), put_e(valid),
                        put_r(pred_vals), put_r(type_allow),
                        {k: put_e(v) for k, v in cols_p.items()})
-        return {k: np.asarray(v)[:b] for k, v in out_dev.items()}
+        return ids, {k: np.asarray(v)[:b] for k, v in out_dev.items()}
 
     def scan_chunks(self, chunks: Iterable[ColumnarEvents], query: ScanQuery
                     ) -> QueryResult:
@@ -571,7 +684,9 @@ class QueryEngine:
         aggregates continue base chunks) MERGE into one row per id —
         count/sum add, min/max combine, zero-match normalization runs after
         the merge. Chunks without aggregate ids cannot be matched across
-        chunks and keep the disjointness contract."""
+        chunks and keep the disjointness contract. Under ``group_by`` rows
+        key by group value (the same value recurring across chunks merges
+        exactly like a repeated aggregate id)."""
         t0 = time.perf_counter()
         collected: List[Tuple[Optional[List[str]], Dict[str, np.ndarray]]] = []
         saw_ids = True
@@ -579,15 +694,15 @@ class QueryEngine:
         seen: Dict[str, int] = {}
         scanned = matched = n_chunks = 0
         for colev in chunks:
-            out = self._raw_scan(colev, query)
-            collected.append((colev.aggregate_ids, out))
+            ids_c, out = self._raw_scan(colev, query)
+            collected.append((ids_c, out))
             scanned += colev.num_events
             matched += int(out["count"].sum())
             n_chunks += 1
-            if colev.aggregate_ids is None:
+            if ids_c is None:
                 saw_ids = False
             elif saw_ids:
-                for a in colev.aggregate_ids:
+                for a in ids_c:
                     if a in seen:
                         has_dup = True
                     else:
@@ -747,35 +862,35 @@ def scan_reference(chunks: Iterable[ColumnarEvents], query: ScanQuery,
     seen: Dict[str, int] = {}
     total_b = scanned = matched = n_chunks = 0
     for colev in chunks:
-        b, n = colev.num_aggregates, colev.num_events
+        n = colev.num_events
         cols: Dict[str, np.ndarray] = {}
         for name in query.columns_needed():
             col = colev.cols.get(name)
             if col is None and colev.derived_cols.get(name) == "ordinal":
-                starts = np.zeros(b + 1, dtype=np.int64)
-                np.cumsum(np.bincount(colev.agg_idx, minlength=b),
+                starts = np.zeros(colev.num_aggregates + 1, dtype=np.int64)
+                np.cumsum(np.bincount(colev.agg_idx,
+                                      minlength=colev.num_aggregates),
                           out=starts[1:])
                 col = (np.arange(n, dtype=np.int64)
                        - starts[colev.agg_idx] + 1).astype(
                     union_dts.get(name, np.dtype(np.int32)))
             cols[name] = col.astype(dev_dt(col.dtype))
+        if query.group_by is not None:
+            gcol = (colev.type_ids if query.group_by == "type_id"
+                    else cols[query.group_by])
+            ids_c, grp_idx = _factorize_group(gcol)
+            b = len(ids_c)
+        else:
+            ids_c, grp_idx = colev.aggregate_ids, colev.agg_idx
+            b = colev.num_aggregates
         mask = np.ones((n,), dtype=bool)
         if query.event_types is not None:
             allow = {type_ids_of[t] for t in query.event_types}
             mask &= np.isin(colev.type_ids, sorted(allow))
-        for p in query.predicates:
-            col = (colev.type_ids if p.column == "type_id"
-                   else cols[p.column])
-            if not _is_integral(p.value) and col.dtype.kind != "f":
-                # mirror the device program: fractional vs integer compares
-                # in f32, not by truncating the value to the column dtype
-                mask &= _apply_op_np(col.astype(np.float32), p.op,
-                                     np.float32(p.value))
-            else:
-                mask &= _apply_op_np(col, p.op,
-                                     np.asarray(p.value, dtype=col.dtype))
+        mask &= predicate_mask_np(cols, colev.type_ids, query.predicates,
+                                  query.or_groups)
         count = np.zeros((b,), dtype=np.int32)
-        np.add.at(count, colev.agg_idx, mask.astype(np.int32))
+        np.add.at(count, grp_idx, mask.astype(np.int32))
         out: Dict[str, np.ndarray] = {"count": count}
         for a in query.aggregates:
             if a.op == "count":
@@ -785,28 +900,28 @@ def scan_reference(chunks: Iterable[ColumnarEvents], query: ScanQuery,
             dt = col.dtype
             if a.op == "sum":
                 acc = np.zeros((b,), dtype=dt)
-                np.add.at(acc, colev.agg_idx, np.where(mask, col,
-                                                       np.zeros((), dt)))
+                np.add.at(acc, grp_idx, np.where(mask, col,
+                                                 np.zeros((), dt)))
             elif a.op == "min":
                 big = _sentinel("min", dt)
                 acc = np.full((b,), big, dtype=dt)
-                np.minimum.at(acc, colev.agg_idx,
+                np.minimum.at(acc, grp_idx,
                               np.where(mask, col, np.asarray(big, dt)))
             else:
                 small = _sentinel("max", dt)
                 acc = np.full((b,), small, dtype=dt)
-                np.maximum.at(acc, colev.agg_idx,
+                np.maximum.at(acc, grp_idx,
                               np.where(mask, col, np.asarray(small, dt)))
             out[a.name] = acc  # raw: sentinels normalize after the merge
-        collected.append((colev.aggregate_ids, out))
+        collected.append((ids_c, out))
         total_b += b
         scanned += n
         matched += int(count.sum())
         n_chunks += 1
-        if colev.aggregate_ids is None:
+        if ids_c is None:
             saw_ids = False
         elif saw_ids:
-            for a_id in colev.aggregate_ids:
+            for a_id in ids_c:
                 if a_id in seen:
                     has_dup = True
                 else:

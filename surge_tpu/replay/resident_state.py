@@ -232,6 +232,10 @@ class ResidentStatePlane(Controllable):
         self._stopped = False  # a STOPPED plane must miss: its freshness view
         #                        (_last_ends) is frozen while the log moves on
         self._seeded = False
+        #: MaterializedViews (surge_tpu.replay.views) riding this plane's
+        #: refresh feed, or None — every committed round folds into the
+        #: registered views, and every partition purge drops their partials
+        self._views = None
         self.stats = {"rounds": 0, "folded_events": 0, "evictions": 0,
                       "gathers": 0, "gathered_rows": 0, "fallbacks": 0}
         #: why reads fell back, cumulatively ({cause: n}) — the labeled
@@ -534,6 +538,14 @@ class ResidentStatePlane(Controllable):
                 part_of[rec.key] = p
         self._watermarks.update(ends)
         self._seeded = True
+        if self._views is not None and self._views.active_or_pending:
+            # the seed IS round zero for every registered view: fold the same
+            # scanned logs, anchored at the same end offsets (pending views
+            # activate here — the seed covers them from offset 0). Partitions
+            # re-anchored mid-seed are reconciled by seed_from_log's purge,
+            # which drops their view partials too.
+            self._views.fold_round(logs, part_of, dict(ends),
+                                   activate_pending=True)
         if not logs:
             self._record_gauges()
             return
@@ -671,7 +683,57 @@ class ResidentStatePlane(Controllable):
                                revoked=removed, resident=len(self._dir))
         self._record_gauges()
 
+    # -- materialized views (surge_tpu.replay.views) ------------------------------------
+
+    def attach_views(self, views) -> None:
+        """Hand the plane the engine's :class:`MaterializedViews`: every
+        committed refresh round (and the cold-start seed) folds into them,
+        and every re-anchor path drops their per-partition partials."""
+        self._views = views
+
+    def register_view(self, vdef) -> None:
+        """Register a view against this plane's feed. Before the seed it
+        simply activates (the seed fold covers it from offset 0); on a
+        seeded plane it parks PENDING and the refresh loop backfills the
+        already-folded prefix between rounds — registration never races a
+        fold."""
+        if self._views is None:
+            raise RuntimeError(
+                "no MaterializedViews attached to this resident plane")
+        self._views.register(vdef, active=not self._seeded)
+
+    def _backfill_pending_views(self) -> None:
+        """Executor half of register-while-running: re-read each assigned
+        partition's committed prefix [0, watermark) and fold it into every
+        pending view. Runs between refresh rounds (the loop awaits it), so
+        it never races a fold; a rebalance landing mid-backfill is fenced
+        exactly like the seed — partitions whose anchor generation moved are
+        dropped from the commit."""
+        views = self._views
+        gens = {p: self._anchor_gen.get(p, 0) for p in self.partitions}
+        wms = {p: self._watermarks.get(p, 0) for p in gens}
+        logs: Dict[str, list] = {}
+        part_of: Dict[str, int] = {}
+        for p, wm in wms.items():
+            if wm <= 0:
+                continue
+            for rec in page_keyed_records(self.log, self.events_topic, p,
+                                          upto=wm):
+                ev = self._encode_checked(rec.key, rec.value, p)
+                if ev is None:
+                    logs.pop(rec.key, None)
+                    continue
+                logs.setdefault(rec.key, []).append(ev)
+                part_of[rec.key] = p
+        committed = {p: wm for p, wm in wms.items()
+                     if p in self._watermarks
+                     and self._anchor_gen.get(p, 0) == gens[p]}
+        for name in [v["view"] for v in views.summary() if not v["active"]]:
+            views.fold_view_backfill(name, logs, part_of, committed)
+
     def _purge_partition(self, p: int) -> None:
+        if self._views is not None:
+            self._views.drop_partition(p)
         for agg in [a for a, ap in self._agg_part.items() if ap == p]:
             slot = self._dir.pop(agg, None)
             if slot is not None:
@@ -766,6 +828,10 @@ class ResidentStatePlane(Controllable):
         into the slab (admitting/evicting as needed), advance watermarks.
         Returns False when nothing was pending."""
         loop = asyncio.get_running_loop()
+        if self._views is not None and self._views.has_pending:
+            # register-while-running: backfill the committed prefix into the
+            # pending views BETWEEN rounds (the loop awaits; no fold races)
+            await loop.run_in_executor(None, self._backfill_pending_views)
         wms = {p: self._watermarks.setdefault(p, 0)
                for p in list(self.partitions)}
         gens = {p: self._anchor_gen.get(p, 0) for p in wms}
@@ -823,6 +889,7 @@ class ResidentStatePlane(Controllable):
                     self._watermarks[p] = 0
                     self._anchor_gen[p] = self._anchor_gen.get(p, 0) + 1
             raise
+        committed: Dict[int, int] = {}
         for p, recs in batches.items():
             # skip partitions revoked OR re-anchored (revoke→re-grant) while
             # the round flew: overwriting a re-grant's 0-anchor would skip
@@ -830,6 +897,17 @@ class ResidentStatePlane(Controllable):
             if (p in self._watermarks
                     and self._anchor_gen.get(p, 0) == gens[p]):
                 self._watermarks[p] = recs[-1].offset + 1
+                committed[p] = recs[-1].offset + 1
+        if (self._views is not None and committed
+                and self._views.active_or_pending):
+            # the views' leg of the round rides the same decoded logs, under
+            # the same gen fence the slab commit just passed — one columnar
+            # encode per committed partition, shared by every view. Off-loop:
+            # the view scans are device dispatches the command path must not
+            # share the loop with. fold_round never raises (a failing view
+            # degrades alone); the plane's watermark advance above stands.
+            await loop.run_in_executor(
+                None, self._views.fold_round, logs, part_of, committed)
         elapsed = time.perf_counter() - t0
         self.stats["rounds"] += 1
         self.stats["folded_events"] += n_events

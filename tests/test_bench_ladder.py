@@ -172,3 +172,37 @@ def test_bench_resident_feed_paired_smoke():
     assert paired["python_feed_events_per_sec_median"] > 0
     assert paired["speedup_median"] > 0
     assert payload["value"] == paired["native_feed_events_per_sec_median"]
+
+
+def test_bench_views_paired_smoke():
+    """SURGE_BENCH_VIEWS=1 (ISSUE 17): the paired interleaved view-read vs
+    scan-per-read reader ladder emits per-rung medians for both arms plus a
+    speedup ratio, tiny-sized here — and even at smoke size the warm view
+    must beat the from-scratch scan on medians."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SURGE_BENCH_VIEWS": "1",
+        "SURGE_BENCH_VIEWS_EVENTS": "4000",
+        "SURGE_BENCH_VIEWS_AGGREGATES": "256",
+        "SURGE_BENCH_VIEWS_ROUNDS": "1",
+        "SURGE_BENCH_VIEWS_LADDER": "8",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON payload on stdout: {proc.stdout!r}"
+    payload = json.loads(lines[-1])
+    paired = payload["views_paired"]
+    assert paired["protocol"]["interleaved"] and paired["protocol"]["medians"]
+    (rung,) = paired["rungs"]
+    assert rung["readers"] == 8
+    for arm in ("view_read", "scan_per_read"):
+        assert rung[arm]["reads_per_sec_median"] > 0
+        assert rung[arm]["rounds"]
+    assert rung["speedup_median"] > 1, \
+        "a materialized view must beat a scan-per-read on medians"
+    assert payload["value"] == rung["view_read"]["reads_per_sec_median"]

@@ -145,6 +145,11 @@ def golden_engine_metrics():
     em.resident_fallbacks_poison.record(1)
     em.query_scan_rows.record(5)
     em.query_pushdown_selectivity.record(0.4)
+    # the materialized-view fold leg + changefeed hub (ISSUE 17)
+    em.views_fold_timer.record_ms(3.0)
+    em.views_delta_rows.record(12)
+    em.views_subscribers.record(2)
+    em.views_resume_gap_rounds.record(4)
     return em
 
 

@@ -312,9 +312,131 @@ def test_scan_merges_extended_segment_delta_chunks(tmp_path):
     assert srows["c"] == {"count": 1, "version": 1}
 
 
+OR_GROUP_QUERIES = [
+    # one OR group over one column: increment_by == 1 OR increment_by == 3
+    ScanQuery(aggregates=(Aggregate("count"),
+                          Aggregate("sum", "increment_by")),
+              or_groups=((Predicate("increment_by", "==", 1),
+                          Predicate("increment_by", "==", 3)),)),
+    # CNF: conjunctive predicate AND two OR groups mixing columns + type_id
+    ScanQuery(aggregates=(Aggregate("count"),
+                          Aggregate("max", "sequence_number")),
+              predicates=(Predicate("sequence_number", ">", 1),),
+              or_groups=((Predicate("type_id", "==", 0),
+                          Predicate("type_id", "==", 2)),
+                         (Predicate("increment_by", "<=", 1),
+                          Predicate("sequence_number", ">=", 5)))),
+    # fractional OR-group legs against an integer column (f32 compare path)
+    ScanQuery(aggregates=(Aggregate("count"),),
+              or_groups=((Predicate("increment_by", "<", 1.5),
+                          Predicate("increment_by", ">", 2.5)),)),
+]
+
+
+@pytest.mark.parametrize("qi", range(len(OR_GROUP_QUERIES)))
+def test_or_groups_equal_numpy_reference(qi):
+    """Each OR group is a disjunction; groups AND with each other and the
+    conjunctive predicates — bit-identical to the extended reference."""
+    logs = counter_logs(143, 21, seed=31 + qi)
+    chunks = chunked_colev(logs, 48)
+    q = OR_GROUP_QUERIES[qi]
+    got = QueryEngine(SPEC, config=Config(
+        {"surge.query.chunk-events": 1024})).scan_chunks(chunks, q)
+    want = scan_reference(chunked_colev(logs, 48), q, SPEC.registry)
+    assert got.aggregate_ids == want.aggregate_ids
+    assert got.matched_events == want.matched_events
+    for name in want.columns:
+        assert np.array_equal(got.columns[name], want.columns[name]), name
+    # the OR really widens: each leg alone matches fewer events
+    if qi == 0:
+        for v in (1, 3):
+            leg = QueryEngine(SPEC, config=Config(
+                {"surge.query.chunk-events": 1024})).scan_chunks(
+                chunked_colev(logs, 48),
+                ScanQuery(aggregates=(Aggregate("count"),),
+                          predicates=(Predicate("increment_by", "==", v),)))
+            assert leg.matched_events < got.matched_events
+
+
+def test_group_by_event_column_equals_reference():
+    """group_by keys rows by distinct event-column values instead of
+    aggregate id; the same value recurring across chunks merges into one
+    row, exactly like a repeated aggregate id."""
+    logs = counter_logs(97, 17, seed=41)
+    q = ScanQuery(aggregates=(Aggregate("count"),
+                              Aggregate("sum", "sequence_number"),
+                              Aggregate("max", "sequence_number")),
+                  group_by="increment_by",
+                  event_types=("CountIncremented", "CountDecremented"))
+    got = QueryEngine(SPEC, config=Config(
+        {"surge.query.chunk-events": 1024})).scan_chunks(
+        chunked_colev(logs, 32), q)
+    want = scan_reference(chunked_colev(logs, 32), q, SPEC.registry)
+    assert got.aggregate_ids == want.aggregate_ids
+    # groups form over ALL events' column values (NoOp rows carry the union
+    # default 0); the type filter then zero-matches the "0" group
+    assert sorted(got.aggregate_ids) == ["0", "1", "2", "3"]
+    for name in want.columns:
+        assert np.array_equal(got.columns[name], want.columns[name]), name
+    # truth per group from the flat event stream
+    flat = [e for log in logs for e in log
+            if not isinstance(e, counter.NoOpEvent)]
+    for j, key in enumerate(got.aggregate_ids):
+        # decrements store no increment_by: their union column fills 0
+        members = [e for e in flat
+                   if getattr(e, "increment_by", 0) == int(key)]
+        assert got.columns["count"][j] == len(members)
+        assert got.columns["sum_sequence_number"][j] == sum(
+            e.sequence_number for e in members)
+
+    # group_by type_id: rows keyed by the structural type ids
+    qt = ScanQuery(aggregates=(Aggregate("count"),), group_by="type_id")
+    got_t = QueryEngine(SPEC, config=Config(
+        {"surge.query.chunk-events": 1024})).scan_chunks(
+        chunked_colev(logs, 32), qt)
+    want_t = scan_reference(chunked_colev(logs, 32), qt, SPEC.registry)
+    assert got_t.aggregate_ids == want_t.aggregate_ids
+    assert np.array_equal(got_t.columns["count"], want_t.columns["count"])
+    assert int(got_t.columns["count"].sum()) == sum(
+        len(log) for log in logs)
+
+
+def test_or_groups_and_group_by_mesh_sharded(mesh8):
+    """The extended predicate compiler + group-by dispatch under the 8-device
+    mesh must stay bit-identical to the reference."""
+    logs = counter_logs(121, 19, seed=43)
+    queries = OR_GROUP_QUERIES + [
+        ScanQuery(aggregates=(Aggregate("count"),
+                              Aggregate("sum", "sequence_number")),
+                  group_by="increment_by",
+                  or_groups=((Predicate("sequence_number", "<", 4),
+                              Predicate("sequence_number", ">", 9)),)),
+    ]
+    cfg = Config({"surge.query.chunk-events": 1024})
+    for q in queries:
+        want = scan_reference(chunked_colev(logs, 40), q, SPEC.registry)
+        got = QueryEngine(SPEC, config=cfg, mesh=mesh8).scan_chunks(
+            chunked_colev(logs, 40), q)
+        assert got.aggregate_ids == want.aggregate_ids
+        for name in want.columns:
+            assert np.array_equal(got.columns[name], want.columns[name]), name
+
+
 def test_query_json_round_trip():
     q = QUERIES[2]
     assert ScanQuery.from_json(q.as_json()) == q
+    q2 = OR_GROUP_QUERIES[1]
+    d = q2.as_json()
+    assert "or_groups" in d
+    assert ScanQuery.from_json(d) == q2
+    q3 = ScanQuery(aggregates=(Aggregate("count"),), group_by="increment_by")
+    assert ScanQuery.from_json(q3.as_json()) == q3
+    assert q3.columns_needed() == ["increment_by"]  # group col projects
+    # plain queries serialize without the new keys (wire compat)
+    assert "or_groups" not in QUERIES[0].as_json()
+    assert "group_by" not in QUERIES[0].as_json()
+    with pytest.raises(ValueError):
+        ScanQuery(aggregates=(Aggregate("count"),), or_groups=((),))
     sq = StateQuery(select=("count",), predicates=(
         Predicate("count", ">", 1),), limit=7)
     assert StateQuery.from_json(sq.as_json()) == sq

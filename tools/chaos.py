@@ -20,6 +20,8 @@ over the broker's admin RPCs::
     python tools/chaos.py fleet broker@127.0.0.1:16001,engine@127.0.0.1:7001
     python tools/chaos.py fleet <specs> --serve 9464
     python tools/chaos.py replay-ledger 127.0.0.1:7001 --last 32
+    python tools/chaos.py views 127.0.0.1:7001           # per-view summary
+    python tools/chaos.py views 127.0.0.1:7001 totals    # one view's rows
 
 ``cluster`` drives N brokers from ONE invocation: with no flags it prints a
 per-broker summary (role, epoch, in-sync view, per-partition high-watermarks,
@@ -77,7 +79,7 @@ def main(argv=None) -> int:
     ap.add_argument("command",
                     choices=["arm", "disarm", "status", "broker", "promote",
                              "flight", "metrics", "plans", "cluster",
-                             "handoff", "fleet", "replay-ledger"])
+                             "handoff", "fleet", "replay-ledger", "views"])
     ap.add_argument("target", nargs="?",
                     help="broker host:port (cluster: comma-separated list; "
                          "handoff: the FROM broker)")
@@ -124,6 +126,8 @@ def main(argv=None) -> int:
 
     if args.command == "replay-ledger":
         return _replay_ledger(args)
+    if args.command == "views":
+        return _views(args)
     if args.command == "fleet":
         return _fleet(args)
     if args.command == "cluster":
@@ -237,6 +241,30 @@ def _replay_ledger(args) -> int:
 
     try:
         print(json.dumps(asyncio.run(fetch()), indent=2))
+        return 0
+    except Exception as exc:  # noqa: BLE001 — a down engine is the finding
+        print(json.dumps({"error": str(exc)[:500]}, indent=2))
+        return 1
+
+
+def _views(args) -> int:
+    """Materialized-view operator panel off an ENGINE admin endpoint: the
+    per-view ``QueryView`` summary (active/version, fold watermarks, group
+    and subscriber counts, degraded-state errors) — or, with a view name as
+    the second positional, that one view's served snapshot rows."""
+    import asyncio
+
+    import grpc
+
+    from surge_tpu.admin.server import AdminClient
+
+    async def fetch():
+        async with grpc.aio.insecure_channel(args.target) as channel:
+            return await AdminClient(channel).query_view(args.plan or "")
+
+    try:
+        payload = asyncio.run(fetch())
+        print(json.dumps(payload, indent=2))
         return 0
     except Exception as exc:  # noqa: BLE001 — a down engine is the finding
         print(json.dumps({"error": str(exc)[:500]}, indent=2))

@@ -51,6 +51,8 @@ _COLUMNS = (
     ("waste", "surge_replay_resident_padding_waste_ratio", "{:.1f}"),
     ("ev/us", "surge_replay_resident_events_per_dispatch_us", "{:.2f}"),
     ("skew", "surge_replay_resident_shard_skew", "{:.2f}"),
+    # materialized views: live changefeed subscriptions across views
+    ("v-subs", "surge_replay_views_subscribers", "{:.0f}"),
     ("entities", "surge_engine_live_entities", "{:.0f}"),
     ("cmd/s", "surge_engine_command_rate_one_minute_rate", "{:.1f}"),
 )
