@@ -1963,10 +1963,12 @@ def mesh_bench() -> dict:
     1. **Capacity fold ladder** — steady-state incremental refresh throughput
        (events/s across publish→caught-up cycles) per rung, where the RUNG IS
        THE SLAB CAPACITY, arms = ``surge.replay.mesh.gather`` local vs
-       replicated. The refresh scatter is not donated, so every window copies
-       the slab it writes: the replicated arm copies the FULL slab on every
-       replica while the local arm copies one 1/n_dev shard each — the cost
-       that scales with the resident set. The local arm holds flat up the
+       replicated. When the refresh scatter is undonated
+       (``surge.replay.donate-refresh`` off — the regime BENCH_MESH_r01 was
+       measured in; donation is on by default since ISSUE 18) every window
+       copies the slab it writes: the replicated arm copies the FULL slab on
+       every replica while the local arm copies one 1/n_dev shard each — the
+       cost that scales with the resident set. The local arm holds flat up the
        ladder; the replicated arm collapses (that cliff is WHY multi-device
        is the first-class path for millions of resident aggregates).
     2. **Read row** — batched ``read_many`` projections per arm: device-local
@@ -2204,6 +2206,290 @@ def mesh_bench() -> dict:
     return out
 
 
+def ragged_bench() -> dict:
+    """SURGE_BENCH_RAGGED=1: the bucketed ragged refresh dispatch (ISSUE 18),
+    PAIRED + INTERLEAVED per the round-6 protocol — arms alternate within
+    every round and only cross-round medians count.
+
+    Two measurements:
+
+    1. **Refresh ladder** — sustained incremental refresh throughput per
+       shape × arm: per cycle the batch is published (untimed — the
+       transactional publish is identical across arms), then the refresh
+       DRAIN is timed over manual ``_refresh_once`` rounds; each arm-round's
+       figure is the MEDIAN of its per-cycle drain rates (one 2-vCPU
+       scheduler spike must not decide a round). Shapes: the
+       device-observatory steady-ragged round (~10 lanes, short ragged
+       tails — the ~9-10x over-dispatch regime BENCH_NOTES round 9 named)
+       trickling into a PRODUCTION-sized 64Ki-row resident set, and the
+       uniform dense 512-lane round. Arms: **dense** is the pre-PR refresh
+       of record (the single ``[pow8(lanes), window]`` rectangle per
+       window AND the copying scatter — ``donate-refresh`` off),
+       **bucketed** the new defaults (one fused program per occupied pow2
+       length bucket, donated scatter), **bucketed_pallas** bucketed plans
+       folding through the ragged Pallas tile — interpreter mode on this
+       CPU host, a correctness arm whose wall numbers only mean something
+       on silicon. Waste ratios, µs/slot and per-stage medians read off
+       each arm's ReplayLedger (the PR-16 pattern: the payload and
+       ``DumpReplayLedger`` cannot disagree).
+    2. **Donation probe** — the 1M-row mesh-local refresh device leg,
+       donate-refresh on vs off (paired, interleaved): round-10 measured
+       19 ms/window (local) vs 49 ms (replicated) at this rung and named
+       the undonated slab copy as the cost; the donated arm must beat the
+       copying arm on the same host.
+
+    Knobs: SURGE_BENCH_RAGGED_ROUNDS (3), _CYCLES (24 publish→drain
+    cycles per arm), _DENSE_LANES (512), _CAPACITY (65536 — the steady
+    shape's resident set), _PROBE_CAPACITY (1048576), _PROBE_CYCLES (4),
+    _PROBE (1 — 0 skips the mesh probe)."""
+    import asyncio
+    import random
+    import statistics
+
+    import jax
+
+    from surge_tpu.config import default_config
+    from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
+    from surge_tpu.models import counter
+    from surge_tpu.replay.ledger import ReplayLedger
+    from surge_tpu.replay.resident_state import ResidentStatePlane
+    from surge_tpu.serialization import SerializedMessage
+
+    rounds = max(int(os.environ.get("SURGE_BENCH_RAGGED_ROUNDS", 3)), 1)
+    cycles = int(os.environ.get("SURGE_BENCH_RAGGED_CYCLES", 24))
+    dense_lanes = int(os.environ.get("SURGE_BENCH_RAGGED_DENSE_LANES", 512))
+    steady_cap = int(os.environ.get("SURGE_BENCH_RAGGED_CAPACITY", 65536))
+    probe_cap = int(os.environ.get(
+        "SURGE_BENCH_RAGGED_PROBE_CAPACITY", 1_048_576))
+    probe_cycles = int(os.environ.get("SURGE_BENCH_RAGGED_PROBE_CYCLES", 4))
+    run_probe = os.environ.get("SURGE_BENCH_RAGGED_PROBE", "1") == "1"
+
+    evt_fmt = counter.event_formatting()
+    state_fmt = counter.state_formatting()
+    npart = 4
+    med = statistics.median
+
+    # the dense arm is the PRE-PR refresh of record — the single padded
+    # rectangle per window AND the copying (undonated) scatter, exactly what
+    # shipped before ISSUE 18; bucketed/bucketed_pallas ride the new
+    # defaults (bucketed dispatch + donated scatter). The decompositions
+    # stay isolated: waste_ratio measures bucketing alone, the 1M-row probe
+    # measures donation alone (both its arms bucketed).
+    ARMS = {
+        "dense": {"surge.replay.resident.refresh-dispatch": "dense",
+                  "surge.replay.donate-refresh": False},
+        "bucketed": {"surge.replay.resident.refresh-dispatch": "bucketed"},
+        "bucketed_pallas": {
+            "surge.replay.resident.refresh-dispatch": "bucketed",
+            "surge.replay.tile-backend": "pallas",
+            "surge.replay.dispatch": "select"},
+    }
+    # (lanes, tails(rng) -> per-lane event count) — every arm of a round
+    # replays the IDENTICAL per-cycle workload (same seed, fresh log). The
+    # steady-ragged shape is the observatory's (~10 lanes, short tails):
+    # tails 5-8 land in ONE pow2 width bucket, so the bucketed arm's win is
+    # pure lane-padding shed ([16,8] vs the dense [64,8] rectangle) — rounds
+    # whose tails straddle several width buckets additionally pay one
+    # program call per bucket, which on this 2-vCPU host is the dominant
+    # cost at 10-lane sizes (see BENCH_NOTES round 11's honest-read)
+    # the steady-ragged shape runs against a PRODUCTION-sized resident set
+    # (_CAPACITY rows, not the observatory test's 64): trickling ragged
+    # updates into a big slab is the round-9/10 roofline regime, and the
+    # capacity is what the pre-PR copying scatter pays per window
+    SHAPES = {
+        "steady_ragged": (10, lambda rng: rng.randrange(5, 9), steady_cap),
+        f"dense_{dense_lanes}": (dense_lanes, lambda rng: 4, dense_lanes),
+    }
+
+    def make_arm_log(n_lanes):
+        log_t = InMemoryLog()
+        log_t.create_topic(TopicSpec("events", npart))
+        prod = log_t.transactional_producer("bench")
+        seqs = {f"agg-{i}": 0 for i in range(n_lanes)}
+
+        def publish(batch):
+            prod.begin()
+            for a, n in batch:
+                for _ in range(n):
+                    seqs[a] += 1
+                    ev = counter.CountIncremented(a, 1, seqs[a])
+                    prod.send(LogRecord(topic="events", key=a,
+                                        value=evt_fmt.write_event(ev).value,
+                                        partition=hash(a) % npart))
+            prod.commit()
+        return log_t, publish
+
+    def make_plane(log_t, cap, ledger, overrides, mesh=None):
+        return ResidentStatePlane(
+            log_t, "events", counter.make_replay_spec(),
+            config=default_config().with_overrides({
+                "surge.replay.resident.capacity": cap,
+                "surge.replay.resident.refresh-interval-ms": 1,
+                "surge.replay.time-chunk": 8,
+                **overrides,
+            }),
+            deserialize_event=lambda b: evt_fmt.read_event(
+                SerializedMessage(key="", value=b)),
+            serialize_state=lambda a, s: state_fmt.write_state(s).value,
+            mesh=mesh, ledger=ledger)
+
+    async def refresh_arm(arm, shape, seed):
+        n_lanes, tails, cap = SHAPES[shape]
+        rng = random.Random(seed)
+        batches = [[(f"agg-{i}", tails(rng)) for i in range(n_lanes)]
+                   for _ in range(cycles + 1)]
+        log_t, publish = make_arm_log(n_lanes)
+        ledger = ReplayLedger(name=f"bench:ragged:{arm}")
+        plane = make_plane(log_t, cap, ledger, ARMS[arm])
+        plane._ensure_device_state()
+        plane.seed_from_log()
+        try:
+            publish(batches[0])  # warm the arm's program shapes
+            while plane.lag_records() > 0:
+                await plane._refresh_once()
+            # the timed leg is the refresh DRAIN, driven by manual rounds
+            # (no refresh timer, no catch-up poll — both would quantize a
+            # sub-ms drain): the transactional publish is identical across
+            # arms and ~4x the refresh at the steady-ragged size, so
+            # publish-inclusive rates are flat no matter what the dispatch
+            # arm does.  Per-cycle rates + median: one scheduler/GC spike
+            # on the 2-vCPU host must not decide a round.
+            cyc_rates = []
+            for batch in batches[1:]:
+                publish(batch)
+                t0 = time.perf_counter()
+                while plane.lag_records() > 0:
+                    await plane._refresh_once()
+                cyc_rates.append(sum(n for _, n in batch)
+                                 / (time.perf_counter() - t0))
+            eps = med(cyc_rates)
+            summ = ledger.summary()
+            stages = ledger.round_stages_us()
+            return eps, {
+                "waste_ratio": summ["waste_ratio"],
+                "us_per_slot": summ["us_per_slot"],
+                "bucket_programs": summ["bucket_programs"],
+                "bucket_fill_ratio": (
+                    round(summ["lanes"] / summ["bucket_lane_slots"], 3)
+                    if summ["bucket_lane_slots"] else None),
+                "dispatch_us_median": (round(med(stages["dispatch_us"]))
+                                       if stages["dispatch_us"] else 0),
+            }
+        finally:
+            await plane.stop()
+
+    out: dict = {"ragged_rounds": rounds, "ragged_cycles": cycles,
+                 "protocol": {"interleaved": True, "medians": True}}
+    arm_names = list(ARMS)
+    per: dict = {s: {a: {"eps": [], "obs": []} for a in ARMS} for s in SHAPES}
+    for rnd in range(rounds):
+        order = arm_names[::-1] if rnd % 2 else arm_names
+        for shape in SHAPES:
+            for arm in order:
+                eps, obs = asyncio.run(refresh_arm(arm, shape, seed=rnd))
+                per[shape][arm]["eps"].append(eps)
+                per[shape][arm]["obs"].append(obs)
+    out["ragged_ladder"] = {}
+    for shape in SHAPES:
+        row = {}
+        for arm in ARMS:
+            eps_rounds = per[shape][arm]["eps"]
+            obs = per[shape][arm]["obs"]
+            row[arm] = {
+                "events_per_sec_median": round(med(eps_rounds)),
+                "rounds": [round(x) for x in eps_rounds],
+                "waste_ratio": round(med(o["waste_ratio"] for o in obs), 2),
+                "us_per_slot": round(med(o["us_per_slot"] for o in obs), 2),
+                "dispatch_us_median": round(
+                    med(o["dispatch_us_median"] for o in obs)),
+                "bucket_fill_ratio": obs[0]["bucket_fill_ratio"],
+            }
+        row["bucketed_vs_dense"] = round(
+            row["bucketed"]["events_per_sec_median"]
+            / row["dense"]["events_per_sec_median"], 2)
+        row["waste_reduction"] = round(
+            row["dense"]["waste_ratio"]
+            / row["bucketed"]["waste_ratio"], 2)
+        row["bucketed_wins_every_round"] = all(
+            b > d for b, d in zip(per[shape]["bucketed"]["eps"],
+                                  per[shape]["dense"]["eps"]))
+        out["ragged_ladder"][shape] = row
+        log(f"ragged ladder [{shape}]: dense "
+            f"{row['dense']['events_per_sec_median']} vs bucketed "
+            f"{row['bucketed']['events_per_sec_median']} vs pallas "
+            f"{row['bucketed_pallas']['events_per_sec_median']} ev/s; "
+            f"waste {row['dense']['waste_ratio']}x -> "
+            f"{row['bucketed']['waste_ratio']}x "
+            f"({row['waste_reduction']}x less), bucketed wins every round: "
+            f"{row['bucketed_wins_every_round']}")
+
+    # -- the 1M-row donation probe (mesh-local, donate on vs off) -----------
+    if run_probe:
+        devs = jax.devices()
+        assert len(devs) >= 8, (
+            "ragged donation probe needs 8 forced host devices — main() "
+            "must set xla_force_host_platform_device_count before jax init")
+        mesh = jax.sharding.Mesh(np.array(devs[:8]), ("data",))
+        probe_aggs = 512
+
+        async def probe_arm(donate: bool):
+            log_t, publish = make_arm_log(probe_aggs)
+            ledger = ReplayLedger(name="bench:ragged:probe")
+            plane = make_plane(log_t, probe_cap, ledger, {
+                "surge.replay.donate-refresh": donate}, mesh=mesh)
+            await plane.start()
+            try:
+                batch = [(f"agg-{i}", 2) for i in range(probe_aggs)]
+                publish(batch)  # warm/compile outside the timed cycles
+                while plane.lag_records() > 0:
+                    await asyncio.sleep(0.002)
+                warm_rounds = ledger.totals["rounds"]
+                for _ in range(probe_cycles):
+                    publish(batch)
+                    while plane.lag_records() > 0:
+                        await asyncio.sleep(0.002)
+                # per-window device dispatch of the timed rounds only (the
+                # warm cycle's rounds carry the compiles)
+                per_window = [ev["dispatch_us"] / max(ev["windows"], 1)
+                              for i, ev in enumerate(
+                                  e for e in ledger.events()
+                                  if e["type"] == "round")
+                              if i >= warm_rounds]
+                return {
+                    "ms_per_window": round(med(per_window) / 1000.0, 2)
+                    if per_window else 0.0,
+                    "windows": int(ledger.totals["windows"]),
+                }
+            finally:
+                await plane.stop()
+
+        probe: dict = {"capacity": probe_cap, "donated": [], "copying": []}
+        for rnd in range(rounds):
+            order = ((False, True) if rnd % 2 else (True, False))
+            for donate in order:
+                r = asyncio.run(probe_arm(donate))
+                probe["donated" if donate else "copying"].append(
+                    r["ms_per_window"])
+        out["donation_probe"] = {
+            "capacity": probe_cap,
+            "donated_ms_per_window": round(med(probe["donated"]), 2),
+            "copying_ms_per_window": round(med(probe["copying"]), 2),
+            "donated_vs_copying": round(
+                med(probe["copying"]) / med(probe["donated"]), 2)
+            if med(probe["donated"]) else 0.0,
+            "round10_local_ms_per_window": 19.0,
+            "donated_rounds": probe["donated"],
+            "copying_rounds": probe["copying"],
+        }
+        p = out["donation_probe"]
+        log(f"donation probe @{probe_cap} rows: donated "
+            f"{p['donated_ms_per_window']} vs copying "
+            f"{p['copying_ms_per_window']} ms/window "
+            f"({p['donated_vs_copying']}x; round-10 undonated local "
+            f"figure: 19 ms)")
+    return out
+
+
 def main() -> None:
     orig_env = dict(os.environ)
     # the parent NEVER initializes the tunneled backend — pin it to the host CPU
@@ -2211,10 +2497,12 @@ def main() -> None:
     os.environ.update(_cpu_env(orig_env))
     for k in ("PALLAS_AXON_POOL_IPS", "AXON_POOL_IPS"):
         os.environ.pop(k, None)
-    if os.environ.get("SURGE_BENCH_MESH", "0") == "1":
-        # the mesh arms need the tier-1 topology: force 8 host devices BEFORE
-        # the first jax backend initialization (flag changes after init are
-        # silently ignored)
+    if (os.environ.get("SURGE_BENCH_MESH", "0") == "1"
+            or os.environ.get("SURGE_BENCH_RAGGED", "0") == "1"):
+        # the mesh arms (and the ragged bench's 1M-row donation probe) need
+        # the tier-1 topology: force 8 host devices BEFORE the first jax
+        # backend initialization (flag changes after init are silently
+        # ignored)
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
@@ -2303,6 +2591,20 @@ def main() -> None:
         payload.update(stats)
         payload["value"] = max(r["local_events_per_sec"]
                                for r in stats["mesh_fold_ladder"])
+        emit(payload)
+        return
+
+    # SURGE_BENCH_RAGGED=1: bucketed ragged refresh dispatch — paired
+    # interleaved dense vs bucketed vs bucketed+pallas arms on the
+    # steady-ragged and dense shapes, plus the 1M-row donation probe
+    if os.environ.get("SURGE_BENCH_RAGGED", "0") == "1":
+        payload = {"metric": "ragged_fold_events_per_sec", "value": 0,
+                   "unit": "events/s"}
+        stats = ragged_bench()
+        payload.update(stats)
+        payload["value"] = max(
+            row["bucketed"]["events_per_sec_median"]
+            for row in stats["ragged_ladder"].values())
         emit(payload)
         return
 

@@ -135,6 +135,14 @@ DEFAULTS: dict[str, Any] = {
     "surge.replay.length-buckets": "64,256,1024,4096",
     "surge.replay.mesh-axes": "data",
     "surge.replay.donate-carry": True,
+    # donate the resident plane's slab + ordinals through the refresh
+    # scatter programs (ISSUE 18 leg c): the round overwrites the slab
+    # in place instead of copying it (the round-10 19 ms vs 49 ms device
+    # leg at 1M rows WAS the copy). Kill-switchable like donate-carry:
+    # false restores copying dispatches (no read path ever sees a
+    # deleted buffer either way — the plane republishes the handle per
+    # window and the gather lane retries across a donation race)
+    "surge.replay.donate-refresh": True,
     # scan-step dispatch ("switch" = lax.switch over schema branches,
     # "select" = compute-all-and-select) and the tile-loop backend ("auto"
     # picks the scanless assoc tree fold for models shipping AssociativeFold)
@@ -206,6 +214,13 @@ DEFAULTS: dict[str, Any] = {
     # bounded replay ledger ring (per-round padding-waste / stage timings /
     # gather legs, dumped via the DumpReplayLedger admin RPC)
     "surge.replay.resident.ledger-capacity": 512,
+    # refresh dispatch shape (ISSUE 18): "bucketed" deals each round's lanes
+    # into pow2 length buckets and issues one fused program per OCCUPIED
+    # bucket (pay for occupied slots, with the compile-signature set bounded
+    # by the layout's bucket table); "dense" restores the single
+    # [pow8(lanes), pow2(max_len)] rectangle per window (the round-9
+    # ~9x over-dispatch arm, kept as the paired-bench baseline)
+    "surge.replay.resident.refresh-dispatch": "bucketed",  # bucketed | dense
     # --- mesh-native resident plane (surge_tpu.replay.plane_mesh) ---
     # how a mesh-backed plane resolves reads/folds against its sharded slab:
     # "local" (default) shards the slab [n_dev, rows] and answers each

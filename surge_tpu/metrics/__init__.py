@@ -267,6 +267,8 @@ class EngineMetrics:
     resident_events_per_dispatch_us: Sensor = field(init=False)
     resident_round_events: Sensor = field(init=False)
     resident_shard_skew: Sensor = field(init=False)
+    resident_bucket_dispatches: Sensor = field(init=False)
+    resident_bucket_fill_ratio: Sensor = field(init=False)
     # TPU scan engine over columnar segments (surge_tpu.replay.query): the
     # analytics plane's scan cadence and coverage
     query_scan_timer: Timer = field(init=False)
@@ -447,7 +449,7 @@ class EngineMetrics:
         self.resident_padding_waste_ratio = m.gauge(MI(
             "surge.replay.resident.padding-waste-ratio",
             "last refresh round's dispatched-to-occupied event-slot ratio "
-            "(pow8 lane bucket x window width over events folded; the "
+            "(lane bucket x window width over events folded; the "
             "over-dispatch the fold-efficiency SLO bounds)"))
         self.resident_dispatch_occupancy = m.gauge(MI(
             "surge.replay.resident.dispatch-occupancy",
@@ -464,6 +466,16 @@ class EngineMetrics:
             "surge.replay.resident.shard-skew",
             "last refresh round's max/mean lane-deal imbalance across mesh "
             "shards (1.0 = perfectly balanced; single-device rounds read 1)"))
+        self.resident_bucket_dispatches = m.gauge(MI(
+            "surge.replay.resident.bucket-dispatches",
+            "bucket refresh programs dispatched by the last refresh round "
+            "(one fused admission+fold+scatter per occupied length bucket; "
+            "dense rounds read 1 per fold group)"))
+        self.resident_bucket_fill_ratio = m.gauge(MI(
+            "surge.replay.resident.bucket-fill-ratio",
+            "occupied fraction of the last refresh round's dispatched lane "
+            "slots (lanes dealt over pow2 lane-bucket capacity summed across "
+            "bucket programs; 1.0 = every dispatched lane held an aggregate)"))
         self.query_scan_timer = m.timer(MI(
             "surge.query.scan-timer",
             "ms per segment scan / state query (device dispatch + the one "

@@ -78,6 +78,7 @@ class ReplayLedger(FlightRecorder):
             "rounds": 0, "events": 0, "lanes": 0, "windows": 0,
             "dispatched_slots": 0, "occupied_slots": 0,
             "dispatch_us": 0.0, "encode_us": 0.0, "feed_us": 0.0,
+            "bucket_programs": 0, "bucket_lane_slots": 0,
             "gathers": 0, "gathered_rows": 0, "gather_wait_us": 0.0,
             "queries": 0, "query_rows": 0,
             "view_rounds": 0, "view_delta_rows": 0, "view_fold_us": 0.0,
@@ -90,12 +91,20 @@ class ReplayLedger(FlightRecorder):
                      feed_us: float, encode_us: float, dispatch_us: float,
                      deal_sizes: Optional[Sequence[int]] = None,
                      causes: Optional[Dict[str, int]] = None,
-                     evictions: int = 0) -> None:
+                     evictions: int = 0,
+                     buckets: Optional[Sequence[Dict]] = None,
+                     bucket_table: Optional[int] = None) -> None:
         """One refresh round's anatomy. ``dispatched``/``occupied`` are
         event SLOTS (lane bucket × window width summed over the round's
         window dispatches vs events actually folded); ``causes`` carries
         the round's fallback-cause deltas; ``deal_sizes`` the per-shard
-        lane-deal lengths on the mesh path (None single-device)."""
+        lane-deal lengths on the mesh path (None single-device).
+
+        ``buckets`` (bucketed refresh dispatch, ISSUE 18) carries one dict
+        per fused bucket program the round issued — ``{width, lanes_b,
+        lanes, windows, dispatched, occupied, ragged}`` — and
+        ``bucket_table`` the size of the layout's bounded compile-signature
+        table; both optional so pre-bucketing callers stay source-compatible."""
         t = self.totals
         t["rounds"] += 1
         t["events"] += events
@@ -106,6 +115,10 @@ class ReplayLedger(FlightRecorder):
         t["dispatch_us"] += dispatch_us
         t["encode_us"] += encode_us
         t["feed_us"] += feed_us
+        if buckets:
+            t["bucket_programs"] += len(buckets)
+            t["bucket_lane_slots"] += sum(
+                int(bk.get("lanes_b", 0)) for bk in buckets)
         self.record(
             "round", events=events, lanes=lanes, windows=windows,
             dispatched=dispatched, occupied=occupied,
@@ -116,7 +129,9 @@ class ReplayLedger(FlightRecorder):
             deal_sizes=list(deal_sizes) if deal_sizes else None,
             skew=round(shard_skew(deal_sizes), 3),
             causes=dict(causes) if causes else None,
-            evictions=evictions or None)
+            evictions=evictions or None,
+            buckets=[dict(bk) for bk in buckets] if buckets else None,
+            bucket_table=bucket_table)
 
     def record_gather(self, *, reads: int, rows: int, wait_us: float,
                       dispatch_us: float, fetch_us: float,

@@ -97,3 +97,87 @@ def make_tile_scan(spec, wire, width: int, bs: int, unroll: int):
         return dict(zip(state_fields, out))
 
     return tile_scan
+
+
+def make_ragged_fold(spec, wire, width: int, bs: int, rows: int, unroll: int):
+    """The RAGGED refresh tile (ISSUE 18 leg b): ``(carry {f: [bs]},
+    words u32 [rows], sides {name: [rows]}, starts i32 [bs], lens i32 [bs],
+    ord i32 [bs]) -> carry`` as a pallas_call.
+
+    Instead of streaming a dense ``[width, lanes]`` rectangle (whose padding
+    the steady ragged round pays ~9× over), the kernel walks a per-lane
+    offset index over ONE flat packed event buffer: step ``t`` of lane ``b``
+    reads ``words[starts[b] + t]``, valid while ``t < lens[b]``. Out-of-range
+    steps clip-gather into OTHER lanes' regions — safe because ``valid``
+    masks the decoded type to the pad sentinel (−1) and the step fn carries
+    state through pad events (the same contract as the engine's flat-corpus
+    worklists). ``starts`` arrive pre-shifted for chained windows; ``lens``
+    is the lane's remaining length within this window, and the derived
+    ordinal of local step ``t`` is ``ord[b] + t + 1``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from surge_tpu.replay.engine import make_step_fn
+
+    step = make_step_fn(spec, "select")
+    state_fields = [f.name for f in spec.registry.state.fields]
+    side_names = sorted(f.name for f in wire.side_fields)
+    lb = min(_LANE_BLOCK, bs)
+    while bs % lb != 0:
+        lb //= 2
+    assert lb >= 1, bs
+    interpret = jax.default_backend() not in ("tpu", "axon")
+
+    def kernel(*refs):
+        words_ref = refs[0]
+        side_refs = dict(zip(side_names, refs[1: 1 + len(side_names)]))
+        k = 1 + len(side_names)
+        starts_ref, lens_ref, ord_ref = refs[k], refs[k + 1], refs[k + 2]
+        in_refs = dict(zip(state_fields,
+                           refs[k + 3: k + 3 + len(state_fields)]))
+        out_refs = dict(zip(state_fields, refs[k + 3 + len(state_fields):]))
+
+        # the flat buffer rides whole into each grid cell (every lane block
+        # gathers arbitrary offsets of it); it is sized to the bucket's
+        # OCCUPIED events, not the padded rectangle, so "whole" is the point
+        words = words_ref[:]
+        sides_all = {name: r[:] for name, r in side_refs.items()}
+        starts = starts_ref[:]
+        lens = lens_ref[:]
+        ordr = ord_ref[:]
+        state0 = {name: in_refs[name][:] for name in state_fields}
+
+        def body(t, state):
+            idx = jnp.minimum(starts + t, np.int32(rows - 1))
+            word = words[idx]
+            side_row = {name: v[idx] for name, v in sides_all.items()}
+            events = wire.decode_words(word, side_row, t < lens, ordr, t)
+            return step(state, events)
+
+        state = jax.lax.fori_loop(0, width, body, state0, unroll=unroll)
+        for name in state_fields:
+            out_refs[name][:] = state[name]
+
+    grid = (bs // lb,)
+    flat_spec = pl.BlockSpec((rows,), lambda i: (0,))
+    vec_spec = pl.BlockSpec((lb,), lambda i: (i,))
+
+    def ragged_fold(carry: Mapping[str, Any], words, sides: Mapping[str, Any],
+                    starts, lens, ordinals):
+        state_dtypes = {f.name: np.dtype(f.dtype)
+                        for f in spec.registry.state.fields}
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[flat_spec] + [flat_spec] * len(side_names)
+                     + [vec_spec] * 3 + [vec_spec] * len(state_fields),
+            out_specs=[vec_spec] * len(state_fields),
+            out_shape=[jax.ShapeDtypeStruct((bs,), state_dtypes[n])
+                       for n in state_fields],
+            interpret=interpret,
+        )(words, *(sides[n] for n in side_names), starts, lens, ordinals,
+          *(carry[n] for n in state_fields))
+        return dict(zip(state_fields, out))
+
+    return ragged_fold

@@ -46,10 +46,10 @@ import numpy as np
 __all__ = ["MeshPlane"]
 
 
-def _pow8(n: int, lo: int = 8) -> int:
+def _pow2(n: int, lo: int = 8) -> int:
     cap = lo
     while cap < n:
-        cap *= 8
+        cap *= 2
     return cap
 
 
@@ -109,7 +109,10 @@ class MeshPlane:
         live = slots < cap
         owner = self.owners(slots)
         deals = [np.nonzero(live & (owner == d))[0] for d in range(self.n_dev)]
-        width = _pow8(max((len(d) for d in deals), default=1), bucket_lo)
+        # pow2 local lane bucket: the global arrays already arrive at pow2
+        # (bucketed) or pow8 (dense) lane buckets, so the per-shard ladder
+        # stays bounded without re-coarsening a small bucket's deal to 8×
+        width = _pow2(max((len(d) for d in deals), default=1), bucket_lo)
         return deals, width
 
     def refresh(self, slab, ords, admit_idx: np.ndarray,
@@ -216,7 +219,12 @@ class MeshPlane:
             # type differs per switch branch; everything here is
             # per-device-local (no collectives), so skip the VMA check
             check_vma=False)
-        prog = jax.jit(mapped)
+        # sharded slab+ordinal donation (surge.replay.donate-refresh): each
+        # shard's refresh scatter consumes the columns it rewrites instead of
+        # copying them every window — the plane republishes its handle after
+        # every donated dispatch (resident_state._dispatch_plan)
+        prog = jax.jit(mapped, donate_argnums=(
+            (0, 1) if plane._donate_refresh else ()))
         self._programs[key] = prog
         return prog
 

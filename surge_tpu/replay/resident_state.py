@@ -180,6 +180,39 @@ class ResidentStatePlane(Controllable):
         # rounds longer than one window fold through several chained windows
         self._window = _pow2(
             max(self.config.get_int("surge.replay.time-chunk", 512), 8))
+        # refresh dispatch shape (ISSUE 18): "bucketed" (default) deals each
+        # round's lanes into pow2 LENGTH buckets and issues one fused
+        # admission→fold→scatter program per occupied bucket, so a steady
+        # ragged round pays for slots near its occupied count instead of the
+        # dense _pow8(lanes) × _pow2(max_len) rectangle; "dense" keeps the
+        # single-rectangle dispatch (the paired-bench baseline arm and the
+        # rollback switch)
+        self._refresh_dispatch = self.config.get_str(
+            "surge.replay.resident.refresh-dispatch", "bucketed")
+        if self._refresh_dispatch not in ("bucketed", "dense"):
+            raise ValueError(
+                f"unknown surge.replay.resident.refresh-dispatch "
+                f"{self._refresh_dispatch!r} (bucketed|dense)")
+        # donate the slab+ordinal columns through every refresh scatter so
+        # the round stops copying the slab it writes (kill-switchable like
+        # donate-carry; see _build_programs for the read-race contract)
+        self._donate_refresh = self.config.get_bool(
+            "surge.replay.donate-refresh", True)
+        # the ragged Pallas fold tile rides the bucketed plans on the
+        # single-device path when the operator EXPLICITLY picks the pallas
+        # tile backend (auto keeps the jit rectangle fold — the kernel's
+        # interpreter mode on cpu is a correctness arm, not a fast path)
+        self._ragged = (
+            self._refresh_dispatch == "bucketed"
+            and self.config.get_str(
+                "surge.replay.tile-backend", "auto") == "pallas")
+        #: every (lanes_b, width) pair a refresh program may compile at —
+        #: the product of the pow2 lane ladder (8.._pow2(capacity)) and the
+        #: pow2 width ladder (2..window). Both the dense sigs (pow8 lanes ⊂
+        #: pow2 lanes, widths ≥ 8) and the bucketed sigs draw from this set,
+        #: so the compile-signature count per slab layout is bounded by it
+        #: however adversarially lane counts / tail lengths vary.
+        self.bucket_table = self._build_bucket_table()
 
         self.partitions: List[int] = sorted(
             partitions if partitions is not None
@@ -221,6 +254,7 @@ class ResidentStatePlane(Controllable):
         self._ords = None
         self._programs_built = False
         self._signatures: set = set()  # (kind, shape...) — compile detection
+        self._ragged_progs: dict = {}  # (lanes_b, width, rows_b) -> jit
 
         # read gather lane
         self._pending: List[Tuple[str, asyncio.Future]] = []
@@ -245,10 +279,29 @@ class ResidentStatePlane(Controllable):
         # per-round fold accounting (reset each refresh round): padded event
         # slots dispatched vs occupied, device dispatch wall, window count —
         # the padding-waste ledger's raw material
-        self._round_acc: Dict[str, Any] = {
-            "windows": 0, "dispatched": 0, "occupied": 0, "dispatch_s": 0.0,
-            "lanes": 0, "batch": 0, "width": 0, "evictions": 0}
+        self._round_acc: Dict[str, Any] = self._fresh_round_acc()
         self._pending_t0: Optional[float] = None  # gather coalesce-wait start
+
+    @staticmethod
+    def _fresh_round_acc() -> Dict[str, Any]:
+        return {"windows": 0, "dispatched": 0, "occupied": 0,
+                "dispatch_s": 0.0, "lanes": 0, "batch": 0, "width": 0,
+                "evictions": 0, "programs": 0, "lane_slots": 0, "buckets": []}
+
+    def _build_bucket_table(self) -> frozenset:
+        """The bounded compile-signature set: every (lane bucket, window
+        width) a refresh program may be shaped at for this capacity/window
+        layout. Small by construction — O(log capacity × log window)."""
+        lanes, cap = [], 8
+        top = _pow2(self.capacity)
+        while cap <= top:
+            lanes.append(cap)
+            cap *= 2
+        widths, w = [], 2
+        while w <= self._window:
+            widths.append(w)
+            w *= 2
+        return frozenset((lb, wb) for lb in lanes for wb in widths)
 
     def _build_state_materializer(self):
         """Precompiled row → domain-state constructor, the batch read path's
@@ -330,7 +383,8 @@ class ResidentStatePlane(Controllable):
         if self._mesh_local:
             from surge_tpu.replay.plane_mesh import MeshPlane
 
-            self._meshp = MeshPlane(self)
+            if self._meshp is None:  # kept across a deleted-slab recovery
+                self._meshp = MeshPlane(self)
             self._slab, self._ords = self._meshp.init_slab()
             self._build_programs()
             return
@@ -394,10 +448,18 @@ class ResidentStatePlane(Controllable):
             ords = ords.at[lane_slots].add(lane_counts)
             return slab, ords
 
-        # no carry donation: the gather lane may hold an in-flight read of the
-        # previous slab while a fold dispatches — the copy is capacity-sized
-        # (KBs..MBs), the deleted-buffer race is not worth it
-        self._refresh_prog = jax.jit(refresh)
+        # slab+ordinal donation (surge.replay.donate-refresh, default on):
+        # the refresh scatter consumes the columns it rewrites instead of
+        # copying the capacity-sized slab every window (the round-10 ladder's
+        # replicated-arm collapse WAS this copy). The gather lane may still
+        # hold an in-flight read of the previous slab while a fold
+        # dispatches: _fold_group republishes self._slab after every donated
+        # window and _drain_batch re-pins + retries on the deleted-buffer
+        # error; a dispatch that fails after consuming its inputs rebuilds
+        # through _recover_if_slab_deleted. The kill-switch restores the old
+        # copying jit wholesale.
+        self._refresh_prog = jax.jit(
+            refresh, donate_argnums=(0, 1) if self._donate_refresh else ())
 
         def gather_wide(slab, ords, idx):
             cols = []
@@ -850,9 +912,7 @@ class ResidentStatePlane(Controllable):
             self._record_gauges()
             return False
         t0 = time.perf_counter()
-        self._round_acc = {
-            "windows": 0, "dispatched": 0, "occupied": 0, "dispatch_s": 0.0,
-            "lanes": 0, "batch": 0, "width": 0, "evictions": 0}
+        self._round_acc = self._fresh_round_acc()
         # the heavy host-side work — per-record deserialize + tensor encode —
         # runs OFF the event loop: a fold round must not stall the command
         # path it shares the loop with (only state mutation + the program
@@ -888,6 +948,10 @@ class ResidentStatePlane(Controllable):
                     self._purge_partition(p)
                     self._watermarks[p] = 0
                     self._anchor_gen[p] = self._anchor_gen.get(p, 0) + 1
+            # a donated dispatch that failed AFTER consuming its inputs
+            # leaves no slab to serve from — rebuild it empty and re-anchor
+            # EVERY tracked partition for refold (the never-double-fold route)
+            self._recover_if_slab_deleted()
             raise
         committed: Dict[int, int] = {}
         for p, recs in batches.items():
@@ -932,6 +996,42 @@ class ResidentStatePlane(Controllable):
         self._record_gauges()
         return True
 
+    def _slab_deleted(self) -> bool:
+        if self._slab is None:
+            return False
+        leaf = next(iter(self._slab.values()))
+        deleted = getattr(leaf, "is_deleted", None)
+        return bool(deleted()) if callable(deleted) else False
+
+    def _recover_if_slab_deleted(self) -> None:
+        """Last-ditch donation recovery: a refresh dispatch that raised after
+        donation consumed the slab left neither the old columns nor a result
+        to rebind. Every resident/spilled row's provenance is the log, so the
+        plane rebuilds EMPTY and re-anchors every tracked partition at 0 —
+        the refresh loop refolds them from scratch exactly like a re-grant,
+        which can never double-fold. No-op while the slab is live (the
+        common failure path: the error fired before the dispatch consumed)."""
+        if not self._slab_deleted():
+            return
+        for p in list(self._watermarks):
+            self._purge_partition(p)
+            self._watermarks[p] = 0
+            self._anchor_gen[p] = self._anchor_gen.get(p, 0) + 1
+        # defensive sweep: every row was consumed with the slab, so nothing
+        # host-side may keep claiming residency or spill coverage
+        self._dir.clear()
+        self._spill.clear()
+        self._lru.clear()
+        self._agg_part.clear()
+        self._free = list(range(self.capacity))
+        self._slab = None
+        self._ords = None
+        self._ensure_device_state()
+        logger.warning(
+            "resident slab was consumed by a failed donated refresh "
+            "dispatch; rebuilt empty and re-anchored %d partition(s) for "
+            "refold", len(self._watermarks))
+
     def _observe_round(self, n_events: int, feed_s: float,
                        enc_s: float) -> None:
         """Device-observatory round close: the padding-waste gauges off the
@@ -943,6 +1043,7 @@ class ResidentStatePlane(Controllable):
         waste = waste_ratio(dispatched, occupied)
         dispatch_us = acc["dispatch_s"] * 1e6
         deal = self._meshp.last_deal if self._meshp is not None else None
+        lane_slots = acc["lane_slots"]
         if self.metrics is not None:
             m = self.metrics
             m.resident_round_events.record(n_events)
@@ -952,6 +1053,9 @@ class ResidentStatePlane(Controllable):
             m.resident_events_per_dispatch_us.record(
                 n_events / dispatch_us if dispatch_us > 0 else 0.0)
             m.resident_shard_skew.record(shard_skew(deal))
+            m.resident_bucket_dispatches.record(acc["programs"])
+            m.resident_bucket_fill_ratio.record(
+                acc["lanes"] / lane_slots if lane_slots else 0.0)
         if self.ledger is not None:
             causes, self._round_causes = self._round_causes, {}
             self.ledger.record_round(
@@ -960,7 +1064,9 @@ class ResidentStatePlane(Controllable):
                 batch=acc["batch"], width=acc["width"],
                 feed_us=feed_s * 1e6, encode_us=enc_s * 1e6,
                 dispatch_us=dispatch_us, deal_sizes=deal,
-                causes=causes or None, evictions=acc["evictions"])
+                causes=causes or None, evictions=acc["evictions"],
+                buckets=acc["buckets"] or None,
+                bucket_table=len(self.bucket_table))
 
     def _decode_batches(self, batches: Dict[int, list]):
         """Executor half of a refresh round: deserialize + encode every
@@ -1070,25 +1176,94 @@ class ResidentStatePlane(Controllable):
                 "the host store only", agg_id)
 
     def _encode_pack_group(self, event_logs: List[list]):
-        """Executor half of one fold group: ragged encode + every time
-        window's wire pack. Pure — touches no plane state."""
-        enc = encode_events(self.spec.registry, event_logs)
-        b, t = enc.batch_size, enc.max_len
-        b_bucket = _pow8(b)
-        # window width adapts to the batch's tail length (bucketed pow2 under
-        # the configured cap): a steady incremental round folds 1–5 events
-        # per aggregate, and scanning the full 512-step cold-start window for
-        # it would make every refresh ~100x more device work than its events
-        width = min(self._window, _pow2(t))
+        """Executor half of one fold group: ragged encode + wire pack of
+        every refresh plan. Pure — touches no plane state.
+
+        Returns ``(b, plans)``. Each plan is one fused program dispatch
+        shape: ``("win", sel, lanes_b, width, wins)`` for the jit rectangle
+        fold (``wins = [(packed, side, counts), ...]`` chained windows) or
+        ``("rag", sel, lanes_b, width, (packed_flat, sides, starts, wins))``
+        for the ragged Pallas tile (``wins = [(t_base, counts), ...]``).
+        ``sel`` indexes the plan's lanes back into the group.
+
+        Dense dispatch is ONE plan covering the whole group at the
+        ``_pow8(b) × _pow2(max_len)`` rectangle. Bucketed dispatch deals
+        lanes into pow2 LENGTH buckets first, so a steady ragged round (many
+        1–5-event lanes under one long tail) stops paying the long lane's
+        width across every short lane — each occupied bucket dispatches its
+        own ``_pow2(lanes, 8) × bucket_width`` grid and the union of scatters
+        still lands on disjoint slots (every lane is in exactly one bucket),
+        which is what keeps the fold byte-identical to the dense path."""
+        b = len(event_logs)
+        if self._refresh_dispatch == "dense":
+            enc = encode_events(self.spec.registry, event_logs)
+            # window width adapts to the batch's tail length (bucketed pow2
+            # under the configured cap): a steady incremental round folds 1–5
+            # events per aggregate, and scanning the full 512-step cold-start
+            # window for it would make every refresh ~100x more device work
+            # than its events
+            width = min(self._window, _pow2(enc.max_len))
+            sel = np.arange(b, dtype=np.int64)
+            return b, [("win", sel, _pow8(b), width,
+                        self._pack_windows(enc, _pow8(b), width))]
+        lens = np.fromiter((len(ev) for ev in event_logs), dtype=np.int64,
+                           count=b)
+        deal: Dict[int, list] = {}
+        for i in range(b):
+            wb = min(self._window, _pow2(max(int(lens[i]), 1), 2))
+            deal.setdefault(wb, []).append(i)
+        plans = []
+        for wb in sorted(deal):
+            sel = np.asarray(deal[wb], dtype=np.int64)
+            enc = encode_events(self.spec.registry,
+                                [event_logs[i] for i in sel])
+            lanes_b = _pow2(len(sel))
+            if self._ragged and not self._mesh_local:
+                plans.append(("rag", sel, lanes_b, wb,
+                              self._pack_ragged(enc, lanes_b, wb)))
+            else:
+                plans.append(("win", sel, lanes_b, wb,
+                              self._pack_windows(enc, lanes_b, wb)))
+        return b, plans
+
+    def _pack_windows(self, enc, lanes_b: int, width: int):
+        """Chained dense windows of one plan: ``[(packed, side, counts)]``."""
+        wins = []
+        for s in range(0, enc.max_len, width):
+            e = min(s + width, enc.max_len)
+            packed, side = self._wire.pack_window(
+                enc.type_ids, enc.cols, s, e, width, lanes_b)
+            counts = np.zeros((lanes_b,), dtype=np.int32)
+            counts[:enc.batch_size] = np.clip(enc.lengths - s, 0, width)
+            wins.append((packed, side, counts))
+        return wins
+
+    def _pack_ragged(self, enc, lanes_b: int, width: int):
+        """Flat-pack one bucket for the ragged Pallas tile: the bucket's
+        events concatenate lane-contiguous into ONE packed buffer of
+        ``_pow2(total)`` rows (pad rows carry type −1, which packs to the
+        pad sentinel and folds as carry-through), with per-lane start
+        offsets; chained windows shift the starts instead of re-packing."""
+        nb, t = enc.batch_size, enc.max_len
+        total = int(enc.lengths.sum())
+        rows_b = _pow2(max(total, 1))
+        mask = np.arange(t, dtype=np.int64)[None, :] < enc.lengths[:, None]
+        flat_tids = np.full((rows_b,), -1, dtype=enc.type_ids.dtype)
+        flat_tids[:total] = enc.type_ids[mask]
+        flat_cols = {}
+        for name, col in enc.cols.items():
+            buf = np.zeros((rows_b,), dtype=col.dtype)
+            buf[:total] = col[mask]
+            flat_cols[name] = buf
+        packed, sides = self._wire.pack_flat(flat_tids, flat_cols)
+        starts = np.zeros((lanes_b,), dtype=np.int32)
+        starts[1:nb] = np.cumsum(enc.lengths[:-1], dtype=np.int64)[:nb - 1]
         wins = []
         for s in range(0, t, width):
-            e = min(s + width, t)
-            packed, side = self._wire.pack_window(
-                enc.type_ids, enc.cols, s, e, width, b_bucket)
-            counts = np.zeros((b_bucket,), dtype=np.int32)
-            counts[:b] = np.clip(enc.lengths - s, 0, width)
-            wins.append((packed, side, counts))
-        return b, b_bucket, width, wins
+            counts = np.zeros((lanes_b,), dtype=np.int32)
+            counts[:nb] = np.clip(enc.lengths - s, 0, width)
+            wins.append((s, counts))
+        return packed, sides, starts, wins
 
     async def _fold_group(self, group: List[str], logs: Dict[str, list],
                           part_of: Dict[str, int],
@@ -1107,88 +1282,51 @@ class ResidentStatePlane(Controllable):
         moved — a revoke→re-grant pair both purges AND re-anchors, so the
         stale fold must not land) and its aggregates' reservations are
         rolled back."""
-        b, b_bucket, width, wins = await asyncio.get_running_loop().run_in_executor(
+        b, plans = await asyncio.get_running_loop().run_in_executor(
             None, self._encode_pack_group, [logs[a] for a in group])
 
-        # -- sync: evict + reserve slots + build the admission arrays -------
+        # -- sync: evict + reserve slots + per-lane admission rows ----------
+        # reservation stays GROUP-level (one evict pass, one slot per lane);
+        # each plan below slices its lanes' rows out of these flat arrays
         admit_ids = [a for a in group if a not in self._dir]
         short = len(admit_ids) - len(self._free)
         if short > 0:
             self._evict(short, protect=set(group))
         init = self.spec.init_state_tree()
-        # admits pad to b_bucket (admits ≤ group ≤ b_bucket), so every window
-        # of a bucket shares ONE compiled signature — shape churn is what
-        # turns steady folds into compile storms
-        admit_idx = np.full((b_bucket,), self.capacity, dtype=np.int32)
-        admit_ord = np.zeros((b_bucket,), dtype=np.int32)
-        admit_vals = {f.name: np.full((b_bucket,), init[f.name], dtype=f.dtype)
-                      for f in self._fields}
         new_slots: Dict[str, int] = {}
-        for j, agg in enumerate(admit_ids):
+        slot_of = np.empty((b,), dtype=np.int32)
+        admit_lane = np.zeros((b,), dtype=bool)
+        admit_ord_of = np.zeros((b,), dtype=np.int32)
+        admit_val_of = {f.name: np.full((b,), init[f.name], dtype=f.dtype)
+                        for f in self._fields}
+        for i, agg in enumerate(group):
+            s = self._dir.get(agg)
+            if s is not None:
+                slot_of[i] = s
+                continue
             slot = self._free.pop()
             new_slots[agg] = slot
-            admit_idx[j] = slot
+            slot_of[i] = slot
+            admit_lane[i] = True
             spilled = self._spill.get(agg)  # peek — popped at commit
             if spilled is not None:
                 row, ordinal = spilled
-                admit_ord[j] = ordinal
-                for k in admit_vals:
-                    admit_vals[k][j] = row[k]
-        lane_slots = np.full((b_bucket,), self.capacity, dtype=np.int32)
-        for i, agg in enumerate(group):
-            s = self._dir.get(agg)
-            lane_slots[i] = new_slots[agg] if s is None else s
+                admit_ord_of[i] = ordinal
+                for k in admit_val_of:
+                    admit_val_of[k][i] = row[k]
 
         # -- dispatch off-loop (reads keep serving from the pinned slab) ----
+        # every lane is in exactly one plan, so each plan's admissions are
+        # the group's admits restricted to its lanes and the plans' scatters
+        # hit disjoint slots — dispatch order cannot change the fold
         slab, ords = self._slab, self._ords
         loop = asyncio.get_running_loop()
-        first = True
-        noop_ord = np.zeros((b_bucket,), dtype=np.int32)
-        noop_idx = np.full((b_bucket,), self.capacity, dtype=np.int32)
-        noop_vals = None  # built once on the first later window
-        sig = ("refresh", b_bucket, width)
-        fresh = sig not in self._signatures
-        self._signatures.add(sig)
         acc = self._round_acc
         acc["lanes"] += b
-        acc["batch"] = b_bucket
-        acc["width"] = width
-        faults = self._faults
-        for packed, side, counts in wins:
-            if first:
-                ai, av, ao = admit_idx, admit_vals, admit_ord
-                first = False
-            else:  # later windows: no-op admissions (all-scratch; the jitted
-                # program never mutates its inputs, so one dict serves all)
-                if noop_vals is None:
-                    noop_vals = {
-                        f.name: np.full((b_bucket,), init[f.name],
-                                        dtype=f.dtype) for f in self._fields}
-                ai, av, ao = noop_idx, noop_vals, noop_ord
-            refresh = (self._meshp.refresh if self._mesh_local
-                       else self._refresh_prog)
-            run = functools.partial(refresh, slab, ords, ai, av,
-                                    ao, lane_slots, counts, packed, side)
-            if faults is not None:
-                # the stall-anatomy e2e's site, INSIDE the executor thunk so
-                # an armed delay lands in the dispatch stage's measured time
-                run = functools.partial(
-                    (lambda f, thunk: (f.point("resident.refresh.dispatch"),
-                                       thunk())[1]), faults, run)
-            d0 = time.perf_counter()
-            if self.profiler is None:
-                slab, ords = await loop.run_in_executor(None, run)
-            else:
-                with self.profiler.stage("compile" if fresh else "dispatch",
-                                         width=width, batch=b_bucket):
-                    slab, ords = await loop.run_in_executor(None, run)
-                fresh = False
-            # padding-waste accounting: the program always runs the full
-            # b_bucket × width slot grid; counts carries the occupied slots
-            acc["windows"] += 1
-            acc["dispatched"] += b_bucket * width
-            acc["occupied"] += int(counts.sum())
-            acc["dispatch_s"] += time.perf_counter() - d0
+        for plan in plans:
+            slab, ords = await self._dispatch_plan(
+                loop, plan, slab, ords, slot_of, admit_lane, admit_ord_of,
+                admit_val_of, init)
 
         # -- sync commit: publish the folded slab + directory ---------------
         self._slab, self._ords = slab, ords
@@ -1210,6 +1348,140 @@ class ResidentStatePlane(Controllable):
                 continue  # purged mid-flight; stays purged
             self._agg_part[agg] = p
             self._touch(agg)
+
+    async def _dispatch_plan(self, loop, plan, slab, ords,
+                             slot_of: np.ndarray, admit_lane: np.ndarray,
+                             admit_ord_of: np.ndarray,
+                             admit_val_of: Dict[str, np.ndarray], init):
+        """Dispatch one refresh plan's chained windows. Pads the plan's
+        admission/lane arrays to its ``lanes_b`` bucket (so every window of a
+        bucket shares ONE compiled signature — shape churn is what turns
+        steady folds into compile storms), runs each window in the executor,
+        and — when donation is on — republishes ``self._slab`` after every
+        dispatch so readers re-pin live buffers (the consumed predecessor
+        would raise on them; directory/spill commit stays deferred, so
+        mid-round rows are folds of committed per-lane prefixes — valid
+        bounded-stale states under the plane's consistency model)."""
+        mode, sel, lanes_b, width, payload = plan
+        nb = len(sel)
+        adm = sel[admit_lane[sel]]
+        admit_idx = np.full((lanes_b,), self.capacity, dtype=np.int32)
+        admit_idx[:len(adm)] = slot_of[adm]
+        admit_ord = np.zeros((lanes_b,), dtype=np.int32)
+        admit_ord[:len(adm)] = admit_ord_of[adm]
+        admit_vals = {f.name: np.full((lanes_b,), init[f.name], dtype=f.dtype)
+                      for f in self._fields}
+        for k in admit_vals:
+            admit_vals[k][:len(adm)] = admit_val_of[k][adm]
+        lane_slots = np.full((lanes_b,), self.capacity, dtype=np.int32)
+        lane_slots[:nb] = slot_of[sel]
+
+        if mode == "rag":
+            packed_flat, sides_flat, starts, wins = payload
+            rows_b = packed_flat.shape[0]
+            sig = ("refresh-ragged", lanes_b, width, rows_b)
+            prog = self._ragged_program(lanes_b, width, rows_b)
+        else:
+            wins = payload
+            sig = ("refresh", lanes_b, width)
+            prog = (self._meshp.refresh if self._mesh_local
+                    else self._refresh_prog)
+        fresh = sig not in self._signatures
+        self._signatures.add(sig)
+        acc = self._round_acc
+        acc["batch"] = lanes_b
+        acc["width"] = width
+        acc["programs"] += 1
+        acc["lane_slots"] += lanes_b
+        occupied = 0
+        faults = self._faults
+        donate = self._donate_refresh
+        first = True
+        noop_ord = np.zeros((lanes_b,), dtype=np.int32)
+        noop_idx = np.full((lanes_b,), self.capacity, dtype=np.int32)
+        noop_vals = None  # built once on the first later window
+        for win in wins:
+            if first:
+                ai, av, ao = admit_idx, admit_vals, admit_ord
+                first = False
+            else:  # later windows: no-op admissions (all-scratch; the jitted
+                # program never mutates its inputs, so one dict serves all)
+                if noop_vals is None:
+                    noop_vals = {
+                        f.name: np.full((lanes_b,), init[f.name],
+                                        dtype=f.dtype) for f in self._fields}
+                ai, av, ao = noop_idx, noop_vals, noop_ord
+            if mode == "rag":
+                t_base, counts = win
+                run = functools.partial(
+                    prog, slab, ords, ai, av, ao, lane_slots, counts,
+                    packed_flat, sides_flat,
+                    (starts + t_base).astype(np.int32))
+            else:
+                packed, side, counts = win
+                run = functools.partial(prog, slab, ords, ai, av,
+                                        ao, lane_slots, counts, packed, side)
+            if faults is not None:
+                # the stall-anatomy e2e's site, INSIDE the executor thunk so
+                # an armed delay lands in the dispatch stage's measured time
+                run = functools.partial(
+                    (lambda f, thunk: (f.point("resident.refresh.dispatch"),
+                                       thunk())[1]), faults, run)
+            d0 = time.perf_counter()
+            if self.profiler is None:
+                slab, ords = await loop.run_in_executor(None, run)
+            else:
+                with self.profiler.stage("compile" if fresh else "dispatch",
+                                         width=width, batch=lanes_b):
+                    slab, ords = await loop.run_in_executor(None, run)
+                fresh = False
+            if donate:
+                self._slab, self._ords = slab, ords
+            # padding-waste accounting: the program always runs the full
+            # lanes_b × width slot grid; counts carries the occupied slots
+            acc["windows"] += 1
+            acc["dispatched"] += lanes_b * width
+            acc["occupied"] += int(counts.sum())
+            occupied += int(counts.sum())
+            acc["dispatch_s"] += time.perf_counter() - d0
+        acc["buckets"].append({
+            "width": width, "lanes_b": lanes_b, "lanes": nb,
+            "windows": len(wins), "dispatched": lanes_b * width * len(wins),
+            "occupied": occupied, "ragged": mode == "rag" or None})
+        return slab, ords
+
+    def _ragged_program(self, lanes_b: int, width: int, rows_b: int):
+        """The fused ragged refresh program (admission scatter → Pallas
+        ragged tile walking the flat packed buffer by per-lane offsets →
+        scatter back), cached per (lanes_b, width, rows_b) shape and donated
+        like the rectangle jit."""
+        key = (lanes_b, width, rows_b)
+        prog = self._ragged_progs.get(key)
+        if prog is not None:
+            return prog
+        import jax
+
+        from surge_tpu.replay.pallas_fold import make_ragged_fold
+
+        wire = self._wire
+        tile = make_ragged_fold(self.spec, wire, width, lanes_b, rows_b, 1)
+
+        def refresh_ragged(slab, ords, admit_idx, admit_vals, admit_ord,
+                           lane_slots, counts, packed, sides, starts):
+            slab = {k: v.at[admit_idx].set(admit_vals[k])
+                    for k, v in slab.items()}
+            ords = ords.at[admit_idx].set(admit_ord)
+            carry = {k: v[lane_slots] for k, v in slab.items()}
+            words = wire.expand_flat(packed)
+            out = tile(carry, words, sides, starts, counts, ords[lane_slots])
+            slab = {k: v.at[lane_slots].set(out[k]) for k, v in slab.items()}
+            ords = ords.at[lane_slots].add(counts)
+            return slab, ords
+
+        prog = jax.jit(refresh_ragged,
+                       donate_argnums=(0, 1) if self._donate_refresh else ())
+        self._ragged_progs[key] = prog
+        return prog
 
     def _touch(self, agg_id: str) -> None:
         self._tick += 1
@@ -1561,35 +1833,51 @@ class ResidentStatePlane(Controllable):
             # garbage would force the wide refetch on every read
             idx = np.full((k_b,), slots[0], dtype=np.int32)
             idx[:k] = slots
-            slab = self._slab  # pin: a fold may replace self._slab
             off_loop = self._fetch_off_loop
             rows: Optional[Dict[str, np.ndarray]] = None
             # device-leg clocks for the observatory: dispatch (gather program
             # call), fetch-barrier (the d2h asarray), decode (buffer → rows →
             # domain states) — a u16 overflow refetch accumulates both passes
             disp_s = fetch_s = dec_s = 0.0
-            t = time.perf_counter()
-            if self._gather_narrow is not None:
-                buf = self._gather_narrow(slab, idx)  # dispatch
-                disp_s += time.perf_counter() - t
-                t = time.perf_counter()
-                host = (await loop.run_in_executor(None, np.asarray, buf)
-                        if off_loop else np.asarray(buf))
-                fetch_s += time.perf_counter() - t
-                t = time.perf_counter()
-                rows = self._decode_narrow(host, k, k_b)
-                dec_s += time.perf_counter() - t
-            if rows is None:  # wide schema, or a u16 overflow refetch
-                t = time.perf_counter()
-                mat, _ = self._gather_wide(slab, self._ords, idx)
-                disp_s += time.perf_counter() - t
-                t = time.perf_counter()
-                host = (await loop.run_in_executor(None, np.asarray, mat)
-                        if off_loop else np.asarray(mat))
-                fetch_s += time.perf_counter() - t
-                t = time.perf_counter()
-                rows = self._decode_wide(host, k)
-                dec_s += time.perf_counter() - t
+            # a DONATED refresh window may consume the pinned slab between
+            # the dispatch below and its fetch (the fold runs in the
+            # executor concurrently) — the deleted-buffer error re-pins the
+            # republished slab and retries; a persistent failure falls
+            # through to the gather lane's host failover
+            for attempt in range(3):
+                # pin: a fold may replace self._slab/_ords mid-drain
+                slab, s_ords = self._slab, self._ords
+                rows = None
+                try:
+                    t = time.perf_counter()
+                    if self._gather_narrow is not None:
+                        buf = self._gather_narrow(slab, idx)  # dispatch
+                        disp_s += time.perf_counter() - t
+                        t = time.perf_counter()
+                        host = (await loop.run_in_executor(
+                            None, np.asarray, buf)
+                            if off_loop else np.asarray(buf))
+                        fetch_s += time.perf_counter() - t
+                        t = time.perf_counter()
+                        rows = self._decode_narrow(host, k, k_b)
+                        dec_s += time.perf_counter() - t
+                    if rows is None:  # wide schema, or a u16 overflow refetch
+                        t = time.perf_counter()
+                        mat, _ = self._gather_wide(slab, s_ords, idx)
+                        disp_s += time.perf_counter() - t
+                        t = time.perf_counter()
+                        host = (await loop.run_in_executor(
+                            None, np.asarray, mat)
+                            if off_loop else np.asarray(mat))
+                        fetch_s += time.perf_counter() - t
+                        t = time.perf_counter()
+                        rows = self._decode_wide(host, k)
+                        dec_s += time.perf_counter() - t
+                    break
+                except RuntimeError as exc:
+                    if attempt == 2 or "delet" not in str(exc).lower():
+                        raise
+                    await asyncio.sleep(0.001)
             t = time.perf_counter()
             states = self._states_of_batch(gather_ids, rows, k)
             dec_s += time.perf_counter() - t

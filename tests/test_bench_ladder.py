@@ -174,6 +174,57 @@ def test_bench_resident_feed_paired_smoke():
     assert payload["value"] == paired["native_feed_events_per_sec_median"]
 
 
+def test_bench_ragged_paired_ladder_smoke():
+    """SURGE_BENCH_RAGGED=1 (ISSUE 18): the paired interleaved dense vs
+    bucketed vs bucketed+pallas refresh-dispatch ladder plus the donation
+    probe emit per-arm medians and waste ratios off the ledger, tiny-sized
+    here (probe capacity shrunk from 1M to 4096 rows so the smoke stays in
+    tier-1 budget; the mesh topology and donate on/off arms still run)."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SURGE_BENCH_RAGGED": "1",
+        "SURGE_BENCH_RAGGED_ROUNDS": "1",
+        "SURGE_BENCH_RAGGED_CYCLES": "3",
+        "SURGE_BENCH_RAGGED_DENSE_LANES": "32",
+        "SURGE_BENCH_RAGGED_CAPACITY": "256",
+        "SURGE_BENCH_RAGGED_PROBE_CAPACITY": "4096",
+        "SURGE_BENCH_RAGGED_PROBE_CYCLES": "2",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON payload on stdout: {proc.stdout!r}"
+    payload = json.loads(lines[-1])
+    assert payload["metric"] == "ragged_fold_events_per_sec"
+    assert payload["protocol"]["interleaved"] and payload["protocol"]["medians"]
+    ladder = payload["ragged_ladder"]
+    assert set(ladder) == {"steady_ragged", "dense_32"}
+    for shape, row in ladder.items():
+        for arm in ("dense", "bucketed", "bucketed_pallas"):
+            assert row[arm]["events_per_sec_median"] > 0, (shape, arm)
+            assert row[arm]["rounds"]
+            assert row[arm]["waste_ratio"] >= 1.0
+        assert row["waste_reduction"] > 0
+        assert "bucketed_wins_every_round" in row
+    # the bucketed arm sheds lane padding on the ragged shape even at
+    # smoke size: its waste ratio must strictly improve on dense's
+    ragged = ladder["steady_ragged"]
+    assert ragged["bucketed"]["waste_ratio"] < ragged["dense"]["waste_ratio"]
+    assert ragged["bucketed"]["bucket_fill_ratio"] > \
+        ragged["dense"]["bucket_fill_ratio"]
+    probe = payload["donation_probe"]
+    assert probe["capacity"] == 4096
+    assert probe["donated_ms_per_window"] > 0
+    assert probe["copying_ms_per_window"] > 0
+    assert probe["round10_local_ms_per_window"] == 19.0
+    assert payload["value"] == max(
+        row["bucketed"]["events_per_sec_median"] for row in ladder.values())
+
+
 def test_bench_views_paired_smoke():
     """SURGE_BENCH_VIEWS=1 (ISSUE 17): the paired interleaved view-read vs
     scan-per-read reader ladder emits per-rung medians for both arms plus a

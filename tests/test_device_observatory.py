@@ -180,12 +180,18 @@ def test_steady_ragged_round_reproduces_roofline_overdispatch():
     """The acceptance anchor: a synthetic steady-ragged round (10 aggregates
     x 5 events) must reproduce the BENCH_NOTES round-9 over-dispatch within
     tolerance — pow8(10)=64 lanes x pow2(5)=8 slots dispatched for 50 real
-    events is ~10.2x, squarely in the published ~9x regime's band."""
+    events is ~10.2x, squarely in the published ~9x regime's band. Pinned to
+    the DENSE dispatch arm: the bucketing PR (ROADMAP item 2 / ISSUE 18)
+    moved the default below this band, which is its acceptance criterion —
+    tests/test_ragged_refresh.py asserts the bucketed side."""
     async def scenario():
         log = make_log()
         registry = Metrics()
         led = ReplayLedger(name="engine:t")
-        plane = make_plane(log, metrics=engine_metrics(registry), ledger=led)
+        plane = make_plane(log, metrics=engine_metrics(registry), ledger=led,
+                           overrides={
+                               "surge.replay.resident.refresh-dispatch":
+                               "dense"})
         plane._ensure_device_state()
         plane.seed_from_log()  # empty log: anchors watermarks, folds nothing
         append_events(log, events_for([f"agg-{i}" for i in range(10)], 5))

@@ -140,6 +140,10 @@ def golden_engine_metrics():
     em.resident_dispatch_occupancy.record(1.0 / 9.0)
     em.resident_events_per_dispatch_us.record(0.125)
     em.resident_shard_skew.record(1.25)
+    # bucketed ragged dispatch (ISSUE 18): 3 occupied length buckets,
+    # lane-level fill across their pow2 lane slots
+    em.resident_bucket_dispatches.record(3)
+    em.resident_bucket_fill_ratio.record(0.62)
     em.resident_fallbacks.record(3)
     em.resident_fallbacks_lag.record(2)
     em.resident_fallbacks_poison.record(1)

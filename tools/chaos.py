@@ -224,11 +224,42 @@ def main(argv=None) -> int:
         client.close()
 
 
+def _render_bucket_anatomy(payload) -> str:
+    """Per-round bucket fill + waste columns off a ledger envelope (ISSUE
+    18's bucketed ragged dispatch): one line per round with buckets, then
+    one line per bucket program (`w<width>×<lanes_b>` lanes dealt / lane
+    slots, slot fill, waste, ragged-tile flag). Empty string when no round
+    in the dump carried bucket anatomy (dense or pre-bucketing engines)."""
+    lines = []
+    for ev in payload.get("events", []):
+        if ev.get("type") != "round" or not ev.get("buckets"):
+            continue
+        lines.append(
+            f"round events={ev['events']} lanes={ev['lanes']} "
+            f"waste={ev.get('waste')} bucket_table={ev.get('bucket_table')}")
+        for bk in ev["buckets"]:
+            lanes, lanes_b = bk.get("lanes", 0), bk.get("lanes_b", 0)
+            disp, occ = bk.get("dispatched", 0), bk.get("occupied", 0)
+            lines.append(
+                f"  w{bk.get('width')}×{lanes_b}: lanes {lanes}/{lanes_b}"
+                f" fill={occ / disp:.2f}" if disp else
+                f"  w{bk.get('width')}×{lanes_b}: lanes {lanes}/{lanes_b}"
+                f" fill=-")
+            if disp:
+                lines[-1] += (f" waste={disp / occ:.2f}" if occ
+                              else " waste=-")
+                if bk.get("ragged"):
+                    lines[-1] += " ragged"
+    return "\n".join(lines)
+
+
 def _replay_ledger(args) -> int:
     """Device-observatory dump from the CLI: one ``DumpReplayLedger``
     envelope (refresh rounds + roofline summary) off an ENGINE admin
     endpoint, printed as JSON — a down/observatory-less engine is a
-    reported finding, exit 1."""
+    reported finding, exit 1. Rounds that carried bucket anatomy (the
+    bucketed ragged dispatch) additionally render a per-bucket fill/waste
+    table on STDERR, keeping stdout the parseable envelope."""
     import asyncio
 
     import grpc
@@ -240,7 +271,11 @@ def _replay_ledger(args) -> int:
             return await AdminClient(channel).replay_ledger_dump(args.last)
 
     try:
-        print(json.dumps(asyncio.run(fetch()), indent=2))
+        payload = asyncio.run(fetch())
+        print(json.dumps(payload, indent=2))
+        anatomy = _render_bucket_anatomy(payload)
+        if anatomy:
+            print(anatomy, file=sys.stderr)
         return 0
     except Exception as exc:  # noqa: BLE001 — a down engine is the finding
         print(json.dumps({"error": str(exc)[:500]}, indent=2))

@@ -51,6 +51,9 @@ _COLUMNS = (
     ("waste", "surge_replay_resident_padding_waste_ratio", "{:.1f}"),
     ("ev/us", "surge_replay_resident_events_per_dispatch_us", "{:.2f}"),
     ("skew", "surge_replay_resident_shard_skew", "{:.2f}"),
+    # bucketed ragged dispatch: bucket programs + lane-slot fill per round
+    ("bkts", "surge_replay_resident_bucket_dispatches", "{:.0f}"),
+    ("fill", "surge_replay_resident_bucket_fill_ratio", "{:.2f}"),
     # materialized views: live changefeed subscriptions across views
     ("v-subs", "surge_replay_views_subscribers", "{:.0f}"),
     ("entities", "surge_engine_live_entities", "{:.0f}"),
