@@ -1245,6 +1245,59 @@ def soak_bench() -> dict:
     }
 
 
+def saga_bench() -> dict:
+    """SURGE_BENCH_SAGA=1: the saga-storm chaos soak
+    (surge_tpu.cluster.soak.run_saga_soak) — a storm of two-step transfer
+    sagas (a seeded fraction poisoned into the compensation walk) against a
+    3-broker spread cluster under a rolling broker kill, seeded link faults
+    and a mid-storm SagaManager restart, per seed.
+
+    Env: SURGE_BENCH_SAGA_SEEDS (comma list; default 61,62,63),
+    SURGE_BENCH_SAGA_SECONDS (14 per seed), SURGE_BENCH_SAGA_COUNT (400
+    sagas per seed), SURGE_BENCH_SAGA_BROKERS (3), SURGE_BENCH_SAGA_PARTITIONS
+    (6), SURGE_BENCH_SAGA_ACCOUNTS (48), SURGE_BENCH_SAGA_POISON (0.3).
+
+    The verdict aggregates every seed: **0 lost / 0 duplicated / 0
+    half-compensated** — every acked saga terminal, every account balance
+    equal to what the saga rows' own committed/compensated masks predict,
+    and the ledger-reconciliation invariant clean over every row — with the
+    whole story reconstructable from the merged flight timelines."""
+    from surge_tpu.cluster.soak import run_saga_soak
+
+    seeds = [int(s) for s in os.environ.get(
+        "SURGE_BENCH_SAGA_SEEDS", "61,62,63").split(",") if s.strip()]
+    seconds = float(os.environ.get("SURGE_BENCH_SAGA_SECONDS", 14.0))
+    count = int(os.environ.get("SURGE_BENCH_SAGA_COUNT", 400))
+    brokers = int(os.environ.get("SURGE_BENCH_SAGA_BROKERS", 3))
+    partitions = int(os.environ.get("SURGE_BENCH_SAGA_PARTITIONS", 6))
+    accounts = int(os.environ.get("SURGE_BENCH_SAGA_ACCOUNTS", 48))
+    poison = float(os.environ.get("SURGE_BENCH_SAGA_POISON", 0.3))
+    rounds = []
+    for seed in seeds:
+        log(f"saga storm: seed {seed} ({count} sagas, {seconds:.0f}s "
+            "schedule)")
+        rounds.append(run_saga_soak(
+            seed, brokers=brokers, partitions=partitions, seconds=seconds,
+            sagas=count, accounts=accounts, poison_fraction=poison))
+    verdict_ok = all(
+        r["lost"] == 0 and r["duplicated"] == 0
+        and r["half_compensated"] == 0 and r["reconcile"]["ok"]
+        for r in rounds)
+    return {
+        "saga_rounds": rounds,
+        "saga_seeds": seeds,
+        "saga_started": sum(r["started"] for r in rounds),
+        "saga_poisoned": sum(r["poisoned"] for r in rounds),
+        "saga_lost": sum(r["lost"] for r in rounds),
+        "saga_duplicated": sum(r["duplicated"] for r in rounds),
+        "saga_half_compensated": sum(r["half_compensated"] for r in rounds),
+        "saga_dead_letter": sum(r["reconcile"]["dead_letter"]
+                                for r in rounds),
+        "saga_verdict": "ok: 0 lost / 0 duplicated / 0 half-compensated"
+        if verdict_ok else "DEGRADED: see saga_rounds",
+    }
+
+
 def handoff_bench() -> dict:
     """SURGE_BENCH_HANDOFF=1: paired interleaved ladder (medians only, per
     the BENCH_NOTES round-6 protocol — single runs swing 2-3x on this host)
@@ -2567,6 +2620,19 @@ def main() -> None:
         stats = soak_bench()
         payload.update(stats)
         payload["value"] = stats.get("soak_acked_commits", 0)
+        emit(payload)
+        return
+
+    # SURGE_BENCH_SAGA=1: the saga-storm chaos soak — hundreds of two-step
+    # transfer sagas (a seeded fraction forced into the compensation walk)
+    # vs rolling broker kills, link faults and a mid-storm manager restart;
+    # the verdict is 0 lost / 0 duplicated / 0 half-compensated with the
+    # ledger-reconciliation invariant checked per saga row
+    if os.environ.get("SURGE_BENCH_SAGA", "0") == "1":
+        payload = {"metric": "saga_started", "value": 0, "unit": "ok"}
+        stats = saga_bench()
+        payload.update(stats)
+        payload["value"] = stats.get("saga_started", 0)
         emit(payload)
         return
 

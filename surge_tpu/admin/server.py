@@ -44,6 +44,13 @@ METHODS = {
     # broker DumpTraces dumps into whole command traces
     # (observability/anatomy.py). Same "last:N" tail convention as DumpFlight
     "DumpTraces": (pb.ComponentRequest, pb.MetricsReply),
+    # saga plane (surge_tpu.saga). Message reuse as above:
+    # StartSaga's ComponentRequest.name carries
+    # {"saga_id","definition","ctx"} JSON; SagaStatus's carries a saga id
+    # ("" = fleet summary + reconciliation verdict). Results ride
+    # MetricsReply as JSON
+    "StartSaga": (pb.ComponentRequest, pb.MetricsReply),
+    "SagaStatus": (pb.ComponentRequest, pb.MetricsReply),
     # refresh-round ledger (surge_tpu.replay.ledger): the device
     # observatory's per-round padding-waste / per-stage anatomy in the same
     # merge-ready flight envelope (role "ledger"), with the roofline summary
@@ -211,6 +218,31 @@ class AdminServer:
                     "events_covered": ckpt.events_covered()}))
         except Exception as exc:  # noqa: BLE001 — operator gets the failure back
             return pb.ComponentReply(ok=False, detail=repr(exc))
+
+    async def StartSaga(self, request, context) -> pb.MetricsReply:
+        """Start a saga on this engine's registered SagaManager.
+        ``request.name`` carries ``{"saga_id", "definition", "ctx"}`` JSON;
+        the started saga's status ledger rides back. Idempotent: the start
+        command's deterministic rid collapses re-submissions."""
+        try:
+            payload = json.loads(request.name or "{}")
+            status = await self.engine.start_saga(
+                payload["saga_id"], payload["definition"],
+                tuple(payload.get("ctx", ())))
+            return pb.MetricsReply(metrics_json=json.dumps(status).encode())
+        except Exception as exc:  # noqa: BLE001 — errors ride the reply
+            return pb.MetricsReply(
+                metrics_json=json.dumps({"error": repr(exc)}).encode())
+
+    async def SagaStatus(self, request, context) -> pb.MetricsReply:
+        """One saga's ledger (``request.name`` = saga id), or the fleet
+        summary + reconciliation verdict (empty name)."""
+        try:
+            status = await self.engine.saga_status(request.name or "")
+            return pb.MetricsReply(metrics_json=json.dumps(status).encode())
+        except Exception as exc:  # noqa: BLE001 — errors ride the reply
+            return pb.MetricsReply(
+                metrics_json=json.dumps({"error": repr(exc)}).encode())
 
     async def ArmFaults(self, request, context) -> pb.MetricsReply:
         """Arm/disarm/inspect a fault plane on the engine's IN-PROCESS log
@@ -421,6 +453,26 @@ class AdminClient:
     async def write_checkpoint(self) -> tuple[bool, str]:
         r = await self._calls["WriteCheckpoint"](pb.Empty())
         return r.ok, r.detail
+
+    async def start_saga(self, saga_id: str, definition: str,
+                         ctx=()) -> dict:
+        """Start (idempotently) a saga; returns its status ledger."""
+        payload = json.dumps({"saga_id": saga_id, "definition": definition,
+                              "ctx": list(ctx)})
+        r = await self._calls["StartSaga"](pb.ComponentRequest(name=payload))
+        out = json.loads(r.metrics_json)
+        if "error" in out and "saga_id" not in out:
+            raise RuntimeError(out["error"])
+        return out
+
+    async def saga_status(self, saga_id: str = "") -> dict:
+        """One saga's ledger, or (empty id) the fleet summary with the
+        reconciliation verdict."""
+        r = await self._calls["SagaStatus"](pb.ComponentRequest(name=saga_id))
+        out = json.loads(r.metrics_json)
+        if "error" in out and "saga_id" not in out and "counts" not in out:
+            raise RuntimeError(out["error"])
+        return out
 
     async def arm_faults(self, spec: str, seed: int = 0) -> dict:
         """Arm a named plan / JSON rules on the engine's in-process log;

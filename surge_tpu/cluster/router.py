@@ -74,11 +74,50 @@ def _router_span(tracer, name: str, **attrs):
     return span
 
 
+class _RoutedHandle:
+    """PipelinedCommit facade over whichever inner broker handle currently
+    carries the dispatch. ``producer`` is the RoutedProducer itself — the
+    publisher's retry gate identity-checks it — and ``future``/``seq``/
+    ``records`` proxy the CURRENT inner handle, so a reroute that re-
+    dispatched on a new leader is transparent to the awaiting commit task
+    (it re-reads ``.future`` after ``retry_pipelined``)."""
+
+    __slots__ = ("producer", "partition", "addr", "inner")
+
+    def __init__(self, producer: "RoutedProducer", partition: int,
+                 addr: str, inner) -> None:
+        self.producer = producer
+        self.partition = partition
+        self.addr = addr
+        self.inner = inner
+
+    @property
+    def future(self):
+        return self.inner.future
+
+    @property
+    def seq(self) -> int:
+        return self.inner.seq
+
+    @property
+    def records(self):
+        return self.inner.records
+
+
 class RoutedProducer:
     """Transactional producer over the router: one inner producer per broker
     the partition map has sent us to, opened lazily and re-opened after a
     fence. A batch commits on its partition's current leader; the retry
-    ladder re-resolves the leader between attempts."""
+    ladder re-resolves the leader between attempts.
+
+    ``commit_pipelined`` keeps PR-3's bounded in-flight window across
+    partition moves (ROADMAP 4(b)): dispatches ship without awaiting
+    earlier replies exactly like the direct gRPC client, and a failed
+    handle's ``retry_pipelined`` re-resolves the leader — same broker →
+    verbatim same-seq resend answered from the broker's dedup window;
+    moved leader → the same records re-dispatched fresh on the new leader,
+    where the replicated txn-dedup state absorbs a landed-but-unacked
+    commit (the sync reroute ladder's proven exactly-once semantics)."""
 
     def __init__(self, router: "PartitionRouter", transactional_id: str,
                  attempts: int = 6) -> None:
@@ -128,6 +167,63 @@ class RoutedProducer:
 
     def send_immediate(self, record: LogRecord) -> LogRecord:
         return self._routed([record], "send_immediate")
+
+    def _inner_for(self, addr: str):
+        inner = self._inner.get(addr)
+        if inner is None or inner.fenced:
+            inner = self._router._child(addr).transactional_producer(
+                self.transactional_id)
+            self._inner[addr] = inner
+        return inner
+
+    def commit_pipelined(self) -> _RoutedHandle:
+        """Dispatch the buffered transaction on the partition's current
+        leader WITHOUT awaiting the reply (the bounded-window write path)."""
+        if self._buffer is None:
+            raise TransactionStateError("no open transaction")
+        records, self._buffer = self._buffer, None
+        partition = self._partition_of(records)
+        addr = self._router.leader_for(partition)
+        inner = self._inner_for(addr)
+        if getattr(inner, "in_transaction", False):
+            inner.abort()  # local buffer left by an earlier dispatch failure
+        inner.begin()
+        for r in records:
+            inner.send(r)
+        return _RoutedHandle(self, partition, addr, inner.commit_pipelined())
+
+    def retry_pipelined(self, handle: _RoutedHandle) -> _RoutedHandle:
+        """Retry a failed pipelined commit wherever the partition now lives.
+
+        Same leader + same inner producer → the inner client's verbatim
+        same-seq resend (broker dedup answers a landed commit from cache).
+        A reroute-class failure (fence / not-leader / transport) drops the
+        suspect broker's producer and re-resolves; the records then
+        re-dispatch fresh on the new leader — identical semantics to the
+        synchronous ``_routed_attempts`` reroute, one attempt per call (the
+        publisher's stash-and-retry ladder provides the outer loop)."""
+        ih = handle.inner
+        if not ih.future.done():
+            raise TransactionStateError("pipelined commit still in flight")
+        exc = None if ih.future.cancelled() else ih.future.exception()
+        rerouted = isinstance(exc, _REROUTE_ERRORS)
+        if rerouted:
+            self._inner.pop(handle.addr, None)
+            self._router.invalidate_partition("", handle.partition,
+                                              suspect=handle.addr)
+        addr = self._router.leader_for(handle.partition, refresh=rerouted)
+        inner = self._inner_for(addr)
+        if addr == handle.addr and inner is ih.producer:
+            inner.retry_pipelined(ih)
+            return handle
+        if getattr(inner, "in_transaction", False):
+            inner.abort()
+        inner.begin()
+        for r in ih.records:
+            inner.send(r)
+        handle.inner = inner.commit_pipelined()
+        handle.addr = addr
+        return handle
 
     def _partition_of(self, records: Sequence[LogRecord]) -> int:
         parts = {r.partition for r in records}

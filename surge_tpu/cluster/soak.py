@@ -27,6 +27,18 @@ broker (a dead one answers ``up{instance}=0``), the SLO engine burns the
 ``run_soak(seed)`` returns the verdict dict; ``tests/test_cluster_selfheal``
 runs the 3-seed fast variant in tier-1 and ``SURGE_BENCH_SOAK=1 python
 bench.py`` the long randomized one.
+
+``run_saga_soak(seed)`` is the saga-storm arm (ISSUE 19): two engines (the
+saga family + a counter "acct" participant) ride the same router over the
+same chaos schedule — rolling kill, link faults, a mid-storm SagaManager
+restart — while a storm of two-step transfer sagas (a seeded fraction
+poisoned into the compensation walk) runs to terminal states. Its verdict
+is **0 lost / 0 duplicated / 0 half-compensated**: every acked saga reaches
+a terminal row, every account's balance equals the sum the saga rows' own
+committed/compensated masks predict, and the ledger-reconciliation
+invariant holds over every row. ``tests/test_saga_soak`` runs the 3-seed
+fast variant in tier-1 and ``SURGE_BENCH_SAGA=1 python bench.py`` the
+storm.
 """
 
 from __future__ import annotations
@@ -48,7 +60,7 @@ from surge_tpu.log import (
 )
 from surge_tpu.log.transport import NotLeaderError, ProducerFencedError
 
-__all__ = ["run_soak"]
+__all__ = ["run_soak", "run_saga_soak"]
 
 TOPIC = "ev"
 
@@ -514,3 +526,331 @@ def _page_verdict(fleet_flight) -> dict:
             open_pages.pop(e.get("objective", "?"), None)
     return {"raised": len(raised), "still_open": sorted(open_pages),
             "cleared": not open_pages}
+
+
+# -- the saga-storm arm --------------------------------------------------------------
+
+
+def _transfer_definition():
+    """The storm's two-step money move.
+
+    Targets ride the saga id itself (``x{seed}:{src}:{dst}:{n}``) so a
+    restarted manager rebuilds every factory input from replayed state
+    alone; a poisoned context slot (``c1 >= 1``) turns the credit into a
+    command the counter model REJECTS, forcing the reverse compensation
+    walk over the already-committed debit.
+    """
+    from surge_tpu.models import counter
+    from surge_tpu.saga import SagaDefinition, SagaStep
+
+    def _src(sid, s):
+        return sid.split(":")[1]
+
+    def _dst(sid, s):
+        return sid.split(":")[2]
+
+    return SagaDefinition(
+        name="transfer", def_id=1,
+        steps=(
+            SagaStep("debit", participant="acct", target=_src,
+                     command=lambda tid, s: counter.Decrement(tid),
+                     compensation=lambda tid, s: counter.Increment(tid)),
+            SagaStep("credit", participant="acct", target=_dst,
+                     command=lambda tid, s: (
+                         counter.FailCommandProcessing(tid, "credit poisoned")
+                         if s.c1 >= 1.0 else counter.Increment(tid)),
+                     compensation=lambda tid, s: counter.Decrement(tid)),
+        ))
+
+
+def run_saga_soak(seed: int, brokers: int = 3, partitions: int = 4,
+                  seconds: float = 6.0, sagas: int = 36,
+                  accounts: int = 12, poison_fraction: float = 0.3,
+                  manager_restart: bool = True, settle_s: float = 35.0,
+                  config_extra: Optional[dict] = None) -> dict:
+    """One seeded saga-storm schedule; returns the verdict dict.
+
+    Like :func:`run_soak` this raises nothing on a failed verdict — the
+    caller asserts on ``lost`` / ``duplicated`` / ``half_compensated`` so a
+    failing storm still reports everything it measured, including the
+    per-account ledger mismatches and the merged flight timeline counts.
+    """
+    import asyncio
+
+    from surge_tpu import SurgeCommandBusinessLogic, create_engine
+    from surge_tpu.cluster.autobalancer import Autobalancer
+    from surge_tpu.cluster.router import PartitionRouter
+    from surge_tpu.models import counter
+    from surge_tpu.observability import (FederatedScraper, FlightRecorder,
+                                         SLO, SLOEngine, merge_dumps)
+    from surge_tpu.saga import TERMINAL, SagaManager, make_saga_logic
+    from surge_tpu.testing.faults import FaultPlane
+    from surge_tpu.testing.support import ZipfKeys
+
+    rng = random.Random(seed)
+    cfg = _soak_config({
+        "surge.engine.num-partitions": partitions,
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 20,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.replay.restore-on-start": False,
+        "surge.saga.step-timeout-ms": 8_000,
+        "surge.saga.step-max-attempts": 8,
+        "surge.saga.step-backoff-ms": 60,
+        "surge.saga.compensation-max-attempts": 8,
+        "surge.saga.poll-interval-ms": 25,
+        **(config_extra or {}),
+    })
+    addrs = [f"127.0.0.1:{p}" for p in _free_ports(brokers)]
+    fleet = _Fleet(addrs, cfg)
+    fleet.start_initial()
+    router = None
+    scraper = None
+    balancer = None
+    try:
+        setup = GrpcLogTransport(addrs[0], config=cfg)
+        setup.cluster_meta("spread", partitions=partitions)
+        setup.close()
+
+        fleet_flight = FlightRecorder(name="fleet", role="engine")
+        scraper = FederatedScraper(
+            [fleet.scrape_target(a) for a in addrs], config=cfg)
+        scraper.slo = SLOEngine(
+            [SLO("fleet-up", family="up", kind="bound", objective=0.99,
+                 threshold=1.0, op="lt",
+                 description="every member answers its scrape")],
+            config=cfg, metrics=scraper.metrics, flight=fleet_flight)
+        balancer = Autobalancer(scraper, addrs, config=cfg,
+                                flight=FlightRecorder(name="autobalancer",
+                                                      role="balancer"))
+        router = PartitionRouter(",".join(addrs), config=cfg)
+
+        # the seeded storm plan, drawn up-front so asyncio interleaving
+        # never perturbs the sequence a seed produces
+        keys = ZipfKeys(random.Random(seed * 31 + 7), n=accounts,
+                        prefix="acct-")
+        plan: List[tuple] = []
+        for n in range(sagas):
+            a = keys.draw()
+            b = keys.draw()
+            while b == a:
+                b = keys.draw()
+            poison = 1.0 if rng.random() < poison_fraction else 0.0
+            plan.append((f"x{seed}:{a}:{b}:{n}", poison))
+        kill_coordinator = bool(seed % 2)
+
+        async def scenario() -> dict:
+            saga_eng = acct_eng = None
+            victim = faulted = None
+            try:
+                saga_eng = create_engine(make_saga_logic(), log=router,
+                                         config=cfg)
+                acct_eng = create_engine(
+                    SurgeCommandBusinessLogic(
+                        aggregate_name="acct", model=counter.CounterModel(),
+                        state_format=counter.state_formatting(),
+                        event_format=counter.event_formatting()),
+                    log=router, config=cfg)
+                # saga.* delay sites widen the race windows the rid table
+                # must close; the crash sites stay for the unit suite
+                mgr = SagaManager(
+                    saga_eng, [_transfer_definition()],
+                    {"acct": acct_eng, "saga": saga_eng}, config=cfg,
+                    faults=FaultPlane.from_spec(json.dumps({"rules": [
+                        {"site": "saga.step.dispatch", "action": "delay",
+                         "p": 0.08, "delay_ms": 15.0, "times": None},
+                        {"site": "saga.compensation.dispatch",
+                         "action": "delay", "p": 0.08, "delay_ms": 15.0,
+                         "times": None},
+                    ]}), seed=seed))
+                saga_eng.register_saga_manager(mgr)
+                await acct_eng.start()
+                await saga_eng.start()
+
+                acked: set = set()
+                start_errors: list = []
+
+                async def _start_one(sid: str, poison: float) -> None:
+                    last: Optional[BaseException] = None
+                    deadline = time.monotonic() + seconds + settle_s
+                    while time.monotonic() < deadline:
+                        try:
+                            await mgr.start_saga(sid, "transfer",
+                                                 (0.0, poison))
+                            acked.add(sid)
+                            return
+                        except Exception as exc:  # noqa: BLE001 — mid-failover
+                            last = exc
+                            await asyncio.sleep(0.1)
+                    start_errors.append((sid, repr(last)))
+
+                # the seeded chaos schedule: starts pace over the first 60%,
+                # kill at 25%, manager restart at 45%, relight at 60%
+                t0 = time.monotonic()
+                kill_at = t0 + 0.25 * seconds
+                restart_at = t0 + 0.45 * seconds
+                relight_at = t0 + 0.60 * seconds
+                end_at = t0 + seconds
+                gap = (0.6 * seconds) / max(len(plan), 1)
+                fault_plan = json.dumps({"rules": [
+                    {"site": "ship.*", "action": "drop", "p": 0.06,
+                     "times": None},
+                    {"site": "rpc.Transact", "action": "reorder", "p": 0.06,
+                     "times": None, "delay_ms": 12.0},
+                ]})
+                starters: List[asyncio.Task] = []
+                launched = 0
+                relit = False
+                mgr_restarted = not manager_restart
+                while time.monotonic() < end_at or launched < len(plan):
+                    now = time.monotonic()
+                    while launched < len(plan) and now >= t0 + gap * launched:
+                        sid, poison = plan[launched]
+                        starters.append(asyncio.get_running_loop().create_task(
+                            _start_one(sid, poison)))
+                        launched += 1
+                    if victim is None and now >= kill_at:
+                        coord = fleet.coordinator() or addrs[0]
+                        others = [a for a in addrs if a != coord]
+                        victim = coord if kill_coordinator else \
+                            others[rng.randrange(len(others))]
+                        survivors = [a for a in addrs if a != victim]
+                        faulted = survivors[rng.randrange(len(survivors))]
+                        client = GrpcLogTransport(faulted, config=cfg)
+                        try:
+                            client.arm_faults(fault_plan, seed=seed)
+                        finally:
+                            client.close()
+                        await asyncio.to_thread(fleet.kill, victim)
+                        logger.warning(
+                            "saga soak %d: killed %s (coordinator=%s); "
+                            "link faults on %s", seed, victim,
+                            kill_coordinator, faulted)
+                    if not mgr_restarted and now >= restart_at:
+                        # the recovery leg: a cold manager resumes every
+                        # in-flight saga from replayed aggregate rows alone
+                        await mgr.stop()
+                        await mgr.start()
+                        mgr_restarted = True
+                    if victim is not None and not relit and now >= relight_at:
+                        follower_of = fleet.coordinator() or \
+                            [a for a in addrs if a != victim][0]
+                        await asyncio.to_thread(fleet.relight, victim,
+                                                follower_of)
+                        relit = True
+                    try:
+                        await asyncio.to_thread(balancer.cycle)
+                    except Exception:  # noqa: BLE001 — must not end the storm
+                        logger.exception("saga soak balancer cycle failed")
+                    await asyncio.sleep(0.1)
+
+                # settle: disarm link faults, drain the starters, then kick
+                # every non-terminal saga until the whole storm is terminal
+                if faulted is not None and not fleet.live[faulted]._dead:
+                    client = GrpcLogTransport(faulted, config=cfg)
+                    try:
+                        client.disarm_faults()
+                    except Exception:  # noqa: BLE001 — faulted broker died
+                        pass
+                    finally:
+                        client.close()
+                for t in starters:
+                    try:
+                        await t
+                    except Exception as exc:  # noqa: BLE001
+                        start_errors.append(("starter", repr(exc)))
+                settle_deadline = time.monotonic() + settle_s
+                pending = sorted(acked)
+                while time.monotonic() < settle_deadline:
+                    snapshot = dict(mgr._all_states())
+                    pending = [sid for sid in sorted(acked)
+                               if sid not in snapshot
+                               or snapshot[sid].status not in TERMINAL]
+                    if not pending:
+                        break
+                    for sid in pending:
+                        mgr.kick(sid)
+                    await asyncio.sleep(0.25)
+
+                # verdicts
+                snapshot = dict(mgr._all_states())
+                reconcile = mgr.reconcile()
+                lost_sagas = set(pending)
+                lost_sagas |= {sid for sid, _ in start_errors
+                               if sid != "starter"}
+                # expected ledger: the saga rows' own masks predict every
+                # balance (committed-and-not-compensated step effects)
+                expected: Dict[str, int] = {}
+                for sid, st in snapshot.items():
+                    if not sid.startswith(f"x{seed}:"):
+                        continue
+                    _, a, b, _ = sid.split(":")
+                    keep = st.committed & ~st.compensated
+                    if keep >> 0 & 1:
+                        expected[a] = expected.get(a, 0) - 1
+                    if keep >> 1 & 1:
+                        expected[b] = expected.get(b, 0) + 1
+                touched = sorted({acct for sid, _ in plan
+                                  for acct in sid.split(":")[1:3]})
+                mismatches: Dict[str, dict] = {}
+                dup_units = 0
+                for acct in touched:
+                    actual = None
+                    for _ in range(4):
+                        try:
+                            st = await acct_eng.aggregate_for(
+                                acct).get_state()
+                            actual = 0 if st is None else st.count
+                            break
+                        except Exception:  # noqa: BLE001 — transient
+                            await asyncio.sleep(0.2)
+                    exp = expected.get(acct, 0)
+                    if actual != exp:
+                        mismatches[acct] = {"expected": exp,
+                                            "actual": actual}
+                        dup_units += abs((actual or 0) - exp)
+
+                dumps = [f.dump() for f in fleet.flights.values()]
+                dumps += [fleet_flight.dump(), saga_eng.flight.dump(),
+                          acct_eng.flight.dump()]
+                timeline = merge_dumps(dumps)
+                saga_events = [e for e in timeline
+                               if str(e.get("type", "")).startswith("saga.")]
+                resumed = max((int(e.get("resumed", 0)) for e in saga_events
+                               if e.get("type") == "saga.manager.start"),
+                              default=0)
+                return {
+                    "seed": seed,
+                    "sagas": len(plan),
+                    "started": len(acked),
+                    "poisoned": sum(1 for _, p in plan if p >= 1.0),
+                    "lost": len(lost_sagas),
+                    "duplicated": dup_units,
+                    "half_compensated": len(reconcile["violations"]),
+                    "reconcile": reconcile,
+                    "counts": reconcile["counts"],
+                    "ledger_mismatches": mismatches,
+                    "start_errors": start_errors,
+                    "victim": victim,
+                    "victim_was_coordinator": kill_coordinator,
+                    "manager_restarted": mgr_restarted and manager_restart,
+                    "manager_resumed": resumed,
+                    "saga_events": len(saga_events),
+                    "timeline_events": len(timeline),
+                }
+            finally:
+                if saga_eng is not None:
+                    await saga_eng.stop()  # stops the manager too
+                if acct_eng is not None:
+                    await acct_eng.stop()
+
+        return asyncio.run(scenario())
+    finally:
+        if balancer is not None:
+            balancer.stop_sync()
+        if scraper is not None:
+            scraper.stop()
+        if router is not None:
+            router.close()
+        fleet.stop_all()

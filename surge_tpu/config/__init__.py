@@ -398,6 +398,18 @@ DEFAULTS: dict[str, Any] = {
     # engine-side flight-recorder ring size (events); the admin DumpFlight
     # RPC and BrokerStatus-style stats report occupancy + dropped count
     "surge.engine.flight-capacity": 1024,
+    # --- saga / process-manager orchestration (surge_tpu.saga) ---
+    # per-step dispatch deadline, forward retry budget and exponential
+    # backoff base; compensations get their own (larger) budget because
+    # exhausting it parks the saga in the dead letter. poll-interval paces
+    # the driver's state re-reads; max-concurrent bounds simultaneous
+    # participant dispatches across all drivers.
+    "surge.saga.step-timeout-ms": 10_000,
+    "surge.saga.step-max-attempts": 4,
+    "surge.saga.step-backoff-ms": 100,
+    "surge.saga.compensation-max-attempts": 6,
+    "surge.saga.poll-interval-ms": 50,
+    "surge.saga.max-concurrent": 512,
 }
 
 

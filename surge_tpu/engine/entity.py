@@ -57,6 +57,14 @@ def _default_metrics() -> EngineMetrics:
 # -- message + result ADTs (PersistentActor.scala:33-64, AggregateRefResult.scala:5-11) --
 
 
+#: envelope-header key carrying a caller-supplied request id (the saga
+#: manager's deterministic saga-scoped rids). When present, the entity
+#: publishes under THIS id instead of minting one — so a re-delivered
+#: command after timeout/crash/failover dedups against the publisher's
+#: completed window instead of folding twice.
+REQUEST_ID_HEADER = "surge-request-id"
+
+
 @dataclass
 class ProcessMessage:
     command: Any
@@ -337,6 +345,24 @@ class AggregateEntity:
         # round-trip to the business app) return awaitables; the single-writer
         # guarantee holds because this entity task awaits inline.
         self.metrics.command_rate.record()
+        rid = env.headers.get(REQUEST_ID_HEADER) if env.headers else None
+        if rid is not None:
+            # caller-supplied rid: short-circuit a re-delivery BEFORE the
+            # model runs. Publish-level dedup alone is not enough here — a
+            # re-run handler would fold its events into in-memory state a
+            # second time while the log stays exactly-once.
+            disposition_of = getattr(self.publisher, "request_disposition", None)
+            disposition = disposition_of(rid) if disposition_of else None
+            if disposition == "completed":
+                resolve_future(env.reply, CommandSuccess(self.state))
+                return
+            if disposition == "in-flight":
+                # the original attempt is still working its way through the
+                # publisher (crashed-entity leftovers): the caller backs off
+                # and retries once the outcome is known
+                resolve_future(env.reply, CommandFailure(RuntimeError(
+                    f"request {rid} still in flight")))
+                return
         try:
             with self.metrics.command_handling_timer.time():
                 result = self._model_process(self.state, command)
@@ -399,8 +425,10 @@ class AggregateEntity:
                 resolve_future(env.reply, CommandFailure(exc))
                 return
 
-            self._rid_n += 1
-            request_id = f"{self._rid_prefix}-{self._rid_n}"
+            request_id = env.headers.get(REQUEST_ID_HEADER) if env.headers else None
+            if request_id is None:
+                self._rid_n += 1
+                request_id = f"{self._rid_prefix}-{self._rid_n}"
             last_error: Optional[Exception] = None
             for _ in range(self.retry.publish_max_retries + 1):
                 try:

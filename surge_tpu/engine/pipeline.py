@@ -177,6 +177,10 @@ class SurgeEngine(Controllable):
             self.config, on_signal=self.health_bus.signal_fn("event-loop"))
             if self.config.get_bool("surge.event-loop-prober.enabled") else None)
         self.surge_model = SurgeModel(logic, self.config)
+        # saga / process-manager plane (surge_tpu.saga): attached via
+        # register_saga_manager on the engine whose aggregates hold the saga
+        # state machines; started/supervised with the pipeline lifecycle
+        self.saga_manager = None
         self.indexer = StateStoreIndexer(self.log, logic.state_topic, config=self.config,
                                          on_signal=self.health_bus.signal_fn("state-store"))
         # routing backend selection by feature flag (SurgePartitionRouterImpl.scala:
@@ -323,6 +327,11 @@ class SurgeEngine(Controllable):
                     "checkpoint-writer", self.checkpoint_writer,
                     restart_patterns=[RegexMatcher(r"checkpoint-writer.*fatal")])
             await self.router.start()
+            if self.saga_manager is not None:
+                await self.saga_manager.start()
+                self.health_supervisor.register(
+                    "saga-manager", self.saga_manager,
+                    restart_patterns=[RegexMatcher(r"saga-manager.*fatal")])
             if not self._external_tracker and not self.tracker.assignments.assignments:
                 # single-node mode: self-assign every partition (no external control
                 # plane; multi-node engines share an externally-updated tracker)
@@ -368,6 +377,8 @@ class SurgeEngine(Controllable):
         self.health_supervisor.stop()
         if self.loop_prober is not None:
             await self.loop_prober.stop()
+        if self.saga_manager is not None:
+            await self.saga_manager.stop()
         await self.router.stop()  # stops regions (shards + publishers)
         if self.views is not None:
             self.views.close()  # end changefeed subscriptions first
@@ -396,6 +407,39 @@ class SurgeEngine(Controllable):
             raise EngineNotRunningError(
                 f"engine status is {self.status.value} (SurgeEngineNotRunningException)")
         self.router.deliver(aggregate_id, env)
+
+    # -- saga plane (surge_tpu.saga) -----------------------------------------------------
+
+    def register_saga_manager(self, manager) -> None:
+        """Attach a :class:`~surge_tpu.saga.manager.SagaManager` to this
+        engine's lifecycle: started after the router, supervised under the
+        ``saga-manager.*fatal`` restart pattern (a fired ``crash.saga.*``
+        point restarts the manager, whose resume scan is the recovery path).
+        Call before :meth:`start`; a manager registered on a running engine
+        is started immediately by the caller."""
+        if manager.on_signal is None:
+            manager.on_signal = self.health_bus.signal_fn("saga-manager")
+        if manager.metrics is None:
+            manager.metrics = self.metrics
+        if manager.flight is None:
+            manager.flight = self.flight
+        self.saga_manager = manager
+
+    async def start_saga(self, saga_id: str, definition: str,
+                         ctx=(0.0, 0.0, 0.0, 0.0)):
+        """Admin-plane delegate → :meth:`SagaManager.start_saga`."""
+        if self.saga_manager is None:
+            raise RuntimeError("no saga manager registered on this engine")
+        return await self.saga_manager.start_saga(saga_id, definition, ctx)
+
+    async def saga_status(self, saga_id: str = ""):
+        """Admin-plane delegate: one saga's ledger, or the fleet summary
+        (counts + reconciliation verdict) when ``saga_id`` is empty."""
+        if self.saga_manager is None:
+            raise RuntimeError("no saga manager registered on this engine")
+        if saga_id:
+            return await self.saga_manager.status(saga_id)
+        return self.saga_manager.summary()
 
     def register_rebalance_listener(self, listener: Callable) -> None:
         """listener(assignments, changes) on every tracker update

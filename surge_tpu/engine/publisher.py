@@ -606,6 +606,27 @@ class PartitionPublisher:
         else:
             await ack
 
+    def request_disposition(self, request_id: str) -> Optional[str]:
+        """Where a request id sits in this publisher's dedup window:
+        ``"completed"`` (committed inside the TTL window), ``"in-flight"``
+        (queued / in-limbo / mid-commit), or None (never seen, or aged out).
+
+        The entity consults this BEFORE running ``process_command`` for a
+        caller-supplied request id (the saga manager's deterministic rids):
+        a re-delivered command must short-circuit at the entity, because
+        re-running the handler would fold its events into in-memory state a
+        second time even though the publish itself dedups."""
+        if request_id in self._completed:
+            return "completed"
+        if request_id in self._queued_rids or request_id in self._committing:
+            return "in-flight"
+        for rb in self._retry_batches:
+            if any(sp.request_id == request_id for sp in rb.pendings):
+                return "in-flight"
+        if any(p.request_id == request_id for p in self._pending):
+            return "in-flight"
+        return None
+
     def is_aggregate_state_current(self, aggregate_id: str) -> bool:
         """True iff nothing published for this aggregate is still ahead of the store's
         indexed watermark and nothing is pending (KafkaProducerActorImpl.scala:530-540)."""

@@ -15,6 +15,7 @@ from typing import Any, Callable, Optional, Sequence
 from surge_tpu.common import wait_future
 from surge_tpu.config import Config, TimeoutConfig, default_config
 from surge_tpu.engine.entity import (
+    REQUEST_ID_HEADER,
     ApplyEvents,
     CommandFailure,
     CommandRejected,
@@ -41,9 +42,12 @@ class AggregateRef:
         self._headers_factory = headers_factory or dict
         self._tracer = tracer
 
-    async def _ask(self, message: Any) -> Any:
+    async def _ask(self, message: Any,
+                   extra_headers: Optional[dict] = None) -> Any:
         fut: "asyncio.Future[Any]" = asyncio.get_running_loop().create_future()
         headers = self._headers_factory()
+        if extra_headers:
+            headers.update(extra_headers)
         span = None
         if self._tracer is not None:
             # span at the ask boundary, trace context rides the envelope headers
@@ -73,10 +77,17 @@ class AggregateRef:
             if span is not None:
                 span.finish()
 
-    async def send_command(self, command: Any):
+    async def send_command(self, command: Any, *,
+                           request_id: Optional[str] = None):
         """→ CommandSuccess(new_state) | CommandRejected(reason) | CommandFailure(err)
-        (AggregateRefTrait.sendCommand:76-93)."""
-        result = await self._ask(ProcessMessage(command))
+        (AggregateRefTrait.sendCommand:76-93).
+
+        ``request_id`` rides the envelope headers into the entity, which
+        publishes under it instead of minting one — a retried send with the
+        same id dedups exactly-once (the saga manager's contract)."""
+        result = await self._ask(
+            ProcessMessage(command),
+            {REQUEST_ID_HEADER: request_id} if request_id is not None else None)
         if isinstance(result, (CommandSuccess, CommandRejected, CommandFailure)):
             return result
         return CommandFailure(TypeError(f"unexpected reply {result!r}"))

@@ -4483,7 +4483,19 @@ class LogServer:
         if tombstone_retention_s is None:
             tombstone_retention_s = (self._config or _dc()).get_seconds(
                 "surge.log.compaction.tombstone-retention-ms", 60_000)
-        if self.role != "leader":
+        if self._spread_active():
+            # per-partition leadership spread: the write authority for THIS
+            # partition drives its compaction (the whole-broker role is
+            # meaningless under a spread — a "follower"-role broker may lead
+            # this slice, and the legacy check would refuse it while letting
+            # a non-owner compact behind the real leader's stream)
+            if not self._leads(topic, partition):
+                owner = self._assignments.get(str(partition))
+                raise RuntimeError(
+                    f"compaction of {topic}[{partition}] must run on its "
+                    f"slice leader ({owner or 'unknown'}); this broker does "
+                    f"not lead it")
+        elif self.role != "leader":
             raise RuntimeError(
                 f"compaction must run on the leader ({self.leader_hint or 'unknown'}); "
                 f"this broker is a {self.role}")

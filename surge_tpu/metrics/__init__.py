@@ -317,6 +317,13 @@ class EngineMetrics:
     trace_kept: Sensor = field(init=False)
     trace_dropped: Sensor = field(init=False)
     trace_tail_buffer: Sensor = field(init=False)
+    # saga / process-manager plane (surge_tpu.saga.manager): the driver
+    # population and terminal-outcome tallies of this engine's SagaManager
+    saga_active: Sensor = field(init=False)
+    saga_completed: Sensor = field(init=False)
+    saga_compensated: Sensor = field(init=False)
+    saga_dead_letter: Sensor = field(init=False)
+    saga_step_timer: Timer = field(init=False)
 
     def __post_init__(self) -> None:
         m, MI = self.registry, MetricInfo
@@ -592,6 +599,23 @@ class EngineMetrics:
             "spans buffered for in-flight traces awaiting their tail "
             "keep/drop decision (bounded by "
             "surge.trace.tail.max-buffer-spans)"))
+        self.saga_active = m.gauge(MI(
+            "surge.saga.active",
+            "in-flight sagas with a live driver task on this manager"))
+        self.saga_completed = m.counter(MI(
+            "surge.saga.completed",
+            "sagas that reached COMPLETED (every step committed)"))
+        self.saga_compensated = m.counter(MI(
+            "surge.saga.compensated",
+            "sagas that reached COMPENSATED (every committed step undone)"))
+        self.saga_dead_letter = m.counter(MI(
+            "surge.saga.dead-letter",
+            "sagas parked in the dead letter (a compensation was rejected "
+            "or exhausted its retry budget — operator intervention needed)"))
+        self.saga_step_timer = m.timer(MI(
+            "surge.saga.step-timer",
+            "ms per saga step dispatch (forward or compensation), command "
+            "send to participant ack"))
         # Deprecation aliases for the r4 renames (ADVICE r4): dashboards keyed
         # to the old identifiers — including a timer's .min/.max/.p99
         # sub-metrics — keep working for a release window; the alias providers

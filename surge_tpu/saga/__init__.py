@@ -1,0 +1,60 @@
+"""Saga / process-manager orchestration across aggregates (ROADMAP 5(b)).
+
+Saga state is itself an aggregate — ``make_saga_logic()`` builds a normal
+engine family, so sagas inherit replay, resident-plane recovery, quorum
+failover and flight observability for free.  The :class:`SagaManager`
+drives every in-flight saga to a terminal state with deterministic
+saga-scoped request ids, making retries after timeout/crash/failover ride
+the existing dedup window exactly-once.  See docs/operations.md
+("Running sagas") and docs/event-engine.md.
+"""
+
+from surge_tpu.saga.definition import (
+    SagaDefinition,
+    SagaStep,
+    definition_index,
+)
+from surge_tpu.saga.manager import (
+    SagaManager,
+    compensation_request_id,
+    step_request_id,
+)
+from surge_tpu.saga.model import (
+    COMPENSATED,
+    COMPENSATING,
+    COMPLETED,
+    DEAD_LETTER,
+    MAX_STEPS,
+    RUNNING,
+    STATUS_NAMES,
+    TERMINAL,
+    SagaModel,
+    SagaState,
+    StartSaga,
+    make_registry,
+    make_replay_spec,
+    make_saga_logic,
+)
+
+__all__ = [
+    "SagaDefinition",
+    "SagaStep",
+    "SagaManager",
+    "SagaModel",
+    "SagaState",
+    "StartSaga",
+    "make_saga_logic",
+    "make_registry",
+    "make_replay_spec",
+    "definition_index",
+    "step_request_id",
+    "compensation_request_id",
+    "MAX_STEPS",
+    "RUNNING",
+    "COMPENSATING",
+    "COMPLETED",
+    "COMPENSATED",
+    "DEAD_LETTER",
+    "STATUS_NAMES",
+    "TERMINAL",
+]

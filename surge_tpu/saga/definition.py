@@ -1,0 +1,98 @@
+"""SagaDefinition — the ordered-step DSL a process manager executes.
+
+A definition is pure code, registered with the :class:`~surge_tpu.saga.
+manager.SagaManager` under a stable ``def_id`` (persisted in the saga
+aggregate's state, so a restarted manager re-binds replayed sagas to their
+definitions). Each step names the participant engine it targets and builds
+its forward and compensation commands from ``(saga_id, SagaState)`` alone —
+no captured per-saga context is allowed to matter, because after a crash
+the ONLY inputs available are the saga id and the replayed state (the four
+float context slots ``c0..c3`` plus whatever the id itself encodes).
+
+::
+
+    transfer = SagaDefinition(
+        name="transfer", def_id=1,
+        steps=(
+            SagaStep("credit-src", participant="counter",
+                     target=lambda sid, s: f"acct-{sid.split(':')[1]}",
+                     command=lambda tid, s: counter.Increment(tid),
+                     compensation=lambda tid, s: counter.Decrement(tid)),
+            SagaStep("credit-dst", participant="counter",
+                     target=lambda sid, s: f"acct-{sid.split(':')[2]}",
+                     command=lambda tid, s: counter.Increment(tid),
+                     compensation=lambda tid, s: counter.Decrement(tid)),
+        ))
+
+A step without a ``compensation`` is skipped during the reverse walk (its
+effect is considered intrinsically safe to keep). Per-step retry/timeout
+overrides fall back to the ``surge.saga.*`` config keys
+(docs/operations.md "Running sagas").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from surge_tpu.saga.model import MAX_STEPS
+
+#: (target_aggregate_id, saga_state) -> command object
+CommandFactory = Callable[[str, Any], Any]
+#: (saga_id, saga_state) -> target aggregate id
+TargetFactory = Callable[[str, Any], str]
+
+
+@dataclass(frozen=True)
+class SagaStep:
+    """One ordered unit of work: a typed command against a target aggregate
+    plus the command that undoes it."""
+
+    name: str
+    participant: str
+    target: TargetFactory
+    command: CommandFactory
+    compensation: Optional[CommandFactory] = None
+    #: per-step overrides; None falls back to surge.saga.* config
+    max_attempts: Optional[int] = None
+    timeout_ms: Optional[float] = None
+    backoff_ms: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class SagaDefinition:
+    """An ordered, immutable step list under a stable numeric id."""
+
+    name: str
+    def_id: int
+    steps: Tuple[SagaStep, ...] = field(default_factory=tuple)
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "steps", tuple(self.steps))
+        if not self.steps:
+            raise ValueError(f"saga {self.name!r} has no steps")
+        if len(self.steps) > MAX_STEPS:
+            raise ValueError(
+                f"saga {self.name!r} has {len(self.steps)} steps "
+                f"(max {MAX_STEPS}: progress bitmasks are int32 columns)")
+        names = [s.name for s in self.steps]
+        if len(set(names)) != len(names):
+            raise ValueError(f"saga {self.name!r} has duplicate step names")
+        if self.def_id <= 0:
+            raise ValueError("def_id must be a positive, stable integer")
+
+    @property
+    def num_steps(self) -> int:
+        return len(self.steps)
+
+
+def definition_index(definitions) -> Dict[int, SagaDefinition]:
+    """def_id -> definition, rejecting collisions (ids are persisted state)."""
+    index: Dict[int, SagaDefinition] = {}
+    for d in definitions:
+        if d.def_id in index and index[d.def_id] is not d:
+            raise ValueError(
+                f"def_id {d.def_id} registered twice "
+                f"({index[d.def_id].name!r} and {d.name!r})")
+        index[d.def_id] = d
+    return index

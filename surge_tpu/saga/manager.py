@@ -1,0 +1,566 @@
+"""SagaManager — the supervised process manager driving sagas to a terminal.
+
+The manager holds NO durable state of its own.  Every transition a saga
+makes is an event on the saga aggregate (surge_tpu.saga.model), so a
+restarted manager rebuilds its whole world by scanning the saga engine's
+state store: any non-terminal row gets a fresh driver task that re-derives
+the next action purely from replayed state.  There is no side journal to
+fsync, no checkpoint to age out, nothing to reconcile against the log —
+the log IS the journal.
+
+Exactly-once across retries, restarts and broker failover comes from
+deterministic saga-scoped request ids:
+
+* forward step ``n``      → ``saga:{saga_id}:{n}:fwd``
+* compensation of ``n``   → ``saga:{saga_id}:{n}:comp``
+* the start command       → ``saga:{saga_id}:start``
+* progress records        → ``saga:{saga_id}:{n}:rec-c`` / ``rec-f`` /
+  ``comp-rec`` / ``dead``
+
+A timed-out or crash-interrupted dispatch is re-sent VERBATIM under the
+same rid; the partition publisher's completed/in-flight dedup window (and
+the entity-level short-circuit in front of ``process_command``) turns the
+duplicate into the original outcome instead of a second fold.  The fault
+plane's ``crash.saga.record.step-committed`` site fires in the torn spot —
+after the participant committed but before the saga recorded it — and the
+kill-failover soak proves the resumed manager closes that gap without
+double-applying the step.
+
+Reconciliation invariant (the soak verdict): every terminal saga satisfies
+*all steps committed* XOR *all committed steps compensated* — COMPLETED
+rows carry the full bitmask and no compensations, COMPENSATED rows carry
+``compensated == committed``, and DEAD_LETTER is the only state allowed to
+hold an unbalanced ledger (it is the acknowledged, operator-visible loss).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
+
+from surge_tpu.common import Ack, Controllable, cancel_safe_wait_for
+from surge_tpu.config import Config, default_config
+from surge_tpu.engine.entity import CommandFailure, CommandRejected, CommandSuccess
+from surge_tpu.saga.definition import SagaDefinition, definition_index
+from surge_tpu.saga.model import (
+    COMPENSATING,
+    COMPLETED,
+    DEAD_LETTER,
+    RUNNING,
+    STATUS_NAMES,
+    TERMINAL,
+    RecordDeadLetter,
+    RecordStepCommitted,
+    RecordStepCompensated,
+    RecordStepFailed,
+    SagaState,
+    StartSaga,
+)
+from surge_tpu.testing.faults import SimulatedCrash
+
+log = logging.getLogger("surge.saga")
+
+#: attempts the manager makes to land a progress record on the saga
+#: aggregate before parking the driver for a poll interval and re-deriving
+#: (records ride the same rid-dedup window, so re-deriving is always safe)
+_RECORD_ATTEMPTS = 8
+
+
+def step_request_id(saga_id: str, step: int) -> str:
+    """The deterministic rid a forward dispatch of ``step`` rides."""
+    return f"saga:{saga_id}:{step}:fwd"
+
+
+def compensation_request_id(saga_id: str, step: int) -> str:
+    """The deterministic rid the compensation of ``step`` rides."""
+    return f"saga:{saga_id}:{step}:comp"
+
+
+class SagaManager(Controllable):
+    """Drives every in-flight saga of one engine to a terminal state.
+
+    Parameters
+    ----------
+    engine:
+        The saga-family engine (``make_saga_logic()``) whose aggregates
+        hold the saga state machines.
+    definitions:
+        Iterable of :class:`SagaDefinition`; ``def_id`` collisions raise.
+    participants:
+        participant name → engine-like (anything with ``aggregate_for``);
+        step targets resolve through this map.
+    faults:
+        Optional :class:`~surge_tpu.testing.faults.FaultPlane` for the
+        ``saga.*`` delay/error sites and ``crash.saga.*`` crash points.
+        Falls back to the saga engine log's armed plane when present.
+    on_signal:
+        ``(name, level)`` health-bus adapter; a fired crash point emits
+        ``saga-manager.crash.fatal`` here so the supervisor restarts the
+        manager (the restart IS the recovery path under test).
+    """
+
+    def __init__(self, engine: Any, definitions: Iterable[SagaDefinition],
+                 participants: Dict[str, Any], *,
+                 config: Config | None = None, metrics: Any = None,
+                 flight: Any = None, faults: Any = None,
+                 on_signal: Optional[Callable[[str, str], None]] = None) -> None:
+        self.engine = engine
+        self.definitions = definition_index(definitions)
+        self._by_name: Dict[str, SagaDefinition] = {
+            d.name: d for d in self.definitions.values()}
+        if len(self._by_name) != len(self.definitions):
+            raise ValueError("saga definition names must be unique")
+        self.participants = dict(participants)
+        self.config = config or getattr(engine, "config", None) or default_config()
+        self.metrics = metrics if metrics is not None else getattr(
+            engine, "metrics", None)
+        self.flight = flight if flight is not None else getattr(
+            engine, "flight", None)
+        self.faults = faults
+        self.on_signal = on_signal
+        cfg = self.config
+        self._step_timeout_s = float(cfg.get("surge.saga.step-timeout-ms")) / 1000.0
+        self._step_attempts = int(cfg.get("surge.saga.step-max-attempts"))
+        self._backoff_s = float(cfg.get("surge.saga.step-backoff-ms")) / 1000.0
+        self._comp_attempts = int(cfg.get("surge.saga.compensation-max-attempts"))
+        self._poll_s = float(cfg.get("surge.saga.poll-interval-ms")) / 1000.0
+        self._gate = asyncio.Semaphore(int(cfg.get("surge.saga.max-concurrent")))
+        self._drivers: Dict[str, asyncio.Task] = {}
+        self._refs: Dict[str, Any] = {}
+        self._counted: set = set()
+        self._running = False
+        self.crashed: Optional[str] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self) -> Ack:
+        self._running = True
+        self.crashed = None
+        resumed = self.resume_in_flight()
+        self._record_flight("saga.manager.start", resumed=resumed)
+        self._gauge_active()
+        return Ack()
+
+    async def stop(self) -> Ack:
+        self._running = False
+        drivers, self._drivers = self._drivers, {}
+        for task in drivers.values():
+            task.cancel()
+        for task in drivers.values():
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._refs.clear()
+        self._record_flight("saga.manager.stop")
+        self._gauge_active()
+        return Ack()
+
+    def resume_in_flight(self) -> int:
+        """Scan the saga state store and (re)spawn a driver for every
+        non-terminal saga.  This is the whole recovery story: no side
+        journal, just the replayed aggregate rows."""
+        n = 0
+        for saga_id, state in self._all_states():
+            if state.status in TERMINAL:
+                self._counted.add(saga_id)
+                continue
+            self._spawn(saga_id)
+            n += 1
+        return n
+
+    def kick(self, saga_id: str) -> None:
+        """Ensure a driver is running for ``saga_id`` (idempotent).
+
+        A liveness-only helper: the soak's settle loop kicks any saga whose
+        driver died with the broker it was mid-call against.  Safety never
+        depends on it — a double-spawned driver's commands collapse into
+        the same deterministic rids."""
+        if self._running:
+            self._spawn(saga_id)
+
+    def health_check(self):
+        from surge_tpu.health import HealthCheck
+
+        status = "down" if self.crashed else ("up" if self._running else "down")
+        return HealthCheck(name="saga-manager", status=status)
+
+    # ------------------------------------------------------------ public API
+
+    async def start_saga(self, saga_id: str, definition: str,
+                         ctx: Tuple[float, float, float, float] = (0.0, 0.0, 0.0, 0.0),
+                         ) -> Dict[str, Any]:
+        """Start (idempotently) a saga under ``saga_id``.
+
+        The start command rides the deterministic ``saga:{id}:start`` rid,
+        and an already-started saga answers with a rejection the caller
+        treats as success — so admin-plane retries and double-submits from
+        a failed-over client collapse into one StartSaga event.
+        """
+        d = self._by_name.get(definition)
+        if d is None:
+            raise KeyError(f"unknown saga definition {definition!r}")
+        c = tuple(ctx) + (0.0,) * (4 - len(ctx))
+        cmd = StartSaga(aggregate_id=saga_id, def_id=d.def_id,
+                        num_steps=d.num_steps,
+                        c0=float(c[0]), c1=float(c[1]),
+                        c2=float(c[2]), c3=float(c[3]))
+        res = await self._send(self.engine, saga_id, cmd,
+                               f"saga:{saga_id}:start", self._step_timeout_s)
+        if isinstance(res, CommandFailure):
+            raise RuntimeError(f"start_saga({saga_id}) failed: {res.error!r}")
+        if isinstance(res, CommandSuccess):
+            self._record_flight("saga.start", saga_id=saga_id,
+                                definition=definition, steps=d.num_steps)
+        self._spawn(saga_id)
+        return await self.status(saga_id)
+
+    async def status(self, saga_id: str) -> Dict[str, Any]:
+        """One saga's ledger, readable by an operator."""
+        state = await self._load(saga_id)
+        if state is None:
+            return {"saga_id": saga_id, "status": "unknown"}
+        d = self.definitions.get(state.def_id)
+        return {
+            "saga_id": saga_id,
+            "status": STATUS_NAMES[state.status],
+            "definition": d.name if d is not None else f"def:{state.def_id}",
+            "step": state.step,
+            "num_steps": state.num_steps,
+            "committed": [i for i in range(state.num_steps)
+                          if state.committed >> i & 1],
+            "compensated": [i for i in range(state.num_steps)
+                            if state.compensated >> i & 1],
+            "attempts": state.attempts,
+            "ctx": [state.c0, state.c1, state.c2, state.c3],
+            "driver": saga_id in self._drivers,
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        """Fleet-shaped counts + the reconciliation verdict."""
+        verdict = self.reconcile()
+        verdict["drivers"] = len(self._drivers)
+        verdict["running"] = self._running
+        return verdict
+
+    def reconcile(self) -> Dict[str, Any]:
+        """The ledger-reconciliation invariant over EVERY saga row.
+
+        A terminal saga must satisfy *all steps committed* XOR *all
+        committed steps compensated*; DEAD_LETTER is the only acknowledged
+        exception.  Violations here are exactly the soak's
+        "half-compensated" count — the verdict must come back empty.
+        """
+        counts = {name: 0 for name in STATUS_NAMES.values()}
+        violations = []
+        total = 0
+        for saga_id, st in self._all_states():
+            total += 1
+            counts[STATUS_NAMES[st.status]] += 1
+            full = (1 << st.num_steps) - 1
+            if st.status == COMPLETED:
+                if st.committed != full:
+                    violations.append({"saga_id": saga_id,
+                                       "kind": "completed-missing-steps",
+                                       "committed": st.committed, "full": full})
+                if st.compensated:
+                    violations.append({"saga_id": saga_id,
+                                       "kind": "completed-but-compensated",
+                                       "compensated": st.compensated})
+            elif st.status not in (RUNNING, COMPENSATING, DEAD_LETTER):
+                # COMPENSATED: every committed step must be undone
+                if st.compensated != st.committed:
+                    violations.append({"saga_id": saga_id,
+                                       "kind": "half-compensated",
+                                       "committed": st.committed,
+                                       "compensated": st.compensated})
+        return {"ok": not violations, "total": total, "counts": counts,
+                "violations": violations,
+                "in_flight": counts["running"] + counts["compensating"],
+                "dead_letter": counts["dead-letter"]}
+
+    # ---------------------------------------------------------- driver loop
+
+    def _spawn(self, saga_id: str) -> None:
+        existing = self._drivers.get(saga_id)
+        if existing is not None and not existing.done():
+            return
+        task = asyncio.get_running_loop().create_task(
+            self._drive(saga_id), name=f"saga-driver-{saga_id}")
+        self._drivers[saga_id] = task
+        task.add_done_callback(lambda t, sid=saga_id: self._reap(sid, t))
+        self._gauge_active()
+
+    def _reap(self, saga_id: str, task: asyncio.Task) -> None:
+        if self._drivers.get(saga_id) is task:
+            del self._drivers[saga_id]
+        self._refs.pop(saga_id, None)
+        if not task.cancelled():
+            exc = task.exception()
+            if exc is not None and not isinstance(exc, SimulatedCrash):
+                log.warning("saga driver %s died: %r", saga_id, exc)
+        self._gauge_active()
+
+    async def _drive(self, saga_id: str) -> None:
+        misses = 0
+        try:
+            while self._running:
+                state = await self._load(saga_id)
+                if state is None:
+                    # started but the fold hasn't landed yet (or unknown id)
+                    misses += 1
+                    if misses > 100:
+                        log.warning("saga %s never materialized; driver exiting",
+                                    saga_id)
+                        return
+                    await asyncio.sleep(self._poll_s)
+                    continue
+                misses = 0
+                if state.status in TERMINAL:
+                    self._finish(saga_id, state)
+                    return
+                d = self.definitions.get(state.def_id)
+                if d is None:
+                    log.warning("saga %s references unknown def_id %d; parked",
+                                saga_id, state.def_id)
+                    self._record_flight("saga.parked", saga_id=saga_id,
+                                        def_id=state.def_id)
+                    return
+                if state.status == RUNNING:
+                    ok = await self._forward(saga_id, state, d)
+                else:
+                    ok = await self._compensate(saga_id, state, d)
+                if not ok:
+                    await asyncio.sleep(self._poll_s)
+        except asyncio.CancelledError:
+            raise
+        except SimulatedCrash as exc:
+            # The torn spot under test: the participant committed (or the
+            # record landed) and the manager died before the next action.
+            # Surface a fatal signal; the health supervisor restarts the
+            # manager, whose resume scan re-derives this saga's next move
+            # under the SAME rids — the dedup window makes it exactly-once.
+            self.crashed = str(exc)
+            self._record_flight("saga.manager.crash", saga_id=saga_id,
+                                point=str(exc))
+            if self.on_signal is not None:
+                self.on_signal("saga-manager.crash.fatal", "fatal")
+            raise
+
+    async def _forward(self, saga_id: str, state: SagaState,
+                       d: SagaDefinition) -> bool:
+        step_i = state.step
+        sdef = d.steps[step_i]
+        participant = self.participants.get(sdef.participant)
+        if participant is None:
+            log.warning("saga %s step %d names unknown participant %r",
+                        saga_id, step_i, sdef.participant)
+            return await self._record(
+                saga_id, RecordStepFailed(saga_id, step_i, 0),
+                f"saga:{saga_id}:{step_i}:rec-f")
+        target = sdef.target(saga_id, state)
+        cmd = sdef.command(target, state)
+        rid = step_request_id(saga_id, step_i)
+        max_attempts = sdef.max_attempts or self._step_attempts
+        timeout_s = (sdef.timeout_ms / 1000.0 if sdef.timeout_ms
+                     else self._step_timeout_s)
+        backoff_s = (sdef.backoff_ms / 1000.0 if sdef.backoff_ms
+                     else self._backoff_s)
+        attempts = 0
+        while attempts < max_attempts:
+            attempts += 1
+            self._point("saga.step.dispatch")
+            t0 = time.monotonic()
+            async with self._gate:
+                res = await self._send(participant, target, cmd, rid, timeout_s)
+            self._time_step((time.monotonic() - t0) * 1000.0)
+            if isinstance(res, CommandSuccess):
+                self._record_flight("saga.step.commit", saga_id=saga_id,
+                                    step=step_i, name=sdef.name,
+                                    target=target, attempt=attempts)
+                # the torn spot: participant committed, saga not yet told
+                self._crash("saga.record.step-committed")
+                return await self._record(
+                    saga_id, RecordStepCommitted(saga_id, step_i),
+                    f"saga:{saga_id}:{step_i}:rec-c")
+            if isinstance(res, CommandRejected):
+                # business no — never retried, flips the saga to compensation
+                self._record_flight("saga.step.reject", saga_id=saga_id,
+                                    step=step_i, name=sdef.name,
+                                    reason=repr(res.reason))
+                return await self._record(
+                    saga_id, RecordStepFailed(saga_id, step_i, attempts),
+                    f"saga:{saga_id}:{step_i}:rec-f")
+            # CommandFailure: timeout / publish / routing — the SAME rid
+            # rides the retry, so a command that actually landed dedups
+            self._record_flight("saga.step.retry", saga_id=saga_id,
+                                step=step_i, attempt=attempts,
+                                error=repr(getattr(res, "error", res)))
+            if attempts < max_attempts:
+                await asyncio.sleep(backoff_s * (2 ** (attempts - 1)))
+        self._record_flight("saga.step.exhausted", saga_id=saga_id,
+                            step=step_i, attempts=attempts)
+        return await self._record(
+            saga_id, RecordStepFailed(saga_id, step_i, attempts),
+            f"saga:{saga_id}:{step_i}:rec-f")
+
+    async def _compensate(self, saga_id: str, state: SagaState,
+                          d: SagaDefinition) -> bool:
+        pending = state.committed & ~state.compensated
+        if pending == 0:
+            # the fold flips status when the masks meet; re-read
+            return True
+        step_i = pending.bit_length() - 1  # reverse order: highest first
+        sdef = d.steps[step_i]
+        rec = RecordStepCompensated(saga_id, step_i)
+        rec_rid = f"saga:{saga_id}:{step_i}:comp-rec"
+        if sdef.compensation is None:
+            # intrinsically safe to keep — recorded as compensated so the
+            # ledger balances without issuing a command
+            self._record_flight("saga.comp.skip", saga_id=saga_id,
+                                step=step_i, name=sdef.name)
+            return await self._record(saga_id, rec, rec_rid)
+        participant = self.participants.get(sdef.participant)
+        if participant is None:
+            return await self._record(
+                saga_id, RecordDeadLetter(saga_id, step_i),
+                f"saga:{saga_id}:{step_i}:dead")
+        target = sdef.target(saga_id, state)
+        cmd = sdef.compensation(target, state)
+        rid = compensation_request_id(saga_id, step_i)
+        timeout_s = (sdef.timeout_ms / 1000.0 if sdef.timeout_ms
+                     else self._step_timeout_s)
+        backoff_s = (sdef.backoff_ms / 1000.0 if sdef.backoff_ms
+                     else self._backoff_s)
+        attempts = 0
+        while attempts < self._comp_attempts:
+            attempts += 1
+            self._point("saga.compensation.dispatch")
+            t0 = time.monotonic()
+            async with self._gate:
+                res = await self._send(participant, target, cmd, rid, timeout_s)
+            self._time_step((time.monotonic() - t0) * 1000.0)
+            if isinstance(res, CommandSuccess):
+                self._record_flight("saga.comp.commit", saga_id=saga_id,
+                                    step=step_i, name=sdef.name,
+                                    target=target, attempt=attempts)
+                self._crash("saga.record.step-compensated")
+                return await self._record(saga_id, rec, rec_rid)
+            if isinstance(res, CommandRejected):
+                # the participant refuses to undo — retrying cannot help;
+                # park the saga in the operator-visible dead letter
+                self._record_flight("saga.comp.reject", saga_id=saga_id,
+                                    step=step_i, reason=repr(res.reason))
+                return await self._record(
+                    saga_id, RecordDeadLetter(saga_id, step_i),
+                    f"saga:{saga_id}:{step_i}:dead")
+            self._record_flight("saga.comp.retry", saga_id=saga_id,
+                                step=step_i, attempt=attempts,
+                                error=repr(getattr(res, "error", res)))
+            if attempts < self._comp_attempts:
+                await asyncio.sleep(backoff_s * (2 ** (attempts - 1)))
+        self._record_flight("saga.comp.exhausted", saga_id=saga_id,
+                            step=step_i, attempts=attempts)
+        return await self._record(
+            saga_id, RecordDeadLetter(saga_id, step_i),
+            f"saga:{saga_id}:{step_i}:dead")
+
+    # ------------------------------------------------------------- plumbing
+
+    async def _record(self, saga_id: str, cmd: Any, rid: str) -> bool:
+        """Land a progress record on the saga aggregate.
+
+        A rejection means the record is already folded (the Record*
+        commands are idempotent-by-rejection) — both outcomes hand control
+        back to the driver loop, which re-reads state and re-derives."""
+        for attempt in range(_RECORD_ATTEMPTS):
+            res = await self._send(self.engine, saga_id, cmd, rid,
+                                   self._step_timeout_s)
+            if isinstance(res, (CommandSuccess, CommandRejected)):
+                return True
+            await asyncio.sleep(self._poll_s * (attempt + 1))
+        log.warning("saga %s could not land %s after %d attempts",
+                    saga_id, type(cmd).__name__, _RECORD_ATTEMPTS)
+        return False
+
+    async def _send(self, engine: Any, aggregate_id: str, cmd: Any,
+                    rid: str, timeout_s: float) -> Any:
+        ref = engine.aggregate_for(aggregate_id)
+        try:
+            return await cancel_safe_wait_for(
+                ref.send_command(cmd, request_id=rid), timeout_s)
+        except asyncio.TimeoutError as exc:
+            return CommandFailure(exc)
+        except (asyncio.CancelledError, SimulatedCrash):
+            raise
+        except Exception as exc:  # noqa: BLE001 — routing errors are retryable
+            return CommandFailure(exc)
+
+    async def _load(self, saga_id: str) -> Optional[SagaState]:
+        ref = self._refs.get(saga_id)
+        if ref is None:
+            ref = self._refs[saga_id] = self.engine.aggregate_for(saga_id)
+        try:
+            return await ref.get_state()
+        except Exception:  # noqa: BLE001 — transient; the driver re-polls
+            return None
+
+    def _all_states(self) -> Iterator[Tuple[str, SagaState]]:
+        indexer = getattr(self.engine, "indexer", None)
+        if indexer is None:
+            return
+        state_format = self.engine.logic.state_format
+        for key, data in indexer.store.all_items():
+            try:
+                st = state_format.read_state(data)
+            except Exception:  # noqa: BLE001 — foreign rows are skipped
+                continue
+            if isinstance(st, SagaState):
+                yield key, st
+
+    def _finish(self, saga_id: str, state: SagaState) -> None:
+        if saga_id in self._counted:
+            return
+        self._counted.add(saga_id)
+        self._record_flight("saga.terminal", saga_id=saga_id,
+                            status=STATUS_NAMES[state.status],
+                            committed=state.committed,
+                            compensated=state.compensated)
+        m = self.metrics
+        if m is None:
+            return
+        if state.status == COMPLETED:
+            m.saga_completed.record(1)
+        elif state.status == DEAD_LETTER:
+            m.saga_dead_letter.record(1)
+        else:
+            m.saga_compensated.record(1)
+
+    def _plane(self) -> Any:
+        if self.faults is not None:
+            return self.faults
+        return getattr(getattr(self.engine, "log", None), "faults", None)
+
+    def _point(self, site: str) -> None:
+        plane = self._plane()
+        if plane is not None:
+            plane.point(site)
+
+    def _crash(self, name: str) -> None:
+        plane = self._plane()
+        if plane is not None:
+            plane.crash_point(name)
+
+    def _gauge_active(self) -> None:
+        if self.metrics is not None:
+            self.metrics.saga_active.record(float(len(self._drivers)))
+
+    def _time_step(self, ms: float) -> None:
+        if self.metrics is not None:
+            self.metrics.saga_step_timer.record_ms(ms)
+
+    def _record_flight(self, etype: str, **fields: Any) -> None:
+        if self.flight is not None:
+            self.flight.record(etype, **fields)

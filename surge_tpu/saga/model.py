@@ -1,0 +1,398 @@
+"""The saga bounded context — saga state IS an aggregate.
+
+The process-manager's whole durability story is that a saga's progress lives
+in an ordinary aggregate family: every transition is an event published
+through the transactional publisher, state replays through the TPU replay
+plane (scratch replay and the resident plane's incremental fold are
+byte-identical — tests/test_saga_replay.py), and recovery after a manager
+restart is nothing but reading the replayed state back (no side journal).
+
+The state is deliberately ALL-NUMERIC so the family stays on the tensor
+path: step progress is a pair of bitmasks (``committed`` / ``compensated``,
+capped at :data:`MAX_STEPS` steps), the definition is referenced by its
+registered ``def_id``, and the only free-form payload is four float32
+context slots (``c0..c3``) the definition's command factories interpret.
+Anything stringly (target aggregate ids, poison markers) must be derived
+from the saga id + context by the :class:`~surge_tpu.saga.definition.
+SagaDefinition`'s callables — which is exactly what makes resumption pure:
+the next action is a function of replayed state alone.
+
+Status machine::
+
+    RUNNING --step n committed--> RUNNING (step=n+1)   [all committed -> COMPLETED]
+    RUNNING --step n failed-----> COMPENSATING         [nothing committed -> COMPENSATED]
+    COMPENSATING --comp n-------> COMPENSATING         [all committed compensated -> COMPENSATED]
+    COMPENSATING --comp exhausted-> DEAD_LETTER
+
+``COMPLETED`` / ``COMPENSATED`` / ``DEAD_LETTER`` are terminal. The
+ledger-reconciliation invariant (cluster/soak.py saga arm, chaos.py sagas):
+every terminal saga has either ALL steps committed and none compensated, or
+ALL committed steps compensated — dead-lettered sagas are the operator's
+queue and are reported separately, never silently counted as reconciled.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from surge_tpu.codec.schema import SchemaRegistry
+from surge_tpu.engine.model import RejectedCommand, ReplayHandlers, ReplaySpec
+from surge_tpu.serialization import (JsonCommandFormatting, JsonEventFormatting,
+                                     JsonFormatting)
+
+#: step-index cap: progress bitmasks live in one int32 state column
+MAX_STEPS = 30
+
+#: status enum (int32 state column; 0 must be RUNNING so the replay plane's
+#: zero-initialized row folds correctly from the SagaStarted event)
+RUNNING, COMPENSATING, COMPLETED, COMPENSATED, DEAD_LETTER = 0, 1, 2, 3, 4
+
+STATUS_NAMES = {RUNNING: "running", COMPENSATING: "compensating",
+                COMPLETED: "completed", COMPENSATED: "compensated",
+                DEAD_LETTER: "dead-letter"}
+
+TERMINAL = frozenset((COMPLETED, COMPENSATED, DEAD_LETTER))
+
+
+# --- domain types -------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SagaState:
+    aggregate_id: str
+    def_id: int
+    num_steps: int
+    status: int
+    step: int          # next forward step index while RUNNING
+    committed: int     # bitmask of committed forward steps
+    compensated: int   # bitmask of compensated steps
+    attempts: int      # attempts burned on the failing step (observability)
+    c0: float
+    c1: float
+    c2: float
+    c3: float
+    version: int
+
+
+@dataclass(frozen=True)
+class StartSaga:
+    aggregate_id: str
+    def_id: int
+    num_steps: int
+    c0: float = 0.0
+    c1: float = 0.0
+    c2: float = 0.0
+    c3: float = 0.0
+
+
+@dataclass(frozen=True)
+class RecordStepCommitted:
+    aggregate_id: str
+    step: int
+
+
+@dataclass(frozen=True)
+class RecordStepFailed:
+    aggregate_id: str
+    step: int
+    attempts: int
+
+
+@dataclass(frozen=True)
+class RecordStepCompensated:
+    aggregate_id: str
+    step: int
+
+
+@dataclass(frozen=True)
+class RecordDeadLetter:
+    aggregate_id: str
+    step: int
+
+
+@dataclass(frozen=True)
+class SagaStarted:
+    aggregate_id: str
+    def_id: int
+    num_steps: int
+    c0: float
+    c1: float
+    c2: float
+    c3: float
+    sequence_number: int
+
+
+@dataclass(frozen=True)
+class SagaStepCommitted:
+    aggregate_id: str
+    step: int
+    sequence_number: int
+
+
+@dataclass(frozen=True)
+class SagaStepFailed:
+    aggregate_id: str
+    step: int
+    attempts: int
+    sequence_number: int
+
+
+@dataclass(frozen=True)
+class SagaStepCompensated:
+    aggregate_id: str
+    step: int
+    sequence_number: int
+
+
+@dataclass(frozen=True)
+class SagaDeadLettered:
+    aggregate_id: str
+    step: int
+    sequence_number: int
+
+
+def _full_mask(num_steps: int) -> int:
+    return (1 << num_steps) - 1
+
+
+# --- scalar model --------------------------------------------------------------------
+
+
+class SagaModel:
+    """Command/fold model for the saga aggregate family.
+
+    Every Record* command is IDEMPOTENT-BY-REJECTION: re-recording an
+    already-recorded transition rejects instead of emitting a duplicate
+    event, so the manager's deterministic re-delivery after a crash can
+    treat ``CommandRejected`` on a record as "already done, move on"."""
+
+    def initial_state(self, aggregate_id: str) -> Optional[SagaState]:
+        return None
+
+    def process_command(self, state: Optional[SagaState], command) -> Sequence[object]:
+        seq = (state.version if state else 0) + 1
+        if isinstance(command, StartSaga):
+            if state is not None:
+                raise RejectedCommand("saga already started")
+            if not 1 <= command.num_steps <= MAX_STEPS:
+                raise RejectedCommand(
+                    f"num_steps must be 1..{MAX_STEPS}, got {command.num_steps}")
+            return [SagaStarted(command.aggregate_id, command.def_id,
+                                command.num_steps, command.c0, command.c1,
+                                command.c2, command.c3, seq)]
+        if state is None:
+            raise RejectedCommand("saga not started")
+        if isinstance(command, RecordStepCommitted):
+            if state.status != RUNNING:
+                raise RejectedCommand(
+                    f"saga is {STATUS_NAMES[state.status]}, not running")
+            if command.step != state.step or state.committed & (1 << command.step):
+                raise RejectedCommand(
+                    f"step {command.step} is not the pending step "
+                    f"(pending={state.step})")
+            return [SagaStepCommitted(command.aggregate_id, command.step, seq)]
+        if isinstance(command, RecordStepFailed):
+            if state.status != RUNNING:
+                raise RejectedCommand(
+                    f"saga is {STATUS_NAMES[state.status]}, not running")
+            if command.step != state.step:
+                raise RejectedCommand(
+                    f"step {command.step} is not the pending step "
+                    f"(pending={state.step})")
+            return [SagaStepFailed(command.aggregate_id, command.step,
+                                   command.attempts, seq)]
+        if isinstance(command, RecordStepCompensated):
+            if state.status != COMPENSATING:
+                raise RejectedCommand(
+                    f"saga is {STATUS_NAMES[state.status]}, not compensating")
+            bit = 1 << command.step
+            if not state.committed & bit:
+                raise RejectedCommand(f"step {command.step} never committed")
+            if state.compensated & bit:
+                raise RejectedCommand(f"step {command.step} already compensated")
+            return [SagaStepCompensated(command.aggregate_id, command.step, seq)]
+        if isinstance(command, RecordDeadLetter):
+            if state.status in TERMINAL:
+                raise RejectedCommand(
+                    f"saga is already terminal ({STATUS_NAMES[state.status]})")
+            return [SagaDeadLettered(command.aggregate_id, command.step, seq)]
+        raise RejectedCommand(f"unknown command {command!r}")
+
+    def handle_event(self, state: Optional[SagaState], event) -> Optional[SagaState]:
+        if isinstance(event, SagaStarted):
+            return SagaState(event.aggregate_id, event.def_id, event.num_steps,
+                             RUNNING, 0, 0, 0, 0, event.c0, event.c1,
+                             event.c2, event.c3, event.sequence_number)
+        if state is None:
+            return None  # orphan record event: nothing to fold onto
+        if isinstance(event, SagaStepCommitted):
+            committed = state.committed | (1 << event.step)
+            done = committed == _full_mask(state.num_steps)
+            return SagaState(state.aggregate_id, state.def_id, state.num_steps,
+                             COMPLETED if done else RUNNING,
+                             event.step + 1, committed, state.compensated, 0,
+                             state.c0, state.c1, state.c2, state.c3,
+                             event.sequence_number)
+        if isinstance(event, SagaStepFailed):
+            nothing_committed = state.committed == 0
+            return SagaState(state.aggregate_id, state.def_id, state.num_steps,
+                             COMPENSATED if nothing_committed else COMPENSATING,
+                             state.step, state.committed, state.compensated,
+                             event.attempts, state.c0, state.c1, state.c2,
+                             state.c3, event.sequence_number)
+        if isinstance(event, SagaStepCompensated):
+            compensated = state.compensated | (1 << event.step)
+            done = compensated == state.committed
+            return SagaState(state.aggregate_id, state.def_id, state.num_steps,
+                             COMPENSATED if done else COMPENSATING,
+                             state.step, state.committed, compensated,
+                             state.attempts, state.c0, state.c1, state.c2,
+                             state.c3, event.sequence_number)
+        if isinstance(event, SagaDeadLettered):
+            return SagaState(state.aggregate_id, state.def_id, state.num_steps,
+                             DEAD_LETTER, state.step, state.committed,
+                             state.compensated, state.attempts, state.c0,
+                             state.c1, state.c2, state.c3,
+                             event.sequence_number)
+        return state
+
+    # -- TPU replay contract ----------------------------------------------------------
+    def replay_spec(self) -> ReplaySpec:
+        return make_replay_spec()
+
+
+# --- tensor schemas + JAX fold -------------------------------------------------------
+
+STARTED, STEP_COMMITTED, STEP_FAILED, STEP_COMPENSATED, DEAD_LETTERED = \
+    0, 1, 2, 3, 4
+
+
+def make_registry() -> SchemaRegistry:
+    reg = SchemaRegistry()
+    reg.register_event(SagaStarted, type_id=STARTED, exclude=("aggregate_id",))
+    reg.register_event(SagaStepCommitted, type_id=STEP_COMMITTED,
+                       exclude=("aggregate_id",), bits={"step": 5})
+    reg.register_event(SagaStepFailed, type_id=STEP_FAILED,
+                       exclude=("aggregate_id",), bits={"step": 5})
+    reg.register_event(SagaStepCompensated, type_id=STEP_COMPENSATED,
+                       exclude=("aggregate_id",), bits={"step": 5})
+    reg.register_event(SagaDeadLettered, type_id=DEAD_LETTERED,
+                       exclude=("aggregate_id",), bits={"step": 5})
+    reg.register_state(SagaState, exclude=("aggregate_id",))
+    return reg
+
+
+def make_replay_spec() -> ReplaySpec:
+    """The saga fold in batched tensor form — every branch of
+    ``handle_event`` as masked int32 arithmetic (bitmask progress makes the
+    status transitions pure compares, no data-dependent control flow)."""
+    import jax.numpy as jnp
+
+    def _shift(step):
+        return jnp.left_shift(jnp.int32(1), step.astype(jnp.int32))
+
+    def started(s, f):
+        return {"def_id": f["def_id"], "num_steps": f["num_steps"],
+                "status": jnp.full_like(f["num_steps"], RUNNING),
+                "step": jnp.zeros_like(f["num_steps"]),
+                "committed": jnp.zeros_like(f["num_steps"]),
+                "compensated": jnp.zeros_like(f["num_steps"]),
+                "attempts": jnp.zeros_like(f["num_steps"]),
+                "c0": f["c0"], "c1": f["c1"], "c2": f["c2"], "c3": f["c3"],
+                "version": f["sequence_number"]}
+
+    def step_committed(s, f):
+        committed = s["committed"] | _shift(f["step"])
+        full = jnp.left_shift(jnp.int32(1), s["num_steps"]) - 1
+        done = committed == full
+        return {"committed": committed,
+                "status": jnp.where(done, COMPLETED, RUNNING)
+                    .astype(s["status"].dtype),
+                "step": (f["step"] + 1).astype(s["step"].dtype),
+                "attempts": jnp.zeros_like(s["attempts"]),
+                "version": f["sequence_number"]}
+
+    def step_failed(s, f):
+        nothing = s["committed"] == 0
+        return {"status": jnp.where(nothing, COMPENSATED, COMPENSATING)
+                    .astype(s["status"].dtype),
+                "attempts": f["attempts"].astype(s["attempts"].dtype),
+                "version": f["sequence_number"]}
+
+    def step_compensated(s, f):
+        compensated = s["compensated"] | _shift(f["step"])
+        done = compensated == s["committed"]
+        return {"compensated": compensated,
+                "status": jnp.where(done, COMPENSATED, COMPENSATING)
+                    .astype(s["status"].dtype),
+                "version": f["sequence_number"]}
+
+    def dead_lettered(s, f):
+        return {"status": jnp.full_like(s["status"], DEAD_LETTER),
+                "version": f["sequence_number"]}
+
+    return ReplaySpec(
+        registry=make_registry(),
+        handlers=ReplayHandlers({STARTED: started,
+                                 STEP_COMMITTED: step_committed,
+                                 STEP_FAILED: step_failed,
+                                 STEP_COMPENSATED: step_compensated,
+                                 DEAD_LETTERED: dead_lettered}),
+        init_record={"def_id": 0, "num_steps": 0, "status": RUNNING,
+                     "step": 0, "committed": 0, "compensated": 0,
+                     "attempts": 0, "c0": 0.0, "c1": 0.0, "c2": 0.0,
+                     "c3": 0.0, "version": 0},
+    )
+
+
+# --- byte formats --------------------------------------------------------------------
+
+_EVENT_TYPES = {c.__name__: c for c in (SagaStarted, SagaStepCommitted,
+                                        SagaStepFailed, SagaStepCompensated,
+                                        SagaDeadLettered)}
+_COMMAND_TYPES = {c.__name__: c for c in (StartSaga, RecordStepCommitted,
+                                          RecordStepFailed,
+                                          RecordStepCompensated,
+                                          RecordDeadLetter)}
+
+
+def _to_tagged_dict(obj) -> dict:
+    d = {k: getattr(obj, k) for k in obj.__dataclass_fields__}
+    d["_type"] = type(obj).__name__
+    return d
+
+
+def _from_tagged_dict(type_map: dict, d: dict):
+    d = dict(d)
+    return type_map[d.pop("_type")](**d)
+
+
+def event_formatting() -> JsonEventFormatting:
+    return JsonEventFormatting(
+        to_dict=_to_tagged_dict,
+        from_dict=lambda d: _from_tagged_dict(_EVENT_TYPES, d),
+        key_of=lambda e: e.aggregate_id)
+
+
+def command_formatting() -> JsonCommandFormatting:
+    return JsonCommandFormatting(
+        to_dict=_to_tagged_dict,
+        from_dict=lambda d: _from_tagged_dict(_COMMAND_TYPES, d))
+
+
+def state_formatting() -> JsonFormatting:
+    return JsonFormatting(
+        to_dict=lambda s: {k: getattr(s, k) for k in s.__dataclass_fields__},
+        from_dict=lambda d: SagaState(**d))
+
+
+def make_saga_logic(aggregate_name: str = "saga"):
+    """The saga family's :class:`SurgeCommandBusinessLogic` bundle — hand it
+    to ``create_engine`` to host saga state like any other aggregate."""
+    from surge_tpu.engine.business_logic import SurgeCommandBusinessLogic
+
+    return SurgeCommandBusinessLogic(
+        aggregate_name=aggregate_name, model=SagaModel(),
+        state_format=state_formatting(), event_format=event_formatting(),
+        command_format=command_formatting())

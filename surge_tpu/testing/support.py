@@ -30,8 +30,55 @@ from surge_tpu.engine.entity import (
 )
 from surge_tpu.engine.model import fold_events
 
-__all__ = ["StubAggregateRef", "StubEngine", "assert_replay_matches_scalar",
-           "random_counter_log", "random_cart_log", "random_bank_log"]
+__all__ = ["StubAggregateRef", "StubEngine", "ZipfKeys",
+           "assert_replay_matches_scalar", "random_counter_log",
+           "random_cart_log", "random_bank_log", "random_saga_log"]
+
+
+class ZipfKeys:
+    """Seedable Zipf-skewed key sampler (production-shaped workloads,
+    ROADMAP 5(a)): key rank ``r`` (1-based) is drawn with probability
+    ``r**-s / H``, so a handful of hot keys dominate while the tail stays
+    long — the shape the saga soak, the autobalancer, and the workload
+    generator all need.
+
+    ::
+
+        keys = ZipfKeys(rng, n=1_000, s=1.1, prefix="acct-")
+        keys.draw()   # -> "acct-0" ~7% of the time at n=1000, s=1.1
+
+    The cumulative table is precomputed once (O(n)); ``draw`` is a binary
+    search (O(log n)).  ``rank()`` returns the raw 0-based rank for callers
+    composing their own key space.
+    """
+
+    def __init__(self, rng, n: int, s: float = 1.1,
+                 prefix: str = "key-") -> None:
+        if n <= 0:
+            raise ValueError("n must be positive")
+        self._rng = rng
+        self.n = n
+        self.s = s
+        self.prefix = prefix
+        acc, cum = 0.0, []
+        for rank in range(1, n + 1):
+            acc += rank ** -s
+            cum.append(acc)
+        self._cum = cum
+        self._total = acc
+
+    def rank(self) -> int:
+        """0-based rank: 0 is the hottest key."""
+        import bisect
+
+        return bisect.bisect_left(self._cum, self._rng.random() * self._total)
+
+    def draw(self) -> str:
+        return f"{self.prefix}{self.rank()}"
+
+    def pmf(self, rank0: int) -> float:
+        """The exact probability of 0-based ``rank0`` (distribution tests)."""
+        return (rank0 + 1) ** -self.s / self._total
 
 
 # --------------------------------------------------------------------------------------
@@ -108,6 +155,52 @@ def random_bank_log(rng, agg: str) -> list:
             log.append(bank_account.BankAccountUpdated(agg, bal))
     else:
         log.append(bank_account.BankAccountUpdated(agg, 42.0))  # orphan
+    return log
+
+
+def random_saga_log(rng, agg: str) -> list:
+    """A saga-family event log via the REAL command path: started, then a
+    random walk of step commits / a failure flipping to compensation /
+    compensations in reverse, sometimes ending in the dead letter —
+    exercising every status transition the replay handlers fold."""
+    from surge_tpu.saga import model as saga
+
+    m = saga.SagaModel()
+    state, log = None, []
+
+    def run(cmd):
+        nonlocal state
+        try:
+            events = m.process_command(state, cmd)
+        except Exception:  # noqa: BLE001 — rejected command, caller moves on
+            return False
+        for e in events:
+            state = m.handle_event(state, e)
+            log.append(e)
+        return True
+
+    if rng.random() < 0.9:
+        num_steps = rng.randrange(1, 7)
+        run(saga.StartSaga(agg, def_id=rng.randrange(1, 4),
+                           num_steps=num_steps, c0=float(rng.randrange(100)),
+                           c1=float(rng.randrange(2))))
+        while state is not None and state.status == saga.RUNNING:
+            if rng.random() < 0.75:
+                run(saga.RecordStepCommitted(agg, state.step))
+            else:
+                run(saga.RecordStepFailed(agg, state.step,
+                                          rng.randrange(1, 5)))
+                break
+            if rng.random() < 0.15:
+                break  # leave some sagas in flight mid-run
+        while state is not None and state.status == saga.COMPENSATING:
+            pending = state.committed & ~state.compensated
+            if rng.random() < 0.1:
+                run(saga.RecordDeadLetter(agg, pending.bit_length() - 1))
+                break
+            run(saga.RecordStepCompensated(agg, pending.bit_length() - 1))
+            if rng.random() < 0.1:
+                break  # mid-compensation in-flight rows too
     return log
 
 
@@ -207,6 +300,7 @@ class StubAggregateRef:
             init = getattr(model, "initial_state", None)
             self._states[aggregate_id] = init(aggregate_id) if init else None
         self.commands: List[Any] = []
+        self.request_ids: List[Optional[str]] = []
         self.applied: List[Sequence[Any]] = []
         self._canned: List[Any] = []
 
@@ -235,8 +329,13 @@ class StubAggregateRef:
 
     # -- AggregateRef surface ---------------------------------------------------------
 
-    async def send_command(self, command: Any):
+    async def send_command(self, command: Any, *,
+                           request_id: Optional[str] = None):
+        # request_id is accepted for signature parity with the real ref (the
+        # saga manager passes its deterministic rids); the stub has no
+        # publisher dedup window, so it is recorded and otherwise ignored
         self.commands.append(command)
+        self.request_ids.append(request_id)
         if self._journal is not None:
             self._journal.append(command)
         if self._canned:

@@ -178,3 +178,73 @@ def test_admin_dump_traces_round_trip():
         await channel2.close()
 
     asyncio.run(scenario())
+
+
+def test_admin_saga_rpcs_round_trip():
+    """StartSaga / SagaStatus over the admin plane: start a transfer saga by
+    RPC, poll its ledger to terminal, read the fleet summary with the
+    reconciliation verdict — and get typed errors for an unknown definition
+    and a clean 'unknown' status for a never-started id."""
+    import time as _time
+
+    import pytest
+    from surge_tpu.log import InMemoryLog
+    from surge_tpu.models.counter import Decrement, Increment
+    from surge_tpu.saga import (SagaDefinition, SagaManager, SagaStep,
+                                make_saga_logic)
+
+    transfer = SagaDefinition(
+        name="transfer", def_id=1,
+        steps=(
+            SagaStep("debit", participant="acct",
+                     target=lambda sid, s: sid.split(":")[1],
+                     command=lambda tid, s: Decrement(tid),
+                     compensation=lambda tid, s: Increment(tid)),
+            SagaStep("credit", participant="acct",
+                     target=lambda sid, s: sid.split(":")[2],
+                     command=lambda tid, s: Increment(tid),
+                     compensation=lambda tid, s: Decrement(tid)),
+        ))
+
+    async def scenario():
+        log = InMemoryLog()
+        acct = create_engine(make_logic(), log=log, config=CFG)
+        saga_cfg = CFG.with_overrides({"surge.saga.poll-interval-ms": 10})
+        saga = create_engine(make_saga_logic(), log=log, config=saga_cfg)
+        mgr = SagaManager(saga, [transfer],
+                          {"acct": acct, "saga": saga}, config=saga_cfg)
+        saga.register_saga_manager(mgr)
+        await acct.start()
+        await saga.start()
+        admin = AdminServer(saga)
+        port = await admin.start()
+        channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        client = AdminClient(channel)
+        try:
+            st = await client.start_saga("t:alice:bob:1", "transfer")
+            assert st["saga_id"] == "t:alice:bob:1"
+            deadline = _time.monotonic() + 20
+            while st["status"] not in ("completed", "compensated",
+                                       "dead-letter"):
+                assert _time.monotonic() < deadline, st
+                await asyncio.sleep(0.02)
+                st = await client.saga_status("t:alice:bob:1")
+            assert st["status"] == "completed"
+            assert st["committed"] == [0, 1] and st["compensated"] == []
+
+            summary = await client.saga_status()
+            assert summary["ok"] and summary["total"] == 1
+            assert summary["counts"]["completed"] == 1
+            assert summary["violations"] == []
+
+            assert (await client.saga_status("never-started"))["status"] \
+                == "unknown"
+            with pytest.raises(RuntimeError, match="unknown saga definition"):
+                await client.start_saga("t:x:y:1", "no-such-definition")
+        finally:
+            await channel.close()
+            await admin.stop()
+            await saga.stop()
+            await acct.stop()
+
+    asyncio.run(scenario())

@@ -257,3 +257,40 @@ def test_bench_views_paired_smoke():
     assert rung["speedup_median"] > 1, \
         "a materialized view must beat a scan-per-read on medians"
     assert payload["value"] == rung["view_read"]["reads_per_sec_median"]
+
+
+def test_bench_saga_storm_smoke():
+    """SURGE_BENCH_SAGA=1 dispatch: one tiny seeded storm through the bench
+    entrypoint — the JSON payload carries the three-zeros verdict keys the
+    driver's last-line-wins parse gates on."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SURGE_BENCH_SAGA": "1",
+        "SURGE_BENCH_SAGA_SEEDS": "31",
+        "SURGE_BENCH_SAGA_SECONDS": "5",
+        "SURGE_BENCH_SAGA_COUNT": "8",
+        "SURGE_BENCH_SAGA_ACCOUNTS": "6",
+        "SURGE_BENCH_SAGA_PARTITIONS": "4",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON payload on stdout: {proc.stdout!r}"
+    payload = json.loads(lines[-1])
+    assert payload["metric"] == "saga_started"
+    for key in ("saga_rounds", "saga_seeds", "saga_started", "saga_poisoned",
+                "saga_lost", "saga_duplicated", "saga_half_compensated",
+                "saga_dead_letter", "saga_verdict"):
+        assert key in payload, f"{key} missing from the saga payload"
+    assert payload["saga_seeds"] == [31]
+    assert payload["saga_started"] == 8
+    assert payload["saga_verdict"] == \
+        "ok: 0 lost / 0 duplicated / 0 half-compensated"
+    assert payload["saga_lost"] == 0 and payload["saga_duplicated"] == 0
+    assert payload["saga_half_compensated"] == 0
+    round0 = payload["saga_rounds"][0]
+    assert round0["reconcile"]["ok"] and round0["timeline_events"] > 0

@@ -560,3 +560,249 @@ def test_selfheal_soak_long_randomized():
         assert verdict["lost"] == 0 and verdict["duplicated"] == 0, verdict
         assert verdict["leaders"]["ok"] and verdict["converged"], verdict
         assert verdict["slo_pages"]["cleared"], verdict["slo_pages"]
+
+
+# -- the routed pipelined window (ROADMAP 4(b)) ---------------------------------------
+
+
+def test_routed_producer_pipelined_window_exactly_once_across_handoff():
+    """PR-3's bounded in-flight window must survive the router: a window of
+    commit_pipelined dispatches ships WITHOUT awaiting earlier replies, and
+    a handle failed by a partition move retries onto the new leader via
+    retry_pipelined — exactly once, no window collapse to depth 1."""
+    leader, (f1, f2), addrs, view, cfg = _spread_trio()
+    router = PartitionRouter(",".join(addrs), config=cfg)
+    try:
+        assign = view["assignments"]
+        src_addr = [a for a in set(assign.values()) if a != addrs[0]][0]
+        moving = int([p for p, a in assign.items() if a == src_addr][0])
+        dst_addr = [a for a in addrs if a != src_addr][0]
+
+        producer = router.transactional_producer("t-window")
+        # the whole window dispatches before ANY reply is awaited
+        handles = []
+        for i in range(6):
+            producer.begin()
+            producer.send(rec("ev", f"k{moving}", b"win-%d" % i, moving))
+            handles.append(producer.commit_pipelined())
+        for i, h in enumerate(handles):
+            committed = h.future.result(timeout=15)
+            assert [r.value for r in committed] == [b"win-%d" % i]
+
+        # move the slice out from under the producer's cached leader
+        src = GrpcLogTransport(src_addr, config=cfg)
+        stats = src.cluster_handoff(dst_addr, moving)
+        src.close()
+        assert stats["to"] == dst_addr
+
+        # the next pipelined dispatch fails on the old leader; the retry
+        # ladder re-resolves and re-dispatches on the new one
+        producer.begin()
+        producer.send(rec("ev", f"k{moving}", b"post-move", moving))
+        h = producer.commit_pipelined()
+        deadline = time.monotonic() + 20
+        while True:
+            try:
+                h.future.result(timeout=15)
+                break
+            except Exception:  # noqa: BLE001 — fenced/not-leader mid-move
+                assert time.monotonic() < deadline, "retry never landed"
+                time.sleep(0.05)
+                h = producer.retry_pipelined(h)
+
+        # exactly once on the NEW leader's log, window order preserved
+        dst = [s for s in (leader, f1, f2) if s.advertised == dst_addr][0]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if len(dst.log.read("ev", moving)) >= 7:
+                break
+            time.sleep(0.05)
+        values = [r.value for r in dst.log.read("ev", moving)]
+        expected = [b"win-%d" % i for i in range(6)] + [b"post-move"]
+        for payload in expected:
+            assert values.count(payload) == 1, (payload, values)
+        assert values[:6] == expected[:6]
+    finally:
+        router.close()
+        _stop_all(leader, f1, f2)
+
+
+def test_engine_over_router_keeps_pipelined_window():
+    """The ROADMAP 4(b) regression guard at the engine layer: a publisher
+    lane over a RoutedProducer must stay pipeline-capable (the old facade
+    lacked commit_pipelined, silently degrading every routed lane to
+    max-in-flight 1). Under a per-Transact broker delay, concurrent
+    commands on one partition must overlap in flight — inflight_peak >= 2
+    is impossible at depth 1."""
+    import asyncio
+
+    from surge_tpu import create_engine
+    from surge_tpu.models import counter
+    from surge_tpu.models.counter import CounterModel
+
+    leader, (f1, f2), addrs, view, cfg0 = _spread_trio()
+    cfg = Config(overrides={
+        **cfg0.overrides,
+        "surge.engine.num-partitions": 4,
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 20,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.producer.max-in-flight": 4,
+    })
+    router = PartitionRouter(",".join(addrs), config=cfg)
+    try:
+        # the unit-level regression check: the routed producer exposes the
+        # pipelined protocol the publisher's capability probe looks for
+        assert hasattr(router.transactional_producer("t-cap"),
+                       "commit_pipelined")
+
+        from surge_tpu import SurgeCommandBusinessLogic
+
+        logic = SurgeCommandBusinessLogic(
+            aggregate_name="counter", model=CounterModel(),
+            state_format=counter.state_formatting(),
+            event_format=counter.event_formatting())
+
+        async def scenario():
+            engine = create_engine(logic, log=router, config=cfg)
+            await engine.start()
+            try:
+                # 12 aggregates all hashing to ONE partition → one lane
+                part = engine.router.partition_for("w-0")
+                aggs, i = [], 0
+                while len(aggs) < 12:
+                    if engine.router.partition_for(f"w-{i}") == part:
+                        aggs.append(f"w-{i}")
+                    i += 1
+                # warm the lane, then slow every Transact on the slice
+                # leader so dispatched batches provably overlap
+                r = await engine.aggregate_for(aggs[0]).send_command(
+                    counter.Increment(aggs[0]))
+                assert type(r).__name__ == "CommandSuccess", r
+                owner = view["assignments"][str(part)]
+                tclient = GrpcLogTransport(owner, config=cfg)
+                try:
+                    tclient.arm_faults(json.dumps({"rules": [{
+                        "site": "rpc.Transact", "action": "delay",
+                        "p": 1.0, "times": 40, "delay_ms": 25.0}]}))
+
+                    async def one(agg, delay):
+                        await asyncio.sleep(delay)
+                        return await engine.aggregate_for(agg).send_command(
+                            counter.Increment(agg))
+
+                    results = await asyncio.gather(
+                        *(one(a, j * 0.008) for j, a in enumerate(aggs)))
+                finally:
+                    try:
+                        tclient.disarm_faults()
+                    finally:
+                        tclient.close()
+                for r in results:
+                    assert type(r).__name__ == "CommandSuccess", r
+                stats = engine.producer_stats()
+                assert stats["lanes"] >= 1
+                assert stats["inflight_peak"] >= 2, stats
+            finally:
+                await engine.stop()
+
+        asyncio.run(scenario())
+    finally:
+        router.close()
+        _stop_all(leader, f1, f2)
+
+
+# -- spread-aware compaction barrier --------------------------------------------------
+
+
+def test_spread_compaction_barrier_runs_on_slice_leader_under_live_load():
+    """Under an ACTIVE leadership spread the compaction barrier belongs to
+    the partition's SLICE leader — a broker whose whole-process role is
+    "follower" (the legacy role gate would refuse it). The barrier bounds
+    its pass to the led slice's in-sync frontier while OTHER partitions
+    keep committing, and a non-owner broker is refused with the owner's
+    address in the error."""
+    leader, (f1, f2), addrs, view, cfg = _spread_trio()
+    router = PartitionRouter(",".join(addrs), config=cfg)
+    try:
+        assign = view["assignments"]
+        setup = GrpcLogTransport(addrs[0], config=cfg)
+        setup.create_topic(TopicSpec("st", 4, compacted=True))
+        setup.close()
+        # a slice led by a follower-ROLE broker — the spread gate's point
+        p = int([q for q, a in assign.items() if a != addrs[0]][0])
+        servers = {s.advertised: s for s in (leader, f1, f2)}
+        slice_leader = servers[assign[str(p)]]
+        other = [s for a, s in servers.items() if a != assign[str(p)]][0]
+        q = int([r for r, a in assign.items()
+                 if a == other.advertised][0])
+
+        # dirty the compacted slice: 4 keys overwritten 6 rounds each
+        producer = router.transactional_producer("t-dirty")
+        for rnd in range(6):
+            for k in range(4):
+                deadline = time.monotonic() + 15
+                while True:
+                    try:
+                        producer.begin()
+                        producer.send(rec("st", f"key-{k}",
+                                          b"v%d-%d" % (rnd, k), p))
+                        producer.commit()
+                        break
+                    except Exception:  # noqa: BLE001 — topic still shipping
+                        assert time.monotonic() < deadline
+                        if producer.in_transaction:
+                            producer.abort()
+                        time.sleep(0.05)
+
+        # a live writer keeps ANOTHER partition committing through the
+        # barrier — the spread means the barrier never fences the fleet
+        stop = threading.Event()
+        side = {"acked": [], "error": None}
+
+        def writer():
+            r2 = PartitionRouter(",".join(addrs), config=cfg)
+            try:
+                i = 0
+                while not stop.is_set():
+                    side["acked"] += _commit_via(
+                        r2, cfg, "t-cb-live", q, [f"live-{i}".encode()])
+                    i += 1
+            except Exception as exc:  # noqa: BLE001
+                side["error"] = exc
+            finally:
+                r2.close()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        before = len(side["acked"])
+
+        # a non-owner (the coordinator included) is refused with the hint
+        with pytest.raises(RuntimeError) as exc:
+            other.compact_partition("st", p, tombstone_retention_s=0.0)
+        assert assign[str(p)] in str(exc.value)
+
+        # the slice leader compacts, barrier-bounded to its in-sync frontier
+        stats = slice_leader.compact_partition("st", p,
+                                               tombstone_retention_s=0.0)
+        assert stats.records_dropped > 0, stats
+        latest = {k: r.value
+                  for k, r in slice_leader.log.latest_by_key("st", p).items()}
+        assert latest == {f"key-{k}": b"v5-%d" % k for k in range(4)}
+        barrier = [e for e in slice_leader.flight.events()
+                   if e["type"] == "compaction.barrier"
+                   and e["partition"] == p]
+        assert barrier, "barrier leg missing from the slice leader's ring"
+        assert barrier[-1]["upto"] <= slice_leader.log.end_offset("st", p)
+
+        time.sleep(0.2)
+        stop.set()
+        t.join(30.0)
+        assert side["error"] is None, f"live writer died: {side['error']!r}"
+        assert len(side["acked"]) > before, \
+            "other partitions stopped committing across the barrier"
+    finally:
+        router.close()
+        _stop_all(leader, f1, f2)

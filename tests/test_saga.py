@@ -1,0 +1,358 @@
+"""Saga orchestration (ISSUE 19): the SagaDefinition DSL, the SagaModel
+state machine, and the supervised SagaManager driving multi-aggregate
+workflows exactly-once — happy path, rejection → reverse compensation,
+dead-letter parking, deterministic-request-id dedup across retries, manager
+restart resume, and the crash-point → supervisor-restart recovery leg."""
+
+import asyncio
+import time
+
+import pytest
+
+from surge_tpu import SurgeCommandBusinessLogic, create_engine
+from surge_tpu.config import Config
+from surge_tpu.engine.model import RejectedCommand
+from surge_tpu.log import InMemoryLog
+from surge_tpu.models import counter
+from surge_tpu.saga import (
+    COMPENSATED,
+    COMPLETED,
+    DEAD_LETTER,
+    RUNNING,
+    SagaDefinition,
+    SagaManager,
+    SagaStep,
+    compensation_request_id,
+    definition_index,
+    make_saga_logic,
+    step_request_id,
+)
+from surge_tpu.saga.model import (
+    RecordStepCommitted,
+    RecordStepCompensated,
+    RecordStepFailed,
+    SagaModel,
+    StartSaga,
+)
+from surge_tpu.testing.faults import FaultPlane
+
+CFG = Config(overrides={
+    "surge.producer.flush-interval-ms": 5,
+    "surge.producer.ktable-check-interval-ms": 5,
+    "surge.state-store.commit-interval-ms": 20,
+    "surge.aggregate.init-retry-interval-ms": 5,
+    "surge.saga.step-timeout-ms": 2_000,
+    "surge.saga.step-backoff-ms": 20,
+    "surge.saga.poll-interval-ms": 10,
+})
+
+TERMINAL_NAMES = ("completed", "compensated", "dead-letter")
+
+
+def _acct_logic():
+    return SurgeCommandBusinessLogic(
+        aggregate_name="acct", model=counter.CounterModel(),
+        state_format=counter.state_formatting(),
+        event_format=counter.event_formatting())
+
+
+def _transfer(poison_credit=False, poison_compensation=False):
+    """Two-step transfer keyed off the saga id (``t:{src}:{dst}:{n}``)."""
+    def credit_cmd(tid, s):
+        if poison_credit or s.c1 >= 1.0:
+            return counter.FailCommandProcessing(tid, "credit poisoned")
+        return counter.Increment(tid)
+
+    def debit_comp(tid, s):
+        if poison_compensation:
+            return counter.FailCommandProcessing(tid, "compensation poisoned")
+        return counter.Increment(tid)
+
+    return SagaDefinition(
+        name="transfer", def_id=1,
+        steps=(
+            SagaStep("debit", participant="acct",
+                     target=lambda sid, s: sid.split(":")[1],
+                     command=lambda tid, s: counter.Decrement(tid),
+                     compensation=debit_comp),
+            SagaStep("credit", participant="acct",
+                     target=lambda sid, s: sid.split(":")[2],
+                     command=credit_cmd,
+                     compensation=lambda tid, s: counter.Decrement(tid)),
+        ))
+
+
+async def _engines(definition, faults=None, register=True):
+    log = InMemoryLog()
+    acct = create_engine(_acct_logic(), log=log, config=CFG)
+    saga = create_engine(make_saga_logic(), log=log, config=CFG)
+    mgr = SagaManager(saga, [definition], {"acct": acct, "saga": saga},
+                      config=CFG, faults=faults)
+    if register:
+        saga.register_saga_manager(mgr)
+    await acct.start()
+    await saga.start()
+    return acct, saga, mgr
+
+
+async def _wait_terminal(mgr, sid, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    st = {}
+    while time.monotonic() < deadline:
+        st = await mgr.status(sid)
+        if st["status"] in TERMINAL_NAMES:
+            return st
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"saga {sid} never reached a terminal state: {st}")
+
+
+async def _count(acct, aid):
+    st = await acct.aggregate_for(aid).get_state()
+    return 0 if st is None else st.count
+
+
+# -- the model state machine ----------------------------------------------------------
+
+
+def _fold(model, state, events):
+    for e in events:
+        state = model.handle_event(state, e)
+    return state
+
+
+def test_model_happy_walk_reaches_completed():
+    m = SagaModel()
+    s = _fold(m, None, m.process_command(None, StartSaga("s1", 1, 2)))
+    assert s.status == RUNNING and s.step == 0 and s.num_steps == 2
+    s = _fold(m, s, m.process_command(s, RecordStepCommitted("s1", 0)))
+    assert s.status == RUNNING and s.step == 1 and s.committed == 0b01
+    s = _fold(m, s, m.process_command(s, RecordStepCommitted("s1", 1)))
+    assert s.status == COMPLETED and s.committed == 0b11 and s.compensated == 0
+
+
+def test_model_failure_walk_compensates_committed_bits():
+    m = SagaModel()
+    s = _fold(m, None, m.process_command(None, StartSaga("s2", 1, 3)))
+    s = _fold(m, s, m.process_command(s, RecordStepCommitted("s2", 0)))
+    s = _fold(m, s, m.process_command(s, RecordStepCommitted("s2", 1)))
+    s = _fold(m, s, m.process_command(s, RecordStepFailed("s2", 2, attempts=4)))
+    assert s.status != COMPLETED and s.committed == 0b011
+    s = _fold(m, s, m.process_command(s, RecordStepCompensated("s2", 1)))
+    assert s.status != COMPENSATED  # half-way is NOT terminal
+    s = _fold(m, s, m.process_command(s, RecordStepCompensated("s2", 0)))
+    assert s.status == COMPENSATED and s.compensated == s.committed
+
+
+def test_model_failure_with_nothing_committed_is_immediately_compensated():
+    m = SagaModel()
+    s = _fold(m, None, m.process_command(None, StartSaga("s3", 1, 2)))
+    s = _fold(m, s, m.process_command(s, RecordStepFailed("s3", 0, attempts=4)))
+    assert s.status == COMPENSATED and s.committed == 0 and s.compensated == 0
+
+
+def test_model_records_are_idempotent_by_rejection():
+    m = SagaModel()
+    s = _fold(m, None, m.process_command(None, StartSaga("s4", 1, 2)))
+    s = _fold(m, s, m.process_command(s, RecordStepCommitted("s4", 0)))
+    with pytest.raises(RejectedCommand):
+        m.process_command(s, RecordStepCommitted("s4", 0))  # already folded
+    with pytest.raises(RejectedCommand):
+        m.process_command(s, StartSaga("s4", 1, 2))  # already started
+    s = _fold(m, s, m.process_command(s, RecordStepFailed("s4", 1, attempts=2)))
+    s = _fold(m, s, m.process_command(s, RecordStepCompensated("s4", 0)))
+    with pytest.raises(RejectedCommand):
+        m.process_command(s, RecordStepCompensated("s4", 0))
+    assert s.status == COMPENSATED
+
+
+def test_definition_validation_rejects_malformed_sagas():
+    step = SagaStep("a", participant="p", target=lambda sid, s: sid,
+                    command=lambda tid, s: None)
+    with pytest.raises(ValueError):
+        SagaDefinition(name="empty", def_id=1, steps=())
+    with pytest.raises(ValueError):
+        SagaDefinition(name="dup", def_id=1, steps=(step, step))
+    with pytest.raises(ValueError):
+        SagaDefinition(name="bad-id", def_id=0, steps=(step,))
+    d1 = SagaDefinition(name="a", def_id=7, steps=(step,))
+    d2 = SagaDefinition(name="b", def_id=7, steps=(step,))
+    with pytest.raises(ValueError):
+        definition_index([d1, d2])
+
+
+def test_request_ids_are_deterministic_and_distinct():
+    assert step_request_id("t:a:b:1", 0) == step_request_id("t:a:b:1", 0)
+    assert step_request_id("t:a:b:1", 0) != step_request_id("t:a:b:1", 1)
+    assert step_request_id("t:a:b:1", 0) != compensation_request_id("t:a:b:1", 0)
+
+
+# -- end to end over real engines -----------------------------------------------------
+
+
+def test_saga_happy_path_completes_exactly_once():
+    async def run():
+        acct, saga, mgr = await _engines(_transfer())
+        try:
+            await saga.start_saga("t:alice:bob:1", "transfer")
+            st = await _wait_terminal(mgr, "t:alice:bob:1")
+            assert st["status"] == "completed"
+            assert st["committed"] == [0, 1] and st["compensated"] == []
+            assert await _count(acct, "alice") == -1
+            assert await _count(acct, "bob") == 1
+            # idempotent re-start: the saga:{id}:start rid collapses the
+            # double submit; nothing moves twice
+            st2 = await saga.start_saga("t:alice:bob:1", "transfer")
+            assert st2["status"] == "completed"
+            assert await _count(acct, "bob") == 1
+            verdict = mgr.reconcile()
+            assert verdict["ok"] and verdict["total"] == 1
+            assert verdict["counts"]["completed"] == 1
+        finally:
+            await saga.stop()
+            await acct.stop()
+
+    asyncio.run(run())
+
+
+def test_rejected_step_compensates_in_reverse_and_nets_zero():
+    async def run():
+        acct, saga, mgr = await _engines(_transfer(poison_credit=True))
+        try:
+            await saga.start_saga("t:src:dst:9", "transfer")
+            st = await _wait_terminal(mgr, "t:src:dst:9")
+            assert st["status"] == "compensated"
+            assert st["committed"] == [0] and st["compensated"] == [0]
+            # the debit landed, then was undone; the credit never landed
+            assert await _count(acct, "src") == 0
+            assert await _count(acct, "dst") == 0
+            assert mgr.reconcile()["ok"]
+            types = [e["type"] for e in saga.flight.events()]
+            assert "saga.step.reject" in types
+            assert "saga.comp.commit" in types
+            assert "saga.terminal" in types
+        finally:
+            await saga.stop()
+            await acct.stop()
+
+    asyncio.run(run())
+
+
+def test_poisoned_compensation_parks_dead_letter():
+    async def run():
+        acct, saga, mgr = await _engines(
+            _transfer(poison_credit=True, poison_compensation=True))
+        try:
+            await saga.start_saga("t:a:b:3", "transfer")
+            st = await _wait_terminal(mgr, "t:a:b:3")
+            assert st["status"] == "dead-letter"
+            verdict = mgr.reconcile()
+            # DEAD_LETTER is the acknowledged exception: counted, not a
+            # reconciliation violation
+            assert verdict["ok"] and verdict["dead_letter"] == 1
+            types = [e["type"] for e in saga.flight.events()]
+            assert "saga.comp.reject" in types
+        finally:
+            await saga.stop()
+            await acct.stop()
+
+    asyncio.run(run())
+
+
+def test_entity_short_circuits_duplicate_request_ids():
+    """The dedup surface under every saga retry: a re-sent request id
+    answers from the publisher's completed window with the CURRENT state —
+    no second fold."""
+    async def run():
+        acct = create_engine(_acct_logic(), log=InMemoryLog(), config=CFG)
+        await acct.start()
+        try:
+            ref = acct.aggregate_for("k-1")
+            r1 = await ref.send_command(counter.Increment("k-1"),
+                                        request_id="saga:t:0:fwd")
+            r2 = await ref.send_command(counter.Increment("k-1"),
+                                        request_id="saga:t:0:fwd")
+            assert type(r1).__name__ == "CommandSuccess"
+            assert type(r2).__name__ == "CommandSuccess"
+            assert r2.state.count == 1  # folded once, answered twice
+            r3 = await ref.send_command(counter.Increment("k-1"),
+                                        request_id="saga:t:1:fwd")
+            assert r3.state.count == 2  # a fresh rid folds normally
+        finally:
+            await acct.stop()
+
+    asyncio.run(run())
+
+
+def test_manager_restart_resumes_in_flight_saga_exactly_once():
+    """Stop the manager mid-saga, then resume with a FRESH manager instance:
+    recovery is the replayed saga rows alone (no side journal), and the
+    deterministic rids make the re-sent leg a dedup hit, not a double
+    fold."""
+    async def run():
+        # a delay plane holds the first step long enough for stop() to land
+        plane = FaultPlane.from_spec(
+            '[{"site": "saga.step.dispatch", "action": "delay", '
+            '"p": 1.0, "delay_ms": 150.0, "times": 2}]')
+        log = InMemoryLog()
+        acct = create_engine(_acct_logic(), log=log, config=CFG)
+        saga = create_engine(make_saga_logic(), log=log, config=CFG)
+        mgr1 = SagaManager(saga, [_transfer()], {"acct": acct, "saga": saga},
+                           config=CFG, faults=plane)
+        await acct.start()
+        await saga.start()
+        try:
+            await mgr1.start()
+            await mgr1.start_saga("t:x:y:7", "transfer")
+            await mgr1.stop()  # driver dies mid-flight
+
+            mgr2 = SagaManager(saga, [_transfer()],
+                               {"acct": acct, "saga": saga}, config=CFG)
+            await mgr2.start()  # resume_in_flight scans the state store
+            try:
+                st = await _wait_terminal(mgr2, "t:x:y:7")
+                assert st["status"] == "completed"
+                assert await _count(acct, "x") == -1
+                assert await _count(acct, "y") == 1  # exactly once
+                assert mgr2.reconcile()["ok"]
+            finally:
+                await mgr2.stop()
+        finally:
+            await saga.stop()
+            await acct.stop()
+
+    asyncio.run(run())
+
+
+def test_crash_point_fires_supervisor_restart_and_stays_exactly_once():
+    """The torn spot: the step command COMMITTED on the participant but the
+    crash fires before RecordStepCommitted reaches the saga row. The health
+    supervisor restarts the manager; the resumed driver re-sends step 0
+    under the SAME rid — the participant answers from its dedup window, the
+    record goes through, and the account moves exactly once."""
+    async def run():
+        plane = FaultPlane.from_spec(
+            '[{"site": "crash.saga.record.step-committed", '
+            '"action": "crash", "p": 1.0, "times": 1}]')
+        log = InMemoryLog()
+        acct = create_engine(_acct_logic(), log=log, config=CFG)
+        saga = create_engine(make_saga_logic(), log=log, config=CFG)
+        mgr = SagaManager(saga, [_transfer()], {"acct": acct, "saga": saga},
+                          config=CFG, faults=plane)
+        saga.register_saga_manager(mgr)  # supervised: saga-manager.*fatal
+        await acct.start()
+        await saga.start()
+        try:
+            await saga.start_saga("t:p:q:5", "transfer")
+            st = await _wait_terminal(mgr, "t:p:q:5")
+            assert st["status"] == "completed"
+            assert await _count(acct, "p") == -1
+            assert await _count(acct, "q") == 1  # no duplicated step
+            types = [e["type"] for e in saga.flight.events()]
+            assert "saga.manager.crash" in types  # the crash is on the ring
+            assert types.count("saga.terminal") == 1
+            assert mgr.reconcile()["ok"]
+        finally:
+            await saga.stop()
+            await acct.stop()
+
+    asyncio.run(run())

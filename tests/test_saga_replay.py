@@ -1,0 +1,200 @@
+"""Saga replay goldens: the batched tensor fold of the saga state machine
+(make_replay_spec's masked-bitmask handlers) must agree with the scalar
+``SagaModel.handle_event`` fold on every status transition — dense cpu,
+8-device mesh-sharded resident tiles, and the device-resident plane across
+evictions and re-admissions, where the incrementally-folded row must come
+back byte-identical to a from-scratch replay of the same log."""
+
+import asyncio
+import random
+
+import numpy as np
+
+from surge_tpu.codec import encode_events
+from surge_tpu.config import Config, default_config
+from surge_tpu.engine.model import fold_events
+from surge_tpu.log import InMemoryLog, LogRecord, TopicSpec
+from surge_tpu.replay import ReplayEngine
+from surge_tpu.replay.resident_state import ResidentStatePlane
+from surge_tpu.saga import model as saga
+from surge_tpu.serialization import SerializedMessage
+from surge_tpu.store import InMemoryKeyValueStore
+from surge_tpu.store.restore import restore_from_events
+from surge_tpu.testing import assert_replay_matches_scalar
+from surge_tpu.testing.support import random_saga_log
+
+EVT = saga.event_formatting()
+STATE = saga.state_formatting()
+TOPIC = "saga-events"
+NPART = 4
+
+
+def random_saga_logs(n, seed=0, min_len=0):
+    rng = random.Random(seed)
+    logs = []
+    while len(logs) < n:
+        log = random_saga_log(rng, f"saga-{len(logs)}")
+        if len(log) >= min_len:
+            logs.append(log)
+    return logs
+
+
+def scalar_fold_states(logs):
+    m = saga.SagaModel()
+    return [fold_events(m, m.initial_state(f"saga-{i}"), log)
+            for i, log in enumerate(logs)]
+
+
+_FIELDS = ("def_id", "num_steps", "status", "step", "committed",
+           "compensated", "version")
+
+
+def assert_rows_match(res, expected):
+    for i, exp in enumerate(expected):
+        for f in _FIELDS:
+            want = getattr(exp, f) if exp is not None else 0
+            assert int(res.states[f][i]) == want, (i, f, exp)
+
+
+def test_saga_dense_golden_cpu():
+    logs = random_saga_logs(61, seed=3)
+    expected = scalar_fold_states(logs)
+    spec = saga.make_replay_spec()
+    eng = ReplayEngine(spec)
+    res = eng.replay_encoded(encode_events(spec.registry, logs))
+    assert res.num_events == sum(len(l) for l in logs)
+    assert_rows_match(res, expected)
+
+
+def test_saga_replay_matches_scalar_harness():
+    """The one-call testing harness over the saga family — the same check
+    every model family in testing/support.py gets."""
+    rng = random.Random(17)
+    logs = [random_saga_log(rng, str(i)) for i in range(40)]
+    assert_replay_matches_scalar(saga.SagaModel(), saga.make_replay_spec(),
+                                 logs)
+
+
+def test_saga_mesh_sharded_resident_golden(mesh8):
+    """The resident tile loop over an 8-device mesh, including a mid-log cut
+    with carried state: the saga bitmasks must survive the resume path."""
+    from surge_tpu.codec.tensor import encode_events_columnar
+
+    logs = random_saga_logs(213, seed=29)  # ragged, not device-aligned
+    expected = scalar_fold_states(logs)
+    spec = saga.make_replay_spec()
+    cfg = Config(overrides={"surge.replay.batch-size": 64,
+                            "surge.replay.time-chunk": 8})
+    eng = ReplayEngine(spec, config=cfg, mesh=mesh8)
+    colev = encode_events_columnar(spec.registry, logs)
+    res = eng.replay_resident_sharded(eng.prepare_resident_sharded(colev))
+    assert res.num_events == sum(len(l) for l in logs)
+    assert_rows_match(res, expected)
+
+    cut = [len(l) // 2 for l in logs]
+    first = encode_events_columnar(spec.registry,
+                                   [l[:c] for l, c in zip(logs, cut)])
+    second = encode_events_columnar(spec.registry,
+                                    [l[c:] for l, c in zip(logs, cut)])
+    r1 = eng.replay_resident_sharded(eng.prepare_resident_sharded(first))
+    r2 = eng.replay_resident_sharded(eng.prepare_resident_sharded(second),
+                                     init_carry=r1.states,
+                                     ordinal_base=np.asarray(cut, np.int32))
+    assert_rows_match(r2, expected)
+
+
+# -- the device-resident plane across evict / re-admit ---------------------------------
+
+
+def part_of(agg: str) -> int:
+    return int(agg.rsplit("-", 1)[1]) % NPART
+
+
+def append_events(log, events):
+    prod = log.transactional_producer("seed")
+    prod.begin()
+    for ev in events:
+        msg = EVT.write_event(ev)
+        prod.send(LogRecord(topic=TOPIC, partition=part_of(ev.aggregate_id),
+                            key=msg.key, value=msg.value))
+    prod.commit()
+
+
+def make_plane(log, *, capacity):
+    cfg = default_config().with_overrides({
+        "surge.replay.resident.capacity": capacity,
+        "surge.replay.resident.refresh-interval-ms": 10,
+        "surge.replay.batch-size": 16,
+        "surge.replay.time-chunk": 8,
+    })
+    return ResidentStatePlane(
+        log, TOPIC, saga.make_replay_spec(), config=cfg,
+        deserialize_event=lambda raw: EVT.read_event(
+            SerializedMessage(key="", value=raw)),
+        serialize_state=lambda a, s: STATE.write_state(s).value,
+        metrics=None)
+
+
+async def wait_caught_up(plane, timeout=20.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while plane.lag_records() > 0:
+        assert asyncio.get_running_loop().time() < deadline, \
+            f"refresh loop never caught up (lag {plane.lag_records()})"
+        await asyncio.sleep(0.02)
+
+
+def cold_restore_bytes(log):
+    """From-scratch replay over the same log on the cpu backend — the
+    byte-identity reference for the incrementally-folded resident rows."""
+    store = InMemoryKeyValueStore()
+    restore_from_events(
+        log, TOPIC, store,
+        deserialize_event=lambda raw: EVT.read_event(
+            SerializedMessage(key="", value=raw)),
+        serialize_state=lambda a, s: STATE.write_state(s).value,
+        model=saga.SagaModel(), replay_spec=saga.make_replay_spec(),
+        config=default_config().with_overrides({
+            "surge.replay.backend": "cpu"}))
+    return dict(store.all_items())
+
+
+def test_saga_resident_plane_byte_identity_across_evict_readmit():
+    """Three waves: seed 8 saga rows at a prefix of their logs, flood 8 new
+    rows through a capacity-8 slab (evicting the first set to spill at their
+    exact fold point), then land the first set's log suffixes so they
+    re-admit and finish folding incrementally. Every tracked row's
+    serialized state must equal the from-scratch replay byte for byte."""
+    async def scenario():
+        log = InMemoryLog()
+        log.create_topic(TopicSpec(TOPIC, NPART))
+        first_logs = random_saga_logs(8, seed=41, min_len=2)
+        second_logs = [random_saga_log(random.Random(1000 + i), f"saga-{i}")
+                       for i in range(8, 16)]
+        # re-key the second wave onto its own ids (random_saga_logs names
+        # from 0; the helper above names explicitly)
+        cuts = [len(l) // 2 for l in first_logs]
+        append_events(log, [e for l, c in zip(first_logs, cuts)
+                            for e in l[:c]])
+        plane = make_plane(log, capacity=8)
+        await plane.start()
+        try:
+            await wait_caught_up(plane)
+            assert set(plane.resident_ids()) == {f"saga-{i}"
+                                                 for i in range(8)}
+            append_events(log, [e for l in second_logs for e in l if l])
+            await wait_caught_up(plane)
+            assert plane.stats["evictions"] > 0
+            # the first wave's suffixes re-admit the evicted rows at their
+            # spilled fold point — no re-seed, no double fold
+            append_events(log, [e for l, c in zip(first_logs, cuts)
+                                for e in l[c:]])
+            await wait_caught_up(plane)
+
+            expected = cold_restore_bytes(log)
+            folded = {agg: STATE.write_state(st).value
+                      for agg, st in plane.snapshot_states().items()}
+            assert folded == expected
+        finally:
+            await plane.stop()
+
+    asyncio.run(scenario())

@@ -176,3 +176,83 @@ def test_dom_leg_column_renders_from_trace_dumps():
     # canned fetch-only targets (no address): the column is "-"
     rows = surgetop.fleet_rows(_canned_scraper())
     assert all(r["dom-leg"] is None for r in rows)
+
+def test_chaos_sagas_panel_counts_and_verdict(capsys):
+    """chaos.py sagas: the operator panel off a live engine admin endpoint —
+    the fleet summary with the reconciliation verdict (exit 0 when ok), one
+    saga's ledger by id (exit 1 for an unknown id), and a typed error with
+    exit 1 when the engine is down. The CLI runs on a worker thread (its own
+    asyncio.run) against the engine loop staying live here."""
+    import asyncio
+
+    from surge_tpu import (SurgeCommandBusinessLogic, create_engine,
+                           default_config)
+    from surge_tpu.admin import AdminServer
+    from surge_tpu.models import counter
+    from surge_tpu.saga import (SagaDefinition, SagaManager, SagaStep,
+                                make_saga_logic)
+
+    cfg = default_config().with_overrides({
+        "surge.producer.flush-interval-ms": 5,
+        "surge.producer.ktable-check-interval-ms": 5,
+        "surge.state-store.commit-interval-ms": 20,
+        "surge.aggregate.init-retry-interval-ms": 5,
+        "surge.saga.poll-interval-ms": 10,
+    })
+    ping = SagaDefinition(
+        name="ping", def_id=1,
+        steps=(SagaStep("inc", participant="acct",
+                        target=lambda sid, s: sid,
+                        command=lambda tid, s: counter.Increment(tid),
+                        compensation=lambda tid, s: counter.Decrement(tid)),))
+
+    async def scenario():
+        log = InMemoryLog()
+        acct = create_engine(SurgeCommandBusinessLogic(
+            aggregate_name="acct", model=counter.CounterModel(),
+            state_format=counter.state_formatting(),
+            event_format=counter.event_formatting()), log=log, config=cfg)
+        saga = create_engine(make_saga_logic(), log=log, config=cfg)
+        saga.register_saga_manager(SagaManager(
+            saga, [ping], {"acct": acct, "saga": saga}, config=cfg))
+        await acct.start()
+        await saga.start()
+        admin = AdminServer(saga)
+        port = await admin.start()
+        addr = f"127.0.0.1:{port}"
+        try:
+            st = await saga.start_saga("ping-1", "ping")
+            deadline = asyncio.get_running_loop().time() + 20
+            while st["status"] not in ("completed", "compensated",
+                                       "dead-letter"):
+                assert asyncio.get_running_loop().time() < deadline, st
+                await asyncio.sleep(0.02)
+                st = await saga.saga_status("ping-1")
+            assert st["status"] == "completed"
+
+            # fleet summary: verdict ok → exit 0
+            assert await asyncio.to_thread(chaos.main, ["sagas", addr]) == 0
+            payload = json.loads(capsys.readouterr().out)
+            assert payload["ok"] and payload["counts"]["completed"] == 1
+            assert payload["violations"] == []
+            # one saga's ledger by id
+            assert await asyncio.to_thread(
+                chaos.main, ["sagas", addr, "ping-1"]) == 0
+            ledger = json.loads(capsys.readouterr().out)
+            assert ledger["status"] == "completed"
+            assert ledger["committed"] == [0]
+            # an unknown id exits 1
+            assert await asyncio.to_thread(
+                chaos.main, ["sagas", addr, "nope"]) == 1
+            capsys.readouterr()
+        finally:
+            await admin.stop()
+            await saga.stop()
+            await acct.stop()
+        return addr
+
+    addr = asyncio.run(scenario())
+    # a down engine: typed error, exit 1
+    assert chaos.main(["sagas", addr]) == 1
+    err = json.loads(capsys.readouterr().out)
+    assert "error" in err

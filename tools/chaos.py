@@ -22,6 +22,8 @@ over the broker's admin RPCs::
     python tools/chaos.py replay-ledger 127.0.0.1:7001 --last 32
     python tools/chaos.py views 127.0.0.1:7001           # per-view summary
     python tools/chaos.py views 127.0.0.1:7001 totals    # one view's rows
+    python tools/chaos.py sagas 127.0.0.1:7001           # saga counts + verdict
+    python tools/chaos.py sagas 127.0.0.1:7001 order-17  # one saga's ledger
 
 ``cluster`` drives N brokers from ONE invocation: with no flags it prints a
 per-broker summary (role, epoch, in-sync view, per-partition high-watermarks,
@@ -79,7 +81,8 @@ def main(argv=None) -> int:
     ap.add_argument("command",
                     choices=["arm", "disarm", "status", "broker", "promote",
                              "flight", "metrics", "plans", "cluster",
-                             "handoff", "fleet", "replay-ledger", "views"])
+                             "handoff", "fleet", "replay-ledger", "views",
+                             "sagas"])
     ap.add_argument("target", nargs="?",
                     help="broker host:port (cluster: comma-separated list; "
                          "handoff: the FROM broker)")
@@ -128,6 +131,8 @@ def main(argv=None) -> int:
         return _replay_ledger(args)
     if args.command == "views":
         return _views(args)
+    if args.command == "sagas":
+        return _sagas(args)
     if args.command == "fleet":
         return _fleet(args)
     if args.command == "cluster":
@@ -304,6 +309,36 @@ def _views(args) -> int:
     except Exception as exc:  # noqa: BLE001 — a down engine is the finding
         print(json.dumps({"error": str(exc)[:500]}, indent=2))
         return 1
+
+
+def _sagas(args) -> int:
+    """Saga operator panel off an ENGINE admin endpoint: the fleet summary
+    (per-status counts, in-flight/dead-letter totals, drivers) PLUS the
+    ledger-reconciliation verdict — every terminal saga must be all-steps-
+    committed XOR all-committed-steps-compensated. A violated invariant (or
+    a summary that reports not-ok) exits 1 so chaos harnesses and CI can
+    gate on it; with a saga id as the second positional the panel shows that
+    one saga's ledger instead (committed/compensated steps, attempts,
+    driver liveness) and exits 0 whenever the saga is known."""
+    import asyncio
+
+    import grpc
+
+    from surge_tpu.admin.server import AdminClient
+
+    async def fetch():
+        async with grpc.aio.insecure_channel(args.target) as channel:
+            return await AdminClient(channel).saga_status(args.plan or "")
+
+    try:
+        payload = asyncio.run(fetch())
+    except Exception as exc:  # noqa: BLE001 — a down engine is the finding
+        print(json.dumps({"error": str(exc)[:500]}, indent=2))
+        return 1
+    print(json.dumps(payload, indent=2))
+    if args.plan:  # one saga's ledger
+        return 0 if payload.get("status") != "unknown" else 1
+    return 0 if payload.get("ok") else 1
 
 
 def _fleet(args) -> int:

@@ -58,6 +58,8 @@ _COLUMNS = (
     ("v-subs", "surge_replay_views_subscribers", "{:.0f}"),
     ("entities", "surge_engine_live_entities", "{:.0f}"),
     ("cmd/s", "surge_engine_command_rate_one_minute_rate", "{:.1f}"),
+    # saga plane: in-flight saga drivers on the manager's engine
+    ("sagas", "surge_saga_active", "{:.0f}"),
 )
 
 
