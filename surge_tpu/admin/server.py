@@ -51,6 +51,11 @@ METHODS = {
     # MetricsReply as JSON
     "StartSaga": (pb.ComponentRequest, pb.MetricsReply),
     "SagaStatus": (pb.ComponentRequest, pb.MetricsReply),
+    # consistency observatory (surge_tpu.observability.audit): the auditor's
+    # verdict — ok flag, unresolved-divergence ledger, last-round detail —
+    # as JSON on MetricsReply (chaos.py audit / surgetop read this).
+    # ComponentRequest.name is unused
+    "AuditStatus": (pb.ComponentRequest, pb.MetricsReply),
     # refresh-round ledger (surge_tpu.replay.ledger): the device
     # observatory's per-round padding-waste / per-stage anatomy in the same
     # merge-ready flight envelope (role "ledger"), with the roofline summary
@@ -239,6 +244,16 @@ class AdminServer:
         summary + reconciliation verdict (empty name)."""
         try:
             status = await self.engine.saga_status(request.name or "")
+            return pb.MetricsReply(metrics_json=json.dumps(status).encode())
+        except Exception as exc:  # noqa: BLE001 — errors ride the reply
+            return pb.MetricsReply(
+                metrics_json=json.dumps({"error": repr(exc)}).encode())
+
+    async def AuditStatus(self, request, context) -> pb.MetricsReply:
+        """The consistency auditor's verdict: ``ok`` plus the unresolved
+        ledger and last-round detail (``chaos.py audit`` exits on ``ok``)."""
+        try:
+            status = self.engine.audit_status()
             return pb.MetricsReply(metrics_json=json.dumps(status).encode())
         except Exception as exc:  # noqa: BLE001 — errors ride the reply
             return pb.MetricsReply(
@@ -471,6 +486,15 @@ class AdminClient:
         r = await self._calls["SagaStatus"](pb.ComponentRequest(name=saga_id))
         out = json.loads(r.metrics_json)
         if "error" in out and "saga_id" not in out and "counts" not in out:
+            raise RuntimeError(out["error"])
+        return out
+
+    async def audit_status(self) -> dict:
+        """The consistency auditor's verdict (``ok``, unresolved ledger,
+        last-round detail); raises when the auditor is not enabled."""
+        r = await self._calls["AuditStatus"](pb.ComponentRequest())
+        out = json.loads(r.metrics_json)
+        if "error" in out and "ok" not in out:
             raise RuntimeError(out["error"])
         return out
 

@@ -410,6 +410,17 @@ DEFAULTS: dict[str, Any] = {
     "surge.saga.compensation-max-attempts": 6,
     "surge.saga.poll-interval-ms": 50,
     "surge.saga.max-concurrent": 512,
+    # --- consistency observatory (observability/audit.py) ---
+    # opt-in: the auditor is a supervised Controllable the engine only
+    # starts when enabled. interval paces cycles; cohort-size bounds the
+    # aggregates shadow-replayed per cycle; digest-enabled gates the
+    # cross-replica digest compare; dedup-probe gates the exactly-once
+    # replay probe (skipped automatically on transports without a seq gate)
+    "surge.audit.enabled": False,
+    "surge.audit.interval-ms": 2_000,
+    "surge.audit.cohort-size": 8,
+    "surge.audit.digest-enabled": True,
+    "surge.audit.dedup-probe": True,
 }
 
 

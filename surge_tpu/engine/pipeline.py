@@ -240,9 +240,14 @@ class SurgeEngine(Controllable):
                 # observatory while the surge.replay.profile.* histograms
                 # stay opt-in behind a DEBUG registry (sensor-level gating)
                 from surge_tpu.replay.profiler import ReplayProfiler
+                # engine-side fault plane (surge.log.faults.plan): arms the
+                # corrupt.slab-row site for the corruption-to-page e2e; None
+                # (the default) keeps every fault check a no-op
+                from surge_tpu.testing.faults import FaultPlane
 
                 self.resident_plane = ResidentStatePlane(
                     self.log, logic.events_topic, spec, config=self.config,
+                    faults=FaultPlane.from_config(self.config),
                     partitions=[],  # assigned at start (follows the indexer)
                     deserialize_event=self._deserialize_event,
                     deserialize_events=batch_read,
@@ -261,6 +266,24 @@ class SurgeEngine(Controllable):
                     metrics=self.metrics, ledger=self.replay_ledger,
                     flight=self.flight)
                 self.resident_plane.attach_views(self.views)
+        # consistency observatory (observability/audit.py): shadow-replays a
+        # rotating cohort of resident rows against a from-scratch log refold,
+        # compares cross-replica chained log digests, and probes the
+        # exactly-once gate — findings page via the state-divergence SLO.
+        # Digest peers join post-construction (engine.auditor.add_digest_peer)
+        # since only cluster wiring knows the replica set.
+        self.auditor = None
+        if (self.resident_plane is not None
+                and self.config.get_bool("surge.audit.enabled")):
+            from surge_tpu.observability.audit import ConsistencyAuditor
+
+            self.auditor = ConsistencyAuditor(
+                self.resident_plane, log=self.log, config=self.config,
+                metrics=self.metrics, flight=self.flight,
+                on_signal=self.health_bus.signal_fn("consistency-auditor"))
+            self.auditor.set_digest_targets(
+                [(logic.events_topic, p)
+                 for p in range(self.log.num_partitions(logic.events_topic))])
         self.checkpoint_writer = None
         ckpt_path = self.config.get_str("surge.store.checkpoint.path", "")
         if ckpt_path and logic.events_topic:
@@ -332,6 +355,12 @@ class SurgeEngine(Controllable):
                 self.health_supervisor.register(
                     "saga-manager", self.saga_manager,
                     restart_patterns=[RegexMatcher(r"saga-manager.*fatal")])
+            if self.auditor is not None:
+                await self.auditor.start()
+                self.health_supervisor.register(
+                    "consistency-auditor", self.auditor,
+                    restart_patterns=[
+                        RegexMatcher(r"consistency-auditor.*fatal")])
             if not self._external_tracker and not self.tracker.assignments.assignments:
                 # single-node mode: self-assign every partition (no external control
                 # plane; multi-node engines share an externally-updated tracker)
@@ -377,6 +406,8 @@ class SurgeEngine(Controllable):
         self.health_supervisor.stop()
         if self.loop_prober is not None:
             await self.loop_prober.stop()
+        if self.auditor is not None:
+            await self.auditor.stop()
         if self.saga_manager is not None:
             await self.saga_manager.stop()
         await self.router.stop()  # stops regions (shards + publishers)
@@ -440,6 +471,14 @@ class SurgeEngine(Controllable):
         if saga_id:
             return await self.saga_manager.status(saga_id)
         return self.saga_manager.summary()
+
+    def audit_status(self) -> dict:
+        """Admin-plane delegate: the consistency auditor's verdict
+        (``ok`` is False while any divergence is unresolved)."""
+        if self.auditor is None:
+            raise RuntimeError("consistency auditor not enabled on this "
+                               "engine (surge.audit.enabled)")
+        return self.auditor.summary()
 
     def register_rebalance_listener(self, listener: Callable) -> None:
         """listener(assignments, changes) on every tracker update
@@ -570,6 +609,10 @@ class SurgeEngine(Controllable):
             components.append(HealthCheck(
                 name="resident-plane",
                 status="up" if self.resident_plane.running else "degraded"))
+        if self.auditor is not None:
+            # degraded-not-down while a divergence is unresolved: the page
+            # means "read the flight timeline", never "restart over it"
+            components.append(self.auditor.health_component())
         return HealthCheck(
             name=self.logic.aggregate_name,
             status="up" if self.status == EngineStatus.RUNNING else "down",

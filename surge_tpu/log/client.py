@@ -165,6 +165,29 @@ class GrpcTxnProducer:
         _raise_for(reply)
         return _reply_records(reply)
 
+    def replay_commit(self, records: Sequence[LogRecord],
+                      seq: Optional[int] = None) -> Sequence[LogRecord]:
+        """Re-ship an ALREADY-ACKED commit with its original seq — the
+        consistency auditor's dedup probe. A healthy broker answers from its
+        dedup window (cached reply: same offsets as the original ack); a
+        broker that appends again has a dedup-window hole. ``seq`` defaults
+        to the last acked sequence (``_next_seq - 1``) and the counter does
+        NOT advance — this is a replay, not a new commit."""
+        if seq is None:
+            seq = self._next_seq - 1
+        if seq < 1:
+            raise TransactionStateError("no acked commit to replay")
+        try:
+            reply = self._transport._transact(self._token, "commit",
+                                              list(records), seq=seq,
+                                              generation=self._generation)
+        except ProducerFencedError:
+            self._fenced = True
+            raise
+        self._check_fence(reply)
+        _raise_for(reply)
+        return _reply_records(reply)
+
     def commit_pipelined(self) -> PipelinedCommit:
         """Dispatch the buffered transaction without awaiting the reply."""
         if self._buffer is None:
@@ -818,6 +841,22 @@ class GrpcLogTransport:
         reply = self._invoke("ArmFaults", req)
         if not reply.ok:
             raise RuntimeError(f"ArmFaults({op}) failed: {reply.error}")
+        return json.loads(reply.records[0].value)
+
+    def partition_digest(self, topic: str, partition: int,
+                         upto: Optional[int] = None) -> dict:
+        """The connected broker's chained digest over ``[base, upto)`` of one
+        partition (surge_tpu.log.digest) — ``upto`` rides ReadRequest's
+        ``from_offset`` (None/0 = the broker's durable end). The auditor
+        compares leader vs follower digests at the same ``upto`` below the
+        hwm without shipping a single record."""
+        import json
+
+        reply = self._invoke("PartitionDigest", pb.ReadRequest(
+            topic=topic, partition=partition,
+            from_offset=0 if upto is None else int(upto)))
+        if not reply.ok:
+            raise RuntimeError(f"PartitionDigest failed: {reply.error}")
         return json.loads(reply.records[0].value)
 
     def compact_topic(self, topic: str, partition: int) -> dict:

@@ -511,6 +511,10 @@ class FileLog(LogBase):
                     logger.exception("journal rotation failed; will retry")
             self._mark_durable(marks)
             self._notify_append(touched)
+            # eager digest maintenance (outside the log lock; the broker's
+            # native Transact path never reaches here — its records are
+            # chained lazily by partition_digest's catch-up read)
+            self._digest_observe(out)
         return out
 
     def _mark_durable(self, marks) -> None:
@@ -1493,7 +1497,9 @@ class FileLog(LogBase):
                     os.unlink(old_path)
             except OSError:
                 pass
-            return dropped
+        if self._digests is not None:
+            self._digests.on_truncate(topic, partition, to_offset)
+        return dropped
 
     # -- compaction ---------------------------------------------------------------------
 
@@ -1624,6 +1630,8 @@ class FileLog(LogBase):
             os.unlink(old_path)
         except OSError:
             pass
+        if self._digests is not None:
+            self._digests.on_compact(topic, partition, frontier_off)
         return stats(clean_size, len(retained), time.perf_counter() - t0)
 
     def _write_manifest_entry(self, topic: str, partition: int,

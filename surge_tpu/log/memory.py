@@ -38,6 +38,10 @@ class LogBase:
     _topics: Dict[str, TopicSpec]
     _epochs: Dict[str, int]
     _auto_create_partitions: int
+    #: lazily-created chained digest index (surge_tpu.log.digest) — None until
+    #: the first partition_digest query, so the append path pays one attribute
+    #: check when nobody audits
+    _digests = None
 
     def topic(self, name: str) -> TopicSpec:
         with self._lock:
@@ -92,6 +96,36 @@ class LogBase:
         clean = getattr(self, "_clean", {})
         end, count = clean.get((topic, partition), (0, 0))
         return {"clean_end": end, "clean_count": count}
+
+    # -- chained digests (the consistency auditor's integrity sensor) -------------------
+
+    def partition_digest(self, topic: str, partition: int,
+                         upto: Optional[int] = None) -> dict:
+        """Chained CRC digest over ``[clean-base, upto)`` of one partition
+        (surge_tpu.log.digest module doc). ``upto`` defaults to — and is
+        clamped at — the durable end offset, so leader and follower compare
+        at the same offset below the high-watermark without shipping
+        records. Creates the digest index on first use; thereafter the
+        append paths maintain it eagerly and queries fold only the delta."""
+        idx = self._digests
+        if idx is None:
+            from surge_tpu.log.digest import DigestIndex
+
+            with self._lock:
+                if self._digests is None:
+                    self._digests = DigestIndex(self)
+                idx = self._digests
+        end = self.end_offset(topic, partition)
+        upto = end if upto is None else min(int(upto), end)
+        return idx.digest_at(topic, partition, upto)
+
+    def _digest_observe(self, records) -> None:
+        """Eager digest hook — call OUTSIDE the log lock (the digest index
+        reads the log under its own lock for catch-up; the only permitted
+        ordering is digest-lock → log-lock)."""
+        idx = self._digests
+        if idx is not None and records:
+            idx.observe(records)
 
     def _notify_append(self, touched) -> None:
         for tp in touched:
@@ -181,6 +215,7 @@ class InMemoryLog(LogBase):
                 out.append(assigned)
                 touched.add(key)
         self._notify_append(touched)
+        self._digest_observe(out)
         return out
 
     # -- reads --------------------------------------------------------------------------
@@ -242,6 +277,7 @@ class InMemoryLog(LogBase):
                         self._latest[key][r.key] = r
                 touched.add(key)
         self._notify_append(touched)
+        self._digest_observe(records)
         return list(records)
 
     # -- failover truncation ------------------------------------------------------------
@@ -275,7 +311,9 @@ class InMemoryLog(LogBase):
             clean_end, clean_count = self._clean.get(key, (0, 0))
             if clean_end > to_offset:
                 self._clean[key] = (to_offset, min(clean_count, len(part)))
-            return len(dropped)
+        if self._digests is not None:
+            self._digests.on_truncate(topic, partition, to_offset)
+        return len(dropped)
 
     def latest_by_key(self, topic: str, partition: int,
                       isolation: str = "read_committed") -> Mapping[str, LogRecord]:
@@ -320,12 +358,16 @@ class InMemoryLog(LogBase):
             self._partitions[key] = retained
             self._clean[key] = (frontier, len(retained) - len(tail))
             bytes_after = sum(_record_bytes(r) for r in retained)
-            return CompactionStats(
-                topic=topic, partition=partition,
-                records_before=before, records_after=len(retained),
-                bytes_before=bytes_before, bytes_after=bytes_after,
-                tombstones_dropped=dropped_tombstones,
-                duration_s=time.perf_counter() - t0)
+        if self._digests is not None and len(retained) != before:
+            # only a pass that dropped records invalidates the chain; a clean
+            # pass leaves the stored bytes (and the digest) untouched
+            self._digests.on_compact(topic, partition, frontier)
+        return CompactionStats(
+            topic=topic, partition=partition,
+            records_before=before, records_after=len(retained),
+            bytes_before=bytes_before, bytes_after=bytes_after,
+            tombstones_dropped=dropped_tombstones,
+            duration_s=time.perf_counter() - t0)
 
 
 def _record_bytes(r: LogRecord) -> int:

@@ -243,9 +243,16 @@ METHODS = {
     #   envelope discipline as DumpFlight: mono↔wall header pair for skew-
     #   proof cross-process assembly, observability/anatomy.py);
     #   ReadRequest.max_records (has_max) limits to the newest N kept traces.
+    # PartitionDigest — the consistency auditor's cross-replica integrity
+    #   sensor: ReadRequest names (topic, partition) and from_offset carries
+    #   the compare offset `upto` (0 = the durable end); the reply record's
+    #   value answers the chained-digest JSON {"topic", "partition", "upto",
+    #   "base", "chained", "digest"} (surge_tpu.log.digest) so leader and
+    #   follower compare at the same offset without shipping records.
     "GetMetricsText": (pb.ListTopicsRequest, pb.TxnReply),
     "DumpFlight": (pb.ReadRequest, pb.TxnReply),
     "DumpTraces": (pb.ReadRequest, pb.TxnReply),
+    "PartitionDigest": (pb.ReadRequest, pb.TxnReply),
     # quorum cluster plane (message reuse, same convention as above):
     # VoteLeader — txn_seq carries the CANDIDATE epoch, records[0].value a
     #   JSON {"candidate": addr, "leader": presumed-dead addr}; the reply
@@ -2137,6 +2144,12 @@ class LogServer:
                     to_apply.append(msg_to_record(m))
                     expected[tp] += 1
                 if to_apply:
+                    if self.faults is not None:
+                        # corrupt.segment-payload: rot one ingested record's
+                        # value — a silent below-hwm replica divergence only
+                        # the cross-replica digest compare can see
+                        to_apply = self.faults.corrupt_records(
+                            "corrupt.segment-payload", to_apply)
                     # verbatim ingest: leader-assigned offsets AND timestamps
                     # preserved, so replica segments converge byte-identically
                     # (the compaction barrier's golden-compare rests on this)
@@ -3469,6 +3482,25 @@ class LogServer:
         return pb.TxnReply(ok=True, records=[pb.RecordMsg(
             has_key=True, key="traces", has_value=True,
             value=_json.dumps(self.trace_ring.dump(last)).encode())])
+
+    def PartitionDigest(self, request: pb.ReadRequest,
+                        context) -> pb.TxnReply:
+        """Chained per-partition digest (surge_tpu.log.digest): the
+        consistency auditor compares leader vs follower answers at the same
+        ``upto`` (ReadRequest.from_offset; 0 = this broker's durable end)
+        below the high-watermark without shipping records. Incremental: the
+        backend folds only the records appended since its last answer."""
+        import json as _json
+
+        try:
+            upto = request.from_offset if request.from_offset > 0 else None
+            digest = self.log.partition_digest(request.topic,
+                                               request.partition, upto)
+        except Exception as exc:  # noqa: BLE001 — an audit probe must answer
+            return pb.TxnReply(ok=False, error_kind="other", error=repr(exc))
+        return pb.TxnReply(ok=True, records=[pb.RecordMsg(
+            has_key=True, key="digest", has_value=True,
+            value=_json.dumps(digest).encode())])
 
     def PromoteFollower(self, request: pb.TxnRequest, context) -> pb.TxnReply:
         import json as _json

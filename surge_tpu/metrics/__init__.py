@@ -324,6 +324,15 @@ class EngineMetrics:
     saga_compensated: Sensor = field(init=False)
     saga_dead_letter: Sensor = field(init=False)
     saga_step_timer: Timer = field(init=False)
+    # consistency observatory (surge_tpu.observability.audit): the shadow-
+    # replay / digest-compare / dedup-probe findings and cadence
+    audit_rounds: Sensor = field(init=False)
+    audit_cohort_size: Sensor = field(init=False)
+    audit_divergent_rows: Sensor = field(init=False)
+    audit_digest_mismatches: Sensor = field(init=False)
+    audit_dedup_holes: Sensor = field(init=False)
+    audit_unresolved: Sensor = field(init=False)
+    audit_round_timer: Timer = field(init=False)
 
     def __post_init__(self) -> None:
         m, MI = self.registry, MetricInfo
@@ -616,6 +625,33 @@ class EngineMetrics:
             "surge.saga.step-timer",
             "ms per saga step dispatch (forward or compensation), command "
             "send to participant ack"))
+        self.audit_rounds = m.counter(MI(
+            "surge.audit.rounds",
+            "consistency-audit cycles completed (shadow replay + digest "
+            "compare + dedup probe)"))
+        self.audit_cohort_size = m.gauge(MI(
+            "surge.audit.cohort-size",
+            "resident aggregates shadow-replayed in the last audit cycle"))
+        self.audit_divergent_rows = m.counter(MI(
+            "surge.audit.divergent-rows",
+            "live slab rows whose bytes diverged from their shadow refold "
+            "(state corruption findings; fenced against evict/re-admit and "
+            "rebalance races)"))
+        self.audit_digest_mismatches = m.counter(MI(
+            "surge.audit.digest-mismatches",
+            "cross-replica chained-digest compares that disagreed at the "
+            "same offset below the high-watermark (replica log divergence)"))
+        self.audit_dedup_holes = m.counter(MI(
+            "surge.audit.dedup-holes",
+            "dedup probes where replaying a recently-acked txn_seq was "
+            "ACCEPTED instead of answered from the dedup window"))
+        self.audit_unresolved = m.gauge(MI(
+            "surge.audit.unresolved-divergences",
+            "divergences found and not yet re-verified clean (drives the "
+            "state-divergence SLO; 0 on a healthy fleet)"))
+        self.audit_round_timer = m.timer(MI(
+            "surge.audit.round-timer",
+            "ms per consistency-audit cycle, sample to verdict"))
         # Deprecation aliases for the r4 renames (ADVICE r4): dashboards keyed
         # to the old identifiers — including a timer's .min/.max/.p99
         # sub-metrics — keep working for a release window; the alias providers

@@ -1,0 +1,432 @@
+"""The consistency observatory — online audits that page when state rots.
+
+Every consistency proof in this repo lives in tests; a production fleet has
+rich *performance* observability but no runtime evidence that the resident
+slab still byte-matches the log, that leader and follower logs agree inside
+the high-watermark, or that the dedup window would still absorb a replay.
+:class:`ConsistencyAuditor` is that missing correctness half: a supervised
+Controllable (the autobalancer's lifecycle shape) whose every cycle runs
+three independent probes —
+
+1. **Shadow replay.** A rotating cohort of resident aggregates is pulled
+   from the live slab in ONE gather (``ResidentStatePlane.audit_pull`` — the
+   (row, ordinal) pairs are atomic w.r.t. fold commits), then re-folded from
+   the log from scratch through the SAME device fold that built them
+   (``shadow_replay_rows``), and byte-compared field by field. Fencing (the
+   views-fold discipline) keeps churn from false-positivizing: findings are
+   discarded at verdict time when the aggregate left the slab, its
+   partition's anchor generation moved (rebalance / re-grant), or the
+   watermark went backwards (failover truncation) while the refold flew;
+   an aggregate whose log prefix no longer covers its ordinal (compaction)
+   is *unverifiable*, never divergent.
+2. **Cross-replica digest compare.** For each audited (topic, partition)
+   the auditor asks every registered peer broker for its chained digest
+   (``PartitionDigest`` RPC → :mod:`surge_tpu.log.digest`) at one common
+   offset — the minimum high-watermark across peers — and flags any
+   disagreement. Unequal chain bases (compaction skew between replicas) are
+   incomparable and skipped; the replication compaction barrier reconverges
+   them. No records ship: two CRCs cross the wire per partition.
+3. **Dedup probe.** The auditor commits one tiny record to its own probe
+   topic through a real transactional producer, then re-ships the SAME
+   txn_seq via ``replay_commit`` — a healthy broker answers from its dedup
+   window with the original offsets (REPLAY); fresh offsets mean the
+   exactly-once gate has a hole. Transports without a wire seq gate
+   (in-memory) are *unsupported* and skipped, never counted as holes.
+
+Findings land everywhere an operator looks: ``surge.audit.*`` instruments,
+an ``audit.divergence`` flight event (merge-ready — the incident timeline
+names the divergent aggregate/partition next to the fault that caused it),
+the ``state-divergence`` DEFAULT_SLOS objective (driven by the
+``surge.audit.unresolved-divergences`` gauge — a finding burns the budget
+until the same check re-verifies clean), a degraded-not-down health
+component, ``chaos.py audit`` and the ``surgetop`` audit column.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from surge_tpu.common import Ack, BackgroundTask, Controllable, logger
+from surge_tpu.config import Config, default_config
+from surge_tpu.health import HealthCheck
+from surge_tpu.log.transport import LogRecord, page_keyed_records
+
+__all__ = ["ConsistencyAuditor", "PROBE_TOPIC"]
+
+#: the dedup probe's private topic — one tiny record per probing cycle,
+#: committed through the real gate (never an aggregate topic: the probe
+#: must not perturb state it audits)
+PROBE_TOPIC = "__audit_probe"
+
+
+class ConsistencyAuditor(Controllable):
+    """Supervised consistency-audit loop (module doc). Construct with the
+    engine's resident plane + log; digest peers join via
+    :meth:`add_digest_peer`; ``cycle()`` is directly awaitable for
+    deterministic tests."""
+
+    def __init__(self, plane=None, log=None, config: Config | None = None,
+                 metrics=None, flight=None, on_signal=None) -> None:
+        self.plane = plane
+        self.log = log if log is not None else getattr(plane, "log", None)
+        cfg = config or default_config()
+        self._interval = max(cfg.get_seconds("surge.audit.interval-ms",
+                                             2_000), 0.01)
+        self._cohort = max(cfg.get_int("surge.audit.cohort-size", 8), 1)
+        self._digest_enabled = cfg.get_bool("surge.audit.digest-enabled",
+                                            True)
+        self._dedup_probe = cfg.get_bool("surge.audit.dedup-probe", True)
+        self.metrics = metrics  # EngineMetrics (surge.audit.*) or None
+        self.flight = flight  # FlightRecorder: findings join the timeline
+        self.on_signal = on_signal or (lambda name, level: None)
+        #: [(name, client)] — clients exposing partition_digest(+ either
+        #: high_watermark or end_offset); ≥2 make the compare meaningful
+        self._digest_peers: List[Tuple[str, object]] = []
+        #: [(topic, partition)] compared each cycle (engine wiring defaults
+        #: this to the events topic's partitions)
+        self._digest_targets: List[Tuple[str, int]] = []
+        #: open findings keyed ("state", agg) / ("digest", topic, part) /
+        #: ("dedup", "probe") — an entry resolves when its check re-verifies
+        #: clean; len() drives the state-divergence SLO gauge
+        self.unresolved: Dict[tuple, dict] = {}
+        self.stats = {"cycles": 0, "cohort_rows": 0, "divergent_rows": 0,
+                      "unverifiable_rows": 0, "digest_compares": 0,
+                      "digest_mismatches": 0, "dedup_probes": 0,
+                      "dedup_holes": 0, "skipped_cycles": 0}
+        self.last_round: dict = {}
+        self._cursor = 0  # cohort rotation position
+        self._probe_producer = None
+        self._probe_n = 0
+        self._task: Optional[BackgroundTask] = None
+        self._running = False
+
+    # -- lifecycle (Controllable) -------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    async def start(self) -> Ack:
+        if self._running:
+            return Ack()
+        self._task = BackgroundTask(self._audit_loop, "consistency-audit")
+        self._task.start()
+        self._running = True
+        return Ack()
+
+    async def stop(self) -> Ack:
+        self._running = False
+        if self._task is not None:
+            await self._task.stop()
+            self._task = None
+        if self._probe_producer is not None:
+            self._probe_producer = None
+        return Ack()
+
+    async def shutdown(self) -> Ack:
+        return await self.stop()
+
+    async def _audit_loop(self) -> None:
+        while True:
+            try:
+                await self.cycle()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the auditor must outlive a bad cycle
+                logger.exception("consistency-audit cycle failed")
+                try:
+                    self.on_signal("consistency-auditor.cycle-error", "error")
+                except Exception:  # noqa: BLE001
+                    logger.exception("on_signal failed")
+            await asyncio.sleep(self._interval)
+
+    # -- peers / targets ----------------------------------------------------------------
+
+    def add_digest_peer(self, name: str, client) -> None:
+        """Register one broker's client for the digest compare. The client
+        needs ``partition_digest(topic, partition, upto)`` plus
+        ``high_watermark`` (or ``end_offset``) — both
+        :class:`~surge_tpu.log.client.GrpcLogTransport` and the in-process
+        log backends qualify."""
+        self._digest_peers.append((name, client))
+
+    def set_digest_targets(self, targets: Sequence[Tuple[str, int]]) -> None:
+        self._digest_targets = [(t, int(p)) for t, p in targets]
+
+    # -- one audit cycle ----------------------------------------------------------------
+
+    async def cycle(self) -> dict:
+        """One full audit round: shadow replay + digest compare + dedup
+        probe. Returns the round verdict (also kept as ``last_round``)."""
+        t0 = time.perf_counter()
+        out: dict = {"cohort": 0, "divergent": [], "unverifiable": 0,
+                     "digest_compared": 0, "digest_mismatches": [],
+                     "dedup": "skipped", "skipped": None}
+        loop = asyncio.get_running_loop()
+        await self._shadow_audit(out, loop)
+        if self._digest_enabled and len(self._digest_peers) >= 2 \
+                and self._digest_targets:
+            mismatches, compared = await loop.run_in_executor(
+                None, self._digest_audit_sync)
+            out["digest_compared"] = compared
+            self.stats["digest_compares"] += compared
+            for m in mismatches:
+                key = ("digest", m["topic"], m["partition"])
+                out["digest_mismatches"].append(m)
+                self.stats["digest_mismatches"] += 1
+                if self.metrics is not None:
+                    self.metrics.audit_digest_mismatches.record()
+                self._find(key, kind="digest", **m)
+            found = {(m["topic"], m["partition"]) for m in mismatches}
+            for t, p in self._digest_targets:
+                if (t, p) not in found:
+                    self._resolve(("digest", t, p))
+        if self._dedup_probe and self.log is not None:
+            verdict = await loop.run_in_executor(None, self._probe_sync)
+            out["dedup"] = verdict
+            if verdict in ("replayed", "hole"):
+                self.stats["dedup_probes"] += 1
+            if verdict == "hole":
+                self.stats["dedup_holes"] += 1
+                if self.metrics is not None:
+                    self.metrics.audit_dedup_holes.record()
+                self._find(("dedup", "probe"), kind="dedup",
+                           detail="replayed acked seq was ACCEPTED "
+                                  "(dedup-window hole)")
+            elif verdict == "replayed":
+                self._resolve(("dedup", "probe"))
+        self.stats["cycles"] += 1
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        if self.metrics is not None:
+            self.metrics.audit_rounds.record()
+            self.metrics.audit_round_timer.record_ms(elapsed_ms)
+            self.metrics.audit_unresolved.record(len(self.unresolved))
+        out["unresolved"] = len(self.unresolved)
+        out["elapsed_ms"] = round(elapsed_ms, 3)
+        self.last_round = out
+        return out
+
+    # -- probe 1: shadow replay ---------------------------------------------------------
+
+    async def _shadow_audit(self, out: dict, loop) -> None:
+        plane = self.plane
+        if plane is None or not getattr(plane, "_seeded", False):
+            return
+        ids = sorted(plane._dir)
+        if not ids:
+            return
+        n = min(self._cohort, len(ids))
+        start = self._cursor % len(ids)
+        cohort = [ids[(start + i) % len(ids)] for i in range(n)]
+        self._cursor = (start + n) % len(ids)
+        # ONE on-loop, await-free block: generations + watermarks + the live
+        # (row, ordinal) pairs all describe the same fold state — the pull is
+        # a single device gather against the pinned slab
+        gens = dict(plane._anchor_gen)
+        wms = dict(plane._watermarks)
+        part_of = {a: plane._agg_part.get(a) for a in cohort}
+        try:
+            pulled = plane.audit_pull(cohort)
+        except Exception as exc:  # noqa: BLE001
+            if "delet" in str(exc).lower():
+                # a donated refresh dispatch consumed the gathered buffers
+                # mid-pull: a liveness race, not a finding — skip the cycle
+                out["skipped"] = "slab-donation-race"
+                self.stats["skipped_cycles"] += 1
+                return
+            raise
+        out["cohort"] = len(pulled)
+        self.stats["cohort_rows"] += len(pulled)
+        if self.metrics is not None:
+            self.metrics.audit_cohort_size.record(len(pulled))
+        if not pulled:
+            return
+        try:
+            verdicts, unverifiable = await loop.run_in_executor(
+                None, self._shadow_verify, pulled, part_of, wms)
+        except Exception:  # noqa: BLE001 — a failover mid-scan is liveness
+            logger.exception("shadow verify failed (transient log read?) — "
+                             "cycle skipped")
+            out["skipped"] = "verify-error"
+            self.stats["skipped_cycles"] += 1
+            return
+        out["unverifiable"] = unverifiable
+        self.stats["unverifiable_rows"] += unverifiable
+        # verdict-time fence (on-loop again): discard anything whose ground
+        # truth moved while the refold flew — evict/re-admit, rebalance
+        # re-anchor, failover truncation are all liveness, not corruption
+        for agg, diff in verdicts:
+            p = part_of.get(agg)
+            if (p is None
+                    or plane._anchor_gen.get(p, 0) != gens.get(p, 0)
+                    or plane._watermarks.get(p, 0) < wms.get(p, 0)
+                    or agg not in plane._dir):
+                continue
+            if diff:
+                finding = {"aggregate": agg, "partition": p, "fields": diff}
+                out["divergent"].append(finding)
+                self.stats["divergent_rows"] += 1
+                if self.metrics is not None:
+                    self.metrics.audit_divergent_rows.record()
+                self._find(("state", agg), kind="state", **finding)
+            else:
+                self._resolve(("state", agg))
+
+    def _shadow_verify(self, pulled: dict, part_of: dict, wms: dict):
+        """Executor half: collect each audited aggregate's first-``ordinal``
+        events with ONE paged scan per partition, refold them through the
+        plane's device fold, byte-compare. Returns
+        ``([(agg, diff_fields)], n_unverifiable)``."""
+        plane = self.plane
+        want = {a: ordn for a, (_row, ordn) in pulled.items() if ordn > 0}
+        events: Dict[str, list] = {a: [] for a in want}
+        by_part: Dict[int, set] = {}
+        for a in want:
+            p = part_of.get(a)
+            if p is not None:
+                by_part.setdefault(p, set()).add(a)
+        for p, aggs in by_part.items():
+            remaining = set(aggs)
+            for rec in page_keyed_records(plane.log, plane.events_topic, p,
+                                          upto=wms.get(p, 0)):
+                a = rec.key
+                if a not in remaining:
+                    continue
+                try:
+                    events[a].append(plane._encode_event(rec.value))
+                except Exception:  # noqa: BLE001 — poison race: unverifiable
+                    events.pop(a, None)
+                    remaining.discard(a)
+                    continue
+                if len(events[a]) >= want[a]:
+                    remaining.discard(a)
+                    if not remaining:
+                        break
+        verify = [a for a in pulled
+                  if a in events and len(events[a]) >= want.get(a, 1 << 62)]
+        results: List[Tuple[str, list]] = []
+        if verify:
+            shadow = plane.shadow_replay_rows(
+                [events[a][: want[a]] for a in verify])
+            for j, a in enumerate(verify):
+                row = pulled[a][0]
+                diff = [k for k in sorted(shadow)
+                        if np.asarray(row[k]).tobytes()
+                        != np.asarray(shadow[k][j]).tobytes()]
+                results.append((a, diff))
+        return results, len(pulled) - len(verify)
+
+    # -- probe 2: cross-replica digest compare ------------------------------------------
+
+    @staticmethod
+    def _peer_hwm(client, topic: str, partition: int) -> int:
+        hw = getattr(client, "high_watermark", None)
+        if hw is not None:
+            return int(hw(topic, partition))
+        return int(client.end_offset(topic, partition))
+
+    def _digest_audit_sync(self):
+        """Blocking half of the digest compare (peer RPCs) — run in the
+        executor. Returns ``(mismatches, n_compared)``."""
+        mismatches: List[dict] = []
+        compared = 0
+        for topic, part in self._digest_targets:
+            try:
+                upto = min(self._peer_hwm(c, topic, part)
+                           for _n, c in self._digest_peers)
+                if upto <= 0:
+                    continue
+                digests = [(n, c.partition_digest(topic, part, upto))
+                           for n, c in self._digest_peers]
+            except Exception:  # noqa: BLE001 — an unreachable peer is not divergence
+                logger.exception("digest compare of %s[%d] failed "
+                                 "(peer unreachable?)", topic, part)
+                continue
+            if len({d["base"] for _n, d in digests}) != 1:
+                continue  # compaction skew between replicas: incomparable
+            if any(d["digest"] is None for _n, d in digests):
+                continue
+            compared += 1
+            if len({d["digest"] for _n, d in digests}) > 1:
+                mismatches.append({
+                    "topic": topic, "partition": part, "upto": upto,
+                    "digests": {n: d["digest"] for n, d in digests}})
+        return mismatches, compared
+
+    # -- probe 3: dedup probe -----------------------------------------------------------
+
+    def _probe_sync(self) -> str:
+        """Blocking half of the exactly-once probe — run in the executor.
+        Commits one record through the real gate, re-ships the SAME seq, and
+        expects the dedup window's cached reply (original offsets)."""
+        prod = self._probe_producer
+        if prod is None:
+            try:
+                self.log.topic(PROBE_TOPIC)  # auto-create
+                prod = self.log.transactional_producer("__audit-probe__")
+            except Exception:  # noqa: BLE001 — no producer plane here
+                return "unavailable"
+            self._probe_producer = prod
+        if not hasattr(prod, "replay_commit"):
+            return "unsupported"  # no wire seq gate to probe (in-memory)
+        self._probe_n += 1
+        rec = LogRecord(topic=PROBE_TOPIC, key="probe",
+                        value=b"%d" % self._probe_n)
+        try:
+            prod.begin()
+            prod.send(rec)
+            acked = prod.commit()
+            replay = prod.replay_commit([rec])
+        except Exception:  # noqa: BLE001 — a failover mid-probe is not a hole
+            self._probe_producer = None
+            return "unavailable"
+        orig = [(r.topic, r.partition, r.offset) for r in acked]
+        seen = [(r.topic, r.partition, r.offset) for r in replay]
+        return "replayed" if orig == seen else "hole"
+
+    # -- findings ledger ----------------------------------------------------------------
+
+    def _find(self, key: tuple, **info) -> None:
+        fresh = key not in self.unresolved
+        self.unresolved[key] = {**info, "cycle": self.stats["cycles"]}
+        if fresh:
+            try:
+                self.on_signal(f"audit.divergence.{info.get('kind')}",
+                               "warning")
+            except Exception:  # noqa: BLE001
+                logger.exception("on_signal failed")
+            if self.flight is not None:
+                self.flight.record("audit.divergence", **info)
+            logger.warning("consistency divergence: %s", info)
+
+    def _resolve(self, key: tuple) -> None:
+        if self.unresolved.pop(key, None) is not None and \
+                self.flight is not None:
+            self.flight.record("audit.resolved", key=list(map(str, key)))
+
+    # -- operator surface ---------------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The ``chaos.py audit`` / AuditStatus verdict: ``ok`` is False
+        while any divergence is unresolved."""
+        return {"ok": not self.unresolved,
+                "running": self._running,
+                "stats": dict(self.stats),
+                "unresolved": [
+                    {"key": list(map(str, k)), **v}
+                    for k, v in sorted(self.unresolved.items(),
+                                       key=lambda kv: str(kv[0]))],
+                "last_round": self.last_round,
+                "digest_peers": [n for n, _c in self._digest_peers],
+                "digest_targets": [[t, p] for t, p in self._digest_targets]}
+
+    def health_component(self) -> HealthCheck:
+        """Degraded while a divergence is unresolved, never down — a
+        corruption page means "go look at the flight timeline", not
+        "restart the engine over it"."""
+        return HealthCheck(name="consistency-audit",
+                           status="degraded" if self.unresolved else "up")

@@ -113,6 +113,14 @@ DEFAULT_SLOS: Tuple[SLO, ...] = (
         kind="bound", objective=0.99, threshold=1.0, op="lt",
         description="every fleet member answers its scrape (an instance "
                     "down burns this objective's budget)"),
+    SLO("state-divergence",
+        family="surge_audit_unresolved_divergences",
+        kind="bound", objective=0.99, threshold=0.0, op="gt",
+        description="the consistency auditor holds no unresolved divergence "
+                    "(slab rows byte-match their shadow refold, replica "
+                    "digests agree below the hwm, dedup probes replay) — "
+                    "any finding burns this objective until re-verified "
+                    "clean"),
 )
 
 

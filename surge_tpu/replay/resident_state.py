@@ -709,6 +709,105 @@ class ResidentStatePlane(Controllable):
                                 int(lengths[n_res + j]))
             self._agg_part[agg] = part_of[agg]
 
+    # -- consistency audit surface (observability/audit.py) -----------------------------
+
+    def audit_pull(self, agg_ids: Sequence[str]) -> Dict[str, tuple]:
+        """ONE gather of the LIVE slab rows + fold ordinals for the given
+        aggregates (the shadow-replay audit's ground truth). Call ON the
+        loop: the (row, ordinal) pairs come out of a single device gather
+        against the pinned slab, so they are atomic w.r.t. fold commits —
+        a row is always the fold of exactly its ordinal's event prefix.
+        Aggregates not resident (spilled/evicted/poisoned) are omitted;
+        returns ``{agg: ({field: scalar}, ordinal)}``."""
+        ids = [a for a in agg_ids if a in self._dir]
+        if not ids:
+            return {}
+        idx = np.fromiter((self._dir[a] for a in ids), dtype=np.int32,
+                          count=len(ids))
+        rows, ords = self._pull_positions(self._slab, idx, ords=self._ords)
+        return {a: ({k: rows[k][j] for k in rows}, int(ords[j]))
+                for j, a in enumerate(ids)}
+
+    def shadow_replay_rows(self, event_logs: List[list]
+                           ) -> Dict[str, np.ndarray]:
+        """Re-fold per-aggregate event lists FROM SCRATCH through the same
+        device fold that built the live rows (the seed path:
+        ``pack_resident`` → ``fold_resident_slab``) and pull the folded rows
+        to host — the auditor's shadow replay. Pure w.r.t. plane state: the
+        fold runs on a fresh one-shot corpus, nothing scatters into the live
+        slab. Heavy (encode + pack + device dispatch) — run in the executor.
+        Returns ``{field: np[b]}`` in ``event_logs`` order."""
+        b = len(event_logs)
+        colev = encode_events_columnar(self.spec.registry, event_logs)
+        colev.derived_cols = dict(self.derived)
+        if self.mesh is not None:
+            from surge_tpu.replay.resident_mesh import fold_resident_sharded
+
+            sharded = self.engine.prepare_resident_sharded(colev)
+            slab_dev = fold_resident_sharded(self.engine, sharded)
+            host = {k: np.asarray(v) for k, v in slab_dev.items()}
+            states = {k: np.empty((b,), dtype=self._dtypes[k]) for k in host}
+            perm = sharded.wire_host.perm
+            for d, lanes in enumerate(sharded.deals):
+                for k in states:
+                    orig = lanes if perm is None else perm[lanes]
+                    states[k][orig] = host[k][d, : len(lanes)]
+            return states
+        wire = self.engine.pack_resident(colev)
+        corpus = self.engine.upload_resident(wire)
+        corpus.cache["oneshot"] = True  # folded exactly once
+        slab_sorted, _ = self.engine.fold_resident_slab(corpus)
+        if corpus.perm is None:
+            inv = np.arange(b, dtype=np.int32)
+        else:
+            inv = np.empty((b,), dtype=np.int32)
+            inv[corpus.perm] = np.arange(b, dtype=np.int32)
+        rows, _ = self._pull_positions(slab_sorted, inv)
+        return rows
+
+    def _corrupt_resident_row(self) -> Optional[str]:
+        """Flip one bit in one LIVE resident slab row (the armed
+        ``corrupt.slab-row`` fault firing): the log stays correct, the
+        device row now lies — exactly the silent rot only the shadow-replay
+        audit can see. The row's fold ordinal is preserved (the corruption
+        must look like a validly-folded row, not an admission glitch). Flips
+        the raw top byte's sign bit so the change survives any on-wire
+        dtype narrowing. Returns the corrupted aggregate id, or None when
+        nothing is resident."""
+        if not self._dir:
+            return None
+        agg = next(iter(self._dir))
+        slot = self._dir[agg]
+        rows, ords = self._pull_positions(
+            self._slab, np.asarray([slot], dtype=np.int32), ords=self._ords)
+        victim = next((f.name for f in self._fields
+                       if f.dtype != np.bool_), self._fields[0].name)
+        k_b = _pow2(1)
+        dst_p = np.full((k_b,), self.capacity, dtype=np.int32)
+        dst_p[0] = slot
+        lens_p = np.zeros((k_b,), dtype=np.int32)
+        lens_p[0] = int(ords[0])
+        vals_p = {k: np.zeros((k_b,), dtype=self._dtypes[k]) for k in rows}
+        for k in rows:
+            v = rows[k][:1].copy()
+            if k == victim:
+                if v.dtype == np.bool_:
+                    v[0] = not v[0]
+                else:
+                    v.view(np.uint8)[-1] ^= 0x80
+            vals_p[k][0] = v[0]
+        if self._mesh_local:
+            self._slab, self._ords = self._meshp.seed_rows(
+                self._slab, self._ords, vals_p, dst_p, lens_p)
+        else:
+            slab_src = {k: self._sharded(vals_p[k]) for k in vals_p}
+            pos = np.arange(k_b, dtype=np.int32)
+            self._slab, self._ords = self._seed_scatter(
+                self._slab, self._ords, slab_src, pos, dst_p, lens_p)
+        logger.warning("fault plane corrupted resident row of %r "
+                       "(field %s)", agg, victim)
+        return agg
+
     def prime(self, watermarks: Dict[int, int]) -> None:
         """Fast-forward fold watermarks after an out-of-band seed covered the
         offsets (the :meth:`StateStoreIndexer.prime` analog — only valid
@@ -994,6 +1093,14 @@ class ResidentStatePlane(Controllable):
                        self._round_acc["dispatch_s"] * 1000.0, 3)})
         self._observe_round(n_events, feed_s, enc_s)
         self._record_gauges()
+        if (self._faults is not None
+                and self._faults.corrupt_point("corrupt.slab-row")):
+            # corruption-to-page e2e: rot one live row AFTER the round
+            # committed — the log stays right, the slab lies
+            corrupted = self._corrupt_resident_row()
+            if corrupted is not None and self.flight is not None:
+                self.flight.record("fault.corrupt", site="corrupt.slab-row",
+                                   aggregate=corrupted)
         return True
 
     def _slab_deleted(self) -> bool:

@@ -74,7 +74,7 @@ class FaultRule:
     how much of a torn write survives; ``error`` the injected message."""
 
     site: str
-    action: str
+    action: str  # drop|delay|reorder|dup|error|stall|torn|crash|corrupt
     p: float = 1.0
     times: Optional[int] = 1
     after: int = 0
@@ -134,6 +134,19 @@ NAMED_PLANS: Dict[str, Callable[[], List[FaultRule]]] = {
     # second leader minted) and the normal kill-failover path takes over
     "handoff-crash-pre-promote": lambda: [
         FaultRule(site="crash.handoff.pre-promote", action="crash")],
+    # silent state rot: flip one bit in one resident slab row at the end of
+    # the next refresh round (post-fold, post-ack — the log stays correct,
+    # the device slab lies). Only the consistency auditor's shadow replay
+    # can see this; the corruption-to-page e2e arms it and expects a
+    # state-divergence page within 3 audit cycles.
+    "corrupt.slab-row": lambda: [
+        FaultRule(site="corrupt.slab-row", action="corrupt")],
+    # silent replica rot: flip one bit in one record's payload as the NEXT
+    # replication ship is ingested on this (follower) broker — leader and
+    # follower logs diverge below the hwm with no error anywhere. Only the
+    # cross-replica digest compare can see this.
+    "corrupt.segment-payload": lambda: [
+        FaultRule(site="corrupt.segment-payload", action="corrupt")],
 }
 
 
@@ -307,6 +320,38 @@ class FaultPlane:
             self._sleep(self._hold_s(rule))
         elif rule.action == "error":
             raise RuntimeError(f"fault injected at {site}: {rule.error}")
+
+    def corrupt_point(self, site: str) -> bool:
+        """Corruption site matched on the bare name: True when an armed
+        ``corrupt`` rule fires and the caller must rot its own state (the
+        resident plane's ``corrupt.slab-row`` flips a bit in one live slab
+        row). The caller owns the mutation — the plane only decides."""
+        rule = self._match(site)
+        return rule is not None and rule.action == "corrupt"
+
+    def corrupt_records(self, site: str, records):
+        """Record-stream corruption site: when an armed ``corrupt`` rule
+        fires, returns a copy of ``records`` with one bit flipped in one
+        record's value (the replication-ingest ``corrupt.segment-payload``
+        site — the follower durably applies bytes the leader never sent).
+        Otherwise returns ``records`` unchanged."""
+        rule = self._match(site)
+        if rule is None or rule.action != "corrupt" or not records:
+            return records
+        import dataclasses
+
+        with self._lock:
+            i = self._rng.randrange(len(records))
+        victim = records[i]
+        value = victim.value or b""
+        if not value:
+            flipped = b"\x01"
+        else:
+            j = len(value) // 2
+            flipped = value[:j] + bytes([value[j] ^ 0x01]) + value[j + 1:]
+        out = list(records)
+        out[i] = dataclasses.replace(victim, value=flipped)
+        return out
 
     def crash_point(self, name: str) -> None:
         """Named crash point: fires the host's hard-stop then raises."""

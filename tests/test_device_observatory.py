@@ -173,6 +173,84 @@ def test_ledger_dump_is_a_merge_ready_flight_envelope():
     assert small.totals["rounds"] == 20  # totals survive ring eviction
 
 
+def test_ledger_ring_wrap_around_keeps_newest_and_counts_dropped():
+    """Wrap-around semantics an operator relies on mid-incident: the ring
+    keeps the NEWEST capacity rounds, the envelope's dropped counter says
+    how many fell off, and the per-round sequence stays monotonic across
+    the wrap (merge_dumps ordering survives eviction)."""
+    led = ReplayLedger(capacity=8, name="engine:t")
+    for i in range(30):
+        led.record_round(events=i, lanes=1, windows=1, dispatched=8,
+                         occupied=1, batch=8, width=1, feed_us=0.0,
+                         encode_us=0.0, dispatch_us=1.0)
+    events = led.events()
+    assert len(events) == 8
+    # newest survive, oldest dropped: rounds 22..29 by the events payload
+    assert [e["events"] for e in events] == list(range(22, 30))
+    assert [e["seq"] for e in events] == sorted(e["seq"] for e in events)
+    dump = led.dump()
+    assert dump["stats"]["dropped"] == 22
+    assert dump["stats"]["capacity"] == 8
+    assert len(dump["events"]) == 8
+    # a sub-minimum capacity clamps to the floor instead of losing rounds
+    tiny = ReplayLedger(capacity=1)
+    for i in range(10):
+        tiny.record_round(events=i, lanes=1, windows=1, dispatched=8,
+                          occupied=1, batch=8, width=1, feed_us=0.0,
+                          encode_us=0.0, dispatch_us=1.0)
+    assert len(tiny.events()) == 8  # deque floor: max(capacity, 8)
+
+
+def test_ledger_dump_last_n_truncation_bounds():
+    """The dump's tail truncation (the DumpReplayLedger ``last:N``
+    convention): N below the count keeps the newest N, N at/beyond the
+    count is the whole ring, and 0 is empty — never an error."""
+    led = ReplayLedger(capacity=16, name="engine:t")
+    for i in range(10):
+        led.record_round(events=i, lanes=1, windows=1, dispatched=8,
+                         occupied=1, batch=8, width=1, feed_us=0.0,
+                         encode_us=0.0, dispatch_us=1.0)
+    assert [e["events"] for e in led.dump(last=3)["events"]] == [7, 8, 9]
+    assert len(led.dump(last=10)["events"]) == 10
+    assert len(led.dump(last=500)["events"]) == 10  # beyond count: all
+    assert led.dump(last=0)["events"] == []
+    assert len(led.dump()["events"]) == 10  # no tail: everything
+
+
+def test_admin_dump_replay_ledger_last_n_truncates_over_the_wire():
+    """The ``last:N`` tail rides ComponentRequest.name through the REAL
+    DumpReplayLedger RPC: the reply's events are truncated server-side to
+    the newest N (an incident dump must not ship the whole ring)."""
+    import grpc
+    from types import SimpleNamespace
+
+    from surge_tpu.admin import AdminClient, AdminServer
+
+    led = ReplayLedger(capacity=64, name="engine:t")
+    for i in range(12):
+        led.record_round(events=i, lanes=1, windows=1, dispatched=8,
+                         occupied=1, batch=8, width=1, feed_us=0.0,
+                         encode_us=0.0, dispatch_us=1.0)
+
+    async def scenario():
+        admin = AdminServer(SimpleNamespace(replay_ledger=led))
+        port = await admin.start()
+        try:
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            client = AdminClient(channel)
+            payload = await client.replay_ledger_dump(last=4)
+            assert [e["events"] for e in payload["events"]] == [8, 9, 10, 11]
+            payload = await client.replay_ledger_dump(last=500)
+            assert len(payload["events"]) == 12  # beyond count: everything
+            payload = await client.replay_ledger_dump()
+            assert len(payload["events"]) == 12
+            await channel.close()
+        finally:
+            await admin.stop()
+
+    asyncio.run(scenario())
+
+
 # -- padding-waste accounting on a REAL refresh round ---------------------------------
 
 

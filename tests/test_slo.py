@@ -231,4 +231,5 @@ def test_default_slos_evaluate_over_the_fleet_golden():
     assert eng.breached() == []
     # the slo gauges joined the scraper's registry -> next render carries them
     text = render_openmetrics(scraper.metrics.registry)
-    assert "surge_slo_objectives 6" in text
+    assert f"surge_slo_objectives {len(DEFAULT_SLOS)}" in text
+    assert len(DEFAULT_SLOS) == 7  # + state-divergence (ISSUE 20)

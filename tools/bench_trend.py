@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Bench trajectory: merge the checked-in ``BENCH_*.json`` series into one table.
+
+Every bench round checks in another ``BENCH_*.json`` at the repo root —
+headline metric files (``{"metric": ..., "value": ..., "unit": ...}``, the
+paired-ladder convention), raw runner envelopes (``BENCH_rNN.json`` with
+``n``/``cmd``/``rc``/``tail``), and device smoke dumps — which makes the
+history write-only: nobody diffs twelve JSON files by hand. This tool reads
+them ALL back and renders the trajectory::
+
+    python tools/bench_trend.py                  # table, one row per file
+    python tools/bench_trend.py --format=json    # + machine verdict LAST line
+
+Rows are grouped per metric and ordered by round (the ``_rNN`` filename
+suffix, else the payload's ``round``/``n``), with the per-round delta
+against the previous round of the SAME metric — so a regression reads as a
+negative delta in one glance. The summary block (and, with ``--format=json``,
+the LAST stdout line, machine-readable for CI) reports first → last per
+metric. Raw runner envelopes contribute their exit code (``bench_exit_code``
+— a nonzero trajectory is itself a finding); files with no extractable
+number still get a row (value ``-``) so the table is the complete inventory.
+
+Exit 0 always when the scan succeeds (the table is information, not a
+verdict); 2 on bad arguments / unreadable directory.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _extract(path: str, data) -> dict:
+    """One trajectory row per file: best-effort headline metric."""
+    name = os.path.basename(path)
+    m = _ROUND_RE.search(name)
+    rnd = int(m.group(1)) if m else None
+    if rnd is None and isinstance(data, dict):
+        for k in ("round", "n"):
+            if isinstance(data.get(k), int):
+                rnd = data[k]
+                break
+    row = {"file": name, "round": rnd, "metric": None, "value": None,
+           "unit": ""}
+    if not isinstance(data, dict):
+        return row
+    if isinstance(data.get("metric"), str) and \
+            isinstance(data.get("value"), (int, float)):
+        row["metric"] = data["metric"]
+        row["value"] = data["value"]
+        row["unit"] = str(data.get("unit", ""))
+    elif isinstance(data.get("parsed"), dict) and \
+            isinstance(data["parsed"].get("value"), (int, float)):
+        row["metric"] = str(data["parsed"].get("metric", "parsed"))
+        row["value"] = data["parsed"]["value"]
+        row["unit"] = str(data["parsed"].get("unit", ""))
+    elif isinstance(data.get("rc"), int):
+        # raw runner envelope: the exit-code trajectory is the signal
+        row["metric"] = "bench_exit_code"
+        row["value"] = data["rc"]
+        row["unit"] = "rc"
+    elif isinstance(data.get("smoke"), dict):
+        # device smoke dump: best steady fold rate across swept configs
+        rates = [c.get("events_per_sec")
+                 for c in data["smoke"].get("configs", [])
+                 if isinstance(c.get("events_per_sec"), (int, float))]
+        if rates:
+            row["metric"] = "fold_events_per_sec"
+            row["value"] = max(rates)
+            row["unit"] = "events/s"
+    if row["value"] is None:
+        # paired-ladder notes (no headline key): peak median throughput
+        # anywhere in the payload — PAIRED medians only, per BENCH_NOTES
+        medians = []
+        _walk_medians(data, medians)
+        if medians:
+            row["metric"] = "commands_per_sec_median"
+            row["value"] = max(medians)
+            row["unit"] = "commands/s"
+    return row
+
+
+def _walk_medians(node, out, key="commands_per_sec_median") -> None:
+    if isinstance(node, dict):
+        v = node.get(key)
+        if isinstance(v, (int, float)):
+            out.append(v)
+        for child in node.values():
+            _walk_medians(child, out, key)
+    elif isinstance(node, list):
+        for child in node:
+            _walk_medians(child, out, key)
+
+
+def collect(root: str, pattern: str = "BENCH_*.json"):
+    """All rows, grouped per metric and ordered by round (trajectory order).
+    Returns ``(rows, series)`` — series maps metric → first/last/delta."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(root, pattern))):
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as exc:
+            rows.append({"file": os.path.basename(path), "round": None,
+                         "metric": "unreadable", "value": None,
+                         "unit": str(exc)[:80]})
+            continue
+        rows.append(_extract(path, data))
+    rows.sort(key=lambda r: (r["metric"] or "~", r["round"] or 0, r["file"]))
+    prev = {}
+    for r in rows:
+        r["delta_pct"] = None
+        if r["metric"] and isinstance(r["value"], (int, float)):
+            p = prev.get(r["metric"])
+            if p:  # nonzero previous value in the same metric series
+                r["delta_pct"] = round(100.0 * (r["value"] - p) / p, 1)
+            prev[r["metric"]] = r["value"] or None
+    series = {}
+    for r in rows:
+        if not r["metric"] or not isinstance(r["value"], (int, float)):
+            continue
+        s = series.setdefault(r["metric"], {"unit": r["unit"], "points": 0,
+                                            "first": r["value"],
+                                            "last": r["value"]})
+        s["points"] += 1
+        s["last"] = r["value"]
+    for s in series.values():
+        s["delta_pct"] = (round(100.0 * (s["last"] - s["first"]) / s["first"],
+                                1) if s["first"] else None)
+    return rows, series
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=None,
+                    help="directory holding the BENCH_*.json series "
+                         "(default: the repo root above tools/)")
+    ap.add_argument("--glob", default="BENCH_*.json",
+                    help="filename pattern to merge")
+    ap.add_argument("--format", dest="fmt", choices=["text", "json"],
+                    default="text",
+                    help="json adds the machine-readable series summary as "
+                         "the LAST stdout line")
+    args = ap.parse_args(argv)
+    root = args.dir or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..")
+    if not os.path.isdir(root):
+        print(f"not a directory: {root}", file=sys.stderr)
+        return 2
+    rows, series = collect(root, args.glob)
+    widths = (max([len(r["metric"] or "-") for r in rows] + [6]),
+              max([len(r["file"]) for r in rows] + [4]))
+    print(f"{'metric':<{widths[0]}}  {'round':>5}  {'value':>14}  "
+          f"{'delta':>7}  {'unit':<10}  file")
+    for r in rows:
+        val = (f"{r['value']:,.6g}"
+               if isinstance(r["value"], (int, float)) else "-")
+        delta = (f"{r['delta_pct']:+.1f}%"
+                 if r["delta_pct"] is not None else "-")
+        print(f"{r['metric'] or '-':<{widths[0]}}  "
+              f"{r['round'] if r['round'] is not None else '-':>5}  "
+              f"{val:>14}  {delta:>7}  {r['unit']:<10}  {r['file']}")
+    print()
+    for name, s in sorted(series.items()):
+        delta = (f"{s['delta_pct']:+.1f}%"
+                 if s["delta_pct"] is not None else "n/a")
+        print(f"{name}: {s['first']:,.6g} -> {s['last']:,.6g} {s['unit']} "
+              f"({delta} over {s['points']} points)")
+    if args.fmt == "json":
+        print(json.dumps({"files": len(rows), "series": series}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

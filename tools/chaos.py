@@ -24,6 +24,8 @@ over the broker's admin RPCs::
     python tools/chaos.py views 127.0.0.1:7001 totals    # one view's rows
     python tools/chaos.py sagas 127.0.0.1:7001           # saga counts + verdict
     python tools/chaos.py sagas 127.0.0.1:7001 order-17  # one saga's ledger
+    python tools/chaos.py audit 127.0.0.1:7001           # consistency verdict
+    python tools/chaos.py audit 127.0.0.1:7001 --format=json
 
 ``cluster`` drives N brokers from ONE invocation: with no flags it prints a
 per-broker summary (role, epoch, in-sync view, per-partition high-watermarks,
@@ -82,7 +84,7 @@ def main(argv=None) -> int:
                     choices=["arm", "disarm", "status", "broker", "promote",
                              "flight", "metrics", "plans", "cluster",
                              "handoff", "fleet", "replay-ledger", "views",
-                             "sagas"])
+                             "sagas", "audit"])
     ap.add_argument("target", nargs="?",
                     help="broker host:port (cluster: comma-separated list; "
                          "handoff: the FROM broker)")
@@ -111,6 +113,10 @@ def main(argv=None) -> int:
                          "leadership (spread clusters)")
     ap.add_argument("--last", type=int, default=None,
                     help="replay-ledger: newest N ledger rounds")
+    ap.add_argument("--format", dest="fmt", choices=["text", "json"],
+                    default="text",
+                    help="audit: text panel, or json with the machine-"
+                         "readable verdict as the LAST stdout line")
     args = ap.parse_args(argv)
 
     if args.command == "plans":
@@ -133,6 +139,8 @@ def main(argv=None) -> int:
         return _views(args)
     if args.command == "sagas":
         return _sagas(args)
+    if args.command == "audit":
+        return _audit(args)
     if args.command == "fleet":
         return _fleet(args)
     if args.command == "cluster":
@@ -338,6 +346,49 @@ def _sagas(args) -> int:
     print(json.dumps(payload, indent=2))
     if args.plan:  # one saga's ledger
         return 0 if payload.get("status") != "unknown" else 1
+    return 0 if payload.get("ok") else 1
+
+
+def _audit(args) -> int:
+    """Consistency-observatory verdict off an ENGINE admin endpoint: the
+    auditor's unresolved-divergence ledger (shadow-replay mismatches name
+    the aggregate + differing fields, digest mismatches the partition + each
+    replica's CRC, dedup holes the probe) plus cycle stats and the last
+    round's detail. ANY unresolved divergence exits 1 — the same verdict
+    convention as ``cluster``/``handoff``/``sagas``, so chaos harnesses and
+    CI gate on it. ``--format=json`` prints the full payload with the
+    machine-readable verdict as the LAST stdout line."""
+    import asyncio
+
+    import grpc
+
+    from surge_tpu.admin.server import AdminClient
+
+    async def fetch():
+        async with grpc.aio.insecure_channel(args.target) as channel:
+            return await AdminClient(channel).audit_status()
+
+    try:
+        payload = asyncio.run(fetch())
+    except Exception as exc:  # noqa: BLE001 — a down engine is the finding
+        print(json.dumps({"ok": False, "error": str(exc)[:500]}))
+        return 1
+    if args.fmt == "json":
+        # full detail first, one-line verdict LAST (machine-readable tail)
+        print(json.dumps(payload, indent=2))
+        print(json.dumps({"ok": payload.get("ok", False),
+                          "unresolved": payload.get("unresolved", [])}))
+        return 0 if payload.get("ok") else 1
+    stats = payload.get("stats", {})
+    print(f"consistency audit: {'OK' if payload.get('ok') else 'DIVERGED'} "
+          f"(cycles={stats.get('cycles', 0)} "
+          f"rows={stats.get('cohort_rows', 0)} "
+          f"divergent={stats.get('divergent_rows', 0)} "
+          f"digest_mismatches={stats.get('digest_mismatches', 0)} "
+          f"dedup_holes={stats.get('dedup_holes', 0)})")
+    for item in payload.get("unresolved", []):
+        print(f"  UNRESOLVED {':'.join(item.get('key', []))}: "
+              f"{json.dumps({k: v for k, v in item.items() if k != 'key'})}")
     return 0 if payload.get("ok") else 1
 
 

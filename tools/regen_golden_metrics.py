@@ -8,29 +8,80 @@ staleness gauges, fleet self-instruments).
 Run after an intentional change to the exposition format, any predeclared
 instrument set, or the federation merge, then update the docs/observability.md
 catalogs to match — golden and catalog are COUPLED (tests/test_exposition.py
-and surgelint's metric-catalog rule enforce both); regen all together."""
+and surgelint's metric-catalog rule enforce both); regen all together.
 
+``--check`` verifies WITHOUT writing: renders all three payloads, diffs them
+against the checked-in goldens, and exits 1 naming every drifted file (with
+the first differing line) — the CI gate that catches a stale golden the day
+an instrument changes, not the week someone remembers to regen."""
+
+import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 
-from surge_tpu.metrics.exposition import render_openmetrics  # noqa: E402
-from test_exposition import (  # noqa: E402
-    BROKER_GOLDEN_PATH,
-    GOLDEN_PATH,
-    golden_broker_metrics,
-    golden_engine_metrics,
-)
-from test_federation import FLEET_GOLDEN_PATH, golden_fleet_scrape  # noqa: E402
 
-for path, text in (
+def _renders():
+    from surge_tpu.metrics.exposition import render_openmetrics
+    from test_exposition import (
+        BROKER_GOLDEN_PATH,
+        GOLDEN_PATH,
+        golden_broker_metrics,
+        golden_engine_metrics,
+    )
+    from test_federation import FLEET_GOLDEN_PATH, golden_fleet_scrape
+
+    return (
         (GOLDEN_PATH, render_openmetrics(golden_engine_metrics().registry)),
         (BROKER_GOLDEN_PATH,
          render_openmetrics(golden_broker_metrics().registry)),
-        (FLEET_GOLDEN_PATH, golden_fleet_scrape().render())):
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        f.write(text)
-    print(f"wrote {path} ({len(text.splitlines())} lines)")
+        (FLEET_GOLDEN_PATH, golden_fleet_scrape().render()))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="verify the checked-in goldens match the canonical "
+                         "renders; exit 1 on any drift, write nothing")
+    args = ap.parse_args(argv)
+    drifted = []
+    for path, text in _renders():
+        if args.check:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    on_disk = f.read()
+            except OSError:
+                on_disk = None
+            if on_disk == text:
+                print(f"ok {path}")
+                continue
+            drifted.append(path)
+            if on_disk is None:
+                print(f"DRIFT {path}: golden missing")
+                continue
+            want, got = text.splitlines(), on_disk.splitlines()
+            for i, (w, g) in enumerate(zip(want, got), start=1):
+                if w != g:
+                    print(f"DRIFT {path}: line {i}\n  golden: {g}\n"
+                          f"  render: {w}")
+                    break
+            else:
+                print(f"DRIFT {path}: line count {len(got)} on disk vs "
+                      f"{len(want)} rendered")
+        else:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                f.write(text)
+            print(f"wrote {path} ({len(text.splitlines())} lines)")
+    if drifted:
+        print(f"{len(drifted)} golden(s) drifted — run "
+              f"tools/regen_golden_metrics.py to refresh (and sync the "
+              f"docs/observability.md catalogs)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

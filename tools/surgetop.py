@@ -60,6 +60,8 @@ _COLUMNS = (
     ("cmd/s", "surge_engine_command_rate_one_minute_rate", "{:.1f}"),
     # saga plane: in-flight saga drivers on the manager's engine
     ("sagas", "surge_saga_active", "{:.0f}"),
+    # consistency observatory: open divergences (anything > 0 is a page)
+    ("audit", "surge_audit_unresolved_divergences", "{:.0f}"),
 )
 
 
